@@ -1,0 +1,52 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Re-implements the capabilities of the reference (hutuxian/Paddle,
+PaddlePaddle ~v1.8 "Fluid": static graph + dygraph, a ~520-op library, data
+parallel / pipeline / parameter-server distribution, AMP, inference) as an
+idiomatic JAX/XLA/Pallas stack for TPU:
+
+- static Program IR traced into single XLA computations (core/),
+- eager dygraph with an autograd tape over jax.vjp (dygraph/),
+- ops as jax/lax lowerings + Pallas kernels for the hot paths (ops/,
+  kernels/),
+- distribution via jax.sharding Mesh + collectives over ICI/DCN
+  (parallel/), not NCCL/gRPC translation.
+"""
+__version__ = "0.1.0"
+
+from . import core  # noqa: F401
+from . import ops  # noqa: F401  (registers the op library)
+from .core import (Executor, Program, append_backward,  # noqa: F401
+                   default_main_program, default_startup_program,
+                   disable_static, enable_static, global_scope, gradients,
+                   in_dygraph_mode, in_static_mode, program_guard,
+                   scope_guard, Scope)
+from .layers.helper import ParamAttr  # noqa: F401
+from . import layers  # noqa: F401
+from . import optimizer  # noqa: F401
+
+
+class CPUPlace:
+    """Device tags kept for API parity with fluid.CPUPlace/CUDAPlace
+    (/root/reference/paddle/fluid/platform/place.h); jax/XLA owns actual
+    placement."""
+
+
+class TPUPlace:
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+
+CUDAPlace = TPUPlace  # scripts written against the reference keep working
+
+
+def set_global_seed(seed: int):
+    """Seed the static executor RNG chain + dygraph RNG."""
+    default_main_program().random_seed = seed
+    from .core.scope import global_scope as _gs
+    from .core.executor import RNG_VAR
+    import jax
+    _gs().set(RNG_VAR, jax.random.PRNGKey(seed))
+
+
+seed = set_global_seed

@@ -1,0 +1,128 @@
+"""Static-graph autodiff: append_backward / gradients.
+
+API analog of /root/reference/python/paddle/fluid/backward.py
+(append_backward:1215, gradients:1742). The reference walks the op list and
+appends one grad OpDesc per forward op via C++-registered GradOpMakers; the
+TPU-native design instead appends a single `backward` meta-op whose lowering
+(core/executor.py:_lower_backward) differentiates the traced forward section
+with jax.grad — XLA sees one fused forward+backward computation, which is
+both simpler and faster than per-op grad kernels.
+
+Recompute segments (reference backward.py:37 ProgramStats,
+:145 modify_forward_desc_for_recompute) are carried as op-index ranges in the
+backward op's `remat_segments` attr and lowered with jax.checkpoint.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from . import dtypes
+from .executor import BACKWARD_OP, GRAD_SUFFIX
+from .program import Program, VarDesc, default_main_program
+
+
+def _var_name(v) -> str:
+    return v.name if isinstance(v, VarDesc) else str(v)
+
+
+def append_backward(loss, parameter_list: Optional[Sequence] = None,
+                    no_grad_set: Optional[set] = None,
+                    checkpoints: Optional[Sequence] = None,
+                    program: Optional[Program] = None,
+                    loss_scale: float = 1.0,
+                    ) -> List[Tuple[VarDesc, VarDesc]]:
+    """Append the backward meta-op computing d(loss)/d(param) for every
+    trainable parameter; returns [(param, grad)] like the reference
+    (backward.py:1215).
+    """
+    program = program or default_main_program()
+    block = program.global_block
+    loss_name = _var_name(loss)
+    no_grad = {_var_name(v) for v in (no_grad_set or set())}
+
+    if parameter_list is not None:
+        params = [_var_name(p) for p in parameter_list]
+    else:
+        params = [v.name for v in program.all_parameters()
+                  if v.trainable and not v.stop_gradient]
+    params = [p for p in params if p not in no_grad
+              and dtypes.is_float(block.var(p).dtype)]
+
+    remat_segments = []
+    if checkpoints:
+        remat_segments = _segments_from_checkpoints(block, checkpoints)
+
+    grad_names = []
+    for p in params:
+        pv = block.var(p)
+        g = block.create_var(p + GRAD_SUFFIX, shape=pv.shape, dtype=pv.dtype,
+                             stop_gradient=True)
+        grad_names.append(g.name)
+
+    block.append_op(
+        BACKWARD_OP,
+        inputs={"Loss": [loss_name]},
+        outputs={"Grads": grad_names},
+        attrs={"parameter_list": params,
+               "loss_scale": loss_scale,
+               "remat_segments": remat_segments})
+
+    return [(block.var(p), block.var(p + GRAD_SUFFIX)) for p in params]
+
+
+def gradients(targets, inputs, target_gradients=None,
+              no_grad_set: Optional[set] = None,
+              program: Optional[Program] = None) -> List[VarDesc]:
+    """d(sum(targets))/d(inputs) for arbitrary vars (reference
+    backward.py:1742 gradients). Supports both leaf vars (feeds/params) and
+    intermediate activations."""
+    program = program or default_main_program()
+    block = program.global_block
+    target_names = [_var_name(t) for t in (targets if isinstance(
+        targets, (list, tuple)) else [targets])]
+    input_names = [_var_name(t) for t in (inputs if isinstance(
+        inputs, (list, tuple)) else [inputs])]
+    no_grad = {_var_name(v) for v in (no_grad_set or set())}
+    input_names = [n for n in input_names if n not in no_grad]
+
+    if len(target_names) == 1:
+        loss_name = target_names[0]
+    else:
+        loss_name = program._unique_name("grad_target_sum")
+        block.create_var(loss_name, dtype=block.var(target_names[0]).dtype,
+                         shape=(), stop_gradient=False)
+        block.append_op("sum_of_sums", inputs={"X": target_names},
+                        outputs={"Out": [loss_name]})
+
+    grads = []
+    for n in input_names:
+        v = block.var(n)
+        g = block.create_var(n + GRAD_SUFFIX, shape=v.shape, dtype=v.dtype,
+                             stop_gradient=True)
+        grads.append(g)
+
+    block.append_op(
+        BACKWARD_OP,
+        inputs={"Loss": [loss_name]},
+        outputs={"Grads": [g.name for g in grads]},
+        attrs={"parameter_list": input_names, "loss_scale": 1.0,
+               "remat_segments": []})
+    return grads
+
+
+def _segments_from_checkpoints(block, checkpoints) -> List[List[int]]:
+    """Convert checkpoint var names into [start, end) op-index segments:
+    each segment ends right after the op producing a checkpoint var —
+    mirrors the reference's segment search (backward.py:37 ProgramStats)."""
+    names = [_var_name(c) for c in checkpoints]
+    boundaries = []
+    for i, op in enumerate(block.ops):
+        if any(n in op.output_names() for n in names):
+            boundaries.append(i + 1)
+    segments = []
+    start = 0
+    for b in sorted(set(boundaries)):
+        if b - start > 1:
+            segments.append([start, b])
+        start = b
+    return segments

@@ -1,0 +1,66 @@
+"""Dtype registry for paddle_tpu.
+
+Mirrors the VarType.Type dtype enum of the reference
+(/root/reference/paddle/fluid/framework/framework.proto:104-136) but maps
+directly onto jax.numpy dtypes; TPU-native default compute dtype is float32
+with bfloat16 as the AMP dtype (reference uses float16 on CUDA).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# canonical name -> jnp dtype
+_DTYPES = {
+    "bool": jnp.bool_,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+}
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bf16": "bfloat16",
+    "fp16": "float16",
+    "fp32": "float32",
+    "fp64": "float64",
+}
+
+
+def convert_dtype(dtype) -> str:
+    """Normalise any dtype spec (str, np dtype, jnp dtype) to a canonical name."""
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name not in _DTYPES:
+            raise ValueError(f"unsupported dtype {dtype!r}")
+        return name
+    name = np.dtype(dtype).name if not hasattr(dtype, "name") else dtype.name
+    name = _ALIASES.get(name, name)
+    if name not in _DTYPES:
+        raise ValueError(f"unsupported dtype {dtype!r}")
+    return name
+
+
+def to_jax_dtype(dtype):
+    return _DTYPES[convert_dtype(dtype)]
+
+
+def is_float(dtype) -> bool:
+    return convert_dtype(dtype) in ("float16", "bfloat16", "float32", "float64")
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in ("int8", "uint8", "int16", "int32", "int64")

@@ -1,0 +1,293 @@
+"""Executor: compiles a Program block into one jitted XLA computation.
+
+TPU-native replacement for the reference's op-by-op C++ interpreter
+(/root/reference/paddle/fluid/framework/executor.cc:180 Executor::Run, hot
+loop :474-480) and its Python driver
+(/root/reference/python/paddle/fluid/executor.py:474 Executor,
+:1238 _run_program). Where the reference creates variables in a Scope and
+runs each op's device kernel in desc order, here the whole block is *traced*
+through the op lowerings once (LowerCtx + registry) into a single jax
+function
+
+    step(state, feeds, rng) -> (fetches, new_state, rng')
+
+which XLA compiles, fuses, and schedules. Persistable variables (parameters,
+optimizer accumulators) form the donated `state` pytree, so in-place-style
+optimizer ops (sgd/adam ParamOut) become functional state updates with buffer
+donation — the TPU analog of the reference's in-place kernel writes.
+
+The backward op appended by core/backward.py:append_backward is lowered here
+with jax.grad over the replayed forward section (XLA CSE dedupes the primal
+computation), replacing the reference's per-op GradOpMaker machinery
+(/root/reference/python/paddle/fluid/backward.py:1215).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes
+from .program import (Block, OpDesc, Program, VarDesc, default_main_program)
+from .registry import REGISTRY, LowerCtx
+from .scope import Scope, global_scope
+
+BACKWARD_OP = "backward"
+GRAD_SUFFIX = "@GRAD"
+RNG_VAR = "@rng_state@"
+
+
+class _BlockLowerer:
+    """Lowers the ops of one block into a traced environment."""
+
+    def __init__(self, program: Program, ctx: LowerCtx):
+        self.program = program
+        self.ctx = ctx
+        ctx.program = program
+        ctx.lowerer = self
+
+    def run_ops(self, ops: Sequence[OpDesc], env: Dict[str, Any],
+                initial_env: Optional[Dict[str, Any]] = None,
+                initial_key=None) -> None:
+        """Execute op lowerings in order, mutating env."""
+        for i, op in enumerate(ops):
+            if op.type == BACKWARD_OP:
+                self._lower_backward(ops, i, env, initial_env, initial_key)
+                continue
+            self._lower_one(op, env)
+
+    def _lower_one(self, op: OpDesc, env: Dict[str, Any]) -> None:
+        opdef = REGISTRY.get(op.type)
+        ins = {slot: [env[n] for n in names]
+               for slot, names in op.inputs.items() if names}
+        try:
+            outs = opdef.lower(self.ctx, ins, op.attrs)
+        except Exception as e:  # annotate with op context, PADDLE_ENFORCE-style
+            e.add_note(f"while lowering op {op.type!r} "
+                       f"(in={op.inputs}, out={op.outputs})")
+            raise
+        block = self.program.global_block
+        for slot, names in op.outputs.items():
+            vals = outs.get(slot)
+            if vals is None:
+                continue
+            if len(vals) < len(names):
+                raise RuntimeError(
+                    f"op {op.type} produced {len(vals)} values for slot "
+                    f"{slot} but {len(names)} outputs declared")
+            for n, v in zip(names, vals):
+                # honor stop_gradient on produced vars (reference Variable
+                # stop_gradient, framework.py:1107) — leaves (feeds/params)
+                # are handled by grad-target selection instead.
+                if n in block.vars:
+                    vd = block.vars[n]
+                    if vd.stop_gradient and not vd.is_parameter and \
+                            hasattr(v, "dtype") and \
+                            jnp.issubdtype(v.dtype, jnp.floating):
+                        v = jax.lax.stop_gradient(v)
+                env[n] = v
+
+    def _lower_backward(self, ops: Sequence[OpDesc], idx: int,
+                        env: Dict[str, Any],
+                        initial_env: Optional[Dict[str, Any]],
+                        initial_key) -> None:
+        """Lower the `backward` meta-op: grads of loss wrt parameter_list.
+
+        Replays ops[0:idx] as a pure function of the parameters with the
+        *same* rng key chain, so dropout masks etc. match the primal pass
+        and XLA CSE merges the duplicate forward work.
+        """
+        op = ops[idx]
+        loss_name = op.input("Loss")[0]
+        param_names = list(op.attr("parameter_list", []))
+        if initial_env is None:
+            raise RuntimeError("backward op requires block-level replay env")
+        scale = op.attr("loss_scale", 1.0)
+        remat_segments = op.attr("remat_segments", [])  # list of [start, end)
+        fwd_ops = list(ops[:idx])
+        # grads wrt leaves (params/feeds in the initial env) are taken by
+        # re-binding them as function arguments; grads wrt intermediates
+        # (gradients() API) by overriding the produced value with the
+        # argument during replay.
+        leaf = [p for p in param_names if p in initial_env]
+        mid = [p for p in param_names if p not in initial_env]
+
+        def fwd(injected: Dict[str, Any]):
+            ctx2 = LowerCtx(initial_key, is_test=self.ctx.is_test,
+                            mesh=self.ctx.mesh)
+            sub = _BlockLowerer(self.program, ctx2)
+            env2 = dict(initial_env)
+            for n in leaf:
+                env2[n] = injected[n]
+            if remat_segments and not mid:
+                _run_with_remat(sub, fwd_ops, env2, remat_segments)
+            else:
+                for fop in fwd_ops:
+                    sub._lower_one(fop, env2)
+                    for n in fop.output_names():
+                        if n in mid:
+                            env2[n] = injected[n]
+            loss = env2[loss_name]
+            if loss.ndim != 0:
+                loss = jnp.sum(loss)
+            return loss * jnp.asarray(scale, loss.dtype)
+
+        primal = {}
+        for p in param_names:
+            if p in initial_env:
+                primal[p] = initial_env[p]
+            elif p in env:
+                primal[p] = env[p]
+            else:
+                raise KeyError(f"gradient target {p!r} has no primal value")
+        grads = jax.grad(fwd)(primal)
+        for p in param_names:
+            env[p + GRAD_SUFFIX] = grads[p]
+
+
+def _run_with_remat(lowerer: _BlockLowerer, ops, env, segments):
+    """Apply jax.checkpoint to op index ranges — the recompute /
+    activation-checkpointing analog of the reference's forward-desc rewrite
+    (/root/reference/python/paddle/fluid/backward.py:145
+    modify_forward_desc_for_recompute)."""
+    seg_starts = {s: e for s, e in segments}
+    i = 0
+    while i < len(ops):
+        if i in seg_starts:
+            end = seg_starts[i]
+            seg_ops = ops[i:end]
+            in_names = sorted({n for op in seg_ops for n in op.input_names()
+                               if n in env})
+            out_names = sorted({n for op in seg_ops
+                                for n in op.output_names()})
+
+            def seg_fn(vals, _ops=seg_ops, _in=in_names, _out=out_names):
+                env2 = dict(zip(_in, vals))
+                # segment may read anything already computed; close over env
+                for k, v in env.items():
+                    env2.setdefault(k, v)
+                lowerer.run_ops(_ops, env2)
+                return [env2[n] for n in _out]
+
+            outs = jax.checkpoint(seg_fn)([env[n] for n in in_names])
+            env.update(dict(zip(out_names, outs)))
+            i = end
+        else:
+            lowerer._lower_one(ops[i], env)
+            i += 1
+
+
+def _feed_sig(feed: Dict[str, np.ndarray]) -> tuple:
+    return tuple(sorted((k, tuple(v.shape), str(np.asarray(v).dtype))
+                        for k, v in feed.items()))
+
+
+def _as_host(v):
+    if isinstance(v, (np.ndarray, np.generic)):
+        return v
+    return np.asarray(v)
+
+
+class Executor:
+    """Runs Programs. API mirrors fluid.Executor
+    (/root/reference/python/paddle/fluid/executor.py:474): run(program, feed,
+    fetch_list) plus train-loop conveniences.
+
+    `place` is accepted for API parity; device placement on TPU is decided
+    by jax/XLA (and by CompiledProgram shardings for multi-chip).
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[tuple, Any] = {}
+        self._seed_counter = 0
+
+    # ------------------------------------------------------------------
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence] = None,
+            scope: Optional[Scope] = None,
+            return_numpy: bool = True,
+            use_program_cache: bool = True):
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        feed = dict(feed or {})
+        fetch_names = [f.name if isinstance(f, VarDesc) else str(f)
+                       for f in (fetch_list or [])]
+
+        feed = {k: _as_host(v) for k, v in feed.items()}
+
+        # run initializer-style programs (startup): ops writing persistables
+        # with no feeds/fetches execute eagerly into the scope.
+        block = program.global_block
+        state_names = self._state_names(program, scope)
+        key = (id(program), program._version, _feed_sig(feed),
+               tuple(fetch_names), tuple(state_names))
+        entry = self._cache.get(key) if use_program_cache else None
+        if entry is None:
+            entry = self._compile(program, block, sorted(feed), fetch_names,
+                                  state_names)
+            if use_program_cache:
+                self._cache[key] = entry
+        fn = entry
+
+        state = {n: scope.find_var(n) for n in state_names}
+        rng = scope.find_var(RNG_VAR)
+        if rng is None:
+            seed = program.random_seed
+            if seed is None:
+                self._seed_counter += 1
+                seed = self._seed_counter
+            rng = jax.random.PRNGKey(seed)
+
+        fetches, new_state, new_rng = fn(state, feed, rng)
+        for n, v in new_state.items():
+            scope.set(n, v)
+        scope.set(RNG_VAR, new_rng)
+
+        if return_numpy:
+            fetches = [np.asarray(v) for v in fetches]
+        return fetches
+
+    # ------------------------------------------------------------------
+    def _state_names(self, program: Program, scope: Scope) -> List[str]:
+        """Persistable vars that already live in the scope are threaded
+        through the jitted step as donated state."""
+        names = []
+        for v in program.persistable_vars():
+            if scope.has(v.name):
+                names.append(v.name)
+        return sorted(names)
+
+    def _compile(self, program: Program, block: Block,
+                 feed_names: List[str], fetch_names: List[str],
+                 state_names: List[str]):
+        persistable = {v.name for v in program.persistable_vars()}
+
+        def step(state, feeds, rng):
+            ctx = LowerCtx(rng)
+            lowerer = _BlockLowerer(program, ctx)
+            env: Dict[str, Any] = {}
+            env.update(state)
+            for n, v in feeds.items():
+                env[n] = jnp.asarray(v)
+            initial_env = dict(env)
+            lowerer.run_ops(block.ops, env, initial_env=initial_env,
+                            initial_key=rng)
+            fetches = [env[n] for n in fetch_names]
+            new_state = {}
+            for n, v in env.items():
+                if n in persistable:
+                    new_state[n] = v
+            # state vars never touched still flow through
+            for n in state_names:
+                new_state.setdefault(n, state[n])
+            return fetches, new_state, ctx.key_out
+
+        jitted = jax.jit(step, donate_argnums=(0,))
+        return jitted
+
+    def close(self):
+        self._cache.clear()

@@ -1,0 +1,110 @@
+"""Operator registry: op name -> lowering to jax.
+
+TPU-native analog of the reference's kernel registry
+(/root/reference/paddle/fluid/framework/op_registry.h:55 REGISTER_OPERATOR and
+op_info.h OpInfoMap). Where the reference registers per-device C++/CUDA
+kernels dispatched at runtime by OpKernelType (operator.cc:1068 ChooseKernel),
+here each op registers a single *lowering function* that emits jax/lax ops;
+XLA then compiles and fuses for the target device — there is no per-device
+kernel dispatch to reimplement.
+
+Gradients: most ops need no hand-written grad because the executor
+differentiates the composed forward with jax.vjp (core/backward.py). Ops that
+are non-differentiable or need custom treatment mark themselves accordingly.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+Arrays = Dict[str, List[Any]]  # slot -> list of jax arrays
+LowerFn = Callable[["LowerCtx", Arrays, Dict[str, Any]], Arrays]
+
+
+class LowerCtx:
+    """Context passed to op lowering functions.
+
+    Carries the PRNG key chain (reference analog: framework/generator.h
+    per-device Generator) and mode flags. Splitting the key per random op
+    keeps lowering deterministic and jit-friendly.
+    """
+
+    def __init__(self, rng_key=None, is_test: bool = False, mesh=None):
+        self._key = rng_key
+        self.is_test = is_test
+        self.mesh = mesh
+
+    def rng(self):
+        if self._key is None:
+            raise RuntimeError(
+                "op requires randomness but no RNG key was provided "
+                "(executor seeds one automatically; in eager mode "
+                "paddle_tpu.seed() sets the global key)")
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    @property
+    def key_out(self):
+        return self._key
+
+
+@dataclass
+class OpDef:
+    name: str
+    lower: LowerFn
+    # slots, for introspection / OpTest harness
+    input_slots: tuple = ()
+    output_slots: tuple = ()
+    # ops with no gradient (REGISTER_OP_WITHOUT_GRADIENT analog)
+    no_grad: bool = False
+    # uses ctx.rng()
+    is_random: bool = False
+    # which input slots are non-differentiable (int indices etc.)
+    non_diff_inputs: tuple = ()
+    # ops that mutate persistable state (optimizer ops): output slot ->
+    # input slot whose variable it updates in place (e.g. ParamOut -> Param)
+    inplace_map: Dict[str, str] = field(default_factory=dict)
+
+
+class OpRegistry:
+    def __init__(self):
+        self._ops: Dict[str, OpDef] = {}
+
+    def register(self, opdef: OpDef):
+        if opdef.name in self._ops:
+            raise ValueError(f"op {opdef.name!r} registered twice")
+        self._ops[opdef.name] = opdef
+
+    def get(self, name: str) -> OpDef:
+        if name not in self._ops:
+            raise KeyError(
+                f"op {name!r} is not registered (have {len(self._ops)} ops)")
+        return self._ops[name]
+
+    def has(self, name: str) -> bool:
+        return name in self._ops
+
+    def names(self) -> List[str]:
+        return sorted(self._ops)
+
+
+REGISTRY = OpRegistry()
+
+
+def register_op(name: str, *, inputs=(), outputs=("Out",), no_grad=False,
+                is_random=False, non_diff_inputs=(), inplace_map=None):
+    """Decorator registering a lowering function for op `name`.
+
+    The lowering fn signature is fn(ctx, ins, attrs) -> outs where ins/outs
+    map slot name -> list of jax arrays.
+    """
+    def deco(fn: LowerFn):
+        REGISTRY.register(OpDef(
+            name=name, lower=fn, input_slots=tuple(inputs),
+            output_slots=tuple(outputs), no_grad=no_grad,
+            is_random=is_random, non_diff_inputs=tuple(non_diff_inputs),
+            inplace_map=dict(inplace_map or {})))
+        return fn
+    return deco

@@ -1,0 +1,83 @@
+"""Scope: name -> value store for static-graph execution.
+
+Analog of the reference's hierarchical Scope
+(/root/reference/paddle/fluid/framework/scope.h:46 — Var/FindVar/NewScope).
+Values are jax.Arrays (device-resident) or numpy arrays (host staging);
+hierarchy is kept for parity with local/step scopes used by executors and
+control flow.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, Any] = {}
+        self._parent = parent
+        self._kids: List["Scope"] = []
+
+    def var(self, name: str, value=None):
+        """Create (or get) a variable in this scope."""
+        if name not in self._vars:
+            self._vars[name] = value
+        elif value is not None:
+            self._vars[name] = value
+        return self._vars[name]
+
+    def set(self, name: str, value) -> None:
+        self._vars[name] = value
+
+    def find_var(self, name: str):
+        scope: Optional[Scope] = self
+        while scope is not None:
+            if name in scope._vars:
+                return scope._vars[name]
+            scope = scope._parent
+        return None
+
+    def has(self, name: str) -> bool:
+        return self.find_var(name) is not None
+
+    def erase(self, name: str) -> None:
+        self._vars.pop(name, None)
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self) -> None:
+        self._kids.clear()
+
+    def local_names(self) -> List[str]:
+        return list(self._vars)
+
+    def items(self):
+        return self._vars.items()
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+class scope_guard:
+    """Swap the global scope (fluid.scope_guard, executor.py:52)."""
+
+    def __init__(self, scope: Scope):
+        self._scope = scope
+        self._old = None
+
+    def __enter__(self):
+        global _global_scope
+        self._old = _global_scope
+        _global_scope = self._scope
+        return self
+
+    def __exit__(self, *exc):
+        global _global_scope
+        _global_scope = self._old
+        return False

@@ -1,0 +1,82 @@
+"""Graph-build-time shape/dtype inference.
+
+The reference implements per-op InferShape in C++
+(/root/reference/paddle/fluid/framework/operator.h:448 OperatorWithKernel::
+InferShape, shape_inference.h) — ~520 hand-written shape functions. The
+TPU-native design gets all of them for free: each op already *is* a jax
+lowering, so `jax.eval_shape` abstractly evaluates it (no FLOPs, no memory)
+and yields output shapes/dtypes. Dynamic (batch) dims are round-tripped
+through a sentinel extent.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dtypes import to_jax_dtype
+from .registry import REGISTRY, LowerCtx
+
+# placeholder extent standing in for -1 (dynamic/batch) dims during abstract
+# evaluation; mapped back to -1 in results.
+_DYN = 1247
+
+
+def _var_struct(var) -> Optional[jax.ShapeDtypeStruct]:
+    if var.shape is None:
+        return None
+    shape = tuple(_DYN if d in (-1, None) else int(d) for d in var.shape)
+    return jax.ShapeDtypeStruct(shape, to_jax_dtype(var.dtype))
+
+
+def infer_op_shapes(block, op) -> bool:
+    """Fill in shapes/dtypes of op's output VarDescs. Returns True on
+    success; failures (unregistered op, unknown input shape, lowering that
+    needs concrete values) leave shapes as None — harmless, later layers
+    simply can't rely on them."""
+    if not REGISTRY.has(op.type):
+        return False
+    opdef = REGISTRY.get(op.type)
+    ins_structs = {}
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            try:
+                v = block.var(n)
+            except KeyError:
+                return False
+            s = _var_struct(v)
+            if s is None:
+                return False
+            vals.append(s)
+        ins_structs[slot] = vals
+
+    def f(key, ins):
+        ctx = LowerCtx(key, is_test=True)
+        return opdef.lower(ctx, ins, dict(op.attrs))
+
+    try:
+        outs = jax.eval_shape(f, jax.ShapeDtypeStruct((2,), jnp.uint32),
+                              ins_structs)
+    except Exception:
+        return False
+
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        for n, s in zip(names, vals):
+            try:
+                v = block.var(n)
+            except KeyError:
+                continue
+            if v.shape is None:
+                v.shape = tuple(-1 if d == _DYN else int(d)
+                                for d in s.shape)
+                v.dtype = np.dtype(s.dtype).name if not hasattr(
+                    s.dtype, "name") else s.dtype.name
+                from .dtypes import convert_dtype
+                v.dtype = convert_dtype(v.dtype)
+    return True

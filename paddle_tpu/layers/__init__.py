@@ -1,0 +1,4 @@
+from .helper import (Constant, Initializer, LayerHelper, MSRA, Normal,  # noqa: F401
+                     ParamAttr, TruncatedNormal, Uniform, Xavier)
+from .nn import *  # noqa: F401,F403
+from . import nn  # noqa: F401

@@ -1,0 +1,203 @@
+"""LayerHelper: shared machinery for graph-building layer functions.
+
+Analog of /root/reference/python/paddle/fluid/layer_helper.py — creates
+parameters (appending their initializer ops to the startup program, like the
+reference's Initializer __call__ appending to startup), temp vars, and ops
+on the current default main program.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.program import (VarDesc, default_main_program,
+                            default_startup_program)
+from ..core import dtypes
+
+
+class ParamAttr:
+    """Parameter attribute (fluid.ParamAttr, param_attr.py:29)."""
+
+    def __init__(self, name: Optional[str] = None, initializer=None,
+                 learning_rate: float = 1.0, regularizer=None,
+                 trainable: bool = True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+
+    @staticmethod
+    def to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return False
+        return ParamAttr()
+
+
+# --- initializers (fluid/initializer.py) -----------------------------------
+class Initializer:
+    def desc(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def desc(self, shape, dtype):
+        return {"type": "fill_constant",
+                "attrs": {"shape": list(shape), "value": self.value,
+                          "dtype": dtypes.convert_dtype(dtype)}}
+
+
+class Normal(Initializer):
+    def __init__(self, loc: float = 0.0, scale: float = 1.0):
+        self.loc, self.scale = loc, scale
+
+    def desc(self, shape, dtype):
+        return {"type": "gaussian_random",
+                "attrs": {"shape": list(shape), "mean": self.loc,
+                          "std": self.scale,
+                          "dtype": dtypes.convert_dtype(dtype)}}
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, loc: float = 0.0, scale: float = 1.0):
+        self.loc, self.scale = loc, scale
+
+    def desc(self, shape, dtype):
+        return {"type": "truncated_gaussian_random",
+                "attrs": {"shape": list(shape), "mean": self.loc,
+                          "std": self.scale,
+                          "dtype": dtypes.convert_dtype(dtype)}}
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def desc(self, shape, dtype):
+        return {"type": "uniform_random",
+                "attrs": {"shape": list(shape), "min": self.low,
+                          "max": self.high,
+                          "dtype": dtypes.convert_dtype(dtype)}}
+
+
+class Xavier(Initializer):
+    """XavierInitializer (initializer.py:422) — fan-based uniform/normal."""
+
+    def __init__(self, uniform: bool = True, fan_in=None, fan_out=None):
+        self.uniform, self.fan_in, self.fan_out = uniform, fan_in, fan_out
+
+    def desc(self, shape, dtype):
+        import numpy as np
+        fan_in = self.fan_in
+        fan_out = self.fan_out
+        if fan_in is None:
+            fan_in = int(np.prod(shape[1:])) if len(shape) > 2 else shape[0]
+        if fan_out is None:
+            if len(shape) > 2:
+                fan_out = int(shape[0] * np.prod(shape[2:]))
+            else:
+                fan_out = shape[1] if len(shape) > 1 else shape[0]
+        if self.uniform:
+            limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
+            return {"type": "uniform_random",
+                    "attrs": {"shape": list(shape), "min": -limit,
+                              "max": limit,
+                              "dtype": dtypes.convert_dtype(dtype)}}
+        std = float(np.sqrt(2.0 / (fan_in + fan_out)))
+        return {"type": "gaussian_random",
+                "attrs": {"shape": list(shape), "mean": 0.0, "std": std,
+                          "dtype": dtypes.convert_dtype(dtype)}}
+
+
+class MSRA(Initializer):
+    """MSRAInitializer / Kaiming (initializer.py:577)."""
+
+    def __init__(self, uniform: bool = True, fan_in=None):
+        self.uniform, self.fan_in = uniform, fan_in
+
+    def desc(self, shape, dtype):
+        import numpy as np
+        fan_in = self.fan_in
+        if fan_in is None:
+            fan_in = int(np.prod(shape[1:])) if len(shape) > 1 else shape[0]
+        if self.uniform:
+            limit = float(np.sqrt(6.0 / fan_in))
+            return {"type": "uniform_random",
+                    "attrs": {"shape": list(shape), "min": -limit,
+                              "max": limit,
+                              "dtype": dtypes.convert_dtype(dtype)}}
+        std = float(np.sqrt(2.0 / fan_in))
+        return {"type": "gaussian_random",
+                "attrs": {"shape": list(shape), "mean": 0.0, "std": std,
+                          "dtype": dtypes.convert_dtype(dtype)}}
+
+
+def _init_desc(initializer, shape, dtype, default=None):
+    if initializer is None:
+        initializer = default or Xavier()
+    if isinstance(initializer, Initializer):
+        return initializer.desc(shape, dtype)
+    return initializer
+
+
+class LayerHelper:
+    def __init__(self, layer_type: str, name: Optional[str] = None):
+        self.layer_type = layer_type
+        self.name = name
+        self.main_program = default_main_program()
+        self.startup_program = default_startup_program()
+        self.block = self.main_program.global_block
+
+    def unique_name(self, suffix: str = "") -> str:
+        base = self.name or self.layer_type
+        return self.main_program._unique_name(
+            f"{base}{('.' + suffix) if suffix else ''}")
+
+    def create_parameter(self, attr, shape, dtype="float32",
+                         default_initializer=None, is_bias=False) -> VarDesc:
+        attr = ParamAttr.to_attr(attr)
+        if attr is False:
+            return None
+        name = attr.name or self.unique_name("b" if is_bias else "w")
+        default = default_initializer or \
+            (Constant(0.0) if is_bias else Xavier())
+        init = _init_desc(attr.initializer, shape, dtype, default)
+        param = self.block.create_parameter(
+            name, shape, dtype, initializer=init, trainable=attr.trainable)
+        # mirror into startup program with its init op (reference
+        # initializer.py appends ops to startup)
+        sblock = self.startup_program.global_block
+        if name not in sblock.vars:
+            sblock.create_parameter(name, shape, dtype, initializer=init,
+                                    trainable=attr.trainable)
+            sblock.append_op(init["type"], inputs={},
+                             outputs={"Out": [name]}, attrs=init["attrs"])
+        return param
+
+    def create_tmp_variable(self, dtype="float32", shape=None,
+                            stop_gradient=False) -> VarDesc:
+        return self.block.create_var(
+            self.unique_name("tmp"), shape=shape, dtype=dtype,
+            stop_gradient=stop_gradient)
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        op = self.block.append_op(type, inputs, outputs, attrs)
+        from ..core.shape_inference import infer_op_shapes
+        infer_op_shapes(self.block, op)
+        return op
+
+    def append_activation(self, out: VarDesc, act: Optional[str]) -> VarDesc:
+        if act is None:
+            return out
+        act_out = self.create_tmp_variable(out.dtype)
+        self.append_op(act, inputs={"X": [out.name]},
+                       outputs={"Out": [act_out.name]})
+        return act_out

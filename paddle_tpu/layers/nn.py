@@ -1,0 +1,560 @@
+"""Graph-building layer functions (static mode).
+
+Analog of /root/reference/python/paddle/fluid/layers/nn.py (214 defs, fc:190,
+conv2d:1575, embedding:397, batch_norm, layer_norm, dropout, ...) — each
+function creates vars + appends ops through LayerHelper exactly like the
+reference's append_op pattern (layer_helper.py), but the ops lower to jax.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.program import VarDesc, default_main_program
+from ..core import dtypes
+from .helper import Constant, LayerHelper, Normal, ParamAttr, Xavier
+
+
+def data(name: str, shape: Sequence[int], dtype="float32",
+         lod_level: int = 0, append_batch_size: bool = True) -> VarDesc:
+    """fluid.layers.data / fluid.data (layers/io.py) — feed placeholder.
+    shape may include -1 for batch; with append_batch_size a leading -1 is
+    added like the v1 API."""
+    shape = list(shape)
+    if append_batch_size and (not shape or shape[0] != -1):
+        shape = [-1] + shape
+    prog = default_main_program()
+    return prog.global_block.create_var(
+        name, shape=shape, dtype=dtype, stop_gradient=True,
+        lod_level=lod_level)
+
+
+def fc(input: VarDesc, size: int, num_flatten_dims: int = 1,
+       param_attr=None, bias_attr=None, act: Optional[str] = None,
+       name: Optional[str] = None) -> VarDesc:
+    """fluid.layers.fc (nn.py:190): mul + elementwise_add + activation."""
+    helper = LayerHelper("fc", name)
+    in_dim = int(np.prod(input.shape[num_flatten_dims:]))
+    w = helper.create_parameter(param_attr, [in_dim, size], input.dtype)
+    pre = helper.create_tmp_variable(input.dtype)
+    helper.append_op("mul", inputs={"X": [input.name], "Y": [w.name]},
+                     outputs={"Out": [pre.name]},
+                     attrs={"x_num_col_dims": num_flatten_dims,
+                            "y_num_col_dims": 1})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [size], input.dtype,
+                                    is_bias=True)
+        tmp = helper.create_tmp_variable(input.dtype)
+        helper.append_op("elementwise_add",
+                         inputs={"X": [pre.name], "Y": [b.name]},
+                         outputs={"Out": [tmp.name]},
+                         attrs={"axis": num_flatten_dims})
+        pre = tmp
+    return helper.append_activation(pre, act)
+
+
+def embedding(input: VarDesc, size: Sequence[int], is_sparse: bool = False,
+              is_distributed: bool = False, padding_idx: Optional[int] = None,
+              param_attr=None, dtype="float32",
+              name: Optional[str] = None) -> VarDesc:
+    """fluid.layers.embedding (nn.py:397). is_sparse/is_distributed are
+    accepted for parity; on TPU the gradient is an XLA scatter-add and
+    distributed tables shard over the mesh (parallel/embedding.py)."""
+    helper = LayerHelper("embedding", name)
+    w = helper.create_parameter(param_attr, list(size), dtype,
+                                default_initializer=Xavier())
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op("lookup_table",
+                     inputs={"W": [w.name], "Ids": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"padding_idx": -1 if padding_idx is None
+                            else padding_idx,
+                            "is_sparse": is_sparse,
+                            "is_distributed": is_distributed})
+    return out
+
+
+def conv2d(input: VarDesc, num_filters: int, filter_size, stride=1,
+           padding=0, dilation=1, groups: int = 1, param_attr=None,
+           bias_attr=None, act: Optional[str] = None,
+           data_format: str = "NCHW", name: Optional[str] = None) -> VarDesc:
+    """fluid.layers.conv2d (nn.py:1575)."""
+    helper = LayerHelper("conv2d", name)
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    if isinstance(stride, int):
+        stride = [stride, stride]
+    if isinstance(padding, int):
+        padding = [padding, padding]
+    if isinstance(dilation, int):
+        dilation = [dilation, dilation]
+    c_in = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    w_shape = [num_filters, c_in // groups] + list(filter_size)
+    import math
+    fan_in = (c_in // groups) * int(np.prod(filter_size))
+    std = math.sqrt(2.0 / fan_in)
+    w = helper.create_parameter(param_attr, w_shape, input.dtype,
+                                default_initializer=Normal(0.0, std))
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("conv2d",
+                     inputs={"Input": [input.name], "Filter": [w.name]},
+                     outputs={"Output": [out.name]},
+                     attrs={"strides": list(stride),
+                            "paddings": list(padding),
+                            "dilations": list(dilation), "groups": groups,
+                            "data_format": data_format})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        tmp = helper.create_tmp_variable(input.dtype)
+        helper.append_op("elementwise_add",
+                         inputs={"X": [out.name], "Y": [b.name]},
+                         outputs={"Out": [tmp.name]},
+                         attrs={"axis": 1 if data_format == "NCHW" else 3})
+        out = tmp
+    return helper.append_activation(out, act)
+
+
+def conv2d_transpose(input: VarDesc, num_filters: int, filter_size,
+                     stride=1, padding=0, dilation=1, groups: int = 1,
+                     param_attr=None, bias_attr=None,
+                     act: Optional[str] = None,
+                     name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("conv2d_transpose", name)
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    if isinstance(stride, int):
+        stride = [stride, stride]
+    if isinstance(padding, int):
+        padding = [padding, padding]
+    if isinstance(dilation, int):
+        dilation = [dilation, dilation]
+    c_in = input.shape[1]
+    w_shape = [c_in, num_filters // groups] + list(filter_size)
+    w = helper.create_parameter(param_attr, w_shape, input.dtype)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("conv2d_transpose",
+                     inputs={"Input": [input.name], "Filter": [w.name]},
+                     outputs={"Output": [out.name]},
+                     attrs={"strides": list(stride),
+                            "paddings": list(padding),
+                            "dilations": list(dilation), "groups": groups})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        tmp = helper.create_tmp_variable(input.dtype)
+        helper.append_op("elementwise_add",
+                         inputs={"X": [out.name], "Y": [b.name]},
+                         outputs={"Out": [tmp.name]}, attrs={"axis": 1})
+        out = tmp
+    return helper.append_activation(out, act)
+
+
+def pool2d(input: VarDesc, pool_size=2, pool_type: str = "max",
+           pool_stride=1, pool_padding=0, global_pooling: bool = False,
+           ceil_mode: bool = False, exclusive: bool = True,
+           name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("pool2d", name)
+    if isinstance(pool_size, int):
+        pool_size = [pool_size, pool_size]
+    if isinstance(pool_stride, int):
+        pool_stride = [pool_stride, pool_stride]
+    if isinstance(pool_padding, int):
+        pool_padding = [pool_padding, pool_padding]
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("pool2d", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"ksize": list(pool_size),
+                            "pooling_type": pool_type,
+                            "strides": list(pool_stride),
+                            "paddings": list(pool_padding),
+                            "global_pooling": global_pooling,
+                            "ceil_mode": ceil_mode,
+                            "exclusive": exclusive,
+                            "adaptive": False})
+    return out
+
+
+def adaptive_pool2d(input: VarDesc, pool_size, pool_type: str = "max",
+                    name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("adaptive_pool2d", name)
+    if isinstance(pool_size, int):
+        pool_size = [pool_size, pool_size]
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("pool2d", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"ksize": list(pool_size),
+                            "pooling_type": pool_type,
+                            "strides": [1, 1], "paddings": [0, 0],
+                            "global_pooling": False, "ceil_mode": False,
+                            "exclusive": True, "adaptive": True})
+    return out
+
+
+def batch_norm(input: VarDesc, act: Optional[str] = None,
+               is_test: bool = False, momentum: float = 0.9,
+               epsilon: float = 1e-5, param_attr=None, bias_attr=None,
+               data_layout: str = "NCHW", moving_mean_name=None,
+               moving_variance_name=None, use_global_stats: bool = False,
+               name: Optional[str] = None) -> VarDesc:
+    """fluid.layers.batch_norm (nn.py:2716)."""
+    helper = LayerHelper("batch_norm", name)
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = helper.create_parameter(param_attr, [c], input.dtype,
+                                    default_initializer=Constant(1.0))
+    bias = helper.create_parameter(bias_attr, [c], input.dtype, is_bias=True)
+    mean = helper.create_parameter(
+        ParamAttr(name=moving_mean_name or helper.unique_name("mean"),
+                  initializer=Constant(0.0), trainable=False),
+        [c], input.dtype)
+    var = helper.create_parameter(
+        ParamAttr(name=moving_variance_name or helper.unique_name("var"),
+                  initializer=Constant(1.0), trainable=False),
+        [c], input.dtype)
+    mean.stop_gradient = True
+    var.stop_gradient = True
+    y = helper.create_tmp_variable(input.dtype)
+    saved_mean = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    saved_var = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    helper.append_op(
+        "batch_norm",
+        inputs={"X": [input.name], "Scale": [scale.name],
+                "Bias": [bias.name], "Mean": [mean.name],
+                "Variance": [var.name]},
+        outputs={"Y": [y.name], "MeanOut": [mean.name],
+                 "VarianceOut": [var.name], "SavedMean": [saved_mean.name],
+                 "SavedVariance": [saved_var.name]},
+        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+               "data_layout": data_layout,
+               "use_global_stats": use_global_stats})
+    return helper.append_activation(y, act)
+
+
+def layer_norm(input: VarDesc, scale: bool = True, shift: bool = True,
+               begin_norm_axis: int = 1, epsilon: float = 1e-5,
+               param_attr=None, bias_attr=None, act: Optional[str] = None,
+               name: Optional[str] = None) -> VarDesc:
+    """fluid.layers.layer_norm (nn.py:3297)."""
+    helper = LayerHelper("layer_norm", name)
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": [input.name]}
+    if scale:
+        s = helper.create_parameter(param_attr, norm_shape, input.dtype,
+                                    default_initializer=Constant(1.0))
+        inputs["Scale"] = [s.name]
+    if shift:
+        b = helper.create_parameter(bias_attr, norm_shape, input.dtype,
+                                    is_bias=True)
+        inputs["Bias"] = [b.name]
+    y = helper.create_tmp_variable(input.dtype)
+    mean = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    var = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    helper.append_op("layer_norm", inputs=inputs,
+                     outputs={"Y": [y.name], "Mean": [mean.name],
+                              "Variance": [var.name]},
+                     attrs={"epsilon": epsilon,
+                            "begin_norm_axis": begin_norm_axis})
+    return helper.append_activation(y, act)
+
+
+def dropout(x: VarDesc, dropout_prob: float, is_test: bool = False,
+            dropout_implementation: str = "downgrade_in_infer",
+            name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("dropout", name)
+    out = helper.create_tmp_variable(x.dtype)
+    mask = helper.create_tmp_variable(x.dtype, stop_gradient=True)
+    helper.append_op("dropout", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name], "Mask": [mask.name]},
+                     attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+                            "dropout_implementation": dropout_implementation})
+    return out
+
+
+def _unary(op_type):
+    def f(x: VarDesc, name: Optional[str] = None, **attrs) -> VarDesc:
+        helper = LayerHelper(op_type, name)
+        out = helper.create_tmp_variable(x.dtype)
+        helper.append_op(op_type, inputs={"X": [x.name]},
+                         outputs={"Out": [out.name]}, attrs=attrs)
+        return out
+    f.__name__ = op_type
+    return f
+
+
+relu = _unary("relu")
+sigmoid = _unary("sigmoid")
+tanh = _unary("tanh")
+gelu = _unary("gelu")
+exp = _unary("exp")
+sqrt = _unary("sqrt")
+abs = _unary("abs")  # noqa: A001
+square = _unary("square")
+log = _unary("log")
+leaky_relu = _unary("leaky_relu")
+relu6 = _unary("relu6")
+softplus = _unary("softplus")
+softsign = _unary("softsign")
+sign = _unary("sign")
+erf = _unary("erf")
+swish = _unary("swish")
+hard_swish = _unary("hard_swish")
+hard_sigmoid = _unary("hard_sigmoid")
+
+
+def softmax(input: VarDesc, axis: int = -1,
+            name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("softmax", name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("softmax", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": axis})
+    return out
+
+
+def log_softmax(input: VarDesc, axis: int = -1,
+                name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("log_softmax", name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("log_softmax", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": axis})
+    return out
+
+
+def cross_entropy(input: VarDesc, label: VarDesc, soft_label: bool = False,
+                  ignore_index: int = -100,
+                  name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("cross_entropy", name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("cross_entropy",
+                     inputs={"X": [input.name], "Label": [label.name]},
+                     outputs={"Y": [out.name]},
+                     attrs={"soft_label": soft_label,
+                            "ignore_index": ignore_index})
+    return out
+
+
+def softmax_with_cross_entropy(logits: VarDesc, label: VarDesc,
+                               soft_label: bool = False,
+                               ignore_index: int = -100, axis: int = -1,
+                               return_softmax: bool = False,
+                               name: Optional[str] = None):
+    helper = LayerHelper("softmax_with_cross_entropy", name)
+    softmax_out = helper.create_tmp_variable(logits.dtype)
+    loss = helper.create_tmp_variable(logits.dtype)
+    helper.append_op("softmax_with_cross_entropy",
+                     inputs={"Logits": [logits.name], "Label": [label.name]},
+                     outputs={"Softmax": [softmax_out.name],
+                              "Loss": [loss.name]},
+                     attrs={"soft_label": soft_label,
+                            "ignore_index": ignore_index, "axis": axis})
+    if return_softmax:
+        return loss, softmax_out
+    return loss
+
+
+def mean(x: VarDesc, name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("mean", name)
+    out = helper.create_tmp_variable(x.dtype, shape=())
+    helper.append_op("mean", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def reduce_sum(x: VarDesc, dim=None, keep_dim: bool = False,
+               name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("reduce_sum", name)
+    out = helper.create_tmp_variable(x.dtype)
+    attrs = {"keep_dim": keep_dim}
+    if dim is None:
+        attrs["reduce_all"] = True
+    else:
+        attrs["dim"] = [dim] if isinstance(dim, int) else list(dim)
+    helper.append_op("reduce_sum", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs=attrs)
+    return out
+
+
+def reduce_mean(x: VarDesc, dim=None, keep_dim: bool = False,
+                name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("reduce_mean", name)
+    out = helper.create_tmp_variable(x.dtype)
+    attrs = {"keep_dim": keep_dim}
+    if dim is None:
+        attrs["reduce_all"] = True
+    else:
+        attrs["dim"] = [dim] if isinstance(dim, int) else list(dim)
+    helper.append_op("reduce_mean", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs=attrs)
+    return out
+
+
+def concat(input, axis: int = 0, name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("concat", name)
+    out = helper.create_tmp_variable(input[0].dtype)
+    helper.append_op("concat", inputs={"X": [v.name for v in input]},
+                     outputs={"Out": [out.name]}, attrs={"axis": axis})
+    return out
+
+
+def reshape(x: VarDesc, shape, name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("reshape", name)
+    out = helper.create_tmp_variable(x.dtype)
+    xshape = helper.create_tmp_variable(x.dtype, stop_gradient=True)
+    helper.append_op("reshape2", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name], "XShape": [xshape.name]},
+                     attrs={"shape": list(shape)})
+    return out
+
+
+def transpose(x: VarDesc, perm, name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("transpose", name)
+    out = helper.create_tmp_variable(x.dtype)
+    xshape = helper.create_tmp_variable(x.dtype, stop_gradient=True)
+    helper.append_op("transpose2", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name], "XShape": [xshape.name]},
+                     attrs={"axis": list(perm)})
+    return out
+
+
+def flatten(x: VarDesc, axis: int = 1, name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("flatten", name)
+    out = helper.create_tmp_variable(x.dtype)
+    xshape = helper.create_tmp_variable(x.dtype, stop_gradient=True)
+    helper.append_op("flatten2", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name], "XShape": [xshape.name]},
+                     attrs={"axis": axis})
+    return out
+
+
+def cast(x: VarDesc, dtype) -> VarDesc:
+    helper = LayerHelper("cast")
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op("cast", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"out_dtype": dtypes.convert_dtype(dtype)})
+    return out
+
+
+def _binary(op_type):
+    def f(x: VarDesc, y: VarDesc, axis: int = -1,
+          act: Optional[str] = None, name: Optional[str] = None) -> VarDesc:
+        helper = LayerHelper(op_type, name)
+        out = helper.create_tmp_variable(x.dtype)
+        helper.append_op(op_type, inputs={"X": [x.name], "Y": [y.name]},
+                         outputs={"Out": [out.name]}, attrs={"axis": axis})
+        return helper.append_activation(out, act)
+    f.__name__ = op_type
+    return f
+
+
+elementwise_add = _binary("elementwise_add")
+elementwise_sub = _binary("elementwise_sub")
+elementwise_mul = _binary("elementwise_mul")
+elementwise_div = _binary("elementwise_div")
+elementwise_max = _binary("elementwise_max")
+elementwise_min = _binary("elementwise_min")
+elementwise_pow = _binary("elementwise_pow")
+
+
+def matmul(x: VarDesc, y: VarDesc, transpose_x: bool = False,
+           transpose_y: bool = False, alpha: float = 1.0,
+           name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("matmul", name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("matmul", inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"transpose_X": transpose_x,
+                            "transpose_Y": transpose_y, "alpha": alpha})
+    return out
+
+
+def mul(x: VarDesc, y: VarDesc, x_num_col_dims: int = 1,
+        y_num_col_dims: int = 1, name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("mul", name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("mul", inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"x_num_col_dims": x_num_col_dims,
+                            "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def scale(x: VarDesc, scale: float = 1.0, bias: float = 0.0,
+          bias_after_scale: bool = True,
+          name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("scale", name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("scale", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"scale": scale, "bias": bias,
+                            "bias_after_scale": bias_after_scale})
+    return out
+
+
+def accuracy(input: VarDesc, label: VarDesc, k: int = 1,
+             name: Optional[str] = None) -> VarDesc:
+    """fluid.layers.accuracy (metric_op.py) — top_k + accuracy op."""
+    helper = LayerHelper("accuracy", name)
+    topk_out = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    topk_idx = helper.create_tmp_variable("int64", stop_gradient=True)
+    helper.append_op("top_k", inputs={"X": [input.name]},
+                     outputs={"Out": [topk_out.name],
+                              "Indices": [topk_idx.name]},
+                     attrs={"k": k})
+    acc = helper.create_tmp_variable("float32", stop_gradient=True)
+    correct = helper.create_tmp_variable("int32", stop_gradient=True)
+    total = helper.create_tmp_variable("int32", stop_gradient=True)
+    helper.append_op("accuracy",
+                     inputs={"Out": [topk_out.name],
+                             "Indices": [topk_idx.name],
+                             "Label": [label.name]},
+                     outputs={"Accuracy": [acc.name],
+                              "Correct": [correct.name],
+                              "Total": [total.name]})
+    return acc
+
+
+def fill_constant(shape, dtype, value, name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("fill_constant", name)
+    out = helper.create_tmp_variable(dtype, stop_gradient=True)
+    helper.append_op("fill_constant", inputs={},
+                     outputs={"Out": [out.name]},
+                     attrs={"shape": list(shape), "value": value,
+                            "dtype": dtypes.convert_dtype(dtype)})
+    return out
+
+
+def one_hot(input: VarDesc, depth: int, name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("one_hot", name)
+    out = helper.create_tmp_variable("float32")
+    helper.append_op("one_hot", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]}, attrs={"depth": depth})
+    return out
+
+
+def topk(input: VarDesc, k: int, name: Optional[str] = None):
+    helper = LayerHelper("top_k", name)
+    out = helper.create_tmp_variable(input.dtype)
+    idx = helper.create_tmp_variable("int64", stop_gradient=True)
+    helper.append_op("top_k", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name], "Indices": [idx.name]},
+                     attrs={"k": k})
+    return out, idx
+
+
+def clip(x: VarDesc, min: float, max: float,
+         name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("clip", name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("clip", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"min": min, "max": max})
+    return out
+
+
+def sums(input, name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("sum", name)
+    out = helper.create_tmp_variable(input[0].dtype)
+    helper.append_op("sum", inputs={"X": [v.name for v in input]},
+                     outputs={"Out": [out.name]})
+    return out

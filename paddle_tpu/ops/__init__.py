@@ -1,0 +1,25 @@
+"""Op library: importing this package registers all op lowerings.
+
+The registry-population pattern mirrors the reference's static registration
+of operators at library load (REGISTER_OPERATOR macros across
+/root/reference/paddle/fluid/operators/); here each submodule import runs
+the @register_op decorators.
+"""
+from ..core.registry import REGISTRY  # noqa: F401
+
+from . import (  # noqa: F401
+    activation,
+    amp,
+    elementwise,
+    math,
+    metrics,
+    nn,
+    optimizers,
+    random,
+    reduce,
+    tensor,
+)
+
+
+def op_names():
+    return REGISTRY.names()

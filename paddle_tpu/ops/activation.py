@@ -1,0 +1,173 @@
+"""Activation ops.
+
+Parity surface: the ~35 activations registered via macro expansion in
+/root/reference/paddle/fluid/operators/activation_op.cc:682+ (list in
+activation_op.h). All lower to single VPU-friendly XLA elementwise HLOs —
+XLA fuses them into neighboring matmuls/convs, which replaces the
+reference's fused-activation kernels (operators/fused/fused_*_activation).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import one
+
+
+def _simple(name, fn):
+    @register_op(name, inputs=("X",))
+    def _op(ctx, ins, attrs, _fn=fn):
+        return one(_fn(ins["X"][0]))
+    return _op
+
+
+_SIMPLE = {
+    "sigmoid": jax.nn.sigmoid,
+    "logsigmoid": jax.nn.log_sigmoid,
+    "exp": jnp.exp,
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "tanh_shrink": lambda x: x - jnp.tanh(x),
+    "sqrt": jnp.sqrt,
+    "rsqrt": jax.lax.rsqrt,
+    "abs": jnp.abs,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "cos": jnp.cos,
+    "sin": jnp.sin,
+    "cosh": jnp.cosh,
+    "sinh": jnp.sinh,
+    "acos": jnp.arccos,
+    "asin": jnp.arcsin,
+    "atan": jnp.arctan,
+    "round": jnp.round,
+    "reciprocal": jnp.reciprocal,
+    "log": jnp.log,
+    "log1p": jnp.log1p,
+    "square": jnp.square,
+    "softsign": jax.nn.soft_sign,
+    "erf": jax.scipy.special.erf,
+    "silu": jax.nn.silu,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+}
+
+for _n, _f in _SIMPLE.items():
+    _simple(_n, _f)
+
+
+@register_op("relu6", inputs=("X",))
+def _relu6(ctx, ins, attrs):
+    threshold = attrs.get("threshold", 6.0)
+    return one(jnp.clip(ins["X"][0], 0.0, threshold))
+
+
+@register_op("leaky_relu", inputs=("X",))
+def _leaky_relu(ctx, ins, attrs):
+    alpha = attrs.get("alpha", 0.02)
+    x = ins["X"][0]
+    return one(jnp.where(x >= 0, x, alpha * x))
+
+
+@register_op("elu", inputs=("X",))
+def _elu(ctx, ins, attrs):
+    alpha = attrs.get("alpha", 1.0)
+    x = ins["X"][0]
+    return one(jnp.where(x > 0, x, alpha * jnp.expm1(x)))
+
+
+@register_op("selu", inputs=("X",))
+def _selu(ctx, ins, attrs):
+    scale = attrs.get("scale", 1.0507009873554805)
+    alpha = attrs.get("alpha", 1.6732632423543772)
+    x = ins["X"][0]
+    return one(scale * jnp.where(x > 0, x, alpha * jnp.expm1(x)))
+
+
+@register_op("gelu", inputs=("X",))
+def _gelu(ctx, ins, attrs):
+    return one(jax.nn.gelu(ins["X"][0],
+                           approximate=attrs.get("approximate", False)))
+
+
+@register_op("softplus", inputs=("X",))
+def _softplus(ctx, ins, attrs):
+    # activation_op.h SoftplusFunctor: beta/threshold form
+    beta = attrs.get("beta", 1.0)
+    threshold = attrs.get("threshold", 20.0)
+    x = ins["X"][0]
+    bx = beta * x
+    return one(jnp.where(bx > threshold, x, jax.nn.softplus(bx) / beta))
+
+
+@register_op("hard_sigmoid", inputs=("X",))
+def _hard_sigmoid(ctx, ins, attrs):
+    slope = attrs.get("slope", 0.2)
+    offset = attrs.get("offset", 0.5)
+    return one(jnp.clip(slope * ins["X"][0] + offset, 0.0, 1.0))
+
+
+@register_op("hard_swish", inputs=("X",))
+def _hard_swish(ctx, ins, attrs):
+    threshold = attrs.get("threshold", 6.0)
+    scale = attrs.get("scale", 6.0)
+    offset = attrs.get("offset", 3.0)
+    x = ins["X"][0]
+    return one(x * jnp.clip(x + offset, 0.0, threshold) / scale)
+
+
+@register_op("swish", inputs=("X",))
+def _swish(ctx, ins, attrs):
+    beta = attrs.get("beta", 1.0)
+    x = ins["X"][0]
+    return one(x * jax.nn.sigmoid(beta * x))
+
+
+@register_op("hard_shrink", inputs=("X",))
+def _hard_shrink(ctx, ins, attrs):
+    t = attrs.get("threshold", 0.5)
+    x = ins["X"][0]
+    return one(jnp.where(jnp.abs(x) > t, x, 0.0))
+
+
+@register_op("soft_shrink", inputs=("X",))
+def _soft_shrink(ctx, ins, attrs):
+    lam = attrs.get("lambda", 0.5)
+    x = ins["X"][0]
+    return one(jnp.where(x > lam, x - lam, jnp.where(x < -lam, x + lam, 0.0)))
+
+
+@register_op("thresholded_relu", inputs=("X",))
+def _thresholded_relu(ctx, ins, attrs):
+    t = attrs.get("threshold", 1.0)
+    x = ins["X"][0]
+    return one(jnp.where(x > t, x, 0.0))
+
+
+@register_op("brelu", inputs=("X",))
+def _brelu(ctx, ins, attrs):
+    t_min = attrs.get("t_min", 0.0)
+    t_max = attrs.get("t_max", 24.0)
+    return one(jnp.clip(ins["X"][0], t_min, t_max))
+
+
+@register_op("stanh", inputs=("X",))
+def _stanh(ctx, ins, attrs):
+    a = attrs.get("scale_a", 0.67)
+    b = attrs.get("scale_b", 1.7159)
+    return one(b * jnp.tanh(a * ins["X"][0]))
+
+
+@register_op("pow", inputs=("X",))
+def _pow(ctx, ins, attrs):
+    return one(jnp.power(ins["X"][0], attrs.get("factor", 1.0)))
+
+
+@register_op("prelu", inputs=("X", "Alpha"))
+def _prelu(ctx, ins, attrs):
+    # operators/prelu_op.cc modes: all | channel | element
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "channel" and x.ndim == 4:
+        alpha = alpha.reshape((1, -1, 1, 1))
+    return one(jnp.where(x > 0, x, alpha * x))

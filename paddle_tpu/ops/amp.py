@@ -1,0 +1,64 @@
+"""AMP loss-scaling ops — /root/reference/paddle/fluid/operators/amp/
+(check_finite_and_unscale_op.cc, update_loss_scaling_op.cc).
+
+On TPU the native mixed-precision dtype is bfloat16, whose fp32-range
+exponent makes loss scaling normally unnecessary; these ops exist for parity
+and for float16 policies.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("check_finite_and_unscale", inputs=("X", "Scale"),
+             outputs=("Out", "FoundInfinite"), no_grad=True)
+def _check_finite_and_unscale(ctx, ins, attrs):
+    xs = ins["X"]
+    scale = ins["Scale"][0]
+    found = jnp.zeros((), dtype=bool)
+    outs = []
+    for x in xs:
+        finite = jnp.all(jnp.isfinite(x))
+        found = jnp.logical_or(found, jnp.logical_not(finite))
+        outs.append(x / scale)
+    return {"Out": outs, "FoundInfinite": [found]}
+
+
+@register_op("update_loss_scaling",
+             inputs=("X", "FoundInfinite", "PrevLossScaling", "InGoodSteps",
+                     "InBadSteps"),
+             outputs=("Out", "LossScaling", "OutGoodSteps", "OutBadSteps"),
+             no_grad=True,
+             inplace_map={"LossScaling": "PrevLossScaling",
+                          "OutGoodSteps": "InGoodSteps",
+                          "OutBadSteps": "InBadSteps"})
+def _update_loss_scaling(ctx, ins, attrs):
+    found = ins["FoundInfinite"][0]
+    scale = ins["PrevLossScaling"][0]
+    good = ins["InGoodSteps"][0]
+    bad = ins["InBadSteps"][0]
+    incr_every = attrs.get("incr_every_n_steps", 1000)
+    decr_every = attrs.get("decr_every_n_nan_or_inf", 2)
+    incr_ratio = attrs.get("incr_ratio", 2.0)
+    decr_ratio = attrs.get("decr_ratio", 0.5)
+
+    new_bad = jnp.where(found, bad + 1, jnp.zeros_like(bad))
+    new_good = jnp.where(found, jnp.zeros_like(good), good + 1)
+    do_decr = new_bad >= decr_every
+    do_incr = new_good >= incr_every
+    new_scale = jnp.where(do_decr, jnp.maximum(scale * decr_ratio, 1.0),
+                          jnp.where(do_incr, scale * incr_ratio, scale))
+    new_bad = jnp.where(do_decr, jnp.zeros_like(new_bad), new_bad)
+    new_good = jnp.where(do_incr, jnp.zeros_like(new_good), new_good)
+
+    outs = []
+    for x in ins["X"]:
+        # zero grads on overflow, matching the reference kernel's FillIf
+        # (update_loss_scaling_op.h). NOTE: like the reference, an adam step
+        # with zero grad still applies decay; the AMP decorator additionally
+        # gates optimizer ops on FoundInfinite for a true skip.
+        outs.append(jnp.where(found, jnp.zeros_like(x), x))
+    return {"Out": outs, "LossScaling": [new_scale],
+            "OutGoodSteps": [new_good], "OutBadSteps": [new_bad]}

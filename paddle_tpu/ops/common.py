@@ -1,0 +1,35 @@
+"""Shared helpers for op lowerings."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def bcast_y(x, y, axis: int = -1):
+    """Reference elementwise broadcast semantics: Y aligns to X starting at
+    `axis` (axis=-1 means trailing alignment / numpy rules). See
+    /root/reference/paddle/fluid/operators/elementwise/elementwise_op.h
+    (GetBroadcastDims) — e.g. X:[2,3,4,5], Y:[3,4], axis=1 -> Y viewed as
+    [1,3,4,1].
+    """
+    if axis == -1 or x.ndim == y.ndim:
+        return y
+    if y.ndim > x.ndim:
+        return y
+    trailing = x.ndim - axis - y.ndim
+    if trailing < 0:
+        return y
+    new_shape = (1,) * axis + tuple(y.shape) + (1,) * trailing
+    return jnp.reshape(y, new_shape)
+
+
+def one(outs):
+    """Wrap a single output array as the standard {'Out': [v]} dict."""
+    return {"Out": [outs]}
+
+
+def norm_axes(axes, ndim):
+    if axes is None:
+        return tuple(range(ndim))
+    if isinstance(axes, int):
+        axes = [axes]
+    return tuple(a % ndim for a in axes)

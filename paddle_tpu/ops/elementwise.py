@@ -1,0 +1,129 @@
+"""Elementwise binary ops + scalar ops.
+
+Parity surface: /root/reference/paddle/fluid/operators/elementwise/
+(elementwise_{add,sub,mul,div,max,min,mod,floordiv,pow}_op.cc) plus scale,
+clip, cast, sign, etc. from operators/. On TPU these are single VPU-mapped
+XLA HLOs; broadcast semantics follow the reference's axis attr
+(elementwise_op.h) via common.bcast_y.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dtypes import to_jax_dtype
+from ..core.registry import register_op
+from .common import bcast_y, one
+
+_BINOPS = {
+    "elementwise_add": jnp.add,
+    "elementwise_sub": jnp.subtract,
+    "elementwise_mul": jnp.multiply,
+    "elementwise_div": jnp.divide,
+    "elementwise_max": jnp.maximum,
+    "elementwise_min": jnp.minimum,
+    "elementwise_mod": jnp.mod,
+    "elementwise_floordiv": jnp.floor_divide,
+    "elementwise_pow": jnp.power,
+}
+
+
+def _make_binop(name, fn):
+    @register_op(name, inputs=("X", "Y"))
+    def _op(ctx, ins, attrs, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = bcast_y(x, y, attrs.get("axis", -1))
+        return one(_fn(x, y))
+    return _op
+
+
+for _name, _fn in _BINOPS.items():
+    _make_binop(_name, _fn)
+
+
+@register_op("scale", inputs=("X",))
+def _scale(ctx, ins, attrs):
+    # operators/scale_op.cc: Out = scale * (X + bias) if bias_after_scale
+    # is False else scale * X + bias
+    x = ins["X"][0]
+    scale = attrs.get("scale", 1.0)
+    bias = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return one(x * scale + bias)
+    return one((x + bias) * scale)
+
+
+@register_op("clip", inputs=("X",))
+def _clip(ctx, ins, attrs):
+    return one(jnp.clip(ins["X"][0], attrs.get("min"), attrs.get("max")))
+
+
+@register_op("clip_by_norm", inputs=("X",))
+def _clip_by_norm(ctx, ins, attrs):
+    # operators/clip_by_norm_op.h: out = x * max_norm / max(norm(x), max_norm)
+    x = ins["X"][0]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(x * x))
+    return one(x * (max_norm / jnp.maximum(norm, max_norm)))
+
+
+@register_op("cast", inputs=("X",))
+def _cast(ctx, ins, attrs):
+    return one(ins["X"][0].astype(to_jax_dtype(attrs["out_dtype"])))
+
+
+@register_op("sign", inputs=("X",))
+def _sign(ctx, ins, attrs):
+    return one(jnp.sign(ins["X"][0]))
+
+
+@register_op("minus", inputs=("X", "Y"))
+def _minus(ctx, ins, attrs):
+    return one(ins["X"][0] - ins["Y"][0])
+
+
+@register_op("kron", inputs=("X", "Y"))
+def _kron(ctx, ins, attrs):
+    return one(jnp.kron(ins["X"][0], ins["Y"][0]))
+
+
+# --- comparison / logical (operators/controlflow/compare_op.cc,
+# logical_op.cc) — no grad
+_CMP = {
+    "equal": jnp.equal, "not_equal": jnp.not_equal, "less_than": jnp.less,
+    "less_equal": jnp.less_equal, "greater_than": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+}
+for _name, _fn in _CMP.items():
+    def _mk(name, fn):
+        @register_op(name, inputs=("X", "Y"), no_grad=True)
+        def _op(ctx, ins, attrs, _fn=fn):
+            x, y = ins["X"][0], ins["Y"][0]
+            return one(_fn(x, bcast_y(x, y, attrs.get("axis", -1))))
+    _mk(_name, _fn)
+
+_LOGICAL = {"logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+            "logical_xor": jnp.logical_xor}
+for _name, _fn in _LOGICAL.items():
+    def _mk2(name, fn):
+        @register_op(name, inputs=("X", "Y"), no_grad=True)
+        def _op(ctx, ins, attrs, _fn=fn):
+            return one(_fn(ins["X"][0], ins["Y"][0]))
+    _mk2(_name, _fn)
+
+
+@register_op("logical_not", inputs=("X",), no_grad=True)
+def _logical_not(ctx, ins, attrs):
+    return one(jnp.logical_not(ins["X"][0]))
+
+
+@register_op("isfinite", inputs=("X",), no_grad=True)
+def _isfinite(ctx, ins, attrs):
+    return one(jnp.all(jnp.isfinite(ins["X"][0])))
+
+
+@register_op("allclose", inputs=("Input", "Other"), no_grad=True)
+def _allclose(ctx, ins, attrs):
+    return one(jnp.allclose(ins["Input"][0], ins["Other"][0],
+                            rtol=float(attrs.get("rtol", 1e-5)),
+                            atol=float(attrs.get("atol", 1e-8)),
+                            equal_nan=attrs.get("equal_nan", False)))

@@ -1,0 +1,234 @@
+"""Dense math ops: matmul family, linalg, misc math.
+
+Parity surface: /root/reference/paddle/fluid/operators/{matmul,mul,bmm,dot,
+addmm,...}_op.cc. These are the MXU ops — all lower to lax.dot_general /
+jnp.einsum so XLA tiles them onto the 128x128 systolic array; bf16 inputs
+hit the MXU natively (the reference routes these to cuBLAS via
+operators/math/blas_impl.cu.h).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import one
+
+
+@register_op("matmul", inputs=("X", "Y"))
+def _matmul(ctx, ins, attrs):
+    # operators/matmul_op.cc: transpose_X/transpose_Y/alpha attrs, batched
+    # via leading dims.
+    x, y = ins["X"][0], ins["Y"][0]
+    tx = attrs.get("transpose_X", False)
+    ty = attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    if x.ndim == 1 and y.ndim == 1:
+        out = jnp.dot(x, y)
+    else:
+        if tx:
+            x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+        if ty:
+            y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+        out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return one(out)
+
+
+@register_op("matmul_v2", inputs=("X", "Y"))
+def _matmul_v2(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("trans_x", False) and x.ndim > 1:
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("trans_y", False) and y.ndim > 1:
+        y = jnp.swapaxes(y, -1, -2)
+    return one(jnp.matmul(x, y))
+
+
+@register_op("mul", inputs=("X", "Y"))
+def _mul(ctx, ins, attrs):
+    # operators/mul_op.cc: flattens X to 2-D at x_num_col_dims, Y at
+    # y_num_col_dims, then plain matmul — the fc building block.
+    import math as _math
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    xshape = x.shape
+    x2 = x.reshape((_math.prod(xshape[:xn]) if xn else 1, -1)) \
+        if x.ndim != 2 else x
+    y2 = y.reshape((-1, _math.prod(y.shape[yn:]))) \
+        if y.ndim != 2 else y
+    out = jnp.matmul(x2, y2)
+    if x.ndim > 2:
+        out = out.reshape(xshape[:xn] + y.shape[yn:])
+    return one(out)
+
+
+@register_op("bmm", inputs=("X", "Y"))
+def _bmm(ctx, ins, attrs):
+    return one(jnp.matmul(ins["X"][0], ins["Y"][0]))
+
+
+@register_op("dot", inputs=("X", "Y"))
+def _dot(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return one(jnp.sum(x * y, axis=-1))
+
+
+@register_op("addmm", inputs=("Input", "X", "Y"))
+def _addmm(ctx, ins, attrs):
+    inp, x, y = ins["Input"][0], ins["X"][0], ins["Y"][0]
+    alpha = attrs.get("Alpha", 1.0)
+    beta = attrs.get("Beta", 1.0)
+    return one(beta * inp + alpha * jnp.matmul(x, y))
+
+
+@register_op("sum", inputs=("X",))
+def _sum(ctx, ins, attrs):
+    # operators/sum_op.cc: adds N tensors
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return one(out)
+
+
+@register_op("sum_of_sums", inputs=("X",))
+def _sum_of_sums(ctx, ins, attrs):
+    # internal helper for gradients() with multiple targets
+    return one(sum(jnp.sum(x) for x in ins["X"]))
+
+
+@register_op("mean", inputs=("X",))
+def _mean(ctx, ins, attrs):
+    return one(jnp.mean(ins["X"][0]))
+
+
+@register_op("cumsum", inputs=("X",))
+def _cumsum(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    if attrs.get("reverse", False):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        pad = [(0, 0)] * x.ndim
+        pad[axis % x.ndim] = (1, 0)
+        out = jnp.pad(out, pad)[tuple(
+            slice(0, -1) if i == axis % x.ndim else slice(None)
+            for i in range(x.ndim))]
+    if attrs.get("reverse", False):
+        out = jnp.flip(out, axis)
+    return one(out)
+
+
+@register_op("trace", inputs=("Input",))
+def _trace(ctx, ins, attrs):
+    return one(jnp.trace(ins["Input"][0], offset=attrs.get("offset", 0),
+                         axis1=attrs.get("axis1", 0),
+                         axis2=attrs.get("axis2", 1)))
+
+
+@register_op("cholesky", inputs=("X",))
+def _cholesky(ctx, ins, attrs):
+    x = ins["X"][0]
+    if attrs.get("upper", False):
+        return one(jnp.swapaxes(jnp.linalg.cholesky(x), -1, -2))
+    return one(jnp.linalg.cholesky(x))
+
+
+@register_op("inverse", inputs=("Input",), outputs=("Output",))
+def _inverse(ctx, ins, attrs):
+    return {"Output": [jnp.linalg.inv(ins["Input"][0])]}
+
+
+@register_op("cross", inputs=("X", "Y"))
+def _cross(ctx, ins, attrs):
+    dim = attrs.get("dim", -1)
+    return one(jnp.cross(ins["X"][0], ins["Y"][0], axis=dim))
+
+
+@register_op("norm", inputs=("X",))
+def _norm(ctx, ins, attrs):
+    # operators/norm_op.cc: l2-normalize along axis, also outputs Norm
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+@register_op("p_norm", inputs=("X",))
+def _p_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs.get("porder", 2.0)
+    axis = attrs.get("axis", -1)
+    keepdim = attrs.get("keepdim", False)
+    eps = attrs.get("epsilon", 1e-12)
+    if p == float("inf"):
+        out = jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    elif p == float("-inf"):
+        out = jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    else:
+        out = jnp.power(jnp.sum(jnp.power(jnp.abs(x) + eps, p), axis=axis,
+                                keepdims=keepdim), 1.0 / p)
+    return one(out)
+
+
+@register_op("frobenius_norm", inputs=("X",))
+def _frobenius_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    dims = attrs.get("dim", None)
+    keepdim = attrs.get("keep_dim", False)
+    axis = tuple(dims) if dims else None
+    return one(jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=keepdim)))
+
+
+@register_op("l1_norm", inputs=("X",))
+def _l1_norm(ctx, ins, attrs):
+    return one(jnp.sum(jnp.abs(ins["X"][0])))
+
+
+@register_op("squared_l2_norm", inputs=("X",))
+def _squared_l2_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    return one(jnp.sum(x * x))
+
+
+@register_op("logsumexp", inputs=("X",))
+def _logsumexp(ctx, ins, attrs):
+    axis = attrs.get("axis", None)
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) and axis else None
+    return one(jax.scipy.special.logsumexp(
+        ins["X"][0], axis=axis, keepdims=attrs.get("keepdim", False)))
+
+
+@register_op("increment", inputs=("X",))
+def _increment(ctx, ins, attrs):
+    return one(ins["X"][0] + attrs.get("step", 1.0))
+
+
+@register_op("cos_sim", inputs=("X", "Y"))
+def _cos_sim(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    return {"Out": [jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn)],
+            "XNorm": [xn], "YNorm": [yn]}
+
+
+@register_op("dist", inputs=("X", "Y"))
+def _dist(ctx, ins, attrs):
+    p = attrs.get("p", 2.0)
+    d = ins["X"][0] - ins["Y"][0]
+    if p == 0:
+        return one(jnp.sum(d != 0).astype(d.dtype))
+    if p == float("inf"):
+        return one(jnp.max(jnp.abs(d)))
+    if p == float("-inf"):
+        return one(jnp.min(jnp.abs(d)))
+    return one(jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p))

@@ -1,0 +1,90 @@
+"""Metric ops — /root/reference/paddle/fluid/operators/metrics/
+(accuracy_op.cc, auc_op.cc, precision_recall_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("accuracy", inputs=("Out", "Indices", "Label"),
+             outputs=("Accuracy", "Correct", "Total"), no_grad=True)
+def _accuracy(ctx, ins, attrs):
+    # accuracy_op.cc: Indices = top-k predicted ids [N, k], Label [N, 1]
+    indices, label = ins["Indices"][0], ins["Label"][0]
+    if label.ndim == 2:
+        label = label[:, 0]
+    correct = jnp.any(indices == label[:, None], axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    total = label.shape[0]
+    return {"Accuracy": [num_correct.astype(jnp.float32) / total],
+            "Correct": [num_correct], "Total": [jnp.asarray(total)]}
+
+
+@register_op("auc", inputs=("Predict", "Label", "StatPos", "StatNeg"),
+             outputs=("AUC", "StatPosOut", "StatNegOut"), no_grad=True,
+             inplace_map={"StatPosOut": "StatPos", "StatNegOut": "StatNeg"})
+def _auc(ctx, ins, attrs):
+    # auc_op.cc: streaming AUC over histogram buckets of the positive-class
+    # probability.
+    predict, label = ins["Predict"][0], ins["Label"][0]
+    stat_pos, stat_neg = ins["StatPos"][0], ins["StatNeg"][0]
+    num_thresholds = attrs.get("num_thresholds", 4095)
+    prob = predict[:, 1] if predict.ndim == 2 and predict.shape[1] == 2 \
+        else predict.reshape(-1)
+    lbl = label.reshape(-1).astype(jnp.int32)
+    bucket = jnp.clip((prob * num_thresholds).astype(jnp.int32), 0,
+                      num_thresholds)
+    pos_add = jnp.zeros_like(stat_pos).at[bucket].add(
+        (lbl == 1).astype(stat_pos.dtype))
+    neg_add = jnp.zeros_like(stat_neg).at[bucket].add(
+        (lbl == 0).astype(stat_neg.dtype))
+    sp = stat_pos + pos_add
+    sn = stat_neg + neg_add
+    # integrate trapezoid over descending threshold
+    tp = jnp.cumsum(sp[::-1])
+    fp = jnp.cumsum(sn[::-1])
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tp_prev = jnp.concatenate([jnp.zeros(1, tp.dtype), tp[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros(1, fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+    auc = jnp.where(tot_pos * tot_neg > 0, area / (tot_pos * tot_neg), 0.0)
+    return {"AUC": [auc], "StatPosOut": [sp], "StatNegOut": [sn]}
+
+
+@register_op("precision_recall",
+             inputs=("MaxProbs", "Indices", "Labels", "Weights",
+                     "StatesInfo"),
+             outputs=("BatchMetrics", "AccumMetrics", "AccumStatesInfo"),
+             no_grad=True)
+def _precision_recall(ctx, ins, attrs):
+    import jax
+    indices, labels = ins["Indices"][0], ins["Labels"][0]
+    states = ins["StatesInfo"][0]  # [C, 4]: TP FP TN FN
+    cls_num = attrs["class_number"]
+    pred = indices.reshape(-1).astype(jnp.int32)
+    lbl = labels.reshape(-1).astype(jnp.int32)
+    oh_pred = jax.nn.one_hot(pred, cls_num)
+    oh_lbl = jax.nn.one_hot(lbl, cls_num)
+    tp = jnp.sum(oh_pred * oh_lbl, axis=0)
+    fp = jnp.sum(oh_pred * (1 - oh_lbl), axis=0)
+    fn = jnp.sum((1 - oh_pred) * oh_lbl, axis=0)
+    tn = jnp.sum((1 - oh_pred) * (1 - oh_lbl), axis=0)
+    batch = jnp.stack([tp, fp, tn, fn], axis=1)
+    accum = states + batch
+
+    def metrics(s):
+        tp_, fp_, tn_, fn_ = s[:, 0], s[:, 1], s[:, 2], s[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / (tp_ + fp_), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / (tp_ + fn_), 0.0)
+        f1 = jnp.where(prec + rec > 0, 2 * prec * rec / (prec + rec), 0.0)
+        micro_p = jnp.sum(tp_) / jnp.maximum(jnp.sum(tp_ + fp_), 1.0)
+        micro_r = jnp.sum(tp_) / jnp.maximum(jnp.sum(tp_ + fn_), 1.0)
+        micro_f1 = jnp.where(micro_p + micro_r > 0,
+                             2 * micro_p * micro_r / (micro_p + micro_r), 0.0)
+        return jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1),
+                          micro_p, micro_r, micro_f1])
+
+    return {"BatchMetrics": [metrics(batch)], "AccumMetrics": [metrics(accum)],
+            "AccumStatesInfo": [accum]}

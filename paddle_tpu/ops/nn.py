@@ -1,0 +1,688 @@
+"""Neural-net ops: conv/pool/norm/dropout/embedding/softmax/losses/attention.
+
+Parity surface: /root/reference/paddle/fluid/operators/ conv2d (conv_op.cc,
+conv_cudnn_op.cu), pool2d, softmax, layer_norm_op.cu, batch_norm_op.cc,
+dropout_op.cc, lookup_table_op.cc, cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, and the fused attention
+(operators/fused/multihead_matmul_op.cu). Convs and matmuls lower to
+lax.conv_general_dilated / dot_general for the MXU; batch_norm keeps
+running-stat state functionally (MeanOut/VarianceOut) matching the reference
+kernel contract.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import one
+
+
+# ---------------------------------------------------------------------------
+# convolution family
+# ---------------------------------------------------------------------------
+def _conv_nd(x, w, strides, paddings, dilations, groups, data_format="NCHW"):
+    nd = x.ndim - 2
+    if isinstance(paddings, int):
+        paddings = [paddings] * nd
+    if len(paddings) == nd:
+        pads = [(p, p) for p in paddings]
+    else:  # [before0, after0, before1, after1 ...]
+        pads = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(nd)]
+    if data_format in ("NCHW", "NCDHW"):
+        dn_in = "NC" + "DHW"[-nd:]
+        dn_out = dn_in
+    else:
+        dn_in = "N" + "DHW"[-nd:] + "C"
+        dn_out = dn_in
+    dn_kernel = "OI" + "DHW"[-nd:]
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        (dn_in, dn_kernel, dn_out))
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pads,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups)
+
+
+@register_op("conv2d", inputs=("Input", "Filter"), outputs=("Output",))
+def _conv2d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    out = _conv_nd(x, w,
+                   tuple(attrs.get("strides", [1, 1])),
+                   attrs.get("paddings", [0, 0]),
+                   tuple(attrs.get("dilations", [1, 1])),
+                   attrs.get("groups", 1),
+                   attrs.get("data_format", "NCHW"))
+    return {"Output": [out]}
+
+
+@register_op("depthwise_conv2d", inputs=("Input", "Filter"),
+             outputs=("Output",))
+def _depthwise_conv2d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    groups = attrs.get("groups", x.shape[1])
+    out = _conv_nd(x, w, tuple(attrs.get("strides", [1, 1])),
+                   attrs.get("paddings", [0, 0]),
+                   tuple(attrs.get("dilations", [1, 1])), groups,
+                   attrs.get("data_format", "NCHW"))
+    return {"Output": [out]}
+
+
+@register_op("conv3d", inputs=("Input", "Filter"), outputs=("Output",))
+def _conv3d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    out = _conv_nd(x, w, tuple(attrs.get("strides", [1, 1, 1])),
+                   attrs.get("paddings", [0, 0, 0]),
+                   tuple(attrs.get("dilations", [1, 1, 1])),
+                   attrs.get("groups", 1),
+                   attrs.get("data_format", "NCDHW"))
+    return {"Output": [out]}
+
+
+@register_op("conv2d_transpose", inputs=("Input", "Filter"),
+             outputs=("Output",))
+def _conv2d_transpose(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    paddings = attrs.get("paddings", [0, 0])
+    dilations = tuple(attrs.get("dilations", [1, 1]))
+    # conv2d_transpose == conv backward-data (reference conv_transpose_op.h):
+    # weight layout is [in_c, out_c, kh, kw]; lower via input dilation.
+    if isinstance(paddings, int):
+        paddings = [paddings] * 2
+    pads = [(p, p) for p in paddings] if len(paddings) == 2 else \
+        [(paddings[0], paddings[1]), (paddings[2], paddings[3])]
+    wt = jnp.flip(jnp.swapaxes(w, 0, 1), axis=(2, 3))  # [out_c, in_c, kh, kw]
+    dn = jax.lax.conv_dimension_numbers(x.shape, wt.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+    out = jax.lax.conv_general_dilated(
+        x, wt, window_strides=(1, 1),
+        padding=[(d * (k - 1) - p0, d * (k - 1) - p1)
+                 for (p0, p1), k, d in zip(pads, w.shape[2:], dilations)],
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=dn)
+    return {"Output": [out]}
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+def _pool(x, ksize, strides, paddings, pooling_type, ceil_mode, exclusive,
+          adaptive, global_pooling, nd):
+    if global_pooling or (adaptive and all(k == 1 for k in ksize)):
+        axes = tuple(range(2, 2 + nd))
+        if pooling_type == "max":
+            return jnp.max(x, axis=axes, keepdims=True)
+        return jnp.mean(x, axis=axes, keepdims=True)
+    if adaptive:
+        # adaptive pooling to output size ksize
+        out = x
+        for i, osize in enumerate(ksize):
+            axis = 2 + i
+            insize = x.shape[axis]
+            # split into osize equal-ish bins (requires divisibility for TPU)
+            if insize % osize == 0:
+                k = insize // osize
+                shape = list(out.shape)
+                shape[axis:axis + 1] = [osize, k]
+                r = out.reshape(shape)
+                out = (jnp.max(r, axis=axis + 1) if pooling_type == "max"
+                       else jnp.mean(r, axis=axis + 1))
+            else:
+                raise NotImplementedError(
+                    "adaptive pool needs divisible sizes on TPU")
+        return out
+    window = (1, 1) + tuple(ksize)
+    strides_full = (1, 1) + tuple(strides)
+    if isinstance(paddings, int):
+        paddings = [paddings] * nd
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    if ceil_mode:
+        new_pads = []
+        for i, (lo, hi) in enumerate(pads):
+            if i >= 2:
+                dim = x.shape[i]
+                k, s = window[i], strides_full[i]
+                out_sz = -(-(dim + lo + hi - k) // s) + 1
+                needed = (out_sz - 1) * s + k - dim - lo
+                hi = max(hi, needed)
+            new_pads.append((lo, hi))
+        pads = tuple(new_pads)
+    if pooling_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window,
+                                     strides_full, pads)
+    # avg pool
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_full,
+                                   pads)
+    if exclusive:
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                       strides_full, pads)
+        return summed / counts
+    return summed / np.prod(ksize)
+
+
+@register_op("pool2d", inputs=("X",))
+def _pool2d(ctx, ins, attrs):
+    return one(_pool(ins["X"][0], attrs.get("ksize", [2, 2]),
+                     attrs.get("strides", [1, 1]), attrs.get("paddings", [0, 0]),
+                     attrs.get("pooling_type", "max"),
+                     attrs.get("ceil_mode", False),
+                     attrs.get("exclusive", True),
+                     attrs.get("adaptive", False),
+                     attrs.get("global_pooling", False), 2))
+
+
+@register_op("pool3d", inputs=("X",))
+def _pool3d(ctx, ins, attrs):
+    return one(_pool(ins["X"][0], attrs.get("ksize", [2, 2, 2]),
+                     attrs.get("strides", [1, 1, 1]),
+                     attrs.get("paddings", [0, 0, 0]),
+                     attrs.get("pooling_type", "max"),
+                     attrs.get("ceil_mode", False),
+                     attrs.get("exclusive", True),
+                     attrs.get("adaptive", False),
+                     attrs.get("global_pooling", False), 3))
+
+
+# ---------------------------------------------------------------------------
+# softmax & losses
+# ---------------------------------------------------------------------------
+@register_op("softmax", inputs=("X",))
+def _softmax(ctx, ins, attrs):
+    return one(jax.nn.softmax(ins["X"][0], axis=attrs.get("axis", -1)))
+
+
+@register_op("log_softmax", inputs=("X",))
+def _log_softmax(ctx, ins, attrs):
+    return one(jax.nn.log_softmax(ins["X"][0], axis=attrs.get("axis", -1)))
+
+
+@register_op("cross_entropy", inputs=("X", "Label"),
+             outputs=("Y",), non_diff_inputs=("Label",))
+def _cross_entropy(ctx, ins, attrs):
+    # operators/cross_entropy_op.cc: X is probabilities (post-softmax)
+    x, label = ins["X"][0], ins["Label"][0]
+    soft = attrs.get("soft_label", False)
+    ignore = attrs.get("ignore_index", -100)
+    eps = 1e-12
+    if soft:
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == x.ndim:
+            lbl = jnp.squeeze(lbl, -1)
+        picked = jnp.take_along_axis(x, lbl[..., None].astype(jnp.int32),
+                                     axis=-1)
+        loss = -jnp.log(picked + eps)
+        loss = jnp.where(lbl[..., None] == ignore, 0.0, loss)
+    return {"Y": [loss]}
+
+
+@register_op("cross_entropy2", inputs=("X", "Label"),
+             outputs=("Y", "XShape", "MatchX"), non_diff_inputs=("Label",))
+def _cross_entropy2(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    lbl = jnp.squeeze(label, -1) if label.ndim == x.ndim else label
+    picked = jnp.take_along_axis(x, lbl[..., None].astype(jnp.int32), axis=-1)
+    return {"Y": [-jnp.log(picked + 1e-12)],
+            "XShape": [jnp.zeros((0,) + x.shape, x.dtype)],
+            "MatchX": [picked]}
+
+
+@register_op("softmax_with_cross_entropy", inputs=("Logits", "Label"),
+             outputs=("Softmax", "Loss"), non_diff_inputs=("Label",))
+def _softmax_with_ce(ctx, ins, attrs):
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    axis = attrs.get("axis", -1)
+    soft_label = attrs.get("soft_label", False)
+    ignore = attrs.get("ignore_index", -100)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    softmax = jnp.exp(logp)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim:
+            lbl = jnp.squeeze(lbl, axis)
+        picked = jnp.take_along_axis(logp, lbl[..., None].astype(jnp.int32),
+                                     axis=axis)
+        loss = -picked
+        loss = jnp.where(lbl[..., None] == ignore, 0.0, loss)
+    return {"Softmax": [softmax], "Loss": [loss]}
+
+
+@register_op("sigmoid_cross_entropy_with_logits", inputs=("X", "Label"),
+             non_diff_inputs=("Label",))
+def _sigmoid_ce(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    ignore = attrs.get("ignore_index", -100)
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = label != ignore
+    loss = jnp.where(mask, loss, 0.0)
+    if attrs.get("normalize", False):
+        loss = loss / jnp.maximum(jnp.sum(mask.astype(x.dtype)), 1.0)
+    return one(loss)
+
+
+@register_op("bce_loss", inputs=("X", "Label"), non_diff_inputs=("Label",))
+def _bce_loss(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    eps = 1e-12
+    return one(-(label * jnp.log(x + eps) +
+                 (1 - label) * jnp.log(1 - x + eps)))
+
+
+@register_op("square_error_cost", inputs=("X", "Y"))
+def _square_error_cost(ctx, ins, attrs):
+    d = ins["X"][0] - ins["Y"][0]
+    return one(d * d)
+
+
+@register_op("smooth_l1_loss", inputs=("X", "Y", "InsideWeight",
+                                       "OutsideWeight"),
+             outputs=("Out", "Diff"))
+def _smooth_l1(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = attrs.get("sigma", 1.0)
+    sigma2 = sigma * sigma
+    diff = x - y
+    if "InsideWeight" in ins and ins["InsideWeight"]:
+        diff = diff * ins["InsideWeight"][0]
+    abs_diff = jnp.abs(diff)
+    loss = jnp.where(abs_diff < 1.0 / sigma2,
+                     0.5 * diff * diff * sigma2,
+                     abs_diff - 0.5 / sigma2)
+    if "OutsideWeight" in ins and ins["OutsideWeight"]:
+        loss = loss * ins["OutsideWeight"][0]
+    return {"Out": [jnp.sum(loss, axis=tuple(range(1, loss.ndim)),
+                            keepdims=True).reshape(x.shape[0], 1)],
+            "Diff": [diff]}
+
+
+@register_op("huber_loss", inputs=("X", "Y"), outputs=("Out", "Residual"))
+def _huber_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r,
+                     delta * (ar - 0.5 * delta))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register_op("log_loss", inputs=("Predicted", "Labels"),
+             outputs=("Loss",), non_diff_inputs=("Labels",))
+def _log_loss(ctx, ins, attrs):
+    p, l = ins["Predicted"][0], ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    return {"Loss": [-l * jnp.log(p + eps) -
+                     (1 - l) * jnp.log(1 - p + eps)]}
+
+
+@register_op("hinge_loss", inputs=("Logits", "Labels"),
+             outputs=("Loss",), non_diff_inputs=("Labels",))
+def _hinge_loss(ctx, ins, attrs):
+    logits, labels = ins["Logits"][0], ins["Labels"][0]
+    return {"Loss": [jnp.maximum(1.0 - (2.0 * labels - 1.0) * logits, 0.0)]}
+
+
+@register_op("rank_loss", inputs=("Left", "Right", "Label"),
+             non_diff_inputs=("Label",))
+def _rank_loss(ctx, ins, attrs):
+    left, right, label = ins["Left"][0], ins["Right"][0], ins["Label"][0]
+    d = left - right
+    return one(jnp.log1p(jnp.exp(d)) - label * d)
+
+
+@register_op("margin_rank_loss", inputs=("X1", "X2", "Label"),
+             outputs=("Out", "Activated"), non_diff_inputs=("Label",))
+def _margin_rank_loss(ctx, ins, attrs):
+    x1, x2, label = ins["X1"][0], ins["X2"][0], ins["Label"][0]
+    margin = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": [out], "Activated": [(out > 0).astype(x1.dtype)]}
+
+
+@register_op("kldiv_loss", inputs=("X", "Target"),
+             outputs=("Loss",), non_diff_inputs=("Target",))
+def _kldiv_loss(ctx, ins, attrs):
+    x, target = ins["X"][0], ins["Target"][0]
+    reduction = attrs.get("reduction", "mean")
+    loss = target * (jnp.where(target > 0, jnp.log(target), 0.0) - x)
+    loss = jnp.where(target > 0, loss, 0.0)
+    if reduction == "mean":
+        return {"Loss": [jnp.mean(loss)]}
+    if reduction == "sum":
+        return {"Loss": [jnp.sum(loss)]}
+    if reduction == "batchmean":
+        return {"Loss": [jnp.sum(loss) / x.shape[0]]}
+    return {"Loss": [loss]}
+
+
+@register_op("nll_loss", inputs=("X", "Label", "Weight"),
+             outputs=("Out", "Total_weight"), non_diff_inputs=("Label",))
+def _nll_loss(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    weight = ins.get("Weight", [None])[0] if ins.get("Weight") else None
+    ignore = attrs.get("ignore_index", -100)
+    reduction = attrs.get("reduction", "mean")
+    picked = -jnp.take_along_axis(x, label[..., None].astype(jnp.int32),
+                                  axis=1).squeeze(1)
+    w = jnp.ones_like(picked) if weight is None else weight[label]
+    w = jnp.where(label == ignore, 0.0, w)
+    picked = picked * w
+    total = jnp.sum(w)
+    if reduction == "mean":
+        return {"Out": [jnp.sum(picked) / jnp.maximum(total, 1e-12)],
+                "Total_weight": [total]}
+    if reduction == "sum":
+        return {"Out": [jnp.sum(picked)], "Total_weight": [total]}
+    return {"Out": [picked], "Total_weight": [total]}
+
+
+@register_op("mse_loss", inputs=("X", "Y"))
+def _mse_loss(ctx, ins, attrs):
+    d = ins["X"][0] - ins["Y"][0]
+    return one(jnp.mean(d * d))
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+@register_op("layer_norm", inputs=("X", "Scale", "Bias"),
+             outputs=("Y", "Mean", "Variance"))
+def _layer_norm(ctx, ins, attrs):
+    # operators/layer_norm_op.cu: normalize over trailing dims from
+    # begin_norm_axis; outputs saved mean/var over the leading dims.
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    axis = attrs.get("begin_norm_axis", 1)
+    red = tuple(range(axis, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=red, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0]
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0]
+    lead = int(np.prod(x.shape[:axis]))
+    return {"Y": [y], "Mean": [mean.reshape(lead)],
+            "Variance": [var.reshape(lead)]}
+
+
+@register_op("batch_norm",
+             inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+             outputs=("Y", "MeanOut", "VarianceOut", "SavedMean",
+                      "SavedVariance"))
+def _batch_norm(ctx, ins, attrs):
+    # operators/batch_norm_op.cc contract: training mode computes batch
+    # stats and updates running Mean/Variance with momentum; test mode uses
+    # running stats. MeanOut/VarianceOut share buffers with Mean/Variance in
+    # the reference — here they are functional state outputs the executor
+    # writes back.
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean_in, var_in = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    use_global = attrs.get("use_global_stats", False) or is_test
+    layout = attrs.get("data_layout", "NCHW")
+    c_axis = 1 if layout == "NCHW" else x.ndim - 1
+    red = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+
+    if use_global:
+        mean, var = mean_in, var_in
+        saved_mean, saved_var = mean_in, var_in
+        mean_out, var_out = mean_in, var_in
+    else:
+        mean = jnp.mean(x, axis=red)
+        var = jnp.mean(jnp.square(x), axis=red) - jnp.square(mean)
+        saved_mean, saved_var = mean, var
+        mean_out = momentum * mean_in + (1 - momentum) * mean
+        var_out = momentum * var_in + (1 - momentum) * var
+    inv = jax.lax.rsqrt(var.reshape(bshape) + eps)
+    y = (x - mean.reshape(bshape)) * inv * scale.reshape(bshape) \
+        + bias.reshape(bshape)
+    return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
+
+
+@register_op("instance_norm", inputs=("X", "Scale", "Bias"),
+             outputs=("Y", "SavedMean", "SavedVariance"))
+def _instance_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(shape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(shape)
+    return {"Y": [y], "SavedMean": [mean.reshape(x.shape[0], x.shape[1])],
+            "SavedVariance": [var.reshape(x.shape[0], x.shape[1])]}
+
+
+@register_op("group_norm", inputs=("X", "Scale", "Bias"),
+             outputs=("Y", "Mean", "Variance"))
+def _group_norm(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    g = attrs.get("groups", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    xg = x.reshape((n, g, c // g) + spatial)
+    red = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=red, keepdims=True)
+    var = jnp.var(xg, axis=red, keepdims=True)
+    y = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    shape = [1, c] + [1] * len(spatial)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(shape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(shape)
+    return {"Y": [y], "Mean": [mean.reshape(n, g)],
+            "Variance": [var.reshape(n, g)]}
+
+
+@register_op("data_norm", inputs=("X", "BatchSize", "BatchSum",
+                                  "BatchSquareSum"),
+             outputs=("Y", "Means", "Scales"))
+def _data_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    bsize, bsum, bsq = ins["BatchSize"][0], ins["BatchSum"][0], \
+        ins["BatchSquareSum"][0]
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / bsq)
+    return {"Y": [(x - means) * scales], "Means": [means],
+            "Scales": [scales]}
+
+
+@register_op("l2_normalize", inputs=("X",))
+def _l2_normalize(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-12)
+    return one(x * jax.lax.rsqrt(
+        jnp.sum(x * x, axis=axis, keepdims=True) + eps))
+
+
+# ---------------------------------------------------------------------------
+# dropout & embedding
+# ---------------------------------------------------------------------------
+@register_op("dropout", inputs=("X",), outputs=("Out", "Mask"),
+             is_random=True)
+def _dropout(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        if impl == "upscale_in_train":
+            return {"Out": [x], "Mask": [jnp.ones_like(x)]}
+        return {"Out": [x * (1.0 - p)], "Mask": [jnp.ones_like(x)]}
+    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / max(1.0 - p, 1e-12), 0.0)
+    else:
+        out = x * mask
+    return {"Out": [out], "Mask": [mask]}
+
+
+@register_op("lookup_table", inputs=("W", "Ids"), non_diff_inputs=("Ids",))
+def _lookup_table(ctx, ins, attrs):
+    # operators/lookup_table_op.cc — Ids shaped [..., 1]; padding_idx rows
+    # output zero. Sparse (SelectedRows) grads become XLA scatter-adds.
+    w, ids = ins["W"][0], ins["Ids"][0]
+    if ids.shape and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    padding_idx = attrs.get("padding_idx", -1)
+    out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    if padding_idx != -1:
+        pad = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+        out = jnp.where((ids == pad)[..., None], 0.0, out)
+    return one(out)
+
+
+@register_op("lookup_table_v2", inputs=("W", "Ids"),
+             non_diff_inputs=("Ids",))
+def _lookup_table_v2(ctx, ins, attrs):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    padding_idx = attrs.get("padding_idx", -1)
+    out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    if padding_idx != -1:
+        pad = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+        out = jnp.where((ids == pad)[..., None], 0.0, out)
+    return one(out)
+
+
+@register_op("embedding_bag_sum", inputs=("W", "Ids"),
+             non_diff_inputs=("Ids",))
+def _embedding_bag_sum(ctx, ins, attrs):
+    # fused_embedding_seq_pool analog: lookup + sum-pool over a fixed axis
+    w, ids = ins["W"][0], ins["Ids"][0]
+    out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    return one(jnp.sum(out, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# attention (reference fused/multihead_matmul_op.cu) — composed form; the
+# Pallas flash-attention kernel in paddle_tpu/kernels/flash_attention.py is
+# substituted by layers.multihead_attention when enabled.
+# ---------------------------------------------------------------------------
+@register_op("multihead_matmul", inputs=("Q", "K", "V", "BiasQK"))
+def _multihead_matmul(ctx, ins, attrs):
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    scale = attrs.get("alpha", 1.0)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if ins.get("BiasQK"):
+        scores = scores + ins["BiasQK"][0]
+    probs = jax.nn.softmax(scores, axis=-1)
+    return one(jnp.einsum("bhqk,bhkd->bhqd", probs, v))
+
+
+@register_op("stack_lstm_unit", inputs=("X", "C"), outputs=("H", "COut"))
+def _lstm_unit(ctx, ins, attrs):
+    x, c_prev = ins["X"][0], ins["C"][0]
+    i, f, o, j = jnp.split(x, 4, axis=-1)
+    forget_bias = attrs.get("forget_bias", 0.0)
+    c = c_prev * jax.nn.sigmoid(f + forget_bias) + \
+        jax.nn.sigmoid(i) * jnp.tanh(j)
+    h = jnp.tanh(c) * jax.nn.sigmoid(o)
+    return {"H": [h], "COut": [c]}
+
+
+# ---------------------------------------------------------------------------
+# interpolation
+# ---------------------------------------------------------------------------
+def _interp(x, out_hw, method, align_corners):
+    """NCHW resize with reference align_corners semantics
+    (interpolate_op.h): align_corners maps output i -> i*(in-1)/(out-1);
+    otherwise half-pixel centers (what jax.image.resize implements)."""
+    n, c, h, w = x.shape
+    oh, ow = out_hw
+    if not align_corners:
+        xt = jnp.transpose(x, (0, 2, 3, 1))
+        out = jax.image.resize(xt, (n, oh, ow, c), method=method)
+        return jnp.transpose(out, (0, 3, 1, 2))
+
+    def src_coords(osize, isize):
+        if osize == 1:
+            return jnp.zeros((1,), jnp.float32)
+        return jnp.arange(osize, dtype=jnp.float32) * (isize - 1) / (osize - 1)
+
+    ys = src_coords(oh, h)
+    xs = src_coords(ow, w)
+    if method == "nearest":
+        yi = jnp.round(ys).astype(jnp.int32)
+        xi = jnp.round(xs).astype(jnp.int32)
+        return x[:, :, yi][:, :, :, xi]
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wy = (ys - y0)[None, None, :, None]
+    wx = (xs - x0)[None, None, None, :]
+    g = lambda yy, xx: x[:, :, yy][:, :, :, xx]
+    top = g(y0, x0) * (1 - wx) + g(y0, x1) * wx
+    bot = g(y1, x0) * (1 - wx) + g(y1, x1) * wx
+    return top * (1 - wy) + bot * wy
+
+
+@register_op("bilinear_interp", inputs=("X",))
+def _bilinear_interp(ctx, ins, attrs):
+    x = ins["X"][0]
+    oh = attrs.get("out_h", -1)
+    ow = attrs.get("out_w", -1)
+    scale = attrs.get("scale", 0.0)
+    if oh <= 0 and scale > 0:
+        oh, ow = int(x.shape[2] * scale), int(x.shape[3] * scale)
+    return one(_interp(x, (oh, ow), "bilinear",
+                       attrs.get("align_corners", True)))
+
+
+@register_op("nearest_interp", inputs=("X",))
+def _nearest_interp(ctx, ins, attrs):
+    x = ins["X"][0]
+    oh = attrs.get("out_h", -1)
+    ow = attrs.get("out_w", -1)
+    scale = attrs.get("scale", 0.0)
+    if oh <= 0 and scale > 0:
+        oh, ow = int(x.shape[2] * scale), int(x.shape[3] * scale)
+    return one(_interp(x, (oh, ow), "nearest",
+                       attrs.get("align_corners", True)))
+
+
+@register_op("grid_sampler", inputs=("X", "Grid"), outputs=("Output",))
+def _grid_sampler(ctx, ins, attrs):
+    x, grid = ins["X"][0], ins["Grid"][0]  # x: NCHW, grid: NHW2 in [-1,1]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1) * (w - 1) / 2
+    gy = (grid[..., 1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+
+    def pick(yy, xx):
+        yy = jnp.clip(yy, 0, h - 1)
+        xx = jnp.clip(xx, 0, w - 1)
+        batch = jnp.arange(n)[:, None, None]
+        return x[batch, :, yy, xx]  # N,H,W,C
+
+    wa = ((x1 - gx) * (y1 - gy))[..., None]
+    wb = ((x1 - gx) * (gy - y0))[..., None]
+    wc = ((gx - x0) * (y1 - gy))[..., None]
+    wd = ((gx - x0) * (gy - y0))[..., None]
+    out = wa * pick(y0, x0) + wb * pick(y1, x0) + \
+        wc * pick(y0, x1) + wd * pick(y1, x1)
+    return {"Output": [jnp.transpose(out, (0, 3, 1, 2))]}

@@ -1,0 +1,347 @@
+"""Optimizer update ops.
+
+Parity surface: /root/reference/paddle/fluid/operators/optimizers/
+(sgd_op.cc, momentum_op.h, adam_op.h, adamax_op.h, adagrad_op.h,
+adadelta_op.h, rmsprop_op.h, ftrl_op.h, lamb_op.h, lars_momentum_op.cc,
+decayed_adagrad_op.h, dpsgd_op.h, proximal_gd_op.h, proximal_adagrad_op.h).
+
+In the reference these are in-place device kernels; here each lowers to a
+functional update whose ParamOut/accumulator outputs the executor writes
+back into donated state — XLA aliases the buffers, so updates remain
+in-place on HBM. Sparse (SelectedRows) gradient variants of the reference
+collapse into the same dense path because embedding grads arrive as XLA
+scatter-adds (see ops/nn.py lookup_table).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+_P = {"ParamOut": "Param"}
+
+
+@register_op("sgd", inputs=("Param", "Grad", "LearningRate"),
+             outputs=("ParamOut",), no_grad=True, inplace_map=_P)
+def _sgd(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    return {"ParamOut": [p - lr * g]}
+
+
+@register_op("momentum", inputs=("Param", "Grad", "Velocity", "LearningRate"),
+             outputs=("ParamOut", "VelocityOut"), no_grad=True,
+             inplace_map={"ParamOut": "Param", "VelocityOut": "Velocity"})
+def _momentum(ctx, ins, attrs):
+    p, g, v, lr = (ins["Param"][0], ins["Grad"][0], ins["Velocity"][0],
+                   ins["LearningRate"][0])
+    mu = attrs.get("mu", 0.9)
+    use_nesterov = attrs.get("use_nesterov", False)
+    v_out = mu * v + g
+    if use_nesterov:
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@register_op("adam",
+             inputs=("Param", "Grad", "LearningRate", "Moment1", "Moment2",
+                     "Beta1Pow", "Beta2Pow"),
+             outputs=("ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+                      "Beta2PowOut"),
+             no_grad=True,
+             inplace_map={"ParamOut": "Param", "Moment1Out": "Moment1",
+                          "Moment2Out": "Moment2", "Beta1PowOut": "Beta1Pow",
+                          "Beta2PowOut": "Beta2Pow"})
+def _adam(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    po = p - lr_t * m1o / (jnp.sqrt(m2o) + eps)
+    return {"ParamOut": [po], "Moment1Out": [m1o], "Moment2Out": [m2o],
+            "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
+
+
+@register_op("adamw",
+             inputs=("Param", "Grad", "LearningRate", "Moment1", "Moment2",
+                     "Beta1Pow", "Beta2Pow"),
+             outputs=("ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+                      "Beta2PowOut"),
+             no_grad=True,
+             inplace_map={"ParamOut": "Param", "Moment1Out": "Moment1",
+                          "Moment2Out": "Moment2", "Beta1PowOut": "Beta1Pow",
+                          "Beta2PowOut": "Beta2Pow"})
+def _adamw(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    wd = attrs.get("coeff", 0.01)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    po = p - lr * wd * p - lr_t * m1o / (jnp.sqrt(m2o) + eps)
+    return {"ParamOut": [po], "Moment1Out": [m1o], "Moment2Out": [m2o],
+            "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
+
+
+@register_op("adamax",
+             inputs=("Param", "Grad", "LearningRate", "Moment", "InfNorm",
+                     "Beta1Pow"),
+             outputs=("ParamOut", "MomentOut", "InfNormOut"),
+             no_grad=True,
+             inplace_map={"ParamOut": "Param", "MomentOut": "Moment",
+                          "InfNormOut": "InfNorm"})
+def _adamax(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    m, inf = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    mo = b1 * m + (1 - b1) * g
+    info = jnp.maximum(b2 * inf, jnp.abs(g) + eps)
+    po = p - (lr / (1 - b1p)) * mo / info
+    return {"ParamOut": [po], "MomentOut": [mo], "InfNormOut": [info]}
+
+
+@register_op("adagrad", inputs=("Param", "Grad", "Moment", "LearningRate"),
+             outputs=("ParamOut", "MomentOut"), no_grad=True,
+             inplace_map={"ParamOut": "Param", "MomentOut": "Moment"})
+def _adagrad(ctx, ins, attrs):
+    p, g, m, lr = (ins["Param"][0], ins["Grad"][0], ins["Moment"][0],
+                   ins["LearningRate"][0])
+    eps = attrs.get("epsilon", 1e-6)
+    mo = m + g * g
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(mo) + eps)],
+            "MomentOut": [mo]}
+
+
+@register_op("decayed_adagrad",
+             inputs=("Param", "Grad", "Moment", "LearningRate"),
+             outputs=("ParamOut", "MomentOut"), no_grad=True,
+             inplace_map={"ParamOut": "Param", "MomentOut": "Moment"})
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, m, lr = (ins["Param"][0], ins["Grad"][0], ins["Moment"][0],
+                   ins["LearningRate"][0])
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mo = decay * m + (1 - decay) * g * g
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(mo) + eps)],
+            "MomentOut": [mo]}
+
+
+@register_op("adadelta",
+             inputs=("Param", "Grad", "AvgSquaredGrad", "AvgSquaredUpdate"),
+             outputs=("ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"),
+             no_grad=True,
+             inplace_map={"ParamOut": "Param",
+                          "AvgSquaredGradOut": "AvgSquaredGrad",
+                          "AvgSquaredUpdateOut": "AvgSquaredUpdate"})
+def _adadelta(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    asg, asu = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    asgo = rho * asg + (1 - rho) * g * g
+    update = -jnp.sqrt((asu + eps) / (asgo + eps)) * g
+    asuo = rho * asu + (1 - rho) * update * update
+    return {"ParamOut": [p + update], "AvgSquaredGradOut": [asgo],
+            "AvgSquaredUpdateOut": [asuo]}
+
+
+@register_op("rmsprop",
+             inputs=("Param", "Grad", "MeanSquare", "MeanGrad", "Moment",
+                     "LearningRate"),
+             outputs=("ParamOut", "MomentOut", "MeanSquareOut",
+                      "MeanGradOut"),
+             no_grad=True,
+             inplace_map={"ParamOut": "Param", "MomentOut": "Moment",
+                          "MeanSquareOut": "MeanSquare",
+                          "MeanGradOut": "MeanGrad"})
+def _rmsprop(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mg, mom = ins["MeanSquare"][0], ins["MeanGrad"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0]
+    rho = attrs.get("decay", 0.9)
+    eps = attrs.get("epsilon", 1e-10)
+    momentum = attrs.get("momentum", 0.0)
+    centered = attrs.get("centered", False)
+    mso = rho * ms + (1 - rho) * g * g
+    if centered:
+        mgo = rho * mg + (1 - rho) * g
+        denom = mso - mgo * mgo + eps
+    else:
+        mgo = mg
+        denom = mso + eps
+    momo = momentum * mom + lr * g / jnp.sqrt(denom)
+    return {"ParamOut": [p - momo], "MomentOut": [momo],
+            "MeanSquareOut": [mso], "MeanGradOut": [mgo]}
+
+
+@register_op("ftrl",
+             inputs=("Param", "SquaredAccumulator", "LinearAccumulator",
+                     "Grad", "LearningRate"),
+             outputs=("ParamOut", "SquaredAccumOut", "LinearAccumOut"),
+             no_grad=True,
+             inplace_map={"ParamOut": "Param",
+                          "SquaredAccumOut": "SquaredAccumulator",
+                          "LinearAccumOut": "LinearAccumulator"})
+def _ftrl(ctx, ins, attrs):
+    p, sq, lin, g, lr = (ins["Param"][0], ins["SquaredAccumulator"][0],
+                         ins["LinearAccumulator"][0], ins["Grad"][0],
+                         ins["LearningRate"][0])
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    new_sq = sq + g * g
+    if power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -power) - jnp.power(sq, -power)) / lr
+    lin_out = lin + g - sigma * p
+    if power == -0.5:
+        x = l2 + jnp.sqrt(new_sq) / lr
+    else:
+        x = l2 + jnp.power(new_sq, -power) / lr
+    pre = jnp.clip(lin_out, -l1, l1) - lin_out
+    p_out = pre / x
+    return {"ParamOut": [p_out], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [lin_out]}
+
+
+@register_op("lamb",
+             inputs=("Param", "Grad", "LearningRate", "Moment1", "Moment2",
+                     "Beta1Pow", "Beta2Pow"),
+             outputs=("ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+                      "Beta2PowOut"),
+             no_grad=True,
+             inplace_map={"ParamOut": "Param", "Moment1Out": "Moment1",
+                          "Moment2Out": "Moment2", "Beta1PowOut": "Beta1Pow",
+                          "Beta2PowOut": "Beta2Pow"})
+def _lamb(ctx, ins, attrs):
+    # operators/optimizers/lamb_op.h: trust-ratio-scaled adam update
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * g * g
+    m1_hat = m1o / (1 - b1p)
+    m2_hat = m2o / (1 - b2p)
+    update = m1_hat / (jnp.sqrt(m2_hat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(p * p))
+    u_norm = jnp.sqrt(jnp.sum(update * update))
+    trust = jnp.where(p_norm > 0, jnp.where(u_norm > 0, p_norm / u_norm, 1.0),
+                      1.0)
+    return {"ParamOut": [p - lr * trust * update], "Moment1Out": [m1o],
+            "Moment2Out": [m2o], "Beta1PowOut": [b1p * b1],
+            "Beta2PowOut": [b2p * b2]}
+
+
+@register_op("lars_momentum",
+             inputs=("Param", "Grad", "Velocity", "LearningRate"),
+             outputs=("ParamOut", "VelocityOut"), no_grad=True,
+             inplace_map={"ParamOut": "Param", "VelocityOut": "Velocity"})
+def _lars_momentum(ctx, ins, attrs):
+    # operators/optimizers/lars_momentum_op.cc: layer-wise adaptive rate
+    p, g, v, lr = (ins["Param"][0], ins["Grad"][0], ins["Velocity"][0],
+                   ins["LearningRate"][0])
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    wd = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 0.0)
+    p_norm = jnp.sqrt(jnp.sum(p * p))
+    g_norm = jnp.sqrt(jnp.sum(g * g))
+    local_lr = lr * coeff * p_norm / (g_norm + wd * p_norm + eps)
+    v_out = mu * v + local_lr * (g + wd * p)
+    return {"ParamOut": [p - v_out], "VelocityOut": [v_out]}
+
+
+@register_op("dpsgd", inputs=("Param", "Grad", "LearningRate"),
+             outputs=("ParamOut",), no_grad=True, is_random=True,
+             inplace_map=_P)
+def _dpsgd(ctx, ins, attrs):
+    import jax
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    clip = attrs.get("clip", 10.0)
+    batch_size = attrs.get("batch_size", 16.0)
+    sigma = attrs.get("sigma", 1.0)
+    g_norm = jnp.sqrt(jnp.sum(g * g))
+    g = g / jnp.maximum(1.0, g_norm / clip)
+    noise = sigma * clip * jax.random.normal(ctx.rng(), g.shape, g.dtype)
+    return {"ParamOut": [p - lr * (g + noise / batch_size)]}
+
+
+@register_op("proximal_gd", inputs=("Param", "Grad", "LearningRate"),
+             outputs=("ParamOut",), no_grad=True, inplace_map=_P)
+def _proximal_gd(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    prox = p - lr * g
+    out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / \
+        (1.0 + lr * l2)
+    return {"ParamOut": [out]}
+
+
+@register_op("proximal_adagrad",
+             inputs=("Param", "Moment", "Grad", "LearningRate"),
+             outputs=("ParamOut", "MomentOut"), no_grad=True,
+             inplace_map={"ParamOut": "Param", "MomentOut": "Moment"})
+def _proximal_adagrad(ctx, ins, attrs):
+    p, m, g, lr = (ins["Param"][0], ins["Moment"][0], ins["Grad"][0],
+                   ins["LearningRate"][0])
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    mo = m + g * g
+    lr_t = lr / jnp.sqrt(mo)
+    prox = p - lr_t * g
+    out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0.0) / \
+        (1.0 + lr_t * l2)
+    return {"ParamOut": [out], "MomentOut": [mo]}
+
+
+@register_op("average_accumulates",
+             inputs=("Param", "SumAccum1", "SumAccum2", "SumAccum3",
+                     "NumAccum", "OldNumAccum", "NumUpdates"),
+             outputs=("SumAccum1Out", "SumAccum2Out", "SumAccum3Out",
+                      "NumAccumOut", "OldNumAccumOut", "NumUpdatesOut"),
+             no_grad=True,
+             inplace_map={"SumAccum1Out": "SumAccum1",
+                          "SumAccum2Out": "SumAccum2",
+                          "SumAccum3Out": "SumAccum3",
+                          "NumAccumOut": "NumAccum",
+                          "OldNumAccumOut": "OldNumAccum",
+                          "NumUpdatesOut": "NumUpdates"})
+def _average_accumulates(ctx, ins, attrs):
+    # support op for ModelAverage (optimizer.py:3107)
+    p = ins["Param"][0]
+    s1, s2, s3 = (ins["SumAccum1"][0], ins["SumAccum2"][0],
+                  ins["SumAccum3"][0])
+    num, old_num, updates = (ins["NumAccum"][0], ins["OldNumAccum"][0],
+                             ins["NumUpdates"][0])
+    avg_window = attrs.get("average_window", 10000.0)
+    max_avg = attrs.get("max_average_window", 10000)
+    min_avg = attrs.get("min_average_window", 10000)
+    num_out = num + 1
+    updates_out = updates + 1
+    s1o = s1 + p
+    # window overflow handling simplified: shift accumulators
+    overflow = num_out > max_avg
+    s2o = jnp.where(overflow, s2 + s1o, s2)
+    s1o = jnp.where(overflow, jnp.zeros_like(s1o), s1o)
+    return {"SumAccum1Out": [s1o], "SumAccum2Out": [s2o],
+            "SumAccum3Out": [s3], "NumAccumOut": [num_out],
+            "OldNumAccumOut": [old_num], "NumUpdatesOut": [updates_out]}

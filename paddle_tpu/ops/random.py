@@ -1,0 +1,84 @@
+"""Random / initializer ops.
+
+Parity surface: gaussian_random, uniform_random, truncated_gaussian_random,
+randint, randperm, bernoulli, dropout's masks etc.
+(/root/reference/paddle/fluid/operators/{gaussian_random,uniform_random,
+truncated_gaussian_random}_op.cc). All draw from the executor's threaded
+PRNG key chain (core/registry.py LowerCtx.rng) — the TPU analog of the
+reference's per-device Generator (framework/generator.h).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtypes import to_jax_dtype
+from ..core.registry import register_op
+from .common import one
+
+
+@register_op("gaussian_random", inputs=(), no_grad=True, is_random=True)
+def _gaussian_random(ctx, ins, attrs):
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    shape = tuple(attrs["shape"])
+    return one(mean + std * jax.random.normal(ctx.rng(), shape, dtype=dtype))
+
+
+@register_op("uniform_random", inputs=(), no_grad=True, is_random=True)
+def _uniform_random(ctx, ins, attrs):
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    lo = attrs.get("min", -1.0)
+    hi = attrs.get("max", 1.0)
+    shape = tuple(attrs["shape"])
+    return one(jax.random.uniform(ctx.rng(), shape, dtype=dtype,
+                                  minval=lo, maxval=hi))
+
+
+@register_op("truncated_gaussian_random", inputs=(), no_grad=True,
+             is_random=True)
+def _truncated_gaussian_random(ctx, ins, attrs):
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    shape = tuple(attrs["shape"])
+    # reference truncates at 2 std
+    return one(mean + std * jax.random.truncated_normal(
+        ctx.rng(), -2.0, 2.0, shape, dtype=dtype))
+
+
+@register_op("randint", inputs=(), no_grad=True, is_random=True)
+def _randint(ctx, ins, attrs):
+    dtype = to_jax_dtype(attrs.get("dtype", "int64"))
+    return one(jax.random.randint(ctx.rng(), tuple(attrs["shape"]),
+                                  attrs.get("low", 0), attrs.get("high"),
+                                  dtype=dtype))
+
+
+@register_op("randperm", inputs=(), no_grad=True, is_random=True)
+def _randperm(ctx, ins, attrs):
+    n = attrs["n"]
+    dtype = to_jax_dtype(attrs.get("dtype", "int64"))
+    return one(jax.random.permutation(ctx.rng(), n).astype(dtype))
+
+
+@register_op("bernoulli", inputs=("X",), no_grad=True, is_random=True)
+def _bernoulli(ctx, ins, attrs):
+    x = ins["X"][0]
+    return one(jax.random.bernoulli(ctx.rng(), x).astype(x.dtype))
+
+
+@register_op("shuffle_batch", inputs=("X",), outputs=("Out", "ShuffleIdx"),
+             no_grad=True, is_random=True)
+def _shuffle_batch(ctx, ins, attrs):
+    x = ins["X"][0]
+    idx = jax.random.permutation(ctx.rng(), x.shape[0])
+    return {"Out": [x[idx]], "ShuffleIdx": [idx.astype(jnp.int64)]}
+
+
+@register_op("sampling_id", inputs=("X",), no_grad=True, is_random=True)
+def _sampling_id(ctx, ins, attrs):
+    x = ins["X"][0]  # [batch, n] probabilities
+    return one(jax.random.categorical(
+        ctx.rng(), jnp.log(x + 1e-20), axis=-1).astype(jnp.int64))

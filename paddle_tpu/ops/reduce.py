@@ -1,0 +1,51 @@
+"""Reduce ops — parity with /root/reference/paddle/fluid/operators/reduce_ops/
+(reduce_{sum,mean,max,min,prod,any,all}_op.cc). attrs: dim (list), keep_dim,
+reduce_all.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import one
+
+_REDUCERS = {
+    "reduce_sum": jnp.sum,
+    "reduce_mean": jnp.mean,
+    "reduce_max": jnp.max,
+    "reduce_min": jnp.min,
+    "reduce_prod": jnp.prod,
+    "reduce_any": jnp.any,
+    "reduce_all": jnp.all,
+}
+
+
+def _make(name, fn):
+    no_grad = name in ("reduce_any", "reduce_all")
+
+    @register_op(name, inputs=("X",), no_grad=no_grad)
+    def _op(ctx, ins, attrs, _fn=fn):
+        x = ins["X"][0]
+        if attrs.get("reduce_all", False):
+            axis = None
+        else:
+            dim = attrs.get("dim", [0])
+            if isinstance(dim, int):
+                dim = [dim]
+            axis = tuple(d % x.ndim for d in dim) if dim else None
+        return one(_fn(x, axis=axis, keepdims=attrs.get("keep_dim", False)))
+    return _op
+
+
+for _n, _f in _REDUCERS.items():
+    _make(_n, _f)
+
+
+@register_op("max", inputs=("X",))
+def _max(ctx, ins, attrs):
+    return one(jnp.max(ins["X"][0]))
+
+
+@register_op("min", inputs=("X",))
+def _min(ctx, ins, attrs):
+    return one(jnp.min(ins["X"][0]))

@@ -1,0 +1,625 @@
+"""Tensor creation / manipulation / indexing ops.
+
+Parity surface: reshape2, transpose2, concat, split, squeeze2, unsqueeze2,
+stack, unstack, slice, strided_slice, gather, gather_nd, scatter,
+scatter_nd_add, expand, expand_as, tile, flip, roll, pad, pad2d/3d, where,
+one_hot, arg_max/min, argsort, top_k, unique, fill_constant, range, linspace,
+tril_triu, index_select, index_sample, masked_select*, meshgrid, flatten2,
+shard_index, diag, eye — /root/reference/paddle/fluid/operators/*.cc.
+
+(*) masked_select has data-dependent output shape; on TPU/XLA we keep static
+shapes, so it returns values gathered to a fixed-size buffer with a count —
+layers expose the masked-fill style alternatives.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtypes import to_jax_dtype
+from ..core.registry import register_op
+from .common import one
+
+
+def _infer_reshape(shape, x):
+    """reference reshape_op.cc ValidateShape: 0 keeps dim, -1 infers."""
+    shape = list(shape)
+    out = []
+    neg = -1
+    known = 1
+    for i, s in enumerate(shape):
+        if s == 0:
+            s = x.shape[i]
+        if s == -1:
+            neg = i
+            out.append(-1)
+            continue
+        known *= int(s)
+        out.append(int(s))
+    if neg >= 0:
+        out[neg] = int(np.prod(x.shape)) // known
+    return tuple(out)
+
+
+@register_op("reshape2", inputs=("X",), outputs=("Out", "XShape"))
+def _reshape2(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = _infer_reshape(attrs["shape"], x)
+    return {"Out": [jnp.reshape(x, shape)],
+            "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register_op("reshape", inputs=("X",))
+def _reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    return one(jnp.reshape(x, _infer_reshape(attrs["shape"], x)))
+
+
+@register_op("transpose2", inputs=("X",), outputs=("Out", "XShape"))
+def _transpose2(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.transpose(x, attrs["axis"])],
+            "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register_op("transpose", inputs=("X",))
+def _transpose(ctx, ins, attrs):
+    return one(jnp.transpose(ins["X"][0], attrs["axis"]))
+
+
+@register_op("concat", inputs=("X",))
+def _concat(ctx, ins, attrs):
+    return one(jnp.concatenate(ins["X"], axis=attrs.get("axis", 0)))
+
+
+@register_op("split", inputs=("X",))
+def _split(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("squeeze2", inputs=("X",), outputs=("Out", "XShape"))
+def _squeeze2(ctx, ins, attrs):
+    x = ins["X"][0]
+    axes = attrs.get("axes", [])
+    if axes:
+        axes = tuple(a % x.ndim for a in axes)
+        new_shape = [s for i, s in enumerate(x.shape)
+                     if not (i in axes and s == 1)]
+        out = jnp.reshape(x, new_shape)
+    else:
+        out = jnp.squeeze(x)
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register_op("unsqueeze2", inputs=("X",), outputs=("Out", "XShape"))
+def _unsqueeze2(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = x
+    for a in sorted(attrs["axes"]):
+        out = jnp.expand_dims(out, a)
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register_op("stack", inputs=("X",), outputs=("Y",))
+def _stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register_op("unstack", inputs=("X",), outputs=("Y",))
+def _unstack(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", x.shape[axis])
+    parts = jnp.split(x, num, axis=axis)
+    return {"Y": [jnp.squeeze(p, axis=axis) for p in parts]}
+
+
+@register_op("unbind", inputs=("X",))
+def _unbind(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    parts = jnp.split(x, x.shape[axis], axis=axis)
+    return {"Out": [jnp.squeeze(p, axis=axis) for p in parts]}
+
+
+@register_op("slice", inputs=("Input",))
+def _slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    out = x[tuple(idx)]
+    decrease = attrs.get("decrease_axis", [])
+    if decrease:
+        out = jnp.reshape(out, [s for i, s in enumerate(out.shape)
+                                if i not in decrease] or [])
+    return one(out)
+
+
+@register_op("strided_slice", inputs=("Input",))
+def _strided_slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"],
+                           attrs["strides"]):
+        idx[a] = slice(s, e, st)
+    return one(x[tuple(idx)])
+
+
+@register_op("gather", inputs=("X", "Index"), non_diff_inputs=("Index",))
+def _gather(ctx, ins, attrs):
+    x, index = ins["X"][0], ins["Index"][0]
+    axis = attrs.get("axis", 0)
+    return one(jnp.take(x, index, axis=axis))
+
+
+@register_op("gather_nd", inputs=("X", "Index"), non_diff_inputs=("Index",))
+def _gather_nd(ctx, ins, attrs):
+    x, index = ins["X"][0], ins["Index"][0]
+    # index [..., k] indexes first k dims of x
+    k = index.shape[-1]
+    idx = tuple(index[..., i] for i in range(k))
+    return one(x[idx])
+
+
+@register_op("scatter", inputs=("X", "Ids", "Updates"),
+             non_diff_inputs=("Ids",))
+def _scatter(ctx, ins, attrs):
+    x, ids, updates = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    if attrs.get("overwrite", True):
+        return one(x.at[ids].set(updates))
+    return one(x.at[ids].add(updates))
+
+
+@register_op("scatter_nd_add", inputs=("X", "Index", "Updates"),
+             non_diff_inputs=("Index",))
+def _scatter_nd_add(ctx, ins, attrs):
+    x, index, updates = ins["X"][0], ins["Index"][0], ins["Updates"][0]
+    k = index.shape[-1]
+    idx = tuple(index[..., i] for i in range(k))
+    return one(x.at[idx].add(updates))
+
+
+@register_op("expand", inputs=("X",))
+def _expand(ctx, ins, attrs):
+    x = ins["X"][0]
+    times = attrs["expand_times"]
+    return one(jnp.tile(x, times))
+
+
+@register_op("expand_v2", inputs=("X",))
+def _expand_v2(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    for i in range(len(shape)):
+        if shape[i] == -1:
+            shape[i] = x.shape[i - len(shape) + x.ndim]
+    return one(jnp.broadcast_to(x, shape))
+
+
+@register_op("expand_as", inputs=("X", "target_tensor"))
+def _expand_as(ctx, ins, attrs):
+    x, t = ins["X"][0], ins["target_tensor"][0]
+    return one(jnp.broadcast_to(x, t.shape))
+
+
+@register_op("tile", inputs=("X",))
+def _tile(ctx, ins, attrs):
+    return one(jnp.tile(ins["X"][0], attrs["repeat_times"]))
+
+
+@register_op("flip", inputs=("X",))
+def _flip(ctx, ins, attrs):
+    return one(jnp.flip(ins["X"][0], axis=tuple(attrs["axis"])))
+
+
+@register_op("roll", inputs=("X",))
+def _roll(ctx, ins, attrs):
+    shifts = attrs["shifts"]
+    axis = attrs.get("axis", None)
+    x = ins["X"][0]
+    if isinstance(shifts, int):
+        shifts = [shifts]
+    if axis is None or axis == []:
+        # flatten-roll-restore, reference roll_op.cc semantics without dims
+        return one(jnp.roll(x.reshape(-1), shifts[0]).reshape(x.shape))
+    if isinstance(axis, int):
+        axis = [axis]
+    return one(jnp.roll(x, tuple(shifts), axis=tuple(axis)))
+
+
+@register_op("reverse", inputs=("X",))
+def _reverse(ctx, ins, attrs):
+    return one(jnp.flip(ins["X"][0], axis=tuple(attrs["axis"])))
+
+
+@register_op("pad", inputs=("X",))
+def _pad(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs["paddings"]
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return one(jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0)))
+
+
+@register_op("pad2d", inputs=("X",))
+def _pad2d(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    p = attrs["paddings"]  # [top, bottom, left, right]
+    mode = attrs.get("mode", "constant")
+    if attrs.get("data_format", "NCHW") == "NCHW":
+        pads = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    else:
+        pads = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    if mode == "constant":
+        return one(jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0)))
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return one(jnp.pad(x, pads, mode=jmode))
+
+
+@register_op("pad3d", inputs=("X",))
+def _pad3d(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs["paddings"]  # [front,back,top,bottom,left,right] order varies
+    mode = attrs.get("mode", "constant")
+    pads = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
+    if attrs.get("data_format", "NCDHW") == "NDHWC":
+        pads = [(0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1]), (0, 0)]
+    if mode == "constant":
+        return one(jnp.pad(x, pads, constant_values=attrs.get("value", 0.0)))
+    jmode = {"reflect": "reflect", "replicate": "edge", "edge": "edge"}[mode]
+    return one(jnp.pad(x, pads, mode=jmode))
+
+
+@register_op("pad_constant_like", inputs=("X", "Y"))
+def _pad_constant_like(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    pads = [(0, x.shape[i] - y.shape[i]) for i in range(x.ndim)]
+    return one(jnp.pad(y, pads, constant_values=attrs.get("pad_value", 0.0)))
+
+
+@register_op("where", inputs=("Condition", "X", "Y"),
+             non_diff_inputs=("Condition",))
+def _where(ctx, ins, attrs):
+    return one(jnp.where(ins["Condition"][0], ins["X"][0], ins["Y"][0]))
+
+
+@register_op("where_index", inputs=("Condition",), no_grad=True)
+def _where_index(ctx, ins, attrs):
+    # data-dependent shape: only usable outside jit (eager on host)
+    return one(jnp.argwhere(ins["Condition"][0]))
+
+
+@register_op("masked_select", inputs=("X", "Mask"), no_grad=True,
+             outputs=("Y",))
+def _masked_select(ctx, ins, attrs):
+    # Data-dependent output shape — eager/host only (XLA needs static
+    # shapes; see module docstring).
+    x, mask = ins["X"][0], ins["Mask"][0]
+    return {"Y": [x[mask]]}
+
+
+@register_op("index_select", inputs=("X", "Index"),
+             non_diff_inputs=("Index",))
+def _index_select(ctx, ins, attrs):
+    return one(jnp.take(ins["X"][0], ins["Index"][0],
+                        axis=attrs.get("dim", 0)))
+
+
+@register_op("index_sample", inputs=("X", "Index"),
+             non_diff_inputs=("Index",))
+def _index_sample(ctx, ins, attrs):
+    x, index = ins["X"][0], ins["Index"][0]
+    return one(jnp.take_along_axis(x, index, axis=1))
+
+
+@register_op("one_hot", inputs=("X",), no_grad=True)
+def _one_hot(ctx, ins, attrs):
+    x = ins["X"][0]
+    depth = attrs["depth"]
+    out = jax.nn.one_hot(jnp.squeeze(x, -1) if x.shape[-1] == 1 else x,
+                         depth, dtype=jnp.float32)
+    return one(out)
+
+
+@register_op("one_hot_v2", inputs=("X",), no_grad=True)
+def _one_hot_v2(ctx, ins, attrs):
+    return one(jax.nn.one_hot(ins["X"][0], attrs["depth"],
+                              dtype=jnp.float32))
+
+
+@register_op("arg_max", inputs=("X",), no_grad=True)
+def _arg_max(ctx, ins, attrs):
+    axis = attrs.get("axis", -1)
+    keepdims = attrs.get("keepdims", False)
+    out = jnp.argmax(ins["X"][0], axis=axis, keepdims=keepdims)
+    return one(out.astype(to_jax_dtype(attrs.get("dtype", "int64"))))
+
+
+@register_op("arg_min", inputs=("X",), no_grad=True)
+def _arg_min(ctx, ins, attrs):
+    axis = attrs.get("axis", -1)
+    keepdims = attrs.get("keepdims", False)
+    out = jnp.argmin(ins["X"][0], axis=axis, keepdims=keepdims)
+    return one(out.astype(to_jax_dtype(attrs.get("dtype", "int64"))))
+
+
+@register_op("argsort", inputs=("X",), outputs=("Out", "Indices"),
+             no_grad=True)
+def _argsort(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    desc = attrs.get("descending", False)
+    idx = jnp.argsort(-x if desc else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("top_k", inputs=("X",), outputs=("Out", "Indices"),
+             non_diff_inputs=("Indices",))
+def _top_k(ctx, ins, attrs):
+    x = ins["X"][0]
+    k = attrs["k"]
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("top_k_v2", inputs=("X",), outputs=("Out", "Indices"),
+             non_diff_inputs=("Indices",))
+def _top_k_v2(ctx, ins, attrs):
+    x = ins["X"][0]
+    k = attrs["k"]
+    axis = attrs.get("axis", -1) % x.ndim
+    largest = attrs.get("largest", True)
+    xt = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(xt if largest else -xt, k)
+    if not largest:
+        vals = -vals
+    return {"Out": [jnp.moveaxis(vals, -1, axis)],
+            "Indices": [jnp.moveaxis(idx, -1, axis).astype(jnp.int64)]}
+
+
+@register_op("unique_with_counts", inputs=("X",),
+             outputs=("Out", "Index", "Count"), no_grad=True)
+def _unique_with_counts(ctx, ins, attrs):
+    x = ins["X"][0]
+    out, inv, counts = jnp.unique(x, return_inverse=True, return_counts=True,
+                                  size=x.size)
+    return {"Out": [out], "Index": [inv.astype(jnp.int32)],
+            "Count": [counts.astype(jnp.int32)]}
+
+
+@register_op("unique", inputs=("X",), outputs=("Out", "Index"), no_grad=True)
+def _unique(ctx, ins, attrs):
+    x = ins["X"][0]
+    out, inv = jnp.unique(x, return_inverse=True, size=x.size)
+    return {"Out": [out], "Index": [inv.astype(jnp.int32)]}
+
+
+@register_op("fill_constant", inputs=(), no_grad=True)
+def _fill_constant(ctx, ins, attrs):
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    return one(jnp.full(tuple(attrs["shape"]), attrs["value"], dtype=dtype))
+
+
+@register_op("fill_constant_batch_size_like", inputs=("Input",), no_grad=True)
+def _fill_constant_bsl(ctx, ins, attrs):
+    x = ins["Input"][0]
+    shape = list(attrs["shape"])
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = x.shape[in_idx]
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    return one(jnp.full(tuple(shape), attrs["value"], dtype=dtype))
+
+
+@register_op("fill_zeros_like", inputs=("X",), no_grad=True)
+def _fill_zeros_like(ctx, ins, attrs):
+    return one(jnp.zeros_like(ins["X"][0]))
+
+
+@register_op("fill_any_like", inputs=("X",), no_grad=True)
+def _fill_any_like(ctx, ins, attrs):
+    dtype = attrs.get("dtype")
+    x = ins["X"][0]
+    dt = to_jax_dtype(dtype) if dtype not in (None, -1) else x.dtype
+    return one(jnp.full_like(x, attrs["value"], dtype=dt))
+
+
+@register_op("assign", inputs=("X",))
+def _assign(ctx, ins, attrs):
+    return one(ins["X"][0])
+
+
+@register_op("assign_value", inputs=(), no_grad=True)
+def _assign_value(ctx, ins, attrs):
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    vals = attrs.get("fp32_values") or attrs.get("int32_values") \
+        or attrs.get("int64_values") or attrs.get("values")
+    return one(jnp.asarray(np.array(vals).reshape(attrs["shape"]),
+                           dtype=dtype))
+
+
+@register_op("shape", inputs=("Input",), no_grad=True)
+def _shape(ctx, ins, attrs):
+    return one(jnp.asarray(ins["Input"][0].shape, dtype=jnp.int32))
+
+
+@register_op("size", inputs=("Input",), no_grad=True)
+def _size(ctx, ins, attrs):
+    return one(jnp.asarray(ins["Input"][0].size, dtype=jnp.int64))
+
+
+@register_op("range", inputs=("Start", "End", "Step"), no_grad=True)
+def _range(ctx, ins, attrs):
+    # XLA needs a static extent: take start/end/step from attrs when given
+    # (layers.range records them), else require concrete inputs — tensor
+    # inputs that are data-dependent cannot produce a static shape on TPU.
+    if "start" in attrs:
+        s, e, st = attrs["start"], attrs["end"], attrs["step"]
+        dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    else:
+        try:
+            s = np.asarray(ins["Start"][0]).item()
+            e = np.asarray(ins["End"][0]).item()
+            st = np.asarray(ins["Step"][0]).item()
+        except Exception as exc:
+            raise ValueError(
+                "range op needs static start/end/step on TPU: pass them as "
+                "attrs or as literal (non-traced) inputs") from exc
+        dtype = ins["Start"][0].dtype
+    return one(jnp.arange(s, e, st, dtype=dtype))
+
+
+@register_op("arange", inputs=(), no_grad=True)
+def _arange(ctx, ins, attrs):
+    dtype = to_jax_dtype(attrs.get("dtype", "int64"))
+    return one(jnp.arange(attrs["start"], attrs["end"], attrs["step"],
+                          dtype=dtype))
+
+
+@register_op("linspace", inputs=(), no_grad=True)
+def _linspace(ctx, ins, attrs):
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    return one(jnp.linspace(attrs["start"], attrs["stop"], attrs["num"],
+                            dtype=dtype))
+
+
+@register_op("eye", inputs=(), no_grad=True)
+def _eye(ctx, ins, attrs):
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    return one(jnp.eye(attrs["num_rows"],
+                       attrs.get("num_columns", attrs["num_rows"]),
+                       dtype=dtype))
+
+
+@register_op("diag", inputs=("Diagonal",))
+def _diag(ctx, ins, attrs):
+    return one(jnp.diag(ins["Diagonal"][0]))
+
+
+@register_op("diag_v2", inputs=("X",))
+def _diag_v2(ctx, ins, attrs):
+    return one(jnp.diag(ins["X"][0], k=attrs.get("offset", 0)))
+
+
+@register_op("tril_triu", inputs=("X",))
+def _tril_triu(ctx, ins, attrs):
+    x = ins["X"][0]
+    diag = attrs.get("diagonal", 0)
+    if attrs.get("lower", True):
+        return one(jnp.tril(x, diag))
+    return one(jnp.triu(x, diag))
+
+
+@register_op("meshgrid", inputs=("X",))
+def _meshgrid(ctx, ins, attrs):
+    outs = jnp.meshgrid(*ins["X"], indexing="ij")
+    return {"Out": list(outs)}
+
+
+@register_op("flatten2", inputs=("X",), outputs=("Out", "XShape"))
+def _flatten2(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    return {"Out": [x.reshape(lead, -1)],
+            "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register_op("flatten", inputs=("X",))
+def _flatten(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    return one(x.reshape(lead, -1))
+
+
+@register_op("flatten_contiguous_range", inputs=("X",),
+             outputs=("Out", "XShape"))
+def _flatten_range(ctx, ins, attrs):
+    x = ins["X"][0]
+    start = attrs.get("start_axis", 1) % max(x.ndim, 1)
+    stop = attrs.get("stop_axis", -1) % max(x.ndim, 1)
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return {"Out": [x.reshape(shape)],
+            "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register_op("shard_index", inputs=("X",), no_grad=True)
+def _shard_index(ctx, ins, attrs):
+    # operators/shard_index_op.cc: map global ids to shard-local ids
+    x = ins["X"][0]
+    index_num = attrs["index_num"]
+    nshards = attrs["nshards"]
+    shard_id = attrs["shard_id"]
+    ignore_value = attrs.get("ignore_value", -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return one(jnp.where(in_shard, x % shard_size, ignore_value))
+
+
+@register_op("label_smooth", inputs=("X",))
+def _label_smooth(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 0.0)
+    k = x.shape[-1]
+    return one(x * (1.0 - eps) + eps / k)
+
+
+@register_op("increment_op", inputs=("X",))
+def _increment_op(ctx, ins, attrs):
+    return one(ins["X"][0] + attrs.get("step", 1.0))
+
+
+@register_op("multiplex", inputs=("X", "Ids"), non_diff_inputs=("Ids",))
+def _multiplex(ctx, ins, attrs):
+    xs = jnp.stack(ins["X"], axis=0)  # [n, batch, d]
+    ids = jnp.squeeze(ins["Ids"][0], -1)  # [batch]
+    batch = jnp.arange(ids.shape[0])
+    return one(xs[ids, batch])
+
+
+@register_op("pixel_shuffle", inputs=("X",))
+def _pixel_shuffle(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    r = attrs.get("upscale_factor", 1)
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return one(x.reshape(n, c // (r * r), h * r, w * r))
+
+
+@register_op("space_to_depth", inputs=("X",))
+def _space_to_depth(ctx, ins, attrs):
+    x = ins["X"][0]
+    b = attrs["blocksize"]
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return one(x.reshape(n, c * b * b, h // b, w // b))
+
+
+@register_op("shuffle_channel", inputs=("X",))
+def _shuffle_channel(ctx, ins, attrs):
+    x = ins["X"][0]
+    g = attrs.get("group", 1)
+    n, c, h, w = x.shape
+    x = x.reshape(n, g, c // g, h, w)
+    x = jnp.swapaxes(x, 1, 2)
+    return one(x.reshape(n, c, h, w))

@@ -1,0 +1,14 @@
+from .lr_scheduler import (CosineDecay, ExponentialDecay,  # noqa: F401
+                           InverseTimeDecay, LRScheduler, NaturalExpDecay,
+                           NoamDecay, PiecewiseDecay, PolynomialDecay,
+                           linear_lr_warmup)
+from .static_opt import (Adadelta, AdadeltaOptimizer, Adagrad,  # noqa: F401
+                         AdagradOptimizer, Adam, AdamOptimizer, AdamW,
+                         Adamax, AdamaxOptimizer, DecayedAdagrad,
+                         DecayedAdagradOptimizer, DpSGD, DpSGDOptimizer,
+                         Ftrl, FtrlOptimizer, GradientClipByGlobalNorm,
+                         GradientClipByNorm, GradientClipByValue, L1Decay,
+                         L2Decay, Lamb, LambOptimizer, LarsMomentum,
+                         LarsMomentumOptimizer, Momentum, MomentumOptimizer,
+                         Optimizer, RMSProp, RMSPropOptimizer, SGD,
+                         SGDOptimizer)

@@ -1,0 +1,187 @@
+"""Learning-rate schedules.
+
+Analog of /root/reference/python/paddle/fluid/layers/learning_rate_scheduler.py
+(noam_decay:60, exponential_decay:98, natural_exp_decay, inverse_time_decay,
+polynomial_decay:242, piecewise_decay:306, cosine_decay:352,
+linear_lr_warmup:410). The reference builds each formula from ops over a
+global step counter; here a single `lr_schedule` op computes the value from
+the step — one fused XLA scalar computation per run.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+STEP_VAR = "@lr_global_step@"
+
+
+@register_op("lr_schedule", inputs=("Step",), outputs=("Out", "StepOut"),
+             no_grad=True, inplace_map={"StepOut": "Step"})
+def _lr_schedule(ctx, ins, attrs):
+    step = ins["Step"][0].astype(jnp.float32)
+    kind = attrs["kind"]
+    base = attrs.get("learning_rate", 0.01)
+    if kind == "constant":
+        lr = jnp.asarray(base, jnp.float32)
+    elif kind == "exponential":
+        decay_steps = attrs["decay_steps"]
+        rate = attrs["decay_rate"]
+        exp = step / decay_steps
+        if attrs.get("staircase", False):
+            exp = jnp.floor(exp)
+        lr = base * jnp.power(rate, exp)
+    elif kind == "natural_exp":
+        decay_steps = attrs["decay_steps"]
+        rate = attrs["decay_rate"]
+        exp = step / decay_steps
+        if attrs.get("staircase", False):
+            exp = jnp.floor(exp)
+        lr = base * jnp.exp(-rate * exp)
+    elif kind == "inverse_time":
+        decay_steps = attrs["decay_steps"]
+        rate = attrs["decay_rate"]
+        t = step / decay_steps
+        if attrs.get("staircase", False):
+            t = jnp.floor(t)
+        lr = base / (1.0 + rate * t)
+    elif kind == "polynomial":
+        decay_steps = attrs["decay_steps"]
+        end_lr = attrs.get("end_learning_rate", 0.0001)
+        power = attrs.get("power", 1.0)
+        if attrs.get("cycle", False):
+            div = jnp.ceil(jnp.maximum(step / decay_steps, 1.0))
+            ds = decay_steps * div
+        else:
+            ds = decay_steps
+            step = jnp.minimum(step, decay_steps)
+        lr = (base - end_lr) * jnp.power(1 - step / ds, power) + end_lr
+    elif kind == "noam":
+        d_model = attrs["d_model"]
+        warmup = attrs["warmup_steps"]
+        s = jnp.maximum(step, 1.0)
+        lr = base * (d_model ** -0.5) * jnp.minimum(
+            s ** -0.5, s * (warmup ** -1.5))
+    elif kind == "cosine":
+        step_each_epoch = attrs["step_each_epoch"]
+        epochs = attrs["epochs"]
+        cur_epoch = jnp.floor(step / step_each_epoch)
+        lr = base * 0.5 * (jnp.cos(cur_epoch * math.pi / epochs) + 1)
+    elif kind == "piecewise":
+        bounds = jnp.asarray(attrs["boundaries"], jnp.float32)
+        values = jnp.asarray(attrs["values"], jnp.float32)
+        idx = jnp.sum((step >= bounds).astype(jnp.int32))
+        lr = values[idx]
+    else:
+        raise ValueError(f"unknown lr schedule {kind!r}")
+    warmup_steps = attrs.get("warmup_steps_linear", 0)
+    if warmup_steps:
+        start_lr = attrs.get("warmup_start_lr", 0.0)
+        frac = jnp.clip(step / warmup_steps, 0.0, 1.0)
+        warm = start_lr + (attrs.get("warmup_end_lr", base) - start_lr) * frac
+        lr = jnp.where(step < warmup_steps, warm, lr)
+    return {"Out": [lr.astype(jnp.float32)],
+            "StepOut": [ins["Step"][0] + 1]}
+
+
+class LRScheduler:
+    kind = "constant"
+
+    def __init__(self, learning_rate: float = 0.01, **params):
+        self.learning_rate = learning_rate
+        self.params = params
+
+    def _attrs(self):
+        a = {"kind": self.kind, "learning_rate": self.learning_rate}
+        a.update(self.params)
+        return a
+
+    def _build(self, program, startup) -> str:
+        block = program.global_block
+        step_name = program._unique_name(STEP_VAR)
+        lr_name = program._unique_name("@lr@")
+        for prog in (program, startup):
+            prog.global_block.create_var(step_name, shape=(), dtype="int64",
+                                         persistable=True,
+                                         stop_gradient=True)
+        block.create_var(lr_name, shape=(), dtype="float32",
+                         stop_gradient=True, persistable=True)
+        startup.global_block.append_op(
+            "fill_constant", inputs={}, outputs={"Out": [step_name]},
+            attrs={"shape": [], "value": 0, "dtype": "int64"})
+        block.append_op("lr_schedule", inputs={"Step": [step_name]},
+                        outputs={"Out": [lr_name], "StepOut": [step_name]},
+                        attrs=self._attrs())
+        return lr_name
+
+
+class ExponentialDecay(LRScheduler):
+    kind = "exponential"
+
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False):
+        super().__init__(learning_rate, decay_steps=decay_steps,
+                         decay_rate=decay_rate, staircase=staircase)
+
+
+class NaturalExpDecay(LRScheduler):
+    kind = "natural_exp"
+
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False):
+        super().__init__(learning_rate, decay_steps=decay_steps,
+                         decay_rate=decay_rate, staircase=staircase)
+
+
+class InverseTimeDecay(LRScheduler):
+    kind = "inverse_time"
+
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False):
+        super().__init__(learning_rate, decay_steps=decay_steps,
+                         decay_rate=decay_rate, staircase=staircase)
+
+
+class PolynomialDecay(LRScheduler):
+    kind = "polynomial"
+
+    def __init__(self, learning_rate, decay_steps, end_learning_rate=0.0001,
+                 power=1.0, cycle=False):
+        super().__init__(learning_rate, decay_steps=decay_steps,
+                         end_learning_rate=end_learning_rate, power=power,
+                         cycle=cycle)
+
+
+class NoamDecay(LRScheduler):
+    kind = "noam"
+
+    def __init__(self, d_model, warmup_steps, learning_rate=1.0):
+        super().__init__(learning_rate, d_model=d_model,
+                         warmup_steps=warmup_steps)
+
+
+class CosineDecay(LRScheduler):
+    kind = "cosine"
+
+    def __init__(self, learning_rate, step_each_epoch, epochs):
+        super().__init__(learning_rate, step_each_epoch=step_each_epoch,
+                         epochs=epochs)
+
+
+class PiecewiseDecay(LRScheduler):
+    kind = "piecewise"
+
+    def __init__(self, boundaries, values):
+        super().__init__(values[0], boundaries=list(boundaries),
+                         values=list(values))
+
+
+def linear_lr_warmup(scheduler: LRScheduler, warmup_steps, start_lr, end_lr):
+    """Wrap any schedule with linear warmup (reference
+    learning_rate_scheduler.py:410)."""
+    scheduler.params.update({"warmup_steps_linear": warmup_steps,
+                             "warmup_start_lr": start_lr,
+                             "warmup_end_lr": end_lr})
+    return scheduler

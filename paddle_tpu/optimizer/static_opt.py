@@ -1,0 +1,500 @@
+"""Static-graph optimizers: append backward + update ops to the program.
+
+Analog of /root/reference/python/paddle/fluid/optimizer.py (Optimizer
+base:56, SGD:952, Momentum:1054, Adam:1746, DecayedAdagrad, Lamb:2935,
+LarsMomentum:1596...). minimize() = append_backward + regularization + grad
+clip + one update op per parameter, with accumulators created as persistable
+vars initialized in the startup program (the reference's
+_create_accumulators / _add_accumulator pattern).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..core.backward import append_backward
+from ..core.program import (Program, VarDesc, default_main_program,
+                            default_startup_program)
+
+
+class GradClipBase:
+    pass
+
+
+class GradientClipByValue(GradClipBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def apply(self, block, params_grads):
+        out = []
+        for p, g in params_grads:
+            clipped = block.create_var(g.name + "@CLIP", stop_gradient=True)
+            block.append_op("clip", inputs={"X": [g.name]},
+                            outputs={"Out": [clipped.name]},
+                            attrs={"min": self.min, "max": self.max})
+            out.append((p, clipped))
+        return out
+
+
+class GradientClipByNorm(GradClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def apply(self, block, params_grads):
+        out = []
+        for p, g in params_grads:
+            clipped = block.create_var(g.name + "@CLIP", stop_gradient=True)
+            block.append_op("clip_by_norm", inputs={"X": [g.name]},
+                            outputs={"Out": [clipped.name]},
+                            attrs={"max_norm": self.clip_norm})
+            out.append((p, clipped))
+        return out
+
+
+class GradientClipByGlobalNorm(GradClipBase):
+    """fluid.clip.GradientClipByGlobalNorm (clip.py:331)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def apply(self, block, params_grads):
+        sq_names = []
+        for _, g in params_grads:
+            sq = block.create_var(g.name + "@SQN", stop_gradient=True)
+            block.append_op("squared_l2_norm", inputs={"X": [g.name]},
+                            outputs={"Out": [sq.name]})
+            sq_names.append(sq.name)
+        total = block.create_var("@global_norm_sq@" + params_grads[0][1].name,
+                                 stop_gradient=True)
+        block.append_op("sum", inputs={"X": sq_names},
+                        outputs={"Out": [total.name]})
+        gnorm = block.create_var(total.name + "@SQRT", stop_gradient=True)
+        block.append_op("sqrt", inputs={"X": [total.name]},
+                        outputs={"Out": [gnorm.name]})
+        # scale = clip_norm / max(global_norm, clip_norm)
+        denom = block.create_var(total.name + "@DEN", stop_gradient=True)
+        cn = block.create_var(total.name + "@CN", stop_gradient=True)
+        block.append_op("fill_constant", inputs={},
+                        outputs={"Out": [cn.name]},
+                        attrs={"shape": [], "value": float(self.clip_norm),
+                               "dtype": "float32"})
+        block.append_op("elementwise_max",
+                        inputs={"X": [gnorm.name], "Y": [cn.name]},
+                        outputs={"Out": [denom.name]})
+        factor = block.create_var(total.name + "@FACTOR", stop_gradient=True)
+        block.append_op("elementwise_div",
+                        inputs={"X": [cn.name], "Y": [denom.name]},
+                        outputs={"Out": [factor.name]})
+        out = []
+        for p, g in params_grads:
+            clipped = block.create_var(g.name + "@CLIP", stop_gradient=True)
+            block.append_op("elementwise_mul",
+                            inputs={"X": [g.name], "Y": [factor.name]},
+                            outputs={"Out": [clipped.name]})
+            out.append((p, clipped))
+        return out
+
+
+class L2Decay:
+    """fluid.regularizer.L2Decay — grad += coeff * param."""
+
+    def __init__(self, regularization_coeff: float = 0.0):
+        self.coeff = regularization_coeff
+
+    def apply(self, block, p, g):
+        scaled = block.create_var(g.name + "@L2", stop_gradient=True)
+        block.append_op("scale", inputs={"X": [p.name]},
+                        outputs={"Out": [scaled.name]},
+                        attrs={"scale": self.coeff})
+        out = block.create_var(g.name + "@REG", stop_gradient=True)
+        block.append_op("sum", inputs={"X": [g.name, scaled.name]},
+                        outputs={"Out": [out.name]})
+        return out
+
+
+class L1Decay:
+    def __init__(self, regularization_coeff: float = 0.0):
+        self.coeff = regularization_coeff
+
+    def apply(self, block, p, g):
+        sg = block.create_var(g.name + "@SIGN", stop_gradient=True)
+        block.append_op("sign", inputs={"X": [p.name]},
+                        outputs={"Out": [sg.name]})
+        scaled = block.create_var(g.name + "@L1", stop_gradient=True)
+        block.append_op("scale", inputs={"X": [sg.name]},
+                        outputs={"Out": [scaled.name]},
+                        attrs={"scale": self.coeff})
+        out = block.create_var(g.name + "@REG", stop_gradient=True)
+        block.append_op("sum", inputs={"X": [g.name, scaled.name]},
+                        outputs={"Out": [out.name]})
+        return out
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer.py:56)."""
+
+    def __init__(self, learning_rate=0.001, regularization=None,
+                 grad_clip=None, name: Optional[str] = None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self.grad_clip = grad_clip
+        self._name = name or type(self).__name__
+        self._lr_name: Optional[str] = None
+        self._accumulators: Dict[str, Dict[str, str]] = {}
+
+    # -- learning rate ---------------------------------------------------
+    def _create_global_learning_rate(self, program, startup):
+        if self._lr_name is not None:
+            return self._lr_name
+        from .lr_scheduler import LRScheduler
+        if isinstance(self._learning_rate, LRScheduler):
+            self._lr_name = self._learning_rate._build(program, startup)
+            return self._lr_name
+        name = program._unique_name(f"{self._name}_lr")
+        for prog in (program, startup):
+            blk = prog.global_block
+            blk.create_var(name, shape=(), dtype="float32", persistable=True,
+                           stop_gradient=True)
+        startup.global_block.append_op(
+            "fill_constant", inputs={}, outputs={"Out": [name]},
+            attrs={"shape": [], "value": float(self._learning_rate),
+                   "dtype": "float32"})
+        self._lr_name = name
+        return name
+
+    def set_lr(self, value, scope=None):
+        """Update the lr var in the scope (dygraph set_lr analog)."""
+        import numpy as np
+        from ..core.scope import global_scope
+        scope = scope or global_scope()
+        scope.set(self._lr_name, np.asarray(value, dtype=np.float32))
+
+    # -- accumulators ----------------------------------------------------
+    def _add_accumulator(self, name: str, param: VarDesc, program, startup,
+                         fill_value: float = 0.0, shape=None,
+                         dtype=None) -> str:
+        key = f"{param.name}@{self._name}@{name}"
+        shape = list(shape if shape is not None else param.shape)
+        dtype = dtype or param.dtype
+        for prog in (program, startup):
+            blk = prog.global_block
+            blk.create_var(key, shape=shape, dtype=dtype, persistable=True,
+                           stop_gradient=True)
+        startup.global_block.append_op(
+            "fill_constant", inputs={}, outputs={"Out": [key]},
+            attrs={"shape": shape, "value": fill_value, "dtype": dtype})
+        self._accumulators.setdefault(name, {})[param.name] = key
+        return key
+
+    # -- main API --------------------------------------------------------
+    def minimize(self, loss, startup_program: Optional[Program] = None,
+                 parameter_list=None, no_grad_set=None,
+                 program: Optional[Program] = None):
+        program = program or default_main_program()
+        startup = startup_program or default_startup_program()
+        params_grads = append_backward(loss, parameter_list, no_grad_set,
+                                       program=program)
+        self.apply_gradients(params_grads, program, startup)
+        return None, params_grads
+
+    def apply_gradients(self, params_grads, program=None, startup=None):
+        program = program or default_main_program()
+        startup = startup or default_startup_program()
+        block = program.global_block
+        if self.regularization is not None:
+            params_grads = [(p, _as_var(block, self.regularization.apply(
+                block, p, _as_var(block, g)))) for p, g in params_grads]
+        if self.grad_clip is not None:
+            params_grads = self.grad_clip.apply(block, params_grads)
+        lr = self._create_global_learning_rate(program, startup)
+        for p, g in params_grads:
+            self._append_optimize_op(block, p, _as_var(block, g), lr,
+                                     program, startup)
+        return params_grads
+
+    def _append_optimize_op(self, block, param, grad, lr, program, startup):
+        raise NotImplementedError
+
+
+def _as_var(block, v):
+    return v if isinstance(v, VarDesc) else block.var(str(v))
+
+
+class SGD(Optimizer):
+    """reference optimizer.py:952 SGDOptimizer."""
+
+    def _append_optimize_op(self, block, param, grad, lr, program, startup):
+        block.append_op("sgd",
+                        inputs={"Param": [param.name], "Grad": [grad.name],
+                                "LearningRate": [lr]},
+                        outputs={"ParamOut": [param.name]})
+
+
+SGDOptimizer = SGD
+
+
+class Momentum(Optimizer):
+    """optimizer.py:1054 MomentumOptimizer."""
+
+    def __init__(self, learning_rate, momentum=0.9, use_nesterov=False,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _append_optimize_op(self, block, param, grad, lr, program, startup):
+        vel = self._add_accumulator("velocity", param, program, startup)
+        block.append_op(
+            "momentum",
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "Velocity": [vel], "LearningRate": [lr]},
+            outputs={"ParamOut": [param.name], "VelocityOut": [vel]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+MomentumOptimizer = Momentum
+
+
+class LarsMomentum(Optimizer):
+    """optimizer.py:1596 LarsMomentumOptimizer."""
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _append_optimize_op(self, block, param, grad, lr, program, startup):
+        vel = self._add_accumulator("velocity", param, program, startup)
+        block.append_op(
+            "lars_momentum",
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "Velocity": [vel], "LearningRate": [lr]},
+            outputs={"ParamOut": [param.name], "VelocityOut": [vel]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay})
+
+
+LarsMomentumOptimizer = LarsMomentum
+
+
+class Adam(Optimizer):
+    """optimizer.py:1746 AdamOptimizer."""
+
+    _op_type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _extra_attrs(self):
+        return {}
+
+    def _append_optimize_op(self, block, param, grad, lr, program, startup):
+        m1 = self._add_accumulator("moment1", param, program, startup)
+        m2 = self._add_accumulator("moment2", param, program, startup)
+        b1p = self._add_accumulator("beta1_pow", param, program, startup,
+                                    fill_value=self._beta1, shape=())
+        b2p = self._add_accumulator("beta2_pow", param, program, startup,
+                                    fill_value=self._beta2, shape=())
+        attrs = {"beta1": self._beta1, "beta2": self._beta2,
+                 "epsilon": self._epsilon}
+        attrs.update(self._extra_attrs())
+        block.append_op(
+            self._op_type,
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "LearningRate": [lr], "Moment1": [m1], "Moment2": [m2],
+                    "Beta1Pow": [b1p], "Beta2Pow": [b2p]},
+            outputs={"ParamOut": [param.name], "Moment1Out": [m1],
+                     "Moment2Out": [m2], "Beta1PowOut": [b1p],
+                     "Beta2PowOut": [b2p]},
+            attrs=attrs)
+
+
+AdamOptimizer = Adam
+
+
+class AdamW(Adam):
+    _op_type = "adamw"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, weight_decay=0.01, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kw)
+        self._coeff = weight_decay
+
+    def _extra_attrs(self):
+        return {"coeff": self._coeff}
+
+
+class Lamb(Adam):
+    """optimizer.py:2935 LambOptimizer."""
+
+    _op_type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kw)
+        self._weight_decay = lamb_weight_decay
+
+    def _extra_attrs(self):
+        return {"weight_decay": self._weight_decay}
+
+
+LambOptimizer = Lamb
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+        self._init_value = initial_accumulator_value
+
+    def _append_optimize_op(self, block, param, grad, lr, program, startup):
+        mom = self._add_accumulator("moment", param, program, startup,
+                                    fill_value=self._init_value)
+        block.append_op(
+            "adagrad",
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "Moment": [mom], "LearningRate": [lr]},
+            outputs={"ParamOut": [param.name], "MomentOut": [mom]},
+            attrs={"epsilon": self._epsilon})
+
+
+AdagradOptimizer = Adagrad
+
+
+class DecayedAdagrad(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _append_optimize_op(self, block, param, grad, lr, program, startup):
+        mom = self._add_accumulator("moment", param, program, startup)
+        block.append_op(
+            "decayed_adagrad",
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "Moment": [mom], "LearningRate": [lr]},
+            outputs={"ParamOut": [param.name], "MomentOut": [mom]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon})
+
+
+DecayedAdagradOptimizer = DecayedAdagrad
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _append_optimize_op(self, block, param, grad, lr, program, startup):
+        mom = self._add_accumulator("moment", param, program, startup)
+        inf = self._add_accumulator("inf_norm", param, program, startup)
+        b1p = self._add_accumulator("beta1_pow", param, program, startup,
+                                    fill_value=self._beta1, shape=())
+        block.append_op(
+            "adamax",
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "LearningRate": [lr], "Moment": [mom], "InfNorm": [inf],
+                    "Beta1Pow": [b1p]},
+            outputs={"ParamOut": [param.name], "MomentOut": [mom],
+                     "InfNormOut": [inf]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
+        # beta1_pow update (reference appends a scale op)
+        block.append_op("scale", inputs={"X": [b1p]},
+                        outputs={"Out": [b1p]},
+                        attrs={"scale": self._beta1})
+
+
+AdamaxOptimizer = Adamax
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _append_optimize_op(self, block, param, grad, lr, program, startup):
+        asg = self._add_accumulator("avg_squared_grad", param, program,
+                                    startup)
+        asu = self._add_accumulator("avg_squared_update", param, program,
+                                    startup)
+        block.append_op(
+            "adadelta",
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "AvgSquaredGrad": [asg], "AvgSquaredUpdate": [asu]},
+            outputs={"ParamOut": [param.name], "AvgSquaredGradOut": [asg],
+                     "AvgSquaredUpdateOut": [asu]},
+            attrs={"rho": self._rho, "epsilon": self._epsilon})
+
+
+AdadeltaOptimizer = Adadelta
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _append_optimize_op(self, block, param, grad, lr, program, startup):
+        ms = self._add_accumulator("mean_square", param, program, startup)
+        mg = self._add_accumulator("mean_grad", param, program, startup)
+        mom = self._add_accumulator("momentum", param, program, startup)
+        block.append_op(
+            "rmsprop",
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "MeanSquare": [ms], "MeanGrad": [mg], "Moment": [mom],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [param.name], "MomentOut": [mom],
+                     "MeanSquareOut": [ms], "MeanGradOut": [mg]},
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered})
+
+
+RMSPropOptimizer = RMSProp
+
+
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _append_optimize_op(self, block, param, grad, lr, program, startup):
+        sq = self._add_accumulator("squared", param, program, startup)
+        lin = self._add_accumulator("linear", param, program, startup)
+        block.append_op(
+            "ftrl",
+            inputs={"Param": [param.name], "SquaredAccumulator": [sq],
+                    "LinearAccumulator": [lin], "Grad": [grad.name],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [param.name], "SquaredAccumOut": [sq],
+                     "LinearAccumOut": [lin]},
+            attrs={"l1": self._l1, "l2": self._l2,
+                   "lr_power": self._lr_power})
+
+
+FtrlOptimizer = Ftrl
+
+
+class DpSGD(Optimizer):
+    def __init__(self, learning_rate, clip=10.0, batch_size=16.0, sigma=1.0,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self._clip, self._batch_size, self._sigma = clip, batch_size, sigma
+
+    def _append_optimize_op(self, block, param, grad, lr, program, startup):
+        block.append_op(
+            "dpsgd",
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [param.name]},
+            attrs={"clip": self._clip, "batch_size": self._batch_size,
+                   "sigma": self._sigma})
+
+
+DpSGDOptimizer = DpSGD

@@ -1,0 +1,14 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's strategy of exercising distributed paths with local
+processes (/root/reference/python/paddle/fluid/tests/unittests/
+test_dist_base.py:594) — except on TPU we use XLA's host-platform device
+virtualization so multi-chip sharding tests run single-process.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

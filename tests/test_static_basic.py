@@ -1,0 +1,141 @@
+"""End-to-end static-graph tests: the 'book' analog of the reference
+(/root/reference/python/paddle/fluid/tests/book/test_fit_a_line.py,
+test_recognize_digits.py) — build program, run startup, train, assert loss
+decreases.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _fresh_programs():
+    main = pt.Program()
+    startup = pt.Program()
+    return main, startup
+
+
+def test_fit_a_line():
+    main, startup = _fresh_programs()
+    rng = np.random.RandomState(0)
+    true_w = rng.randn(13, 1).astype(np.float32)
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [13], append_batch_size=True)
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.nn.square(
+            layers.elementwise_sub(pred, y)))
+        opt = pt.optimizer.SGD(learning_rate=0.01)
+        opt.minimize(loss, startup_program=startup, program=main)
+
+    scope = pt.Scope()
+    exe = pt.Executor()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for i in range(50):
+            xb = rng.randn(32, 13).astype(np.float32)
+            yb = xb @ true_w + 0.01 * rng.randn(32, 1).astype(np.float32)
+            out, = exe.run(main, feed={"x": xb, "y": yb},
+                           fetch_list=[loss])
+            losses.append(float(out))
+    assert losses[-1] < losses[0] * 0.2, losses[::10]
+
+
+def test_mnist_style_conv():
+    main, startup = _fresh_programs()
+    rng = np.random.RandomState(1)
+    with pt.program_guard(main, startup):
+        img = layers.data("img", [1, 28, 28])
+        label = layers.data("label", [1], dtype="int64")
+        conv1 = layers.conv2d(img, num_filters=8, filter_size=5, act="relu")
+        pool1 = layers.pool2d(conv1, pool_size=2, pool_stride=2)
+        conv2 = layers.conv2d(pool1, num_filters=16, filter_size=5,
+                              act="relu")
+        pool2 = layers.pool2d(conv2, pool_size=2, pool_stride=2)
+        logits = layers.fc(pool2, size=10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        opt = pt.optimizer.Adam(learning_rate=1e-3)
+        opt.minimize(loss, startup_program=startup, program=main)
+
+    scope = pt.Scope()
+    exe = pt.Executor()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        # synthetic separable "digits": class k has bright k-th row block
+        losses = []
+        for i in range(30):
+            lbl = rng.randint(0, 10, size=(16, 1)).astype(np.int64)
+            imgs = 0.1 * rng.randn(16, 1, 28, 28).astype(np.float32)
+            for b in range(16):
+                imgs[b, 0, int(lbl[b, 0]) * 2: int(lbl[b, 0]) * 2 + 2, :] += 1.0
+            lv, av = exe.run(main, feed={"img": imgs, "label": lbl},
+                             fetch_list=[loss, acc])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0], losses[::5]
+
+
+def test_program_serialization_roundtrip():
+    main, startup = _fresh_programs()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        h = layers.fc(x, size=3, act="relu")
+        loss = layers.mean(h)
+    js = main.to_json()
+    prog2 = pt.Program.from_json(js)
+    assert len(prog2.global_block.ops) == len(main.global_block.ops)
+    assert set(prog2.global_block.vars) == set(main.global_block.vars)
+
+    # the deserialized program must execute identically
+    scope = pt.Scope()
+    exe = pt.Executor()
+    xb = np.ones((2, 4), np.float32)
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        a, = exe.run(main, feed={"x": xb}, fetch_list=[loss])
+        b, = exe.run(prog2, feed={"x": xb}, fetch_list=[loss.name])
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_gradients_api():
+    main, startup = _fresh_programs()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [3], append_batch_size=False)
+        x.stop_gradient = False
+        y = layers.nn.square(x)
+        loss = layers.reduce_sum(y)
+        (gx,) = pt.gradients(loss, x, program=main)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        xv = np.array([1.0, 2.0, 3.0], np.float32)
+        g, = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 2 * xv, rtol=1e-6)
+
+
+def test_lr_scheduler():
+    main, startup = _fresh_programs()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [2])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(pred)
+        sched = pt.optimizer.ExponentialDecay(
+            learning_rate=0.1, decay_steps=10, decay_rate=0.5,
+            staircase=True)
+        opt = pt.optimizer.SGD(learning_rate=sched)
+        opt.minimize(loss, startup_program=startup, program=main)
+    lr_name = opt._lr_name
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        lrs = []
+        for i in range(21):
+            lr, = exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+                          fetch_list=[lr_name])
+            lrs.append(float(lr))
+    assert abs(lrs[0] - 0.1) < 1e-6
+    assert abs(lrs[10] - 0.05) < 1e-6
+    assert abs(lrs[20] - 0.025) < 1e-6
