@@ -1,0 +1,20 @@
+"""Eager/dygraph mode — TPU-native analog of
+/root/reference/paddle/fluid/imperative/ + python/paddle/fluid/dygraph/."""
+from .tape import (GradNode, Tensor, no_grad, run_backward, run_op,  # noqa: F401
+                   seed, to_tensor, to_variable)
+
+
+class guard:
+    """fluid.dygraph.guard — dygraph is the default mode here; this is a
+    no-op context manager kept for API parity with v1 scripts."""
+
+    def __init__(self, place=None):
+        pass
+
+    def __enter__(self):
+        from ..core.program import disable_static
+        disable_static()
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,11 @@
+from . import functional  # noqa: F401
+from .layer import Layer, LayerList, ParameterList, Sequential  # noqa: F401
+from .layers_lib import *  # noqa: F401,F403
+from .layers_lib import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm,  # noqa: F401
+                         BatchNorm1D, BatchNorm2D, BatchNorm3D, BCELoss,
+                         BCEWithLogitsLoss, Conv2D, Conv2DTranspose,
+                         CrossEntropyLoss, Dropout, Embedding, Flatten,
+                         GELU, GroupNorm, KLDivLoss, L1Loss, LayerNorm,
+                         LeakyReLU, Linear, MaxPool2D, MSELoss, NLLLoss,
+                         ReLU, ReLU6, Sigmoid, SmoothL1Loss, Softmax,
+                         Tanh)

@@ -1,0 +1,341 @@
+"""Functional ops working in both eager and static modes.
+
+Analog of paddle.nn.functional (/root/reference/python/paddle/nn/functional/)
+— in eager mode each call runs the op lowering immediately through the tape
+(dygraph tracer path, framework.py:2867 append_op dygraph branch); in static
+mode it appends an OpDesc to the default program (LayerHelper path).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..core.program import in_dygraph_mode
+from ..dygraph import tape
+from ..dygraph.tape import Tensor
+
+
+def _run(op_type, ins, attrs, out_slot="Out"):
+    """Dual dispatch for single-output ops."""
+    if in_dygraph_mode():
+        return tape.run_op(op_type, ins, attrs)[out_slot][0]
+    from ..layers.helper import LayerHelper
+    helper = LayerHelper(op_type)
+    out = helper.create_tmp_variable()
+    helper.append_op(op_type,
+                     inputs={k: [v.name for v in vs]
+                             for k, vs in ins.items() if vs},
+                     outputs={out_slot: [out.name]}, attrs=attrs)
+    return out
+
+
+def _run_multi(op_type, ins, attrs, out_slots):
+    if in_dygraph_mode():
+        outs = tape.run_op(op_type, ins, attrs)
+        return [outs[s][0] for s in out_slots]
+    from ..layers.helper import LayerHelper
+    helper = LayerHelper(op_type)
+    outs = {s: [helper.create_tmp_variable().name] for s in out_slots}
+    helper.append_op(op_type,
+                     inputs={k: [v.name for v in vs]
+                             for k, vs in ins.items() if vs},
+                     outputs=outs, attrs=attrs)
+    return [helper.block.var(outs[s][0]) for s in out_slots]
+
+
+# --- activations -----------------------------------------------------------
+def _unary(op_type, **default_attrs):
+    def f(x, name=None, **attrs):
+        a = dict(default_attrs)
+        a.update(attrs)
+        return _run(op_type, {"X": [x]}, a)
+    f.__name__ = op_type
+    return f
+
+
+relu = _unary("relu")
+relu6 = _unary("relu6")
+sigmoid = _unary("sigmoid")
+tanh = _unary("tanh")
+gelu = _unary("gelu")
+elu = _unary("elu")
+selu = _unary("selu")
+silu = _unary("silu")
+mish = _unary("mish")
+softplus = _unary("softplus")
+softsign = _unary("softsign")
+swish = _unary("swish")
+hardswish = _unary("hard_swish")
+hardsigmoid = _unary("hard_sigmoid")
+hardshrink = _unary("hard_shrink")
+softshrink = _unary("soft_shrink")
+tanhshrink = _unary("tanh_shrink")
+leaky_relu = _unary("leaky_relu")
+exp = _unary("exp")
+sqrt = _unary("sqrt")
+square = _unary("square")
+log = _unary("log")
+
+
+def prelu(x, weight):
+    return _run("prelu", {"X": [x], "Alpha": [weight]}, {"mode": "all"})
+
+
+def softmax(x, axis: int = -1, name=None):
+    return _run("softmax", {"X": [x]}, {"axis": axis})
+
+
+def log_softmax(x, axis: int = -1, name=None):
+    return _run("log_softmax", {"X": [x]}, {"axis": axis})
+
+
+# --- linear / conv / pool --------------------------------------------------
+def linear(x, weight, bias=None, name=None):
+    out = _run("matmul", {"X": [x], "Y": [weight]}, {})
+    if bias is not None:
+        out = _run("elementwise_add", {"X": [out], "Y": [bias]},
+                   {"axis": -1})
+    return out
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NCHW", name=None):
+    if isinstance(stride, int):
+        stride = [stride, stride]
+    if isinstance(padding, int):
+        padding = [padding, padding]
+    if isinstance(dilation, int):
+        dilation = [dilation, dilation]
+    out = _run("conv2d", {"Input": [x], "Filter": [weight]},
+               {"strides": list(stride), "paddings": list(padding),
+                "dilations": list(dilation), "groups": groups,
+                "data_format": data_format}, out_slot="Output")
+    if bias is not None:
+        out = _run("elementwise_add", {"X": [out], "Y": [bias]},
+                   {"axis": 1 if data_format == "NCHW" else 3})
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                     groups: int = 1, name=None):
+    if isinstance(stride, int):
+        stride = [stride, stride]
+    if isinstance(padding, int):
+        padding = [padding, padding]
+    if isinstance(dilation, int):
+        dilation = [dilation, dilation]
+    out = _run("conv2d_transpose", {"Input": [x], "Filter": [weight]},
+               {"strides": list(stride), "paddings": list(padding),
+                "dilations": list(dilation), "groups": groups},
+               out_slot="Output")
+    if bias is not None:
+        out = _run("elementwise_add", {"X": [out], "Y": [bias]}, {"axis": 1})
+    return out
+
+
+def _pool2d(x, kernel_size, stride, padding, ptype, ceil_mode=False,
+            exclusive=True, adaptive=False, global_pool=False):
+    if isinstance(kernel_size, int):
+        kernel_size = [kernel_size, kernel_size]
+    stride = stride if stride is not None else kernel_size
+    if isinstance(stride, int):
+        stride = [stride, stride]
+    if isinstance(padding, int):
+        padding = [padding, padding]
+    return _run("pool2d", {"X": [x]},
+                {"ksize": list(kernel_size), "strides": list(stride),
+                 "paddings": list(padding), "pooling_type": ptype,
+                 "ceil_mode": ceil_mode, "exclusive": exclusive,
+                 "adaptive": adaptive, "global_pooling": global_pool})
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               name=None):
+    return _pool2d(x, kernel_size, stride, padding, "max", ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, name=None):
+    return _pool2d(x, kernel_size, stride, padding, "avg", ceil_mode,
+                   exclusive)
+
+
+def adaptive_avg_pool2d(x, output_size, name=None):
+    if isinstance(output_size, int):
+        output_size = [output_size, output_size]
+    return _pool2d(x, output_size, output_size, 0, "avg", adaptive=True)
+
+
+def adaptive_max_pool2d(x, output_size, name=None):
+    if isinstance(output_size, int):
+        output_size = [output_size, output_size]
+    return _pool2d(x, output_size, output_size, 0, "max", adaptive=True)
+
+
+# --- norm / dropout / embedding -------------------------------------------
+def layer_norm(x, normalized_shape=None, weight=None, bias=None,
+               epsilon: float = 1e-5, begin_norm_axis: Optional[int] = None):
+    if begin_norm_axis is None:
+        n = (1 if isinstance(normalized_shape, int)
+             else len(normalized_shape)) if normalized_shape else 1
+        begin_norm_axis = len(x.shape) - n
+    ins = {"X": [x]}
+    if weight is not None:
+        ins["Scale"] = [weight]
+    if bias is not None:
+        ins["Bias"] = [bias]
+    y, _, _ = _run_multi("layer_norm", ins,
+                         {"epsilon": epsilon,
+                          "begin_norm_axis": begin_norm_axis},
+                         ["Y", "Mean", "Variance"])
+    return y
+
+
+def batch_norm(x, running_mean, running_var, weight, bias,
+               training: bool = False, momentum: float = 0.9,
+               epsilon: float = 1e-5, data_format: str = "NCHW"):
+    ins = {"X": [x], "Scale": [weight], "Bias": [bias],
+           "Mean": [running_mean], "Variance": [running_var]}
+    attrs = {"momentum": momentum, "epsilon": epsilon,
+             "is_test": not training, "data_layout": data_format,
+             "use_global_stats": not training}
+    outs = _run_multi("batch_norm", ins, attrs,
+                      ["Y", "MeanOut", "VarianceOut", "SavedMean",
+                       "SavedVariance"])
+    y, mean_out, var_out = outs[0], outs[1], outs[2]
+    if training and in_dygraph_mode():
+        running_mean.set_value(mean_out.value)
+        running_var.set_value(var_out.value)
+    return y
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5):
+    ins = {"X": [x]}
+    if weight is not None:
+        ins["Scale"] = [weight]
+    if bias is not None:
+        ins["Bias"] = [bias]
+    y, _, _ = _run_multi("group_norm", ins,
+                         {"groups": num_groups, "epsilon": epsilon},
+                         ["Y", "Mean", "Variance"])
+    return y
+
+
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    ins = {"X": [x]}
+    if weight is not None:
+        ins["Scale"] = [weight]
+    if bias is not None:
+        ins["Bias"] = [bias]
+    y, _, _ = _run_multi("instance_norm", ins, {"epsilon": epsilon},
+                         ["Y", "SavedMean", "SavedVariance"])
+    return y
+
+
+def dropout(x, p: float = 0.5, training: bool = True,
+            mode: str = "upscale_in_train", name=None):
+    out, _ = _run_multi("dropout", {"X": [x]},
+                        {"dropout_prob": p, "is_test": not training,
+                         "dropout_implementation": mode},
+                        ["Out", "Mask"])
+    return out
+
+
+def embedding(x, weight, padding_idx: Optional[int] = None, name=None):
+    return _run("lookup_table_v2", {"W": [weight], "Ids": [x]},
+                {"padding_idx": -1 if padding_idx is None else padding_idx})
+
+
+# --- losses ----------------------------------------------------------------
+def cross_entropy(input, label, soft_label: bool = False,
+                  ignore_index: int = -100, reduction: str = "mean",
+                  axis: int = -1, use_softmax: bool = True, name=None):
+    if use_softmax:
+        loss, _ = _run_multi(
+            "softmax_with_cross_entropy",
+            {"Logits": [input], "Label": [label]},
+            {"soft_label": soft_label, "ignore_index": ignore_index,
+             "axis": axis}, ["Loss", "Softmax"])
+    else:
+        loss = _run("cross_entropy", {"X": [input], "Label": [label]},
+                    {"soft_label": soft_label, "ignore_index": ignore_index},
+                    out_slot="Y")
+    return _reduce(loss, reduction)
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return _run("mean", {"X": [loss]}, {})
+    if reduction == "sum":
+        return _run("reduce_sum", {"X": [loss]}, {"reduce_all": True})
+    return loss
+
+
+def mse_loss(input, label, reduction: str = "mean", name=None):
+    return _reduce(_run("square_error_cost",
+                        {"X": [input], "Y": [label]}, {}), reduction)
+
+
+def l1_loss(input, label, reduction: str = "mean", name=None):
+    d = _run("elementwise_sub", {"X": [input], "Y": [label]}, {"axis": -1})
+    return _reduce(_run("abs", {"X": [d]}, {}), reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index: int = -100,
+             reduction: str = "mean", name=None):
+    ins = {"X": [input], "Label": [label]}
+    if weight is not None:
+        ins["Weight"] = [weight]
+    out, _ = _run_multi("nll_loss", ins,
+                        {"ignore_index": ignore_index,
+                         "reduction": reduction},
+                        ["Out", "Total_weight"])
+    return out
+
+
+def kl_div(input, label, reduction: str = "mean", name=None):
+    return _run("kldiv_loss", {"X": [input], "Target": [label]},
+                {"reduction": reduction}, out_slot="Loss")
+
+
+def binary_cross_entropy(input, label, reduction: str = "mean", name=None):
+    return _reduce(_run("bce_loss", {"X": [input], "Label": [label]}, {}),
+                   reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, reduction: str = "mean",
+                                     name=None):
+    return _reduce(_run("sigmoid_cross_entropy_with_logits",
+                        {"X": [logit], "Label": [label]}, {}), reduction)
+
+
+def smooth_l1_loss(input, label, reduction: str = "mean", delta: float = 1.0,
+                   name=None):
+    out, _ = _run_multi("huber_loss", {"X": [input], "Y": [label]},
+                        {"delta": delta}, ["Out", "Residual"])
+    return _reduce(out, reduction)
+
+
+def one_hot(x, num_classes, name=None):
+    return _run("one_hot_v2", {"X": [x]}, {"depth": num_classes})
+
+
+def pad(x, pad, mode: str = "constant", value: float = 0.0,
+        data_format: str = "NCHW", name=None):
+    return _run("pad2d" if len(pad) == 4 else "pad3d", {"X": [x]},
+                {"paddings": list(pad), "mode": mode, "pad_value": value,
+                 "value": value, "data_format": data_format})
+
+
+def interpolate(x, size=None, scale_factor=None, mode: str = "nearest",
+                align_corners: bool = False, name=None):
+    attrs = {"align_corners": align_corners}
+    if size is not None:
+        attrs["out_h"], attrs["out_w"] = size
+    else:
+        attrs["scale"] = float(scale_factor)
+    op = {"nearest": "nearest_interp", "bilinear": "bilinear_interp"}[mode]
+    return _run(op, {"X": [x]}, attrs)
+
+
+def label_smooth(label, epsilon: float = 0.1, name=None):
+    return _run("label_smooth", {"X": [label]}, {"epsilon": epsilon})

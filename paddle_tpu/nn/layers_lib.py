@@ -1,0 +1,355 @@
+"""nn Layer classes (v2-style API).
+
+Analog of /root/reference/python/paddle/nn/layer/ (common.py Linear,
+conv.py Conv2D, norm.py BatchNorm/LayerNorm/GroupNorm, transformer.py
+MultiHeadAttention/TransformerEncoder) and fluid/dygraph/nn.py.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from ..layers.helper import Constant, Normal, ParamAttr, Uniform, Xavier
+from . import functional as F
+from .layer import Layer, LayerList, ParameterList, Sequential  # noqa: F401
+
+
+class Linear(Layer):
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=Xavier())
+        self.bias = self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True)
+        if self.bias is not None:
+            self.add_parameter("bias", self.bias)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class Conv2D(Layer):
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 weight_attr=None, bias_attr=None,
+                 data_format: str = "NCHW"):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = [kernel_size, kernel_size]
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+        fan_in = in_channels // groups * int(np.prod(kernel_size))
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups] + list(kernel_size),
+            attr=weight_attr,
+            default_initializer=Normal(0.0, math.sqrt(2.0 / fan_in)))
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+        if self.bias is not None:
+            self.add_parameter("bias", self.bias)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups,
+                        self._data_format)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = [kernel_size, kernel_size]
+        self._stride, self._padding = stride, padding
+        self._dilation, self._groups = dilation, groups
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups] + list(kernel_size),
+            attr=weight_attr)
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+        if self.bias is not None:
+            self.add_parameter("bias", self.bias)
+
+    def forward(self, x):
+        return F.conv2d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._dilation, self._groups)
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 padding_idx: Optional[int] = None, sparse: bool = False,
+                 weight_attr=None, name=None):
+        super().__init__()
+        self._padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=Normal(0.0, 1.0 / math.sqrt(embedding_dim)))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, self._padding_idx)
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon: float = 1e-5,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        n = int(np.prod(normalized_shape))
+        self.weight = self.create_parameter(
+            [n], attr=weight_attr, default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([n], attr=bias_attr, is_bias=True)
+        if self.bias is not None:
+            self.add_parameter("bias", self.bias)
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight,
+                            self.bias, self._epsilon)
+
+
+class BatchNorm2D(Layer):
+    def __init__(self, num_features: int, momentum: float = 0.9,
+                 epsilon: float = 1e-5, weight_attr=None, bias_attr=None,
+                 data_format: str = "NCHW"):
+        super().__init__()
+        self._momentum, self._epsilon = momentum, epsilon
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                          is_bias=True)
+        if self.bias is not None:
+            self.add_parameter("bias", self.bias)
+        mean = self.create_parameter([num_features],
+                                     default_initializer=Constant(0.0),
+                                     attr=ParamAttr(trainable=False))
+        var = self.create_parameter([num_features],
+                                    default_initializer=Constant(1.0),
+                                    attr=ParamAttr(trainable=False))
+        self._mean = self.register_buffer("_mean", mean)
+        self._variance = self.register_buffer("_variance", var)
+
+    def forward(self, x):
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, training=self.training,
+                            momentum=self._momentum, epsilon=self._epsilon,
+                            data_format=self._data_format)
+
+
+BatchNorm = BatchNorm2D
+BatchNorm1D = BatchNorm2D
+BatchNorm3D = BatchNorm2D
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups: int, num_channels: int,
+                 epsilon: float = 1e-5, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            [num_channels], attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_channels], attr=bias_attr,
+                                          is_bias=True)
+        if self.bias is not None:
+            self.add_parameter("bias", self.bias)
+
+    def forward(self, x):
+        return F.group_norm(x, self._num_groups, self.weight, self.bias,
+                            self._epsilon)
+
+
+class Dropout(Layer):
+    def __init__(self, p: float = 0.5, mode: str = "upscale_in_train"):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, self.p, training=self.training, mode=self.mode)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis: int = 1, stop_axis: int = -1):
+        super().__init__()
+        self.start_axis, self.stop_axis = start_axis, stop_axis
+
+    def forward(self, x):
+        from ..dygraph import tape
+        from ..core.program import in_dygraph_mode
+        if in_dygraph_mode():
+            return tape.run_op(
+                "flatten_contiguous_range", {"X": [x]},
+                {"start_axis": self.start_axis,
+                 "stop_axis": self.stop_axis})["Out"][0]
+        from ..layers import nn as L
+        return L.flatten(x, axis=self.start_axis)
+
+
+def _act_layer(fn_name):
+    fn = getattr(F, fn_name)
+
+    class _Act(Layer):
+        def __init__(self, name=None):
+            super().__init__()
+
+        def forward(self, x):
+            return fn(x)
+
+    _Act.__name__ = fn_name.title().replace("_", "")
+    return _Act
+
+
+ReLU = _act_layer("relu")
+ReLU6 = _act_layer("relu6")
+GELU = _act_layer("gelu")
+Sigmoid = _act_layer("sigmoid")
+Tanh = _act_layer("tanh")
+Softplus = _act_layer("softplus")
+Silu = _act_layer("silu")
+Mish = _act_layer("mish")
+Hardswish = _act_layer("hardswish")
+Hardsigmoid = _act_layer("hardsigmoid")
+LeakyReLU = _act_layer("leaky_relu")
+
+
+class Softmax(Layer):
+    def __init__(self, axis: int = -1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode: bool = False):
+        super().__init__()
+        self.kernel_size, self.stride = kernel_size, stride
+        self.padding, self.ceil_mode = padding, ceil_mode
+
+    def forward(self, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.ceil_mode)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode: bool = False, exclusive: bool = True):
+        super().__init__()
+        self.kernel_size, self.stride = kernel_size, stride
+        self.padding, self.ceil_mode = padding, ceil_mode
+        self.exclusive = exclusive
+
+    def forward(self, x):
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.ceil_mode, self.exclusive)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size)
+
+
+# --- losses ----------------------------------------------------------------
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, ignore_index: int = -100,
+                 reduction: str = "mean", soft_label: bool = False,
+                 axis: int = -1, use_softmax: bool = True):
+        super().__init__()
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+        self.soft_label = soft_label
+        self.axis = axis
+        self.use_softmax = use_softmax
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, self.soft_label,
+                               self.ignore_index, self.reduction, self.axis,
+                               self.use_softmax)
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction: str = "mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.mse_loss(input, label, self.reduction)
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction: str = "mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.l1_loss(input, label, self.reduction)
+
+
+class NLLLoss(Layer):
+    def __init__(self, weight=None, ignore_index: int = -100,
+                 reduction: str = "mean"):
+        super().__init__()
+        self.weight = weight
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.nll_loss(input, label, self.weight, self.ignore_index,
+                          self.reduction)
+
+
+class BCELoss(Layer):
+    def __init__(self, reduction: str = "mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.binary_cross_entropy(input, label, self.reduction)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, reduction: str = "mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, logit, label):
+        return F.binary_cross_entropy_with_logits(logit, label,
+                                                  self.reduction)
+
+
+class KLDivLoss(Layer):
+    def __init__(self, reduction: str = "mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.kl_div(input, label, self.reduction)
+
+
+class SmoothL1Loss(Layer):
+    def __init__(self, reduction: str = "mean", delta: float = 1.0):
+        super().__init__()
+        self.reduction = reduction
+        self.delta = delta
+
+    def forward(self, input, label):
+        return F.smooth_l1_loss(input, label, self.reduction, self.delta)

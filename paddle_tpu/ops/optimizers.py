@@ -96,10 +96,11 @@ def _adamw(ctx, ins, attrs):
 @register_op("adamax",
              inputs=("Param", "Grad", "LearningRate", "Moment", "InfNorm",
                      "Beta1Pow"),
-             outputs=("ParamOut", "MomentOut", "InfNormOut"),
+             outputs=("ParamOut", "MomentOut", "InfNormOut", "Beta1PowOut"),
              no_grad=True,
              inplace_map={"ParamOut": "Param", "MomentOut": "Moment",
-                          "InfNormOut": "InfNorm"})
+                          "InfNormOut": "InfNorm",
+                          "Beta1PowOut": "Beta1Pow"})
 def _adamax(ctx, ins, attrs):
     p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
     m, inf = ins["Moment"][0], ins["InfNorm"][0]
@@ -110,7 +111,8 @@ def _adamax(ctx, ins, attrs):
     mo = b1 * m + (1 - b1) * g
     info = jnp.maximum(b2 * inf, jnp.abs(g) + eps)
     po = p - (lr / (1 - b1p)) * mo / info
-    return {"ParamOut": [po], "MomentOut": [mo], "InfNormOut": [info]}
+    return {"ParamOut": [po], "MomentOut": [mo], "InfNormOut": [info],
+            "Beta1PowOut": [b1p * b1]}
 
 
 @register_op("adagrad", inputs=("Param", "Grad", "Moment", "LearningRate"),

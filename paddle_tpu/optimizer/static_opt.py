@@ -35,6 +35,10 @@ class GradientClipByValue(GradClipBase):
             out.append((p, clipped))
         return out
 
+    def eager_apply(self, pgs):
+        import jax.numpy as jnp
+        return [(p, jnp.clip(g, self.min, self.max)) for p, g in pgs]
+
 
 class GradientClipByNorm(GradClipBase):
     def __init__(self, clip_norm):
@@ -48,6 +52,15 @@ class GradientClipByNorm(GradClipBase):
                             outputs={"Out": [clipped.name]},
                             attrs={"max_norm": self.clip_norm})
             out.append((p, clipped))
+        return out
+
+    def eager_apply(self, pgs):
+        import jax.numpy as jnp
+        out = []
+        for p, g in pgs:
+            norm = jnp.sqrt(jnp.sum(g * g))
+            out.append((p, g * (self.clip_norm /
+                                jnp.maximum(norm, self.clip_norm))))
         return out
 
 
@@ -94,6 +107,13 @@ class GradientClipByGlobalNorm(GradClipBase):
             out.append((p, clipped))
         return out
 
+    def eager_apply(self, pgs):
+        import jax.numpy as jnp
+        total = sum(jnp.sum(g * g) for _, g in pgs)
+        gnorm = jnp.sqrt(total)
+        factor = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        return [(p, g * factor) for p, g in pgs]
+
 
 class L2Decay:
     """fluid.regularizer.L2Decay — grad += coeff * param."""
@@ -110,6 +130,9 @@ class L2Decay:
         block.append_op("sum", inputs={"X": [g.name, scaled.name]},
                         outputs={"Out": [out.name]})
         return out
+
+    def eager_apply(self, p_val, g):
+        return g + self.coeff * p_val
 
 
 class L1Decay:
@@ -129,18 +152,35 @@ class L1Decay:
                         outputs={"Out": [out.name]})
         return out
 
+    def eager_apply(self, p_val, g):
+        import jax.numpy as jnp
+        return g + self.coeff * jnp.sign(p_val)
+
 
 class Optimizer:
-    """Base optimizer (reference optimizer.py:56)."""
+    """Base optimizer (reference optimizer.py:56). Works in both modes like
+    the reference: static minimize() appends ops; eager step()/minimize()
+    applies the same op lowerings immediately to parameter Tensors
+    (dygraph optimizer path, optimizer.py:783 _apply_optimize)."""
 
     def __init__(self, learning_rate=0.001, regularization=None,
-                 grad_clip=None, name: Optional[str] = None):
+                 grad_clip=None, name: Optional[str] = None,
+                 parameter_list=None, parameters=None, weight_decay=None,
+                 **_ignored):
         self._learning_rate = learning_rate
         self.regularization = regularization
+        if weight_decay is not None and regularization is None:
+            self.regularization = (
+                L2Decay(float(weight_decay))
+                if isinstance(weight_decay, (int, float))
+                else weight_decay)
         self.grad_clip = grad_clip
         self._name = name or type(self).__name__
         self._lr_name: Optional[str] = None
         self._accumulators: Dict[str, Dict[str, str]] = {}
+        self._parameter_list = parameters or parameter_list
+        self._eager_store: Dict[int, dict] = {}
+        self._eager_step_count = 0
 
     # -- learning rate ---------------------------------------------------
     def _create_global_learning_rate(self, program, startup):
@@ -190,6 +230,25 @@ class Optimizer:
     def minimize(self, loss, startup_program: Optional[Program] = None,
                  parameter_list=None, no_grad_set=None,
                  program: Optional[Program] = None):
+        # dispatch on the loss object: an eager Tensor means dygraph step
+        # (reference checks in_dygraph_mode; here the loss type is
+        # unambiguous and does not require a global mode switch)
+        if not isinstance(loss, VarDesc):
+            if self._parameter_list is None and parameter_list is not None:
+                self._parameter_list = list(parameter_list)
+            if no_grad_set:
+                skip = {id(p) for p in no_grad_set}
+                kept = [p for p in self._parameter_list
+                        if id(p) not in skip]
+                saved = self._parameter_list
+                self._parameter_list = kept
+                try:
+                    self.step()
+                finally:
+                    self._parameter_list = saved
+            else:
+                self.step()
+            return None, []
         program = program or default_main_program()
         startup = startup_program or default_startup_program()
         params_grads = append_backward(loss, parameter_list, no_grad_set,
@@ -201,11 +260,11 @@ class Optimizer:
         program = program or default_main_program()
         startup = startup or default_startup_program()
         block = program.global_block
+        if self.grad_clip is not None:
+            params_grads = self.grad_clip.apply(block, params_grads)
         if self.regularization is not None:
             params_grads = [(p, _as_var(block, self.regularization.apply(
                 block, p, _as_var(block, g)))) for p, g in params_grads]
-        if self.grad_clip is not None:
-            params_grads = self.grad_clip.apply(block, params_grads)
         lr = self._create_global_learning_rate(program, startup)
         for p, g in params_grads:
             self._append_optimize_op(block, p, _as_var(block, g), lr,
@@ -214,6 +273,89 @@ class Optimizer:
 
     def _append_optimize_op(self, block, param, grad, lr, program, startup):
         raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # eager (dygraph) path
+    # ------------------------------------------------------------------
+    def _eager_spec(self):
+        """(op_type, attrs, accums) where accums is a list of
+        (in_slot, out_slot, key, fill, is_scalar)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no eager implementation")
+
+    def _eager_lr(self):
+        import jax.numpy as jnp
+        from .lr_scheduler import LRScheduler
+        from ..core.registry import REGISTRY, LowerCtx
+        if isinstance(self._learning_rate, LRScheduler):
+            outs = REGISTRY.get("lr_schedule").lower(
+                LowerCtx(), {"Step": [jnp.asarray(self._eager_step_count)]},
+                self._learning_rate._attrs())
+            return outs["Out"][0]
+        return jnp.asarray(float(self._learning_rate), jnp.float32)
+
+    def step(self):
+        import jax.numpy as jnp
+        from ..core.registry import REGISTRY, LowerCtx
+        from ..dygraph import tape
+        params = self._parameter_list
+        if params is None:
+            raise ValueError(
+                "eager optimizer needs parameters= at construction")
+        pgs = [(p, p.grad) for p in params if p.grad is not None]
+        if self.grad_clip is not None:
+            pgs = self.grad_clip.eager_apply(pgs)
+        lr = self._eager_lr()
+        op_type, attrs, accums = self._eager_spec()
+        opdef = REGISTRY.get(op_type)
+        for p, g in pgs:
+            g = jnp.asarray(g, p.value.dtype)
+            if self.regularization is not None:
+                g = self.regularization.eager_apply(p.value, g)
+            store = self._eager_store.setdefault(id(p), {})
+            ins = {"Param": [p.value], "Grad": [g], "LearningRate": [lr]}
+            for in_slot, out_slot, key, fill, is_scalar in accums:
+                if key not in store:
+                    store[key] = (jnp.asarray(fill, jnp.float32) if is_scalar
+                                  else jnp.full_like(p.value, fill))
+                ins[in_slot] = [store[key]]
+            outs = opdef.lower(LowerCtx(tape._state.next_key()), ins, attrs)
+            p.value = outs["ParamOut"][0]
+            for in_slot, out_slot, key, fill, is_scalar in accums:
+                if out_slot in outs:
+                    store[key] = outs[out_slot][0]
+        self._eager_step_count += 1
+
+    def clear_grad(self):
+        for p in (self._parameter_list or []):
+            p.clear_gradient()
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        import numpy as np
+        return float(np.asarray(self._eager_lr()))
+
+    def state_dict(self):
+        import numpy as np
+        out = {"_step": self._eager_step_count}
+        params = self._parameter_list or []
+        for i, p in enumerate(params):
+            store = self._eager_store.get(id(p), {})
+            for k, v in store.items():
+                out[f"{p.name}@{k}"] = np.asarray(v)
+        return out
+
+    def set_state_dict(self, state):
+        import jax.numpy as jnp
+        self._eager_step_count = int(state.get("_step", 0))
+        params = self._parameter_list or []
+        for p in params:
+            prefix = f"{p.name}@"
+            store = self._eager_store.setdefault(id(p), {})
+            for k, v in state.items():
+                if isinstance(k, str) and k.startswith(prefix):
+                    store[k[len(prefix):]] = jnp.asarray(v)
 
 
 def _as_var(block, v):
@@ -228,6 +370,9 @@ class SGD(Optimizer):
                         inputs={"Param": [param.name], "Grad": [grad.name],
                                 "LearningRate": [lr]},
                         outputs={"ParamOut": [param.name]})
+
+    def _eager_spec(self):
+        return "sgd", {}, []
 
 
 SGDOptimizer = SGD
@@ -250,6 +395,11 @@ class Momentum(Optimizer):
                     "Velocity": [vel], "LearningRate": [lr]},
             outputs={"ParamOut": [param.name], "VelocityOut": [vel]},
             attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+    def _eager_spec(self):
+        return "momentum", {"mu": self._momentum,
+                            "use_nesterov": self._use_nesterov}, [
+            ("Velocity", "VelocityOut", "velocity", 0.0, False)]
 
 
 MomentumOptimizer = Momentum
@@ -275,6 +425,12 @@ class LarsMomentum(Optimizer):
             attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
                    "lars_weight_decay": self._lars_weight_decay})
 
+    def _eager_spec(self):
+        return "lars_momentum", {
+            "mu": self._momentum, "lars_coeff": self._lars_coeff,
+            "lars_weight_decay": self._lars_weight_decay}, [
+            ("Velocity", "VelocityOut", "velocity", 0.0, False)]
+
 
 LarsMomentumOptimizer = LarsMomentum
 
@@ -291,6 +447,16 @@ class Adam(Optimizer):
 
     def _extra_attrs(self):
         return {}
+
+    def _eager_spec(self):
+        attrs = {"beta1": self._beta1, "beta2": self._beta2,
+                 "epsilon": self._epsilon}
+        attrs.update(self._extra_attrs())
+        return self._op_type, attrs, [
+            ("Moment1", "Moment1Out", "moment1", 0.0, False),
+            ("Moment2", "Moment2Out", "moment2", 0.0, False),
+            ("Beta1Pow", "Beta1PowOut", "beta1_pow", self._beta1, True),
+            ("Beta2Pow", "Beta2PowOut", "beta2_pow", self._beta2, True)]
 
     def _append_optimize_op(self, block, param, grad, lr, program, startup):
         m1 = self._add_accumulator("moment1", param, program, startup)
@@ -361,6 +527,10 @@ class Adagrad(Optimizer):
             outputs={"ParamOut": [param.name], "MomentOut": [mom]},
             attrs={"epsilon": self._epsilon})
 
+    def _eager_spec(self):
+        return "adagrad", {"epsilon": self._epsilon}, [
+            ("Moment", "MomentOut", "moment", self._init_value, False)]
+
 
 AdagradOptimizer = Adagrad
 
@@ -378,6 +548,11 @@ class DecayedAdagrad(Optimizer):
                     "Moment": [mom], "LearningRate": [lr]},
             outputs={"ParamOut": [param.name], "MomentOut": [mom]},
             attrs={"decay": self._decay, "epsilon": self._epsilon})
+
+    def _eager_spec(self):
+        return "decayed_adagrad", {"decay": self._decay,
+                                   "epsilon": self._epsilon}, [
+            ("Moment", "MomentOut", "moment", 0.0, False)]
 
 
 DecayedAdagradOptimizer = DecayedAdagrad
@@ -400,13 +575,16 @@ class Adamax(Optimizer):
                     "LearningRate": [lr], "Moment": [mom], "InfNorm": [inf],
                     "Beta1Pow": [b1p]},
             outputs={"ParamOut": [param.name], "MomentOut": [mom],
-                     "InfNormOut": [inf]},
+                     "InfNormOut": [inf], "Beta1PowOut": [b1p]},
             attrs={"beta1": self._beta1, "beta2": self._beta2,
                    "epsilon": self._epsilon})
-        # beta1_pow update (reference appends a scale op)
-        block.append_op("scale", inputs={"X": [b1p]},
-                        outputs={"Out": [b1p]},
-                        attrs={"scale": self._beta1})
+
+    def _eager_spec(self):
+        return "adamax", {"beta1": self._beta1, "beta2": self._beta2,
+                          "epsilon": self._epsilon}, [
+            ("Moment", "MomentOut", "moment", 0.0, False),
+            ("InfNorm", "InfNormOut", "inf_norm", 0.0, False),
+            ("Beta1Pow", "Beta1PowOut", "beta1_pow", self._beta1, True)]
 
 
 AdamaxOptimizer = Adamax
@@ -429,6 +607,11 @@ class Adadelta(Optimizer):
             outputs={"ParamOut": [param.name], "AvgSquaredGradOut": [asg],
                      "AvgSquaredUpdateOut": [asu]},
             attrs={"rho": self._rho, "epsilon": self._epsilon})
+
+    def _eager_spec(self):
+        return "adadelta", {"rho": self._rho, "epsilon": self._epsilon}, [
+            ("AvgSquaredGrad", "AvgSquaredGradOut", "asg", 0.0, False),
+            ("AvgSquaredUpdate", "AvgSquaredUpdateOut", "asu", 0.0, False)]
 
 
 AdadeltaOptimizer = Adadelta
@@ -455,6 +638,14 @@ class RMSProp(Optimizer):
             attrs={"decay": self._rho, "epsilon": self._epsilon,
                    "momentum": self._momentum, "centered": self._centered})
 
+    def _eager_spec(self):
+        return "rmsprop", {"decay": self._rho, "epsilon": self._epsilon,
+                           "momentum": self._momentum,
+                           "centered": self._centered}, [
+            ("MeanSquare", "MeanSquareOut", "mean_square", 0.0, False),
+            ("MeanGrad", "MeanGradOut", "mean_grad", 0.0, False),
+            ("Moment", "MomentOut", "moment", 0.0, False)]
+
 
 RMSPropOptimizer = RMSProp
 
@@ -476,6 +667,12 @@ class Ftrl(Optimizer):
                      "LinearAccumOut": [lin]},
             attrs={"l1": self._l1, "l2": self._l2,
                    "lr_power": self._lr_power})
+
+    def _eager_spec(self):
+        return "ftrl", {"l1": self._l1, "l2": self._l2,
+                        "lr_power": self._lr_power}, [
+            ("SquaredAccumulator", "SquaredAccumOut", "squared", 0.0, False),
+            ("LinearAccumulator", "LinearAccumOut", "linear", 0.0, False)]
 
 
 FtrlOptimizer = Ftrl

@@ -4,11 +4,26 @@ Mirrors the reference's strategy of exercising distributed paths with local
 processes (/root/reference/python/paddle/fluid/tests/unittests/
 test_dist_base.py:594) — except on TPU we use XLA's host-platform device
 virtualization so multi-chip sharding tests run single-process.
+
+Note: the axon TPU sitecustomize imports jax at interpreter startup with
+JAX_PLATFORMS=axon baked into jax.config, so setting os.environ here is not
+enough — jax.config must be updated directly.
 """
 import os
+import re
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_sessionstart(session):
+    n = len(jax.devices())
+    assert jax.default_backend() == "cpu", jax.default_backend()
+    assert n == 8, f"expected 8 virtual CPU devices, got {n}"

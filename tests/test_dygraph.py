@@ -1,0 +1,130 @@
+"""Dygraph/eager tests — analog of the reference's imperative tests
+(/root/reference/python/paddle/fluid/tests/unittests/test_imperative_basic.py
+and test_imperative_mnist.py): eager forward, tape backward, optimizer step.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.dygraph import Tensor, no_grad, to_tensor
+import paddle_tpu.nn.functional as F
+
+
+def test_tape_simple_grad():
+    x = to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                  stop_gradient=False)
+    y = x * x + 2.0 * x
+    loss = pt.dygraph.run_op("reduce_sum", {"X": [y]},
+                             {"reduce_all": True})["Out"][0]
+    loss.backward()
+    np.testing.assert_allclose(x.gradient, 2 * np.array([1, 2, 3.]) + 2,
+                               rtol=1e-6)
+
+
+def test_tape_shared_subexpression():
+    # diamond graph: z = a*b + a*c — grad a must accumulate
+    a = to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    b = to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    c = to_tensor(np.array([4.0], np.float32), stop_gradient=False)
+    z = a * b + a * c
+    z.backward()
+    np.testing.assert_allclose(a.gradient, [7.0], rtol=1e-6)
+    np.testing.assert_allclose(b.gradient, [2.0], rtol=1e-6)
+
+
+def test_no_grad():
+    x = to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    with no_grad():
+        y = x * 2.0
+    assert y._node is None and y.stop_gradient
+
+
+def test_linear_layer_training():
+    rng = np.random.RandomState(0)
+    true_w = rng.randn(4, 1).astype(np.float32)
+    model = nn.Linear(4, 1)
+    opt = pt.optimizer.SGD(learning_rate=0.1,
+                           parameters=model.parameters())
+    losses = []
+    for i in range(100):
+        xb = rng.randn(16, 4).astype(np.float32)
+        yb = xb @ true_w
+        pred = model(to_tensor(xb))
+        loss = F.mse_loss(pred, to_tensor(yb))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
+
+
+def test_mlp_adam_and_state_dict():
+    rng = np.random.RandomState(1)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = pt.optimizer.Adam(learning_rate=1e-2,
+                            parameters=model.parameters())
+    ce = nn.CrossEntropyLoss()
+    losses = []
+    for i in range(60):
+        lbl = rng.randint(0, 4, (32,)).astype(np.int64)
+        x = rng.randn(32, 8).astype(np.float32) * 0.1
+        x[np.arange(32), lbl] += 2.0
+        loss = ce(model(to_tensor(x)), to_tensor(lbl[:, None]))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5
+
+    sd = model.state_dict()
+    model2 = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model2.set_state_dict(sd)
+    x = rng.randn(4, 8).astype(np.float32)
+    np.testing.assert_allclose(model(to_tensor(x)).numpy(),
+                               model2(to_tensor(x)).numpy(), rtol=1e-6)
+
+
+def test_conv_bn_dropout_eager():
+    model = nn.Sequential(
+        nn.Conv2D(1, 4, 3, padding=1), nn.BatchNorm2D(4), nn.ReLU(),
+        nn.MaxPool2D(2), nn.Flatten(), nn.Dropout(0.5), nn.Linear(4 * 4 * 4, 2))
+    x = to_tensor(np.random.randn(2, 1, 8, 8).astype(np.float32))
+    model.train()
+    out = model(x)
+    assert out.shape == (2, 2)
+    mean_before = model[1]._mean.numpy().copy()
+    loss = pt.dygraph.run_op("mean", {"X": [out]}, {})["Out"][0]
+    loss.backward()
+    # bn running stats updated in train mode
+    out2 = model(x)
+    assert not np.allclose(model[1]._mean.numpy(), mean_before)
+    model.eval()
+    a = model(x).numpy()
+    b = model(x).numpy()
+    np.testing.assert_allclose(a, b)  # dropout off in eval
+
+
+def test_retain_graph_double_backward_error_free():
+    x = to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    g1 = x.gradient.copy()
+    y.backward(retain_graph=True)
+    np.testing.assert_allclose(x.gradient, 2 * g1)
+
+
+@pytest.mark.parametrize("opt_name", ["SGD", "Momentum", "Adam", "AdamW",
+                                      "Adagrad", "RMSProp", "Lamb",
+                                      "Adamax", "Adadelta", "Ftrl"])
+def test_all_optimizers_step(opt_name):
+    cls = getattr(pt.optimizer, opt_name)
+    model = nn.Linear(4, 2)
+    opt = cls(learning_rate=0.01, parameters=model.parameters())
+    before = model.weight.numpy().copy()
+    x = to_tensor(np.ones((3, 4), np.float32))
+    loss = F.mse_loss(model(x), to_tensor(np.zeros((3, 2), np.float32)))
+    loss.backward()
+    opt.step()
+    assert not np.allclose(model.weight.numpy(), before)
+    assert np.all(np.isfinite(model.weight.numpy()))
