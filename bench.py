@@ -1,0 +1,78 @@
+"""Benchmark: BERT-base pretrain step throughput on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is measured-MFU / target-MFU with target 0.45 (BASELINE.md
+north star: >=45% MFU on the BERT-base pretrain config).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
+                                        pretraining_loss)
+    from paddle_tpu.jit import TrainStep
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if on_tpu:
+        cfg = BertConfig()  # BERT-base
+        B, S, steps = 32, 128, 20
+    else:  # CI / smoke fallback
+        cfg = BertConfig(vocab_size=1000, hidden_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         intermediate_size=256, max_position_embeddings=128)
+        B, S, steps = 8, 64, 5
+
+    model = BertForPretraining(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16") if False else None  # params fp32; compute bf16 via amp
+    opt = pt.optimizer.Adam(1e-4, parameters=model.parameters())
+    step = TrainStep(model, pretraining_loss, opt,
+                     amp_dtype="bfloat16" if on_tpu else None)
+
+    rng = np.random.RandomState(0)
+
+    def batch():
+        ids = rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32)
+        mlm = np.where(rng.rand(B, S) < 0.15, ids, -100).astype(np.int32)
+        nsp = rng.randint(0, 2, (B, 1)).astype(np.int32)
+        return ids, mlm, nsp
+
+    # warmup/compile
+    ids, mlm, nsp = batch()
+    loss = step((ids,), (mlm, nsp))
+    float(loss)
+
+    t0 = time.time()
+    for _ in range(steps):
+        loss = step((ids,), (mlm, nsp))
+    float(loss)  # sync
+    dt = (time.time() - t0) / steps
+
+    tokens_per_sec = B * S / dt
+
+    # MFU: ~6*N FLOPs/token fwd+bwd with N ≈ 12*L*H^2 (attention+FFN) +
+    # embeddings excluded; use standard 6*params estimate.
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_token = 6 * n_params
+    achieved = tokens_per_sec * flops_per_token
+    # v5e peak: 197 TFLOPs bf16 per chip
+    peak = 197e12 if on_tpu else 1e12
+    mfu = achieved / peak
+    print(json.dumps({
+        "metric": "tokens/sec/chip BERT-base pretrain (fused step, bf16)"
+        if on_tpu else "tokens/sec/chip tiny-BERT (cpu smoke)",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

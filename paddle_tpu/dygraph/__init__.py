@@ -5,16 +5,20 @@ from .tape import (GradNode, Tensor, no_grad, run_backward, run_op,  # noqa: F40
 
 
 class guard:
-    """fluid.dygraph.guard — dygraph is the default mode here; this is a
-    no-op context manager kept for API parity with v1 scripts."""
+    """fluid.dygraph.guard: eager-mode section; restores the previous
+    mode on exit (the reference saves/restores the tracer)."""
 
     def __init__(self, place=None):
-        pass
+        self._was_static = False
 
     def __enter__(self):
-        from ..core.program import disable_static
+        from ..core.program import disable_static, in_static_mode
+        self._was_static = in_static_mode()
         disable_static()
         return self
 
     def __exit__(self, *exc):
+        if self._was_static:
+            from ..core.program import enable_static
+            enable_static()
         return False

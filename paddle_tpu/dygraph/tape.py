@@ -51,6 +51,19 @@ def seed(s: int):
 
 
 @contextlib.contextmanager
+def rng_scope(key):
+    """Bind the eager RNG chain to an explicit key and restore on exit —
+    required when tracing eager code under jit so traced keys never leak
+    into the global chain."""
+    old = _state.key
+    _state.key = key
+    try:
+        yield
+    finally:
+        _state.key = old
+
+
+@contextlib.contextmanager
 def no_grad():
     old = _state.grad_enabled
     _state.grad_enabled = False
@@ -189,12 +202,14 @@ class Tensor:
     def __getitem__(self, idx):
         out = self.value[idx]
         t = Tensor(out, stop_gradient=self.stop_gradient)
-        if _state.grad_enabled and not self.stop_gradient:
-            def fn(v):
-                return [v[idx]]
-            _, vjp_fn = jax.vjp(lambda v: fn(v)[0], self.value)
-            node = GradNode("getitem", lambda cts: vjp_fn(cts[0]), [self],
-                            [t])
+        if _state.grad_enabled and not self.stop_gradient and \
+                jnp.issubdtype(self.value.dtype, jnp.floating):
+            _, vjp_fn = jax.vjp(lambda v: v[idx], self.value)
+            # vjp_fn(ct) returns a tuple of per-input grads; run_backward
+            # expects vjp_fn(cts)[0] to be a list parallel to node.inputs
+            node = GradNode("getitem",
+                            lambda cts, _f=vjp_fn: (list(_f(cts[0])),),
+                            [self], [t])
             t._node = node
             t.stop_gradient = False
         return t
@@ -324,11 +339,40 @@ def run_op(op_type: str, ins: Dict[str, List[Any]], attrs: Dict[str, Any],
                 for slot, vals in outs.items()}
 
 
+def apply_fn(fn, *tensors):
+    """Apply a raw-jax function to Tensors with tape recording: fn takes
+    raw arrays and returns a list of raw arrays. The escape hatch for
+    composite kernels (attention cores, Pallas calls) that are not single
+    registry ops — the analog of the reference's custom-op path
+    (framework/load_op_lib.h) with jax.vjp supplying the gradient."""
+    ts = [t if isinstance(t, Tensor) else Tensor(t) for t in tensors]
+    diff_idx = [i for i, t in enumerate(ts)
+                if _state.grad_enabled and not t.stop_gradient and
+                jnp.issubdtype(t.value.dtype, jnp.floating)]
+    vals = [t.value for t in ts]
+    if diff_idx:
+        def wrapped(diff_vals):
+            local = list(vals)
+            for i, v in zip(diff_idx, diff_vals):
+                local[i] = v
+            return fn(*local)
+
+        flat, vjp_fn = jax.vjp(wrapped, [vals[i] for i in diff_idx])
+        outs = [Tensor(v, stop_gradient=False) for v in flat]
+        node = GradNode("apply_fn", vjp_fn, [ts[i] for i in diff_idx], outs)
+        for t in outs:
+            t._node = node
+        return outs
+    return [Tensor(v, stop_gradient=True) for v in fn(*vals)]
+
+
 def _cast_node(src: Tensor, dst: Tensor, dtype):
     if src.stop_gradient or not _state.grad_enabled:
         return None
     _, vjp_fn = jax.vjp(lambda v: [v.astype(dtype)], src.value)
-    return GradNode("cast", lambda cts: vjp_fn(cts), [src], [dst])
+    # contract: vjp_fn(cts)[0] must be a list parallel to node.inputs
+    return GradNode("cast", lambda cts, _f=vjp_fn: (list(_f(cts)),),
+                    [src], [dst])
 
 
 def run_backward(loss: Tensor, grad=None, retain_graph: bool = False):
@@ -361,17 +405,14 @@ def run_backward(loss: Tensor, grad=None, retain_graph: bool = False):
     cot[id(loss)] = g0
 
     # pending counts: how many downstream nodes feed each node
-    pending: Dict[int, int] = {id(n): 0 for n in nodes}
-    consumers: Dict[int, List[GradNode]] = {}
+    deps: Dict[int, int] = {id(n): 0 for n in nodes}
     for n in nodes:
         for t in n.inputs:
             if t._node is not None:
-                pending[id(t._node)] += 1
-                consumers.setdefault(id(t._node), []).append(n)
+                deps[id(t._node)] += 1
 
     # process in reverse topological order
     order: List[GradNode] = []
-    deps = dict(pending)
     frontier = [n for n in nodes if deps[id(n)] == 0]
     while frontier:
         n = frontier.pop()

@@ -1,0 +1,272 @@
+"""jit: compile eager Layers / functions into single XLA computations.
+
+Analog of the reference's dygraph->static bridge
+(/root/reference/python/paddble — dygraph/jit.py TracedLayer and
+dygraph_to_static/program_translator.py:680). Where the reference re-traces
+Python into a ProgramDesc via AST transforms, the TPU-native design uses
+functional capture: Layer parameters/buffers are temporarily re-bound to
+traced values and the eager ops execute inside a jax trace — the natural
+define-by-run -> compiled path on XLA.
+
+`functional_call` is the core primitive; `to_static` wraps inference;
+`TrainStep` fuses forward+backward+optimizer into ONE donated-state jitted
+step — the throughput path used by hapi Model.fit, bench.py and the
+distributed trainers (reference analog: the whole
+ParallelExecutor/SSA-graph machinery of framework/details/).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.registry import REGISTRY, LowerCtx
+from .dygraph import tape
+from .dygraph.tape import Tensor
+from .nn.layer import Layer
+
+
+def _named_state(layer: Layer):
+    """Unique (by object identity) parameter/buffer maps. Weight tying
+    (e.g. BERT MLM decoder sharing the embedding matrix) yields the same
+    Tensor under several names; keeping one canonical name per object
+    avoids donating the same buffer twice and double-counting grads —
+    setting the canonical entry updates every alias since they are the
+    same Tensor object."""
+    named, buffers = {}, {}
+    seen = set()
+    for n, t in layer.named_parameters():
+        if id(t) not in seen:
+            seen.add(id(t))
+            named[n] = t
+    for n, t in layer.named_buffers():
+        if id(t) not in seen:
+            seen.add(id(t))
+            buffers[n] = t
+    return named, buffers
+
+
+def functional_call(layer: Layer, state: Dict[str, Any], *args,
+                    training: bool = False, rng=None, **kwargs):
+    """Run layer.forward with parameters/buffers taken from `state`
+    (name -> array), returning (outputs, new_state). Pure: layer tensors
+    are restored afterwards, so it is safe to call under jax tracing."""
+    params, buffers = _named_state(layer)
+    everything = {**params, **buffers}
+    old_vals = {n: t.value for n, t in everything.items()}
+    old_training = layer.training
+    old_is_test = tape._state.is_test
+    old_key = tape._state.key
+    if rng is not None:
+        tape._state.key = rng
+    if training:
+        layer.train()
+    else:
+        layer.eval()
+    try:
+        for n, t in everything.items():
+            if n in state:
+                t.value = state[n]
+        with tape.no_grad():
+            out = layer(*args, **kwargs)
+        new_state = {n: t.value for n, t in everything.items()}
+    finally:
+        for n, t in everything.items():
+            t.value = old_vals[n]
+        layer.training = old_training
+        tape._state.is_test = old_is_test
+        tape._state.key = old_key
+    out_vals = jax.tree.map(
+        lambda x: x.value if isinstance(x, Tensor) else x, out,
+        is_leaf=lambda x: isinstance(x, Tensor))
+    return out_vals, new_state
+
+
+def state_of(layer: Layer) -> Dict[str, Any]:
+    params, buffers = _named_state(layer)
+    return {n: t.value for n, t in {**params, **buffers}.items()}
+
+
+def load_state(layer: Layer, state: Dict[str, Any]):
+    params, buffers = _named_state(layer)
+    for n, t in {**params, **buffers}.items():
+        if n in state:
+            t.value = state[n]
+
+
+def to_static(layer_or_fn, example_inputs=None, donate_state: bool = False):
+    """Compile a Layer's forward (inference) or a plain fn into one jitted
+    XLA computation — TracedLayer analog (dygraph/jit.py)."""
+    if isinstance(layer_or_fn, Layer):
+        layer = layer_or_fn
+
+        @jax.jit
+        def fwd(state, *args):
+            out, _ = functional_call(layer, state, *map(_wrap, args))
+            return out
+
+        def run(*args):
+            return fwd(state_of(layer), *[_unwrap(a) for a in args])
+
+        run._jitted = fwd
+        return run
+    return jax.jit(layer_or_fn)
+
+
+def _wrap(x):
+    return Tensor(x) if not isinstance(x, Tensor) else x
+
+
+def _unwrap(x):
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class TrainStep:
+    """One fused forward+backward+update XLA computation with donated
+    parameter/optimizer state.
+
+    Replaces the reference's per-op executor + allreduce-op-handle pipeline
+    (framework/details/) for the throughput path. Optimizer updates reuse
+    the optimizer op lowerings (ops/optimizers.py) applied functionally.
+
+    loss_fn(outputs, *labels) -> scalar Tensor-valued loss computed with
+    framework ops (it runs under the capture, so eager ops trace in).
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer,
+                 mesh=None, batch_spec=None, param_rules=None,
+                 grad_accum_steps: int = 1, amp_dtype: Optional[str] = None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.param_rules = param_rules
+        self.grad_accum_steps = grad_accum_steps
+        self.amp_dtype = amp_dtype
+        self._step_fn = None
+        self._opt_state: Dict[str, Any] = {}
+        self._rng = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        params, buffers = _named_state(model)
+        self.param_names = list(params)
+        self.buffer_names = list(buffers)
+
+    # -- functional optimizer update over the op lowerings ---------------
+    def _opt_update(self, params, grads, opt_state, lr_step):
+        op_type, attrs, accums = self.optimizer._eager_spec()
+        opdef = REGISTRY.get(op_type)
+        from .optimizer.lr_scheduler import LRScheduler
+        if isinstance(self.optimizer._learning_rate, LRScheduler):
+            lr = REGISTRY.get("lr_schedule").lower(
+                LowerCtx(), {"Step": [lr_step]},
+                self.optimizer._learning_rate._attrs())["Out"][0]
+        else:
+            lr = jnp.asarray(float(self.optimizer._learning_rate),
+                             jnp.float32)
+        pgs = list(params.items())
+        gs = [grads[n] for n, _ in pgs]
+        if self.optimizer.grad_clip is not None:
+            clipped = self.optimizer.grad_clip.eager_apply(
+                list(zip([p for _, p in pgs], gs)))
+            gs = [g for _, g in clipped]
+        new_params, new_opt = {}, {}
+        for (name, p), g in zip(pgs, gs):
+            if self.optimizer.regularization is not None:
+                g = self.optimizer.regularization.eager_apply(p, g)
+            st = opt_state.get(name, {})
+            ins = {"Param": [p], "Grad": [g.astype(p.dtype)],
+                   "LearningRate": [lr]}
+            nst = {}
+            for in_slot, out_slot, key, fill, is_scalar in accums:
+                cur = st.get(key)
+                if cur is None:
+                    cur = (jnp.asarray(fill, jnp.float32) if is_scalar
+                           else jnp.full_like(p, fill))
+                ins[in_slot] = [cur]
+            outs = opdef.lower(LowerCtx(), ins, attrs)
+            new_params[name] = outs["ParamOut"][0]
+            for in_slot, out_slot, key, fill, is_scalar in accums:
+                nst[key] = outs.get(out_slot, [ins[in_slot][0]])[0]
+            new_opt[name] = nst
+        return new_params, new_opt
+
+    def _build(self, donate: bool = True):
+        model, loss_fn = self.model, self.loss_fn
+
+        def step(state, opt_state, lr_step, rng, batch):
+            inputs, labels = batch
+            params = {n: state[n] for n in self.param_names}
+            consts = {n: state[n] for n in self.buffer_names}
+
+            def loss_of(p):
+                full = {**consts, **p}
+                if self.amp_dtype is not None:
+                    old_amp = tape._state.amp_dtype
+                    tape._state.amp_dtype = self.amp_dtype
+                r1, r2 = jax.random.split(rng)
+                try:
+                    out, new_state = functional_call(
+                        model, full, *[Tensor(x) for x in inputs],
+                        training=True, rng=r1)
+                finally:
+                    if self.amp_dtype is not None:
+                        tape._state.amp_dtype = old_amp
+                # loss ops under an explicit rng scope so traced keys never
+                # leak into the global eager chain; no_grad because
+                # jax.grad differentiates
+                with tape.rng_scope(r2), tape.no_grad():
+                    loss_t = loss_fn(
+                        *(out if isinstance(out, (tuple, list))
+                          else (out,)),
+                        *[Tensor(x) for x in labels])
+                loss_v = loss_t.value if isinstance(loss_t, Tensor) \
+                    else loss_t
+                new_buf = {n: new_state[n] for n in self.buffer_names}
+                return loss_v.astype(jnp.float32), new_buf
+
+            (loss, new_buf), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            new_params, new_opt = self._opt_update(params, grads, opt_state,
+                                                  lr_step)
+            new_state = {**new_buf, **new_params}
+            return loss, new_state, new_opt, lr_step + 1
+
+        in_shardings = None
+        jit_kwargs = {}
+        if donate:
+            jit_kwargs["donate_argnums"] = (0, 1)
+        return jax.jit(step, **jit_kwargs)
+
+    def __call__(self, inputs, labels):
+        if self._step_fn is None:
+            self._step_fn = self._build()
+            self._state = state_of(self.model)
+            if self.mesh is not None and self.param_rules is not None:
+                # annotate parameter shardings (tp/dp layout); GSPMD
+                # propagates activation shardings + inserts collectives
+                from jax.sharding import NamedSharding
+                self._state = {
+                    n: jax.device_put(v, NamedSharding(
+                        self.mesh, self.param_rules(n, tuple(v.shape))))
+                    for n, v in self._state.items()}
+            self._lr_step = jnp.zeros((), jnp.int32)
+        inputs = tuple(_unwrap(x) for x in (
+            inputs if isinstance(inputs, (tuple, list)) else (inputs,)))
+        labels = tuple(_unwrap(x) for x in (
+            labels if isinstance(labels, (tuple, list)) else (labels,)))
+        if self.mesh is not None:
+            from .parallel.env import shard_batch
+            inputs = shard_batch(inputs)
+            labels = shard_batch(labels)
+        self._rng, sub = jax.random.split(self._rng)
+        loss, self._state, self._opt_state, self._lr_step = self._step_fn(
+            self._state, self._opt_state, self._lr_step, sub,
+            (inputs, labels))
+        return loss
+
+    def sync_model(self):
+        """Write compiled-state back into the Layer's Tensors (for eval /
+        checkpointing after fit)."""
+        load_state(self.model, self._state)

@@ -1,0 +1,8 @@
+from .lenet import LeNet  # noqa: F401
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa: F401
+                     resnet152)
+from .bert import (BertConfig, BertForPretraining,  # noqa: F401
+                   BertForSequenceClassification, BertModel,
+                   bert_base_config, bert_large_config, ernie_large_config,
+                   pretraining_loss)
+from .wide_deep import WideDeep  # noqa: F401

@@ -1,0 +1,189 @@
+"""BERT — BASELINE config 3 (BERT-base pretrain, fused attention +
+layer_norm) and config 5 (ERNIE-large finetune ≈ same architecture with a
+task head; ERNIE differs from BERT in pretraining data/masking, not
+architecture).
+
+Parity model for the reference's ERNIE/BERT path: the fused attention op
+(/root/reference/paddle/fluid/operators/fused/multihead_matmul_op.cu) and
+fused_embedding_eltwise_layernorm (operators/fused/) correspond here to the
+Pallas flash-attention kernel + XLA-fused embedding-sum-layernorm.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from .. import nn
+from ..layers.helper import Normal
+from ..nn import functional as F
+from ..nn.transformer import TransformerEncoder, TransformerEncoderLayer
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+
+
+def bert_base_config() -> BertConfig:
+    return BertConfig()
+
+
+def bert_large_config() -> BertConfig:
+    return BertConfig(hidden_size=1024, num_hidden_layers=24,
+                      num_attention_heads=16, intermediate_size=4096)
+
+
+ernie_large_config = bert_large_config
+
+
+class BertEmbeddings(nn.Layer):
+    """word + position + token-type embeddings + LN + dropout (the
+    reference fuses these as fused_embedding_eltwise_layernorm; XLA fuses
+    the adds+LN here)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        from ..layers.helper import ParamAttr
+        init = ParamAttr(initializer=Normal(0.0, cfg.initializer_range))
+        self.word_embeddings = nn.Embedding(
+            cfg.vocab_size, cfg.hidden_size, weight_attr=init)
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size, weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(
+            cfg.type_vocab_size, cfg.hidden_size, weight_attr=init)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size, epsilon=1e-12)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+        self.max_pos = cfg.max_position_embeddings
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        import jax.numpy as jnp
+        from ..dygraph.tape import Tensor
+        seq = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(
+                jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32),
+                                 tuple(input_ids.shape)))
+        if token_type_ids is None:
+            token_type_ids = Tensor(
+                jnp.zeros(tuple(input_ids.shape), jnp.int32))
+        emb = (self.word_embeddings(input_ids) +
+               self.position_embeddings(position_ids) +
+               self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, hidden):
+        first = hidden[:, 0]
+        return F.tanh(self.dense(first))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg: Optional[BertConfig] = None):
+        super().__init__()
+        self.cfg = cfg = cfg or bert_base_config()
+        self.embeddings = BertEmbeddings(cfg)
+        self.encoder = TransformerEncoder(
+            lambda: TransformerEncoderLayer(
+                cfg.hidden_size, cfg.num_attention_heads,
+                cfg.intermediate_size, cfg.hidden_dropout_prob,
+                cfg.hidden_act,
+                attn_dropout=cfg.attention_probs_dropout_prob),
+            cfg.num_hidden_layers)
+        self.pooler = BertPooler(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        import jax.numpy as jnp
+        from ..dygraph.tape import Tensor
+        mask = None
+        if attention_mask is not None:
+            m = attention_mask.value if isinstance(attention_mask, Tensor) \
+                else attention_mask
+            # [B, S] 1/0 -> additive [B, 1, 1, S]
+            mask = Tensor((1.0 - m.astype(jnp.float32))[:, None, None, :]
+                          * jnp.finfo(jnp.float32).min)
+        emb = self.embeddings(input_ids, token_type_ids)
+        encoded = self.encoder(emb, mask)
+        pooled = self.pooler(encoded)
+        return encoded, pooled
+
+
+class BertLMHead(nn.Layer):
+    """MLM head with weight tying to the word embeddings."""
+
+    def __init__(self, cfg: BertConfig, embedding_weights):
+        super().__init__()
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size, epsilon=1e-12)
+        self.act = cfg.hidden_act
+        self.decoder_weight = embedding_weights  # tied
+        self.decoder_bias = self.create_parameter(
+            [cfg.vocab_size], is_bias=True)
+        self.add_parameter("decoder_bias", self.decoder_bias)
+
+    def forward(self, hidden):
+        from ..dygraph import tape
+        h = self.layer_norm(getattr(F, self.act)(self.transform(hidden)))
+        logits = tape.run_op(
+            "matmul", {"X": [h], "Y": [self.decoder_weight]},
+            {"transpose_Y": True})["Out"][0]
+        return logits + self.decoder_bias
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP pretraining heads (config 3)."""
+
+    def __init__(self, cfg: Optional[BertConfig] = None):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        cfg = self.bert.cfg
+        self.cls = BertLMHead(cfg, self.bert.embeddings.word_embeddings
+                              .weight)
+        self.nsp = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        encoded, pooled = self.bert(input_ids, token_type_ids,
+                                    attention_mask)
+        return self.cls(encoded), self.nsp(pooled)
+
+
+def pretraining_loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels):
+    """masked-LM loss (ignore_index=-100 for unmasked) + NSP loss."""
+    mlm = F.cross_entropy(mlm_logits, mlm_labels, ignore_index=-100,
+                          reduction="mean")
+    nsp = F.cross_entropy(nsp_logits, nsp_labels, reduction="mean")
+    return mlm + nsp
+
+
+class BertForSequenceClassification(nn.Layer):
+    """Finetune head — ERNIE-large finetune path (config 5)."""
+
+    def __init__(self, cfg: Optional[BertConfig] = None,
+                 num_classes: int = 2, dropout: Optional[float] = None):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        cfg = self.bert.cfg
+        self.dropout = nn.Dropout(
+            cfg.hidden_dropout_prob if dropout is None else dropout)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))

@@ -1,0 +1,23 @@
+"""LeNet-5 for MNIST — BASELINE config 1 (MNIST LeNet).
+
+Mirrors the reference book example
+(/root/reference/python/paddle/fluid/tests/book/test_recognize_digits.py
+conv_net) in the v2 Layer API.
+"""
+from .. import nn
+
+
+class LeNet(nn.Layer):
+    def __init__(self, num_classes: int = 10):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 5, padding=2), nn.ReLU(), nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5), nn.ReLU(), nn.MaxPool2D(2, 2))
+        self.fc = nn.Sequential(
+            nn.Flatten(),
+            nn.Linear(16 * 5 * 5, 120), nn.ReLU(),
+            nn.Linear(120, 84), nn.ReLU(),
+            nn.Linear(84, num_classes))
+
+    def forward(self, x):
+        return self.fc(self.features(x))

@@ -1,0 +1,116 @@
+"""ResNet family — BASELINE config 2 (ResNet-50 ImageNet, Fleet DP).
+
+Parity model for the reference's vision zoo
+(/root/reference/python/paddle/vision via hapi and the fluid image
+classification book test). NCHW layout; bottleneck design matches the
+standard ResNet-v1.5 (stride in the 3x3) used by the reference benchmarks.
+"""
+from __future__ import annotations
+
+from .. import nn
+
+
+class BottleneckBlock(nn.Layer):
+    expansion = 4
+
+    def __init__(self, in_ch: int, ch: int, stride: int = 1,
+                 downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2D(in_ch, ch, 1, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(ch)
+        self.conv2 = nn.Conv2D(ch, ch, 3, stride=stride, padding=1,
+                               bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(ch)
+        self.conv3 = nn.Conv2D(ch, ch * self.expansion, 1, bias_attr=False)
+        self.bn3 = nn.BatchNorm2D(ch * self.expansion)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class BasicBlock(nn.Layer):
+    expansion = 1
+
+    def __init__(self, in_ch: int, ch: int, stride: int = 1,
+                 downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2D(in_ch, ch, 3, stride=stride, padding=1,
+                               bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(ch)
+        self.conv2 = nn.Conv2D(ch, ch, 3, padding=1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(ch)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class ResNet(nn.Layer):
+    def __init__(self, block, layers, num_classes: int = 1000,
+                 in_channels: int = 3):
+        super().__init__()
+        self.in_ch = 64
+        self.conv1 = nn.Conv2D(in_channels, 64, 7, stride=2, padding=3,
+                               bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(64)
+        self.relu = nn.ReLU()
+        self.maxpool = nn.MaxPool2D(3, 2, padding=1)
+        self.layer1 = self._make_layer(block, 64, layers[0])
+        self.layer2 = self._make_layer(block, 128, layers[1], 2)
+        self.layer3 = self._make_layer(block, 256, layers[2], 2)
+        self.layer4 = self._make_layer(block, 512, layers[3], 2)
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.flatten = nn.Flatten()
+        self.fc = nn.Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, ch, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.in_ch != ch * block.expansion:
+            downsample = nn.Sequential(
+                nn.Conv2D(self.in_ch, ch * block.expansion, 1,
+                          stride=stride, bias_attr=False),
+                nn.BatchNorm2D(ch * block.expansion))
+        layers = [block(self.in_ch, ch, stride, downsample)]
+        self.in_ch = ch * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.in_ch, ch))
+        return nn.Sequential(*layers)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        return self.fc(self.flatten(self.avgpool(x)))
+
+
+def resnet18(**kw):
+    return ResNet(BasicBlock, [2, 2, 2, 2], **kw)
+
+
+def resnet34(**kw):
+    return ResNet(BasicBlock, [3, 4, 6, 3], **kw)
+
+
+def resnet50(**kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], **kw)
+
+
+def resnet101(**kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], **kw)
+
+
+def resnet152(**kw):
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], **kw)

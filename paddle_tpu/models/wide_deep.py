@@ -1,0 +1,59 @@
+"""Wide&Deep CTR model — BASELINE config 4 (distributed embedding PS /
+GeoSGD).
+
+Parity model for the reference's CTR path (dist_fleet_ctr.py test models and
+the MultiSlotDataFeed slot format, /root/reference/paddle/fluid/framework/
+data_feed.cc:734). Sparse slots go through embedding tables that shard over
+the mesh (parallel/embedding.py DistributedEmbedding) the way the reference
+shards them over parameter servers (operators/distributed_ops/
+distributed_lookup_table_op.cc).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .. import nn
+from ..nn import functional as F
+
+
+class WideDeep(nn.Layer):
+    def __init__(self, sparse_feature_number: int = 100000,
+                 sparse_feature_dim: int = 16,
+                 dense_feature_dim: int = 13,
+                 num_sparse_slots: int = 26,
+                 fc_sizes: Optional[List[int]] = None,
+                 distributed_embedding=None):
+        super().__init__()
+        fc_sizes = fc_sizes or [400, 400, 400]
+        self.num_sparse_slots = num_sparse_slots
+        if distributed_embedding is not None:
+            self.embedding = distributed_embedding
+        else:
+            self.embedding = nn.Embedding(sparse_feature_number,
+                                          sparse_feature_dim)
+        # wide part: linear over dense features
+        self.wide = nn.Linear(dense_feature_dim, 1)
+        # deep part: MLP over [dense ; concat(sparse embeddings)]
+        layers = []
+        in_dim = dense_feature_dim + num_sparse_slots * sparse_feature_dim
+        for size in fc_sizes:
+            layers += [nn.Linear(in_dim, size), nn.ReLU()]
+            in_dim = size
+        layers.append(nn.Linear(in_dim, 1))
+        self.deep = nn.Sequential(*layers)
+
+    def forward(self, sparse_ids, dense_features):
+        """sparse_ids: int [B, num_slots]; dense_features: [B, dense_dim]."""
+        emb = self.embedding(sparse_ids)  # [B, slots, dim]
+        b = emb.shape[0]
+        emb_flat = emb.reshape([b, -1])
+        from ..dygraph import tape
+        deep_in = tape.run_op(
+            "concat", {"X": [dense_features, emb_flat]},
+            {"axis": 1})["Out"][0]
+        logit = self.wide(dense_features) + self.deep(deep_in)
+        return logit
+
+    def loss(self, logit, label):
+        return F.binary_cross_entropy_with_logits(
+            logit, label, reduction="mean")

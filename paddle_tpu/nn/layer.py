@@ -45,6 +45,9 @@ class Layer:
         val = REGISTRY.get(init["type"]).lower(ctx, {}, init["attrs"])["Out"][0]
         t = Tensor(val, stop_gradient=not attr.trainable,
                    name=attr.name, trainable=attr.trainable)
+        t.is_param = True  # __setattr__ registers by this flag, so frozen
+        # (trainable=False) parameters still land in state_dict like the
+        # reference's Parameter class
         return t
 
     def add_parameter(self, name: str, param: Optional[Tensor]):
@@ -62,7 +65,7 @@ class Layer:
         return value
 
     def __setattr__(self, name, value):
-        if isinstance(value, Tensor) and value.trainable:
+        if isinstance(value, Tensor) and getattr(value, "is_param", False):
             self.__dict__.setdefault("_parameters", OrderedDict())
             self._parameters[name] = value
             object.__setattr__(self, name, value)

@@ -1,0 +1,169 @@
+"""Transformer layers: MultiHeadAttention, encoder/decoder stacks.
+
+Analog of /root/reference/python/paddle/nn/layer/transformer.py
+(MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder) and of
+the reference's fused attention op
+(/root/reference/paddle/fluid/operators/fused/multihead_matmul_op.cu).
+The attention core routes to the Pallas flash-attention kernel
+(paddle_tpu/kernels/flash_attention.py) on TPU when enabled; otherwise a
+composed einsum path that XLA fuses.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from ..core.program import in_dygraph_mode
+from ..dygraph import tape
+from ..dygraph.tape import Tensor
+from . import functional as F
+from .layer import Layer, LayerList
+from .layers_lib import Dropout, LayerNorm, Linear
+
+_USE_FLASH = True
+
+
+def set_flash_attention(enabled: bool):
+    global _USE_FLASH
+    _USE_FLASH = enabled
+
+
+def _attention_core(q, k, v, attn_mask, dropout_p, training, is_causal=False):
+    """q,k,v: [B, H, S, D] raw jax arrays -> [B, H, S, D]."""
+    import jax
+    import jax.numpy as jnp
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    if _USE_FLASH and jax.default_backend() == "tpu" and \
+            q.shape[-2] >= 128 and q.shape[-1] in (64, 128, 256):
+        try:
+            from ..kernels.flash_attention import flash_attention
+            return flash_attention(q, k, v, bias=attn_mask, causal=is_causal,
+                                   sm_scale=scale)
+        except Exception:
+            pass  # fall through to the composed path
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if attn_mask is not None:
+        scores = scores + attn_mask
+    if is_causal:
+        s = scores.shape[-1]
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_p and training:
+        key = tape._state.next_key()
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+class MultiHeadAttention(Layer):
+    """paddle.nn.MultiHeadAttention analog (transformer.py:88)."""
+
+    def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
+                 kdim: Optional[int] = None, vdim: Optional[int] = None,
+                 need_weights: bool = False, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        assert self.head_dim * num_heads == embed_dim
+        self.dropout = dropout
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self.k_proj = Linear(kdim or embed_dim, embed_dim, weight_attr,
+                             bias_attr)
+        self.v_proj = Linear(vdim or embed_dim, embed_dim, weight_attr,
+                             bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                is_causal: bool = False):
+        import jax.numpy as jnp
+        key = query if key is None else key
+        value = query if value is None else value
+        q = self.q_proj(query)
+        k = self.k_proj(key)
+        v = self.v_proj(value)
+
+        qv, kv, vv = q.value, k.value, v.value
+        b, sq, _ = qv.shape
+        sk = kv.shape[1]
+        h, d = self.num_heads, self.head_dim
+
+        def split(x, s):
+            return jnp.transpose(x.reshape(b, s, h, d), (0, 2, 1, 3))
+
+        mask_v = None
+        if attn_mask is not None:
+            mask_v = attn_mask.value if isinstance(attn_mask, Tensor) \
+                else attn_mask
+
+        def core(qx, kx, vx):
+            out = _attention_core(split(qx, sq), split(kx, sk),
+                                  split(vx, sk), mask_v, self.dropout,
+                                  self.training, is_causal)
+            return [jnp.transpose(out, (0, 2, 1, 3)).reshape(
+                b, sq, self.embed_dim)]
+
+        out = tape.apply_fn(core, q, k, v)[0]
+        return self.out_proj(out)
+
+
+class TransformerEncoderLayer(Layer):
+    """paddle.nn.TransformerEncoderLayer analog (transformer.py:585)."""
+
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
+                 dropout: float = 0.1, activation: str = "gelu",
+                 attn_dropout: Optional[float] = None,
+                 act_dropout: Optional[float] = None,
+                 normalize_before: bool = False):
+        super().__init__()
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead,
+            dropout if attn_dropout is None else attn_dropout)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.dropout = Dropout(dropout)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(
+            dropout if act_dropout is None else act_dropout)
+        self.activation = activation
+        self.normalize_before = normalize_before
+
+    def forward(self, src, src_mask=None):
+        residual = src
+        if self.normalize_before:
+            src = self.norm1(src)
+        src = self.self_attn(src, attn_mask=src_mask)
+        src = residual + self.dropout1(src)
+        if not self.normalize_before:
+            src = self.norm1(src)
+        residual = src
+        if self.normalize_before:
+            src = self.norm2(src)
+        act = getattr(F, self.activation)
+        src = self.linear2(self.dropout2(act(self.linear1(src))))
+        src = residual + self.dropout(src)
+        if not self.normalize_before:
+            src = self.norm2(src)
+        return src
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer_fn, num_layers: int, norm=None):
+        super().__init__()
+        self.layers = LayerList([encoder_layer_fn()
+                                 for _ in range(num_layers)])
+        self.norm = norm
+        if norm is not None:
+            self.add_sublayer("norm", norm)
+
+    def forward(self, src, src_mask=None):
+        out = src
+        for layer in self.layers:
+            out = layer(out, src_mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return out

@@ -236,6 +236,10 @@ class Optimizer:
         if not isinstance(loss, VarDesc):
             if self._parameter_list is None and parameter_list is not None:
                 self._parameter_list = list(parameter_list)
+            if self._parameter_list is None:
+                raise ValueError(
+                    "eager optimizer needs parameters= at construction "
+                    "(or parameter_list= to minimize)")
             if no_grad_set:
                 skip = {id(p) for p in no_grad_set}
                 kept = [p for p in self._parameter_list

@@ -1,0 +1,253 @@
+"""Collective communication API + collective ops.
+
+Parity surface: /root/reference/paddle/fluid/operators/collective/
+(c_allreduce_{sum,max,min,prod}_op.cc, c_broadcast_op.cc, c_allgather_op.cc,
+c_reducescatter_op.cc, c_scatter_op.cc, barrier_op.cc) and the python API
+/root/reference/python/paddle/distributed/collective.py:59-419.
+
+Design: the reference keys comms by ring_id and issues NCCL calls on comm
+streams (c_allreduce_op.h:108-133); here `ring_id` maps to a *named mesh
+axis* and each collective lowers to the XLA collective primitive
+(psum/all_gather/psum_scatter/ppermute) which rides ICI. Outside shard_map
+(no device axis bound), SPMD semantics make the host-level call the
+identity over a replicated value — matching single-rank behavior of the
+reference. The functional API below works in BOTH positions:
+
+- inside shard_map/pjit-manual code: real lax collectives,
+- at host level on sharded jax.Arrays: jit-wrapped collectives via
+  shard_map over the global mesh.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.registry import register_op
+from . import env as _envmod
+
+# ring_id -> axis name registry: the analog of NCCLCommContext's ring table
+# (collective_helper.h:62). Transpilers create rings; here ring 0 is the
+# data axis by default.
+_ring_axes = {0: _envmod.DP_AXIS}
+
+
+def set_ring_axis(ring_id: int, axis: str):
+    _ring_axes[ring_id] = axis
+
+
+def ring_axis(ring_id: int) -> str:
+    return _ring_axes.get(ring_id, _envmod.DP_AXIS)
+
+
+def _in_shard_map(axis: str) -> bool:
+    try:
+        jax.lax.axis_size(axis)
+        return True
+    except (NameError, KeyError, ValueError):
+        return False
+
+
+def _host_collective(fn, x, axis):
+    """Apply a per-shard collective to a host-level array via shard_map."""
+    mesh = _envmod.get_mesh()
+    if mesh is None or axis not in mesh.axis_names or \
+            mesh.shape[axis] == 1:
+        return x  # single rank: identity (matches reference nranks==1)
+    spec = P(*([axis] + [None] * (jnp.ndim(x) - 1)))
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=spec,
+                                 out_specs=spec))(x)
+
+
+_REDUCERS = {
+    "sum": jax.lax.psum,
+    "max": jax.lax.pmax,
+    "min": jax.lax.pmin,
+    "prod": lambda x, axis_name: jnp.exp(
+        jax.lax.psum(jnp.log(x), axis_name)),
+}
+
+
+def all_reduce(x, op: str = "sum", axis: Optional[str] = None,
+               ring_id: int = 0):
+    """c_allreduce_{sum,max,min,prod} analog."""
+    axis = axis or ring_axis(ring_id)
+    red = _REDUCERS[op]
+    if _in_shard_map(axis):
+        return red(x, axis)
+
+    def f(shard):
+        r = red(shard, axis)
+        # host-level semantic: every shard becomes the reduction → out
+        # sharding stays the same but all shards equal; express as
+        # reduce + broadcast by returning replicated-value shards
+        return r
+    val = x.value if hasattr(x, "value") else x
+    out = _host_collective(f, val, axis)
+    return _rewrap(x, out)
+
+
+def all_gather(x, axis: Optional[str] = None, ring_id: int = 0,
+               tensor_axis: int = 0):
+    """c_allgather analog: concat shards along tensor_axis."""
+    axis = axis or ring_axis(ring_id)
+    if _in_shard_map(axis):
+        return jax.lax.all_gather(x, axis, axis=tensor_axis, tiled=True)
+    mesh = _envmod.get_mesh()
+    val = x.value if hasattr(x, "value") else x
+    if mesh is None or axis not in mesh.axis_names:
+        return x
+    spec_in = P(*([axis] + [None] * (jnp.ndim(val) - 1)))
+    spec_out = P(*([None] * jnp.ndim(val)))
+
+    def f(shard):
+        return jax.lax.all_gather(shard, axis, axis=tensor_axis, tiled=True)
+    out = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=spec_in,
+                                out_specs=spec_out, check_vma=False))(val)
+    return _rewrap(x, out)
+
+
+def reduce_scatter(x, axis: Optional[str] = None, ring_id: int = 0,
+                   tensor_axis: int = 0):
+    """c_reducescatter analog."""
+    axis = axis or ring_axis(ring_id)
+    if _in_shard_map(axis):
+        return jax.lax.psum_scatter(x, axis, scatter_dimension=tensor_axis,
+                                    tiled=True)
+    raise NotImplementedError(
+        "host-level reduce_scatter: shard the array and use pjit shardings")
+
+
+def broadcast(x, src: int = 0, axis: Optional[str] = None, ring_id: int = 0):
+    """c_broadcast analog: everyone takes rank `src`'s shard."""
+    axis = axis or ring_axis(ring_id)
+    if _in_shard_map(axis):
+        n = jax.lax.axis_size(axis)
+        return jax.lax.ppermute(x, axis, [(src, i) for i in range(n)])
+    val = x.value if hasattr(x, "value") else x
+
+    def f(shard):
+        n = jax.lax.axis_size(axis)
+        return jax.lax.ppermute(shard, axis, [(src, i) for i in range(n)])
+    out = _host_collective(f, val, axis)
+    return _rewrap(x, out)
+
+
+def all_to_all(x, axis: Optional[str] = None, ring_id: int = 0,
+               split_axis: int = 0, concat_axis: int = 0):
+    """alltoall analog (distributed/collective.py:376) — the primitive for
+    sequence-parallel attention (DeepSpeed-Ulysses style) and sharded
+    embedding exchange."""
+    axis = axis or ring_axis(ring_id)
+    if _in_shard_map(axis):
+        return jax.lax.all_to_all(x, axis, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
+    raise NotImplementedError("host-level all_to_all: use inside shard_map")
+
+
+def ppermute(x, perm, axis: Optional[str] = None, ring_id: int = 0):
+    """send/recv pair analog for pipeline stage boundaries."""
+    axis = axis or ring_axis(ring_id)
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def barrier(ring_id: int = 0):
+    """barrier op analog — a no-op at host level: the single-controller
+    dispatch plus XLA program order already serialize; kept for API
+    parity."""
+    return None
+
+
+def _rewrap(x, out):
+    if hasattr(x, "value"):
+        from ..dygraph.tape import Tensor
+        return Tensor(out, stop_gradient=getattr(x, "stop_gradient", True))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# collective *ops* for static programs: the transpiler inserts these; the
+# executor lowers them. Inside a sharded executor (CompiledProgram with a
+# mesh) they become real collectives; single-device they are identity,
+# mirroring the reference's nranks==1 fast path.
+# ---------------------------------------------------------------------------
+def _c_allreduce(kind):
+    def lower(ctx, ins, attrs):
+        x = ins["X"][0]
+        axis = attrs.get("axis") or ring_axis(attrs.get("ring_id", 0))
+        if _in_shard_map(axis):
+            return {"Out": [_REDUCERS[kind](x, axis)]}
+        return {"Out": [x]}
+    return lower
+
+
+for _k in ("sum", "max", "min", "prod"):
+    register_op(f"c_allreduce_{_k}", inputs=("X",), no_grad=True)(
+        _c_allreduce(_k))
+
+
+@register_op("c_broadcast", inputs=("X",), no_grad=True)
+def _c_broadcast(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis") or ring_axis(attrs.get("ring_id", 0))
+    root = attrs.get("root", 0)
+    if _in_shard_map(axis):
+        n = jax.lax.axis_size(axis)
+        return {"Out": [jax.lax.ppermute(
+            x, axis, [(root, i) for i in range(n)])]}
+    return {"Out": [x]}
+
+
+@register_op("c_allgather", inputs=("X",), no_grad=True)
+def _c_allgather(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis") or ring_axis(attrs.get("ring_id", 0))
+    if _in_shard_map(axis):
+        return {"Out": [jax.lax.all_gather(x, axis, axis=0, tiled=True)]}
+    return {"Out": [x]}
+
+
+@register_op("c_reducescatter", inputs=("X",), no_grad=True)
+def _c_reducescatter(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis") or ring_axis(attrs.get("ring_id", 0))
+    if _in_shard_map(axis):
+        return {"Out": [jax.lax.psum_scatter(x, axis, scatter_dimension=0,
+                                             tiled=True)]}
+    return {"Out": [x]}
+
+
+@register_op("c_sync_calc_stream", inputs=("X",), no_grad=True)
+def _c_sync_calc(ctx, ins, attrs):
+    # stream sync is moot under XLA scheduling (SURVEY.md §5 mapping)
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("c_sync_comm_stream", inputs=("X",), no_grad=True)
+def _c_sync_comm(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("barrier", inputs=("X",), no_grad=True)
+def _barrier_op(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("c_comm_init_all", inputs=(), outputs=(), no_grad=True)
+def _c_comm_init_all(ctx, ins, attrs):
+    # comm bootstrap collapses into mesh construction (SURVEY.md §2.7);
+    # the op is accepted for program compatibility and does nothing.
+    return {}
+
+
+@register_op("c_gen_nccl_id", inputs=(), outputs=(), no_grad=True)
+def _c_gen_nccl_id(ctx, ins, attrs):
+    return {}
+
+
+@register_op("c_comm_init", inputs=(), outputs=(), no_grad=True)
+def _c_comm_init(ctx, ins, attrs):
+    return {}

@@ -1,0 +1,139 @@
+"""Distributed environment: device mesh + rank/world info.
+
+TPU-native replacement for the reference's communicator bootstrap stack
+(/root/reference/paddle/fluid/platform/collective_helper.h:50 NCCLComm /
+NCCLCommContext keyed by ring_id; imperative/nccl_context.cc TCP ncclUniqueId
+exchange; python/paddle/distributed/parallel.py:32 init_parallel_env).
+On TPU the NCCL-ring machinery collapses into a jax.sharding.Mesh over the
+ICI topology: `ring_id` becomes a named mesh axis, comm bootstrap becomes
+mesh construction, and XLA inserts/schedules the collectives.
+
+Axes (left open for every parallelism family the framework supports):
+  dp — data parallel          mp — tensor/model parallel
+  pp — pipeline stages        sp — sequence/context parallel
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DP_AXIS = "dp"
+MP_AXIS = "mp"
+PP_AXIS = "pp"
+SP_AXIS = "sp"
+ALL_AXES = (DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS)
+
+
+class DistEnv:
+    """Global parallel environment (ParallelEnv analog,
+    dygraph/parallel.py:96)."""
+
+    def __init__(self, mesh: Optional[Mesh] = None):
+        self.mesh = mesh
+
+    @property
+    def nranks(self) -> int:
+        return self.mesh.size if self.mesh is not None else 1
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def rank(self) -> int:
+        # single-controller SPMD: the host drives all devices; per-device
+        # rank only exists inside shard_map'ped code (lax.axis_index)
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+    @property
+    def local_rank(self) -> int:
+        return self.rank
+
+    def axis_size(self, axis: str) -> int:
+        if self.mesh is None or axis not in self.mesh.axis_names:
+            return 1
+        return self.mesh.shape[axis]
+
+
+_env = DistEnv()
+
+
+def init_parallel_env(mesh_shape: Optional[Dict[str, int]] = None,
+                      devices: Optional[Sequence] = None) -> DistEnv:
+    """Create the global mesh (paddle.distributed.init_parallel_env analog,
+    parallel.py:32 — there: gen ncclUniqueId + init comm rings; here: build
+    a Mesh over the PjRt device list; XLA owns the rings).
+
+    mesh_shape maps axis name -> extent; unspecified axes get extent 1.
+    Default: all devices on the data axis.
+    """
+    global _env
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if mesh_shape is None:
+        mesh_shape = {DP_AXIS: n}
+    axes = [a for a in ALL_AXES if mesh_shape.get(a, 1) > 1] or [DP_AXIS]
+    extents = [mesh_shape.get(a, 1) for a in axes]
+    total = int(np.prod(extents))
+    if total != n:
+        # grow the data axis to cover all devices, but only when the user
+        # did not pin it explicitly — a pinned dp that doesn't fit is an
+        # error, never silently resized
+        dp_pinned = mesh_shape.get(DP_AXIS) is not None
+        others = total // (mesh_shape.get(DP_AXIS) or 1)
+        if DP_AXIS in axes and not dp_pinned and n % others == 0:
+            extents[axes.index(DP_AXIS)] = n // others
+        elif not dp_pinned and DP_AXIS not in axes and n % total == 0:
+            axes.insert(0, DP_AXIS)
+            extents.insert(0, n // total)
+        else:
+            raise ValueError(
+                f"mesh shape {mesh_shape} does not cover {n} devices "
+                f"(product {total})")
+    dev_array = np.array(devices).reshape(extents)
+    _env.mesh = Mesh(dev_array, tuple(axes))
+    return _env
+
+
+def get_env() -> DistEnv:
+    return _env
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _env.mesh
+
+
+def get_world_size() -> int:
+    return _env.nranks
+
+
+def get_rank() -> int:
+    return _env.rank
+
+
+def sharding(*spec) -> NamedSharding:
+    """NamedSharding over the global mesh with the given PartitionSpec
+    entries, e.g. sharding('dp', None) for batch-sharded 2-D data."""
+    if _env.mesh is None:
+        raise RuntimeError("init_parallel_env() first")
+    return NamedSharding(_env.mesh, PartitionSpec(*spec))
+
+
+def shard_batch(batch, axis: str = DP_AXIS):
+    """Device-put a host batch sharded along its leading dim — the analog of
+    the reference feeding per-device scopes
+    (framework/parallel_executor.cc BCast/feed split)."""
+    if _env.mesh is None or _env.axis_size(axis) == 1:
+        return jax.device_put(batch)
+    sh = sharding(axis)
+
+    def put(x):
+        ndim = np.ndim(x)
+        spec = PartitionSpec(*([axis] + [None] * (ndim - 1)))
+        return jax.device_put(x, NamedSharding(_env.mesh, spec))
+
+    return jax.tree.map(put, batch)

@@ -128,3 +128,42 @@ def test_all_optimizers_step(opt_name):
     opt.step()
     assert not np.allclose(model.weight.numpy(), before)
     assert np.all(np.isfinite(model.weight.numpy()))
+
+
+def test_getitem_gradient():
+    # regression: indexing grad must be full-shaped with scatter semantics
+    x = to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4),
+                  stop_gradient=False)
+    y = x[1]
+    s = pt.dygraph.run_op("reduce_sum", {"X": [y]},
+                          {"reduce_all": True})["Out"][0]
+    s.backward()
+    expect = np.zeros((3, 4), np.float32)
+    expect[1] = 1.0
+    np.testing.assert_allclose(x.gradient, expect)
+
+
+def test_amp_autocast_gradients():
+    # regression: cast-node grads must be full-shaped
+    from paddle_tpu.dygraph import tape
+    w = to_tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+    x = to_tensor(np.full((4, 2), 2.0, np.float32))
+    tape._state.amp_dtype = "bfloat16"
+    try:
+        y = pt.dygraph.run_op("matmul", {"X": [x], "Y": [w]}, {})["Out"][0]
+        assert y.dtype == "bfloat16"
+        s = pt.dygraph.run_op("reduce_sum", {"X": [y]},
+                              {"reduce_all": True})["Out"][0]
+        s.backward()
+    finally:
+        tape._state.amp_dtype = None
+    assert w.grad.shape == (2, 3)
+    np.testing.assert_allclose(w.gradient, np.full((2, 3), 8.0), rtol=1e-2)
+
+
+def test_frozen_param_in_state_dict():
+    from paddle_tpu.layers.helper import ParamAttr
+    lin = nn.Linear(2, 2, weight_attr=ParamAttr(trainable=False))
+    names = [n for n, _ in lin.named_parameters()]
+    assert "weight" in names and "bias" in names
+    assert "weight" in lin.state_dict()
