@@ -1,0 +1,199 @@
+"""Per-op test harness: numpy oracle + numeric finite-difference grad check.
+
+TPU-native analog of the reference's OpTest workhorse
+(/root/reference/python/paddle/fluid/tests/unittests/op_test.py:170 —
+check_output:948 runs the op through a tiny scope+executor and compares to
+numpy-computed expected outputs; check_grad:1236 compares analytic gradients
+against numeric gradients from get_numeric_gradient:57).
+
+Differences from the reference, by design:
+  - the op runs through the XLA-jitted block executor instead of a C++
+    scope interpreter — which is exactly the production path here;
+  - the numeric gradient is of the scalar L = sum(out * W) for a fixed
+    random weighting W (mathematically the same contract: it checks the
+    vector-Jacobian product the analytic path computes);
+  - no place/layout sweep — XLA owns layout; dtype sweep is the caller's
+    choice of input dtypes.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.core.backward import gradients
+from paddle_tpu.core.program import VarDesc
+
+__all__ = ["OpTest"]
+
+
+def _norm_slot(value):
+    """Normalize a slot value to [(var_name, ndarray), ...]."""
+    if isinstance(value, (list, tuple)):
+        return [(str(n), np.asarray(a)) for n, a in value]
+    return None  # single-var slot; name assigned by caller
+
+
+class OpTest:
+    """Declarative single-op test.
+
+    >>> t = OpTest("elementwise_add", inputs={"X": x, "Y": y},
+    ...            outputs={"Out": x + y})
+    >>> t.check_output()
+    >>> t.check_grad(["X", "Y"])
+    """
+
+    def __init__(self, op_type: str,
+                 inputs: Optional[Dict] = None,
+                 outputs: Optional[Dict] = None,
+                 attrs: Optional[Dict] = None):
+        self.op_type = op_type
+        self.attrs = dict(attrs or {})
+        # slot -> [(name, array)]
+        self.inputs: Dict[str, List] = {}
+        for slot, v in (inputs or {}).items():
+            multi = _norm_slot(v)
+            if multi is None:
+                multi = [(f"{op_type}_{slot.lower()}", np.asarray(v))]
+            self.inputs[slot] = multi
+        self.outputs: Dict[str, List] = {}
+        for slot, v in (outputs or {}).items():
+            multi = _norm_slot(v)
+            if multi is None:
+                multi = [(f"{op_type}_{slot.lower()}_out", np.asarray(v))]
+            self.outputs[slot] = multi
+
+    # ------------------------------------------------------------------
+    def _build(self):
+        """Fresh (program, scope, executor, feed, out_vars-by-slot)."""
+        main = pt.Program()
+        startup = pt.Program()
+        feed = {}
+        in_map, out_map = {}, {}
+        with pt.program_guard(main, startup):
+            block = main.global_block
+            for slot, vars_ in self.inputs.items():
+                names = []
+                for name, arr in vars_:
+                    block.create_var(name, shape=arr.shape,
+                                     dtype=str(arr.dtype),
+                                     stop_gradient=False)
+                    feed[name] = arr
+                    names.append(name)
+                in_map[slot] = names
+            for slot, vars_ in self.outputs.items():
+                names = []
+                for name, arr in vars_:
+                    block.create_var(name, shape=arr.shape,
+                                     dtype=str(arr.dtype),
+                                     stop_gradient=False)
+                    names.append(name)
+                out_map[slot] = names
+            block.append_op(self.op_type, inputs=in_map, outputs=out_map,
+                            attrs=self.attrs)
+        return main, startup, feed, out_map
+
+    # ------------------------------------------------------------------
+    def check_output(self, atol: float = 1e-5, rtol: float = 1e-4):
+        main, startup, feed, out_map = self._build()
+        exe = pt.Executor()
+        scope = pt.Scope()
+        fetch, expect = [], []
+        for slot, vars_ in self.outputs.items():
+            for name, arr in vars_:
+                fetch.append(name)
+                expect.append(arr)
+        with pt.scope_guard(scope):
+            got = exe.run(main, feed=feed, fetch_list=fetch)
+        for name, e, g in zip(fetch, expect, got):
+            g = np.asarray(g)
+            assert g.shape == tuple(e.shape), (
+                f"{self.op_type}/{name}: shape {g.shape} != {e.shape}")
+            np.testing.assert_allclose(
+                g, e, atol=atol, rtol=rtol,
+                err_msg=f"{self.op_type} output {name!r} mismatch")
+
+    # ------------------------------------------------------------------
+    def check_grad(self, inputs_to_check: Sequence[str],
+                   output_slot: str = "Out",
+                   max_relative_error: float = 5e-3,
+                   numeric_delta: float = 5e-3,
+                   atol: float = 1e-4,
+                   seed: int = 7):
+        """Compare analytic d(sum(out*W))/dx against central differences.
+
+        inputs_to_check: slot names; every var in the slot is checked.
+        Only float inputs can be checked.
+        """
+        rng = np.random.RandomState(seed)
+        out_vars = self.outputs[output_slot]
+        weights = {name: rng.uniform(0.5, 1.5, arr.shape).astype(np.float32)
+                   for name, arr in out_vars}
+
+        check_names = []
+        for slot in inputs_to_check:
+            for name, arr in self.inputs[slot]:
+                if not np.issubdtype(arr.dtype, np.floating):
+                    raise ValueError(f"cannot grad-check non-float {name}")
+                check_names.append(name)
+
+        # --- analytic ---------------------------------------------------
+        main, startup, feed, out_map = self._build()
+        with pt.program_guard(main, startup):
+            block = main.global_block
+            layers = pt.layers
+            terms = []
+            for name, arr in out_vars:
+                wname = "gradw_" + name
+                block.create_var(wname, shape=arr.shape, dtype="float32",
+                                 stop_gradient=True)
+                feed[wname] = weights[name]
+                prod = layers.elementwise_mul(block.var(name),
+                                              block.var(wname))
+                terms.append(layers.reduce_sum(prod))
+            loss = terms[0] if len(terms) == 1 else layers.sums(terms)
+            grad_vars = gradients(loss, [block.var(n) for n in check_names],
+                                  program=main)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            analytic = exe.run(main, feed=feed,
+                               fetch_list=[g.name for g in grad_vars])
+
+        # --- numeric ----------------------------------------------------
+        fmain, fstartup, ffeed, _ = self._build()
+        fexe = pt.Executor()
+        fscope = pt.Scope()
+        fetch_outs = [name for name, _ in out_vars]
+
+        def loss_of(feed_dict):
+            with pt.scope_guard(fscope):
+                outs = fexe.run(fmain, feed=feed_dict,
+                                fetch_list=fetch_outs)
+            return sum(float(np.sum(np.asarray(o) * weights[n]))
+                       for n, o in zip(fetch_outs, outs))
+
+        for name, g_analytic in zip(check_names, analytic):
+            base = ffeed[name]
+            num = np.zeros_like(base, dtype=np.float64).ravel()
+            flat = base.ravel()
+            for i in range(flat.size):
+                orig = flat[i]
+                flat[i] = orig + numeric_delta
+                lp = loss_of(ffeed)
+                flat[i] = orig - numeric_delta
+                lm = loss_of(ffeed)
+                flat[i] = orig
+                num[i] = (lp - lm) / (2.0 * numeric_delta)
+            num = num.reshape(base.shape)
+            g_analytic = np.asarray(g_analytic, dtype=np.float64)
+            denom = np.maximum(np.maximum(np.abs(num),
+                                          np.abs(g_analytic)), 1e-3)
+            rel = np.abs(num - g_analytic) / denom
+            bad = rel > max_relative_error
+            close = np.abs(num - g_analytic) < atol
+            bad &= ~close
+            assert not bad.any(), (
+                f"{self.op_type} grad wrt {name}: max rel err "
+                f"{rel.max():.4g} (numeric {num.ravel()[rel.argmax()]:.5g} "
+                f"vs analytic {g_analytic.ravel()[rel.argmax()]:.5g})")

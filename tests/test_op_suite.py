@@ -1,0 +1,375 @@
+"""OpTest-based per-op suite: numpy oracle + numeric gradient checks.
+
+Mirrors the reference's per-op unittest pattern
+(/root/reference/python/paddle/fluid/tests/unittests/test_elementwise_add_op.py
+and friends): tiny inputs, numpy-computed expected outputs, finite-difference
+gradient comparison.
+"""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _rand(*shape, seed=0, lo=-1.0, hi=1.0):
+    rng = np.random.RandomState(seed)
+    return rng.uniform(lo, hi, shape).astype(np.float32)
+
+
+# --------------------------------------------------------------------------
+# elementwise
+# --------------------------------------------------------------------------
+@pytest.mark.parametrize("op,fn", [
+    ("elementwise_add", np.add),
+    ("elementwise_sub", np.subtract),
+    ("elementwise_mul", np.multiply),
+    ("elementwise_max", np.maximum),
+    ("elementwise_min", np.minimum),
+])
+def test_elementwise(op, fn):
+    x, y = _rand(3, 4, seed=1), _rand(3, 4, seed=2) + 2.0
+    t = OpTest(op, {"X": x, "Y": y}, {"Out": fn(x, y)})
+    t.check_output()
+    t.check_grad(["X", "Y"], max_relative_error=2e-2)
+
+
+def test_elementwise_div():
+    x, y = _rand(3, 4, seed=3), _rand(3, 4, seed=4, lo=1.0, hi=2.0)
+    t = OpTest("elementwise_div", {"X": x, "Y": y}, {"Out": x / y})
+    t.check_output()
+    t.check_grad(["X", "Y"], max_relative_error=2e-2)
+
+
+def test_elementwise_broadcast():
+    x, y = _rand(2, 3, 4, seed=5), _rand(3, 4, seed=6)
+    OpTest("elementwise_add", {"X": x, "Y": y},
+           {"Out": x + y}).check_output()
+    # axis-style broadcast: y shaped (3,) against axis=1
+    y1 = _rand(3, seed=7)
+    OpTest("elementwise_add", {"X": x, "Y": y1}, attrs={"axis": 1},
+           outputs={"Out": x + y1[None, :, None]}).check_output()
+
+
+# --------------------------------------------------------------------------
+# matmul family
+# --------------------------------------------------------------------------
+def test_matmul():
+    x, y = _rand(3, 5, seed=8), _rand(5, 4, seed=9)
+    t = OpTest("matmul", {"X": x, "Y": y}, {"Out": x @ y})
+    t.check_output()
+    t.check_grad(["X", "Y"], max_relative_error=2e-2)
+
+
+def test_matmul_transpose():
+    x, y = _rand(5, 3, seed=10), _rand(4, 5, seed=11)
+    OpTest("matmul", {"X": x, "Y": y},
+           attrs={"transpose_X": True, "transpose_Y": True},
+           outputs={"Out": x.T @ y.T}).check_output()
+
+
+def test_matmul_batched():
+    x, y = _rand(2, 3, 5, seed=12), _rand(2, 5, 4, seed=13)
+    OpTest("matmul", {"X": x, "Y": y},
+           outputs={"Out": np.matmul(x, y)}).check_output()
+
+
+def test_mul():
+    x, y = _rand(3, 5, seed=14), _rand(5, 4, seed=15)
+    t = OpTest("mul", {"X": x, "Y": y}, {"Out": x @ y})
+    t.check_output()
+    t.check_grad(["X", "Y"], max_relative_error=2e-2)
+
+
+# --------------------------------------------------------------------------
+# activations
+# --------------------------------------------------------------------------
+@pytest.mark.parametrize("op,fn,grad", [
+    ("relu", lambda x: np.maximum(x, 0), True),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x)), True),
+    ("tanh", np.tanh, True),
+    ("exp", np.exp, True),
+    ("square", np.square, True),
+    ("softplus", lambda x: np.log1p(np.exp(x)), True),
+    ("abs", np.abs, False),
+    ("floor", np.floor, False),
+    ("ceil", np.ceil, False),
+    ("reciprocal", lambda x: 1.0 / x, True),
+])
+def test_activation(op, fn, grad):
+    # keep away from non-differentiable points
+    x = _rand(3, 4, seed=16, lo=0.2, hi=1.5)
+    t = OpTest(op, {"X": x}, {"Out": fn(x)})
+    t.check_output(atol=1e-5)
+    if grad:
+        t.check_grad(["X"], max_relative_error=2e-2)
+
+
+def test_leaky_relu():
+    x = _rand(3, 4, seed=17, lo=0.3, hi=1.0)
+    x[0] = -x[0]
+    alpha = 0.1
+    t = OpTest("leaky_relu", {"X": x}, {"Out": np.where(x > 0, x, alpha * x)},
+               attrs={"alpha": alpha})
+    t.check_output()
+    t.check_grad(["X"], max_relative_error=2e-2)
+
+
+def test_gelu():
+    import math
+    x = _rand(3, 4, seed=18)
+    expect = np.array([[0.5 * v * (1 + math.erf(v / math.sqrt(2)))
+                        for v in row] for row in x], dtype=np.float32)
+    t = OpTest("gelu", {"X": x}, {"Out": expect})
+    t.check_output(atol=1e-4)
+    t.check_grad(["X"], max_relative_error=2e-2)
+
+
+# --------------------------------------------------------------------------
+# softmax / losses
+# --------------------------------------------------------------------------
+def _np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def test_softmax():
+    x = _rand(3, 5, seed=19)
+    t = OpTest("softmax", {"X": x}, {"Out": _np_softmax(x)})
+    t.check_output()
+    t.check_grad(["X"], max_relative_error=2e-2)
+
+
+def test_log_softmax():
+    x = _rand(3, 5, seed=20)
+    t = OpTest("log_softmax", {"X": x}, {"Out": np.log(_np_softmax(x))})
+    t.check_output()
+    t.check_grad(["X"], max_relative_error=2e-2)
+
+
+def test_softmax_with_cross_entropy():
+    logits = _rand(4, 6, seed=21)
+    label = np.array([[1], [0], [5], [2]], dtype=np.int64)
+    sm = _np_softmax(logits)
+    loss = -np.log(np.take_along_axis(sm, label.astype(np.int64), 1))
+    t = OpTest("softmax_with_cross_entropy",
+               {"Logits": logits, "Label": label},
+               {"Softmax": sm, "Loss": loss.astype(np.float32)})
+    t.check_output(atol=1e-5)
+    t.check_grad(["Logits"], output_slot="Loss", max_relative_error=2e-2)
+
+
+def test_cross_entropy():
+    probs = _np_softmax(_rand(4, 6, seed=22)).astype(np.float32)
+    label = np.array([[1], [0], [5], [2]], dtype=np.int64)
+    loss = -np.log(np.take_along_axis(probs, label, 1))
+    t = OpTest("cross_entropy", {"X": probs, "Label": label},
+               {"Y": loss.astype(np.float32)})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X"], output_slot="Y", max_relative_error=2e-2)
+
+
+def test_mse_and_huber():
+    x, y = _rand(4, 3, seed=23), _rand(4, 3, seed=24)
+    OpTest("square_error_cost", {"X": x, "Y": y},
+           {"Out": (x - y) ** 2}).check_output()
+    delta = 1.0
+    r = y - x
+    huber = np.where(np.abs(r) <= delta, 0.5 * r * r,
+                     delta * (np.abs(r) - 0.5 * delta)).astype(np.float32)
+    OpTest("huber_loss", {"X": x, "Y": y}, {"Out": huber},
+           attrs={"delta": delta}).check_output()
+
+
+# --------------------------------------------------------------------------
+# reductions
+# --------------------------------------------------------------------------
+@pytest.mark.parametrize("op,fn", [
+    ("reduce_sum", np.sum), ("reduce_mean", np.mean),
+    ("reduce_max", np.max), ("reduce_min", np.min),
+    ("reduce_prod", np.prod),
+])
+def test_reduce_all_dims(op, fn):
+    x = _rand(3, 4, seed=25, lo=0.5, hi=1.5)
+    OpTest(op, {"X": x}, {"Out": np.asarray(fn(x), np.float32)},
+           attrs={"reduce_all": True}).check_output()
+
+
+def test_reduce_dim_keepdim():
+    x = _rand(3, 4, 5, seed=26)
+    OpTest("reduce_sum", {"X": x},
+           {"Out": x.sum(axis=(1,))}, attrs={"dim": [1]}).check_output()
+    OpTest("reduce_mean", {"X": x},
+           {"Out": x.mean(axis=2, keepdims=True)},
+           attrs={"dim": [2], "keep_dim": True}).check_output()
+    t = OpTest("reduce_sum", {"X": x}, {"Out": x.sum(axis=1)},
+               attrs={"dim": [1]})
+    t.check_grad(["X"], max_relative_error=2e-2)
+
+
+def test_logsumexp():
+    x = _rand(3, 4, seed=27)
+    expect = np.log(np.exp(x).sum()).astype(np.float32)
+    OpTest("logsumexp", {"X": x}, {"Out": expect},
+           attrs={"reduce_all": True}).check_output()
+
+
+# --------------------------------------------------------------------------
+# tensor manipulation
+# --------------------------------------------------------------------------
+def test_concat_and_grad():
+    xs = [("a", _rand(2, 3, seed=28)), ("b", _rand(2, 2, seed=29)),
+          ("c", _rand(2, 4, seed=30))]
+    expect = np.concatenate([a for _, a in xs], axis=1)
+    t = OpTest("concat", {"X": xs}, {"Out": expect}, attrs={"axis": 1})
+    t.check_output()
+    t.check_grad(["X"], max_relative_error=2e-2)
+
+
+def test_split():
+    x = _rand(2, 9, seed=31)
+    outs = np.split(x, [3, 6], axis=1)
+    OpTest("split", {"X": x},
+           {"Out": [("s0", outs[0]), ("s1", outs[1]), ("s2", outs[2])]},
+           attrs={"sections": [3, 3, 3], "axis": 1}).check_output()
+
+
+def test_transpose_reshape():
+    x = _rand(2, 3, 4, seed=32)
+    OpTest("transpose2", {"X": x}, {"Out": x.transpose(2, 0, 1)},
+           attrs={"axis": [2, 0, 1]}).check_output()
+    OpTest("reshape2", {"X": x}, {"Out": x.reshape(6, 4)},
+           attrs={"shape": [6, 4]}).check_output()
+    OpTest("reshape2", {"X": x}, {"Out": x.reshape(2, 12)},
+           attrs={"shape": [0, -1]}).check_output()
+
+
+def test_stack_gather_scatter():
+    a, b = _rand(3, 4, seed=33), _rand(3, 4, seed=34)
+    OpTest("stack", {"X": [("a", a), ("b", b)]},
+           {"Y": np.stack([a, b], 1)}, attrs={"axis": 1}).check_output()
+
+    x = _rand(5, 3, seed=35)
+    idx = np.array([0, 2, 4], dtype=np.int64)
+    t = OpTest("gather", {"X": x, "Index": idx}, {"Out": x[idx]})
+    t.check_output()
+    t.check_grad(["X"], max_relative_error=2e-2)
+
+    upd = _rand(2, 3, seed=36)
+    ids = np.array([1, 3], dtype=np.int64)
+    expect = x.copy()
+    expect[ids] = upd
+    OpTest("scatter", {"X": x, "Ids": ids, "Updates": upd},
+           {"Out": expect}, attrs={"overwrite": True}).check_output()
+
+
+def test_slice_pad_tile():
+    x = _rand(3, 4, 5, seed=37)
+    OpTest("slice", {"Input": x}, {"Out": x[1:3, :, 2:4]},
+           attrs={"axes": [0, 2], "starts": [1, 2],
+                  "ends": [3, 4]}).check_output()
+    OpTest("pad", {"X": _rand(2, 3, seed=38)},
+           {"Out": np.pad(_rand(2, 3, seed=38), [(1, 0), (0, 2)])},
+           attrs={"paddings": [1, 0, 0, 2]}).check_output()
+    x2 = _rand(2, 3, seed=39)
+    OpTest("tile", {"X": x2}, {"Out": np.tile(x2, (2, 1))},
+           attrs={"repeat_times": [2, 1]}).check_output()
+
+
+def test_cast_clip_cumsum_sign():
+    x = _rand(3, 4, seed=40)
+    OpTest("cast", {"X": x}, {"Out": x.astype(np.int32)},
+           attrs={"out_dtype": "int32"}).check_output()
+    OpTest("clip", {"X": x}, {"Out": np.clip(x, -0.3, 0.3)},
+           attrs={"min": -0.3, "max": 0.3}).check_output()
+    OpTest("cumsum", {"X": x}, {"Out": np.cumsum(x, axis=1)},
+           attrs={"axis": 1}).check_output()
+    OpTest("sign", {"X": x}, {"Out": np.sign(x)}).check_output()
+
+
+def test_where_onehot_topk():
+    x, y = _rand(3, 4, seed=41), _rand(3, 4, seed=42)
+    cond = x > y
+    OpTest("where", {"Condition": cond, "X": x, "Y": y},
+           {"Out": np.where(cond, x, y)}).check_output()
+
+    ids = np.array([[1], [3], [0]], dtype=np.int64)
+    oh = np.zeros((3, 5), np.float32)
+    oh[np.arange(3), ids[:, 0]] = 1
+    OpTest("one_hot_v2", {"X": ids[:, 0]}, {"Out": oh},
+           attrs={"depth": 5}).check_output()
+
+    x = np.array([[0.1, 0.9, 0.5], [0.7, 0.2, 0.8]], np.float32)
+    OpTest("top_k_v2", {"X": x},
+           {"Out": np.array([[0.9, 0.5], [0.8, 0.7]], np.float32),
+            "Indices": np.array([[1, 2], [2, 0]], np.int64)},
+           attrs={"k": 2}).check_output()
+
+
+def test_lookup_table():
+    w = _rand(10, 4, seed=43)
+    ids = np.array([[1], [7], [3]], dtype=np.int64)
+    OpTest("lookup_table_v2", {"W": w, "Ids": ids[:, 0]},
+           {"Out": w[ids[:, 0]]}).check_output()
+
+
+# --------------------------------------------------------------------------
+# NN ops
+# --------------------------------------------------------------------------
+def test_layer_norm():
+    x = _rand(3, 6, seed=44)
+    scale = _rand(6, seed=45, lo=0.5, hi=1.5)
+    bias = _rand(6, seed=46)
+    mean = x.mean(1, keepdims=True)
+    var = x.var(1, keepdims=True)
+    y = (x - mean) / np.sqrt(var + 1e-5) * scale + bias
+    t = OpTest("layer_norm", {"X": x, "Scale": scale, "Bias": bias},
+               {"Y": y.astype(np.float32)},
+               attrs={"begin_norm_axis": 1, "epsilon": 1e-5})
+    t.check_output(atol=1e-4)
+    t.check_grad(["X", "Scale", "Bias"], output_slot="Y",
+                 max_relative_error=3e-2)
+
+
+def test_conv2d():
+    x = _rand(1, 2, 5, 5, seed=47)
+    w = _rand(3, 2, 3, 3, seed=48)
+    import jax.lax as lax  # oracle via lax on numpy (independent path ok)
+    # plain numpy conv oracle
+    out = np.zeros((1, 3, 3, 3), np.float32)
+    for oc in range(3):
+        for i in range(3):
+            for j in range(3):
+                out[0, oc, i, j] = np.sum(x[0, :, i:i+3, j:j+3] * w[oc])
+    t = OpTest("conv2d", {"Input": x, "Filter": w}, {"Output": out},
+               attrs={"strides": [1, 1], "paddings": [0, 0],
+                      "dilations": [1, 1], "groups": 1})
+    t.check_output(atol=1e-4)
+    t.check_grad(["Input", "Filter"], output_slot="Output",
+                 max_relative_error=3e-2)
+
+
+def test_pool2d():
+    x = _rand(1, 2, 4, 4, seed=49)
+    mx = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    t = OpTest("pool2d", {"X": x}, {"Out": mx},
+               attrs={"pooling_type": "max", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]})
+    t.check_output()
+    av = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+    t2 = OpTest("pool2d", {"X": x}, {"Out": av},
+                attrs={"pooling_type": "avg", "ksize": [2, 2],
+                       "strides": [2, 2], "paddings": [0, 0]})
+    t2.check_output()
+    t2.check_grad(["X"], max_relative_error=2e-2)
+
+
+def test_dropout_test_mode():
+    x = _rand(4, 4, seed=50)
+    # default impl is downgrade_in_infer: test-time out = x * (1-p)
+    # (reference operators/dropout_op.h semantics)
+    OpTest("dropout", {"X": x}, {"Out": x * 0.5},
+           attrs={"dropout_prob": 0.5, "is_test": True}).check_output()
+    OpTest("dropout", {"X": x}, {"Out": x},
+           attrs={"dropout_prob": 0.5, "is_test": True,
+                  "dropout_implementation": "upscale_in_train"}
+           ).check_output()
