@@ -1,0 +1,97 @@
+"""SelectedRows: row-sparse tensor for embedding gradients.
+
+TPU-native analog of the reference's SelectedRows
+(/root/reference/paddle/fluid/framework/selected_rows.h:32 — a `rows`
+index vector plus a `value` tensor whose i-th row is the data for logical
+row rows[i], within a dense `height`). The reference emits these from
+`lookup_table` grads when is_sparse=True (operators/lookup_table_op.cc:82)
+and gives optimizers sparse overloads.
+
+Here SelectedRows is a jax pytree (rows + values are traced arrays, height
+is static), so it flows through jit. `merged()` combines duplicate rows
+with a static output shape (jnp.unique(size=n) + segment_sum) — the XLA
+answer to the reference's scatter-merge in merge_selected_rows
+(operators/math/selected_rows_functor.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SelectedRows"]
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = jnp.asarray(rows, jnp.int32)
+        self.values = jnp.asarray(values)
+        self.height = int(height)
+
+    # --- pytree protocol ------------------------------------------------
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        rows, values = children
+        obj = object.__new__(cls)
+        obj.rows = rows
+        obj.values = values
+        obj.height = height
+        return obj
+
+    # --- conversions ----------------------------------------------------
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def to_dense(self) -> jnp.ndarray:
+        """Scatter-add into the dense [height, ...] tensor. Out-of-range
+        rows (used as drop markers) are dropped by XLA scatter mode."""
+        dense = jnp.zeros(self.shape, self.values.dtype)
+        return dense.at[self.rows].add(self.values, mode="drop")
+
+    def merged(self) -> "SelectedRows":
+        """Combine duplicate rows (summing values); same static length,
+        vacated slots get row index = height (a drop marker)."""
+        n = self.rows.shape[0]
+        uniq, inv = jnp.unique(self.rows, return_inverse=True, size=n,
+                               fill_value=self.height)
+        vals = jax.ops.segment_sum(self.values, inv.reshape(-1),
+                                   num_segments=n)
+        return SelectedRows(uniq, vals, self.height)
+
+    # --- arithmetic (for grad accumulation) -----------------------------
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            assert other.height == self.height
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]), self.height)
+        if other is None:
+            return self
+        # dense + sparse -> dense
+        return self.to_dense() + other
+
+    __radd__ = __add__
+
+    def __mul__(self, scalar):
+        return SelectedRows(self.rows, self.values * scalar, self.height)
+
+    __rmul__ = __mul__
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.to_dense())
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={self.rows.shape[0]}, "
+                f"value_shape={tuple(self.values.shape)})")

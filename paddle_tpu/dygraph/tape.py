@@ -266,6 +266,19 @@ _AMP_WHITE = {"matmul", "matmul_v2", "mul", "conv2d", "depthwise_conv2d",
 def run_op(op_type: str, ins: Dict[str, List[Any]], attrs: Dict[str, Any],
            n_outs: Optional[Dict[str, int]] = None) -> Dict[str, List[Tensor]]:
     """Eager TraceOp (imperative/tracer.cc:48): execute + record grad node."""
+    # sparse embedding: lookup_table with is_sparse=True produces a
+    # SelectedRows gradient for W (reference operators/lookup_table_op.cc:82
+    # — grad var type SELECTED_ROWS; rows+values written by the grad kernel
+    # lookup_table_op.cu:125-138)
+    if attrs.get("is_sparse") and op_type in ("lookup_table",
+                                              "lookup_table_v2"):
+        w = ins.get("W", [None])[0]
+        # SelectedRows cotangents only work for leaf weights — upstream
+        # jax.vjp nodes can't consume them. Non-leaf W falls back to the
+        # dense scatter-add grad.
+        if w is None or not isinstance(w, Tensor) or w._node is None:
+            return _sparse_lookup(op_type, ins, attrs)
+        attrs = dict(attrs, is_sparse=False)
     opdef = REGISTRY.get(op_type)
 
     ins = {slot: [v if isinstance(v, Tensor) else Tensor(v) for v in vals]
@@ -339,6 +352,49 @@ def run_op(op_type: str, ins: Dict[str, List[Any]], attrs: Dict[str, Any],
                 for slot, vals in outs.items()}
 
 
+def _sparse_lookup(op_type, ins, attrs):
+    """Eager sparse embedding: forward = gather; W grad = SelectedRows.
+
+    Mirrors the reference contract where `lookup_table(is_sparse=True)`
+    emits a SELECTED_ROWS grad holding (ids, out_grad) instead of a dense
+    scatter-add (operators/lookup_table_op.cu:125-138); sparse optimizer
+    overloads consume it (optimizer/static_opt.py step()).
+    """
+    from ..core.selected_rows import SelectedRows
+    opdef = REGISTRY.get(op_type)
+    ins = {slot: [v if isinstance(v, Tensor) else Tensor(v) for v in vals]
+           for slot, vals in ins.items() if vals}
+    w, ids = ins["W"][0], ins["Ids"][0]
+    ctx = LowerCtx(_state.next_key(), is_test=_state.is_test)
+    raw = {"W": [w.value], "Ids": [ids.value]}
+    out_val = opdef.lower(ctx, raw, attrs)["Out"][0]
+
+    need_grad = _state.grad_enabled and not w.stop_gradient and \
+        jnp.issubdtype(w.value.dtype, jnp.floating)
+    out = Tensor(out_val, stop_gradient=not need_grad)
+    if need_grad:
+        height = w.value.shape[0]
+        dim = w.value.shape[1]
+        flat_ids = ids.value.astype(jnp.int32)
+        if op_type == "lookup_table" and flat_ids.shape and \
+                flat_ids.shape[-1] == 1:
+            flat_ids = jnp.squeeze(flat_ids, -1)
+        flat_ids = flat_ids.reshape(-1)
+        padding_idx = attrs.get("padding_idx", -1)
+        if padding_idx != -1:
+            pad = padding_idx if padding_idx >= 0 else height + padding_idx
+            # drop marker: out-of-range rows vanish in to_dense (mode=drop)
+            flat_ids = jnp.where(flat_ids == pad, height, flat_ids)
+
+        def vjp_fn(cts, _ids=flat_ids, _h=height, _d=dim):
+            ct = cts[0].reshape(-1, _d)
+            return ([SelectedRows(_ids, ct, _h)],)
+
+        node = GradNode(op_type + "_sparse", vjp_fn, [w], [out])
+        out._node = node
+    return {"Out": [out]}
+
+
 def apply_fn(fn, *tensors):
     """Apply a raw-jax function to Tensors with tape recording: fn takes
     raw arrays and returns a list of raw arrays. The escape hatch for
@@ -373,6 +429,19 @@ def _cast_node(src: Tensor, dst: Tensor, dtype):
     # contract: vjp_fn(cts)[0] must be a list parallel to node.inputs
     return GradNode("cast", lambda cts, _f=vjp_fn: (list(_f(cts)),),
                     [src], [dst])
+
+
+def _accum_grad(old, new):
+    """Grad accumulation across dense and SelectedRows grads (reference
+    imperative/gradient_accumulator.h:43 handles the same mix)."""
+    if old is None:
+        return new
+    from ..core.selected_rows import SelectedRows
+    if isinstance(new, SelectedRows):
+        return new + old  # SelectedRows.__add__ handles SR+SR and SR+dense
+    if isinstance(old, SelectedRows):
+        return old + new
+    return old + new
 
 
 def run_backward(loss: Tensor, grad=None, retain_graph: bool = False):
@@ -439,11 +508,12 @@ def run_backward(loss: Tensor, grad=None, retain_graph: bool = False):
         for t, g in zip(node.inputs, in_grads):
             if t._node is None:
                 # leaf: accumulate into .grad if it wants gradient
+                # (SelectedRows-aware, gradient_accumulator.h:43 analog)
                 if not t.stop_gradient:
-                    t.grad = g if t.grad is None else t.grad + g
+                    t.grad = _accum_grad(t.grad, g)
             else:
                 key = id(t)
-                cot[key] = g if key not in cot else cot[key] + g
+                cot[key] = g if key not in cot else _accum_grad(cot[key], g)
         if not retain_graph:
             node.vjp_fn = None
 

@@ -240,9 +240,13 @@ def dropout(x, p: float = 0.5, training: bool = True,
     return out
 
 
-def embedding(x, weight, padding_idx: Optional[int] = None, name=None):
+def embedding(x, weight, padding_idx: Optional[int] = None,
+              sparse: bool = False, name=None):
+    """paddle.nn.functional.embedding. sparse=True yields a SelectedRows
+    gradient for `weight` in dygraph (reference lookup_table_op.cc:82)."""
     return _run("lookup_table_v2", {"W": [weight], "Ids": [x]},
-                {"padding_idx": -1 if padding_idx is None else padding_idx})
+                {"padding_idx": -1 if padding_idx is None else padding_idx,
+                 "is_sparse": sparse})
 
 
 # --- losses ----------------------------------------------------------------

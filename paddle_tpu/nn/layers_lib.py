@@ -89,12 +89,14 @@ class Embedding(Layer):
                  weight_attr=None, name=None):
         super().__init__()
         self._padding_idx = padding_idx
+        self._sparse = sparse
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=Normal(0.0, 1.0 / math.sqrt(embedding_dim)))
 
     def forward(self, x):
-        return F.embedding(x, self.weight, self._padding_idx)
+        return F.embedding(x, self.weight, self._padding_idx,
+                           sparse=self._sparse)
 
 
 class LayerNorm(Layer):

@@ -17,6 +17,7 @@ from . import (  # noqa: F401
     optimizers,
     random,
     reduce,
+    sequence,
     tensor,
 )
 

@@ -1,0 +1,414 @@
+"""Sequence (LoD) ops on the TPU-native ragged representation.
+
+The reference stores variable-length batches as packed LoDTensors — a
+[total_tokens, ...] tensor plus level-of-detail offsets
+(/root/reference/paddle/fluid/framework/lod_tensor.h:104) — and every
+`sequence_*` op walks those offsets
+(/root/reference/paddle/fluid/operators/sequence_ops/).
+
+XLA needs static shapes, so the TPU-native ragged representation is
+**padded + lengths**: X is [batch, max_time, ...] and the companion
+`SeqLen` input is an int32 [batch] vector of valid lengths (SURVEY.md §5:
+"ragged/variable-length batching ... bucketing/padding policy + masked
+sequence ops"). Every op here masks by SeqLen; when SeqLen is absent all
+`max_time` steps are treated as valid. Gradients flow through the jnp
+lowerings via jax autodiff — padding positions receive zero gradient by
+construction of the masks.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import one
+
+__all__ = []
+
+
+def _lengths(ins, x, time_axis=1):
+    """SeqLen input or all-valid fallback; returns int32 [B]."""
+    if ins.get("SeqLen"):
+        return ins["SeqLen"][0].astype(jnp.int32)
+    return jnp.full((x.shape[0],), x.shape[time_axis], dtype=jnp.int32)
+
+
+def _time_mask(x, lengths, time_axis=1):
+    """bool mask [B, T] broadcastable against x."""
+    T = x.shape[time_axis]
+    mask = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
+    shape = [1] * x.ndim
+    shape[0] = x.shape[0]
+    shape[time_axis] = T
+    return jnp.reshape(mask, shape)
+
+
+# --------------------------------------------------------------------------
+# sequence_mask — takes lengths directly, like the reference
+# (operators/sequence_ops/sequence_mask_op.cc: X is the lengths tensor).
+# --------------------------------------------------------------------------
+@register_op("sequence_mask", inputs=("X", "MaxLenTensor"), outputs=("Y",),
+             no_grad=True)
+def _sequence_mask(ctx, ins, attrs):
+    lengths = ins["X"][0]
+    maxlen = attrs.get("maxlen", -1)
+    if ins.get("MaxLenTensor"):
+        raise NotImplementedError(
+            "dynamic maxlen is not XLA-compatible; pass the maxlen attr")
+    if maxlen is None or maxlen < 0:
+        raise ValueError("sequence_mask on TPU needs a static maxlen attr "
+                         "(dynamic max(lengths) is not jittable)")
+    out_dtype = attrs.get("out_dtype", "int64")
+    mask = (jnp.arange(maxlen, dtype=lengths.dtype)[None, :]
+            < lengths[..., None])
+    from ..core import dtypes
+    return {"Y": [mask.astype(dtypes.to_jax_dtype(out_dtype))]}
+
+
+# --------------------------------------------------------------------------
+# sequence_pool (operators/sequence_ops/sequence_pool_op.cc; pooltypes in
+# operators/math/sequence_pooling.cc: SUM/AVERAGE/SQRT/MAX/LAST/FIRST)
+# --------------------------------------------------------------------------
+@register_op("sequence_pool", inputs=("X", "SeqLen"),
+             outputs=("Out", "MaxIndex"), non_diff_inputs=("SeqLen",))
+def _sequence_pool(ctx, ins, attrs):
+    x = ins["X"][0]
+    lengths = _lengths(ins, x)
+    pooltype = attrs.get("pooltype", "SUM").upper()
+    pad_value = attrs.get("pad_value", 0.0)
+    mask = _time_mask(x, lengths)
+    n = jnp.maximum(lengths, 1).astype(x.dtype)
+    n = jnp.reshape(n, (-1,) + (1,) * (x.ndim - 2))
+
+    if pooltype == "SUM":
+        out = jnp.sum(jnp.where(mask, x, 0), axis=1)
+    elif pooltype == "AVERAGE":
+        out = jnp.sum(jnp.where(mask, x, 0), axis=1) / n
+    elif pooltype == "SQRT":
+        out = jnp.sum(jnp.where(mask, x, 0), axis=1) / jnp.sqrt(n)
+    elif pooltype == "MAX":
+        neg = jnp.asarray(-jnp.inf, x.dtype)
+        out = jnp.max(jnp.where(mask, x, neg), axis=1)
+    elif pooltype == "LAST":
+        idx = jnp.maximum(lengths - 1, 0)
+        out = jnp.take_along_axis(
+            x, jnp.reshape(idx, (-1, 1) + (1,) * (x.ndim - 2)), axis=1)
+        out = jnp.squeeze(out, 1)
+    elif pooltype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {pooltype!r}")
+    # empty sequences produce pad_value (reference sequence_pool_op.h)
+    empty = jnp.reshape(lengths == 0, (-1,) + (1,) * (x.ndim - 2))
+    out = jnp.where(empty, jnp.asarray(pad_value, x.dtype), out)
+
+    if pooltype == "MAX":
+        neg = jnp.asarray(-jnp.inf, x.dtype)
+        midx = jnp.argmax(jnp.where(mask, x, neg), axis=1)
+        return {"Out": [out], "MaxIndex": [midx.astype(jnp.int32)]}
+    return {"Out": [out]}
+
+
+# --------------------------------------------------------------------------
+# sequence_softmax (operators/sequence_ops/sequence_softmax_op.cc):
+# softmax over the valid prefix of each sequence.
+# --------------------------------------------------------------------------
+@register_op("sequence_softmax", inputs=("X", "SeqLen"),
+             non_diff_inputs=("SeqLen",))
+def _sequence_softmax(ctx, ins, attrs):
+    x = ins["X"][0]
+    lengths = _lengths(ins, x)
+    mask = _time_mask(x, lengths)
+    neg = jnp.asarray(-1e30, x.dtype)
+    logits = jnp.where(mask, x, neg)
+    sm = jax.nn.softmax(logits, axis=1)
+    return one(jnp.where(mask, sm, 0))
+
+
+# --------------------------------------------------------------------------
+# sequence_reverse (operators/sequence_ops/sequence_reverse_op.h): reverse
+# each valid prefix; padding stays in place at the tail.
+# --------------------------------------------------------------------------
+@register_op("sequence_reverse", inputs=("X", "SeqLen"), outputs=("Y",),
+             non_diff_inputs=("SeqLen",))
+def _sequence_reverse(ctx, ins, attrs):
+    x = ins["X"][0]
+    lengths = _lengths(ins, x)
+    T = x.shape[1]
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    src = jnp.where(t < lengths[:, None], lengths[:, None] - 1 - t, t)
+    idx = jnp.reshape(src, (x.shape[0], T) + (1,) * (x.ndim - 2))
+    return {"Y": [jnp.take_along_axis(x, idx, axis=1)]}
+
+
+# --------------------------------------------------------------------------
+# sequence_expand / sequence_expand_as
+# (operators/sequence_ops/sequence_expand_op.cc). Padded-native contract:
+# X holds one row per sequence ([B, D] or [B, 1, D]); it is broadcast
+# across the reference sequence's time steps and masked by its lengths.
+# This covers the dominant use (expand per-sequence vector to timesteps);
+# general per-sequence repeat counts are not static-shape representable.
+# --------------------------------------------------------------------------
+@register_op("sequence_expand", inputs=("X", "Y", "SeqLen"),
+             non_diff_inputs=("Y", "SeqLen"))
+def _sequence_expand(ctx, ins, attrs):
+    x = ins["X"][0]
+    ref = ins["Y"][0]
+    if x.ndim == 3 and x.shape[1] == 1:
+        x = jnp.squeeze(x, 1)
+    T = ref.shape[1]
+    lengths = (ins["SeqLen"][0].astype(jnp.int32) if ins.get("SeqLen")
+               else jnp.full((ref.shape[0],), T, dtype=jnp.int32))
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], T) + x.shape[1:])
+    mask = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
+    mask = jnp.reshape(mask, (x.shape[0], T) + (1,) * (x.ndim - 1))
+    return one(jnp.where(mask, out, 0))
+
+
+@register_op("sequence_expand_as", inputs=("X", "Y", "SeqLen"),
+             non_diff_inputs=("Y", "SeqLen"))
+def _sequence_expand_as(ctx, ins, attrs):
+    return _sequence_expand(ctx, ins, attrs)
+
+
+# --------------------------------------------------------------------------
+# sequence_concat (operators/sequence_ops/sequence_concat_op.cc): per-row
+# concatenation along time of the *valid* tokens; output T = sum of input
+# Ts, valid length = sum of lengths, padding compacted to the tail.
+# --------------------------------------------------------------------------
+@register_op("sequence_concat", inputs=("X", "SeqLen"),
+             outputs=("Out", "OutLen"), non_diff_inputs=("SeqLen",))
+def _sequence_concat(ctx, ins, attrs):
+    xs = ins["X"]
+    lens = ins.get("SeqLen") or [
+        jnp.full((x.shape[0],), x.shape[1], dtype=jnp.int32) for x in xs]
+    assert len(lens) == len(xs), "one SeqLen per X input"
+    B = xs[0].shape[0]
+    T_out = sum(x.shape[1] for x in xs)
+    feat = xs[0].shape[2:]
+    out = jnp.zeros((B, T_out) + feat, xs[0].dtype)
+    offset = jnp.zeros((B,), jnp.int32)
+    pos_out = jnp.broadcast_to(jnp.arange(T_out, dtype=jnp.int32), (B, T_out))
+    for x, l in zip(xs, lens):
+        l = l.astype(jnp.int32)
+        T = x.shape[1]
+        t = jnp.arange(T, dtype=jnp.int32)[None, :]
+        valid = t < l[:, None]
+        dest = offset[:, None] + t                      # [B, T]
+        dest = jnp.where(valid, dest, T_out)            # dump padding
+        # one-hot matmul scatter: XLA lowers this to a masked gather and it
+        # stays differentiable; T is small for sequence workloads
+        onehot = (pos_out[:, None, :] == dest[:, :, None])  # [B, T, T_out]
+        contrib = jnp.einsum("bto,bt...->bo...",
+                             onehot.astype(x.dtype),
+                             jnp.where(jnp.reshape(
+                                 valid, valid.shape + (1,) * len(feat)),
+                                 x, 0))
+        out = out + contrib
+        offset = offset + l
+    return {"Out": [out], "OutLen": [offset]}
+
+
+# --------------------------------------------------------------------------
+# sequence_slice (operators/sequence_ops/sequence_slice_op.h): per-sequence
+# [offset, offset+length) window, re-based to t=0.
+# --------------------------------------------------------------------------
+@register_op("sequence_slice", inputs=("X", "Offset", "Length"),
+             non_diff_inputs=("Offset", "Length"))
+def _sequence_slice(ctx, ins, attrs):
+    x = ins["X"][0]
+    off = jnp.reshape(ins["Offset"][0].astype(jnp.int32), (-1,))
+    ln = jnp.reshape(ins["Length"][0].astype(jnp.int32), (-1,))
+    T = x.shape[1]
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    src = jnp.minimum(off[:, None] + t, T - 1)
+    idx = jnp.reshape(src, (x.shape[0], T) + (1,) * (x.ndim - 2))
+    g = jnp.take_along_axis(x, idx, axis=1)
+    mask = jnp.reshape(t < ln[:, None],
+                       (x.shape[0], T) + (1,) * (x.ndim - 2))
+    return one(jnp.where(mask, g, 0))
+
+
+# --------------------------------------------------------------------------
+# sequence_erase (operators/sequence_ops/sequence_erase_op.h): drop tokens
+# whose value is in `tokens`, compact left, zero-pad, emit new lengths.
+# --------------------------------------------------------------------------
+@register_op("sequence_erase", inputs=("X", "SeqLen"),
+             outputs=("Out", "OutLen"), no_grad=True)
+def _sequence_erase(ctx, ins, attrs):
+    x = ins["X"][0]  # int ids [B, T]
+    lengths = _lengths(ins, x)
+    tokens = jnp.asarray(attrs.get("tokens", []), x.dtype)
+    T = x.shape[1]
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    valid = t < lengths[:, None]
+    erased = jnp.isin(x, tokens) & valid
+    keep = valid & ~erased
+    # stable compaction: keys put kept tokens first in original order
+    keys = jnp.where(keep, t, T + t)
+    order = jnp.argsort(keys, axis=1)
+    out = jnp.take_along_axis(x, order, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    out = jnp.where(t < new_len[:, None], out, 0)
+    return {"Out": [out], "OutLen": [new_len]}
+
+
+# --------------------------------------------------------------------------
+# sequence_enumerate (operators/sequence_ops/sequence_enumerate_op.h):
+# win_size sliding windows of ids; positions past the end get pad_value.
+# --------------------------------------------------------------------------
+@register_op("sequence_enumerate", inputs=("X", "SeqLen"), no_grad=True)
+def _sequence_enumerate(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, T]
+    lengths = _lengths(ins, x)
+    win = attrs["win_size"]
+    pad = attrs.get("pad_value", 0)
+    T = x.shape[1]
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    cols = []
+    for k in range(win):
+        src = jnp.minimum(t + k, T - 1)
+        g = jnp.take_along_axis(x, src, axis=1)
+        ok = (t + k) < lengths[:, None]
+        cols.append(jnp.where(ok, g, jnp.asarray(pad, x.dtype)))
+    return one(jnp.stack(cols, axis=-1))
+
+
+# --------------------------------------------------------------------------
+# sequence_pad / sequence_unpad
+# (operators/sequence_ops/sequence_pad_op.cc). In the padded-native world
+# sequence_pad normalizes padding positions to PadValue and reports lengths;
+# sequence_unpad zeroes padding (the packed form does not exist here).
+# --------------------------------------------------------------------------
+@register_op("sequence_pad", inputs=("X", "PadValue", "SeqLen"),
+             outputs=("Out", "Length"), non_diff_inputs=("PadValue", "SeqLen"))
+def _sequence_pad(ctx, ins, attrs):
+    x = ins["X"][0]
+    lengths = _lengths(ins, x)
+    pad = ins["PadValue"][0] if ins.get("PadValue") else jnp.asarray(0, x.dtype)
+    mask = _time_mask(x, lengths)
+    return {"Out": [jnp.where(mask, x, pad.astype(x.dtype))],
+            "Length": [lengths.astype(jnp.int64)]}
+
+
+@register_op("sequence_unpad", inputs=("X", "Length"),
+             non_diff_inputs=("Length",))
+def _sequence_unpad(ctx, ins, attrs):
+    x = ins["X"][0]
+    lengths = ins["Length"][0].astype(jnp.int32)
+    mask = _time_mask(x, lengths)
+    return one(jnp.where(mask, x, 0))
+
+
+# --------------------------------------------------------------------------
+# sequence_reshape (operators/sequence_ops/sequence_reshape_op.cc): change
+# the feature width; time expands/contracts by the same factor.
+# --------------------------------------------------------------------------
+@register_op("sequence_reshape", inputs=("X", "SeqLen"),
+             outputs=("Out", "OutLen"), non_diff_inputs=("SeqLen",))
+def _sequence_reshape(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, T, D]
+    lengths = _lengths(ins, x)
+    new_dim = attrs["new_dim"]
+    B, T, D = x.shape
+    assert (T * D) % new_dim == 0, "T*D must divide new_dim"
+    out = jnp.reshape(x, (B, T * D // new_dim, new_dim))
+    new_len = (lengths * D) // new_dim
+    return {"Out": [out], "OutLen": [new_len]}
+
+
+# --------------------------------------------------------------------------
+# sequence_conv (operators/sequence_ops/sequence_conv_op.cc): context-window
+# convolution over time. Filter is [context_length * D, out_channels], same
+# layout as the reference's im2col + GEMM path
+# (operators/math/context_project.h).
+# --------------------------------------------------------------------------
+@register_op("sequence_conv", inputs=("X", "Filter", "PaddingData", "SeqLen"),
+             non_diff_inputs=("SeqLen",))
+def _sequence_conv(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, T, D]
+    w = ins["Filter"][0]
+    ctx_len = attrs.get("contextLength", attrs.get("context_length", 3))
+    ctx_start = attrs.get("contextStart", attrs.get("context_start",
+                                                    -(ctx_len - 1) // 2))
+    lengths = _lengths(ins, x)
+    mask = _time_mask(x, lengths)
+    xm = jnp.where(mask, x, 0)
+    B, T, D = x.shape
+    shifted = []
+    for k in range(ctx_len):
+        offset = ctx_start + k
+        if offset < 0:
+            s = jnp.pad(xm[:, :T + offset], ((0, 0), (-offset, 0), (0, 0)))
+        elif offset > 0:
+            s = jnp.pad(xm[:, offset:], ((0, 0), (0, offset), (0, 0)))
+        else:
+            s = xm
+        # context outside the valid window contributes zeros (reference
+        # pads with zeros unless PaddingData given; trainable padding kept
+        # out of scope)
+        shifted.append(s)
+    col = jnp.concatenate(shifted, axis=-1)        # [B, T, ctx*D]
+    out = jnp.einsum("btc,co->bto", col, w)
+    return one(jnp.where(mask, out, 0))
+
+
+# --------------------------------------------------------------------------
+# row_conv (operators/row_conv_op.cc): lookahead convolution,
+# out[t] = sum_k w[k] * x[t+k].
+# --------------------------------------------------------------------------
+@register_op("row_conv", inputs=("X", "Filter", "SeqLen"),
+             non_diff_inputs=("SeqLen",))
+def _row_conv(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, T, D]
+    w = ins["Filter"][0]  # [future_ctx, D]
+    lengths = _lengths(ins, x)
+    mask = _time_mask(x, lengths)
+    xm = jnp.where(mask, x, 0)
+    T = x.shape[1]
+    out = jnp.zeros_like(xm)
+    for k in range(w.shape[0]):
+        if k == 0:
+            s = xm
+        else:
+            s = jnp.pad(xm[:, k:], ((0, 0), (0, k), (0, 0)))
+        out = out + s * w[k][None, None, :]
+    return one(jnp.where(mask, out, 0))
+
+
+# --------------------------------------------------------------------------
+# lod_reset (operators/lod_reset_op.cc): install new lengths metadata.
+# --------------------------------------------------------------------------
+@register_op("lod_reset", inputs=("X", "Y"), outputs=("Out", "OutLen"),
+             non_diff_inputs=("Y",))
+def _lod_reset(ctx, ins, attrs):
+    x = ins["X"][0]
+    # both Y and target_lod carry LoD *offsets* ([0, 1, 3, ...]), exactly
+    # like the reference (operators/lod_reset_op.cc: Y's data or the
+    # target_lod attr is a level-0 offset vector); OutLen is the derived
+    # per-sequence lengths used by the padded representation.
+    if ins.get("Y"):
+        off = ins["Y"][0].astype(jnp.int32)
+        new_len = off[1:] - off[:-1]
+    else:
+        arr = np.asarray(attrs.get("target_lod", []), np.int32)
+        new_len = jnp.asarray(arr[1:] - arr[:-1])
+    return {"Out": [x], "OutLen": [new_len]}
+
+
+# --------------------------------------------------------------------------
+# fused_embedding_seq_pool (operators/fused/fused_embedding_seq_pool_op.cc):
+# lookup_table + sequence_pool(SUM) in one op.
+# --------------------------------------------------------------------------
+@register_op("fused_embedding_seq_pool", inputs=("W", "Ids", "SeqLen"),
+             non_diff_inputs=("Ids", "SeqLen"))
+def _fused_embedding_seq_pool(ctx, ins, attrs):
+    w = ins["W"][0]
+    ids = ins["Ids"][0]
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    emb = jnp.take(w, ids.astype(jnp.int32), axis=0)  # [B, T, D]
+    lengths = _lengths(ins, emb)
+    mask = _time_mask(emb, lengths)
+    return one(jnp.sum(jnp.where(mask, emb, 0), axis=1))

@@ -623,3 +623,24 @@ def _shuffle_channel(ctx, ins, attrs):
     x = x.reshape(n, g, c // g, h, w)
     x = jnp.swapaxes(x, 1, 2)
     return one(x.reshape(n, c, h, w))
+
+
+# --------------------------------------------------------------------------
+# SelectedRows plumbing (framework/selected_rows.h:32;
+# operators/get_tensor_from_selected_rows_op.cc, merge_selected_rows via
+# operators/math/selected_rows_functor.cc MergeAdd)
+# --------------------------------------------------------------------------
+@register_op("merge_selected_rows", inputs=("X",), no_grad=True)
+def _merge_selected_rows(ctx, ins, attrs):
+    from ..core.selected_rows import SelectedRows
+    x = ins["X"][0]
+    assert isinstance(x, SelectedRows), "merge_selected_rows needs SelectedRows"
+    return one(x.merged())
+
+
+@register_op("get_tensor_from_selected_rows", inputs=("X",), no_grad=True)
+def _get_tensor_from_selected_rows(ctx, ins, attrs):
+    from ..core.selected_rows import SelectedRows
+    x = ins["X"][0]
+    assert isinstance(x, SelectedRows)
+    return one(x.to_dense())

@@ -20,6 +20,30 @@ class GradClipBase:
     pass
 
 
+def _sr_merged(g):
+    """Merge a SelectedRows grad so duplicate rows don't double-count in
+    norms (reference clip path runs merge_selected_rows first,
+    fluid/clip.py _clip on SELECTED_ROWS grads)."""
+    from ..core.selected_rows import SelectedRows
+    return g.merged() if isinstance(g, SelectedRows) else g
+
+
+def _sr_map(g, fn):
+    """Apply an elementwise fn to a dense grad or a SelectedRows' values."""
+    from ..core.selected_rows import SelectedRows
+    if isinstance(g, SelectedRows):
+        return SelectedRows(g.rows, fn(g.values), g.height)
+    return fn(g)
+
+
+def _sr_sq_sum(g):
+    import jax.numpy as jnp
+    from ..core.selected_rows import SelectedRows
+    if isinstance(g, SelectedRows):
+        return jnp.sum(g.values * g.values)
+    return jnp.sum(g * g)
+
+
 class GradientClipByValue(GradClipBase):
     def __init__(self, max, min=None):
         self.max = max
@@ -37,7 +61,9 @@ class GradientClipByValue(GradClipBase):
 
     def eager_apply(self, pgs):
         import jax.numpy as jnp
-        return [(p, jnp.clip(g, self.min, self.max)) for p, g in pgs]
+        return [(p, _sr_map(_sr_merged(g),
+                            lambda v: jnp.clip(v, self.min, self.max)))
+                for p, g in pgs]
 
 
 class GradientClipByNorm(GradClipBase):
@@ -58,9 +84,10 @@ class GradientClipByNorm(GradClipBase):
         import jax.numpy as jnp
         out = []
         for p, g in pgs:
-            norm = jnp.sqrt(jnp.sum(g * g))
-            out.append((p, g * (self.clip_norm /
-                                jnp.maximum(norm, self.clip_norm))))
+            g = _sr_merged(g)
+            norm = jnp.sqrt(_sr_sq_sum(g))
+            factor = self.clip_norm / jnp.maximum(norm, self.clip_norm)
+            out.append((p, _sr_map(g, lambda v, f=factor: v * f)))
         return out
 
 
@@ -109,10 +136,11 @@ class GradientClipByGlobalNorm(GradClipBase):
 
     def eager_apply(self, pgs):
         import jax.numpy as jnp
-        total = sum(jnp.sum(g * g) for _, g in pgs)
+        pgs = [(p, _sr_merged(g)) for p, g in pgs]
+        total = sum(_sr_sq_sum(g) for _, g in pgs)
         gnorm = jnp.sqrt(total)
         factor = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
-        return [(p, g * factor) for p, g in pgs]
+        return [(p, _sr_map(g, lambda v: v * factor)) for p, g in pgs]
 
 
 class L2Decay:
@@ -287,6 +315,11 @@ class Optimizer:
         raise NotImplementedError(
             f"{type(self).__name__} has no eager implementation")
 
+    def _sparse_apply(self, p_val, sr, lr, store, attrs, accums):
+        """Row-sparse update for a merged SelectedRows grad; return the new
+        param value, or None to fall back to a densified update."""
+        return None
+
     def _eager_lr(self):
         import jax.numpy as jnp
         from .lr_scheduler import LRScheduler
@@ -312,7 +345,28 @@ class Optimizer:
         lr = self._eager_lr()
         op_type, attrs, accums = self._eager_spec()
         opdef = REGISTRY.get(op_type)
+        from ..core.selected_rows import SelectedRows
         for p, g in pgs:
+            if isinstance(g, SelectedRows):
+                if self.regularization is not None and \
+                        not getattr(self, "_warned_sparse_reg", False):
+                    import warnings
+                    warnings.warn(
+                        "regularization is skipped for SelectedRows "
+                        "(sparse) gradients, matching the reference "
+                        "(fluid/regularizer.py append_regularization_ops "
+                        "warns and skips LOD_TENSOR-only regularizers)")
+                    self._warned_sparse_reg = True
+                # sparse update path (reference optimizers' SelectedRows
+                # kernels, e.g. operators/optimizers/sgd_op.h:73,
+                # adam_op.h lazy_mode): touch only the gathered rows.
+                store = self._eager_store.setdefault(id(p), {})
+                new_p = self._sparse_apply(p.value, g.merged(), lr, store,
+                                           attrs, accums)
+                if new_p is not None:
+                    p.value = new_p
+                    continue
+                g = g.to_dense()  # optimizer lacks a sparse rule: densify
             g = jnp.asarray(g, p.value.dtype)
             if self.regularization is not None:
                 g = self.regularization.eager_apply(p.value, g)
@@ -378,6 +432,12 @@ class SGD(Optimizer):
     def _eager_spec(self):
         return "sgd", {}, []
 
+    def _sparse_apply(self, p_val, sr, lr, store, attrs, accums):
+        # operators/optimizers/sgd_op.h:73 SelectedRows branch
+        g = sr.values.astype(p_val.dtype)
+        return p_val.at[sr.rows].add(-(lr.astype(p_val.dtype) * g),
+                                     mode="drop")
+
 
 SGDOptimizer = SGD
 
@@ -404,6 +464,22 @@ class Momentum(Optimizer):
         return "momentum", {"mu": self._momentum,
                             "use_nesterov": self._use_nesterov}, [
             ("Velocity", "VelocityOut", "velocity", 0.0, False)]
+
+    def _sparse_apply(self, p_val, sr, lr, store, attrs, accums):
+        # operators/optimizers/momentum_op.h SparseMomentumFunctor
+        import jax.numpy as jnp
+        v = store.get("velocity")
+        if v is None:
+            v = jnp.zeros_like(p_val)
+        rows = sr.rows
+        safe = jnp.minimum(rows, p_val.shape[0] - 1)
+        g = sr.values.astype(p_val.dtype)
+        mu = attrs["mu"]
+        vg = mu * v[safe] + g
+        lr_ = lr.astype(p_val.dtype)
+        step = (g + mu * vg) if attrs.get("use_nesterov") else vg
+        store["velocity"] = v.at[rows].set(vg, mode="drop")
+        return p_val.at[rows].add(-lr_ * step, mode="drop")
 
 
 MomentumOptimizer = Momentum
@@ -482,6 +558,32 @@ class Adam(Optimizer):
                      "Beta2PowOut": [b2p]},
             attrs=attrs)
 
+    def _sparse_apply(self, p_val, sr, lr, store, attrs, accums):
+        # lazy-mode row-sparse adam (operators/optimizers/adam_op.h
+        # SparseAdamFunctor, lazy_mode=true: only touched rows update)
+        import jax.numpy as jnp
+        m1 = store.get("moment1")
+        m2 = store.get("moment2")
+        if m1 is None:
+            m1 = jnp.zeros_like(p_val)
+        if m2 is None:
+            m2 = jnp.zeros_like(p_val)
+        b1, b2, eps = attrs["beta1"], attrs["beta2"], attrs["epsilon"]
+        b1p = store.get("beta1_pow", jnp.asarray(b1, jnp.float32))
+        b2p = store.get("beta2_pow", jnp.asarray(b2, jnp.float32))
+        rows = sr.rows
+        safe = jnp.minimum(rows, p_val.shape[0] - 1)
+        g = sr.values.astype(p_val.dtype)
+        m1g = b1 * m1[safe] + (1 - b1) * g
+        m2g = b2 * m2[safe] + (1 - b2) * g * g
+        lr_t = (lr * jnp.sqrt(1 - b2p) / (1 - b1p)).astype(p_val.dtype)
+        store["moment1"] = m1.at[rows].set(m1g, mode="drop")
+        store["moment2"] = m2.at[rows].set(m2g, mode="drop")
+        store["beta1_pow"] = b1p * b1
+        store["beta2_pow"] = b2p * b2
+        return p_val.at[rows].add(
+            -lr_t * m1g / (jnp.sqrt(m2g) + eps), mode="drop")
+
 
 AdamOptimizer = Adam
 
@@ -534,6 +636,21 @@ class Adagrad(Optimizer):
     def _eager_spec(self):
         return "adagrad", {"epsilon": self._epsilon}, [
             ("Moment", "MomentOut", "moment", self._init_value, False)]
+
+    def _sparse_apply(self, p_val, sr, lr, store, attrs, accums):
+        # operators/optimizers/adagrad_op.h SelectedRows branch
+        import jax.numpy as jnp
+        G = store.get("moment")
+        if G is None:
+            G = jnp.full_like(p_val, self._init_value)
+        rows = sr.rows
+        safe = jnp.minimum(rows, p_val.shape[0] - 1)
+        g = sr.values.astype(p_val.dtype)
+        Gg = G[safe] + g * g
+        store["moment"] = G.at[rows].set(Gg, mode="drop")
+        lr_ = lr.astype(p_val.dtype)
+        return p_val.at[rows].add(
+            -lr_ * g / (jnp.sqrt(Gg) + attrs["epsilon"]), mode="drop")
 
 
 AdagradOptimizer = Adagrad

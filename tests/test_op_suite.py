@@ -333,7 +333,6 @@ def test_layer_norm():
 def test_conv2d():
     x = _rand(1, 2, 5, 5, seed=47)
     w = _rand(3, 2, 3, 3, seed=48)
-    import jax.lax as lax  # oracle via lax on numpy (independent path ok)
     # plain numpy conv oracle
     out = np.zeros((1, 3, 3, 3), np.float32)
     for oc in range(3):

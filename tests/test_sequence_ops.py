@@ -1,0 +1,208 @@
+"""Sequence (LoD) op tests on the padded+lengths ragged representation.
+
+Oracle semantics follow the reference sequence_ops
+(/root/reference/paddle/fluid/operators/sequence_ops/) translated to
+padded form: only positions t < len are valid.
+"""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _seq_batch(seed=0, B=3, T=5, D=4):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-1, 1, (B, T, D)).astype(np.float32)
+    lens = np.array([5, 3, 1], dtype=np.int32)
+    mask = np.arange(T)[None, :] < lens[:, None]
+    x = np.where(mask[..., None], x, 0.0).astype(np.float32)
+    return x, lens, mask
+
+
+def test_sequence_mask():
+    lens = np.array([3, 1, 0], dtype=np.int32)
+    expect = (np.arange(4)[None, :] < lens[:, None]).astype(np.int64)
+    OpTest("sequence_mask", {"X": lens}, {"Y": expect},
+           attrs={"maxlen": 4, "out_dtype": "int64"}).check_output()
+
+
+@pytest.mark.parametrize("pooltype,fn", [
+    ("SUM", lambda x, l, m: np.where(m[..., None], x, 0).sum(1)),
+    ("AVERAGE", lambda x, l, m:
+        np.where(m[..., None], x, 0).sum(1) / np.maximum(l, 1)[:, None]),
+    ("SQRT", lambda x, l, m:
+        np.where(m[..., None], x, 0).sum(1)
+        / np.sqrt(np.maximum(l, 1))[:, None]),
+    ("MAX", lambda x, l, m:
+        np.where(m[..., None], x, -np.inf).max(1)),
+    ("LAST", lambda x, l, m:
+        x[np.arange(len(l)), np.maximum(l - 1, 0)]),
+    ("FIRST", lambda x, l, m: x[:, 0]),
+])
+def test_sequence_pool(pooltype, fn):
+    x, lens, mask = _seq_batch(seed=1)
+    expect = fn(x, lens, mask).astype(np.float32)
+    t = OpTest("sequence_pool", {"X": x, "SeqLen": lens}, {"Out": expect},
+               attrs={"pooltype": pooltype})
+    t.check_output()
+    if pooltype in ("SUM", "AVERAGE", "SQRT"):
+        t.check_grad(["X"], max_relative_error=2e-2)
+
+
+def test_sequence_softmax():
+    x, lens, mask = _seq_batch(seed=2, D=1)
+    x2 = x[..., 0]
+    e = np.where(mask, np.exp(x2 - x2.max(1, keepdims=True)), 0)
+    expect = np.where(mask, e / e.sum(1, keepdims=True), 0).astype(np.float32)
+    t = OpTest("sequence_softmax", {"X": x2, "SeqLen": lens},
+               {"Out": expect})
+    t.check_output(atol=1e-5)
+
+
+def test_sequence_reverse():
+    x, lens, mask = _seq_batch(seed=3)
+    expect = x.copy()
+    for i, l in enumerate(lens):
+        expect[i, :l] = x[i, :l][::-1]
+    OpTest("sequence_reverse", {"X": x, "SeqLen": lens},
+           {"Y": expect}).check_output()
+
+
+def test_sequence_expand():
+    rng = np.random.RandomState(4)
+    xvec = rng.uniform(-1, 1, (3, 4)).astype(np.float32)
+    ref = np.zeros((3, 5, 4), np.float32)
+    lens = np.array([2, 5, 0], dtype=np.int32)
+    mask = np.arange(5)[None, :] < lens[:, None]
+    expect = np.where(mask[..., None], xvec[:, None, :], 0).astype(np.float32)
+    OpTest("sequence_expand", {"X": xvec, "Y": ref, "SeqLen": lens},
+           {"Out": expect}).check_output()
+
+
+def test_sequence_concat():
+    xa, la, _ = _seq_batch(seed=5, T=4)
+    xb, lb, _ = _seq_batch(seed=6, T=5)
+    lb = np.array([2, 4, 3], dtype=np.int32)
+    maskb = np.arange(5)[None, :] < lb[:, None]
+    xb = np.where(maskb[..., None], xb, 0).astype(np.float32)
+    la = np.array([3, 2, 1], dtype=np.int32)
+    maska = np.arange(4)[None, :] < la[:, None]
+    xa = np.where(maska[..., None], xa, 0).astype(np.float32)
+    B, D = 3, 4
+    out = np.zeros((B, 9, D), np.float32)
+    outlen = la + lb
+    for i in range(B):
+        toks = np.concatenate([xa[i, :la[i]], xb[i, :lb[i]]], 0)
+        out[i, :len(toks)] = toks
+    OpTest("sequence_concat",
+           {"X": [("xa", xa), ("xb", xb)],
+            "SeqLen": [("la", la), ("lb", lb)]},
+           {"Out": out, "OutLen": outlen.astype(np.int32)}).check_output()
+
+
+def test_sequence_slice():
+    x, lens, _ = _seq_batch(seed=7)
+    off = np.array([1, 0, 0], dtype=np.int32)
+    ln = np.array([2, 3, 1], dtype=np.int32)
+    expect = np.zeros_like(x)
+    for i in range(3):
+        expect[i, :ln[i]] = x[i, off[i]:off[i] + ln[i]]
+    OpTest("sequence_slice", {"X": x, "Offset": off, "Length": ln},
+           {"Out": expect}).check_output()
+
+
+def test_sequence_erase():
+    x = np.array([[2, 1, 3, 1, 0], [1, 1, 2, 0, 0]], dtype=np.int64)
+    lens = np.array([5, 3], dtype=np.int32)
+    expect = np.array([[2, 3, 0, 0, 0], [2, 0, 0, 0, 0]], dtype=np.int64)
+    outlen = np.array([2, 1], dtype=np.int32)
+    OpTest("sequence_erase", {"X": x, "SeqLen": lens},
+           {"Out": expect, "OutLen": outlen},
+           attrs={"tokens": [1, 0]}).check_output()
+
+
+def test_sequence_enumerate():
+    x = np.array([[1, 2, 3, 4, 0]], dtype=np.int64)
+    lens = np.array([4], dtype=np.int32)
+    expect = np.array([[[1, 2], [2, 3], [3, 4], [4, 0], [0, 0]]],
+                      dtype=np.int64)
+    OpTest("sequence_enumerate", {"X": x, "SeqLen": lens},
+           {"Out": expect},
+           attrs={"win_size": 2, "pad_value": 0}).check_output()
+
+
+def test_sequence_pad_unpad():
+    x, lens, mask = _seq_batch(seed=8)
+    padv = np.float32(-1.0)
+    expect = np.where(mask[..., None], x, -1.0).astype(np.float32)
+    OpTest("sequence_pad", {"X": x, "PadValue": padv, "SeqLen": lens},
+           {"Out": expect, "Length": lens.astype(np.int64)}).check_output()
+    OpTest("sequence_unpad", {"X": expect, "Length": lens},
+           {"Out": np.where(mask[..., None], expect, 0).astype(np.float32)}
+           ).check_output()
+
+
+def test_sequence_reshape():
+    x, lens, _ = _seq_batch(seed=9, T=4, D=4)
+    lens = np.array([4, 2, 2], dtype=np.int32)
+    mask = np.arange(4)[None, :] < lens[:, None]
+    x = np.where(mask[..., None], x, 0).astype(np.float32)
+    expect = x.reshape(3, 8, 2)
+    OpTest("sequence_reshape", {"X": x, "SeqLen": lens},
+           {"Out": expect, "OutLen": lens * 2},
+           attrs={"new_dim": 2}).check_output()
+
+
+def test_sequence_conv():
+    x, lens, mask = _seq_batch(seed=10)
+    D, O, ctx_len = 4, 3, 3
+    rng = np.random.RandomState(11)
+    w = rng.uniform(-0.5, 0.5, (ctx_len * D, O)).astype(np.float32)
+    xm = np.where(mask[..., None], x, 0)
+    B, T = x.shape[:2]
+    col = np.zeros((B, T, ctx_len * D), np.float32)
+    for k in range(ctx_len):
+        offset = -1 + k  # context_start = -(ctx_len-1)//2 = -1
+        for t in range(T):
+            src = t + offset
+            if 0 <= src < T:
+                col[:, t, k * D:(k + 1) * D] = xm[:, src]
+    expect = np.where(mask[..., None], col @ w, 0).astype(np.float32)
+    t = OpTest("sequence_conv", {"X": x, "Filter": w, "SeqLen": lens},
+               {"Out": expect},
+               attrs={"contextLength": ctx_len, "contextStart": -1})
+    t.check_output(atol=1e-5)
+    t.check_grad(["Filter"], max_relative_error=2e-2)
+
+
+def test_row_conv():
+    x, lens, mask = _seq_batch(seed=12)
+    rng = np.random.RandomState(13)
+    w = rng.uniform(-0.5, 0.5, (2, 4)).astype(np.float32)
+    xm = np.where(mask[..., None], x, 0)
+    expect = xm * w[0][None, None]
+    shifted = np.zeros_like(xm)
+    shifted[:, :-1] = xm[:, 1:]
+    expect = expect + shifted * w[1][None, None]
+    expect = np.where(mask[..., None], expect, 0).astype(np.float32)
+    OpTest("row_conv", {"X": x, "Filter": w, "SeqLen": lens},
+           {"Out": expect}).check_output(atol=1e-5)
+
+
+def test_fused_embedding_seq_pool():
+    rng = np.random.RandomState(14)
+    w = rng.uniform(-1, 1, (10, 4)).astype(np.float32)
+    ids = np.array([[1, 2, 3], [4, 5, 0]], dtype=np.int64)
+    lens = np.array([3, 2], dtype=np.int32)
+    expect = np.stack([w[[1, 2, 3]].sum(0), w[[4, 5]].sum(0)]).astype(
+        np.float32)
+    OpTest("fused_embedding_seq_pool", {"W": w, "Ids": ids, "SeqLen": lens},
+           {"Out": expect}).check_output()
+
+
+def test_lod_reset():
+    x, _, _ = _seq_batch(seed=15)
+    offsets = np.array([0, 1, 3, 6], dtype=np.int32)
+    OpTest("lod_reset", {"X": x, "Y": offsets},
+           {"Out": x,
+            "OutLen": np.array([1, 2, 3], np.int32)}).check_output()
