@@ -22,7 +22,7 @@ def main():
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
         cfg = BertConfig()  # BERT-base
-        B, S, steps = 32, 128, 20
+        B, S, steps = 64, 128, 50
     else:  # CI / smoke fallback
         cfg = BertConfig(vocab_size=1000, hidden_size=128,
                          num_hidden_layers=2, num_attention_heads=4,
@@ -44,10 +44,13 @@ def main():
         nsp = rng.randint(0, 2, (B, 1)).astype(np.int32)
         return ids, mlm, nsp
 
-    # warmup/compile
+    # warmup/compile: TWO steps — the first call compiles with empty
+    # optimizer state, the second recompiles once the accumulator pytree
+    # exists; only then is the step cached
     ids, mlm, nsp = batch()
-    loss = step((ids,), (mlm, nsp))
-    float(loss)
+    for _ in range(2):
+        loss = step((ids,), (mlm, nsp))
+        float(loss)
 
     t0 = time.time()
     for _ in range(steps):

@@ -24,7 +24,7 @@ __all__ = ["SelectedRows"]
 
 @jax.tree_util.register_pytree_node_class
 class SelectedRows:
-    __slots__ = ("rows", "values", "height")
+    __slots__ = ("rows", "values", "height", "_is_merged")
 
     def __init__(self, rows, values, height: int):
         self.rows = jnp.asarray(rows, jnp.int32)
@@ -61,13 +61,20 @@ class SelectedRows:
 
     def merged(self) -> "SelectedRows":
         """Combine duplicate rows (summing values); same static length,
-        vacated slots get row index = height (a drop marker)."""
+        vacated slots get row index = height (a drop marker). Idempotent:
+        an already-merged result is returned as-is (the marker is a plain
+        Python attribute, dropped by pytree transforms, so at worst the
+        merge re-runs)."""
+        if getattr(self, "_is_merged", False):
+            return self
         n = self.rows.shape[0]
         uniq, inv = jnp.unique(self.rows, return_inverse=True, size=n,
                                fill_value=self.height)
         vals = jax.ops.segment_sum(self.values, inv.reshape(-1),
                                    num_segments=n)
-        return SelectedRows(uniq, vals, self.height)
+        out = SelectedRows(uniq, vals, self.height)
+        out._is_merged = True
+        return out
 
     # --- arithmetic (for grad accumulation) -----------------------------
     def __add__(self, other):

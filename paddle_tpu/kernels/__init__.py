@@ -1,0 +1,14 @@
+"""Pallas TPU kernels for the hot ops.
+
+TPU-native replacements for the reference's hand-written CUDA fused ops:
+- flash_attention: /root/reference/paddle/fluid/operators/fused/
+  multihead_matmul_op.cu (fused QK^T -> softmax -> PV attention)
+- fused layer_norm: /root/reference/paddle/fluid/operators/layer_norm_op.cu
+- fused softmax cross-entropy: /root/reference/paddle/fluid/operators/
+  softmax_with_cross_entropy_op.cu
+
+Each kernel exposes a pure-jnp reference path used on CPU (and by the
+numpy-oracle OpTest harness); the Pallas path engages on TPU backends.
+"""
+from . import flash_attention  # noqa: F401
+from . import layer_norm  # noqa: F401

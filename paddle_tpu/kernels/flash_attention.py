@@ -1,0 +1,464 @@
+"""Flash attention for TPU (Pallas), with custom VJP.
+
+TPU-native equivalent of the reference's fused attention CUDA op
+(/root/reference/paddle/fluid/operators/fused/multihead_matmul_op.cu — a
+QK^T -> softmax -> PV fusion for inference-length sequences) and of its
+composed matmul+softmax training path. Instead of translating the CUDA
+kernel, this implements the online-softmax tiling that keeps the O(S^2)
+score matrix out of HBM: the score tile lives in VMEM, the MXU does the
+two matmuls per (q-block, k-block) pair, and running (max, sum)
+statistics rescale the accumulator — the standard FlashAttention
+recurrence, laid out on the TPU memory hierarchy (HBM -> VMEM blocks via
+BlockSpec; fp32 accumulation via preferred_element_type).
+
+Layouts: q, k, v are [B, H, S, D]; bias is additive, broadcastable to
+[B, H, Sq, Sk] (dims of size 1 are broadcast in-kernel via BlockSpec
+index maps). Returns [B, H, Sq, D].
+
+The backward pass saves only out + logsumexp and recomputes score tiles
+(two Pallas kernels: one gridded over q-blocks for dQ, one over k-blocks
+for dK/dV) — the same memory/FLOPs trade the reference gets from
+recompute checkpointing (backward.py:145).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU too (used for interpret mode)
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# reference (composed) implementation — CPU path and test oracle
+# ---------------------------------------------------------------------------
+
+def attention_reference(q, k, v, bias=None, causal=False, sm_scale=None):
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * sm_scale
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
+                sm_scale, causal, block_k, sk, sq_total):
+    # blocks: q [1,1,bq,d]; k/v [1,1,sk,d]; bias [1,1,bq|1,sk] or None;
+    # value-indexed with [0, 0, ...] (ref views of <128-lane dims don't
+    # lower on Mosaic)
+    bq, d = q_ref.shape[2], q_ref.shape[3]
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale
+    nk = sk // block_k
+
+    def body(ki, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, 0, pl.ds(ki * block_k, block_k), :] \
+            .astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(ki * block_k, block_k), :] \
+            .astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, block_k]
+        if bias_ref is not None:
+            b = bias_ref[0, 0, :, pl.ds(ki * block_k, block_k)] \
+                .astype(jnp.float32)
+            s = s + jnp.broadcast_to(b, s.shape)
+        if causal:
+            # bottom-right aligned (tril k=sk-sq), matching
+            # attention_reference and the composed fallback
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0) \
+                + qi * bq + (sk - sq_total)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1) \
+                + ki * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    if causal:
+        # only k-blocks with k_start <= q_end + (sk - sq) contribute
+        nk_live = jnp.minimum(pl.cdiv((qi + 1) * bq + (sk - sq_total),
+                                      block_k), nk)
+        acc, m, l = jax.lax.fori_loop(0, nk_live, body, (acc0, m0, l0))
+    else:
+        acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, None]  # [bq, 1] trailing lane
+
+
+def _bias_spec(bias, b_axis, h_axis, blk_q, sk, block_q_axis=2):
+    """BlockSpec for a [B?,H?,Sq?,Sk] additive bias with broadcast dims."""
+    bshape = bias.shape
+    qdim = bshape[2]
+    blk = (1, 1, blk_q if qdim != 1 else 1, sk)
+
+    def idx(b, h, i):
+        return (b if bshape[0] != 1 else 0,
+                h if bshape[1] != 1 else 0,
+                i if qdim != 1 else 0,
+                0)
+    return pl.BlockSpec(blk, idx)
+
+
+def _fwd(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
+    batch, heads, sq, d = q.shape
+    sk = k.shape[2]
+    blk_q = min(block_q, sq)
+    blk_k = min(block_k, sk)
+    # pallas path needs aligned shapes; caller guarantees via _supported()
+    grid = (batch, heads, sq // blk_q)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, blk_q, d), lambda b, h, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, sk, d), lambda b, h, i: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, sk, d), lambda b, h, i: (b, h, 0, 0)),
+    ]
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(_bias_spec(bias, batch, heads, blk_q, sk))
+        args.append(bias)
+
+    def kern(q_ref, k_ref, v_ref, *rest):
+        if bias is not None:
+            b_ref, o_ref, lse_ref = rest
+        else:
+            b_ref, (o_ref, lse_ref) = None, rest
+        _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
+                    sm_scale=sm_scale, causal=causal, block_k=blk_k, sk=sk,
+                    sq_total=sq)
+
+    # lse carries a trailing singleton dim: Mosaic requires the last two
+    # block dims to be (8k, 128m) or equal to the array dims
+    out_shape = [
+        jax.ShapeDtypeStruct((batch, heads, sq, d), q.dtype),
+        jax.ShapeDtypeStruct((batch, heads, sq, 1), jnp.float32),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, 1, blk_q, d), lambda b, h, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, blk_q, 1), lambda b, h, i: (b, h, i, 0)),
+    ]
+    o, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * batch * heads * sq * sk * d,
+            bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize * 2,
+            transcendentals=batch * heads * sq * sk),
+    )(*args)
+    return o, lse.reshape(batch, heads, sq)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, *, sm_scale, causal, block_k, sk,
+                   sq_total):
+    bq, d = q_ref.shape[2], q_ref.shape[3]
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0]
+    delta = delta_ref[0, 0, :, 0]
+    nk = jnp.minimum(pl.cdiv((qi + 1) * bq + (sk - sq_total), block_k),
+                     sk // block_k) if causal else sk // block_k
+
+    def body(ki, dq):
+        k_blk = k_ref[0, 0, pl.ds(ki * block_k, block_k), :] \
+            .astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(ki * block_k, block_k), :] \
+            .astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if bias_ref is not None:
+            b = bias_ref[0, 0, :, pl.ds(ki * block_k, block_k)] \
+                .astype(jnp.float32)
+            s = s + jnp.broadcast_to(b, s.shape)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0) \
+                + qi * bq + (sk - sq_total)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1) \
+                + ki * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *, sm_scale, causal,
+                    block_q, sq, sk_total):
+    bk, d = k_ref.shape[2], k_ref.shape[3]
+    ki = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    nq = sq // block_q
+    # first q-block that can (bottom-right-aligned) see k-block ki
+    q_start = jnp.maximum(ki * bk - (sk_total - sq), 0) // block_q \
+        if causal else 0
+
+    def body(qi, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, 0, pl.ds(qi * block_q, block_q), :] \
+            .astype(jnp.float32)
+        do_blk = do_ref[0, 0, pl.ds(qi * block_q, block_q), :] \
+            .astype(jnp.float32)
+        lse_blk = lse_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
+        delta_blk = delta_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
+        s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if bias_ref is not None:
+            b = bias_ref[0, 0, pl.ds(qi * block_q, block_q) if
+                         bias_ref.shape[2] != 1 else slice(None), :] \
+                .astype(jnp.float32)
+            s = s + jnp.broadcast_to(b, s.shape)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0) \
+                + qi * block_q + (sk_total - sq)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1) \
+                + ki * bk
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse_blk[:, None])  # [block_q, bk]
+        dv_new = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk[:, None]) * sm_scale
+        dk_new = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(q_start, nq, body, (z, z))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v, bias, o, lse = res
+    do = g
+    batch, heads, sq, d = q.shape
+    sk = k.shape[2]
+    blk_q = min(block_q, sq)
+    blk_k = min(block_k, sk)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    qspec = pl.BlockSpec((1, 1, blk_q, d), lambda b, h, i: (b, h, i, 0))
+    qfull = pl.BlockSpec((1, 1, sq, d), lambda b, h, i: (b, h, 0, 0))
+    kfull = pl.BlockSpec((1, 1, sk, d), lambda b, h, i: (b, h, 0, 0))
+    kspec = pl.BlockSpec((1, 1, blk_k, d), lambda b, h, i: (b, h, i, 0))
+    lse_blk = pl.BlockSpec((1, 1, blk_q, 1), lambda b, h, i: (b, h, i, 0))
+    lse_full = pl.BlockSpec((1, 1, sq, 1), lambda b, h, i: (b, h, 0, 0))
+    lse4 = lse[..., None]
+    delta4 = delta[..., None]
+
+    # ---- dQ: grid over q blocks
+    in_specs = [qspec, kfull, kfull, qspec, lse_blk, lse_blk]
+    args = [q, k, v, do, lse4, delta4]
+    if bias is not None:
+        in_specs.insert(3, _bias_spec(bias, batch, heads, blk_q, sk))
+        args.insert(3, bias)
+
+    def dq_kern(*refs):
+        if bias is not None:
+            q_r, k_r, v_r, b_r, do_r, lse_r, dl_r, dq_r = refs
+        else:
+            q_r, k_r, v_r, do_r, lse_r, dl_r, dq_r = refs
+            b_r = None
+        _bwd_dq_kernel(q_r, k_r, v_r, b_r, do_r, lse_r, dl_r, dq_r,
+                       sm_scale=sm_scale, causal=causal,
+                       block_k=blk_k, sk=sk, sq_total=sq)
+
+    dq = pl.pallas_call(
+        dq_kern,
+        grid=(batch, heads, sq // blk_q),
+        in_specs=in_specs,
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(*args)
+
+    # ---- dK/dV: grid over k blocks
+    in_specs2 = [qfull, kspec, kspec, qfull, lse_full, lse_full]
+    args2 = [q, k, v, do, lse4, delta4]
+    if bias is not None:
+        bshape = bias.shape
+
+        def bidx(b, h, i):
+            return (b if bshape[0] != 1 else 0, h if bshape[1] != 1 else 0,
+                    0, i)
+        bspec2 = pl.BlockSpec(
+            (1, 1, bshape[2] if bshape[2] != 1 else 1, blk_k), bidx)
+        in_specs2.insert(3, bspec2)
+        args2.insert(3, bias)
+
+    def dkv_kern(*refs):
+        if bias is not None:
+            q_r, k_r, v_r, b_r, do_r, lse_r, dl_r, dk_r, dv_r = refs
+        else:
+            q_r, k_r, v_r, do_r, lse_r, dl_r, dk_r, dv_r = refs
+            b_r = None
+        _bwd_dkv_kernel(q_r, k_r, v_r, b_r, do_r, lse_r, dl_r, dk_r, dv_r,
+                        sm_scale=sm_scale, causal=causal, block_q=blk_q,
+                        sq=sq, sk_total=sk)
+
+    dk, dv = pl.pallas_call(
+        dkv_kern,
+        grid=(batch, heads, sk // blk_k),
+        in_specs=in_specs2,
+        out_specs=[kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        interpret=interpret,
+    )(*args2)
+
+    dbias = None
+    if bias is not None:
+        # blockwise recompute of ds, scanned over q-blocks, so the full
+        # [B,H,Sq,Sk] score matrix never materializes in HBM (same online
+        # tiling as the kernels; ds w.r.t. bias excludes sm_scale since
+        # s = qk*scale + bias).
+        full_shape = (batch, heads, sq, sk)
+        reduce_axes = tuple(i for i, (bs, fs) in
+                            enumerate(zip(bias.shape, full_shape))
+                            if bs != fs)
+        nq = sq // blk_q
+        qf = q.astype(jnp.float32)
+        dof = do.astype(jnp.float32)
+        kf = k.astype(jnp.float32)
+        vf = v.astype(jnp.float32)
+
+        def qblock(qi):
+            qs = jax.lax.dynamic_slice_in_dim(qf, qi * blk_q, blk_q, 2)
+            dos = jax.lax.dynamic_slice_in_dim(dof, qi * blk_q, blk_q, 2)
+            lses = jax.lax.dynamic_slice_in_dim(lse, qi * blk_q, blk_q, 2)
+            deltas = jax.lax.dynamic_slice_in_dim(delta, qi * blk_q,
+                                                  blk_q, 2)
+            bsl = bias if bias.shape[2] == 1 else \
+                jax.lax.dynamic_slice_in_dim(bias, qi * blk_q, blk_q, 2)
+            s = jnp.einsum("bhqd,bhkd->bhqk", qs, kf) * sm_scale + bsl
+            if causal:
+                rows = (jnp.arange(blk_q) + qi * blk_q + (sk - sq))[:, None]
+                cols = jnp.arange(sk)[None, :]
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            p = jnp.exp(s - lses[..., None])
+            dp = jnp.einsum("bhqd,bhkd->bhqk", dos, vf)
+            ds = p * (dp - deltas[..., None])
+            # reduce all broadcast axes except q (axis 2) now
+            red_now = tuple(a for a in reduce_axes if a != 2)
+            part = ds.sum(axis=red_now, keepdims=True) if red_now else ds
+            if 2 in reduce_axes:
+                part = part.sum(axis=2, keepdims=True)
+            return part
+
+        parts = jax.lax.map(qblock, jnp.arange(nq))
+        if 2 in reduce_axes:
+            dbias = parts.sum(axis=0).astype(bias.dtype)
+        else:
+            # parts: [nq, B, H, blk_q, Sk] -> concat along q
+            m = jnp.moveaxis(parts, 0, 2)
+            dbias = m.reshape(m.shape[0], m.shape[1], sq,
+                              m.shape[-1]).astype(bias.dtype)
+        dbias = dbias.reshape(bias.shape)
+    return dq, dk, dv, dbias
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+def _supported(q, k, sq, sk, d, blk_q, blk_k):
+    return (sq % min(blk_q, sq) == 0 and sk % min(blk_k, sk) == 0 and
+            min(blk_q, sq) % 8 == 0 and min(blk_k, sk) % 128 == 0 and
+            d % 8 == 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, bias, causal, sm_scale, block_q, block_k,
+                  interpret)
+    return o, (q, k, v, bias, o, lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    dq, dk, dv, dbias = _bwd(causal, sm_scale, block_q, block_k, interpret,
+                             res, g)
+    return dq, dk, dv, dbias
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
+                    causal: bool = False, sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Fused attention. q,k,v: [B,H,S,D]; bias broadcastable to
+    [B,H,Sq,Sk]. Falls back to the composed XLA path for unsupported
+    shapes."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    batch, heads, sq, d = q.shape
+    sk = k.shape[2]
+    if not _supported(q, k, sq, sk, d, block_q, block_k):
+        return attention_reference(q, k, v, bias, causal, sm_scale)
+    if bias is not None:
+        # normalize bias to 4d
+        while bias.ndim < 4:
+            bias = bias[None]
+    return _flash(q, k, v, bias, causal, sm_scale, block_q, block_k,
+                  _use_interpret())

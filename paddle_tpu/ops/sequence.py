@@ -312,9 +312,21 @@ def _sequence_reshape(ctx, ins, attrs):
     lengths = _lengths(ins, x)
     new_dim = attrs["new_dim"]
     B, T, D = x.shape
-    assert (T * D) % new_dim == 0, "T*D must divide new_dim"
+    assert (T * D) % new_dim == 0, \
+        "new_dim must divide T*D (sequence_reshape_op.cc requires each " \
+        "sequence's element count to be divisible by new_dim)"
+    if D % new_dim != 0 and new_dim % D != 0:
+        raise ValueError(
+            "sequence_reshape: new_dim (%d) must divide or be a multiple "
+            "of D (%d) so every sequence length maps to a whole number of "
+            "output steps (reference enforces per-sequence divisibility)"
+            % (new_dim, D))
     out = jnp.reshape(x, (B, T * D // new_dim, new_dim))
-    new_len = (lengths * D) // new_dim
+    # ceil: a sequence whose length*D is not divisible by new_dim keeps its
+    # trailing partial step (zero-padded) instead of silently dropping it;
+    # the reference errors on per-sequence indivisibility
+    # (sequence_reshape_op.cc), which a traced length cannot do under jit
+    new_len = -((lengths * D) // -new_dim)
     return {"Out": [out], "OutLen": [new_len]}
 
 
@@ -329,6 +341,11 @@ def _sequence_reshape(ctx, ins, attrs):
 def _sequence_conv(ctx, ins, attrs):
     x = ins["X"][0]  # [B, T, D]
     w = ins["Filter"][0]
+    if ins.get("PaddingData"):
+        raise NotImplementedError(
+            "sequence_conv: trainable PaddingData (paddingTrainable=True, "
+            "sequence_conv_op.cc) is not supported; zero padding is used. "
+            "Pass no PaddingData input.")
     ctx_len = attrs.get("contextLength", attrs.get("context_length", 3))
     ctx_start = attrs.get("contextStart", attrs.get("context_start",
                                                     -(ctx_len - 1) // 2))

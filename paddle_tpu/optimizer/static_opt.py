@@ -32,7 +32,11 @@ def _sr_map(g, fn):
     """Apply an elementwise fn to a dense grad or a SelectedRows' values."""
     from ..core.selected_rows import SelectedRows
     if isinstance(g, SelectedRows):
-        return SelectedRows(g.rows, fn(g.values), g.height)
+        out = SelectedRows(g.rows, fn(g.values), g.height)
+        # elementwise fn preserves merged-ness; keep the marker so step()
+        # doesn't redo the unique/segment_sum merge
+        out._is_merged = getattr(g, "_is_merged", False)
+        return out
     return fn(g)
 
 
@@ -599,6 +603,18 @@ class AdamW(Adam):
     def _extra_attrs(self):
         return {"coeff": self._coeff}
 
+    def _sparse_apply(self, p_val, sr, lr, store, attrs, accums):
+        # adamw decoupled decay on the touched rows (adamw_op.h applies
+        # param -= lr*coeff*param before the adam step), then plain
+        # sparse adam via the base class.
+        import jax.numpy as jnp
+        rows = sr.rows
+        safe = jnp.minimum(rows, p_val.shape[0] - 1)
+        decay = (lr * self._coeff).astype(p_val.dtype) \
+            if hasattr(lr, "astype") else lr * self._coeff
+        p_val = p_val.at[rows].add(-decay * p_val[safe], mode="drop")
+        return super()._sparse_apply(p_val, sr, lr, store, attrs, accums)
+
 
 class Lamb(Adam):
     """optimizer.py:2935 LambOptimizer."""
@@ -612,6 +628,13 @@ class Lamb(Adam):
 
     def _extra_attrs(self):
         return {"weight_decay": self._weight_decay}
+
+    def _sparse_apply(self, p_val, sr, lr, store, attrs, accums):
+        # Lamb's trust ratio is a whole-parameter norm ratio
+        # (lamb_op.h computes ||p|| / ||update|| over the full tensor), so
+        # a rows-only update would use a wrong ratio; densify instead and
+        # let the real lamb op run.
+        return None
 
 
 LambOptimizer = Lamb

@@ -1,0 +1,133 @@
+"""Pallas kernel tests (interpret mode on CPU) vs composed-jnp oracles.
+
+Mirrors the reference's OpTest contract (numpy oracle + gradient check,
+/root/reference/python/paddle/fluid/tests/unittests/op_test.py:948,1236)
+for the fused kernels."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.kernels.flash_attention import (attention_reference,
+                                                flash_attention)
+from paddle_tpu.kernels.layer_norm import layer_norm, layer_norm_reference
+
+
+def _rand(shape, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape)
+                       .astype(np.float32))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_forward(causal):
+    b, h, s, d = 2, 2, 256, 64
+    q, k, v = _rand((b, h, s, d), 0), _rand((b, h, s, d), 1), \
+        _rand((b, h, s, d), 2)
+    out = flash_attention(q, k, v, causal=causal)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_causal_cross_length():
+    # sq != sk: bottom-right-aligned causal mask must match the reference
+    b, h, sq, sk, d = 1, 2, 128, 256, 64
+    q = _rand((b, h, sq, d), 0)
+    k, v = _rand((b, h, sk, d), 1), _rand((b, h, sk, d), 2)
+    out = flash_attention(q, k, v, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    g_f = jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True)
+                   .sum(), argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(lambda q, k, v: attention_reference(q, k, v, causal=True)
+                   .sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_f, g_r):
+        np.testing.assert_allclose(a, b_, atol=1e-4, rtol=1e-4)
+
+
+def test_flash_attention_bias_broadcast():
+    b, h, s, d = 2, 2, 128, 64
+    q, k, v = _rand((b, h, s, d), 0), _rand((b, h, s, d), 1), \
+        _rand((b, h, s, d), 2)
+    # key-padding style mask [B, 1, 1, S]
+    bias = jnp.where(_rand((b, 1, 1, s), 3) > 0, 0.0, -1e9)
+    out = flash_attention(q, k, v, bias=bias)
+    ref = attention_reference(q, k, v, bias=bias)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_grads(causal):
+    b, h, s, d = 1, 2, 256, 64
+    q, k, v = _rand((b, h, s, d), 0), _rand((b, h, s, d), 1), \
+        _rand((b, h, s, d), 2)
+
+    def f_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=causal) *
+                _rand((b, h, s, d), 9)).sum()
+
+    def f_ref(q, k, v):
+        return (attention_reference(q, k, v, causal=causal) *
+                _rand((b, h, s, d), 9)).sum()
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_flash, g_ref):
+        np.testing.assert_allclose(a, b_, atol=1e-4, rtol=1e-4)
+
+
+def test_flash_attention_bias_grad():
+    b, h, s, d = 1, 2, 128, 64
+    q, k, v = _rand((b, h, s, d), 0), _rand((b, h, s, d), 1), \
+        _rand((b, h, s, d), 2)
+    bias = _rand((b, 1, 1, s), 3)
+
+    def f_flash(q, k, v, bias):
+        return (flash_attention(q, k, v, bias=bias)).sum()
+
+    def f_ref(q, k, v, bias):
+        return (attention_reference(q, k, v, bias=bias)).sum()
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for a, b_ in zip(g_flash, g_ref):
+        np.testing.assert_allclose(a, b_, atol=1e-4, rtol=1e-4)
+
+
+def test_flash_attention_unaligned_fallback():
+    # S not multiple of 128 -> composed path, still correct
+    b, h, s, d = 1, 2, 100, 32
+    q, k, v = _rand((b, h, s, d), 0), _rand((b, h, s, d), 1), \
+        _rand((b, h, s, d), 2)
+    out = flash_attention(q, k, v)
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_layer_norm_forward():
+    x = _rand((4, 6, 256), 0)
+    g, b = _rand((256,), 1), _rand((256,), 2)
+    out = layer_norm(x, g, b)
+    ref = layer_norm_reference(x, g, b)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_layer_norm_grads():
+    x = _rand((8, 256), 0)
+    g, b = _rand((256,), 1), _rand((256,), 2)
+    w = _rand((8, 256), 5)
+
+    gr_f = jax.grad(lambda x, g, b: (layer_norm(x, g, b) * w).sum(),
+                    argnums=(0, 1, 2))(x, g, b)
+    gr_r = jax.grad(lambda x, g, b: (layer_norm_reference(x, g, b) * w).sum(),
+                    argnums=(0, 1, 2))(x, g, b)
+    for a, b_ in zip(gr_f, gr_r):
+        np.testing.assert_allclose(a, b_, atol=1e-4, rtol=1e-4)
+
+
+def test_layer_norm_unaligned_fallback():
+    x = _rand((4, 100), 0)
+    g, b = _rand((100,), 1), _rand((100,), 2)
+    np.testing.assert_allclose(layer_norm(x, g, b),
+                               layer_norm_reference(x, g, b),
+                               atol=1e-5, rtol=1e-5)
