@@ -24,6 +24,12 @@ from .core import (Executor, Program, append_backward,  # noqa: F401
 from .layers.helper import ParamAttr  # noqa: F401
 from . import layers  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from .io import (save, load, save_persistables, load_persistables,  # noqa: F401
+                 save_params, load_params, save_inference_model,
+                 load_inference_model, save_dygraph, load_dygraph)
+from . import inference  # noqa: F401
+from . import incubate  # noqa: F401
 
 
 class CPUPlace:

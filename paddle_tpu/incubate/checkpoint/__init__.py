@@ -1,0 +1,2 @@
+from .auto_checkpoint import train_epoch_range  # noqa: F401
+from .checkpoint_saver import CheckpointSaver  # noqa: F401

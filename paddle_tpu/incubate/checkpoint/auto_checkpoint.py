@@ -1,0 +1,154 @@
+"""Auto-checkpoint: transparent epoch-level resume.
+
+Analog of /root/reference/python/paddle/fluid/incubate/checkpoint/
+auto_checkpoint.py:71 — the reference wraps the user's epoch loop in
+``train_epoch_range``, hashes the program + range to name a checkpoint
+stream, saves persistables to HDFS every interval, and on restart skips
+already-completed epochs. Same contract here over LocalFS: the hash keys
+on the serialized main/startup programs + the range; state is the scope's
+persistables (saved via io.save_persistables) + a status JSON.
+
+Enabled when PADDLE_RUNNING_ENV=PADDLE_EDL_AUTO_CHECKPOINT (reference
+_get_running_key env contract) or when ``always=True`` is passed.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Optional
+
+from ... import io as _io
+from ...core.executor import Executor
+from ...core.program import default_main_program, default_startup_program
+from .checkpoint_saver import CheckpointSaver, LocalFS
+
+_checker = None
+
+
+class AutoCheckpointChecker:
+    """Env contract (reference auto_checkpoint.py:113 AutoCheckpointChecker:
+    run env, job id, hdfs dir, save interval)."""
+
+    def __init__(self):
+        self.run_env = os.environ.get("PADDLE_RUNNING_ENV", "")
+        self.job_id = os.environ.get("PADDLE_JOB_ID", "default_job")
+        self.ckpt_dir = os.environ.get(
+            "PADDLE_EDL_HDFS_CHECKPOINT_PATH",
+            os.environ.get("PADDLE_CHECKPOINT_DIR", "./auto_checkpoint"))
+        self.save_interval = int(
+            os.environ.get("PADDLE_EDL_SAVE_CHECKPOINT_INTER", "900"))
+
+    def get_range_checkpoint_path(self, name: str) -> str:
+        return os.path.join(self.ckpt_dir, self.job_id, "range", name)
+
+    @property
+    def valid(self) -> bool:
+        return self.run_env == "PADDLE_EDL_AUTO_CHECKPOINT"
+
+
+def _get_checker() -> AutoCheckpointChecker:
+    global _checker
+    if _checker is None:
+        _checker = AutoCheckpointChecker()
+    return _checker
+
+
+class ExeTrainStatus:
+    """auto_checkpoint.py:193 — serializable per-range status."""
+
+    def __init__(self):
+        self.epoch_no = -1
+        self.hash_key = None
+        self.checkpoint_no = None
+
+    def to_dict(self):
+        return {"epoch_no": self.epoch_no, "hash_key": self.hash_key,
+                "checkpoint_no": self.checkpoint_no}
+
+    @classmethod
+    def from_dict(cls, d):
+        st = cls()
+        st.epoch_no = d.get("epoch_no", -1)
+        st.hash_key = d.get("hash_key")
+        st.checkpoint_no = d.get("checkpoint_no")
+        return st
+
+
+class TrainEpochRange:
+    def __init__(self, max_epoch_num: int, name: str,
+                 save_checkpoint_inter: Optional[int] = None,
+                 checker: Optional[AutoCheckpointChecker] = None):
+        self.name = name
+        self.max_epoch_num = max_epoch_num
+        self._checker = checker or _get_checker()
+        self._saver = CheckpointSaver(LocalFS())
+        self._save_inter = (save_checkpoint_inter
+                            if save_checkpoint_inter is not None
+                            else self._checker.save_interval)
+        self._last_save = time.time()
+        self._status = ExeTrainStatus()
+        self._status.hash_key = self._hash()
+        self._root = self._checker.get_range_checkpoint_path(name)
+        self._restore()
+
+    def _hash(self) -> str:
+        h = hashlib.md5()
+        h.update(default_main_program().to_json().encode())
+        h.update(default_startup_program().to_json().encode())
+        h.update(str(self.max_epoch_num).encode())
+        return h.hexdigest()
+
+    # --- persistence ----------------------------------------------------
+    def _save_fn(self, path):
+        exe = Executor()
+        _io.save_persistables(exe, path, default_main_program())
+        with open(os.path.join(path, "status.json"), "w") as f:
+            json.dump(self._status.to_dict(), f)
+
+    def _load_fn(self, path):
+        status_file = os.path.join(path, "status.json")
+        with open(status_file) as f:
+            st = ExeTrainStatus.from_dict(json.load(f))
+        if st.hash_key != self._status.hash_key:
+            return  # different program/range: don't resume
+        exe = Executor()
+        _io.load_persistables(exe, path, default_main_program())
+        self._status = st
+
+    def _restore(self):
+        if self._saver.get_checkpoint_no(self._root):
+            self._saver.load_checkpoint(self._root, self._load_fn)
+
+    def save_checkpoint(self):
+        self._status.checkpoint_no = self._saver.save_checkpoint(
+            self._root, self._save_fn)
+        self._last_save = time.time()
+
+    # --- the epoch generator (auto_checkpoint.py train_epoch_range) -----
+    def get(self):
+        start = self._status.epoch_no + 1
+        for epoch in range(start, self.max_epoch_num):
+            yield epoch
+            self._status.epoch_no = epoch
+            if time.time() - self._last_save >= self._save_inter or \
+                    epoch == self.max_epoch_num - 1:
+                self.save_checkpoint()
+
+
+def train_epoch_range(max_epoch_num: int, name: str = "default",
+                      save_checkpoint_inter: Optional[int] = None):
+    """for epoch in train_epoch_range(N): ... — transparently resumes at
+    the first un-finished epoch after a crash/restart when auto-checkpoint
+    is enabled; plain range otherwise."""
+    checker = _get_checker()
+    if not checker.valid and save_checkpoint_inter is None:
+        for epoch in range(max_epoch_num):
+            yield epoch
+        return
+    tr = TrainEpochRange(max_epoch_num, name,
+                         save_checkpoint_inter=save_checkpoint_inter,
+                         checker=checker)
+    for epoch in tr.get():
+        yield epoch

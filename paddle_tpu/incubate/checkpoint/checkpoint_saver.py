@@ -1,0 +1,95 @@
+"""Generic checkpoint saver over a filesystem abstraction.
+
+Analog of /root/reference/python/paddle/fluid/incubate/checkpoint/
+checkpoint_saver.py (SerializableBase/CheckpointSaver over fleet.utils.fs
+LocalFS/HDFSClient). Checkpoints are numbered directories
+``<dir>/__paddle_checkpoint__.<no>``; save trims older ones, load picks
+the newest."""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List, Optional
+
+CKPT_PREFIX = "__paddle_checkpoint__"
+
+
+class LocalFS:
+    """fleet/utils/fs.py:119 LocalFS surface (the subset checkpointing
+    needs). An HDFS twin would shell out like the reference's
+    HDFSClient:258; out of scope without a cluster."""
+
+    def ls_dir(self, path):
+        if not os.path.isdir(path):
+            return [], []
+        dirs, files = [], []
+        for e in sorted(os.listdir(path)):
+            full = os.path.join(path, e)
+            (dirs if os.path.isdir(full) else files).append(e)
+        return dirs, files
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def mv(self, src, dst):
+        self.delete(dst)
+        shutil.move(src, dst)
+
+
+class CheckpointSaver:
+    def __init__(self, fs=None):
+        self.fs = fs or LocalFS()
+
+    def _numbered(self, root) -> List[int]:
+        dirs, _ = self.fs.ls_dir(root)
+        nos = []
+        for d in dirs:
+            if d.startswith(CKPT_PREFIX + "."):
+                try:
+                    nos.append(int(d.split(".")[-1]))
+                except ValueError:
+                    pass
+        return sorted(nos)
+
+    def save_checkpoint(self, root: str, save_fn, max_num: int = 3) -> int:
+        """save_fn(path) writes the payload into a tmp dir; commit is an
+        atomic rename (the reference's tmp + mv dance)."""
+        self.fs.mkdirs(root)
+        nos = self._numbered(root)
+        no = (nos[-1] + 1) if nos else 0
+        final = os.path.join(root, "%s.%d" % (CKPT_PREFIX, no))
+        tmp = final + ".tmp"
+        self.fs.delete(tmp)
+        self.fs.mkdirs(tmp)
+        save_fn(tmp)
+        self.fs.mv(tmp, final)
+        for old in nos[:-max(0, max_num - 1)] if max_num > 0 else []:
+            self.fs.delete(os.path.join(root, "%s.%d" % (CKPT_PREFIX, old)))
+        return no
+
+    def get_checkpoint_no(self, root: str) -> List[int]:
+        return self._numbered(root)
+
+    def load_checkpoint(self, root: str, load_fn,
+                        checkpoint_no: Optional[int] = None):
+        nos = self._numbered(root)
+        if not nos:
+            return None
+        no = checkpoint_no if checkpoint_no is not None else nos[-1]
+        path = os.path.join(root, "%s.%d" % (CKPT_PREFIX, no))
+        load_fn(path)
+        return no
+
+    def clean_redundant_checkpoints(self, root: str, reserved: int = 1):
+        nos = self._numbered(root)
+        for old in nos[:-reserved] if reserved > 0 else nos:
+            self.fs.delete(os.path.join(root, "%s.%d" % (CKPT_PREFIX, old)))

@@ -1,0 +1,143 @@
+"""Inference API: Config + Predictor.
+
+TPU-native analog of the reference's AnalysisPredictor
+(/root/reference/paddle/fluid/inference/api/analysis_predictor.h:82;
+Run: analysis_predictor.cc:288, ZeroCopyRun:715,
+OptimizeInferenceProgram:500). The reference loads a ProgramDesc, runs an
+IR pass pipeline (fusions, TensorRT subgraphs), then interprets ops per
+request. Here the loaded Program is traced ONCE into a single jitted XLA
+computation per input-shape signature — XLA plays the role of the whole
+analysis pass pipeline (fusion, layout, constant folding), and repeated
+Run() calls hit the compiled executable.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core.executor import Executor
+from .core.program import Program
+from .core.scope import Scope
+from . import io as _io
+
+__all__ = ["Config", "AnalysisConfig", "Predictor", "create_predictor",
+           "PredictorTensor"]
+
+
+class Config:
+    """AnalysisConfig analog (inference/api/paddle_analysis_config.h).
+    GPU/MKLDNN/TensorRT toggles are accepted for API parity; XLA on TPU
+    owns those decisions."""
+
+    def __init__(self, model_dir: Optional[str] = None,
+                 prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self.model_dir = model_dir
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._ir_optim = True
+        self._bf16 = False
+
+    # parity knobs (no-ops or simple flags)
+    def disable_gpu(self):
+        pass
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        pass
+
+    def switch_ir_optim(self, x: bool = True):
+        self._ir_optim = x
+
+    def enable_mkldnn_bfloat16(self):
+        self._bf16 = True
+
+    def enable_bf16(self):
+        self._bf16 = True
+
+
+AnalysisConfig = Config
+
+
+class PredictorTensor:
+    """ZeroCopyTensor analog: named input/output handle."""
+
+    def __init__(self, name: str, predictor: "Predictor", is_input: bool):
+        self.name = name
+        self._pred = predictor
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr):
+        assert self._is_input
+        self._pred._feeds[self.name] = np.asarray(arr)
+
+    def reshape(self, shape):
+        pass  # shapes come from the fed array
+
+    def copy_to_cpu(self):
+        assert not self._is_input
+        return np.asarray(self._pred._outputs[self.name])
+
+
+class Predictor:
+    def __init__(self, config: Config, scope: Optional[Scope] = None):
+        self.config = config
+        self.scope = scope or Scope()
+        self.exe = Executor()
+        if config.model_dir is None:
+            raise ValueError("Config.model_dir is required")
+        self.program, self.feed_names, self.fetch_names = \
+            _io.load_inference_model(
+                config.model_dir, self.exe,
+                model_filename=config.prog_file,
+                params_filename=config.params_file,
+                scope=self.scope)
+        if config._bf16:
+            self._cast_params_bf16()
+        self._feeds: Dict[str, np.ndarray] = {}
+        self._outputs: Dict[str, np.ndarray] = {}
+
+    def _cast_params_bf16(self):
+        import jax.numpy as jnp
+        for v in self.program.list_vars():
+            if not v.persistable:
+                continue
+            val = self.scope.find_var(v.name)
+            if val is not None and hasattr(val, "dtype") and \
+                    val.dtype == jnp.float32:
+                self.scope.set(v.name, val.astype(jnp.bfloat16))
+
+    # --- ZeroCopy-style API (analysis_predictor.cc:715) -----------------
+    def get_input_names(self) -> List[str]:
+        return list(self.feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self.fetch_names)
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        assert name in self.feed_names, name
+        return PredictorTensor(name, self, True)
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        assert name in self.fetch_names, name
+        return PredictorTensor(name, self, False)
+
+    def run(self, feeds: Optional[Sequence[np.ndarray]] = None):
+        """Positional run (Run: analysis_predictor.cc:288) or ZeroCopyRun
+        over handles set via copy_from_cpu."""
+        if feeds is not None:
+            self._feeds = dict(zip(self.feed_names, feeds))
+        missing = [n for n in self.feed_names if n not in self._feeds]
+        if missing:
+            raise RuntimeError("missing inputs: %s" % missing)
+        outs = self.exe.run(self.program, feed=dict(self._feeds),
+                            fetch_list=list(self.fetch_names),
+                            scope=self.scope)
+        self._outputs = dict(zip(self.fetch_names, outs))
+        return [self._outputs[n] for n in self.fetch_names]
+
+
+def create_predictor(config: Config) -> Predictor:
+    """CreatePaddlePredictor analog (analysis_predictor.cc:1016)."""
+    return Predictor(config)

@@ -1,0 +1,272 @@
+"""Model persistence: parameters, programs, inference bundles.
+
+TPU-native analog of /root/reference/python/paddle/fluid/io.py
+(save_persistables:598, save_inference_model:1164, save:1669,
+load_inference_model:1374) and of the reference's save/load *ops*
+(operators/save_op.cc, load_op.cc, save_combine_op.cc): where the
+reference appends save/load ops to programs and runs them through the
+executor, here persistence is a host-side operation over the Scope
+(XLA owns device buffers; jax.device_get stages them out) — there is no
+op-graph detour to replicate.
+
+Formats:
+- parameters: one combined ``.npz`` (named arrays; SelectedRows and
+  scalar RNG state excluded) — the save_combine_op analog.
+- program: the Program IR's canonical JSON (``__model__`` file), the
+  ProgramDesc protobuf analog (core/program.py to_json/from_json).
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core.executor import RNG_VAR
+from .core.program import Program, VarDesc, default_main_program
+from .core.scope import Scope, global_scope
+
+__all__ = [
+    "save_vars", "save_persistables", "save_params", "load_vars",
+    "load_persistables", "load_params", "save_inference_model",
+    "load_inference_model", "save", "load", "save_dygraph", "load_dygraph",
+    "prune_program",
+]
+
+
+def _scope_of(scope):
+    return scope if scope is not None else global_scope()
+
+
+def _collect(program: Program, scope: Scope, predicate) -> Dict[str, np.ndarray]:
+    out = {}
+    for var in program.list_vars():
+        if not predicate(var):
+            continue
+        val = scope.find_var(var.name)
+        if val is None:
+            continue
+        out[var.name] = np.asarray(val)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# variable-level save/load (io.py:save_vars:200, load_vars:715)
+# ---------------------------------------------------------------------------
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None, scope=None):
+    program = main_program or default_main_program()
+    scope = _scope_of(scope)
+    if vars is not None:
+        names = [v.name if isinstance(v, VarDesc) else str(v) for v in vars]
+        data = {}
+        for n in names:
+            val = scope.find_var(n)
+            if val is None:
+                raise RuntimeError("save_vars: %r not found in scope" % n)
+            data[n] = np.asarray(val)
+    else:
+        predicate = predicate or (lambda v: v.persistable)
+        data = _collect(program, scope, predicate)
+    path = os.path.join(dirname, filename or "__params__.npz")
+    os.makedirs(dirname, exist_ok=True)
+    np.savez(path, **data)
+    return sorted(data)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None, scope=None):
+    program = main_program or default_main_program()
+    scope = _scope_of(scope)
+    path = os.path.join(dirname, filename or "__params__.npz")
+    with np.load(path) as zf:
+        data = {k: zf[k] for k in zf.files}
+    if vars is not None:
+        names = [v.name if isinstance(v, VarDesc) else str(v) for v in vars]
+    else:
+        predicate = predicate or (lambda v: v.persistable)
+        names = [v.name for v in program.list_vars() if predicate(v)]
+    import jax.numpy as jnp
+    missing = []
+    for n in names:
+        if n == RNG_VAR:
+            continue
+        if n in data:
+            scope.set(n, jnp.asarray(data[n]))
+        else:
+            missing.append(n)
+    if missing:
+        raise RuntimeError("load_vars: missing in %s: %s" % (path, missing))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    """io.py:598 — every persistable var of the program."""
+    return save_vars(executor, dirname, main_program,
+                     predicate=lambda v: v.persistable and v.name != RNG_VAR,
+                     filename=filename, scope=scope)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=lambda v: v.persistable and v.name != RNG_VAR,
+                     filename=filename, scope=scope)
+
+
+def save_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    """io.py:471 — trainable parameters only (no optimizer accumulators)."""
+    return save_vars(executor, dirname, main_program,
+                     predicate=lambda v: getattr(v, "is_parameter", False),
+                     filename=filename, scope=scope)
+
+
+def load_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=lambda v: getattr(v, "is_parameter", False),
+                     filename=filename, scope=scope)
+
+
+# ---------------------------------------------------------------------------
+# program pruning (framework.py Program._prune / _prune_with_input)
+# ---------------------------------------------------------------------------
+
+def prune_program(program: Program, feed_names: Sequence[str],
+                  fetch_names: Sequence[str]) -> Program:
+    """Backward slice of the global block: keep only ops (transitively)
+    producing the fetch vars, stopping at feeds. Ops carrying sub-block
+    attrs keep their sub-blocks whole (conservative, like the reference's
+    prune of control-flow ops)."""
+    src = Program.from_dict(program.to_dict())  # deep copy
+    block = src.global_block
+    needed = set(fetch_names)
+    feed_set = set(feed_names)
+    kept = []
+    for op in reversed(list(block.ops)):
+        outs = [n for ns in op.outputs.values() for n in ns]
+        if any(o in needed for o in outs):
+            kept.append(op)
+            for ns in op.inputs.values():
+                for n in ns:
+                    if n not in feed_set:
+                        needed.add(n)
+    kept.reverse()
+    block.ops = kept
+    # drop vars unused by surviving ops (keep feeds/fetches)
+    used = set(feed_names) | set(fetch_names)
+    for op in kept:
+        for ns in op.inputs.values():
+            used.update(ns)
+        for ns in op.outputs.values():
+            used.update(ns)
+    block.vars = {n: v for n, v in block.vars.items() if n in used}
+    src._version += 1
+    return src
+
+
+# ---------------------------------------------------------------------------
+# inference bundle (io.py:1164 save_inference_model / :1374 load)
+# ---------------------------------------------------------------------------
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, scope=None):
+    program = main_program or default_main_program()
+    scope = _scope_of(scope)
+    fetch_names = [v.name if isinstance(v, VarDesc) else str(v)
+                   for v in target_vars]
+    pruned = prune_program(program.clone(for_test=True), feeded_var_names,
+                           fetch_names)
+    os.makedirs(dirname, exist_ok=True)
+    meta = {"program": pruned.to_dict(), "feed_names": list(feeded_var_names),
+            "fetch_names": fetch_names, "format_version": 1}
+    with open(os.path.join(dirname, model_filename or "__model__"),
+              "w") as f:
+        json.dump(meta, f)
+    # persist every persistable the pruned program still references
+    save_vars(executor, dirname, pruned,
+              predicate=lambda v: v.persistable and v.name != RNG_VAR,
+              filename=params_filename, scope=scope)
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None, scope=None):
+    with open(os.path.join(dirname, model_filename or "__model__")) as f:
+        meta = json.load(f)
+    program = Program.from_dict(meta["program"])
+    scope = _scope_of(scope)
+    load_vars(executor, dirname, program,
+              predicate=lambda v: v.persistable and v.name != RNG_VAR,
+              filename=params_filename, scope=scope)
+    return program, meta["feed_names"], meta["fetch_names"]
+
+
+# ---------------------------------------------------------------------------
+# paddle.save/load pickle-style (io.py:1669) + dygraph state dicts
+# ---------------------------------------------------------------------------
+
+def save(obj, path):
+    """fluid.save(program, path) writes <path>.pdparams/.pdmodel; also
+    accepts a plain state dict (paddle.save v2 style) -> single pickle."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    if isinstance(obj, Program):
+        scope = global_scope()
+        params = _collect(obj, scope, lambda v: getattr(v, "is_parameter",
+                                                        False))
+        opt = _collect(obj, scope,
+                       lambda v: v.persistable and
+                       not getattr(v, "is_parameter", False) and
+                       v.name != RNG_VAR)
+        with open(path + ".pdparams", "wb") as f:
+            pickle.dump(params, f, protocol=2)
+        with open(path + ".pdopt", "wb") as f:
+            pickle.dump(opt, f, protocol=2)
+        with open(path + ".pdmodel", "w") as f:
+            f.write(obj.to_json())
+    else:
+        state = {k: np.asarray(v) for k, v in dict(obj).items()}
+        with open(path, "wb") as f:
+            pickle.dump(state, f, protocol=2)
+
+
+def load(program_or_path, path=None):
+    """fluid.load(program, path) restores params+opt state into the scope;
+    load(path) returns the pickled state dict."""
+    import jax.numpy as jnp
+    if isinstance(program_or_path, Program):
+        assert path is not None
+        scope = global_scope()
+        for suffix in (".pdparams", ".pdopt"):
+            p = path + suffix
+            if not os.path.exists(p):
+                continue
+            with open(p, "rb") as f:
+                state = pickle.load(f)
+            for k, v in state.items():
+                scope.set(k, jnp.asarray(v))
+        return None
+    with open(program_or_path, "rb") as f:
+        return pickle.load(f)
+
+
+def save_dygraph(state_dict, model_path):
+    """dygraph/checkpoint.py:33 save_dygraph — state dict -> .pdparams."""
+    save(state_dict, model_path + ".pdparams"
+         if not model_path.endswith(".pdparams") else model_path)
+
+
+def load_dygraph(model_path):
+    """dygraph/checkpoint.py:168 — returns (param_dict, opt_dict|None)."""
+    base = model_path[:-9] if model_path.endswith(".pdparams") \
+        else model_path
+    params = load(base + ".pdparams")
+    opt = load(base + ".pdopt") if os.path.exists(base + ".pdopt") else None
+    return params, opt

@@ -352,6 +352,17 @@ def softmax_with_cross_entropy(logits: VarDesc, label: VarDesc,
     return loss
 
 
+def square_error_cost(input: VarDesc, label: VarDesc,
+                      name: Optional[str] = None) -> VarDesc:
+    """(input - label)^2 elementwise (layers/loss.py square_error_cost)."""
+    helper = LayerHelper("square_error_cost", name)
+    out = helper.create_tmp_variable(input.dtype, shape=input.shape)
+    helper.append_op("square_error_cost",
+                     inputs={"X": [input.name], "Y": [label.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
 def mean(x: VarDesc, name: Optional[str] = None) -> VarDesc:
     helper = LayerHelper("mean", name)
     out = helper.create_tmp_variable(x.dtype, shape=())

@@ -1,0 +1,173 @@
+"""save/load + inference predictor + auto-checkpoint tests.
+
+Mirrors the reference's book tests that save_inference_model then reload
+and check identical outputs (tests/book/test_fit_a_line.py) and the
+auto-checkpoint epoch-resume unit tests
+(unittests/test_auto_checkpoint.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _build_regression():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, 1, name="pred")
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = pt.optimizer.SGD(0.05)
+        opt.minimize(loss, startup_program=startup, program=main)
+    return main, startup, pred, loss
+
+
+def _train(exe, main, loss, steps=10, seed=0):
+    rng = np.random.RandomState(seed)
+    out = None
+    for _ in range(steps):
+        xb = rng.randn(16, 4).astype(np.float32)
+        yb = (xb @ np.array([[1.], [2.], [-1.], [0.5]], np.float32)
+              + 0.1).astype(np.float32)
+        out, = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+    return out
+
+
+def test_save_load_persistables(tmp_path):
+    main, startup, pred, loss = _build_regression()
+    exe = pt.Executor()
+    exe.run(startup)
+    _train(exe, main, loss)
+    wname = main.all_parameters()[0].name
+    w = np.asarray(pt.global_scope().find_var(wname))
+
+    pt.save_persistables(exe, str(tmp_path), main)
+
+    # clobber + reload
+    pt.global_scope().set(wname, np.zeros_like(w))
+    pt.load_persistables(exe, str(tmp_path), main)
+    np.testing.assert_allclose(
+        np.asarray(pt.global_scope().find_var(wname)), w)
+
+
+def test_save_load_program_pickle_style(tmp_path):
+    main, startup, pred, loss = _build_regression()
+    exe = pt.Executor()
+    exe.run(startup)
+    _train(exe, main, loss)
+    wname = main.all_parameters()[0].name
+    w = np.asarray(pt.global_scope().find_var(wname))
+    path = str(tmp_path / "model")
+    pt.save(main, path)
+    assert os.path.exists(path + ".pdparams")
+    assert os.path.exists(path + ".pdmodel")
+    pt.global_scope().set(wname, np.zeros_like(w))
+    pt.load(main, path)
+    np.testing.assert_allclose(
+        np.asarray(pt.global_scope().find_var(wname)), w)
+
+
+def test_inference_model_roundtrip(tmp_path):
+    main, startup, pred, loss = _build_regression()
+    exe = pt.Executor()
+    exe.run(startup)
+    _train(exe, main, loss)
+
+    xb = np.random.RandomState(7).randn(5, 4).astype(np.float32)
+    d = str(tmp_path / "infer")
+    pt.save_inference_model(d, ["x"], [pred], exe, main)
+    # expectation from the pruned graph in the ORIGINAL scope (running the
+    # full main program would also apply its sgd op and move the weights)
+    from paddle_tpu.io import prune_program
+    infer_prog = prune_program(main.clone(for_test=True), ["x"], [pred.name])
+    expect, = exe.run(infer_prog, feed={"x": xb}, fetch_list=[pred.name])
+
+    # fresh scope reload
+    exe2 = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        prog, feeds, fetches = pt.load_inference_model(d, exe2)
+        assert feeds == ["x"]
+        # pruned program must not contain the backward/optimizer ops
+        types = {op.type for op in prog.global_block.ops}
+        assert "sgd" not in types and not any("grad" in t for t in types)
+        got, = exe2.run(prog, feed={"x": xb}, fetch_list=fetches)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor(tmp_path):
+    main, startup, pred, loss = _build_regression()
+    exe = pt.Executor()
+    exe.run(startup)
+    _train(exe, main, loss)
+    xb = np.random.RandomState(3).randn(6, 4).astype(np.float32)
+    d = str(tmp_path / "infer")
+    pt.save_inference_model(d, ["x"], [pred], exe, main)
+    from paddle_tpu.io import prune_program
+    infer_prog = prune_program(main.clone(for_test=True), ["x"], [pred.name])
+    expect, = exe.run(infer_prog, feed={"x": xb}, fetch_list=[pred.name])
+
+    from paddle_tpu.inference import Config, create_predictor
+    cfg = Config(model_dir=d)
+    predictor = create_predictor(cfg)
+    assert predictor.get_input_names() == ["x"]
+    h = predictor.get_input_handle("x")
+    h.copy_from_cpu(xb)
+    predictor.run()
+    out = predictor.get_output_handle(predictor.get_output_names()[0]) \
+        .copy_to_cpu()
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_dygraph_state_dict_roundtrip(tmp_path):
+    from paddle_tpu.nn.layers_lib import Linear
+    was_dygraph = pt.in_dygraph_mode()
+    pt.disable_static()
+    try:
+        lin = Linear(4, 3)
+        sd = lin.state_dict()
+        pt.save_dygraph(sd, str(tmp_path / "lin"))
+        params, opt = pt.load_dygraph(str(tmp_path / "lin"))
+        assert opt is None
+        lin2 = Linear(4, 3)
+        lin2.set_state_dict(params)
+        for k in sd:
+            np.testing.assert_allclose(np.asarray(sd[k]),
+                                       np.asarray(lin2.state_dict()[k]))
+    finally:
+        if not was_dygraph:
+            pt.enable_static()
+
+
+def test_auto_checkpoint_resume(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_RUNNING_ENV", "PADDLE_EDL_AUTO_CHECKPOINT")
+    monkeypatch.setenv("PADDLE_CHECKPOINT_DIR", str(tmp_path / "ac"))
+    monkeypatch.setenv("PADDLE_JOB_ID", "job0")
+    import paddle_tpu.incubate.checkpoint.auto_checkpoint as ac
+    monkeypatch.setattr(ac, "_checker", None)
+
+    main, startup, pred, loss = _build_regression()
+    exe = pt.Executor()
+
+    with pt.program_guard(main, startup):
+        exe.run(startup)
+        seen = []
+        for epoch in ac.train_epoch_range(3, name="r1",
+                                          save_checkpoint_inter=0):
+            seen.append(epoch)
+            _train(exe, main, loss, steps=2, seed=epoch)
+        assert seen == [0, 1, 2]
+        wname = main.all_parameters()[0].name
+        w_done = np.asarray(pt.global_scope().find_var(wname))
+
+        # "restart": epochs should all be skipped, weights restored
+        monkeypatch.setattr(ac, "_checker", None)
+        pt.global_scope().set(wname, np.zeros_like(w_done))
+        seen2 = [e for e in ac.train_epoch_range(3, name="r1",
+                                                 save_checkpoint_inter=0)]
+        assert seen2 == []
+        np.testing.assert_allclose(
+            np.asarray(pt.global_scope().find_var(wname)), w_done)
