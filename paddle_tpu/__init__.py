@@ -30,6 +30,10 @@ from .io import (save, load, save_persistables, load_persistables,  # noqa: F401
                  load_inference_model, save_dygraph, load_dygraph)
 from . import inference  # noqa: F401
 from . import incubate  # noqa: F401
+from . import reader  # noqa: F401
+from .reader import DataLoader, batch  # noqa: F401
+from . import dataset  # noqa: F401
+from .dataset import DatasetFactory  # noqa: F401
 
 
 class CPUPlace:

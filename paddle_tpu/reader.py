@@ -1,0 +1,358 @@
+"""DataLoader: batched, shuffled, multi-worker host pipeline with device
+prefetch.
+
+TPU-native analog of the reference DataLoader stack
+(/root/reference/python/paddle/fluid/reader.py:123 DataLoader,
+fluid/dataloader/dataloader_iter.py:350 multiprocess workers over index
+queues + shared-memory tensor transport, operators/reader/
+buffered_reader.h:32 double-buffered async H2D). Mapping:
+- worker processes -> multiprocessing.Pool-style _WorkerLoop procs
+  feeding a result queue (numpy arrays pickle through; the reference's
+  mmap_allocator shared-memory fast path is an optimization XLA's
+  pinned-host staging makes unnecessary),
+- LoDTensorBlockingQueue + read op -> a bounded Queue the iterator
+  drains,
+- buffered_reader double-buffering -> a prefetch thread that issues
+  jax.device_put one batch ahead of compute.
+
+Also provides the classic `paddle.reader` decorators (shuffle, batch,
+buffered, xmap) and `paddle.batch`.
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue as _queue
+import threading
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Dataset", "IterableDataset", "BatchSampler", "DataLoader",
+           "batch", "shuffle", "buffered", "xmap_readers"]
+
+
+class Dataset:
+    """Map-style dataset (fluid/dataloader/dataset.py)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset:
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class TensorDataset(Dataset):
+    def __init__(self, *arrays):
+        assert arrays and all(len(a) == len(arrays[0]) for a in arrays)
+        self.arrays = arrays
+
+    def __getitem__(self, idx):
+        return tuple(a[idx] for a in self.arrays)
+
+    def __len__(self):
+        return len(self.arrays[0])
+
+
+class BatchSampler:
+    """fluid/dataloader/batch_sampler.py."""
+
+    def __init__(self, dataset=None, shuffle: bool = False,
+                 batch_size: int = 1, drop_last: bool = False,
+                 num_samples: Optional[int] = None, seed: Optional[int] = None):
+        self.n = num_samples if num_samples is not None else len(dataset)
+        self.shuffle = shuffle
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._seed = seed
+        self._epoch = 0
+
+    def __iter__(self):
+        order = np.arange(self.n)
+        if self.shuffle:
+            rng = np.random.RandomState(
+                self._seed + self._epoch if self._seed is not None else None)
+            rng.shuffle(order)
+            self._epoch += 1
+        for i in range(0, self.n, self.batch_size):
+            idx = order[i:i + self.batch_size]
+            if len(idx) < self.batch_size and self.drop_last:
+                break
+            yield list(idx)
+
+    def __len__(self):
+        if self.drop_last:
+            return self.n // self.batch_size
+        return (self.n + self.batch_size - 1) // self.batch_size
+
+
+def default_collate_fn(batch_items: Sequence) -> Any:
+    first = batch_items[0]
+    if isinstance(first, (tuple, list)):
+        return tuple(default_collate_fn([it[i] for it in batch_items])
+                     for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: default_collate_fn([it[k] for it in batch_items])
+                for k in first}
+    return np.stack([np.asarray(x) for x in batch_items])
+
+
+def _worker_loop(dataset, index_queue, result_queue, collate_fn):
+    """dataloader_iter.py:350 _worker_loop: pull index batch, fetch
+    samples, push collated result."""
+    while True:
+        job = index_queue.get()
+        if job is None:
+            break
+        job_id, indices = job
+        try:
+            samples = [dataset[i] for i in indices]
+            result_queue.put((job_id, collate_fn(samples), None))
+        except Exception as e:  # propagate to the main process
+            result_queue.put((job_id, None, repr(e)))
+
+
+class _MultiprocessIter:
+    def __init__(self, loader):
+        self.loader = loader
+        ds = loader.dataset
+        nw = loader.num_workers
+        ctx = mp.get_context("fork")
+        self._index_queues = [ctx.Queue() for _ in range(nw)]
+        self._result_queue = ctx.Queue()
+        self._workers = [
+            ctx.Process(target=_worker_loop,
+                        args=(ds, self._index_queues[i], self._result_queue,
+                              loader.collate_fn), daemon=True)
+            for i in range(nw)]
+        for w in self._workers:
+            w.start()
+        self._batches = iter(loader.batch_sampler)
+        self._send_idx = 0
+        self._rcv_idx = 0
+        self._reorder = {}
+        self._done_sending = False
+        # keep 2 jobs in flight per worker (prefetch_factor)
+        for _ in range(2 * nw):
+            self._dispatch()
+
+    def _dispatch(self):
+        try:
+            indices = next(self._batches)
+        except StopIteration:
+            self._done_sending = True
+            return
+        self._index_queues[self._send_idx % len(self._index_queues)].put(
+            (self._send_idx, indices))
+        self._send_idx += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._rcv_idx >= self._send_idx and self._done_sending:
+            self._shutdown()
+            raise StopIteration
+        while self._rcv_idx not in self._reorder:
+            job_id, data, err = self._result_queue.get()
+            if err is not None:
+                self._shutdown()
+                raise RuntimeError("DataLoader worker failed: %s" % err)
+            self._reorder[job_id] = data
+        data = self._reorder.pop(self._rcv_idx)
+        self._rcv_idx += 1
+        self._dispatch()
+        return data
+
+    def _shutdown(self):
+        for q in self._index_queues:
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for w in self._workers:
+            w.join(timeout=1)
+            if w.is_alive():
+                w.terminate()
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
+class _DevicePrefetcher:
+    """buffered_reader.h:32 analog: stage the NEXT batch onto the device
+    while the current one computes."""
+
+    def __init__(self, it: Iterable, depth: int = 2):
+        import jax
+        self._jax = jax
+        self._q: _queue.Queue = _queue.Queue(maxsize=depth)
+        self._it = iter(it)
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        jax = self._jax
+        try:
+            for item in self._it:
+                staged = jax.tree.map(
+                    lambda x: jax.device_put(np.asarray(x))
+                    if isinstance(x, np.ndarray) or np.isscalar(x) else x,
+                    item)
+                self._q.put(("item", staged))
+        except Exception as e:
+            self._q.put(("err", e))
+            return
+        self._q.put(("end", None))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        kind, val = self._q.get()
+        if kind == "end":
+            raise StopIteration
+        if kind == "err":
+            raise val
+        return val
+
+
+class DataLoader:
+    """reader.py:123. use_buffer_reader enables device prefetch."""
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list: bool = True, batch_sampler=None,
+                 batch_size: int = 1, shuffle: bool = False,
+                 drop_last: bool = False, collate_fn=None,
+                 num_workers: int = 0, use_buffer_reader: bool = True,
+                 prefetch_factor: int = 2, use_shared_memory: bool = True,
+                 timeout: int = 0, worker_init_fn=None, seed=None):
+        self.dataset = dataset
+        self.num_workers = max(0, int(num_workers))
+        self.collate_fn = collate_fn or default_collate_fn
+        self.use_buffer_reader = use_buffer_reader
+        self.return_list = return_list
+        self._iterable_src = isinstance(dataset, IterableDataset) or (
+            not hasattr(dataset, "__getitem__") and
+            hasattr(dataset, "__iter__"))
+        if not self._iterable_src:
+            self.batch_sampler = batch_sampler or BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last, seed=seed)
+        else:
+            self.batch_sampler = None
+            self._batch_size = batch_size
+            self._drop_last = drop_last
+
+    def _host_iter(self):
+        if self._iterable_src:
+            def gen():
+                it = iter(self.dataset)
+                while True:
+                    chunk = list(itertools.islice(it, self._batch_size))
+                    if not chunk:
+                        return
+                    if len(chunk) < self._batch_size and self._drop_last:
+                        return
+                    yield self.collate_fn(chunk)
+            return gen()
+        if self.num_workers == 0:
+            def gen():
+                for indices in self.batch_sampler:
+                    yield self.collate_fn([self.dataset[i]
+                                           for i in indices])
+            return gen()
+        return _MultiprocessIter(self)
+
+    def __iter__(self):
+        it = self._host_iter()
+        if self.use_buffer_reader:
+            return iter(_DevicePrefetcher(it))
+        return iter(it)
+
+    def __len__(self):
+        if self.batch_sampler is not None:
+            return len(self.batch_sampler)
+        raise TypeError("length of an iterable-dataset DataLoader "
+                        "is unknown")
+
+
+# ---------------------------------------------------------------------------
+# classic reader decorators (python/paddle/reader/decorator.py)
+# ---------------------------------------------------------------------------
+
+def batch(reader: Callable, batch_size: int, drop_last: bool = False):
+    def gen():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return gen
+
+
+def shuffle(reader: Callable, buf_size: int, seed=None):
+    def gen():
+        rng = np.random.RandomState(seed)
+        buf: List = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            yield from buf
+    return gen
+
+
+def buffered(reader: Callable, size: int):
+    def gen():
+        q: _queue.Queue = _queue.Queue(maxsize=size)
+        END = object()
+
+        def fill():
+            try:
+                for item in reader():
+                    q.put(item)
+            finally:
+                q.put(END)
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is END:
+                return
+            yield item
+    return gen
+
+
+def xmap_readers(mapper: Callable, reader: Callable, process_num: int,
+                 buffer_size: int, order: bool = False):
+    """Parallel map over a reader via threads (reference uses threads
+    too: reader/decorator.py xmap_readers)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def gen():
+        with ThreadPoolExecutor(max_workers=process_num) as pool:
+            it = reader()
+            window = []
+            for item in it:
+                window.append(pool.submit(mapper, item))
+                if len(window) >= buffer_size:
+                    yield window.pop(0).result()
+            for fut in window:
+                yield fut.result()
+    return gen
