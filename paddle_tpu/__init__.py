@@ -18,9 +18,9 @@ from . import core  # noqa: F401
 from . import ops  # noqa: F401  (registers the op library)
 from .core import (Executor, Program, append_backward,  # noqa: F401
                    default_main_program, default_startup_program,
-                   disable_static, enable_static, global_scope, gradients,
-                   in_dygraph_mode, in_static_mode, program_guard,
-                   scope_guard, Scope)
+                   device_guard, disable_static, enable_static,
+                   global_scope, gradients, in_dygraph_mode, in_static_mode,
+                   program_guard, scope_guard, Scope)
 from .layers.helper import ParamAttr  # noqa: F401
 from . import layers  # noqa: F401
 from . import optimizer  # noqa: F401

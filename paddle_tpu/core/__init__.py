@@ -3,7 +3,7 @@ from .backward import append_backward, gradients  # noqa: F401
 from .executor import Executor  # noqa: F401
 from .program import (  # noqa: F401
     Block, OpDesc, Program, VarDesc, default_main_program,
-    default_startup_program, disable_static, enable_static, in_dygraph_mode,
-    in_static_mode, program_guard)
+    default_startup_program, device_guard, disable_static, enable_static,
+    in_dygraph_mode, in_static_mode, program_guard)
 from .registry import REGISTRY, register_op  # noqa: F401
 from .scope import Scope, global_scope, scope_guard  # noqa: F401

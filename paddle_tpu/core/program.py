@@ -197,6 +197,10 @@ class Block:
     # --- op management --------------------------------------------------
     def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> OpDesc:
         op = OpDesc(type, inputs, outputs, attrs)
+        if _state.current_device is not None:
+            # device_guard stamp (framework.py:5516: each op's op_device
+            # attr drives PipelineOptimizer._split_program)
+            op.attrs.setdefault("op_device", _state.current_device)
         self.ops.append(op)
         self.program._bump()
         return op
@@ -330,6 +334,7 @@ class _GlobalState:
         self.main_program = Program()
         self.startup_program = Program()
         self.static_mode = False  # eager by default, like paddle 2.x
+        self.current_device = None  # set by device_guard
 
 
 _state = _GlobalState()
@@ -392,4 +397,24 @@ class program_guard:
         switch_main_program(self._old_main)
         if self._old_startup is not None:
             switch_startup_program(self._old_startup)
+        return False
+
+
+class device_guard:
+    """Stamp appended ops with an op_device attr (framework.py:5516
+    fluid.device_guard). Device strings follow the reference's
+    "gpu:<stage>" convention; here the stage index is what matters — the
+    pipeline compiler groups ops by it."""
+
+    def __init__(self, device: Optional[str] = None):
+        self._device = device
+        self._old = None
+
+    def __enter__(self):
+        self._old = _state.current_device
+        _state.current_device = self._device
+        return self
+
+    def __exit__(self, *exc):
+        _state.current_device = self._old
         return False

@@ -4,3 +4,6 @@ from .env import (DistEnv, get_env, get_mesh, get_rank,  # noqa: F401
 from .collective import (all_gather, all_reduce, all_to_all, barrier,  # noqa: F401
                          broadcast, ppermute, reduce_scatter, ring_axis,
                          set_ring_axis)
+from .pipeline import (gpipe, stack_stage_params, PipelineLayer,  # noqa: F401
+                       PipelineOptimizer, split_program_by_device)
+from .ring_attention import ring_attention, ulysses_attention  # noqa: F401

@@ -1,0 +1,202 @@
+"""Pipeline parallelism: GPipe microbatching over a mesh 'pp' axis.
+
+TPU-native replacement for the reference's pipeline stack
+(/root/reference/python/paddle/fluid/optimizer.py:3666 PipelineOptimizer
+splitting programs by device_guard; framework/trainer.h:207
+PipelineTrainer + section_worker.cc:82 running microbatches through
+per-stage threads with queue vars between sections). Instead of threads
+and queues, the schedule is a single SPMD computation: stage parameters
+are stacked on a leading axis and shard_map'ed over 'pp', and one
+lax.scan plays the GPipe clock — each tick every device runs its stage
+on its current microbatch and lax.ppermute hands the activation to the
+next stage over ICI. Bubbles are the scan steps where a stage's input is
+not yet (or no longer) valid; their results are masked out. The whole
+schedule — forward, backward (jax.grad through ppermute reverses the
+ring), and optimizer — compiles to one XLA program.
+
+Constraints (inherent to the SPMD formulation): every stage consumes and
+produces activations of the same shape [mb, ...]; heterogeneous head /
+embedding layers run replicated outside the pipelined middle.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .env import PP_AXIS
+
+
+def stack_stage_params(per_stage_params: Sequence[Any]):
+    """[pytree per stage] -> single pytree with leading stage dim, ready
+    to shard over 'pp'."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
+          stacked_params: Any,
+          x: jax.Array,
+          num_microbatches: int,
+          mesh: Mesh,
+          axis: str = PP_AXIS,
+          remat: bool = True) -> jax.Array:
+    """Run x through num_stages pipeline stages with GPipe microbatching.
+
+    stage_fn(params_of_one_stage, act[mb, ...]) -> act[mb, ...]
+    stacked_params: leading dim == mesh.shape[axis] (see
+    stack_stage_params)
+    x: [B, ...] with B % num_microbatches == 0.
+
+    Differentiable end-to-end; with remat=True each stage's forward is
+    rematerialized in the backward (the reference reaches the same
+    memory trade via recompute checkpointing, backward.py:145).
+    """
+    n_stages = mesh.shape[axis]
+    B = x.shape[0]
+    assert B % num_microbatches == 0, (B, num_microbatches)
+    mb = B // num_microbatches
+    x_mb = x.reshape((num_microbatches, mb) + x.shape[1:])
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    T = num_microbatches + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def shard_body(params, x_all):
+        # params leaves carry a leading local-stage dim of 1
+        params = jax.tree.map(lambda p: p[0], params)
+        stage = jax.lax.axis_index(axis)
+        # mark the carries as device-varying along the pp axis (jax>=0.9
+        # shard_map vma tracking; the loop body makes them varying)
+        zero = jax.lax.pcast(jnp.zeros(x_all.shape[1:], x_all.dtype),
+                             (axis,), to="varying")
+        outs0 = jax.lax.pcast(jnp.zeros_like(x_all), (axis,), to="varying")
+
+        def tick(carry, t):
+            recv, outs = carry
+            src = jnp.clip(t, 0, num_microbatches - 1)
+            inp = jnp.where(stage == 0, x_all[src], recv)
+            y = fn(params, inp)
+            # collect on the last stage once the first microbatch arrives
+            out_idx = jnp.clip(t - (n_stages - 1), 0, num_microbatches - 1)
+            take = jnp.logical_and(stage == n_stages - 1,
+                                   t >= n_stages - 1)
+            outs = jnp.where(
+                take, jax.lax.dynamic_update_index_in_dim(
+                    outs, y, out_idx, 0), outs)
+            recv_new = jax.lax.ppermute(y, axis, perm)
+            return (recv_new, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (zero, outs0), jnp.arange(T))
+        # replicate the last stage's outputs to every device
+        return jax.lax.psum(
+            jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs)),
+            axis)
+
+    pspec_params = jax.tree.map(lambda _: P(axis), stacked_params)
+    out = jax.shard_map(
+        shard_body, mesh=mesh,
+        in_specs=(pspec_params, P()),
+        out_specs=P(),
+    )(stacked_params, x_mb)
+    return out.reshape((B,) + out.shape[2:])
+
+
+class PipelineLayer:
+    """Convenience wrapper: uniform dygraph blocks -> pipelined callable.
+
+    blocks: list of nn.Layer with matching in/out activation shapes (one
+    or more per stage; len(blocks) % n_stages == 0 — consecutive blocks
+    group onto a stage, the reference's section assignment).
+    """
+
+    def __init__(self, blocks, mesh: Mesh, num_microbatches: int,
+                 axis: str = PP_AXIS, remat: bool = True):
+        from ..jit import state_of, functional_call
+        from ..dygraph.tape import Tensor
+        n_stages = mesh.shape[axis]
+        assert len(blocks) % n_stages == 0, \
+            "blocks must divide evenly over stages"
+        self.blocks = list(blocks)
+        self.mesh = mesh
+        self.axis = axis
+        self.num_microbatches = num_microbatches
+        self.remat = remat
+        self.per_stage = len(blocks) // n_stages
+        self._functional_call = functional_call
+        self._Tensor = Tensor
+        states = [state_of(b) for b in blocks]
+        # group block states per stage, then stack across stages
+        self._keys = sorted(states[0])
+        grouped = []
+        for s in range(n_stages):
+            stage_blocks = states[s * self.per_stage:(s + 1) * self.per_stage]
+            grouped.append({"b%d_%s" % (i, k): v
+                            for i, st in enumerate(stage_blocks)
+                            for k, v in st.items()})
+        self.stacked = stack_stage_params(grouped)
+
+    def _stage_fn(self, params, act):
+        out = act
+        for i in range(self.per_stage):
+            st = {k.split("_", 1)[1]: v for k, v in params.items()
+                  if k.startswith("b%d_" % i)}
+            r, _ = self._functional_call(self.blocks[0], st,
+                                         self._Tensor(out), training=False)
+            out = r.value if hasattr(r, "value") else r
+        return out
+
+    def __call__(self, x):
+        return gpipe(self._stage_fn, self.stacked, x,
+                     self.num_microbatches, self.mesh, self.axis,
+                     self.remat)
+
+
+# ---------------------------------------------------------------------------
+# static-graph side: device_guard sections (optimizer.py:3790
+# PipelineOptimizer._split_program)
+# ---------------------------------------------------------------------------
+
+def split_program_by_device(program):
+    """Group the global block's ops into sections by their op_device
+    stamp (set under core.program.device_guard). Unstamped ops inherit
+    the previous op's device, like the reference's
+    _add_op_device_attr_for_op. Returns [(device, [OpDesc, ...]), ...] in
+    program order."""
+    sections = []
+    cur_dev, cur_ops = None, []
+    for op in program.global_block.ops:
+        dev = op.attrs.get("op_device", cur_dev)
+        if dev != cur_dev and cur_ops:
+            sections.append((cur_dev, cur_ops))
+            cur_ops = []
+        cur_dev = dev
+        cur_ops.append(op)
+    if cur_ops:
+        sections.append((cur_dev, cur_ops))
+    return sections
+
+
+class PipelineOptimizer:
+    """optimizer.py:3666 PipelineOptimizer API shell for the static path:
+    validates device_guard sections and delegates minimize to the inner
+    optimizer (single-program semantics are unchanged on one chip — the
+    executor compiles the whole block; XLA schedules across the stamped
+    sections). The *throughput* pipeline path on TPU is gpipe() /
+    PipelineLayer above, where stages live on a real mesh axis."""
+
+    def __init__(self, optimizer, num_microbatches: int = 1):
+        self._inner = optimizer
+        self.num_microbatches = num_microbatches
+
+    def minimize(self, loss, startup_program=None, program=None,
+                 parameter_list=None):
+        result = self._inner.minimize(loss, startup_program=startup_program,
+                                      program=program,
+                                      parameter_list=parameter_list)
+        from ..core.program import default_main_program
+        prog = program if program is not None else default_main_program()
+        self.sections = split_program_by_device(prog)
+        return result

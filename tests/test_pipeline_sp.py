@@ -1,0 +1,153 @@
+"""Pipeline parallelism + sequence/context parallelism tests on the
+8-device CPU mesh (conftest.py), mirroring the reference's strategy of
+local-process distributed tests (test_dist_base.py:594) — here
+single-process SPMD."""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import paddle_tpu as pt
+from paddle_tpu.parallel import (gpipe, ring_attention, stack_stage_params,
+                                 split_program_by_device, ulysses_attention)
+from paddle_tpu.kernels.flash_attention import attention_reference
+
+
+def _mesh(axis, n):
+    return Mesh(np.array(jax.devices()[:n]), (axis,))
+
+
+# ---------------------------------------------------------------------------
+# gpipe
+# ---------------------------------------------------------------------------
+
+def _mlp_stage(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _make_stages(n_stages, d, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"w": jnp.asarray(rng.randn(d, d) / math.sqrt(d),
+                              jnp.float32),
+             "b": jnp.asarray(rng.randn(d) * 0.1, jnp.float32)}
+            for _ in range(n_stages)]
+
+
+@pytest.mark.parametrize("n_micro", [4, 8])
+def test_gpipe_matches_sequential(n_micro):
+    n_stages, d, B = 4, 16, 16
+    stages = _make_stages(n_stages, d)
+    x = jnp.asarray(np.random.RandomState(1).randn(B, d), jnp.float32)
+
+    seq = x
+    for p in stages:
+        seq = _mlp_stage(p, seq)
+
+    mesh = _mesh("pp", n_stages)
+    out = gpipe(_mlp_stage, stack_stage_params(stages), x, n_micro, mesh)
+    np.testing.assert_allclose(out, seq, atol=1e-5, rtol=1e-5)
+
+
+def test_gpipe_grads_match_sequential():
+    n_stages, d, B = 2, 8, 8
+    stages = _make_stages(n_stages, d)
+    x = jnp.asarray(np.random.RandomState(2).randn(B, d), jnp.float32)
+    mesh = _mesh("pp", n_stages)
+    stacked = stack_stage_params(stages)
+
+    def loss_pipe(stacked):
+        return gpipe(_mlp_stage, stacked, x, 4, mesh).sum()
+
+    def loss_seq(stages):
+        h = x
+        for p in stages:
+            h = _mlp_stage(p, h)
+        return h.sum()
+
+    g_pipe = jax.grad(loss_pipe)(stacked)
+    g_seq = jax.grad(loss_seq)(stages)
+    for i in range(n_stages):
+        np.testing.assert_allclose(g_pipe["w"][i], g_seq[i]["w"],
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(g_pipe["b"][i], g_seq[i]["b"],
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_gpipe_jit_compiles_once():
+    n_stages, d, B = 4, 8, 8
+    stages = _make_stages(n_stages, d)
+    mesh = _mesh("pp", n_stages)
+    stacked = stack_stage_params(stages)
+    f = jax.jit(lambda s, x: gpipe(_mlp_stage, s, x, 4, mesh))
+    x = jnp.ones((B, d), jnp.float32)
+    out1 = f(stacked, x)
+    out2 = f(stacked, 2 * x)
+    assert out1.shape == (B, d) and not np.allclose(out1, out2)
+
+
+# ---------------------------------------------------------------------------
+# device_guard / static sections
+# ---------------------------------------------------------------------------
+
+def test_device_guard_sections():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [4])
+        with pt.device_guard("gpu:0"):
+            h = pt.layers.fc(x, 8)
+        with pt.device_guard("gpu:1"):
+            y = pt.layers.fc(h, 2)
+    secs = split_program_by_device(main)
+    devs = [d for d, _ in secs]
+    assert devs == ["gpu:0", "gpu:1"]
+    # every op in section 1 is stamped (or inherited) gpu:1
+    assert all(op.attrs.get("op_device", "gpu:1") == "gpu:1"
+               for op in secs[1][1])
+
+
+# ---------------------------------------------------------------------------
+# ring / ulysses attention
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(causal):
+    B, H, S, D = 2, 2, 64, 8
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+    mesh = _mesh("sp", 8)
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_grad():
+    B, H, S, D = 1, 2, 32, 8
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+    mesh = _mesh("sp", 4)
+    g_ring = jax.grad(lambda q, k, v: ring_attention(
+        q, k, v, mesh, causal=True).sum(), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: attention_reference(
+        q, k, v, causal=True).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_reference(causal):
+    B, H, S, D = 2, 8, 32, 4
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+    mesh = _mesh("sp", 8)
+    out = ulysses_attention(q, k, v, mesh, causal=causal)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
