@@ -1,0 +1,264 @@
+"""Structural (control-flow) op lowerings: while, conditional_block,
+tensor arrays.
+
+TPU-native analog of /root/reference/paddle/fluid/operators/controlflow/
+(while_op.cc:42 — an OperatorBase running its sub-block through a nested
+Executor with per-iteration step scopes; conditional_block_op.cc — same
+for an if-branch) and of the LoDTensorArray ops
+(operators/controlflow/{write,read}_to_array... lod_tensor_array ops).
+
+Design: these ops need *name-level* access to the traced environment (the
+reference gives them the Scope), so they are special-cased by the
+executor's _BlockLowerer rather than registered as value-level lowerings:
+
+- while        -> lax.while_loop with an explicit carry = the sub-block's
+                  externally-read + exported vars (the reference's
+                  step-scope saving maps to this carry). Forward only:
+                  XLA cannot reverse-differentiate a dynamic trip count;
+                  differentiable loops should build with lax.scan-style
+                  static unrolling (StaticRNN) instead.
+- conditional_block -> lax.cond; false branch forwards the pre-existing
+                  values of the block's outputs (so they must be
+                  assigned before the op, as the reference requires for
+                  grads). Differentiable.
+- write_to_array / read_from_array / array_length -> TensorArrays are
+  trace-time python lists in the environment. Writes append (the
+  canonical fluid pattern writes at index == length); reads with a
+  traced index stack the list and dynamically index. Arrays cannot
+  cross a `while` boundary (a growing list has no fixed XLA type) —
+  use them with build-time python loops, as StaticRNN does.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+STRUCTURAL_OPS = ("while", "conditional_block", "write_to_array",
+                  "read_from_array", "array_length")
+
+
+def _block_io(block) -> Tuple[Set[str], Set[str]]:
+    """(external_reads, writes) of a block: reads-before-writes vs all
+    writes, in op order."""
+    written: Set[str] = set()
+    ext: Set[str] = set()
+    for op in block.ops:
+        for ns in op.inputs.values():
+            for n in ns:
+                if n not in written:
+                    ext.add(n)
+        for ns in op.outputs.values():
+            written.update(ns)
+    return ext, written
+
+
+def _as_pred(x) -> jax.Array:
+    return jnp.reshape(jnp.asarray(x), ()).astype(bool)
+
+
+def lower_while(lowerer, op, env: Dict[str, Any]) -> None:
+    from .executor import _BlockLowerer  # cycle-free at call time
+    from .registry import LowerCtx
+
+    program = lowerer.program
+    sub = program.blocks[int(op.attr("sub_block"))]
+    cond_name = op.input("Condition")[0]
+    ext_reads, writes = _block_io(sub)
+    exported = writes & (set(env) | set(op.output("Out")))
+    carry_names = sorted(((ext_reads & set(env)) | exported | {cond_name}))
+    missing = [n for n in carry_names if n not in env]
+    if missing:
+        raise RuntimeError(
+            "while: loop vars %s must be assigned before the loop "
+            "(while_op.cc requires them in the outer scope)" % missing)
+    for n in carry_names:
+        if isinstance(env[n], list):
+            raise NotImplementedError(
+                "while: LoDTensorArray cannot cross a while boundary on "
+                "XLA (dynamic-length list has no fixed type); build the "
+                "loop with a python-level loop / StaticRNN instead")
+
+    key0 = lowerer.ctx.key_out
+
+    def cond_fn(carry):
+        vals, _ = carry
+        return _as_pred(vals[carry_names.index(cond_name)])
+
+    def body_fn(carry):
+        vals, key = carry
+        env2 = dict(env)  # loop-invariant outer vars stay visible
+        env2.update(zip(carry_names, vals))
+        ctx2 = LowerCtx(key, is_test=lowerer.ctx.is_test,
+                        mesh=lowerer.ctx.mesh)
+        sub_low = _BlockLowerer(program, ctx2)
+        sub_low.run_ops(sub.ops, env2)
+        new_vals = tuple(env2[n] for n in carry_names)
+        for n, old, new in zip(carry_names,
+                               vals, new_vals):
+            if jnp.shape(old) != jnp.shape(new) or \
+                    jnp.result_type(old) != jnp.result_type(new):
+                raise RuntimeError(
+                    "while: loop var %r changed shape/dtype across an "
+                    "iteration (%s/%s -> %s/%s); XLA while requires "
+                    "loop-invariant types" %
+                    (n, jnp.shape(old), jnp.result_type(old),
+                     jnp.shape(new), jnp.result_type(new)))
+        return new_vals, ctx2.key_out
+
+    init = (tuple(jnp.asarray(env[n]) for n in carry_names), key0)
+    final_vals, final_key = jax.lax.while_loop(cond_fn, body_fn, init)
+    lowerer.ctx._key = final_key
+    env.update(zip(carry_names, final_vals))
+    for n in op.output("Out"):
+        if n not in env:
+            raise RuntimeError("while: output %r never assigned" % n)
+
+
+def lower_conditional_block(lowerer, op, env: Dict[str, Any]) -> None:
+    from .executor import _BlockLowerer
+    from .registry import LowerCtx
+
+    program = lowerer.program
+    sub = program.blocks[int(op.attr("sub_block"))]
+    cond_name = op.input("Cond")[0]
+    out_names = list(op.output("Out"))
+    ext_reads, writes = _block_io(sub)
+    reads = sorted(ext_reads & set(env))
+    exports = sorted(set(out_names) or (writes & set(env)))
+    missing = [n for n in exports if n not in env]
+    if missing:
+        raise RuntimeError(
+            "conditional_block: outputs %s must be assigned before the op "
+            "so the false branch has values (fluid requires the same for "
+            "grad: conditional_block_op.cc)" % missing)
+
+    key0 = lowerer.ctx.key_out
+    read_vals = tuple(env[n] for n in reads)
+    out_prev = tuple(jnp.asarray(env[n]) for n in exports)
+
+    def true_fn(operands):
+        read_vals, out_prev, key = operands
+        env2 = dict(env)
+        env2.update(zip(reads, read_vals))
+        ctx2 = LowerCtx(key, is_test=lowerer.ctx.is_test,
+                        mesh=lowerer.ctx.mesh)
+        sub_low = _BlockLowerer(program, ctx2)
+        sub_low.run_ops(sub.ops, env2)
+        return tuple(jnp.asarray(env2[n]).astype(jnp.result_type(p))
+                     .reshape(jnp.shape(p))
+                     for n, p in zip(exports, out_prev))
+
+    def false_fn(operands):
+        _, out_prev, _ = operands
+        return out_prev
+
+    outs = jax.lax.cond(_as_pred(env[cond_name]), true_fn, false_fn,
+                        (read_vals, out_prev, key0))
+    # burn the key whether or not the branch ran, keeping the chain aligned
+    lowerer.ctx._key = jax.random.split(key0)[0] if key0 is not None else None
+    env.update(zip(exports, outs))
+
+
+def lower_write_to_array(lowerer, op, env: Dict[str, Any]) -> None:
+    x = env[op.input("X")[0]]
+    out_name = op.output("Out")[0]
+    arr = env.get(out_name)
+    if arr is None:
+        arr = []
+    elif not isinstance(arr, list):
+        raise RuntimeError("write_to_array: %r is not a tensor array"
+                           % out_name)
+    # canonical fluid pattern appends at index == length; a concrete
+    # in-range index overwrites (lod_tensor_array semantics)
+    idx = env.get(op.input("I")[0]) if op.input("I") else None
+    pos = None
+    if idx is not None:
+        try:
+            pos = int(np.asarray(jax.core.concrete_or_error(
+                None, idx, "write_to_array index")))
+        except Exception:
+            pos = None  # traced index -> append
+    new = list(arr)
+    if pos is not None and 0 <= pos < len(new):
+        new[pos] = x
+    else:
+        new.append(x)
+    env[out_name] = new
+
+
+def lower_read_from_array(lowerer, op, env: Dict[str, Any]) -> None:
+    arr = env[op.input("X")[0]]
+    if not isinstance(arr, list):
+        raise RuntimeError("read_from_array: input is not a tensor array")
+    if not arr:
+        raise RuntimeError("read_from_array: empty tensor array")
+    idx = env[op.input("I")[0]]
+    out_name = op.output("Out")[0]
+    try:
+        pos = int(np.asarray(jax.core.concrete_or_error(
+            None, idx, "read_from_array index")))
+        env[out_name] = arr[pos]
+    except Exception:
+        stacked = jnp.stack([jnp.asarray(v) for v in arr])
+        i = jnp.clip(jnp.reshape(jnp.asarray(idx), ()).astype(jnp.int32),
+                     0, len(arr) - 1)
+        env[out_name] = jax.lax.dynamic_index_in_dim(stacked, i,
+                                                     keepdims=False)
+
+
+def lower_array_length(lowerer, op, env: Dict[str, Any]) -> None:
+    arr = env[op.input("X")[0]]
+    env[op.output("Out")[0]] = jnp.asarray([len(arr)], jnp.int64)
+
+
+def lower_cond_block_pair(lowerer, op, env: Dict[str, Any]) -> None:
+    """layers.cond's lowering: both branch blocks under one lax.cond.
+    (The reference emits two conditional_blocks + select_input per
+    output; lax.cond is the native XLA merge and stays differentiable.)"""
+    from .executor import _BlockLowerer
+    from .registry import LowerCtx
+
+    program = lowerer.program
+    t_blk = program.blocks[int(op.attr("true_block"))]
+    f_blk = program.blocks[int(op.attr("false_block"))]
+    t_outs = list(op.attr("true_outs", []))
+    f_outs = list(op.attr("false_outs", []))
+    out_names = list(op.output("Out"))
+    cond_name = op.input("Cond")[0]
+
+    reads_t, _ = _block_io(t_blk)
+    reads_f, _ = _block_io(f_blk)
+    reads = sorted((reads_t | reads_f) & set(env))
+    key0 = lowerer.ctx.key_out
+    read_vals = tuple(env[n] for n in reads)
+
+    def run_branch(blk, outs):
+        def fn(operands):
+            read_vals, key = operands
+            env2 = dict(env)
+            env2.update(zip(reads, read_vals))
+            ctx2 = LowerCtx(key, is_test=lowerer.ctx.is_test,
+                            mesh=lowerer.ctx.mesh)
+            _BlockLowerer(program, ctx2).run_ops(blk.ops, env2)
+            return tuple(jnp.asarray(env2[n]) for n in outs)
+        return fn
+
+    true_fn = run_branch(t_blk, t_outs)
+    false_fn = run_branch(f_blk, f_outs)
+    outs = jax.lax.cond(_as_pred(env[cond_name]), true_fn, false_fn,
+                        (read_vals, key0))
+    lowerer.ctx._key = jax.random.split(key0)[0] if key0 is not None else None
+    env.update(zip(out_names, outs))
+
+
+LOWERINGS = {
+    "while": lower_while,
+    "conditional_block": lower_conditional_block,
+    "cond_block_pair": lower_cond_block_pair,
+    "write_to_array": lower_write_to_array,
+    "read_from_array": lower_read_from_array,
+    "array_length": lower_array_length,
+}

@@ -56,6 +56,15 @@ class _BlockLowerer:
             if op.type == BACKWARD_OP:
                 self._lower_backward(ops, i, env, initial_env, initial_key)
                 continue
+            self._lower_any(op, env)
+
+    def _lower_any(self, op: OpDesc, env: Dict[str, Any]) -> None:
+        from .control_flow import LOWERINGS as _CF
+        if op.type in _CF:
+            # structural ops get name-level env access (the reference
+            # hands them the Scope: while_op.cc:42)
+            _CF[op.type](self, op, env)
+        else:
             self._lower_one(op, env)
 
     def _lower_one(self, op: OpDesc, env: Dict[str, Any]) -> None:
@@ -125,7 +134,7 @@ class _BlockLowerer:
                 _run_with_remat(sub, fwd_ops, env2, remat_segments)
             else:
                 for fop in fwd_ops:
-                    sub._lower_one(fop, env2)
+                    sub._lower_any(fop, env2)
                     for n in fop.output_names():
                         if n in mid:
                             env2[n] = injected[n]
@@ -175,7 +184,7 @@ def _run_with_remat(lowerer: _BlockLowerer, ops, env, segments):
             env.update(dict(zip(out_names, outs)))
             i = end
         else:
-            lowerer._lower_one(ops[i], env)
+            lowerer._lower_any(ops[i], env)
             i += 1
 
 

@@ -234,6 +234,7 @@ class Program:
 
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
+        self._block_stack: List[int] = [0]
         self._name_counter = 0
         self.random_seed: Optional[int] = None
         # structural version, bumped on any mutation — used by the executor's
@@ -254,13 +255,33 @@ class Program:
     def global_block(self) -> Block:
         return self.blocks[0]
 
-    def create_block(self, parent_idx: int = 0) -> Block:
+    def create_block(self, parent_idx: Optional[int] = None) -> Block:
+        if parent_idx is None:
+            parent_idx = self.current_block().idx
         blk = Block(self, len(self.blocks), parent_idx)
         self.blocks.append(blk)
         return blk
 
     def current_block(self) -> Block:
-        return self.blocks[0]
+        return self.blocks[self._block_stack[-1]]
+
+    class _BlockGuard:
+        def __init__(self, program: "Program", block: "Block"):
+            self._program = program
+            self._idx = block.idx
+
+        def __enter__(self):
+            self._program._block_stack.append(self._idx)
+            return self._program.blocks[self._idx]
+
+        def __exit__(self, *exc):
+            self._program._block_stack.pop()
+            return False
+
+    def block_guard(self, block: Block) -> "_BlockGuard":
+        """Build ops into a sub-block (framework.py Program._create_block /
+        _rollback pairing used by control-flow layers)."""
+        return Program._BlockGuard(self, block)
 
     # --- queries --------------------------------------------------------
     def all_parameters(self) -> List[VarDesc]:

@@ -1,0 +1,177 @@
+"""Control-flow layers: While, cond, tensor arrays, Print/Assert.
+
+Analog of /root/reference/python/paddle/fluid/layers/control_flow.py
+(While:1021, array_write:1370, array_read:1575, increment:1315,
+less_than:1723, Print:231) over the structural op lowerings in
+core/control_flow.py.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..core.program import VarDesc, default_main_program
+from .helper import LayerHelper
+
+__all__ = ["While", "cond", "increment", "array_write", "array_read",
+           "array_length", "create_array", "Print", "Assert"]
+
+
+class While:
+    """layers/control_flow.py:1021:
+
+        i = fill_constant(...); cond = less_than(i, n)
+        w = While(cond)
+        with w.block():
+            ...
+            increment(i, in_place=True)
+            assign(less_than(i, n), cond)   # update the condition var
+
+    Loop-carried vars are discovered from the sub-block's reads/writes
+    (core/control_flow.py lower_while); forward-only under XLA.
+    """
+
+    def __init__(self, cond: VarDesc, is_test: bool = False,
+                 name: Optional[str] = None):
+        self.helper = LayerHelper("while", name)
+        self.cond_var = cond
+        self.program = default_main_program()
+
+    class _Guard:
+        def __init__(self, w: "While"):
+            self._w = w
+            self._sub = w.program.create_block()
+            self._guard = w.program.block_guard(self._sub)
+
+        def __enter__(self):
+            self._guard.__enter__()
+            return self._sub
+
+        def __exit__(self, *exc):
+            self._guard.__exit__(*exc)
+            if exc and exc[0] is not None:
+                return False
+            w = self._w
+            # outputs: every var the sub-block writes that exists in the
+            # parent too (in-place loop vars)
+            parent = w.program.current_block()
+            writes = []
+            for op in self._sub.ops:
+                for ns in op.outputs.values():
+                    for n in ns:
+                        if parent.has_var(n) and n not in writes:
+                            writes.append(n)
+            parent.append_op(
+                "while",
+                inputs={"Condition": [w.cond_var.name]},
+                outputs={"Out": writes},
+                attrs={"sub_block": self._sub.idx})
+            return False
+
+    def block(self) -> "_Guard":
+        return While._Guard(self)
+
+
+def cond(pred: VarDesc, true_fn, false_fn=None, name: Optional[str] = None):
+    """layers.cond (control_flow.py:2214): run true_fn/false_fn graphs,
+    merge outputs. Built as two conditional_block ops + select_input per
+    output, exactly the reference's lowering shape."""
+    helper = LayerHelper("cond", name)
+    program = default_main_program()
+    parent = program.current_block()
+
+    def _build(fn):
+        sub = program.create_block()
+        with program.block_guard(sub):
+            out = fn() if fn is not None else None
+        outs = out if isinstance(out, (tuple, list)) else \
+            ([] if out is None else [out])
+        return sub, list(outs)
+
+    true_sub, true_outs = _build(true_fn)
+    false_sub, false_outs = _build(false_fn)
+    if len(true_outs) != len(false_outs):
+        raise ValueError(
+            "cond: true_fn and false_fn must return the same number of "
+            "outputs (%d vs %d)" % (len(true_outs), len(false_outs)))
+
+    # one structural op holding both branch blocks -> lax.cond (the
+    # reference builds two conditional_blocks + select_input per output;
+    # lax.cond expresses the same merge natively and differentiably)
+    merged = [helper.create_tmp_variable(t_o.dtype, shape=t_o.shape)
+              for t_o in true_outs]
+    parent.append_op(
+        "cond_block_pair",
+        inputs={"Cond": [pred.name]},
+        outputs={"Out": [m.name for m in merged]},
+        attrs={"true_block": true_sub.idx,
+               "false_block": false_sub.idx,
+               "true_outs": [v.name for v in true_outs],
+               "false_outs": [v.name for v in false_outs]})
+    if not merged:
+        return None
+    return merged[0] if len(merged) == 1 else merged
+
+
+def increment(x: VarDesc, value: float = 1.0, in_place: bool = True):
+    helper = LayerHelper("increment")
+    out = x if in_place else helper.create_tmp_variable(x.dtype,
+                                                        shape=x.shape)
+    helper.append_op("increment", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"step": value})
+    return out
+
+
+def create_array(dtype: str = "float32", name: Optional[str] = None):
+    """control_flow.py create_array: declare a TENSOR_ARRAY var."""
+    from ..core.program import TENSOR_ARRAY
+    helper = LayerHelper("array", name)
+    return helper.block.create_var(
+        helper.unique_name("array"), dtype=dtype, type=TENSOR_ARRAY)
+
+
+def array_write(x: VarDesc, i: VarDesc, array=None):
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    helper.append_op("write_to_array",
+                     inputs={"X": [x.name], "I": [i.name]},
+                     outputs={"Out": [array.name]})
+    return array
+
+
+def array_read(array: VarDesc, i: VarDesc):
+    helper = LayerHelper("array_read")
+    out = helper.create_tmp_variable()
+    helper.append_op("read_from_array",
+                     inputs={"X": [array.name], "I": [i.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def array_length(array: VarDesc):
+    helper = LayerHelper("array_length")
+    out = helper.create_tmp_variable("int64", shape=(1,))
+    helper.append_op("array_length", inputs={"X": [array.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def Print(input: VarDesc, first_n: int = -1, message: Optional[str] = None,
+          summarize: int = 20, **kw):
+    helper = LayerHelper("print")
+    out = helper.create_tmp_variable(input.dtype, shape=input.shape)
+    helper.append_op("print", inputs={"In": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"message": message or "", "first_n": first_n,
+                            "summarize": summarize})
+    return out
+
+
+def Assert(cond: VarDesc, data: Optional[Sequence[VarDesc]] = None,
+           summarize: int = 20, name: Optional[str] = None):
+    helper = LayerHelper("assert", name)
+    helper.append_op(
+        "assert",
+        inputs={"Cond": [cond.name],
+                "Data": [d.name for d in (data or [])]},
+        outputs={}, attrs={"summarize": summarize})

@@ -154,7 +154,12 @@ class LayerHelper:
         self.name = name
         self.main_program = default_main_program()
         self.startup_program = default_startup_program()
-        self.block = self.main_program.global_block
+
+    @property
+    def block(self):
+        # current (possibly sub-) block — control-flow layers build into
+        # sub-blocks under Program.block_guard
+        return self.main_program.current_block()
 
     def unique_name(self, suffix: str = "") -> str:
         base = self.name or self.layer_type

@@ -399,6 +399,30 @@ def reduce_mean(x: VarDesc, dim=None, keep_dim: bool = False,
     return out
 
 
+def _reduce_layer(op_type):
+    def f(x: VarDesc, dim=None, keep_dim: bool = False,
+          name: Optional[str] = None) -> VarDesc:
+        helper = LayerHelper(op_type, name)
+        out = helper.create_tmp_variable(x.dtype)
+        attrs = {"keep_dim": keep_dim}
+        if dim is None:
+            attrs["reduce_all"] = True
+        else:
+            attrs["dim"] = [dim] if isinstance(dim, int) else list(dim)
+        helper.append_op(op_type, inputs={"X": [x.name]},
+                         outputs={"Out": [out.name]}, attrs=attrs)
+        return out
+    f.__name__ = op_type
+    return f
+
+
+reduce_max = _reduce_layer("reduce_max")
+reduce_min = _reduce_layer("reduce_min")
+reduce_prod = _reduce_layer("reduce_prod")
+reduce_any = _reduce_layer("reduce_any")
+reduce_all = _reduce_layer("reduce_all")
+
+
 def concat(input, axis: int = 0, name: Optional[str] = None) -> VarDesc:
     helper = LayerHelper("concat", name)
     out = helper.create_tmp_variable(input[0].dtype)
@@ -456,6 +480,38 @@ def _binary(op_type):
         return helper.append_activation(out, act)
     f.__name__ = op_type
     return f
+
+
+def _cmp(op_type):
+    def f(x: VarDesc, y: VarDesc, name: Optional[str] = None) -> VarDesc:
+        helper = LayerHelper(op_type, name)
+        out = helper.create_tmp_variable("bool", shape=x.shape)
+        helper.append_op(op_type, inputs={"X": [x.name], "Y": [y.name]},
+                         outputs={"Out": [out.name]})
+        return out
+    f.__name__ = op_type
+    return f
+
+
+less_than = _cmp("less_than")
+less_equal = _cmp("less_equal")
+greater_than = _cmp("greater_than")
+greater_equal = _cmp("greater_equal")
+equal = _cmp("equal")
+not_equal = _cmp("not_equal")
+logical_and = _cmp("logical_and")
+logical_or = _cmp("logical_or")
+logical_xor = _cmp("logical_xor")
+
+
+def assign(input: VarDesc, output: Optional[VarDesc] = None) -> VarDesc:
+    """layers.assign (tensor.py:560): copy input into output var."""
+    helper = LayerHelper("assign")
+    if output is None:
+        output = helper.create_tmp_variable(input.dtype, shape=input.shape)
+    helper.append_op("assign", inputs={"X": [input.name]},
+                     outputs={"Out": [output.name]})
+    return output
 
 
 elementwise_add = _binary("elementwise_add")

@@ -10,6 +10,7 @@ from ..core.registry import REGISTRY  # noqa: F401
 from . import (  # noqa: F401
     activation,
     amp,
+    controlflow,
     elementwise,
     math,
     metrics,

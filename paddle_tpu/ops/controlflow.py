@@ -1,0 +1,66 @@
+"""Value-level control-flow helper ops.
+
+Analog of /root/reference/paddle/fluid/operators/controlflow/
+select_{input,output}_op.cc (branch-merge plumbing emitted by
+layers.cond/case), print_op.cc and assert_op.cc. The structural ops
+(while/conditional_block/tensor arrays) live in core/control_flow.py —
+they need scope-level access.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import one
+
+
+@register_op("select_input", inputs=("X", "Mask"), outputs=("Out",),
+             non_diff_inputs=("Mask",))
+def _select_input(ctx, ins, attrs):
+    # select_input_op.cc: Out = X[Mask] (branch results have equal
+    # shapes, so this is a differentiable gather over the stacked pair)
+    xs = ins["X"]
+    mask = jnp.reshape(jnp.asarray(ins["Mask"][0]), ()).astype(jnp.int32)
+    stacked = jnp.stack([jnp.asarray(x) for x in xs])
+    return one(jax.lax.dynamic_index_in_dim(stacked, jnp.clip(
+        mask, 0, len(xs) - 1), keepdims=False))
+
+
+@register_op("select_output", inputs=("X", "Mask"), outputs=("Out",),
+             non_diff_inputs=("Mask",))
+def _select_output(ctx, ins, attrs):
+    # select_output_op.cc routes X to Out[Mask]; XLA computes both
+    # branches, so every output gets the value and the downstream
+    # select_input picks the live one.
+    n = attrs.get("num_outputs", 2)
+    return {"Out": [ins["X"][0] for _ in range(n)]}
+
+
+@register_op("print", inputs=("In",), outputs=("Out",), no_grad=True)
+def _print(ctx, ins, attrs):
+    x = ins["In"][0]
+    msg = attrs.get("message", "")
+    jax.debug.print(msg + " {}", x)
+    return one(x)
+
+
+@register_op("assert", inputs=("Cond", "Data"), outputs=(), no_grad=True)
+def _assert(ctx, ins, attrs):
+    cond = ins["Cond"][0]
+    try:
+        ok = bool(np.asarray(jax.core.concrete_or_error(
+            None, cond, "assert")).all())
+        if not ok:
+            raise AssertionError(attrs.get("summarize_message",
+                                           "assert_op failed"))
+    except AssertionError:
+        raise
+    except Exception:
+        # traced condition: report at runtime without aborting (XLA has
+        # no abort; the reference's assert_op kills the process)
+        jax.debug.print("ASSERT failed: {} (summarize={})",
+                        jnp.all(jnp.asarray(cond).astype(bool)),
+                        attrs.get("summarize", 20))
+    return {}

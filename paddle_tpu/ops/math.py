@@ -209,7 +209,10 @@ def _logsumexp(ctx, ins, attrs):
 
 @register_op("increment", inputs=("X",))
 def _increment(ctx, ins, attrs):
-    return one(ins["X"][0] + attrs.get("step", 1.0))
+    # dtype-preserving (increment_op.cc: Out has X's type; a float step on
+    # an int counter must not promote)
+    x = ins["X"][0]
+    return one(x + jnp.asarray(attrs.get("step", 1.0), jnp.result_type(x)))
 
 
 @register_op("cos_sim", inputs=("X", "Y"))

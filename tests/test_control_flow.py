@@ -1,0 +1,143 @@
+"""Control-flow op tests: While loops, cond branches, tensor arrays.
+
+Mirrors the reference's unittests/test_while_op.py, test_cond.py and
+test_array_read_write.py semantics against the lax.while_loop/cond
+structural lowerings (core/control_flow.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run(main, startup, feed, fetch):
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_while_sums_to_n():
+    # while i < 10: s += i; i += 1  (test_while_op.py pattern)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 10)
+        s = layers.fill_constant([1], "float32", 0.0)
+        cond_v = layers.less_than(i, n)
+        w = layers.While(cond_v)
+        with w.block():
+            sf = layers.cast(i, "float32")
+            s2 = layers.elementwise_add(s, sf)
+            layers.assign(s2, s)
+            layers.increment(i, 1.0)
+            layers.assign(layers.less_than(i, n), cond_v)
+        out = layers.assign(s)
+    res, = _run(main, startup, {}, [out])
+    assert float(res) == sum(range(10))
+
+
+def test_while_with_feed():
+    # iterate x <- x * 0.5 until max(x) < 1
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        limit = layers.fill_constant([1], "float32", 1.0)
+        mx = layers.reduce_max(x, keep_dim=False)
+        cond_v = layers.greater_than(
+            layers.reshape(mx, [1]), limit)
+        w = layers.While(cond_v)
+        with w.block():
+            half = layers.scale(x, 0.5)
+            layers.assign(half, x)
+            mx2 = layers.reduce_max(x, keep_dim=False)
+            layers.assign(layers.greater_than(
+                layers.reshape(mx2, [1]), limit), cond_v)
+        out = layers.assign(x)
+    xin = np.array([[8.0, 2.0, 0.5, 7.9]], np.float32)
+    res, = _run(main, startup, {"x": xin}, [out])
+    assert res.max() <= 1.0  # halves 8 -> 4 -> 2 -> 1, stops at 1.0
+    np.testing.assert_allclose(res, xin / 8.0)
+
+
+def test_cond_branches():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [2])
+        flag = layers.data("flag", [1], dtype="bool")
+        out = layers.cond(flag,
+                          lambda: layers.scale(x, 2.0),
+                          lambda: layers.scale(x, -1.0))
+    xin = np.array([[1.0, 3.0]], np.float32)
+    t, = _run(main, startup, {"x": xin, "flag": np.array([True])}, [out])
+    f, = _run(main, startup, {"x": xin, "flag": np.array([False])}, [out])
+    np.testing.assert_allclose(t, xin * 2)
+    np.testing.assert_allclose(f, -xin)
+
+
+def test_cond_multi_output():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [2])
+        flag = layers.data("flag", [1], dtype="bool")
+        outs = layers.cond(
+            flag,
+            lambda: (layers.scale(x, 1.0), layers.scale(x, 2.0)),
+            lambda: (layers.scale(x, 3.0), layers.scale(x, 4.0)))
+    xin = np.ones((1, 2), np.float32)
+    a, b = _run(main, startup, {"x": xin, "flag": np.array([False])},
+                list(outs))
+    np.testing.assert_allclose(a, xin * 3)
+    np.testing.assert_allclose(b, xin * 4)
+
+
+def test_cond_is_differentiable():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [2])
+        x.stop_gradient = False
+        flag = layers.data("flag", [1], dtype="bool")
+        y = layers.cond(flag,
+                        lambda: layers.scale(x, 2.0),
+                        lambda: layers.scale(x, 5.0))
+        loss = layers.mean(y)
+        grads = pt.gradients([loss], [x])
+    xin = np.ones((2, 2), np.float32)
+    g_t, = _run(main, startup, {"x": xin, "flag": np.array([True])},
+                [grads[0]])
+    g_f, = _run(main, startup, {"x": xin, "flag": np.array([False])},
+                [grads[0]])
+    np.testing.assert_allclose(g_t, np.full_like(xin, 2.0 / 4))
+    np.testing.assert_allclose(g_f, np.full_like(xin, 5.0 / 4))
+
+
+def test_array_write_read():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [3])
+        i0 = layers.fill_constant([1], "int64", 0)
+        i1 = layers.fill_constant([1], "int64", 1)
+        arr = layers.array_write(layers.scale(x, 1.0), i0)
+        layers.array_write(layers.scale(x, 10.0), i1, array=arr)
+        n = layers.array_length(arr)
+        first = layers.array_read(arr, i0)
+        second = layers.array_read(arr, i1)
+    xin = np.array([[1.0, 2.0, 3.0]], np.float32)
+    ln, a, b = _run(main, startup, {"x": xin}, [n, first, second])
+    assert int(ln) == 2
+    np.testing.assert_allclose(a, xin)
+    np.testing.assert_allclose(b, xin * 10)
+
+
+def test_print_and_assert_run():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [2])
+        y = layers.Print(x, message="dbg:")
+        ok = layers.less_than(
+            layers.reduce_sum(y, keep_dim=True),
+            layers.fill_constant([1], "float32", 100.0))
+        layers.Assert(ok)
+        out = layers.scale(y, 2.0)
+    res, = _run(main, startup, {"x": np.ones((1, 2), np.float32)}, [out])
+    np.testing.assert_allclose(res, np.full((1, 2), 2.0))
