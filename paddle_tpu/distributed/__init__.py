@@ -1,0 +1,6 @@
+from .sharded_embedding import ShardedEmbedding, sharded_lookup  # noqa: F401
+from .large_scale_kv import LargeScaleKV, SparseTableConfig  # noqa: F401
+from .communicator import (Communicator, AsyncCommunicator,  # noqa: F401
+                           GeoCommunicator, HalfAsyncCommunicator,
+                           ParamServer, SyncCommunicator)
+from .ps_worker import DownpourWorker  # noqa: F401

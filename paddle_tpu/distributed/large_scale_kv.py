@@ -1,0 +1,139 @@
+"""Host-side large-scale sparse parameter table.
+
+Analog of the reference's in-server sparse table
+(/root/reference/paddle/fluid/operators/distributed/large_scale_kv.h:762
+ValueBlock/SparseVariable: hash-sharded rows created on first touch with
+configured initializers, updated by sparse optimizer rules, saved/loaded
+to disk). This is the spill-over tier for embeddings too big for HBM:
+rows live in host RAM (numpy), the trainer pulls the rows a batch
+touches, the TPU computes dense grads for those rows, and push applies
+the sparse optimizer update host-side — the DownpourWorker pull/push
+contract (framework/device_worker.h:246; fleet_wrapper.h:105,186).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+
+@dataclass
+class SparseTableConfig:
+    name: str = "embedding"
+    dim: int = 8
+    initializer: str = "gaussian"   # gaussian | uniform | fill
+    init_scale: float = 0.01
+    fill_value: float = 0.0
+    optimizer: str = "sgd"          # sgd | adagrad | adam
+    lr: float = 0.01
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    seed: int = 0
+
+
+class LargeScaleKV:
+    """One sparse variable: id -> row (+ per-row optimizer slots)."""
+
+    def __init__(self, config: SparseTableConfig):
+        self.cfg = config
+        self._rows: Dict[int, np.ndarray] = {}
+        self._slots: Dict[str, Dict[int, np.ndarray]] = {}
+        self._beta_pow: Dict[int, np.ndarray] = {}
+        self._rng = np.random.RandomState(config.seed)
+        self._lock = threading.Lock()
+
+    # --- row init on first touch (large_scale_kv.h Initializer impls) ---
+    def _new_row(self) -> np.ndarray:
+        c = self.cfg
+        if c.initializer == "gaussian":
+            return self._rng.normal(0.0, c.init_scale,
+                                    c.dim).astype(np.float32)
+        if c.initializer == "uniform":
+            return self._rng.uniform(-c.init_scale, c.init_scale,
+                                     c.dim).astype(np.float32)
+        return np.full(c.dim, c.fill_value, np.float32)
+
+    # --- pull / push ------------------------------------------------------
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        """Rows for ids (created on miss), shape [len(ids), dim]."""
+        ids = np.asarray(ids).reshape(-1)
+        out = np.empty((len(ids), self.cfg.dim), np.float32)
+        with self._lock:
+            for i, r in enumerate(ids):
+                row = self._rows.get(int(r))
+                if row is None:
+                    row = self._new_row()
+                    self._rows[int(r)] = row
+                out[i] = row
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray,
+             lr: Optional[float] = None):
+        """Apply the configured sparse optimizer row-wise. Duplicate ids
+        in a batch are pre-merged (summed), the reference's
+        MergeSelectedRows before the optimizer kernel."""
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), grads.shape[1]), np.float32)
+        np.add.at(merged, inv, grads)
+        lr = self.cfg.lr if lr is None else lr
+        opt = self.cfg.optimizer
+        with self._lock:
+            for i, r in enumerate(uniq):
+                r = int(r)
+                row = self._rows.get(r)
+                if row is None:
+                    row = self._new_row()
+                g = merged[i]
+                if opt == "sgd":
+                    row = row - lr * g
+                elif opt == "adagrad":
+                    G = self._slots.setdefault("g2", {}).get(
+                        r, np.zeros_like(row))
+                    G = G + g * g
+                    self._slots["g2"][r] = G
+                    row = row - lr * g / (np.sqrt(G) + self.cfg.epsilon)
+                elif opt == "adam":
+                    c = self.cfg
+                    m = self._slots.setdefault("m", {}).get(
+                        r, np.zeros_like(row))
+                    v = self._slots.setdefault("v", {}).get(
+                        r, np.zeros_like(row))
+                    b = self._beta_pow.get(r, np.array([c.beta1, c.beta2],
+                                                       np.float64))
+                    m = c.beta1 * m + (1 - c.beta1) * g
+                    v = c.beta2 * v + (1 - c.beta2) * g * g
+                    lr_t = lr * np.sqrt(1 - b[1]) / (1 - b[0])
+                    row = row - lr_t * m / (np.sqrt(v) + c.epsilon)
+                    self._slots["m"][r], self._slots["v"][r] = m, v
+                    self._beta_pow[r] = b * [c.beta1, c.beta2]
+                else:
+                    raise ValueError("unknown sparse optimizer %r" % opt)
+                self._rows[r] = row.astype(np.float32)
+
+    # --- introspection / persistence -------------------------------------
+    def size(self) -> int:
+        return len(self._rows)
+
+    def ids(self):
+        return sorted(self._rows)
+
+    def save(self, dirname: str):
+        os.makedirs(dirname, exist_ok=True)
+        with open(os.path.join(dirname, self.cfg.name + ".kv"), "wb") as f:
+            pickle.dump({"cfg": self.cfg.__dict__, "rows": self._rows,
+                         "slots": self._slots,
+                         "beta_pow": self._beta_pow}, f, protocol=2)
+
+    def load(self, dirname: str):
+        with open(os.path.join(dirname, self.cfg.name + ".kv"), "rb") as f:
+            d = pickle.load(f)
+        self._rows = d["rows"]
+        self._slots = d["slots"]
+        self._beta_pow = d.get("beta_pow", {})

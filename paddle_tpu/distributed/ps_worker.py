@@ -1,0 +1,46 @@
+"""Downpour-style PS training worker: pull sparse -> step -> push grads.
+
+Analog of the reference's DownpourWorker train loop
+(/root/reference/paddle/fluid/framework/downpour_worker.cc +
+fleet_wrapper.h:105 PullSparseVarsSync / :186
+PushSparseVarsWithLabelAsync): for each batch, fetch the embedding rows
+the batch touches from the sparse table into a dense input, run the
+compiled train step on device, then push the rows' gradients back. The
+host KV round-trip happens outside jit — the same boundary the
+reference draws between its RPC pulls and the device graph.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from .communicator import ParamServer
+from .large_scale_kv import LargeScaleKV
+
+
+class DownpourWorker:
+    def __init__(self, server: ParamServer, table: str):
+        self.server = server
+        self.table = table
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        """[B, T] ids -> [B, T, dim] rows (dense input for the step)."""
+        kv = self.server.sparse[self.table]
+        flat = np.asarray(ids).reshape(-1)
+        rows = kv.pull(flat)
+        return rows.reshape(np.asarray(ids).shape + (kv.cfg.dim,))
+
+    def push(self, ids: np.ndarray, row_grads: np.ndarray):
+        """[B, T] ids + [B, T, dim] grads -> sparse optimizer update."""
+        kv = self.server.sparse[self.table]
+        flat_ids = np.asarray(ids).reshape(-1)
+        flat_g = np.asarray(row_grads).reshape(len(flat_ids), -1)
+        kv.push(flat_ids, flat_g)
+
+    def train_batch(self, ids: np.ndarray, step_fn: Callable, *args):
+        """step_fn(rows, *args) -> (loss, row_grads). Returns loss."""
+        rows = self.pull(ids)
+        loss, row_grads = step_fn(rows, *args)
+        self.push(ids, np.asarray(row_grads))
+        return loss

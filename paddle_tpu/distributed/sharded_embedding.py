@@ -1,0 +1,99 @@
+"""HBM-sharded embedding tables with mesh-collective lookup.
+
+TPU-native replacement for the reference's distributed embedding ops
+(/root/reference/paddle/fluid/operators/distributed_ops/
+distributed_lookup_table_op.cc + distributed/parameter_prefetch.cc:73-82,
+which shard rows round-robin `id % pservers` and RPC each server for its
+rows). Here the table lives sharded across device HBM on a mesh axis with
+the same `id % n_shards` row placement, and the "prefetch" is a shard_map
+gather + psum over ICI: every device gathers the rows it owns for the
+whole id batch (drop-markers elsewhere) and one all-reduce assembles the
+result. Gradients reverse through the gather as scatter-adds into each
+shard — the SelectedRows push path of the reference, handled by XLA.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.env import MP_AXIS
+
+
+def shard_rows(vocab_size: int, n_shards: int) -> int:
+    """Rows per shard under round-robin placement (ceil)."""
+    return -(-vocab_size // n_shards)
+
+
+def sharded_lookup(table_local: jax.Array, ids: jax.Array, mesh: Mesh,
+                  axis: str = MP_AXIS, vocab_size: Optional[int] = None):
+    """Gather rows of a row-sharded table for a replicated id batch.
+
+    table_local: global view [n_shards * rows_per_shard, D] sharded on
+    rows over `axis` (row r lives on shard r % n — ids are mapped to
+    (id % n, id // n)). ids: any int shape. Returns ids.shape + [D].
+    """
+    n = mesh.shape[axis]
+    D = table_local.shape[-1]
+
+    def body(tbl, ids_):
+        # tbl: local [rows_per_shard, D]; every device sees all ids
+        me = jax.lax.axis_index(axis)
+        flat = ids_.reshape(-1)
+        local_row = flat // n
+        mine = (flat % n) == me
+        safe = jnp.where(mine, local_row, 0)
+        rows = tbl[safe]
+        rows = jnp.where(mine[:, None], rows, 0)
+        return jax.lax.psum(rows, axis)
+
+    out = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, None), P()),
+        out_specs=P(),
+    )(table_local, ids)
+    return out.reshape(ids.shape + (D,))
+
+
+class ShardedEmbedding:
+    """Embedding with its table sharded over a mesh axis.
+
+    Create once (host init), then call .lookup(ids) inside jit/grad; the
+    table participates in autodiff as a regular parameter (pass .table
+    through your param pytree and call sharded_lookup directly for a
+    functional style).
+    """
+
+    def __init__(self, vocab_size: int, dim: int, mesh: Mesh,
+                 axis: str = MP_AXIS, seed: int = 0,
+                 scale: Optional[float] = None):
+        self.vocab_size = vocab_size
+        self.dim = dim
+        self.mesh = mesh
+        self.axis = axis
+        n = mesh.shape[axis]
+        padded = shard_rows(vocab_size, n) * n
+        key = jax.random.PRNGKey(seed)
+        scale = scale if scale is not None else 1.0 / math.sqrt(dim)
+        host = jax.random.normal(key, (padded, dim), jnp.float32) * scale
+        self.table = jax.device_put(
+            host, NamedSharding(mesh, P(axis, None)))
+
+    def lookup(self, ids):
+        return sharded_lookup(self.table, jnp.asarray(ids), self.mesh,
+                              self.axis, self.vocab_size)
+
+    def dense_view(self) -> np.ndarray:
+        """Host copy in logical id order (row r at table[(r % n) shard,
+        r // n]) — for tests/checkpointing."""
+        n = self.mesh.shape[self.axis]
+        tbl = np.asarray(self.table)
+        rows_per = tbl.shape[0] // n
+        out = np.zeros((self.vocab_size, self.dim), tbl.dtype)
+        for r in range(self.vocab_size):
+            out[r] = tbl[(r % n) * rows_per + r // n]
+        return out
