@@ -60,3 +60,6 @@ def set_global_seed(seed: int):
 
 
 seed = set_global_seed
+from . import fleet  # noqa: F401
+from . import distributed  # noqa: F401
+from . import contrib  # noqa: F401

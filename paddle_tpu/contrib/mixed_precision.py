@@ -1,0 +1,214 @@
+"""Static-graph automatic mixed precision (AMP).
+
+Analog of /root/reference/python/paddle/fluid/contrib/mixed_precision/
+(decorator.py:218 decorate -> OptimizerWithMixedPrecision:27,
+fp16_lists.py white/black lists, fp16_utils.py:190 rewrite_program +
+:51 _insert_cast_op): the program is rewritten so white-list ops compute
+in the low-precision dtype (casts inserted at the boundaries), the loss
+is scaled by a (dynamically updated) loss-scale variable, and gradients
+are unscaled + checked for inf/nan before the optimizer applies.
+
+TPU default low dtype is bfloat16 — fp32-range exponent, so dynamic loss
+scaling is normally unnecessary (and off by default for bf16); fp16 mode
+keeps the reference's full scaling machinery.
+
+Master weights: parameters stay fp32 (cast at each use) — the backward
+replay differentiates through the inserted casts, so grads arrive fp32,
+matching the reference's master-weight scheme.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Set
+
+from ..core.backward import append_backward
+from ..core.program import Program, default_main_program, \
+    default_startup_program
+
+# fp16_lists.py — white: matmul-class ops that the MXU wants in low
+# precision; black: numerically sensitive reductions/losses.
+WHITE_LIST: Set[str] = {
+    "matmul", "matmul_v2", "mul", "fc", "conv2d", "depthwise_conv2d",
+    "conv3d", "conv2d_transpose", "bmm",
+}
+BLACK_LIST: Set[str] = {
+    "softmax_with_cross_entropy", "cross_entropy", "cross_entropy2",
+    "exp", "log", "mean", "sum", "reduce_sum", "reduce_mean", "softmax",
+    "layer_norm", "batch_norm", "square_error_cost", "update_loss_scaling",
+    "check_finite_and_unscale",
+}
+
+
+class AutoMixedPrecisionLists:
+    def __init__(self, custom_white_list: Optional[Sequence[str]] = None,
+                 custom_black_list: Optional[Sequence[str]] = None):
+        self.white_list = set(WHITE_LIST)
+        self.black_list = set(BLACK_LIST)
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+        overlap = self.white_list & self.black_list
+        if overlap:
+            raise ValueError("ops in both white and black lists: %s"
+                             % sorted(overlap))
+
+
+def rewrite_program(program: Program, amp_lists: AutoMixedPrecisionLists,
+                    dest_dtype: str = "bfloat16") -> int:
+    """Insert casts so white-list ops consume dest_dtype inputs and
+    black-list ops consume fp32 (fp16_utils.py:190). Returns the number
+    of cast ops inserted."""
+    block = program.global_block
+    n_casts = 0
+    low_of = {}    # var -> its low-precision cast name
+    high_of = {}   # var -> its fp32 cast name (for black after white)
+    new_ops = []
+    for op in list(block.ops):
+        if op.type in amp_lists.white_list:
+            for slot, names in op.inputs.items():
+                new_names = []
+                for n in names:
+                    v = block.vars.get(n)
+                    if v is not None and v.dtype == "float32":
+                        cast_name = low_of.get(n)
+                        if cast_name is None:
+                            cast_name = n + ".cast_" + dest_dtype
+                            block.create_var(cast_name, shape=v.shape,
+                                             dtype=dest_dtype,
+                                             stop_gradient=v.stop_gradient)
+                            from ..core.program import OpDesc
+                            new_ops.append(OpDesc(
+                                "cast", {"X": [n]}, {"Out": [cast_name]},
+                                {"out_dtype": dest_dtype}))
+                            low_of[n] = cast_name
+                            n_casts += 1
+                        new_names.append(cast_name)
+                    else:
+                        new_names.append(n)
+                op.inputs[slot] = new_names
+            # outputs become dest dtype; downstream black ops re-cast
+            for names in op.outputs.values():
+                for n in names:
+                    if n in block.vars and \
+                            block.vars[n].dtype == "float32":
+                        block.vars[n].dtype = dest_dtype
+        elif op.type in amp_lists.black_list:
+            for slot, names in op.inputs.items():
+                new_names = []
+                for n in names:
+                    v = block.vars.get(n)
+                    if v is not None and v.dtype == dest_dtype:
+                        cast_name = high_of.get(n)
+                        if cast_name is None:
+                            cast_name = n + ".cast_fp32"
+                            block.create_var(cast_name, shape=v.shape,
+                                             dtype="float32",
+                                             stop_gradient=v.stop_gradient)
+                            from ..core.program import OpDesc
+                            new_ops.append(OpDesc(
+                                "cast", {"X": [n]}, {"Out": [cast_name]},
+                                {"out_dtype": "float32"}))
+                            high_of[n] = cast_name
+                            n_casts += 1
+                        new_names.append(cast_name)
+                    else:
+                        new_names.append(n)
+                op.inputs[slot] = new_names
+        while new_ops:  # insert pending casts just before their consumer
+            block.ops.insert(block.ops.index(op), new_ops.pop(0))
+    program._bump()
+    return n_casts
+
+
+class OptimizerWithMixedPrecision:
+    """decorator.py:27 — wraps an optimizer with AMP program rewrite +
+    loss scaling."""
+
+    def __init__(self, optimizer, amp_lists: AutoMixedPrecisionLists,
+                 init_loss_scaling: float = 2.0 ** 15,
+                 use_dynamic_loss_scaling: bool = True,
+                 incr_every_n_steps: int = 1000,
+                 decr_every_n_nan_or_inf: int = 2,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 dest_dtype: str = "bfloat16"):
+        self._inner = optimizer
+        self._amp_lists = amp_lists
+        self._init_scale = init_loss_scaling
+        self._dynamic = use_dynamic_loss_scaling
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._dest = dest_dtype
+        self._loss_scale_name = None
+
+    def get_loss_scaling(self):
+        return self._loss_scale_name
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, program=None):
+        program = program or default_main_program()
+        startup = startup_program or default_startup_program()
+        block = program.global_block
+
+        rewrite_program(program, self._amp_lists, self._dest)
+
+        # loss scale state vars
+        def mkvar(name, value, dtype="float32", shape=()):
+            nm = program._unique_name(name)
+            for prog in (program, startup):
+                prog.global_block.create_var(
+                    nm, shape=shape, dtype=dtype, persistable=True,
+                    stop_gradient=True)
+            startup.global_block.append_op(
+                "fill_constant", inputs={}, outputs={"Out": [nm]},
+                attrs={"shape": list(shape), "value": value,
+                       "dtype": dtype})
+            return nm
+        scale = mkvar("loss_scaling", self._init_scale)
+        self._loss_scale_name = scale
+
+        params_grads = append_backward(
+            loss, parameter_list, no_grad_set, program=program,
+            loss_scale_var=scale)
+        grad_names = [g.name for _, g in params_grads]
+
+        found = program._unique_name("found_inf")
+        block.create_var(found, shape=(), dtype="bool",
+                         stop_gradient=True)
+        block.append_op(
+            "check_finite_and_unscale",
+            inputs={"X": grad_names, "Scale": [scale]},
+            outputs={"Out": grad_names, "FoundInfinite": [found]})
+        if self._dynamic:
+            good = mkvar("good_steps", 0, "int32")
+            bad = mkvar("bad_steps", 0, "int32")
+            block.append_op(
+                "update_loss_scaling",
+                inputs={"X": grad_names, "FoundInfinite": [found],
+                        "PrevLossScaling": [scale], "InGoodSteps": [good],
+                        "InBadSteps": [bad]},
+                outputs={"Out": grad_names, "LossScaling": [scale],
+                         "OutGoodSteps": [good], "OutBadSteps": [bad]},
+                attrs={"incr_every_n_steps": self._incr_every,
+                       "decr_every_n_nan_or_inf": self._decr_every,
+                       "incr_ratio": self._incr_ratio,
+                       "decr_ratio": self._decr_ratio})
+        self._inner.apply_gradients(params_grads, program, startup)
+        return None, params_grads
+
+
+def decorate(optimizer, amp_lists: Optional[AutoMixedPrecisionLists] = None,
+             init_loss_scaling: float = 2.0 ** 15,
+             use_dynamic_loss_scaling: Optional[bool] = None,
+             dest_dtype: str = "bfloat16", **kw):
+    """contrib.mixed_precision.decorate (decorator.py:218)."""
+    if use_dynamic_loss_scaling is None:
+        # bf16 has fp32 exponent range: scaling off by default
+        use_dynamic_loss_scaling = dest_dtype == "float16"
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists or AutoMixedPrecisionLists(),
+        init_loss_scaling=init_loss_scaling if use_dynamic_loss_scaling
+        else 1.0,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling,
+        dest_dtype=dest_dtype, **kw)

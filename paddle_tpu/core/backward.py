@@ -30,6 +30,7 @@ def append_backward(loss, parameter_list: Optional[Sequence] = None,
                     checkpoints: Optional[Sequence] = None,
                     program: Optional[Program] = None,
                     loss_scale: float = 1.0,
+                    loss_scale_var: Optional[str] = None,
                     ) -> List[Tuple[VarDesc, VarDesc]]:
     """Append the backward meta-op computing d(loss)/d(param) for every
     trainable parameter; returns [(param, grad)] like the reference
@@ -59,9 +60,13 @@ def append_backward(loss, parameter_list: Optional[Sequence] = None,
                              stop_gradient=True)
         grad_names.append(g.name)
 
+    ins = {"Loss": [loss_name]}
+    if loss_scale_var is not None:
+        # dynamic loss scaling (AMP): scale read from a variable each step
+        ins["LossScale"] = [loss_scale_var]
     block.append_op(
         BACKWARD_OP,
-        inputs={"Loss": [loss_name]},
+        inputs=ins,
         outputs={"Grads": grad_names},
         attrs={"parameter_list": params,
                "loss_scale": loss_scale,

@@ -114,6 +114,9 @@ class _BlockLowerer:
         if initial_env is None:
             raise RuntimeError("backward op requires block-level replay env")
         scale = op.attr("loss_scale", 1.0)
+        if op.input("LossScale"):
+            # dynamic loss scaling: scale value read from the env var
+            scale = env[op.input("LossScale")[0]]
         remat_segments = op.attr("remat_segments", [])  # list of [start, end)
         fwd_ops = list(ops[:idx])
         # grads wrt leaves (params/feeds in the initial env) are taken by

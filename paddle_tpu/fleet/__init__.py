@@ -1,0 +1,197 @@
+"""Fleet: the unified distributed-training facade.
+
+Analog of /root/reference/python/paddle/distributed/fleet/base/
+fleet_base.py:63 (Fleet singleton: init, role queries, init_worker/
+init_server, distributed_optimizer, minimize:937 chaining
+meta-optimizers via strategy_compiler.py, save_* passthroughs).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .distributed_strategy import DistributedStrategy
+from .role_maker import (PaddleCloudRoleMaker, Role, RoleMakerBase,
+                         UserDefinedRoleMaker)
+from . import meta_optimizers
+from .meta_optimizers import (DGCMomentumOptimizer,  # noqa: F401
+                              GradientMergeOptimizer, LambMetaOptimizer,
+                              LarsMetaOptimizer, LocalSGDOptimizer,
+                              RecomputeOptimizer)
+
+__all__ = ["init", "is_worker", "is_server", "is_first_worker",
+           "worker_index", "worker_num", "server_num", "init_worker",
+           "init_server", "stop_worker", "distributed_optimizer",
+           "minimize", "DistributedStrategy", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker", "Fleet", "fleet"]
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._inner_opt = None
+        self._server = None
+        self._communicator = None
+
+    # --- lifecycle (fleet_base.py init:170) ------------------------------
+    def init(self, role_maker: Optional[RoleMakerBase] = None,
+             is_collective: bool = False,
+             strategy: Optional[DistributedStrategy] = None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        self._strategy = strategy or DistributedStrategy()
+        return self
+
+    def _rm(self) -> RoleMakerBase:
+        if self._role_maker is None:
+            self.init()
+        return self._role_maker
+
+    # --- role queries -----------------------------------------------------
+    def is_worker(self):
+        return self._rm().is_worker()
+
+    def is_server(self):
+        return self._rm().is_server()
+
+    def is_first_worker(self):
+        return self._rm().is_first_worker()
+
+    def worker_index(self):
+        return self._rm().worker_index()
+
+    def worker_num(self):
+        return self._rm().worker_num()
+
+    def server_num(self):
+        return self._rm().server_num()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._rm().get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_endpoints(self, to_string=False):
+        eps = self._rm().get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    # --- PS runtime (fleet_base.py init_worker:372 / init_server:395) ----
+    def init_server(self, *args, **kw):
+        from ..distributed import ParamServer
+        if self._server is None:
+            self._server = ParamServer()
+        return self._server
+
+    def run_server(self):
+        return self._server
+
+    def init_worker(self):
+        """Start the communicator (the reference starts the async send
+        thread here, fleet_base.py:372)."""
+        from ..distributed import (AsyncCommunicator, GeoCommunicator,
+                                   SyncCommunicator)
+        if self._server is None:
+            self._server = self.init_server()
+        st = self._strategy or DistributedStrategy()
+        if st.a_sync and st.a_sync_configs.get("geo_sgd_mode"):
+            self._communicator = GeoCommunicator(
+                self._server,
+                trainer_push_step=st.a_sync_configs.get(
+                    "geo_sgd_need_push_nums", 100))
+        elif st.a_sync:
+            self._communicator = AsyncCommunicator(self._server)
+        else:
+            self._communicator = SyncCommunicator(self._server)
+        self._communicator.start()
+        return self._communicator
+
+    def stop_worker(self):
+        if self._communicator is not None:
+            self._communicator.stop()
+            self._communicator = None
+
+    def barrier_worker(self):
+        if self._communicator is not None:
+            self._communicator.barrier()
+
+    # --- optimizer chain (fleet_base.py:937 minimize) ---------------------
+    def distributed_optimizer(self, optimizer,
+                              strategy: Optional[DistributedStrategy]
+                              = None):
+        self._inner_opt = optimizer
+        if strategy is not None:
+            self._strategy = strategy
+        return self
+
+    def _compile_chain(self):
+        """strategy_compiler.py analog: wrap the user optimizer by the
+        enabled strategy flags, innermost first."""
+        st = self._strategy or DistributedStrategy()
+        opt = self._inner_opt
+        if st.lamb:
+            opt = LambMetaOptimizer(opt, **st.lamb_configs)
+        if st.lars:
+            opt = LarsMetaOptimizer(opt, **st.lars_configs)
+        if st.dgc:
+            cfg = st.dgc_configs
+            opt = DGCMomentumOptimizer(
+                opt, rampup_begin_step=cfg.get("rampup_begin_step", 0),
+                sparsity=(cfg.get("sparsity") or [0.999])[-1])
+        if st.gradient_merge:
+            opt = GradientMergeOptimizer(opt, **st.gradient_merge_configs)
+        if st.recompute:
+            opt = RecomputeOptimizer(
+                opt, st.recompute_configs.get("checkpoints", []))
+        if st.localsgd:
+            opt = LocalSGDOptimizer(opt, **st.localsgd_configs)
+        if st.amp:
+            from ..contrib import mixed_precision as mp
+            cfg = dict(st.amp_configs)
+            opt = mp.decorate(
+                opt,
+                mp.AutoMixedPrecisionLists(
+                    cfg.get("custom_white_list") or None,
+                    cfg.get("custom_black_list") or None),
+                init_loss_scaling=cfg.get("init_loss_scaling", 2.0 ** 15),
+                use_dynamic_loss_scaling=cfg.get(
+                    "use_dynamic_loss_scaling"),
+                dest_dtype=cfg.get("dest_dtype", "bfloat16"))
+        return opt
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, program=None):
+        if self._inner_opt is None:
+            raise RuntimeError("call fleet.distributed_optimizer(opt) "
+                               "before minimize")
+        chain = self._compile_chain()
+        return chain.minimize(loss, startup_program=startup_program,
+                              parameter_list=parameter_list,
+                              no_grad_set=no_grad_set, program=program)
+
+    # --- save passthroughs (fleet_base.py:529) ----------------------------
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None):
+        from .. import io as _io
+        return _io.save_inference_model(dirname, feeded_var_names,
+                                        target_vars, executor,
+                                        main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from .. import io as _io
+        return _io.save_persistables(executor, dirname, main_program)
+
+
+fleet = Fleet()
+
+# module-level convenience API, like `from paddle.distributed import fleet`
+init = fleet.init
+is_worker = fleet.is_worker
+is_server = fleet.is_server
+is_first_worker = fleet.is_first_worker
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+server_num = fleet.server_num
+init_worker = fleet.init_worker
+init_server = fleet.init_server
+stop_worker = fleet.stop_worker
+distributed_optimizer = fleet.distributed_optimizer
+minimize = fleet.minimize

@@ -1,0 +1,135 @@
+"""Process launcher: `python -m paddle_tpu.fleet.launch train.py args...`
+
+Analog of /root/reference/python/paddle/distributed/fleet/launch.py
+(:413 launch entry, launch_collective:188 / launch_ps:227) +
+launch_utils.py (per-process env wiring, TrainerProc watchdog that
+terminates the pod when any member dies). On a TPU pod slice the normal
+deployment is ONE controller process per host (jax single-controller
+SPMD) — `--nproc_per_node` beyond 1 exists for CPU-mesh testing and PS
+clusters, where each process gets the reference's env contract
+(PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS /
+TRAINING_ROLE=PSERVER + PADDLE_PSERVERS_IP_PORT_LIST).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List
+
+
+def _find_free_ports(n: int) -> List[int]:
+    import socket
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def launch_collective(args, extra: List[str]) -> int:
+    n = args.nproc_per_node
+    ports = _find_free_ports(n)
+    endpoints = ",".join("127.0.0.1:%d" % p for p in ports)
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update({
+            "TRAINING_ROLE": "TRAINER",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(n),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT": "127.0.0.1:%d" % ports[rank],
+            "FLAGS_selected_devices": str(rank),
+        })
+        cmd = [sys.executable, args.training_script] + extra
+        log = open(os.path.join(args.log_dir, "workerlog.%d" % rank), "w") \
+            if args.log_dir else None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            log = open(os.path.join(args.log_dir,
+                                    "workerlog.%d" % rank), "w")
+        procs.append(subprocess.Popen(cmd, env=env, stdout=log,
+                                      stderr=subprocess.STDOUT
+                                      if log else None))
+    return _watchdog(procs)
+
+
+def launch_ps(args, extra: List[str]) -> int:
+    ns, nw = args.server_num, args.worker_num
+    sports = _find_free_ports(ns)
+    server_eps = ",".join("127.0.0.1:%d" % p for p in sports)
+    procs = []
+    for i in range(ns):
+        env = dict(os.environ)
+        env.update({
+            "TRAINING_ROLE": "PSERVER",
+            "POD_IP": "127.0.0.1",
+            "PADDLE_PORT": str(sports[i]),
+            "PADDLE_PSERVERS_IP_PORT_LIST": server_eps,
+            "PADDLE_TRAINERS_NUM": str(nw),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, args.training_script] + extra, env=env))
+    for rank in range(nw):
+        env = dict(os.environ)
+        env.update({
+            "TRAINING_ROLE": "TRAINER",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nw),
+            "PADDLE_PSERVERS_IP_PORT_LIST": server_eps,
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, args.training_script] + extra, env=env))
+    return _watchdog(procs)
+
+
+def _watchdog(procs) -> int:
+    """launch_utils.py TrainerProc poll loop: any member failing kills
+    the pod; all-success exits 0."""
+    try:
+        while True:
+            alive = False
+            for p in procs:
+                ret = p.poll()
+                if ret is None:
+                    alive = True
+                elif ret != 0:
+                    for q in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+                    return ret
+            if not alive:
+                return 0
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGTERM)
+        raise
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "paddle_tpu.fleet.launch",
+        description="spawn training processes with the fleet env contract")
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--server_num", type=int, default=0)
+    parser.add_argument("--worker_num", type=int, default=0)
+    parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("training_script")
+    args, extra = parser.parse_known_args(argv)
+    if args.server_num > 0:
+        return launch_ps(args, extra)
+    return launch_collective(args, extra)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,243 @@
+"""Meta-optimizers: strategy-driven optimizer/program rewrites.
+
+Analog of /root/reference/python/paddle/distributed/fleet/meta_optimizers/
+(amp_optimizer.py, recompute_optimizer.py, gradient_merge_optimizer.py,
+lamb/lars_optimizer.py, dgc_optimizer.py, localsgd_optimizer.py,
+pipeline_optimizer.py, graph_execution_optimizer.py) and of the wrapper
+optimizers in fluid/optimizer.py (GradientMergeOptimizer:4994,
+RecomputeOptimizer:4518). Each wraps an inner optimizer and rewrites the
+program at minimize() time; fleet's strategy compiler chains them
+(strategy_compiler.py analog in fleet/__init__.py).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..core.backward import append_backward
+from ..core.program import OpDesc, default_main_program, \
+    default_startup_program
+from ..optimizer.static_opt import Lamb, LarsMomentum, Momentum, Optimizer
+
+
+class MetaOptimizerBase:
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, program=None):
+        return self._inner.minimize(loss, startup_program=startup_program,
+                                    parameter_list=parameter_list,
+                                    no_grad_set=no_grad_set,
+                                    program=program)
+
+
+class RecomputeOptimizer(MetaOptimizerBase):
+    """optimizer.py:4518 / recompute_optimizer.py — forward segments
+    between user checkpoints are rematerialized in the backward
+    (executor lowers remat_segments with jax.checkpoint)."""
+
+    def __init__(self, inner, checkpoints: List):
+        super().__init__(inner)
+        self._checkpoints = list(checkpoints)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, program=None):
+        program = program or default_main_program()
+        startup = startup_program or default_startup_program()
+        params_grads = append_backward(
+            loss, parameter_list, no_grad_set,
+            checkpoints=self._checkpoints, program=program)
+        self._inner.apply_gradients(params_grads, program, startup)
+        return None, params_grads
+
+
+class GradientMergeOptimizer(MetaOptimizerBase):
+    """optimizer.py:4994 / gradient_merge_optimizer.py — accumulate k
+    microbatch grads into persistable buffers; every k-th step a
+    conditional block applies the inner optimizer on the (averaged)
+    accumulation and zeroes the buffers."""
+
+    def __init__(self, inner, k_steps: int = 1, avg: bool = True):
+        super().__init__(inner)
+        self.k_steps = max(1, int(k_steps))
+        self.avg = avg
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, program=None):
+        from ..layers.helper import LayerHelper  # late: avoid cycles
+        program = program or default_main_program()
+        startup = startup_program or default_startup_program()
+        block = program.global_block
+        params_grads = append_backward(loss, parameter_list, no_grad_set,
+                                       program=program)
+        if self.k_steps == 1:
+            self._inner.apply_gradients(params_grads, program, startup)
+            return None, params_grads
+
+        def pvar(name, value, dtype="float32", shape=()):
+            nm = program._unique_name(name)
+            for prog in (program, startup):
+                prog.global_block.create_var(nm, shape=shape, dtype=dtype,
+                                             persistable=True,
+                                             stop_gradient=True)
+            startup.global_block.append_op(
+                "fill_constant", inputs={}, outputs={"Out": [nm]},
+                attrs={"shape": list(shape), "value": value,
+                       "dtype": dtype})
+            return nm
+
+        counter = pvar("gm_step", 0.0, "int32")
+        block.append_op("increment", inputs={"X": [counter]},
+                        outputs={"Out": [counter]}, attrs={"step": 1})
+        accum_of = {}
+        for p, g in params_grads:
+            acc = pvar("gm_acc_" + p.name, 0.0, p.dtype,
+                       tuple(p.shape or ()))
+            block.append_op("elementwise_add",
+                            inputs={"X": [acc], "Y": [g.name]},
+                            outputs={"Out": [acc]}, attrs={"axis": -1})
+            accum_of[p.name] = acc
+
+        k_name = pvar("gm_k", self.k_steps, "int32")
+        mod = program._unique_name("gm_mod")
+        block.create_var(mod, shape=(), dtype="int32", stop_gradient=True)
+        block.append_op("elementwise_mod",
+                        inputs={"X": [counter], "Y": [k_name]},
+                        outputs={"Out": [mod]}, attrs={"axis": -1})
+        zero = pvar("gm_zero", 0, "int32")
+        pred = program._unique_name("gm_pred")
+        block.create_var(pred, shape=(), dtype="bool", stop_gradient=True)
+        block.append_op("equal", inputs={"X": [mod], "Y": [zero]},
+                        outputs={"Out": [pred]})
+
+        # true block: apply inner optimizer on (averaged) accums, zero them
+        true_blk = program.create_block()
+        with program.block_guard(true_blk):
+            lr = self._inner._create_global_learning_rate(program, startup)
+            scaled_grads = []
+            for p, _ in params_grads:
+                acc = accum_of[p.name]
+                scaled = program._unique_name(acc + "_avg")
+                block_cur = program.current_block()
+                block_cur.create_var(scaled, shape=tuple(p.shape or ()),
+                                     dtype=p.dtype, stop_gradient=True)
+                block_cur.append_op(
+                    "scale", inputs={"X": [acc]},
+                    outputs={"Out": [scaled]},
+                    attrs={"scale": 1.0 / self.k_steps if self.avg
+                           else 1.0, "bias": 0.0})
+                scaled_grads.append(scaled)
+            for (p, _), sg in zip(params_grads, scaled_grads):
+                self._inner._append_optimize_op(
+                    program.current_block(), p,
+                    program.current_block().var(sg), lr, program, startup)
+            for p, _ in params_grads:  # zero the buffers
+                acc = accum_of[p.name]
+                program.current_block().append_op(
+                    "scale", inputs={"X": [acc]}, outputs={"Out": [acc]},
+                    attrs={"scale": 0.0, "bias": 0.0})
+        false_blk = program.create_block()  # no-op branch
+
+        # exports: everything the true block wrote that lives in the
+        # parent (params, accums, optimizer state)
+        writes = []
+        for op in true_blk.ops:
+            for ns in op.outputs.values():
+                for n in ns:
+                    if n not in writes and block.has_var(n) and \
+                            n not in {s for s in scaled_grads}:
+                        writes.append(n)
+        block.append_op(
+            "cond_block_pair",
+            inputs={"Cond": [pred]},
+            outputs={"Out": writes},
+            attrs={"true_block": true_blk.idx, "false_block": false_blk.idx,
+                   "true_outs": writes, "false_outs": writes})
+        return None, params_grads
+
+
+class LambMetaOptimizer(MetaOptimizerBase):
+    """lamb_optimizer.py — swap the inner Adam-family optimizer for Lamb
+    keeping lr/clip/regularization."""
+
+    def __init__(self, inner, lamb_weight_decay: float = 0.01,
+                 exclude_from_weight_decay: Optional[List[str]] = None):
+        lamb = Lamb(learning_rate=inner._learning_rate,
+                    lamb_weight_decay=lamb_weight_decay,
+                    grad_clip=inner.grad_clip,
+                    regularization=inner.regularization)
+        super().__init__(lamb)
+
+
+class LarsMetaOptimizer(MetaOptimizerBase):
+    """lars_optimizer.py — swap Momentum for LarsMomentum."""
+
+    def __init__(self, inner, lars_coeff: float = 0.001,
+                 lars_weight_decay: float = 0.0005):
+        momentum = getattr(inner, "_momentum", 0.9)
+        lars = LarsMomentum(learning_rate=inner._learning_rate,
+                            momentum=momentum, lars_coeff=lars_coeff,
+                            lars_weight_decay=lars_weight_decay,
+                            grad_clip=inner.grad_clip,
+                            regularization=inner.regularization)
+        super().__init__(lars)
+
+
+class DGCMomentumOptimizer(MetaOptimizerBase):
+    """optimizer.py:1181 DGCMomentumOptimizer / dgc_optimizer.py — deep
+    gradient compression: after rampup, keep only the top-k fraction of
+    each grad (by magnitude), accumulate the rest locally with momentum
+    correction (operators/dgc_op.*). The dense allreduce of the sparse
+    residual maps to the dp-axis psum of the masked grad."""
+
+    def __init__(self, inner, rampup_begin_step: int = 0,
+                 sparsity: float = 0.999):
+        super().__init__(inner)
+        self._rampup = rampup_begin_step
+        self._sparsity = float(sparsity)
+        self._step = 0
+        self._residual = {}
+
+    def compress(self, name: str, grad: np.ndarray) -> np.ndarray:
+        """Eager-path compression (tested host-side; device path is the
+        same arithmetic under jit)."""
+        self._step += 1
+        if self._step <= self._rampup:
+            return grad
+        g = np.asarray(grad) + self._residual.get(name, 0.0)
+        flat = np.abs(g).ravel()
+        k = max(1, int(round(flat.size * (1.0 - self._sparsity))))
+        thresh = np.partition(flat, -k)[-k]
+        mask = np.abs(g) >= thresh
+        self._residual[name] = np.where(mask, 0.0, g)
+        return np.where(mask, g, 0.0)
+
+
+class LocalSGDOptimizer(MetaOptimizerBase):
+    """localsgd_optimizer.py:78-140 — run k local steps, then average
+    parameters across the data-parallel group. Single-controller SPMD
+    keeps params replicated, so the averaging step is the identity
+    unless params are intentionally de-synced (per-device shard_map
+    training); provided for strategy parity with the periodic-psum
+    formulation documented here."""
+
+    def __init__(self, inner, k_steps: int = 1):
+        super().__init__(inner)
+        self.k_steps = k_steps
+
+    def average_params(self, params, mesh=None, axis="dp"):
+        import jax
+        if mesh is None:
+            return params
+        from jax.sharding import PartitionSpec as P
+
+        def avg(p):
+            return jax.shard_map(
+                lambda x: jax.lax.pmean(x, axis),
+                mesh=mesh, in_specs=P(), out_specs=P())(p)
+        return jax.tree.map(avg, params)

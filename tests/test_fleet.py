@@ -1,0 +1,239 @@
+"""Fleet API + meta-optimizer chain + AMP rewrite + launch tests.
+
+Mirrors the reference's test_fleet_base.py, test_dist_strategy
+(meta-optimizer wiring), test_mixed_precision and test_launch semantics."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.fleet import (DistributedStrategy, Fleet,
+                              PaddleCloudRoleMaker, UserDefinedRoleMaker)
+from paddle_tpu.fleet.role_maker import Role
+
+
+def _build(seed_w=None):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, 1, name="p")
+        loss = layers.mean(layers.square_error_cost(pred, y))
+    return main, startup, pred, loss
+
+
+def _batches(n=12, seed=0):
+    rng = np.random.RandomState(seed)
+    w = np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+    for _ in range(n):
+        xb = rng.randn(16, 4).astype(np.float32)
+        yield xb, (xb @ w + 0.1).astype(np.float32)
+
+
+def _train(main, startup, loss, steps=12):
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        losses = []
+        for xb, yb in _batches(steps):
+            out, = exe.run(main, feed={"x": xb, "y": yb},
+                           fetch_list=[loss])
+            losses.append(float(out))
+    return losses
+
+
+# ---------------------------------------------------------------------------
+# role maker / facade
+# ---------------------------------------------------------------------------
+
+def test_cloud_role_maker_trainer_env(monkeypatch):
+    monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                       "h0:1,h1:2,h2:3,h3:4")
+    rm = PaddleCloudRoleMaker()
+    assert rm.is_worker() and not rm.is_server()
+    assert rm.worker_index() == 2
+    assert rm.worker_num() == 4
+    assert not rm.is_first_worker()
+
+
+def test_cloud_role_maker_pserver_env(monkeypatch):
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("POD_IP", "10.0.0.2")
+    monkeypatch.setenv("PADDLE_PORT", "7164")
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                       "10.0.0.1:7164,10.0.0.2:7164")
+    rm = PaddleCloudRoleMaker()
+    assert rm.is_server()
+    assert rm.server_index() == 1
+    assert rm.server_num() == 2
+
+
+def test_fleet_facade_and_strategy_roundtrip():
+    f = Fleet()
+    f.init(UserDefinedRoleMaker(current_id=0, role=Role.WORKER,
+                                worker_num=2))
+    assert f.is_first_worker() and f.worker_num() == 2
+    st = DistributedStrategy()
+    st.amp = True
+    st.recompute = True
+    d = st.to_dict()
+    st2 = DistributedStrategy.from_dict(d)
+    assert st2.amp and st2.recompute and not st2.dgc
+    with pytest.raises(ValueError):
+        DistributedStrategy.from_dict({"bogus_flag": True})
+
+
+# ---------------------------------------------------------------------------
+# meta-optimizer chain over static programs
+# ---------------------------------------------------------------------------
+
+def test_fleet_minimize_plain_sgd_converges():
+    main, startup, pred, loss = _build()
+    f = Fleet().init(UserDefinedRoleMaker())
+    with pt.program_guard(main, startup):
+        f.distributed_optimizer(pt.optimizer.SGD(0.05),
+                                DistributedStrategy())
+        f.minimize(loss, startup_program=startup, program=main)
+    losses = _train(main, startup, loss)
+    assert losses[-1] < losses[0]
+
+
+def test_fleet_amp_rewrite_inserts_casts_and_trains():
+    main, startup, pred, loss = _build()
+    st = DistributedStrategy()
+    st.amp = True
+    f = Fleet().init(UserDefinedRoleMaker())
+    with pt.program_guard(main, startup):
+        f.distributed_optimizer(pt.optimizer.SGD(0.05), st)
+        f.minimize(loss, startup_program=startup, program=main)
+    types = [op.type for op in main.global_block.ops]
+    assert "cast" in types, types
+    assert "check_finite_and_unscale" in types
+    losses = _train(main, startup, loss)
+    assert losses[-1] < losses[0]
+
+
+def test_fleet_recompute_trains():
+    main, startup, pred, loss = _build()
+    st = DistributedStrategy()
+    st.recompute = True
+    # checkpoint at the fc output
+    st.recompute_configs = {"checkpoints": [pred.name]}
+    f = Fleet().init(UserDefinedRoleMaker())
+    with pt.program_guard(main, startup):
+        f.distributed_optimizer(pt.optimizer.SGD(0.05), st)
+        f.minimize(loss, startup_program=startup, program=main)
+    losses = _train(main, startup, loss)
+    assert losses[-1] < losses[0]
+
+
+def test_gradient_merge_applies_every_k_steps():
+    main, startup, pred, loss = _build()
+    st = DistributedStrategy()
+    st.gradient_merge = True
+    st.gradient_merge_configs = {"k_steps": 4, "avg": True}
+    f = Fleet().init(UserDefinedRoleMaker())
+    with pt.program_guard(main, startup):
+        f.distributed_optimizer(pt.optimizer.SGD(0.1), st)
+        f.minimize(loss, startup_program=startup, program=main)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        wname = main.all_parameters()[0].name
+        w0 = np.asarray(pt.global_scope().find_var(wname)).copy()
+        batches = list(_batches(4))
+        # 3 steps: no parameter change yet
+        for xb, yb in batches[:3]:
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        w3 = np.asarray(pt.global_scope().find_var(wname))
+        np.testing.assert_allclose(w3, w0)
+        # 4th step applies the merged update
+        exe.run(main, feed={"x": batches[3][0], "y": batches[3][1]},
+                fetch_list=[loss])
+        w4 = np.asarray(pt.global_scope().find_var(wname))
+        assert not np.allclose(w4, w0)
+
+
+def test_lamb_lars_meta_swap():
+    for flag, op_type in [("lamb", "lamb"), ("lars", "lars_momentum")]:
+        main, startup, pred, loss = _build()
+        st = DistributedStrategy()
+        setattr(st, flag, True)
+        f = Fleet().init(UserDefinedRoleMaker())
+        inner = pt.optimizer.Adam(0.001) if flag == "lamb" else \
+            pt.optimizer.Momentum(0.001, momentum=0.9)
+        with pt.program_guard(main, startup):
+            f.distributed_optimizer(inner, st)
+            f.minimize(loss, startup_program=startup, program=main)
+        types = {op.type for op in main.global_block.ops}
+        assert op_type in types, (flag, types)
+
+
+def test_dgc_compress_topk_and_residual():
+    from paddle_tpu.fleet import DGCMomentumOptimizer
+    dgc = DGCMomentumOptimizer(pt.optimizer.Momentum(0.1, momentum=0.9),
+                               rampup_begin_step=0, sparsity=0.75)
+    g = np.array([4.0, -3.0, 0.1, 0.2], np.float32)
+    out = dgc.compress("w", g)
+    assert np.count_nonzero(out) == 1 and out[0] == 4.0
+    # residual carries the dropped mass into the next step
+    out2 = dgc.compress("w", np.zeros(4, np.float32))
+    assert out2[1] == -3.0
+
+
+# ---------------------------------------------------------------------------
+# PS wiring through the facade
+# ---------------------------------------------------------------------------
+
+def test_fleet_ps_worker_server_flow():
+    f = Fleet().init(UserDefinedRoleMaker())
+    st = DistributedStrategy()
+    st.a_sync = True
+    f._strategy = st
+    server = f.init_server()
+    server.init_param("w", np.zeros(2, np.float32))
+    comm = f.init_worker()
+    comm.send("w", np.ones(2, np.float32))
+    f.barrier_worker()
+    f.stop_worker()
+    assert comm.recv("w")[0] < 0
+
+
+# ---------------------------------------------------------------------------
+# launch CLI
+# ---------------------------------------------------------------------------
+
+def test_launch_collective_env_contract(tmp_path):
+    script = tmp_path / "probe.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        n = os.environ["PADDLE_TRAINERS_NUM"]
+        eps = os.environ["PADDLE_TRAINER_ENDPOINTS"]
+        assert len(eps.split(",")) == int(n), eps
+        print("rank", rank, "of", n)
+    """))
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.fleet.launch",
+         "--nproc_per_node", "2", str(script)],
+        capture_output=True, text=True, cwd="/root/repo", timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_launch_propagates_failure(tmp_path):
+    script = tmp_path / "boom.py"
+    script.write_text("import os, sys; "
+                      "sys.exit(3 if os.environ['PADDLE_TRAINER_ID'] == '1' "
+                      "else 0)")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.fleet.launch",
+         "--nproc_per_node", "2", str(script)],
+        capture_output=True, text=True, cwd="/root/repo", timeout=120)
+    assert r.returncode == 3
