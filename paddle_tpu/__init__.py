@@ -63,3 +63,6 @@ seed = set_global_seed
 from . import fleet  # noqa: F401
 from . import distributed  # noqa: F401
 from . import contrib  # noqa: F401
+from . import metric  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi import Model  # noqa: F401

@@ -1,0 +1,176 @@
+"""Training callbacks for the hapi Model loop.
+
+Analog of /root/reference/python/paddle/hapi/callbacks.py (Callback:64,
+ProgBarLogger:311, ModelCheckpoint:575, LRScheduler:647,
+EarlyStopping:723).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params: Dict = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = dict(params or {})
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks: Optional[List[Callback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb):
+        self.callbacks.append(cb)
+
+    def _call(self, name, *args, **kw):
+        for cb in self.callbacks:
+            getattr(cb, name)(*args, **kw)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *a, **k: self._call(name, *a, **k)
+        raise AttributeError(name)
+
+    def set_model(self, model):
+        for cb in self.callbacks:
+            cb.set_model(model)
+
+    def set_params(self, params):
+        for cb in self.callbacks:
+            cb.set_params(params)
+
+
+class ProgBarLogger(Callback):
+    """callbacks.py:311 — periodic stdout lines (log_freq steps)."""
+
+    def __init__(self, log_freq: int = 10, verbose: int = 1):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            items = " ".join("%s: %.4f" % (k, float(np.asarray(v)))
+                             for k, v in (logs or {}).items()
+                             if np.isscalar(v) or np.ndim(v) == 0)
+            print("Epoch %d step %d %s" % (self._epoch, step, items))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            print("Epoch %d done in %.1fs" % (epoch,
+                                              time.time() - self._t0))
+
+
+class ModelCheckpoint(Callback):
+    """callbacks.py:575 — save every save_freq epochs + final."""
+
+    def __init__(self, save_freq: int = 1, save_dir: str = "checkpoint"):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model and epoch % self.save_freq == 0:
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+    def on_train_end(self, logs=None):
+        if self.model:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class LRSchedulerCallback(Callback):
+    """callbacks.py:647 LRScheduler — step the lr schedule per epoch (or
+    per batch when by_step)."""
+
+    def __init__(self, by_step: bool = False):
+        super().__init__()
+        self.by_step = by_step
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if not self.by_step and s is not None:
+            s.step()
+
+
+class EarlyStopping(Callback):
+    """callbacks.py:723 — stop when the monitored metric stops improving."""
+
+    def __init__(self, monitor: str = "loss", patience: int = 0,
+                 mode: str = "min", min_delta: float = 0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.mode = mode
+        self.min_delta = abs(min_delta)
+        self.best = np.inf if mode == "min" else -np.inf
+        self.wait = 0
+        self.stopped_epoch = -1
+
+    def _improved(self, cur):
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        if self.monitor not in logs:
+            return
+        cur = float(np.asarray(logs[self.monitor]).reshape(-1)[0])
+        if self._improved(cur):
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience and self.model is not None:
+                self.model.stop_training = True

@@ -1,0 +1,224 @@
+"""hapi Model: the high-level train/eval/predict loop.
+
+Analog of /root/reference/python/paddle/hapi/model.py:788 (Model with
+prepare:1180, fit:1243, evaluate, predict, save/load, train_batch/
+eval_batch). The dygraph adapter's per-batch forward/backward collapses
+into the fused TrainStep (jit-compiled forward+backward+update with
+donated state) — the hapi loop is the reference's, the step is XLA's.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .. import io as _io
+from ..dygraph.tape import Tensor
+from ..jit import TrainStep, functional_call, load_state, state_of
+from ..metric import Metric
+from .callbacks import Callback, CallbackList, ProgBarLogger
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step: Optional[TrainStep] = None
+        self._eval_fn = None
+        self.stop_training = False
+
+    # --- prepare (model.py:1180) -----------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            assert isinstance(m, Metric), \
+                "metrics must be paddle_tpu.metric.Metric instances"
+        amp = None
+        if isinstance(amp_configs, str):
+            amp = "bfloat16" if amp_configs in ("O1", "O2", "bf16",
+                                                "bfloat16") else None
+        elif isinstance(amp_configs, dict):
+            amp = amp_configs.get("dtype", "bfloat16")
+        if optimizer is not None and loss is not None:
+            def loss_fn(*outs_and_labels):
+                # split: network outputs first, labels after
+                return self._call_loss(loss, outs_and_labels)
+            self._train_step = TrainStep(self.network, loss_fn, optimizer,
+                                         amp_dtype=amp)
+        return self
+
+    @staticmethod
+    def _call_loss(loss, outs_and_labels):
+        return loss(*outs_and_labels)
+
+    # --- single-batch API (model.py train_batch:996) ----------------------
+    def train_batch(self, inputs, labels=None):
+        assert self._train_step is not None, "call prepare() first"
+        self.network.train()
+        loss = self._train_step(_to_list(inputs), _to_list(labels))
+        return [np.asarray(loss)]
+
+    def _build_eval(self):
+        import jax
+
+        def eval_fn(state, inputs):
+            out, _ = functional_call(self.network, state,
+                                     *[Tensor(x) for x in inputs],
+                                     training=False)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            return tuple(o.value if isinstance(o, Tensor) else o
+                         for o in outs)
+        self._eval_fn = jax.jit(eval_fn)
+
+    def _current_state(self):
+        if self._train_step is not None and \
+                getattr(self._train_step, "_state", None) is not None:
+            return self._train_step._state
+        return state_of(self.network)
+
+    def eval_batch(self, inputs, labels=None):
+        if self._eval_fn is None:
+            self._build_eval()
+        self.network.eval()
+        import jax.numpy as jnp
+        outs = self._eval_fn(self._current_state(),
+                             tuple(jnp.asarray(np.asarray(x))
+                                   for x in _to_list(inputs)))
+        return [np.asarray(o) for o in outs]
+
+    predict_batch = eval_batch
+
+    # --- fit (model.py:1243) ---------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
+            epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
+            save_dir: Optional[str] = None, save_freq: int = 1,
+            verbose: int = 1, drop_last: bool = False, shuffle: bool = True,
+            num_workers: int = 0, callbacks=None):
+        loader = self._as_loader(train_data, batch_size, shuffle,
+                                 drop_last, num_workers)
+        eval_loader = self._as_loader(eval_data, batch_size, False, False,
+                                      0) if eval_data is not None else None
+
+        cbks = CallbackList(_to_list(callbacks))
+        if verbose:
+            cbks.append(ProgBarLogger(log_freq, verbose))
+        if save_dir:
+            from .callbacks import ModelCheckpoint
+            cbks.append(ModelCheckpoint(save_freq, save_dir))
+        cbks.set_model(self)
+        cbks.set_params({"epochs": epochs, "verbose": verbose})
+
+        self.stop_training = False
+        cbks.on_train_begin()
+        history = {"loss": []}
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                loss = self.train_batch(inputs, labels)[0]
+                history["loss"].append(float(loss))
+                cbks.on_train_batch_end(step, {"loss": loss})
+            logs = {"loss": history["loss"][-1]}
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, batch_size=None,
+                                          verbose=0, _callbacks=cbks)
+                logs.update(eval_logs)
+            cbks.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        cbks.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size: int = 1, verbose: int = 0,
+                 num_workers: int = 0, _callbacks=None):
+        loader = self._as_loader(eval_data, batch_size, False, False,
+                                 num_workers) if batch_size is not None \
+            else eval_data
+        cbks = _callbacks or CallbackList([])
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        losses = []
+        for step, batch in enumerate(loader):
+            inputs, labels = self._split_batch(batch)
+            outs = self.eval_batch(inputs)
+            if self._loss is not None and labels:
+                import jax.numpy as jnp
+                lv = self._loss(*[Tensor(jnp.asarray(o)) for o in outs],
+                                *[Tensor(jnp.asarray(np.asarray(x)))
+                                  for x in labels])
+                losses.append(float(np.asarray(
+                    lv.value if isinstance(lv, Tensor) else lv)))
+            for m in self._metrics:
+                args = m.compute(outs[0], labels[0] if labels else None)
+                m.update(*args)
+            cbks.on_eval_batch_end(step)
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size: int = 1, num_workers: int = 0):
+        loader = self._as_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        outs: List[List[np.ndarray]] = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch, has_labels=False)
+            res = self.eval_batch(inputs)
+            outs.append(res)
+        n_out = len(outs[0])
+        return [np.concatenate([o[i] for o in outs]) for i in range(n_out)]
+
+    # --- persistence (model.py save:1059 / load:1091) ---------------------
+    def save(self, path):
+        if self._train_step is not None:
+            self._train_step.sync_model()
+        sd = self.network.state_dict()
+        _io.save_dygraph(sd, path)
+
+    def load(self, path):
+        params, _ = _io.load_dygraph(path)
+        self.network.set_state_dict(params)
+        if self._train_step is not None:
+            self._train_step._step_fn = None  # recompile with new state
+
+    def parameters(self):
+        return self.network.parameters()
+
+    # --- helpers ----------------------------------------------------------
+    @staticmethod
+    def _split_batch(batch, has_labels: bool = True):
+        batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+        if not has_labels or len(batch) == 1:
+            return batch, []
+        return batch[:-1], batch[-1:]
+
+    @staticmethod
+    def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
+        if data is None:
+            return None
+        from ..reader import DataLoader, Dataset, IterableDataset
+        if isinstance(data, DataLoader):
+            return data
+        if hasattr(data, "__getitem__") and hasattr(data, "__len__"):
+            return DataLoader(data, batch_size=batch_size or 1,
+                              shuffle=shuffle, drop_last=drop_last,
+                              num_workers=num_workers,
+                              use_buffer_reader=False)
+        return data  # already an iterable of batches

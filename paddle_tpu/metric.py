@@ -1,0 +1,155 @@
+"""Metrics: streaming accuracy/precision/recall/auc.
+
+Analog of /root/reference/python/paddle/metric/metrics.py (Metric base
+:47, Accuracy:138, Precision:255, Recall:350, Auc:443) and of the metric
+ops (operators/metrics/: accuracy_op, auc_op, precision_recall_op).
+Host-side numpy accumulation — the op lowerings in ops/metrics.py serve
+the static-graph path; these classes serve hapi/dygraph loops.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+
+
+class Metric:
+    def __init__(self, name: Optional[str] = None):
+        self._name = name or type(self).__name__.lower()
+
+    def name(self) -> str:
+        return self._name
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def compute(self, pred, label):
+        """Optional fast-path preprocessing run on device outputs before
+        update() (metrics.py Metric.compute contract)."""
+        return pred, label
+
+
+class Accuracy(Metric):
+    """metrics.py:138 — top-k accuracy."""
+
+    def __init__(self, topk=(1,), name: Optional[str] = None):
+        super().__init__(name or "acc")
+        self.topk = tuple(topk) if isinstance(topk, (list, tuple)) \
+            else (topk,)
+        self.reset()
+
+    def reset(self):
+        self.correct = np.zeros(len(self.topk), np.int64)
+        self.total = 0
+
+    def compute(self, pred, label):
+        pred = np.asarray(pred)
+        label = np.asarray(label).reshape(len(pred), -1)[:, 0]
+        kmax = max(self.topk)
+        top = np.argsort(-pred, axis=-1)[:, :kmax]
+        return top, label
+
+    def update(self, top, label):
+        top = np.asarray(top)
+        label = np.asarray(label).reshape(-1, 1)
+        hit = top == label
+        for i, k in enumerate(self.topk):
+            self.correct[i] += int(hit[:, :k].any(axis=1).sum())
+        self.total += len(label)
+
+    def accumulate(self):
+        accs = [c / max(1, self.total) for c in self.correct]
+        return accs[0] if len(accs) == 1 else accs
+
+
+class Precision(Metric):
+    """metrics.py:255 — binary precision over 0.5-thresholded scores."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name or "precision")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, pred, label):
+        p = (np.asarray(pred).reshape(-1) > 0.5).astype(np.int64)
+        y = np.asarray(label).reshape(-1).astype(np.int64)
+        self.tp += int(((p == 1) & (y == 1)).sum())
+        self.fp += int(((p == 1) & (y == 0)).sum())
+
+    def accumulate(self):
+        d = self.tp + self.fp
+        return self.tp / d if d else 0.0
+
+
+class Recall(Metric):
+    """metrics.py:350."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name or "recall")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, pred, label):
+        p = (np.asarray(pred).reshape(-1) > 0.5).astype(np.int64)
+        y = np.asarray(label).reshape(-1).astype(np.int64)
+        self.tp += int(((p == 1) & (y == 1)).sum())
+        self.fn += int(((p == 0) & (y == 1)).sum())
+
+    def accumulate(self):
+        d = self.tp + self.fn
+        return self.tp / d if d else 0.0
+
+
+class Auc(Metric):
+    """metrics.py:443 — ROC AUC via the reference's histogram
+    approximation (auc_op.cc: bucketed thresholds)."""
+
+    def __init__(self, num_thresholds: int = 4095,
+                 name: Optional[str] = None):
+        super().__init__(name or "auc")
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._pos = np.zeros(self.num_thresholds + 1, np.int64)
+        self._neg = np.zeros(self.num_thresholds + 1, np.int64)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        idx = np.minimum((preds * self.num_thresholds).astype(np.int64),
+                         self.num_thresholds)
+        np.add.at(self._pos, idx[labels > 0.5], 1)
+        np.add.at(self._neg, idx[labels <= 0.5], 1)
+
+    def accumulate(self):
+        # trapezoid over the bucketed ROC (auc_op.h Compute)
+        tot_pos = self._pos.sum()
+        tot_neg = self._neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # walk buckets from the highest threshold down
+        tp = np.cumsum(self._pos[::-1])
+        fp = np.cumsum(self._neg[::-1])
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        tpr = np.concatenate([[0.0], tpr])
+        fpr = np.concatenate([[0.0], fpr])
+        return float(np.trapezoid(tpr, fpr))

@@ -1,0 +1,153 @@
+"""hapi Model.fit/evaluate/predict + metrics + callbacks tests.
+
+Mirrors the reference's test_model.py (fit on a small classifier, metric
+accumulation, checkpoint save/load, early stopping)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.hapi.callbacks import Callback, EarlyStopping
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+from paddle_tpu.reader import TensorDataset
+
+
+def _make_data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    w = np.array([[1.0, -1.0], [2.0, 0.0], [-1.0, 1.0], [0.5, 0.5]],
+                 np.float32)
+    logits = x @ w
+    y = logits.argmax(1).astype(np.int64).reshape(-1, 1)
+    return x, y
+
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.l1 = nn.Linear(4, 16)
+        self.l2 = nn.Linear(16, 2)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        return self.l2(F.relu(self.l1(x)))
+
+
+def _ce_loss(logits, label):
+    import paddle_tpu.nn.functional as F
+    return F.cross_entropy(logits, label)
+
+
+def test_metrics_standalone():
+    acc = Accuracy(topk=(1, 2))
+    pred = np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]])
+    label = np.array([[0], [1], [1]])
+    acc.update(*acc.compute(pred, label))
+    a1, a2 = acc.accumulate()
+    assert abs(a1 - 2 / 3) < 1e-6 and a2 == 1.0
+
+    p = Precision()
+    p.update(np.array([0.9, 0.8, 0.2]), np.array([1, 0, 1]))
+    assert abs(p.accumulate() - 0.5) < 1e-6
+    r = Recall()
+    r.update(np.array([0.9, 0.8, 0.2]), np.array([1, 0, 1]))
+    assert abs(r.accumulate() - 0.5) < 1e-6
+
+    auc = Auc()
+    scores = np.concatenate([np.random.RandomState(0).rand(100) * 0.5,
+                             np.random.RandomState(1).rand(100) * 0.5
+                             + 0.5])
+    labels = np.concatenate([np.zeros(100), np.ones(100)])
+    auc.update(scores, labels)
+    assert auc.accumulate() > 0.95
+
+
+def test_model_fit_reduces_loss_and_evaluates():
+    x, y = _make_data(128)
+    model = pt.Model(_MLP())
+    model.prepare(pt.optimizer.Adam(0.01,
+                                    parameters=model.parameters()),
+                  _ce_loss, metrics=Accuracy())
+    hist = model.fit(TensorDataset(x, y), batch_size=16, epochs=4,
+                     verbose=0, shuffle=True)
+    assert hist["loss"][-1] < hist["loss"][0]
+    logs = model.evaluate(TensorDataset(x, y), batch_size=32)
+    assert logs["acc"] > 0.7
+    assert "loss" in logs
+
+
+def test_model_predict_shapes():
+    x, y = _make_data(20)
+    model = pt.Model(_MLP())
+    model.prepare(pt.optimizer.SGD(0.01, parameters=model.parameters()),
+                  _ce_loss)
+    out, = model.predict(TensorDataset(x), batch_size=8)
+    assert out.shape == (20, 2)
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    x, y = _make_data(32)
+    model = pt.Model(_MLP())
+    model.prepare(pt.optimizer.SGD(0.05, parameters=model.parameters()),
+                  _ce_loss)
+    model.fit(TensorDataset(x, y), batch_size=8, epochs=1, verbose=0)
+    before, = model.predict(TensorDataset(x), batch_size=32)
+    path = str(tmp_path / "m")
+    model.save(path)
+
+    model2 = pt.Model(_MLP())
+    model2.prepare(pt.optimizer.SGD(0.05,
+                                    parameters=model2.parameters()),
+                   _ce_loss)
+    model2.load(path)
+    after, = model2.predict(TensorDataset(x), batch_size=32)
+    np.testing.assert_allclose(before, after, atol=1e-5)
+
+
+def test_callbacks_order_and_early_stopping():
+    x, y = _make_data(32)
+
+    class Recorder(Callback):
+        def __init__(self):
+            super().__init__()
+            self.events = []
+
+        def on_train_begin(self, logs=None):
+            self.events.append("train_begin")
+
+        def on_epoch_begin(self, epoch, logs=None):
+            self.events.append("epoch_begin")
+
+        def on_train_batch_end(self, step, logs=None):
+            self.events.append("batch")
+
+        def on_epoch_end(self, epoch, logs=None):
+            self.events.append("epoch_end")
+
+        def on_train_end(self, logs=None):
+            self.events.append("train_end")
+
+    rec = Recorder()
+    model = pt.Model(_MLP())
+    model.prepare(pt.optimizer.SGD(0.01, parameters=model.parameters()),
+                  _ce_loss)
+    model.fit(TensorDataset(x, y), batch_size=16, epochs=2, verbose=0,
+              callbacks=[rec])
+    assert rec.events[0] == "train_begin" and rec.events[-1] == "train_end"
+    assert rec.events.count("epoch_begin") == 2
+
+    # early stopping: patience 0 on a non-improving metric stops training
+    class ConstantMetricStop(EarlyStopping):
+        def on_epoch_end(self, epoch, logs=None):
+            self.on_eval_end({"loss": 1.0})  # never improves after 1st
+
+    model2 = pt.Model(_MLP())
+    model2.prepare(pt.optimizer.SGD(0.01,
+                                    parameters=model2.parameters()),
+                   _ce_loss)
+    stopper = ConstantMetricStop(monitor="loss", patience=0)
+    model2.fit(TensorDataset(x, y), batch_size=16, epochs=10, verbose=0,
+               callbacks=[stopper])
+    assert model2.stop_training
