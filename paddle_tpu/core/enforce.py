@@ -1,0 +1,50 @@
+"""Error machinery + nan/inf debugging.
+
+Analog of /root/reference/paddle/fluid/platform/enforce.h
+(PADDLE_ENFORCE* with typed errors and context notes) and of
+details/nan_inf_utils_detail.cc (FLAGS_check_nan_inf scanning each op's
+outputs, operator.cc:1056). Under XLA the per-op scan is traced into the
+compiled step as a lax.cond + host debug callback, so it reports at
+*runtime* with the op/var name that first produced a non-finite value.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["enforce", "EnforceNotMet", "check_numerics"]
+
+
+class EnforceNotMet(RuntimeError):
+    """PADDLE_ENFORCE failure (enforce.h ThrowOnError)."""
+
+
+def enforce(cond: bool, msg: str = "", *fmt_args: Any) -> None:
+    if not cond:
+        raise EnforceNotMet(msg % fmt_args if fmt_args else msg)
+
+
+def check_numerics(value, op_type: str, var_name: str):
+    """Trace a finite-check on a float array; on a non-finite value the
+    compiled step prints the culprit op/var (nan_inf_utils_detail.cc
+    prints and aborts; XLA cannot abort, so this reports loudly)."""
+    import jax
+    import jax.numpy as jnp
+    if not hasattr(value, "dtype") or \
+            not jnp.issubdtype(value.dtype, jnp.floating):
+        return value
+
+    finite = jnp.all(jnp.isfinite(value))
+
+    def _report(bad):
+        if bad:
+            print("!!! check_nan_inf: op %r output %r contains nan/inf"
+                  % (op_type, var_name))
+
+    def _bad(_):
+        jax.debug.callback(_report, True)
+
+    def _ok(_):
+        pass
+
+    jax.lax.cond(finite, _ok, _bad, None)
+    return value

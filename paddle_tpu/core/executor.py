@@ -72,11 +72,22 @@ class _BlockLowerer:
         ins = {slot: [env[n] for n in names]
                for slot, names in op.inputs.items() if names}
         try:
-            outs = opdef.lower(self.ctx, ins, op.attrs)
+            from ..profiler import RecordEvent
+            with RecordEvent(op.type, "op_lower"):
+                outs = opdef.lower(self.ctx, ins, op.attrs)
         except Exception as e:  # annotate with op context, PADDLE_ENFORCE-style
             e.add_note(f"while lowering op {op.type!r} "
                        f"(in={op.inputs}, out={op.outputs})")
             raise
+        from ..flags import get_flag
+        if get_flag("check_nan_inf"):
+            # FLAGS_check_nan_inf (operator.cc:1056): traced finite-check
+            # on every float output, reporting at runtime
+            from .enforce import check_numerics
+            for slot, vals in outs.items():
+                names = op.outputs.get(slot, [])
+                for n, v in zip(names, vals or []):
+                    check_numerics(v, op.type, n)
         block = self.program.global_block
         for slot, names in op.outputs.items():
             vals = outs.get(slot)

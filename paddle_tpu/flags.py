@@ -1,0 +1,72 @@
+"""Global flags registry.
+
+Analog of the reference's gflags surface
+(/root/reference/paddle/fluid/platform/flags.cc:33-521 DEFINE_* +
+pybind/global_value_getter_setter.cc exposing __set_flags/get_flags to
+Python). Flags that configured CUDA allocators/streams have no TPU
+meaning and are accepted as inert for script compatibility; behavioral
+flags (nan/inf checking, deterministic mode, eager deletion analogs) are
+read by the executor/ops.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Union
+
+_DEFS: Dict[str, Any] = {
+    # debugging (flags.cc:98 cudnn_deterministic, operator.cc:1056
+    # check_nan_inf)
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_fast_check_nan_inf": False,
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_enable_unused_var_check": False,
+    # memory knobs — inert on TPU (XLA owns HBM) but settable
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_memory_fraction_of_eager_deletion": 1.0,
+    "FLAGS_gpu_allocator_retry_time": 2000,
+    # execution
+    "FLAGS_benchmark": False,
+    "FLAGS_paddle_num_threads": 1,
+    "FLAGS_max_inplace_grad_add": 0,
+    # collectives — inert (XLA combiner thresholds are compiler flags)
+    "FLAGS_fuse_parameter_memory_size": -1,
+    "FLAGS_fuse_parameter_groups_size": 3,
+    "FLAGS_sync_nccl_allreduce": True,
+}
+
+_values: Dict[str, Any] = dict(_DEFS)
+
+
+def _canon(name: str) -> str:
+    return name if name.startswith("FLAGS_") else "FLAGS_" + name
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """fluid.set_flags — unknown flags raise, like __set_flags."""
+    for k, v in flags.items():
+        k = _canon(k)
+        if k not in _values:
+            raise ValueError("unknown flag %r (known: %d flags)"
+                             % (k, len(_values)))
+        _values[k] = v
+
+
+def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, Any]:
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        ck = _canon(k)
+        if ck not in _values:
+            raise ValueError("unknown flag %r" % k)
+        out[ck] = _values[ck]
+    return out
+
+
+def get_flag(name: str, default: Any = None) -> Any:
+    return _values.get(_canon(name), default)
+
+
+def register_flag(name: str, default: Any) -> None:
+    _values.setdefault(_canon(name), default)

@@ -1,0 +1,176 @@
+"""Profiler: host event spans + device traces + chrome-tracing export.
+
+Analog of /root/reference/paddle/fluid/platform/profiler.{h,cc}
+(RecordEvent:126 scoped host spans, ProfilerState CPU/GPU/All:39,
+start_profiler/stop_profiler + report tables) and device_tracer.cc
+(CUPTI kernel capture -> profiler.proto -> tools/timeline.py chrome
+trace). The device side maps onto jax.profiler (XPlane/TensorBoard
+traces capture the real TPU timeline); the host side keeps the
+RecordEvent span tree, aggregate tables, and a chrome://tracing JSON
+exporter so tools/timeline.py-style workflows keep working.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+__all__ = ["RecordEvent", "profiler", "start_profiler", "stop_profiler",
+           "reset_profiler", "export_chrome_tracing", "summary",
+           "start_device_trace", "stop_device_trace"]
+
+_lock = threading.Lock()
+_enabled = False
+_events: List[dict] = []
+_tls = threading.local()
+
+
+def _now_us() -> float:
+    return time.perf_counter() * 1e6
+
+
+class RecordEvent:
+    """Scoped host span (profiler.h:126). Usable as context manager or
+    decorator; nests via a thread-local stack."""
+
+    def __init__(self, name: str, event_type: str = "op"):
+        self.name = name
+        self.event_type = event_type
+        self._t0 = None
+
+    def __enter__(self):
+        if _enabled:
+            self._t0 = _now_us()
+            stack = getattr(_tls, "stack", None)
+            if stack is None:
+                stack = _tls.stack = []
+            stack.append(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        if _enabled and self._t0 is not None:
+            t1 = _now_us()
+            stack = _tls.stack
+            full = "/".join(stack)
+            stack.pop()
+            with _lock:
+                _events.append({
+                    "name": self.name, "full_name": full,
+                    "cat": self.event_type, "ts": self._t0,
+                    "dur": t1 - self._t0,
+                    "tid": threading.get_ident() % 100000,
+                })
+        return False
+
+    def __call__(self, fn):
+        def wrapped(*a, **k):
+            with RecordEvent(self.name, self.event_type):
+                return fn(*a, **k)
+        return wrapped
+
+
+def start_profiler(state: str = "CPU", tracer_option: str = "Default"):
+    """fluid/profiler.py start_profiler. state 'All'/'GPU' additionally
+    starts a jax.profiler device trace when a trace dir is configured via
+    start_device_trace()."""
+    global _enabled
+    _enabled = True
+
+
+def stop_profiler(sorted_key: Optional[str] = "total",
+                  profile_path: Optional[str] = None):
+    global _enabled
+    _enabled = False
+    if profile_path:
+        export_chrome_tracing(profile_path)
+    return summary(sorted_key)
+
+
+def reset_profiler():
+    with _lock:
+        _events.clear()
+
+
+class profiler:
+    """Context manager: `with profiler.profiler('CPU', ...)` parity
+    (fluid/profiler.py:context)."""
+
+    def __init__(self, state: str = "CPU", sorted_key: str = "total",
+                 profile_path: Optional[str] = None):
+        self._path = profile_path
+        self._key = sorted_key
+
+    def __enter__(self):
+        reset_profiler()
+        start_profiler()
+        return self
+
+    def __exit__(self, *exc):
+        stop_profiler(self._key, self._path)
+        return False
+
+
+def summary(sorted_key: Optional[str] = "total") -> List[dict]:
+    """Aggregate table like the reference's profiler report: per name
+    {calls, total_us, avg_us, max_us}."""
+    agg: Dict[str, dict] = defaultdict(
+        lambda: {"calls": 0, "total_us": 0.0, "max_us": 0.0})
+    with _lock:
+        for e in _events:
+            a = agg[e["name"]]
+            a["calls"] += 1
+            a["total_us"] += e["dur"]
+            a["max_us"] = max(a["max_us"], e["dur"])
+    rows = [{"name": k, **v, "avg_us": v["total_us"] / v["calls"]}
+            for k, v in agg.items()]
+    if sorted_key in ("total", None):
+        rows.sort(key=lambda r: -r["total_us"])
+    elif sorted_key == "calls":
+        rows.sort(key=lambda r: -r["calls"])
+    elif sorted_key == "max":
+        rows.sort(key=lambda r: -r["max_us"])
+    return rows
+
+
+def export_chrome_tracing(path: str):
+    """tools/timeline.py analog: write chrome://tracing JSON."""
+    with _lock:
+        trace = {
+            "traceEvents": [
+                {"name": e["name"], "cat": e["cat"], "ph": "X",
+                 "ts": e["ts"], "dur": e["dur"], "pid": 0, "tid": e["tid"],
+                 "args": {"full_name": e["full_name"]}}
+                for e in _events
+            ]
+        }
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+
+
+# --- device (XLA) tracing — the CUPTI/device_tracer analog ---------------
+
+_device_trace_dir = None
+
+
+def start_device_trace(log_dir: str):
+    """jax.profiler.start_trace: captures the real TPU timeline (XPlane)
+    viewable in TensorBoard/Perfetto — the device_tracer.cc replacement."""
+    global _device_trace_dir
+    import jax
+    jax.profiler.start_trace(log_dir)
+    _device_trace_dir = log_dir
+
+
+def stop_device_trace():
+    global _device_trace_dir
+    import jax
+    jax.profiler.stop_trace()
+    d = _device_trace_dir
+    _device_trace_dir = None
+    return d

@@ -1,0 +1,94 @@
+"""Profiler spans/export, flags registry, enforce, nan/inf check tests.
+
+Mirrors the reference's test_profiler.py, flag getter/setter tests and
+nan_inf_utils debugging behavior."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, profiler
+from paddle_tpu.core.enforce import EnforceNotMet, enforce
+
+
+def test_record_event_nesting_and_summary():
+    profiler.reset_profiler()
+    profiler.start_profiler()
+    with profiler.RecordEvent("outer"):
+        with profiler.RecordEvent("inner"):
+            pass
+        with profiler.RecordEvent("inner"):
+            pass
+    rows = profiler.stop_profiler()
+    by_name = {r["name"]: r for r in rows}
+    assert by_name["inner"]["calls"] == 2
+    assert by_name["outer"]["calls"] == 1
+    assert by_name["outer"]["total_us"] >= by_name["inner"]["total_us"]
+
+
+def test_chrome_tracing_export(tmp_path):
+    profiler.reset_profiler()
+    profiler.start_profiler()
+    with profiler.RecordEvent("span_a"):
+        pass
+    path = str(tmp_path / "trace.json")
+    profiler.stop_profiler(profile_path=path)
+    with open(path) as f:
+        trace = json.load(f)
+    assert any(e["name"] == "span_a" for e in trace["traceEvents"])
+    assert all(e["ph"] == "X" for e in trace["traceEvents"])
+
+
+def test_executor_ops_produce_spans():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.fc(x, 8)
+    profiler.reset_profiler()
+    profiler.start_profiler()
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[y])
+    rows = profiler.stop_profiler()
+    names = {r["name"] for r in rows}
+    assert "mul" in names  # fc lowers via mul
+
+
+def test_flags_set_get_unknown():
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    assert pt.get_flags("check_nan_inf")["FLAGS_check_nan_inf"] is True
+    pt.set_flags({"check_nan_inf": False})  # short name accepted
+    with pytest.raises(ValueError):
+        pt.set_flags({"FLAGS_not_a_flag": 1})
+    with pytest.raises(ValueError):
+        pt.get_flags("nope")
+
+
+def test_enforce():
+    enforce(True, "fine")
+    with pytest.raises(EnforceNotMet, match="bad value 3"):
+        enforce(False, "bad value %d", 3)
+
+
+def test_check_nan_inf_reports(capfd):
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [2])
+            y = layers.log(x)  # log of a negative -> nan
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            out, = exe.run(main,
+                           feed={"x": np.array([[-1.0, 2.0]], np.float32)},
+                           fetch_list=[y])
+        assert np.isnan(out).any()
+        captured = capfd.readouterr()
+        assert "check_nan_inf" in captured.out
+        assert "log" in captured.out
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
