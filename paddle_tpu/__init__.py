@@ -70,3 +70,6 @@ from . import profiler  # noqa: F401
 from . import flags as _flags_mod  # noqa: F401
 from .flags import set_flags, get_flags  # noqa: F401
 from .core.enforce import enforce, EnforceNotMet  # noqa: F401
+from . import compiler  # noqa: F401
+from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
+                       ExecutionStrategy)

@@ -1,0 +1,104 @@
+"""CompiledProgram: multi-device execution of static programs.
+
+Analog of /root/reference/python/paddle/fluid/compiler.py
+(CompiledProgram:87, with_data_parallel:160) and of the C++
+ParallelExecutor it drives (framework/parallel_executor.cc:443: replicate
+the graph per device, insert AllReduceOpHandles per gradient, run SSA
+executors on threads). On TPU the whole apparatus collapses into GSPMD:
+with_data_parallel marks the program so the Executor stages batch feeds
+sharded over the mesh's 'dp' axis and parameters replicated — XLA then
+partitions the single jitted computation and inserts the gradient
+all-reduces the reference built op-handles for
+(multi_devices_graph_pass.cc:464 CreateAllReduceOp).
+
+BuildStrategy / ExecutionStrategy keep the reference's knob surface
+(details/build_strategy.h); most knobs are XLA's decisions now and are
+accepted as inert configuration.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .core.program import Program
+
+
+class BuildStrategy:
+    """details/build_strategy.h — knob surface kept for compatibility."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.sync_batch_norm = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    """details/execution_strategy.h."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 100
+        self.use_thread_barrier = False
+
+
+class CompiledProgram:
+    def __init__(self, program_or_graph, build_strategy:
+                 Optional[BuildStrategy] = None):
+        if isinstance(program_or_graph, CompiledProgram):
+            raise ValueError("already compiled")
+        self._program: Program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy: Optional[ExecutionStrategy] = None
+        self._is_data_parallel = False
+        self._loss_name: Optional[str] = None
+        self._mesh = None
+
+    def with_data_parallel(self, loss_name: Optional[str] = None,
+                           build_strategy: Optional[BuildStrategy] = None,
+                           exec_strategy: Optional[ExecutionStrategy] = None,
+                           share_vars_from=None, places=None):
+        """compiler.py:160. places maps to the mesh's dp extent: by
+        default every visible device joins the data-parallel axis."""
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        self._exec_strategy = exec_strategy
+        if places is not None:
+            self._n_devices = len(places) if hasattr(places, "__len__") \
+                else int(places)
+        else:
+            self._n_devices = None
+        return self
+
+    def _get_mesh(self):
+        if self._mesh is not None:
+            return self._mesh
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+        from .parallel.env import get_mesh
+        mesh = get_mesh()
+        if mesh is not None and "dp" in mesh.axis_names:
+            self._mesh = mesh
+        else:
+            devs = jax.devices()
+            n = self._n_devices or len(devs)
+            self._mesh = Mesh(np.array(devs[:n]), ("dp",))
+        return self._mesh

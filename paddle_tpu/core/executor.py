@@ -203,7 +203,9 @@ def _run_with_remat(lowerer: _BlockLowerer, ops, env, segments):
 
 
 def _feed_sig(feed: Dict[str, np.ndarray]) -> tuple:
-    return tuple(sorted((k, tuple(v.shape), str(np.asarray(v).dtype))
+    return tuple(sorted((k, tuple(v.shape), str(v.dtype)
+                         if hasattr(v, "dtype")
+                         else str(np.asarray(v).dtype))
                         for k, v in feed.items()))
 
 
@@ -234,6 +236,17 @@ class Executor:
             scope: Optional[Scope] = None,
             return_numpy: bool = True,
             use_program_cache: bool = True):
+        # CompiledProgram.with_data_parallel (compiler.py): unwrap and
+        # stage feeds sharded over the mesh dp axis — GSPMD partitions
+        # the step and inserts the grad all-reduces (the ParallelExecutor
+        # + AllReduceOpHandle pipeline of the reference)
+        dp_mesh = None
+        from ..compiler import CompiledProgram as _CP
+        if isinstance(program, _CP):
+            cp = program
+            program = cp._program
+            if cp._is_data_parallel:
+                dp_mesh = cp._get_mesh()
         program = program if program is not None else default_main_program()
         scope = scope if scope is not None else global_scope()
         feed = dict(feed or {})
@@ -241,6 +254,20 @@ class Executor:
                        for f in (fetch_list or [])]
 
         feed = {k: _as_host(v) for k, v in feed.items()}
+        if dp_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            n = dp_mesh.shape["dp"]
+            staged = {}
+            for k, v in feed.items():
+                arr = np.asarray(v)
+                if arr.ndim >= 1 and arr.shape[0] % n == 0:
+                    spec = P("dp", *([None] * (arr.ndim - 1)))
+                    staged[k] = jax.device_put(
+                        arr, NamedSharding(dp_mesh, spec))
+                else:
+                    staged[k] = jax.device_put(
+                        arr, NamedSharding(dp_mesh, P()))
+            feed = staged
 
         # run initializer-style programs (startup): ops writing persistables
         # with no feeds/fetches execute eagerly into the scope.
