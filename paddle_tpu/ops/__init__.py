@@ -10,8 +10,14 @@ from ..core.registry import REGISTRY  # noqa: F401
 from . import (  # noqa: F401
     activation,
     amp,
+    beam,
     controlflow,
+    detection,
     elementwise,
+    fused,
+    loss_extra,
+    rnn,
+    vision,
     math,
     metrics,
     nn,

@@ -1,0 +1,87 @@
+"""Beam search ops.
+
+Analog of /root/reference/paddle/fluid/operators/beam_search_op.* (one
+step: expand beams by top-k over accumulated scores, with end-token
+pruning), beam_search_decode_op.* (walk the recorded parent pointers to
+emit final hypotheses) and gather_tree (operators/gather_tree_op.cc).
+Static-shape convention: beams are dense [batch, beam_size]; finished
+beams propagate their score with the end token.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import one
+
+
+@register_op("beam_search",
+             inputs=("pre_ids", "pre_scores", "ids", "scores"),
+             outputs=("selected_ids", "selected_scores", "parent_idx"),
+             no_grad=True)
+def _beam_search(ctx, ins, attrs):
+    """One decode step. pre_ids/pre_scores: [batch*beam, 1]; scores:
+    [batch*beam, V] log-probs of the next token. Returns the top
+    beam_size continuations per batch with their source beam index."""
+    beam_size = attrs["beam_size"]
+    end_id = attrs.get("end_id", 0)
+    pre_ids = ins["pre_ids"][0].reshape(-1)
+    pre_scores = ins["pre_scores"][0].reshape(-1)
+    scores = ins["scores"][0]
+    BK, V = scores.shape
+    batch = BK // beam_size
+
+    finished = pre_ids == end_id
+    # finished beams only continue with end_id at unchanged score
+    cand = pre_scores[:, None] + jnp.where(finished[:, None], NEG_INF,
+                                           scores)
+    end_col = jnp.zeros((BK, V), bool).at[:, end_id].set(True)
+    cand = jnp.where(finished[:, None] & end_col, pre_scores[:, None],
+                     cand)
+    cand = cand.reshape(batch, beam_size * V)
+    top_scores, top_idx = jax.lax.top_k(cand, beam_size)
+    src_beam = top_idx // V          # [batch, beam]
+    token = top_idx % V
+    parent = src_beam + jnp.arange(batch)[:, None] * beam_size
+    return {"selected_ids": [token.reshape(-1, 1).astype(jnp.int64)],
+            "selected_scores": [top_scores.reshape(-1, 1)],
+            "parent_idx": [parent.reshape(-1).astype(jnp.int64)]}
+
+
+NEG_INF = -1e9
+
+
+@register_op("gather_tree", inputs=("Ids", "Parents"), no_grad=True)
+def _gather_tree(ctx, ins, attrs):
+    """gather_tree_op.cc: ids/parents [T, batch, beam] -> full paths by
+    back-tracking parent pointers from the last step."""
+    ids = ins["Ids"][0]
+    parents = ins["Parents"][0]
+    T, B, K = ids.shape
+
+    def back(carry, t):
+        beam_ptr = carry  # [B, K] current source beam per final slot
+        tok = jnp.take_along_axis(ids[t], beam_ptr, axis=1)
+        nxt = jnp.take_along_axis(parents[t], beam_ptr, axis=1)
+        return nxt.astype(beam_ptr.dtype), tok
+
+    init = jnp.broadcast_to(jnp.arange(K), (B, K)).astype(jnp.int32)
+    _, toks = jax.lax.scan(back, init, jnp.arange(T - 1, -1, -1))
+    return one(toks[::-1])
+
+
+@register_op("beam_search_decode",
+             inputs=("Ids", "Scores", "ParentIdx"),
+             outputs=("SentenceIds", "SentenceScores"), no_grad=True)
+def _beam_search_decode(ctx, ins, attrs):
+    """beam_search_decode_op.*: assemble final sequences from per-step
+    ids + parent pointers. Inputs are stacked [T, batch, beam] (the
+    reference walks LoD tensor arrays; arrays stack to this layout)."""
+    ids = ins["Ids"][0]
+    scores = ins["Scores"][0]
+    parents = ins["ParentIdx"][0]
+    paths = _gather_tree(ctx, {"Ids": [ids], "Parents": [parents]},
+                         {})["Out"][0]
+    return {"SentenceIds": [paths], "SentenceScores": [scores[-1]]}

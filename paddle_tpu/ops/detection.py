@@ -1,0 +1,706 @@
+"""Detection (CV) ops: anchors/priors, box coding, IoU, NMS, ROI pooling.
+
+Analog of /root/reference/paddle/fluid/operators/detection/ (prior_box_op,
+density_prior_box_op, anchor_generator_op, box_coder_op, iou_similarity_op,
+box_clip_op, yolo_box_op, multiclass_nms_op, matrix_nms_op,
+bipartite_match_op, target_assign_op, sigmoid_focal_loss_op) and
+operators/roi_align_op / roi_pool_op.
+
+Static-shape policy: the reference emits variable-row LoD outputs from
+NMS-style ops; XLA requires static shapes, so those ops return padded
+fixed-size results plus a count/index tensor (the framework's ragged
+convention) — keep_top_k / nms_top_k attrs bound the sizes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import one
+
+
+# ---------------------------------------------------------------------------
+# anchors / priors
+# ---------------------------------------------------------------------------
+
+@register_op("prior_box", inputs=("Input", "Image"),
+             outputs=("Boxes", "Variances"), no_grad=True)
+def _prior_box(ctx, ins, attrs):
+    """prior_box_op.cc: SSD prior boxes for one feature map."""
+    feat = ins["Input"][0]    # [N, C, H, W]
+    img = ins["Image"][0]     # [N, C, IH, IW]
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = img.shape[2], img.shape[3]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", []):
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if attrs.get("flip", False):
+                ars.append(1.0 / float(ar))
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    step_w = attrs.get("step_w", 0.0) or IW / W
+    step_h = attrs.get("step_h", 0.0) or IH / H
+    offset = attrs.get("offset", 0.5)
+    clip = attrs.get("clip", False)
+    min_max_ar_order = attrs.get("min_max_aspect_ratios_order", False)
+
+    widths, heights = [], []
+    for ms in min_sizes:
+        if min_max_ar_order:
+            widths.append(ms)
+            heights.append(ms)
+            if max_sizes:
+                mx = max_sizes[min_sizes.index(ms)]
+                s = np.sqrt(ms * mx)
+                widths.append(s)
+                heights.append(s)
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                widths.append(ms * np.sqrt(ar))
+                heights.append(ms / np.sqrt(ar))
+        else:
+            for ar in ars:
+                widths.append(ms * np.sqrt(ar))
+                heights.append(ms / np.sqrt(ar))
+            if max_sizes:
+                mx = max_sizes[min_sizes.index(ms)]
+                s = np.sqrt(ms * mx)
+                widths.append(s)
+                heights.append(s)
+    widths = jnp.asarray(widths, jnp.float32)
+    heights = jnp.asarray(heights, jnp.float32)
+    K = widths.shape[0]
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)          # [H, W]
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    xmin = (cxg - widths / 2) / IW
+    ymin = (cyg - heights / 2) / IH
+    xmax = (cxg + widths / 2) / IW
+    ymax = (cyg + heights / 2) / IH
+    boxes = jnp.stack([xmin, ymin, xmax, ymax], axis=-1)  # [H, W, K, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           boxes.shape)
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+@register_op("density_prior_box", inputs=("Input", "Image"),
+             outputs=("Boxes", "Variances"), no_grad=True)
+def _density_prior_box(ctx, ins, attrs):
+    """density_prior_box_op.cc: dense grid of priors per cell."""
+    feat = ins["Input"][0]
+    img = ins["Image"][0]
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = img.shape[2], img.shape[3]
+    fixed_sizes = [float(s) for s in attrs.get("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in attrs.get("fixed_ratios", [1.0])]
+    densities = [int(d) for d in attrs.get("densities", [1])]
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    step_w = attrs.get("step_w", 0.0) or IW / W
+    step_h = attrs.get("step_h", 0.0) or IH / H
+    offset = attrs.get("offset", 0.5)
+
+    ws, hs, sxs, sys = [], [], [], []
+    for size, dens in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            shift = size / dens
+            for di in range(dens):
+                for dj in range(dens):
+                    ws.append(bw)
+                    hs.append(bh)
+                    sxs.append(-size / 2.0 + shift / 2.0 + dj * shift)
+                    sys.append(-size / 2.0 + shift / 2.0 + di * shift)
+    ws = jnp.asarray(ws, jnp.float32)
+    hs = jnp.asarray(hs, jnp.float32)
+    sxs = jnp.asarray(sxs, jnp.float32)
+    sys = jnp.asarray(sys, jnp.float32)
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    cxg = cxg[..., None] + sxs
+    cyg = cyg[..., None] + sys
+    xmin = jnp.clip((cxg - ws / 2) / IW, 0.0, 1.0)
+    ymin = jnp.clip((cyg - hs / 2) / IH, 0.0, 1.0)
+    xmax = jnp.clip((cxg + ws / 2) / IW, 0.0, 1.0)
+    ymax = jnp.clip((cyg + hs / 2) / IH, 0.0, 1.0)
+    boxes = jnp.stack([xmin, ymin, xmax, ymax], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           boxes.shape)
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+@register_op("anchor_generator", inputs=("Input",),
+             outputs=("Anchors", "Variances"), no_grad=True)
+def _anchor_generator(ctx, ins, attrs):
+    """anchor_generator_op.cc: RPN anchors per feature-map cell."""
+    feat = ins["Input"][0]
+    H, W = feat.shape[2], feat.shape[3]
+    sizes = [float(s) for s in attrs.get("anchor_sizes", [64, 128, 256])]
+    ratios = [float(r) for r in attrs.get("aspect_ratios", [0.5, 1, 2])]
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    stride = attrs.get("stride", [16.0, 16.0])
+    offset = attrs.get("offset", 0.5)
+
+    ws, hs = [], []
+    for r in ratios:
+        for s in sizes:
+            area = stride[0] * stride[1]
+            area_ratios = area / r
+            base_w = np.round(np.sqrt(area_ratios))
+            base_h = np.round(base_w * r)
+            scale_w = s / stride[0]
+            scale_h = s / stride[1]
+            ws.append(scale_w * base_w)
+            hs.append(scale_h * base_h)
+    ws = jnp.asarray(ws, jnp.float32)
+    hs = jnp.asarray(hs, jnp.float32)
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    cxg, cyg = cxg[..., None], cyg[..., None]
+    anchors = jnp.stack([cxg - 0.5 * ws, cyg - 0.5 * hs,
+                         cxg + 0.5 * ws, cyg + 0.5 * hs], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           anchors.shape)
+    return {"Anchors": [anchors], "Variances": [var]}
+
+
+# ---------------------------------------------------------------------------
+# box arithmetic
+# ---------------------------------------------------------------------------
+
+def _iou_matrix(a, b, normalized=True):
+    """a: [N,4], b: [M,4] -> [N,M] IoU."""
+    off = 0.0 if normalized else 1.0
+    area_a = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    area_b = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1 + off, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + off, 0.0)
+    inter = iw * ih
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("iou_similarity", inputs=("X", "Y"), no_grad=True)
+def _iou_similarity(ctx, ins, attrs):
+    return one(_iou_matrix(ins["X"][0], ins["Y"][0],
+                           attrs.get("box_normalized", True)))
+
+
+@register_op("box_clip", inputs=("Input", "ImInfo"), no_grad=True)
+def _box_clip(ctx, ins, attrs):
+    """box_clip_op.cc: clamp boxes into the image (im_info = [h, w,
+    scale] per image)."""
+    boxes = ins["Input"][0]  # [B, N, 4] or [N, 4]
+    im = ins["ImInfo"][0]
+    if boxes.ndim == 2:
+        h, w = im[0, 0], im[0, 1]
+        return one(jnp.stack([
+            jnp.clip(boxes[:, 0], 0, w - 1), jnp.clip(boxes[:, 1], 0, h - 1),
+            jnp.clip(boxes[:, 2], 0, w - 1), jnp.clip(boxes[:, 3], 0, h - 1),
+        ], axis=-1))
+    h = im[:, 0][:, None]
+    w = im[:, 1][:, None]
+    return one(jnp.stack([
+        jnp.clip(boxes[..., 0], 0, w - 1), jnp.clip(boxes[..., 1], 0, h - 1),
+        jnp.clip(boxes[..., 2], 0, w - 1), jnp.clip(boxes[..., 3], 0, h - 1),
+    ], axis=-1))
+
+
+@register_op("box_coder", inputs=("PriorBox", "PriorBoxVar", "TargetBox"),
+             no_grad=True)
+def _box_coder(ctx, ins, attrs):
+    """box_coder_op.cc: encode_center_size / decode_center_size."""
+    prior = ins["PriorBox"][0]        # [M, 4] (xmin,ymin,xmax,ymax)
+    pvar = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None
+    target = ins["TargetBox"][0]
+    code_type = attrs.get("code_type", "encode_center_size")
+    normalized = attrs.get("box_normalized", True)
+    off = 0.0 if normalized else 1.0
+
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if pvar is None:
+        pvar = jnp.ones_like(prior)
+
+    if code_type.startswith("encode"):
+        # target: [N, 4] gt boxes; output [N, M, 4]
+        tw = target[:, 2] - target[:, 0] + off
+        th = target[:, 3] - target[:, 1] + off
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None]) / pw[None] / pvar[None, :, 0]
+        dy = (tcy[:, None] - pcy[None]) / ph[None] / pvar[None, :, 1]
+        dw = jnp.log(jnp.abs(tw[:, None] / pw[None])) / pvar[None, :, 2]
+        dh = jnp.log(jnp.abs(th[:, None] / ph[None])) / pvar[None, :, 3]
+        return one(jnp.stack([dx, dy, dw, dh], axis=-1))
+    # decode: target [N, M, 4] or [N, 4] deltas vs priors
+    t = target if target.ndim == 3 else target[:, None, :]
+    dcx = pvar[None, :, 0] * t[..., 0] * pw[None] + pcx[None]
+    dcy = pvar[None, :, 1] * t[..., 1] * ph[None] + pcy[None]
+    dw = jnp.exp(pvar[None, :, 2] * t[..., 2]) * pw[None]
+    dh = jnp.exp(pvar[None, :, 3] * t[..., 3]) * ph[None]
+    out = jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                     dcx + dw * 0.5 - off, dcy + dh * 0.5 - off], axis=-1)
+    if target.ndim == 2:
+        out = out[:, 0]
+    return one(out)
+
+
+@register_op("polygon_box_transform", inputs=("Input",), no_grad=True)
+def _polygon_box_transform(ctx, ins, attrs):
+    """polygon_box_transform_op.cc: offset predictions -> absolute
+    quad coordinates. Input [N, 8k, H, W]: even channels add col index
+    *4, odd add row index *4 (EAST text detection convention)."""
+    x = ins["Input"][0]
+    N, C, H, W = x.shape
+    col = jnp.arange(W, dtype=x.dtype)[None, None, None, :] * 4
+    row = jnp.arange(H, dtype=x.dtype)[None, None, :, None] * 4
+    ch = jnp.arange(C) % 2
+    base = jnp.where(ch[None, :, None, None] == 0, col, row)
+    return one(base - x)
+
+
+# ---------------------------------------------------------------------------
+# yolo
+# ---------------------------------------------------------------------------
+
+@register_op("yolo_box", inputs=("X", "ImgSize"),
+             outputs=("Boxes", "Scores"), no_grad=True)
+def _yolo_box(ctx, ins, attrs):
+    """yolo_box_op.cc: decode YOLOv3 head outputs to boxes+scores."""
+    x = ins["X"][0]  # [N, A*(5+cls), H, W]
+    img = ins["ImgSize"][0]  # [N, 2] (h, w)
+    anchors = [int(a) for a in attrs["anchors"]]
+    class_num = int(attrs["class_num"])
+    conf_thresh = attrs.get("conf_thresh", 0.01)
+    downsample = attrs.get("downsample_ratio", 32)
+    clip_bbox = attrs.get("clip_bbox", True)
+    scale_xy = attrs.get("scale_x_y", 1.0)
+
+    N, C, H, W = x.shape
+    A = len(anchors) // 2
+    x = x.reshape(N, A, 5 + class_num, H, W)
+    grid_x = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+    input_h = downsample * H
+    input_w = downsample * W
+
+    sig = jax.nn.sigmoid
+    bx = (sig(x[:, :, 0]) * scale_xy - (scale_xy - 1) / 2 + grid_x) / W
+    by = (sig(x[:, :, 1]) * scale_xy - (scale_xy - 1) / 2 + grid_y) / H
+    bw = jnp.exp(x[:, :, 2]) * aw / input_w
+    bh = jnp.exp(x[:, :, 3]) * ah / input_h
+    conf = sig(x[:, :, 4])
+    probs = sig(x[:, :, 5:]) * conf[:, :, None]
+
+    imh = img[:, 0].astype(jnp.float32)[:, None, None, None]
+    imw = img[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.maximum(x1, 0)
+        y1 = jnp.maximum(y1, 0)
+        x2 = jnp.minimum(x2, imw - 1)
+        y2 = jnp.minimum(y2, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # [N, A, H, W, 4]
+    mask = (conf > conf_thresh)[..., None]
+    boxes = jnp.where(mask, boxes, 0.0).reshape(N, A * H * W, 4)
+    scores = jnp.where(mask, jnp.moveaxis(probs, 2, -1), 0.0) \
+        .reshape(N, A * H * W, class_num)
+    return {"Boxes": [boxes], "Scores": [scores]}
+
+
+# ---------------------------------------------------------------------------
+# NMS family — fixed-size padded outputs
+# ---------------------------------------------------------------------------
+
+def _nms_single(boxes, scores, iou_thresh, top_k, normalized=True):
+    """boxes [M,4], scores [M] -> keep mask after greedy NMS bounded to
+    top_k iterations (standard masked formulation)."""
+    M = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    boxes_s = boxes[order]
+    iou = _iou_matrix(boxes_s, boxes_s, normalized)
+    keep = jnp.ones(M, bool)
+
+    def body(i, keep):
+        sup = iou[i] > iou_thresh
+        sup = sup & (jnp.arange(M) > i) & keep[i]
+        return keep & ~sup
+
+    keep = jax.lax.fori_loop(0, min(top_k, M) if top_k > 0 else M, body,
+                             keep)
+    inv = jnp.zeros(M, jnp.int32).at[order].set(jnp.arange(M))
+    return keep[inv]  # back to original order
+
+
+@register_op("multiclass_nms", inputs=("BBoxes", "Scores"),
+             outputs=("Out", "Index", "NmsRoisNum"), no_grad=True)
+def _multiclass_nms(ctx, ins, attrs):
+    """multiclass_nms_op.cc. Single-image [M,4]+[C,M] or batched
+    [N,M,4]+[N,C,M]. Out is padded [keep_top_k, 6] (label, score, box)
+    with -1 labels marking empty slots; NmsRoisNum gives valid counts."""
+    bboxes = ins["BBoxes"][0]
+    scores = ins["Scores"][0]
+    score_thresh = attrs.get("score_threshold", 0.05)
+    nms_thresh = attrs.get("nms_threshold", 0.3)
+    nms_top_k = attrs.get("nms_top_k", 400)
+    keep_top_k = attrs.get("keep_top_k", 200)
+    normalized = attrs.get("normalized", True)
+    batched = bboxes.ndim == 3
+    if not batched:
+        bboxes = bboxes[None]
+        scores = scores[None]
+    N, M = bboxes.shape[0], bboxes.shape[1]
+    C = scores.shape[1]
+    K = keep_top_k if keep_top_k > 0 else M * C
+
+    def per_image(boxes, sc):
+        # per class: mask scores below threshold, NMS, gather
+        all_scores = []
+        all_labels = []
+        all_boxes = []
+        for c in range(C):
+            s = jnp.where(sc[c] > score_thresh, sc[c], 0.0)
+            keep = _nms_single(boxes, s, nms_thresh, nms_top_k, normalized)
+            s = jnp.where(keep & (s > 0), s, 0.0)
+            all_scores.append(s)
+            all_labels.append(jnp.full((M,), c, jnp.float32))
+            all_boxes.append(boxes)
+        s = jnp.concatenate(all_scores)
+        lbl = jnp.concatenate(all_labels)
+        bx = jnp.concatenate(all_boxes, axis=0)
+        top = jnp.argsort(-s)[:K]
+        s_k = s[top]
+        valid = s_k > 0
+        out = jnp.concatenate([
+            jnp.where(valid, lbl[top], -1.0)[:, None],
+            s_k[:, None], bx[top]], axis=-1)
+        out = jnp.where(valid[:, None], out, -1.0)
+        return out, top % M, valid.sum()
+
+    outs, idxs, counts = jax.vmap(per_image)(bboxes, scores)
+    if not batched:
+        return {"Out": [outs[0]], "Index": [idxs[0]],
+                "NmsRoisNum": [counts.reshape(1)]}
+    return {"Out": [outs], "Index": [idxs],
+            "NmsRoisNum": [counts.astype(jnp.int32)]}
+
+
+@register_op("matrix_nms", inputs=("BBoxes", "Scores"),
+             outputs=("Out", "Index", "RoisNum"), no_grad=True)
+def _matrix_nms(ctx, ins, attrs):
+    """matrix_nms_op.cc: parallel soft-NMS via pairwise IoU decay —
+    decay_j = min_i ((1-iou_ij) / (1-max_iou_i)) over higher-scored i
+    (gaussian or linear kernel)."""
+    bboxes = ins["BBoxes"][0]
+    scores = ins["Scores"][0]
+    score_thresh = attrs.get("score_threshold", 0.05)
+    post_thresh = attrs.get("post_threshold", 0.0)
+    keep_top_k = attrs.get("keep_top_k", 200)
+    use_gaussian = attrs.get("use_gaussian", False)
+    sigma = attrs.get("gaussian_sigma", 2.0)
+    normalized = attrs.get("normalized", True)
+    batched = bboxes.ndim == 3
+    if not batched:
+        bboxes = bboxes[None]
+        scores = scores[None]
+    N, M = bboxes.shape[0], bboxes.shape[1]
+    C = scores.shape[1]
+    K = keep_top_k if keep_top_k > 0 else M * C
+
+    def per_class(boxes, s):
+        s = jnp.where(s > score_thresh, s, 0.0)
+        order = jnp.argsort(-s)
+        bs = boxes[order]
+        ss = s[order]
+        iou = _iou_matrix(bs, bs, normalized)
+        upper = jnp.tril(iou, k=-1)  # iou with higher-scored boxes
+        max_iou = upper.max(axis=1)  # compensation per i
+        if use_gaussian:
+            decay = jnp.exp(-(upper ** 2 - max_iou[None, :] ** 2) / sigma)
+        else:
+            decay = (1 - upper) / (1 - max_iou[None, :] + 1e-10)
+        decay = jnp.where(jnp.tril(jnp.ones_like(iou, bool), k=-1),
+                          decay, 1.0)
+        ds = ss * decay.min(axis=1)
+        inv = jnp.zeros(M, jnp.int32).at[order].set(jnp.arange(M))
+        return ds[inv]
+
+    def per_image(boxes, sc):
+        ds = jax.vmap(lambda s: per_class(boxes, s))(sc)  # [C, M]
+        ds = jnp.where(ds > post_thresh, ds, 0.0)
+        flat = ds.reshape(-1)
+        lbl = jnp.repeat(jnp.arange(C, dtype=jnp.float32), M)
+        bx = jnp.tile(boxes, (C, 1))
+        top = jnp.argsort(-flat)[:K]
+        s_k = flat[top]
+        valid = s_k > 0
+        out = jnp.concatenate([
+            jnp.where(valid, lbl[top], -1.0)[:, None], s_k[:, None],
+            bx[top]], axis=-1)
+        return jnp.where(valid[:, None], out, -1.0), top % M, valid.sum()
+
+    outs, idxs, counts = jax.vmap(per_image)(bboxes, scores)
+    if not batched:
+        return {"Out": [outs[0]], "Index": [idxs[0]],
+                "RoisNum": [counts.reshape(1)]}
+    return {"Out": [outs], "Index": [idxs],
+            "RoisNum": [counts.astype(jnp.int32)]}
+
+
+@register_op("bipartite_match", inputs=("DistMat",),
+             outputs=("ColToRowMatchIndices", "ColToRowMatchDist"),
+             no_grad=True)
+def _bipartite_match(ctx, ins, attrs):
+    """bipartite_match_op.cc: greedy bipartite matching on a distance
+    matrix [R, C] — repeatedly take the global max, retire its row+col;
+    then (match_type=per_prediction) assign remaining cols whose best
+    row exceeds dist_threshold."""
+    dist = ins["DistMat"][0]
+    match_type = attrs.get("match_type", "bipartite")
+    thresh = attrs.get("dist_threshold", 0.5)
+    R, C = dist.shape
+
+    def body(carry, _):
+        d, row_free, col_idx, col_d = carry
+        masked = jnp.where(row_free[:, None], d, -1.0)
+        flat = jnp.argmax(masked)
+        r, c = flat // C, flat % C
+        best = masked[r, c]
+        take = best > -0.5
+        col_idx = jnp.where(take, col_idx.at[c].set(r), col_idx)
+        col_d = jnp.where(take, col_d.at[c].set(best), col_d)
+        row_free = jnp.where(take, row_free.at[r].set(False), row_free)
+        d = jnp.where(take, d.at[:, c].set(-1.0), d)
+        return (d, row_free, col_idx, col_d), None
+
+    init = (dist, jnp.ones(R, bool),
+            jnp.full((C,), -1, jnp.int32), jnp.zeros(C, dist.dtype))
+    (d_, rf, col_idx, col_d), _ = jax.lax.scan(body, init,
+                                               jnp.arange(min(R, C)))
+    if match_type == "per_prediction":
+        best_r = jnp.argmax(dist, axis=0)
+        best_d = dist.max(axis=0)
+        extra = (col_idx < 0) & (best_d >= thresh)
+        col_idx = jnp.where(extra, best_r.astype(jnp.int32), col_idx)
+        col_d = jnp.where(extra, best_d, col_d)
+    return {"ColToRowMatchIndices": [col_idx[None]],
+            "ColToRowMatchDist": [col_d[None]]}
+
+
+@register_op("target_assign",
+             inputs=("X", "MatchIndices", "NegIndices"),
+             outputs=("Out", "OutWeight"), no_grad=True)
+def _target_assign(ctx, ins, attrs):
+    """target_assign_op.cc: out[i,j] = X[match[i,j]] with weight 1 for
+    matched entries, mismatch_value elsewhere."""
+    x = ins["X"][0]  # [N, K] or [N, K, D] gt per row
+    match = ins["MatchIndices"][0]  # [B, M]
+    mismatch = attrs.get("mismatch_value", 0)
+    B, M = match.shape
+    matched = match >= 0
+    safe = jnp.maximum(match, 0)
+    if x.ndim == 2:
+        x = x[..., None]
+    out = x[safe]  # [B, M, D] (x indexed on first dim)
+    out = jnp.where(matched[..., None], out,
+                    jnp.asarray(mismatch, out.dtype))
+    w = matched.astype(jnp.float32)[..., None]
+    return {"Out": [out], "OutWeight": [w]}
+
+
+@register_op("sigmoid_focal_loss", inputs=("X", "Label", "FgNum"),
+             non_diff_inputs=("Label", "FgNum"))
+def _sigmoid_focal_loss(ctx, ins, attrs):
+    """sigmoid_focal_loss_op.cc (RetinaNet): class index 0 = background;
+    positive class c contributes at logit column c-1."""
+    x = ins["X"][0]          # [N, C]
+    label = ins["Label"][0].reshape(-1)  # [N] in [0, C]
+    fg = ins["FgNum"][0].reshape(()).astype(x.dtype)
+    gamma = attrs.get("gamma", 2.0)
+    alpha = attrs.get("alpha", 0.25)
+    N, C = x.shape
+    t = jax.nn.one_hot(label - 1, C, dtype=x.dtype)  # label 0 -> all zero
+    p = jax.nn.sigmoid(x)
+    ce = jnp.where(t > 0, -jnp.log(jnp.clip(p, 1e-12)),
+                   -jnp.log(jnp.clip(1 - p, 1e-12)))
+    pt = jnp.where(t > 0, p, 1 - p)
+    a = jnp.where(t > 0, alpha, 1 - alpha)
+    loss = a * (1 - pt) ** gamma * ce / jnp.maximum(fg, 1.0)
+    return one(loss)
+
+
+# ---------------------------------------------------------------------------
+# ROI pooling
+# ---------------------------------------------------------------------------
+
+@register_op("roi_align", inputs=("X", "ROIs", "RoisNum"),
+             non_diff_inputs=("ROIs", "RoisNum"))
+def _roi_align(ctx, ins, attrs):
+    """roi_align_op.cc: average of bilinear samples per output bin.
+    ROIs: [R, 4] in image coords with RoisNum per-image counts (LoD in
+    the reference); here RoisLod is replaced by a per-roi batch index
+    derived from RoisNum (or all zeros for a single image)."""
+    x = ins["X"][0]  # [N, C, H, W]
+    rois = ins["ROIs"][0]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    ratio = attrs.get("sampling_ratio", -1)
+    ratio = 2 if ratio <= 0 else ratio
+    N, C, H, W = x.shape
+    if ins.get("RoisNum"):
+        nums = ins["RoisNum"][0]
+        batch_idx = jnp.repeat(jnp.arange(nums.shape[0]), nums,
+                               total_repeat_length=rois.shape[0])
+    else:
+        batch_idx = jnp.zeros(rois.shape[0], jnp.int32)
+
+    def sample(img, box):
+        # img: [C, H, W]; box scaled to feature coords
+        x1, y1, x2, y2 = box * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid [ph*ratio, pw*ratio]
+        gy = y1 + (jnp.arange(ph * ratio) + 0.5) * bin_h / ratio
+        gx = x1 + (jnp.arange(pw * ratio) + 0.5) * bin_w / ratio
+
+        def bilinear(yy, xx):
+            y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+            y1_ = jnp.clip(y0 + 1, 0, H - 1)
+            x1_ = jnp.clip(x0 + 1, 0, W - 1)
+            wy = yy - y0
+            wx = xx - x0
+            y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+            y1i, x1i = y1_.astype(jnp.int32), x1_.astype(jnp.int32)
+            v = (img[:, y0i, x0i] * (1 - wy) * (1 - wx) +
+                 img[:, y1i, x0i] * wy * (1 - wx) +
+                 img[:, y0i, x1i] * (1 - wy) * wx +
+                 img[:, y1i, x1i] * wy * wx)
+            return v
+
+        yy, xx = jnp.meshgrid(gy, gx, indexing="ij")
+        vals = bilinear(yy.reshape(-1), xx.reshape(-1))  # [C, ph*r*pw*r]
+        vals = vals.reshape(C, ph, ratio, pw, ratio)
+        return vals.mean(axis=(2, 4))
+
+    out = jax.vmap(lambda b, i: sample(x[i], b))(rois, batch_idx)
+    return one(out)
+
+
+@register_op("roi_pool", inputs=("X", "ROIs", "RoisNum"),
+             outputs=("Out", "Argmax"),
+             non_diff_inputs=("ROIs", "RoisNum"))
+def _roi_pool(ctx, ins, attrs):
+    """roi_pool_op.cc: max pool per quantized bin."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    N, C, H, W = x.shape
+    if ins.get("RoisNum"):
+        nums = ins["RoisNum"][0]
+        batch_idx = jnp.repeat(jnp.arange(nums.shape[0]), nums,
+                               total_repeat_length=rois.shape[0])
+    else:
+        batch_idx = jnp.zeros(rois.shape[0], jnp.int32)
+
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def pool(img, box):
+        x1 = jnp.round(box[0] * scale)
+        y1 = jnp.round(box[1] * scale)
+        x2 = jnp.round(box[2] * scale)
+        y2 = jnp.round(box[3] * scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        outs = []
+        for i in range(ph):
+            for j in range(pw):
+                hy1 = jnp.floor(y1 + i * rh / ph)
+                hy2 = jnp.ceil(y1 + (i + 1) * rh / ph)
+                wx1 = jnp.floor(x1 + j * rw / pw)
+                wx2 = jnp.ceil(x1 + (j + 1) * rw / pw)
+                m = ((ys[:, None] >= hy1) & (ys[:, None] < hy2) &
+                     (xs[None, :] >= wx1) & (xs[None, :] < wx2))
+                v = jnp.where(m[None], img, -jnp.inf).max(axis=(1, 2))
+                outs.append(jnp.where(jnp.isfinite(v), v, 0.0))
+        return jnp.stack(outs, axis=-1).reshape(C, ph, pw)
+
+    out = jax.vmap(lambda b, i: pool(x[i], b))(rois, batch_idx)
+    return {"Out": [out], "Argmax": [jnp.zeros_like(out, jnp.int32)]}
+
+
+@register_op("distribute_fpn_proposals",
+             inputs=("FpnRois",),
+             outputs=("MultiFpnRois", "RestoreIndex", "MultiLevelRoIsNum"),
+             no_grad=True)
+def _distribute_fpn_proposals(ctx, ins, attrs):
+    """distribute_fpn_proposals_op.cc: route each RoI to its FPN level by
+    scale (level = floor(log2(sqrt(area)/224)) + refer_level). Static
+    shapes: each level output is the full list with non-member rows
+    zeroed; RestoreIndex is identity (order preserved)."""
+    rois = ins["FpnRois"][0]
+    min_level = attrs.get("min_level", 2)
+    max_level = attrs.get("max_level", 5)
+    refer_level = attrs.get("refer_level", 4)
+    refer_scale = attrs.get("refer_scale", 224)
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = jnp.sqrt(jnp.maximum(w * h, 1e-6))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    outs, counts = [], []
+    for L in range(min_level, max_level + 1):
+        m = (lvl == L)[:, None]
+        outs.append(jnp.where(m, rois, 0.0))
+        counts.append((lvl == L).sum())
+    restore = jnp.arange(rois.shape[0], dtype=jnp.int32)
+    return {"MultiFpnRois": outs, "RestoreIndex": [restore[:, None]],
+            "MultiLevelRoIsNum": [jnp.stack(counts).astype(jnp.int32)]}
+
+
+@register_op("collect_fpn_proposals",
+             inputs=("MultiLevelRois", "MultiLevelScores"),
+             outputs=("FpnRois", "RoisNum"), no_grad=True)
+def _collect_fpn_proposals(ctx, ins, attrs):
+    """collect_fpn_proposals_op.cc: concat per-level RoIs, keep the
+    post_nms_topN by score (padded static output)."""
+    rois = jnp.concatenate(ins["MultiLevelRois"], axis=0)
+    scores = jnp.concatenate([s.reshape(-1)
+                              for s in ins["MultiLevelScores"]], axis=0)
+    topn = attrs.get("post_nms_topN", rois.shape[0])
+    topn = min(topn, rois.shape[0])
+    top = jnp.argsort(-scores)[:topn]
+    return {"FpnRois": [rois[top]],
+            "RoisNum": [jnp.asarray([topn], jnp.int32)]}

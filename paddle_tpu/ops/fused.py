@@ -1,0 +1,198 @@
+"""Fused ops: attention, embedding+layernorm, bn+act, fc+residual+ln.
+
+Analog of /root/reference/paddle/fluid/operators/fused/ — hand-written
+CUDA fusions (multihead_matmul_op.cu, fused_embedding_eltwise_layernorm,
+fused_bn_activation, fused_elemwise_activation,
+fused_fc_elementwise_layernorm, fused_embedding_seq_pool, conv_fusion,
+fusion_repeated_fc_relu, fusion_seqpool_concat, fusion_squared_mat_sub).
+On TPU these register as *semantic* ops: multihead_matmul routes to the
+Pallas flash-attention kernel; the rest lower to jnp compositions that
+XLA fuses into the same single-kernel shape the reference hand-wrote.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import one
+
+
+@register_op("multihead_matmul",
+             inputs=("Input", "W", "Bias", "BiasQK"))
+def _multihead_matmul(ctx, ins, attrs):
+    """multihead_matmul_op.cu: fused QKV projection + attention.
+    Input [B, S, 3H] is the packed QKV projection output (or W/Bias
+    project it here); BiasQK is the additive attention mask."""
+    x = ins["Input"][0]
+    n_head = attrs["head_number"]
+    if ins.get("W"):
+        w = ins["W"][0]      # [H, 3, H'] or [H, 3H]
+        b = ins["Bias"][0] if ins.get("Bias") else None
+        if w.ndim == 3:
+            w = w.reshape(w.shape[0], -1)
+        x = x @ w
+        if b is not None:
+            x = x + b.reshape(-1)
+    B, S, H3 = x.shape
+    H = H3 // 3
+    d = H // n_head
+    qkv = x.reshape(B, S, 3, n_head, d)
+    q = jnp.moveaxis(qkv[:, :, 0], 1, 2)  # [B, heads, S, d]
+    k = jnp.moveaxis(qkv[:, :, 1], 1, 2)
+    v = jnp.moveaxis(qkv[:, :, 2], 1, 2)
+    bias_qk = ins["BiasQK"][0] if ins.get("BiasQK") else None
+    scale = attrs.get("alpha", 1.0 / math.sqrt(d))
+    from ..kernels.flash_attention import flash_attention
+    out = flash_attention(q, k, v, bias=bias_qk, sm_scale=scale)
+    return one(jnp.moveaxis(out, 1, 2).reshape(B, S, H))
+
+
+@register_op("fused_embedding_eltwise_layernorm",
+             inputs=("Ids", "Embs", "Scale", "Bias"),
+             non_diff_inputs=("Ids",))
+def _fused_emb_ln(ctx, ins, attrs):
+    """Sum of N embedding lookups + layer_norm (the BERT embedding
+    block the reference fused for inference)."""
+    ids = ins["Ids"]
+    embs = ins["Embs"]
+    total = None
+    for i, e in zip(ids, embs):
+        v = e[i.reshape(i.shape[:2]).astype(jnp.int32)]
+        total = v if total is None else total + v
+    from ..kernels.layer_norm import layer_norm
+    return one(layer_norm(total, ins["Scale"][0], ins["Bias"][0],
+                          attrs.get("epsilon", 1e-5)))
+
+
+@register_op("fused_bn_activation",
+             inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+             outputs=("Y", "MeanOut", "VarianceOut", "SavedMean",
+                      "SavedVariance"))
+def _fused_bn_act(ctx, ins, attrs):
+    """fused_bn_activation_op.cu: batch_norm -> activation in one pass;
+    same contract as the batch_norm op with act_type applied."""
+    from .nn import _batch_norm
+    outs = _batch_norm(ctx, ins, attrs)
+    act = attrs.get("act_type", "relu")
+    fn = {"relu": jax.nn.relu, "swish": jax.nn.swish,
+          "gelu": jax.nn.gelu, "": lambda v: v}[act]
+    outs["Y"] = [fn(outs["Y"][0])]
+    return outs
+
+
+@register_op("fused_elemwise_activation", inputs=("X", "Y"),
+             outputs=("Out", "IntermediateOut"))
+def _fused_elemwise_activation(ctx, ins, attrs):
+    """fused_elemwise_activation_op.cc: functor_list composes one
+    elementwise binary + one unary, e.g. ['elementwise_add', 'relu']."""
+    x, y = ins["X"][0], ins["Y"][0]
+    functors = [f.strip() for f in attrs.get("functor_list",
+                                             ["elementwise_add", "relu"])]
+    binary = {"elementwise_add": jnp.add, "elementwise_sub": jnp.subtract,
+              "elementwise_mul": jnp.multiply}
+    unary = {"relu": jax.nn.relu, "scale": lambda v: v *
+             attrs.get("scale", 1.0), "tanh": jnp.tanh,
+             "sigmoid": jax.nn.sigmoid, "gelu": jax.nn.gelu}
+    f0, f1 = functors
+    if f0 in binary:   # binary(unary?) order: binary then unary
+        mid = binary[f0](x, y)
+        out = unary[f1](mid)
+    else:              # unary(y) then binary
+        mid = unary[f0](y)
+        out = binary[f1](x, mid)
+    return {"Out": [out], "IntermediateOut": [mid]}
+
+
+@register_op("fused_fc_elementwise_layernorm",
+             inputs=("X", "W", "Bias0", "Y", "Scale", "Bias1"),
+             outputs=("Out", "Mean", "Variance"))
+def _fused_fc_eltwise_ln(ctx, ins, attrs):
+    """fc -> +residual -> layer_norm (transformer FFN tail)."""
+    x = ins["X"][0]
+    w = ins["W"][0]
+    h = x @ w
+    if ins.get("Bias0"):
+        h = h + ins["Bias0"][0]
+    h = h + ins["Y"][0]
+    from ..kernels.layer_norm import layer_norm_with_stats
+    y, mean, var = layer_norm_with_stats(
+        h, ins["Scale"][0], ins["Bias1"][0], attrs.get("epsilon", 1e-5))
+    return {"Out": [y], "Mean": [mean], "Variance": [var]}
+
+
+# fused_embedding_seq_pool registers in ops/sequence.py (lookup +
+# masked sum-pool over the ragged time axis).
+
+
+@register_op("conv_fusion", inputs=("Input", "Filter", "Bias", "ResidualData"))
+def _conv_fusion(ctx, ins, attrs):
+    """conv_fusion_op.cu: conv + bias + (residual add) + activation."""
+    from .nn import _conv2d
+    outs = _conv2d(ctx, {"Input": ins["Input"],
+                         "Filter": ins["Filter"]}, attrs)
+    y = outs["Output"][0] if "Output" in outs else outs["Out"][0]
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(1, -1, 1, 1)
+    if ins.get("ResidualData"):
+        y = y + ins["ResidualData"][0]
+    act = attrs.get("activation", "relu")
+    fn = {"relu": jax.nn.relu, "identity": lambda v: v,
+          "": lambda v: v}[act]
+    return one(fn(y))
+
+
+@register_op("fusion_repeated_fc_relu", inputs=("X", "W", "Bias"),
+             outputs=("Out", "ReluOut"))
+def _fusion_repeated_fc_relu(ctx, ins, attrs):
+    """fusion_repeated_fc_relu_op.cc: chain of fc+relu layers."""
+    x = ins["X"][0]
+    mids = []
+    for w, b in zip(ins["W"], ins["Bias"]):
+        x = jax.nn.relu(x @ w + b.reshape(-1))
+        mids.append(x)
+    return {"Out": [x], "ReluOut": mids[:-1] or [x]}
+
+
+@register_op("fusion_seqpool_concat", inputs=("X", "SeqLen"),
+             non_diff_inputs=("SeqLen",))
+def _fusion_seqpool_concat(ctx, ins, attrs):
+    """fusion_seqpool_concat_op.cc: sum/avg/sqrt-pool each padded
+    sequence input then concat on features."""
+    pooltype = attrs.get("pooltype", "SUM")
+    lens = ins["SeqLen"][0].astype(jnp.float32) if ins.get("SeqLen") \
+        else None
+    outs = []
+    for x in ins["X"]:
+        if lens is not None:
+            mask = (jnp.arange(x.shape[1])[None] <
+                    lens[:, None]).astype(x.dtype)
+            xm = x * mask[..., None]
+            denom = jnp.maximum(lens, 1.0)[:, None]
+        else:
+            xm = x
+            denom = x.shape[1]
+        s = xm.sum(axis=1)
+        if pooltype == "AVERAGE":
+            s = s / denom
+        elif pooltype == "SQRT":
+            s = s / jnp.sqrt(denom)
+        outs.append(s)
+    return one(jnp.concatenate(outs, axis=1))
+
+
+@register_op("fusion_squared_mat_sub", inputs=("X", "Y"),
+             outputs=("SquaredX", "SquaredY", "SquaredXY", "Out"))
+def _fusion_squared_mat_sub(ctx, ins, attrs):
+    """fusion_squared_mat_sub_op.cc: ( (x@y)^2 - (x^2)@(y^2) ) * scalar
+    — the FM (factorization machine) interaction term."""
+    x, y = ins["X"][0], ins["Y"][0]
+    scalar = attrs.get("scalar", 1.0)
+    xy = x @ y
+    x2y2 = (x * x) @ (y * y)
+    return {"SquaredX": [x * x], "SquaredY": [y * y],
+            "SquaredXY": [xy * xy],
+            "Out": [(xy * xy - x2y2) * scalar]}

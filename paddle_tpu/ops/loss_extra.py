@@ -1,0 +1,382 @@
+"""Extra losses and sparse-model ops: CTC, CRF, NCE, hsigmoid, CTR misc.
+
+Analog of /root/reference/paddle/fluid/operators/warpctc_op.* (the CTC
+loss the reference gets from the external warp-ctc library — here a
+lax.scan forward algorithm in log space), linear_chain_crf_op.*,
+nce_op.*, hierarchical_sigmoid_op.*, center_loss_op, bpr_loss_op,
+teacher_student_sigmoid_loss_op, cvm_op, fsp_op, batch_fc_op,
+partial_concat/partial_sum_op, hash_op, shard_index (exists), and the
+DGC ops (dgc_op.cc top-k sparsification).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import one
+
+NEG = -1e30
+
+
+def _logsumexp2(a, b):
+    m = jnp.maximum(a, b)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    return m + jnp.log(jnp.exp(a - m) + jnp.exp(b - m))
+
+
+@register_op("warpctc", inputs=("Logits", "Label", "LogitsLength",
+                                "LabelLength"),
+             outputs=("Loss", "WarpCTCGrad"),
+             non_diff_inputs=("Label", "LogitsLength", "LabelLength"))
+def _warpctc(ctx, ins, attrs):
+    """CTC loss (warpctc_op.cc semantics): Logits [B, T, C] raw
+    (norm_by_times handled by caller), Label [B, L] padded, lengths per
+    batch. blank index from attrs. Forward algorithm in log space via
+    lax.scan — differentiable, so WarpCTCGrad is served by autodiff."""
+    logits = ins["Logits"][0]
+    labels = ins["Label"][0].astype(jnp.int32)
+    blank = attrs.get("blank", 0)
+    B, T, C = logits.shape
+    L = labels.shape[1]
+    logit_len = ins["LogitsLength"][0].astype(jnp.int32).reshape(-1) \
+        if ins.get("LogitsLength") else jnp.full((B,), T, jnp.int32)
+    label_len = ins["LabelLength"][0].astype(jnp.int32).reshape(-1) \
+        if ins.get("LabelLength") else jnp.full((B,), L, jnp.int32)
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    S = 2 * L + 1
+    # extended label sequence: blank l1 blank l2 ... blank
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    ext_valid = jnp.arange(S)[None, :] < (2 * label_len + 1)[:, None]
+    # allowed skip: ext[s] != ext[s-2]
+    skip_ok = jnp.concatenate(
+        [jnp.zeros((B, 2), bool), ext[:, 2:] != ext[:, :-2]], axis=1)
+
+    def step(alpha, t):
+        # alpha [B, S] log-probs
+        a0 = alpha
+        a1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]],
+                             axis=1)
+        a2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]],
+                             axis=1)
+        a2 = jnp.where(skip_ok, a2, NEG)
+        merged = _logsumexp2(_logsumexp2(a0, a1), a2)
+        emit = jnp.take_along_axis(logp[:, t], ext, axis=1)
+        new = merged + emit
+        new = jnp.where(ext_valid, new, NEG)
+        # freeze past logit_len
+        live = (t < logit_len)[:, None]
+        new = jnp.where(live, new, alpha)
+        return new, None
+
+    alpha0 = jnp.full((B, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(jnp.take_along_axis(
+        logp[:, 0], ext[:, :1], axis=1)[:, 0])
+    has1 = label_len > 0
+    alpha0 = alpha0.at[:, 1].set(jnp.where(
+        has1, jnp.take_along_axis(logp[:, 0], ext[:, 1:2], axis=1)[:, 0],
+        NEG))
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    endA = jnp.take_along_axis(alpha, (2 * label_len)[:, None],
+                               axis=1)[:, 0]
+    endB = jnp.take_along_axis(alpha, jnp.maximum(2 * label_len - 1,
+                                                  0)[:, None],
+                               axis=1)[:, 0]
+    loss = -_logsumexp2(endA, jnp.where(label_len > 0, endB, NEG))
+    return {"Loss": [loss.reshape(B, 1)],
+            "WarpCTCGrad": [jnp.zeros_like(logits)]}
+
+
+@register_op("linear_chain_crf",
+             inputs=("Emission", "Transition", "Label", "Length"),
+             outputs=("Alpha", "EmissionExps", "TransitionExps",
+                      "LogLikelihood"),
+             non_diff_inputs=("Label", "Length"))
+def _linear_chain_crf(ctx, ins, attrs):
+    """linear_chain_crf_op.cc: log-likelihood of a tag path. Emission
+    [B, T, D] padded (+Length), Transition [D+2, D] (row 0 start, row 1
+    stop weights, rest pairwise)."""
+    em = ins["Emission"][0]
+    tr = ins["Transition"][0]
+    labels = ins["Label"][0].astype(jnp.int32)
+    if labels.ndim == 3:
+        labels = labels[..., 0]
+    B, T, D = em.shape
+    length = ins["Length"][0].astype(jnp.int32).reshape(-1) \
+        if ins.get("Length") else jnp.full((B,), T, jnp.int32)
+    start = tr[0]
+    stop = tr[1]
+    w = tr[2:]
+
+    # partition via forward algorithm
+    def step(alpha, t):
+        # alpha [B, D] log
+        new = jax.scipy.special.logsumexp(
+            alpha[:, :, None] + w[None], axis=1) + em[:, t]
+        live = (t < length)[:, None]
+        return jnp.where(live, new, alpha), None
+
+    alpha0 = start[None] + em[:, 0]
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    logZ = jax.scipy.special.logsumexp(alpha + stop[None], axis=1)
+
+    # score of the gold path
+    t_idx = jnp.arange(T)
+    emit_score = jnp.take_along_axis(em, labels[..., None],
+                                     axis=2)[..., 0]
+    emit_score = jnp.where(t_idx[None] < length[:, None], emit_score,
+                           0.0).sum(axis=1)
+    prev = labels[:, :-1]
+    nxt = labels[:, 1:]
+    trans_score = w[prev, nxt]
+    trans_score = jnp.where(t_idx[None, 1:] < length[:, None],
+                            trans_score, 0.0).sum(axis=1)
+    last = jnp.take_along_axis(labels, (length - 1)[:, None],
+                               axis=1)[:, 0]
+    gold = emit_score + trans_score + start[labels[:, 0]] + stop[last]
+    ll = gold - logZ
+    return {"Alpha": [alpha], "EmissionExps": [jnp.exp(em)],
+            "TransitionExps": [jnp.exp(tr)],
+            "LogLikelihood": [-ll.reshape(B, 1)]}
+
+
+@register_op("nce", inputs=("Input", "Label", "Weight", "Bias",
+                            "SampleWeight"),
+             outputs=("Cost", "SampleLogits", "SampleLabels"),
+             non_diff_inputs=("Label",), is_random=True)
+def _nce(ctx, ins, attrs):
+    """nce_op.cc: noise-contrastive estimation with uniform negative
+    sampling."""
+    x = ins["Input"][0]          # [B, D]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    w = ins["Weight"][0]         # [V, D]
+    b = ins["Bias"][0].reshape(-1) if ins.get("Bias") else None
+    num_neg = attrs.get("num_neg_samples", 10)
+    V = attrs.get("num_total_classes", w.shape[0])
+    B = x.shape[0]
+    key = ctx.rng()
+    neg = jax.random.randint(key, (B, num_neg), 0, V)
+    samples = jnp.concatenate([label[:, None], neg], axis=1)  # [B, 1+k]
+    sw = w[samples]                                 # [B, 1+k, D]
+    logits = jnp.einsum("bkd,bd->bk", sw, x)
+    if b is not None:
+        logits = logits + b[samples]
+    # P(noise) uniform = 1/V; logit correction log(k * q)
+    corr = jnp.log(num_neg / V)
+    logits = logits - corr
+    lbl = jnp.zeros_like(logits).at[:, 0].set(1.0)
+    p = jax.nn.sigmoid(logits)
+    cost = -(lbl * jnp.log(jnp.clip(p, 1e-12)) +
+             (1 - lbl) * jnp.log(jnp.clip(1 - p, 1e-12))).sum(axis=1)
+    return {"Cost": [cost.reshape(B, 1)], "SampleLogits": [logits],
+            "SampleLabels": [samples]}
+
+
+@register_op("hierarchical_sigmoid",
+             inputs=("X", "W", "Label", "PathTable", "PathCode", "Bias"),
+             outputs=("Out", "PreOut"),
+             non_diff_inputs=("Label", "PathTable", "PathCode"))
+def _hierarchical_sigmoid(ctx, ins, attrs):
+    """hierarchical_sigmoid_op.cc. Default complete-binary-tree coding
+    over num_classes when PathTable is absent; custom trees pass
+    PathTable [B, L] (inner-node ids, -1 pad) + PathCode [B, L] (0/1)."""
+    x = ins["X"][0]  # [B, D]
+    w = ins["W"][0]  # [num_nodes, D]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    B = x.shape[0]
+    if ins.get("PathTable"):
+        table = ins["PathTable"][0].astype(jnp.int32)
+        code = ins["PathCode"][0].astype(x.dtype)
+        valid = table >= 0
+        safe = jnp.maximum(table, 0)
+    else:
+        num_classes = attrs["num_classes"]
+        L = max(1, int(np.ceil(np.log2(max(2, num_classes)))))
+        # complete binary tree: leaf id = label + num_classes... use
+        # the reference's coding: node index path of (label + C) >> k
+        idx = label + num_classes
+        table_list, code_list = [], []
+        for k in range(L - 1, -1, -1):
+            node = idx >> (k + 1)
+            table_list.append(node - 1)   # inner nodes are 1-based
+            code_list.append(((idx >> k) & 1).astype(x.dtype))
+        table = jnp.stack(table_list, axis=1)
+        code = jnp.stack(code_list, axis=1)
+        valid = table >= 0
+        safe = jnp.maximum(table, 0)
+    wrows = w[safe]                       # [B, L, D]
+    pre = jnp.einsum("bld,bd->bl", wrows, x)
+    if ins.get("Bias"):
+        pre = pre + ins["Bias"][0].reshape(-1)[safe]
+    # code==1 means 'right': sigmoid CE against the code bits
+    p = jax.nn.sigmoid(pre)
+    ce = -(code * jnp.log(jnp.clip(p, 1e-12)) +
+           (1 - code) * jnp.log(jnp.clip(1 - p, 1e-12)))
+    ce = jnp.where(valid, ce, 0.0)
+    return {"Out": [ce.sum(axis=1, keepdims=True)], "PreOut": [pre]}
+
+
+@register_op("center_loss", inputs=("X", "Label", "Centers",
+                                    "CenterUpdateRate"),
+             outputs=("Loss", "SampleCenterDiff", "CentersOut"),
+             non_diff_inputs=("Label", "CenterUpdateRate"))
+def _center_loss(ctx, ins, attrs):
+    """center_loss_op.cc: pull features toward per-class centers; the
+    centers update in-place with rate alpha when update=True."""
+    x = ins["X"][0]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    centers = ins["Centers"][0]
+    diff = x - centers[label]
+    loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+    out_centers = centers
+    if attrs.get("need_update", True) and ins.get("CenterUpdateRate"):
+        alpha = ins["CenterUpdateRate"][0].reshape(())
+        counts = jnp.zeros((centers.shape[0],)).at[label].add(1.0)
+        sums = jnp.zeros_like(centers).at[label].add(diff)
+        out_centers = centers + alpha * sums / (counts[:, None] + 1.0)
+    return {"Loss": [loss], "SampleCenterDiff": [diff],
+            "CentersOut": [out_centers]}
+
+
+@register_op("bpr_loss", inputs=("X", "Label"), non_diff_inputs=("Label",))
+def _bpr_loss(ctx, ins, attrs):
+    """bpr_loss_op.cc: bayesian personalized ranking over logits."""
+    x = ins["X"][0]  # [B, C]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    B, C = x.shape
+    pos = jnp.take_along_axis(x, label[:, None], axis=1)
+    diff = jax.nn.sigmoid(pos - x)
+    lp = jnp.log(jnp.clip(diff, 1e-12))
+    mask = jax.nn.one_hot(label, C) == 0
+    loss = -(lp * mask).sum(axis=1, keepdims=True) / (C - 1)
+    return one(loss)
+
+
+@register_op("teacher_student_sigmoid_loss", inputs=("X", "Label"),
+             non_diff_inputs=("Label",))
+def _ts_sigmoid_loss(ctx, ins, attrs):
+    """teacher_student_sigmoid_loss_op.cc: label<=0 pure sigmoid CE on
+    sign; label>0 adds the soft teacher term."""
+    x = ins["X"][0].reshape(-1)
+    y = ins["Label"][0].reshape(-1)
+    # log(1 + exp(x)) stable
+    softplus = jnp.logaddexp(0.0, x)
+    hard = softplus - jnp.where(y > -1.0, 1.0, 0.0) * 0.0  # base
+    ce_hard = softplus - x * (y > 0.0)
+    teacher = jnp.where(y > 0.0, y, 0.0)
+    ce_soft = jnp.where(y > 0.0, softplus - x * teacher, 0.0)
+    loss = jnp.where(y > 0.0, ce_soft, ce_hard)
+    return one(loss.reshape(-1, 1))
+
+
+@register_op("cvm", inputs=("X", "CVM"), non_diff_inputs=("CVM",))
+def _cvm(ctx, ins, attrs):
+    """cvm_op.cc: CTR show/click feature — use_cvm keeps the 2 leading
+    columns log-transformed, else strips them."""
+    x = ins["X"][0]
+    if attrs.get("use_cvm", True):
+        show = jnp.log(x[:, :1] + 1.0)
+        click = jnp.log(x[:, 1:2] + 1.0) - jnp.log(x[:, :1] + 1.0)
+        return one(jnp.concatenate([show, click, x[:, 2:]], axis=1))
+    return one(x[:, 2:])
+
+
+@register_op("fsp", inputs=("X", "Y"))
+def _fsp(ctx, ins, attrs):
+    """fsp_op.cc: flow-of-solution-procedure matrix (distillation):
+    [N, C1, H, W] x [N, C2, H, W] -> [N, C1, C2] / (H*W)."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    H, W = x.shape[2], x.shape[3]
+    return one(jnp.einsum("nchw,ndhw->ncd", x, y) / (H * W))
+
+
+@register_op("batch_fc", inputs=("Input", "W", "Bias"))
+def _batch_fc(ctx, ins, attrs):
+    """batch_fc_op.cc: per-slot fc — Input [S, B, I], W [S, I, O]."""
+    x = ins["Input"][0]
+    w = ins["W"][0]
+    out = jnp.einsum("sbi,sio->sbo", x, w)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    return one(out)
+
+
+@register_op("partial_concat", inputs=("X",), no_grad=False)
+def _partial_concat(ctx, ins, attrs):
+    """partial_concat_op.cc: concat a column slice of each input."""
+    start = attrs.get("start_index", 0)
+    length = attrs.get("length", -1)
+    parts = []
+    for x in ins["X"]:
+        end = x.shape[1] if length < 0 else start + length
+        parts.append(x[:, start:end])
+    return one(jnp.concatenate(parts, axis=1))
+
+
+@register_op("partial_sum", inputs=("X",))
+def _partial_sum(ctx, ins, attrs):
+    start = attrs.get("start_index", 0)
+    length = attrs.get("length", -1)
+    total = None
+    for x in ins["X"]:
+        end = x.shape[1] if length < 0 else start + length
+        sl = x[:, start:end]
+        total = sl if total is None else total + sl
+    return one(total)
+
+
+@register_op("hash", inputs=("X",), no_grad=True)
+def _hash(ctx, ins, attrs):
+    """hash_op.cc: num_hash xxhash buckets of each int row — here a
+    deterministic multiplicative hash (same contract: stable int
+    bucketing, mod_by)."""
+    x = ins["X"][0].astype(jnp.uint32)
+    num_hash = attrs.get("num_hash", 1)
+    mod_by = attrs.get("mod_by", 100000)
+    outs = []
+    for i in range(num_hash):
+        seed = jnp.uint32(0x9E3779B9 + i * 0x85EBCA6B)
+        h = x * seed
+        h = jnp.bitwise_xor(h, h >> 15)
+        h = (h.astype(jnp.uint64).prod(axis=-1) % mod_by)
+        outs.append(h.astype(jnp.int64))
+    return one(jnp.stack(outs, axis=1)[:, :, None])
+
+
+@register_op("dgc", inputs=("U", "V", "Grad", "Param"),
+             outputs=("U_out", "V_out", "EncodeGrad", "Grad_out",
+                      "GatherBuff"), no_grad=True)
+def _dgc(ctx, ins, attrs):
+    """dgc_op.cc: momentum-corrected top-k gradient sparsification."""
+    u = ins["U"][0]
+    v = ins["V"][0]
+    g = ins["Grad"][0]
+    m = attrs.get("m", 0.9)
+    ratio = attrs.get("sparsity_ratio", attrs.get("ratio", 0.001))
+    u_new = m * u + g
+    v_new = v + u_new
+    flat = jnp.abs(v_new).reshape(-1)
+    k = max(1, int(round(flat.size * ratio)))
+    thr = jax.lax.top_k(flat, k)[0][-1]
+    mask = jnp.abs(v_new) >= thr
+    encode = jnp.where(mask, v_new, 0.0)
+    v_out = jnp.where(mask, 0.0, v_new)
+    u_out = jnp.where(mask, 0.0, u_new)
+    return {"U_out": [u_out], "V_out": [v_out], "EncodeGrad": [encode],
+            "Grad_out": [encode], "GatherBuff": [encode]}
+
+
+@register_op("dgc_clip_by_norm", inputs=("X", "current_step"),
+             non_diff_inputs=("current_step",), no_grad=True)
+def _dgc_clip_by_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    rampup = attrs.get("rampup_begin_step", 0.0)
+    step = ins["current_step"][0].reshape(())
+    max_norm = attrs.get("max_norm", 1.0)
+    norm = jnp.sqrt(jnp.sum(x * x))
+    clipped = x * jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return one(jnp.where(step >= rampup, clipped, x))

@@ -586,15 +586,9 @@ def _embedding_bag_sum(ctx, ins, attrs):
 # Pallas flash-attention kernel in paddle_tpu/kernels/flash_attention.py is
 # substituted by layers.multihead_attention when enabled.
 # ---------------------------------------------------------------------------
-@register_op("multihead_matmul", inputs=("Q", "K", "V", "BiasQK"))
-def _multihead_matmul(ctx, ins, attrs):
-    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
-    scale = attrs.get("alpha", 1.0)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-    if ins.get("BiasQK"):
-        scores = scores + ins["BiasQK"][0]
-    probs = jax.nn.softmax(scores, axis=-1)
-    return one(jnp.einsum("bhqk,bhkd->bhqd", probs, v))
+# multihead_matmul (packed-QKV signature of the reference's fused op)
+# registers in ops/fused.py and routes to the Pallas flash-attention
+# kernel.
 
 
 @register_op("stack_lstm_unit", inputs=("X", "C"), outputs=("H", "COut"))

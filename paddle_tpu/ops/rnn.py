@@ -1,0 +1,270 @@
+"""Recurrent ops: LSTM/GRU cells and sequence recurrences.
+
+Analog of /root/reference/paddle/fluid/operators/{lstm,lstm_unit,lstmp,
+gru,gru_unit,cudnn_lstm}_op.* and the fused variants
+operators/fused/{fusion_lstm,fusion_gru}_op.cc, whose compute cores live
+in operators/math/detail/lstm_kernel.h (gate order: candidate, input,
+forget, output) and gru_kernel.h. The reference iterates LoD batches
+with hand-written cell kernels (+x86 JIT, operators/jit/); here the
+recurrence is one lax.scan over the padded time axis with a length mask
+— XLA keeps the per-step matmuls on the MXU and fuses the elementwise
+cell, which is the role the reference's fused/JIT kernels played.
+
+Layout conventions (framework-wide ragged convention): X is padded
+[B, T, I] with optional SeqLen [B]; gate weights pack 4D (lstm) / 3D
+(gru) on the trailing axis in the order noted per op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import one
+
+
+def _mask_from(ins, x):
+    if ins.get("SeqLen"):
+        lens = ins["SeqLen"][0].astype(jnp.int32)
+        t = jnp.arange(x.shape[1])[None, :]
+        return (t < lens[:, None]).astype(x.dtype)
+    return None
+
+
+def _lstm_scan(x_proj, h0, c0, wh, bias, mask, use_peepholes=False,
+               w_peep=None):
+    """x_proj: [B, T, 4D] (x@Wx + b already applied); gates packed
+    [i, f, c~, o] on the trailing axis."""
+    B, T, D4 = x_proj.shape
+    D = D4 // 4
+
+    def cell(carry, t):
+        h, c = carry
+        g = x_proj[:, t] + h @ wh  # [B, 4D]
+        i, f, cc, o = jnp.split(g, 4, axis=-1)
+        if use_peepholes and w_peep is not None:
+            wi, wf, wo = jnp.split(w_peep, 3, axis=-1)
+            i = i + c * wi
+            f = f + c * wf
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        cc = jnp.tanh(cc)
+        c_new = f * c + i * cc
+        if use_peepholes and w_peep is not None:
+            o = o + c_new * jnp.split(w_peep, 3, axis=-1)[2]
+        o = jax.nn.sigmoid(o)
+        h_new = o * jnp.tanh(c_new)
+        if mask is not None:
+            m = mask[:, t][:, None]
+            h_new = m * h_new + (1 - m) * h
+            c_new = m * c_new + (1 - m) * c
+        return (h_new, c_new), (h_new, c_new)
+
+    (h_f, c_f), (hs, cs) = jax.lax.scan(cell, (h0, c0), jnp.arange(T))
+    return (jnp.moveaxis(hs, 0, 1), jnp.moveaxis(cs, 0, 1), h_f, c_f)
+
+
+@register_op("lstm", inputs=("Input", "WeightX", "WeightH", "Bias", "H0",
+                             "C0", "SeqLen"),
+             outputs=("Hidden", "Cell", "LastH", "LastC"),
+             non_diff_inputs=("SeqLen",))
+def _lstm(ctx, ins, attrs):
+    x = ins["Input"][0]
+    wx = ins["WeightX"][0]
+    wh = ins["WeightH"][0]
+    B, T, _ = x.shape
+    D = wh.shape[0]
+    xp = jnp.einsum("bti,ij->btj", x, wx)
+    if ins.get("Bias"):
+        xp = xp + ins["Bias"][0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, D), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, D), x.dtype)
+    mask = _mask_from(ins, x)
+    hs, cs, h_f, c_f = _lstm_scan(xp, h0, c0, wh, None, mask,
+                                  attrs.get("use_peepholes", False))
+    return {"Hidden": [hs], "Cell": [cs], "LastH": [h_f], "LastC": [c_f]}
+
+
+@register_op("fusion_lstm", inputs=("X", "WeightX", "WeightH", "Bias",
+                                    "H0", "C0", "SeqLen"),
+             outputs=("Hidden", "Cell", "LastH", "LastC"),
+             non_diff_inputs=("SeqLen",))
+def _fusion_lstm(ctx, ins, attrs):
+    # fusion_lstm_op.cc fuses x@Wx with the recurrence — identical here
+    ins = dict(ins)
+    ins["Input"] = ins.pop("X")
+    return _lstm(ctx, ins, attrs)
+
+
+@register_op("lstm_unit", inputs=("X", "C_prev"),
+             outputs=("C", "H"))
+def _lstm_unit(ctx, ins, attrs):
+    # lstm_unit_op.cc: X is the pre-projected gate tensor [B, 4D],
+    # gates [i, f, c~, o]; forget_bias added to f
+    x = ins["X"][0]
+    c_prev = ins["C_prev"][0]
+    fb = attrs.get("forget_bias", 0.0)
+    i, f, cc, o = jnp.split(x, 4, axis=-1)
+    c = jax.nn.sigmoid(f + fb) * c_prev + \
+        jax.nn.sigmoid(i) * jnp.tanh(cc)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return {"C": [c], "H": [h]}
+
+
+@register_op("lstmp", inputs=("Input", "WeightX", "WeightH", "ProjWeight",
+                              "Bias", "H0", "C0", "SeqLen"),
+             outputs=("Projection", "Cell", "LastH", "LastC"),
+             non_diff_inputs=("SeqLen",))
+def _lstmp(ctx, ins, attrs):
+    """lstmp_op.cc: LSTM with a projection of h (h_proj = h @ P) fed
+    back into the recurrence."""
+    x = ins["Input"][0]
+    wx = ins["WeightX"][0]
+    wh = ins["WeightH"][0]  # [P, 4D] (recurrence over projected state)
+    proj = ins["ProjWeight"][0]  # [D, P]
+    B, T, _ = x.shape
+    D = proj.shape[0]
+    P = proj.shape[1]
+    xp = jnp.einsum("bti,ij->btj", x, wx)
+    if ins.get("Bias"):
+        xp = xp + ins["Bias"][0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, P), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, D), x.dtype)
+    mask = _mask_from(ins, x)
+
+    def cell(carry, t):
+        hp, c = carry
+        g = xp[:, t] + hp @ wh
+        i, f, cc, o = jnp.split(g, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c_new = f * c + i * jnp.tanh(cc)
+        h_new = o * jnp.tanh(c_new)
+        hp_new = h_new @ proj
+        if mask is not None:
+            m = mask[:, t][:, None]
+            hp_new = m * hp_new + (1 - m) * hp
+            c_new = m * c_new + (1 - m) * c
+        return (hp_new, c_new), (hp_new, c_new)
+
+    (hp_f, c_f), (hps, cs) = jax.lax.scan(cell, (h0, c0), jnp.arange(T))
+    return {"Projection": [jnp.moveaxis(hps, 0, 1)],
+            "Cell": [jnp.moveaxis(cs, 0, 1)],
+            "LastH": [hp_f], "LastC": [c_f]}
+
+
+def _gru_scan(xp, h0, wh, mask, origin_mode=False):
+    """xp: [B, T, 3D], gates packed [u(update), r(reset), c~]."""
+    B, T, D3 = xp.shape
+    D = D3 // 3
+    wh_ur = wh[:, :2 * D]
+    wh_c = wh[:, 2 * D:]
+
+    def cell(h, t):
+        g_ur = xp[:, t, :2 * D] + h @ wh_ur
+        u, r = jnp.split(jax.nn.sigmoid(g_ur), 2, axis=-1)
+        cc = jnp.tanh(xp[:, t, 2 * D:] + (r * h) @ wh_c)
+        if origin_mode:
+            h_new = u * h + (1 - u) * cc
+        else:
+            h_new = (1 - u) * h + u * cc
+        if mask is not None:
+            m = mask[:, t][:, None]
+            h_new = m * h_new + (1 - m) * h
+        return h_new, h_new
+
+    h_f, hs = jax.lax.scan(cell, h0, jnp.arange(T))
+    return jnp.moveaxis(hs, 0, 1), h_f
+
+
+@register_op("gru", inputs=("Input", "WeightX", "WeightH", "Bias", "H0",
+                            "SeqLen"),
+             outputs=("Hidden", "LastH"), non_diff_inputs=("SeqLen",))
+def _gru(ctx, ins, attrs):
+    x = ins["Input"][0]
+    wx = ins["WeightX"][0]
+    wh = ins["WeightH"][0]  # [D, 3D]
+    B, T, _ = x.shape
+    D = wh.shape[0]
+    xp = jnp.einsum("bti,ij->btj", x, wx)
+    if ins.get("Bias"):
+        xp = xp + ins["Bias"][0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, D), x.dtype)
+    hs, h_f = _gru_scan(xp, h0, wh, _mask_from(ins, x),
+                        attrs.get("origin_mode", False))
+    return {"Hidden": [hs], "LastH": [h_f]}
+
+
+@register_op("fusion_gru", inputs=("X", "WeightX", "WeightH", "Bias",
+                                   "H0", "SeqLen"),
+             outputs=("Hidden", "LastH"), non_diff_inputs=("SeqLen",))
+def _fusion_gru(ctx, ins, attrs):
+    ins = dict(ins)
+    ins["Input"] = ins.pop("X")
+    return _gru(ctx, ins, attrs)
+
+
+@register_op("gru_unit", inputs=("Input", "HiddenPrev", "Weight", "Bias"),
+             outputs=("Gate", "ResetHiddenPrev", "Hidden"))
+def _gru_unit(ctx, ins, attrs):
+    # gru_unit_op.cc: Input [B, 3D] pre-projected; Weight [D, 3D]
+    x = ins["Input"][0]
+    h = ins["HiddenPrev"][0]
+    w = ins["Weight"][0]
+    D = h.shape[-1]
+    if ins.get("Bias"):
+        x = x + ins["Bias"][0]
+    g_ur = x[:, :2 * D] + h @ w[:, :2 * D]
+    u, r = jnp.split(jax.nn.sigmoid(g_ur), 2, axis=-1)
+    rh = r * h
+    cc = jnp.tanh(x[:, 2 * D:] + rh @ w[:, 2 * D:])
+    if attrs.get("origin_mode", False):
+        h_new = u * h + (1 - u) * cc
+    else:
+        h_new = (1 - u) * h + u * cc
+    gate = jnp.concatenate([u, r, cc], axis=-1)
+    return {"Gate": [gate], "ResetHiddenPrev": [rh], "Hidden": [h_new]}
+
+
+@register_op("cudnn_lstm", inputs=("Input", "InitH", "InitC", "W",
+                                   "WeightList", "SeqLen"),
+             outputs=("Out", "LastH", "LastC"),
+             non_diff_inputs=("SeqLen",))
+def _cudnn_lstm(ctx, ins, attrs):
+    """cudnn_lstm_op.cc: multi-layer (optionally bidirectional) LSTM.
+    WeightList carries per-layer-direction [Wx, Wh, Bx, Bh] tensors (the
+    flat-buffer W of cuDNN unpacked)."""
+    x = ins["Input"][0]  # [B, T, I]
+    num_layers = attrs.get("num_layers", 1)
+    bidirec = attrs.get("is_bidirec", False)
+    ndir = 2 if bidirec else 1
+    wl = ins.get("WeightList", [])
+    assert len(wl) == 4 * num_layers * ndir, \
+        "WeightList must hold [Wx, Wh, Bx, Bh] per layer-direction"
+    B, T, _ = x.shape
+    D = wl[1].shape[0]
+    init_h = ins["InitH"][0] if ins.get("InitH") else \
+        jnp.zeros((num_layers * ndir, B, D), x.dtype)
+    init_c = ins["InitC"][0] if ins.get("InitC") else \
+        jnp.zeros((num_layers * ndir, B, D), x.dtype)
+    mask = _mask_from(ins, x)
+
+    out = x
+    last_h, last_c = [], []
+    for layer in range(num_layers):
+        dir_outs = []
+        for d in range(ndir):
+            li = layer * ndir + d
+            wx, wh, bx, bh = wl[4 * li:4 * li + 4]
+            inp = out[:, ::-1] if d == 1 else out
+            m = mask[:, ::-1] if (mask is not None and d == 1) else mask
+            xp = jnp.einsum("bti,ij->btj", inp, wx) + bx + bh
+            hs, cs, h_f, c_f = _lstm_scan(xp, init_h[li], init_c[li], wh,
+                                          None, m)
+            dir_outs.append(hs[:, ::-1] if d == 1 else hs)
+            last_h.append(h_f)
+            last_c.append(c_f)
+        out = jnp.concatenate(dir_outs, axis=-1) if ndir == 2 \
+            else dir_outs[0]
+    return {"Out": [out], "LastH": [jnp.stack(last_h)],
+            "LastC": [jnp.stack(last_c)]}

@@ -1,0 +1,313 @@
+"""Vision ops: interpolation family, affine grids, unfold/unpool, misc.
+
+Analog of /root/reference/paddle/fluid/operators/interpolate_op.*
+(bilinear/nearest/linear/bicubic/trilinear_interp[_v2]), affine_grid_op,
+affine_channel_op, unfold_op, unpool_op, max_pool2d_with_index,
+temporal_shift_op, lrn_op, im2sequence_op, crop/crop_tensor_op,
+conv_shift_op, spectral_norm_op. Resizes lower to jax.image.resize
+(XLA-native gather/conv forms); the NCHW layout convention follows the
+reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import one
+
+
+def _out_hw(ins, attrs, ndim_spatial=2):
+    if ins.get("OutSize"):
+        raise NotImplementedError(
+            "interp with a tensor OutSize is data-dependent; pass the "
+            "static out_h/out_w attrs (XLA needs static shapes)")
+    if ndim_spatial == 1:
+        return (attrs.get("out_w", -1),)
+    if ndim_spatial == 3:
+        return (attrs.get("out_d", -1), attrs.get("out_h", -1),
+                attrs.get("out_w", -1))
+    return (attrs.get("out_h", -1), attrs.get("out_w", -1))
+
+
+def _interp(ctx, ins, attrs, method, ndim_spatial=2):
+    x = ins["X"][0]  # NCHW / NCW / NCDHW
+    sizes = _out_hw(ins, attrs, ndim_spatial)
+    scale = attrs.get("scale", 0.0)
+    spatial = x.shape[2:]
+    if any(s <= 0 for s in sizes):
+        assert scale > 0, "need out sizes or scale"
+        sizes = tuple(int(s * scale) for s in spatial)
+    align_corners = attrs.get("align_corners", True)
+    out_shape = x.shape[:2] + tuple(sizes)
+    if align_corners and method != "nearest":
+        # jax.image has no align_corners; build coordinates explicitly
+        def resize_one(img):  # [spatial...]
+            coords = []
+            for i, (so, si) in enumerate(zip(sizes, spatial)):
+                if so == 1:
+                    c = jnp.zeros((so,))
+                else:
+                    c = jnp.linspace(0, si - 1, so)
+                coords.append(c)
+            mesh = jnp.meshgrid(*coords, indexing="ij")
+            return jax.scipy.ndimage.map_coordinates(
+                img, [m.reshape(-1) for m in mesh], order=1,
+                mode="nearest").reshape(sizes)
+        flat = x.reshape((-1,) + spatial)
+        out = jax.vmap(resize_one)(flat)
+        return one(out.reshape(out_shape).astype(x.dtype))
+    jmethod = {"bilinear": "linear", "linear": "linear",
+               "trilinear": "linear", "nearest": "nearest",
+               "bicubic": "cubic"}[method]
+    return one(jax.image.resize(x, out_shape, jmethod).astype(x.dtype))
+
+
+# bilinear_interp / nearest_interp (v1) register in ops/nn.py
+for _name, _m, _nd in [("bilinear_interp_v2", "bilinear", 2),
+                       ("nearest_interp_v2", "nearest", 2),
+                       ("linear_interp", "linear", 1),
+                       ("bicubic_interp", "bicubic", 2),
+                       ("bicubic_interp_v2", "bicubic", 2),
+                       ("trilinear_interp", "trilinear", 3)]:
+    def _mk(name, m, nd):
+        @register_op(name, inputs=("X", "OutSize"),
+                     non_diff_inputs=("OutSize",))
+        def _op(ctx, ins, attrs, _m=m, _nd=nd):
+            return _interp(ctx, ins, attrs, _m, _nd)
+    _mk(_name, _m, _nd)
+
+
+@register_op("affine_grid", inputs=("Theta", "OutputShape"),
+             non_diff_inputs=("OutputShape",))
+def _affine_grid(ctx, ins, attrs):
+    """affine_grid_op.cc: theta [N,2,3] -> sampling grid [N,H,W,2] in
+    [-1,1] coords."""
+    theta = ins["Theta"][0]
+    shape = attrs.get("output_shape")
+    if not shape and ins.get("OutputShape"):
+        shape = [int(v) for v in np.asarray(ins["OutputShape"][0])]
+    N, C, H, W = [int(s) for s in shape]
+    align = attrs.get("align_corners", True)
+    if align:
+        ys = jnp.linspace(-1, 1, H)
+        xs = jnp.linspace(-1, 1, W)
+    else:
+        ys = (jnp.arange(H) * 2 + 1) / H - 1
+        xs = (jnp.arange(W) * 2 + 1) / W - 1
+    yg, xg = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([xg, yg, jnp.ones_like(xg)], axis=-1)  # [H,W,3]
+    grid = jnp.einsum("hwk,njk->nhwj", base, theta)
+    return one(grid)
+
+
+@register_op("affine_channel", inputs=("X", "Scale", "Bias"))
+def _affine_channel(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = ins["Scale"][0]
+    bias = ins["Bias"][0]
+    layout = attrs.get("data_layout", "NCHW")
+    if layout == "NCHW":
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    return one(x * scale.reshape(shape) + bias.reshape(shape))
+
+
+@register_op("unfold", inputs=("X",))
+def _unfold(ctx, ins, attrs):
+    """unfold_op.cc (im2col): [N,C,H,W] -> [N, C*kh*kw, L]."""
+    x = ins["X"][0]
+    kh, kw = attrs["kernel_sizes"]
+    sh, sw = attrs.get("strides", [1, 1])
+    ph, pw = attrs.get("paddings", [0, 0])[:2]
+    dh, dw = attrs.get("dilations", [1, 1])
+    N, C, H, W = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            sl = xp[:, :, i * dh:i * dh + oh * sh:sh,
+                    j * dw:j * dw + ow * sw:sw]
+            cols.append(sl)
+    out = jnp.stack(cols, axis=2)  # [N, C, kh*kw, oh, ow]
+    return one(out.reshape(N, C * kh * kw, oh * ow))
+
+
+@register_op("max_pool2d_with_index", inputs=("X",),
+             outputs=("Out", "Mask"))
+def _max_pool2d_with_index(ctx, ins, attrs):
+    x = ins["X"][0]
+    kh, kw = attrs["ksize"]
+    sh, sw = attrs.get("strides", [kh, kw])
+    ph, pw = attrs.get("paddings", [0, 0])
+    N, C, H, W = x.shape
+    neg = jnp.finfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=neg)
+    oh = (H + 2 * ph - kh) // sh + 1
+    ow = (W + 2 * pw - kw) // sw + 1
+    # flat index map of the padded tensor
+    idx = jnp.arange(xp.shape[2] * xp.shape[3]).reshape(xp.shape[2],
+                                                        xp.shape[3])
+    patches, idxs = [], []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(xp[:, :, i:i + oh * sh:sh, j:j + ow * sw:sw])
+            idxs.append(idx[i:i + oh * sh:sh, j:j + ow * sw:sw])
+    stack = jnp.stack(patches, axis=-1)        # [N,C,oh,ow,k]
+    istack = jnp.stack(idxs, axis=-1)          # [oh,ow,k]
+    arg = jnp.argmax(stack, axis=-1)
+    out = jnp.max(stack, axis=-1)
+    # convert padded flat idx back to unpadded coordinates
+    flat = jnp.take_along_axis(
+        jnp.broadcast_to(istack, stack.shape), arg[..., None],
+        axis=-1)[..., 0]
+    py = flat // xp.shape[3] - ph
+    px = flat % xp.shape[3] - pw
+    mask = py * W + px
+    return {"Out": [out], "Mask": [mask.astype(jnp.int32)]}
+
+
+@register_op("unpool", inputs=("X", "Indices"),
+             non_diff_inputs=("Indices",))
+def _unpool(ctx, ins, attrs):
+    """unpool_op.cc: scatter pooled values back by the max indices."""
+    x = ins["X"][0]
+    idx = ins["Indices"][0]
+    oh, ow = attrs.get("unpooled_size", attrs.get("output_size"))
+    N, C, H, W = x.shape
+    out = jnp.zeros((N, C, oh * ow), x.dtype)
+    flat_idx = idx.reshape(N, C, -1)
+    flat_x = x.reshape(N, C, -1)
+    out = jax.vmap(jax.vmap(
+        lambda o, i, v: o.at[i].set(v)))(out, flat_idx, flat_x)
+    return one(out.reshape(N, C, oh, ow))
+
+
+@register_op("temporal_shift", inputs=("X",))
+def _temporal_shift(ctx, ins, attrs):
+    """temporal_shift_op.cc: shift a channel slice along the segment
+    (time) axis; x is [N*T, C, H, W]."""
+    x = ins["X"][0]
+    T = attrs["seg_num"]
+    ratio = attrs.get("shift_ratio", 0.25)
+    NT, C, H, W = x.shape
+    N = NT // T
+    x5 = x.reshape(N, T, C, H, W)
+    c1 = int(C * ratio)
+    c2 = int(C * 2 * ratio)
+    fwd = jnp.pad(x5[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0),
+                                   (0, 0)))
+    bwd = jnp.pad(x5[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0),
+                                      (0, 0)))
+    out = jnp.concatenate([fwd, bwd, x5[:, :, c2:]], axis=2)
+    return one(out.reshape(NT, C, H, W))
+
+
+@register_op("lrn", inputs=("X",), outputs=("Out", "MidOut"))
+def _lrn(ctx, ins, attrs):
+    """lrn_op.cc: local response norm across channels."""
+    x = ins["X"][0]
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = x * x
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": [x / mid ** beta], "MidOut": [mid]}
+
+
+@register_op("im2sequence", inputs=("X", "Y"),
+             outputs=("Out", "OutLen"), non_diff_inputs=("Y",))
+def _im2sequence(ctx, ins, attrs):
+    """im2sequence_op.cc: image patches as a sequence
+    [N, oh*ow, C*kh*kw] (ragged convention: + per-image length)."""
+    x = ins["X"][0]
+    kh, kw = attrs["kernels"]
+    sh, sw = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0, 0, 0])
+    N, C, H, W = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]),
+                     (pads[1], pads[3])))
+    oh = (H + pads[0] + pads[2] - kh) // sh + 1
+    ow = (W + pads[1] + pads[3] - kw) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            cols.append(xp[:, :, i:i + oh * sh:sh, j:j + ow * sw:sw])
+    out = jnp.stack(cols, axis=2).reshape(N, C * kh * kw, oh * ow)
+    out = jnp.moveaxis(out, 1, 2)  # [N, oh*ow, C*kh*kw]
+    lens = jnp.full((N,), oh * ow, jnp.int64)
+    return {"Out": [out], "OutLen": [lens]}
+
+
+@register_op("crop", inputs=("X", "Y", "Offsets"),
+             non_diff_inputs=("Y", "Offsets"))
+def _crop(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = attrs.get("shape")
+    if not shape and ins.get("Y"):
+        shape = ins["Y"][0].shape
+    offsets = attrs.get("offsets")
+    if offsets is None and ins.get("Offsets"):
+        offsets = [int(v) for v in np.asarray(ins["Offsets"][0])]
+    offsets = offsets or [0] * x.ndim
+    return one(jax.lax.dynamic_slice(x, offsets, shape))
+
+
+@register_op("crop_tensor", inputs=("X", "Shape", "Offsets"),
+             non_diff_inputs=("Shape", "Offsets"))
+def _crop_tensor(ctx, ins, attrs):
+    return _crop(ctx, {"X": ins["X"],
+                       "Y": [],
+                       "Offsets": ins.get("Offsets", [])},
+                 attrs)
+
+
+@register_op("conv_shift", inputs=("X", "Y"))
+def _conv_shift(ctx, ins, attrs):
+    """conv_shift_op.cc: circular correlation of x [B,M] with y [B,N]
+    (N odd, N <= M): out[b,i] = sum_j x[b,(i+j-N//2) mod M] * y[b,j]."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    B, M = x.shape
+    N = y.shape[1]
+    half = N // 2
+    shifted = [jnp.roll(x, half - j, axis=1) * y[:, j:j + 1]
+               for j in range(N)]
+    return one(sum(shifted))
+
+
+@register_op("spectral_norm", inputs=("Weight", "U", "V"),
+             non_diff_inputs=("U", "V"))
+def _spectral_norm(ctx, ins, attrs):
+    """spectral_norm_op.cc: weight / sigma_max via power iteration
+    started from the persistent U/V vectors."""
+    w = ins["Weight"][0]
+    u = ins["U"][0].reshape(-1)
+    v = ins["V"][0].reshape(-1)
+    dim = attrs.get("dim", 0)
+    power_iters = attrs.get("power_iters", 1)
+    eps = attrs.get("eps", 1e-12)
+    wmat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+
+    def it(_, uv):
+        u_, v_ = uv
+        v_ = wmat.T @ u_
+        v_ = v_ / (jnp.linalg.norm(v_) + eps)
+        u_ = wmat @ v_
+        u_ = u_ / (jnp.linalg.norm(u_) + eps)
+        return u_, v_
+
+    u, v = jax.lax.fori_loop(0, power_iters, it, (u, v))
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    sigma = u @ wmat @ v
+    return one(w / sigma)

@@ -1,0 +1,499 @@
+"""Oracle tests for the op-expansion waves: RNN, detection, vision,
+losses (CTC/CRF/NCE/hsigmoid), beam search, fused ops.
+
+Numpy/brute-force oracles per the reference's OpTest contract."""
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu  # registers ops
+from paddle_tpu.core.registry import REGISTRY, LowerCtx
+
+
+def run_op(op, ins, attrs=None, rng=None):
+    ctx = LowerCtx(jax.random.PRNGKey(0) if rng is None else rng)
+    ins = {k: [jnp.asarray(v) for v in vs] for k, vs in ins.items()}
+    return REGISTRY.get(op).lower(ctx, ins, attrs or {})
+
+
+def _r(shape, seed=0, scale=1.0):
+    return (np.random.RandomState(seed).randn(*shape) * scale) \
+        .astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# RNN
+# ---------------------------------------------------------------------------
+
+def _np_lstm(x, wx, wh, b, lens):
+    B, T, _ = x.shape
+    D = wh.shape[0]
+    h = np.zeros((B, D), np.float32)
+    c = np.zeros((B, D), np.float32)
+    hs = np.zeros((B, T, D), np.float32)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for t in range(T):
+        g = x[:, t] @ wx + h @ wh + b
+        i, f, cc, o = np.split(g, 4, axis=-1)
+        c_new = sig(f) * c + sig(i) * np.tanh(cc)
+        h_new = sig(o) * np.tanh(c_new)
+        m = (t < lens)[:, None]
+        h = np.where(m, h_new, h)
+        c = np.where(m, c_new, c)
+        hs[:, t] = h
+    return hs, h, c
+
+
+def test_lstm_matches_numpy():
+    B, T, I, D = 2, 5, 3, 4
+    x = _r((B, T, I), 0)
+    wx = _r((I, 4 * D), 1, 0.5)
+    wh = _r((D, 4 * D), 2, 0.5)
+    b = _r((4 * D,), 3, 0.1)
+    lens = np.array([5, 3], np.int32)
+    outs = run_op("lstm", {"Input": [x], "WeightX": [wx], "WeightH": [wh],
+                           "Bias": [b], "SeqLen": [lens]})
+    hs_np, h_np, c_np = _np_lstm(x, wx, wh, b, lens)
+    np.testing.assert_allclose(outs["Hidden"][0], hs_np, atol=1e-5)
+    np.testing.assert_allclose(outs["LastH"][0], h_np, atol=1e-5)
+    np.testing.assert_allclose(outs["LastC"][0], c_np, atol=1e-5)
+
+
+def test_gru_shapes_and_mask_freeze():
+    B, T, I, D = 2, 4, 3, 5
+    outs = run_op("gru", {"Input": [_r((B, T, I))],
+                          "WeightX": [_r((I, 3 * D), 1, 0.5)],
+                          "WeightH": [_r((D, 3 * D), 2, 0.5)],
+                          "SeqLen": [np.array([4, 2], np.int32)]})
+    hs = np.asarray(outs["Hidden"][0])
+    assert hs.shape == (B, T, D)
+    # past its length, batch 1's hidden state is frozen
+    np.testing.assert_allclose(hs[1, 1], hs[1, 3])
+    assert not np.allclose(hs[0, 1], hs[0, 3])
+
+
+def test_cudnn_lstm_bidirectional():
+    B, T, I, D = 2, 3, 4, 5
+    wl = []
+    for _ in range(2):  # one layer, two directions
+        wl += [_r((I, 4 * D), 1, 0.3), _r((D, 4 * D), 2, 0.3),
+               _r((4 * D,), 3, 0.1), _r((4 * D,), 4, 0.1)]
+    outs = run_op("cudnn_lstm", {"Input": [_r((B, T, I))],
+                                 "WeightList": wl},
+                  {"num_layers": 1, "is_bidirec": True})
+    assert np.asarray(outs["Out"][0]).shape == (B, T, 2 * D)
+    assert np.asarray(outs["LastH"][0]).shape == (2, B, D)
+
+
+def test_lstm_unit_and_gru_unit():
+    B, D = 3, 4
+    outs = run_op("lstm_unit", {"X": [_r((B, 4 * D))],
+                                "C_prev": [_r((B, D), 7)]},
+                  {"forget_bias": 1.0})
+    assert np.asarray(outs["H"][0]).shape == (B, D)
+    outs = run_op("gru_unit", {"Input": [_r((B, 3 * D))],
+                               "HiddenPrev": [_r((B, D), 8)],
+                               "Weight": [_r((D, 3 * D), 9, 0.5)]})
+    assert np.asarray(outs["Hidden"][0]).shape == (B, D)
+
+
+# ---------------------------------------------------------------------------
+# detection
+# ---------------------------------------------------------------------------
+
+def test_iou_similarity():
+    a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    b = np.array([[0, 0, 2, 2], [10, 10, 11, 11]], np.float32)
+    out = np.asarray(run_op("iou_similarity", {"X": [a], "Y": [b]})["Out"][0])
+    np.testing.assert_allclose(out[0], [1.0, 0.0], atol=1e-6)
+    assert abs(out[1, 0] - 1 / 7) < 1e-5  # inter 1, union 7
+
+
+def test_prior_box_count_and_range():
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+    img = np.zeros((1, 3, 64, 64), np.float32)
+    outs = run_op("prior_box", {"Input": [feat], "Image": [img]},
+                  {"min_sizes": [16.0], "max_sizes": [32.0],
+                   "aspect_ratios": [2.0], "flip": True, "clip": True})
+    boxes = np.asarray(outs["Boxes"][0])
+    # 1 min + 1 max + 2 extra ratios = 4 priors per cell
+    assert boxes.shape == (4, 4, 4, 4)
+    assert boxes.min() >= 0 and boxes.max() <= 1
+
+
+def test_box_coder_roundtrip():
+    prior = np.array([[0, 0, 10, 10], [5, 5, 15, 19]], np.float32)
+    gt = np.array([[1, 1, 8, 9]], np.float32)
+    enc = np.asarray(run_op("box_coder",
+                            {"PriorBox": [prior], "TargetBox": [gt]},
+                            {"code_type": "encode_center_size"})["Out"][0])
+    dec = np.asarray(run_op("box_coder",
+                            {"PriorBox": [prior],
+                             "TargetBox": [enc.transpose(0, 1, 2)]},
+                            {"code_type": "decode_center_size"})["Out"][0])
+    # decoding the encoding of gt against each prior recovers gt
+    np.testing.assert_allclose(dec[0, 0], gt[0], atol=1e-4)
+    np.testing.assert_allclose(dec[0, 1], gt[0], atol=1e-4)
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     np.float32)
+    scores = np.array([[0.9, 0.85, 0.7]], np.float32)  # one class
+    outs = run_op("multiclass_nms", {"BBoxes": [boxes],
+                                     "Scores": [scores]},
+                  {"score_threshold": 0.1, "nms_threshold": 0.5,
+                   "keep_top_k": 3})
+    out = np.asarray(outs["Out"][0])
+    n = int(np.asarray(outs["NmsRoisNum"][0])[0])
+    assert n == 2  # the two heavy overlaps collapse to one
+    kept_scores = sorted(out[:n, 1].tolist(), reverse=True)
+    assert abs(kept_scores[0] - 0.9) < 1e-6
+    assert abs(kept_scores[1] - 0.7) < 1e-6
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([[0.9, 0.1, 0.3],
+                     [0.8, 0.7, 0.2]], np.float32)
+    outs = run_op("bipartite_match", {"DistMat": [dist]})
+    idx = np.asarray(outs["ColToRowMatchIndices"][0])[0]
+    # greedy: (r0,c0)=0.9 then (r1,c1)=0.7; c2 unmatched
+    assert idx[0] == 0 and idx[1] == 1 and idx[2] == -1
+
+
+def test_roi_align_full_box_mean():
+    # pooling the whole image into 1x1 with exact bilinear sampling
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 3, 3]], np.float32)  # full box, pixel coords
+    out = np.asarray(run_op("roi_align", {"X": [x], "ROIs": [rois]},
+                            {"pooled_height": 2, "pooled_width": 2,
+                             "spatial_scale": 1.0,
+                             "sampling_ratio": 2})["Out"][0])
+    assert out.shape == (1, 1, 2, 2)
+    # top-left bin mean < bottom-right bin mean, overall == image mean
+    assert out[0, 0, 0, 0] < out[0, 0, 1, 1]
+    assert abs(out.mean() - x.mean()) < 1e-4
+
+
+def test_yolo_box_shapes():
+    N, A, cls, H, W = 1, 2, 3, 2, 2
+    x = _r((N, A * (5 + cls), H, W), 0)
+    img = np.array([[64, 64]], np.int32)
+    outs = run_op("yolo_box", {"X": [x], "ImgSize": [img]},
+                  {"anchors": [10, 13, 16, 30], "class_num": cls,
+                   "conf_thresh": 0.0, "downsample_ratio": 32})
+    assert np.asarray(outs["Boxes"][0]).shape == (N, A * H * W, 4)
+    assert np.asarray(outs["Scores"][0]).shape == (N, A * H * W, cls)
+
+
+def test_sigmoid_focal_loss_positive():
+    x = _r((4, 3), 0)
+    label = np.array([0, 1, 2, 3], np.int64)
+    fg = np.array([3], np.int32)
+    out = np.asarray(run_op("sigmoid_focal_loss",
+                            {"X": [x], "Label": [label], "FgNum": [fg]},
+                            {})["Out"][0])
+    assert out.shape == (4, 3) and (out >= 0).all()
+
+
+# ---------------------------------------------------------------------------
+# vision
+# ---------------------------------------------------------------------------
+
+def test_interp_v2_and_trilinear():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = np.asarray(run_op("bilinear_interp_v2", {"X": [x]},
+                            {"out_h": 8, "out_w": 8,
+                             "align_corners": False})["Out"][0])
+    assert out.shape == (1, 1, 8, 8)
+    assert abs(out.mean() - x.mean()) < 0.5
+    x3 = np.ones((1, 1, 2, 2, 2), np.float32)
+    out3 = np.asarray(run_op("trilinear_interp", {"X": [x3]},
+                             {"out_d": 4, "out_h": 4, "out_w": 4,
+                              "align_corners": False})["Out"][0])
+    assert out3.shape == (1, 1, 4, 4, 4)
+
+
+def test_unfold_matches_manual():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = np.asarray(run_op("unfold", {"X": [x]},
+                            {"kernel_sizes": [2, 2]})["Out"][0])
+    assert out.shape == (1, 4, 9)
+    np.testing.assert_allclose(out[0, :, 0], [0, 1, 4, 5])  # first patch
+
+
+def test_maxpool_with_index_unpool_roundtrip():
+    x = _r((1, 2, 4, 4), 3)
+    outs = run_op("max_pool2d_with_index", {"X": [x]},
+                  {"ksize": [2, 2], "strides": [2, 2]})
+    out, mask = np.asarray(outs["Out"][0]), np.asarray(outs["Mask"][0])
+    assert out.shape == (1, 2, 2, 2)
+    up = np.asarray(run_op("unpool", {"X": [out], "Indices": [mask]},
+                           {"unpooled_size": [4, 4]})["Out"][0])
+    # unpooled peaks equal the pooled maxima, rest zero
+    assert np.isclose(np.sort(up[up != 0]),
+                      np.sort(out.ravel())).all()
+
+
+def test_temporal_shift_moves_channels():
+    x = np.arange(2 * 4 * 1 * 1, dtype=np.float32).reshape(2, 4, 1, 1)
+    out = np.asarray(run_op("temporal_shift", {"X": [x]},
+                            {"seg_num": 2, "shift_ratio": 0.25})["Out"][0])
+    # channel 0 shifts backward in time: frame0 gets frame1's value
+    assert out[0, 0, 0, 0] == x[1, 0, 0, 0]
+    assert out[1, 0, 0, 0] == 0  # padded
+
+
+def test_conv_shift_circular():
+    x = np.array([[1.0, 2.0, 3.0, 4.0]], np.float32)
+    y = np.array([[0.0, 1.0, 0.0]], np.float32)  # identity kernel
+    out = np.asarray(run_op("conv_shift", {"X": [x], "Y": [y]})["Out"][0])
+    np.testing.assert_allclose(out, x)
+
+
+def test_spectral_norm_unit_sigma():
+    w = _r((4, 6), 0)
+    u = _r((4,), 1)
+    v = _r((6,), 2)
+    out = np.asarray(run_op("spectral_norm",
+                            {"Weight": [w], "U": [u], "V": [v]},
+                            {"power_iters": 20})["Out"][0])
+    assert abs(np.linalg.svd(out, compute_uv=False)[0] - 1.0) < 1e-3
+
+
+def test_affine_grid_identity():
+    theta = np.tile(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32),
+                    (1, 1, 1))
+    out = np.asarray(run_op("affine_grid", {"Theta": [theta]},
+                            {"output_shape": [1, 1, 2, 2],
+                             "align_corners": True})["Out"][0])
+    np.testing.assert_allclose(out[0, 0, 0], [-1, -1])
+    np.testing.assert_allclose(out[0, 1, 1], [1, 1])
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def _np_ctc_loss(logp, labels, blank=0):
+    """Brute force: sum over all alignments (tiny T only)."""
+    T, C = logp.shape
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        # collapse path
+        col = []
+        prev = None
+        for s in path:
+            if s != prev and s != blank:
+                col.append(s)
+            prev = s
+        if col == list(labels):
+            total += np.exp(sum(logp[t, path[t]] for t in range(T)))
+    return -np.log(total)
+
+
+def test_warpctc_matches_bruteforce():
+    T, C = 4, 3
+    rng = np.random.RandomState(0)
+    logits = rng.randn(1, T, C).astype(np.float32)
+    labels = np.array([[1, 2]], np.int32)
+    out = np.asarray(run_op("warpctc",
+                            {"Logits": [logits], "Label": [labels]},
+                            {"blank": 0})["Loss"][0])
+    logp = np.log(np.exp(logits[0]) /
+                  np.exp(logits[0]).sum(-1, keepdims=True))
+    expect = _np_ctc_loss(logp, [1, 2])
+    np.testing.assert_allclose(out[0, 0], expect, rtol=1e-4)
+
+
+def test_warpctc_is_differentiable():
+    logits = jnp.asarray(_r((1, 4, 3), 5))
+    labels = jnp.asarray([[1, 2]], jnp.int32)
+
+    def loss(lg):
+        return run_op("warpctc", {"Logits": [lg], "Label": [labels]},
+                      {"blank": 0})["Loss"][0].sum()
+    g = jax.grad(loss)(logits)
+    assert np.isfinite(np.asarray(g)).all() and np.abs(g).sum() > 0
+
+
+def _np_crf_nll(em, tr, labels):
+    """Brute force partition over all tag paths."""
+    T, D = em.shape
+    start, stop, w = tr[0], tr[1], tr[2:]
+    scores = []
+    for path in itertools.product(range(D), repeat=T):
+        s = start[path[0]] + em[0, path[0]]
+        for t in range(1, T):
+            s += w[path[t - 1], path[t]] + em[t, path[t]]
+        s += stop[path[-1]]
+        scores.append(s)
+    logZ = np.log(np.exp(scores).sum())
+    gold = start[labels[0]] + em[0, labels[0]]
+    for t in range(1, T):
+        gold += w[labels[t - 1], labels[t]] + em[t, labels[t]]
+    gold += stop[labels[-1]]
+    return -(gold - logZ)
+
+
+def test_linear_chain_crf_matches_bruteforce():
+    T, D = 3, 3
+    em = _r((1, T, D), 0)
+    tr = _r((D + 2, D), 1, 0.5)
+    labels = np.array([[0, 2, 1]], np.int32)
+    out = np.asarray(run_op("linear_chain_crf",
+                            {"Emission": [em], "Transition": [tr],
+                             "Label": [labels]},
+                            {})["LogLikelihood"][0])
+    expect = _np_crf_nll(em[0], tr, labels[0])
+    np.testing.assert_allclose(out[0, 0], expect, rtol=1e-4)
+
+
+def test_nce_shapes_and_positive_cost():
+    outs = run_op("nce", {"Input": [_r((4, 8), 0)],
+                          "Label": [np.array([1, 2, 3, 4], np.int64)],
+                          "Weight": [_r((10, 8), 1, 0.3)]},
+                  {"num_neg_samples": 5, "num_total_classes": 10})
+    cost = np.asarray(outs["Cost"][0])
+    assert cost.shape == (4, 1) and (cost > 0).all()
+    assert np.asarray(outs["SampleLogits"][0]).shape == (4, 6)
+
+
+def test_hierarchical_sigmoid_positive_loss():
+    out = np.asarray(run_op(
+        "hierarchical_sigmoid",
+        {"X": [_r((4, 8), 0)], "W": [_r((15, 8), 1, 0.3)],
+         "Label": [np.array([0, 3, 7, 15], np.int64)]},
+        {"num_classes": 16})["Out"][0])
+    assert out.shape == (4, 1) and (out > 0).all()
+
+
+def test_center_loss_updates_centers():
+    x = _r((4, 3), 0)
+    labels = np.array([0, 0, 1, 1], np.int64)
+    centers = np.zeros((2, 3), np.float32)
+    outs = run_op("center_loss",
+                  {"X": [x], "Label": [labels], "Centers": [centers],
+                   "CenterUpdateRate": [np.array([0.5], np.float32)]},
+                  {"need_update": True})
+    assert (np.asarray(outs["Loss"][0]) >= 0).all()
+    assert not np.allclose(np.asarray(outs["CentersOut"][0]), 0)
+
+
+def test_cvm():
+    x = np.array([[3.0, 1.0, 5.0, 6.0]], np.float32)
+    out = np.asarray(run_op("cvm", {"X": [x]}, {"use_cvm": True})["Out"][0])
+    np.testing.assert_allclose(out[0, 0], np.log(4.0), rtol=1e-6)
+    out2 = np.asarray(run_op("cvm", {"X": [x]},
+                             {"use_cvm": False})["Out"][0])
+    np.testing.assert_allclose(out2, [[5.0, 6.0]])
+
+
+def test_dgc_topk_and_residual():
+    g = np.array([4.0, -3.0, 0.1, 0.2], np.float32)
+    outs = run_op("dgc", {"U": [np.zeros(4, np.float32)],
+                          "V": [np.zeros(4, np.float32)],
+                          "Grad": [g], "Param": [np.zeros(4, np.float32)]},
+                  {"m": 0.9, "ratio": 0.25})
+    enc = np.asarray(outs["EncodeGrad"][0])
+    assert np.count_nonzero(enc) == 1 and enc[0] == 4.0
+    v = np.asarray(outs["V_out"][0])
+    assert v[1] == -3.0  # residual kept
+
+
+# ---------------------------------------------------------------------------
+# beam search
+# ---------------------------------------------------------------------------
+
+def test_beam_search_step_and_gather_tree():
+    beam, V = 2, 4
+    pre_ids = np.array([[1], [2]], np.int64)   # batch=1, beam=2
+    pre_scores = np.array([[0.0], [-0.5]], np.float32)
+    scores = np.log(np.array([[0.1, 0.6, 0.2, 0.1],
+                              [0.7, 0.1, 0.1, 0.1]], np.float32))
+    outs = run_op("beam_search",
+                  {"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                   "ids": [pre_ids], "scores": [scores]},
+                  {"beam_size": beam, "end_id": 0})
+    sel = np.asarray(outs["selected_ids"][0]).ravel()
+    par = np.asarray(outs["parent_idx"][0]).ravel()
+    # best continuation: beam0+token1 (0-0.51); then beam1+token0
+    assert sel[0] == 1 and par[0] == 0
+
+    # gather_tree on a hand-built 2-step history
+    ids = np.array([[[1, 2]], [[3, 4]]], np.int64).transpose(0, 1, 2)
+    ids = np.array([[[1, 2]], [[3, 4]]], np.int64)  # [T=2, B=1, K=2]
+    parents = np.array([[[0, 0]], [[1, 0]]], np.int64)
+    out = np.asarray(run_op("gather_tree",
+                            {"Ids": [ids], "Parents": [parents]})["Out"][0])
+    # final beam 0 came from step0-beam1: path [2, 3]
+    assert out[:, 0, 0].tolist() == [2, 3]
+    assert out[:, 0, 1].tolist() == [1, 4]
+
+
+# ---------------------------------------------------------------------------
+# fused
+# ---------------------------------------------------------------------------
+
+def test_multihead_matmul_packed_matches_manual():
+    B, S, H, heads = 2, 8, 16, 2
+    x = _r((B, S, H), 0)
+    w = _r((H, 3 * H), 1, 0.2)
+    b = _r((3 * H,), 2, 0.1)
+    outs = run_op("multihead_matmul",
+                  {"Input": [x], "W": [w], "Bias": [b]},
+                  {"head_number": heads})
+    out = np.asarray(outs["Out"][0])
+    # manual
+    qkv = (x @ w + b).reshape(B, S, 3, heads, H // heads)
+    q = np.moveaxis(qkv[:, :, 0], 1, 2)
+    k = np.moveaxis(qkv[:, :, 1], 1, 2)
+    v = np.moveaxis(qkv[:, :, 2], 1, 2)
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(H // heads)
+    p = np.exp(s) / np.exp(s).sum(-1, keepdims=True)
+    ref = np.moveaxis(np.einsum("bhqk,bhkd->bhqd", p, v), 1, 2) \
+        .reshape(B, S, H)
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_fused_fc_elementwise_layernorm_matches_composition():
+    B, I, O = 4, 6, 8
+    x, w = _r((B, I), 0), _r((I, O), 1, 0.4)
+    b0, y = _r((O,), 2, 0.1), _r((B, O), 3)
+    scale, b1 = _r((O,), 4, 0.2) + 1.0, _r((O,), 5, 0.1)
+    outs = run_op("fused_fc_elementwise_layernorm",
+                  {"X": [x], "W": [w], "Bias0": [b0], "Y": [y],
+                   "Scale": [scale], "Bias1": [b1]}, {})
+    h = x @ w + b0 + y
+    mu = h.mean(-1, keepdims=True)
+    var = h.var(-1)
+    ref = (h - mu) / np.sqrt(var[:, None] + 1e-5) * scale + b1
+    np.testing.assert_allclose(np.asarray(outs["Out"][0]), ref, atol=1e-4)
+
+
+def test_fused_elemwise_activation():
+    x, y = _r((3, 4), 0), _r((3, 4), 1)
+    outs = run_op("fused_elemwise_activation", {"X": [x], "Y": [y]},
+                  {"functor_list": ["elementwise_add", "relu"]})
+    np.testing.assert_allclose(np.asarray(outs["Out"][0]),
+                               np.maximum(x + y, 0), atol=1e-6)
+
+
+def test_fusion_squared_mat_sub_fm_term():
+    x, y = _r((2, 3), 0), _r((3, 4), 1)
+    outs = run_op("fusion_squared_mat_sub", {"X": [x], "Y": [y]},
+                  {"scalar": 0.5})
+    ref = ((x @ y) ** 2 - (x ** 2) @ (y ** 2)) * 0.5
+    np.testing.assert_allclose(np.asarray(outs["Out"][0]), ref, atol=1e-5)
+
+
+def test_fusion_seqpool_concat_masks():
+    x1 = _r((2, 3, 4), 0)
+    lens = np.array([3, 1], np.int64)
+    out = np.asarray(run_op("fusion_seqpool_concat",
+                            {"X": [x1], "SeqLen": [lens]},
+                            {"pooltype": "SUM"})["Out"][0])
+    np.testing.assert_allclose(out[1], x1[1, 0], atol=1e-6)
