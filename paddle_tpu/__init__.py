@@ -73,3 +73,4 @@ from .core.enforce import enforce, EnforceNotMet  # noqa: F401
 from . import compiler  # noqa: F401
 from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
                        ExecutionStrategy)
+from . import amp  # noqa: F401

@@ -1,0 +1,148 @@
+"""Dygraph AMP: auto_cast context + GradScaler.
+
+Analog of /root/reference/python/paddle/fluid/dygraph/amp/
+(auto_cast.py amp_guard — flips the Tracer's AMP mode so white-list ops
+autocast, imperative/amp_auto_cast.cc — and loss_scaler.py GradScaler
+with dynamic scaling). TPU default low dtype is bfloat16, whose fp32
+exponent range makes loss scaling a no-op by default; float16 keeps the
+full dynamic-scale machinery.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .dygraph import tape
+from .dygraph.tape import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler"]
+
+
+class auto_cast:
+    """paddle.amp.auto_cast / fluid.dygraph.amp_guard."""
+
+    def __init__(self, enable: bool = True, dtype: str = "bfloat16",
+                 custom_white_list=None, custom_black_list=None):
+        self._enable = enable
+        self._dtype = dtype
+        self._white = set(custom_white_list or ())
+        self._black = set(custom_black_list or ())
+        self._saved = None
+        self._saved_lists = None
+
+    def __enter__(self):
+        self._saved = tape._state.amp_dtype
+        tape._state.amp_dtype = self._dtype if self._enable else None
+        if self._white or self._black:
+            self._saved_lists = set(tape._AMP_WHITE)
+            tape._AMP_WHITE |= self._white
+            tape._AMP_WHITE -= self._black
+        return self
+
+    def __exit__(self, *exc):
+        tape._state.amp_dtype = self._saved
+        if self._saved_lists is not None:
+            tape._AMP_WHITE.clear()
+            tape._AMP_WHITE.update(self._saved_lists)
+        return False
+
+
+amp_guard = auto_cast
+
+
+class GradScaler:
+    """fluid/dygraph/amp/loss_scaler.py GradScaler (AmpScaler):
+    scale() multiplies the loss; minimize()/step() unscale grads, skip
+    the step on inf/nan, and update the scale."""
+
+    def __init__(self, enable: bool = True,
+                 init_loss_scaling: float = 2.0 ** 15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000,
+                 decr_every_n_nan_or_inf: int = 2,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf_last = False
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def is_enable(self):
+        return self._enable
+
+    def get_scale(self) -> float:
+        return self._scale
+
+    def _unscale_and_check(self, optimizer) -> bool:
+        """Divide grads by scale; True if all finite."""
+        import jax.numpy as jnp
+        found_inf = False
+        for p in optimizer._parameter_list or []:
+            if p.grad is None:
+                continue
+            g = p.grad
+            if hasattr(g, "values"):  # SelectedRows
+                vals = g.values / self._scale
+                if not bool(jnp.isfinite(vals).all()):
+                    found_inf = True
+                g.values = vals
+            else:
+                g = g / self._scale
+                if not bool(jnp.isfinite(g).all()):
+                    found_inf = True
+                p.grad = g
+        return not found_inf
+
+    def _update(self, finite: bool):
+        if not self._dynamic:
+            return
+        if finite:
+            self._good += 1
+            self._bad = 0
+            if self._good >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good = 0
+        else:
+            self._bad += 1
+            self._good = 0
+            if self._bad >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad = 0
+
+    def minimize(self, optimizer, scaled_loss):
+        """AmpScaler.minimize: assumes scaled_loss.backward() already
+        ran. Unscales, steps unless inf/nan, updates the scale."""
+        if not self._enable:
+            optimizer.step()
+            return
+        finite = self._unscale_and_check(optimizer)
+        self._found_inf_last = not finite
+        if finite:
+            optimizer.step()
+        self._update(finite)
+
+    def step(self, optimizer):
+        self.minimize(optimizer, None)
+
+    def update(self):
+        pass  # folded into minimize/step
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_count": self._good,
+                "decr_count": self._bad}
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good = state.get("incr_count", 0)
+        self._bad = state.get("decr_count", 0)

@@ -1,0 +1,102 @@
+"""Dygraph DataParallel + process spawn.
+
+Analog of /root/reference/python/paddle/fluid/dygraph/parallel.py
+(DataParallel:236 — scale_loss:337 divides by nranks,
+apply_collective_grads:449 coalesces + allreduces gradients over NCCL)
+and python/paddle/distributed/spawn.py:231.
+
+On a single-controller TPU mesh the replicated-dygraph formulation is
+degenerate (every "rank" computes the same grads), so allreduce is a
+mathematical no-op there; the class exists for API parity and for
+shard_map-per-device flows where grads really do differ. spawn() forks
+per-rank host processes with the reference's env contract — the
+multi-host (one controller per host) deployment path.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from . import collective
+from .env import DP_AXIS, get_env, get_mesh
+
+
+class DataParallel:
+    """Wraps a dygraph Layer for data-parallel training."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size_MB: int = 25,
+                 last_comm_buffer_size_MB: int = 1):
+        self._layers = layers
+        self._nranks = max(1, get_env().nranks)
+
+    def __getattr__(self, name):
+        return getattr(self._layers, name)
+
+    def __call__(self, *args, **kw):
+        return self._layers(*args, **kw)
+
+    def forward(self, *args, **kw):
+        return self._layers(*args, **kw)
+
+    def scale_loss(self, loss):
+        """parallel.py:337 — divide by trainer count so the summed
+        allreduce averages."""
+        if self._nranks <= 1:
+            return loss
+        return loss * (1.0 / self._nranks)
+
+    def apply_collective_grads(self):
+        """parallel.py:449 — allreduce every parameter gradient over the
+        dp axis (coalescing is XLA's job)."""
+        if self._nranks <= 1 or get_mesh() is None:
+            return
+        for p in self._layers.parameters():
+            if p.grad is None:
+                continue
+            g = p.grad
+            if hasattr(g, "values"):  # SelectedRows: reduce values
+                g.values = collective.all_reduce(g.values, "sum",
+                                                 axis=DP_AXIS)
+            else:
+                p.grad = collective.all_reduce(g, "sum", axis=DP_AXIS)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+
+def _spawn_target(fn, rank, nprocs, env, args):
+    os.environ.update(env)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    fn(*args) if args else fn(rank)
+
+
+def spawn(func, args=(), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, **options) -> List[mp.Process]:
+    """paddle.distributed.spawn (spawn.py:231): one process per rank
+    with the cluster env contract; join waits and raises on failure."""
+    ctx = mp.get_context("spawn")
+    eps = ",".join("127.0.0.1:%d" % (61000 + i) for i in range(nprocs))
+    procs = []
+    for rank in range(nprocs):
+        env = {"PADDLE_TRAINER_ENDPOINTS": eps,
+               "TRAINING_ROLE": "TRAINER"}
+        p = ctx.Process(target=_spawn_target,
+                        args=(func, rank, nprocs, env, args),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError("spawned rank failed with exit code %s"
+                                   % p.exitcode)
+    return procs

@@ -1,0 +1,93 @@
+"""Dygraph AMP (auto_cast/GradScaler) + DataParallel + spawn tests.
+
+Mirrors the reference's test_imperative_auto_mixed_precision.py and
+parallel_dygraph tests."""
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.amp import GradScaler, auto_cast
+from paddle_tpu.dygraph import to_tensor
+
+
+def test_auto_cast_computes_bf16_matmul():
+    import jax.numpy as jnp
+    from paddle_tpu.dygraph import run_op
+    x = to_tensor(np.ones((2, 4), np.float32))
+    w = to_tensor(np.ones((4, 4), np.float32))
+    with auto_cast(True, dtype="bfloat16"):
+        y = run_op("matmul", {"X": [x], "Y": [w]}, {})["Out"][0]
+    assert y.value.dtype == jnp.bfloat16
+    y2 = run_op("matmul", {"X": [x], "Y": [w]}, {})["Out"][0]
+    assert y2.value.dtype == jnp.float32
+
+
+def test_grad_scaler_scales_and_steps():
+    lin = nn.Linear(4, 1)
+    opt = pt.optimizer.SGD(0.1, parameters=lin.parameters())
+    scaler = GradScaler(init_loss_scaling=4.0,
+                        use_dynamic_loss_scaling=False)
+    x = to_tensor(np.ones((2, 4), np.float32))
+    w0 = np.asarray(lin.weight.value).copy()
+    loss = lin(x).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    # grad is scaled by 4 before unscale
+    g_scaled = np.asarray(lin.weight.grad).copy()
+    scaler.minimize(opt, scaled)
+    w1 = np.asarray(lin.weight.value)
+    # effective update used the UNscaled grad
+    np.testing.assert_allclose(w0 - 0.1 * (g_scaled / 4.0), w1,
+                               atol=1e-6)
+    opt.clear_grad()
+
+
+def test_grad_scaler_skips_on_inf_and_decays():
+    lin = nn.Linear(2, 1)
+    opt = pt.optimizer.SGD(0.1, parameters=lin.parameters())
+    scaler = GradScaler(init_loss_scaling=8.0, decr_every_n_nan_or_inf=1)
+    w0 = np.asarray(lin.weight.value).copy()
+    x = to_tensor(np.array([[np.inf, 1.0]], np.float32))
+    loss = lin(x).sum()
+    scaler.scale(loss).backward()
+    scaler.minimize(opt, None)
+    np.testing.assert_allclose(np.asarray(lin.weight.value), w0)
+    assert scaler.get_scale() == 4.0  # decayed by 0.5
+    opt.clear_grad()
+
+
+def test_data_parallel_wrapper_scale_and_allreduce():
+    import paddle_tpu.parallel as dist
+    env = dist.init_parallel_env({"dp": 4})
+    try:
+        lin = nn.Linear(3, 1)
+        dp = dist.DataParallel(lin)
+        x = to_tensor(np.ones((2, 3), np.float32))
+        loss = dp(x).sum()
+        scaled = dp.scale_loss(loss)
+        assert abs(float(np.asarray(scaled.value)) -
+                   float(np.asarray(loss.value)) / 4) < 1e-6
+        scaled.backward()
+        g0 = np.asarray(lin.weight.grad).copy()
+        dp.apply_collective_grads()
+        # replicated grads: allreduce-sum multiplies by nranks, undoing
+        # the 1/nranks loss scale
+        np.testing.assert_allclose(np.asarray(lin.weight.grad), g0 * 4,
+                                   rtol=1e-6)
+    finally:
+        dist.init_parallel_env(None)
+
+
+def _spawn_probe(rank):
+    import os
+    assert os.environ["PADDLE_TRAINER_ID"] == str(rank)
+    assert int(os.environ["PADDLE_TRAINERS_NUM"]) == 2
+
+
+def test_spawn_runs_ranks():
+    from paddle_tpu.parallel import spawn
+    spawn(_spawn_probe, nprocs=2, join=True)
