@@ -194,6 +194,15 @@ class OptimizerWithMixedPrecision:
                        "decr_every_n_nan_or_inf": self._decr_every,
                        "incr_ratio": self._incr_ratio,
                        "decr_ratio": self._decr_ratio})
+        else:
+            # dynamic scaling off (bf16 default): update_loss_scaling —
+            # whose kernel zeroes grads on overflow — never runs, so zero
+            # them here; otherwise a single inf/nan grad would poison the
+            # parameters through the unconditional optimizer ops
+            block.append_op(
+                "zero_on_found_infinite",
+                inputs={"X": grad_names, "FoundInfinite": [found]},
+                outputs={"Out": grad_names})
         self._inner.apply_gradients(params_grads, program, startup)
         return None, params_grads
 

@@ -28,11 +28,24 @@ from ..core.registry import REGISTRY, LowerCtx
 
 class _EagerState:
     def __init__(self):
-        self.key = jax.random.PRNGKey(0)
+        # lazy: creating a PRNGKey initializes the XLA backend, which must
+        # not happen at import time — multi-host bootstrap
+        # (parallel.env.init_distributed_runtime) has to run first
+        self._key = None
         self.grad_enabled = True
         self.is_test = False
         self.amp_dtype: Optional[str] = None  # "bfloat16" during auto_cast
         self.name_counter = 0
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(0)
+        return self._key
+
+    @key.setter
+    def key(self, k):
+        self._key = k
 
     def next_key(self):
         self.key, sub = jax.random.split(self.key)
@@ -219,6 +232,148 @@ class Tensor:
 
     def transpose(self, perm):
         return run_op("transpose", {"X": [self]}, {"axis": list(perm)})["Out"][0]
+
+    # --- reductions (VarBase method parity; reference pybind generates
+    # these from the op registry via op_function_generator.cc) ------------
+    def _reduce(self, op, axis, keepdim):
+        attrs = {"keep_dim": bool(keepdim)}
+        if axis is None:
+            attrs["reduce_all"] = True
+            attrs["dim"] = [0]
+        else:
+            attrs["dim"] = [axis] if isinstance(axis, int) else list(axis)
+        return run_op(op, {"X": [self]}, attrs)["Out"][0]
+
+    def sum(self, axis=None, keepdim=False):
+        return self._reduce("reduce_sum", axis, keepdim)
+
+    def mean(self, axis=None, keepdim=False):
+        return self._reduce("reduce_mean", axis, keepdim)
+
+    def max(self, axis=None, keepdim=False):
+        return self._reduce("reduce_max", axis, keepdim)
+
+    def min(self, axis=None, keepdim=False):
+        return self._reduce("reduce_min", axis, keepdim)
+
+    def prod(self, axis=None, keepdim=False):
+        return self._reduce("reduce_prod", axis, keepdim)
+
+    def any(self, axis=None, keepdim=False):
+        return self._reduce("reduce_any", axis, keepdim)
+
+    def all(self, axis=None, keepdim=False):
+        return self._reduce("reduce_all", axis, keepdim)
+
+    def argmax(self, axis=None, keepdim=False):
+        return run_op("arg_max", {"X": [self]},
+                      {"axis": -1 if axis is None else axis,
+                       "flatten": axis is None,
+                       "keepdims": bool(keepdim)})["Out"][0]
+
+    def argmin(self, axis=None, keepdim=False):
+        return run_op("arg_min", {"X": [self]},
+                      {"axis": -1 if axis is None else axis,
+                       "flatten": axis is None,
+                       "keepdims": bool(keepdim)})["Out"][0]
+
+    def numel(self):
+        return self.size
+
+    # --- elementwise math methods ---------------------------------------
+    def _unary(self, op):
+        return run_op(op, {"X": [self]}, {})["Out"][0]
+
+    def abs(self):
+        return self._unary("abs")
+
+    def exp(self):
+        return self._unary("exp")
+
+    def log(self):
+        return self._unary("log")
+
+    def sqrt(self):
+        return self._unary("sqrt")
+
+    def rsqrt(self):
+        return self._unary("rsqrt")
+
+    def square(self):
+        return self._unary("square")
+
+    def tanh(self):
+        return self._unary("tanh")
+
+    def sigmoid(self):
+        return self._unary("sigmoid")
+
+    def floor(self):
+        return self._unary("floor")
+
+    def ceil(self):
+        return self._unary("ceil")
+
+    def pow(self, factor):
+        return self.__pow__(factor)
+
+    def clip(self, min=None, max=None):
+        lo = -3.4e38 if min is None else float(min)
+        hi = 3.4e38 if max is None else float(max)
+        return run_op("clip", {"X": [self]}, {"min": lo, "max": hi})["Out"][0]
+
+    def scale(self, scale=1.0, bias=0.0):
+        return run_op("scale", {"X": [self]},
+                      {"scale": float(scale), "bias": float(bias)})["Out"][0]
+
+    def matmul(self, y, transpose_x=False, transpose_y=False):
+        return run_op("matmul", {"X": [self], "Y": [_as_tensor_like(y, self)]},
+                      {"transpose_X": transpose_x,
+                       "transpose_Y": transpose_y})["Out"][0]
+
+    def unsqueeze(self, axis):
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        return run_op("unsqueeze2", {"X": [self]}, {"axes": axes})["Out"][0]
+
+    def squeeze(self, axis=None):
+        axes = [] if axis is None else (
+            [axis] if isinstance(axis, int) else list(axis))
+        return run_op("squeeze2", {"X": [self]}, {"axes": axes})["Out"][0]
+
+    def flatten(self, start_axis=0, stop_axis=-1):
+        shape = list(self.shape)
+        n = len(shape)
+        s = start_axis % n if n else 0
+        e = stop_axis % n if n else 0
+        new = shape[:s] + [int(np.prod(shape[s:e + 1]) or 1)] + shape[e + 1:]
+        return self.reshape(new)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    # --- comparisons (elementwise, v2 Tensor semantics); identity hash is
+    # kept so tapes/sets keyed by object identity still work ---------------
+    def equal(self, o):
+        return self._binary(o, "equal")
+
+    def not_equal(self, o):
+        return self._binary(o, "not_equal")
+
+    def __eq__(self, o):
+        try:
+            return self.equal(o)
+        except (TypeError, ValueError):
+            # non-array operand (None, sentinel objects): fall back to
+            # identity semantics so `t == None` / `t in [..]` keep working
+            return NotImplemented
+
+    def __ne__(self, o):
+        try:
+            return self.not_equal(o)
+        except (TypeError, ValueError):
+            return NotImplemented
+
+    __hash__ = object.__hash__
 
     def __repr__(self):
         return (f"Tensor(shape={self.shape}, dtype={self.dtype}, "

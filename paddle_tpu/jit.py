@@ -243,15 +243,21 @@ class TrainStep:
         if self._step_fn is None:
             self._step_fn = self._build()
             self._state = state_of(self.model)
-            if self.mesh is not None and self.param_rules is not None:
-                # annotate parameter shardings (tp/dp layout); GSPMD
-                # propagates activation shardings + inserts collectives
-                from jax.sharding import NamedSharding
-                self._state = {
-                    n: jax.device_put(v, NamedSharding(
-                        self.mesh, self.param_rules(n, tuple(v.shape))))
-                    for n, v in self._state.items()}
             self._lr_step = jnp.zeros((), jnp.int32)
+            if self.mesh is not None:
+                # annotate parameter shardings (tp/dp layout); GSPMD
+                # propagates activation shardings + inserts collectives.
+                # Without rules params replicate — and in multi-process
+                # SPMD every jit input must be a GLOBAL array over the
+                # mesh, scalars included
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                rules = self.param_rules or (lambda n, s: P())
+                self._state = {
+                    n: jax.device_put(np.asarray(v), NamedSharding(
+                        self.mesh, rules(n, tuple(v.shape))))
+                    for n, v in self._state.items()}
+                self._lr_step = jax.device_put(
+                    self._lr_step, NamedSharding(self.mesh, P()))
         inputs = tuple(_unwrap(x) for x in (
             inputs if isinstance(inputs, (tuple, list)) else (inputs,)))
         labels = tuple(_unwrap(x) for x in (
@@ -261,6 +267,10 @@ class TrainStep:
             inputs = shard_batch(inputs)
             labels = shard_batch(labels)
         self._rng, sub = jax.random.split(self._rng)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sub = jax.device_put(np.asarray(sub),
+                                 NamedSharding(self.mesh, P()))
         loss, self._state, self._opt_state, self._lr_step = self._step_fn(
             self._state, self._opt_state, self._lr_step, sub,
             (inputs, labels))

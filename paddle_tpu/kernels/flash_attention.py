@@ -60,6 +60,13 @@ def attention_reference(q, k, v, bias=None, causal=False, sm_scale=None):
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
+    if causal and scores.shape[-2] > scores.shape[-1]:
+        # bottom-right-aligned causal with sq > sk: leading q-rows see no
+        # keys at all — define their output as 0 (matching the flash
+        # kernel's empty-row semantics) instead of softmax's uniform probs
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        visible = (jnp.arange(sq) + (sk - sq)) >= 0
+        probs = probs * visible[:, None].astype(probs.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)
                       ).astype(q.dtype)
 
@@ -101,6 +108,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
             s = jnp.where(rows >= cols, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
+        if causal:
+            # rows whose running max is still NEG_INF (no visible key yet)
+            # would get exp(NEG_INF - NEG_INF) = 1; force masked entries
+            # to contribute exactly 0 so l stays 0 for empty rows
+            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=1)
         acc = acc * alpha[:, None] + jax.lax.dot_general(
@@ -118,9 +130,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
         acc, m, l = jax.lax.fori_loop(0, nk_live, body, (acc0, m0, l0))
     else:
         acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l))[:, None]  # [bq, 1] trailing lane
+    # empty rows (causal with sq > sk: no visible keys) have l == 0 →
+    # output 0, and a FINITE lse (0) so the backward's exp(s - lse) is
+    # exp(NEG_INF) = 0 instead of exp(NEG_INF - NEG_INF) = 1 blowing up
+    # dQ/dK/dV
+    empty = l <= 0.0
+    l_safe = jnp.where(empty, 1.0, l)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse = jnp.where(empty, 0.0, m + jnp.log(l_safe))
+    lse_ref[0, 0] = lse[:, None]  # [bq, 1] trailing lane
 
 
 def _bias_spec(bias, b_axis, h_axis, blk_q, sk, block_q_axis=2):
@@ -460,5 +478,12 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
         # normalize bias to 4d
         while bias.ndim < 4:
             bias = bias[None]
+        if bias.shape[3] == 1 and sk != 1:
+            # _bias_spec blocks the key axis at full Sk; a size-1 key dim
+            # would mis-slice at pallas trace time, so materialize the
+            # broadcast (costs Sq x Sk bias bytes — same as the composed
+            # fallback's score matrix, but keeps the flash kernel)
+            bias = jnp.broadcast_to(
+                bias, bias.shape[:3] + (sk,))
     return _flash(q, k, v, bias, causal, sm_scale, block_q, block_k,
                   _use_interpret())

@@ -57,8 +57,20 @@ def _update_loss_scaling(ctx, ins, attrs):
     for x in ins["X"]:
         # zero grads on overflow, matching the reference kernel's FillIf
         # (update_loss_scaling_op.h). NOTE: like the reference, an adam step
-        # with zero grad still applies decay; the AMP decorator additionally
-        # gates optimizer ops on FoundInfinite for a true skip.
+        # with zero grad still applies weight decay — optimizer ops run
+        # unconditionally; zeroed grads make the update a decay-only step.
         outs.append(jnp.where(found, jnp.zeros_like(x), x))
     return {"Out": outs, "LossScaling": [new_scale],
             "OutGoodSteps": [new_good], "OutBadSteps": [new_bad]}
+
+
+@register_op("zero_on_found_infinite", inputs=("X", "FoundInfinite"),
+             outputs=("Out",), no_grad=True)
+def _zero_on_found_infinite(ctx, ins, attrs):
+    """TPU-side addition (no reference analog): when dynamic loss scaling
+    is off (the bf16 default) update_loss_scaling never runs, so this op
+    provides the grad-zeroing half of its contract — non-finite grads are
+    replaced by zeros instead of NaN-poisoning the parameters."""
+    found = ins["FoundInfinite"][0]
+    return {"Out": [jnp.where(found, jnp.zeros_like(x), x)
+                    for x in ins["X"]]}

@@ -35,7 +35,14 @@ def _mask_from(ins, x):
 def _lstm_scan(x_proj, h0, c0, wh, bias, mask, use_peepholes=False,
                w_peep=None):
     """x_proj: [B, T, 4D] (x@Wx + b already applied); gates packed
-    [i, f, c~, o] on the trailing axis."""
+    [i, f, c~, o] on the trailing axis.
+
+    NOTE — intentional divergence from the reference: lstm_kernel.h packs
+    gates [c~, i, f, o] ("candidate, input, forget, output"). This
+    framework adopts the [i, f, c~, o] convention (cuDNN/torch order).
+    Weights ported from reference checkpoints must permute the 4D gate
+    axis with `lstm_gate_permutation_from_reference()` below.
+    """
     B, T, D4 = x_proj.shape
     D = D4 // 4
 
@@ -63,6 +70,18 @@ def _lstm_scan(x_proj, h0, c0, wh, bias, mask, use_peepholes=False,
 
     (h_f, c_f), (hs, cs) = jax.lax.scan(cell, (h0, c0), jnp.arange(T))
     return (jnp.moveaxis(hs, 0, 1), jnp.moveaxis(cs, 0, 1), h_f, c_f)
+
+
+def lstm_gate_permutation_from_reference(w, axis=-1):
+    """Permute an LSTM gate-packed weight/bias from the reference's
+    [c~, i, f, o] order (operators/math/detail/lstm_kernel.h) to this
+    framework's [i, f, c~, o]. `axis` is the 4D-packed gate axis."""
+    d4 = w.shape[axis]
+    assert d4 % 4 == 0, w.shape
+    d = d4 // 4
+    parts = jnp.split(jnp.asarray(w), 4, axis=axis)  # [c~, i, f, o]
+    return jnp.concatenate([parts[1], parts[2], parts[0], parts[3]],
+                           axis=axis)
 
 
 @register_op("lstm", inputs=("Input", "WeightX", "WeightH", "Bias", "H0",

@@ -343,20 +343,26 @@ def _one_hot_v2(ctx, ins, attrs):
                               dtype=jnp.float32))
 
 
+def _arg_reduce(fn, ins, attrs):
+    x = ins["X"][0]
+    if attrs.get("flatten", False):
+        # arg over the flattened tensor (arg_max_op.h flatten attr)
+        out = fn(x.reshape(-1), axis=0, keepdims=attrs.get("keepdims",
+                                                           False))
+    else:
+        out = fn(x, axis=attrs.get("axis", -1),
+                 keepdims=attrs.get("keepdims", False))
+    return one(out.astype(to_jax_dtype(attrs.get("dtype", "int64"))))
+
+
 @register_op("arg_max", inputs=("X",), no_grad=True)
 def _arg_max(ctx, ins, attrs):
-    axis = attrs.get("axis", -1)
-    keepdims = attrs.get("keepdims", False)
-    out = jnp.argmax(ins["X"][0], axis=axis, keepdims=keepdims)
-    return one(out.astype(to_jax_dtype(attrs.get("dtype", "int64"))))
+    return _arg_reduce(jnp.argmax, ins, attrs)
 
 
 @register_op("arg_min", inputs=("X",), no_grad=True)
 def _arg_min(ctx, ins, attrs):
-    axis = attrs.get("axis", -1)
-    keepdims = attrs.get("keepdims", False)
-    out = jnp.argmin(ins["X"][0], axis=axis, keepdims=keepdims)
-    return one(out.astype(to_jax_dtype(attrs.get("dtype", "int64"))))
+    return _arg_reduce(jnp.argmin, ins, attrs)
 
 
 @register_op("argsort", inputs=("X",), outputs=("Out", "Indices"),

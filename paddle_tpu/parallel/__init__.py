@@ -1,5 +1,6 @@
 from .env import (DistEnv, get_env, get_mesh, get_rank,  # noqa: F401
-                  get_world_size, init_parallel_env, shard_batch, sharding,
+                  get_world_size, init_distributed_runtime,
+                  init_parallel_env, shard_batch, sharding,
                   DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS)
 from .collective import (all_gather, all_reduce, all_to_all, barrier,  # noqa: F401
                          broadcast, ppermute, reduce_scatter, ring_axis,

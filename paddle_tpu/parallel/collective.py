@@ -52,14 +52,30 @@ def _in_shard_map(axis: str) -> bool:
 
 
 def _host_collective(fn, x, axis):
-    """Apply a per-shard collective to a host-level array via shard_map."""
+    """Apply a per-rank collective to a host-level value via shard_map.
+
+    Rank semantics follow the input's sharding. An array actually sharded
+    over `axis` (e.g. via shard_batch) enters shard-per-rank — each shard
+    is that rank's value. Anything else (numpy, single-device,
+    replicated) is the SAME logical value on every rank — the reference's
+    replicated-per-process dygraph grads — so each rank runs the
+    collective on its copy: allreduce-sum multiplies by nranks, exactly
+    the NCCL semantics DataParallel.scale_loss pre-divides for
+    (dygraph/parallel.py:337)."""
+    from jax.sharding import NamedSharding
     mesh = _envmod.get_mesh()
     if mesh is None or axis not in mesh.axis_names or \
             mesh.shape[axis] == 1:
         return x  # single rank: identity (matches reference nranks==1)
-    spec = P(*([axis] + [None] * (jnp.ndim(x) - 1)))
+    spec = P()
+    sh = getattr(x, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        in_axes = [a for entry in sh.spec if entry is not None
+                   for a in (entry if isinstance(entry, tuple) else (entry,))]
+        if axis in in_axes:
+            spec = sh.spec
     return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=spec,
-                                 out_specs=spec))(x)
+                                 out_specs=spec, check_vma=False))(x)
 
 
 _REDUCERS = {

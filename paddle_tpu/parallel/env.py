@@ -60,6 +60,49 @@ class DistEnv:
 
 
 _env = DistEnv()
+_dist_initialized = False
+
+
+def init_distributed_runtime(coordinator_address: Optional[str] = None,
+                             num_processes: Optional[int] = None,
+                             process_id: Optional[int] = None) -> bool:
+    """Multi-process/multi-host bootstrap — the TPU analog of the
+    reference's c_gen_nccl_id -> c_comm_init op pair
+    (/root/reference/python/paddle/fluid/transpiler/collective.py:113-123)
+    and the raw-TCP ncclUniqueId exchange
+    (/root/reference/paddle/fluid/imperative/nccl_context.cc:21-77).
+
+    Consumes the cluster env contract materialized by fleet/launch.py and
+    spawn() (role_maker.py:421-492): PADDLE_TRAINER_ID,
+    PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS. Rank 0's endpoint hosts
+    the coordination service; jax.distributed wires every process into ONE
+    global PjRt topology, after which jax.devices() spans all hosts and a
+    Mesh over it rides ICI within a slice / DCN across hosts.
+
+    Must run before the local backend initializes. Returns True when a
+    multi-process runtime was (already) formed.
+    """
+    global _dist_initialized
+    if _dist_initialized:
+        return True
+    n = num_processes if num_processes is not None else \
+        int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if n <= 1:
+        return False
+    rank = process_id if process_id is not None else \
+        int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coordinator_address is None:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        coordinator_address = os.environ.get("PADDLE_COORDINATOR_ENDPOINT") \
+            or (eps.split(",")[0] if eps else None)
+    if not coordinator_address:
+        raise RuntimeError(
+            "multi-process init needs PADDLE_TRAINER_ENDPOINTS or "
+            "PADDLE_COORDINATOR_ENDPOINT (launch/spawn set these)")
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=n, process_id=rank)
+    _dist_initialized = True
+    return True
 
 
 def init_parallel_env(mesh_shape: Optional[Dict[str, int]] = None,
@@ -72,6 +115,12 @@ def init_parallel_env(mesh_shape: Optional[Dict[str, int]] = None,
     Default: all devices on the data axis.
     """
     global _env
+    if devices is None and not _dist_initialized and \
+            int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1 and \
+            os.environ.get("PADDLE_TRAINER_ENDPOINTS"):
+        # launched under the cluster contract: form the global runtime
+        # first so jax.devices() below spans every process
+        init_distributed_runtime()
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if mesh_shape is None:
@@ -81,8 +130,9 @@ def init_parallel_env(mesh_shape: Optional[Dict[str, int]] = None,
     total = int(np.prod(extents))
     if total != n:
         # grow the data axis to cover all devices, but only when the user
-        # did not pin it explicitly — a pinned dp that doesn't fit is an
-        # error, never silently resized
+        # did not pin it explicitly — a pinned shape that fits in fewer
+        # devices becomes a sub-mesh over the first `total` devices (the
+        # reference likewise forms comm rings over a subset of places)
         dp_pinned = mesh_shape.get(DP_AXIS) is not None
         others = total // (mesh_shape.get(DP_AXIS) or 1)
         if DP_AXIS in axes and not dp_pinned and n % others == 0:
@@ -90,10 +140,25 @@ def init_parallel_env(mesh_shape: Optional[Dict[str, int]] = None,
         elif not dp_pinned and DP_AXIS not in axes and n % total == 0:
             axes.insert(0, DP_AXIS)
             extents.insert(0, n // total)
+        elif total < n:
+            # explicit sub-mesh: legitimate (rings over a subset of
+            # places) but loud — idle chips are a silent throughput cliff
+            import logging
+            logging.getLogger("paddle_tpu").warning(
+                "mesh %s uses %d of %d devices; %d devices stay idle",
+                mesh_shape, total, n, n - total)
+            devices = devices[:total]
+            if jax.process_count() > 1 and not any(
+                    d.process_index == jax.process_index()
+                    for d in devices):
+                raise ValueError(
+                    f"sub-mesh over {total} devices excludes every device "
+                    f"addressable by process {jax.process_index()}; shrink "
+                    "PADDLE_TRAINERS_NUM or grow the mesh")
         else:
             raise ValueError(
-                f"mesh shape {mesh_shape} does not cover {n} devices "
-                f"(product {total})")
+                f"mesh shape {mesh_shape} needs {total} devices but only "
+                f"{n} are available")
     dev_array = np.array(devices).reshape(extents)
     _env.mesh = Mesh(dev_array, tuple(axes))
     return _env
@@ -126,14 +191,24 @@ def sharding(*spec) -> NamedSharding:
 def shard_batch(batch, axis: str = DP_AXIS):
     """Device-put a host batch sharded along its leading dim — the analog of
     the reference feeding per-device scopes
-    (framework/parallel_executor.cc BCast/feed split)."""
+    (framework/parallel_executor.cc BCast/feed split).
+
+    Single-process: `batch` is the GLOBAL batch, split across the axis.
+    Multi-process (after init_distributed_runtime): `batch` is this
+    process's LOCAL shard (standard SPMD data loading — each trainer reads
+    its own files, as the reference's DataFeed does) and is assembled into
+    a global array spanning all hosts."""
     if _env.mesh is None or _env.axis_size(axis) == 1:
         return jax.device_put(batch)
-    sh = sharding(axis)
+
+    multiproc = jax.process_count() > 1
 
     def put(x):
         ndim = np.ndim(x)
         spec = PartitionSpec(*([axis] + [None] * (ndim - 1)))
-        return jax.device_put(x, NamedSharding(_env.mesh, spec))
+        sh = NamedSharding(_env.mesh, spec)
+        if multiproc:
+            return jax.make_array_from_process_local_data(sh, np.asarray(x))
+        return jax.device_put(x, sh)
 
     return jax.tree.map(put, batch)

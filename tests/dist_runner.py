@@ -1,0 +1,79 @@
+"""Distributed-vs-local parity model runner (dist_mnist.py analog).
+
+The reference runs every "multi-node" test as multiple localhost
+processes and compares per-step losses between a local run and the
+distributed run (/root/reference/python/paddle/fluid/tests/unittests/
+test_dist_base.py:594,674,785). Same discipline here: this script trains
+a fixed-seed MLP for a few steps over a dp=4 mesh and prints the loss
+trajectory as one JSON line.
+
+Modes:
+  local  — one process, 4 virtual CPU devices, global batch
+  dist   — one of PADDLE_TRAINERS_NUM processes; the cluster contract env
+           vars are set by the parent; jax.distributed forms the global
+           4-device mesh (2 local devices per process) and this process
+           feeds its LOCAL half of every batch.
+
+Caller must set XLA_FLAGS/JAX_PLATFORMS before python starts (env), so
+jax initializes the right backend.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    mode = sys.argv[1]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as pt
+    import paddle_tpu.parallel as dist
+    from paddle_tpu import nn
+    from paddle_tpu.dygraph import Tensor, seed
+    from paddle_tpu.jit import TrainStep
+
+    # bootstrap FIRST: seeding creates a PRNGKey, which would initialize
+    # the local backend before jax.distributed can form the global one
+    env = dist.init_parallel_env({"dp": 4})
+    seed(7)
+    np.random.seed(7)
+    assert env.nranks == 4, env.nranks
+    rank = env.rank
+    nproc = jax.process_count()
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(8, 16)
+            self.l2 = nn.Linear(16, 1)
+
+        def forward(self, x):
+            return self.l2(self.l1(x).tanh())
+
+    def loss_fn(pred, label):
+        return ((pred - label) * (pred - label)).mean()
+
+    model = MLP()
+    opt = pt.optimizer.SGD(0.1, parameters=model.parameters())
+    step = TrainStep(model, loss_fn, opt, mesh=env.mesh)
+
+    data_rng = np.random.RandomState(3)
+    losses = []
+    for _ in range(5):
+        x = data_rng.randn(8, 8).astype(np.float32)  # GLOBAL batch
+        y = (x.sum(axis=1, keepdims=True) > 0).astype(np.float32)
+        if nproc > 1:
+            per = 8 // nproc  # this process's shard of the dp batch
+            x = x[rank * per:(rank + 1) * per]
+            y = y[rank * per:(rank + 1) * per]
+        loss = step((x,), (y,))
+        losses.append(float(loss))
+    if rank == 0 or nproc == 1:
+        print("LOSSES " + json.dumps(losses), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -45,6 +45,40 @@ def test_flash_attention_causal_cross_length():
         np.testing.assert_allclose(a, b_, atol=1e-4, rtol=1e-4)
 
 
+def test_flash_attention_causal_sq_gt_sk():
+    # sq > sk: leading q-rows see ZERO keys (bottom-right alignment);
+    # their output is 0 and — the ADVICE r1 regression — their backward
+    # must not blow up through exp(s - lse) with lse ~ -1e30
+    b, h, sq, sk, d = 1, 2, 256, 128, 64
+    q = _rand((b, h, sq, d), 0)
+    k, v = _rand((b, h, sk, d), 1), _rand((b, h, sk, d), 2)
+    out = flash_attention(q, k, v, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    # empty rows output exactly 0 in both paths
+    np.testing.assert_allclose(out[:, :, :sq - sk], 0.0, atol=1e-6)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    g_f = jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True)
+                   .sum(), argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(lambda q, k, v: attention_reference(q, k, v, causal=True)
+                   .sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_f, g_r):
+        assert np.isfinite(np.asarray(a)).all()
+        np.testing.assert_allclose(a, b_, atol=1e-4, rtol=1e-4)
+
+
+def test_flash_attention_key_axis_size1_bias():
+    # bias [...,1] on the key axis broadcasts instead of failing at
+    # pallas trace time (ADVICE r1)
+    b, h, s, d = 1, 2, 128, 64
+    q, k, v = _rand((b, h, s, d), 0), _rand((b, h, s, d), 1), \
+        _rand((b, h, s, d), 2)
+    bias = _rand((b, 1, s, 1), 3)
+    out = flash_attention(q, k, v, bias=bias)
+    ref = attention_reference(q, k, v, bias=bias)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
 def test_flash_attention_bias_broadcast():
     b, h, s, d = 2, 2, 128, 64
     q, k, v = _rand((b, h, s, d), 0), _rand((b, h, s, d), 1), \
