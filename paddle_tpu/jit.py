@@ -121,6 +121,8 @@ def _wrap(x):
 
 
 def _unwrap(x):
+    if x is None:  # optional model inputs (e.g. token_type_ids) pass through
+        return None
     return x.value if isinstance(x, Tensor) else jnp.asarray(x)
 
 
@@ -208,7 +210,9 @@ class TrainStep:
                 r1, r2 = jax.random.split(rng)
                 try:
                     out, new_state = functional_call(
-                        model, full, *[Tensor(x) for x in inputs],
+                        model, full,
+                        *[Tensor(x) if x is not None else None
+                          for x in inputs],
                         training=True, rng=r1)
                 finally:
                     if self.amp_dtype is not None:

@@ -75,11 +75,11 @@ def attention_reference(q, k, v, bias=None, causal=False, sm_scale=None):
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
-                sm_scale, causal, block_k, sk, sq_total):
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, drop_ref, o_ref, lse_ref, *,
+                sm_scale, causal, block_k, sk, sq_total, keep_prob):
     # blocks: q [1,1,bq,d]; k/v [1,1,sk,d]; bias [1,1,bq|1,sk] or None;
-    # value-indexed with [0, 0, ...] (ref views of <128-lane dims don't
-    # lower on Mosaic)
+    # drop (keep-mask) [1,1,bq,sk] or None; value-indexed with [0, 0, ...]
+    # (ref views of <128-lane dims don't lower on Mosaic)
     bq, d = q_ref.shape[2], q_ref.shape[3]
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * sm_scale
@@ -114,9 +114,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
             # to contribute exactly 0 so l stays 0 for empty rows
             p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         alpha = jnp.exp(m - m_new)
+        # softmax denominator accumulates the UNdropped probs (dropout
+        # does not renormalize); only the value accumulation is masked
         l_new = l * alpha + jnp.sum(p, axis=1)
+        if drop_ref is not None:
+            dm = drop_ref[0, 0, :, pl.ds(ki * block_k, block_k)] \
+                .astype(jnp.float32)
+            p_acc = p * dm * (1.0 / keep_prob)
+        else:
+            p_acc = p
         acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p_acc, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
@@ -155,7 +163,8 @@ def _bias_spec(bias, b_axis, h_axis, blk_q, sk, block_q_axis=2):
     return pl.BlockSpec(blk, idx)
 
 
-def _fwd(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
+def _fwd(q, k, v, bias, drop_mask, causal, sm_scale, block_q, block_k,
+         interpret, keep_prob):
     batch, heads, sq, d = q.shape
     sk = k.shape[2]
     blk_q = min(block_q, sq)
@@ -172,15 +181,19 @@ def _fwd(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
     if bias is not None:
         in_specs.append(_bias_spec(bias, batch, heads, blk_q, sk))
         args.append(bias)
+    if drop_mask is not None:
+        in_specs.append(
+            pl.BlockSpec((1, 1, blk_q, sk), lambda b, h, i: (b, h, i, 0)))
+        args.append(drop_mask)
 
     def kern(q_ref, k_ref, v_ref, *rest):
-        if bias is not None:
-            b_ref, o_ref, lse_ref = rest
-        else:
-            b_ref, (o_ref, lse_ref) = None, rest
-        _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
+        rest = list(rest)
+        b_ref = rest.pop(0) if bias is not None else None
+        dm_ref = rest.pop(0) if drop_mask is not None else None
+        o_ref, lse_ref = rest
+        _fwd_kernel(q_ref, k_ref, v_ref, b_ref, dm_ref, o_ref, lse_ref,
                     sm_scale=sm_scale, causal=causal, block_k=blk_k, sk=sk,
-                    sq_total=sq)
+                    sq_total=sq, keep_prob=keep_prob)
 
     # lse carries a trailing singleton dim: Mosaic requires the last two
     # block dims to be (8k, 128m) or equal to the array dims
@@ -211,9 +224,9 @@ def _fwd(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
 # backward kernels
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, drop_ref, do_ref, lse_ref,
                    delta_ref, dq_ref, *, sm_scale, causal, block_k, sk,
-                   sq_total):
+                   sq_total, keep_prob):
     bq, d = q_ref.shape[2], q_ref.shape[3]
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)
@@ -243,6 +256,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if drop_ref is not None:
+            # d/ds of sum_k (m/keep) p_k v_k with lse fixed by the full
+            # (undropped) softmax: ds = p * (m/keep * dp - delta)
+            dm = drop_ref[0, 0, :, pl.ds(ki * block_k, block_k)] \
+                .astype(jnp.float32)
+            dp = dp * dm * (1.0 / keep_prob)
         ds = p * (dp - delta[:, None]) * sm_scale
         return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
@@ -251,9 +270,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, drop_ref, do_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, *, sm_scale, causal,
-                    block_q, sq, sk_total):
+                    block_q, sq, sk_total, keep_prob):
     bk, d = k_ref.shape[2], k_ref.shape[3]
     ki = pl.program_id(2)
     k = k_ref[0, 0].astype(jnp.float32)
@@ -285,11 +304,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
                 + ki * bk
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse_blk[:, None])  # [block_q, bk]
+        if drop_ref is not None:
+            dm = drop_ref[0, 0, pl.ds(qi * block_q, block_q), :] \
+                .astype(jnp.float32) * (1.0 / keep_prob)
+            p_drop = p * dm
+        else:
+            p_drop = p
         dv_new = dv + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())),
+            p_drop, do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if drop_ref is not None:
+            dp = dp * dm
         ds = p * (dp - delta_blk[:, None]) * sm_scale
         dk_new = dk + jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())),
