@@ -1,8 +1,23 @@
-"""Benchmark: BERT-base pretrain step throughput on the local chip.
+"""Benchmark: BERT-base pretrain + ResNet-50 train throughput on the
+local chip (BASELINE.json metric: images/sec/chip (ResNet-50) +
+tokens/sec/chip (BERT-base)).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline is measured-MFU / target-MFU with target 0.45 (BASELINE.md
-north star: >=45% MFU on the BERT-base pretrain config).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+vs_baseline is min(bert_mfu, resnet_mfu) / 0.45 — the north star is
+>=45% MFU on BOTH headline configs, so the conservative (worst) config
+gates the score. Extra keys carry the per-config numbers and the proof
+that the Pallas flash kernel is actually inside the compiled step
+(round 2 silently benchmarked the fallback; never again).
+
+FLOPs accounting (honest-MFU):
+- BERT: analytic transformer FLOPs — 6*N_dense per token for the dense
+  blocks (embedding-table rows excluded: a lookup is a gather, not a
+  matmul), + 12*L*H*S per token for the attention score/value matmuls,
+  + MLM head on the M masked positions only (6*H*V + 6*H*H per masked
+  token) + pooler/NSP. The 6N-all-params model the round-2 bench used
+  inflated MFU by counting ~23M embedding rows as matmul FLOPs.
+- ResNet-50: ~4.09 GMACs/image at 224x224 => 2*MACs = 8.18 GFLOPs
+  forward; fwd+bwd = 3x forward.
 """
 import json
 import os
@@ -12,68 +27,142 @@ import time
 import numpy as np
 
 
-def main():
+def _bench_bert(on_tpu):
     import jax
     import paddle_tpu as pt
     from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
                                         pretraining_loss)
     from paddle_tpu.jit import TrainStep
 
-    on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
         cfg = BertConfig()  # BERT-base
-        B, S, steps = 64, 128, 50
+        B, S, M, steps = 32, 512, 80, 30
     else:  # CI / smoke fallback
         cfg = BertConfig(vocab_size=1000, hidden_size=128,
                          num_hidden_layers=2, num_attention_heads=4,
-                         intermediate_size=256, max_position_embeddings=128)
-        B, S, steps = 8, 64, 5
+                         intermediate_size=256, max_position_embeddings=512)
+        B, S, M, steps = 4, 128, 20, 3
 
     model = BertForPretraining(cfg)
-    if on_tpu:
-        model.to(dtype="bfloat16") if False else None  # params fp32; compute bf16 via amp
     opt = pt.optimizer.Adam(1e-4, parameters=model.parameters())
     step = TrainStep(model, pretraining_loss, opt,
                      amp_dtype="bfloat16" if on_tpu else None)
 
     rng = np.random.RandomState(0)
-
-    def batch():
-        ids = rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32)
-        mlm = np.where(rng.rand(B, S) < 0.15, ids, -100).astype(np.int32)
-        nsp = rng.randint(0, 2, (B, 1)).astype(np.int32)
-        return ids, mlm, nsp
+    ids = rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    # masked-position pretraining batch: M masked slots per row; labels
+    # are the original ids at those positions (gathered — matches the
+    # model's masked_positions contract, models/bert.py:176)
+    pos = np.stack([rng.choice(S, M, replace=False) for _ in range(B)]
+                   ).astype(np.int32)
+    mlm = np.take_along_axis(ids, pos, axis=1).astype(np.int32)
+    nsp = rng.randint(0, 2, (B, 1)).astype(np.int32)
+    inputs = (ids, None, None, pos)
+    labels = (mlm, nsp)
 
     # warmup/compile: TWO steps — the first call compiles with empty
     # optimizer state, the second recompiles once the accumulator pytree
     # exists; only then is the step cached
-    ids, mlm, nsp = batch()
     for _ in range(2):
-        loss = step((ids,), (mlm, nsp))
+        loss = step(inputs, labels)
         float(loss)
+
+    # proof the Pallas flash kernel is in the program: the lowered
+    # StableHLO of the cached step must contain the Mosaic custom call.
+    flash_in_hlo = False
+    try:
+        import jax.numpy as jnp
+        lowered = step._step_fn.lower(
+            step._state, step._opt_state, step._lr_step,
+            jax.random.PRNGKey(0),
+            (tuple(jnp.asarray(x) if x is not None else None
+                   for x in inputs),
+             tuple(jnp.asarray(x) for x in labels)))
+        txt = lowered.as_text()
+        flash_in_hlo = ("tpu_custom_call" in txt) or ("mosaic" in txt)
+    except Exception as e:  # proof failure is loud, not fatal
+        print("WARN: flash HLO check failed: %r" % (e,), file=sys.stderr)
+    if on_tpu and not flash_in_hlo:
+        print("WARN: Pallas flash kernel NOT found in compiled step!",
+              file=sys.stderr)
 
     t0 = time.time()
     for _ in range(steps):
-        loss = step((ids,), (mlm, nsp))
+        loss = step(inputs, labels)
     float(loss)  # sync
     dt = (time.time() - t0) / steps
-
     tokens_per_sec = B * S / dt
 
-    # MFU: ~6*N FLOPs/token fwd+bwd with N ≈ 12*L*H^2 (attention+FFN) +
-    # embeddings excluded; use standard 6*params estimate.
-    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    flops_per_token = 6 * n_params
-    achieved = tokens_per_sec * flops_per_token
-    # v5e peak: 197 TFLOPs bf16 per chip
-    peak = 197e12 if on_tpu else 1e12
-    mfu = achieved / peak
+    H, L, V = cfg.hidden_size, cfg.num_hidden_layers, cfg.vocab_size
+    I = cfg.intermediate_size
+    # dense params per layer: qkv+out 4H^2 + ffn 2HI; 6 flops/param/token
+    n_dense = L * (4 * H * H + 2 * H * I)
+    flops_token = 6 * n_dense + 12 * L * H * S
+    # heads: MLM transform H^2 + tied decoder H*V on M positions;
+    # pooler H^2 + nsp 2H on 1 position — amortized over B*S tokens
+    head = 6 * (H * H + H * V) * M + 6 * (H * H + 2 * H)
+    flops_step = flops_token * B * S + head * B
+    mfu = (flops_step / dt) / (197e12 if on_tpu else 1e12)
+    return tokens_per_sec, mfu, flash_in_hlo
+
+
+def _bench_resnet(on_tpu):
+    import paddle_tpu as pt
+    from paddle_tpu.models.resnet import resnet50, resnet18
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.nn import functional as F
+
+    if on_tpu:
+        model = resnet50(num_classes=1000)
+        B, HW, steps, flops_img = 64, 224, 20, 3 * 2 * 4.09e9
+    else:
+        model = resnet18(num_classes=10)
+        B, HW, steps, flops_img = 4, 32, 3, 3 * 2 * 0.037e9
+
+    opt = pt.optimizer.Momentum(0.1, 0.9, parameters=model.parameters())
+
+    def loss_fn(logits, label):
+        return F.cross_entropy(logits, label, reduction="mean")
+
+    step = TrainStep(model, loss_fn, opt,
+                     amp_dtype="bfloat16" if on_tpu else None)
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, 3, HW, HW).astype(np.float32)
+    y = rng.randint(0, 1000 if on_tpu else 10, (B, 1)).astype(np.int64)
+
+    for _ in range(2):
+        loss = step((x,), (y,))
+        float(loss)
+    t0 = time.time()
+    for _ in range(steps):
+        loss = step((x,), (y,))
+    float(loss)
+    dt = (time.time() - t0) / steps
+    imgs_per_sec = B / dt
+    mfu = (imgs_per_sec * flops_img) / (197e12 if on_tpu else 1e12)
+    return imgs_per_sec, mfu
+
+
+def main():
+    import jax
+    on_tpu = jax.default_backend() not in ("cpu",)
+
+    bert_tps, bert_mfu, flash_ok = _bench_bert(on_tpu)
+    rn_ips, rn_mfu = _bench_resnet(on_tpu)
+
+    vs = min(bert_mfu, rn_mfu) / 0.45
     print(json.dumps({
-        "metric": "tokens/sec/chip BERT-base pretrain (fused step, bf16)"
-        if on_tpu else "tokens/sec/chip tiny-BERT (cpu smoke)",
-        "value": round(tokens_per_sec, 1),
+        "metric": "tokens/sec/chip BERT-base (S=512, masked-LM, bf16) + "
+                  "images/sec/chip ResNet-50 (224px, bf16)"
+        if on_tpu else "cpu smoke (tiny BERT + resnet18)",
+        "value": round(bert_tps, 1),
         "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.45, 4),
+        "vs_baseline": round(vs, 4),
+        "bert_tokens_per_sec": round(bert_tps, 1),
+        "bert_mfu": round(bert_mfu, 4),
+        "resnet50_images_per_sec": round(rn_ips, 1),
+        "resnet50_mfu": round(rn_mfu, 4),
+        "flash_kernel_in_hlo": bool(flash_ok),
     }))
 
 

@@ -315,6 +315,12 @@ class Executor:
                  feed_names: List[str], fetch_names: List[str],
                  state_names: List[str]):
         persistable = {v.name for v in program.persistable_vars()}
+        has_host = any(REGISTRY.has(op.type) and REGISTRY.get(op.type).host
+                       for op in block.ops)
+        if has_host:
+            return self._compile_segmented(program, block, feed_names,
+                                           fetch_names, state_names,
+                                           persistable)
 
         def step(state, feeds, rng):
             ctx = LowerCtx(rng)
@@ -338,6 +344,105 @@ class Executor:
 
         jitted = jax.jit(step, donate_argnums=(0,))
         return jitted
+
+    def _compile_segmented(self, program: Program, block: Block,
+                           feed_names: List[str], fetch_names: List[str],
+                           state_names: List[str], persistable):
+        """Programs containing host ops (PS send/recv RPC, py_func, save
+        IO): split the op list at host-op boundaries, jit each pure
+        segment, run host ops eagerly on numpy in between — the analog of
+        the reference interleaving RPC ops with device kernels on the
+        compute stream (operators/distributed_ops/send_op.cc). The
+        backward meta-op must live in the same segment as the forward ops
+        it replays (PS trainer programs satisfy this: fwd+backward are
+        contiguous, send/recv come after — distribute_transpiler.py:545
+        appends send/recv at the tail)."""
+        segments: List[Tuple[str, List[OpDesc]]] = []
+        cur: List[OpDesc] = []
+        for op in block.ops:
+            if REGISTRY.has(op.type) and REGISTRY.get(op.type).host:
+                if cur:
+                    segments.append(("jit", cur))
+                    cur = []
+                segments.append(("host", [op]))
+            else:
+                cur.append(op)
+        if cur:
+            segments.append(("jit", cur))
+
+        # static name-availability walk to fix each jit segment's
+        # signature (the _compile cache key already pins the feed sig)
+        available = set(state_names) | set(feed_names)
+        seg_meta = []
+        for kind, seg_ops in segments:
+            in_names = sorted({n for op in seg_ops
+                               for n in op.input_names()
+                               if n in available})
+            out_names = sorted({n for op in seg_ops
+                                for n in op.output_names()})
+            seg_meta.append([kind, seg_ops, in_names, out_names])
+            available |= set(out_names)
+        # liveness pruning: a jit segment must only export names a later
+        # segment, a fetch, or the persistable state needs — exporting
+        # every intermediate would force XLA to materialize all
+        # activations as live outputs (blocking fusion/DCE)
+        live = set(fetch_names) | (persistable & available)
+        for meta in reversed(seg_meta):
+            kind, seg_ops, in_names, out_names = meta
+            meta[3] = sorted(set(out_names) & live)
+            live = (live - set(out_names)) | set(in_names)
+
+        jitted_segs = {}
+
+        def make_seg(si, seg_ops, in_names, out_names):
+            def seg(vals, key):
+                ctx = LowerCtx(key)
+                lowerer = _BlockLowerer(program, ctx)
+                env2 = dict(zip(in_names, vals))
+                initial_env = dict(env2)
+                lowerer.run_ops(seg_ops, env2, initial_env=initial_env,
+                                initial_key=key)
+                outs = [env2[n] for n in out_names]
+                key_out = ctx.key_out if ctx.key_out is not None else key
+                return outs, key_out
+            return jax.jit(seg)
+
+        def step(state, feeds, rng):
+            env: Dict[str, Any] = dict(state)
+            env.update(feeds)
+            key = rng
+            for si, (kind, seg_ops, in_names, out_names) in \
+                    enumerate(seg_meta):
+                if kind == "host":
+                    op = seg_ops[0]
+                    opdef = REGISTRY.get(op.type)
+                    ins = {slot: [np.asarray(env[n]) for n in names]
+                           for slot, names in op.inputs.items() if names}
+                    try:
+                        outs = opdef.lower(LowerCtx(), ins, op.attrs)
+                    except Exception as e:
+                        e.add_note(f"while running host op {op.type!r}")
+                        raise
+                    for slot, names in op.outputs.items():
+                        vals = (outs or {}).get(slot)
+                        if vals is None:
+                            continue
+                        for n, v in zip(names, vals):
+                            env[n] = v
+                else:
+                    fn = jitted_segs.get(si)
+                    if fn is None:
+                        fn = jitted_segs[si] = make_seg(
+                            si, seg_ops, in_names, out_names)
+                    outs, key = fn([env[n] for n in in_names], key)
+                    env.update(dict(zip(out_names, outs)))
+            fetches = [env[n] for n in fetch_names]
+            new_state = {n: env[n] for n in env if n in persistable}
+            for n in state_names:
+                new_state.setdefault(n, state[n])
+            return fetches, new_state, key
+
+        return step
 
     def close(self):
         self._cache.clear()

@@ -66,6 +66,12 @@ class OpDef:
     # ops that mutate persistable state (optimizer ops): output slot ->
     # input slot whose variable it updates in place (e.g. ParamOut -> Param)
     inplace_map: Dict[str, str] = field(default_factory=dict)
+    # host-side ops (PS send/recv RPC, py_func, save/load IO): NOT
+    # jax-traceable. The executor splits the block into jit segments at
+    # host-op boundaries and runs these eagerly on numpy between them —
+    # the analog of the reference running RPC ops on the CPU compute
+    # stream while CUDA kernels run async (distributed_ops/send_op.cc).
+    host: bool = False
 
 
 class OpRegistry:
@@ -94,17 +100,18 @@ REGISTRY = OpRegistry()
 
 
 def register_op(name: str, *, inputs=(), outputs=("Out",), no_grad=False,
-                is_random=False, non_diff_inputs=(), inplace_map=None):
+                is_random=False, non_diff_inputs=(), inplace_map=None,
+                host=False):
     """Decorator registering a lowering function for op `name`.
 
     The lowering fn signature is fn(ctx, ins, attrs) -> outs where ins/outs
-    map slot name -> list of jax arrays.
+    map slot name -> list of jax arrays (numpy arrays for host=True ops).
     """
     def deco(fn: LowerFn):
         REGISTRY.register(OpDef(
             name=name, lower=fn, input_slots=tuple(inputs),
             output_slots=tuple(outputs), no_grad=no_grad,
             is_random=is_random, non_diff_inputs=tuple(non_diff_inputs),
-            inplace_map=dict(inplace_map or {})))
+            inplace_map=dict(inplace_map or {}), host=host))
         return fn
     return deco

@@ -34,6 +34,10 @@ class ParamServer:
         self._lock = threading.Lock()
         self.sparse: Dict[str, LargeScaleKV] = {}
         self._recv_count: Dict[str, int] = {}
+        # sync-mode pending window (listen_and_serv RunSyncLoop: grads
+        # from all trainers merge, then the optimize block runs once)
+        self._pending: Dict[str, np.ndarray] = {}
+        self._pending_n: Dict[str, int] = {}
 
     # --- dense ------------------------------------------------------------
     def init_param(self, name: str, value: np.ndarray):
@@ -51,6 +55,28 @@ class ParamServer:
         """Geo: add a trainer's parameter delta."""
         with self._lock:
             self._dense[name] += np.asarray(delta, np.float32)
+
+    def accumulate_grad(self, name: str, grad: np.ndarray):
+        """Sync mode: stage a trainer's grad; applied (averaged) by
+        apply_pending when the send barrier completes."""
+        with self._lock:
+            g = np.asarray(grad, np.float32)
+            if name in self._pending:
+                self._pending[name] += g
+            else:
+                self._pending[name] = g.copy()
+            self._pending_n[name] = self._pending_n.get(name, 0) + 1
+
+    def apply_pending(self):
+        """Run the per-grad optimize block over the merged window
+        (average of the trainers' grads, listen_and_serv_op.cc:248)."""
+        with self._lock:
+            for name, g in self._pending.items():
+                n = max(self._pending_n.get(name, 1), 1)
+                self._dense[name] -= self._lr * (g / n)
+                self._recv_count[name] = self._recv_count.get(name, 0) + 1
+            self._pending.clear()
+            self._pending_n.clear()
 
     def get_param(self, name: str) -> np.ndarray:
         with self._lock:

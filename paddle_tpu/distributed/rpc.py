@@ -1,0 +1,472 @@
+"""PS RPC transport: a real process boundary for the parameter server.
+
+TPU-native analog of the reference's gRPC/BRPC PS transport
+(/root/reference/paddle/fluid/operators/distributed/grpc/grpc_server.cc
+AsyncGRPCServer with RequestSend/RequestGet/RequestPrefetch handlers;
+send_recv.proto.in:19 `VariableMessage{varname, type, dims, tensor
+payload}`; grpc_client.cc AsyncSendVar/AsyncGetVar). The reference's
+choice of gRPC is a CUDA-era implementation detail; what matters — and
+what this module provides — is the contract: variables serialized over a
+socket between trainer and pserver processes, request/response per RPC,
+a server loop dispatching to per-variable handlers, and barriers
+counting trainers (listen_and_serv_op.cc:248 WaitBarrier).
+
+Wire format (little-endian):
+  frame   := u32 total_len, payload
+  request := u8 op, u16 name_len, name bytes, u32 narrays,
+             narrays x array
+  array   := u8 dtype_len, dtype str, u8 ndim, ndim x i64 dims, raw bytes
+  reply   := u8 status (0 ok / 1 error), then arrays (ok) or
+             u32 msg_len + utf8 message (error)
+
+The server is thread-per-connection (each trainer holds one persistent
+connection — same as a gRPC channel); the dense/sparse table logic stays
+in ParamServer, which this transport wraps. Handlers for arrays of ids /
+grads reuse ParamServer's numpy paths — the device never sees the RPC
+(pulls land in host RAM and are fed to the chip by the caller, matching
+the reference's CPU-side pserver)."""
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class _DynamicBarrier:
+    """Barrier whose party count can shrink while others wait (the
+    reference's RequestNotifyHandler decrements the barrier when a
+    trainer completes, listen_and_serv_op.cc:248) — threading.Barrier
+    can't do that without stranding blocked waiters."""
+
+    def __init__(self, parties: int, action=None):
+        self._parties = max(parties, 1)
+        self._action = action
+        self._count = 0
+        self._gen = 0
+        self._cond = threading.Condition()
+
+    def _maybe_release(self):
+        # caller holds the lock
+        if self._count >= self._parties:
+            if self._action is not None:
+                self._action()
+            self._count = 0
+            self._gen += 1
+            self._cond.notify_all()
+
+    def wait(self, timeout: float = 60.0):
+        with self._cond:
+            gen = self._gen
+            self._count += 1
+            self._maybe_release()
+            if gen == self._gen:
+                if not self._cond.wait_for(lambda: gen != self._gen,
+                                           timeout=timeout):
+                    # withdraw this arrival: a stale count would make
+                    # every later round release one party early (and
+                    # fire apply_pending on a partial grad window)
+                    if gen == self._gen and self._count > 0:
+                        self._count -= 1
+                    raise TimeoutError("PS barrier timed out")
+
+    def remove_party(self):
+        with self._cond:
+            self._parties = max(self._parties - 1, 1)
+            self._maybe_release()
+
+# op codes (request types — RequestSend/RequestGet/... in grpc_server.cc)
+OP_INIT_PARAM = 1
+OP_SEND_GRAD = 2
+OP_SEND_DELTA = 3
+OP_GET_PARAM = 4
+OP_CREATE_SPARSE = 5
+OP_PULL_SPARSE = 6
+OP_PUSH_SPARSE = 7
+OP_BARRIER = 8
+OP_STOP = 9
+OP_PING = 10
+OP_SAVE_SPARSE = 11
+OP_COMPLETE = 12  # trainer signals exit (RequestNotifyHandler)
+OP_SEND_GRAD_SYNC = 13  # stage grad; applied at the send barrier
+
+
+def _pack_array(a: np.ndarray) -> bytes:
+    a = np.ascontiguousarray(a)
+    dt = a.dtype.str.encode()
+    parts = [struct.pack("<B", len(dt)), dt,
+             struct.pack("<B", a.ndim)]
+    for d in a.shape:
+        parts.append(struct.pack("<q", d))
+    parts.append(a.tobytes())
+    return b"".join(parts)
+
+
+def _unpack_array(buf: memoryview, off: int) -> Tuple[np.ndarray, int]:
+    (dtl,) = struct.unpack_from("<B", buf, off)
+    off += 1
+    dt = np.dtype(bytes(buf[off:off + dtl]).decode())
+    off += dtl
+    (ndim,) = struct.unpack_from("<B", buf, off)
+    off += 1
+    shape = []
+    for _ in range(ndim):
+        (d,) = struct.unpack_from("<q", buf, off)
+        shape.append(d)
+        off += 8
+    n = int(np.prod(shape)) if shape else 1
+    nbytes = n * dt.itemsize
+    arr = np.frombuffer(buf[off:off + nbytes], dtype=dt).reshape(shape)
+    off += nbytes
+    return arr.copy(), off
+
+
+def encode_request(op: int, name: str, arrays: Sequence[np.ndarray]) \
+        -> bytes:
+    nb = name.encode()
+    body = [struct.pack("<BH", op, len(nb)), nb,
+            struct.pack("<I", len(arrays))]
+    for a in arrays:
+        body.append(_pack_array(np.asarray(a)))
+    payload = b"".join(body)
+    return struct.pack("<I", len(payload)) + payload
+
+
+def decode_request(payload: memoryview) \
+        -> Tuple[int, str, List[np.ndarray]]:
+    op, nl = struct.unpack_from("<BH", payload, 0)
+    off = 3
+    name = bytes(payload[off:off + nl]).decode()
+    off += nl
+    (na,) = struct.unpack_from("<I", payload, off)
+    off += 4
+    arrays = []
+    for _ in range(na):
+        a, off = _unpack_array(payload, off)
+        arrays.append(a)
+    return op, name, arrays
+
+
+def encode_reply(arrays: Sequence[np.ndarray] = (),
+                 error: Optional[str] = None) -> bytes:
+    if error is not None:
+        eb = error.encode()
+        payload = struct.pack("<B", 1) + struct.pack("<I", len(eb)) + eb
+    else:
+        body = [struct.pack("<B", 0), struct.pack("<I", len(arrays))]
+        for a in arrays:
+            body.append(_pack_array(np.asarray(a)))
+        payload = b"".join(body)
+    return struct.pack("<I", len(payload)) + payload
+
+
+def decode_reply(payload: memoryview) -> List[np.ndarray]:
+    (status,) = struct.unpack_from("<B", payload, 0)
+    if status != 0:
+        (ml,) = struct.unpack_from("<I", payload, 1)
+        raise RuntimeError("pserver error: "
+                           + bytes(payload[5:5 + ml]).decode())
+    (na,) = struct.unpack_from("<I", payload, 1)
+    off = 5
+    arrays = []
+    for _ in range(na):
+        a, off = _unpack_array(payload, off)
+        arrays.append(a)
+    return arrays
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket) -> memoryview:
+    (ln,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return memoryview(_recv_exact(sock, ln))
+
+
+class PsServer:
+    """Socket server hosting a ParamServer (listen_and_serv_op.cc:330
+    RunSyncLoop / RunAsyncLoop analog — one handler thread per trainer
+    connection, barrier counting trainers)."""
+
+    def __init__(self, param_server, endpoint: str = "127.0.0.1:0",
+                 n_trainers: int = 1):
+        from .communicator import ParamServer  # noqa: F401  (type)
+        self.ps = param_server
+        self.n_trainers = n_trainers
+        host, port = endpoint.rsplit(":", 1)
+        # barrier action: the last trainer to arrive applies the merged
+        # sync-window grads (RunSyncLoop's optimize-after-barrier)
+        self._barrier = _DynamicBarrier(n_trainers,
+                                        action=param_server.apply_pending)
+        self._stop = threading.Event()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    while not outer._stop.is_set():
+                        payload = _recv_frame(sock)
+                        reply = outer._dispatch(payload)
+                        sock.sendall(reply)
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Server((host, int(port)), Handler)
+        self.endpoint = "%s:%d" % (host, self._srv.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    # -- dispatch ---------------------------------------------------------
+    def _dispatch(self, payload: memoryview) -> bytes:
+        try:
+            op, name, arrays = decode_request(payload)
+            if op == OP_INIT_PARAM:
+                # idempotent across trainers (every trainer's startup
+                # program sends its init; first wins, like the
+                # reference's pserver startup holding the value)
+                if name not in self.ps._dense:
+                    self.ps.init_param(name, arrays[0])
+                return encode_reply()
+            if op == OP_SEND_GRAD:
+                self.ps.send_grad(name, arrays[0])
+                return encode_reply()
+            if op == OP_SEND_GRAD_SYNC:
+                self.ps.accumulate_grad(name, arrays[0])
+                return encode_reply()
+            if op == OP_SEND_DELTA:
+                self.ps.send_delta(name, arrays[0])
+                return encode_reply()
+            if op == OP_GET_PARAM:
+                return encode_reply([self.ps.get_param(name)])
+            if op == OP_CREATE_SPARSE:
+                import json
+                from .large_scale_kv import SparseTableConfig
+                cfg_dict = json.loads(bytes(arrays[0].tobytes()).decode())
+                if name not in self.ps.sparse:
+                    self.ps.create_sparse_table(
+                        SparseTableConfig(**cfg_dict))
+                return encode_reply()
+            if op == OP_PULL_SPARSE:
+                return encode_reply(
+                    [self.ps.pull_sparse(name, arrays[0])])
+            if op == OP_PUSH_SPARSE:
+                self.ps.push_sparse(name, arrays[0], arrays[1])
+                return encode_reply()
+            if op == OP_BARRIER:
+                self._barrier.wait(timeout=60.0)
+                return encode_reply()
+            if op == OP_PING:
+                return encode_reply([np.asarray([1], np.int32)])
+            if op == OP_COMPLETE:
+                # a finished trainer must not block others' barriers —
+                # releases currently-blocked waiters if it was the
+                # missing party
+                self._barrier.remove_party()
+                return encode_reply()
+            if op == OP_STOP:
+                self._stop.set()
+                threading.Thread(target=self._srv.shutdown,
+                                 daemon=True).start()
+                return encode_reply()
+            return encode_reply(error="unknown op %d" % op)
+        except Exception as e:  # serialize errors back to the client
+            return encode_reply(error=repr(e))
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        """Block until a trainer sends OP_STOP (pserver main loop)."""
+        self._srv.serve_forever()
+
+    def stop(self):
+        self._stop.set()
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class PsClient:
+    """Trainer-side stub with the ParamServer method surface, so the
+    communicators work unchanged against local or remote servers
+    (grpc_client.cc AsyncSendVar/AsyncGetVar analog; one persistent
+    connection per endpoint = one channel)."""
+
+    def __init__(self, endpoint: str, timeout: float = 120.0):
+        host, port = endpoint.rsplit(":", 1)
+        self.endpoint = endpoint
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def _call(self, op: int, name: str = "",
+              arrays: Sequence[np.ndarray] = ()) -> List[np.ndarray]:
+        with self._lock:
+            self._sock.sendall(encode_request(op, name, arrays))
+            return decode_reply(_recv_frame(self._sock))
+
+    # --- ParamServer surface --------------------------------------------
+    def init_param(self, name, value):
+        self._call(OP_INIT_PARAM, name, [np.asarray(value, np.float32)])
+
+    def send_grad(self, name, grad):
+        self._call(OP_SEND_GRAD, name, [np.asarray(grad, np.float32)])
+
+    def send_grad_sync(self, name, grad):
+        self._call(OP_SEND_GRAD_SYNC, name,
+                   [np.asarray(grad, np.float32)])
+
+    def send_delta(self, name, delta):
+        self._call(OP_SEND_DELTA, name, [np.asarray(delta, np.float32)])
+
+    def get_param(self, name):
+        return self._call(OP_GET_PARAM, name)[0]
+
+    def create_sparse_table(self, cfg):
+        import dataclasses
+        import json
+        blob = json.dumps(dataclasses.asdict(cfg)).encode()
+        self._call(OP_CREATE_SPARSE, cfg.name,
+                   [np.frombuffer(blob, np.uint8)])
+
+    def pull_sparse(self, table, ids):
+        return self._call(OP_PULL_SPARSE, table,
+                          [np.asarray(ids, np.int64)])[0]
+
+    def push_sparse(self, table, ids, grads):
+        self._call(OP_PUSH_SPARSE, table,
+                   [np.asarray(ids, np.int64),
+                    np.asarray(grads, np.float32)])
+
+    def barrier(self):
+        self._call(OP_BARRIER)
+
+    def ping(self) -> bool:
+        try:
+            return int(self._call(OP_PING)[0][0]) == 1
+        except Exception:
+            return False
+
+    def complete(self):
+        # tolerate a server already stopped by a faster trainer's STOP —
+        # completion after shutdown is a no-op, not an error
+        try:
+            self._call(OP_COMPLETE)
+        except (ConnectionError, OSError):
+            pass
+
+    def stop_server(self):
+        try:
+            self._call(OP_STOP)
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class ShardedPsClient:
+    """Round-robin client over multiple pservers: each dense variable
+    lives on endpoint hash(name) % n; sparse tables shard ids by
+    id % n across ALL pservers (distribute_transpiler.py:545
+    slice_variable places param blocks round-robin the same way)."""
+
+    def __init__(self, endpoints: Sequence[str],
+                 clients: Optional[Sequence["PsClient"]] = None):
+        self.clients = list(clients) if clients is not None \
+            else [PsClient(ep) for ep in endpoints]
+
+    def _home(self, name: str) -> PsClient:
+        # crc32, NOT builtin hash(): placement must agree across trainer
+        # processes (hash() is randomized per-process by PYTHONHASHSEED)
+        return self.clients[zlib.crc32(name.encode())
+                            % len(self.clients)]
+
+    def init_param(self, name, value):
+        self._home(name).init_param(name, value)
+
+    def send_grad(self, name, grad):
+        self._home(name).send_grad(name, grad)
+
+    def send_delta(self, name, delta):
+        self._home(name).send_delta(name, delta)
+
+    def send_grad_sync(self, name, grad):
+        self._home(name).send_grad_sync(name, grad)
+
+    def get_param(self, name):
+        return self._home(name).get_param(name)
+
+    def create_sparse_table(self, cfg):
+        for c in self.clients:
+            c.create_sparse_table(cfg)
+
+    def pull_sparse(self, table, ids):
+        ids = np.asarray(ids, np.int64)
+        n = len(self.clients)
+        flat = ids.reshape(-1)
+        out = None
+        for i, c in enumerate(self.clients):
+            sel = np.nonzero(flat % n == i)[0]
+            if sel.size == 0:
+                continue
+            part = c.pull_sparse(table, flat[sel])
+            if out is None:
+                out = np.zeros((flat.size, part.shape[-1]), part.dtype)
+            out[sel] = part
+        if out is None:
+            return np.zeros((0, 1), np.float32)
+        return out.reshape(ids.shape + (out.shape[-1],))
+
+    def push_sparse(self, table, ids, grads):
+        ids = np.asarray(ids, np.int64)
+        flat = ids.reshape(-1)
+        g = np.asarray(grads, np.float32).reshape(flat.size, -1)
+        n = len(self.clients)
+        for i, c in enumerate(self.clients):
+            sel = np.nonzero(flat % n == i)[0]
+            if sel.size:
+                c.push_sparse(table, flat[sel], g[sel])
+
+    def barrier(self):
+        for c in self.clients:
+            c.barrier()
+
+    def complete(self):
+        for c in self.clients:
+            c.complete()
+
+    def stop_server(self):
+        for c in self.clients:
+            try:
+                c.stop_server()
+            except Exception:
+                pass
+
+    def close(self):
+        for c in self.clients:
+            c.close()

@@ -29,6 +29,11 @@ _DEFS: Dict[str, Any] = {
     "FLAGS_benchmark": False,
     "FLAGS_paddle_num_threads": 1,
     "FLAGS_max_inplace_grad_add": 0,
+    # kernels: if the Pallas flash-attention call raises, fall back to
+    # the composed path (True) or propagate the error (False). Default
+    # False so a broken kernel can never silently ship — the round-2
+    # bench measured the fallback without anyone noticing.
+    "FLAGS_flash_attention_fallback": False,
     # collectives — inert (XLA combiner thresholds are compiler flags)
     "FLAGS_fuse_parameter_memory_size": -1,
     "FLAGS_fuse_parameter_groups_size": 3,

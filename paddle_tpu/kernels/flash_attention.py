@@ -48,7 +48,12 @@ def _use_interpret() -> bool:
 # reference (composed) implementation — CPU path and test oracle
 # ---------------------------------------------------------------------------
 
-def attention_reference(q, k, v, bias=None, causal=False, sm_scale=None):
+def attention_reference(q, k, v, bias=None, causal=False, sm_scale=None,
+                        keep_mask=None, keep_prob=1.0):
+    """Composed oracle/fallback. keep_mask (1=keep) applies
+    attention-probs dropout with the kernel's exact semantics: the
+    softmax denominator stays undropped; only the value accumulation is
+    masked and rescaled by 1/keep_prob."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -67,6 +72,8 @@ def attention_reference(q, k, v, bias=None, causal=False, sm_scale=None):
         sq, sk = scores.shape[-2], scores.shape[-1]
         visible = (jnp.arange(sq) + (sk - sq)) >= 0
         probs = probs * visible[:, None].astype(probs.dtype)
+    if keep_mask is not None:
+        probs = probs * keep_mask.astype(probs.dtype) * (1.0 / keep_prob)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)
                       ).astype(q.dtype)
 
@@ -329,8 +336,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, drop_ref, do_ref, lse_ref,
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v, bias, o, lse = res
+def _bwd(causal, sm_scale, block_q, block_k, interpret, keep_prob, res, g):
+    q, k, v, bias, drop_mask, o, lse = res
     do = g
     batch, heads, sq, d = q.shape
     sk = k.shape[2]
@@ -350,19 +357,25 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     # ---- dQ: grid over q blocks
     in_specs = [qspec, kfull, kfull, qspec, lse_blk, lse_blk]
     args = [q, k, v, do, lse4, delta4]
+    if drop_mask is not None:
+        in_specs.insert(3, pl.BlockSpec((1, 1, blk_q, sk),
+                                        lambda b, h, i: (b, h, i, 0)))
+        args.insert(3, drop_mask)
     if bias is not None:
         in_specs.insert(3, _bias_spec(bias, batch, heads, blk_q, sk))
         args.insert(3, bias)
 
     def dq_kern(*refs):
-        if bias is not None:
-            q_r, k_r, v_r, b_r, do_r, lse_r, dl_r, dq_r = refs
-        else:
-            q_r, k_r, v_r, do_r, lse_r, dl_r, dq_r = refs
-            b_r = None
-        _bwd_dq_kernel(q_r, k_r, v_r, b_r, do_r, lse_r, dl_r, dq_r,
+        refs = list(refs)
+        q_r, k_r, v_r = refs[:3]
+        rest = refs[3:]
+        b_r = rest.pop(0) if bias is not None else None
+        dm_r = rest.pop(0) if drop_mask is not None else None
+        do_r, lse_r, dl_r, dq_r = rest
+        _bwd_dq_kernel(q_r, k_r, v_r, b_r, dm_r, do_r, lse_r, dl_r, dq_r,
                        sm_scale=sm_scale, causal=causal,
-                       block_k=blk_k, sk=sk, sq_total=sq)
+                       block_k=blk_k, sk=sk, sq_total=sq,
+                       keep_prob=keep_prob)
 
     dq = pl.pallas_call(
         dq_kern,
@@ -376,6 +389,10 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     # ---- dK/dV: grid over k blocks
     in_specs2 = [qfull, kspec, kspec, qfull, lse_full, lse_full]
     args2 = [q, k, v, do, lse4, delta4]
+    if drop_mask is not None:
+        in_specs2.insert(3, pl.BlockSpec((1, 1, sq, blk_k),
+                                         lambda b, h, i: (b, h, 0, i)))
+        args2.insert(3, drop_mask)
     if bias is not None:
         bshape = bias.shape
 
@@ -388,14 +405,16 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
         args2.insert(3, bias)
 
     def dkv_kern(*refs):
-        if bias is not None:
-            q_r, k_r, v_r, b_r, do_r, lse_r, dl_r, dk_r, dv_r = refs
-        else:
-            q_r, k_r, v_r, do_r, lse_r, dl_r, dk_r, dv_r = refs
-            b_r = None
-        _bwd_dkv_kernel(q_r, k_r, v_r, b_r, do_r, lse_r, dl_r, dk_r, dv_r,
+        refs = list(refs)
+        q_r, k_r, v_r = refs[:3]
+        rest = refs[3:]
+        b_r = rest.pop(0) if bias is not None else None
+        dm_r = rest.pop(0) if drop_mask is not None else None
+        do_r, lse_r, dl_r, dk_r, dv_r = rest
+        _bwd_dkv_kernel(q_r, k_r, v_r, b_r, dm_r, do_r, lse_r, dl_r,
+                        dk_r, dv_r,
                         sm_scale=sm_scale, causal=causal, block_q=blk_q,
-                        sq=sq, sk_total=sk)
+                        sq=sq, sk_total=sk, keep_prob=keep_prob)
 
     dk, dv = pl.pallas_call(
         dkv_kern,
@@ -438,6 +457,10 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
                 s = jnp.where(rows >= cols, s, NEG_INF)
             p = jnp.exp(s - lses[..., None])
             dp = jnp.einsum("bhqd,bhkd->bhqk", dos, vf)
+            if drop_mask is not None:
+                dmsl = jax.lax.dynamic_slice_in_dim(
+                    drop_mask, qi * blk_q, blk_q, 2)
+                dp = dp * dmsl.astype(jnp.float32) * (1.0 / keep_prob)
             ds = p * (dp - deltas[..., None])
             # reduce all broadcast axes except q (axis 2) now
             red_now = tuple(a for a in reduce_axes if a != 2)
@@ -468,39 +491,66 @@ def _supported(q, k, sq, sk, d, blk_q, blk_k):
             d % 8 == 0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, bias, drop_mask, causal, sm_scale, block_q, block_k,
+           interpret, keep_prob):
+    o, _ = _fwd(q, k, v, bias, drop_mask, causal, sm_scale, block_q,
+                block_k, interpret, keep_prob)
     return o
 
 
-def _flash_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, bias, causal, sm_scale, block_q, block_k,
-                  interpret)
-    return o, (q, k, v, bias, o, lse)
+def _flash_fwd(q, k, v, bias, drop_mask, causal, sm_scale, block_q, block_k,
+               interpret, keep_prob):
+    o, lse = _fwd(q, k, v, bias, drop_mask, causal, sm_scale, block_q,
+                  block_k, interpret, keep_prob)
+    return o, (q, k, v, bias, drop_mask, o, lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, keep_prob,
+               res, g):
     dq, dk, dv, dbias = _bwd(causal, sm_scale, block_q, block_k, interpret,
-                             res, g)
-    return dq, dk, dv, dbias
+                             keep_prob, res, g)
+    drop_mask = res[4]
+    ddrop = None if drop_mask is None else jnp.zeros_like(drop_mask)
+    return dq, dk, dv, dbias, ddrop
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def dropout_keep_mask(rng, dropout_rate, shape, dtype):
+    """Precompute a keep-mask (1=keep, 0=drop) for attention-probs dropout.
+
+    Held in q's dtype so the HBM cost at bf16 is Sq*Sk*2 bytes per (b,h) —
+    the flash kernel still never materializes the score matrix itself.
+    """
+    keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, shape)
+    return keep.astype(dtype)
+
+
 def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
                     causal: bool = False, sm_scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128):
+                    block_q: int = 128, block_k: int = 128,
+                    dropout_rate: float = 0.0,
+                    dropout_rng: Optional[jax.Array] = None):
     """Fused attention. q,k,v: [B,H,S,D]; bias broadcastable to
-    [B,H,Sq,Sk]. Falls back to the composed XLA path for unsupported
-    shapes."""
+    [B,H,Sq,Sk]. Attention-probs dropout (matching the reference's
+    attn_dropout in multihead_matmul / transformer layers) is applied
+    inside the kernel from a precomputed keep-mask when dropout_rate>0
+    and dropout_rng is given. Falls back to the composed XLA path for
+    unsupported shapes."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     batch, heads, sq, d = q.shape
     sk = k.shape[2]
+    want_drop = dropout_rate > 0.0 and dropout_rng is not None
+    keep_prob = 1.0 - dropout_rate if want_drop else 1.0
     if not _supported(q, k, sq, sk, d, block_q, block_k):
-        return attention_reference(q, k, v, bias, causal, sm_scale)
+        keep = dropout_keep_mask(dropout_rng, dropout_rate,
+                                 (batch, heads, sq, sk), jnp.float32) \
+            if want_drop else None
+        return attention_reference(q, k, v, bias, causal, sm_scale,
+                                   keep_mask=keep, keep_prob=keep_prob)
     if bias is not None:
         # normalize bias to 4d
         while bias.ndim < 4:
@@ -512,5 +562,9 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
             # fallback's score matrix, but keeps the flash kernel)
             bias = jnp.broadcast_to(
                 bias, bias.shape[:3] + (sk,))
-    return _flash(q, k, v, bias, causal, sm_scale, block_q, block_k,
-                  _use_interpret())
+    drop_mask = None
+    if want_drop:
+        drop_mask = dropout_keep_mask(
+            dropout_rng, dropout_rate, (batch, heads, sq, sk), q.dtype)
+    return _flash(q, k, v, bias, drop_mask, causal, sm_scale, block_q,
+                  block_k, _use_interpret(), keep_prob)

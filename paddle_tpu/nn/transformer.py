@@ -28,7 +28,7 @@ def set_flash_attention(enabled: bool):
     _USE_FLASH = enabled
 
 
-_FLASH_MIN_SEQ = 512
+_FLASH_MIN_SEQ = 256
 
 
 def _attention_core(q, k, v, attn_mask, dropout_p, training, is_causal=False):
@@ -44,26 +44,41 @@ def _attention_core(q, k, v, attn_mask, dropout_p, training, is_causal=False):
     score tile fits HBM traffic easily and XLA's batched matmuls amortize
     the chip's fixed per-matmul cost better than many small Pallas
     programs. The Pallas flash kernel takes over at long S where the
-    O(S^2) score matrix must stay out of HBM (it does not implement
-    attention-probs dropout; the composed path is used whenever dropout
-    is active in training)."""
+    O(S^2) score matrix must stay out of HBM. Attention-probs dropout
+    runs inside the kernel from a precomputed keep-mask, so the flash
+    path covers real training configs (BERT's default
+    attention_probs_dropout_prob=0.1 included).
+
+    A kernel error propagates by default; set
+    FLAGS_flash_attention_fallback=True to instead log once and use the
+    composed path (never silent — see round-2 postmortem)."""
     import jax
     import jax.numpy as jnp
     scale = 1.0 / math.sqrt(q.shape[-1])
     want_dropout = bool(dropout_p) and training
     if _USE_FLASH and jax.default_backend() == "tpu" and \
-            q.shape[1] >= _FLASH_MIN_SEQ and q.shape[-1] in (64, 128, 256) \
-            and not want_dropout:
+            q.shape[1] >= _FLASH_MIN_SEQ and q.shape[-1] in (64, 128, 256):
         try:
             from ..kernels.flash_attention import flash_attention
+            rng = tape._state.next_key() if want_dropout else None
             out = flash_attention(
                 jnp.transpose(q, (0, 2, 1, 3)),
                 jnp.transpose(k, (0, 2, 1, 3)),
                 jnp.transpose(v, (0, 2, 1, 3)),
-                bias=attn_mask, causal=is_causal, sm_scale=scale)
+                bias=attn_mask, causal=is_causal, sm_scale=scale,
+                dropout_rate=float(dropout_p) if want_dropout else 0.0,
+                dropout_rng=rng)
             return jnp.transpose(out, (0, 2, 1, 3))
         except Exception:
-            pass  # fall through to the composed path
+            from .. import flags as _flags
+            if not _flags.get_flag("FLAGS_flash_attention_fallback",
+                                   False):
+                raise
+            import logging
+            logging.getLogger("paddle_tpu").warning(
+                "flash_attention failed; composed-attention fallback "
+                "is active (FLAGS_flash_attention_fallback=True)",
+                exc_info=True)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if attn_mask is not None:
         scores = scores + attn_mask

@@ -13,6 +13,7 @@ from . import (  # noqa: F401
     beam,
     controlflow,
     detection,
+    distributed_ps,
     elementwise,
     fused,
     loss_extra,

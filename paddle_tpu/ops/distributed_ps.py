@@ -1,0 +1,210 @@
+"""Parameter-server ops: send / recv / barriers / listen_and_serv /
+distributed_lookup_table — host ops running RPC against pserver
+processes.
+
+Analog of the reference's distributed op set
+(/root/reference/paddle/fluid/operators/distributed_ops/send_op.cc,
+recv_op.cc, send_barrier_op.cc, fetch_barrier_op.cc,
+listen_and_serv_op.cc, distributed_lookup_table_op.cc,
+fake_init_op.cc). These are the ops the DistributeTranspiler inserts;
+the executor runs them on the host between jit segments
+(core/executor.py:_compile_segmented), with the transport provided by
+distributed/rpc.py instead of gRPC.
+
+Clients are cached per endpoint-set — the analog of the reference's
+RPCClient::GetInstance channel cache (grpc_client.cc)."""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ..core.registry import register_op
+
+_CLIENTS: Dict[Tuple[str, ...], object] = {}
+_EP_CLIENTS: Dict[str, object] = {}
+
+
+def get_ps_client(endpoints):
+    """Shared ShardedPsClient for an endpoint list — built over the
+    per-endpoint channel cache so each pserver gets exactly ONE
+    connection per process (grpc_client.cc GetChannel)."""
+    key = tuple(endpoints)
+    cli = _CLIENTS.get(key)
+    if cli is None:
+        from ..distributed.rpc import ShardedPsClient
+        cli = _CLIENTS[key] = ShardedPsClient(
+            list(endpoints),
+            clients=[get_endpoint_client(ep) for ep in endpoints])
+    return cli
+
+
+def get_endpoint_client(endpoint: str):
+    """Per-endpoint PsClient (one channel per pserver, grpc_client.cc
+    GetChannel)."""
+    cli = _EP_CLIENTS.get(endpoint)
+    if cli is None:
+        from ..distributed.rpc import PsClient
+        cli = _EP_CLIENTS[endpoint] = PsClient(endpoint)
+    return cli
+
+
+def reset_ps_clients():
+    for c in list(_CLIENTS.values()) + list(_EP_CLIENTS.values()):
+        try:
+            c.close()
+        except Exception:
+            pass
+    _CLIENTS.clear()
+    _EP_CLIENTS.clear()
+
+
+@register_op("send", inputs=("X",), outputs=(), no_grad=True, host=True)
+def _send(ctx, ins, attrs):
+    """Push grads (or Geo deltas) to their pservers (send_op.cc:38).
+
+    attrs: endpoints, var_names (parallel to X), is_delta, sync_mode;
+    optional `blocks` = {var: [[block_name, endpoint, start, rows]]}
+    from the transpiler's slice_variable — each slice goes to its
+    assigned pserver; without blocks, hash placement of whole vars."""
+    names = attrs["var_names"]
+    is_delta = bool(attrs.get("is_delta", False))
+    sync = bool(attrs.get("sync_mode", False))
+    blocks = attrs.get("blocks")
+
+    def push(cli, bname, val):
+        if is_delta:
+            cli.send_delta(bname, val)
+        elif sync:
+            cli.send_grad_sync(bname, val)
+        else:
+            cli.send_grad(bname, val)
+
+    for name, val in zip(names, ins.get("X", [])):
+        v = np.asarray(val, np.float32)
+        if blocks and name in blocks:
+            for bname, ep, start, rows in blocks[name]:
+                push(get_endpoint_client(ep),
+                     bname, v.reshape(v.shape[0], -1)[start:start + rows]
+                     if v.ndim > 1 else v[start:start + rows])
+        else:
+            push(get_ps_client(attrs["endpoints"]), name, v)
+    return {}
+
+
+@register_op("recv", inputs=(), outputs=("Out",), no_grad=True, host=True)
+def _recv(ctx, ins, attrs):
+    """Pull fresh params from their pservers (recv_op.cc:129).
+    attrs: endpoints, var_names (parallel to Out); optional blocks +
+    shapes for sliced vars (concat along axis 0 of the 2d view)."""
+    blocks = attrs.get("blocks")
+    shapes = attrs.get("shapes") or {}
+    outs = []
+    for n in attrs["var_names"]:
+        if blocks and n in blocks:
+            parts = [get_endpoint_client(ep).get_param(bname)
+                     for bname, ep, start, rows in blocks[n]]
+            full = np.concatenate(parts, axis=0)
+            if n in shapes:
+                full = full.reshape(shapes[n])
+            outs.append(full)
+        else:
+            outs.append(get_ps_client(attrs["endpoints"]).get_param(n))
+    return {"Out": outs}
+
+
+@register_op("send_barrier", inputs=(), outputs=(), no_grad=True,
+             host=True)
+def _send_barrier(ctx, ins, attrs):
+    """Sync-mode barrier after sends (send_barrier_op.cc:40)."""
+    get_ps_client(attrs["endpoints"]).barrier()
+    return {}
+
+
+@register_op("fetch_barrier", inputs=(), outputs=(), no_grad=True,
+             host=True)
+def _fetch_barrier(ctx, ins, attrs):
+    """Sync-mode barrier before recvs (fetch_barrier_op.cc:40)."""
+    get_ps_client(attrs["endpoints"]).barrier()
+    return {}
+
+
+@register_op("distributed_lookup_table", inputs=("Ids",),
+             outputs=("Outputs",), no_grad=True, host=True)
+def _distributed_lookup_table(ctx, ins, attrs):
+    """Sparse pull: rows for Ids from the sharded remote table
+    (distributed_lookup_table_op.cc:39). attrs: endpoints,
+    table_name."""
+    cli = get_ps_client(attrs["endpoints"])
+    table = attrs["table_name"]
+    return {"Outputs": [np.asarray(cli.pull_sparse(table, ids),
+                                   np.float32)
+                        for ids in ins.get("Ids", [])]}
+
+
+@register_op("distributed_push_sparse", inputs=("Ids", "Grads"),
+             outputs=(), no_grad=True, host=True)
+def _distributed_push_sparse(ctx, ins, attrs):
+    """Sparse push of per-row grads (the send path of the sparse grad,
+    send_op.cc handling SelectedRows)."""
+    cli = get_ps_client(attrs["endpoints"])
+    table = attrs["table_name"]
+    for ids, g in zip(ins.get("Ids", []), ins.get("Grads", [])):
+        cli.push_sparse(table, ids, g)
+    return {}
+
+
+@register_op("fake_init", inputs=(), outputs=("Out",), no_grad=True,
+             host=True)
+def _fake_init(ctx, ins, attrs):
+    """Placeholder init for vars whose real storage lives on the pserver
+    (fake_init_op.cc:40) — trainer-side shape-only zeros."""
+    shape = attrs.get("shape", [1])
+    return {"Out": [np.zeros(shape, np.float32)]}
+
+
+@register_op("listen_and_serv", inputs=("X",), outputs=(), no_grad=True,
+             host=True)
+def _listen_and_serv(ctx, ins, attrs):
+    """Run the pserver loop: host the dense/sparse tables at `endpoint`,
+    apply per-grad optimize rules on arrival/at the sync barrier, block
+    until a trainer sends STOP (listen_and_serv_op.cc:330 RunSyncLoop /
+    RunAsyncLoop).
+
+    inputs X: initial values of this server's params (produced by the
+    startup-init ops the transpiler folds into the pserver program,
+    parallel to attrs["var_names"]) — each is sliced into its row
+    blocks per attrs["param_blocks"] and hosted under the block names.
+
+    attrs:
+      endpoint: "host:port" to bind
+      n_trainers: barrier party count
+      lr: server-side SGD rate for dense grads
+      var_names: names parallel to X
+      param_blocks: {param: [[block_name, start_row, rows]]}
+      dense_params: {name: initial value} — direct-init alternative
+      sparse_tables: [SparseTableConfig-dicts]
+    """
+    from ..distributed.communicator import ParamServer
+    from ..distributed.large_scale_kv import SparseTableConfig
+    from ..distributed.rpc import PsServer
+
+    ps = ParamServer(lr=float(attrs.get("lr", 0.01)))
+    pblocks = attrs.get("param_blocks") or {}
+    for name, val in zip(attrs.get("var_names", []), ins.get("X", [])):
+        v = np.asarray(val, np.float32)
+        v2 = v.reshape(v.shape[0], -1) if v.ndim > 1 else v
+        for bname, start, rows in pblocks.get(
+                name, [[name + ".block0", 0, v2.shape[0]]]):
+            ps.init_param(bname, v2[start:start + rows])
+    for name, val in (attrs.get("dense_params") or {}).items():
+        ps.init_param(name, np.asarray(val, np.float32))
+    for cfg in (attrs.get("sparse_tables") or []):
+        ps.create_sparse_table(SparseTableConfig(**cfg))
+    srv = PsServer(ps, endpoint=attrs["endpoint"],
+                   n_trainers=int(attrs.get("n_trainers", 1)))
+    srv.start()
+    # publish for tests / introspection, then block like the reference
+    attrs["_server"] = srv
+    srv._thread.join()
+    return {}

@@ -1,0 +1,7 @@
+"""Program transpilers (distributed rewrites of the Program IR)."""
+from .distribute_transpiler import (DistributeTranspiler,
+                                    DistributeTranspilerConfig,
+                                    slice_variable)
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "slice_variable"]

@@ -1,0 +1,109 @@
+"""Subprocess runner for parameter-server transport tests.
+
+Roles (argv[1]):
+  local                — plain single-process SGD training, full batch
+  trainer <id>         — transpiled trainer program over the RPC
+                         transport (half batch per trainer)
+  pserver <endpoint>   — transpiled pserver program (blocks until STOP)
+
+Env: PS_ENDPOINTS (comma list), PS_TRAINERS (int), PS_STEPS, PS_SEED.
+Mirrors the reference's test_dist_base.py:594 discipline: both runs
+print "LOSSES [...]" for per-step parity checks — sync PS averages the
+two trainers' half-batch grads, which equals the local full-batch grad.
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import layers  # noqa: E402
+
+SEED = int(os.environ.get("PS_SEED", "7"))
+STEPS = int(os.environ.get("PS_STEPS", "5"))
+LR = 0.1
+B = 8  # per-trainer batch
+
+
+def build():
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = SEED
+    startup.random_seed = SEED
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        h = layers.fc(x, 16, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.SGD(LR).minimize(loss, startup_program=startup,
+                                      program=main)
+    return main, startup, loss
+
+
+def batch(step, lo, hi):
+    rng = np.random.RandomState(1234 + step)
+    x = rng.randn(2 * B, 4).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) * 0.5 + 0.1).astype(np.float32)
+    return {"x": x[lo:hi], "y": y[lo:hi]}
+
+
+def main():
+    role = sys.argv[1]
+    main_prog, startup, loss = build()
+
+    if role == "local":
+        exe = pt.Executor()
+        exe.run(startup)
+        losses = []
+        for s in range(STEPS):
+            out, = exe.run(main_prog, feed=batch(s, 0, 2 * B),
+                           fetch_list=[loss])
+            losses.append(float(out))
+        print("LOSSES " + json.dumps(losses), flush=True)
+        return
+
+    from paddle_tpu.transpiler import DistributeTranspiler
+    eps = os.environ["PS_ENDPOINTS"]
+    trainers = int(os.environ.get("PS_TRAINERS", "2"))
+
+    if role == "pserver":
+        endpoint = sys.argv[2]
+        t = DistributeTranspiler()
+        t.transpile(0, program=main_prog, pservers=eps, trainers=trainers,
+                    startup_program=startup)
+        pprog = t.get_pserver_program(endpoint)
+        print("PSERVER READY " + endpoint, flush=True)
+        pt.Executor().run(pprog)  # blocks until a trainer sends STOP
+        return
+
+    if role == "trainer":
+        tid = int(sys.argv[2])
+        t = DistributeTranspiler()
+        t.transpile(tid, program=main_prog, pservers=eps,
+                    trainers=trainers, startup_program=startup)
+        tprog = t.get_trainer_program()
+        exe = pt.Executor()
+        exe.run(startup)
+        losses = []
+        for s in range(STEPS):
+            out, = exe.run(tprog, feed=batch(s, tid * B, (tid + 1) * B),
+                           fetch_list=[loss])
+            losses.append(float(out))
+        print("LOSSES " + json.dumps(losses), flush=True)
+        from paddle_tpu.ops.distributed_ps import get_ps_client
+        cli = get_ps_client([e.strip() for e in eps.split(",")])
+        cli.complete()
+        if tid == 0:
+            cli.stop_server()
+        return
+
+    raise SystemExit("unknown role " + role)
+
+
+if __name__ == "__main__":
+    main()
