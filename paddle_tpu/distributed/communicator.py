@@ -83,8 +83,12 @@ class ParamServer:
             return self._dense[name].copy()
 
     def create_sparse_table(self, cfg: SparseTableConfig):
-        self.sparse[cfg.name] = LargeScaleKV(cfg)
-        return self.sparse[cfg.name]
+        # idempotent + locked: concurrent trainers racing their creates
+        # must never replace a live table (and lose its rows/slots)
+        with self._lock:
+            if cfg.name not in self.sparse:
+                self.sparse[cfg.name] = LargeScaleKV(cfg)
+            return self.sparse[cfg.name]
 
     def pull_sparse(self, table: str, ids):
         return self.sparse[table].pull(ids)

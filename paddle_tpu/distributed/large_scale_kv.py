@@ -44,18 +44,24 @@ class LargeScaleKV:
         self._rows: Dict[int, np.ndarray] = {}
         self._slots: Dict[str, Dict[int, np.ndarray]] = {}
         self._beta_pow: Dict[int, np.ndarray] = {}
-        self._rng = np.random.RandomState(config.seed)
         self._lock = threading.Lock()
 
     # --- row init on first touch (large_scale_kv.h Initializer impls) ---
-    def _new_row(self) -> np.ndarray:
+    def _new_row(self, row_id: int = 0) -> np.ndarray:
         c = self.cfg
-        if c.initializer == "gaussian":
-            return self._rng.normal(0.0, c.init_scale,
-                                    c.dim).astype(np.float32)
-        if c.initializer == "uniform":
-            return self._rng.uniform(-c.init_scale, c.init_scale,
-                                     c.dim).astype(np.float32)
+        # per-id deterministic init (seed ^ id), NOT a sequential rng:
+        # the value of row i must not depend on which ids were pulled
+        # before it, so replicas/restarts/local-vs-remote tables agree —
+        # the property the reference gets from initializing rows on one
+        # pserver authority
+        if c.initializer in ("gaussian", "uniform"):
+            rng = np.random.RandomState(
+                (c.seed * 2654435761 + row_id * 40503) & 0x7fffffff)
+            if c.initializer == "gaussian":
+                return rng.normal(0.0, c.init_scale,
+                                  c.dim).astype(np.float32)
+            return rng.uniform(-c.init_scale, c.init_scale,
+                               c.dim).astype(np.float32)
         return np.full(c.dim, c.fill_value, np.float32)
 
     # --- pull / push ------------------------------------------------------
@@ -67,7 +73,7 @@ class LargeScaleKV:
             for i, r in enumerate(ids):
                 row = self._rows.get(int(r))
                 if row is None:
-                    row = self._new_row()
+                    row = self._new_row(int(r))
                     self._rows[int(r)] = row
                 out[i] = row
         return out
@@ -89,7 +95,7 @@ class LargeScaleKV:
                 r = int(r)
                 row = self._rows.get(r)
                 if row is None:
-                    row = self._new_row()
+                    row = self._new_row(r)
                 g = merged[i]
                 if opt == "sgd":
                     row = row - lr * g

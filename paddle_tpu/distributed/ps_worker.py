@@ -20,23 +20,27 @@ from .large_scale_kv import LargeScaleKV
 
 
 class DownpourWorker:
+    """Works against anything with the ParamServer pull/push surface —
+    the in-process ParamServer OR a PsClient/ShardedPsClient over the
+    RPC transport (distributed/rpc.py): the worker loop is transport-
+    agnostic exactly like the reference's FleetWrapper, which talks to
+    local or remote tables through one pslib interface."""
+
     def __init__(self, server: ParamServer, table: str):
         self.server = server
         self.table = table
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
         """[B, T] ids -> [B, T, dim] rows (dense input for the step)."""
-        kv = self.server.sparse[self.table]
         flat = np.asarray(ids).reshape(-1)
-        rows = kv.pull(flat)
-        return rows.reshape(np.asarray(ids).shape + (kv.cfg.dim,))
+        rows = np.asarray(self.server.pull_sparse(self.table, flat))
+        return rows.reshape(np.asarray(ids).shape + (rows.shape[-1],))
 
     def push(self, ids: np.ndarray, row_grads: np.ndarray):
         """[B, T] ids + [B, T, dim] grads -> sparse optimizer update."""
-        kv = self.server.sparse[self.table]
         flat_ids = np.asarray(ids).reshape(-1)
         flat_g = np.asarray(row_grads).reshape(len(flat_ids), -1)
-        kv.push(flat_ids, flat_g)
+        self.server.push_sparse(self.table, flat_ids, flat_g)
 
     def train_batch(self, ids: np.ndarray, step_fn: Callable, *args):
         """step_fn(rows, *args) -> (loss, row_grads). Returns loss."""

@@ -259,9 +259,8 @@ class PsServer:
                 import json
                 from .large_scale_kv import SparseTableConfig
                 cfg_dict = json.loads(bytes(arrays[0].tobytes()).decode())
-                if name not in self.ps.sparse:
-                    self.ps.create_sparse_table(
-                        SparseTableConfig(**cfg_dict))
+                # create_sparse_table is itself locked + idempotent
+                self.ps.create_sparse_table(SparseTableConfig(**cfg_dict))
                 return encode_reply()
             if op == OP_PULL_SPARSE:
                 return encode_reply(

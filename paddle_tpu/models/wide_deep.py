@@ -45,6 +45,12 @@ class WideDeep(nn.Layer):
     def forward(self, sparse_ids, dense_features):
         """sparse_ids: int [B, num_slots]; dense_features: [B, dense_dim]."""
         emb = self.embedding(sparse_ids)  # [B, slots, dim]
+        return self.forward_from_rows(emb, dense_features)
+
+    def forward_from_rows(self, emb, dense_features):
+        """PS/heter path: embedding rows already pulled from the
+        parameter server ([B, slots, dim] — the reference's
+        distributed_lookup_table output feeding the local dense net)."""
         b = emb.shape[0]
         emb_flat = emb.reshape([b, -1])
         from ..dygraph import tape

@@ -23,6 +23,8 @@ from ..core.registry import register_op
 
 _CLIENTS: Dict[Tuple[str, ...], object] = {}
 _EP_CLIENTS: Dict[str, object] = {}
+# live PsServers started by listen_and_serv, keyed by endpoint
+_SERVERS: Dict[str, object] = {}
 
 
 def get_ps_client(endpoints):
@@ -204,7 +206,9 @@ def _listen_and_serv(ctx, ins, attrs):
     srv = PsServer(ps, endpoint=attrs["endpoint"],
                    n_trainers=int(attrs.get("n_trainers", 1)))
     srv.start()
-    # publish for tests / introspection, then block like the reference
-    attrs["_server"] = srv
+    # publish for tests/introspection in a module registry — NOT inside
+    # the op's attrs (a live server in the IR would break
+    # Program.clone/serialization) — then block like the reference
+    _SERVERS[srv.endpoint] = srv
     srv._thread.join()
     return {}

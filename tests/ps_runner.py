@@ -68,7 +68,7 @@ def main():
         return
 
     from paddle_tpu.transpiler import DistributeTranspiler
-    eps = os.environ["PS_ENDPOINTS"]
+    eps = os.environ.get("PS_ENDPOINTS", "")
     trainers = int(os.environ.get("PS_TRAINERS", "2"))
 
     if role == "pserver":
@@ -102,7 +102,123 @@ def main():
             cli.stop_server()
         return
 
-    raise SystemExit("unknown role " + role)
+    ctr_main(role)
+
+
+# ---------------------------------------------------------------------------
+# Wide&Deep CTR over the transport (roles: ctr_local, ctr_trainer,
+# ctr_pserver) — VERDICT r2 "Next round" #3's acceptance test
+# ---------------------------------------------------------------------------
+
+CTR_B = 8          # per-trainer batch
+CTR_SLOTS = 4
+CTR_VOCAB = 64
+CTR_DIM = 4
+CTR_DENSE = 6
+
+
+def _ctr_model():
+    from paddle_tpu.dygraph import tape
+    from paddle_tpu.models.wide_deep import WideDeep
+    tape.seed(SEED)
+    return WideDeep(sparse_feature_number=CTR_VOCAB,
+                    sparse_feature_dim=CTR_DIM,
+                    dense_feature_dim=CTR_DENSE,
+                    num_sparse_slots=CTR_SLOTS, fc_sizes=[16])
+
+
+def _ctr_batch(step, lo, hi):
+    rng = np.random.RandomState(99 + step)
+    ids = rng.randint(0, CTR_VOCAB, (2 * CTR_B, CTR_SLOTS))
+    dense = rng.randn(2 * CTR_B, CTR_DENSE).astype(np.float32)
+    y = (dense.sum(1, keepdims=True) > 0).astype(np.float32)
+    return ids[lo:hi], dense[lo:hi], y[lo:hi]
+
+
+def _sparse_cfg():
+    from paddle_tpu.distributed import SparseTableConfig
+    return SparseTableConfig(name="emb", dim=CTR_DIM,
+                             initializer="gaussian", init_scale=0.1,
+                             optimizer="sgd", lr=LR, seed=3)
+
+
+def _ctr_loop(server, n_trainers, tid, sync):
+    """Transport-agnostic Downpour+sync-dense loop. `server` is a
+    ParamServer or a (Sharded)PsClient; `sync()` runs the grad-window
+    barrier."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import DownpourWorker
+    from paddle_tpu.dygraph import tape
+
+    model = _ctr_model()
+    params = {n: p for n, p in model.named_parameters()}
+    pnames = sorted(params)
+    if tid == 0:
+        for n in pnames:
+            server.init_param(n, np.asarray(params[n].value))
+    server.create_sparse_table(_sparse_cfg())
+    worker = DownpourWorker(server, "emb")
+    sync()  # everyone sees init
+
+    losses = []
+    for s in range(STEPS):
+        # recv fresh dense params from the server (the transpiled
+        # recv-op equivalent for the dygraph worker loop)
+        for n in pnames:
+            params[n].set_value(np.asarray(server.get_param(n)))
+        share = 2 * CTR_B // n_trainers
+        ids, dense, y = _ctr_batch(s, tid * share, (tid + 1) * share)
+        rows = worker.pull(ids)
+        sync()  # all pulls done before any push lands
+        rows_t = tape.Tensor(jnp.asarray(rows), stop_gradient=False)
+        logit = model.forward_from_rows(rows_t,
+                                        tape.to_tensor(dense))
+        loss = model.loss(logit, tape.to_tensor(y))
+        loss.backward()
+        worker.push(ids, np.asarray(rows_t.gradient) / n_trainers)
+        for n in pnames:
+            g = np.asarray(params[n].gradient, np.float32)
+            params[n].clear_gradient()
+            if hasattr(server, "send_grad_sync"):
+                server.send_grad_sync(n, g)
+            else:
+                server.accumulate_grad(n, g)
+        sync()  # dense window applies
+        losses.append(float(loss.value))
+    print("LOSSES " + json.dumps(losses), flush=True)
+
+
+def ctr_main(role):
+    eps_env = os.environ.get("PS_ENDPOINTS", "")
+    trainers = int(os.environ.get("PS_TRAINERS", "2"))
+
+    if role == "ctr_local":
+        from paddle_tpu.distributed import ParamServer
+        server = ParamServer(lr=LR)
+        _ctr_loop(server, 1, 0, sync=server.apply_pending)
+        return
+
+    if role == "ctr_pserver":
+        from paddle_tpu.distributed import ParamServer
+        from paddle_tpu.distributed.rpc import PsServer
+        srv = PsServer(ParamServer(lr=LR), endpoint=sys.argv[2],
+                       n_trainers=trainers)
+        print("PSERVER READY " + srv.endpoint, flush=True)
+        srv.serve_forever()
+        return
+
+    if role == "ctr_trainer":
+        tid = int(sys.argv[2])
+        from paddle_tpu.ops.distributed_ps import get_ps_client
+        cli = get_ps_client([e.strip() for e in eps_env.split(",")])
+        _ctr_loop(cli, trainers, tid, sync=cli.barrier)
+        cli.complete()
+        if tid == 0:
+            cli.stop_server()
+        return
+
+    raise SystemExit("unknown ctr role " + role)
 
 
 if __name__ == "__main__":

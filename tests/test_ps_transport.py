@@ -46,6 +46,27 @@ def _losses(out: bytes):
     raise AssertionError("no LOSSES line:\n" + out.decode())
 
 
+def _wait_for_listeners(procs, endpoints, timeout=120.0):
+    """Retry-connect until every pserver listens (fleet-launch style);
+    kill the procs and surface their stderr on timeout."""
+    deadline = time.time() + timeout
+    for ep in endpoints:
+        host, port = ep.rsplit(":", 1)
+        while True:
+            try:
+                socket.create_connection((host, int(port)),
+                                         timeout=1).close()
+                break
+            except OSError:
+                if time.time() > deadline:
+                    for s in procs:
+                        s.kill()
+                    raise AssertionError(
+                        "pserver never listened: "
+                        + procs[0].stderr.read().decode())
+                time.sleep(0.2)
+
+
 # ---------------------------------------------------------------------------
 # wire protocol unit tests (in-process server, real sockets)
 # ---------------------------------------------------------------------------
@@ -142,23 +163,7 @@ def test_ps_training_loss_parity(n_pservers):
         [sys.executable, RUNNER, "pserver", ep], env=env,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE)
         for ep in eps.split(",")]
-    # wait for the listeners (retry-connect like fleet launch does)
-    deadline = time.time() + 120
-    for ep in eps.split(","):
-        host, port = ep.rsplit(":", 1)
-        while True:
-            try:
-                socket.create_connection((host, int(port)),
-                                         timeout=1).close()
-                break
-            except OSError:
-                if time.time() > deadline:
-                    for s in servers:
-                        s.kill()
-                    raise AssertionError(
-                        "pserver never listened: "
-                        + servers[0].stderr.read().decode())
-                time.sleep(0.2)
+    _wait_for_listeners(servers, eps.split(","))
 
     trainers = [subprocess.Popen(
         [sys.executable, RUNNER, "trainer", str(i)], env=env,
@@ -185,3 +190,42 @@ def test_ps_training_loss_parity(n_pservers):
     mean_losses = [(a + b) / 2 for a, b in zip(l0, l1)]
     np.testing.assert_allclose(mean_losses, ref, atol=1e-5, rtol=1e-5)
     assert mean_losses[-1] < mean_losses[0]
+
+
+def test_wide_deep_ctr_over_transport_loss_parity():
+    """BASELINE config 4 acceptance: Wide&Deep CTR, 1 pserver + 2
+    trainer processes (Downpour sparse pull/push + sync dense window)
+    vs single-process local — per-step loss parity."""
+    env = _env({"PS_STEPS": "4"})
+    local = subprocess.run([sys.executable, RUNNER, "ctr_local"],
+                           env=env, capture_output=True, timeout=300)
+    assert local.returncode == 0, local.stderr.decode()
+    ref = _losses(local.stdout)
+
+    ep = "127.0.0.1:%d" % _free_port()
+    env = _env({"PS_ENDPOINTS": ep, "PS_TRAINERS": "2", "PS_STEPS": "4"})
+    server = subprocess.Popen(
+        [sys.executable, RUNNER, "ctr_pserver", ep], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    _wait_for_listeners([server], [ep])
+
+    trainers = [subprocess.Popen(
+        [sys.executable, RUNNER, "ctr_trainer", str(i)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        for i in range(2)]
+    touts = []
+    try:
+        for p in trainers:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, err.decode()
+            touts.append(out)
+        out, err = server.communicate(timeout=60)
+        assert server.returncode == 0, err.decode()
+    finally:
+        for p in trainers + [server]:
+            if p.poll() is None:
+                p.kill()
+
+    l0, l1 = _losses(touts[0]), _losses(touts[1])
+    mean_losses = [(a + b) / 2 for a, b in zip(l0, l1)]
+    np.testing.assert_allclose(mean_losses, ref, atol=1e-5, rtol=1e-5)
