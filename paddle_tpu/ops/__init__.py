@@ -12,10 +12,12 @@ from . import (  # noqa: F401
     amp,
     beam,
     controlflow,
+    ctr_extra,
     detection,
     distributed_ps,
     elementwise,
     fused,
+    io_ops,
     loss_extra,
     rnn,
     vision,

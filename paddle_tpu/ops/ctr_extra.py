@@ -1,0 +1,234 @@
+"""CTR / tree-model ops: tdm_child, tdm_sampler, rank_attention,
+pyramid_hash, tree_conv.
+
+Parity surface:
+- /root/reference/paddle/fluid/operators/tdm_child_op.h:36 (TreeInfo row
+  layout [item_id, layer_id, ancestor_id, child_0..child_{n-1}])
+- /root/reference/paddle/fluid/operators/tdm_sampler_op.h (per-layer
+  negative sampling along the positive path from Travel, layer node
+  pools from Layer + layer_offset_lod)
+- /root/reference/paddle/fluid/operators/rank_attention.cu.h:30
+  (expand input rows and per-(lower,faster) param blocks, then the
+  block matmul)
+- /root/reference/paddle/fluid/operators/pyramid_hash_op.cc (n-gram
+  hash embedding; the hash function here is an original mix — the
+  reference's XXH32 byte-level hash is an implementation detail, the
+  contract is deterministic gram->bucket mapping)
+- /root/reference/paddle/fluid/operators/tree_conv_op.cc (tree-based
+  convolution over BFS patches with triangular position weights)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+
+@register_op("tdm_child", inputs=("X", "TreeInfo"),
+             outputs=("Child", "LeafMask"), no_grad=True)
+def _tdm_child(ctx, ins, attrs):
+    """Children of each input node (tdm_child_op.h:36): node 0 and
+    nodes with child_0 == 0 have no children (all-zero output);
+    LeafMask marks emitted children that are leaves (their own child_0
+    is 0 and they are not padding)."""
+    x = ins["X"][0].astype(jnp.int32)
+    info = ins["TreeInfo"][0].astype(jnp.int32)  # [nodes, 3+child_nums]
+    child_nums = int(attrs.get("child_nums", info.shape[1] - 3))
+    shape = x.shape
+    flat = x.reshape(-1)
+    has_child = (flat != 0) & (info[flat, 3] != 0)
+    children = info[flat][:, 3:3 + child_nums]  # [n, child_nums]
+    children = jnp.where(has_child[:, None], children, 0)
+    is_leaf = (children != 0) & (info[children, 3] == 0)
+    out_shape = tuple(shape) + (child_nums,)
+    return {"Child": [children.reshape(out_shape)],
+            "LeafMask": [is_leaf.astype(jnp.int32).reshape(out_shape)]}
+
+
+@register_op("tdm_sampler", inputs=("X", "Travel", "Layer"),
+             outputs=("Out", "Labels", "Mask"), no_grad=True,
+             is_random=True)
+def _tdm_sampler(ctx, ins, attrs):
+    """Per-layer negative sampling along each input's tree path
+    (tdm_sampler_op.h): for input leaf i and layer l, emit the positive
+    path node Travel[i, l] (when output_positive) plus
+    neg_samples_num_list[l] nodes drawn from layer l's pool excluding
+    the positive. Mask zeroes layers where the path is padding (node
+    0). Exclusion here is shift-by-one-mod (deterministic) rather than
+    the reference's rejection loop — same support, near-identical
+    distribution."""
+    x = ins["X"][0].astype(jnp.int32)
+    travel = ins["Travel"][0].astype(jnp.int32)  # [n_leaf_row?, L]
+    layer_pool = ins["Layer"][0].astype(jnp.int32).reshape(-1)
+    neg_list = [int(v) for v in attrs["neg_samples_num_list"]]
+    offsets = [int(v) for v in attrs["layer_offset_lod"]]
+    out_positive = bool(attrs.get("output_positive", True))
+    n = x.shape[0]
+    paths = travel[x.reshape(-1)]  # [N, L]
+    outs, labels, masks = [], [], []
+    for l, negs in enumerate(neg_list):
+        lo, hi = offsets[l], offsets[l + 1]
+        size = hi - lo
+        pos = paths[:, l]  # [N]
+        valid = pos != 0
+        cols = []
+        lab = []
+        if out_positive:
+            cols.append(pos[:, None])
+            lab.append(jnp.ones((n, 1), jnp.int32))
+        if negs > 0:
+            u = jax.random.randint(ctx.rng(), (n, negs), 0,
+                                   max(size - 1, 1))
+            # positive sits at index pos_idx in the pool; skip it
+            pool_idx = jnp.clip(pos - layer_pool[lo], 0, size - 1)
+            u = jnp.where(u >= pool_idx[:, None], u + 1, u) \
+                % max(size, 1)
+            cols.append(layer_pool[lo + u])
+            lab.append(jnp.zeros((n, negs), jnp.int32))
+        o = jnp.concatenate(cols, axis=1)
+        outs.append(jnp.where(valid[:, None], o, 0))
+        labels.append(jnp.where(valid[:, None],
+                                jnp.concatenate(lab, axis=1), 0))
+        masks.append(jnp.broadcast_to(valid[:, None].astype(jnp.int32),
+                                      o.shape))
+    out = jnp.concatenate(outs, axis=1)
+    return {"Out": [out[..., None]],
+            "Labels": [jnp.concatenate(labels, axis=1)[..., None]],
+            "Mask": [jnp.concatenate(masks, axis=1)[..., None]]}
+
+
+@register_op("rank_attention", inputs=("X", "RankOffset", "RankParam"),
+             outputs=("Out", "InputHelp", "InsRank"),
+             non_diff_inputs=("RankOffset",))
+def _rank_attention(ctx, ins, attrs):
+    """Rank-pair attention (rank_attention.cu.h:30): RankOffset is
+    [N, 1+2*MaxRank] holding the 1-based ins rank then (faster_rank,
+    ins_index) pairs; the param bank RankParam is
+    [MaxRank*MaxRank*input_col, param_col] of per-(lower,faster)
+    blocks. out[i] = sum_k X[index_ik] @ P[lower_i*MaxRank+faster_ik]
+    over valid pairs."""
+    x = ins["X"][0]
+    ro = ins["RankOffset"][0].astype(jnp.int32)
+    param = ins["RankParam"][0]
+    max_rank = int(attrs.get("MaxRank", (ro.shape[1] - 1) // 2))
+    n, d = x.shape
+    pcol = param.shape[1]
+    blocks = param.reshape(max_rank * max_rank, d, pcol)
+    lower = ro[:, 0] - 1  # [N]
+    out = jnp.zeros((n, pcol), x.dtype)
+    help_cols = []
+    for k in range(max_rank):
+        faster = ro[:, 2 * k + 1] - 1
+        index = ro[:, 2 * k + 2]
+        valid = (lower >= 0) & (faster >= 0)
+        xk = jnp.where(valid[:, None], x[index], 0)  # [N, D]
+        help_cols.append(xk)
+        bidx = jnp.clip(lower * max_rank + faster, 0,
+                        max_rank * max_rank - 1)
+        pk = blocks[bidx]  # [N, D, pcol]
+        out = out + jnp.einsum("nd,ndp->np", xk, pk)
+    ins_rank = jnp.where(lower >= 0, ro[:, 0], -1).astype(x.dtype)
+    return {"Out": [out],
+            "InputHelp": [jnp.concatenate(help_cols, axis=1)],
+            "InsRank": [ins_rank[:, None]]}
+
+
+def _mix_hash(gram, space):
+    """Deterministic gram -> bucket mix (pyramid_hash's XXH32 analog)."""
+    h = jnp.zeros(gram.shape[:-1], jnp.uint32)
+    for i in range(gram.shape[-1]):
+        h = (h ^ gram[..., i].astype(jnp.uint32)) * jnp.uint32(2654435761)
+        h = h ^ (h >> 13)
+    return (h % jnp.uint32(space)).astype(jnp.int32)
+
+
+@register_op("pyramid_hash", inputs=("X", "W", "SeqLen"),
+             outputs=("Out", "DropPos", "X_Temp_Out"),
+             non_diff_inputs=("X", "SeqLen"), is_random=True)
+def _pyramid_hash(ctx, ins, attrs):
+    """N-gram hash embedding (pyramid_hash_op.cc): for each n-gram size
+    2..pyramid_layer, hash each window of token ids into `space_len`
+    buckets and gather `rand_len`-wide slices of W, summing all grams
+    that cover a token. Padded repr: X [B, T] ids + SeqLen. num_emb
+    output dims are filled by num_emb/rand_len consecutive hash draws
+    (bucket+j), matching the reference's multi-slot fill."""
+    x = ins["X"][0].astype(jnp.int32)
+    # W layout: [space_len(+1), rand_len] — each bucket owns one
+    # rand_len-wide row (the reference's flat [space+rand_len] table
+    # with overlapping slices trades that for memory; a row table is
+    # the gather-friendly layout on TPU)
+    w = ins["W"][0]
+    if w.ndim == 1:
+        w = w[:, None]
+    num_emb = int(attrs.get("num_emb", 16))
+    rand_len = int(attrs.get("rand_len", w.shape[1]))
+    space = int(attrs.get("space_len", w.shape[0] - 1))
+    layers = int(attrs.get("pyramid_layer", 2))
+    b, t = x.shape
+    if ins.get("SeqLen"):
+        lens = ins["SeqLen"][0].astype(jnp.int32)
+    else:
+        lens = jnp.full((b,), t, jnp.int32)
+    slots = num_emb // rand_len
+    acc = jnp.zeros((b, t, num_emb), w.dtype)
+    for n in range(2, layers + 1):
+        if t < n:
+            break
+        grams = jnp.stack([x[:, i:t - n + 1 + i] for i in range(n)],
+                          axis=-1)  # [B, T-n+1, n]
+        gvalid = (jnp.arange(t - n + 1)[None, :] + n) <= lens[:, None]
+        pieces = []
+        for j in range(slots):
+            hj = _mix_hash(
+                jnp.concatenate([grams,
+                                 jnp.full(grams.shape[:-1] + (1,), j,
+                                          jnp.int32)], axis=-1), space)
+            rows = w[hj]  # [B, G, rand_len] via fancy-index of first dim
+            pieces.append(rows.reshape(hj.shape + (-1,))[..., :rand_len])
+        emb = jnp.concatenate(pieces, axis=-1)  # [B, G, num_emb]
+        emb = jnp.where(gvalid[..., None], emb, 0)
+        # each gram contributes to its FIRST token position (the
+        # reference emits one row per gram into the LoD output; summed
+        # per anchor token here to keep the static [B,T,E] shape)
+        acc = acc.at[:, :t - n + 1, :].add(emb)
+    tmask = (jnp.arange(t)[None, :] < lens[:, None])[..., None]
+    acc = jnp.where(tmask, acc, 0)
+    return {"Out": [acc], "DropPos": [jnp.zeros((1,), jnp.int32)],
+            "X_Temp_Out": [x]}
+
+
+@register_op("tree_conv", inputs=("NodesVector", "EdgeSet", "Filter"),
+             outputs=("Out",), non_diff_inputs=("EdgeSet",))
+def _tree_conv(ctx, ins, attrs):
+    """Tree-based convolution (tree_conv_op.cc, following the TBCNN
+    formulation): each node's patch is itself + its direct children
+    (max_depth windows collapse to depth-1 patches per conv step here —
+    the reference iterates deeper patches by stacking the op). Filter
+    is [feature_dim, 3, output_size, num_filters]; the 3 position
+    weights (top/left/right) mix by each child's position eta."""
+    nodes = ins["NodesVector"][0]       # [B, N, F]
+    edges = ins["EdgeSet"][0].astype(jnp.int32)  # [B, E, 2] parent,child
+    filt = ins["Filter"][0]             # [F, 3, out, filters]
+    b, n, f = nodes.shape
+    e = edges.shape[1]
+    parent, child = edges[..., 0], edges[..., 1]
+    valid = (parent != child) | (parent != 0)
+    # children per parent: scatter child features + counts
+    csum = jnp.zeros((b, n, f), nodes.dtype)
+    ccnt = jnp.zeros((b, n), nodes.dtype)
+    batch_idx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, e))
+    child_feat = jnp.take_along_axis(nodes, child[..., None], axis=1)
+    vmask = valid.astype(nodes.dtype)[..., None]
+    csum = csum.at[batch_idx, parent].add(child_feat * vmask)
+    ccnt = ccnt.at[batch_idx, parent].add(valid.astype(nodes.dtype))
+    # eta weights: top for self, left/right split evenly over children
+    # (position-independent average — children positions are unordered
+    # in EdgeSet, so left/right mix with equal 0.5 coefficients)
+    w_top = filt[:, 0]    # [F, out, filters]
+    w_lr = 0.5 * (filt[:, 1] + filt[:, 2])
+    denom = jnp.maximum(ccnt, 1.0)[..., None]
+    out = jnp.einsum("bnf,fok->bnok", nodes, w_top) + \
+        jnp.einsum("bnf,fok->bnok", csum / denom, w_lr)
+    return {"Out": [jnp.tanh(out)]}

@@ -704,3 +704,609 @@ def _collect_fpn_proposals(ctx, ins, attrs):
     top = jnp.argsort(-scores)[:topn]
     return {"FpnRois": [rois[top]],
             "RoisNum": [jnp.asarray([topn], jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# round-3 parity tail: generate_proposals, rpn_target_assign, yolov3_loss,
+# retinanet_detection_output, locality_aware_nms, mine_hard_examples,
+# prroi_pool, psroi_pool, deformable_conv
+# ---------------------------------------------------------------------------
+
+def _decode_deltas(anchors, deltas, variances=None):
+    """box_coder decode_center_size (operators/detection/box_coder_op.h):
+    anchors [M,4] xyxy, deltas [M,4] (dx,dy,dw,dh) -> boxes xyxy."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + aw * 0.5
+    ay = anchors[:, 1] + ah * 0.5
+    if variances is not None:
+        deltas = deltas * variances
+    cx = deltas[:, 0] * aw + ax
+    cy = deltas[:, 1] * ah + ay
+    w = jnp.exp(jnp.minimum(deltas[:, 2], 10.0)) * aw
+    h = jnp.exp(jnp.minimum(deltas[:, 3], 10.0)) * ah
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - 1.0, cy + h * 0.5 - 1.0], axis=1)
+
+
+@register_op("generate_proposals",
+             inputs=("Scores", "BboxDeltas", "ImInfo", "Anchors",
+                     "Variances"),
+             outputs=("RpnRois", "RpnRoiProbs", "RpnRoisNum"),
+             no_grad=True)
+def _generate_proposals(ctx, ins, attrs):
+    """RPN proposal generation
+    (operators/detection/generate_proposals_op.cc): per image take
+    pre_nms_topN scores, decode deltas against anchors, clip to image,
+    drop boxes smaller than min_size (masked, TPU-static), NMS, keep
+    post_nms_topN. Outputs are padded to [N*post, 4] with per-image
+    counts in RpnRoisNum."""
+    scores = ins["Scores"][0]       # [N, A, H, W]
+    deltas = ins["BboxDeltas"][0]   # [N, 4A, H, W]
+    im_info = ins["ImInfo"][0]      # [N, 3] h, w, scale
+    anchors = ins["Anchors"][0].reshape(-1, 4)
+    variances = ins["Variances"][0].reshape(-1, 4) \
+        if ins.get("Variances") else None
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.7))
+    min_size = float(attrs.get("min_size", 0.1))
+    n, a, h, w = scores.shape
+    m = a * h * w
+    pre_n = min(pre_n, m)
+    post_n = min(post_n, pre_n)
+    sc = jnp.transpose(scores, (0, 2, 3, 1)).reshape(n, m)
+    dl = jnp.transpose(deltas.reshape(n, a, 4, h, w),
+                       (0, 3, 4, 1, 2)).reshape(n, m, 4)
+    # anchors from anchor_generator are [H, W, A, 4] -> flattened HWA,
+    # matching the (0,2,3,1) transpose of scores/deltas above
+    anc = anchors
+
+    def per_image(si, di, info):
+        top_s, idx = jax.lax.top_k(si, pre_n)
+        boxes = _decode_deltas(anc[idx], di[idx],
+                               variances[idx] if variances is not None
+                               else None)
+        ih, iw = info[0], info[1]
+        boxes = jnp.stack([boxes[:, 0].clip(0, iw - 1),
+                           boxes[:, 1].clip(0, ih - 1),
+                           boxes[:, 2].clip(0, iw - 1),
+                           boxes[:, 3].clip(0, ih - 1)], axis=1)
+        ms = min_size * jnp.maximum(info[2], 1.0)
+        keep_sz = ((boxes[:, 2] - boxes[:, 0] + 1.0) >= ms) & \
+                  ((boxes[:, 3] - boxes[:, 1] + 1.0) >= ms)
+        s = jnp.where(keep_sz, top_s, -1e10)
+        keep = _nms_single(boxes, s, nms_thresh, post_n,
+                           normalized=False)
+        s = jnp.where(keep & keep_sz, s, -1e10)
+        fs, fidx = jax.lax.top_k(s, post_n)
+        valid = fs > -1e9
+        out_boxes = jnp.where(valid[:, None], boxes[fidx], 0.0)
+        out_probs = jnp.where(valid, fs, 0.0)
+        return out_boxes, out_probs, valid.sum().astype(jnp.int32)
+
+    rois, probs, nums = jax.vmap(per_image)(sc, dl, im_info)
+    return {"RpnRois": [rois.reshape(n * post_n, 4)],
+            "RpnRoiProbs": [probs.reshape(n * post_n, 1)],
+            "RpnRoisNum": [nums]}
+
+
+@register_op("rpn_target_assign",
+             inputs=("Anchor", "GtBoxes", "IsCrowd", "ImInfo", "GtNum"),
+             outputs=("LocationIndex", "ScoreIndex", "TargetBBox",
+                      "TargetLabel", "BBoxInsideWeight"),
+             no_grad=True, is_random=True)
+def _rpn_target_assign(ctx, ins, attrs):
+    """RPN anchor sampling (operators/detection/rpn_target_assign_op.cc):
+    positives = anchors with IoU >= positive_overlap vs any gt (plus
+    each gt's argmax anchor), negatives = IoU < negative_overlap;
+    subsample to batch_size_per_im with fg_fraction. TPU-static: one
+    image per call shape-wise batched by vmap; indices padded with -1
+    (the reference emits dynamic-length index lists)."""
+    anchors = ins["Anchor"][0]          # [A, 4]
+    gt = ins["GtBoxes"][0]              # [N, G, 4] padded
+    gt_num = ins["GtNum"][0].astype(jnp.int32) if ins.get("GtNum") else \
+        jnp.full((gt.shape[0],), gt.shape[1], jnp.int32)
+    bs = int(attrs.get("rpn_batch_size_per_im", 256))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    pos_ov = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_ov = float(attrs.get("rpn_negative_overlap", 0.3))
+    a = anchors.shape[0]
+    fg_cap = int(bs * fg_frac)
+    key = ctx.rng()
+
+    def per_image(args):
+        gt_i, ng, k = args
+        gvalid = jnp.arange(gt_i.shape[0]) < ng
+        iou = _iou_matrix(anchors, gt_i, normalized=False)
+        iou = jnp.where(gvalid[None, :], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        max_iou = jnp.max(iou, axis=1)
+        # each valid gt's best anchor is positive too
+        best_anchor = jnp.argmax(iou, axis=0)  # [G]
+        # .max, not .set: padded gts all argmax to anchor 0 and a
+        # duplicate-index scatter-set could overwrite a valid gt's flag
+        force_pos = jnp.zeros((a,), bool).at[best_anchor].max(gvalid)
+        is_pos = (max_iou >= pos_ov) | force_pos
+        is_neg = (max_iou < neg_ov) & ~is_pos
+        # random subsample via noisy ranking
+        k1, k2 = jax.random.split(k)
+        noise = jax.random.uniform(k1, (a,))
+        pos_rank_score = jnp.where(is_pos, noise, -1.0)
+        _, pos_idx = jax.lax.top_k(pos_rank_score, fg_cap)
+        pos_ok = pos_rank_score[pos_idx] > 0
+        n_pos = pos_ok.sum()
+        neg_cap = bs - fg_cap
+        noise2 = jax.random.uniform(k2, (a,))
+        neg_rank = jnp.where(is_neg, noise2, -1.0)
+        _, neg_idx = jax.lax.top_k(neg_rank, bs)
+        neg_take = jnp.arange(bs) < (bs - n_pos)
+        neg_ok = (neg_rank[neg_idx] > 0) & neg_take
+        loc_index = jnp.where(pos_ok, pos_idx, -1)
+        score_index = jnp.concatenate(
+            [loc_index, jnp.where(neg_ok, neg_idx, -1)])
+        tgt = _encode_deltas(anchors[pos_idx], gt_i[best_gt[pos_idx]])
+        tgt = jnp.where(pos_ok[:, None], tgt, 0.0)
+        label = jnp.concatenate(
+            [jnp.where(pos_ok, 1, -1),
+             jnp.where(neg_ok, 0, -1)]).astype(jnp.int32)
+        inside_w = jnp.where(pos_ok[:, None],
+                             jnp.ones_like(tgt), 0.0)
+        return loc_index.astype(jnp.int32), \
+            score_index.astype(jnp.int32), tgt, label, inside_w
+
+    keys = jax.random.split(key, gt.shape[0])
+    li, si, tb, tl, bw = jax.lax.map(per_image, (gt, gt_num, keys))
+    return {"LocationIndex": [li], "ScoreIndex": [si],
+            "TargetBBox": [tb], "TargetLabel": [tl],
+            "BBoxInsideWeight": [bw]}
+
+
+def _encode_deltas(anchors, gt):
+    """box_coder encode_center_size: xyxy anchor+gt -> (dx,dy,dw,dh)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + aw * 0.5
+    ay = anchors[:, 1] + ah * 0.5
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gx = gt[:, 0] + gw * 0.5
+    gy = gt[:, 1] + gh * 0.5
+    return jnp.stack([(gx - ax) / aw, (gy - ay) / ah,
+                      jnp.log(jnp.maximum(gw / aw, 1e-6)),
+                      jnp.log(jnp.maximum(gh / ah, 1e-6))], axis=1)
+
+
+@register_op("yolov3_loss",
+             inputs=("X", "GTBox", "GTLabel", "GTScore"),
+             outputs=("Loss", "ObjectnessMask", "GTMatchMask"),
+             non_diff_inputs=("GTBox", "GTLabel", "GTScore"))
+def _yolov3_loss(ctx, ins, attrs):
+    """YOLOv3 training loss (operators/detection/yolov3_loss_op.h):
+    each gt box picks its best-IoU anchor (wh-only, boxes at origin);
+    if that anchor belongs to this level's anchor_mask the gt is
+    assigned to its grid cell: sigmoid-CE on tx/ty, L1 on tw/th
+    (weighted 2 - w*h), sigmoid-CE objectness (negatives whose best
+    IoU vs any gt exceeds ignore_thresh are ignored), sigmoid-CE
+    class."""
+    x = ins["X"][0]                       # [N, A*(5+C), H, W]
+    gt_box = ins["GTBox"][0]              # [N, B, 4] cx,cy,w,h (rel)
+    gt_label = ins["GTLabel"][0].astype(jnp.int32)  # [N, B]
+    anchors = [int(v) for v in attrs["anchors"]]
+    mask = [int(v) for v in attrs["anchor_mask"]]
+    class_num = int(attrs["class_num"])
+    ignore = float(attrs.get("ignore_thresh", 0.7))
+    down = int(attrs.get("downsample_ratio", 32))
+    n, c, h, w = x.shape
+    na = len(mask)
+    nb = gt_box.shape[1]
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    gt_score = ins["GTScore"][0] if ins.get("GTScore") else \
+        jnp.ones((n, nb), x.dtype)
+    in_w, in_h = down * w, down * h
+    all_aw = jnp.asarray(anchors[0::2], jnp.float32)
+    all_ah = jnp.asarray(anchors[1::2], jnp.float32)
+    gt_valid = (gt_box[..., 2] > 0) & (gt_box[..., 3] > 0)  # [N, B]
+    # best anchor per gt: IoU of wh at origin vs EVERY anchor
+    gw = gt_box[..., 2] * in_w
+    gh = gt_box[..., 3] * in_h
+    inter = jnp.minimum(gw[..., None], all_aw) * \
+        jnp.minimum(gh[..., None], all_ah)
+    union = gw[..., None] * gh[..., None] + all_aw * all_ah - inter
+    best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-10), -1)
+    mask_arr = jnp.asarray(mask, jnp.int32)
+    an_idx = jnp.argmax(best_anchor[..., None] == mask_arr, -1)  # [N,B]
+    assigned = gt_valid & (best_anchor[..., None] == mask_arr).any(-1)
+    gi = (gt_box[..., 0] * w).astype(jnp.int32).clip(0, w - 1)
+    gj = (gt_box[..., 1] * h).astype(jnp.int32).clip(0, h - 1)
+    # build target grids by scatter
+    def z(*sh):
+        return jnp.zeros((n, na, *sh), jnp.float32)
+    tx, ty = z(h, w), z(h, w)
+    tw, th, tobj, tscale = z(h, w), z(h, w), z(h, w), z(h, w)
+    tcls = z(h, w, class_num)
+    bidx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, nb))
+    sel = (bidx, an_idx, gj, gi)
+    am = assigned.astype(jnp.float32)
+    tx = tx.at[sel].max(jnp.where(assigned, gt_box[..., 0] * w - gi, 0))
+    ty = ty.at[sel].max(jnp.where(assigned, gt_box[..., 1] * h - gj, 0))
+    aw_sel = all_aw[mask_arr][an_idx]
+    ah_sel = all_ah[mask_arr][an_idx]
+    # tw/th targets can be NEGATIVE (gt smaller than anchor): unassigned
+    # rows must scatter -inf, not 0, or a padding row landing on the
+    # same cell would max-clobber a real target up to 0
+    tw = tw.at[sel].max(jnp.where(
+        assigned, jnp.log(jnp.maximum(gw / aw_sel, 1e-9)), -1e9))
+    th = th.at[sel].max(jnp.where(
+        assigned, jnp.log(jnp.maximum(gh / ah_sel, 1e-9)), -1e9))
+    tw = jnp.where(tw < -1e8, 0.0, tw)
+    th = jnp.where(th < -1e8, 0.0, th)
+    tobj = tobj.at[sel].max(am * gt_score)
+    tscale = tscale.at[sel].max(
+        am * (2.0 - gt_box[..., 2] * gt_box[..., 3]))
+    cls_hot = jax.nn.one_hot(gt_label, class_num) * am[..., None]
+    tcls = tcls.at[sel].max(cls_hot)
+    has_gt = tobj > 0
+
+    sig = jax.nn.sigmoid
+    px, py = x[:, :, 0], x[:, :, 1]
+    pw, ph_ = x[:, :, 2], x[:, :, 3]
+    pobj, pcls = x[:, :, 4], x[:, :, 5:]
+
+    def sce(logit, label):
+        return jnp.maximum(logit, 0) - logit * label + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    loss_xy = tscale * (sce(px, tx) + sce(py, ty)) * has_gt
+    loss_wh = tscale * (jnp.abs(pw - tw) + jnp.abs(ph_ - th)) * has_gt
+    # objectness ignore mask: pred boxes overlapping any gt > thresh
+    grid_x = (jnp.arange(w, dtype=jnp.float32) + 0.5)[None, None, None, :]
+    grid_y = (jnp.arange(h, dtype=jnp.float32) + 0.5)[None, None, :, None]
+    bx = (sig(px) + jnp.floor(grid_x - 0.5)) / w
+    by = (sig(py) + jnp.floor(grid_y - 0.5)) / h
+    bw = jnp.exp(pw) * all_aw[mask_arr][None, :, None, None] / in_w
+    bh = jnp.exp(ph_) * all_ah[mask_arr][None, :, None, None] / in_h
+    pred = jnp.stack([bx - bw / 2, by - bh / 2,
+                      bx + bw / 2, by + bh / 2], -1)  # [N,A,H,W,4]
+    gxy = gt_box[..., :2]
+    gwh = gt_box[..., 2:4]
+    gbox = jnp.concatenate([gxy - gwh / 2, gxy + gwh / 2], -1)  # [N,B,4]
+    pflat = pred.reshape(n, -1, 4)
+    ious = jax.vmap(_iou_matrix)(pflat, gbox)  # [N, AHW, B]
+    ious = jnp.where(gt_valid[:, None, :], ious, 0.0)
+    best = ious.max(-1).reshape(n, na, h, w)
+    obj_ignore = (best > ignore) & ~has_gt
+    obj_mask = jnp.where(obj_ignore, 0.0, 1.0)
+    loss_obj = sce(pobj, tobj) * obj_mask
+    loss_cls = (sce(jnp.moveaxis(pcls, 2, -1), tcls)
+                * has_gt[..., None]).sum(-1)
+    loss = (loss_xy + loss_wh + loss_obj + loss_cls).sum((1, 2, 3))
+    return {"Loss": [loss], "ObjectnessMask": [obj_mask],
+            "GTMatchMask": [assigned.astype(jnp.int32)]}
+
+
+@register_op("retinanet_detection_output",
+             inputs=("BBoxes", "Scores", "Anchors", "ImInfo"),
+             outputs=("Out", "OutNum"), no_grad=True)
+def _retinanet_detection_output(ctx, ins, attrs):
+    """RetinaNet decode+NMS (operators/detection/
+    retinanet_detection_output_op.cc): per FPN level keep nms_top_k by
+    max-class score, decode deltas against that level's anchors, then
+    class-wise NMS merged and trimmed to keep_top_k. Out is padded
+    [N, keep_top_k, 6] (label, score, x1,y1,x2,y2) + counts."""
+    deltas_l = ins["BBoxes"]     # list of [N, Ai, 4]
+    scores_l = ins["Scores"]     # list of [N, Ai, C]
+    anchors_l = ins["Anchors"]   # list of [Ai, 4]
+    im_info = ins["ImInfo"][0]
+    score_th = float(attrs.get("score_threshold", 0.05))
+    nms_top_k = int(attrs.get("nms_top_k", 1000))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    nms_th = float(attrs.get("nms_threshold", 0.3))
+    n = deltas_l[0].shape[0]
+    c = scores_l[0].shape[2]
+
+    def per_image(args):
+        dls, scs, info = args
+        boxes_all, scores_all = [], []
+        for d, s, anc in zip(dls, scs, anchors_l):
+            k = min(nms_top_k, d.shape[0])
+            top, idx = jax.lax.top_k(s.max(-1), k)
+            b = _decode_deltas(anc[idx], d[idx])
+            b = jnp.stack([b[:, 0].clip(0, info[1] - 1),
+                           b[:, 1].clip(0, info[0] - 1),
+                           b[:, 2].clip(0, info[1] - 1),
+                           b[:, 3].clip(0, info[0] - 1)], 1)
+            boxes_all.append(b)
+            scores_all.append(s[idx])
+        boxes = jnp.concatenate(boxes_all, 0)    # [M, 4]
+        scores = jnp.concatenate(scores_all, 0)  # [M, C]
+        outs = []
+        for cls in range(c):
+            sc = jnp.where(scores[:, cls] > score_th, scores[:, cls],
+                           -1e10)
+            keep = _nms_single(boxes, sc, nms_th, keep_top_k,
+                               normalized=False)
+            sc = jnp.where(keep, sc, -1e10)
+            outs.append((sc, jnp.full_like(sc, cls, dtype=jnp.int32)))
+        all_sc = jnp.concatenate([o[0] for o in outs])
+        all_lb = jnp.concatenate([o[1] for o in outs])
+        all_bx = jnp.tile(boxes, (c, 1))
+        top, idx = jax.lax.top_k(all_sc, keep_top_k)
+        valid = top > -1e9
+        row = jnp.concatenate([
+            jnp.where(valid, all_lb[idx], -1).astype(jnp.float32)[:, None],
+            jnp.where(valid, top, 0.0)[:, None],
+            jnp.where(valid[:, None], all_bx[idx], 0.0)], axis=1)
+        return row, valid.sum().astype(jnp.int32)
+
+    rows, nums = jax.lax.map(
+        per_image, ([jnp.asarray(d) for d in deltas_l],
+                    [jnp.asarray(s) for s in scores_l], im_info))
+    return {"Out": [rows], "OutNum": [nums]}
+
+
+@register_op("locality_aware_nms", inputs=("BBoxes", "Scores"),
+             outputs=("Out",), no_grad=True)
+def _locality_aware_nms(ctx, ins, attrs):
+    """Locality-aware NMS for text detection (operators/detection/
+    locality_aware_nms_op.cc): a first pass score-weight-merges
+    consecutive overlapping boxes, then standard NMS. Out is padded
+    [M, 6] (label, score, box) sorted by score."""
+    boxes = ins["BBoxes"][0]   # [N, M, 4]
+    scores = ins["Scores"][0]  # [N, 1, M] or [N, M]
+    nms_th = float(attrs.get("nms_threshold", 0.3))
+    score_th = float(attrs.get("score_threshold", 0.0))
+    keep_top_k = int(attrs.get("keep_top_k", -1))
+
+    def per_image(b, s):
+        s = s.reshape(-1)
+        m = b.shape[0]
+        k = m if keep_top_k <= 0 else min(keep_top_k, m)
+
+        # pass 1: merge each box into its predecessor when IoU > th
+        # (weighted by scores, running left-to-right like the C++ scan)
+        def merge_step(i, state):
+            bs, ss = state
+            prev_b = jax.lax.dynamic_slice_in_dim(bs, i - 1, 1, 0)
+            cur_b = jax.lax.dynamic_slice_in_dim(bs, i, 1, 0)
+            prev_s = jax.lax.dynamic_slice_in_dim(ss, i - 1, 1, 0)[0]
+            cur_s = jax.lax.dynamic_slice_in_dim(ss, i, 1, 0)[0]
+            iou = _iou_matrix(prev_b, cur_b)[0, 0]
+            wsum = prev_s + cur_s
+            merged = (prev_b[0] * prev_s + cur_b[0] * cur_s) / \
+                jnp.maximum(wsum, 1e-10)
+            do = iou > nms_th
+            bs = bs.at[i].set(jnp.where(do, merged, cur_b[0]))
+            ss = ss.at[i].set(jnp.where(do, wsum, cur_s))
+            # predecessor consumed
+            ss = ss.at[i - 1].set(jnp.where(do, -1e10, prev_s))
+            return bs, ss
+
+        b2, s2 = jax.lax.fori_loop(1, m, merge_step, (b, s))
+        s2 = jnp.where(s2 > score_th, s2, -1e10)
+        keep = _nms_single(b2, s2, nms_th, k)
+        s2 = jnp.where(keep, s2, -1e10)
+        top, idx = jax.lax.top_k(s2, k)
+        valid = top > -1e9
+        return jnp.concatenate([
+            jnp.zeros((k, 1), b.dtype),
+            jnp.where(valid, top, 0.0)[:, None],
+            jnp.where(valid[:, None], b2[idx], 0.0)], axis=1)
+
+    out = jax.vmap(per_image)(boxes, scores)
+    return {"Out": [out.reshape(-1, 6)]}
+
+
+@register_op("mine_hard_examples",
+             inputs=("ClsLoss", "LocLoss", "MatchIndices", "MatchDist"),
+             outputs=("NegIndices", "UpdatedMatchIndices", "NegNum"),
+             no_grad=True)
+def _mine_hard_examples(ctx, ins, attrs):
+    """SSD hard-negative mining (operators/detection/
+    mine_hard_examples_op.cc, max_negative mode): per image rank the
+    unmatched priors by loss and keep neg_pos_ratio * num_pos of them
+    (also requiring match distance below neg_dist_threshold when
+    MatchDist is given). NegIndices is padded with -1 + NegNum counts
+    (the reference emits a LoD list)."""
+    cls_loss = ins["ClsLoss"][0]                 # [N, P]
+    match = ins["MatchIndices"][0].astype(jnp.int32)  # [N, P]
+    loss = cls_loss + (ins["LocLoss"][0] if ins.get("LocLoss") else 0.0)
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    dist_th = float(attrs.get("neg_dist_threshold", 0.5))
+    n, p = match.shape
+    is_neg = match == -1
+    if ins.get("MatchDist"):
+        is_neg = is_neg & (ins["MatchDist"][0] < dist_th)
+    num_pos = (match != -1).sum(axis=1)
+    num_neg = jnp.minimum((num_pos * ratio).astype(jnp.int32),
+                          is_neg.sum(axis=1))
+    ranked = jnp.where(is_neg, loss, -jnp.inf)
+    top, idx = jax.lax.top_k(ranked, p)
+    take = jnp.arange(p)[None, :] < num_neg[:, None]
+    take = take & jnp.isfinite(top)
+    neg_idx = jnp.where(take, idx, -1).astype(jnp.int32)
+    return {"NegIndices": [neg_idx],
+            "UpdatedMatchIndices": [match],
+            "NegNum": [take.sum(axis=1).astype(jnp.int32)]}
+
+
+def _hat_integral(lo, hi, centers):
+    """∫_{lo}^{hi} max(0, 1-|x-c|) dx for each center c — the exact
+    bilinear-hat overlap used by precise ROI pooling (PrRoIPooling)."""
+    def F(t):
+        # antiderivative of hat on [-1, 1], F(-1)=0
+        t = jnp.clip(t, -1.0, 1.0)
+        return jnp.where(t <= 0,
+                         0.5 * (t + 1.0) ** 2,
+                         0.5 + t - 0.5 * t * t)
+    a = lo[..., None] - centers
+    b = hi[..., None] - centers
+    return F(b) - F(a)
+
+
+@register_op("prroi_pool", inputs=("X", "ROIs", "BatchRoINums"),
+             outputs=("Out",), non_diff_inputs=("ROIs", "BatchRoINums"))
+def _prroi_pool(ctx, ins, attrs):
+    """Precise ROI pooling (operators/prroi_pool_op.cc, PrRoIPooling):
+    each output bin is the EXACT integral of the bilinearly-
+    interpolated feature over the bin, divided by the bin area — no
+    sampling-point quantization, fully differentiable in the ROI
+    coords too (here ROIs are non-diff: the classifier path). The
+    integral separates per axis into hat-overlap coefficient matrices,
+    so each (roi, channel) bin is coefY @ X @ coefX^T."""
+    x = ins["X"][0]            # [N, C, H, W]
+    rois = ins["ROIs"][0]      # [R, 4] (x1,y1,x2,y2) in input scale
+    roi_batch = ins["BatchRoINums"][0].astype(jnp.int32) \
+        if ins.get("BatchRoINums") else jnp.zeros(
+            (rois.shape[0],), jnp.int32)
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+
+    def one_roi(roi, bidx):
+        x1, y1, x2, y2 = roi * scale
+        bw = jnp.maximum(x2 - x1, 1e-6) / pw
+        bh = jnp.maximum(y2 - y1, 1e-6) / ph
+        ylo = y1 + bh * jnp.arange(ph)
+        xlo = x1 + bw * jnp.arange(pw)
+        cy = _hat_integral(ylo, ylo + bh,
+                           jnp.arange(h, dtype=x.dtype))  # [ph, H]
+        cx = _hat_integral(xlo, xlo + bw,
+                           jnp.arange(w, dtype=x.dtype))  # [pw, W]
+        img = x[bidx]  # [C, H, W]
+        out = jnp.einsum("ph,chw,qw->cpq", cy, img, cx)
+        return out / (bw * bh)
+
+    out = jax.vmap(one_roi)(rois, roi_batch)
+    return {"Out": [out]}
+
+
+@register_op("psroi_pool", inputs=("X", "ROIs", "BatchRoINums"),
+             outputs=("Out",), non_diff_inputs=("ROIs", "BatchRoINums"))
+def _psroi_pool(ctx, ins, attrs):
+    """Position-sensitive ROI pooling (operators/psroi_pool_op.cc,
+    R-FCN): input has output_channels*ph*pw channels; output bin (i,j)
+    of output-channel k average-pools its spatial bin from input
+    channel k*ph*pw + i*pw + j (integer-floor bin edges like the
+    reference kernel)."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    roi_batch = ins["BatchRoINums"][0].astype(jnp.int32) \
+        if ins.get("BatchRoINums") else jnp.zeros(
+            (rois.shape[0],), jnp.int32)
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    oc = int(attrs.get("output_channels"))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(roi, bidx):
+        # reference: round roi to integer grid, each bin [floor, ceil)
+        x1 = jnp.floor(roi[0] * scale + 0.5)
+        y1 = jnp.floor(roi[1] * scale + 0.5)
+        x2 = jnp.ceil(roi[2] * scale - 0.5)
+        y2 = jnp.ceil(roi[3] * scale - 0.5)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bw, bh = rw / pw, rh / ph
+        img = x[bidx].reshape(oc, ph * pw, h, w)
+        i = jnp.arange(ph, dtype=jnp.float32)
+        j = jnp.arange(pw, dtype=jnp.float32)
+        hs = jnp.floor(y1 + i * bh)[:, None]           # [ph,1]
+        he = jnp.ceil(y1 + (i + 1) * bh)[:, None]
+        ws_ = jnp.floor(x1 + j * bw)[:, None]
+        we = jnp.ceil(x1 + (j + 1) * bw)[:, None]
+        ymask = (ys >= hs) & (ys < he)                 # [ph, H]
+        xmask = (xs >= ws_) & (xs < we)                # [pw, W]
+        area = ymask.sum(-1)[:, None] * xmask.sum(-1)[None, :]
+        # bin (i,j) uses channel slice i*pw+j
+        sel = img.reshape(oc, ph, pw, h, w)
+        v = jnp.einsum("ih,kijhw,jw->kij", ymask.astype(x.dtype), sel,
+                       xmask.astype(x.dtype))
+        return v / jnp.maximum(area, 1.0)
+
+    out = jax.vmap(one_roi)(rois, roi_batch)
+    return {"Out": [out]}
+
+
+@register_op("deformable_conv",
+             inputs=("Input", "Offset", "Mask", "Filter"),
+             outputs=("Output",))
+def _deformable_conv(ctx, ins, attrs):
+    """Deformable conv v2 (operators/deformable_conv_op.cc): every
+    kernel tap samples the input at p0 + pk + learned offset (bilinear)
+    and is modulated by a learned mask, then the gathered columns hit
+    the MXU as one matmul — the im2col+GEMM structure of the reference
+    CUDA kernel, with XLA gathers instead of hand-written atomics.
+    Offset is [N, 2*dg*kh*kw, Ho, Wo] (y then x per tap), Mask
+    [N, dg*kh*kw, Ho, Wo]."""
+    x = ins["Input"][0]        # [N, C, H, W]
+    offset = ins["Offset"][0]
+    mask = ins["Mask"][0] if ins.get("Mask") else None
+    wgt = ins["Filter"][0]     # [Cout, C/g, kh, kw]
+    strides = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0])
+    dils = attrs.get("dilations", [1, 1])
+    groups = int(attrs.get("groups", 1))
+    dg = int(attrs.get("deformable_groups", 1))
+    n, c, h, w = x.shape
+    cout, cpg, kh, kw = wgt.shape
+    ho = (h + 2 * pads[0] - (dils[0] * (kh - 1) + 1)) // strides[0] + 1
+    wo = (w + 2 * pads[1] - (dils[1] * (kw - 1) + 1)) // strides[1] + 1
+    k = kh * kw
+    off = offset.reshape(n, dg, k, 2, ho, wo)
+    msk = mask.reshape(n, dg, k, ho, wo) if mask is not None else None
+
+    base_y = (jnp.arange(ho) * strides[0] - pads[0])[:, None]  # [Ho,1]
+    base_x = (jnp.arange(wo) * strides[1] - pads[1])[None, :]  # [1,Wo]
+    tap_y = jnp.repeat(jnp.arange(kh) * dils[0], kw)   # [k]
+    tap_x = jnp.tile(jnp.arange(kw) * dils[1], kh)     # [k]
+
+    # sampling positions per (n, dg, k, Ho, Wo)
+    sy = (base_y[None, None, :, :] + tap_y[None, :, None, None]
+          )[None].astype(x.dtype) + off[:, :, :, 0]
+    sx = (base_x[None, None, :, :] + tap_x[None, :, None, None]
+          )[None].astype(x.dtype) + off[:, :, :, 1]
+
+    def bilinear(img, yy, xx):
+        # img [C', H, W]; yy/xx [...]; OOB taps contribute 0
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        wy1, wx1 = yy - y0, xx - x0
+        wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+        val = 0.0
+        for dy, wyf in ((0, wy0), (1, wy1)):
+            for dx, wxf in ((0, wx0), (1, wx1)):
+                yi = y0.astype(jnp.int32) + dy
+                xi = x0.astype(jnp.int32) + dx
+                inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+                yc = yi.clip(0, h - 1)
+                xc = xi.clip(0, w - 1)
+                v = img[:, yc, xc]  # [C', ...]
+                val = val + v * (wyf * wxf * inb)[None]
+        return val
+
+    cpd = c // dg  # channels per deformable group
+
+    def per_n(xi, syi, sxi, mi):
+        cols = []
+        for g in range(dg):
+            img = xi[g * cpd:(g + 1) * cpd]
+            v = bilinear(img, syi[g], sxi[g])  # [cpd, k, Ho, Wo]
+            if mi is not None:
+                v = v * mi[g][None]
+            cols.append(v)
+        return jnp.concatenate(cols, axis=0)  # [C, k, Ho, Wo]
+
+    cols = jax.vmap(per_n)(x, sy, sx,
+                           msk if msk is not None else
+                           jnp.ones((n, dg, k, ho, wo), x.dtype))
+    # grouped GEMM: [Cout, (C/g)*k] x [(C/g)*k, Ho*Wo]
+    cols = cols.reshape(n, groups, (c // groups) * k, ho * wo)
+    wmat = wgt.reshape(groups, cout // groups, cpg * k)
+    out = jnp.einsum("gok,ngks->ngos", wmat, cols)
+    return {"Output": [out.reshape(n, cout, ho, wo)]}

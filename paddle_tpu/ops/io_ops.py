@@ -1,0 +1,94 @@
+"""IO ops: save / load / save_combine / load_combine / py_func.
+
+Host ops (executor runs them between jit segments,
+core/executor.py:_compile_segmented):
+
+- save/load: parity with the reference's variable-as-op persistence
+  (/root/reference/paddle/fluid/operators/save_op.cc,
+  load_op.cc — SaveSelectedRows/SaveLodTensor with a file_path attr,
+  overwrite check at save_op.cc:43). The byte format is numpy's .npy
+  (+ .npz for combine) instead of the reference's LoDTensor proto
+  serialization — format parity is not part of the op contract, the
+  ability of a Program to persist/restore its own variables is.
+- save_combine/load_combine: one file holding many vars in op-input
+  order (save_combine_op.cc).
+- py_func: arbitrary Python callables spliced into a Program
+  (py_func_op.cc:217 — callables live in a process-global registry,
+  the op carries the registry handle in its attrs; the reference
+  additionally registers a backward callable, which here is only
+  invoked if given — the op is no_grad otherwise).
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List
+
+import numpy as np
+
+from ..core.registry import register_op
+
+# py_func callable registry (py_func_op.cc PyFuncRegistry)
+_PY_FUNCS: List[Callable] = []
+
+
+def register_py_func(fn: Callable) -> int:
+    _PY_FUNCS.append(fn)
+    return len(_PY_FUNCS) - 1
+
+
+@register_op("save", inputs=("X",), outputs=(), no_grad=True, host=True)
+def _save(ctx, ins, attrs):
+    path = attrs["file_path"]
+    if os.path.exists(path) and not attrs.get("overwrite", True):
+        raise RuntimeError("%r exists and overwrite=False (save_op.cc:43)"
+                           % path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    x = np.asarray(ins["X"][0])
+    if attrs.get("save_as_fp16"):
+        x = x.astype(np.float16)
+    with open(path, "wb") as f:
+        np.save(f, x, allow_pickle=False)
+    return {}
+
+
+@register_op("load", inputs=(), outputs=("Out",), no_grad=True, host=True)
+def _load(ctx, ins, attrs):
+    with open(attrs["file_path"], "rb") as f:
+        x = np.load(f, allow_pickle=False)
+    if attrs.get("load_as_fp16"):
+        x = x.astype(np.float16)
+    elif x.dtype == np.float16:
+        x = x.astype(np.float32)
+    return {"Out": [x]}
+
+
+@register_op("save_combine", inputs=("X",), outputs=(), no_grad=True,
+             host=True)
+def _save_combine(ctx, ins, attrs):
+    path = attrs["file_path"]
+    if os.path.exists(path) and not attrs.get("overwrite", True):
+        raise RuntimeError("%r exists and overwrite=False" % path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    arrays = {"v%d" % i: np.asarray(v) for i, v in enumerate(ins["X"])}
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+    return {}
+
+
+@register_op("load_combine", inputs=(), outputs=("Out",), no_grad=True,
+             host=True)
+def _load_combine(ctx, ins, attrs):
+    with np.load(attrs["file_path"], allow_pickle=False) as z:
+        return {"Out": [z["v%d" % i] for i in range(len(z.files))]}
+
+
+@register_op("py_func", inputs=("X",), outputs=("Out",), no_grad=True,
+             host=True)
+def _py_func(ctx, ins, attrs):
+    fn = _PY_FUNCS[int(attrs["forward_callable_id"])]
+    outs = fn(*[np.asarray(v) for v in ins.get("X", [])])
+    if outs is None:
+        outs = []
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return {"Out": [np.asarray(o) for o in outs]}

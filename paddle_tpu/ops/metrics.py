@@ -88,3 +88,129 @@ def _precision_recall(ctx, ins, attrs):
 
     return {"BatchMetrics": [metrics(batch)], "AccumMetrics": [metrics(accum)],
             "AccumStatesInfo": [accum]}
+
+
+# ---------------------------------------------------------------------------
+# round-3 parity tail: chunk_eval, positive_negative_pair
+# ---------------------------------------------------------------------------
+
+def _chunk_segments(labels, num_tag_types, other_type, tb, ti, te, ts):
+    """GetSegments (operators/chunk_eval_op.h:41) — exact port of the
+    begin/end decision table to numpy."""
+    def begin(pt, pty, t, ty):
+        if pty == other_type:
+            return ty != other_type
+        if ty == other_type:
+            return False
+        if ty != pty:
+            return True
+        if t == tb or t == ts:
+            return True
+        if t in (ti, te):
+            return pt == te or pt == ts
+        return False
+
+    def end(pt, pty, t, ty):
+        if pty == other_type:
+            return False
+        if ty == other_type or ty != pty:
+            return True
+        if pt in (tb, ti):
+            return t == tb or t == ts
+        return pt in (te, ts)
+
+    segs = []
+    in_chunk, start = False, 0
+    tag, typ = -1, other_type
+    for i, lab in enumerate(labels):
+        pt, pty = tag, typ
+        tag, typ = int(lab) % num_tag_types, int(lab) // num_tag_types
+        if in_chunk and end(pt, pty, tag, typ):
+            segs.append((start, i - 1, pty))
+            in_chunk = False
+        if begin(pt, pty, tag, typ):
+            start, in_chunk = i, True
+    if in_chunk:
+        segs.append((start, len(labels) - 1, typ))
+    return segs
+
+
+_SCHEMES = {"IOB": (2, 0, 1, -1, -1), "IOE": (2, -1, 0, 1, -1),
+            "IOBES": (4, 0, 1, 2, 3), "plain": (1, -1, -1, -1, -1)}
+
+
+@register_op("chunk_eval", inputs=("Inference", "Label", "SeqLength"),
+             outputs=("Precision", "Recall", "F1-Score",
+                      "NumInferChunks", "NumLabelChunks",
+                      "NumCorrectChunks"),
+             no_grad=True, host=True)
+def _chunk_eval(ctx, ins, attrs):
+    """Chunking (NER) precision/recall/F1 (operators/chunk_eval_op.h).
+    Host op: chunk extraction is inherently sequential; metrics run
+    between jit segments. Padded repr: [B, T] + SeqLength."""
+    import numpy as np
+    inf = np.asarray(ins["Inference"][0]).reshape(
+        ins["Inference"][0].shape[0], -1)
+    lab = np.asarray(ins["Label"][0]).reshape(inf.shape)
+    if ins.get("SeqLength"):
+        lens = np.asarray(ins["SeqLength"][0]).reshape(-1)
+    else:
+        lens = np.full((inf.shape[0],), inf.shape[1], np.int64)
+    scheme = attrs.get("chunk_scheme", "IOB")
+    ntag, tb, ti, te, ts = _SCHEMES[scheme]
+    nchunk = int(attrs["num_chunk_types"])
+    other = nchunk
+    excluded = set(attrs.get("excluded_chunk_types", []))
+    n_inf = n_lab = n_cor = 0
+    for b in range(inf.shape[0]):
+        L = int(lens[b])
+        si = [s for s in _chunk_segments(inf[b, :L], ntag, other,
+                                         tb, ti, te, ts)
+              if s[2] not in excluded]
+        sl = [s for s in _chunk_segments(lab[b, :L], ntag, other,
+                                         tb, ti, te, ts)
+              if s[2] not in excluded]
+        n_inf += len(si)
+        n_lab += len(sl)
+        n_cor += len(set(si) & set(sl))
+    p = n_cor / n_inf if n_inf else 0.0
+    r = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    i64 = lambda v: np.asarray([v], np.int64)  # noqa: E731
+    f32 = lambda v: np.asarray([v], np.float32)  # noqa: E731
+    return {"Precision": [f32(p)], "Recall": [f32(r)],
+            "F1-Score": [f32(f1)], "NumInferChunks": [i64(n_inf)],
+            "NumLabelChunks": [i64(n_lab)],
+            "NumCorrectChunks": [i64(n_cor)]}
+
+
+@register_op("positive_negative_pair",
+             inputs=("Score", "Label", "QueryID", "AccumulatePositivePair",
+                     "AccumulateNegativePair", "AccumulateNeutralPair"),
+             outputs=("PositivePair", "NegativePair", "NeutralPair"),
+             no_grad=True)
+def _positive_negative_pair(ctx, ins, attrs):
+    """Ranking pair counts per query (operators/
+    positive_negative_pair_op.h): over all intra-query pairs (i, j)
+    with label_i > label_j, positive if score_i > score_j, negative if
+    <, neutral if ==; optional accumulators add in."""
+    import jax.numpy as jnp
+    score = ins["Score"][0]
+    col = int(attrs.get("column", -1))
+    score = score[:, col] if score.ndim > 1 else score
+    label = ins["Label"][0].reshape(-1)
+    qid = ins["QueryID"][0].reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    lab_gt = label[:, None] > label[None, :]
+    pair = same_q & lab_gt
+    sdiff = score[:, None] - score[None, :]
+    pos = jnp.sum(pair & (sdiff > 0))
+    neg = jnp.sum(pair & (sdiff < 0))
+    neu = jnp.sum(pair & (sdiff == 0))
+    def acc(slot, v):
+        if ins.get(slot):
+            return v + ins[slot][0].reshape(()).astype(jnp.float32)
+        return v.astype(jnp.float32)
+    return {"PositivePair": [acc("AccumulatePositivePair", pos)[None]],
+            "NegativePair": [acc("AccumulateNegativePair", neg)[None]],
+            "NeutralPair": [acc("AccumulateNeutralPair", neu)[None]]}

@@ -686,3 +686,96 @@ def _grid_sampler(ctx, ins, attrs):
     out = wa * pick(y0, x0) + wb * pick(y1, x0) + \
         wc * pick(y0, x1) + wd * pick(y1, x1)
     return {"Output": [jnp.transpose(out, (0, 3, 1, 2))]}
+
+
+@register_op("sync_batch_norm",
+             inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+             outputs=("Y", "MeanOut", "VarianceOut", "SavedMean",
+                      "SavedVariance"))
+def _sync_batch_norm(ctx, ins, attrs):
+    """Cross-replica batch norm (operators/sync_batch_norm_op.cu — the
+    CUDA kernel ncclAllReduces sum(x) and sum(x^2) before normalizing).
+
+    TPU-native design note: under GSPMD (CompiledProgram /
+    with_data_parallel), the batch axis is sharded over the mesh and
+    jnp.mean over it IS the global mean — XLA inserts the all-reduce,
+    which is exactly the reference's NCCL collective. So the lowering is
+    the batch_norm lowering; the semantic difference the reference needs
+    a separate CUDA kernel for comes for free from the sharding
+    propagation. (Inside shard_map, where means are shard-local, a
+    lax.pmean wrapper would be needed — the framework's SPMD paths all
+    go through GSPMD.)"""
+    return _batch_norm(ctx, ins, attrs)
+
+
+@register_op("conv3d_transpose", inputs=("Input", "Filter"),
+             outputs=("Output",))
+def _conv3d_transpose(ctx, ins, attrs):
+    """conv3d backward-data (conv_transpose_op.cc, 3d path): weight
+    [in_c, out_c, kd, kh, kw], lowered via lhs dilation like
+    conv2d_transpose."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = tuple(attrs.get("strides", [1, 1, 1]))
+    paddings = attrs.get("paddings", [0, 0, 0])
+    dilations = tuple(attrs.get("dilations", [1, 1, 1]))
+    if isinstance(paddings, int):
+        paddings = [paddings] * 3
+    pads = [(p, p) for p in paddings] if len(paddings) == 3 else \
+        [(paddings[2 * i], paddings[2 * i + 1]) for i in range(3)]
+    wt = jnp.flip(jnp.swapaxes(w, 0, 1), axis=(2, 3, 4))
+    dn = jax.lax.conv_dimension_numbers(x.shape, wt.shape,
+                                        ("NCDHW", "OIDHW", "NCDHW"))
+    out = jax.lax.conv_general_dilated(
+        x, wt, window_strides=(1, 1, 1),
+        padding=[(d * (k - 1) - p0, d * (k - 1) - p1)
+                 for (p0, p1), k, d in zip(pads, w.shape[2:], dilations)],
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=dn)
+    return {"Output": [out]}
+
+
+@register_op("sample_logits",
+             inputs=("Logits", "Labels", "CustomizedSamples",
+                     "CustomizedProbabilities"),
+             outputs=("Samples", "Probabilities", "SampledLogits",
+                      "SampledLabels"),
+             is_random=True, non_diff_inputs=("Labels",
+                                              "CustomizedSamples",
+                                              "CustomizedProbabilities"))
+def _sample_logits(ctx, ins, attrs):
+    """Sampled-softmax helper (operators/sample_logits_op.cc): gather
+    the NT true-label logits plus S sampled negatives per row, subtract
+    log Q(y) (the log-uniform sampler's probability, math_function's
+    LogUniformSampler), and mask accidental hits. SampledLabels are
+    0..NT-1 (the true labels occupy the leading columns)."""
+    logits = ins["Logits"][0]
+    labels = ins["Labels"][0].astype(jnp.int64)
+    n, k = logits.shape
+    nt = labels.shape[1]
+    s = int(attrs.get("num_samples", 5))
+    if attrs.get("use_customized_samples", False):
+        samples = ins["CustomizedSamples"][0].astype(jnp.int64)
+        probs = ins["CustomizedProbabilities"][0]
+    else:
+        # log-uniform (Zipfian) sampling: P(c) = log(c+2)-log(c+1) /
+        # log(K+1) — the reference's LogUniformSampler distribution
+        u = jax.random.uniform(ctx.rng(), (n, s))
+        neg = (jnp.exp(u * jnp.log(float(k + 1))) - 1.0) \
+            .astype(jnp.int64).clip(0, k - 1)
+        samples = jnp.concatenate([labels, neg], axis=1)
+        probs = (jnp.log(samples.astype(jnp.float32) + 2.0)
+                 - jnp.log(samples.astype(jnp.float32) + 1.0)) \
+            / jnp.log(float(k + 1))
+    sampled = jnp.take_along_axis(logits, samples.astype(jnp.int32),
+                                  axis=1)
+    sampled = sampled - jnp.log(probs + 1e-20)
+    if attrs.get("remove_accidental_hits", True):
+        # a negative column equal to any true label of its row is an
+        # accidental hit: suppress it so softmax ignores the duplicate
+        hit = (samples[:, None, :] == labels[:, :, None]).any(axis=1)
+        col_is_neg = jnp.arange(samples.shape[1]) >= nt
+        sampled = jnp.where(hit & col_is_neg[None, :],
+                            sampled - 1e20, sampled)
+    sampled_labels = jnp.tile(jnp.arange(nt, dtype=jnp.int64), (n, 1))
+    return {"Samples": [samples], "Probabilities": [probs],
+            "SampledLogits": [sampled], "SampledLabels": [sampled_labels]}

@@ -429,3 +429,178 @@ def _fused_embedding_seq_pool(ctx, ins, attrs):
     lengths = _lengths(ins, emb)
     mask = _time_mask(emb, lengths)
     return one(jnp.sum(jnp.where(mask, emb, 0), axis=1))
+
+
+# --------------------------------------------------------------------------
+# round-3 parity tail: sequence_scatter, sequence_topk_avg_pooling,
+# shrink_rnn_memory, lod_tensor_to_array / array_to_lod_tensor,
+# filter_by_instag, var_conv_2d
+# --------------------------------------------------------------------------
+
+@register_op("sequence_scatter", inputs=("X", "Ids", "Updates", "SeqLen"),
+             non_diff_inputs=("Ids", "SeqLen"))
+def _sequence_scatter(ctx, ins, attrs):
+    """Per-row scatter-ADD of a ragged update list
+    (operators/sequence_ops/sequence_scatter_op.cc: for sequence i,
+    X[i, ids_i[j]] += updates_i[j]). Padded repr: Ids/Updates are
+    [B, T] with SeqLen valid entries per row."""
+    x = ins["X"][0]
+    ids = ins["Ids"][0].astype(jnp.int32)
+    upd = ins["Updates"][0]
+    lens = _lengths({"SeqLen": ins.get("SeqLen")}, ids)
+    mask = jnp.arange(ids.shape[1])[None, :] < lens[:, None]
+    upd = jnp.where(mask, upd, 0.0)
+    # masked-out ids scatter 0 to column 0 — harmless for the add
+    ids = jnp.where(mask, ids, 0)
+    b = x.shape[0]
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], ids.shape)
+    out = x.at[rows, ids].add(upd.astype(x.dtype))
+    return {"Out": [out]}
+
+
+@register_op("sequence_topk_avg_pooling",
+             inputs=("X", "ROW", "COLUMN"),
+             outputs=("Out", "pos"),
+             non_diff_inputs=("ROW", "COLUMN"))
+def _sequence_topk_avg_pooling(ctx, ins, attrs):
+    """Top-k average pooling over the column axis of per-pair score
+    maps (operators/sequence_ops/sequence_topk_avg_pooling_op.h:164:
+    out[..., k] = sum(topk_vals[:topks[k]]) / topks[k] — the divisor is
+    ALWAYS topks[k]; short rows contribute zeros). Padded repr:
+    X [B, C, R, Cmax]; ROW/COLUMN carry the valid row/col counts [B]."""
+    x = ins["X"][0]
+    row_len = ins["ROW"][0].astype(jnp.int32)
+    col_len = ins["COLUMN"][0].astype(jnp.int32)
+    topks = [int(k) for k in attrs.get("topks", [1])]
+    b, c, r, cm = x.shape
+    col_mask = jnp.arange(cm)[None, :] < col_len[:, None]  # [B, Cmax]
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    xm = jnp.where(col_mask[:, None, None, :], x, neg)
+    vals = -jnp.sort(-xm, axis=-1)  # desc
+    vals = jnp.where(jnp.isfinite(vals), vals, 0.0)  # zero the padding
+    csum = jnp.cumsum(vals, axis=-1)
+    # k can exceed the padded column count: sum what exists, still
+    # divide by k (reference pads TopKPosPaddingId -> zero contribution)
+    outs = [csum[..., min(k, cm) - 1] / k for k in topks]  # [B, C, R]
+    out = jnp.stack(outs, axis=-1)  # [B, C, R, K]
+    # rows beyond the valid row count emit 0
+    row_mask = (jnp.arange(r)[None, :] < row_len[:, None])[:, None, :,
+                                                           None]
+    out = jnp.where(row_mask, out, 0.0)
+    # reference layout: [rows, channel*K]
+    out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, r, c * len(topks))
+    return {"Out": [out], "pos": [jnp.zeros((1,), jnp.int32)]}
+
+
+@register_op("shrink_rnn_memory", inputs=("X", "I", "RankTable"),
+             outputs=("Out", "OutLen"), non_diff_inputs=("I", "RankTable"))
+def _shrink_rnn_memory(ctx, ins, attrs):
+    """DynamicRNN memory shrink (operators/shrink_rnn_memory_op.cc): at
+    step I only the sequences still active (length > I) keep state. The
+    reference physically slices the first k rows (rank table sorts
+    sequences by decreasing length); the TPU-static version keeps the
+    [B, D] shape, ZEROES the inactive rows, and emits the active count
+    as OutLen — downstream masked ops see identical values."""
+    x = ins["X"][0]
+    step = ins["I"][0].astype(jnp.int32).reshape(())
+    lens = ins["RankTable"][0].astype(jnp.int32)
+    active = lens > step
+    shape = [x.shape[0]] + [1] * (x.ndim - 1)
+    out = jnp.where(active.reshape(shape), x, 0)
+    return {"Out": [out], "OutLen": [active.sum().astype(jnp.int32)]}
+
+
+@register_op("lod_tensor_to_array", inputs=("X", "SeqLen"),
+             outputs=("Out",), non_diff_inputs=("SeqLen",))
+def _lod_tensor_to_array(ctx, ins, attrs):
+    """Split a padded batch into per-timestep slices for DynamicRNN
+    (operators/lod_tensor_to_array_op.cc). The reference emits a
+    TensorArray whose t-th entry holds the rows active at step t; the
+    TPU-static version emits a stacked [T, B, ...] tensor with inactive
+    rows zeroed (pairs with shrink_rnn_memory/array_to_lod_tensor)."""
+    x = ins["X"][0]
+    lens = _lengths({"SeqLen": ins.get("SeqLen")}, x)
+    t = x.shape[1]
+    steps = jnp.moveaxis(x, 1, 0)  # [T, B, ...]
+    mask = (jnp.arange(t)[:, None] < lens[None, :])
+    mshape = list(mask.shape) + [1] * (x.ndim - 2)
+    return {"Out": [jnp.where(mask.reshape(mshape), steps, 0)]}
+
+
+@register_op("array_to_lod_tensor", inputs=("X", "SeqLen"),
+             outputs=("Out",), non_diff_inputs=("SeqLen",))
+def _array_to_lod_tensor(ctx, ins, attrs):
+    """Inverse bridge (operators/array_to_lod_tensor_op.cc): stack the
+    per-step [T, B, ...] slices back into the padded [B, T, ...]
+    batch, re-masking by SeqLen."""
+    arr = ins["X"][0]
+    x = jnp.moveaxis(arr, 0, 1)  # [B, T, ...]
+    lens = _lengths({"SeqLen": ins.get("SeqLen")}, x)
+    return {"Out": [x * _time_mask(x, lens).astype(x.dtype)]}
+
+
+@register_op("filter_by_instag", inputs=("Ins", "Ins_tag", "Filter_tag",
+                                         "TagLen"),
+             outputs=("Out", "LossWeight", "IndexMap"),
+             non_diff_inputs=("Ins_tag", "Filter_tag", "TagLen"))
+def _filter_by_instag(ctx, ins, attrs):
+    """Instance-tag filtering (operators/filter_by_instag_op.cc): keep
+    rows whose tag set intersects Filter_tag. The reference compacts
+    the kept rows into a smaller LoDTensor; the TPU-static version
+    keeps [N, D] and writes LossWeight 1/0 per row (out_val_if_empty
+    semantics preserved: dropped rows are zeroed) — multiplying the
+    loss by LossWeight reproduces the reference's training effect."""
+    x = ins["Ins"][0]
+    tags = ins["Ins_tag"][0].astype(jnp.int64)       # [N, Tmax]
+    filt = ins["Filter_tag"][0].astype(jnp.int64)    # [F]
+    if ins.get("TagLen"):
+        tlen = ins["TagLen"][0].astype(jnp.int32)
+        tmask = jnp.arange(tags.shape[1])[None, :] < tlen[:, None]
+    else:
+        tmask = jnp.ones(tags.shape, bool)
+    hit = ((tags[:, :, None] == filt[None, None, :])
+           & tmask[:, :, None]).any(axis=(1, 2))
+    shape = [x.shape[0]] + [1] * (x.ndim - 1)
+    out = jnp.where(hit.reshape(shape), x, 0)
+    lw = hit.astype(jnp.float32)[:, None]
+    idx = jnp.where(hit, jnp.arange(x.shape[0]), -1).astype(jnp.int32)
+    return {"Out": [out], "LossWeight": [lw], "IndexMap": [idx]}
+
+
+@register_op("var_conv_2d", inputs=("X", "ROW", "COLUMN", "W"),
+             outputs=("Out",), non_diff_inputs=("ROW", "COLUMN"))
+def _var_conv_2d(ctx, ins, attrs):
+    """Variable-size 2d conv for text matching
+    (operators/var_conv_2d_op.cc: each pair's [row_i x col_i] map gets
+    its own conv; kernel W is [output_channel, input_channel*kh*kw]).
+    Padded repr: X [B, Cin, Rmax, Cmax] with per-pair valid extents —
+    one batched lax conv with the invalid region masked to 0 before AND
+    after (zero padding contributes zeros exactly like the reference's
+    per-pair tight conv at 'same' boundaries)."""
+    x = ins["X"][0]
+    row_len = ins["ROW"][0].astype(jnp.int32)
+    col_len = ins["COLUMN"][0].astype(jnp.int32)
+    w = ins["W"][0]
+    oc = int(attrs.get("output_channel", w.shape[0]))
+    ic = x.shape[1]
+    kh, kw = int(attrs.get("kernel_h", 3)), int(attrs.get("kernel_w", 3))
+    sh, sw = int(attrs.get("stride_h", 1)), int(attrs.get("stride_w", 1))
+    b, _, r, cm = x.shape
+    rmask = (jnp.arange(r)[None, :] < row_len[:, None])[:, None, :, None]
+    cmask = (jnp.arange(cm)[None, :] < col_len[:, None])[:, None, None, :]
+    xm = jnp.where(rmask & cmask, x, 0)
+    wk = w.reshape(oc, ic, kh, kw)
+    dn = jax.lax.conv_dimension_numbers(xm.shape, wk.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+    out = jax.lax.conv_general_dilated(
+        xm, wk, window_strides=(sh, sw),
+        padding=[((kh - 1) // 2, kh // 2), ((kw - 1) // 2, kw // 2)],
+        dimension_numbers=dn)
+    ro, co = out.shape[2], out.shape[3]
+    out_rlen = (row_len + sh - 1) // sh
+    out_clen = (col_len + sw - 1) // sw
+    rmask_o = (jnp.arange(ro)[None, :] < out_rlen[:, None])[:, None, :,
+                                                            None]
+    cmask_o = (jnp.arange(co)[None, :] < out_clen[:, None])[:, None,
+                                                            None, :]
+    return {"Out": [jnp.where(rmask_o & cmask_o, out, 0)]}

@@ -1,0 +1,612 @@
+"""Round-3 op parity sweep tests: the ~29 checklist ops VERDICT r2
+missing #2 lists, each against a numpy oracle (OpTest discipline,
+reference op_test.py:948) with grad checks for the differentiable ones.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import REGISTRY, LowerCtx
+import paddle_tpu.ops  # noqa: F401  (registers everything)
+
+
+def run_op(name, ins, attrs=None, rng=None):
+    """Lower one op eagerly with list-of-array slots."""
+    opdef = REGISTRY.get(name)
+    ins = {k: [jnp.asarray(a) for a in (v if isinstance(v, list) else [v])]
+           for k, v in ins.items() if v is not None}
+    ctx = LowerCtx(rng if rng is not None else jax.random.PRNGKey(0))
+    return opdef.lower(ctx, ins, attrs or {})
+
+
+# ---------------------------------------------------------------------------
+# io ops
+# ---------------------------------------------------------------------------
+
+def test_save_load_roundtrip(tmp_path):
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    p = str(tmp_path / "var.npy")
+    run_op("save", {"X": x}, {"file_path": p})
+    out = run_op("load", {}, {"file_path": p})["Out"][0]
+    np.testing.assert_array_equal(np.asarray(out), x)
+    # fp16 round trip upcasts on load
+    run_op("save", {"X": x}, {"file_path": p, "save_as_fp16": True})
+    out16 = np.asarray(run_op("load", {}, {"file_path": p})["Out"][0])
+    assert out16.dtype == np.float32
+    np.testing.assert_allclose(out16, x, atol=1e-2)
+
+
+def test_save_no_overwrite(tmp_path):
+    p = str(tmp_path / "v.npy")
+    run_op("save", {"X": np.zeros(2, np.float32)}, {"file_path": p})
+    with pytest.raises(RuntimeError, match="overwrite"):
+        run_op("save", {"X": np.zeros(2, np.float32)},
+               {"file_path": p, "overwrite": False})
+
+
+def test_save_load_combine(tmp_path):
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    b = np.arange(4, dtype=np.int64)
+    p = str(tmp_path / "combined")
+    run_op("save_combine", {"X": [a, b]}, {"file_path": p})
+    outs = run_op("load_combine", {}, {"file_path": p})["Out"]
+    np.testing.assert_array_equal(np.asarray(outs[0]), a)
+    np.testing.assert_array_equal(np.asarray(outs[1]), b)
+
+
+def test_py_func():
+    from paddle_tpu.ops.io_ops import register_py_func
+    fid = register_py_func(lambda a, b: a @ b + 1.0)
+    x = np.random.RandomState(1).randn(2, 3).astype(np.float32)
+    y = np.random.RandomState(2).randn(3, 2).astype(np.float32)
+    out = run_op("py_func", {"X": [x, y]},
+                 {"forward_callable_id": fid})["Out"][0]
+    np.testing.assert_allclose(np.asarray(out), x @ y + 1.0, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# nn ops
+# ---------------------------------------------------------------------------
+
+def test_sync_batch_norm_matches_batch_norm():
+    r = np.random.RandomState(3)
+    x = r.randn(4, 3, 5, 5).astype(np.float32)
+    args = {"X": x, "Scale": np.ones(3, np.float32),
+            "Bias": np.zeros(3, np.float32),
+            "Mean": np.zeros(3, np.float32),
+            "Variance": np.ones(3, np.float32)}
+    o1 = run_op("batch_norm", dict(args))
+    o2 = run_op("sync_batch_norm", dict(args))
+    np.testing.assert_allclose(np.asarray(o1["Y"][0]),
+                               np.asarray(o2["Y"][0]), atol=1e-6)
+
+
+def test_conv3d_transpose_shape_and_oracle():
+    r = np.random.RandomState(4)
+    x = r.randn(1, 2, 3, 4, 4).astype(np.float32)
+    w = r.randn(2, 3, 2, 2, 2).astype(np.float32)  # [in, out, kd,kh,kw]
+    out = np.asarray(run_op("conv3d_transpose",
+                            {"Input": x, "Filter": w},
+                            {"strides": [2, 2, 2]})["Output"][0])
+    assert out.shape == (1, 3, 6, 8, 8)
+    # oracle: scatter-accumulate definition of transpose conv
+    ref = np.zeros_like(out)
+    for d in range(3):
+        for i in range(4):
+            for j in range(4):
+                for kd in range(2):
+                    for ki in range(2):
+                        for kj in range(2):
+                            ref[0, :, 2*d+kd, 2*i+ki, 2*j+kj] += np.einsum(
+                                "c,co->o", x[0, :, d, i, j],
+                                w[:, :, kd, ki, kj])
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_sample_logits():
+    r = np.random.RandomState(5)
+    logits = r.randn(4, 20).astype(np.float32)
+    labels = r.randint(0, 20, (4, 1)).astype(np.int64)
+    o = run_op("sample_logits", {"Logits": logits, "Labels": labels},
+               {"num_samples": 6}, rng=jax.random.PRNGKey(7))
+    samples = np.asarray(o["Samples"][0])
+    assert samples.shape == (4, 7)
+    np.testing.assert_array_equal(samples[:, 0], labels[:, 0])
+    sl = np.asarray(o["SampledLogits"][0])
+    probs = np.asarray(o["Probabilities"][0])
+    gathered = np.take_along_axis(logits, samples.astype(np.int64), 1)
+    expect = gathered - np.log(probs + 1e-20)
+    # non-accidental entries match gather - logQ
+    hit = (samples[:, None, :] == labels[:, :, None]).any(1)
+    hit[:, 0] = False
+    np.testing.assert_allclose(sl[~hit], expect[~hit], rtol=1e-5)
+    assert (sl[hit] < -1e18).all()
+    np.testing.assert_array_equal(
+        np.asarray(o["SampledLabels"][0]), np.zeros((4, 1), np.int64))
+
+
+# ---------------------------------------------------------------------------
+# sequence / LoD tail
+# ---------------------------------------------------------------------------
+
+def test_sequence_scatter():
+    x = np.zeros((2, 6), np.float32)
+    ids = np.array([[1, 3, 1], [0, 5, 0]], np.int32)
+    upd = np.array([[1., 2., 3.], [4., 5., 6.]], np.float32)
+    lens = np.array([3, 2], np.int32)
+    out = np.asarray(run_op("sequence_scatter",
+                            {"X": x, "Ids": ids, "Updates": upd,
+                             "SeqLen": lens})["Out"][0])
+    ref = np.zeros((2, 6), np.float32)
+    ref[0, 1] += 1 + 3
+    ref[0, 3] += 2
+    ref[1, 0] += 4
+    ref[1, 5] += 5
+    np.testing.assert_allclose(out, ref)
+
+
+def test_sequence_topk_avg_pooling():
+    r = np.random.RandomState(6)
+    x = r.randn(2, 3, 4, 5).astype(np.float32)  # [B, C, R, Cmax]
+    row = np.array([4, 2], np.int32)
+    col = np.array([5, 3], np.int32)
+    topks = [1, 3]
+    out = np.asarray(run_op(
+        "sequence_topk_avg_pooling",
+        {"X": x, "ROW": row, "COLUMN": col},
+        {"topks": topks, "channel_num": 3})["Out"][0])
+    assert out.shape == (2, 4, 6)
+    # oracle (reference sequence_topk_avg_pooling_op.h:164)
+    for b in range(2):
+        for c in range(3):
+            for rr in range(4):
+                vals = np.sort(x[b, c, rr, :col[b]])[::-1]
+                for ki, k in enumerate(topks):
+                    exp = vals[:k].sum() / k if rr < row[b] else 0.0
+                    got = out[b, rr, c * len(topks) + ki]
+                    np.testing.assert_allclose(got, exp, rtol=2e-5,
+                                               atol=1e-6)
+
+
+def test_shrink_rnn_memory_and_lod_array_bridges():
+    r = np.random.RandomState(7)
+    x = r.randn(3, 4, 2).astype(np.float32)  # [B, T, D]
+    lens = np.array([4, 2, 1], np.int32)
+    arr = np.asarray(run_op("lod_tensor_to_array",
+                            {"X": x, "SeqLen": lens})["Out"][0])
+    assert arr.shape == (4, 3, 2)
+    # step t keeps rows with len > t
+    for t in range(4):
+        for b in range(3):
+            if lens[b] > t:
+                np.testing.assert_allclose(arr[t, b], x[b, t])
+            else:
+                assert (arr[t, b] == 0).all()
+    back = np.asarray(run_op("array_to_lod_tensor",
+                             {"X": arr, "SeqLen": lens})["Out"][0])
+    masked = x * (np.arange(4)[None, :, None] < lens[:, None, None])
+    np.testing.assert_allclose(back, masked)
+
+    sh = run_op("shrink_rnn_memory",
+                {"X": x[:, 0, :], "I": np.asarray([1], np.int32),
+                 "RankTable": lens})
+    out, k = np.asarray(sh["Out"][0]), int(np.asarray(sh["OutLen"][0]))
+    assert k == 2  # lens > 1 -> rows 0,1
+    np.testing.assert_allclose(out[:2], x[:2, 0, :])
+    assert (out[2] == 0).all()
+
+
+def test_filter_by_instag():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    tags = np.array([[1, 2], [3, 0], [5, 6], [2, 9]], np.int64)
+    filt = np.array([2, 5], np.int64)
+    o = run_op("filter_by_instag",
+               {"Ins": x, "Ins_tag": tags, "Filter_tag": filt})
+    lw = np.asarray(o["LossWeight"][0]).reshape(-1)
+    np.testing.assert_array_equal(lw, [1, 0, 1, 1])
+    out = np.asarray(o["Out"][0])
+    np.testing.assert_allclose(out[1], 0)
+    np.testing.assert_allclose(out[0], x[0])
+
+
+def test_var_conv_2d_masks_invalid_region():
+    r = np.random.RandomState(8)
+    x = r.randn(2, 1, 6, 6).astype(np.float32)
+    w = r.randn(2, 1 * 3 * 3).astype(np.float32)
+    row = np.array([6, 3], np.int32)
+    col = np.array([6, 2], np.int32)
+    out = np.asarray(run_op(
+        "var_conv_2d", {"X": x, "ROW": row, "COLUMN": col, "W": w},
+        {"output_channel": 2, "input_channel": 1,
+         "kernel_h": 3, "kernel_w": 3})["Out"][0])
+    assert out.shape == (2, 2, 6, 6)
+    assert (out[1, :, 3:, :] == 0).all() and (out[1, :, :, 2:] == 0).all()
+    assert np.abs(out[0]).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def test_chunk_eval_iob():
+    # tags: type*2 + {B=0, I=1}; 2 chunk types
+    # seq: B0 I0 O B1 -> chunks (0,1,t0), (3,3,t1)
+    O = 4  # "other" tag = num_chunk_types*num_tag_types
+    inf = np.array([[0, 1, O, 2]], np.int64)
+    lab = np.array([[0, 1, O, 0]], np.int64)
+    o = run_op("chunk_eval", {"Inference": inf, "Label": lab},
+               {"num_chunk_types": 2, "chunk_scheme": "IOB"})
+    assert int(np.asarray(o["NumInferChunks"][0])) == 2
+    assert int(np.asarray(o["NumLabelChunks"][0])) == 2
+    assert int(np.asarray(o["NumCorrectChunks"][0])) == 1
+    np.testing.assert_allclose(np.asarray(o["Precision"][0]), 0.5)
+    np.testing.assert_allclose(np.asarray(o["F1-Score"][0]), 0.5)
+
+
+def test_positive_negative_pair():
+    score = np.array([3., 1., 2., 5.], np.float32)[:, None]
+    label = np.array([1, 0, 0, 1], np.int64)
+    qid = np.array([0, 0, 0, 1], np.int64)
+    o = run_op("positive_negative_pair",
+               {"Score": score, "Label": label, "QueryID": qid})
+    # query 0 pairs: (0,1): 3>1 pos; (0,2): 3>2 pos. query 1: no pairs
+    assert float(np.asarray(o["PositivePair"][0])) == 2.0
+    assert float(np.asarray(o["NegativePair"][0])) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# tdm / ctr
+# ---------------------------------------------------------------------------
+
+def _tree_info():
+    # node: [item_id, layer, ancestor, child0, child1]
+    # tree: 1 -> (2, 3); 2 -> (4, 5); 3, 4, 5 leaves
+    info = np.zeros((6, 5), np.int32)
+    info[1] = [0, 0, 0, 2, 3]
+    info[2] = [0, 1, 1, 4, 5]
+    info[3] = [3, 1, 1, 0, 0]
+    info[4] = [4, 2, 2, 0, 0]
+    info[5] = [5, 2, 2, 0, 0]
+    return info
+
+
+def test_tdm_child():
+    o = run_op("tdm_child", {"X": np.array([[1], [2], [3]], np.int32),
+                             "TreeInfo": _tree_info()},
+               {"child_nums": 2})
+    child = np.asarray(o["Child"][0]).reshape(3, 2)
+    mask = np.asarray(o["LeafMask"][0]).reshape(3, 2)
+    np.testing.assert_array_equal(child, [[2, 3], [4, 5], [0, 0]])
+    np.testing.assert_array_equal(mask, [[0, 1], [1, 1], [0, 0]])
+
+
+def test_tdm_sampler():
+    travel = np.zeros((6, 2), np.int32)
+    travel[4] = [2, 4]  # leaf 4's path: layer0 node 2, layer1 node 4
+    travel[5] = [3, 5]
+    layer = np.array([2, 3, 4, 5], np.int32)  # layer0: [2,3], layer1: [4,5]
+    o = run_op("tdm_sampler",
+               {"X": np.array([[4], [5]], np.int32), "Travel": travel,
+                "Layer": layer},
+               {"neg_samples_num_list": [1, 1],
+                "layer_offset_lod": [0, 2, 4],
+                "output_positive": True},
+               rng=jax.random.PRNGKey(0))
+    out = np.asarray(o["Out"][0]).reshape(2, 4)
+    lab = np.asarray(o["Labels"][0]).reshape(2, 4)
+    np.testing.assert_array_equal(lab, [[1, 0, 1, 0]] * 2)
+    # positives are the path nodes; negatives the other layer node
+    assert out[0, 0] == 2 and out[0, 1] == 3
+    assert out[0, 2] == 4 and out[0, 3] == 5
+    assert out[1, 0] == 3 and out[1, 1] == 2
+
+
+def test_rank_attention():
+    r = np.random.RandomState(9)
+    n, d, pcol, mr = 3, 4, 2, 2
+    x = r.randn(n, d).astype(np.float32)
+    param = r.randn(mr * mr * d, pcol).astype(np.float32)
+    # row 0: rank 1, one pair (faster rank 2 -> ins 1)
+    ro = np.array([[1, 1, 0, 2, 1],
+                   [2, 1, 0, 2, 1],
+                   [0, 0, 0, 0, 0]], np.int32)
+    o = run_op("rank_attention",
+               {"X": x, "RankOffset": ro, "RankParam": param},
+               {"MaxRank": mr})
+    out = np.asarray(o["Out"][0])
+    blocks = param.reshape(mr * mr, d, pcol)
+    exp0 = x[0] @ blocks[0 * mr + 0] + x[1] @ blocks[0 * mr + 1]
+    np.testing.assert_allclose(out[0], exp0, rtol=1e-5)
+    assert (out[2] == 0).all()  # rank 0 -> invalid
+
+
+def test_pyramid_hash_deterministic_and_grad():
+    r = np.random.RandomState(10)
+    x = np.array([[3, 7, 7, 1], [2, 2, 0, 0]], np.int32)
+    w = r.randn(50, 4).astype(np.float32)
+    lens = np.array([4, 2], np.int32)
+    attrs = {"num_emb": 8, "rand_len": 4, "space_len": 49,
+             "pyramid_layer": 3}
+    o1 = np.asarray(run_op("pyramid_hash",
+                           {"X": x, "W": w, "SeqLen": lens},
+                           attrs)["Out"][0])
+    o2 = np.asarray(run_op("pyramid_hash",
+                           {"X": x, "W": w, "SeqLen": lens},
+                           attrs)["Out"][0])
+    np.testing.assert_array_equal(o1, o2)
+    assert o1.shape == (2, 4, 8)
+    assert (o1[1, 2:] == 0).all()  # beyond seq len
+
+    def loss(wv):
+        from paddle_tpu.ops.ctr_extra import _pyramid_hash
+        out = run_op("pyramid_hash", {"X": x, "W": wv, "SeqLen": lens},
+                     attrs)["Out"][0]
+        return (out * out).sum()
+    g = jax.grad(lambda wv: loss(wv))(jnp.asarray(w))
+    assert np.isfinite(np.asarray(g)).all() and np.abs(g).sum() > 0
+
+
+def test_tree_conv_shapes_and_grad():
+    r = np.random.RandomState(11)
+    nodes = r.randn(2, 5, 3).astype(np.float32)
+    edges = np.array([[[0, 1], [0, 2], [1, 3]],
+                      [[0, 1], [0, 0], [0, 0]]], np.int32)
+    filt = r.randn(3, 3, 4, 2).astype(np.float32)
+    out = np.asarray(run_op("tree_conv",
+                            {"NodesVector": nodes, "EdgeSet": edges,
+                             "Filter": filt})["Out"][0])
+    assert out.shape == (2, 5, 4, 2)
+
+    def loss(f):
+        return (run_op("tree_conv", {"NodesVector": nodes,
+                                     "EdgeSet": edges,
+                                     "Filter": f})["Out"][0] ** 2).sum()
+    g = jax.grad(loss)(jnp.asarray(filt))
+    assert np.isfinite(np.asarray(g)).all()
+
+
+# ---------------------------------------------------------------------------
+# detection tail
+# ---------------------------------------------------------------------------
+
+def test_generate_proposals():
+    r = np.random.RandomState(12)
+    n, a, h, w = 1, 3, 4, 4
+    scores = r.rand(n, a, h, w).astype(np.float32)
+    deltas = (r.randn(n, 4 * a, h, w) * 0.1).astype(np.float32)
+    anchors = np.zeros((h, w, a, 4), np.float32)
+    for i in range(h):
+        for j in range(w):
+            for k in range(a):
+                cx, cy = j * 16 + 8, i * 16 + 8
+                s = 16 * (k + 1)
+                anchors[i, j, k] = [cx - s/2, cy - s/2, cx + s/2, cy + s/2]
+    im_info = np.array([[64., 64., 1.0]], np.float32)
+    o = run_op("generate_proposals",
+               {"Scores": scores, "BboxDeltas": deltas,
+                "ImInfo": im_info, "Anchors": anchors},
+               {"pre_nms_topN": 20, "post_nms_topN": 10,
+                "nms_thresh": 0.7, "min_size": 4.0})
+    rois = np.asarray(o["RpnRois"][0])
+    num = int(np.asarray(o["RpnRoisNum"][0])[0])
+    assert rois.shape == (10, 4)
+    assert 0 < num <= 10
+    valid = rois[:num]
+    assert (valid[:, 2] >= valid[:, 0]).all()
+    assert (valid[:, 0] >= 0).all() and (valid[:, 2] <= 63).all()
+
+
+def test_rpn_target_assign():
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [0, 0, 9, 9], [100, 100, 110, 110]], np.float32)
+    gt = np.array([[[0, 0, 10, 10]]], np.float32)
+    o = run_op("rpn_target_assign",
+               {"Anchor": anchors, "GtBoxes": gt,
+                "GtNum": np.array([1], np.int32)},
+               {"rpn_batch_size_per_im": 4, "rpn_fg_fraction": 0.5,
+                "rpn_positive_overlap": 0.7,
+                "rpn_negative_overlap": 0.3},
+               rng=jax.random.PRNGKey(1))
+    label = np.asarray(o["TargetLabel"][0])[0]
+    loc = np.asarray(o["LocationIndex"][0])[0]
+    # anchor 0 overlaps gt exactly -> positive; anchor 3 far -> negative
+    pos_anchors = set(loc[loc >= 0].tolist())
+    assert 0 in pos_anchors
+    assert (label == 1).sum() >= 1 and (label == 0).sum() >= 1
+
+
+def test_yolov3_loss_finite_and_responsive():
+    r = np.random.RandomState(13)
+    n, cls, h, w = 2, 3, 4, 4
+    a_mask = [0, 1]
+    anchors = [10, 13, 16, 30, 33, 23]
+    x = (r.randn(n, 2 * (5 + cls), h, w) * 0.1).astype(np.float32)
+    gt = np.zeros((n, 2, 4), np.float32)
+    gt[0, 0] = [0.5, 0.5, 0.2, 0.3]
+    lab = np.zeros((n, 2), np.int32)
+    attrs = {"anchors": anchors, "anchor_mask": a_mask,
+             "class_num": cls, "ignore_thresh": 0.7,
+             "downsample_ratio": 32}
+    loss = np.asarray(run_op("yolov3_loss",
+                             {"X": x, "GTBox": gt, "GTLabel": lab},
+                             attrs)["Loss"][0])
+    assert loss.shape == (n,)
+    assert np.isfinite(loss).all()
+    # image 0 has a gt -> strictly larger loss than empty image's
+    assert loss[0] > loss[1]
+
+    def f(xv):
+        return run_op("yolov3_loss", {"X": xv, "GTBox": gt,
+                                      "GTLabel": lab}, attrs)["Loss"][0].sum()
+    g = jax.grad(f)(jnp.asarray(x))
+    assert np.isfinite(np.asarray(g)).all() and np.abs(g).sum() > 0
+
+
+def test_retinanet_detection_output():
+    r = np.random.RandomState(14)
+    n, a, c = 1, 6, 3
+    deltas = (r.randn(n, a, 4) * 0.05).astype(np.float32)
+    scores = jax.nn.sigmoid(jnp.asarray(
+        r.randn(n, a, c).astype(np.float32) * 2))
+    anchors = np.array([[i * 10, i * 10, i * 10 + 20, i * 10 + 20]
+                        for i in range(a)], np.float32)
+    o = run_op("retinanet_detection_output",
+               {"BBoxes": [deltas], "Scores": [np.asarray(scores)],
+                "Anchors": [anchors],
+                "ImInfo": np.array([[100., 100., 1.]], np.float32)},
+               {"score_threshold": 0.05, "nms_top_k": 6,
+                "keep_top_k": 5, "nms_threshold": 0.3})
+    out = np.asarray(o["Out"][0])
+    num = int(np.asarray(o["OutNum"][0])[0])
+    assert out.shape == (1, 5, 6)
+    assert 0 < num <= 5
+    assert (out[0, :num, 1] > 0).all()  # scores
+    labels = out[0, :num, 0]
+    assert ((labels >= 0) & (labels < c)).all()
+
+
+def test_locality_aware_nms_merges():
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                       [50, 50, 60, 60]]], np.float32)
+    scores = np.array([[[0.6, 0.8, 0.9]]], np.float32)
+    out = np.asarray(run_op("locality_aware_nms",
+                            {"BBoxes": boxes, "Scores": scores},
+                            {"nms_threshold": 0.5,
+                             "score_threshold": 0.1})["Out"][0])
+    valid = out[out[:, 1] > 0]
+    assert len(valid) == 2  # two clusters
+    merged = valid[valid[:, 1] > 1.0]  # merged score = 0.6+0.8
+    assert len(merged) == 1
+    np.testing.assert_allclose(
+        merged[0, 2:],
+        (np.array([0, 0, 10, 10.]) * 0.6
+         + np.array([1, 1, 11, 11.]) * 0.8) / 1.4, rtol=1e-5)
+
+
+def test_mine_hard_examples():
+    cls_loss = np.array([[5., 4., 3., 2., 1., 0.5]], np.float32)
+    match = np.array([[0, -1, -1, -1, 1, -1]], np.int32)
+    o = run_op("mine_hard_examples",
+               {"ClsLoss": cls_loss, "MatchIndices": match},
+               {"neg_pos_ratio": 1.0})
+    neg = np.asarray(o["NegIndices"][0])[0]
+    nn = int(np.asarray(o["NegNum"][0])[0])
+    assert nn == 2  # 2 pos * ratio 1.0
+    assert set(neg[neg >= 0].tolist()) == {1, 2}  # highest-loss negs
+
+
+def test_prroi_pool_exact_on_constant():
+    # constant image -> every bin integrates to the constant
+    x = np.full((1, 2, 8, 8), 3.0, np.float32)
+    rois = np.array([[1.0, 1.0, 6.0, 6.0]], np.float32)
+    out = np.asarray(run_op("prroi_pool",
+                            {"X": x, "ROIs": rois},
+                            {"pooled_height": 2, "pooled_width": 2,
+                             "spatial_scale": 1.0})["Out"][0])
+    assert out.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(out, 3.0, rtol=1e-5)
+
+
+def test_prroi_pool_linear_ramp():
+    # f(x,y) = x: integral average over bin = bin x-center
+    xs = np.arange(8, dtype=np.float32)
+    img = np.broadcast_to(xs[None, None, None, :],
+                          (1, 1, 8, 8)).copy()
+    rois = np.array([[2.0, 2.0, 6.0, 6.0]], np.float32)
+    out = np.asarray(run_op("prroi_pool", {"X": img, "ROIs": rois},
+                            {"pooled_height": 1, "pooled_width": 2,
+                             "spatial_scale": 1.0})["Out"][0])
+    # bins x in [2,4] and [4,6] -> centers 3 and 5
+    np.testing.assert_allclose(out[0, 0, 0], [3.0, 5.0], rtol=1e-5)
+
+
+def test_psroi_pool():
+    # 8 channels = 2 out_c * 2x2 bins; constant per channel
+    c = np.arange(8, dtype=np.float32)
+    x = np.broadcast_to(c[None, :, None, None], (1, 8, 8, 8)).copy()
+    rois = np.array([[0.0, 0.0, 8.0, 8.0]], np.float32)
+    out = np.asarray(run_op("psroi_pool", {"X": x, "ROIs": rois},
+                            {"pooled_height": 2, "pooled_width": 2,
+                             "output_channels": 2,
+                             "spatial_scale": 1.0})["Out"][0])
+    assert out.shape == (1, 2, 2, 2)
+    # out_c k bin (i,j) = channel k*4 + i*2 + j
+    expect = c.reshape(2, 2, 2)
+    np.testing.assert_allclose(out[0], expect, rtol=1e-5)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    r = np.random.RandomState(15)
+    x = r.randn(1, 2, 6, 6).astype(np.float32)
+    w = r.randn(3, 2, 3, 3).astype(np.float32)
+    offset = np.zeros((1, 2 * 1 * 9, 4, 4), np.float32)
+    mask = np.ones((1, 9, 4, 4), np.float32)
+    out = np.asarray(run_op(
+        "deformable_conv",
+        {"Input": x, "Offset": offset, "Mask": mask, "Filter": w},
+        {"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+         "groups": 1, "deformable_groups": 1})["Output"][0])
+    ref = np.asarray(run_op("conv2d", {"Input": x, "Filter": w},
+                            {"strides": [1, 1],
+                             "paddings": [0, 0]})["Output"][0])
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_deformable_conv_grad_finite():
+    r = np.random.RandomState(16)
+    x = r.randn(1, 2, 5, 5).astype(np.float32)
+    w = r.randn(2, 2, 3, 3).astype(np.float32)
+    offset = (r.randn(1, 18, 3, 3) * 0.3).astype(np.float32)
+    mask = np.abs(r.randn(1, 9, 3, 3)).astype(np.float32).clip(0, 1)
+
+    def f(xv, wv, ov, mv):
+        return (run_op("deformable_conv",
+                       {"Input": xv, "Offset": ov, "Mask": mv,
+                        "Filter": wv},
+                       {"strides": [1, 1], "paddings": [0, 0],
+                        "dilations": [1, 1], "groups": 1,
+                        "deformable_groups": 1})["Output"][0] ** 2).sum()
+    g = jax.grad(f, argnums=(0, 1, 2, 3))(
+        jnp.asarray(x), jnp.asarray(w), jnp.asarray(offset),
+        jnp.asarray(mask))
+    for gi in g:
+        assert np.isfinite(np.asarray(gi)).all()
+    assert np.abs(np.asarray(g[2])).sum() > 0  # offsets get gradient
+
+
+def test_sequence_topk_exceeding_columns():
+    r = np.random.RandomState(17)
+    x = r.randn(1, 1, 2, 4).astype(np.float32)
+    out = np.asarray(run_op(
+        "sequence_topk_avg_pooling",
+        {"X": x, "ROW": np.array([2], np.int32),
+         "COLUMN": np.array([4], np.int32)},
+        {"topks": [10], "channel_num": 1})["Out"][0])
+    # k=10 > 4 cols: sum all, divide by 10
+    np.testing.assert_allclose(out[0, 0, 0], x[0, 0, 0].sum() / 10,
+                               rtol=1e-5)
+
+
+def test_yolov3_loss_padding_does_not_clobber_negative_wh_target():
+    # real gt at cell (0,0) anchor 0 with box SMALLER than its anchor
+    # (negative tw target); a padded gt row also scatters to (0,0,0)
+    n, cls, h, w = 1, 2, 2, 2
+    anchors = [100, 100, 16, 30]
+    attrs = {"anchors": anchors, "anchor_mask": [0, 1],
+             "class_num": cls, "ignore_thresh": 0.7,
+             "downsample_ratio": 32}
+    gt = np.zeros((n, 2, 4), np.float32)
+    gt[0, 0] = [0.1, 0.1, 0.3, 0.3]  # 19.2px vs anchor 100 -> tw < 0
+    lab = np.zeros((n, 2), np.int32)
+    x = np.zeros((n, 2 * (5 + cls), h, w), np.float32)
+    # with pw logits 0, L1 wh loss = |0 - tw| + |0 - th| = 2*|tw|
+    loss = float(np.asarray(run_op(
+        "yolov3_loss", {"X": x, "GTBox": gt, "GTLabel": lab},
+        attrs)["Loss"][0])[0])
+    tw = np.log(0.3 * 64 / 100)
+    tscale = 2.0 - 0.3 * 0.3
+    assert loss > tscale * 2 * abs(tw) * 0.99  # wh term present
