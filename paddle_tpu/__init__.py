@@ -34,6 +34,9 @@ from . import reader  # noqa: F401
 from .reader import DataLoader, batch  # noqa: F401
 from . import dataset  # noqa: F401
 from .dataset import DatasetFactory  # noqa: F401
+from .dygraph import grad, to_tensor  # noqa: F401  (paddle.grad parity)
+from . import transpiler  # noqa: F401
+from .transpiler import DistributeTranspiler  # noqa: F401
 
 
 class CPUPlace:

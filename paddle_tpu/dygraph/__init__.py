@@ -1,6 +1,6 @@
 """Eager/dygraph mode — TPU-native analog of
 /root/reference/paddle/fluid/imperative/ + python/paddle/fluid/dygraph/."""
-from .tape import (GradNode, Tensor, no_grad, run_backward, run_op,  # noqa: F401
+from .tape import (GradNode, Tensor, grad, no_grad, run_backward, run_op,  # noqa: F401
                    seed, to_tensor, to_variable)
 
 

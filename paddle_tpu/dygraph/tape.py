@@ -89,14 +89,18 @@ def no_grad():
 class GradNode:
     """Recorded op on the tape (OpBase analog, imperative/op_base.h:31)."""
 
-    __slots__ = ("vjp_fn", "inputs", "outputs", "op_type", "pending")
+    __slots__ = ("vjp_fn", "inputs", "outputs", "op_type", "pending",
+                 "fwd_fn")
 
-    def __init__(self, op_type, vjp_fn, inputs, outputs):
+    def __init__(self, op_type, vjp_fn, inputs, outputs, fwd_fn=None):
         self.op_type = op_type
         self.vjp_fn = vjp_fn
         self.inputs = inputs      # list[Tensor] — differentiable inputs
         self.outputs = outputs    # list[weakref-free Tensor refs]
         self.pending = 0
+        # replayable primal fn(diff_vals)->flat outs; enables re-
+        # linearization for grad-of-grad (PartialGradEngine analog)
+        self.fwd_fn = fwd_fn
 
 
 class Tensor:
@@ -222,7 +226,8 @@ class Tensor:
             # expects vjp_fn(cts)[0] to be a list parallel to node.inputs
             node = GradNode("getitem",
                             lambda cts, _f=vjp_fn: (list(_f(cts[0])),),
-                            [self], [t])
+                            [self], [t],
+                            fwd_fn=lambda dv, _i=idx: [dv[0][_i]])
             t._node = node
             t.stop_gradient = False
         return t
@@ -470,7 +475,8 @@ def run_op(op_type: str, ins: Dict[str, List[Any]], attrs: Dict[str, Any],
                         jnp.issubdtype(t.value.dtype, jnp.floating):
                     diff.append(t)
                     diff_pos.append((slot, i))
-    ctx = LowerCtx(_state.next_key(), is_test=_state.is_test)
+    key0 = _state.next_key()
+    ctx = LowerCtx(key0, is_test=_state.is_test)
 
     raw_ins = {slot: [t.value for t in vals] for slot, vals in ins.items()}
 
@@ -478,10 +484,15 @@ def run_op(op_type: str, ins: Dict[str, List[Any]], attrs: Dict[str, Any],
         out_struct: List[tuple] = []
 
         def fn(diff_vals):
+            # fresh ctx per call: replaying fn (jax.vjp here, re-
+            # linearization in grad(create_graph=True)) must consume the
+            # SAME rng keys or dropout masks would differ between the
+            # primal and the re-traced pass
+            ctx2 = LowerCtx(key0, is_test=_state.is_test)
             local = {slot: list(vals) for slot, vals in raw_ins.items()}
             for (slot, i), v in zip(diff_pos, diff_vals):
                 local[slot][i] = v
-            outs = opdef.lower(ctx, local, attrs)
+            outs = opdef.lower(ctx2, local, attrs)
             flat = []
             out_struct.clear()
             for slot, vals in outs.items():
@@ -497,7 +508,7 @@ def run_op(op_type: str, ins: Dict[str, List[Any]], attrs: Dict[str, Any],
             t = Tensor(v, stop_gradient=False)
             out_tensors.setdefault(slot, []).append(t)
             wrapped.append(t)
-        node = GradNode(op_type, vjp_fn, diff, wrapped)
+        node = GradNode(op_type, vjp_fn, diff, wrapped, fwd_fn=fn)
         for t in wrapped:
             t._node = node
         return out_tensors
@@ -570,7 +581,8 @@ def apply_fn(fn, *tensors):
 
         flat, vjp_fn = jax.vjp(wrapped, [vals[i] for i in diff_idx])
         outs = [Tensor(v, stop_gradient=False) for v in flat]
-        node = GradNode("apply_fn", vjp_fn, [ts[i] for i in diff_idx], outs)
+        node = GradNode("apply_fn", vjp_fn, [ts[i] for i in diff_idx], outs,
+                        fwd_fn=wrapped)
         for t in outs:
             t._node = node
         return outs
@@ -583,7 +595,8 @@ def _cast_node(src: Tensor, dst: Tensor, dtype):
     _, vjp_fn = jax.vjp(lambda v: [v.astype(dtype)], src.value)
     # contract: vjp_fn(cts)[0] must be a list parallel to node.inputs
     return GradNode("cast", lambda cts, _f=vjp_fn: (list(_f(cts)),),
-                    [src], [dst])
+                    [src], [dst],
+                    fwd_fn=lambda dv, _d=dtype: [dv[0].astype(_d)])
 
 
 def _accum_grad(old, new):
@@ -608,44 +621,12 @@ def run_backward(loss: Tensor, grad=None, retain_graph: bool = False):
             loss.grad = g if loss.grad is None else loss.grad + g
         return
 
-    # build reachable graph + dependency counts (PrepareDeps,
-    # basic_engine.cc:124)
-    nodes: List[GradNode] = []
-    seen = set()
-    stack = [loss._node]
-    while stack:
-        node = stack.pop()
-        if id(node) in seen:
-            continue
-        seen.add(id(node))
-        nodes.append(node)
-        for t in node.inputs:
-            if t._node is not None and id(t._node) not in seen:
-                stack.append(t._node)
+    order = _topo_order([loss._node])
 
     # out-tensor cotangent accumulators
     cot: Dict[int, Any] = {}
     g0 = jnp.ones_like(loss.value) if grad is None else jnp.asarray(grad)
     cot[id(loss)] = g0
-
-    # pending counts: how many downstream nodes feed each node
-    deps: Dict[int, int] = {id(n): 0 for n in nodes}
-    for n in nodes:
-        for t in n.inputs:
-            if t._node is not None:
-                deps[id(t._node)] += 1
-
-    # process in reverse topological order
-    order: List[GradNode] = []
-    frontier = [n for n in nodes if deps[id(n)] == 0]
-    while frontier:
-        n = frontier.pop()
-        order.append(n)
-        for t in n.inputs:
-            if t._node is not None:
-                deps[id(t._node)] -= 1
-                if deps[id(t._node)] == 0:
-                    frontier.append(t._node)
 
     for node in order:
         cts = []
@@ -676,3 +657,168 @@ def run_backward(loss: Tensor, grad=None, retain_graph: bool = False):
         for n in order:
             for t in n.outputs:
                 t._node = None
+
+
+def _topo_order(roots, prune_to=None):
+    """Reachable subgraph below `roots` in reverse-topological
+    (processing) order — the shared walk of run_backward and grad()
+    (PrepareDeps, basic_engine.cc:124). With prune_to (a list of
+    Tensors), nodes that cannot reach any of them are dropped, the
+    reference PartialGradEngine's input-path pruning."""
+    nodes, seen, stack = [], set(), [n for n in roots if n is not None]
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        nodes.append(node)
+        for t in node.inputs:
+            if t._node is not None and id(t._node) not in seen:
+                stack.append(t._node)
+    deps = {id(n): 0 for n in nodes}
+    for n in nodes:
+        for t in n.inputs:
+            if t._node is not None:
+                deps[id(t._node)] += 1
+    order, frontier = [], [n for n in nodes if deps[id(n)] == 0]
+    while frontier:
+        n = frontier.pop()
+        order.append(n)
+        for t in n.inputs:
+            if t._node is not None:
+                deps[id(t._node)] -= 1
+                if deps[id(t._node)] == 0:
+                    frontier.append(t._node)
+    if prune_to is not None:
+        wanted = {id(t) for t in prune_to}
+        keep = set()
+        for n in reversed(order):  # leaves-first
+            if any(id(t) in wanted or (t._node is not None and
+                                       id(t._node) in keep)
+                   for t in n.inputs):
+                keep.add(id(n))
+        order = [n for n in order if id(n) in keep]
+    return order
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad — compute d(outputs)/d(inputs) WITHOUT writing .grad.
+
+    Analog of the reference's PartialGradEngine
+    (/root/reference/paddle/fluid/imperative/partial_grad_engine.cc:1042
+    PartialGradTask; python surface imperative/backward_strategy +
+    fluid/dygraph/base.py:grad). With create_graph=True the backward is
+    itself recorded on the tape: each GradNode's saved primal fn is
+    RE-LINEARIZED (jax.vjp inside a taped apply_fn), so the returned
+    grads carry grad nodes and can be differentiated again — enabling
+    gradient-penalty losses and higher-order derivatives.
+    """
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor) or not isinstance(
+            grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]  # one seed, not an iterable of rows
+    else:
+        grad_outputs = list(grad_outputs)
+    if len(grad_outputs) != len(outputs):
+        raise ValueError(
+            "grad_outputs must match outputs (%d vs %d) — a missing "
+            "seed would silently drop that output's contribution"
+            % (len(grad_outputs), len(outputs)))
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    order = _topo_order([o._node for o in outputs], prune_to=inputs)
+
+    # cotangent accumulators: id(tensor) -> Tensor (create_graph) / array
+    cot: Dict[int, Any] = {}
+
+    def seed(o, go):
+        if go is None:
+            v = jnp.ones_like(o.value)
+            return Tensor(v) if create_graph else v
+        if create_graph:
+            return go if isinstance(go, Tensor) else Tensor(go)
+        return go.value if isinstance(go, Tensor) else jnp.asarray(go)
+
+    def accum(cur, g):
+        if cur is None:
+            return g
+        if create_graph:
+            return cur + g  # taped elementwise_add
+        return _accum_grad(cur, g)
+
+    for o, go in zip(outputs, grad_outputs):
+        cot[id(o)] = accum(cot.get(id(o)), seed(o, go))
+
+    for node in order:
+        cts, any_ct = [], False
+        for t in node.outputs:
+            c = cot.get(id(t))
+            if c is None:
+                c = Tensor(jnp.zeros_like(t.value)) if create_graph \
+                    else jnp.zeros_like(t.value)
+            else:
+                any_ct = True
+            cts.append(c)
+        if not any_ct:
+            continue
+        if create_graph:
+            if node.fwd_fn is None:
+                if node.vjp_fn is not None:
+                    raise RuntimeError(
+                        "create_graph=True cannot differentiate through "
+                        "op %r (no replayable primal — e.g. sparse "
+                        "SelectedRows lookups); use the dense path"
+                        % node.op_type)
+                raise RuntimeError(
+                    "create_graph=True requires the tape to retain "
+                    "primal functions; this graph was already released "
+                    "(an earlier grad()/backward() without retain_graph "
+                    "ran on it)")
+            k = len(node.inputs)
+
+            def gradop(*args, _f=node.fwd_fn, _k=k):
+                prim, c = list(args[:_k]), list(args[_k:])
+                _, vjp = jax.vjp(lambda dv: list(_f(dv)), prim)
+                return list(vjp(c)[0])
+
+            in_grads = apply_fn(gradop, *node.inputs, *cts)
+        else:
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    "trying to backward through a graph that was "
+                    "already released; pass retain_graph=True to the "
+                    "earlier grad()/backward() call")
+            in_grads = node.vjp_fn(cts)[0]
+        for t, g in zip(node.inputs, in_grads):
+            cot[id(t)] = accum(cot.get(id(t)), g)
+
+    if not retain_graph:
+        # release closures AND detach outputs' _node pointers, so a
+        # later backward() on this subgraph raises the clear
+        # "already released" error instead of calling a None vjp_fn
+        for node in order:
+            node.vjp_fn = None
+            node.fwd_fn = None
+            for t in node.outputs:
+                t._node = None
+
+    results = []
+    for t in inputs:
+        g = cot.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the inputs has no gradient path to the "
+                    "outputs (pass allow_unused=True to get None)")
+            results.append(None)
+        elif create_graph:
+            results.append(g if isinstance(g, Tensor) else Tensor(g))
+        else:
+            v = g.value if isinstance(g, Tensor) else g
+            results.append(Tensor(v, stop_gradient=True))
+    return results

@@ -117,28 +117,66 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn):
             result_queue.put((job_id, None, repr(e)))
 
 
+class _WorkerPool:
+    """Persistent spawn-worker pool, shared across a DataLoader's epochs
+    (spawn start-up re-imports the framework in each worker — paying
+    that once per loader, not once per epoch, mirrors the reference's
+    long-lived reader threads)."""
+
+    def __init__(self, dataset, collate_fn, num_workers):
+        # spawn, not fork: the parent holds live XLA runtime threads
+        # and fork() of a multithreaded process deadlocks (the reference
+        # reader uses clean worker processes the same way,
+        # reader/buffered_reader + paddle.io DataLoader workers)
+        ctx = mp.get_context("spawn")
+        self.num_workers = num_workers
+        self.index_queues = [ctx.Queue() for _ in range(num_workers)]
+        self.result_queue = ctx.Queue()
+        self.workers = [
+            ctx.Process(target=_worker_loop,
+                        args=(dataset, self.index_queues[i],
+                              self.result_queue, collate_fn),
+                        daemon=True)
+            for i in range(num_workers)]
+        for w in self.workers:
+            w.start()
+        self.next_job_id = 0  # monotonic across epochs
+
+    def shutdown(self):
+        for q in self.index_queues:
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for w in self.workers:
+            w.join(timeout=1)
+            if w.is_alive():
+                w.terminate()
+
+
 class _MultiprocessIter:
     def __init__(self, loader):
         self.loader = loader
-        ds = loader.dataset
-        nw = loader.num_workers
-        ctx = mp.get_context("fork")
-        self._index_queues = [ctx.Queue() for _ in range(nw)]
-        self._result_queue = ctx.Queue()
-        self._workers = [
-            ctx.Process(target=_worker_loop,
-                        args=(ds, self._index_queues[i], self._result_queue,
-                              loader.collate_fn), daemon=True)
-            for i in range(nw)]
-        for w in self._workers:
-            w.start()
+        pool = getattr(loader, "_pool", None)
+        alive = pool is not None and all(w.is_alive()
+                                         for w in pool.workers)
+        if not alive:
+            if pool is not None:
+                pool.shutdown()
+            pool = loader._pool = _WorkerPool(
+                loader.dataset, loader.collate_fn, loader.num_workers)
+        self._pool = pool
+        self._index_queues = pool.index_queues
+        self._result_queue = pool.result_queue
+        self._workers = pool.workers
         self._batches = iter(loader.batch_sampler)
-        self._send_idx = 0
-        self._rcv_idx = 0
+        self._first_job = pool.next_job_id
+        self._send_idx = pool.next_job_id
+        self._rcv_idx = pool.next_job_id
         self._reorder = {}
         self._done_sending = False
         # keep 2 jobs in flight per worker (prefetch_factor)
-        for _ in range(2 * nw):
+        for _ in range(2 * pool.num_workers):
             self._dispatch()
 
     def _dispatch(self):
@@ -150,6 +188,7 @@ class _MultiprocessIter:
         self._index_queues[self._send_idx % len(self._index_queues)].put(
             (self._send_idx, indices))
         self._send_idx += 1
+        self._pool.next_job_id = self._send_idx
 
     def __iter__(self):
         return self
@@ -159,7 +198,23 @@ class _MultiprocessIter:
             self._shutdown()
             raise StopIteration
         while self._rcv_idx not in self._reorder:
-            job_id, data, err = self._result_queue.get()
+            try:
+                job_id, data, err = self._result_queue.get(timeout=5.0)
+            except _queue.Empty:
+                # dead-worker watchdog: spawn workers that failed to
+                # start (e.g. unpicklable dataset, __main__ re-import
+                # in interactive sessions) would otherwise hang the
+                # training loop forever
+                dead = [w for w in self._workers if not w.is_alive()]
+                if dead:
+                    self._shutdown()
+                    raise RuntimeError(
+                        "DataLoader worker(s) died (exitcodes %s) — "
+                        "with spawn workers the dataset/collate_fn "
+                        "must be picklable and importable from the "
+                        "main module" %
+                        [w.exitcode for w in dead]) from None
+                continue
             if err is not None:
                 self._shutdown()
                 raise RuntimeError("DataLoader worker failed: %s" % err)
@@ -170,21 +225,12 @@ class _MultiprocessIter:
         return data
 
     def _shutdown(self):
-        for q in self._index_queues:
-            try:
-                q.put(None)
-            except Exception:
-                pass
-        for w in self._workers:
-            w.join(timeout=1)
-            if w.is_alive():
-                w.terminate()
-
-    def __del__(self):
-        try:
-            self._shutdown()
-        except Exception:
-            pass
+        # epoch end keeps the pool alive for the next __iter__; only a
+        # worker failure tears it down (and clears the loader's cache)
+        if any(not w.is_alive() for w in self._workers):
+            self._pool.shutdown()
+            if getattr(self.loader, "_pool", None) is self._pool:
+                self.loader._pool = None
 
 
 class _DevicePrefetcher:

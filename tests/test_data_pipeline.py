@@ -165,13 +165,17 @@ def test_dataloader_multiprocess_matches_serial():
         np.testing.assert_array_equal(a, b)
 
 
-def test_dataloader_worker_error_propagates():
-    class Bad(Dataset):
-        def __len__(self):
-            return 4
+class _BadDataset(Dataset):
+    # module-level: spawn workers must pickle the dataset
+    def __len__(self):
+        return 4
 
-        def __getitem__(self, i):
-            raise RuntimeError("boom")
+    def __getitem__(self, i):
+        raise RuntimeError("boom")
+
+
+def test_dataloader_worker_error_propagates():
+    Bad = _BadDataset
     with pytest.raises(RuntimeError):
         list(DataLoader(Bad(), batch_size=2, num_workers=1,
                         use_buffer_reader=False))
