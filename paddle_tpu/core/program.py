@@ -329,12 +329,30 @@ class Program:
         return Program.from_dict(json.loads(s))
 
     def clone(self, for_test: bool = False) -> "Program":
-        """Deep-copy; with for_test=True flips is_test attrs like the
-        reference's Program.clone(for_test=True) (framework.py:4179)."""
+        """Deep-copy; with for_test=True keep only the FORWARD section
+        (everything before the backward meta-op, optimizer ops stripped)
+        and flip is_test attrs — the reference's
+        Program.clone(for_test=True) prunes the same way
+        (framework.py:4179 "forward content of original one"). Without
+        the prune, running an eval clone would apply an optimizer step
+        and silently corrupt training state."""
         prog = Program.from_dict(copy.deepcopy(self.to_dict()))
         prog.random_seed = self.random_seed
         if for_test:
+            # ops whose ParamOut writes a Param in place = optimizers
+            from .registry import REGISTRY
             for blk in prog.blocks:
+                cut = next((i for i, op in enumerate(blk.ops)
+                            if op.type == "backward"), None)
+                if cut is not None:
+                    blk.ops = blk.ops[:cut]
+                # strip OPTIMIZER ops precisely: ParamOut-in-place
+                # writers. Other stateful forward ops (streaming 'auc'
+                # stats etc.) must SURVIVE — the reference's test clone
+                # keeps metric ops
+                blk.ops = [op for op in blk.ops
+                           if not (REGISTRY.has(op.type) and "ParamOut"
+                                   in REGISTRY.get(op.type).inplace_map)]
                 for op in blk.ops:
                     if "is_test" in op.attrs:
                         op.attrs["is_test"] = True

@@ -3,4 +3,4 @@ from .large_scale_kv import LargeScaleKV, SparseTableConfig  # noqa: F401
 from .communicator import (Communicator, AsyncCommunicator,  # noqa: F401
                            GeoCommunicator, HalfAsyncCommunicator,
                            ParamServer, SyncCommunicator)
-from .ps_worker import DownpourWorker  # noqa: F401
+from .ps_worker import DownpourWorker, HeterWorker  # noqa: F401

@@ -48,3 +48,85 @@ class DownpourWorker:
         loss, row_grads = step_fn(rows, *args)
         self.push(ids, np.asarray(row_grads))
         return loss
+
+
+class HeterWorker(DownpourWorker):
+    """Two-stage heterogeneous worker: the HOST stage (sparse pull/push
+    against the KV table / pserver) is double-buffered against the
+    DEVICE stage (the dense jit step) — batch N+1's rows transfer while
+    batch N computes.
+
+    Analog of the reference's heterogeneous trainer
+    (/root/reference/paddle/fluid/framework/hetercpu_worker.cc — CPU
+    workers own the sparse stage, the accelerator worker the dense
+    stage, handing off through HeterTask queues;
+    framework/device_worker.h:246). Two pipeline threads replace the
+    reference's task-queue fan-out: a puller thread keeps `depth`
+    pulled batches staged, and pushes happen on a background thread so
+    the device never waits on host KV traffic.
+    """
+
+    def __init__(self, server, table: str, depth: int = 2):
+        super().__init__(server, table)
+        self._depth = depth
+
+    def run_pipeline(self, batches, step_fn):
+        """batches: iterable of (ids, *args); step_fn(rows, *args) ->
+        (loss, row_grads). Returns the list of losses.
+
+        Stage H1 (thread): pull rows for upcoming batches.
+        Stage D  (caller): run the device step.
+        Stage H2 (thread): push row grads of finished batches.
+        """
+        import queue
+        import threading
+
+        pulled: "queue.Queue" = queue.Queue(maxsize=self._depth)
+        to_push: "queue.Queue" = queue.Queue()
+        err: list = []
+
+        def puller():
+            try:
+                for item in batches:
+                    ids = item[0]
+                    rows = self.pull(ids)
+                    pulled.put((ids, rows, item[1:]))
+            except Exception as e:  # pragma: no cover - surfaced below
+                err.append(e)
+            finally:
+                pulled.put(None)
+
+        def pusher():
+            while True:
+                job = to_push.get()
+                if job is None:
+                    return
+                ids, grads = job
+                try:
+                    self.push(ids, grads)
+                except Exception as e:  # pragma: no cover
+                    err.append(e)
+
+        tp = threading.Thread(target=puller, daemon=True)
+        ts = threading.Thread(target=pusher, daemon=True)
+        tp.start()
+        ts.start()
+        losses = []
+        while True:
+            item = pulled.get()
+            if item is None:
+                break
+            ids, rows, args = item
+            loss, row_grads = step_fn(rows, *args)
+            to_push.put((ids, np.asarray(row_grads)))
+            losses.append(loss)
+        to_push.put(None)
+        tp.join(timeout=120)
+        ts.join(timeout=120)
+        if tp.is_alive() or ts.is_alive():
+            raise RuntimeError(
+                "HeterWorker pipeline threads did not drain — pending "
+                "sparse pushes would be lost (pserver unreachable?)")
+        if err:
+            raise err[0]
+        return losses

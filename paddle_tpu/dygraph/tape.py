@@ -730,6 +730,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             % (len(grad_outputs), len(outputs)))
     if retain_graph is None:
         retain_graph = create_graph
+    if not only_inputs:
+        # reference parity: fluid.dygraph.grad asserts on
+        # only_inputs=False rather than silently mis-executing
+        raise AssertionError("only_inputs=False is not supported "
+                             "(the reference rejects it too)")
 
     order = _topo_order([o._node for o in outputs], prune_to=inputs)
 

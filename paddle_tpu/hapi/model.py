@@ -89,6 +89,26 @@ class Model:
         return state_of(self.network)
 
     def eval_batch(self, inputs, labels=None):
+        import jax.numpy as jnp
+        outs = self.predict_batch(inputs)
+        labels = _to_list(labels)
+        if labels:
+            # reference eval_batch: loss + metric states for the batch
+            res = []
+            if self._loss is not None:
+                lv = self._loss(*[Tensor(jnp.asarray(o)) for o in outs],
+                                *[Tensor(jnp.asarray(np.asarray(x)))
+                                  for x in labels])
+                res.append(np.asarray(lv.value
+                                      if isinstance(lv, Tensor) else lv))
+            for m in self._metrics:
+                m.update(*m.compute(*outs,
+                                    *[np.asarray(x) for x in labels]))
+                res.append(m.accumulate())
+            return res
+        return outs
+
+    def predict_batch(self, inputs):
         if self._eval_fn is None:
             self._build_eval()
         self.network.eval()
@@ -97,8 +117,6 @@ class Model:
                              tuple(jnp.asarray(np.asarray(x))
                                    for x in _to_list(inputs)))
         return [np.asarray(o) for o in outs]
-
-    predict_batch = eval_batch
 
     # --- fit (model.py:1243) ---------------------------------------------
     def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
@@ -154,7 +172,7 @@ class Model:
         losses = []
         for step, batch in enumerate(loader):
             inputs, labels = self._split_batch(batch)
-            outs = self.eval_batch(inputs)
+            outs = self.predict_batch(inputs)
             if self._loss is not None and labels:
                 import jax.numpy as jnp
                 lv = self._loss(*[Tensor(jnp.asarray(o)) for o in outs],
@@ -163,7 +181,9 @@ class Model:
                 losses.append(float(np.asarray(
                     lv.value if isinstance(lv, Tensor) else lv)))
             for m in self._metrics:
-                args = m.compute(outs[0], labels[0] if labels else None)
+                largs = [np.asarray(x) for x in labels]
+                args = m.compute(*outs, *largs) if largs else \
+                    m.compute(outs[0], None)
                 m.update(*args)
             cbks.on_eval_batch_end(step)
         logs = {}
@@ -180,23 +200,56 @@ class Model:
         outs: List[List[np.ndarray]] = []
         for batch in loader:
             inputs, _ = self._split_batch(batch, has_labels=False)
-            res = self.eval_batch(inputs)
+            res = self.predict_batch(inputs)
             outs.append(res)
         n_out = len(outs[0])
         return [np.concatenate([o[i] for o in outs]) for i in range(n_out)]
 
     # --- persistence (model.py save:1059 / load:1091) ---------------------
-    def save(self, path):
+    def save(self, path, training: bool = True):
+        """Save params (.pdparams); with training=True also the
+        optimizer accumulators (.pdopt) — reference model.py:1059."""
         if self._train_step is not None:
             self._train_step.sync_model()
         sd = self.network.state_dict()
         _io.save_dygraph(sd, path)
+        if training and self._train_step is not None and \
+                self._train_step._opt_state:
+            flat = {}
+            for pname, slots in self._train_step._opt_state.items():
+                for k, v in slots.items():
+                    flat["%s//%s" % (pname, k)] = np.asarray(v)
+            np.savez(path + ".pdopt.npz", **flat)
 
-    def load(self, path):
+    def load(self, path, reset_optimizer: bool = False):
         params, _ = _io.load_dygraph(path)
         self.network.set_state_dict(params)
         if self._train_step is not None:
             self._train_step._step_fn = None  # recompile with new state
+            self._train_step._opt_state = {}
+        opt_path = path + ".pdopt.npz"
+        if not reset_optimizer and self._train_step is not None and \
+                os.path.exists(opt_path):
+            import jax.numpy as jnp
+            state = {}
+            with np.load(opt_path) as z:
+                for key in z.files:
+                    pname, slot = key.split("//", 1)
+                    state.setdefault(pname, {})[slot] = jnp.asarray(
+                        z[key])
+            self._train_step._opt_state = state
+
+    def summary(self, input_size=None):
+        """Parameter inventory (hapi model.py summary:2001)."""
+        rows, total = [], 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape))
+            total += n
+            rows.append((name, tuple(p.shape), n))
+        for name, shape, n in rows:
+            print("%-40s %-20s %d" % (name, shape, n))
+        print("Total params: %d" % total)
+        return {"total_params": total, "trainable_params": total}
 
     def parameters(self):
         return self.network.parameters()

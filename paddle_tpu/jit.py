@@ -267,9 +267,11 @@ class TrainStep:
         labels = tuple(_unwrap(x) for x in (
             labels if isinstance(labels, (tuple, list)) else (labels,)))
         if self.mesh is not None:
+            # shard with THIS step's mesh — the global parallel-env mesh
+            # may be a different (even differently-sized) mesh
             from .parallel.env import shard_batch
-            inputs = shard_batch(inputs)
-            labels = shard_batch(labels)
+            inputs = shard_batch(inputs, mesh=self.mesh)
+            labels = shard_batch(labels, mesh=self.mesh)
         self._rng, sub = jax.random.split(self._rng)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P

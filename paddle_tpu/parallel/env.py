@@ -188,7 +188,7 @@ def sharding(*spec) -> NamedSharding:
     return NamedSharding(_env.mesh, PartitionSpec(*spec))
 
 
-def shard_batch(batch, axis: str = DP_AXIS):
+def shard_batch(batch, axis: str = DP_AXIS, mesh=None):
     """Device-put a host batch sharded along its leading dim — the analog of
     the reference feeding per-device scopes
     (framework/parallel_executor.cc BCast/feed split).
@@ -198,15 +198,28 @@ def shard_batch(batch, axis: str = DP_AXIS):
     process's LOCAL shard (standard SPMD data loading — each trainer reads
     its own files, as the reference's DataFeed does) and is assembled into
     a global array spanning all hosts."""
-    if _env.mesh is None or _env.axis_size(axis) == 1:
-        return jax.device_put(batch)
-
+    use_mesh = mesh if mesh is not None else _env.mesh
+    axis_n = (use_mesh.shape.get(axis, 1) if use_mesh is not None
+              else 1)
     multiproc = jax.process_count() > 1
+    if use_mesh is None or axis_n == 1:
+        if multiproc and use_mesh is not None:
+            # no dp axis (pure mp/pp): the batch is REPLICATED, but in
+            # multi-process SPMD every jit input must still be a global
+            # array over the mesh — assemble it from the (identical)
+            # per-process copies
+            rep = NamedSharding(use_mesh, PartitionSpec())
+
+            def put_rep(x):
+                return jax.make_array_from_process_local_data(
+                    rep, np.asarray(x))
+            return jax.tree.map(put_rep, batch)
+        return jax.device_put(batch)
 
     def put(x):
         ndim = np.ndim(x)
         spec = PartitionSpec(*([axis] + [None] * (ndim - 1)))
-        sh = NamedSharding(_env.mesh, spec)
+        sh = NamedSharding(use_mesh, spec)
         if multiproc:
             return jax.make_array_from_process_local_data(sh, np.asarray(x))
         return jax.device_put(x, sh)

@@ -141,6 +141,38 @@ class _WorkerPool:
         for w in self.workers:
             w.start()
         self.next_job_id = 0  # monotonic across epochs
+        # shared result landing zone: concurrent iterators over one
+        # loader both drain result_queue; whoever pops a job parks it
+        # here so the OWNING iterator finds it (no cross-stealing)
+        self.results = {}
+        self._rlock = threading.Lock()
+
+    def collect(self, job_id, timeout=5.0):
+        """Block until job_id's result is available; park others."""
+        while True:
+            with self._rlock:
+                if job_id in self.results:
+                    return self.results.pop(job_id)
+            try:
+                jid, data, err = self.result_queue.get(timeout=timeout)
+            except _queue.Empty:
+                dead = [w for w in self.workers if not w.is_alive()]
+                if dead:
+                    raise RuntimeError(
+                        "DataLoader worker(s) died (exitcodes %s) — "
+                        "with spawn workers the dataset/collate_fn "
+                        "must be picklable and importable from the "
+                        "main module" %
+                        [w.exitcode for w in dead]) from None
+                continue
+            with self._rlock:
+                self.results[jid] = (data, err)
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
 
     def shutdown(self):
         for q in self.index_queues:
@@ -170,10 +202,9 @@ class _MultiprocessIter:
         self._result_queue = pool.result_queue
         self._workers = pool.workers
         self._batches = iter(loader.batch_sampler)
-        self._first_job = pool.next_job_id
         self._send_idx = pool.next_job_id
         self._rcv_idx = pool.next_job_id
-        self._reorder = {}
+        self._sent = []  # job ids THIS iterator owns, in order
         self._done_sending = False
         # keep 2 jobs in flight per worker (prefetch_factor)
         for _ in range(2 * pool.num_workers):
@@ -187,40 +218,24 @@ class _MultiprocessIter:
             return
         self._index_queues[self._send_idx % len(self._index_queues)].put(
             (self._send_idx, indices))
-        self._send_idx += 1
-        self._pool.next_job_id = self._send_idx
+        self._sent.append(self._send_idx)
+        self._send_idx = self._pool.next_job_id = \
+            max(self._send_idx + 1, self._pool.next_job_id)
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        if self._rcv_idx >= self._send_idx and self._done_sending:
-            self._shutdown()
+        if not self._sent and self._done_sending:
             raise StopIteration
-        while self._rcv_idx not in self._reorder:
-            try:
-                job_id, data, err = self._result_queue.get(timeout=5.0)
-            except _queue.Empty:
-                # dead-worker watchdog: spawn workers that failed to
-                # start (e.g. unpicklable dataset, __main__ re-import
-                # in interactive sessions) would otherwise hang the
-                # training loop forever
-                dead = [w for w in self._workers if not w.is_alive()]
-                if dead:
-                    self._shutdown()
-                    raise RuntimeError(
-                        "DataLoader worker(s) died (exitcodes %s) — "
-                        "with spawn workers the dataset/collate_fn "
-                        "must be picklable and importable from the "
-                        "main module" %
-                        [w.exitcode for w in dead]) from None
-                continue
-            if err is not None:
-                self._shutdown()
-                raise RuntimeError("DataLoader worker failed: %s" % err)
-            self._reorder[job_id] = data
-        data = self._reorder.pop(self._rcv_idx)
-        self._rcv_idx += 1
+        try:
+            data, err = self._pool.collect(self._sent.pop(0))
+        except RuntimeError:
+            self._shutdown()
+            raise
+        if err is not None:
+            self._shutdown()
+            raise RuntimeError("DataLoader worker failed: %s" % err)
         self._dispatch()
         return data
 

@@ -35,9 +35,22 @@ def main():
     from paddle_tpu.dygraph import Tensor, seed
     from paddle_tpu.jit import TrainStep
 
+    if mode == "die":
+        # rank-failure victim: rank 1 exits mid-run with an error so the
+        # launch watchdog must kill the surviving ranks (fleet/launch.py
+        # failure propagation, reference launch_utils.py watchdog)
+        env = dist.init_parallel_env({"dp": 4})
+        if env.rank == 1:
+            print("RANK1 DYING", flush=True)
+            os._exit(17)
+        import time
+        time.sleep(120)  # rank 0 hangs; only the watchdog can end it
+        return
+
+    axis = "mp" if mode in ("mp", "mp_local") else "dp"
     # bootstrap FIRST: seeding creates a PRNGKey, which would initialize
     # the local backend before jax.distributed can form the global one
-    env = dist.init_parallel_env({"dp": 4})
+    env = dist.init_parallel_env({axis: 4})
     seed(7)
     np.random.seed(7)
     assert env.nranks == 4, env.nranks
@@ -58,14 +71,30 @@ def main():
 
     model = MLP()
     opt = pt.optimizer.SGD(0.1, parameters=model.parameters())
-    step = TrainStep(model, loss_fn, opt, mesh=env.mesh)
+    if mode in ("mp", "mp_local"):
+        # tensor parallelism: l1 weight column-sharded, l2 row-sharded
+        # over the mp axis — XLA inserts the activation all-reduce
+        # (Megatron layout; scaling-book recipe)
+        from jax.sharding import PartitionSpec as P
+
+        def rules(name, shape):
+            if shape == (8, 16):
+                return P(None, "mp")
+            if shape == (16, 1):
+                return P("mp", None)
+            return P()
+
+        step = TrainStep(model, loss_fn, opt, mesh=env.mesh,
+                         param_rules=rules)
+    else:
+        step = TrainStep(model, loss_fn, opt, mesh=env.mesh)
 
     data_rng = np.random.RandomState(3)
     losses = []
     for _ in range(5):
         x = data_rng.randn(8, 8).astype(np.float32)  # GLOBAL batch
         y = (x.sum(axis=1, keepdims=True) > 0).astype(np.float32)
-        if nproc > 1:
+        if nproc > 1 and mode == "dist":
             per = 8 // nproc  # this process's shard of the dp batch
             x = x[rank * per:(rank + 1) * per]
             y = y[rank * per:(rank + 1) * per]

@@ -151,3 +151,71 @@ def test_callbacks_order_and_early_stopping():
     model2.fit(TensorDataset(x, y), batch_size=16, epochs=10, verbose=0,
                callbacks=[stopper])
     assert model2.stop_training
+
+
+def test_hapi_eval_batch_with_labels_and_metric_contract():
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.dygraph import tape
+    tape.seed(3)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    model = Model(net)
+    model.prepare(pt.optimizer.Adam(1e-2, parameters=net.parameters()),
+                  loss=lambda out, lab: F.cross_entropy(
+                      out, lab, reduction="mean"),
+                  metrics=Accuracy())
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randint(0, 3, (8, 1)).astype(np.int64)
+    model.train_batch([x], [y])
+    res = model.eval_batch([x], [y])
+    assert len(res) == 2  # loss + accuracy
+    assert 0.0 <= float(np.asarray(res[1])) <= 1.0
+
+
+def test_hapi_save_load_with_optimizer_state(tmp_path):
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.dygraph import tape
+    tape.seed(4)
+    net = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 1))
+    model = Model(net)
+    model.prepare(pt.optimizer.Adam(1e-2,
+                                    parameters=net.parameters()),
+                  loss=lambda out, lab: F.mse_loss(out, lab))
+    rng = np.random.RandomState(1)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randn(8, 1).astype(np.float32)
+    for _ in range(3):
+        model.train_batch([x], [y])
+    path = str(tmp_path / "ckpt")
+    model.save(path)
+    assert os.path.exists(path + ".pdopt.npz")
+
+    # a fresh model restores params AND Adam moments: its next step
+    # must match the original's next step exactly
+    l_ref = float(model.train_batch([x], [y])[0])
+
+    tape.seed(4)
+    net2 = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 1))
+    model2 = Model(net2)
+    model2.prepare(pt.optimizer.Adam(1e-2,
+                                     parameters=net2.parameters()),
+                   loss=lambda out, lab: F.mse_loss(out, lab))
+    model2.load(path)
+    l_new = float(model2.train_batch([x], [y])[0])
+    np.testing.assert_allclose(l_new, l_ref, rtol=1e-5)
+
+
+def test_hapi_summary(capsys):
+    from paddle_tpu import nn
+    from paddle_tpu.hapi import Model
+    net = nn.Linear(3, 2)
+    info = Model(net).summary()
+    assert info["total_params"] == 3 * 2 + 2
+    assert "Total params" in capsys.readouterr().out
