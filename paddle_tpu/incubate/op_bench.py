@@ -1,0 +1,85 @@
+"""Per-op micro-benchmark harness.
+
+Analog of the reference's op_tester
+(/root/reference/paddle/fluid/operators/benchmark/op_tester.cc — build
+one op from a config, run it N times on the target device, report
+latency). Here the op's registry lowering is jit-compiled standalone
+and timed on the default backend; use it to rank kernel variants or
+catch lowering regressions:
+
+    from paddle_tpu.incubate.op_bench import bench_op
+    r = bench_op("softmax", {"X": (128, 1024)}, repeat=100)
+    print(r["mean_us"], r["p50_us"])
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def bench_op(op_type: str, input_shapes: Dict[str, Any],
+             attrs: Optional[Dict[str, Any]] = None, repeat: int = 50,
+             warmup: int = 5, dtype="float32", seed: int = 0,
+             grad: bool = False) -> Dict[str, Any]:
+    """Time one op lowering under jit.
+
+    input_shapes: slot -> shape tuple (or list of shapes, or a concrete
+    ndarray to control dtype/values). With grad=True the timed function
+    is value+grad w.r.t. the first input slot instead of forward only.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.registry import LowerCtx, REGISTRY
+
+    opdef = REGISTRY.get(op_type)
+    rng = np.random.RandomState(seed)
+
+    def make(v):
+        if isinstance(v, np.ndarray):
+            return jnp.asarray(v)
+        return jnp.asarray(rng.randn(*v).astype(dtype))
+
+    ins = {}
+    for slot, v in input_shapes.items():
+        vals = v if isinstance(v, list) else [v]
+        ins[slot] = [make(x) for x in vals]
+    attrs = dict(attrs or {})
+    key = jax.random.PRNGKey(seed)
+
+    def fwd(ins_vals):
+        outs = opdef.lower(LowerCtx(key), ins_vals, attrs)
+        return [v for vals in outs.values() for v in vals if v is not None]
+
+    if grad:
+        first_slot = next(iter(ins))
+
+        def loss(x0):
+            iv = dict(ins)
+            iv[first_slot] = [x0] + ins[first_slot][1:]
+            return sum(jnp.sum(o.astype(jnp.float32)) for o in fwd(iv))
+        fn = jax.jit(jax.value_and_grad(loss))
+        arg = ins[first_slot][0]
+    else:
+        fn = jax.jit(lambda iv: fwd(iv))
+        arg = ins
+
+    out = fn(arg)
+    jax.block_until_ready(out)
+    for _ in range(warmup):
+        out = fn(arg)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        out = fn(arg)
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - t0) * 1e6)
+    times = np.asarray(times)
+    return {"op": op_type, "repeat": repeat,
+            "mean_us": float(times.mean()),
+            "p50_us": float(np.percentile(times, 50)),
+            "p99_us": float(np.percentile(times, 99)),
+            "min_us": float(times.min())}

@@ -604,3 +604,37 @@ def _var_conv_2d(ctx, ins, attrs):
     cmask_o = (jnp.arange(co)[None, :] < out_clen[:, None])[:, None,
                                                             None, :]
     return {"Out": [jnp.where(rmask_o & cmask_o, out, 0)]}
+
+
+@register_op("split_lod_tensor", inputs=("X", "Mask"),
+             outputs=("OutTrue", "OutFalse", "TrueLen", "FalseLen"),
+             non_diff_inputs=("Mask",))
+def _split_lod_tensor(ctx, ins, attrs):
+    """IfElse data router (operators/split_lod_tensor_op.cc): rows with
+    mask true feed the true branch. The reference compacts each branch
+    into a smaller LoDTensor; the TPU-static version keeps [N, ...] and
+    zeroes the other branch's rows — merge_lod_tensor reassembles
+    exactly. CAVEAT vs the reference: ELEMENTWISE branch compute sees
+    identical values, but cross-row reductions (mean/softmax/batchnorm
+    over the batch axis) include the zeroed rows — divide by the
+    emitted TrueLen/FalseLen counts (not N) inside such branches."""
+    x = ins["X"][0]
+    mask = ins["Mask"][0].reshape(-1).astype(bool)
+    shape = [x.shape[0]] + [1] * (x.ndim - 1)
+    m = mask.reshape(shape)
+    n_true = mask.sum().astype(jnp.int32)
+    return {"OutTrue": [jnp.where(m, x, 0)],
+            "OutFalse": [jnp.where(m, 0, x)],
+            "TrueLen": [n_true],
+            "FalseLen": [mask.shape[0] - n_true]}
+
+
+@register_op("merge_lod_tensor", inputs=("InTrue", "InFalse", "Mask", "X"),
+             outputs=("Out",), non_diff_inputs=("Mask", "X"))
+def _merge_lod_tensor(ctx, ins, attrs):
+    """Inverse router (operators/merge_lod_tensor_op.cc): pick each
+    row from the branch its mask bit selected."""
+    t, f = ins["InTrue"][0], ins["InFalse"][0]
+    mask = ins["Mask"][0].reshape(-1).astype(bool)
+    shape = [t.shape[0]] + [1] * (t.ndim - 1)
+    return {"Out": [jnp.where(mask.reshape(shape), t, f)]}

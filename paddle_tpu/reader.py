@@ -143,15 +143,37 @@ class _WorkerPool:
         self.next_job_id = 0  # monotonic across epochs
         # shared result landing zone: concurrent iterators over one
         # loader both drain result_queue; whoever pops a job parks it
-        # here so the OWNING iterator finds it (no cross-stealing)
+        # here so the OWNING iterator finds it (no cross-stealing).
+        # `owned` = job ids some live iterator still wants: results of
+        # ABANDONED iterators (early break) are discarded on arrival
+        # instead of leaking in the parking dict forever
         self.results = {}
+        self.owned = set()
         self._rlock = threading.Lock()
+
+    def issue_job(self, indices):
+        """Allocate a pool-global job id and enqueue (the id MUST come
+        from the pool at dispatch time — per-iterator counters go stale
+        when iterators interleave and would collide)."""
+        with self._rlock:
+            jid = self.next_job_id
+            self.next_job_id = jid + 1
+            self.owned.add(jid)
+        self.index_queues[jid % self.num_workers].put((jid, indices))
+        return jid
+
+    def disown(self, job_ids):
+        with self._rlock:
+            for jid in job_ids:
+                self.owned.discard(jid)
+                self.results.pop(jid, None)
 
     def collect(self, job_id, timeout=5.0):
         """Block until job_id's result is available; park others."""
         while True:
             with self._rlock:
                 if job_id in self.results:
+                    self.owned.discard(job_id)
                     return self.results.pop(job_id)
             try:
                 jid, data, err = self.result_queue.get(timeout=timeout)
@@ -166,7 +188,9 @@ class _WorkerPool:
                         [w.exitcode for w in dead]) from None
                 continue
             with self._rlock:
-                self.results[jid] = (data, err)
+                if jid in self.owned:
+                    self.results[jid] = (data, err)
+                # else: abandoned iterator's job — drop it
 
     def __del__(self):
         try:
@@ -202,8 +226,6 @@ class _MultiprocessIter:
         self._result_queue = pool.result_queue
         self._workers = pool.workers
         self._batches = iter(loader.batch_sampler)
-        self._send_idx = pool.next_job_id
-        self._rcv_idx = pool.next_job_id
         self._sent = []  # job ids THIS iterator owns, in order
         self._done_sending = False
         # keep 2 jobs in flight per worker (prefetch_factor)
@@ -216,17 +238,14 @@ class _MultiprocessIter:
         except StopIteration:
             self._done_sending = True
             return
-        self._index_queues[self._send_idx % len(self._index_queues)].put(
-            (self._send_idx, indices))
-        self._sent.append(self._send_idx)
-        self._send_idx = self._pool.next_job_id = \
-            max(self._send_idx + 1, self._pool.next_job_id)
+        self._sent.append(self._pool.issue_job(indices))
 
     def __iter__(self):
         return self
 
     def __next__(self):
         if not self._sent and self._done_sending:
+            self._shutdown()
             raise StopIteration
         try:
             data, err = self._pool.collect(self._sent.pop(0))
@@ -240,12 +259,22 @@ class _MultiprocessIter:
         return data
 
     def _shutdown(self):
+        # release this iterator's outstanding jobs so their late
+        # results are discarded, not parked forever
+        self._pool.disown(self._sent)
+        self._sent = []
         # epoch end keeps the pool alive for the next __iter__; only a
         # worker failure tears it down (and clears the loader's cache)
         if any(not w.is_alive() for w in self._workers):
             self._pool.shutdown()
             if getattr(self.loader, "_pool", None) is self._pool:
                 self.loader._pool = None
+
+    def __del__(self):
+        try:
+            self._pool.disown(self._sent)
+        except Exception:
+            pass
 
 
 class _DevicePrefetcher:

@@ -88,3 +88,13 @@ def test_build_strategy_knobs():
         pt.BuildStrategy.ReduceStrategy.Reduce
     with pytest.raises(ValueError):
         pt.CompiledProgram(cp)
+
+
+def test_build_strategy_xla_flags_render():
+    from paddle_tpu.compiler import BuildStrategy
+    bs = BuildStrategy()
+    assert bs.xla_flags_for() == ""  # defaults: XLA's own combiner
+    bs.fuse_all_reduce_threshold_mb = 32
+    assert "combine_threshold_bytes=33554432" in bs.xla_flags_for()
+    bs.fuse_all_reduce_ops = False
+    assert "combine_threshold_bytes=0" in bs.xla_flags_for()

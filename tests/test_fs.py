@@ -1,0 +1,115 @@
+"""Filesystem abstraction tests: LocalFS contract + HDFSClient driving
+a fake `hadoop` CLI (the reference tests HDFSClient the same way —
+test_fs.py with a mocked shell)."""
+import os
+import stat
+
+import pytest
+
+from paddle_tpu.fleet.fs import (ExecuteError, FSFileExistsError,
+                                 HDFSClient, LocalFS, fs_for_path)
+
+
+def test_local_fs_contract(tmp_path):
+    fs = LocalFS()
+    d = str(tmp_path / "d")
+    fs.mkdirs(d)
+    assert fs.is_dir(d) and fs.is_exist(d) and not fs.is_file(d)
+    f = os.path.join(d, "a.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    with pytest.raises(FSFileExistsError):
+        fs.touch(f, exist_ok=False)
+    fs.mkdirs(os.path.join(d, "sub"))
+    dirs, files = fs.ls_dir(d)
+    assert dirs == ["sub"] and files == ["a.txt"]
+    assert fs.list_dirs(d) == ["sub"]
+    f2 = os.path.join(d, "b.txt")
+    fs.mv(f, f2)
+    assert fs.is_file(f2) and not fs.is_exist(f)
+    fs.delete(f2)
+    assert not fs.is_exist(f2)
+    fs.delete(d)
+    assert not fs.is_exist(d)
+    assert not fs.need_upload_download()
+
+
+def _fake_hadoop(tmp_path):
+    """A `hadoop` stand-in implementing the fs subcommands over a local
+    root — lets the HDFSClient's CLI driving be tested hermetically."""
+    root = tmp_path / "hdfs_root"
+    root.mkdir()
+    script = tmp_path / "hadoop"
+    script.write_text(f"""#!/bin/bash
+ROOT={root}
+# drop "fs" and -D conf pairs
+args=()
+skip=0
+for a in "${{@:2}}"; do
+  if [ $skip = 1 ]; then skip=0; continue; fi
+  if [ "$a" = "-D" ]; then skip=1; continue; fi
+  args+=("$a")
+done
+cmd=${{args[0]}}
+p() {{ echo "$ROOT/${{1#hdfs://}}"; }}
+case $cmd in
+  -test)
+    flag=${{args[1]}}; path=$(p "${{args[2]}}")
+    if [ "$flag" = "-e" ]; then [ -e "$path" ]; exit $?; fi
+    if [ "$flag" = "-d" ]; then [ -d "$path" ]; exit $?; fi
+    exit 1;;
+  -mkdir) path=$(p "${{args[2]}}"); mkdir -p "$path";;
+  -put) cp "${{args[1]}}" "$(p "${{args[2]}}")";;
+  -get) cp "$(p "${{args[1]}}")" "${{args[2]}}";;
+  -rmr) rm -rf "$(p "${{args[1]}}")";;
+  -mv) mv "$(p "${{args[1]}}")" "$(p "${{args[2]}}")";;
+  -touchz) touch "$(p "${{args[1]}}")";;
+  -ls)
+    path=$(p "${{args[1]}}")
+    for e in "$path"/*; do
+      [ -e "$e" ] || continue
+      if [ -d "$e" ]; then t="drwxr-xr-x"; else t="-rw-r--r--"; fi
+      echo "$t 1 u g 0 2026-01-01 00:00 $e"
+    done;;
+  *) echo "unknown $cmd" >&2; exit 1;;
+esac
+""")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    return str(script), root
+
+
+def test_hdfs_client_over_fake_cli(tmp_path):
+    hadoop, root = _fake_hadoop(tmp_path)
+    cli = HDFSClient(hadoop_bin=hadoop,
+                     configs={"fs.default.name": "hdfs://ns",
+                              "hadoop.job.ugi": "u,p"})
+    assert cli.need_upload_download()
+    cli.mkdirs("hdfs://data/dir")
+    assert cli.is_exist("hdfs://data/dir")
+    assert cli.is_dir("hdfs://data/dir")
+    local = tmp_path / "x.txt"
+    local.write_text("hello")
+    cli.upload(str(local), "hdfs://data/dir/x.txt")
+    assert cli.is_file("hdfs://data/dir/x.txt")
+    got = tmp_path / "got.txt"
+    cli.download("hdfs://data/dir/x.txt", str(got))
+    assert got.read_text() == "hello"
+    dirs, files = cli.ls_dir("hdfs://data/dir")
+    assert files == ["x.txt"]
+    cli.touch("hdfs://data/dir/y.txt")
+    cli.mv("hdfs://data/dir/y.txt", "hdfs://data/dir/z.txt")
+    assert cli.is_file("hdfs://data/dir/z.txt")
+    cli.delete("hdfs://data/dir/z.txt")
+    assert not cli.is_exist("hdfs://data/dir/z.txt")
+
+
+def test_hdfs_client_no_binary_errors():
+    cli = HDFSClient(hadoop_bin=None)
+    cli._bin = None
+    with pytest.raises(ExecuteError, match="hadoop"):
+        cli.mkdirs("hdfs://x")
+
+
+def test_fs_for_path_routing():
+    assert isinstance(fs_for_path("/tmp/x"), LocalFS)
+    assert isinstance(fs_for_path("hdfs://ns/x"), HDFSClient)

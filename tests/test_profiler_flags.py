@@ -92,3 +92,13 @@ def test_check_nan_inf_reports(capfd):
         assert "log" in captured.out
     finally:
         pt.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_op_bench_harness():
+    from paddle_tpu.incubate.op_bench import bench_op
+    r = bench_op("softmax", {"X": (8, 32)}, repeat=5, warmup=1)
+    assert r["op"] == "softmax" and r["mean_us"] > 0
+    assert r["min_us"] <= r["p50_us"] <= r["p99_us"] + 1e-9
+    g = bench_op("matmul", {"X": (8, 16), "Y": (16, 4)}, repeat=3,
+                 warmup=1, grad=True)
+    assert g["mean_us"] > 0

@@ -229,3 +229,43 @@ def test_wide_deep_ctr_over_transport_loss_parity():
     l0, l1 = _losses(touts[0]), _losses(touts[1])
     mean_losses = [(a + b) / 2 for a, b in zip(l0, l1)]
     np.testing.assert_allclose(mean_losses, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_dead_trainer_releases_barrier():
+    """A trainer whose connection drops without OP_COMPLETE is dead:
+    its barrier party is removed so survivors keep training
+    (heart_beat_monitor.h:54 analog — connection = heartbeat)."""
+    import threading
+    from paddle_tpu.distributed.communicator import ParamServer
+    from paddle_tpu.distributed.rpc import PsClient, PsServer
+
+    srv = PsServer(ParamServer(lr=1.0), "127.0.0.1:0",
+                   n_trainers=2).start()
+    alive = PsClient(srv.endpoint)
+    dead = PsClient(srv.endpoint)
+    try:
+        alive.init_param("w", np.zeros(2, np.float32))
+        released = []
+
+        def wait_barrier():
+            alive.send_grad_sync("w", np.ones(2, np.float32))
+            alive.barrier()   # would block forever with 2 live parties
+            released.append(True)
+
+        # the dying trainer DID trainer traffic (so its connection
+        # counts as a heartbeat; a pull-only client closing must not
+        # shrink the barrier) and staged a grad before dying
+        dead.send_grad_sync("w", np.full(2, 3.0, np.float32))
+        t = threading.Thread(target=wait_barrier, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        assert not released  # still waiting on the second trainer
+        dead.close()         # trainer dies WITHOUT complete()
+        t.join(timeout=20)
+        assert released, "surviving trainer stayed deadlocked"
+        # window applied with both staged grads: mean(1, 3) = 2
+        np.testing.assert_allclose(alive.get_param("w"),
+                                   -np.full(2, 2.0, np.float32))
+    finally:
+        alive.stop_server()
+        alive.close()

@@ -139,3 +139,28 @@ def test_lr_scheduler():
     assert abs(lrs[0] - 0.1) < 1e-6
     assert abs(lrs[10] - 0.05) < 1e-6
     assert abs(lrs[20] - 0.025) < 1e-6
+
+
+def test_pass_framework_and_dropout_prune():
+    from paddle_tpu.core.passes import apply_pass, list_passes
+    assert "amp_rewrite" in list_passes()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        h = layers.fc(x, 8, act="relu")
+        d = layers.dropout(h, dropout_prob=0.5)
+        out = layers.fc(d, 2)
+    n_before = len(main.global_block.ops)
+    pruned = apply_pass(main.clone(), "drop_dropout_eval")
+    ops = [o.type for o in pruned.global_block.ops]
+    # default downgrade_in_infer semantics: dropout -> scale(1-p)
+    assert "dropout" not in ops and "scale" in ops
+    assert len(pruned.global_block.ops) == n_before
+    # consumers rewired: the program still runs and matches the
+    # dropout-in-test-mode output
+    exe = pt.Executor()
+    exe.run(startup)
+    feed = {"x": np.random.RandomState(0).randn(4, 4).astype(np.float32)}
+    o1, = exe.run(main.clone(for_test=True), feed=feed, fetch_list=[out])
+    o2, = exe.run(pruned, feed=feed, fetch_list=[out])
+    np.testing.assert_allclose(o1, o2, atol=1e-6)
