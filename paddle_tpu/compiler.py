@@ -17,6 +17,7 @@ accepted as inert configuration.
 """
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 from .core.program import Program
@@ -56,9 +57,10 @@ class BuildStrategy:
 
     def xla_flags_for(self) -> str:
         """Render this strategy's collective knobs as an XLA_FLAGS
-        fragment. XLA reads the env at backend init, so launchers
-        (fleet/launch.py) prepend this to child processes' XLA_FLAGS;
-        inside a live process it can only warn."""
+        fragment. XLA reads the env at backend init:
+        CompiledProgram.with_data_parallel exports it (warning when the
+        backend already initialized), and fleet/launch.py forwards the
+        parent's XLA_FLAGS to child processes."""
         frags = []
         if self.fuse_all_reduce_ops and \
                 self.fuse_all_reduce_threshold_mb >= 0:
@@ -102,6 +104,27 @@ class CompiledProgram:
         if build_strategy is not None:
             self._build_strategy = build_strategy
         self._exec_strategy = exec_strategy
+        frag = self._build_strategy.xla_flags_for()
+        if frag and frag not in os.environ.get("XLA_FLAGS", ""):
+            # export for THIS process (effective only if the backend
+            # has not initialized yet) and for any child the launcher
+            # spawns — XLA reads the env once at backend init
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") + " " + frag).strip()
+            import jax.extend.backend as _jb
+            try:
+                initialized = bool(
+                    getattr(_jb, "backends_are_initialized",
+                            lambda: True)())
+            except Exception:
+                initialized = True
+            if initialized:
+                import logging
+                logging.getLogger("paddle_tpu").warning(
+                    "BuildStrategy collective knobs (%s) exported to "
+                    "XLA_FLAGS after backend init — they take effect "
+                    "only in processes launched from here "
+                    "(fleet.launch children inherit the env)", frag)
         if places is not None:
             self._n_devices = len(places) if hasattr(places, "__len__") \
                 else int(places)

@@ -4,3 +4,4 @@ from .communicator import (Communicator, AsyncCommunicator,  # noqa: F401
                            GeoCommunicator, HalfAsyncCommunicator,
                            ParamServer, SyncCommunicator)
 from .ps_worker import DownpourWorker, HeterWorker  # noqa: F401
+from .multi_trainer import MultiTrainer, train_from_dataset  # noqa: F401

@@ -1,0 +1,78 @@
+"""MultiTrainer: N device-worker threads draining one dataset channel.
+
+Analog of the reference's trainer fan-out
+(/root/reference/paddle/fluid/framework/multi_trainer.cc — MultiTrainer
+spawns `thread_num` DeviceWorkers, each pulling batches from the
+DataFeed's shared channel and running the train program;
+trainer_desc.proto thread_num). Here the channel is a lock-guarded
+batch iterator and each worker thread runs a DownpourWorker/HeterWorker
+style step; the device compute serializes through jit dispatch, so the
+fan-out's win is what the reference's also is on the CPU side —
+overlapping host work (parsing, KV pulls/pushes) across threads.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterable, List, Optional
+
+import numpy as np
+
+
+class MultiTrainer:
+    """run(batches, worker_fn, thread_num): worker_fn(batch) -> loss.
+
+    Batches are drained from ONE shared iterator (the reference's
+    reader channel): workers pull whenever free, so a slow host stage
+    in one thread doesn't stall the others.
+    """
+
+    def __init__(self, thread_num: int = 2):
+        self.thread_num = max(1, int(thread_num))
+
+    def run(self, batches: Iterable, worker_fn: Callable[[Any], Any]
+            ) -> List[float]:
+        it = iter(batches)
+        lock = threading.Lock()
+        losses: List[float] = []
+        errors: List[BaseException] = []
+
+        def channel_next():
+            with lock:
+                try:
+                    return next(it), True
+                except StopIteration:
+                    return None, False
+
+        def worker(tid: int):
+            while True:
+                batch, ok = channel_next()
+                if not ok:
+                    return
+                try:
+                    loss = worker_fn(batch)
+                except BaseException as e:  # surfaced after join
+                    errors.append(e)
+                    return
+                with lock:
+                    losses.append(float(np.asarray(loss)))
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(self.thread_num)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return losses
+
+
+def train_from_dataset(dataset, worker_fn, thread_num: int = 2,
+                       epochs: int = 1) -> List[float]:
+    """Executor.train_from_dataset-shaped convenience: drain the
+    Dataset's batch stream through a MultiTrainer pool per epoch."""
+    mt = MultiTrainer(thread_num)
+    losses: List[float] = []
+    for _ in range(epochs):
+        losses.extend(mt.run(iter(dataset), worker_fn))
+    return losses

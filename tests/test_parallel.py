@@ -134,3 +134,63 @@ def test_graft_entry_dryrun():
     out = jax.jit(fn)(*args)
     assert out.shape[0] == 4
     g.dryrun_multichip(8)
+
+
+def test_dygraph_data_parallel_real_sharded_path():
+    """DataParallel.forward must actually shard batches over the dp
+    mesh (round-2 weak #8: the wrapper was ornamental) AND match the
+    unsharded numerics exactly."""
+    import paddle_tpu.parallel as dist
+    from paddle_tpu import nn
+    from paddle_tpu.dygraph import tape
+    from paddle_tpu.parallel.data_parallel import DataParallel
+
+    dist.init_parallel_env({"dp": 4})
+    tape.seed(21)
+    net = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 2))
+    tape.seed(21)
+    ref_net = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 2))
+    dp = DataParallel(net)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 6).astype(np.float32)
+
+    out = dp(tape.to_tensor(x, stop_gradient=False))
+    ref = ref_net(tape.to_tensor(x, stop_gradient=False))
+    np.testing.assert_allclose(np.asarray(out.value),
+                               np.asarray(ref.value), atol=1e-6)
+    # the forward really ran sharded: activations carry a dp sharding
+    shard_axes = {getattr(s, "spec", None)
+                  for s in [out.value.sharding]}
+    assert any("dp" in str(s) for s in shard_axes), out.value.sharding
+
+    # backward numerics identical to the unsharded run
+    loss = (out * out).sum()
+    loss.backward()
+    rloss = (ref * ref).sum()
+    rloss.backward()
+    for p, q in zip(net.parameters(), ref_net.parameters()):
+        np.testing.assert_allclose(np.asarray(p.gradient),
+                                   np.asarray(q.gradient), atol=1e-5)
+
+
+def test_dygraph_data_parallel_input_grads_flow():
+    """Round-3 regression: the sharding reshard is TAPED — input grads
+    must reach the caller's tensor (saliency/GAN flows)."""
+    import paddle_tpu.parallel as dist
+    from paddle_tpu import nn
+    from paddle_tpu.dygraph import tape
+    from paddle_tpu.parallel.data_parallel import DataParallel
+
+    dist.init_parallel_env({"dp": 4})
+    tape.seed(31)
+    net = nn.Linear(3, 1)
+    tape.seed(31)
+    ref_net = nn.Linear(3, 1)
+    x = tape.to_tensor(np.random.RandomState(1).randn(4, 3)
+                       .astype(np.float32), stop_gradient=False)
+    xr = tape.to_tensor(np.asarray(x.value), stop_gradient=False)
+    (DataParallel(net)(x) ** 2).sum().backward()
+    (ref_net(xr) ** 2).sum().backward()
+    assert x.gradient is not None
+    np.testing.assert_allclose(np.asarray(x.gradient),
+                               np.asarray(xr.gradient), atol=1e-5)

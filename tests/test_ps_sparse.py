@@ -260,3 +260,56 @@ def test_heter_worker_pipeline_matches_serial():
     all_ids = np.concatenate([b[0].reshape(-1) for b in batches])
     np.testing.assert_allclose(s2.pull_sparse("emb", all_ids),
                                s1.pull_sparse("emb", all_ids))
+
+
+def test_multi_trainer_drains_channel_and_trains():
+    """MultiTrainer fan-out (multi_trainer.cc analog): N worker threads
+    drain one batch channel; every batch is consumed exactly once and
+    CTR training still converges."""
+    from paddle_tpu.distributed import MultiTrainer
+    rng = np.random.RandomState(11)
+    vocab, dim, B, T = 60, 4, 16, 3
+    server = ParamServer()
+    server.create_sparse_table(SparseTableConfig(
+        name="emb", dim=dim, initializer="gaussian", init_scale=0.1,
+        optimizer="adagrad", lr=0.5, seed=2))
+    worker = DownpourWorker(server, "emb")
+    true_w = rng.randn(vocab) * 2
+
+    batches = []
+    for _ in range(40):
+        ids = rng.randint(0, vocab, (B, T))
+        y = (true_w[ids].sum(1) > 0).astype(np.float32)
+        batches.append((ids, y))
+
+    @jax.jit
+    def step(rows, y):
+        def loss_fn(rows):
+            logit = rows.sum(axis=(1, 2))
+            p = jax.nn.sigmoid(logit)
+            return -jnp.mean(y * jnp.log(p + 1e-7) +
+                             (1 - y) * jnp.log(1 - p + 1e-7))
+        return jax.value_and_grad(loss_fn)(rows)
+
+    consumed = []
+
+    def worker_fn(batch):
+        ids, y = batch
+        consumed.append(1)
+        return worker.train_batch(ids, lambda rows, yy=y: [
+            np.asarray(v) for v in step(jnp.asarray(rows),
+                                        jnp.asarray(yy))])
+
+    losses = MultiTrainer(thread_num=3).run(batches, worker_fn)
+    assert len(losses) == len(batches) == len(consumed)
+    assert np.mean(losses[-8:]) < np.mean(losses[:8]) * 0.7
+
+
+def test_multi_trainer_propagates_worker_error():
+    from paddle_tpu.distributed import MultiTrainer
+
+    def bad(batch):
+        raise ValueError("worker exploded")
+
+    with pytest.raises(ValueError, match="exploded"):
+        MultiTrainer(thread_num=2).run([1, 2, 3], bad)

@@ -269,3 +269,33 @@ def test_dead_trainer_releases_barrier():
     finally:
         alive.stop_server()
         alive.close()
+
+
+def test_geo_communicator_over_transport():
+    """GeoSGD across the process boundary: the GeoCommunicator is
+    transport-agnostic (send_delta/get_param duck-typing), so local
+    training with periodic delta pushes works against a remote pserver
+    exactly as against the in-process object (communicator.cc:403
+    GeoCommunicator semantics)."""
+    from paddle_tpu.distributed import GeoCommunicator, ParamServer
+    from paddle_tpu.distributed.rpc import PsClient, PsServer
+
+    srv = PsServer(ParamServer(), "127.0.0.1:0", n_trainers=1).start()
+    cli = PsClient(srv.endpoint)
+    try:
+        cli.init_param("w", np.zeros(4, np.float32))
+        geo = GeoCommunicator(cli, trainer_push_step=3)
+        geo.init_local("w")
+        g = np.ones(4, np.float32)
+        for i in range(6):
+            geo.local_step("w", g, lr=0.1)
+        # 6 local sgd steps pushed as 2 delta windows of -0.3 each
+        np.testing.assert_allclose(cli.get_param("w"),
+                                   np.full(4, -0.6, np.float32),
+                                   atol=1e-6)
+        np.testing.assert_allclose(geo.local_param("w"),
+                                   np.full(4, -0.6, np.float32),
+                                   atol=1e-6)
+    finally:
+        cli.stop_server()
+        cli.close()
