@@ -130,12 +130,32 @@ class LargeScaleKV:
     def ids(self):
         return sorted(self._rows)
 
+    def write(self, ids: np.ndarray, values: np.ndarray):
+        """Direct row assignment (lookup_sparse_table_write): resets the
+        rows' optimizer slots too — a written row restarts its history,
+        keeping the rows/slots invariant in one place."""
+        ids = np.asarray(ids).reshape(-1)
+        values = np.asarray(values, np.float32).reshape(len(ids), -1)
+        with self._lock:
+            for i, r in enumerate(ids):
+                r = int(r)
+                self._rows[r] = values[i]
+                for slot in self._slots.values():
+                    slot.pop(r, None)
+                self._beta_pow.pop(r, None)
+
     def save(self, dirname: str):
         os.makedirs(dirname, exist_ok=True)
-        with open(os.path.join(dirname, self.cfg.name + ".kv"), "wb") as f:
-            pickle.dump({"cfg": self.cfg.__dict__, "rows": self._rows,
-                         "slots": self._slots,
-                         "beta_pow": self._beta_pow}, f, protocol=2)
+        with self._lock:
+            # snapshot under the lock: handler threads mutate _rows
+            # concurrently (PsServer is thread-per-connection)
+            blob = pickle.dumps(
+                {"cfg": self.cfg.__dict__, "rows": dict(self._rows),
+                 "slots": {k: dict(v) for k, v in self._slots.items()},
+                 "beta_pow": dict(self._beta_pow)}, protocol=2)
+        with open(os.path.join(dirname, self.cfg.name + ".kv"),
+                  "wb") as f:
+            f.write(blob)
 
     def load(self, dirname: str):
         with open(os.path.join(dirname, self.cfg.name + ".kv"), "rb") as f:

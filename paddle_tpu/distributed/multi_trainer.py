@@ -38,6 +38,8 @@ class MultiTrainer:
 
         def channel_next():
             with lock:
+                if errors:  # a sibling failed: stop the drain — no
+                    return None, False  # more pushes after a fatal error
                 try:
                     return next(it), True
                 except StopIteration:

@@ -303,6 +303,12 @@ class PsServer:
                 return encode_reply()
             if op == OP_PING:
                 return encode_reply([np.asarray([1], np.int32)])
+            if op == OP_SAVE_SPARSE:
+                # checkpoint_notify: persist every sparse table under
+                # dirname (name arg) — save_op on the pserver side
+                for kv in self.ps.sparse.values():
+                    kv.save(name)
+                return encode_reply()
             if op == OP_COMPLETE:
                 # a finished trainer must not block others' barriers —
                 # releases currently-blocked waiters if it was the
@@ -390,6 +396,9 @@ class PsClient:
 
     def barrier(self):
         self._call(OP_BARRIER)
+
+    def save_sparse(self, dirname: str):
+        self._call(OP_SAVE_SPARSE, dirname)
 
     def ping(self) -> bool:
         try:
@@ -484,6 +493,10 @@ class ShardedPsClient:
     def barrier(self):
         for c in self.clients:
             c.barrier()
+
+    def save_sparse(self, dirname: str):
+        for c in self.clients:
+            c.save_sparse(dirname)
 
     def complete(self):
         for c in self.clients:

@@ -1310,3 +1310,187 @@ def _deformable_conv(ctx, ins, attrs):
     wmat = wgt.reshape(groups, cout // groups, cpg * k)
     out = jnp.einsum("gok,ngks->ngos", wmat, cols)
     return {"Output": [out.reshape(n, cout, ho, wo)]}
+
+
+@register_op("box_decoder_and_assign",
+             inputs=("PriorBox", "PriorBoxVar", "TargetBox", "BoxScore"),
+             outputs=("DecodeBox", "OutputAssignBox"), no_grad=True)
+def _box_decoder_and_assign(ctx, ins, attrs):
+    """Decode per-class box deltas and keep each roi's best-class box
+    (operators/detection/box_decoder_and_assign_op.cc)."""
+    prior = ins["PriorBox"][0]          # [N, 4]
+    pvar = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None
+    deltas = ins["TargetBox"][0]        # [N, 4*C]
+    scores = ins["BoxScore"][0]         # [N, C]
+    n, c4 = deltas.shape
+    c = c4 // 4
+    d = deltas.reshape(n, c, 4)
+    boxes = []
+    for ci in range(c):
+        boxes.append(_decode_deltas(prior, d[:, ci],
+                                    pvar if pvar is not None else None))
+    dec = jnp.stack(boxes, axis=1).reshape(n, c4)  # [N, 4C]
+    if c > 1:
+        # reference (box_decoder_and_assign_op.h): background (class 0)
+        # never wins the assignment — argmax over classes 1..C-1
+        best = 1 + jnp.argmax(scores[:, 1:], axis=1)
+        assign = jnp.take_along_axis(
+            dec.reshape(n, c, 4), best[:, None, None].repeat(4, -1),
+            axis=1)[:, 0]
+    else:
+        assign = prior  # no foreground class: fall back to the prior
+    return {"DecodeBox": [dec], "OutputAssignBox": [assign]}
+
+
+@register_op("generate_proposal_labels",
+             inputs=("RpnRois", "GtClasses", "IsCrowd", "GtBoxes",
+                     "ImInfo", "RpnRoisNum", "GtNum"),
+             outputs=("Rois", "LabelsInt32", "BboxTargets",
+                      "BboxInsideWeights", "BboxOutsideWeights",
+                      "RoisNum"),
+             no_grad=True, is_random=True)
+def _generate_proposal_labels(ctx, ins, attrs):
+    """Fast-RCNN RoI sampling (operators/detection/
+    generate_proposal_labels_op.cc): per image, label each proposal by
+    max-IoU gt (fg >= fg_thresh, bg in [bg_lo, bg_hi)), subsample to
+    batch_size_per_im with fg_fraction, emit box regression targets for
+    fg rois. TPU-static: fixed batch_size_per_im rows per image, -1/0
+    padding."""
+    rois = ins["RpnRois"][0]            # [N*R, 4] padded
+    gt_cls = ins["GtClasses"][0]        # [N, G]
+    gt = ins["GtBoxes"][0]              # [N, G, 4]
+    rois_num = ins["RpnRoisNum"][0].astype(jnp.int32)
+    gt_num = ins["GtNum"][0].astype(jnp.int32) if ins.get("GtNum") else \
+        jnp.full((gt.shape[0],), gt.shape[1], jnp.int32)
+    bs = int(attrs.get("batch_size_per_im", 512))
+    fg_frac = float(attrs.get("fg_fraction", 0.25))
+    fg_th = float(attrs.get("fg_thresh", 0.5))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    n = gt.shape[0]
+    r = rois.shape[0] // n
+    rois = rois.reshape(n, r, 4)
+    fg_cap = int(bs * fg_frac)
+    key = ctx.rng()
+
+    def per_image(args):
+        roi_i, nroi, gt_i, cls_i, ng, k = args
+        rvalid = jnp.arange(r) < nroi
+        gvalid = jnp.arange(gt_i.shape[0]) < ng
+        # gt boxes join the roi pool (the reference appends them)
+        iou = _iou_matrix(roi_i, gt_i, normalized=False)
+        iou = jnp.where(gvalid[None, :] & rvalid[:, None], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        is_fg = best_iou >= fg_th
+        is_bg = (best_iou < bg_hi) & (best_iou >= bg_lo) & rvalid & \
+            ~is_fg
+        k1, k2 = jax.random.split(k)
+        # cap fg at fg_cap via a first top-k, then rank fg above bg in
+        # ONE combined top-k(bs): bg fills whatever fg leaves unfilled
+        # (the reference draws bs - n_fg backgrounds)
+        fg_noise = jax.random.uniform(k1, (r,))
+        fg_rank = jnp.where(is_fg, fg_noise, -1.0)
+        _, fg_idx = jax.lax.top_k(fg_rank, min(fg_cap, r))
+        fg_keep = jnp.zeros(r, bool).at[fg_idx].set(
+            fg_rank[fg_idx] > 0)
+        combined = jnp.where(fg_keep, 2.0 + fg_noise,
+                             jnp.where(is_bg,
+                                       1.0 + jax.random.uniform(k2, (r,)),
+                                       -1.0))
+        top, sel = jax.lax.top_k(combined, min(bs, r))
+        ok = top > 0
+        if r < bs:  # pad the fixed bs rows
+            sel = jnp.concatenate([sel, jnp.zeros(bs - r, sel.dtype)])
+            ok = jnp.concatenate([ok, jnp.zeros(bs - r, bool)])
+        sel_fg = fg_keep[sel] & ok
+        sel_rois = jnp.where(ok[:, None], roi_i[sel], 0.0)
+        labels = jnp.where(sel_fg, cls_i[best_gt[sel]], 0)
+        labels = jnp.where(ok, labels, -1).astype(jnp.int32)
+        tgt = _encode_deltas(roi_i[sel], gt_i[best_gt[sel]])
+        tgt = jnp.where(sel_fg[:, None], tgt, 0.0)
+        w = jnp.where(sel_fg[:, None], 1.0, 0.0) * jnp.ones((1, 4))
+        return (sel_rois, labels, tgt, w, w,
+                ok.sum().astype(jnp.int32))
+
+    keys = jax.random.split(key, n)
+    out = jax.lax.map(per_image, (rois, rois_num, gt, gt_cls, gt_num,
+                                  keys))
+    rois_o, labels, tgt, wi, wo, num = out
+    return {"Rois": [rois_o.reshape(n * bs, 4)],
+            "LabelsInt32": [labels.reshape(n * bs)],
+            "BboxTargets": [tgt.reshape(n * bs, 4)],
+            "BboxInsideWeights": [wi.reshape(n * bs, 4)],
+            "BboxOutsideWeights": [wo.reshape(n * bs, 4)],
+            "RoisNum": [num]}
+
+
+@register_op("roi_perspective_transform",
+             inputs=("X", "ROIs", "RoisImageIdx"),
+             outputs=("Out", "Mask", "TransformMatrix"),
+             non_diff_inputs=("ROIs",))
+def _roi_perspective_transform(ctx, ins, attrs):
+    """Perspective-warp each quadrilateral ROI to a fixed rectangle
+    (operators/detection/roi_perspective_transform_op.cc, EAST text
+    detection): solve the 3x3 homography from the 4 roi corners to the
+    output rectangle, bilinear-sample along it."""
+    x = ins["X"][0]                 # [N, C, H, W]
+    rois = ins["ROIs"][0]           # [R, 8] four corners (x1..y4)
+    # per-roi image index (the reference's LoD); defaults to image 0
+    roi_img = ins["RoisImageIdx"][0].astype(jnp.int32) \
+        if ins.get("RoisImageIdx") else jnp.zeros(
+            (rois.shape[0],), jnp.int32)
+    ph = int(attrs.get("transformed_height", 8))
+    pw = int(attrs.get("transformed_width", 8))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+
+    def homography(quad):
+        # map (0,0),(pw-1,0),(pw-1,ph-1),(0,ph-1) -> quad corners
+        src = jnp.asarray([[0, 0], [pw - 1, 0], [pw - 1, ph - 1],
+                           [0, ph - 1]], jnp.float32)
+        dst = quad.reshape(4, 2) * scale
+        rows = []
+        for i in range(4):
+            sx, sy = src[i]
+            dx, dy = dst[i, 0], dst[i, 1]
+            rows.append(jnp.asarray([sx, sy, 1, 0, 0, 0,
+                                     -dx * sx, -dx * sy]))
+            rows.append(jnp.asarray([0, 0, 0, sx, sy, 1,
+                                     -dy * sx, -dy * sy]))
+        a = jnp.stack(rows)
+        b = dst.reshape(-1)
+        hvec = jnp.linalg.solve(a + 1e-6 * jnp.eye(8), b)
+        return jnp.concatenate([hvec, jnp.ones(1)]).reshape(3, 3)
+
+    gy, gx = jnp.meshgrid(jnp.arange(ph, dtype=jnp.float32),
+                          jnp.arange(pw, dtype=jnp.float32),
+                          indexing="ij")
+    ones = jnp.ones_like(gx)
+    grid = jnp.stack([gx, gy, ones], axis=-1)  # [ph, pw, 3]
+
+    def one_roi(args):
+        quad, img_idx = args
+        img = x[img_idx]
+        m = homography(quad)
+        pts = grid @ m.T
+        px = pts[..., 0] / (pts[..., 2] + 1e-8)
+        py = pts[..., 1] / (pts[..., 2] + 1e-8)
+        x0 = jnp.floor(px).astype(jnp.int32)
+        y0 = jnp.floor(py).astype(jnp.int32)
+        wx = px - x0
+        wy = py - y0
+        val = 0.0
+        inb = jnp.zeros(px.shape, bool)
+        for dy, wyf in ((0, 1 - wy), (1, wy)):
+            for dx, wxf in ((0, 1 - wx), (1, wx)):
+                yi, xi = y0 + dy, x0 + dx
+                ok = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+                inb = inb | ok
+                v = img[:, yi.clip(0, h - 1), xi.clip(0, w - 1)]
+                val = val + v * (wyf * wxf * ok)[None]
+        return val, inb.astype(jnp.int32), m
+
+    outs, masks, mats = jax.lax.map(one_roi, (rois, roi_img))
+    return {"Out": [outs], "Mask": [masks[:, None]],
+            "TransformMatrix": [mats.reshape(rois.shape[0], 9)]}

@@ -212,3 +212,136 @@ def _listen_and_serv(ctx, ins, attrs):
     _SERVERS[srv.endpoint] = srv
     srv._thread.join()
     return {}
+
+
+@register_op("split_ids", inputs=("Ids",), outputs=("Out",),
+             no_grad=True, host=True)
+def _split_ids(ctx, ins, attrs):
+    """Shard ids by id % n_parts for per-pserver lookups
+    (operators/distributed_ops/split_ids_op.cc). Emits n_parts padded
+    arrays (-1 fill; the reference emits ragged LoD pieces)."""
+    ids = np.asarray(ins["Ids"][0]).reshape(-1)
+    n = int(attrs["n_parts"])
+    outs = []
+    cap = max(1, len(ids))
+    for i in range(n):
+        part = ids[ids % n == i]
+        pad = np.full(cap, -1, ids.dtype)
+        pad[:len(part)] = part
+        outs.append(pad)
+    return {"Out": outs}
+
+
+@register_op("merge_ids", inputs=("Ids", "Rows", "X"), outputs=("Out",),
+             no_grad=True, host=True)
+def _merge_ids(ctx, ins, attrs):
+    """Inverse of split_ids for looked-up rows
+    (operators/distributed_ops/merge_ids_op.cc): reassemble per-part
+    row blocks into the original id order."""
+    ids = np.asarray(ins["Ids"][0]).reshape(-1)
+    parts_ids = [np.asarray(v).reshape(-1) for v in ins["Rows"]]
+    parts_rows = [np.asarray(v) for v in ins["X"]]
+    dim = parts_rows[0].shape[-1]
+    lut = {}
+    for pid, prow in zip(parts_ids, parts_rows):
+        for i, r in enumerate(pid):
+            if r >= 0:
+                lut[int(r)] = prow[i]
+    out = np.stack([lut[int(i)] for i in ids]).reshape(
+        ids.shape + (dim,))
+    return {"Out": [out]}
+
+
+@register_op("split_selected_rows", inputs=("X",), outputs=("Out",),
+             no_grad=True, host=True)
+def _split_selected_rows(ctx, ins, attrs):
+    """Split a SelectedRows grad into per-pserver height sections
+    (operators/distributed_ops/split_selected_rows_op.cc).
+    height_sections attr gives each shard's row range."""
+    from ..core.selected_rows import SelectedRows
+    x = ins["X"][0]
+    sections = [int(s) for s in attrs["height_sections"]]
+    if not isinstance(x, SelectedRows):
+        # dense fallback: split along axis 0
+        outs, start = [], 0
+        xv = np.asarray(x)
+        for s in sections:
+            outs.append(xv[start:start + s])
+            start += s
+        return {"Out": outs}
+    rows = np.asarray(x.rows)
+    vals = np.asarray(x.values)
+    outs, start = [], 0
+    for s in sections:
+        sel = (rows >= start) & (rows < start + s)
+        outs.append(SelectedRows(rows[sel] - start, vals[sel], s))
+        start += s
+    return {"Out": outs}
+
+
+@register_op("ref_by_trainer_id", inputs=("X", "TrainerId"),
+             outputs=("Out",), no_grad=True, host=True)
+def _ref_by_trainer_id(ctx, ins, attrs):
+    """Pick this trainer's entry from a list input
+    (operators/distributed_ops/ref_by_trainer_id_op.cc)."""
+    tid = int(np.asarray(ins["TrainerId"][0]).reshape(-1)[0])
+    return {"Out": [np.asarray(ins["X"][tid % len(ins["X"])])]}
+
+
+@register_op("checkpoint_notify", inputs=(), outputs=(), no_grad=True,
+             host=True)
+def _checkpoint_notify(ctx, ins, attrs):
+    """Tell every pserver to persist its sparse tables under dirname
+    (operators/distributed_ops/checkpoint_notify_op.cc — the save
+    happens SERVER-side via the OP_SAVE_SPARSE rpc). attrs: dirname,
+    endpoints."""
+    get_ps_client(attrs["endpoints"]).save_sparse(attrs["dirname"])
+    return {}
+
+
+@register_op("send_and_recv", inputs=("X",), outputs=("Out",),
+             no_grad=True, host=True)
+def _send_and_recv(ctx, ins, attrs):
+    """Fused send+recv round trip (operators/distributed_ops/
+    send_and_recv_op.cc — the heter pipeline's single-RPC step): push
+    the grad, pull the fresh param in one host op."""
+    cli = get_ps_client(attrs["endpoints"])
+    name = attrs["var_names"][0]
+    cli.send_grad(name, np.asarray(ins["X"][0], np.float32))
+    return {"Out": [cli.get_param(name)]}
+
+
+@register_op("lookup_sparse_table_init", inputs=(), outputs=(),
+             no_grad=True, host=True)
+def _lookup_sparse_table_init(ctx, ins, attrs):
+    """Create a LargeScaleKV table in the process-global registry
+    (operators/distributed_ops/lookup_sparse_table_*_op.cc family —
+    large-scale sparse vars live outside Program scope)."""
+    from ..distributed.large_scale_kv import (LargeScaleKV,
+                                              SparseTableConfig)
+    cfg = SparseTableConfig(**{k: attrs[k] for k in
+                               ("name", "dim", "initializer",
+                                "init_scale", "optimizer", "lr", "seed")
+                               if k in attrs})
+    _SPARSE_TABLES.setdefault(cfg.name, LargeScaleKV(cfg))
+    return {}
+
+
+_SPARSE_TABLES: Dict[str, object] = {}
+
+
+@register_op("lookup_sparse_table_read", inputs=("Ids",),
+             outputs=("Out",), no_grad=True, host=True)
+def _lookup_sparse_table_read(ctx, ins, attrs):
+    ids = np.asarray(ins["Ids"][0])
+    kv = _SPARSE_TABLES[attrs["table_name"]]
+    rows = kv.pull(ids.reshape(-1))
+    return {"Out": [rows.reshape(ids.shape + (rows.shape[-1],))]}
+
+
+@register_op("lookup_sparse_table_write", inputs=("Ids", "Value"),
+             outputs=(), no_grad=True, host=True)
+def _lookup_sparse_table_write(ctx, ins, attrs):
+    _SPARSE_TABLES[attrs["table_name"]].write(
+        np.asarray(ins["Ids"][0]), np.asarray(ins["Value"][0]))
+    return {}

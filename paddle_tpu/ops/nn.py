@@ -779,3 +779,66 @@ def _sample_logits(ctx, ins, attrs):
     sampled_labels = jnp.tile(jnp.arange(nt, dtype=jnp.int64), (n, 1))
     return {"Samples": [samples], "Probabilities": [probs],
             "SampledLogits": [sampled], "SampledLabels": [sampled_labels]}
+
+
+@register_op("hsigmoid", inputs=("X", "W", "Label", "Bias", "PathTable",
+                                 "PathCode"),
+             outputs=("Out", "PreOut"),
+             non_diff_inputs=("Label", "PathTable", "PathCode"))
+def _hsigmoid(ctx, ins, attrs):
+    """Hierarchical sigmoid loss (operators/hierarchical_sigmoid_op.cc,
+    math/matrix_bit_code.h SimpleCode): with the default complete
+    binary tree over num_classes, label l's path node at depth d is
+    ((l + C) >> (d+1)) - 1 and its code bit ((l + C) >> d) & 1; the
+    loss sums softplus(preout) - code*preout over valid depths.
+    Custom trees pass PathTable/PathCode (id -1 = stop)."""
+    x = ins["X"][0]                       # [N, D]
+    w = ins["W"][0]                       # [C-1, D]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    bias = ins["Bias"][0].reshape(-1) if ins.get("Bias") else None
+    c = int(attrs.get("num_classes", w.shape[0] + 1))
+    if ins.get("PathTable"):
+        nodes = ins["PathTable"][0].astype(jnp.int32)   # [N, L]
+        codes = ins["PathCode"][0].astype(jnp.int32)
+        valid = nodes >= 0
+        nodes = jnp.maximum(nodes, 0)
+    else:
+        depth = max(1, int(np.ceil(np.log2(max(c, 2)))))
+        full = label + c                                 # [N]
+        ds = jnp.arange(depth, dtype=jnp.int32)
+        nodes = (full[:, None] >> (ds + 1)[None, :]) - 1  # [N, L]
+        codes = (full[:, None] >> ds[None, :]) & 1
+        valid = nodes >= 0
+        # visit path root-to-leaf order irrelevant for the sum
+        nodes = jnp.maximum(nodes, 0)
+    pre = jnp.einsum("nd,nld->nl", x, w[nodes])          # [N, L]
+    if bias is not None:
+        pre = pre + bias[nodes]
+    # softplus(pre) - code*pre, masked to the real path
+    loss = jnp.where(valid,
+                     jnp.logaddexp(0.0, pre) - codes * pre, 0.0)
+    return {"Out": [loss.sum(axis=1, keepdims=True)],
+            "PreOut": [pre]}
+
+
+@register_op("inplace_abn",
+             inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+             outputs=("Y", "MeanOut", "VarianceOut", "SavedMean",
+                      "SavedVariance"))
+def _inplace_abn(ctx, ins, attrs):
+    """In-place activated batch norm (operators/inplace_abn_op.cc):
+    batch_norm followed by the fused activation — in-placeness is an
+    HBM trick XLA owns; semantics are bn+act."""
+    outs = _batch_norm(ctx, ins, attrs)
+    act = attrs.get("activation", "identity")
+    y = outs["Y"][0]
+    if act in ("leaky_relu", "leakyrelu"):
+        alpha = attrs.get("alpha", 0.01)
+        y = jnp.where(y >= 0, y, alpha * y)
+    elif act == "elu":
+        alpha = attrs.get("alpha", 1.0)
+        y = jnp.where(y >= 0, y, alpha * (jnp.exp(y) - 1.0))
+    elif act != "identity":
+        y = getattr(jax.nn, act)(y)
+    outs["Y"] = [y]
+    return outs

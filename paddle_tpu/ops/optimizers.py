@@ -347,3 +347,38 @@ def _average_accumulates(ctx, ins, attrs):
     return {"SumAccum1Out": [s1o], "SumAccum2Out": [s2o],
             "SumAccum3Out": [s3], "NumAccumOut": [num_out],
             "OldNumAccumOut": [old_num], "NumUpdatesOut": [updates_out]}
+
+
+@register_op("dgc_momentum",
+             inputs=("Param", "Grad", "Velocity", "LearningRate",
+                     "CurrentStep"),
+             outputs=("ParamOut", "VelocityOut"),
+             no_grad=True,
+             inplace_map={"ParamOut": "Param", "VelocityOut": "Velocity"})
+def _dgc_momentum(ctx, ins, attrs):
+    """DGC momentum (operators/optimizers/dgc_momentum_op.h): before
+    rampup_step behaves as plain momentum; after it the caller has
+    already top-k sparsified the grad (fleet.meta_optimizers DGC), and
+    momentum correction applies on the sparse residual-added grad —
+    the update rule itself is the same momentum kernel."""
+    p = ins["Param"][0]
+    g = ins["Grad"][0]
+    v = ins["Velocity"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    mu = float(attrs.get("mu", 0.9))
+    use_nesterov = bool(attrs.get("use_nesterov", False))
+    rampup = float(attrs.get("rampup_begin_step", -1.0))
+    step = ins["CurrentStep"][0].reshape(()).astype(jnp.float32) \
+        if ins.get("CurrentStep") else jnp.asarray(0.0)
+    v_mom = mu * v + g
+    if use_nesterov:
+        p_mom = p - lr * (g + mu * v_mom)
+    else:
+        p_mom = p - lr * v_mom
+    # dgc_momentum_op.h:63-69: before rampup -> momentum; after it the
+    # DGC pipeline already momentum-corrected the sparsified grad, so
+    # the kernel applies PLAIN SGD (velocity untouched)
+    use_sgd = (rampup >= 0) & (step >= rampup)
+    p_out = jnp.where(use_sgd, p - lr * g, p_mom)
+    v_out = jnp.where(use_sgd, v, v_mom)
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}

@@ -311,3 +311,130 @@ def _spectral_norm(ctx, ins, attrs):
     v = jax.lax.stop_gradient(v)
     sigma = u @ wmat @ v
     return one(w / sigma)
+
+
+@register_op("empty", inputs=(), outputs=("Out",), no_grad=True)
+def _empty(ctx, ins, attrs):
+    """empty_op.cc: uninitialized tensor of given shape/dtype — on a
+    functional runtime 'uninitialized' is zeros."""
+    from ..core import dtypes as _dt
+    shape = [int(s) for s in attrs.get("shape", [1])]
+    return {"Out": [jnp.zeros(shape,
+                              _dt.to_jax_dtype(attrs.get("dtype",
+                                                         "float32")))]}
+
+
+@register_op("max_pool3d_with_index", inputs=("X",),
+             outputs=("Out", "Mask"))
+def _max_pool3d_with_index(ctx, ins, attrs):
+    """3d twin of max_pool2d_with_index (operators/pool_with_index_op):
+    argmax index within the flattened D*H*W input volume."""
+    x = ins["X"][0]  # [N, C, D, H, W]
+    ks = [int(k) for k in attrs.get("ksize", [2, 2, 2])]
+    st = [int(s) for s in attrs.get("strides", ks)]
+    pd = [int(p) for p in attrs.get("paddings", [0, 0, 0])]
+    n, c, d, h, w = x.shape
+    od = (d + 2 * pd[0] - ks[0]) // st[0] + 1
+    oh = (h + 2 * pd[1] - ks[1]) // st[1] + 1
+    ow = (w + 2 * pd[2] - ks[2]) // st[2] + 1
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1]),
+                     (pd[2], pd[2])), constant_values=-jnp.inf)
+    # window extraction via gather of kd*kh*kw strided views
+    outs, idxs = [], []
+    flat_idx = (jnp.arange(d)[:, None, None] * (h * w)
+                + jnp.arange(h)[None, :, None] * w
+                + jnp.arange(w)[None, None, :])
+    flat_idx = jnp.pad(flat_idx, ((pd[0], pd[0]), (pd[1], pd[1]),
+                                  (pd[2], pd[2])), constant_values=-1)
+    views, iviews = [], []
+    for kd in range(ks[0]):
+        for kh in range(ks[1]):
+            for kw_ in range(ks[2]):
+                v = xp[:, :, kd:kd + od * st[0]:st[0],
+                       kh:kh + oh * st[1]:st[1],
+                       kw_:kw_ + ow * st[2]:st[2]]
+                iv = flat_idx[kd:kd + od * st[0]:st[0],
+                              kh:kh + oh * st[1]:st[1],
+                              kw_:kw_ + ow * st[2]:st[2]]
+                views.append(v)
+                iviews.append(jnp.broadcast_to(iv, v.shape))
+    stack = jnp.stack(views)          # [K, N, C, od, oh, ow]
+    istack = jnp.stack(iviews)
+    best = jnp.argmax(stack, axis=0)
+    out = jnp.max(stack, axis=0)
+    mask = jnp.take_along_axis(istack, best[None], axis=0)[0]
+    return {"Out": [out], "Mask": [mask.astype(jnp.int32)]}
+
+
+@register_op("correlation", inputs=("Input1", "Input2"),
+             outputs=("Output",))
+def _correlation(ctx, ins, attrs):
+    """Optical-flow correlation layer (operators/correlation_op.cc,
+    FlowNet): for each displacement (di, dj) in the search window,
+    output channel = mean over input channels of x1 · shift(x2)."""
+    x1, x2 = ins["Input1"][0], ins["Input2"][0]  # [N, C, H, W]
+    pad = int(attrs.get("pad_size", 4))
+    max_disp = int(attrs.get("max_displacement", 4))
+    stride2 = int(attrs.get("stride2", 1))
+    n, c, h, w = x1.shape
+    x2p = jnp.pad(x2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    disps = range(-max_disp, max_disp + 1, stride2)
+    chans = []
+    for di in disps:
+        for dj in disps:
+            sh = x2p[:, :, pad + di:pad + di + h, pad + dj:pad + dj + w]
+            chans.append((x1 * sh).mean(axis=1))
+    return {"Output": [jnp.stack(chans, axis=1)]}
+
+
+@register_op("bilateral_slice", inputs=("X", "Grid", "Guide"),
+             outputs=("Out",))
+def _bilateral_slice(ctx, ins, attrs):
+    """HDRnet bilateral slicing (operators/bilateral_slice_op.cc):
+    trilinear sample of the bilateral grid at (x, y, guide(x,y)) and
+    optional affine application to the input channels."""
+    x = ins["X"][0]          # [N, Cin, H, W]
+    grid = ins["Grid"][0]    # [N, Cg, Dg, Hg, Wg]
+    guide = ins["Guide"][0]  # [N, H, W]
+    has_offset = bool(attrs.get("has_offset", False))
+    n, cin, h, w = x.shape
+    _, cg, dg, hg, wg = grid.shape
+    gy = (jnp.arange(h) + 0.5) * hg / h - 0.5
+    gx = (jnp.arange(w) + 0.5) * wg / w - 0.5
+    gz = guide * dg - 0.5    # [N, H, W]
+
+    def tri(gridn, zz):
+        # gather 8 corners with clamped trilinear weights; zz is
+        # per-pixel [H, W], y varies per row, x per column — advanced
+        # indexing broadcasts them to one [Cg, H, W] gather per corner
+        y0 = jnp.clip(jnp.floor(gy), 0, hg - 1).astype(jnp.int32)  # [H]
+        x0 = jnp.clip(jnp.floor(gx), 0, wg - 1).astype(jnp.int32)  # [W]
+        y1 = jnp.clip(y0 + 1, 0, hg - 1)
+        x1 = jnp.clip(x0 + 1, 0, wg - 1)
+        z0 = jnp.clip(jnp.floor(zz), 0, dg - 1).astype(jnp.int32)  # [H,W]
+        z1 = jnp.clip(z0 + 1, 0, dg - 1)
+        wy1 = jnp.clip(gy - y0, 0, 1)[:, None]          # [H, 1]
+        wx1 = jnp.clip(gx - x0, 0, 1)[None, :]          # [1, W]
+        wz1 = jnp.clip(zz - z0, 0, 1)                   # [H, W]
+        out = 0.0
+        for zi, wz in ((z0, 1 - wz1), (z1, wz1)):
+            for yi, wy in ((y0, 1 - wy1), (y1, wy1)):
+                for xi, wx in ((x0, 1 - wx1), (x1, wx1)):
+                    v = gridn[:, zi, yi[:, None], xi[None, :]]
+                    out = out + v * (wz * wy * wx)[None]
+        return out  # [Cg, H, W]
+
+    outs = []
+    for b in range(n):
+        coeff = tri(grid[b], gz[b])
+        if has_offset:
+            # coeff rows: Cout x (Cin + 1) affine
+            cout = cg // (cin + 1)
+            m = coeff.reshape(cout, cin + 1, h, w)
+            y = (m[:, :cin] * x[b][None]).sum(1) + m[:, cin]
+        else:
+            cout = cg // cin
+            m = coeff.reshape(cout, cin, h, w)
+            y = (m * x[b][None]).sum(1)
+        outs.append(y)
+    return {"Out": [jnp.stack(outs)]}

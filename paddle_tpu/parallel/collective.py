@@ -267,3 +267,43 @@ def _c_gen_nccl_id(ctx, ins, attrs):
 @register_op("c_comm_init", inputs=(), outputs=(), no_grad=True)
 def _c_comm_init(ctx, ins, attrs):
     return {}
+
+
+def _c_reduce(kind):
+    def lower(ctx, ins, attrs):
+        """c_reduce_{sum,max,min,prod} (c_reduce_op.h): reduce to the
+        root rank. Under GSPMD the all-reduce result IS the per-root
+        value (every replica holds it); root selection is a rank-side
+        concern the single controller doesn't have — semantics match
+        the reference's root output."""
+        x = ins["X"][0]
+        axis = ring_axis(attrs.get("ring_id", 0))
+        if _in_shard_map(axis):
+            red = {"sum": jax.lax.psum, "max": jax.lax.pmax,
+                   "min": jax.lax.pmin}.get(kind)
+            if red is None:  # prod: log-space psum is lossy; use
+                return {"Out": [jax.lax.all_gather(x, axis).prod(0)]}
+            return {"Out": [red(x, axis)]}
+        return {"Out": [x]}
+    return lower
+
+
+for _k in ("sum", "max", "min", "prod"):
+    register_op("c_reduce_%s" % _k, inputs=("X",), no_grad=True)(
+        _c_reduce(_k))
+
+
+@register_op("c_scatter", inputs=("X",), no_grad=True)
+def _c_scatter(ctx, ins, attrs):
+    """c_scatter_op.cc: root's tensor splits across the ring; rank i
+    takes slice i. Inside shard_map: slice by axis_index."""
+    x = ins["X"][0]
+    axis = ring_axis(attrs.get("ring_id", 0))
+    nranks = int(attrs.get("nranks", 1))
+    if _in_shard_map(axis):
+        i = jax.lax.axis_index(axis)
+        per = x.shape[0] // jax.lax.axis_size(axis)
+        return {"Out": [jax.lax.dynamic_slice_in_dim(x, i * per, per, 0)]}
+    # single-controller: emit the full split stack; GSPMD shards it
+    return {"Out": [x.reshape((nranks, x.shape[0] // nranks)
+                              + x.shape[1:])]}

@@ -57,7 +57,9 @@ class DataParallel:
         t = x if isinstance(x, Tensor) else None
         v = t.value if t is not None else x
         if not hasattr(v, "ndim"):
-            v = np.asarray(v)
+            # only array-likes shard; containers/None/scalars pass
+            # through untouched (a list of states must STAY a list)
+            return x
         n = mesh.shape[DP_AXIS]
         if v.ndim < 1 or v.shape[0] % n != 0:
             return x

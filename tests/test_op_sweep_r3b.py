@@ -1,0 +1,259 @@
+"""Round-3 second op batch: registry long-tail (hsigmoid, pool3d-index,
+correlation, bilateral_slice, collectives-to-root, PS helper ops,
+detection labels, dgc_momentum)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.ops  # noqa: F401
+import paddle_tpu.parallel.collective  # noqa: F401  (registers c_*)
+from paddle_tpu.core.registry import REGISTRY, LowerCtx
+
+from test_op_sweep_r3 import run_op  # reuse the harness
+
+
+def test_hsigmoid_matches_loop_oracle():
+    r = np.random.RandomState(0)
+    n, d, c = 4, 6, 8
+    x = r.randn(n, d).astype(np.float32)
+    w = r.randn(c - 1, d).astype(np.float32)
+    b = r.randn(c - 1).astype(np.float32)
+    label = r.randint(0, c, (n, 1)).astype(np.int64)
+    o = run_op("hsigmoid", {"X": x, "W": w, "Label": label, "Bias": b},
+               {"num_classes": c})
+    out = np.asarray(o["Out"][0]).reshape(-1)
+    # oracle: complete-binary-tree SimpleCode walk
+    import math
+    depth = int(math.ceil(math.log2(c)))
+    for i in range(n):
+        full = int(label[i, 0]) + c
+        loss = 0.0
+        for dd in range(depth):
+            node = (full >> (dd + 1)) - 1
+            if node < 0:
+                continue
+            code = (full >> dd) & 1
+            pre = float(x[i] @ w[node] + b[node])
+            loss += math.log1p(math.exp(-abs(pre))) + max(pre, 0) \
+                - code * pre
+        np.testing.assert_allclose(out[i], loss, rtol=1e-5, atol=1e-5)
+
+    def f(xv):
+        return run_op("hsigmoid", {"X": xv, "W": w, "Label": label,
+                                   "Bias": b},
+                      {"num_classes": c})["Out"][0].sum()
+    g = jax.grad(f)(jnp.asarray(x))
+    assert np.isfinite(np.asarray(g)).all() and np.abs(g).sum() > 0
+
+
+def test_empty_and_inplace_abn():
+    o = run_op("empty", {}, {"shape": [2, 3], "dtype": "float32"})
+    assert np.asarray(o["Out"][0]).shape == (2, 3)
+    r = np.random.RandomState(1)
+    x = r.randn(2, 3, 4, 4).astype(np.float32)
+    args = {"X": x, "Scale": np.ones(3, np.float32),
+            "Bias": np.zeros(3, np.float32),
+            "Mean": np.zeros(3, np.float32),
+            "Variance": np.ones(3, np.float32)}
+    bn = run_op("batch_norm", dict(args))["Y"][0]
+    abn = run_op("inplace_abn", dict(args),
+                 {"activation": "leaky_relu", "alpha": 0.1})["Y"][0]
+    ref = np.where(np.asarray(bn) >= 0, np.asarray(bn),
+                   0.1 * np.asarray(bn))
+    np.testing.assert_allclose(np.asarray(abn), ref, atol=1e-6)
+
+
+def test_max_pool3d_with_index():
+    r = np.random.RandomState(2)
+    x = r.randn(1, 2, 4, 4, 4).astype(np.float32)
+    o = run_op("max_pool3d_with_index", {"X": x},
+               {"ksize": [2, 2, 2], "strides": [2, 2, 2]})
+    out = np.asarray(o["Out"][0])
+    mask = np.asarray(o["Mask"][0])
+    assert out.shape == (1, 2, 2, 2, 2)
+    for ci in range(2):
+        blk = x[0, ci, :2, :2, :2]
+        assert out[0, ci, 0, 0, 0] == blk.max()
+        d, h, w = np.unravel_index(blk.argmax(), blk.shape)
+        assert mask[0, ci, 0, 0, 0] == d * 16 + h * 4 + w
+
+
+def test_correlation_zero_displacement():
+    r = np.random.RandomState(3)
+    x1 = r.randn(1, 3, 5, 5).astype(np.float32)
+    x2 = r.randn(1, 3, 5, 5).astype(np.float32)
+    o = np.asarray(run_op("correlation",
+                          {"Input1": x1, "Input2": x2},
+                          {"pad_size": 1, "max_displacement": 1,
+                           "stride2": 1})["Output"][0])
+    assert o.shape == (1, 9, 5, 5)
+    # center channel (d=(0,0)) == mean over C of x1*x2
+    np.testing.assert_allclose(o[0, 4], (x1[0] * x2[0]).mean(0),
+                               rtol=1e-5)
+
+
+def test_bilateral_slice_constant_grid():
+    # grid holding constant multiplier m per output channel: out = m*x
+    n, cin, h, w = 1, 2, 4, 4
+    cout = 2
+    grid = np.zeros((n, cout * cin, 4, 3, 3), np.float32)
+    grid[:, 0] = 2.0  # out0 = 2*x0
+    grid[:, 3] = 3.0  # out1 = 3*x1
+    x = np.random.RandomState(4).randn(n, cin, h, w).astype(np.float32)
+    guide = np.full((n, h, w), 0.5, np.float32)
+    o = np.asarray(run_op("bilateral_slice",
+                          {"X": x, "Grid": grid, "Guide": guide},
+                          {"has_offset": False})["Out"][0])
+    np.testing.assert_allclose(o[0, 0], 2 * x[0, 0], atol=1e-5)
+    np.testing.assert_allclose(o[0, 1], 3 * x[0, 1], atol=1e-5)
+
+
+def test_c_reduce_and_scatter_shardmap():
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.array(devs), ("dp",))
+    import paddle_tpu.parallel as dist
+    dist.init_parallel_env({"dp": 4})
+    x = np.arange(8, dtype=np.float32)
+
+    def body(xs):
+        o = run_op("c_reduce_sum", {"X": xs}, {"ring_id": 0})
+        return o["Out"][0]
+
+    out = shard_map(body, mesh=mesh, in_specs=P("dp"),
+                    out_specs=P("dp"))(jnp.asarray(x))
+    # every shard holds the global sum of its position across shards
+    np.testing.assert_allclose(np.asarray(out)[:2],
+                               [0 + 2 + 4 + 6, 1 + 3 + 5 + 7])
+
+
+def test_split_merge_ids_roundtrip():
+    ids = np.array([5, 2, 9, 4, 2], np.int64)
+    o = run_op("split_ids", {"Ids": ids}, {"n_parts": 3})
+    parts = [np.asarray(p) for p in o["Out"]]
+    for i, p in enumerate(parts):
+        valid = p[p >= 0]
+        assert (valid % 3 == i).all()
+    rows = [np.stack([np.full(4, float(v), np.float32) if v >= 0
+                      else np.zeros(4, np.float32) for v in p])
+            for p in parts]
+    m = run_op("merge_ids", {"Ids": ids, "Rows": list(parts),
+                             "X": rows}, {})
+    out = np.asarray(m["Out"][0])
+    np.testing.assert_allclose(out[:, 0], ids.astype(np.float32))
+
+
+def test_split_selected_rows():
+    from paddle_tpu.core.selected_rows import SelectedRows
+    sr = SelectedRows(np.array([1, 7, 4]),
+                      np.arange(12, dtype=np.float32).reshape(3, 4), 10)
+    opdef = REGISTRY.get("split_selected_rows")
+    outs = opdef.lower(LowerCtx(), {"X": [sr]},
+                       {"height_sections": [5, 5]})["Out"]
+    a, b = outs
+    assert sorted(np.asarray(a.rows).tolist()) == [1, 4]
+    assert np.asarray(b.rows).tolist() == [2]  # 7 - 5
+
+
+def test_lookup_sparse_table_ops():
+    run_op("lookup_sparse_table_init", {},
+           {"name": "t1", "dim": 4, "initializer": "fill",
+            "init_scale": 0.0, "optimizer": "sgd", "lr": 0.1, "seed": 0})
+    ids = np.array([3, 8], np.int64)
+    vals = np.ones((2, 4), np.float32) * 7
+    run_op("lookup_sparse_table_write", {"Ids": ids, "Value": vals},
+           {"table_name": "t1"})
+    out = np.asarray(run_op("lookup_sparse_table_read", {"Ids": ids},
+                            {"table_name": "t1"})["Out"][0])
+    np.testing.assert_allclose(out, vals)
+
+
+def test_checkpoint_notify_over_transport(tmp_path):
+    from paddle_tpu.distributed import ParamServer, SparseTableConfig
+    from paddle_tpu.distributed.rpc import PsClient, PsServer
+    srv = PsServer(ParamServer(), "127.0.0.1:0", n_trainers=1).start()
+    cli = PsClient(srv.endpoint)
+    try:
+        cli.create_sparse_table(SparseTableConfig(
+            name="ck", dim=2, initializer="fill", fill_value=1.5))
+        cli.pull_sparse("ck", np.array([0, 1], np.int64))
+        d = str(tmp_path / "snap")
+        import os
+        os.makedirs(d, exist_ok=True)
+        run_op("checkpoint_notify", {},
+               {"endpoints": [srv.endpoint], "dirname": d})
+        assert (tmp_path / "snap" / "ck.kv").exists()
+    finally:
+        cli.stop_server()
+        cli.close()
+        from paddle_tpu.ops.distributed_ps import reset_ps_clients
+        reset_ps_clients()
+
+
+def test_box_decoder_and_assign():
+    prior = np.array([[0, 0, 10, 10]], np.float32)
+    deltas = np.zeros((1, 8), np.float32)  # 2 classes, zero deltas
+    scores = np.array([[0.2, 0.8]], np.float32)
+    o = run_op("box_decoder_and_assign",
+               {"PriorBox": prior, "TargetBox": deltas,
+                "BoxScore": scores}, {})
+    dec = np.asarray(o["DecodeBox"][0])
+    assign = np.asarray(o["OutputAssignBox"][0])
+    # zero deltas decode back to the prior (xyxy with -1 width conv)
+    np.testing.assert_allclose(assign[0], dec[0, 4:])
+    np.testing.assert_allclose(dec[0, :4], [0, 0, 10, 10], atol=1e-4)
+
+
+def test_generate_proposal_labels():
+    rois = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                     [0, 0, 9, 9], [40, 40, 50, 50]], np.float32)
+    gt = np.array([[[0, 0, 10, 10]]], np.float32)
+    cls = np.array([[3]], np.int32)
+    o = run_op("generate_proposal_labels",
+               {"RpnRois": rois, "GtClasses": cls, "GtBoxes": gt,
+                "RpnRoisNum": np.array([4], np.int32),
+                "GtNum": np.array([1], np.int32)},
+               {"batch_size_per_im": 4, "fg_fraction": 0.5,
+                "fg_thresh": 0.5, "bg_thresh_hi": 0.5,
+                "bg_thresh_lo": 0.0},
+               rng=jax.random.PRNGKey(3))
+    labels = np.asarray(o["LabelsInt32"][0])
+    # the exact-overlap roi must be fg with class 3; far rois bg (0)
+    assert (labels == 3).sum() >= 1
+    assert (labels == 0).sum() >= 1
+    wi = np.asarray(o["BboxInsideWeights"][0])
+    assert (wi[labels == 3] == 1).all()
+    assert (wi[labels == 0] == 0).all()
+
+
+def test_roi_perspective_transform_identity():
+    r = np.random.RandomState(6)
+    x = r.randn(1, 1, 6, 6).astype(np.float32)
+    ph = pw = 4
+    # axis-aligned quad covering [0,3]x[0,3] -> identity sampling
+    rois = np.array([[0, 0, 3, 0, 3, 3, 0, 3]], np.float32)
+    o = run_op("roi_perspective_transform", {"X": x, "ROIs": rois},
+               {"transformed_height": ph, "transformed_width": pw,
+                "spatial_scale": 1.0})
+    out = np.asarray(o["Out"][0])
+    np.testing.assert_allclose(out[0, 0], x[0, 0, :4, :4], atol=1e-4)
+
+
+def test_dgc_momentum_matches_momentum_rule():
+    r = np.random.RandomState(7)
+    p = r.randn(5).astype(np.float32)
+    g = r.randn(5).astype(np.float32)
+    v = r.randn(5).astype(np.float32)
+    o = run_op("dgc_momentum",
+               {"Param": p, "Grad": g, "Velocity": v,
+                "LearningRate": np.asarray([0.1], np.float32),
+                "CurrentStep": np.asarray([0], np.float32)},
+               {"mu": 0.9})
+    v_ref = 0.9 * v + g
+    np.testing.assert_allclose(np.asarray(o["VelocityOut"][0]), v_ref,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(o["ParamOut"][0]),
+                               p - 0.1 * v_ref, rtol=1e-6)
