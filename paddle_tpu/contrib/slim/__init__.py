@@ -1,0 +1,17 @@
+"""contrib.slim — model compression (quantization) toolkit.
+
+Analog of /root/reference/python/paddle/fluid/contrib/slim/ (quantization
+passes + post-training quantization + imperative QAT).
+"""
+from .quantization import (AddQuantDequantPass, ConvertToInt8Pass,
+                           OutScaleForInferencePass, OutScaleForTrainingPass,
+                           PostTrainingQuantization, QuantizationFreezePass,
+                           QuantizationTransformPass)
+from .imperative import ImperativeQuantAware
+
+__all__ = [
+    "QuantizationTransformPass", "QuantizationFreezePass",
+    "AddQuantDequantPass", "ConvertToInt8Pass", "OutScaleForTrainingPass",
+    "OutScaleForInferencePass", "PostTrainingQuantization",
+    "ImperativeQuantAware",
+]

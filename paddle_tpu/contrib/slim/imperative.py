@@ -1,0 +1,136 @@
+"""Imperative (dygraph) quantization-aware training.
+
+Analog of /root/reference/python/paddle/fluid/contrib/slim/quantization/
+imperative/qat.py (ImperativeQuantAware.quantize walks the Layer tree and
+swaps quantizable sublayers for Quantized* wrappers that fake-quantize
+weight + input on every forward).
+
+The wrappers run the fake-qdq ops through the eager tape (dygraph
+run_op), so the straight-through gradients reach the float weights and
+the moving-average scale state advances per step, exactly like static
+QAT. Scale state lives on the wrapper as plain Tensors (buffers)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ...nn import functional as F
+from ...nn.layer import Layer
+from ...nn.layers_lib import Conv2D, Linear
+
+
+class FakeQuantMovingAverage(Layer):
+    """Activation observer+quantizer (moving_average_abs_max)."""
+
+    def __init__(self, bits: int = 8, moving_rate: float = 0.9):
+        super().__init__()
+        from ...dygraph.tape import Tensor
+        self._bits = bits
+        self._rate = moving_rate
+        self.register_buffer("scale",
+                             Tensor(np.asarray([0.001], np.float32),
+                                    stop_gradient=True))
+        self.register_buffer("accum",
+                             Tensor(np.asarray([1.0], np.float32),
+                                    stop_gradient=True))
+        self.register_buffer("state",
+                             Tensor(np.asarray([1.0], np.float32),
+                                    stop_gradient=True))
+
+    def forward(self, x):
+        from ...dygraph.tape import run_op
+        outs = run_op(
+            "fake_quantize_dequantize_moving_average_abs_max",
+            {"X": [x], "InScale": [self._buffers["scale"]],
+             "InAccum": [self._buffers["accum"]],
+             "InState": [self._buffers["state"]]},
+            {"bit_length": self._bits, "moving_rate": self._rate,
+             "is_test": not self.training})
+        self.register_buffer("scale", outs["OutScale"][0].detach())
+        self.register_buffer("accum", outs["OutAccum"][0].detach())
+        self.register_buffer("state", outs["OutState"][0].detach())
+        return outs["Out"][0]
+
+
+class FakeQuantChannelWiseAbsMax(Layer):
+    """Weight quantizer (per output channel, recomputed each forward —
+    weights move during QAT)."""
+
+    def __init__(self, bits: int = 8, quant_axis: int = 0):
+        super().__init__()
+        self._bits = bits
+        self._axis = quant_axis
+
+    def forward(self, w):
+        from ...dygraph.tape import run_op
+        outs = run_op(
+            "fake_channel_wise_quantize_dequantize_abs_max", {"X": [w]},
+            {"bit_length": self._bits, "quant_axis": self._axis})
+        return outs["Out"][0]
+
+
+class QuantizedLinear(Layer):
+    def __init__(self, layer: Linear, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = getattr(layer, "bias", None)
+        # mul weight [in, out] quantizes axis 1 (quantization_pass.py:74)
+        self._w_fake = FakeQuantChannelWiseAbsMax(weight_bits, quant_axis=1)
+        self._in_fake = FakeQuantMovingAverage(activation_bits, moving_rate)
+
+    def forward(self, x):
+        return F.linear(self._in_fake(x), self._w_fake(self.weight),
+                        self.bias)
+
+
+class QuantizedConv2D(Layer):
+    def __init__(self, layer: Conv2D, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9):
+        super().__init__()
+        self._inner = layer
+        self._w_fake = FakeQuantChannelWiseAbsMax(weight_bits, quant_axis=0)
+        self._in_fake = FakeQuantMovingAverage(activation_bits, moving_rate)
+
+    def forward(self, x):
+        inner = self._inner
+        w = self._w_fake(inner.weight)
+        return F.conv2d(self._in_fake(x), w, inner.bias,
+                        stride=inner._stride, padding=inner._padding,
+                        dilation=inner._dilation, groups=inner._groups,
+                        data_format=inner._data_format)
+
+
+class ImperativeQuantAware:
+    """qat.py ImperativeQuantAware: in-place swap of quantizable
+    sublayers.
+
+    >>> quanter = ImperativeQuantAware()
+    >>> quanter.quantize(model)   # train as usual; STE grads flow
+    """
+
+    _SWAP = {Linear: QuantizedLinear, Conv2D: QuantizedConv2D}
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 moving_rate: float = 0.9,
+                 quantizable_layer_type: Optional[Sequence[str]] = None):
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+        names = set(quantizable_layer_type or ["Linear", "Conv2D"])
+        self._types = {cls: q for cls, q in self._SWAP.items()
+                       if cls.__name__ in names}
+
+    def quantize(self, model: Layer) -> Layer:
+        self._quantize_children(model)
+        return model
+
+    def _quantize_children(self, layer: Layer):
+        for name, sub in list(layer._sub_layers.items()):
+            cls = type(sub)
+            if cls in self._types:
+                setattr(layer, name, self._types[cls](
+                    sub, self._wbits, self._abits, self._rate))
+            else:
+                self._quantize_children(sub)

@@ -1,0 +1,599 @@
+"""Static-graph quantization passes + post-training quantization.
+
+Analog of /root/reference/python/paddle/fluid/contrib/slim/quantization/
+(quantization_pass.py: QuantizationTransformPass:211 inserts fake
+quant/dequant around quantizable ops' inputs; QuantizationFreezePass:1037
+folds trained scales into an int8-simulation inference graph;
+AddQuantDequantPass:1646 covers the second-tier op set;
+OutScaleForTrainingPass:1475 / OutScaleForInferencePass:1589 record output
+thresholds; post_training_quantization.py calibrates scales offline).
+
+The reference's passes rewrite an IrGraph with scope+place side effects;
+here they rewrite the Program's OpDesc list directly (the JSON IR is the
+graph) and initialize state through the startup program or the scope —
+the same two-phase contract. Quantization simulation stays in float so
+XLA fuses the round/clip chains into the surrounding matmul/conv; the
+frozen graph computes on integer-valued tensors, which is also the
+int8-serving handoff point.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...core.program import OpDesc, Program
+from ...core.scope import global_scope
+
+# ops whose weight+activation inputs get full QAT treatment
+# (quantization_pass.py _quantizable_op_type)
+TRANSFORM_PASS_OP_TYPES = ["conv2d", "depthwise_conv2d", "mul", "matmul",
+                           "matmul_v2", "conv2d_transpose"]
+# second-tier ops: activation-only quant-dequant (AddQuantDequantPass
+# _supported_quantizable_op_type)
+QUANT_DEQUANT_PASS_OP_TYPES = [
+    "pool2d", "elementwise_add", "concat", "softmax", "argmax", "transpose",
+    "equal", "gather", "greater_equal", "greater_than", "less_equal",
+    "less_than", "mean", "not_equal", "reshape", "reshape2",
+    "bilinear_interp", "nearest_interp", "trilinear_interp", "slice",
+    "squeeze", "elementwise_sub", "relu", "relu6", "leaky_relu", "tanh",
+    "swish",
+]
+# ops whose outputs get a moving-average observer for out_threshold
+OUT_SCALE_OP_TYPES = TRANSFORM_PASS_OP_TYPES + QUANT_DEQUANT_PASS_OP_TYPES \
+    + ["batch_norm", "layer_norm", "sigmoid"]
+
+_ACT_QUANT_TYPES = ("abs_max", "moving_average_abs_max", "range_abs_max")
+_WEIGHT_QUANT_TYPES = ("abs_max", "channel_wise_abs_max")
+
+
+def _weight_quant_axis(op_type: str) -> int:
+    """Output-channel axis of the weight (quantization_pass.py:74
+    _channel_wise_quant_axis1_ops): OIHW convs quantize axis 0;
+    mul/matmul [in,out] and conv2d_transpose IOHW quantize axis 1."""
+    return 1 if op_type in ("mul", "matmul", "matmul_v2",
+                            "conv2d_transpose") else 0
+
+
+def _is_param(block, name: str) -> bool:
+    v = block.vars.get(name)
+    return v is not None and v.persistable
+
+
+class _PassBase:
+    """Shared var/state plumbing for the quant passes."""
+
+    def __init__(self, scope=None, startup_program: Optional[Program] = None):
+        self._scope = scope
+        self._startup = startup_program
+
+    def _state_var(self, block, name: str, value: float,
+                   shape=(1,)) -> str:
+        """Create a persistable state var initialized to `value` via the
+        startup program (reference _init_var appends fill_constant to
+        startup) and/or directly in the scope."""
+        if name not in block.vars:
+            block.create_var(name, shape=list(shape), dtype="float32",
+                             persistable=True, stop_gradient=True)
+        if self._startup is not None:
+            sblock = self._startup.global_block
+            if name not in sblock.vars:
+                sblock.create_var(name, shape=list(shape), dtype="float32",
+                                  persistable=True)
+                sblock.append_op(
+                    "fill_constant", inputs={}, outputs={"Out": [name]},
+                    attrs={"shape": list(shape), "value": float(value),
+                           "dtype": "float32"})
+        scope = self._scope if self._scope is not None else global_scope()
+        if scope.find_var(name) is None:
+            scope.set(name, np.full(shape, value, np.float32))
+        return name
+
+
+class QuantizationTransformPass(_PassBase):
+    """Insert fake quant-dequant on the inputs of quantizable ops
+    (quantization_pass.py:211). Apply BEFORE append_backward so the
+    straight-through gradients train the float weights."""
+
+    def __init__(self, scope=None, startup_program=None, weight_bits: int = 8,
+                 activation_bits: int = 8,
+                 activation_quantize_type: str = "moving_average_abs_max",
+                 weight_quantize_type: str = "channel_wise_abs_max",
+                 moving_rate: float = 0.9, window_size: int = 10000,
+                 quantizable_op_type: Optional[Sequence[str]] = None,
+                 skip_pattern: str = "skip_quant"):
+        super().__init__(scope, startup_program)
+        if activation_quantize_type not in _ACT_QUANT_TYPES:
+            raise ValueError("unknown activation_quantize_type %r (want %s)"
+                             % (activation_quantize_type, _ACT_QUANT_TYPES))
+        if weight_quantize_type not in _WEIGHT_QUANT_TYPES:
+            raise ValueError("unknown weight_quantize_type %r (want %s)"
+                             % (weight_quantize_type, _WEIGHT_QUANT_TYPES))
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._act_type = activation_quantize_type
+        self._w_type = weight_quantize_type
+        self._moving_rate = moving_rate
+        self._window = window_size
+        self._op_types = list(quantizable_op_type or TRANSFORM_PASS_OP_TYPES)
+        self._skip = skip_pattern
+
+    def apply(self, program: Program) -> Program:
+        block = program.global_block
+        quantized: Dict[str, str] = {}   # var -> qdq output name
+        new_ops: List[OpDesc] = []
+        for op in list(block.ops):
+            if op.type in self._op_types and \
+                    not op.attr(self._skip, False):
+                for slot, names in op.inputs.items():
+                    op.inputs[slot] = [
+                        self._quant_input(block, new_ops, op, n, quantized)
+                        for n in names]
+                op.attrs["quantization_type"] = "qat_with_weight"
+            new_ops.append(op)
+        block.ops = new_ops
+        return program
+
+    def _quant_input(self, block, new_ops, op, name, quantized) -> str:
+        if name in quantized:
+            return quantized[name]
+        v = block.vars.get(name)
+        if v is None or v.dtype not in ("float32", "float64"):
+            return name
+        if _is_param(block, name):
+            out = self._insert_weight_qdq(block, new_ops, op, name)
+        else:
+            out = self._insert_act_qdq(block, new_ops, name)
+        quantized[name] = out
+        return out
+
+    def _insert_weight_qdq(self, block, new_ops, op, name) -> str:
+        v = block.vars[name]
+        out = name + ".quantized.dequantized"
+        scale = name + ".quant_scale"
+        if self._w_type == "channel_wise_abs_max":
+            axis = _weight_quant_axis(op.type)
+            n_ch = v.shape[axis] if v.shape else 1
+            block.create_var(out, shape=v.shape, dtype=v.dtype,
+                             stop_gradient=False)
+            block.create_var(scale, shape=[n_ch], dtype="float32",
+                             stop_gradient=True)
+            new_ops.append(OpDesc(
+                "fake_channel_wise_quantize_dequantize_abs_max",
+                {"X": [name]}, {"Out": [out], "OutScale": [scale]},
+                {"bit_length": self._wbits, "quant_axis": axis}))
+        else:
+            block.create_var(out, shape=v.shape, dtype=v.dtype,
+                             stop_gradient=False)
+            block.create_var(scale, shape=[1], dtype="float32",
+                             stop_gradient=True)
+            new_ops.append(OpDesc(
+                "fake_quantize_dequantize_abs_max",
+                {"X": [name]}, {"Out": [out], "OutScale": [scale]},
+                {"bit_length": self._wbits}))
+        return out
+
+    def _insert_act_qdq(self, block, new_ops, name) -> str:
+        v = block.vars[name]
+        out = name + ".quantized.dequantized"
+        block.create_var(out, shape=v.shape, dtype=v.dtype,
+                         stop_gradient=False)
+        scale = self._state_var(block, name + ".quant_scale", 0.001)
+        if self._act_type == "abs_max":
+            new_ops.append(OpDesc(
+                "fake_quantize_dequantize_abs_max",
+                {"X": [name]}, {"Out": [out], "OutScale": [scale]},
+                {"bit_length": self._abits}))
+        elif self._act_type == "moving_average_abs_max":
+            accum = self._state_var(block, name + ".quant_accum", 1.0)
+            state = self._state_var(block, name + ".quant_state", 1.0)
+            new_ops.append(OpDesc(
+                "fake_quantize_dequantize_moving_average_abs_max",
+                {"X": [name], "InScale": [scale], "InAccum": [accum],
+                 "InState": [state]},
+                {"Out": [out], "OutScale": [scale], "OutAccum": [accum],
+                 "OutState": [state]},
+                {"bit_length": self._abits, "moving_rate": self._moving_rate,
+                 "is_test": False}))
+        else:  # range_abs_max — fused qdq twin so STE gradients flow
+            scales = self._state_var(block, name + ".quant_scales", 0.0,
+                                     shape=(self._window,))
+            it = self._state_var(block, name + ".quant_iter", 0.0)
+            new_ops.append(OpDesc(
+                "fake_quantize_dequantize_range_abs_max",
+                {"X": [name], "InScale": [scale], "InScales": [scales],
+                 "Iter": [it]},
+                {"Out": [out], "OutScale": [scale], "OutScales": [scales],
+                 "IterOut": [it]},
+                {"bit_length": self._abits, "window_size": self._window,
+                 "is_test": False}))
+        return out
+
+
+class AddQuantDequantPass(_PassBase):
+    """Activation-only quant-dequant on the second-tier op set
+    (quantization_pass.py:1646) — makes their int8 inference lossless to
+    simulate. Always moving-average."""
+
+    def __init__(self, scope=None, startup_program=None,
+                 quant_bits: int = 8, moving_rate: float = 0.9,
+                 quantizable_op_type: Optional[Sequence[str]] = None,
+                 skip_pattern: str = "skip_quant"):
+        super().__init__(scope, startup_program)
+        self._bits = quant_bits
+        self._moving_rate = moving_rate
+        self._op_types = list(quantizable_op_type
+                              or QUANT_DEQUANT_PASS_OP_TYPES)
+        self._skip = skip_pattern
+
+    def apply(self, program: Program) -> Program:
+        tp = QuantizationTransformPass(
+            self._scope, self._startup, activation_bits=self._bits,
+            activation_quantize_type="moving_average_abs_max",
+            moving_rate=self._moving_rate, quantizable_op_type=[])
+        block = program.global_block
+        quantized: Dict[str, str] = {}
+        new_ops: List[OpDesc] = []
+        for op in list(block.ops):
+            if op.type in self._op_types and not op.attr(self._skip, False):
+                for slot, names in op.inputs.items():
+                    new_names = []
+                    for n in names:
+                        v = block.vars.get(n)
+                        if v is None or _is_param(block, n) or \
+                                v.dtype not in ("float32", "float64"):
+                            new_names.append(n)
+                        elif n in quantized:
+                            new_names.append(quantized[n])
+                        else:
+                            out = tp._insert_act_qdq(block, new_ops, n)
+                            quantized[n] = out
+                            new_names.append(out)
+                    op.inputs[slot] = new_names
+                op.attrs["quantization_type"] = "qat_without_weight"
+            new_ops.append(op)
+        block.ops = new_ops
+        return program
+
+
+class QuantizationFreezePass(_PassBase):
+    """Fold trained scales into an inference graph
+    (quantization_pass.py:1037): activation qdq ops become fixed-scale
+    quant-only ops; weights are replaced in the scope by their
+    integer-grid values; each quantized op's output is dequantized by a
+    channel-wise two-level dequant carrying [weight_scales, act_scale]."""
+
+    def __init__(self, scope=None, place=None, weight_bits: int = 8,
+                 activation_bits: int = 8,
+                 weight_quantize_type: str = "channel_wise_abs_max"):
+        super().__init__(scope, None)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._w_type = weight_quantize_type
+
+    def apply(self, program: Program) -> Program:
+        scope = self._scope if self._scope is not None else global_scope()
+        block = program.global_block
+        abins = float((1 << (self._abits - 1)) - 1)
+        wbins = float((1 << (self._wbits - 1)) - 1)
+
+        act_scale_of: Dict[str, str] = {}  # qdq output var -> scale var
+        weight_of: Dict[str, str] = {}     # qdq output var -> raw weight
+        # vars consumed ONLY by weight-quantized ops can freeze to the
+        # integer grid (the consumer's output dequant restores the
+        # scale); anything read by a plain or qat_without_weight op must
+        # stay in the dequantized domain
+        only_weight_consumers: Dict[str, bool] = {}
+        for op in block.ops:
+            if op.type.startswith("fake_"):
+                continue
+            is_w = op.attr("quantization_type", "") == "qat_with_weight"
+            for names in op.inputs.values():
+                for n in names:
+                    only_weight_consumers[n] = \
+                        only_weight_consumers.get(n, True) and is_w
+        new_ops: List[OpDesc] = []
+        for op in list(block.ops):
+            if op.type.startswith("fake_quantize_dequantize") or \
+                    op.type == "fake_channel_wise_quantize_dequantize_" \
+                               "abs_max":
+                src = op.input("X")[0]
+                dst = op.output("Out")[0]
+                if _is_param(block, src):
+                    # quantize the stored weight onto the integer grid
+                    w = np.asarray(scope.find_var(src))
+                    axis = int(op.attr("quant_axis", 0))
+                    if self._w_type == "channel_wise_abs_max":
+                        red = tuple(i for i in range(w.ndim) if i != axis)
+                        s = np.abs(w).max(axis=red)
+                        bshape = [1] * w.ndim
+                        bshape[axis] = w.shape[axis]
+                        sb = s.reshape(bshape)
+                    else:
+                        s = np.abs(w).max().reshape(1)
+                        sb = s
+                    sb = np.where(sb <= 1e-30, 1e-6, sb)
+                    wq = np.round(w / sb * wbins)
+                    scope.set(src, wq.astype(np.float32))
+                    scope.set(src + ".quant_scale", s.astype(np.float32))
+                    # the scale var must be persistable so the executor
+                    # sources it from the scope at run time
+                    sv = block.vars.get(src + ".quant_scale")
+                    if sv is None:
+                        block.create_var(src + ".quant_scale",
+                                         shape=[int(s.size)],
+                                         dtype="float32", persistable=True,
+                                         stop_gradient=True)
+                    else:
+                        sv.persistable = True
+                    weight_of[dst] = src
+                    continue  # drop the op; consumers rewired below
+                # activation: consumers that all re-scale through their
+                # own output dequant get quant-only input; anything else
+                # (AddQuantDequantPass second-tier ops, plain float ops)
+                # keeps a fixed-scale qdq so its input stays dequantized
+                scale_var = op.output("OutScale")[0]
+                q_out = dst
+                if only_weight_consumers.get(dst, False):
+                    new_ops.append(OpDesc(
+                        "fake_quantize_moving_average_abs_max",
+                        {"X": [src], "InScale": [scale_var]},
+                        {"Out": [q_out], "OutScale": [scale_var]},
+                        {"bit_length": self._abits, "is_test": True}))
+                    act_scale_of[q_out] = scale_var
+                else:
+                    new_ops.append(OpDesc(
+                        "fake_quantize_dequantize_moving_average_abs_max",
+                        {"X": [src], "InScale": [scale_var]},
+                        {"Out": [q_out], "OutScale": [scale_var]},
+                        {"bit_length": self._abits, "is_test": True}))
+                continue
+            new_ops.append(op)
+        block.ops = new_ops
+
+        # rewire weight inputs + add dequant after each quantized op;
+        # `rename` routes downstream consumers to dequantized values
+        final_ops: List[OpDesc] = []
+        rename: Dict[str, str] = {}
+        for op in block.ops:
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [rename.get(n, n) for n in names]
+            if op.attr("quantization_type", "") != "qat_with_weight":
+                final_ops.append(op)
+                continue
+            w_scales = act_scale = None
+            w_axis = _weight_quant_axis(op.type)
+            for slot, names in op.inputs.items():
+                rewired = []
+                for n in names:
+                    if n in weight_of:
+                        raw = weight_of[n]
+                        rewired.append(raw)
+                        w_scales = raw + ".quant_scale"
+                    else:
+                        rewired.append(n)
+                        if n in act_scale_of:
+                            act_scale = act_scale_of[n]
+                op.inputs[slot] = rewired
+            final_ops.append(op)
+            if w_scales is None:
+                continue
+            # out = q_out * w_scale/wbins * act_scale/abins — the
+            # two-level channel dequant (fake_dequantize_op.cc
+            # ChannelDequantizeFunctor)
+            out_name = op.output("Out" if "Out" in op.outputs
+                                 else list(op.outputs)[0])[0]
+            deq = out_name + ".dequantized"
+            v = block.vars.get(out_name)
+            block.create_var(deq, shape=v.shape if v else None,
+                             dtype="float32")
+            scales_in = [w_scales]
+            bits = [self._wbits]
+            if act_scale is not None:
+                scales_in.append(act_scale)
+                bits.append(self._abits)
+            # the weight's output-channel axis lands on the conv/matmul
+            # output's channel axis: NCHW convs -> axis 1; mul/matmul
+            # [.., out] -> last axis
+            out_axis = 1 if w_axis == 0 else \
+                (len(v.shape) - 1 if v is not None and v.shape else 1)
+            final_ops.append(OpDesc(
+                "fake_channel_wise_dequantize_max_abs",
+                {"X": [out_name], "Scales": scales_in}, {"Out": [deq]},
+                {"quant_bits": bits, "quant_axis": out_axis}))
+            rename[out_name] = deq
+        block.ops = final_ops
+        return program
+
+
+class ConvertToInt8Pass(_PassBase):
+    """Cast frozen integer-grid weights to int8 storage in the scope
+    (quantization_pass.py:1346) — the serving-export handoff."""
+
+    def __init__(self, scope=None, place=None):
+        super().__init__(scope, None)
+
+    def apply(self, program: Program) -> Program:
+        scope = self._scope if self._scope is not None else global_scope()
+        block = program.global_block
+        for op in block.ops:
+            if op.attr("quantization_type", "") != "qat_with_weight":
+                continue
+            for names in op.inputs.values():
+                for n in names:
+                    if _is_param(block, n) and \
+                            scope.find_var(n + ".quant_scale") is not None:
+                        w = np.asarray(scope.find_var(n))
+                        scope.set(n, np.clip(w, -128, 127).astype(np.int8))
+                        if n in block.vars:
+                            block.vars[n].dtype = "int8"
+        return program
+
+
+class OutScaleForTrainingPass(_PassBase):
+    """Attach a moving_average_abs_max_scale observer to the outputs of
+    listed ops (quantization_pass.py:1475)."""
+
+    def __init__(self, scope=None, startup_program=None,
+                 moving_rate: float = 0.9,
+                 op_types: Optional[Sequence[str]] = None):
+        super().__init__(scope, startup_program)
+        self._moving_rate = moving_rate
+        self._op_types = list(op_types or OUT_SCALE_OP_TYPES)
+
+    def apply(self, program: Program) -> Program:
+        block = program.global_block
+        new_ops: List[OpDesc] = []
+        for op in list(block.ops):
+            new_ops.append(op)
+            if op.type not in self._op_types:
+                continue
+            slot = "Out" if "Out" in op.outputs else \
+                ("Y" if "Y" in op.outputs else None)
+            if slot is None:
+                continue
+            name = op.outputs[slot][0]
+            v = block.vars.get(name)
+            if v is None or v.dtype not in ("float32", "float64"):
+                continue
+            scale = self._state_var(block, name + ".out_scale", 0.001)
+            accum = self._state_var(block, name + ".out_accum", 1.0)
+            state = self._state_var(block, name + ".out_state", 1.0)
+            obs = name + ".scale_observed"
+            block.create_var(obs, shape=v.shape, dtype=v.dtype)
+            new_ops.append(OpDesc(
+                "moving_average_abs_max_scale",
+                {"X": [name], "InAccum": [accum], "InState": [state]},
+                {"Out": [obs], "OutScale": [scale], "OutAccum": [accum],
+                 "OutState": [state]},
+                {"moving_rate": self._moving_rate, "is_test": False}))
+        block.ops = new_ops
+        return program
+
+
+class OutScaleForInferencePass(_PassBase):
+    """Write trained output scales into op attrs as `out_threshold`
+    (quantization_pass.py:1589) and drop the observers."""
+
+    def __init__(self, scope=None):
+        super().__init__(scope, None)
+
+    def apply(self, program: Program) -> Program:
+        scope = self._scope if self._scope is not None else global_scope()
+        block = program.global_block
+        new_ops = []
+        for op in block.ops:
+            if op.type == "moving_average_abs_max_scale":
+                continue
+            slot = "Out" if "Out" in op.outputs else \
+                ("Y" if "Y" in op.outputs else None)
+            if slot is not None:
+                name = op.outputs[slot][0]
+                accum = scope.find_var(name + ".out_accum")
+                state = scope.find_var(name + ".out_state")
+                if accum is not None and state is not None:
+                    op.attrs["out_threshold"] = float(
+                        np.asarray(accum).reshape(())
+                        / np.asarray(state).reshape(()))
+            new_ops.append(op)
+        block.ops = new_ops
+        return program
+
+
+class PostTrainingQuantization:
+    """Offline calibration quantization
+    (post_training_quantization.py: feed sample batches through the
+    float inference program, estimate activation scales, then emit the
+    frozen int8-simulation program).
+
+    algo='abs_max' takes the max |x| over calibration batches;
+    algo='hist' takes the `hist_percent` percentile of the |x|
+    histogram (the KL/hist family collapsed to percentile — same
+    outlier-rejection role, deterministic)."""
+
+    def __init__(self, executor, program: Program, feed_list: Sequence[str],
+                 fetch_list: Sequence, data_loader, scope=None,
+                 batch_nums: Optional[int] = None, algo: str = "abs_max",
+                 hist_percent: float = 0.99999, bits: int = 8,
+                 weight_quantize_type: str = "channel_wise_abs_max",
+                 quantizable_op_type: Optional[Sequence[str]] = None):
+        self._exe = executor
+        self._program = program
+        self._feed_list = list(feed_list)
+        self._fetch_list = list(fetch_list)
+        self._loader = data_loader
+        self._scope = scope if scope is not None else global_scope()
+        self._batch_nums = batch_nums
+        if algo not in ("abs_max", "hist", "avg"):
+            raise ValueError("unknown algo %r" % algo)
+        self._algo = algo
+        self._percent = hist_percent
+        self._bits = bits
+        self._w_type = weight_quantize_type
+        self._op_types = list(quantizable_op_type
+                              or TRANSFORM_PASS_OP_TYPES)
+
+    def quantize(self) -> Program:
+        program = self._program.clone(for_test=True)
+        block = program.global_block
+        # activation vars to calibrate: non-param float inputs of
+        # quantizable ops
+        targets: List[str] = []
+        for op in block.ops:
+            if op.type not in self._op_types:
+                continue
+            for names in op.inputs.values():
+                for n in names:
+                    v = block.vars.get(n)
+                    if v is not None and not _is_param(block, n) and \
+                            v.dtype in ("float32", "float64") and \
+                            n not in targets:
+                        targets.append(n)
+
+        stats = {n: [] for n in targets}
+        for i, batch in enumerate(self._loader()):
+            if self._batch_nums is not None and i >= self._batch_nums:
+                break
+            feed = batch if isinstance(batch, dict) else \
+                dict(zip(self._feed_list, batch))
+            outs = self._exe.run(program, feed=feed, fetch_list=targets,
+                                 scope=self._scope)
+            for n, o in zip(targets, outs):
+                a = np.abs(np.asarray(o)).ravel()
+                if not a.size:
+                    continue
+                if self._algo == "hist":
+                    # streaming: per-batch percentile, O(1) memory per
+                    # var (the reference keeps running histograms; the
+                    # max-of-batch-percentiles estimator serves the same
+                    # outlier-rejection role without retaining
+                    # activations)
+                    stats[n].append(float(np.quantile(a, self._percent)))
+                else:
+                    stats[n].append(float(a.max()))
+
+        scales: Dict[str, float] = {}
+        for n in targets:
+            if not stats[n]:
+                scales[n] = 1.0
+            elif self._algo == "avg":
+                scales[n] = float(np.mean(stats[n]))
+            else:  # abs_max and hist both take the max over batches
+                scales[n] = float(np.max(stats[n]))
+
+        # build the QAT graph with fixed scales, then freeze it
+        tp = QuantizationTransformPass(
+            scope=self._scope, weight_bits=self._bits,
+            activation_bits=self._bits,
+            activation_quantize_type="moving_average_abs_max",
+            weight_quantize_type=self._w_type,
+            quantizable_op_type=self._op_types)
+        tp.apply(program)
+        for n, s in scales.items():
+            self._scope.set(n + ".quant_scale",
+                            np.asarray([max(s, 1e-6)], np.float32))
+        QuantizationFreezePass(
+            scope=self._scope, weight_bits=self._bits,
+            activation_bits=self._bits,
+            weight_quantize_type=self._w_type).apply(program)
+        return program

@@ -25,6 +25,7 @@ from . import (  # noqa: F401
     metrics,
     nn,
     optimizers,
+    quantize,
     random,
     reduce,
     sequence,

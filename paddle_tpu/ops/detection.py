@@ -1362,6 +1362,8 @@ def _generate_proposal_labels(ctx, ins, attrs):
     rois_num = ins["RpnRoisNum"][0].astype(jnp.int32)
     gt_num = ins["GtNum"][0].astype(jnp.int32) if ins.get("GtNum") else \
         jnp.full((gt.shape[0],), gt.shape[1], jnp.int32)
+    crowd = ins["IsCrowd"][0].astype(bool) if ins.get("IsCrowd") else \
+        jnp.zeros(gt.shape[:2], bool)
     bs = int(attrs.get("batch_size_per_im", 512))
     fg_frac = float(attrs.get("fg_fraction", 0.25))
     fg_th = float(attrs.get("fg_thresh", 0.5))
@@ -1369,45 +1371,51 @@ def _generate_proposal_labels(ctx, ins, attrs):
     bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
     n = gt.shape[0]
     r = rois.shape[0] // n
+    g = gt.shape[1]
     rois = rois.reshape(n, r, 4)
     fg_cap = int(bs * fg_frac)
     key = ctx.rng()
+    r2 = r + g  # roi pool = proposals + appended gt boxes
 
     def per_image(args):
-        roi_i, nroi, gt_i, cls_i, ng, k = args
-        rvalid = jnp.arange(r) < nroi
-        gvalid = jnp.arange(gt_i.shape[0]) < ng
-        # gt boxes join the roi pool (the reference appends them)
-        iou = _iou_matrix(roi_i, gt_i, normalized=False)
-        iou = jnp.where(gvalid[None, :] & rvalid[:, None], iou, -1.0)
+        roi_i, nroi, gt_i, cls_i, ng, crowd_i, k = args
+        gvalid = (jnp.arange(g) < ng)
+        match_ok = gvalid & ~crowd_i  # crowd gt never matches (reference
+        # filters them out of the roi set, generate_proposal_labels_op.cc)
+        # gt boxes join the roi pool (reference concatenates them so an
+        # image whose proposals all miss still trains on the gt itself)
+        pool = jnp.concatenate([roi_i, gt_i], axis=0)  # [r2, 4]
+        pvalid = jnp.concatenate([jnp.arange(r) < nroi, match_ok])
+        iou = _iou_matrix(pool, gt_i, normalized=False)
+        iou = jnp.where(match_ok[None, :] & pvalid[:, None], iou, -1.0)
         best_gt = jnp.argmax(iou, axis=1)
         best_iou = jnp.max(iou, axis=1)
-        is_fg = best_iou >= fg_th
-        is_bg = (best_iou < bg_hi) & (best_iou >= bg_lo) & rvalid & \
+        is_fg = (best_iou >= fg_th) & pvalid
+        is_bg = (best_iou < bg_hi) & (best_iou >= bg_lo) & pvalid & \
             ~is_fg
         k1, k2 = jax.random.split(k)
         # cap fg at fg_cap via a first top-k, then rank fg above bg in
         # ONE combined top-k(bs): bg fills whatever fg leaves unfilled
         # (the reference draws bs - n_fg backgrounds)
-        fg_noise = jax.random.uniform(k1, (r,))
+        fg_noise = jax.random.uniform(k1, (r2,))
         fg_rank = jnp.where(is_fg, fg_noise, -1.0)
-        _, fg_idx = jax.lax.top_k(fg_rank, min(fg_cap, r))
-        fg_keep = jnp.zeros(r, bool).at[fg_idx].set(
+        _, fg_idx = jax.lax.top_k(fg_rank, min(fg_cap, r2))
+        fg_keep = jnp.zeros(r2, bool).at[fg_idx].set(
             fg_rank[fg_idx] > 0)
         combined = jnp.where(fg_keep, 2.0 + fg_noise,
                              jnp.where(is_bg,
-                                       1.0 + jax.random.uniform(k2, (r,)),
+                                       1.0 + jax.random.uniform(k2, (r2,)),
                                        -1.0))
-        top, sel = jax.lax.top_k(combined, min(bs, r))
+        top, sel = jax.lax.top_k(combined, min(bs, r2))
         ok = top > 0
-        if r < bs:  # pad the fixed bs rows
-            sel = jnp.concatenate([sel, jnp.zeros(bs - r, sel.dtype)])
-            ok = jnp.concatenate([ok, jnp.zeros(bs - r, bool)])
+        if r2 < bs:  # pad the fixed bs rows
+            sel = jnp.concatenate([sel, jnp.zeros(bs - r2, sel.dtype)])
+            ok = jnp.concatenate([ok, jnp.zeros(bs - r2, bool)])
         sel_fg = fg_keep[sel] & ok
-        sel_rois = jnp.where(ok[:, None], roi_i[sel], 0.0)
+        sel_rois = jnp.where(ok[:, None], pool[sel], 0.0)
         labels = jnp.where(sel_fg, cls_i[best_gt[sel]], 0)
         labels = jnp.where(ok, labels, -1).astype(jnp.int32)
-        tgt = _encode_deltas(roi_i[sel], gt_i[best_gt[sel]])
+        tgt = _encode_deltas(pool[sel], gt_i[best_gt[sel]])
         tgt = jnp.where(sel_fg[:, None], tgt, 0.0)
         w = jnp.where(sel_fg[:, None], 1.0, 0.0) * jnp.ones((1, 4))
         return (sel_rois, labels, tgt, w, w,
@@ -1415,7 +1423,7 @@ def _generate_proposal_labels(ctx, ins, attrs):
 
     keys = jax.random.split(key, n)
     out = jax.lax.map(per_image, (rois, rois_num, gt, gt_cls, gt_num,
-                                  keys))
+                                  crowd, keys))
     rois_o, labels, tgt, wi, wo, num = out
     return {"Rois": [rois_o.reshape(n * bs, 4)],
             "LabelsInt32": [labels.reshape(n * bs)],

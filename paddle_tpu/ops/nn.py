@@ -240,7 +240,10 @@ def _softmax_with_ce(ctx, ins, attrs):
     axis = attrs.get("axis", -1)
     soft_label = attrs.get("soft_label", False)
     ignore = attrs.get("ignore_index", -100)
-    logp = jax.nn.log_softmax(logits, axis=axis)
+    # logsumexp in fp32 even when AMP feeds bf16 logits (the reference
+    # lists softmax_with_cross_entropy in the AMP black list for the
+    # same reason)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
     softmax = jnp.exp(logp)
     if soft_label:
         loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
@@ -443,19 +446,25 @@ def _batch_norm(ctx, ins, attrs):
     bshape = [1] * x.ndim
     bshape[c_axis] = x.shape[c_axis]
 
+    # statistics always accumulate in fp32 (the reference kernel's
+    # BatchNormParamType promotes fp16/bf16 stats the same way); the
+    # normalized output stays in x's dtype so a bf16 residual stream is
+    # not silently promoted to fp32 — under AMP that doubles the HBM
+    # traffic of every BN/relu/add chain on TPU
+    xs = x.astype(jnp.float32)
     if use_global:
         mean, var = mean_in, var_in
         saved_mean, saved_var = mean_in, var_in
         mean_out, var_out = mean_in, var_in
     else:
-        mean = jnp.mean(x, axis=red)
-        var = jnp.mean(jnp.square(x), axis=red) - jnp.square(mean)
+        mean = jnp.mean(xs, axis=red)
+        var = jnp.mean(jnp.square(xs), axis=red) - jnp.square(mean)
         saved_mean, saved_var = mean, var
         mean_out = momentum * mean_in + (1 - momentum) * mean
         var_out = momentum * var_in + (1 - momentum) * var
     inv = jax.lax.rsqrt(var.reshape(bshape) + eps)
-    y = (x - mean.reshape(bshape)) * inv * scale.reshape(bshape) \
-        + bias.reshape(bshape)
+    y = ((xs - mean.reshape(bshape)) * inv * scale.reshape(bshape)
+         + bias.reshape(bshape)).astype(x.dtype)
     return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
             "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
 

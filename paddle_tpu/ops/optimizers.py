@@ -375,10 +375,11 @@ def _dgc_momentum(ctx, ins, attrs):
         p_mom = p - lr * (g + mu * v_mom)
     else:
         p_mom = p - lr * v_mom
-    # dgc_momentum_op.h:63-69: before rampup -> momentum; after it the
-    # DGC pipeline already momentum-corrected the sparsified grad, so
-    # the kernel applies PLAIN SGD (velocity untouched)
-    use_sgd = (rampup >= 0) & (step >= rampup)
+    # dgc_momentum_op.h:63-69: step < rampup_begin_step -> momentum,
+    # else PLAIN SGD (velocity untouched) — the DGC pipeline has already
+    # momentum-corrected the sparsified grad post-rampup. No negative
+    # special case: the attr default -1.0 means SGD from step 0.
+    use_sgd = step >= rampup
     p_out = jnp.where(use_sgd, p - lr * g, p_mom)
     v_out = jnp.where(use_sgd, v, v_mom)
     return {"ParamOut": [p_out], "VelocityOut": [v_out]}

@@ -1,0 +1,339 @@
+"""Quantization op family — fake (simulated) quantization for QAT and
+post-training quantization, plus the int8 quantize/dequantize/requantize
+trio.
+
+Reference surface:
+- /root/reference/paddle/fluid/operators/fake_quantize_op.cc
+  (fake_quantize_abs_max, fake_quantize_range_abs_max,
+   fake_quantize_moving_average_abs_max, fake_channel_wise_quantize_abs_max,
+   moving_average_abs_max_scale, fake_quantize_dequantize_*)
+- /root/reference/paddle/fluid/operators/fake_dequantize_op.cc
+  (fake_dequantize_max_abs, fake_channel_wise_dequantize_max_abs)
+- /root/reference/paddle/fluid/operators/mkldnn/quantize_mkldnn_op.cc
+  et al. (quantize / dequantize / requantize)
+
+TPU design notes:
+- Simulated quantization stays in float: round(x/s*bin) is computed on
+  the VPU and fuses with the surrounding matmul/conv.
+- The *_dequantize ops carry a straight-through-estimator gradient
+  (reference FakeQuantDequantGradOp: dX = dOut), expressed as
+  x + stop_gradient(qdq(x) - x) so jax autodiff recovers exactly the
+  reference's pass-through derivative. Quant-only ops are no_grad.
+- Scale state (range window, moving average accum/state) is functional:
+  the executor writes Out* state back to the scope, like batch_norm's
+  MeanOut/VarianceOut.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+
+def _bin_cnt(attrs, key="bit_length", default=8):
+    bits = int(attrs.get(key, default))
+    if not 1 <= bits <= 16:
+        raise ValueError("bit_length must be in [1, 16], got %d" % bits)
+    return float((1 << (bits - 1)) - 1)
+
+
+def _inv(s):
+    # fake_quantize_op.h inverse(): guard against zero scale
+    eps = 1e-6
+    return jnp.where(s <= 1e-30, 1.0 / (s + eps), 1.0 / s)
+
+
+def _absmax(x):
+    return jnp.max(jnp.abs(x))
+
+
+def _channel_absmax(x, axis):
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    return jnp.max(jnp.abs(x), axis=red)
+
+
+def _bshape(x, axis):
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    return shape
+
+
+def _quant(x, scale, bin_cnt):
+    """clip + round to the integer grid (still float dtype)."""
+    x = jnp.clip(x, -scale, scale)
+    return jnp.round(bin_cnt * _inv(scale) * x)
+
+
+def _qdq(x, scale, bin_cnt):
+    return _quant(x, scale, bin_cnt) * scale / bin_cnt
+
+
+def _ste(x, y):
+    """Straight-through estimator: forward y, backward identity to x."""
+    return x + jax.lax.stop_gradient(y - x)
+
+
+@register_op("fake_quantize_abs_max", inputs=("X",),
+             outputs=("Out", "OutScale"), no_grad=True)
+def _fake_quantize_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    bins = _bin_cnt(attrs)
+    scale = _absmax(x)
+    return {"Out": [_quant(x, scale, bins)],
+            "OutScale": [scale.reshape(1)]}
+
+
+@register_op("fake_quantize_dequantize_abs_max", inputs=("X",),
+             outputs=("Out", "OutScale"))
+def _fake_qdq_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    bins = _bin_cnt(attrs)
+    scale = jax.lax.stop_gradient(_absmax(x))
+    return {"Out": [_ste(x, _qdq(x, scale, bins))],
+            "OutScale": [scale.reshape(1)]}
+
+
+@register_op("fake_channel_wise_quantize_abs_max", inputs=("X",),
+             outputs=("Out", "OutScale"), no_grad=True)
+def _fake_channel_quantize(ctx, ins, attrs):
+    x = ins["X"][0]
+    bins = _bin_cnt(attrs)
+    axis = int(attrs.get("quant_axis", 0))
+    scale = _channel_absmax(x, axis)
+    s = scale.reshape(_bshape(x, axis))
+    return {"Out": [_quant(x, s, bins)], "OutScale": [scale]}
+
+
+@register_op("fake_channel_wise_quantize_dequantize_abs_max", inputs=("X",),
+             outputs=("Out", "OutScale"))
+def _fake_channel_qdq(ctx, ins, attrs):
+    x = ins["X"][0]
+    bins = _bin_cnt(attrs)
+    axis = int(attrs.get("quant_axis", 0))
+    scale = jax.lax.stop_gradient(_channel_absmax(x, axis))
+    s = scale.reshape(_bshape(x, axis))
+    return {"Out": [_ste(x, _qdq(x, s, bins))], "OutScale": [scale]}
+
+
+@register_op("fake_quantize_range_abs_max",
+             inputs=("X", "InScale", "InScales", "Iter"),
+             outputs=("Out", "OutScale", "OutScales", "IterOut"),
+             no_grad=True,
+             inplace_map={"OutScale": "InScale", "OutScales": "InScales",
+                          "IterOut": "Iter"})
+def _fake_quantize_range(ctx, ins, attrs):
+    """Sliding-window max of per-batch abs-max scales
+    (FindRangeAbsMaxFunctor, fake_quantize_op.cc:183). InScales/OutScales
+    is the circular window buffer; Iter the step counter."""
+    x = ins["X"][0]
+    bins = _bin_cnt(attrs)
+    window = int(attrs.get("window_size", 10000))
+    is_test = bool(attrs.get("is_test", False)) or ctx.is_test
+    in_scale = ins["InScale"][0].reshape(())
+    if is_test:
+        return {"Out": [_quant(x, in_scale, bins)],
+                "OutScale": [in_scale.reshape(1)],
+                "OutScales": ins.get("InScales",
+                                     [jnp.zeros((window,), x.dtype)]),
+                "IterOut": ins["Iter"]}
+    it = ins["Iter"][0].reshape(()).astype(jnp.int32)
+    scales = (ins["InScales"][0] if ins.get("InScales")
+              else jnp.zeros((window,), x.dtype))
+    cur = _absmax(x)
+    idx = jnp.mod(it, window)
+    scales = scales.at[idx].set(cur)
+    n = jnp.minimum(it + 1, window)
+    mask = jnp.arange(window) < n
+    out_scale = jnp.max(jnp.where(mask, scales, 0.0))
+    return {"Out": [_quant(x, out_scale, bins)],
+            "OutScale": [out_scale.reshape(1)],
+            "OutScales": [scales], "IterOut": [it + 1]}
+
+
+def _moving_average_scale(ins, x, moving_rate):
+    """FindMovingAverageAbsMaxFunctor: state = r*state + 1,
+    accum = r*accum + |x|_max, scale = accum/state."""
+    cur = jax.lax.stop_gradient(_absmax(x))
+    accum = ins["InAccum"][0].reshape(()) if ins.get("InAccum") else \
+        jnp.asarray(0.0, x.dtype)
+    state = ins["InState"][0].reshape(()) if ins.get("InState") else \
+        jnp.asarray(0.0, x.dtype)
+    state = moving_rate * state + 1.0
+    accum = moving_rate * accum + cur
+    return accum / state, accum, state
+
+
+@register_op("fake_quantize_moving_average_abs_max",
+             inputs=("X", "InScale", "InAccum", "InState"),
+             outputs=("Out", "OutScale", "OutAccum", "OutState"),
+             no_grad=True,
+             inplace_map={"OutScale": "InScale", "OutAccum": "InAccum",
+                          "OutState": "InState"})
+def _fake_quantize_moving(ctx, ins, attrs):
+    x = ins["X"][0]
+    bins = _bin_cnt(attrs)
+    rate = float(attrs.get("moving_rate", 0.9))
+    is_test = bool(attrs.get("is_test", False)) or ctx.is_test
+    if is_test:
+        scale = ins["InScale"][0].reshape(())
+        return {"Out": [_quant(x, scale, bins)],
+                "OutScale": [scale.reshape(1)],
+                "OutAccum": ins.get("InAccum", [jnp.zeros(1)]),
+                "OutState": ins.get("InState", [jnp.zeros(1)])}
+    scale, accum, state = _moving_average_scale(ins, x, rate)
+    return {"Out": [_quant(x, scale, bins)],
+            "OutScale": [scale.reshape(1)], "OutAccum": [accum.reshape(1)],
+            "OutState": [state.reshape(1)]}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             inputs=("X", "InScale", "InAccum", "InState"),
+             outputs=("Out", "OutScale", "OutAccum", "OutState"),
+             inplace_map={"OutScale": "InScale", "OutAccum": "InAccum",
+                          "OutState": "InState"})
+def _fake_qdq_moving(ctx, ins, attrs):
+    x = ins["X"][0]
+    bins = _bin_cnt(attrs)
+    rate = float(attrs.get("moving_rate", 0.9))
+    is_test = bool(attrs.get("is_test", False)) or ctx.is_test
+    if is_test:
+        scale = ins["InScale"][0].reshape(())
+        return {"Out": [_ste(x, _qdq(x, scale, bins))],
+                "OutScale": [scale.reshape(1)],
+                "OutAccum": ins.get("InAccum", [jnp.zeros(1)]),
+                "OutState": ins.get("InState", [jnp.zeros(1)])}
+    scale, accum, state = _moving_average_scale(ins, x, rate)
+    return {"Out": [_ste(x, _qdq(x, scale, bins))],
+            "OutScale": [scale.reshape(1)], "OutAccum": [accum.reshape(1)],
+            "OutState": [state.reshape(1)]}
+
+
+@register_op("fake_quantize_dequantize_range_abs_max",
+             inputs=("X", "InScale", "InScales", "Iter"),
+             outputs=("Out", "OutScale", "OutScales", "IterOut"),
+             inplace_map={"OutScale": "InScale", "OutScales": "InScales",
+                          "IterOut": "Iter"})
+def _fake_qdq_range(ctx, ins, attrs):
+    """TPU-side fused variant: the reference trains range_abs_max QAT as
+    a quant op + dequant op pair whose backward is pass-through; here the
+    pair is one differentiable op carrying the STE, symmetric with the
+    moving-average twin (fake_quantize_op.cc FindRangeAbsMaxFunctor for
+    the scale recurrence)."""
+    x = ins["X"][0]
+    bins = _bin_cnt(attrs)
+    window = int(attrs.get("window_size", 10000))
+    is_test = bool(attrs.get("is_test", False)) or ctx.is_test
+    in_scale = ins["InScale"][0].reshape(())
+    if is_test:
+        return {"Out": [_ste(x, _qdq(x, in_scale, bins))],
+                "OutScale": [in_scale.reshape(1)],
+                "OutScales": ins.get("InScales",
+                                     [jnp.zeros((window,), x.dtype)]),
+                "IterOut": ins["Iter"]}
+    it = ins["Iter"][0].reshape(()).astype(jnp.int32)
+    scales = (ins["InScales"][0] if ins.get("InScales")
+              else jnp.zeros((window,), x.dtype))
+    cur = jax.lax.stop_gradient(_absmax(x))
+    idx = jnp.mod(it, window)
+    scales = scales.at[idx].set(cur)
+    n = jnp.minimum(it + 1, window)
+    mask = jnp.arange(window) < n
+    out_scale = jnp.max(jnp.where(mask, scales, 0.0))
+    return {"Out": [_ste(x, _qdq(x, out_scale, bins))],
+            "OutScale": [out_scale.reshape(1)],
+            "OutScales": [scales], "IterOut": [it + 1]}
+
+
+@register_op("moving_average_abs_max_scale",
+             inputs=("X", "InAccum", "InState"),
+             outputs=("Out", "OutScale", "OutAccum", "OutState"),
+             inplace_map={"OutAccum": "InAccum", "OutState": "InState"})
+def _moving_average_abs_max_scale(ctx, ins, attrs):
+    """Observer only: Out = X, scale state updated (used by
+    OutScaleForTrainingPass)."""
+    x = ins["X"][0]
+    rate = float(attrs.get("moving_rate", 0.9))
+    is_test = bool(attrs.get("is_test", False)) or ctx.is_test
+    if is_test:
+        accum = ins["InAccum"][0] if ins.get("InAccum") else jnp.ones(1)
+        state = ins["InState"][0] if ins.get("InState") else jnp.ones(1)
+        scale = (accum.reshape(()) / state.reshape(())).reshape(1)
+        return {"Out": [x], "OutScale": [scale], "OutAccum": [accum],
+                "OutState": [state]}
+    scale, accum, state = _moving_average_scale(ins, x, rate)
+    return {"Out": [x], "OutScale": [scale.reshape(1)],
+            "OutAccum": [accum.reshape(1)], "OutState": [state.reshape(1)]}
+
+
+@register_op("fake_dequantize_max_abs", inputs=("X", "Scale"),
+             outputs=("Out",))
+def _fake_dequantize_max_abs(ctx, ins, attrs):
+    """Out = X * Scale / max_range (fake_dequantize_op.cc)."""
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(())
+    max_range = float(attrs.get("max_range", 127.0))
+    return {"Out": [x.astype(scale.dtype) * scale / max_range]}
+
+
+@register_op("fake_channel_wise_dequantize_max_abs",
+             inputs=("X", "Scales"), outputs=("Out",))
+def _fake_channel_dequantize(ctx, ins, attrs):
+    """One or two scale levels (fake_dequantize_op.cc
+    ChannelDequantizeFunctor): one level — per-channel weight scales on
+    quant_axis; two — per-channel weight scales then a scalar activation
+    scale."""
+    x = ins["X"][0]
+    scales = ins["Scales"]
+    bits = attrs.get("quant_bits", [8])
+    if isinstance(bits, int):
+        bits = [bits]
+    axis = int(attrs.get("quant_axis", 0))
+    s0 = scales[0]
+    out = x.astype(s0.dtype)
+    max0 = float((1 << (int(bits[0]) - 1)) - 1)
+    out = out * s0.reshape(_bshape(x, axis)) / max0
+    if len(scales) > 1:
+        max1 = float((1 << (int(bits[1]) - 1)) - 1)
+        out = out * scales[1].reshape(()) / max1
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# int8 quantize / dequantize / requantize (the mkldnn trio — on TPU these
+# are real dtype conversions, e.g. for int8 serving exports)
+# ---------------------------------------------------------------------------
+
+@register_op("quantize", inputs=("Input",), outputs=("Output",),
+             no_grad=True)
+def _quantize(ctx, ins, attrs):
+    x = ins["Input"][0]
+    scale = float(attrs.get("Scale", 1.0))
+    shift = float(attrs.get("Shift", 0.0))
+    y = jnp.round(x * scale + shift)
+    if bool(attrs.get("is_negative_input", True)) and shift == 0.0:
+        y = jnp.clip(y, -128, 127).astype(jnp.int8)
+    else:
+        y = jnp.clip(y, 0, 255).astype(jnp.uint8)
+    return {"Output": [y]}
+
+
+@register_op("dequantize", inputs=("Input",), outputs=("Output",),
+             no_grad=True)
+def _dequantize(ctx, ins, attrs):
+    x = ins["Input"][0]
+    scale = float(attrs.get("Scale", 1.0))
+    shift = float(attrs.get("Shift", 0.0))
+    return {"Output": [(x.astype(jnp.float32) - shift) / scale]}
+
+
+@register_op("requantize", inputs=("Input",), outputs=("Output",),
+             no_grad=True)
+def _requantize(ctx, ins, attrs):
+    x = ins["Input"][0]
+    s_in = float(attrs.get("Scale_in", 1.0))
+    s_out = float(attrs.get("Scale_out", 1.0))
+    y = jnp.round(x.astype(jnp.float32) * (s_out / s_in))
+    info = jnp.iinfo(x.dtype)  # clip to the SOURCE type's range
+    return {"Output": [jnp.clip(y, info.min, info.max).astype(x.dtype)]}

@@ -247,13 +247,25 @@ def test_dgc_momentum_matches_momentum_rule():
     p = r.randn(5).astype(np.float32)
     g = r.randn(5).astype(np.float32)
     v = r.randn(5).astype(np.float32)
+    # dgc_momentum_op.h: step < rampup_begin_step -> momentum
     o = run_op("dgc_momentum",
                {"Param": p, "Grad": g, "Velocity": v,
                 "LearningRate": np.asarray([0.1], np.float32),
                 "CurrentStep": np.asarray([0], np.float32)},
-               {"mu": 0.9})
+               {"mu": 0.9, "rampup_begin_step": 10.0})
     v_ref = 0.9 * v + g
     np.testing.assert_allclose(np.asarray(o["VelocityOut"][0]), v_ref,
                                rtol=1e-6)
     np.testing.assert_allclose(np.asarray(o["ParamOut"][0]),
                                p - 0.1 * v_ref, rtol=1e-6)
+    # step >= rampup (incl. the default -1.0 from step 0): plain SGD,
+    # velocity untouched
+    o2 = run_op("dgc_momentum",
+                {"Param": p, "Grad": g, "Velocity": v,
+                 "LearningRate": np.asarray([0.1], np.float32),
+                 "CurrentStep": np.asarray([0], np.float32)},
+                {"mu": 0.9})
+    np.testing.assert_allclose(np.asarray(o2["VelocityOut"][0]), v,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(o2["ParamOut"][0]), p - 0.1 * g,
+                               rtol=1e-6)

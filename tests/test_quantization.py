@@ -1,0 +1,327 @@
+"""Quantization tests: fake-quant op oracles + STE grads, static QAT
+transform/freeze round trip, post-training quantization, imperative QAT.
+
+Reference discipline:
+- op oracles mirror unittests/test_fake_quantize_op.py (round/clip grid,
+  scale outputs, moving-average state recurrence)
+- pass tests mirror unittests/test_quantization_pass.py (scales train,
+  frozen graph stays close to float)
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.registry import REGISTRY, LowerCtx
+from paddle_tpu.contrib.slim import (ImperativeQuantAware,
+                                     PostTrainingQuantization,
+                                     QuantizationFreezePass,
+                                     QuantizationTransformPass)
+import paddle_tpu.ops  # noqa: F401
+
+
+def run_op(name, ins, attrs=None):
+    opdef = REGISTRY.get(name)
+    ins = {k: [jnp.asarray(a) for a in (v if isinstance(v, list) else [v])]
+           for k, v in ins.items() if v is not None}
+    return opdef.lower(LowerCtx(jax.random.PRNGKey(0)), ins, attrs or {})
+
+
+# ---------------------------------------------------------------------------
+# op oracles
+# ---------------------------------------------------------------------------
+
+def test_fake_quantize_abs_max_oracle():
+    x = np.random.RandomState(0).randn(8, 5).astype(np.float32)
+    out = run_op("fake_quantize_abs_max", {"X": x}, {"bit_length": 8})
+    s = np.abs(x).max()
+    np.testing.assert_allclose(np.asarray(out["OutScale"]).ravel(), [s],
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["Out"][0]),
+                               np.round(x / s * 127), rtol=1e-5)
+
+
+def test_fake_qdq_value_and_ste_grad():
+    x = np.random.RandomState(1).randn(6, 4).astype(np.float32)
+
+    def f(xx):
+        o = REGISTRY.get("fake_quantize_dequantize_abs_max").lower(
+            LowerCtx(jax.random.PRNGKey(0)), {"X": [xx]}, {"bit_length": 8})
+        return jnp.sum(o["Out"][0])
+
+    s = np.abs(x).max()
+    expect = np.round(x / s * 127) * s / 127
+    o = run_op("fake_quantize_dequantize_abs_max", {"X": x})
+    np.testing.assert_allclose(np.asarray(o["Out"][0]), expect, atol=1e-6)
+    # straight-through estimator: dX = dOut (FakeQuantDequantGradOp)
+    g = jax.grad(f)(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(g), np.ones_like(x), atol=1e-6)
+
+
+def test_fake_channel_wise_quantize():
+    w = np.random.RandomState(2).randn(4, 3, 2, 2).astype(np.float32)
+    o = run_op("fake_channel_wise_quantize_abs_max", {"X": w},
+               {"bit_length": 8, "quant_axis": 0})
+    s = np.abs(w).max(axis=(1, 2, 3))
+    np.testing.assert_allclose(np.asarray(o["OutScale"][0]), s, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(o["Out"][0]),
+        np.round(w / s.reshape(4, 1, 1, 1) * 127), rtol=1e-5)
+
+
+def test_moving_average_state_recurrence():
+    rate = 0.9
+    x1 = np.asarray([[1.0, -2.0]], np.float32)
+    o = run_op("fake_quantize_moving_average_abs_max",
+               {"X": x1, "InScale": np.asarray([0.001], np.float32),
+                "InAccum": np.asarray([1.0], np.float32),
+                "InState": np.asarray([1.0], np.float32)},
+               {"bit_length": 8, "moving_rate": rate})
+    # state' = r*state + 1; accum' = r*accum + absmax; scale = accum/state
+    state = rate * 1.0 + 1.0
+    accum = rate * 1.0 + 2.0
+    np.testing.assert_allclose(np.asarray(o["OutState"][0]), [state],
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(o["OutAccum"][0]), [accum],
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(o["OutScale"][0]),
+                               [accum / state], rtol=1e-6)
+
+
+def test_range_abs_max_window():
+    o = run_op("fake_quantize_range_abs_max",
+               {"X": np.asarray([3.0, -1.0], np.float32),
+                "InScale": np.asarray([0.5], np.float32),
+                "InScales": np.zeros(4, np.float32),
+                "Iter": np.asarray([0], np.int32)},
+               {"bit_length": 8, "window_size": 4})
+    np.testing.assert_allclose(np.asarray(o["OutScale"][0]), [3.0])
+    assert int(np.asarray(o["IterOut"][0])) == 1
+    # test mode quantizes with the stored scale
+    o2 = run_op("fake_quantize_range_abs_max",
+                {"X": np.asarray([0.25], np.float32),
+                 "InScale": np.asarray([0.5], np.float32),
+                 "InScales": np.zeros(4, np.float32),
+                 "Iter": np.asarray([5], np.int32)},
+                {"bit_length": 8, "window_size": 4, "is_test": True})
+    np.testing.assert_allclose(np.asarray(o2["Out"][0]),
+                               [np.round(0.25 / 0.5 * 127)])
+
+
+def test_dequantize_two_level():
+    xq = np.asarray([[127.0, -64.0], [10.0, 0.0]], np.float32)
+    ws = np.asarray([0.5, 2.0], np.float32)   # per out-channel (axis 1)
+    as_ = np.asarray([3.0], np.float32)
+    o = run_op("fake_channel_wise_dequantize_max_abs",
+               {"X": xq, "Scales": [ws, as_]},
+               {"quant_bits": [8, 8], "quant_axis": 1})
+    expect = xq * ws.reshape(1, 2) / 127 * 3.0 / 127
+    np.testing.assert_allclose(np.asarray(o["Out"][0]), expect, rtol=1e-5)
+
+
+def test_int8_trio_roundtrip():
+    x = np.asarray([0.5, -1.0, 0.99], np.float32)
+    q = run_op("quantize", {"Input": x}, {"Scale": 127.0})["Output"][0]
+    assert np.asarray(q).dtype == np.int8
+    d = run_op("dequantize", {"Input": q}, {"Scale": 127.0})["Output"][0]
+    np.testing.assert_allclose(np.asarray(d), x, atol=1 / 127)
+    r = run_op("requantize", {"Input": q},
+               {"Scale_in": 127.0, "Scale_out": 63.5})["Output"][0]
+    np.testing.assert_allclose(np.asarray(r),
+                               np.round(np.asarray(q) * 0.5), atol=0)
+
+
+# ---------------------------------------------------------------------------
+# static QAT
+# ---------------------------------------------------------------------------
+
+def _build_fc_net(main, startup, rng):
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8], append_batch_size=True)
+        y = layers.data("y", [1])
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.nn.square(layers.elementwise_sub(pred, y)))
+    return x, y, pred, loss
+
+
+def test_qat_transform_trains_and_freezes():
+    main, startup = pt.Program(), pt.Program()
+    rng = np.random.RandomState(0)
+    x, y, pred, loss = _build_fc_net(main, startup, rng)
+
+    scope = pt.Scope()
+    tp = QuantizationTransformPass(scope=scope, startup_program=startup)
+    tp.apply(main)
+    qdq_ops = [op for op in main.global_block.ops
+               if op.type.startswith("fake_")]
+    assert len(qdq_ops) >= 4  # 2 weights + 2 activations
+
+    with pt.program_guard(main, startup):
+        opt = pt.optimizer.SGD(learning_rate=0.05)
+        opt.minimize(loss, startup_program=startup, program=main)
+
+    true_w = rng.randn(8, 1).astype(np.float32)
+    exe = pt.Executor()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for i in range(60):
+            xb = rng.randn(32, 8).astype(np.float32)
+            yb = xb @ true_w
+            out, = exe.run(main, feed={"x": xb, "y": yb},
+                           fetch_list=[loss])
+            losses.append(float(out))
+        assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+        # the moving-average scale state moved off its init
+        scale_vars = [n for n in scope.local_names()
+                      if n.endswith(".quant_scale")
+                      and not n.startswith("fc")]
+        act_scales = [np.asarray(scope.find_var(n)).ravel()[0]
+                      for n in scope.local_names()
+                      if n.endswith(".quant_scale")]
+        assert any(abs(s - 0.001) > 1e-4 for s in act_scales), act_scales
+
+        # freeze for inference: output stays close to the QAT program
+        infer = main.clone(for_test=True)
+        xb = rng.randn(16, 8).astype(np.float32)
+        dummy_y = np.zeros((16, 1), np.float32)
+        float_out, = exe.run(infer, feed={"x": xb, "y": dummy_y},
+                             fetch_list=[pred])
+        QuantizationFreezePass(scope=scope).apply(infer)
+        types = [op.type for op in infer.global_block.ops]
+        assert "fake_channel_wise_dequantize_max_abs" in types
+        assert not any(t.startswith("fake_quantize_dequantize")
+                       for t in types)
+        frozen_out, = exe.run(infer, feed={"x": xb, "y": dummy_y},
+                              fetch_list=[pred])
+        np.testing.assert_allclose(np.asarray(frozen_out),
+                                   np.asarray(float_out),
+                                   atol=0.1, rtol=0.1)
+
+
+def test_post_training_quantization():
+    main, startup = pt.Program(), pt.Program()
+    rng = np.random.RandomState(3)
+    x, y, pred, loss = _build_fc_net(main, startup, rng)
+
+    scope = pt.Scope()
+    exe = pt.Executor()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        xb = rng.randn(16, 8).astype(np.float32)
+        dummy_y = np.zeros((16, 1), np.float32)
+        ref, = exe.run(main.clone(for_test=True),
+                       feed={"x": xb, "y": dummy_y}, fetch_list=[pred])
+
+        def loader():
+            for _ in range(4):
+                yield {"x": rng.randn(16, 8).astype(np.float32),
+                       "y": np.zeros((16, 1), np.float32)}
+
+        ptq = PostTrainingQuantization(
+            exe, main, feed_list=["x"], fetch_list=[pred],
+            data_loader=loader, scope=scope, algo="abs_max")
+        qprog = ptq.quantize()
+        qout, = exe.run(qprog, feed={"x": xb, "y": dummy_y},
+                        fetch_list=[pred])
+    np.testing.assert_allclose(np.asarray(qout), np.asarray(ref),
+                               atol=0.15, rtol=0.15)
+
+
+# ---------------------------------------------------------------------------
+# imperative QAT
+# ---------------------------------------------------------------------------
+
+def test_imperative_qat_linear():
+    import paddle_tpu.nn as nn
+    rng = np.random.RandomState(4)
+
+    model = nn.Sequential(nn.Linear(8, 16), nn.Linear(16, 1))
+    quanter = ImperativeQuantAware()
+    quanter.quantize(model)
+    from paddle_tpu.contrib.slim.imperative import QuantizedLinear
+    assert any(isinstance(m, QuantizedLinear) for m in model.sublayers())
+
+    opt = pt.optimizer.SGD(learning_rate=0.05,
+                           parameters=model.parameters())
+    true_w = rng.randn(8, 1).astype(np.float32)
+    losses = []
+    for i in range(60):
+        xb = rng.randn(32, 8).astype(np.float32)
+        yb = xb @ true_w
+        out = model(pt.to_tensor(xb))
+        loss = ((out - pt.to_tensor(yb)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+    # observer state advanced
+    q = [m for m in model.sublayers() if isinstance(m, QuantizedLinear)][0]
+    assert abs(float(q._in_fake._buffers["scale"].value[0]) - 0.001) > 1e-4
+
+
+def test_qat_range_abs_max_trains():
+    """range_abs_max activation quant must carry STE gradients
+    (regression: the quant-only op blocked all activation grads)."""
+    main, startup = pt.Program(), pt.Program()
+    rng = np.random.RandomState(5)
+    x, y, pred, loss = _build_fc_net(main, startup, rng)
+    scope = pt.Scope()
+    QuantizationTransformPass(
+        scope=scope, startup_program=startup,
+        activation_quantize_type="range_abs_max", window_size=16
+    ).apply(main)
+    with pt.program_guard(main, startup):
+        opt = pt.optimizer.SGD(learning_rate=0.05)
+        opt.minimize(loss, startup_program=startup, program=main)
+    true_w = rng.randn(8, 1).astype(np.float32)
+    exe = pt.Executor()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for i in range(60):
+            xb = rng.randn(32, 8).astype(np.float32)
+            out, = exe.run(main, feed={"x": xb, "y": xb @ true_w},
+                           fetch_list=[loss])
+            losses.append(float(out))
+        assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_freeze_keeps_second_tier_dequantized():
+    """AddQuantDequantPass + freeze: second-tier consumers (relu) must
+    keep dequantized-domain inputs (regression: freeze converted every
+    qdq to quant-only, feeding relu integer-grid values)."""
+    from paddle_tpu.contrib.slim import AddQuantDequantPass
+    main, startup = pt.Program(), pt.Program()
+    rng = np.random.RandomState(6)
+    x, y, pred, loss = _build_fc_net(main, startup, rng)
+    scope = pt.Scope()
+    QuantizationTransformPass(scope=scope, startup_program=startup
+                              ).apply(main)
+    AddQuantDequantPass(scope=scope, startup_program=startup,
+                        quantizable_op_type=["relu"]).apply(main)
+    exe = pt.Executor()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for i in range(10):  # training-mode runs advance the scale state
+            xb = rng.randn(32, 8).astype(np.float32)
+            exe.run(main, feed={"x": xb, "y": np.zeros((32, 1),
+                                                       np.float32)},
+                    fetch_list=[loss])
+        infer = main.clone(for_test=True)
+        xb = rng.randn(16, 8).astype(np.float32)
+        dy = np.zeros((16, 1), np.float32)
+        ref, = exe.run(infer, feed={"x": xb, "y": dy}, fetch_list=[pred])
+        QuantizationFreezePass(scope=scope).apply(infer)
+        frozen, = exe.run(infer, feed={"x": xb, "y": dy},
+                          fetch_list=[pred])
+    # frozen output stays in the float domain, close to the QAT output
+    np.testing.assert_allclose(np.asarray(frozen), np.asarray(ref),
+                               atol=0.2, rtol=0.2)
