@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 STRUCTURAL_OPS = ("while", "conditional_block", "write_to_array",
-                  "read_from_array", "array_length")
+                  "read_from_array", "array_length", "run_program")
 
 
 def _block_io(block) -> Tuple[Set[str], Set[str]]:
@@ -254,6 +254,28 @@ def lower_cond_block_pair(lowerer, op, env: Dict[str, Any]) -> None:
     env.update(zip(out_names, outs))
 
 
+def lower_run_program(lowerer, op, env: Dict[str, Any]) -> None:
+    """run_program op (operators/run_program_op.cc): execute a captured
+    sub-block inline — the op @to_static emits so a traced Program runs
+    inside dygraph. The sub-block's ops lower straight into the current
+    trace (one fused XLA computation, no interpreter hop), reading
+    outer vars from env and publishing the declared outputs."""
+    from .executor import _BlockLowerer
+
+    program = lowerer.program
+    sub = program.blocks[int(op.attr("sub_block"))]
+    # the captured sub-block reads outer vars by their own names (the
+    # @to_static capture shares the var table), so the outer env is the
+    # feed — no renaming layer exists in this IR
+    env2 = dict(env)
+    sub_lowerer = _BlockLowerer(program, lowerer.ctx)
+    sub_lowerer.run_ops(sub.ops, env2, initial_env=dict(env2),
+                        initial_key=lowerer.ctx.key_out)
+    for n in list(op.output("Out")) + list(op.output("DOut")):
+        if n in env2:
+            env[n] = env2[n]
+
+
 LOWERINGS = {
     "while": lower_while,
     "conditional_block": lower_conditional_block,
@@ -261,4 +283,5 @@ LOWERINGS = {
     "write_to_array": lower_write_to_array,
     "read_from_array": lower_read_from_array,
     "array_length": lower_array_length,
+    "run_program": lower_run_program,
 }

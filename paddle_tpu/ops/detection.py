@@ -1502,3 +1502,87 @@ def _roi_perspective_transform(ctx, ins, attrs):
     outs, masks, mats = jax.lax.map(one_roi, (rois, roi_img))
     return {"Out": [outs], "Mask": [masks[:, None]],
             "TransformMatrix": [mats.reshape(rois.shape[0], 9)]}
+
+
+@register_op("generate_mask_labels",
+             inputs=("ImInfo", "GtClasses", "IsCrowd", "GtSegms", "Rois",
+                     "LabelsInt32"),
+             outputs=("MaskRois", "RoiHasMaskInt32", "MaskInt32"),
+             no_grad=True, host=True)
+def _generate_mask_labels(ctx, ins, attrs):
+    """Mask R-CNN mask-target sampling
+    (operators/detection/generate_mask_labels_op.cc): for each
+    foreground roi, crop its best-matching gt mask, resize to
+    resolution M, and emit a per-class flattened target the sigmoid
+    mask head trains on. The reference rasterizes COCO polygons; here
+    GtSegms is the already-rasterized bitmap [N, G, Hm, Wm] (the data
+    pipeline owns polygon decoding — numpy host op, resolution is tiny).
+    """
+    im_info = np.asarray(ins["ImInfo"][0])     # [N, 3]
+    gt_cls = np.asarray(ins["GtClasses"][0])   # [N, G]
+    segms = np.asarray(ins["GtSegms"][0])      # [N, G, Hm, Wm]
+    rois = np.asarray(ins["Rois"][0])          # [N*R, 4]
+    labels = np.asarray(ins["LabelsInt32"][0]) # [N*R]
+    crowd_in = np.asarray(ins["IsCrowd"][0]).reshape(
+        gt_cls.shape).astype(bool) if ins.get("IsCrowd") else \
+        np.zeros(gt_cls.shape, bool)
+    M = int(attrs.get("resolution", 14))
+    num_classes = int(attrs.get("num_classes", 81))
+    n, g = segms.shape[0], segms.shape[1]
+    r = rois.shape[0] // n
+    rois = rois.reshape(n, r, 4)
+    labels = labels.reshape(n, r)
+
+    mask_rois, has_mask, targets = [], [], []
+    for i in range(n):
+        hm, wm = segms.shape[2], segms.shape[3]
+        im_h, im_w = float(im_info[i][0]), float(im_info[i][1])
+        for j in range(r):
+            cls = int(labels[i, j])
+            if cls <= 0:
+                continue
+            x1, y1, x2, y2 = rois[i, j]
+            # best same-class NON-crowd gt by bitmap-bbox IoU with the
+            # roi (reference matches rois against the sampled gt and
+            # skips is_crowd segments)
+            gi, best = None, 0.0
+            for k in range(g):
+                if int(gt_cls[i, k]) != cls or crowd_in[i, k] \
+                        or not segms[i, k].any():
+                    continue
+                ys_k, xs_k = np.nonzero(segms[i, k] > 0.5)
+                hm_k, wm_k = segms.shape[2], segms.shape[3]
+                gx1 = xs_k.min() / max(wm_k - 1, 1) * im_info[i][1]
+                gx2 = xs_k.max() / max(wm_k - 1, 1) * im_info[i][1]
+                gy1 = ys_k.min() / max(hm_k - 1, 1) * im_info[i][0]
+                gy2 = ys_k.max() / max(hm_k - 1, 1) * im_info[i][0]
+                iw = max(0.0, min(x2, gx2) - max(x1, gx1))
+                ih = max(0.0, min(y2, gy2) - max(y1, gy1))
+                inter = iw * ih
+                union = ((x2 - x1) * (y2 - y1)
+                         + (gx2 - gx1) * (gy2 - gy1) - inter)
+                iou = inter / union if union > 0 else 0.0
+                if gi is None or iou > best:
+                    gi, best = k, iou
+            if gi is None:
+                continue
+            # crop the gt bitmap over the roi (bitmap spans the image)
+            ys = np.clip(np.linspace(y1, y2, M) / max(im_h, 1e-6)
+                         * (hm - 1), 0, hm - 1)
+            xs = np.clip(np.linspace(x1, x2, M) / max(im_w, 1e-6)
+                         * (wm - 1), 0, wm - 1)
+            patch = segms[i, gi][np.round(ys).astype(int)[:, None],
+                                 np.round(xs).astype(int)[None, :]]
+            tgt = np.full((num_classes, M, M), -1.0, np.float32)
+            tgt[cls] = (patch > 0.5).astype(np.float32)
+            mask_rois.append(np.asarray([x1, y1, x2, y2], np.float32))
+            has_mask.append(j + i * r)
+            targets.append(tgt.reshape(-1))
+    if not mask_rois:  # static-friendly empty result
+        return {"MaskRois": [np.zeros((0, 4), np.float32)],
+                "RoiHasMaskInt32": [np.zeros((0,), np.int32)],
+                "MaskInt32": [np.zeros((0, num_classes * M * M),
+                                       np.int32)]}
+    return {"MaskRois": [np.stack(mask_rois)],
+            "RoiHasMaskInt32": [np.asarray(has_mask, np.int32)],
+            "MaskInt32": [np.stack(targets).astype(np.int32)]}

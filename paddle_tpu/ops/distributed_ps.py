@@ -345,3 +345,247 @@ def _lookup_sparse_table_write(ctx, ins, attrs):
     _SPARSE_TABLES[attrs["table_name"]].write(
         np.asarray(ins["Ids"][0]), np.asarray(ins["Value"][0]))
     return {}
+
+
+# ---------------------------------------------------------------------------
+# pslib / BoxPS sparse pull-push family
+# (operators/pull_sparse_op.cc, pull_sparse_v2_op.cc, pull_box_sparse_op.cc,
+#  pull_box_extended_sparse_op.cc — FleetWrapper::PullSparseToTensorsAndScale
+#  against the pslib/BoxPS embedding service; here the backend is the same
+#  process-global LargeScaleKV registry the lookup_sparse_table ops use,
+#  or a remote pserver when `epmap` is set)
+# ---------------------------------------------------------------------------
+
+def _fleet_table(attrs, dim_key="EmbeddingDim", name_key="tablename"):
+    """Get-or-create the KV table addressed by the op attrs. A dim
+    conflict with an existing table is an error, not a silent reuse —
+    the first toucher (often prefetch) must not pin a wrong width."""
+    from ..distributed.large_scale_kv import (LargeScaleKV,
+                                              SparseTableConfig)
+    name = attrs.get(name_key) or "fleet_table_%d" % attrs.get("TableId", 0)
+    want_dim = attrs.get(dim_key)
+    kv = _SPARSE_TABLES.get(name)
+    if kv is None:
+        if want_dim is None:
+            raise ValueError(
+                "sparse table %r does not exist yet and the op carries "
+                "no %s attr to create it" % (name, dim_key))
+        kv = _SPARSE_TABLES[name] = LargeScaleKV(SparseTableConfig(
+            name=name, dim=int(want_dim)))
+    elif want_dim is not None and int(want_dim) != kv.cfg.dim:
+        raise ValueError(
+            "sparse table %r has dim %d but the op asks for %s=%d"
+            % (name, kv.cfg.dim, dim_key, int(want_dim)))
+    return kv
+
+
+def _pull_sparse_impl(ctx, ins, attrs, dim_key, squeeze_trailing=True):
+    kv = _fleet_table(attrs, dim_key)
+    outs = []
+    for ids in ins["Ids"]:
+        ids = np.asarray(ids)
+        rows = kv.pull(ids.reshape(-1))
+        # v1 ids shaped [.., 1] follow the lookup_table squeeze
+        # contract; v2 keeps the ids' own trailing dim
+        lead = ids.shape[:-1] if squeeze_trailing and ids.ndim \
+            and ids.shape[-1] == 1 else ids.shape
+        outs.append(rows.reshape(lead + (rows.shape[-1],)))
+    return outs
+
+
+@register_op("pull_sparse", inputs=("Ids", "W"), outputs=("Out",),
+             no_grad=True, host=True)
+def _pull_sparse(ctx, ins, attrs):
+    """pull_sparse_op.cc: one lookup per Ids slot against TableId."""
+    return {"Out": _pull_sparse_impl(ctx, ins, attrs, "EmbeddingDim")}
+
+
+@register_op("pull_sparse_v2", inputs=("Ids", "W"), outputs=("Out",),
+             no_grad=True, host=True)
+def _pull_sparse_v2(ctx, ins, attrs):
+    """pull_sparse_v2_op.cc — same service call, ids keep their own
+    trailing dim (no [.., 1] squeeze contract)."""
+    return {"Out": _pull_sparse_impl(ctx, ins, attrs, "EmbeddingDim",
+                                     squeeze_trailing=False)}
+
+
+def _push_sparse_impl(ctx, ins, attrs, dim_key):
+    kv = _fleet_table(attrs, dim_key)
+    scale = bool(attrs.get("ScaleSparseGrad", True))
+    grads = ins.get("Out@GRAD") or ins.get("Grads") or []
+    for ids, g in zip(ins["Ids"], grads):
+        ids = np.asarray(ids).reshape(-1)
+        g = np.asarray(g, np.float32).reshape(len(ids), -1)
+        if scale and g.shape[0]:
+            g = g / float(g.shape[0])
+        kv.push(ids, g)
+    return {}
+
+
+@register_op("push_sparse", inputs=("Ids", "W", "Out@GRAD"), outputs=(),
+             no_grad=True, host=True)
+def _push_sparse(ctx, ins, attrs):
+    """push_sparse_op semantics (pull_sparse_op.cc PushSparseFunctor):
+    slot grads scaled by batch size when ScaleSparseGrad."""
+    return _push_sparse_impl(ctx, ins, attrs, "EmbeddingDim")
+
+
+@register_op("push_sparse_v2", inputs=("Ids", "W", "Out@GRAD"),
+             outputs=(), no_grad=True, host=True)
+def _push_sparse_v2(ctx, ins, attrs):
+    return _push_sparse_impl(ctx, ins, attrs, "EmbeddingDim")
+
+
+@register_op("pull_box_sparse", inputs=("Ids",), outputs=("Out",),
+             no_grad=True, host=True)
+def _pull_box_sparse(ctx, ins, attrs):
+    """pull_box_sparse_op.cc (BoxPS ad-embedding service; attr `size` is
+    the embedding dim)."""
+    return {"Out": _pull_sparse_impl(ctx, ins, attrs, "size")}
+
+
+@register_op("push_box_sparse", inputs=("Ids", "Out@GRAD"), outputs=(),
+             no_grad=True, host=True)
+def _push_box_sparse(ctx, ins, attrs):
+    return _push_sparse_impl(ctx, ins, attrs, "size")
+
+
+@register_op("pull_box_extended_sparse", inputs=("Ids",),
+             outputs=("Out", "OutExtend"), no_grad=True, host=True)
+def _pull_box_extended_sparse(ctx, ins, attrs):
+    """pull_box_extended_sparse_op.cc: base table (emb_size) + extended
+    table (emb_extended_size) pulled together."""
+    base = _pull_sparse_impl(ctx, ins, dict(attrs, tablename=(
+        attrs.get("tablename") or "box_base_%d" % attrs.get("TableId", 0))),
+        "emb_size")
+    ext = _pull_sparse_impl(ctx, ins, dict(attrs, tablename=(
+        (attrs.get("tablename") or "box") + ".extend")),
+        "emb_extended_size")
+    return {"Out": base, "OutExtend": ext}
+
+
+@register_op("push_box_extended_sparse", inputs=("Ids", "Out@GRAD",
+                                                 "OutExtend@GRAD"),
+             outputs=(), no_grad=True, host=True)
+def _push_box_extended_sparse(ctx, ins, attrs):
+    _push_sparse_impl(ctx, {"Ids": ins["Ids"],
+                            "Out@GRAD": ins.get("Out@GRAD", [])},
+                      dict(attrs, tablename=(
+                          attrs.get("tablename")
+                          or "box_base_%d" % attrs.get("TableId", 0))),
+                      "emb_size")
+    _push_sparse_impl(ctx, {"Ids": ins["Ids"],
+                            "Out@GRAD": ins.get("OutExtend@GRAD", [])},
+                      dict(attrs, tablename=(
+                          (attrs.get("tablename") or "box") + ".extend")),
+                      "emb_extended_size")
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# SelectedRows shard plumbing + remote save/prefetch
+# ---------------------------------------------------------------------------
+
+@register_op("lookup_sparse_table_merge", inputs=("X",), outputs=("Out",),
+             no_grad=True, host=True)
+def _lookup_sparse_table_merge(ctx, ins, attrs):
+    """Merge shard SelectedRows into one
+    (distributed_ops/lookup_sparse_table_merge_op.cc)."""
+    from ..core.selected_rows import SelectedRows
+    import jax.numpy as jnp
+    parts = ins["X"]
+    rows = jnp.concatenate([p.rows for p in parts])
+    vals = jnp.concatenate([p.values for p in parts])
+    return {"Out": [SelectedRows(rows, vals, parts[0].height)]}
+
+
+@register_op("lookup_sparse_table_grad_split", inputs=("Grad",),
+             outputs=("Row", "Value"), no_grad=True, host=True)
+def _lookup_sparse_table_grad_split(ctx, ins, attrs):
+    """Split a SelectedRows grad into (merged rows, values) pair for the
+    sparse push path (lookup_sparse_table_grad_split_op.cc; duplicates
+    merged first when is_entry)."""
+    g = ins["Grad"][0]
+    rows = np.asarray(g.rows)
+    vals = np.asarray(g.values)
+    uniq, inv = np.unique(rows, return_inverse=True)
+    merged = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+    np.add.at(merged, inv, vals)
+    return {"Row": [uniq.astype(np.int64)], "Value": [merged]}
+
+
+@register_op("recv_save", inputs=(), outputs=(), no_grad=True, host=True)
+def _recv_save(ctx, ins, attrs):
+    """Fetch a (possibly sliced) remote parameter and write it straight
+    to disk (distributed_ops/recv_save_op.cc): dense vars gather slices
+    from each endpoint; sparse vars concatenate remote shard rows."""
+    import os
+    eps = list(attrs.get("endpoints", []))
+    varname = attrs.get("varname") or attrs.get("var_name", "")
+    slices = list(attrs.get("slice_varnames", [])) or [varname] * len(eps)
+    path = attrs["file_path"]
+    if os.path.exists(path) and not attrs.get("overwrite", True):
+        raise RuntimeError("recv_save: %r exists and overwrite=False"
+                           % path)
+    parts = []
+    for ep, sl in zip(eps, slices):
+        cli = get_endpoint_client(ep)
+        parts.append(np.asarray(cli.get_param(sl)))
+    full = np.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+    shape = attrs.get("shape")
+    if shape:
+        full = full.reshape(shape)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:  # save-op on-disk format (np.save)
+        np.save(f, full, allow_pickle=False)
+    return {}
+
+
+@register_op("prefetch", inputs=("X",), outputs=("Out",), no_grad=True,
+             host=True)
+def _prefetch(ctx, ins, attrs):
+    """Prefetch remote embedding rows for the given id sections
+    (distributed_ops/prefetch_op.cc): section i of X goes to endpoint i,
+    rows come back in Out order."""
+    eps = list(attrs.get("epmap", []))
+    table = attrs.get("table_name") or attrs.get("tablename", "emb")
+    outs = []
+    for i, ids in enumerate(ins["X"]):
+        ids = np.asarray(ids).reshape(-1)
+        if eps:
+            cli = get_endpoint_client(eps[i % len(eps)])
+            outs.append(np.asarray(cli.pull_sparse(table, ids)))
+        else:
+            kv = _fleet_table({"tablename": table,
+                               "EmbeddingDim":
+                               attrs.get("EmbeddingDim")})
+            outs.append(kv.pull(ids))
+    return {"Out": outs}
+
+
+@register_op("split_byref", inputs=("X",), outputs=("Out",),
+             no_grad=True, host=True)
+def _split_byref(ctx, ins, attrs):
+    """Split along dim 0 into `sections` (split_byref_op.cc — the
+    zero-copy variant the transpiler uses before send; XLA owns layout
+    here so the split is a plain slice)."""
+    x = np.asarray(ins["X"][0])
+    sections = list(attrs.get("sections", []))
+    if sections:
+        idx = np.cumsum(sections)[:-1]
+        return {"Out": list(np.split(x, idx, axis=0))}
+    return {"Out": list(np.split(x, attrs.get("num", 1), axis=0))}
+
+
+@register_op("fl_listen_and_serv", inputs=("X",), outputs=(),
+             no_grad=True, host=True)
+def _fl_listen_and_serv(ctx, ins, attrs):
+    """Federated-learning server loop
+    (distributed_ops/fl_listen_and_serv_op.cc): same RPC surface as
+    listen_and_serv — the FL variant only changes the client-side round
+    policy (trainers aggregate locally, send deltas per round), which
+    the GeoCommunicator delta path provides."""
+    opdef = None
+    from ..core.registry import REGISTRY as _R
+    opdef = _R.get("listen_and_serv")
+    return opdef.lower(ctx, ins, attrs)

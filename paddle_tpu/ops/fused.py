@@ -196,3 +196,140 @@ def _fusion_squared_mat_sub(ctx, ins, attrs):
     return {"SquaredX": [x * x], "SquaredY": [y * y],
             "SquaredXY": [xy * xy],
             "Out": [(xy * xy - x2y2) * scalar]}
+
+
+@register_op("fused_embedding_fc_lstm",
+             inputs=("Ids", "Embeddings", "WeightH", "Bias", "H0", "C0"),
+             outputs=("Hidden", "Cell"),
+             non_diff_inputs=("Ids",))
+def _fused_embedding_fc_lstm(ctx, ins, attrs):
+    """operators/fused/fused_embedding_fc_lstm_op.cc: the embedding
+    lookup IS the x-projection (Embeddings rows are pre-multiplied by
+    WeightX, [V, 4D]), then the LSTM recurrence runs over the gathered
+    projections — gather straight into the scan, no WeightX matmul.
+    is_reverse runs the recurrence back-to-front (time flip in, flip
+    out). Non-default gate/cell/candidate activations are not supported
+    by the shared scan and are rejected loudly rather than silently
+    replaced."""
+    for k, dflt in (("gate_activation", "sigmoid"),
+                    ("cell_activation", "tanh"),
+                    ("candidate_activation", "tanh")):
+        if attrs.get(k, dflt) != dflt:
+            raise NotImplementedError(
+                "fused_embedding_fc_lstm: %s=%r (only the reference "
+                "default %r lowers)" % (k, attrs[k], dflt))
+    from .rnn import _lstm_scan
+    ids = ins["Ids"][0]
+    emb = ins["Embeddings"][0]          # [V, 4D]
+    if ids.shape and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    xp = jnp.take(emb, ids.astype(jnp.int32), axis=0)  # [B, T, 4D]
+    if ins.get("Bias"):
+        xp = xp + ins["Bias"][0].reshape(-1)[None, None, :xp.shape[-1]]
+    wh = ins["WeightH"][0]
+    B = xp.shape[0]
+    D = wh.shape[0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, D), xp.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, D), xp.dtype)
+    reverse = bool(attrs.get("is_reverse", False))
+    if reverse:
+        xp = jnp.flip(xp, axis=1)
+    hs, cs, _, _ = _lstm_scan(xp, h0, c0, wh, None, None)
+    if reverse:
+        hs, cs = jnp.flip(hs, axis=1), jnp.flip(cs, axis=1)
+    return {"Hidden": [hs], "Cell": [cs]}
+
+
+@register_op("fusion_conv_inception",
+             inputs=("Input", "Filter", "Bias"), outputs=("Output",))
+def _fusion_conv_inception(ctx, ins, attrs):
+    """operators/fused/fusion_conv_inception_op.cu: an Inception cell
+    fused into one op. Branch routing: filter[0] consumes 3x3-max-pooled
+    x (the pool branch); every other filter consumes x, EXCEPT that a
+    filter whose in-channels match the previous branch's out-channels
+    instead chains onto that branch (the 1x1→3x3[→3x3] towers). All
+    branch outputs concat on channels; XLA fuses the bias epilogues."""
+    import jax
+    x = ins["Input"][0]
+    filters = ins["Filter"]
+    biases = ins.get("Bias") or [None] * len(filters)
+
+    def conv(src, w, b):
+        pads = [((k - 1) // 2, (k - 1) // 2) for k in w.shape[2:]]
+        dn = jax.lax.conv_dimension_numbers(src.shape, w.shape,
+                                            ("NCHW", "OIHW", "NCHW"))
+        y = jax.lax.conv_general_dilated(src, w, (1, 1), pads,
+                                         dimension_numbers=dn)
+        if b is not None:
+            y = y + b.reshape(1, -1, 1, 1)
+        return y
+
+    pooled = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 1, 3, 3), (1, 1, 1, 1),
+        [(0, 0), (0, 0), (1, 1), (1, 1)])
+    outs = []
+    for i, (w, b) in enumerate(zip(filters, biases)):
+        if i == 0:
+            outs.append(conv(pooled, w, b))
+        elif outs and w.shape[1] == outs[-1].shape[1] != x.shape[1]:
+            outs[-1] = conv(outs[-1], w, b)  # chain onto the tower
+        else:
+            outs.append(conv(x, w, b))
+    return {"Output": [jnp.concatenate(outs, axis=1)]}
+
+
+@register_op("fusion_seqconv_eltadd_relu",
+             inputs=("X", "Filter", "Bias", "SeqLen"),
+             outputs=("Out",), non_diff_inputs=("SeqLen",))
+def _fusion_seqconv_eltadd_relu(ctx, ins, attrs):
+    """operators/fused/fusion_seqconv_eltadd_relu_op.cc:
+    sequence_conv + bias add + relu in one op."""
+    from ..core.registry import REGISTRY as _R
+    sub = {"X": ins["X"], "Filter": ins["Filter"]}
+    if ins.get("SeqLen"):
+        sub["SeqLen"] = ins["SeqLen"]
+    out = _R.get("sequence_conv").lower(ctx, sub, {
+        "contextLength": attrs.get("contextLength", 3),
+        "contextStart": attrs.get("contextStart", -1),
+        "contextStride": attrs.get("contextStride", 1),
+    })["Out"][0]
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    return {"Out": [jnp.maximum(out, 0.0)]}
+
+
+@register_op("fusion_seqexpand_concat_fc",
+             inputs=("X", "FCWeight", "FCBias"), outputs=("Out",))
+def _fusion_seqexpand_concat_fc(ctx, ins, attrs):
+    """operators/fused/fusion_seqexpand_concat_fc_op.cc: X[0] is the
+    time-major reference sequence; X[1:] are per-sequence vectors
+    broadcast (seq_expand) along its steps, all concatenated then
+    pushed through one fc + activation."""
+    xs = ins["X"]
+    ref = xs[0]                       # [B, T, D0]
+    parts = [ref]
+    for x in xs[1:]:
+        if x.ndim == 2:
+            x = x[:, None, :]
+        parts.append(jnp.broadcast_to(
+            x, (ref.shape[0], ref.shape[1], x.shape[-1])))
+    cat = jnp.concatenate(parts, axis=-1)
+    w = ins["FCWeight"][0]
+    out = jnp.einsum("btd,de->bte", cat, w)
+    if ins.get("FCBias"):
+        out = out + ins["FCBias"][0]
+    act = attrs.get("fc_activation", "identity")
+    if act == "relu":
+        out = jnp.maximum(out, 0.0)
+    elif act == "tanh":
+        out = jnp.tanh(out)
+    return {"Out": [out]}
+
+
+@register_op("squared_mat_sub", inputs=("X", "Y"),
+             outputs=("SquaredX", "SquaredY", "SquaredXY", "Out"))
+def _squared_mat_sub(ctx, ins, attrs):
+    """operators/fused/fusion_squared_mat_sub_op.cc's unfused twin —
+    identical contract, delegated so the FM-interaction formula lives in
+    one place."""
+    return _fusion_squared_mat_sub(ctx, ins, attrs)

@@ -92,3 +92,77 @@ def _py_func(ctx, ins, attrs):
     if not isinstance(outs, (list, tuple)):
         outs = [outs]
     return {"Out": [np.asarray(o) for o in outs]}
+
+
+# ---------------------------------------------------------------------------
+# queue + reader ops (operators/reader/ + queue_generator_op.cc,
+# enqueue_op.cc, dequeue_op.cc): the LoDTensorBlockingQueue surface the
+# py_reader/DataLoader feeds through. Queues live in a process-global
+# registry keyed by name, exactly like the reference's VarDesc-held
+# queue holders (reader_op_registry.cc).
+# ---------------------------------------------------------------------------
+import queue as _queue_mod
+
+_QUEUES: dict = {}
+
+
+def get_blocking_queue(name: str, capacity: int = 64):
+    q = _QUEUES.get(name)
+    if q is None:
+        q = _QUEUES[name] = _queue_mod.Queue(maxsize=capacity)
+    return q
+
+
+@register_op("queue_generator", inputs=(), outputs=(), no_grad=True,
+             host=True)
+def _queue_generator(ctx, ins, attrs):
+    """queue_generator_op.cc: create named blocking queues."""
+    for name in attrs.get("names", []):
+        get_blocking_queue(name, int(attrs.get("capacity", 64)))
+    return {}
+
+
+@register_op("enqueue", inputs=("X",), outputs=(), no_grad=True,
+             host=True)
+def _enqueue(ctx, ins, attrs):
+    q = get_blocking_queue(attrs["queue_name"])
+    q.put([np.asarray(x) for x in ins["X"]])
+    return {}
+
+
+@register_op("dequeue", inputs=(), outputs=("Out",), no_grad=True,
+             host=True)
+def _dequeue(ctx, ins, attrs):
+    q = get_blocking_queue(attrs["queue_name"])
+    return {"Out": q.get()}
+
+
+@register_op("create_py_reader", inputs=(), outputs=("Out",),
+             no_grad=True, host=True)
+def _create_py_reader(ctx, ins, attrs):
+    """reader/create_py_reader_op.cc: bind a queue into a reader handle
+    (the handle is just the queue name here — Program vars hold it as a
+    host string value)."""
+    name = attrs.get("queue_name") or attrs.get("name", "py_reader_queue")
+    get_blocking_queue(name, int(attrs.get("capacity", 64)))
+    return {"Out": [name]}
+
+
+@register_op("create_double_buffer_reader", inputs=("UnderlyingReader",),
+             outputs=("Out",), no_grad=True, host=True)
+def _create_double_buffer_reader(ctx, ins, attrs):
+    """reader/create_double_buffer_reader_op.cc: the device prefetch
+    stage. Device staging is the DataLoader's _DevicePrefetcher job in
+    this runtime; the reader handle passes through so read ops chain."""
+    return {"Out": [ins["UnderlyingReader"][0]]}
+
+
+@register_op("read", inputs=("Reader",), outputs=("Out",), no_grad=True,
+             host=True)
+def _read(ctx, ins, attrs):
+    """reader/read_op.cc: pop one batch (list of arrays) from the
+    reader's queue."""
+    name = ins["Reader"][0]
+    q = get_blocking_queue(str(name))
+    batch = q.get()
+    return {"Out": list(batch)}

@@ -438,3 +438,43 @@ def _bilateral_slice(ctx, ins, attrs):
             y = (m * x[b][None]).sum(1)
         outs.append(y)
     return {"Out": [jnp.stack(outs)]}
+
+
+@register_op("depthwise_conv2d_transpose", inputs=("Input", "Filter"),
+             outputs=("Output",))
+def _depthwise_conv2d_transpose(ctx, ins, attrs):
+    """conv2d_transpose with one group per channel
+    (conv_transpose_op.cc registers the depthwise variant over the same
+    GradKernel): weight [C, 1, kh, kw], each channel deconvolved
+    independently via input dilation + feature_group_count."""
+    import jax
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    paddings = attrs.get("paddings", [0, 0])
+    dilations = tuple(attrs.get("dilations", [1, 1]))
+    if isinstance(paddings, int):
+        paddings = [paddings] * 2
+    pads = [(p, p) for p in paddings] if len(paddings) == 2 else \
+        [(paddings[0], paddings[1]), (paddings[2], paddings[3])]
+    c = x.shape[1]
+    wt = jnp.flip(w, axis=(2, 3))  # [C, 1, kh, kw]: O=C, I/g=1
+    dn = jax.lax.conv_dimension_numbers(x.shape, wt.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+    out = jax.lax.conv_general_dilated(
+        x, wt, window_strides=(1, 1),
+        padding=[(d * (k - 1) - p0, d * (k - 1) - p1)
+                 for (p0, p1), k, d in zip(pads, w.shape[2:], dilations)],
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=c)
+    return {"Output": [out]}
+
+
+@register_op("deformable_conv_v1",
+             inputs=("Input", "Offset", "Filter"), outputs=("Output",))
+def _deformable_conv_v1(ctx, ins, attrs):
+    """Deformable conv v1 (operators/deformable_conv_v1_op.cc) — v2
+    without the modulation mask; same sampling kernel."""
+    from ..core.registry import REGISTRY as _R
+    sub = {"Input": ins["Input"], "Offset": ins["Offset"],
+           "Filter": ins["Filter"]}
+    return _R.get("deformable_conv").lower(ctx, sub, attrs)

@@ -307,3 +307,36 @@ def _c_scatter(ctx, ins, attrs):
     # single-controller: emit the full split stack; GSPMD shards it
     return {"Out": [x.reshape((nranks, x.shape[0] // nranks)
                               + x.shape[1:])]}
+
+
+@register_op("allreduce", inputs=("X",), no_grad=True)
+def _allreduce_legacy(ctx, ins, attrs):
+    """Legacy allreduce op (operators/collective/allreduce_op.h):
+    reduce_type attr selects the reduction; rides the same mesh axis as
+    c_allreduce_*."""
+    import jax
+    x = ins["X"][0]
+    axis = attrs.get("axis") or ring_axis(attrs.get("ring_id", 0))
+    red = {0: jax.lax.psum, 1: jax.lax.pmax, 2: jax.lax.pmin}.get(
+        int(attrs.get("reduce_type", 0)), jax.lax.psum)
+    if _in_shard_map(axis):
+        return {"Out": [red(x, axis)]}
+    return {"Out": [x]}
+
+
+@register_op("broadcast", inputs=("X",), no_grad=True)
+def _broadcast_legacy(ctx, ins, attrs):
+    """Legacy broadcast op (operators/collective/broadcast_op.cc) —
+    c_broadcast semantics with the root attr."""
+    from ..core.registry import REGISTRY as _R
+    return _R.get("c_broadcast").lower(ctx, ins, attrs)
+
+
+@register_op("gen_nccl_id", inputs=(), outputs=("NCCLID",), no_grad=True,
+             host=True)
+def _gen_nccl_id(ctx, ins, attrs):
+    """gen_nccl_id_op.cc bootstraps NCCL communicators over RPC; on TPU
+    the rendezvous is jax.distributed's coordinator, so the op returns
+    an opaque token for program-level parity."""
+    import numpy as np
+    return {"NCCLID": [np.zeros((1,), np.int64)]}
