@@ -524,7 +524,8 @@ def dropout_keep_mask(rng, dropout_rate, shape, dtype):
     Held in q's dtype so the HBM cost at bf16 is Sq*Sk*2 bytes per (b,h) —
     the flash kernel still never materializes the score matrix itself.
     """
-    keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, shape)
+    from ..ops.nn import _keep_mask
+    keep = _keep_mask(rng, 1.0 - dropout_rate, shape)
     return keep.astype(dtype)
 
 

@@ -30,6 +30,27 @@ def set_flash_attention(enabled: bool):
 
 _FLASH_MIN_SEQ = 256
 
+# trace-time record of which attention path ACTUALLY lowered (the
+# round-2 postmortem: a bench must never infer the path from config —
+# it reads this log, written at the moment of routing)
+_PATH_LOG = []
+
+
+def reset_attention_path_log():
+    del _PATH_LOG[:]
+
+
+def attention_paths_taken():
+    return list(_PATH_LOG)
+
+
+def routes_to_flash(seq_len: int, head_dim: int) -> bool:
+    """The router's own predicate (kept next to it so they cannot
+    drift): whether _attention_core will attempt the Pallas kernel."""
+    import jax
+    return (_USE_FLASH and jax.default_backend() == "tpu"
+            and seq_len >= _FLASH_MIN_SEQ and head_dim in (64, 128, 256))
+
 
 def _attention_core(q, k, v, attn_mask, dropout_p, training, is_causal=False):
     """q,k,v: [B, S, H, D] raw jax arrays -> [B, S, H, D].
@@ -56,8 +77,7 @@ def _attention_core(q, k, v, attn_mask, dropout_p, training, is_causal=False):
     import jax.numpy as jnp
     scale = 1.0 / math.sqrt(q.shape[-1])
     want_dropout = bool(dropout_p) and training
-    if _USE_FLASH and jax.default_backend() == "tpu" and \
-            q.shape[1] >= _FLASH_MIN_SEQ and q.shape[-1] in (64, 128, 256):
+    if routes_to_flash(q.shape[1], q.shape[-1]):
         try:
             from ..kernels.flash_attention import flash_attention
             rng = tape._state.next_key() if want_dropout else None
@@ -68,6 +88,7 @@ def _attention_core(q, k, v, attn_mask, dropout_p, training, is_causal=False):
                 bias=attn_mask, causal=is_causal, sm_scale=scale,
                 dropout_rate=float(dropout_p) if want_dropout else 0.0,
                 dropout_rng=rng)
+            _PATH_LOG.append("flash")
             return jnp.transpose(out, (0, 2, 1, 3))
         except Exception:
             from .. import flags as _flags
@@ -79,6 +100,7 @@ def _attention_core(q, k, v, attn_mask, dropout_p, training, is_causal=False):
                 "flash_attention failed; composed-attention fallback "
                 "is active (FLAGS_flash_attention_fallback=True)",
                 exc_info=True)
+    _PATH_LOG.append("composed")
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if attn_mask is not None:
         scores = scores + attn_mask
@@ -88,8 +110,9 @@ def _attention_core(q, k, v, attn_mask, dropout_p, training, is_causal=False):
         scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores, axis=-1)
     if want_dropout:
+        from ..ops.nn import _keep_mask
         key = tape._state.next_key()
-        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        keep = _keep_mask(key, 1.0 - dropout_p, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(probs.dtype))
 
@@ -135,6 +158,16 @@ class MultiHeadAttention(Layer):
             # graph, grads flowing back through the slices
             def core(x, wq, wk, wv, bq, bk, bv):
                 b, sq, _ = x.shape
+                # apply_fn bypasses the tape's per-op autocast, so honor
+                # the AMP policy here: without this the fused QKV matmul
+                # AND the flash kernel run fp32 (half MXU rate, double
+                # VMEM traffic)
+                if tape._state.amp_dtype is not None:
+                    from ..core.dtypes import to_jax_dtype
+                    amp_jdt = to_jax_dtype(tape._state.amp_dtype)
+                    x, wq, wk, wv, bq, bk, bv = (
+                        t.astype(amp_jdt)
+                        for t in (x, wq, wk, wv, bq, bk, bv))
                 w = jnp.concatenate([wq, wk, wv], axis=1)
                 bias = jnp.concatenate([bq, bk, bv])
                 qkv = x @ w + bias
