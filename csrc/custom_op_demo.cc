@@ -1,0 +1,94 @@
+// Demo custom-op library for the ptcop_* C ABI
+// (paddle_tpu/custom_op.py load_op_library) — the TPU framework's
+// analog of the reference's tests/custom_op/ relu .so
+// (/root/reference/paddle/fluid/framework/load_op_lib.h consumer).
+//
+// Exports two host ops:
+//   custom_axpby:  Out = alpha * X0 + beta * X1  (attrs alpha, beta)
+//   custom_count_positive: Out = [#elements > 0] as a [1] tensor
+//
+// Build: g++ -O2 -shared -fPIC -o libcustom_op_demo.so custom_op_demo.cc
+
+#include <cstring>
+#include <cstdlib>
+#include <string>
+
+namespace {
+
+constexpr int kMaxRank = 8;
+
+long long numel(const long long* dims, int rank) {
+  long long n = 1;
+  for (int i = 0; i < rank; ++i) n *= dims[i];
+  return n;
+}
+
+// minimal "alpha": 1.5 style lookup inside the attrs json — enough for
+// flat numeric attrs without a json dependency
+double attr_num(const char* attrs_json, const char* key, double dflt) {
+  if (!attrs_json) return dflt;
+  std::string pat = std::string("\"") + key + "\":";
+  const char* p = std::strstr(attrs_json, pat.c_str());
+  if (!p) return dflt;
+  return std::atof(p + pat.size());
+}
+
+}  // namespace
+
+extern "C" {
+
+int ptcop_num_ops(void) { return 2; }
+
+const char* ptcop_op_name(int i) {
+  return i == 0 ? "custom_axpby" : "custom_count_positive";
+}
+
+int ptcop_num_inputs(const char* op) {
+  return std::strcmp(op, "custom_axpby") == 0 ? 2 : 1;
+}
+
+int ptcop_num_outputs(const char*) { return 1; }
+
+int ptcop_infer_shape(const char* op, int n_in, const long long* in_dims,
+                      const int* in_ranks, long long* out_dims,
+                      int* out_ranks, const char*) {
+  if (std::strcmp(op, "custom_axpby") == 0) {
+    if (n_in != 2 || in_ranks[0] != in_ranks[1]) return 1;
+    for (int i = 0; i < in_ranks[0]; ++i) {
+      if (in_dims[i] != in_dims[kMaxRank + i]) return 2;
+      out_dims[i] = in_dims[i];
+    }
+    out_ranks[0] = in_ranks[0];
+    return 0;
+  }
+  if (std::strcmp(op, "custom_count_positive") == 0) {
+    out_ranks[0] = 1;
+    out_dims[0] = 1;
+    return 0;
+  }
+  return 3;
+}
+
+int ptcop_compute(const char* op, int n_in, const float** ins,
+                  const long long* in_dims, const int* in_ranks, int n_out,
+                  float** outs, const char* attrs_json) {
+  if (std::strcmp(op, "custom_axpby") == 0) {
+    if (n_in != 2 || n_out != 1) return 1;
+    const float a = static_cast<float>(attr_num(attrs_json, "alpha", 1.0));
+    const float b = static_cast<float>(attr_num(attrs_json, "beta", 1.0));
+    const long long n = numel(in_dims, in_ranks[0]);
+    for (long long i = 0; i < n; ++i)
+      outs[0][i] = a * ins[0][i] + b * ins[1][i];
+    return 0;
+  }
+  if (std::strcmp(op, "custom_count_positive") == 0) {
+    const long long n = numel(in_dims, in_ranks[0]);
+    long long c = 0;
+    for (long long i = 0; i < n; ++i) c += ins[0][i] > 0.0f;
+    outs[0][0] = static_cast<float>(c);
+    return 0;
+  }
+  return 2;
+}
+
+}  // extern "C"

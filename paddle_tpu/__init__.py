@@ -77,3 +77,4 @@ from . import compiler  # noqa: F401
 from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
                        ExecutionStrategy)
 from . import amp  # noqa: F401
+from .custom_op import load_op_library, load_op_module  # noqa: F401

@@ -1,0 +1,160 @@
+"""User custom-op loading — the load_op_library mechanism.
+
+Analog of the reference's custom-op path
+(/root/reference/paddle/fluid/framework/load_op_lib.h +
+pybind.cc:1654 load_op_library: users compile ops into a .so against
+the framework headers; loading joins them to the global op registry).
+
+TPU-native twins, both joining the same REGISTRY the built-in ops use:
+
+1. **Python custom ops** (`load_op_module`): a module that uses
+   @register_op — the idiomatic path, since a jnp/pallas lowering IS a
+   TPU kernel. This mirrors the reference's intent (user code extends
+   the op set) with the compile step collapsed into XLA.
+
+2. **Native C/C++ custom ops** (`load_op_library`): a .so exporting the
+   `ptcop_*` C ABI below. These register as host ops (executor runs
+   them between jit segments on host buffers) — the analog of the
+   reference's CPU-kernel custom ops. Contract (all functions return 0
+   on success):
+
+       int  ptcop_num_ops(void);
+       const char* ptcop_op_name(int i);
+       int  ptcop_num_inputs(const char* op);
+       int  ptcop_num_outputs(const char* op);
+       // fill out_dims (rank<=8 each) from input shapes
+       int  ptcop_infer_shape(const char* op, int n_in,
+                              const long long* in_dims, const int* in_ranks,
+                              long long* out_dims, int* out_ranks,
+                              const char* attrs_json);
+       // float32 buffers, caller-allocated outputs
+       int  ptcop_compute(const char* op, int n_in, const float** ins,
+                          const long long* in_dims, const int* in_ranks,
+                          int n_out, float** outs, const char* attrs_json);
+
+   in_dims/out_dims use a FIXED stride of 8 slots per tensor: tensor
+   i's dims occupy [i*8, i*8 + rank_i); unused slots are zero. Max
+   rank is 8.
+"""
+from __future__ import annotations
+
+import ctypes
+import importlib
+import importlib.util
+import json
+import os
+from typing import List
+
+import numpy as np
+
+from .core.registry import REGISTRY, register_op
+
+_LOADED_LIBS = {}
+
+_MAX_RANK = 8
+
+
+def load_op_module(module_or_path: str) -> List[str]:
+    """Import a python module of @register_op lowerings; returns the op
+    names it added."""
+    before = set(REGISTRY.names())
+    if os.path.exists(module_or_path):
+        name = "paddle_tpu_custom_%s" % (
+            os.path.basename(module_or_path).rsplit(".", 1)[0])
+        spec = importlib.util.spec_from_file_location(name, module_or_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    else:
+        importlib.import_module(module_or_path)
+    return sorted(set(REGISTRY.names()) - before)
+
+
+def load_op_library(so_path: str) -> List[str]:
+    """Load a ptcop_* .so and register each exported op as a host op;
+    returns the op names added. Idempotent per path."""
+    so_path = os.path.abspath(so_path)
+    if so_path in _LOADED_LIBS:
+        return _LOADED_LIBS[so_path]
+    lib = ctypes.CDLL(so_path)
+    lib.ptcop_num_ops.restype = ctypes.c_int
+    lib.ptcop_op_name.restype = ctypes.c_char_p
+    lib.ptcop_op_name.argtypes = [ctypes.c_int]
+    for f in ("ptcop_num_inputs", "ptcop_num_outputs"):
+        getattr(lib, f).restype = ctypes.c_int
+        getattr(lib, f).argtypes = [ctypes.c_char_p]
+    lib.ptcop_infer_shape.restype = ctypes.c_int
+    lib.ptcop_infer_shape.argtypes = [
+        ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_int),
+        ctypes.c_char_p]
+    lib.ptcop_compute.restype = ctypes.c_int
+    lib.ptcop_compute.argtypes = [
+        ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int, ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_char_p]
+
+    names = [lib.ptcop_op_name(i).decode()
+             for i in range(lib.ptcop_num_ops())]
+    # validate the whole set BEFORE registering any — a duplicate must
+    # not leave partial registrations behind (load_op_lib.h refuses
+    # duplicate custom ops the same way)
+    dups = [n for n in names if REGISTRY.has(n)]
+    if dups:
+        raise ValueError(
+            "load_op_library: ops already registered: %s" % dups)
+    for op_name in names:
+        _register_native_op(lib, op_name)
+    _LOADED_LIBS[so_path] = names
+    return names
+
+
+def _register_native_op(lib, op_name: str):
+    n_in = lib.ptcop_num_inputs(op_name.encode())
+    n_out = lib.ptcop_num_outputs(op_name.encode())
+
+    @register_op(op_name, inputs=("X",), outputs=("Out",), no_grad=True,
+                 host=True)
+    def _custom(ctx, ins, attrs, lib=lib, op_name=op_name, n_in=n_in,
+                n_out=n_out):
+        xs = [np.ascontiguousarray(np.asarray(x), np.float32)
+              for x in ins.get("X", [])]
+        if len(xs) != n_in:
+            raise ValueError("%s expects %d inputs, got %d"
+                             % (op_name, n_in, len(xs)))
+        for x in xs:
+            if x.ndim > _MAX_RANK:
+                raise ValueError(
+                    "%s: input rank %d exceeds the ptcop ABI limit of %d"
+                    % (op_name, x.ndim, _MAX_RANK))
+        attrs_json = json.dumps(
+            {k: v for k, v in attrs.items()
+             if isinstance(v, (int, float, str, bool, list))}).encode()
+        in_dims = (ctypes.c_longlong * (n_in * _MAX_RANK))(
+            *[d for x in xs
+              for d in (list(x.shape) + [0] * (_MAX_RANK - x.ndim))])
+        in_ranks = (ctypes.c_int * n_in)(*[x.ndim for x in xs])
+        out_dims = (ctypes.c_longlong * (n_out * _MAX_RANK))()
+        out_ranks = (ctypes.c_int * n_out)()
+        rc = lib.ptcop_infer_shape(op_name.encode(), n_in, in_dims,
+                                   in_ranks, out_dims, out_ranks,
+                                   attrs_json)
+        if rc != 0:
+            raise RuntimeError("%s: infer_shape failed rc=%d"
+                               % (op_name, rc))
+        outs = [np.empty([out_dims[j * _MAX_RANK + k]
+                          for k in range(out_ranks[j])], np.float32)
+                for j in range(n_out)]
+        in_ptrs = (ctypes.POINTER(ctypes.c_float) * n_in)(
+            *[x.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for x in xs])
+        out_ptrs = (ctypes.POINTER(ctypes.c_float) * n_out)(
+            *[o.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for o in outs])
+        rc = lib.ptcop_compute(op_name.encode(), n_in, in_ptrs, in_dims,
+                               in_ranks, n_out, out_ptrs, attrs_json)
+        if rc != 0:
+            raise RuntimeError("%s: compute failed rc=%d" % (op_name, rc))
+        return {"Out": outs}

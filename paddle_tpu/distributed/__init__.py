@@ -5,3 +5,4 @@ from .communicator import (Communicator, AsyncCommunicator,  # noqa: F401
                            ParamServer, SyncCommunicator)
 from .ps_worker import DownpourWorker, HeterWorker  # noqa: F401
 from .multi_trainer import MultiTrainer, train_from_dataset  # noqa: F401
+from .trainer_factory import TrainerDesc, TrainerFactory  # noqa: F401

@@ -23,7 +23,42 @@ from .core.scope import Scope
 from . import io as _io
 
 __all__ = ["Config", "AnalysisConfig", "Predictor", "create_predictor",
-           "PredictorTensor"]
+           "PredictorTensor", "PassStrategy", "TpuPassStrategy"]
+
+
+class PassStrategy:
+    """Ordered, editable pass pipeline — the paddle_pass_builder analog
+    (inference/api/paddle_pass_builder.cc: PaddlePassBuilder
+    AppendPass/DeletePass/TurnOnMKLDNN...). Passes are names in the
+    framework pass registry (core/passes.py); the Predictor applies them
+    in order before tracing."""
+
+    def __init__(self, passes: Optional[List[str]] = None):
+        self._passes = list(passes or [])
+
+    def append_pass(self, name: str):
+        self._passes.append(name)
+
+    def insert_pass(self, idx: int, name: str):
+        self._passes.insert(idx, name)
+
+    def delete_pass(self, name: str):
+        self._passes = [p for p in self._passes if p != name]
+
+    def passes(self) -> List[str]:
+        return list(self._passes)
+
+
+class TpuPassStrategy(PassStrategy):
+    """Default TPU pipeline. The reference GPU order
+    (paddle_pass_builder.cc:104: is_test -> conv/bn + attention +
+    fc fusions -> runtime cache) keeps only its SEMANTIC members here —
+    eval-mode cleanup and the fusion markers — because XLA performs the
+    instruction-level fusions (conv+bias+act, fc, attention epilogues)
+    during compilation."""
+
+    def __init__(self):
+        super().__init__(["drop_dropout_eval", "fuse_elewise_add_act"])
 
 
 class Config:
@@ -39,6 +74,7 @@ class Config:
         self.params_file = params_file
         self._ir_optim = True
         self._bf16 = False
+        self._pass_builder: Optional[PassStrategy] = None
 
     # parity knobs (no-ops or simple flags)
     def disable_gpu(self):
@@ -55,6 +91,13 @@ class Config:
 
     def enable_bf16(self):
         self._bf16 = True
+
+    def pass_builder(self) -> PassStrategy:
+        """AnalysisConfig::pass_builder(): the editable pipeline; created
+        on first access with the TPU default strategy."""
+        if self._pass_builder is None:
+            self._pass_builder = TpuPassStrategy()
+        return self._pass_builder
 
 
 AnalysisConfig = Config
@@ -93,6 +136,10 @@ class Predictor:
                 model_filename=config.prog_file,
                 params_filename=config.params_file,
                 scope=self.scope)
+        if config._ir_optim:
+            from .core.passes import apply_pass
+            for name in config.pass_builder().passes():
+                self.program = apply_pass(self.program, name)
         if config._bf16:
             self._cast_params_bf16()
         self._feeds: Dict[str, np.ndarray] = {}

@@ -171,3 +171,34 @@ def test_auto_checkpoint_resume(tmp_path, monkeypatch):
         assert seen2 == []
         np.testing.assert_allclose(
             np.asarray(pt.global_scope().find_var(wname)), w_done)
+
+
+def test_predictor_pass_builder(tmp_path):
+    """PassStrategy pipeline runs before trace (paddle_pass_builder
+    analog): dropout ops must be rewritten out of the loaded program."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.inference import Config, create_predictor
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        h = layers.fc(x, size=8, act="relu")
+        h = layers.dropout(h, dropout_prob=0.5)
+        out = layers.fc(h, size=2)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        pt.io.save_inference_model(str(tmp_path / "m"), ["x"], [out],
+                                   exe, main_program=main, scope=scope)
+    cfg = Config(str(tmp_path / "m"))
+    assert "drop_dropout_eval" in cfg.pass_builder().passes()
+    cfg.pass_builder().delete_pass("fuse_elewise_add_act")
+    pred = create_predictor(cfg)
+    assert not any(op.type == "dropout"
+                   for op in pred.program.global_block.ops)
+    ih = pred.get_input_handle("x")
+    ih.copy_from_cpu(np.ones((3, 4), np.float32))
+    pred.run()
+    got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    assert got.shape == (3, 2)

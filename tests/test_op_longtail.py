@@ -339,3 +339,24 @@ def test_fused_embedding_fc_lstm_reverse():
     np.testing.assert_allclose(
         np.asarray(o["Hidden"][0]),
         np.asarray(ref["Hidden"][0])[:, ::-1], rtol=1e-5, atol=1e-5)
+
+
+def test_trainer_factory_selection():
+    from paddle_tpu.distributed.trainer_factory import (
+        TrainerFactory, MultiTrainer, DistMultiTrainer, DownpourSGD)
+    f = TrainerFactory()
+    t = f._create_trainer()
+    assert isinstance(t, MultiTrainer)
+    assert t.to_dict()["device_worker"]["device_worker_name"] == "Hogwild"
+    t2 = f._create_trainer({"trainer": "DistMultiTrainer",
+                            "device_worker": "DownpourSGD",
+                            "thread_num": 3, "dump_slot": True,
+                            "mpi_rank": 1, "mpi_size": 4})
+    assert isinstance(t2, DistMultiTrainer)
+    d = t2.to_dict()
+    assert d["thread_num"] == 3 and d["dump_slot"] and d["mpi_rank"] == 1
+    # fan-out actually runs batches through workers
+    out = t2.run(range(10), lambda b: b * 2)
+    assert sorted(out) == [i * 2 for i in range(10)]
+    with pytest.raises(ValueError):
+        f._create_trainer({"trainer": "NoSuch", "device_worker": "Hogwild"})
