@@ -229,6 +229,7 @@ class Executor:
         self._cache: Dict[tuple, Any] = {}
         self._seed_counter = 0
         self._warned_uneven: set = set()
+        self._unused_checked: set = set()
 
     # ------------------------------------------------------------------
     def run(self, program: Optional[Program] = None,
@@ -294,6 +295,8 @@ class Executor:
                tuple(fetch_names), tuple(state_names))
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
+            from ..monitor import stat_add
+            stat_add("STAT_executor_compile")
             entry = self._compile(program, block, sorted(feed), fetch_names,
                                   state_names)
             if use_program_cache:
@@ -309,14 +312,61 @@ class Executor:
                 seed = self._seed_counter
             rng = jax.random.PRNGKey(seed)
 
+        from ..flags import get_flag
+        if get_flag("FLAGS_enable_unused_var_check"):
+            self._warn_unused_vars(program, fetch_names)
+
         fetches, new_state, new_rng = fn(state, feed, rng)
         for n, v in new_state.items():
             scope.set(n, v)
         scope.set(RNG_VAR, new_rng)
 
+        if get_flag("FLAGS_fast_check_nan_inf") and \
+                not get_flag("check_nan_inf"):
+            # FLAGS_fast_check_nan_inf (operator.cc:1037): instead of the
+            # per-op traced scan, only the fetched values are checked —
+            # one cheap host-side pass after the step (converted once,
+            # reused for the numpy return below)
+            from .enforce import EnforceNotMet
+            fetches = [np.asarray(v) for v in fetches]
+            for name, arr in zip(fetch_names, fetches):
+                if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                    raise EnforceNotMet(
+                        "fast_check_nan_inf: fetch %r contains "
+                        "nan/inf" % name)
+
         if return_numpy:
             fetches = [np.asarray(v) for v in fetches]
         return fetches
+
+    def _warn_unused_vars(self, program: Program, fetch_names):
+        """FLAGS_enable_unused_var_check (unused_var_check.cc): warn once
+        per program about vars an op produced that nothing consumes —
+        usually a graph-construction bug."""
+        pid = (id(program), program._version)
+        if pid in self._unused_checked:
+            return
+        self._unused_checked.add(pid)
+        consumed = set(fetch_names)
+        produced = {}
+        for blk in program.blocks:
+            for op in blk.ops:
+                for ns in op.inputs.values():
+                    consumed.update(ns)
+                for ns in op.outputs.values():
+                    for n in ns:
+                        produced.setdefault(n, op.type)
+        block = program.global_block
+        unused = sorted(
+            n for n, op_type in produced.items()
+            if n not in consumed
+            and not (n in block.vars and block.vars[n].persistable))
+        if unused:
+            import logging
+            logging.getLogger("paddle_tpu").warning(
+                "unused_var_check: vars produced but never consumed: %s",
+                ", ".join("%s (by %s)" % (n, produced[n])
+                          for n in unused[:20]))
 
     # ------------------------------------------------------------------
     def _state_names(self, program: Program, scope: Scope) -> List[str]:

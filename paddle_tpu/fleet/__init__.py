@@ -11,6 +11,7 @@ from typing import Optional
 
 from .distributed_strategy import DistributedStrategy
 from .fs import FS, HDFSClient, LocalFS, fs_for_path  # noqa: F401
+from . import metrics  # noqa: F401
 from .role_maker import (PaddleCloudRoleMaker, Role, RoleMakerBase,
                          UserDefinedRoleMaker)
 from . import meta_optimizers

@@ -1,0 +1,105 @@
+"""Fleet distributed metrics — cross-worker metric aggregation.
+
+Analog of /root/reference/python/paddle/distributed/fleet/metrics/
+metric.py (sum:23, max:62, min:101, auc:140, mae:223, rmse:261,
+mse:299, acc:337 — each all-reduces worker-local statistics over the
+trainer comm world before the final formula).
+
+The reference aggregates over MPI/Gloo; these are HOST-level helpers
+the same way (call them on fetched numpy statistics). When the
+parallel env has an initialized mesh ring, aggregation goes through
+the collective module's host all-reduce; with no distributed context
+the local value IS the global value (single-trainer fleet). For PS
+runs aggregating over a transport instead of the mesh, pass
+`reduce_fn(value, op) -> value`.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+__all__ = ["sum", "max", "min", "acc", "mae", "mse", "rmse", "auc"]
+
+
+def _default_reduce(value: np.ndarray, op: str) -> np.ndarray:
+    """All-reduce over the mesh ring when one is initialized; identity
+    in local runs."""
+    from ..parallel.collective import all_reduce, ring_axis
+    try:
+        axis = ring_axis(0)
+    except Exception:  # no parallel env initialized
+        return value
+    if axis is None:
+        return value
+    return np.asarray(all_reduce(value, op=op, axis=axis))
+
+
+def _agg(input, op: str, reduce_fn: Optional[Callable]) -> np.ndarray:
+    if hasattr(input, "aval") and not hasattr(input, "addressable_data"):
+        raise TypeError(
+            "fleet.metrics aggregates HOST statistics (fetched numpy "
+            "values); inside a traced section use "
+            "parallel.collective.all_reduce directly")
+    val = np.asarray(input, np.float64)
+    if reduce_fn is not None:
+        return np.asarray(reduce_fn(val, op))
+    return np.asarray(_default_reduce(val, op))
+
+
+def sum(input, scope=None, reduce_fn: Optional[Callable] = None):  # noqa: A001
+    """fleet.metrics.sum: global sum of a worker-local statistic."""
+    return _agg(input, "sum", reduce_fn)
+
+
+def max(input, scope=None, reduce_fn: Optional[Callable] = None):  # noqa: A001
+    return _agg(input, "max", reduce_fn)
+
+
+def min(input, scope=None, reduce_fn: Optional[Callable] = None):  # noqa: A001
+    return _agg(input, "min", reduce_fn)
+
+
+def acc(correct, total, scope=None, reduce_fn=None):
+    """Global accuracy = sum(correct) / sum(total) (metric.py:337)."""
+    c = _agg(correct, "sum", reduce_fn)
+    t = _agg(total, "sum", reduce_fn)
+    return float(np.sum(c) / np.maximum(np.sum(t), 1e-12))
+
+
+def mae(abserr, total_ins_num, scope=None, reduce_fn=None):
+    a = _agg(abserr, "sum", reduce_fn)
+    t = _agg(total_ins_num, "sum", reduce_fn)
+    return float(np.sum(a) / np.maximum(np.sum(t), 1e-12))
+
+
+def mse(sqrerr, total_ins_num, scope=None, reduce_fn=None):
+    s = _agg(sqrerr, "sum", reduce_fn)
+    t = _agg(total_ins_num, "sum", reduce_fn)
+    return float(np.sum(s) / np.maximum(np.sum(t), 1e-12))
+
+
+def rmse(sqrerr, total_ins_num, scope=None, reduce_fn=None):
+    import math
+    return math.sqrt(mse(sqrerr, total_ins_num, scope, reduce_fn))
+
+
+def auc(stat_pos, stat_neg, scope=None, reduce_fn=None):
+    """Global AUC from per-worker positive/negative prediction
+    histograms (metric.py:140: allreduce both histograms, then one
+    trapezoid sweep)."""
+    pos = np.asarray(_agg(stat_pos, "sum", reduce_fn), np.float64).ravel()
+    neg = np.asarray(_agg(stat_neg, "sum", reduce_fn), np.float64).ravel()
+    # sweep thresholds high->low accumulating tp/fp (same recurrence as
+    # the reference's loop)
+    tot_pos = new_pos = 0.0
+    tot_neg = new_neg = 0.0
+    area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[i]
+        new_neg = tot_neg + neg[i]
+        area += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+        tot_pos, tot_neg = new_pos, new_neg
+    if tot_pos <= 0 or tot_neg <= 0:
+        return 0.5
+    return float(area / (tot_pos * tot_neg))

@@ -1,0 +1,46 @@
+"""Runtime stats registry — the platform monitor analog.
+
+Analog of /root/reference/paddle/fluid/platform/monitor.{h,cc} (the
+STAT_ADD/STAT_RESET int64 registry) exposed to python as
+get_float_stats/get_int_stats (pybind.cc:1664 get_float_stats). Stats
+are named counters any subsystem bumps (executor compiles, host-op
+dispatches, bytes fed); thread-safe, process-global.
+
+    from paddle_tpu.monitor import stat_add, get_float_stats
+    stat_add("STAT_executor_compile", 1)
+    get_float_stats()  # {"STAT_executor_compile": 1.0, ...}
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_LOCK = threading.Lock()
+_STATS: Dict[str, float] = {}
+
+
+def stat_add(name: str, value: float = 1.0) -> None:
+    with _LOCK:
+        _STATS[name] = _STATS.get(name, 0.0) + float(value)
+
+
+def stat_reset(name: str, value: float = 0.0) -> None:
+    with _LOCK:
+        _STATS[name] = float(value)
+
+
+def stat_get(name: str) -> float:
+    with _LOCK:
+        return _STATS.get(name, 0.0)
+
+
+def get_float_stats() -> Dict[str, float]:
+    """pybind.cc:1664 get_float_stats: snapshot of every registered
+    stat."""
+    with _LOCK:
+        return dict(_STATS)
+
+
+def get_int_stats() -> Dict[str, int]:
+    with _LOCK:
+        return {k: int(v) for k, v in _STATS.items()}

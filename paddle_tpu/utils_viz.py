@@ -1,0 +1,64 @@
+"""Program visualization — the debugger/graphviz analog.
+
+Analog of /root/reference/python/paddle/fluid/debugger.py (draw_block_graphviz)
++ tools' graphviz.py and the ir/graph_viz_pass: renders a Program block
+as DOT text (ops as boxes, vars as ellipses, parameters shaded) for
+chrome/graphviz inspection. Pure text — no graphviz binary needed to
+generate; `dot -Tpng` renders it wherever available.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .core.program import Program
+
+
+def _esc(s: str) -> str:
+    return s.replace('"', '\\"')
+
+
+def program_to_dot(program: Program, block_idx: int = 0,
+                   title: Optional[str] = None,
+                   max_vars_per_op: int = 8) -> str:
+    """DOT source for one block (debugger.py draw_block_graphviz)."""
+    block = program.blocks[block_idx]
+    lines = ["digraph Program {",
+             '  rankdir=TB; node [fontsize=10];']
+    if title:
+        lines.append('  label="%s"; labelloc=t;' % _esc(title))
+    emitted_vars = set()
+
+    def var_node(name: str) -> str:
+        nid = "var_" + name.replace(".", "_").replace("@", "_AT_")
+        if name not in emitted_vars:
+            emitted_vars.add(name)
+            v = block.vars.get(name)
+            if v is not None and v.persistable:
+                style = 'shape=ellipse style=filled fillcolor=lightblue'
+            else:
+                style = 'shape=ellipse'
+            shape = "" if v is None or v.shape is None else \
+                "\\n%s" % (tuple(v.shape),)
+            lines.append('  %s [%s label="%s%s"];'
+                         % (nid, style, _esc(name), shape))
+        return nid
+
+    for i, op in enumerate(block.ops):
+        op_id = "op_%d" % i
+        lines.append('  %s [shape=box style=filled fillcolor=gold '
+                     'label="%s"];' % (op_id, _esc(op.type)))
+        for names in op.inputs.values():
+            for n in names[:max_vars_per_op]:
+                lines.append("  %s -> %s;" % (var_node(n), op_id))
+        for names in op.outputs.values():
+            for n in names[:max_vars_per_op]:
+                lines.append("  %s -> %s;" % (op_id, var_node(n)))
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def save_program_dot(program: Program, path: str, **kw) -> str:
+    dot = program_to_dot(program, **kw)
+    with open(path, "w") as f:
+        f.write(dot)
+    return path

@@ -102,3 +102,84 @@ def test_op_bench_harness():
     g = bench_op("matmul", {"X": (8, 16), "Y": (16, 4)}, repeat=3,
                  warmup=1, grad=True)
     assert g["mean_us"] > 0
+
+
+def test_monitor_stats():
+    """platform/monitor STAT registry analog (pybind get_float_stats)."""
+    import paddle_tpu as pt
+    from paddle_tpu.monitor import (get_float_stats, get_int_stats,
+                                    stat_add, stat_get, stat_reset)
+    stat_reset("STAT_test_counter")
+    stat_add("STAT_test_counter", 2)
+    stat_add("STAT_test_counter")
+    assert stat_get("STAT_test_counter") == 3.0
+    assert get_int_stats()["STAT_test_counter"] == 3
+    # executor compiles bump the stat
+    before = stat_get("STAT_executor_compile")
+    main = pt.Program()
+    main.global_block.create_var("z", shape=[2], dtype="float32")
+    main.global_block.append_op("fill_constant", {}, {"Out": ["z"]},
+                                {"shape": [2], "value": 1.0,
+                                 "dtype": "float32"})
+    pt.Executor().run(main, feed={}, fetch_list=["z"])
+    assert stat_get("STAT_executor_compile") >= before + 1
+
+
+def test_fast_check_nan_inf_and_unused_var(caplog):
+    import logging
+    import numpy as np
+    import pytest
+    import paddle_tpu as pt
+    from paddle_tpu.core.enforce import EnforceNotMet
+    main = pt.Program()
+    blk = main.global_block
+    blk.create_var("x", shape=[2], dtype="float32")
+    blk.create_var("y", shape=[2], dtype="float32")
+    blk.create_var("dead", shape=[2], dtype="float32")
+    blk.append_op("scale", {"X": ["x"]}, {"Out": ["y"]},
+                  {"scale": 1.0, "bias": 0.0})
+    blk.append_op("scale", {"X": ["x"]}, {"Out": ["dead"]},
+                  {"scale": 2.0, "bias": 0.0})
+    exe = pt.Executor()
+    pt.set_flags({"FLAGS_fast_check_nan_inf": True,
+                  "FLAGS_enable_unused_var_check": True})
+    try:
+        with caplog.at_level(logging.WARNING, logger="paddle_tpu"):
+            out, = exe.run(main, feed={"x": np.ones(2, np.float32)},
+                           fetch_list=["y"])
+        assert any("dead" in r.message for r in caplog.records)
+        with pytest.raises(EnforceNotMet, match="nan/inf"):
+            exe.run(main, feed={"x": np.asarray([np.inf, 1.0],
+                                                np.float32)},
+                    fetch_list=["y"])
+    finally:
+        pt.set_flags({"FLAGS_fast_check_nan_inf": False,
+                      "FLAGS_enable_unused_var_check": False})
+
+
+def test_program_to_dot():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.utils_viz import program_to_dot
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.fc(x, size=2)
+    dot = program_to_dot(main, title="net")
+    assert dot.startswith("digraph")
+    assert "mul" in dot or "matmul" in dot
+    assert "lightblue" in dot  # parameters shaded
+
+
+def test_fleet_metrics_local():
+    import numpy as np
+    from paddle_tpu.fleet import metrics as fm
+    assert fm.acc(np.asarray(3.0), np.asarray(4.0)) == 0.75
+    assert fm.mae(np.asarray(2.0), np.asarray(4.0)) == 0.5
+    assert abs(fm.rmse(np.asarray(8.0), np.asarray(2.0)) - 2.0) < 1e-9
+    # AUC oracle: perfect separation -> 1.0; random histograms -> 0.5ish
+    pos = np.zeros(10); neg = np.zeros(10)
+    pos[9] = 100; neg[0] = 100
+    assert fm.auc(pos, neg) == 1.0
+    pos2 = np.ones(10); neg2 = np.ones(10)
+    assert abs(fm.auc(pos2, neg2) - 0.5) < 1e-6
