@@ -78,3 +78,20 @@ from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
                        ExecutionStrategy)
 from . import amp  # noqa: F401
 from .custom_op import load_op_library, load_op_module  # noqa: F401
+from . import static  # noqa: F401
+from . import tensor  # noqa: F401
+from .tensor import (zeros, ones, full, zeros_like, ones_like,  # noqa: F401
+                     full_like, arange, linspace, eye, concat, split,
+                     stack, unstack, reshape, transpose, squeeze,
+                     unsqueeze, gather, gather_nd, scatter, flip, roll,
+                     tile, expand, expand_as, cast, flatten, unique,
+                     chunk, add, subtract, multiply, divide, pow,
+                     maximum, minimum, abs, exp, log, sqrt, square,
+                     clip, matmul, bmm, dot, cross, norm, tril, triu,
+                     equal, not_equal, greater_than, greater_equal,
+                     less_than, less_equal, logical_and, logical_or,
+                     logical_not, isfinite, isnan, allclose, rand,
+                     randn, randint, randperm, uniform, normal, argmax,
+                     argmin, argsort, sort, topk, where, index_select,
+                     masked_select, nonzero, cumsum, kron, numel)
+from .dygraph.tape import no_grad  # noqa: F401

@@ -325,15 +325,18 @@ class Executor:
                 not get_flag("check_nan_inf"):
             # FLAGS_fast_check_nan_inf (operator.cc:1037): instead of the
             # per-op traced scan, only the fetched values are checked —
-            # one cheap host-side pass after the step (converted once,
-            # reused for the numpy return below)
+            # one cheap host-side pass after the step. The host copies
+            # replace `fetches` only under return_numpy, so the flag
+            # never changes the caller's on-device return type.
             from .enforce import EnforceNotMet
-            fetches = [np.asarray(v) for v in fetches]
-            for name, arr in zip(fetch_names, fetches):
+            host = [np.asarray(v) for v in fetches]
+            for name, arr in zip(fetch_names, host):
                 if arr.dtype.kind == "f" and not np.isfinite(arr).all():
                     raise EnforceNotMet(
                         "fast_check_nan_inf: fetch %r contains "
                         "nan/inf" % name)
+            if return_numpy:
+                return host
 
         if return_numpy:
             fetches = [np.asarray(v) for v in fetches]

@@ -73,7 +73,11 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         data = _collect(program, scope, predicate)
     path = os.path.join(dirname, filename or "__params__.npz")
     os.makedirs(dirname, exist_ok=True)
-    np.savez(path, **data)
+    # write through a file object: np.savez(path) silently appends
+    # ".npz" to names without that suffix, breaking round-trips for
+    # reference-style filenames like "model.pdparams"
+    with open(path, "wb") as f:
+        np.savez(f, **data)
     return sorted(data)
 
 

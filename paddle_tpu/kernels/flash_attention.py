@@ -531,7 +531,7 @@ def dropout_keep_mask(rng, dropout_rate, shape, dtype):
 
 def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
                     causal: bool = False, sm_scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 512,
                     dropout_rate: float = 0.0,
                     dropout_rng: Optional[jax.Array] = None):
     """Fused attention. q,k,v: [B,H,S,D]; bias broadcastable to

@@ -1,0 +1,64 @@
+"""The v2 static-graph namespace — paddle.static parity.
+
+Analog of /root/reference/python/paddle/static (re-exports of the
+fluid static-graph surface under the v2 name: Program/program_guard,
+Executor/scope, data/InputSpec, save/load, CompiledProgram/strategies,
+append_backward/gradients, and static.nn layers).
+"""
+from __future__ import annotations
+
+from ..core.program import (Program, default_main_program,  # noqa: F401
+                            default_startup_program, program_guard)
+from ..core.executor import Executor  # noqa: F401
+from ..core.scope import Scope, global_scope, scope_guard  # noqa: F401
+from ..core.backward import append_backward, gradients  # noqa: F401
+from ..compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
+                        ExecutionStrategy)
+from ..io import (load_inference_model, load_persistables,  # noqa: F401
+                  save_inference_model, save_persistables, load_vars,
+                  save_vars)
+from ..layers import data  # noqa: F401
+from .. import layers as nn  # noqa: F401  (static.nn layer builders)
+
+
+class InputSpec:
+    """paddle.static.InputSpec (v2 signature descriptor used by
+    to_static / hapi Model): shape with None/-1 dynamic dims, dtype,
+    name."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return ("InputSpec(shape=%s, dtype=%r, name=%r)"
+                % (list(self.shape), self.dtype, self.name))
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        import numpy as np
+        val = tensor.value if hasattr(tensor, "value") else tensor
+        arr = np.asarray(val)
+        return cls(arr.shape, str(arr.dtype), name)
+
+
+def save(program: Program, model_path: str):
+    """paddle.static.save: program + persistables to <path>.pd*"""
+    import json
+    import os
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    with open(model_path + ".pdmodel", "w") as f:
+        f.write(json.dumps(program.to_dict()))
+    save_persistables(Executor(), os.path.dirname(model_path) or ".",
+                      main_program=program,
+                      filename=os.path.basename(model_path) + ".pdparams")
+
+
+def load(program: Program, model_path: str, executor=None):
+    """paddle.static.load: restore persistables saved by save()."""
+    import os
+    load_persistables(executor or Executor(),
+                      os.path.dirname(model_path) or ".",
+                      main_program=program,
+                      filename=os.path.basename(model_path) + ".pdparams")

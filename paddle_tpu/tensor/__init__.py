@@ -1,0 +1,591 @@
+"""The v2 tensor-op namespace — paddle.tensor parity.
+
+Analog of /root/reference/python/paddle/tensor/ (creation.py, linalg.py,
+logic.py, manipulation.py, math.py, random.py, search.py, stat.py —
+re-exported at the paddle top level). Every function is dual-mode via
+the nn.functional dispatch: eager -> tape.run_op, static -> append_op
+on the default program. Each wraps an already-registered op lowering,
+so the namespace adds API surface, not new kernels.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core import dtypes as _dtypes
+from ..core.program import in_dygraph_mode
+from ..nn.functional import _run, _run_multi
+
+__all__ = [
+    # creation
+    "zeros", "ones", "full", "zeros_like", "ones_like", "full_like",
+    "arange", "linspace", "eye", "diag", "assign", "empty", "empty_like",
+    # manipulation
+    "concat", "split", "stack", "unstack", "reshape", "transpose",
+    "squeeze", "unsqueeze", "slice", "strided_slice", "gather",
+    "gather_nd", "scatter", "scatter_nd_add", "flip", "roll", "tile",
+    "expand", "expand_as", "cast", "flatten", "unique", "chunk",
+    # math
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "pow", "maximum", "minimum", "abs", "exp", "log", "sqrt", "square",
+    "clip", "sum", "mean", "max", "min", "prod", "cumsum", "increment",
+    "sign", "floor", "ceil", "round", "reciprocal", "kron",
+    # linalg
+    "matmul", "bmm", "dot", "cross", "norm", "tril", "triu", "t",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_not",
+    "logical_xor", "isfinite", "isnan", "allclose",
+    # random
+    "rand", "randn", "randint", "randperm", "uniform", "normal",
+    # search
+    "argmax", "argmin", "argsort", "sort", "topk", "where",
+    "index_select", "masked_select", "index_sample", "nonzero",
+    # stat
+    "std", "var", "numel", "shape",
+]
+
+
+def _dt(dtype):
+    return _dtypes.convert_dtype(dtype or "float32")
+
+
+# --------------------------------------------------------------------------
+# creation (tensor/creation.py)
+# --------------------------------------------------------------------------
+
+def full(shape, fill_value, dtype=None, name=None):
+    return _run("fill_constant", {},
+                {"shape": list(shape), "value": float(fill_value),
+                 "dtype": _dt(dtype)})
+
+
+def zeros(shape, dtype=None, name=None):
+    return full(shape, 0.0, dtype)
+
+
+def ones(shape, dtype=None, name=None):
+    return full(shape, 1.0, dtype)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    a = {"value": float(fill_value)}
+    if dtype is not None:
+        a["dtype"] = _dt(dtype)
+    return _run("fill_any_like", {"X": [x]}, a)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return full_like(x, 0.0, dtype)
+
+
+def ones_like(x, dtype=None, name=None):
+    return full_like(x, 1.0, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    if dtype is None:  # float args infer a float range (paddle.arange)
+        dtype = "float32" if any(isinstance(v, float)
+                                 for v in (start, end, step)) else "int64"
+    return _run("arange", {},
+                {"start": start, "end": end, "step": step,
+                 "dtype": _dt(dtype)})
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return _run("linspace", {},
+                {"start": float(start), "stop": float(stop),
+                 "num": int(num), "dtype": _dt(dtype)})
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return _run("eye", {},
+                {"num_rows": int(num_rows),
+                 "num_columns": int(num_columns or num_rows),
+                 "dtype": _dt(dtype)})
+
+
+def diag(x, offset=0, name=None):
+    return _run("diag_v2", {"X": [x]}, {"offset": int(offset)})
+
+
+def assign(x, output=None):
+    return _run("assign", {"X": [x]}, {})
+
+
+def empty(shape, dtype=None, name=None):
+    return _run("empty", {}, {"shape": list(shape), "dtype": _dt(dtype)})
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+# --------------------------------------------------------------------------
+# manipulation (tensor/manipulation.py)
+# --------------------------------------------------------------------------
+
+def concat(x, axis=0, name=None):
+    return _run("concat", {"X": list(x)}, {"axis": int(axis)})
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        attrs = {"num": n, "axis": int(axis)}
+    else:
+        n = len(num_or_sections)
+        attrs = {"sections": list(num_or_sections), "axis": int(axis)}
+    if in_dygraph_mode():
+        from ..dygraph import tape
+        return tape.run_op("split", {"X": [x]}, attrs,
+                           n_outs={"Out": n})["Out"]
+    from ..layers.helper import LayerHelper
+    helper = LayerHelper("split")
+    outs = [helper.create_tmp_variable() for _ in range(n)]
+    helper.append_op("split", inputs={"X": [x.name]},
+                     outputs={"Out": [o.name for o in outs]}, attrs=attrs)
+    return outs
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def stack(x, axis=0, name=None):
+    return _run("stack", {"X": list(x)}, {"axis": int(axis)}, "Y")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num if num is not None else x.shape[axis]
+    attrs = {"axis": int(axis), "num": int(n)}
+    if in_dygraph_mode():
+        from ..dygraph import tape
+        return tape.run_op("unstack", {"X": [x]}, attrs,
+                           n_outs={"Y": int(n)})["Y"]
+    from ..layers.helper import LayerHelper
+    helper = LayerHelper("unstack")
+    outs = [helper.create_tmp_variable() for _ in range(int(n))]
+    helper.append_op("unstack", inputs={"X": [x.name]},
+                     outputs={"Y": [o.name for o in outs]}, attrs=attrs)
+    return outs
+
+
+def reshape(x, shape, name=None):
+    return _run("reshape2", {"X": [x]}, {"shape": list(shape)})
+
+
+def transpose(x, perm, name=None):
+    return _run("transpose2", {"X": [x]}, {"axis": list(perm)})
+
+
+def t(x, name=None):
+    nd = len(x.shape)
+    if nd < 2:
+        return assign(x)
+    return transpose(x, list(range(nd - 2)) + [nd - 1, nd - 2])
+
+
+def squeeze(x, axis=None, name=None):
+    axes = [] if axis is None else \
+        (list(axis) if isinstance(axis, (list, tuple)) else [axis])
+    return _run("squeeze2", {"X": [x]}, {"axes": axes})
+
+
+def unsqueeze(x, axis, name=None):
+    axes = list(axis) if isinstance(axis, (list, tuple)) else [axis]
+    return _run("unsqueeze2", {"X": [x]}, {"axes": axes})
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    return _run("slice", {"Input": [x]},
+                {"axes": list(axes), "starts": list(starts),
+                 "ends": list(ends)})
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return _run("strided_slice", {"Input": [x]},
+                {"axes": list(axes), "starts": list(starts),
+                 "ends": list(ends), "strides": list(strides)})
+
+
+def gather(x, index, axis=0, name=None):
+    return _run("gather", {"X": [x], "Index": [index]},
+                {"axis": int(axis)})
+
+
+def gather_nd(x, index, name=None):
+    return _run("gather_nd", {"X": [x], "Index": [index]}, {})
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _run("scatter", {"X": [x], "Ids": [index],
+                            "Updates": [updates]},
+                {"overwrite": bool(overwrite)})
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return _run("scatter_nd_add", {"X": [x], "Index": [index],
+                                   "Updates": [updates]}, {})
+
+
+def flip(x, axis, name=None):
+    axes = list(axis) if isinstance(axis, (list, tuple)) else [axis]
+    return _run("flip", {"X": [x]}, {"axis": axes})
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = list(shifts) if isinstance(shifts, (list, tuple)) else [shifts]
+    ax = [] if axis is None else \
+        (list(axis) if isinstance(axis, (list, tuple)) else [axis])
+    return _run("roll", {"X": [x]}, {"shifts": sh, "axis": ax})
+
+
+def tile(x, repeat_times, name=None):
+    return _run("tile", {"X": [x]},
+                {"repeat_times": list(repeat_times)})
+
+
+def expand(x, shape, name=None):
+    return _run("expand_v2", {"X": [x]}, {"shape": list(shape)})
+
+
+def expand_as(x, y, name=None):
+    return _run("expand_as", {"X": [x], "Y": [y]}, {})
+
+
+def cast(x, dtype):
+    return _run("cast", {"X": [x]}, {"out_dtype": _dt(dtype)})
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return _run("flatten_contiguous_range", {"X": [x]},
+                {"start_axis": int(start_axis),
+                 "stop_axis": int(stop_axis)})
+
+
+def unique(x, return_index=False, return_inverse=False,
+           return_counts=False, name=None):
+    """Dygraph returns the dynamic-length result (host computation —
+    unique is no_grad); static mode keeps the op's padded-to-input-size
+    contract, the XLA static-shape discipline (ops/tensor.py unique)."""
+    if in_dygraph_mode():
+        from ..dygraph.tape import Tensor
+        val = np.asarray(x.value if hasattr(x, "value") else x)
+        out, idx, inv, cnt = np.unique(val, return_index=True,
+                                       return_inverse=True,
+                                       return_counts=True)
+        res = [Tensor(out)]
+        if return_index:
+            res.append(Tensor(idx.astype(np.int64)))
+        if return_inverse:
+            res.append(Tensor(inv.astype(np.int64)))
+        if return_counts:
+            res.append(Tensor(cnt.astype(np.int64)))
+        return res[0] if len(res) == 1 else tuple(res)
+    if return_index:
+        raise NotImplementedError(
+            "unique(return_index=True) is dygraph-only: the static op's "
+            "padded contract (ops/tensor.py unique_with_counts) carries "
+            "the inverse mapping, not first-occurrence indices")
+    outs = _run_multi("unique_with_counts", {"X": [x]}, {},
+                      ["Out", "Index", "Count"])
+    res = [outs[0]]
+    if return_inverse:
+        res.append(outs[1])
+    if return_counts:
+        res.append(outs[2])
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+# --------------------------------------------------------------------------
+# math (tensor/math.py)
+# --------------------------------------------------------------------------
+
+def _binary(op_type):
+    def f(x, y, name=None):
+        return _run(op_type, {"X": [x], "Y": [y]}, {})
+    f.__name__ = op_type
+    return f
+
+
+add = _binary("elementwise_add")
+subtract = _binary("elementwise_sub")
+multiply = _binary("elementwise_mul")
+divide = _binary("elementwise_div")
+floor_divide = _binary("elementwise_floordiv")
+mod = _binary("elementwise_mod")
+maximum = _binary("elementwise_max")
+minimum = _binary("elementwise_min")
+kron = _binary("kron")
+
+
+def pow(x, y, name=None):  # noqa: A001
+    if isinstance(y, (int, float)):
+        return _run("pow", {"X": [x]}, {"factor": float(y)})
+    return _run("elementwise_pow", {"X": [x], "Y": [y]}, {})
+
+
+def _unary(op_type):
+    def f(x, name=None):
+        return _run(op_type, {"X": [x]}, {})
+    f.__name__ = op_type
+    return f
+
+
+abs = _unary("abs")  # noqa: A001
+exp = _unary("exp")
+log = _unary("log")
+sqrt = _unary("sqrt")
+square = _unary("square")
+sign = _unary("sign")
+floor = _unary("floor")
+ceil = _unary("ceil")
+round = _unary("round")  # noqa: A001
+reciprocal = _unary("reciprocal")
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A001
+    return _run("clip", {"X": [x]},
+                {"min": float(min if min is not None else -3.4e38),
+                 "max": float(max if max is not None else 3.4e38)})
+
+
+def _reduce(op_type):
+    def f(x, axis=None, keepdim=False, name=None):
+        attrs = {"keep_dim": bool(keepdim),
+                 "reduce_all": axis is None}
+        if axis is not None:
+            attrs["dim"] = (list(axis) if isinstance(axis, (list, tuple))
+                            else [axis])
+        return _run(op_type, {"X": [x]}, attrs)
+    f.__name__ = op_type
+    return f
+
+
+sum = _reduce("reduce_sum")  # noqa: A001
+mean = _reduce("reduce_mean")
+max = _reduce("reduce_max")  # noqa: A001
+min = _reduce("reduce_min")  # noqa: A001
+prod = _reduce("reduce_prod")
+
+
+def cumsum(x, axis=None, name=None):
+    attrs = {"flatten": axis is None}
+    if axis is not None:
+        attrs["axis"] = int(axis)
+    return _run("cumsum", {"X": [x]}, attrs)
+
+
+def increment(x, value=1.0, name=None):
+    return _run("increment", {"X": [x]}, {"step": float(value)})
+
+
+# --------------------------------------------------------------------------
+# linalg (tensor/linalg.py)
+# --------------------------------------------------------------------------
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _run("matmul_v2", {"X": [x], "Y": [y]},
+                {"trans_x": bool(transpose_x),
+                 "trans_y": bool(transpose_y)})
+
+
+bmm = _binary("bmm")
+dot = _binary("dot")
+
+
+def cross(x, y, axis=None, name=None):
+    attrs = {} if axis is None else {"dim": int(axis)}
+    return _run("cross", {"X": [x], "Y": [y]}, attrs)
+
+
+def norm(x, p=2, axis=None, keepdim=False, name=None):
+    if p == "fro" or (axis is None and p == 2):
+        return _run("frobenius_norm", {"X": [x]},
+                    {"keep_dim": bool(keepdim), "reduce_all": axis is None,
+                     **({} if axis is None else {"dim": [axis]})})
+    if axis is None:  # Lp over all elements: flatten, then p_norm
+        x = reshape(x, [-1])
+        axis = 0
+    return _run("p_norm", {"X": [x]},
+                {"porder": float(p), "axis": int(axis),
+                 "keepdim": bool(keepdim)})
+
+
+def tril(x, diagonal=0, name=None):
+    return _run("tril_triu", {"X": [x]},
+                {"diagonal": int(diagonal), "lower": True})
+
+
+def triu(x, diagonal=0, name=None):
+    return _run("tril_triu", {"X": [x]},
+                {"diagonal": int(diagonal), "lower": False})
+
+
+# --------------------------------------------------------------------------
+# logic (tensor/logic.py)
+# --------------------------------------------------------------------------
+
+equal = _binary("equal")
+not_equal = _binary("not_equal")
+greater_than = _binary("greater_than")
+greater_equal = _binary("greater_equal")
+less_than = _binary("less_than")
+less_equal = _binary("less_equal")
+logical_and = _binary("logical_and")
+logical_or = _binary("logical_or")
+logical_xor = _binary("logical_xor")
+logical_not = _unary("logical_not")
+isfinite = _unary("isfinite")
+
+
+def isnan(x, name=None):
+    return not_equal(x, x)  # NaN is the only value unequal to itself
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return _run("allclose", {"Input": [x], "Other": [y]},
+                {"rtol": str(rtol), "atol": str(atol),
+                 "equal_nan": bool(equal_nan)})
+
+
+# --------------------------------------------------------------------------
+# random (tensor/random.py)
+# --------------------------------------------------------------------------
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    return _run("uniform_random", {},
+                {"shape": list(shape), "min": float(min),
+                 "max": float(max), "seed": int(seed),
+                 "dtype": _dt(dtype)})
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    return _run("gaussian_random", {},
+                {"shape": list(shape), "mean": float(mean),
+                 "std": float(std), "dtype": "float32"})
+
+
+def randn(shape, dtype=None, name=None):
+    return normal(0.0, 1.0, shape)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return _run("randint", {},
+                {"shape": list(shape), "low": int(low), "high": int(high),
+                 "dtype": _dt(dtype or "int64")})
+
+
+def randperm(n, dtype=None, name=None):
+    return _run("randperm", {}, {"n": int(n),
+                                 "dtype": _dt(dtype or "int64")})
+
+
+# --------------------------------------------------------------------------
+# search (tensor/search.py)
+# --------------------------------------------------------------------------
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _run("arg_max", {"X": [x]},
+                {"axis": -1 if axis is None else int(axis),
+                 "flatten": axis is None, "keepdims": bool(keepdim)})
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _run("arg_min", {"X": [x]},
+                {"axis": -1 if axis is None else int(axis),
+                 "flatten": axis is None, "keepdims": bool(keepdim)})
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    out, idx = _run_multi("argsort", {"X": [x]},
+                          {"axis": int(axis),
+                           "descending": bool(descending)},
+                          ["Out", "Indices"])
+    return idx
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    out, idx = _run_multi("argsort", {"X": [x]},
+                          {"axis": int(axis),
+                           "descending": bool(descending)},
+                          ["Out", "Indices"])
+    return out
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    out, idx = _run_multi("top_k_v2", {"X": [x]},
+                          {"k": int(k), "axis": int(axis),
+                           "largest": bool(largest),
+                           "sorted": bool(sorted)},
+                          ["Out", "Indices"])
+    return out, idx
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition)
+    return _run("where", {"Condition": [condition], "X": [x], "Y": [y]},
+                {})
+
+
+def nonzero(x, as_tuple=False):
+    return _run("where_index", {"Condition": [x]}, {})
+
+
+def index_select(x, index, axis=0, name=None):
+    return _run("index_select", {"X": [x], "Index": [index]},
+                {"dim": int(axis)})
+
+
+def index_sample(x, index):
+    return _run("index_sample", {"X": [x], "Index": [index]}, {})
+
+
+def masked_select(x, mask, name=None):
+    return _run("masked_select", {"X": [x], "Mask": [mask]}, {},
+                out_slot="Y")
+
+
+# --------------------------------------------------------------------------
+# stat (tensor/stat.py)
+# --------------------------------------------------------------------------
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return sqrt(var(x, axis, unbiased, keepdim))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    m = mean(x, axis, True)
+    sq = square(subtract(x, m))
+    v = mean(sq, axis, keepdim)
+    if unbiased:
+        import numpy as _np
+        shape = x.shape
+        if axis is None:
+            n = int(_np.prod(shape))
+        else:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            n = int(_np.prod([shape[a] for a in axes]))
+        if n > 1:
+            v = _run("scale", {"X": [v]},
+                     {"scale": n / (n - 1.0), "bias": 0.0})
+    return v
+
+
+def numel(x, name=None):
+    return _run("size", {"Input": [x]}, {})
+
+
+def shape(x):
+    return _run("shape", {"Input": [x]}, {})

@@ -1,0 +1,174 @@
+"""v2 namespace parity tests: paddle.tensor-style top-level functions
+(dual-mode) and paddle.static re-exports.
+
+Reference surface: /root/reference/python/paddle/tensor/ (creation/
+linalg/logic/manipulation/math/random/search/stat) and
+python/paddle/static."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.tensor as T
+
+
+@pytest.fixture
+def x():
+    return pt.to_tensor(np.asarray([[1.0, -2.0], [3.0, 4.0]], np.float32))
+
+
+@pytest.fixture
+def y():
+    return pt.to_tensor(np.ones((2, 2), np.float32))
+
+
+def _np(t):
+    return np.asarray(t.value if hasattr(t, "value") else t)
+
+
+def test_creation(x):
+    assert _np(T.zeros([2, 3])).shape == (2, 3)
+    assert float(_np(T.full([2], 7.0))[0]) == 7.0
+    assert float(_np(T.full_like(x, 5))[0, 0]) == 5.0
+    np.testing.assert_array_equal(_np(T.arange(4)), [0, 1, 2, 3])
+    assert abs(float(_np(T.linspace(0, 1, 5))[-1]) - 1.0) < 1e-6
+    assert float(_np(T.eye(3)).trace()) == 3.0
+    assert _np(T.diag(pt.to_tensor(np.asarray([1.0, 2.0])))).shape == (2, 2)
+
+
+def test_manipulation(x, y):
+    assert _np(T.concat([x, y], 1)).shape == (2, 4)
+    parts = T.split(x, 2, 1)
+    assert len(parts) == 2 and _np(parts[0]).shape == (2, 1)
+    assert _np(T.stack([x, y])).shape == (2, 2, 2)
+    assert len(T.unstack(x)) == 2
+    assert _np(T.reshape(x, [4])).shape == (4,)
+    assert float(_np(T.transpose(x, [1, 0]))[0, 1]) == 3.0
+    assert _np(T.unsqueeze(x, 0)).shape == (1, 2, 2)
+    assert _np(T.squeeze(T.reshape(x, [1, 4]))).shape == (4,)
+    assert _np(T.flatten(x)).shape == (4,)
+    assert _np(T.tile(x, [2, 1])).shape == (4, 2)
+    assert _np(T.cast(x, "int32")).dtype == np.int32
+    assert float(_np(T.flip(x, 0))[0, 0]) == 3.0
+    assert float(_np(T.roll(x, 1, 0))[0, 0]) == 3.0
+    idx = pt.to_tensor(np.asarray([0], np.int64))
+    assert _np(T.gather(x, idx)).shape == (1, 2)
+    u = T.unique(pt.to_tensor(np.asarray([3, 1, 1, 2], np.int64)))
+    np.testing.assert_array_equal(_np(u), [1, 2, 3])
+
+
+def test_math_linalg(x, y):
+    assert float(_np(T.add(x, y))[0, 0]) == 2.0
+    assert float(_np(T.pow(x, 2))[0, 1]) == 4.0
+    assert float(_np(T.clip(x, 0, 2))[0, 1]) == 0.0
+    assert float(_np(T.sum(x))) == 6.0
+    assert _np(T.mean(x, 1)).shape == (2,)
+    assert float(_np(T.cumsum(x, 0))[1, 0]) == 4.0
+    assert _np(T.matmul(x, y)).shape == (2, 2)
+    assert float(_np(T.tril(x))[0, 1]) == 0.0
+    assert float(_np(T.triu(x))[1, 0]) == 0.0
+    assert _np(T.norm(x, 2, 1)).shape == (2,)
+    assert _np(T.kron(x, y)).shape == (4, 4)
+    v = pt.to_tensor(np.ones(3, np.float32))
+    assert float(_np(T.dot(v, v)).reshape(-1)[0]) == 3.0
+    # std/var vs numpy (unbiased)
+    xv = _np(x)
+    np.testing.assert_allclose(float(_np(T.var(x))), xv.var(ddof=1),
+                               rtol=1e-6)
+
+
+def test_logic_search(x, y):
+    assert _np(T.equal(x, y)).dtype == bool
+    assert bool(_np(T.isfinite(x)).all())
+    assert bool(_np(T.allclose(x, x)))
+    assert not bool(_np(T.isnan(x)).any())
+    np.testing.assert_array_equal(_np(T.argmax(x, 1)), [0, 1])
+    assert float(_np(T.sort(x, 1))[0, 0]) == -2.0
+    out, idx = T.topk(x, 1, 1)
+    assert _np(out).shape == (2, 1)
+    w = T.where(T.greater_than(x, T.zeros_like(x)), x, y)
+    assert float(_np(w)[0, 1]) == 1.0
+    m = T.masked_select(x, T.greater_than(x, T.zeros_like(x)))
+    assert _np(m).shape == (3,)
+    assert int(_np(T.numel(x))) == 4
+
+
+def test_random_shapes():
+    assert _np(T.rand([3])).shape == (3,)
+    assert _np(T.randn([3])).shape == (3,)
+    r = _np(T.randint(0, 5, [10]))
+    assert r.shape == (10,) and (r >= 0).all() and (r < 5).all()
+    assert sorted(_np(T.randperm(5))) == [0, 1, 2, 3, 4]
+
+
+def test_tensor_namespace_in_static_mode():
+    """The same functions append ops when building a Program (the v2
+    contract: paddle.enable_static() switches the dispatch)."""
+    from paddle_tpu import layers
+    from paddle_tpu.core.program import disable_static, enable_static
+    main, startup = pt.Program(), pt.Program()
+    enable_static()
+    try:
+        with pt.program_guard(main, startup):
+            a = layers.data("a", [4])
+            b = T.reshape(T.add(a, a), [2, 2])
+            out = T.matmul(b, b)
+    finally:
+        disable_static()
+    exe = pt.Executor()
+    got, = exe.run(main, feed={"a": np.ones((1, 4), np.float32) * 2},
+                   fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got), np.full((2, 2), 32.0))
+
+
+def test_static_namespace(tmp_path):
+    import paddle_tpu.static as static
+    from paddle_tpu.core.program import disable_static, enable_static
+    enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [3], dtype="float32")
+            w = static.nn.fc(x, size=2)
+        exe = static.Executor()
+        scope = static.Scope()
+        with static.scope_guard(scope):
+            exe.run(startup)
+            out, = exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
+                           fetch_list=[w])
+            assert np.asarray(out).shape == (2, 2)
+            # save/load round trip restores parameters
+            static.save(main, str(tmp_path / "model"))
+            pname = [v.name for v in main.all_parameters()][0]
+            orig = np.asarray(scope.find_var(pname)).copy()
+            scope.set(pname, np.zeros_like(orig))
+            static.load(main, str(tmp_path / "model"), exe)
+            np.testing.assert_allclose(np.asarray(scope.find_var(pname)),
+                                       orig)
+    finally:
+        disable_static()
+    spec = static.InputSpec([None, 8], "float32", "inp")
+    assert spec.shape == (-1, 8)
+
+
+def test_no_grad_context(x):
+    with pt.no_grad():
+        z = T.add(x, x)
+    assert z.stop_gradient
+
+
+def test_review_regressions(x):
+    # isnan: inf is NOT nan
+    v = pt.to_tensor(np.asarray([np.inf, np.nan, 1.0], np.float32))
+    np.testing.assert_array_equal(_np(T.isnan(v)), [False, True, False])
+    # L1 norm over all elements
+    v2 = pt.to_tensor(np.asarray([3.0, -4.0], np.float32))
+    assert abs(float(_np(T.norm(v2, p=1))) - 7.0) < 1e-6
+    assert abs(float(_np(T.norm(v2, p=2))) - 5.0) < 1e-6
+    # float arange infers float dtype
+    r = _np(T.arange(0.0, 1.0, 0.25))
+    np.testing.assert_allclose(r, [0.0, 0.25, 0.5, 0.75])
+    # unique with inverse in dygraph
+    u, inv = T.unique(pt.to_tensor(np.asarray([2, 1, 2], np.int64)),
+                      return_inverse=True)
+    np.testing.assert_array_equal(_np(u), [1, 2])
+    np.testing.assert_array_equal(_np(inv), [1, 0, 1])
