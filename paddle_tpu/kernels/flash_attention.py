@@ -546,6 +546,13 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
     sk = k.shape[2]
     want_drop = dropout_rate > 0.0 and dropout_rng is not None
     keep_prob = 1.0 - dropout_rate if want_drop else 1.0
+    # shrink the requested blocks to divisors of the sequence dims (a
+    # non-dividing block would silently bounce S=1280 etc. off the
+    # kernel onto the composed fallback — the regime flash exists for)
+    while block_q > 8 and sq % min(block_q, sq):
+        block_q //= 2
+    while block_k > 128 and sk % min(block_k, sk):
+        block_k //= 2
     if not _supported(q, k, sq, sk, d, block_q, block_k):
         keep = dropout_keep_mask(dropout_rng, dropout_rate,
                                  (batch, heads, sq, sk), jnp.float32) \

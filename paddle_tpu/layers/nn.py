@@ -625,3 +625,174 @@ def sums(input, name: Optional[str] = None) -> VarDesc:
     helper.append_op("sum", inputs={"X": [v.name for v in input]},
                      outputs={"Out": [out.name]})
     return out
+
+
+# ---------------------------------------------------------------------------
+# sequence / RNN / CRF layer builders (fluid.layers book-model surface)
+# ---------------------------------------------------------------------------
+
+def sequence_pool(input: VarDesc, pool_type: str = "sum",
+                  seq_len: Optional[VarDesc] = None,
+                  name: Optional[str] = None) -> VarDesc:
+    """fluid.layers.sequence_pool (sequence_ops; ragged repr is
+    padded + lengths, ops/sequence.py)."""
+    helper = LayerHelper("sequence_pool", name)
+    out = helper.create_tmp_variable(input.dtype)
+    ins = {"X": [input.name]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len.name]
+    outs = {"Out": [out.name]}
+    if pool_type.upper() == "MAX":  # MaxIndex only exists for max pool
+        outs["MaxIndex"] = [helper.create_tmp_variable("int32").name]
+    helper.append_op("sequence_pool", inputs=ins, outputs=outs,
+                     attrs={"pooltype": pool_type.upper()})
+    return out
+
+
+def sequence_conv(input: VarDesc, num_filters: int, filter_size: int = 3,
+                  act: Optional[str] = None, param_attr=None,
+                  bias_attr=None, name: Optional[str] = None) -> VarDesc:
+    """fluid.layers.sequence_conv (nn.py:2462)."""
+    helper = LayerHelper("sequence_conv", name)
+    d = input.shape[-1]
+    w = helper.create_parameter(param_attr,
+                                [filter_size * d, num_filters],
+                                input.dtype)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("sequence_conv",
+                     inputs={"X": [input.name], "Filter": [w.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"contextLength": filter_size,
+                            "contextStart": -(filter_size // 2),
+                            "contextStride": 1})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        bout = helper.create_tmp_variable(input.dtype)
+        helper.append_op("elementwise_add",
+                         inputs={"X": [out.name], "Y": [b.name]},
+                         outputs={"Out": [bout.name]}, attrs={"axis": -1})
+        out = bout
+    return helper.append_activation(out, act)
+
+
+def dynamic_lstm(input: VarDesc, size: int, h_0=None, c_0=None,
+                 param_attr=None, bias_attr=None, use_peepholes=False,
+                 is_reverse=False, seq_len: Optional[VarDesc] = None,
+                 name: Optional[str] = None):
+    """fluid.layers.dynamic_lstm (nn.py:466): input is the
+    PRE-PROJECTED [.., 4*hidden] sequence (the fc lives outside, like
+    the reference); returns (hidden, cell) full sequences. The ragged
+    repr is padded + lengths, so pass seq_len for variable-length
+    batches — REQUIRED with is_reverse, where the flip relies on the
+    length mask to skip front padding."""
+    if is_reverse and seq_len is None:
+        raise ValueError(
+            "dynamic_lstm(is_reverse=True) needs seq_len: without the "
+            "length mask the time flip feeds padding first")
+    helper = LayerHelper("dynamic_lstm", name)
+    d = size // 4
+    wh = helper.create_parameter(param_attr, [d, 4 * d], input.dtype)
+    bias = helper.create_parameter(bias_attr, [4 * d], input.dtype,
+                                   is_bias=True)
+    hidden = helper.create_tmp_variable(input.dtype)
+    cell = helper.create_tmp_variable(input.dtype)
+    last_h = helper.create_tmp_variable(input.dtype)
+    last_c = helper.create_tmp_variable(input.dtype)
+    ins = {"Input": [input.name], "WeightH": [wh.name],
+           "Bias": [bias.name]}
+    if h_0 is not None:
+        ins["H0"] = [h_0.name]
+    if c_0 is not None:
+        ins["C0"] = [c_0.name]
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len.name]
+    helper.append_op("lstm", inputs=ins,
+                     outputs={"Hidden": [hidden.name],
+                              "Cell": [cell.name],
+                              "LastH": [last_h.name],
+                              "LastC": [last_c.name]},
+                     attrs={"use_peepholes": use_peepholes,
+                            "is_reverse": is_reverse})
+    return hidden, cell
+
+
+def dynamic_gru(input: VarDesc, size: int, h_0=None, param_attr=None,
+                bias_attr=None, is_reverse=False, origin_mode=False,
+                seq_len: Optional[VarDesc] = None,
+                name: Optional[str] = None) -> VarDesc:
+    """fluid.layers.dynamic_gru (nn.py:850): input pre-projected
+    [.., 3*hidden]. Pass seq_len for variable-length batches —
+    REQUIRED with is_reverse (see dynamic_lstm)."""
+    if is_reverse and seq_len is None:
+        raise ValueError(
+            "dynamic_gru(is_reverse=True) needs seq_len: without the "
+            "length mask the time flip feeds padding first")
+    helper = LayerHelper("dynamic_gru", name)
+    wh = helper.create_parameter(param_attr, [size, 3 * size],
+                                 input.dtype)
+    bias = helper.create_parameter(bias_attr, [3 * size], input.dtype,
+                                   is_bias=True)
+    hidden = helper.create_tmp_variable(input.dtype)
+    last_h = helper.create_tmp_variable(input.dtype)
+    ins = {"Input": [input.name], "WeightH": [wh.name],
+           "Bias": [bias.name]}
+    if h_0 is not None:
+        ins["H0"] = [h_0.name]
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len.name]
+    helper.append_op("gru", inputs=ins,
+                     outputs={"Hidden": [hidden.name],
+                              "LastH": [last_h.name]},
+                     attrs={"origin_mode": origin_mode,
+                            "is_reverse": is_reverse})
+    return hidden
+
+
+def linear_chain_crf(input: VarDesc, label: VarDesc, param_attr=None,
+                     length: Optional[VarDesc] = None,
+                     name: Optional[str] = None) -> VarDesc:
+    """fluid.layers.linear_chain_crf (nn.py:1590): returns the negative
+    log-likelihood [B, 1]; the Transition parameter ([D+2, D], rows
+    0/1 start/stop) is created here."""
+    helper = LayerHelper("linear_chain_crf", name)
+    d = input.shape[-1]
+    transition = helper.create_parameter(
+        ParamAttr.to_attr(param_attr) or ParamAttr(),
+        [d + 2, d], input.dtype, default_initializer=Constant(0.0))
+    alpha = helper.create_tmp_variable(input.dtype)
+    eexp = helper.create_tmp_variable(input.dtype)
+    texp = helper.create_tmp_variable(input.dtype)
+    ll = helper.create_tmp_variable(input.dtype)
+    ins = {"Emission": [input.name], "Transition": [transition.name],
+           "Label": [label.name]}
+    if length is not None:
+        ins["Length"] = [length.name]
+    helper.append_op("linear_chain_crf", inputs=ins,
+                     outputs={"Alpha": [alpha.name],
+                              "EmissionExps": [eexp.name],
+                              "TransitionExps": [texp.name],
+                              "LogLikelihood": [ll.name]})
+    return ll
+
+
+def crf_decoding(input: VarDesc, param_attr, label=None, length=None,
+                 name: Optional[str] = None) -> VarDesc:
+    """fluid.layers.crf_decoding (nn.py:1699): Viterbi path (or the
+    per-token correctness indicator when label is given). param_attr
+    must NAME the transition parameter created by linear_chain_crf."""
+    helper = LayerHelper("crf_decoding", name)
+    attr = ParamAttr.to_attr(param_attr)
+    tname = attr.name if attr is not None and attr.name else None
+    if tname is None:
+        raise ValueError("crf_decoding needs param_attr naming the "
+                         "transition parameter of linear_chain_crf")
+    out = helper.create_tmp_variable("int64")
+    ins = {"Emission": [input.name], "Transition": [tname]}
+    if label is not None:
+        ins["Label"] = [label.name]
+    if length is not None:
+        ins["Length"] = [length.name]
+    helper.append_op("crf_decoding", inputs=ins,
+                     outputs={"ViterbiPath": [out.name]})
+    return out

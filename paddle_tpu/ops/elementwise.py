@@ -54,7 +54,16 @@ def _scale(ctx, ins, attrs):
 
 @register_op("clip", inputs=("X",))
 def _clip(ctx, ins, attrs):
-    return one(jnp.clip(ins["X"][0], attrs.get("min"), attrs.get("max")))
+    # bounds cast to x's dtype so integer tensors stay integer
+    # (clip_op.cc templates the bound on T; python-float bounds must
+    # not promote)
+    x = ins["X"][0]
+    lo, hi = attrs.get("min"), attrs.get("max")
+    if lo is not None:
+        lo = jnp.asarray(lo, x.dtype)
+    if hi is not None:
+        hi = jnp.asarray(hi, x.dtype)
+    return one(jnp.clip(x, lo, hi))
 
 
 @register_op("clip_by_norm", inputs=("X",))

@@ -380,3 +380,60 @@ def _dgc_clip_by_norm(ctx, ins, attrs):
     norm = jnp.sqrt(jnp.sum(x * x))
     clipped = x * jnp.minimum(1.0, max_norm / (norm + 1e-6))
     return one(jnp.where(step >= rampup, clipped, x))
+
+
+@register_op("crf_decoding",
+             inputs=("Emission", "Transition", "Label", "Length"),
+             outputs=("ViterbiPath",), no_grad=True,
+             non_diff_inputs=("Label", "Length"))
+def _crf_decoding(ctx, ins, attrs):
+    """Viterbi decode (operators/crf_decoding_op.cc): max-sum forward
+    pass with backpointers, then the backward walk. Without Label the
+    output is the best tag path [B, T] (padded region 0); with Label it
+    is the per-token correctness indicator (1 = decoded tag equals the
+    gold tag, 0 = incorrect), the reference's eval contract."""
+    em = ins["Emission"][0]
+    tr = ins["Transition"][0]
+    B, T, D = em.shape
+    length = ins["Length"][0].astype(jnp.int32).reshape(-1) \
+        if ins.get("Length") else jnp.full((B,), T, jnp.int32)
+    start, stop, w = tr[0], tr[1], tr[2:]
+
+    def fwd(carry, t):
+        score = carry  # [B, D]
+        cand = score[:, :, None] + w[None]          # [B, D, D]
+        best_prev = jnp.argmax(cand, axis=1)        # [B, D]
+        new = jnp.max(cand, axis=1) + em[:, t]
+        live = (t < length)[:, None]
+        return jnp.where(live, new, score), best_prev
+
+    score0 = start[None] + em[:, 0]
+    final, backptrs = jax.lax.scan(fwd, score0, jnp.arange(1, T))
+    # last live position's best tag includes the stop weights
+    final = final + stop[None]
+    last_tag = jnp.argmax(final, axis=1).astype(jnp.int32)  # [B]
+
+    def back(carry, t):
+        tag = carry  # [B]
+        bp = backptrs[t - 1]  # transition into step t chose prev tag
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        # only walk back while t <= length-1 (inside the sequence)
+        tag_prev = jnp.where(t < length, prev.astype(jnp.int32), tag)
+        return tag_prev, tag
+
+    # walk t = T-1 .. 1 emitting the tag AT each t, then the final carry
+    # is the tag at t=0
+    tag_last, tags_rev = jax.lax.scan(back, last_tag,
+                                      jnp.arange(T - 1, 0, -1))
+    path = jnp.concatenate([tag_last[:, None],
+                            jnp.flip(jnp.swapaxes(tags_rev, 0, 1), 1)],
+                           axis=1)  # [B, T]
+    mask = jnp.arange(T)[None] < length[:, None]
+    path = jnp.where(mask, path, 0)
+    if ins.get("Label"):
+        label = ins["Label"][0].astype(jnp.int32)
+        if label.ndim == 3:
+            label = label[..., 0]
+        correct = (path == label) & mask
+        return {"ViterbiPath": [correct.astype(jnp.int64)]}
+    return {"ViterbiPath": [path.astype(jnp.int64)]}

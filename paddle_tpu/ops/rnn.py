@@ -89,19 +89,30 @@ def lstm_gate_permutation_from_reference(w, axis=-1):
              outputs=("Hidden", "Cell", "LastH", "LastC"),
              non_diff_inputs=("SeqLen",))
 def _lstm(ctx, ins, attrs):
+    # WeightX optional: the fluid dynamic_lstm contract feeds a
+    # pre-projected [B, T, 4D] input (dynamic_lstm's fc lives outside
+    # the op, lstm_op.cc) — no identity matmul
     x = ins["Input"][0]
-    wx = ins["WeightX"][0]
     wh = ins["WeightH"][0]
     B, T, _ = x.shape
     D = wh.shape[0]
-    xp = jnp.einsum("bti,ij->btj", x, wx)
+    xp = jnp.einsum("bti,ij->btj", x, ins["WeightX"][0]) \
+        if ins.get("WeightX") else x
     if ins.get("Bias"):
         xp = xp + ins["Bias"][0]
     h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, D), x.dtype)
     c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, D), x.dtype)
     mask = _mask_from(ins, x)
+    reverse = bool(attrs.get("is_reverse", False))
+    if reverse:
+        # flip time; padded slots land at the FRONT where the mask
+        # holds the carry until the real (reversed) steps begin
+        xp = jnp.flip(xp, axis=1)
+        mask = jnp.flip(mask, axis=1) if mask is not None else None
     hs, cs, h_f, c_f = _lstm_scan(xp, h0, c0, wh, None, mask,
                                   attrs.get("use_peepholes", False))
+    if reverse:
+        hs, cs = jnp.flip(hs, axis=1), jnp.flip(cs, axis=1)
     return {"Hidden": [hs], "Cell": [cs], "LastH": [h_f], "LastC": [c_f]}
 
 
@@ -200,17 +211,25 @@ def _gru_scan(xp, h0, wh, mask, origin_mode=False):
                             "SeqLen"),
              outputs=("Hidden", "LastH"), non_diff_inputs=("SeqLen",))
 def _gru(ctx, ins, attrs):
+    # WeightX optional, like lstm: dynamic_gru feeds [B, T, 3D]
     x = ins["Input"][0]
-    wx = ins["WeightX"][0]
     wh = ins["WeightH"][0]  # [D, 3D]
     B, T, _ = x.shape
     D = wh.shape[0]
-    xp = jnp.einsum("bti,ij->btj", x, wx)
+    xp = jnp.einsum("bti,ij->btj", x, ins["WeightX"][0]) \
+        if ins.get("WeightX") else x
     if ins.get("Bias"):
         xp = xp + ins["Bias"][0]
     h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, D), x.dtype)
-    hs, h_f = _gru_scan(xp, h0, wh, _mask_from(ins, x),
+    mask = _mask_from(ins, x)
+    reverse = bool(attrs.get("is_reverse", False))
+    if reverse:
+        xp = jnp.flip(xp, axis=1)
+        mask = jnp.flip(mask, axis=1) if mask is not None else None
+    hs, h_f = _gru_scan(xp, h0, wh, mask,
                         attrs.get("origin_mode", False))
+    if reverse:
+        hs = jnp.flip(hs, axis=1)
     return {"Hidden": [hs], "LastH": [h_f]}
 
 

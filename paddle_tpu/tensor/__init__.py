@@ -404,10 +404,18 @@ def cross(x, y, axis=None, name=None):
 
 
 def norm(x, p=2, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        if p != "fro":
+            raise ValueError(
+                "norm: a multi-dim axis is only defined for p='fro' "
+                "(paddle.linalg.norm contract)")
+        return _run("frobenius_norm", {"X": [x]},
+                    {"keep_dim": bool(keepdim), "reduce_all": False,
+                     "dim": [int(a) for a in axis]})
     if p == "fro" or (axis is None and p == 2):
         return _run("frobenius_norm", {"X": [x]},
                     {"keep_dim": bool(keepdim), "reduce_all": axis is None,
-                     **({} if axis is None else {"dim": [axis]})})
+                     **({} if axis is None else {"dim": [int(axis)]})})
     if axis is None:  # Lp over all elements: flatten, then p_norm
         x = reshape(x, [-1])
         axis = 0

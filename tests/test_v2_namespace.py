@@ -172,3 +172,17 @@ def test_review_regressions(x):
                       return_inverse=True)
     np.testing.assert_array_equal(_np(u), [1, 2])
     np.testing.assert_array_equal(_np(inv), [1, 0, 1])
+
+
+def test_clip_preserves_int_dtype():
+    v = pt.to_tensor(np.asarray([1, 5], np.int32))
+    r = _np(T.clip(v, 0, 2))
+    assert r.dtype == np.int32
+    np.testing.assert_array_equal(r, [1, 2])
+
+
+def test_norm_fro_multi_axis(x):
+    got = float(_np(T.norm(x, "fro", [0, 1])))
+    assert abs(got - np.sqrt((_np(x) ** 2).sum())) < 1e-5
+    with pytest.raises(ValueError, match="fro"):
+        T.norm(x, 1, [0, 1])
