@@ -6,3 +6,5 @@ from .bert import (BertConfig, BertForPretraining,  # noqa: F401
                    bert_base_config, bert_large_config, ernie_large_config,
                    pretraining_loss)
 from .wide_deep import WideDeep  # noqa: F401
+from .vision_zoo import (MobileNetV2, VGG, mobilenet_v2,  # noqa: F401
+                         vgg11, vgg16, vgg19)

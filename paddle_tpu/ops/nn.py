@@ -121,17 +121,31 @@ def _pool(x, ksize, strides, paddings, pooling_type, ceil_mode, exclusive,
         for i, osize in enumerate(ksize):
             axis = 2 + i
             insize = x.shape[axis]
-            # split into osize equal-ish bins (requires divisibility for TPU)
             if insize % osize == 0:
+                # divisible: reshape + reduce (cheapest)
                 k = insize // osize
                 shape = list(out.shape)
                 shape[axis:axis + 1] = [osize, k]
                 r = out.reshape(shape)
                 out = (jnp.max(r, axis=axis + 1) if pooling_type == "max"
                        else jnp.mean(r, axis=axis + 1))
+            elif pooling_type != "max":
+                # non-divisible average: static bin-membership matrix
+                # (adaptive_pool bins are [floor(j*I/O), ceil((j+1)*I/O))
+                # like pool_op.h AdaptivePool) contracted on the MXU —
+                # shapes stay static, no dynamic slicing
+                w = np.zeros((osize, insize), np.float32)
+                for j in range(osize):
+                    lo = (j * insize) // osize
+                    hi = -(-((j + 1) * insize) // osize)
+                    w[j, lo:hi] = 1.0 / (hi - lo)
+                out = jnp.moveaxis(
+                    jnp.tensordot(out, jnp.asarray(w, out.dtype),
+                                  axes=[[axis], [1]]), -1, axis)
             else:
                 raise NotImplementedError(
-                    "adaptive pool needs divisible sizes on TPU")
+                    "adaptive MAX pool needs divisible sizes on TPU "
+                    "(static shapes; average pooling handles any size)")
         return out
     window = (1, 1) + tuple(ksize)
     strides_full = (1, 1) + tuple(strides)
