@@ -11,6 +11,18 @@ from __future__ import annotations
 from .. import nn
 
 
+def _make_divisible(v, divisor=8, min_value=None):
+    """Reference channel rounding (vision/models/mobilenetv2.py
+    _make_divisible): round to the nearest multiple of `divisor`, never
+    dropping more than 10%."""
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
 class _ConvBNReLU(nn.Sequential):
     def __init__(self, c_in, c_out, k, stride=1, groups=1, relu6=True):
         pad = (k - 1) // 2
@@ -55,11 +67,11 @@ class MobileNetV2(nn.Layer):
         # (vision/models/mobilenetv2.py)
         width_mult = scale
         nn.Layer.__init__(self)
-        c = int(32 * width_mult)
-        last = int(1280 * max(1.0, width_mult))
+        c = _make_divisible(32 * width_mult)
+        last = _make_divisible(1280 * max(1.0, width_mult))
         feats = [_ConvBNReLU(in_channels, c, 3, stride=2)]
         for t, co, n, s in self.CFG:
-            co = int(co * width_mult)
+            co = _make_divisible(co * width_mult)
             for i in range(n):
                 feats.append(InvertedResidual(c, co, s if i == 0 else 1,
                                               t))

@@ -9,3 +9,6 @@ from .layers_lib import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm,  # noqa: F401
                          LeakyReLU, Linear, MaxPool2D, MSELoss, NLLLoss,
                          ReLU, ReLU6, Sigmoid, SmoothL1Loss, Softmax,
                          Tanh)
+from .transformer import (MultiHeadAttention, Transformer,  # noqa: F401
+                          TransformerDecoder, TransformerDecoderLayer,
+                          TransformerEncoder, TransformerEncoderLayer)
