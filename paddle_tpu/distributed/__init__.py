@@ -4,5 +4,6 @@ from .communicator import (Communicator, AsyncCommunicator,  # noqa: F401
                            GeoCommunicator, HalfAsyncCommunicator,
                            ParamServer, SyncCommunicator)
 from .ps_worker import DownpourWorker, HeterWorker  # noqa: F401
-from .multi_trainer import MultiTrainer, train_from_dataset  # noqa: F401
+from .multi_trainer import (MultiTrainer, recompute,  # noqa: F401
+                            train_from_dataset)
 from .trainer_factory import TrainerDesc, TrainerFactory  # noqa: F401

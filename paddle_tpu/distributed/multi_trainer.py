@@ -78,3 +78,74 @@ def train_from_dataset(dataset, worker_fn, thread_num: int = 2,
     for _ in range(epochs):
         losses.extend(mt.run(iter(dataset), worker_fn))
     return losses
+
+
+def recompute(layer_or_fn, *args, **kwargs):
+    """Dygraph activation recompute — the eager twin of the static
+    recompute rewrite (reference: distributed/fleet/utils/recompute
+    wraps a segment so its activations are rematerialized in backward).
+
+    On TPU the segment becomes jax.checkpoint inside one taped
+    apply_fn: the forward runs once, the backward re-traces the segment
+    instead of storing its activations (HBM for FLOPs — the standard
+    remat trade).
+
+        out = recompute(self.block, x)          # Layer: parameter grads
+                                                # flow to block.parameters()
+        out = recompute(lambda a, b: ..., a, b) # PURE function of its args
+
+    A plain function must be pure in its Tensor args: parameters
+    captured by closure get NO gradients (they are invisible to the
+    functional vjp) — pass the owning Layer instead.
+    """
+    from ..dygraph import tape
+    from ..dygraph.tape import Tensor
+    from ..nn.layer import Layer
+    import jax
+
+    flat = [a for a in args if isinstance(a, Tensor)]
+    if len(flat) != len(args):
+        raise ValueError("recompute: all positional args must be "
+                         "Tensors (got %s)" % [type(a) for a in args])
+
+    if isinstance(layer_or_fn, Layer):
+        from ..jit import functional_call
+        if kwargs:
+            # functional_call owns `training`/`rng`; forwarding user
+            # kwargs through it risks silent collisions — keep the
+            # segment's surface positional (the fleet-recompute shape)
+            raise ValueError(
+                "recompute(Layer, ...) takes positional Tensor inputs "
+                "only; got kwargs %s" % sorted(kwargs))
+        params = list(layer_or_fn.named_parameters())
+        names = [n for n, _ in params]
+        ptensors = [p for _, p in params]
+        n_in = len(flat)
+        training = layer_or_fn.training
+        # the rng key is an ARGUMENT of the checkpointed function so the
+        # backward rematerialization re-traces with the SAME key —
+        # dropout masks match between forward and recompute
+        key = Tensor(tape._state.next_key())
+
+        def raw(*vals):
+            state = dict(zip(names, vals[n_in:-1]))
+            with tape.no_grad():  # jax.vjp differentiates; no tape nodes
+                out, _ = functional_call(
+                    layer_or_fn, state,
+                    *[Tensor(v) for v in vals[:n_in]],
+                    training=training, rng=vals[-1])
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            return [o.value if isinstance(o, Tensor) else o
+                    for o in outs]
+
+        outs = tape.apply_fn(jax.checkpoint(raw), *flat, *ptensors, key)
+    else:
+        def raw(*vals):
+            with tape.no_grad():
+                out = layer_or_fn(*[Tensor(v) for v in vals], **kwargs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            return [o.value if isinstance(o, Tensor) else o
+                    for o in outs]
+
+        outs = tape.apply_fn(jax.checkpoint(raw), *flat)
+    return outs[0] if len(outs) == 1 else tuple(outs)

@@ -256,9 +256,7 @@ class TransformerEncoder(Layer):
         super().__init__()
         self.layers = LayerList([encoder_layer_fn()
                                  for _ in range(num_layers)])
-        self.norm = norm
-        if norm is not None:
-            self.add_sublayer("norm", norm)
+        self.norm = norm  # __setattr__ registers the sublayer
 
     def forward(self, src, src_mask=None):
         out = src
@@ -333,9 +331,7 @@ class TransformerDecoder(Layer):
         super().__init__()
         self.layers = LayerList([decoder_layer_fn()
                                  for _ in range(num_layers)])
-        self.norm = norm
-        if norm is not None:
-            self.add_sublayer("norm", norm)
+        self.norm = norm  # __setattr__ registers the sublayer
 
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):
         out = tgt
