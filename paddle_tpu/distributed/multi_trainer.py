@@ -140,8 +140,14 @@ def recompute(layer_or_fn, *args, **kwargs):
 
         outs = tape.apply_fn(jax.checkpoint(raw), *flat, *ptensors, key)
     else:
+        # a concrete key captured OUTSIDE the trace: (a) ops inside raw
+        # split from it instead of writing tracers into the global
+        # chain, (b) the backward re-trace sees the same key, so any
+        # randomness matches the forward
+        fn_key = tape._state.next_key()
+
         def raw(*vals):
-            with tape.no_grad():
+            with tape.rng_scope(fn_key), tape.no_grad():
                 out = layer_or_fn(*[Tensor(v) for v in vals], **kwargs)
             outs = out if isinstance(out, (tuple, list)) else (out,)
             return [o.value if isinstance(o, Tensor) else o

@@ -11,4 +11,7 @@ from .static_opt import (Adadelta, AdadeltaOptimizer, Adagrad,  # noqa: F401
                          L2Decay, Lamb, LambOptimizer, LarsMomentum,
                          LarsMomentumOptimizer, Momentum, MomentumOptimizer,
                          Optimizer, RMSProp, RMSPropOptimizer, SGD,
-                         SGDOptimizer)
+                         SGDOptimizer,
+                         ExponentialMovingAverage, ModelAverage)
+
+Dpsgd = DpSGD  # reference spelling (fluid/optimizer.py Dpsgd)

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..core.backward import append_backward
 from ..core.program import (Program, VarDesc, default_main_program,
                             default_startup_program)
@@ -839,3 +841,172 @@ class DpSGD(Optimizer):
 
 
 DpSGDOptimizer = DpSGD
+
+
+class ExponentialMovingAverage:
+    """fluid.optimizer.ExponentialMovingAverage (optimizer.py:3720):
+    shadow = decay * shadow + (1 - decay) * param, with the warmup
+    decay min(decay, (1 + step) / (10 + step)); `apply` swaps shadows
+    in for evaluation, `restore` swaps back.
+
+    Dual-mode: eager (pass parameters=...) updates Tensor values
+    directly; static (pass scope + program to each call) operates on
+    the scope the Executor trains in — the same variable-swap protocol
+    the reference implements with appended ops."""
+
+    def __init__(self, decay: float = 0.999, thres_steps=None,
+                 parameters=None):
+        if thres_steps is not None:
+            raise NotImplementedError(
+                "ExponentialMovingAverage: thres_steps (an external "
+                "step variable driving the warmup) is not supported — "
+                "warmup follows this instance's update() count")
+        self._decay = float(decay)
+        self._params = list(parameters) if parameters is not None else None
+        self._step = 0
+        self._shadow: Dict[str, np.ndarray] = {}
+        self._backup: Dict[str, np.ndarray] = {}
+
+    # -- name/value plumbing over both modes ----------------------------
+    def _items(self, scope=None, program=None):
+        if self._params is not None:
+            return [(("p%d" % i), p) for i, p in enumerate(self._params)]
+        program = program or default_main_program()
+        return [(v.name, v) for v in program.all_parameters()
+                if v.trainable]
+
+    def _get(self, handle, scope):
+        if scope is None:
+            return np.asarray(handle.value)
+        return np.asarray(scope.find_var(handle.name))
+
+    def _set(self, handle, value, scope):
+        if scope is None:
+            handle.set_value(value)
+        else:
+            scope.set(handle.name, value)
+
+    def update(self, scope=None, program=None):
+        self._step += 1
+        decay = min(self._decay,
+                    (1.0 + self._step) / (10.0 + self._step))
+        for name, h in self._items(scope, program):
+            cur = self._get(h, scope)
+            prev = self._shadow.get(name)
+            self._shadow[name] = cur.copy() if prev is None else \
+                decay * prev + (1.0 - decay) * cur
+
+    def apply(self, scope=None, program=None, need_restore: bool = True):
+        """Context manager: shadows in, originals restored on exit when
+        need_restore."""
+        ema = self
+
+        class _Guard:
+            def __enter__(self_g):
+                ema._backup = {}
+                for name, h in ema._items(scope, program):
+                    if name in ema._shadow:
+                        ema._backup[name] = ema._get(h, scope)
+                        ema._set(h, ema._shadow[name], scope)
+                return ema
+
+            def __exit__(self_g, *exc):
+                if need_restore:
+                    ema.restore(scope, program)
+                return False
+        return _Guard()
+
+    def restore(self, scope=None, program=None):
+        for name, h in self._items(scope, program):
+            if name in self._backup:
+                self._set(h, self._backup[name], scope)
+        self._backup = {}
+
+
+class ModelAverage:
+    """fluid.optimizer.ModelAverage (optimizer.py:3562): sliding-window
+    parameter average via the sum_1/sum_2/sum_3 accumulator rotation of
+    average_accumulates_op; apply() evaluates with the averaged weights,
+    restore() swaps back. Same dual eager/scope protocol as
+    ExponentialMovingAverage."""
+
+    def __init__(self, average_window_rate: float,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, parameters=None):
+        self._rate = float(average_window_rate)
+        self._min_w = int(min_average_window)
+        self._max_w = int(max_average_window)
+        self._params = list(parameters) if parameters is not None else None
+        self._num_updates = 0
+        self._num_accum = 0
+        self._old_num_accum = 0
+        self._sum1: Dict[str, np.ndarray] = {}
+        self._sum2: Dict[str, np.ndarray] = {}
+        self._sum3: Dict[str, np.ndarray] = {}
+        self._backup: Dict[str, np.ndarray] = {}
+
+    _items = ExponentialMovingAverage._items
+    _get = ExponentialMovingAverage._get
+    _set = ExponentialMovingAverage._set
+
+    _MAX_NUM_ACCUMULATES = 16384  # precision rotation, op.h:34
+
+    def update(self, scope=None, program=None):
+        """average_accumulates_op.h exactly: sum_1 += param each step;
+        precision rotation folds sum_1 into sum_2 every 16384 updates;
+        when num_accum >= min_window and num_accum >=
+        min(max_window, num_updates * rate) the window restarts —
+        sum_3 <- sum_1 + sum_2 (old sum_3 DISCARDED), sums zeroed."""
+        self._num_updates += 1
+        self._num_accum += 1
+        for name, h in self._items(scope, program):
+            cur = self._get(h, scope)
+            self._sum1[name] = self._sum1.get(name, 0.0) + cur
+        if self._num_updates % self._MAX_NUM_ACCUMULATES == 0:
+            for name in list(self._sum1):
+                self._sum2[name] = self._sum2.get(name, 0.0) + \
+                    self._sum1[name]
+                self._sum1[name] = np.zeros_like(
+                    np.asarray(self._sum2[name]))
+        if self._num_accum >= self._min_w and self._num_accum >= min(
+                self._max_w, self._num_updates * self._rate):
+            for name in list(self._sum1):
+                self._sum3[name] = np.asarray(
+                    self._sum1[name]) + np.asarray(
+                    self._sum2.get(name, 0.0))
+                self._sum1[name] = np.zeros_like(self._sum3[name])
+                self._sum2[name] = np.zeros_like(self._sum3[name])
+            self._old_num_accum = self._num_accum
+            self._num_accum = 0
+
+    def _averaged(self, name):
+        total = (np.asarray(self._sum1.get(name, 0.0))
+                 + np.asarray(self._sum2.get(name, 0.0))
+                 + np.asarray(self._sum3.get(name, 0.0)))
+        denom = self._num_accum + self._old_num_accum
+        return total / max(denom, 1)
+
+    def apply(self, scope=None, program=None, need_restore: bool = True):
+        ma = self
+
+        class _Guard:
+            def __enter__(self_g):
+                ma._backup = {}
+                for name, h in ma._items(scope, program):
+                    if name in ma._sum1 or name in ma._sum3:
+                        ma._backup[name] = ma._get(h, scope)
+                        ma._set(h, ma._averaged(name).astype(
+                            ma._backup[name].dtype), scope)
+                return ma
+
+            def __exit__(self_g, *exc):
+                if need_restore:
+                    ma.restore(scope, program)
+                return False
+        return _Guard()
+
+    def restore(self, scope=None, program=None):
+        for name, h in self._items(scope, program):
+            if name in self._backup:
+                self._set(h, self._backup[name], scope)
+        self._backup = {}

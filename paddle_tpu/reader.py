@@ -446,3 +446,87 @@ def xmap_readers(mapper: Callable, reader: Callable, process_num: int,
             for fut in window:
                 yield fut.result()
     return gen
+
+
+class _GeneratorDataLoader(DataLoader):
+    """DataLoader.from_generator handle (reader.py:123 from_generator):
+    the user binds a generator after construction; iteration yields
+    feed dicts (return_list=False) or lists, like the reference."""
+
+    def __init__(self, feed_list=None, capacity: int = 16,
+                 use_double_buffer: bool = True, iterable: bool = True,
+                 return_list: bool = False, drop_last: bool = True):
+        if not iterable:
+            raise NotImplementedError(
+                "from_generator(iterable=False) (the start()/reset() "
+                "protocol around Executor.run) is not supported — use "
+                "the iterable loader")
+        self.feed_names = [getattr(v, "name", str(v))
+                           for v in (feed_list or [])]
+        self.capacity = capacity
+        self.use_buffer_reader = use_double_buffer
+        self.return_list = return_list
+        self.drop_last = drop_last
+        self._gen = None
+        self.num_workers = 0
+        self.collate_fn = default_collate_fn
+
+    def _collate_rows(self, rows):
+        cols = list(zip(*rows))
+        return [np.stack([np.asarray(v) for v in col]) for col in cols]
+
+    def set_batch_generator(self, generator, places=None):
+        self._gen = generator
+        return self
+
+    def set_sample_list_generator(self, generator, places=None):
+        def batched():
+            for samples in generator():
+                yield self._collate_rows(samples)
+        self._gen = batched
+        return self
+
+    def set_sample_generator(self, generator, batch_size: int,
+                             drop_last: bool = True, places=None):
+        def batched():
+            buf = []
+            for sample in generator():
+                buf.append(sample)
+                if len(buf) == batch_size:
+                    yield self._collate_rows(buf)
+                    buf = []
+            if buf and not drop_last:
+                yield self._collate_rows(buf)
+        self._gen = batched
+        return self
+
+    def __iter__(self):
+        if self._gen is None:
+            raise RuntimeError(
+                "DataLoader.from_generator: bind data first with "
+                "set_batch_generator / set_sample_list_generator / "
+                "set_sample_generator")
+        it = self._gen()
+        if self.use_buffer_reader:
+            import jax
+            if jax.default_backend() != "cpu":
+                it = _DevicePrefetcher(it, depth=max(2, self.capacity))
+        if self.return_list or not self.feed_names:
+            return iter(it)
+        return ({n: v for n, v in zip(self.feed_names, batch)}
+                for batch in it)
+
+    def __len__(self):
+        raise TypeError("from_generator loaders have no length")
+
+
+def _dataloader_from_generator(feed_list=None, capacity: int = 16,
+                               use_double_buffer: bool = True,
+                               iterable: bool = True,
+                               return_list: bool = False,
+                               drop_last: bool = True):
+    return _GeneratorDataLoader(feed_list, capacity, use_double_buffer,
+                                iterable, return_list, drop_last)
+
+
+DataLoader.from_generator = staticmethod(_dataloader_from_generator)

@@ -186,3 +186,50 @@ def test_norm_fro_multi_axis(x):
     assert abs(got - np.sqrt((_np(x) ** 2).sum())) < 1e-5
     with pytest.raises(ValueError, match="fro"):
         T.norm(x, 1, [0, 1])
+
+
+def test_ema_and_model_average_eager():
+    from paddle_tpu.optimizer import (ExponentialMovingAverage,
+                                      ModelAverage)
+    import paddle_tpu.nn as nn
+    lin = nn.Linear(3, 2)
+    w = lin.weight
+    ema = ExponentialMovingAverage(0.5, parameters=[w])
+    v0 = np.asarray(w.value).copy()
+    ema.update()
+    w.set_value(v0 + 1.0)
+    ema.update()
+    # shadow = 0.2*v0... warmup decay = min(0.5, 2/11... wait step=2 ->
+    # min(0.5, 3/12)=0.25: shadow = 0.25*v0 + 0.75*(v0+1)
+    with ema.apply():
+        shown = np.asarray(w.value)
+        np.testing.assert_allclose(shown, 0.25 * v0 + 0.75 * (v0 + 1),
+                                   rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(w.value), v0 + 1.0)
+
+    ma = ModelAverage(0.5, min_average_window=2, max_average_window=4,
+                      parameters=[w])
+    for i in range(3):
+        w.set_value(np.full_like(v0, float(i)))
+        ma.update()
+    with ma.apply():
+        avg = np.asarray(w.value)
+        np.testing.assert_allclose(avg, np.full_like(v0, 1.0),
+                                   rtol=1e-6)  # mean(0,1,2)
+    np.testing.assert_allclose(np.asarray(w.value), 2.0)
+
+
+def test_dataloader_from_generator():
+    from paddle_tpu.reader import DataLoader
+    dl = DataLoader.from_generator(capacity=4, return_list=True)
+    dl.set_batch_generator(
+        lambda: iter([[np.ones((2, 3)), np.zeros((2, 1))]
+                      for _ in range(3)]))
+    batches = list(dl)
+    assert len(batches) == 3 and batches[0][0].shape == (2, 3)
+    dl2 = DataLoader.from_generator(capacity=4, return_list=True)
+    dl2.set_sample_list_generator(
+        lambda: iter([[(np.ones(3), np.zeros(1)) for _ in range(4)]
+                      for _ in range(2)]))
+    b2 = list(dl2)
+    assert len(b2) == 2 and b2[0][0].shape == (4, 3)
