@@ -6,3 +6,5 @@ from .control_flow import (While, Assert, Print, array_length,  # noqa: F401
                            array_read, array_write, cond, create_array,
                            increment)
 from . import control_flow  # noqa: F401
+from .auto import *  # noqa: F401,F403  (generated layer builders)
+from .auto import generate_layer_fn  # noqa: F401

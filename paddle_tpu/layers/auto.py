@@ -1,0 +1,230 @@
+"""Auto-generated layer builders — the layer_function_generator analog.
+
+The reference fills most of fluid.layers from op metadata
+(/root/reference/python/paddle/fluid/layers/layer_function_generator.py:
+generate_layer_fn builds a python wrapper from an OpProto's inputs/
+outputs; layers/ops.py registers one per listed op). Here the same
+generator reads the op registry's slot metadata, so every registered op
+with a plain tensor-in/tensor-out contract gets a fluid-style builder
+for free — dual-mode through nn.functional's dispatch.
+
+Functions the v2 tensor namespace already implements dual-mode
+(zeros/argmax/gather/...) are re-exported rather than regenerated.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.registry import REGISTRY
+from ..nn.functional import _run, _run_multi
+
+__all__ = ["generate_layer_fn"]
+
+
+def generate_layer_fn(op_type: str, out_slots=None):
+    """layer_function_generator.py:generate_layer_fn: positional args
+    map onto the op's input slots in declared order (lists allowed for
+    duplicable slots), keyword args matching slot names feed inputs,
+    everything else becomes op attrs. Returns one var, or a tuple in
+    declared output order when the op has several outputs."""
+    opdef = REGISTRY.get(op_type)
+    in_slots = list(opdef.input_slots)
+    all_out = list(out_slots or opdef.output_slots)
+
+    def fn(*args, name: Optional[str] = None, **kwargs):
+        ins = {}
+        for slot, arg in zip(in_slots, args):
+            if arg is None:
+                continue
+            ins[slot] = list(arg) if isinstance(arg, (list, tuple)) \
+                else [arg]
+        if len(args) > len(in_slots):
+            raise TypeError("%s takes at most %d tensor args (%s)"
+                            % (op_type, len(in_slots), in_slots))
+        attrs = {}
+        for k, v in kwargs.items():
+            if k in in_slots:
+                if v is not None:
+                    ins[k] = list(v) if isinstance(v, (list, tuple)) \
+                        else [v]
+            else:
+                attrs[k] = v
+        if len(all_out) == 1:
+            return _run(op_type, ins, attrs, out_slot=all_out[0])
+        outs = _run_multi(op_type, ins, attrs, all_out)
+        return tuple(outs)
+
+    fn.__name__ = op_type
+    fn.__doc__ = ("Auto-generated builder for op %r (inputs %s, "
+                  "outputs %s) — layer_function_generator analog."
+                  % (op_type, in_slots, all_out))
+    return fn
+
+
+# --- fluid.layers names backed 1:1 by a registered op ----------------------
+# (name -> (op_type, out_slots or None)); out_slots trims multi-output
+# ops whose extra outputs are intermediates in the reference builder
+_OP_BACKED = {
+    "affine_channel": ("affine_channel", None),
+    "affine_grid": ("affine_grid", None),
+    "anchor_generator": ("anchor_generator", None),
+    "add_position_encoding": ("add_position_encoding", None),
+    "bilinear_tensor_product": ("bilinear_tensor_product", None),
+    "bipartite_match": ("bipartite_match", None),
+    "box_clip": ("box_clip", None),
+    "box_coder": ("box_coder", None),
+    "box_decoder_and_assign": ("box_decoder_and_assign", None),
+    "bpr_loss": ("bpr_loss", None),
+    "center_loss": ("center_loss", None),
+    "chunk_eval": ("chunk_eval", None),
+    "clip_by_norm": ("clip_by_norm", None),
+    "collect_fpn_proposals": ("collect_fpn_proposals", None),
+    "continuous_value_model": ("cvm", None),
+    "cos_sim": ("cos_sim", None),
+    "crop": ("crop", None),
+    "crop_tensor": ("crop_tensor", None),
+    "ctc_greedy_decoder": ("ctc_greedy_decoder", None),
+    "data_norm": ("data_norm", None),
+    "deformable_conv": ("deformable_conv", None),
+    "density_prior_box": ("density_prior_box", None),
+    "dice_loss": ("dice_loss", None),
+    "distribute_fpn_proposals": ("distribute_fpn_proposals", None),
+    "edit_distance": ("edit_distance", None),
+    "elementwise_floordiv": ("elementwise_floordiv", None),
+    "elementwise_mod": ("elementwise_mod", None),
+    "elu": ("elu", None),
+    "expand": ("expand", None),
+    "expand_as": ("expand_as", None),
+    "fill_constant_batch_size_like": ("fill_constant_batch_size_like",
+                                      None),
+    "filter_by_instag": ("filter_by_instag", None),
+    "fsp_matrix": ("fsp", None),
+    "gather_tree": ("gather_tree", None),
+    "gaussian_random": ("gaussian_random", None),
+    "generate_mask_labels": ("generate_mask_labels", None),
+    "generate_proposal_labels": ("generate_proposal_labels", None),
+    "generate_proposals": ("generate_proposals", None),
+    "get_tensor_from_selected_rows": ("get_tensor_from_selected_rows",
+                                      None),
+    "grid_sampler": ("grid_sampler", None),
+    "group_norm": ("group_norm", None),
+    "hash": ("hash", None),
+    "huber_loss": ("huber_loss", None),
+    "im2sequence": ("im2sequence", None),
+    "inplace_abn": ("inplace_abn", None),
+    "instance_norm": ("instance_norm", None),
+    "iou_similarity": ("iou_similarity", None),
+    "isfinite": ("isfinite", None),
+    "kldiv_loss": ("kldiv_loss", None),
+    "l2_normalize": ("l2_normalize", None),
+    "label_smooth": ("label_smooth", None),
+    "locality_aware_nms": ("locality_aware_nms", None),
+    "lod_reset": ("lod_reset", None),
+    "log_loss": ("log_loss", None),
+    "logical_not": ("logical_not", None),
+    "lrn": ("lrn", None),
+    "lstm_unit": ("lstm_unit", None),
+    "margin_rank_loss": ("margin_rank_loss", None),
+    "matrix_nms": ("matrix_nms", None),
+    "maxout": ("maxout", None),
+    "mean_iou": ("mean_iou", None),
+    "merge_selected_rows": ("merge_selected_rows", None),
+    "mish": ("mish", None),
+    "mse_loss": ("square_error_cost", None),
+    "multiclass_nms": ("multiclass_nms", None),
+    "multiplex": ("multiplex", None),
+    "nce": ("nce", None),
+    "pad": ("pad", None),
+    "pad2d": ("pad2d", None),
+    "pad_constant_like": ("pad_constant_like", None),
+    "pixel_shuffle": ("pixel_shuffle", None),
+    "polygon_box_transform": ("polygon_box_transform", None),
+    "prelu": ("prelu", None),
+    "prior_box": ("prior_box", None),
+    "prroi_pool": ("prroi_pool", None),
+    "psroi_pool": ("psroi_pool", None),
+    "random_crop": ("random_crop", None),
+    "rank_loss": ("rank_loss", None),
+    "retinanet_detection_output": ("retinanet_detection_output", None),
+    "reverse": ("reverse", None),
+    "roi_align": ("roi_align", None),
+    "roi_perspective_transform": ("roi_perspective_transform", None),
+    "roi_pool": ("roi_pool", None),
+    "row_conv": ("row_conv", None),
+    "rpn_target_assign": ("rpn_target_assign", None),
+    "sampling_id": ("sampling_id", None),
+    "scatter_nd": ("scatter_nd", None),
+    "selu": ("selu", None),
+    "sequence_concat": ("sequence_concat", None),
+    "sequence_enumerate": ("sequence_enumerate", None),
+    "sequence_expand": ("sequence_expand", None),
+    "sequence_expand_as": ("sequence_expand_as", None),
+    "sequence_mask": ("sequence_mask", None),
+    "sequence_pad": ("sequence_pad", None),
+    "sequence_reshape": ("sequence_reshape", None),
+    "sequence_reverse": ("sequence_reverse", None),
+    "sequence_scatter": ("sequence_scatter", None),
+    "sequence_slice": ("sequence_slice", None),
+    "sequence_softmax": ("sequence_softmax", None),
+    "sequence_unpad": ("sequence_unpad", None),
+    "shard_index": ("shard_index", None),
+    "shuffle_channel": ("shuffle_channel", None),
+    "sigmoid_cross_entropy_with_logits":
+        ("sigmoid_cross_entropy_with_logits", None),
+    "sigmoid_focal_loss": ("sigmoid_focal_loss", None),
+    "similarity_focus": ("similarity_focus", None),
+    "smooth_l1": ("smooth_l1_loss", None),
+    "space_to_depth": ("space_to_depth", None),
+    "spectral_norm": ("spectral_norm", None),
+    "stanh": ("stanh", None),
+    "target_assign": ("target_assign", None),
+    "teacher_student_sigmoid_loss": ("teacher_student_sigmoid_loss",
+                                     None),
+    "temporal_shift": ("temporal_shift", None),
+    "unbind": ("unbind", None),
+    "unfold": ("unfold", None),
+    "uniform_random": ("uniform_random", None),
+    "warpctc": ("warpctc", None),
+    "yolo_box": ("yolo_box", None),
+    "yolov3_loss": ("yolov3_loss", None),
+}
+
+
+def _install():
+    import sys
+    installed = []
+    for name, (op_type, outs) in sorted(_OP_BACKED.items()):
+        if not REGISTRY.has(op_type):
+            continue
+        globals()[name] = generate_layer_fn(op_type, outs)
+        installed.append(name)
+    __all__.extend(installed)
+
+    # names the v2 tensor namespace implements dual-mode already
+    from .. import tensor as _T
+    reexport = [
+        "argmax", "argmin", "argsort", "diag", "eye", "gather",
+        "gather_nd", "linspace", "ones", "ones_like", "pow", "range",
+        "scatter", "scatter_nd_add", "shape", "slice", "split",
+        "squeeze", "stack", "strided_slice", "triu", "unique",
+        "unique_with_counts", "unsqueeze", "unstack", "where", "zeros",
+        "zeros_like",
+    ]
+    alias = {"range": "arange", "unique_with_counts": "unique"}
+    for name in reexport:
+        src = alias.get(name, name)
+        if hasattr(_T, src):
+            globals()[name] = getattr(_T, src)
+            __all__.append(name)
+
+
+def sum(x, name=None):  # noqa: A001
+    """fluid.layers.sum: elementwise sum of a LIST of tensors (sum_op)
+    — NOT the v2 reduction (that is paddle.sum / tensor.sum)."""
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    return _run("sum", {"X": xs}, {})
+
+
+__all__.append("sum")
+
+_install()

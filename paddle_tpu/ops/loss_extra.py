@@ -437,3 +437,107 @@ def _crf_decoding(ctx, ins, attrs):
         correct = (path == label) & mask
         return {"ViterbiPath": [correct.astype(jnp.int64)]}
     return {"ViterbiPath": [path.astype(jnp.int64)]}
+
+
+@register_op("dice_loss", inputs=("X", "Label"),
+             non_diff_inputs=("Label",))
+def _dice_loss(ctx, ins, attrs):
+    """nn.py dice_loss composition (the reference builds it from
+    elementwise ops; one op here): 1 - 2*|X∩L| / (|X| + |L|)."""
+    x = ins["X"][0]
+    label = ins["Label"][0].astype(x.dtype)
+    if label.shape != x.shape and label.shape[-1] == 1:
+        label = jnp.squeeze(label, -1)
+        label = jax.nn.one_hot(label.astype(jnp.int32), x.shape[-1],
+                               dtype=x.dtype)
+    eps = float(attrs.get("epsilon", 1e-5))
+    red = tuple(range(1, x.ndim))
+    inter = jnp.sum(x * label, axis=red)
+    union = jnp.sum(x, axis=red) + jnp.sum(label, axis=red)
+    # epsilon in the DENOMINATOR only (nn.py:7104) — empty gt + empty
+    # pred must cost 1.0, not 0.0
+    return one(jnp.mean(1.0 - 2.0 * inter / (union + eps)).reshape(1))
+
+
+@register_op("mean_iou", inputs=("Predictions", "Labels"),
+             outputs=("OutMeanIou", "OutWrong", "OutCorrect"),
+             no_grad=True)
+def _mean_iou(ctx, ins, attrs):
+    """mean_iou_op.cc: mean intersection-over-union over classes."""
+    pred = ins["Predictions"][0].astype(jnp.int32).reshape(-1)
+    label = ins["Labels"][0].astype(jnp.int32).reshape(-1)
+    n = int(attrs["num_classes"])
+    ph = jax.nn.one_hot(pred, n, dtype=jnp.float32)
+    lh = jax.nn.one_hot(label, n, dtype=jnp.float32)
+    inter = jnp.sum(ph * lh, axis=0)
+    union = jnp.sum(ph, axis=0) + jnp.sum(lh, axis=0) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.where(valid, union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+    wrong = jnp.sum(ph, axis=0) - inter
+    return {"OutMeanIou": [miou.reshape(())],
+            "OutWrong": [wrong.astype(jnp.int32)],
+            "OutCorrect": [inter.astype(jnp.int32)]}
+
+
+@register_op("edit_distance", inputs=("Hyps", "Refs", "HypsLength",
+                                      "RefsLength"),
+             outputs=("Out", "SequenceNum"), no_grad=True, host=True)
+def _edit_distance(ctx, ins, attrs):
+    """edit_distance_op.cc: Levenshtein distance per sequence pair
+    (host op — classic DP, ragged lengths)."""
+    hyps = np.asarray(ins["Hyps"][0])
+    refs = np.asarray(ins["Refs"][0])
+    if hyps.ndim == 3:
+        hyps = hyps[..., 0]
+        refs = refs[..., 0]
+    B = hyps.shape[0]
+    hl = np.asarray(ins["HypsLength"][0]).reshape(-1).astype(int) \
+        if ins.get("HypsLength") else np.full(B, hyps.shape[1])
+    rl = np.asarray(ins["RefsLength"][0]).reshape(-1).astype(int) \
+        if ins.get("RefsLength") else np.full(B, refs.shape[1])
+    normalized = bool(attrs.get("normalized", True))
+    out = np.zeros((B, 1), np.float32)
+    for b in range(B):
+        h = hyps[b, :hl[b]]
+        r = refs[b, :rl[b]]
+        m, n = len(h), len(r)
+        d = np.arange(n + 1, dtype=np.float32)
+        for i in range(1, m + 1):
+            prev = d.copy()
+            d[0] = i
+            for j in range(1, n + 1):
+                d[j] = min(prev[j] + 1, d[j - 1] + 1,
+                           prev[j - 1] + (h[i - 1] != r[j - 1]))
+        dist = d[n]
+        out[b, 0] = dist / max(n, 1) if normalized else dist
+    return {"Out": [out], "SequenceNum": [np.asarray([B], np.int64)]}
+
+
+@register_op("ctc_greedy_decoder", inputs=("Input", "InputLength"),
+             outputs=("Out", "OutLength"), no_grad=True, host=True)
+def _ctc_greedy_decoder(ctx, ins, attrs):
+    """ctc_align / greedy decode: argmax per step, collapse repeats,
+    drop blanks (host op, ragged output padded with -1)."""
+    x = np.asarray(ins["Input"][0])  # [B, T, C] probs
+    blank = int(attrs.get("blank", 0))
+    B, T, _ = x.shape
+    lens = np.asarray(ins["InputLength"][0]).reshape(-1).astype(int) \
+        if ins.get("InputLength") else np.full(B, T)
+    paths = []
+    for b in range(B):
+        ids = x[b, :lens[b]].argmax(-1)
+        out = []
+        prev = -1
+        for t in ids:
+            if t != prev and t != blank:
+                out.append(int(t))
+            prev = int(t)
+        paths.append(out)
+    maxlen = max((len(p) for p in paths), default=0) or 1
+    res = np.full((B, maxlen), -1, np.int64)
+    for b, p in enumerate(paths):
+        res[b, :len(p)] = p
+    return {"Out": [res],
+            "OutLength": [np.asarray([len(p) for p in paths],
+                                     np.int64).reshape(-1, 1)]}

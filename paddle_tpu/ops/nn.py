@@ -891,3 +891,87 @@ def _inplace_abn(ctx, ins, attrs):
         y = getattr(jax.nn, act)(y)
     outs["Y"] = [y]
     return outs
+
+
+@register_op("maxout", inputs=("X",))
+def _maxout(ctx, ins, attrs):
+    """maxout_op.cc: channel groups of `groups` reduced by max
+    (NCHW: C -> C/groups)."""
+    x = ins["X"][0]
+    g = int(attrs["groups"])
+    axis = int(attrs.get("axis", 1))
+    if axis < 0:
+        axis += x.ndim
+    c = x.shape[axis]
+    shape = list(x.shape)
+    shape[axis:axis + 1] = [c // g, g]
+    return one(jnp.max(x.reshape(shape), axis=axis + 1))
+
+
+@register_op("add_position_encoding", inputs=("X",))
+def _add_position_encoding(ctx, ins, attrs):
+    """add_position_encoding_op.cc: x*alpha + sinusoid(pos)*beta,
+    the transformer position table computed in-graph."""
+    x = ins["X"][0]
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    rank2 = x.ndim == 2  # LoD form [N, D]: one running sequence
+    if rank2:
+        x = x[None]
+    B, T, D = x.shape
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    half = (D + 1) // 2
+    div = jnp.exp(jnp.arange(half, dtype=jnp.float32)
+                  * -(np.log(10000.0) / max(half - 1, 1)))
+    enc = jnp.concatenate([jnp.sin(pos * div), jnp.cos(pos * div)],
+                          axis=1)[:, :D]  # odd D: trim the cos tail
+    out = x * alpha + enc[None].astype(x.dtype) * beta
+    return one(out[0] if rank2 else out)
+
+
+@register_op("bilinear_tensor_product",
+             inputs=("X", "Y", "Weight", "Bias"))
+def _bilinear_tensor_product(ctx, ins, attrs):
+    """bilinear_tensor_product_op.cc: out[:, k] = x @ W[k] @ y^T diag."""
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]  # w [K, M, N]
+    out = jnp.einsum("bm,kmn,bn->bk", x, w, y)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    return one(out)
+
+
+@register_op("similarity_focus", inputs=("X",), no_grad=True)
+def _similarity_focus(ctx, ins, attrs):
+    """similarity_focus_op.h: for each indexed channel slice, greedily
+    select min(H, W) maxima with pairwise-distinct rows AND columns
+    (the reference walks positions in descending order skipping used
+    rows/cols); the union over indexes lights the mask across all
+    channels. Static unrolled greedy — min(H, W) steps."""
+    x = ins["X"][0]  # [B, C, H, W]
+    axis = int(attrs.get("axis", 1))
+    indexes = list(attrs.get("indexes", [0]))
+    if axis != 1:
+        raise NotImplementedError("similarity_focus: axis=1 (channel) "
+                                  "only on TPU")
+    sel = x[:, jnp.asarray(indexes, jnp.int32)]   # [B, I, H, W]
+    B, I, H, W = sel.shape
+    k = min(H, W)
+    neg = jnp.asarray(-jnp.inf, sel.dtype)
+    scores = sel
+    picked = jnp.zeros((B, I, H, W), bool)
+    row_used = jnp.zeros((B, I, H), bool)
+    col_used = jnp.zeros((B, I, W), bool)
+    for _ in range(k):
+        masked = jnp.where(row_used[..., :, None]
+                           | col_used[..., None, :], neg, scores)
+        flat = masked.reshape(B, I, H * W)
+        idx = jnp.argmax(flat, axis=2)
+        r, c = idx // W, idx % W
+        picked = picked | (
+            (jnp.arange(H)[None, None, :, None] == r[..., None, None])
+            & (jnp.arange(W)[None, None, None, :] == c[..., None, None]))
+        row_used = row_used | jax.nn.one_hot(r, H, dtype=bool)
+        col_used = col_used | jax.nn.one_hot(c, W, dtype=bool)
+    mask2d = picked.any(axis=1)
+    return one(jnp.broadcast_to(mask2d[:, None], x.shape)
+               .astype(x.dtype))

@@ -650,3 +650,18 @@ def _get_tensor_from_selected_rows(ctx, ins, attrs):
     x = ins["X"][0]
     assert isinstance(x, SelectedRows)
     return one(x.to_dense())
+
+
+@register_op("scatter_nd", inputs=("Index", "Updates", "Shape"),
+             non_diff_inputs=("Index", "Shape"))
+def _scatter_nd(ctx, ins, attrs):
+    """scatter_nd_op.cc: zeros of `shape` with Updates scatter-added at
+    Index (the functional twin of scatter_nd_add)."""
+    idx = ins["Index"][0].astype(jnp.int32)
+    upd = ins["Updates"][0]
+    if ins.get("Shape"):
+        shape = [int(s) for s in np.asarray(ins["Shape"][0])]
+    else:
+        shape = list(attrs["shape"])
+    zeros = jnp.zeros(shape, upd.dtype)
+    return one(zeros.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd))

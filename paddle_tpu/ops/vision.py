@@ -478,3 +478,42 @@ def _deformable_conv_v1(ctx, ins, attrs):
     sub = {"Input": ins["Input"], "Offset": ins["Offset"],
            "Filter": ins["Filter"]}
     return _R.get("deformable_conv").lower(ctx, sub, attrs)
+
+
+@register_op("random_crop", inputs=("X", "Seed"),
+             outputs=("Out", "SeedOut"), no_grad=True, is_random=True)
+def _random_crop(ctx, ins, attrs):
+    """random_crop_op.h: per-INSTANCE uniform crop offsets over the
+    trailing `shape` dims (the reference draws an engine per instance);
+    a nonzero Seed input drives the keys deterministically and SeedOut
+    advances it for the next step."""
+    import jax
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    nd = len(shape)
+    batch_dims = x.shape[:x.ndim - nd]
+    n = 1
+    for b in batch_dims:
+        n *= b
+    if ins.get("Seed"):
+        seed = ins["Seed"][0].reshape(-1)[0].astype(jnp.uint32)
+        key = jax.random.key_data(jax.random.PRNGKey(0)) * 0 +             jnp.stack([seed, seed ^ jnp.uint32(0x9e3779b9)])
+        key = key.astype(jnp.uint32)
+    else:
+        key = ctx.rng()
+    flat = x.reshape((n,) + x.shape[x.ndim - nd:])
+    keys = jax.random.split(key, n * nd).reshape(n, nd, 2)
+
+    def crop_one(xi, ki):
+        starts = [jax.random.randint(ki[i], (), 0,
+                                     xi.shape[i] - shape[i] + 1)
+                  for i in range(nd)]
+        return jax.lax.dynamic_slice(xi, starts, shape)
+
+    out = jax.vmap(crop_one)(flat, keys)
+    out = out.reshape(tuple(batch_dims) + tuple(shape))
+    if ins.get("Seed"):
+        seed_out = (ins["Seed"][0] + 1).astype(ins["Seed"][0].dtype)
+    else:
+        seed_out = jnp.zeros((1,), jnp.int64)
+    return {"Out": [out], "SeedOut": [seed_out]}

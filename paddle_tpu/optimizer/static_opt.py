@@ -856,11 +856,11 @@ class ExponentialMovingAverage:
 
     def __init__(self, decay: float = 0.999, thres_steps=None,
                  parameters=None):
-        if thres_steps is not None:
-            raise NotImplementedError(
-                "ExponentialMovingAverage: thres_steps (an external "
-                "step variable driving the warmup) is not supported — "
-                "warmup follows this instance's update() count")
+        # reference optimizer.py:3575: warmup decay ONLY when
+        # thres_steps is given; otherwise the constant decay applies
+        # from step one. The warmup here follows this instance's
+        # update() count instead of an external step variable.
+        self._warmup = thres_steps is not None
         self._decay = float(decay)
         self._params = list(parameters) if parameters is not None else None
         self._step = 0
@@ -889,7 +889,8 @@ class ExponentialMovingAverage:
     def update(self, scope=None, program=None):
         self._step += 1
         decay = min(self._decay,
-                    (1.0 + self._step) / (10.0 + self._step))
+                    (1.0 + self._step) / (10.0 + self._step)) \
+            if self._warmup else self._decay
         for name, h in self._items(scope, program):
             cur = self._get(h, scope)
             prev = self._shadow.get(name)

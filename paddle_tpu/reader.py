@@ -487,7 +487,10 @@ class _GeneratorDataLoader(DataLoader):
         return self
 
     def set_sample_generator(self, generator, batch_size: int,
-                             drop_last: bool = True, places=None):
+                             drop_last: Optional[bool] = None,
+                             places=None):
+        if drop_last is None:
+            drop_last = self.drop_last  # constructor flag is the default
         def batched():
             buf = []
             for sample in generator():

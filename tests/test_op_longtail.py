@@ -360,3 +360,79 @@ def test_trainer_factory_selection():
     assert sorted(out) == [i * 2 for i in range(10)]
     with pytest.raises(ValueError):
         f._create_trainer({"trainer": "NoSuch", "device_worker": "Hogwild"})
+
+
+def test_generated_layer_builders():
+    """layer_function_generator analog: auto-generated fluid.layers
+    builders work dual-mode over registry metadata."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    # eager: a few representative generated builders
+    x = pt.to_tensor(np.random.RandomState(0).randn(2, 6).astype(np.float32))
+    out = layers.l2_normalize(x, axis=1)
+    n = np.linalg.norm(np.asarray(out.value), axis=1)
+    np.testing.assert_allclose(n, 1.0, rtol=1e-4)
+
+    img = pt.to_tensor(np.random.RandomState(1).randn(1, 4, 4, 4)
+                       .astype(np.float32))
+    up = layers.pixel_shuffle(img, upscale_factor=2)
+    assert np.asarray(up.value).shape == (1, 1, 8, 8)
+
+    a = pt.to_tensor(np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    rev = layers.reverse(a, axis=[0])
+    np.testing.assert_array_equal(np.asarray(rev.value),
+                                  [[3, 4], [1, 2]])
+
+    # static: generated builder appends an op into the program
+    main, startup = pt.Program(), pt.Program()
+    from paddle_tpu.core.program import disable_static, enable_static
+    enable_static()
+    try:
+        with pt.program_guard(main, startup):
+            d = layers.data("d", [4])
+            y = layers.label_smooth(d, epsilon=0.1)
+    finally:
+        disable_static()
+    assert any(op.type == "label_smooth"
+               for op in main.global_block.ops)
+
+    # re-exported tensor-namespace names resolve
+    assert layers.zeros is not None and layers.argmax is not None
+
+
+def test_new_ops_oracles():
+    r = np.random.RandomState(5)
+    # maxout
+    x = r.randn(2, 6, 3, 3).astype(np.float32)
+    o = run_op("maxout", {"X": x}, {"groups": 2})
+    np.testing.assert_allclose(
+        np.asarray(o["Out"][0]),
+        x.reshape(2, 3, 2, 3, 3).max(2), rtol=1e-6)
+    # mean_iou: perfect prediction -> 1.0
+    pred = np.asarray([0, 1, 2, 1], np.int64)
+    o = run_op("mean_iou", {"Predictions": pred, "Labels": pred},
+               {"num_classes": 3})
+    assert abs(float(np.asarray(o["OutMeanIou"][0])) - 1.0) < 1e-6
+    # edit distance oracle
+    hyps = np.asarray([[1, 2, 3]], np.int64)
+    refs = np.asarray([[1, 3, 3]], np.int64)
+    o = run_op("edit_distance", {"Hyps": hyps, "Refs": refs},
+               {"normalized": False})
+    assert float(np.asarray(o["Out"][0])[0, 0]) == 1.0
+    # ctc greedy decode collapses repeats and blanks
+    probs = np.zeros((1, 5, 4), np.float32)
+    for t, c in enumerate([1, 1, 0, 2, 2]):
+        probs[0, t, c] = 1.0
+    o = run_op("ctc_greedy_decoder", {"Input": probs}, {"blank": 0})
+    np.testing.assert_array_equal(np.asarray(o["Out"][0])[0, :2], [1, 2])
+    # scatter_nd
+    idx = np.asarray([[1], [3]], np.int64)
+    upd = np.asarray([9.0, 7.0], np.float32)
+    o = run_op("scatter_nd", {"Index": idx, "Updates": upd},
+               {"shape": [5]})
+    np.testing.assert_array_equal(np.asarray(o["Out"][0]),
+                                  [0, 9, 0, 7, 0])
+    # dice loss: perfect overlap -> ~0
+    p = np.asarray([[0.0, 1.0], [1.0, 0.0]], np.float32)
+    o = run_op("dice_loss", {"X": p, "Label": p}, {})
+    assert float(np.asarray(o["Out"][0])[0]) < 1e-4

@@ -194,18 +194,27 @@ def test_ema_and_model_average_eager():
     import paddle_tpu.nn as nn
     lin = nn.Linear(3, 2)
     w = lin.weight
+    # thres_steps=None -> constant decay (reference optimizer.py:3575)
     ema = ExponentialMovingAverage(0.5, parameters=[w])
     v0 = np.asarray(w.value).copy()
     ema.update()
     w.set_value(v0 + 1.0)
     ema.update()
-    # shadow = 0.2*v0... warmup decay = min(0.5, 2/11... wait step=2 ->
-    # min(0.5, 3/12)=0.25: shadow = 0.25*v0 + 0.75*(v0+1)
     with ema.apply():
         shown = np.asarray(w.value)
-        np.testing.assert_allclose(shown, 0.25 * v0 + 0.75 * (v0 + 1),
+        np.testing.assert_allclose(shown, 0.5 * v0 + 0.5 * (v0 + 1),
                                    rtol=1e-6)
     np.testing.assert_allclose(np.asarray(w.value), v0 + 1.0)
+    # thres_steps given -> warmup decay min(d, (1+t)/(10+t))
+    w.set_value(v0)
+    ema2 = ExponentialMovingAverage(0.5, thres_steps=True,
+                                    parameters=[w])
+    ema2.update()
+    w.set_value(v0 + 1.0)
+    ema2.update()
+    with ema2.apply():
+        np.testing.assert_allclose(
+            np.asarray(w.value), 0.25 * v0 + 0.75 * (v0 + 1), rtol=1e-6)
 
     ma = ModelAverage(0.5, min_average_window=2, max_average_window=4,
                       parameters=[w])
