@@ -7,6 +7,7 @@ core/control_flow.py.
 """
 from __future__ import annotations
 
+import numpy as np
 from typing import Optional, Sequence
 
 from ..core.program import VarDesc, default_main_program
@@ -15,6 +16,19 @@ from .helper import LayerHelper
 __all__ = ["While", "cond", "increment", "array_write", "array_read",
            "array_length", "create_array", "Print", "Assert"]
 
+
+
+
+def _parent_writes(sub, parent):
+    """Output vars a sub-block writes that exist in the parent — the
+    structural op's Out list (shared by While and conditional blocks)."""
+    writes = []
+    for op in sub.ops:
+        for ns in op.outputs.values():
+            for n in ns:
+                if parent.has_var(n) and n not in writes:
+                    writes.append(n)
+    return writes
 
 class While:
     """layers/control_flow.py:1021:
@@ -51,15 +65,8 @@ class While:
             if exc and exc[0] is not None:
                 return False
             w = self._w
-            # outputs: every var the sub-block writes that exists in the
-            # parent too (in-place loop vars)
             parent = w.program.current_block()
-            writes = []
-            for op in self._sub.ops:
-                for ns in op.outputs.values():
-                    for n in ns:
-                        if parent.has_var(n) and n not in writes:
-                            writes.append(n)
+            writes = _parent_writes(self._sub, parent)
             parent.append_op(
                 "while",
                 inputs={"Condition": [w.cond_var.name]},
@@ -175,3 +182,169 @@ def Assert(cond: VarDesc, data: Optional[Sequence[VarDesc]] = None,
         inputs={"Cond": [cond.name],
                 "Data": [d.name for d in (data or [])]},
         outputs={}, attrs={"summarize": summarize})
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test: bool = False,
+               name: Optional[str] = None):
+    """layers.while_loop (control_flow.py:1111): functional while over
+    graph-built cond/body. Dygraph runs the python loop directly (the
+    reference does the same in imperative mode); static mode builds the
+    while op's sub-block from body_fn and lowers to lax.while_loop."""
+    from ..core.program import in_dygraph_mode
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise ValueError("while_loop: loop_vars must be a non-empty "
+                         "list")
+    loop_vars = list(loop_vars)
+    if in_dygraph_mode():
+        while True:
+            c = cond_fn(*loop_vars)
+            if not bool(np.asarray(c.value if hasattr(c, "value")
+                                   else c)):
+                break
+            out = body_fn(*loop_vars)
+            loop_vars = list(out) if isinstance(out, (tuple, list)) \
+                else [out]
+        return loop_vars
+
+    from .nn import assign, logical_and  # noqa: F401
+    helper = LayerHelper("while_loop", name)
+    cond_var = cond_fn(*loop_vars)
+    w = While(cond_var, is_test=is_test)
+    with w.block():
+        out = body_fn(*loop_vars)
+        out = list(out) if isinstance(out, (tuple, list)) else [out]
+        if len(out) != len(loop_vars):
+            raise ValueError("while_loop: body returned %d vars for %d "
+                             "loop vars" % (len(out), len(loop_vars)))
+        for res, var in zip(out, loop_vars):
+            if res.name != var.name:
+                helper.append_op("assign", inputs={"X": [res.name]},
+                                 outputs={"Out": [var.name]})
+        new_cond = cond_fn(*loop_vars)
+        helper.append_op("assign", inputs={"X": [new_cond.name]},
+                         outputs={"Out": [cond_var.name]})
+    return loop_vars
+
+
+def case(pred_fn_pairs, default=None, name: Optional[str] = None):
+    """layers.case (control_flow.py:2026): first true predicate wins;
+    `default` (or the LAST branch, like the reference) handles the
+    fall-through. Composed from nested cond calls."""
+    if not pred_fn_pairs:
+        raise ValueError("case: pred_fn_pairs must be non-empty")
+    pairs = list(pred_fn_pairs)
+    if default is None:
+        _, default = pairs[-1]
+        pairs = pairs[:-1]
+        if not pairs:
+            return default()
+
+    def build(i):
+        if i == len(pairs):
+            return default
+        pred, fn = pairs[i]
+        return lambda: cond(pred, fn, build(i + 1))
+
+    return build(0)()
+
+
+def switch_case(branch_index, branch_fns, default=None,
+                name: Optional[str] = None):
+    """layers.switch_case (control_flow.py:2387): integer-indexed
+    branch selection (dict or list of fns)."""
+    from .nn import equal as _eq, fill_constant
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    if default is None:
+        default = items[-1][1]
+        items = items[:-1]
+        if not items:
+            return default()
+    pairs = []
+    for idx, fn in items:
+        pairs.append((_eq(branch_index,
+                          fill_constant([1], value=int(idx),
+                                        dtype=branch_index.dtype)),
+                      fn))
+    return case(pairs, default=default)
+
+
+class Switch:
+    """fluid.layers.Switch (control_flow.py:1524):
+
+        with Switch() as switch:
+            with switch.case(cond1): ...assign...
+            with switch.default(): ...assign...
+
+    Builds the same first-match semantics as `case`; each with-block
+    appends ops into a conditional_block guarded by the accumulated
+    not-any-previous predicate."""
+
+    def __init__(self, name: Optional[str] = None):
+        self._helper = LayerHelper("switch", name)
+        self._prev = None  # OR of earlier case predicates
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    class _CaseGuard:
+        def __init__(self, sw, pred):
+            from .nn import logical_and
+            from .auto import logical_not
+            if sw._prev is not None:
+                pred = logical_and(pred, logical_not(sw._prev))
+            self._block = _ConditionalBlock(pred)
+            from .nn import logical_or
+            sw._prev = pred if sw._prev is None else \
+                logical_or(sw._prev, pred)
+
+        def __enter__(self):
+            return self._block.__enter__()
+
+        def __exit__(self, *exc):
+            return self._block.__exit__(*exc)
+
+    def case(self, condition):
+        return Switch._CaseGuard(self, condition)
+
+    def default(self):
+        from .auto import logical_not
+        if self._prev is None:
+            raise ValueError("Switch.default before any case")
+        guard = Switch._CaseGuard.__new__(Switch._CaseGuard)
+        guard._block = _ConditionalBlock(logical_not(self._prev))
+        return guard
+
+
+class _ConditionalBlock:
+    """with-block appending ops under a conditional_block op whose
+    outputs are the vars assigned inside (IfElse/Switch building
+    block, control_flow.py ConditionalBlock)."""
+
+    def __init__(self, pred: VarDesc):
+        self._pred = pred
+        self._program = default_main_program()
+
+    def __enter__(self):
+        self._sub = self._program.create_block()
+        self._guard = self._program.block_guard(self._sub)
+        self._guard.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._guard.__exit__(*exc)
+        if exc and exc[0] is not None:
+            return False
+        parent = self._program.current_block()
+        writes = _parent_writes(self._sub, parent)
+        parent.append_op(
+            "conditional_block",
+            inputs={"Cond": [self._pred.name]},
+            outputs={"Out": writes},
+            attrs={"sub_block": self._sub.idx})
+        return False

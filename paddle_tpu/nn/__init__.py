@@ -12,3 +12,5 @@ from .layers_lib import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm,  # noqa: F401
 from .transformer import (MultiHeadAttention, Transformer,  # noqa: F401
                           TransformerDecoder, TransformerDecoderLayer,
                           TransformerEncoder, TransformerEncoderLayer)
+from .rnn import (BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN,  # noqa: F401
+                  SimpleRNN, SimpleRNNCell)

@@ -1,0 +1,376 @@
+"""The v2 RNN API — cells, the RNN scan wrapper, LSTM/GRU/SimpleRNN.
+
+Analog of /root/reference/python/paddle/fluid/layers/rnn.py (RNNCell,
+rnn:441, birnn) surfaced in paddle.nn (SimpleRNNCell/LSTMCell/GRUCell,
+RNN, LSTM/GRU/SimpleRNN with num_layers + bidirect).
+
+TPU design: one code path — each cell exposes a pure step on raw
+arrays, and RNN runs it under lax.scan inside a single taped apply_fn,
+so the whole sequence is ONE differentiable XLA loop (no per-step op
+dispatch), with parameters passed as explicit vjp arguments. Gate
+orders follow the cuDNN/torch convention the kernel module documents
+(ops/rnn.py: [i, f, c~, o] for LSTM; [r, z, c] here for GRU —
+paddle.nn's own order).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..dygraph import tape
+from ..dygraph.tape import Tensor
+from .layer import Layer, LayerList
+from ..layers.helper import Uniform
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    """paddle.nn.RNNCellBase: cells own weight_ih [G*H, I],
+    weight_hh [G*H, H], bias_ih/bias_hh [G*H]."""
+
+    def __init__(self, input_size: int, hidden_size: int, gates: int):
+        super().__init__()
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter(
+            [gates * hidden_size, input_size], default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [gates * hidden_size, hidden_size], default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [gates * hidden_size], default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [gates * hidden_size], default_initializer=init)
+
+    def _params(self):
+        return [self.weight_ih, self.weight_hh, self.bias_ih,
+                self.bias_hh]
+
+    # subclasses: pure step on raw arrays
+    #   raw_step(w_ih, w_hh, b_ih, b_hh, x_t, states) -> (out, states)
+
+    def get_initial_states(self, batch):
+        import jax.numpy as jnp
+        z = jnp.zeros((batch, self.hidden_size), jnp.float32)
+        return self.init_state_shape(z)
+
+    def forward(self, inputs, states=None):
+        """Single step: inputs [B, I]."""
+        x = inputs if isinstance(inputs, Tensor) else Tensor(inputs)
+        if states is None:
+            states = self.get_initial_states(x.shape[0])
+            states = tape_map(Tensor, states)
+        flat_states = flatten_states(states)
+
+        def raw(xv, *rest):
+            ws, sts = rest[:4], rest[4:]
+            out, new_sts = self.raw_step(*ws, xv, sts)
+            return [out] + list(new_sts)
+
+        outs = tape.apply_fn(raw, x, *self._params(), *flat_states)
+        return outs[0], unflatten_states(self, outs[1:])
+
+
+def tape_map(fn, states):
+    if isinstance(states, (tuple, list)):
+        return tuple(tape_map(fn, s) for s in states)
+    return fn(states)
+
+
+def flatten_states(states):
+    if isinstance(states, (tuple, list)):
+        out = []
+        for s in states:
+            out.extend(flatten_states(s))
+        return out
+    return [states]
+
+
+def unflatten_states(cell, flat):
+    """Rebuild the cell's state pytree from flat — structure comes from
+    the cell's OWN init_state_shape, so custom multi-state cells keep
+    every element."""
+    import jax.numpy as jnp
+    proto = cell.init_state_shape(jnp.zeros((1, 1)))
+    if isinstance(proto, (tuple, list)):
+        return tuple(flat[:len(proto)])
+    return flat[0]
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size: int, hidden_size: int,
+                 activation: str = "tanh"):
+        super().__init__(input_size, hidden_size, gates=1)
+        if activation not in ("tanh", "relu"):
+            raise ValueError("SimpleRNNCell activation must be tanh or "
+                             "relu")
+        self.activation = activation
+
+    def init_state_shape(self, z):
+        return z
+
+    def raw_step(self, w_ih, w_hh, b_ih, b_hh, x, states):
+        import jax.numpy as jnp
+        (h,) = states
+        g = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        h2 = jnp.tanh(g) if self.activation == "tanh" else \
+            jnp.maximum(g, 0.0)
+        return h2, (h2,)
+
+
+class LSTMCell(RNNCellBase):
+    """Gate order [i, f, c~(g), o] — paddle.nn.LSTMCell layout."""
+
+    def __init__(self, input_size: int, hidden_size: int):
+        super().__init__(input_size, hidden_size, gates=4)
+
+    def init_state_shape(self, z):
+        return (z, z)
+
+    def raw_step(self, w_ih, w_hh, b_ih, b_hh, x, states):
+        import jax
+        import jax.numpy as jnp
+        h, c = states
+        g = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, gg, o = jnp.split(g, 4, axis=-1)
+        c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gg)
+        h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+        return h2, (h2, c2)
+
+
+class GRUCell(RNNCellBase):
+    """Gate order [r, z, c] (paddle.nn.GRUCell: reset, update,
+    candidate; candidate uses r * (h @ W_hc + b_hc))."""
+
+    def __init__(self, input_size: int, hidden_size: int):
+        super().__init__(input_size, hidden_size, gates=3)
+
+    def init_state_shape(self, z):
+        return z
+
+    def raw_step(self, w_ih, w_hh, b_ih, b_hh, x, states):
+        import jax
+        import jax.numpy as jnp
+        (h,) = states
+        gx = x @ w_ih.T + b_ih
+        gh = h @ w_hh.T + b_hh
+        xr, xz, xc = jnp.split(gx, 3, axis=-1)
+        hr, hz, hc = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        c = jnp.tanh(xc + r * hc)
+        h2 = (1.0 - z) * c + z * h
+        return h2, (h2,)
+
+
+class RNN(Layer):
+    """paddle.nn.RNN: scan a cell over time (rnn.py:441). inputs
+    [B, T, I] (time_major=False) -> outputs [B, T, H], final states."""
+
+    def __init__(self, cell: RNNCellBase, is_reverse: bool = False,
+                 time_major: bool = False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None,
+                sequence_length=None):
+        import jax
+        import jax.numpy as jnp
+        x = inputs if isinstance(inputs, Tensor) else Tensor(inputs)
+        batch_axis = 1 if self.time_major else 0
+        B = x.shape[batch_axis]
+        if initial_states is None:
+            init = tape_map(Tensor, self.cell.get_initial_states(B))
+        else:
+            # keep the caller's Tensors — a learned h0 must receive
+            # gradients through apply_fn
+            init = tape_map(
+                lambda s: s if isinstance(s, Tensor) else Tensor(s),
+                initial_states)
+        flat_init = flatten_states(init)
+        n_states = len(flat_init)
+        seq = sequence_length
+        seq_v = None
+        if seq is not None:
+            seq_v = seq if isinstance(seq, Tensor) else Tensor(seq)
+        cell = self.cell
+        time_major = self.time_major
+        reverse = self.is_reverse
+
+        def raw(xv, *rest):
+            ws = rest[:4]
+            sts = rest[4:4 + n_states]
+            lens = rest[4 + n_states] if seq_v is not None else None
+            xs = xv if time_major else jnp.swapaxes(xv, 0, 1)  # [T,B,I]
+            T = xs.shape[0]
+            mask = None
+            if lens is not None:
+                mask = (jnp.arange(T)[:, None]
+                        < lens.reshape(-1)[None, :].astype(jnp.int32))
+            if reverse:
+                xs = jnp.flip(xs, axis=0)
+                mask = jnp.flip(mask, axis=0) if mask is not None \
+                    else None
+
+            def step(carry, inp):
+                x_t, m_t = inp if mask is not None else (inp, None)
+                out, new = cell.raw_step(*ws, x_t, carry)
+                if m_t is not None:
+                    keep = m_t[:, None]
+                    new = tuple(jnp.where(keep, n, c)
+                                for n, c in zip(new, carry))
+                    out = jnp.where(keep, out, jnp.zeros_like(out))
+                return tuple(new), out
+
+            xsin = (xs, mask) if mask is not None else xs
+            final, outs = jax.lax.scan(step, tuple(sts), xsin)
+            if reverse:
+                outs = jnp.flip(outs, axis=0)
+            if not time_major:
+                outs = jnp.swapaxes(outs, 0, 1)
+            return [outs] + list(final)
+
+        args = [x, *cell._params(), *flat_init]
+        if seq_v is not None:
+            args.append(seq_v)
+        outs = tape.apply_fn(raw, *args)
+        return outs[0], unflatten_states(cell, outs[1:1 + n_states])
+
+
+class BiRNN(Layer):
+    """paddle.nn.BiRNN: forward + reverse cells, outputs concatenated."""
+
+    def __init__(self, cell_fw: RNNCellBase, cell_bw: RNNCellBase,
+                 time_major: bool = False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False,
+                          time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True,
+                          time_major=time_major)
+
+    def forward(self, inputs, initial_states=None,
+                sequence_length=None):
+        import paddle_tpu.tensor as T
+        init_fw = init_bw = None
+        if initial_states is not None:
+            init_fw, init_bw = initial_states
+        fw, s_fw = self.rnn_fw(inputs, init_fw, sequence_length)
+        bw, s_bw = self.rnn_bw(inputs, init_bw, sequence_length)
+        return T.concat([fw, bw], axis=-1), (s_fw, s_bw)
+
+
+class _MultiLayerRNN(Layer):
+    """Shared engine for SimpleRNN / LSTM / GRU: num_layers stacks,
+    direction forward|bidirect, inter-layer dropout."""
+
+    CELL = None
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 num_layers: int = 1, direction: str = "forward",
+                 time_major: bool = False, dropout: float = 0.0,
+                 **cell_kwargs):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError("direction must be forward or bidirect")
+        self.bidirect = direction != "forward"
+        self.num_layers = num_layers
+        self.dropout = dropout
+        self.time_major = time_major
+        self.hidden_size = hidden_size
+        layers = []
+        for li in range(num_layers):
+            isz = input_size if li == 0 else hidden_size * (
+                2 if self.bidirect else 1)
+            if self.bidirect:
+                layers.append(BiRNN(self.CELL(isz, hidden_size,
+                                              **cell_kwargs),
+                                    self.CELL(isz, hidden_size,
+                                              **cell_kwargs),
+                                    time_major=time_major))
+            else:
+                layers.append(RNN(self.CELL(isz, hidden_size,
+                                            **cell_kwargs),
+                                  time_major=time_major))
+        self.layers = LayerList(layers)
+
+    def forward(self, inputs, initial_states=None,
+                sequence_length=None):
+        """Returns (outputs, final_states) with final states STACKED
+        over layers*directions like the reference ([L*D, B, H]; LSTM: a
+        (h, c) pair of such stacks). initial_states accepts the same
+        stacked form."""
+        from . import functional as F
+        import paddle_tpu.tensor as T
+        d = 2 if self.bidirect else 1
+        per_layer = [None] * self.num_layers
+        if initial_states is not None:
+            per_layer = self._split_states(initial_states, d)
+        out = inputs
+        finals = []
+        for li, layer in enumerate(self.layers):
+            out, st = layer(out, per_layer[li], sequence_length)
+            finals.append(st)
+            if self.dropout and li < self.num_layers - 1 \
+                    and self.training:
+                out = F.dropout(out, p=self.dropout)
+        return out, self._stack_states(finals, d)
+
+    def _split_states(self, states, d):
+        """[L*D, B, H] stacks -> per-layer cell-state structures."""
+        import paddle_tpu.tensor as T
+        is_lstm = isinstance(self, LSTM)
+        hs = states[0] if is_lstm else states
+        cs = states[1] if is_lstm else None
+        per = []
+        for li in range(self.num_layers):
+            rows = [T.squeeze(T.slice(hs, [0], [li * d + k],
+                                      [li * d + k + 1]), 0)
+                    for k in range(d)]
+            crows = [T.squeeze(T.slice(cs, [0], [li * d + k],
+                                       [li * d + k + 1]), 0)
+                     for k in range(d)] if cs is not None else None
+            if self.bidirect:
+                if is_lstm:
+                    per.append(((rows[0], crows[0]),
+                                (rows[1], crows[1])))
+                else:
+                    per.append((rows[0], rows[1]))
+            else:
+                per.append((rows[0], crows[0]) if is_lstm else rows[0])
+        return per
+
+    def _stack_states(self, finals, d):
+        """Per-layer finals -> reference stacked form."""
+        import paddle_tpu.tensor as T
+        is_lstm = isinstance(self, LSTM)
+        hs, cs = [], []
+        for st in finals:
+            dirs = st if self.bidirect else (st,)
+            for sd in dirs:
+                if is_lstm:
+                    hs.append(sd[0])
+                    cs.append(sd[1])
+                else:
+                    hs.append(sd)
+        h = T.stack(hs, axis=0)
+        if is_lstm:
+            return (h, T.stack(cs, axis=0))
+        return h
+
+
+class SimpleRNN(_MultiLayerRNN):
+    CELL = SimpleRNNCell
+
+
+class LSTM(_MultiLayerRNN):
+    CELL = LSTMCell
+
+
+class GRU(_MultiLayerRNN):
+    CELL = GRUCell

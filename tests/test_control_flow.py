@@ -141,3 +141,109 @@ def test_print_and_assert_run():
         out = layers.scale(y, 2.0)
     res, = _run(main, startup, {"x": np.ones((1, 2), np.float32)}, [out])
     np.testing.assert_allclose(res, np.full((1, 2), 2.0))
+
+
+def test_while_loop_functional():
+    """layers.while_loop (control_flow.py:1111) static + dygraph."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.core.program import enable_static, disable_static
+
+    main, startup = pt.Program(), pt.Program()
+    enable_static()
+    try:
+        with pt.program_guard(main, startup):
+            i = layers.fill_constant([1], value=0, dtype="int64")
+            s = layers.fill_constant([1], value=0, dtype="int64")
+            i, s = layers.while_loop(
+                lambda i, s: layers.less_than(i, layers.fill_constant(
+                    [1], value=5, dtype="int64")),
+                lambda i, s: [layers.elementwise_add(
+                    i, layers.fill_constant([1], value=1,
+                                            dtype="int64")),
+                    layers.elementwise_add(s, i)],
+                [i, s])
+    finally:
+        disable_static()
+    exe = pt.Executor()
+    iv, sv = exe.run(main, feed={}, fetch_list=[i, s])
+    assert int(np.asarray(iv)) == 5
+    assert int(np.asarray(sv)) == 0 + 1 + 2 + 3 + 4
+
+    # dygraph: plain python loop over Tensors
+    import paddle_tpu.tensor as T
+    iv = pt.to_tensor(np.asarray([0], np.int64))
+    sv = pt.to_tensor(np.asarray([0], np.int64))
+    iv, sv = layers.while_loop(
+        lambda i, s: T.less_than(i, pt.to_tensor(np.asarray([4],
+                                                            np.int64))),
+        lambda i, s: [T.add(i, pt.to_tensor(np.asarray([1], np.int64))),
+                      T.add(s, i)],
+        [iv, sv])
+    assert int(np.asarray(sv.value)) == 0 + 1 + 2 + 3
+
+
+def test_case_and_switch_case():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.core.program import enable_static, disable_static
+    main, startup = pt.Program(), pt.Program()
+    enable_static()
+    try:
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [1])
+            zero = layers.fill_constant([1], value=0.0, dtype="float32")
+            out = layers.case(
+                [(layers.less_than(x, zero),
+                  lambda: layers.elementwise_mul(x, x))],
+                default=lambda: layers.elementwise_add(x, x))
+            idx = layers.data("idx", [1], dtype="int64")
+            sw = layers.switch_case(
+                idx, {0: lambda: layers.elementwise_add(x, x),
+                      1: lambda: layers.elementwise_mul(x, x)},
+                default=lambda: layers.elementwise_sub(x, x))
+    finally:
+        disable_static()
+    exe = pt.Executor()
+    o, s0 = exe.run(main, feed={"x": np.asarray([[-3.0]], np.float32),
+                                "idx": np.asarray([1], np.int64)},
+                    fetch_list=[out, sw])
+    assert float(np.asarray(o)) == 9.0      # negative -> square
+    assert float(np.asarray(s0)) == 9.0     # idx 1 -> square
+    o2, s1 = exe.run(main, feed={"x": np.asarray([[2.0]], np.float32),
+                                 "idx": np.asarray([5], np.int64)},
+                     fetch_list=[out, sw])
+    assert float(np.asarray(o2)) == 4.0     # default -> add
+    assert float(np.asarray(s1)) == 0.0     # default -> sub
+
+
+def test_switch_class():
+    """fluid.layers.Switch with-block API (control_flow.py:1524):
+    piecewise lr-style assignment."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.core.program import enable_static, disable_static
+    main, startup = pt.Program(), pt.Program()
+    enable_static()
+    try:
+        with pt.program_guard(main, startup):
+            step = layers.data("step", [1])
+            lr = layers.fill_constant([1], value=0.0, dtype="float32")
+            thresh = layers.fill_constant([1], value=10.0,
+                                          dtype="float32")
+            with layers.Switch() as switch:
+                with switch.case(layers.less_than(step, thresh)):
+                    layers.nn.assign(layers.fill_constant(
+                        [1], value=0.1, dtype="float32"), lr)
+                with switch.default():
+                    layers.nn.assign(layers.fill_constant(
+                        [1], value=0.01, dtype="float32"), lr)
+    finally:
+        disable_static()
+    exe = pt.Executor()
+    lo, = exe.run(main, feed={"step": np.asarray([[3.0]], np.float32)},
+                  fetch_list=[lr])
+    assert abs(float(np.asarray(lo)) - 0.1) < 1e-7
+    hi, = exe.run(main, feed={"step": np.asarray([[30.0]], np.float32)},
+                  fetch_list=[lr])
+    assert abs(float(np.asarray(hi)) - 0.01) < 1e-7

@@ -1,0 +1,112 @@
+"""paddle.nn RNN API tests: cells vs manual oracles, RNN scan wrapper,
+ragged masking, bidirection, multi-layer stacks, gradients.
+
+Reference surface: fluid/layers/rnn.py RNNCell/rnn/birnn + the
+paddle.nn SimpleRNN/LSTM/GRU family."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+
+
+def _np(t):
+    return np.asarray(t.value if hasattr(t, "value") else t)
+
+
+def test_lstm_cell_oracle():
+    cell = nn.LSTMCell(4, 3)
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    out, (h, c) = cell(pt.to_tensor(x))
+    # numpy oracle
+    wi, wh, bi, bh = [_np(p) for p in cell._params()]
+    g = x @ wi.T + bi + np.zeros((2, 3)) @ wh.T + bh
+    i, f, gg, o = np.split(g, 4, axis=-1)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    c_ref = sig(f) * 0 + sig(i) * np.tanh(gg)
+    h_ref = sig(o) * np.tanh(c_ref)
+    np.testing.assert_allclose(_np(out), h_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(_np(c), c_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_rnn_wrapper_matches_stepwise():
+    rng = np.random.RandomState(1)
+    cell = nn.GRUCell(3, 5)
+    x = rng.randn(2, 4, 3).astype(np.float32)
+    out, final = nn.RNN(cell)(pt.to_tensor(x))
+    # stepping the cell manually must match
+    h = None
+    for t in range(4):
+        o, h = cell(pt.to_tensor(x[:, t]), h)
+        np.testing.assert_allclose(_np(out)[:, t], _np(o),
+                                   rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(_np(final), _np(h), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_rnn_sequence_length_masking():
+    rng = np.random.RandomState(2)
+    cell = nn.SimpleRNNCell(3, 4)
+    x = rng.randn(2, 5, 3).astype(np.float32)
+    lens = np.asarray([5, 3], np.int64)
+    out, final = nn.RNN(cell)(pt.to_tensor(x),
+                              sequence_length=pt.to_tensor(lens))
+    # beyond its length, sequence 1's outputs are zero and the final
+    # state equals the state at t=len-1
+    assert np.abs(_np(out)[1, 3:]).max() == 0.0
+    short, fs = nn.RNN(cell)(pt.to_tensor(x[1:2, :3]))
+    np.testing.assert_allclose(_np(final)[1], _np(fs)[0], rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_bidirect_and_stack_shapes_and_grads():
+    rng = np.random.RandomState(3)
+    m = nn.LSTM(4, 6, num_layers=2, direction="bidirect")
+    x = pt.to_tensor(rng.randn(2, 5, 4).astype(np.float32))
+    out, finals = m(x)
+    assert _np(out).shape == (2, 5, 12)
+    loss = (out ** 2).mean()
+    loss.backward()
+    g = m.layers[0].rnn_fw.cell.weight_ih.grad
+    assert g is not None and np.abs(_np(g)).max() > 0
+
+
+def test_reverse_rnn_is_time_flip():
+    rng = np.random.RandomState(4)
+    cell = nn.SimpleRNNCell(3, 4)
+    x = rng.randn(1, 6, 3).astype(np.float32)
+    out_r, _ = nn.RNN(cell, is_reverse=True)(pt.to_tensor(x))
+    out_f, _ = nn.RNN(cell)(pt.to_tensor(x[:, ::-1].copy()))
+    np.testing.assert_allclose(_np(out_r), _np(out_f)[:, ::-1],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_learned_initial_state_gets_grad():
+    """Review regression: a learned h0 passed as initial_states must
+    receive gradients through the scan."""
+    rng = np.random.RandomState(5)
+    cell = nn.GRUCell(3, 4)
+    h0 = pt.to_tensor(rng.randn(2, 4).astype(np.float32))
+    h0.stop_gradient = False
+    x = pt.to_tensor(rng.randn(2, 5, 3).astype(np.float32))
+    out, _ = nn.RNN(cell)(x, initial_states=h0)
+    ((out ** 2).mean()).backward()
+    assert h0.grad is not None
+    assert np.abs(_np(h0.grad)).max() > 0
+
+
+def test_multilayer_stacked_final_states():
+    """Review regression: LSTM/GRU finals follow the reference stacked
+    [L*D, B, H] form and round-trip as initial_states."""
+    rng = np.random.RandomState(6)
+    m = nn.LSTM(3, 4, num_layers=2, direction="bidirect")
+    x = pt.to_tensor(rng.randn(2, 5, 3).astype(np.float32))
+    out, (h, c) = m(x)
+    assert _np(h).shape == (4, 2, 4) and _np(c).shape == (4, 2, 4)
+    out2, _ = m(x, initial_states=(h, c))
+    assert _np(out2).shape == (2, 5, 8)
+
+    g = nn.GRU(3, 4, num_layers=2)
+    _, hg = g(x)
+    assert _np(hg).shape == (2, 2, 4)
+    _, _ = g(x, initial_states=hg)
