@@ -1,0 +1,222 @@
+"""Probability distributions — Uniform, Normal, Categorical,
+MultivariateNormalDiag.
+
+Analog of /root/reference/python/paddle/fluid/layers/distributions.py
+(Distribution:30, Uniform:100, Normal:219, Categorical:356,
+MultivariateNormalDiag:461) surfaced under the v2 name
+paddle.distribution. sample/entropy/log_prob/probs/kl_divergence follow
+the reference formulas; everything computes through the dual-mode
+tensor ops, so it works eagerly and while building a Program.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from .dygraph.tape import Tensor
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical",
+           "MultivariateNormalDiag", "kl_divergence"]
+
+
+def _t(v):
+    if isinstance(v, Tensor):
+        return v
+    return Tensor(np.asarray(v, np.float32))
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U[low, high) (distributions.py:100)."""
+
+    def __init__(self, low, high):
+        self.low = _t(low)
+        self.high = _t(high)
+
+    def sample(self, shape=()):
+        import jax
+        from .dygraph import tape
+        from . import tensor as T
+        key = tape._state.next_key()
+        base_shape = tuple(shape) + tuple(self.low.shape)
+        u = jax.random.uniform(key, base_shape or (1,))
+        un = Tensor(u)
+        return T.add(self.low,
+                     T.multiply(un, T.subtract(self.high, self.low)))
+
+    def entropy(self):
+        from . import tensor as T
+        return T.log(T.subtract(self.high, self.low))
+
+    def log_prob(self, value):
+        from . import tensor as T
+        v = _t(value)
+        inside = T.logical_and(T.greater_equal(v, self.low),
+                               T.less_than(v, self.high))
+        lp = T.subtract(T.zeros_like(v),
+                        T.log(T.subtract(self.high, self.low)))
+        neg_inf = T.full_like(v, -1e38)
+        return T.where(inside, lp, neg_inf)
+
+
+class Normal(Distribution):
+    """N(loc, scale) (distributions.py:219)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def sample(self, shape=()):
+        import jax
+        from .dygraph import tape
+        from . import tensor as T
+        key = tape._state.next_key()
+        base_shape = tuple(shape) + tuple(self.loc.shape)
+        z = Tensor(jax.random.normal(key, base_shape or (1,)))
+        return T.add(self.loc, T.multiply(z, self.scale))
+
+    def entropy(self):
+        from . import tensor as T
+        c = 0.5 + 0.5 * math.log(2 * math.pi)
+        return T.add(T.full_like(self.scale, c), T.log(self.scale))
+
+    def log_prob(self, value):
+        from . import tensor as T
+        v = _t(value)
+        var = T.multiply(self.scale, self.scale)
+        z = T.subtract(v, self.loc)
+        quad = T.divide(T.multiply(z, z),
+                        T.multiply(T.full_like(var, 2.0), var))
+        return T.subtract(
+            T.subtract(T.zeros_like(quad), quad),
+            T.add(T.log(self.scale),
+                  T.full_like(self.scale,
+                              0.5 * math.log(2 * math.pi))))
+
+    def kl_divergence(self, other: "Normal"):
+        """distributions.py:334 Normal-Normal KL."""
+        from . import tensor as T
+        var_ratio = T.divide(self.scale, other.scale)
+        var_ratio = T.multiply(var_ratio, var_ratio)
+        t1 = T.divide(T.subtract(self.loc, other.loc), other.scale)
+        t1 = T.multiply(t1, t1)
+        half = T.full_like(var_ratio, 0.5)
+        one = T.full_like(var_ratio, 1.0)
+        return T.multiply(half,
+                          T.subtract(T.add(var_ratio, t1),
+                                     T.add(one, T.log(var_ratio))))
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (distributions.py:356)."""
+
+    def __init__(self, logits):
+        self.logits = _t(logits)
+
+    def _probs(self):
+        from .nn import functional as F
+        return F.softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        import jax
+        from .dygraph import tape
+        key = tape._state.next_key()
+        logits = self.logits.value
+        n = int(np.prod(shape)) if shape else 1
+        draws = jax.random.categorical(
+            key, logits, axis=-1,
+            shape=tuple(shape) + tuple(logits.shape[:-1]) if shape
+            else logits.shape[:-1])
+        return Tensor(draws)
+
+    def entropy(self):
+        from . import tensor as T
+        from .nn import functional as F
+        p = self._probs()
+        logp = F.log_softmax(self.logits, axis=-1)
+        return T.subtract(T.zeros_like(T.sum(p, -1)),
+                          T.sum(T.multiply(p, logp), -1))
+
+    def log_prob(self, value):
+        from . import tensor as T
+        from .nn import functional as F
+        logp = F.log_softmax(self.logits, axis=-1)
+        idx = _t(value)
+        return T.squeeze(T.index_sample(
+            logp, T.cast(T.unsqueeze(idx, -1)
+                         if len(idx.shape) < len(logp.shape)
+                         else idx, "int32")), -1)
+
+    def kl_divergence(self, other: "Categorical"):
+        from . import tensor as T
+        from .nn import functional as F
+        p = self._probs()
+        diff = T.subtract(F.log_softmax(self.logits, -1),
+                          F.log_softmax(other.logits, -1))
+        return T.sum(T.multiply(p, diff), -1)
+
+
+class MultivariateNormalDiag(Distribution):
+    """N(loc, diag(scale)) with a DIAGONAL covariance passed as a full
+    matrix like the reference (distributions.py:461 uses its diagonal)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)  # [D, D] diagonal matrix
+
+    def _diag(self):
+        from . import tensor as T
+        d = self.scale.shape[-1]
+        eye = Tensor(np.eye(d, dtype=np.float32))
+        return T.sum(T.multiply(self.scale, eye), -1)
+
+    def sample(self, shape=()):
+        import jax
+        from .dygraph import tape
+        from . import tensor as T
+        key = tape._state.next_key()
+        z = Tensor(jax.random.normal(
+            key, tuple(shape) + tuple(self.loc.shape)))
+        return T.add(self.loc, T.multiply(z, self._diag()))
+
+    def entropy(self):
+        from . import tensor as T
+        d = float(self.loc.shape[-1])
+        const = 0.5 * d * (1.0 + math.log(2 * math.pi))
+        logdet = T.sum(T.log(self._diag()), -1)
+        return T.add(T.full_like(logdet, const), logdet)
+
+    def kl_divergence(self, other: "MultivariateNormalDiag"):
+        from . import tensor as T
+        s1, s2 = self._diag(), other._diag()
+        var1 = T.multiply(s1, s1)
+        var2 = T.multiply(s2, s2)
+        dmu = T.subtract(self.loc, other.loc)
+        t1 = T.sum(T.divide(T.add(var1, T.multiply(dmu, dmu)), var2),
+                   -1)
+        logdet = T.sum(T.subtract(T.log(var2), T.log(var1)), -1)
+        d = float(self.loc.shape[-1])
+        half = 0.5
+        return T.multiply(
+            T.full_like(t1, half),
+            T.add(T.subtract(t1, T.full_like(t1, d)), logdet))
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """paddle.distribution.kl_divergence dispatch."""
+    return p.kl_divergence(q)

@@ -14,6 +14,7 @@ from ..core.program import VarDesc, default_main_program
 from .helper import LayerHelper
 
 __all__ = ["While", "cond", "increment", "array_write", "array_read",
+           "while_loop", "case", "switch_case", "Switch",
            "array_length", "create_array", "Print", "Assert"]
 
 
@@ -206,7 +207,6 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test: bool = False,
                 else [out]
         return loop_vars
 
-    from .nn import assign, logical_and  # noqa: F401
     helper = LayerHelper("while_loop", name)
     cond_var = cond_fn(*loop_vars)
     w = While(cond_var, is_test=is_test)

@@ -796,3 +796,164 @@ def crf_decoding(input: VarDesc, param_attr, label=None, length=None,
     helper.append_op("crf_decoding", inputs=ins,
                      outputs={"ViterbiPath": [out.name]})
     return out
+
+
+# ---------------------------------------------------------------------------
+# fundamental var builders + misc surface (fluid.layers tail)
+# ---------------------------------------------------------------------------
+
+def create_tensor(dtype: str = "float32", name: Optional[str] = None,
+                  persistable: bool = False) -> VarDesc:
+    """fluid.layers.create_tensor (tensor.py:66)."""
+    helper = LayerHelper("create_tensor", name)
+    return helper.block.create_var(
+        name or helper.unique_name("tensor"), dtype=dtype,
+        persistable=persistable)
+
+
+def create_global_var(shape, value, dtype="float32",
+                      persistable: bool = False, force_cpu: bool = False,
+                      name: Optional[str] = None) -> VarDesc:
+    """fluid.layers.create_global_var (tensor.py:120): a persistable
+    var initialized by fill_constant in the startup program."""
+    helper = LayerHelper("global_var", name)
+    vname = name or helper.unique_name("gvar")
+    var = helper.block.create_var(vname, shape=list(shape), dtype=dtype,
+                                  persistable=persistable,
+                                  stop_gradient=True)
+    sblock = helper.startup_program.global_block
+    if vname not in sblock.vars:
+        sblock.create_var(vname, shape=list(shape), dtype=dtype,
+                          persistable=persistable)
+        sblock.append_op("fill_constant", inputs={},
+                         outputs={"Out": [vname]},
+                         attrs={"shape": list(shape),
+                                "value": float(value),
+                                "dtype": dtypes.convert_dtype(dtype)})
+    return var
+
+
+def create_parameter(shape, dtype="float32", name: Optional[str] = None,
+                     attr=None, is_bias: bool = False,
+                     default_initializer=None) -> VarDesc:
+    """fluid.layers.create_parameter (tensor.py:34)."""
+    helper = LayerHelper("create_parameter", name)
+    attr = ParamAttr.to_attr(attr) or ParamAttr()
+    if name is not None and attr.name is None:
+        attr.name = name
+    return helper.create_parameter(attr, list(shape), dtype,
+                                   default_initializer, is_bias=is_bias)
+
+
+def autoincreased_step_counter(counter_name: Optional[str] = None,
+                               begin: int = 1, step: int = 1) -> VarDesc:
+    """layers.autoincreased_step_counter (tensor.py:155): a persistable
+    int64 counter incremented once per executor run. Repeated calls
+    with the same name share ONE increment (the reference's
+    counter.op-is-None guard) — otherwise two callers would double-step
+    every schedule keyed on it."""
+    helper = LayerHelper("step_counter")
+    vname = counter_name or "@STEP_COUNTER@"
+    var = create_global_var([1], float(begin - step), "int64",
+                            persistable=True, name=vname)
+    prog = helper.main_program
+    seen = getattr(prog, "_step_counters", None)
+    if seen is None:
+        seen = prog._step_counters = set()
+    if vname not in seen:
+        seen.add(vname)
+        helper.append_op("increment", inputs={"X": [vname]},
+                         outputs={"Out": [vname]},
+                         attrs={"step": float(step)})
+    return var
+
+
+def image_resize(input: VarDesc, out_shape=None, scale=None,
+                 resample: str = "BILINEAR", align_corners: bool = True,
+                 align_mode: int = 1, data_format: str = "NCHW",
+                 name: Optional[str] = None) -> VarDesc:
+    """fluid.layers.image_resize (nn.py:7556) — routes to the interp
+    op family."""
+    if resample.upper() == "TRILINEAR":
+        # 5-D path owns out_d and the NCDHW layout
+        return resize_trilinear(
+            input, out_shape, scale, name, align_corners, align_mode,
+            "NCDHW" if data_format == "NCHW" else data_format)
+    op = {"BILINEAR": "bilinear_interp", "NEAREST": "nearest_interp",
+          "BICUBIC": "bicubic_interp"}.get(resample.upper())
+    if op is None:
+        raise ValueError("image_resize: unknown resample %r" % resample)
+    helper = LayerHelper(op, name)
+    out = helper.create_tmp_variable(input.dtype)
+    attrs = {"align_corners": align_corners, "align_mode": align_mode,
+             "data_layout": data_format}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), \
+            int(out_shape[1])
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op(op, inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]}, attrs=attrs)
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    align_corners=True, align_mode=1,
+                    data_format="NCHW"):
+    return image_resize(input, out_shape, scale, "BILINEAR",
+                        align_corners, align_mode, data_format, name)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   align_corners=True, data_format="NCHW"):
+    return image_resize(input, out_shape, scale, "NEAREST",
+                        align_corners, 1, data_format, name)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    """3-d variant; out_shape is [D, H, W]."""
+    helper = LayerHelper("trilinear_interp", name)
+    out = helper.create_tmp_variable(input.dtype)
+    attrs = {"align_corners": align_corners, "align_mode": align_mode,
+             "data_layout": data_format}
+    if out_shape is not None:
+        attrs["out_d"], attrs["out_h"], attrs["out_w"] = \
+            [int(v) for v in out_shape]
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op("trilinear_interp", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]}, attrs=attrs)
+    return out
+
+
+def has_inf(x: VarDesc, name: Optional[str] = None) -> VarDesc:
+    """layers.has_inf (tensor.py:940)."""
+    helper = LayerHelper("has_inf", name)
+    out = helper.create_tmp_variable("bool")
+    helper.append_op("isinf", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def has_nan(x: VarDesc, name: Optional[str] = None) -> VarDesc:
+    helper = LayerHelper("has_nan", name)
+    out = helper.create_tmp_variable("bool")
+    helper.append_op("isnan", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def is_empty(x: VarDesc, name: Optional[str] = None) -> VarDesc:
+    """layers.is_empty (control_flow.py:3406)."""
+    helper = LayerHelper("is_empty", name)
+    out = helper.create_tmp_variable("bool")
+    helper.append_op("is_empty", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def rank(input: VarDesc) -> VarDesc:
+    """layers.rank (nn.py:11587): static rank as a 0-d int constant."""
+    return fill_constant([1], value=len(input.shape), dtype="int32")

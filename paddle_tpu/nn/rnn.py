@@ -91,14 +91,15 @@ def flatten_states(states):
 
 
 def unflatten_states(cell, flat):
-    """Rebuild the cell's state pytree from flat — structure comes from
-    the cell's OWN init_state_shape, so custom multi-state cells keep
-    every element."""
+    """Rebuild the cell's state pytree from flat — the structure comes
+    from the cell's OWN init_state_shape (pytree unflatten), so nested
+    custom-cell states keep every element."""
+    import jax
     import jax.numpy as jnp
     proto = cell.init_state_shape(jnp.zeros((1, 1)))
-    if isinstance(proto, (tuple, list)):
-        return tuple(flat[:len(proto)])
-    return flat[0]
+    treedef = jax.tree_util.tree_structure(proto)
+    n = treedef.num_leaves
+    return jax.tree_util.tree_unflatten(treedef, list(flat[:n]))
 
 
 class SimpleRNNCell(RNNCellBase):

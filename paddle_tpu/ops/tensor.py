@@ -665,3 +665,23 @@ def _scatter_nd(ctx, ins, attrs):
         shape = list(attrs["shape"])
     zeros = jnp.zeros(shape, upd.dtype)
     return one(zeros.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd))
+
+
+@register_op("isinf", inputs=("X",), no_grad=True)
+def _isinf(ctx, ins, attrs):
+    """isfinite_op.cc family OverflowOp(isinf): ANY inf in X (scalar
+    bool, the has_inf contract)."""
+    return one(jnp.any(jnp.isinf(ins["X"][0])))
+
+
+@register_op("isnan", inputs=("X",), no_grad=True)
+def _isnan(ctx, ins, attrs):
+    """OverflowOp(isnan): ANY nan in X."""
+    return one(jnp.any(jnp.isnan(ins["X"][0])))
+
+
+@register_op("is_empty", inputs=("X",), no_grad=True)
+def _is_empty(ctx, ins, attrs):
+    """is_empty_op.cc: numel == 0 (static shapes make this a
+    compile-time constant on TPU)."""
+    return one(jnp.asarray(ins["X"][0].size == 0))

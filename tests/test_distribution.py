@@ -1,0 +1,73 @@
+"""Distribution tests vs closed-form oracles
+(fluid/layers/distributions.py parity)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distribution import (Categorical, MultivariateNormalDiag,
+                                     Normal, Uniform, kl_divergence)
+
+
+def _np(t):
+    return np.asarray(t.value if hasattr(t, "value") else t)
+
+
+def test_uniform():
+    d = Uniform(np.asarray([0.0, 2.0]), np.asarray([1.0, 6.0]))
+    s = _np(d.sample((1000,)))
+    assert s.shape == (1000, 2)
+    assert (s[:, 0] >= 0).all() and (s[:, 0] < 1).all()
+    assert (s[:, 1] >= 2).all() and (s[:, 1] < 6).all()
+    np.testing.assert_allclose(_np(d.entropy()), [0.0, np.log(4.0)],
+                               atol=1e-6)
+    lp = _np(d.log_prob(np.asarray([0.5, 10.0])))
+    assert abs(lp[0] - 0.0) < 1e-6 and lp[1] < -1e30
+
+
+def test_normal_logprob_entropy_kl():
+    d = Normal(0.0, 2.0)
+    lp = float(_np(d.log_prob(np.asarray([1.0]))))
+    ref = -0.5 * (1.0 / 4.0) - np.log(2.0) - 0.5 * np.log(2 * np.pi)
+    assert abs(lp - ref) < 1e-5
+    ent = float(_np(d.entropy()))
+    assert abs(ent - (0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0))) \
+        < 1e-5
+    q = Normal(1.0, 1.0)
+    kl = float(_np(kl_divergence(d, q)))
+    # closed form: log(s2/s1) + (s1^2 + (m1-m2)^2)/(2 s2^2) - 1/2
+    ref_kl = np.log(1.0 / 2.0) + (4.0 + 1.0) / 2.0 - 0.5
+    assert abs(kl - ref_kl) < 1e-5
+    assert _np(d.sample((64,))).shape == (64,)
+
+
+def test_categorical():
+    logits = np.log(np.asarray([[0.2, 0.3, 0.5]], np.float32))
+    d = Categorical(logits)
+    ent = float(_np(d.entropy())[0])
+    ref = -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5))
+    assert abs(ent - ref) < 1e-5
+    lp = float(_np(d.log_prob(np.asarray([2], np.int64)))[0])
+    assert abs(lp - np.log(0.5)) < 1e-5
+    q = Categorical(np.log(np.asarray([[1 / 3] * 3], np.float32)))
+    kl = float(_np(d.kl_divergence(q))[0])
+    ref_kl = (0.2 * np.log(0.2 * 3) + 0.3 * np.log(0.3 * 3)
+              + 0.5 * np.log(0.5 * 3))
+    assert abs(kl - ref_kl) < 1e-5
+    s = _np(d.sample((500,)))
+    assert set(np.unique(s)) <= {0, 1, 2}
+
+
+def test_mvn_diag():
+    loc = np.asarray([0.0, 1.0], np.float32)
+    scale = np.diag([1.0, 2.0]).astype(np.float32)
+    d = MultivariateNormalDiag(loc, scale)
+    ent = float(_np(d.entropy()))
+    ref = 0.5 * 2 * (1 + np.log(2 * np.pi)) + np.log(1.0) + np.log(2.0)
+    assert abs(ent - ref) < 1e-5
+    q = MultivariateNormalDiag(loc, np.eye(2, dtype=np.float32))
+    kl = float(_np(kl_divergence(d, q)))
+    # sum over dims of Normal KLs (same means):
+    # KL = log(s2/s1) + (s1^2)/(2 s2^2) - 1/2 per dim
+    ref_kl = (np.log(1 / 1) + 1 / 2 - 0.5) \
+        + (np.log(1 / 2) + 4 / 2 - 0.5)
+    assert abs(kl - ref_kl) < 1e-4

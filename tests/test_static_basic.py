@@ -164,3 +164,39 @@ def test_pass_framework_and_dropout_prune():
     o1, = exe.run(main.clone(for_test=True), feed=feed, fetch_list=[out])
     o2, = exe.run(pruned, feed=feed, fetch_list=[out])
     np.testing.assert_allclose(o1, o2, atol=1e-6)
+
+
+def test_var_builders_and_misc_layers():
+    """create_tensor/create_global_var/create_parameter/
+    autoincreased_step_counter + has_inf/has_nan/is_empty/rank/
+    image_resize (fluid tensor.py + nn.py tail)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [2, 4, 4])
+        gv = layers.create_global_var([1], 3.5, persistable=True)
+        p = layers.create_parameter([3], name="myparam")
+        ctr = layers.autoincreased_step_counter()
+        up = layers.resize_bilinear(x, out_shape=[8, 8])
+        hi = layers.has_inf(x)
+        hn = layers.has_nan(x)
+        rk = layers.rank(x)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        feed = {"x": np.ones((1, 2, 4, 4), np.float32)}
+        g, c1, u, i1, n1, r1 = exe.run(
+            main, feed=feed, fetch_list=[gv, ctr, up, hi, hn, rk])
+        assert float(np.asarray(g)) == 3.5
+        assert np.asarray(u).shape == (1, 2, 8, 8)
+        assert not bool(np.asarray(i1)) and not bool(np.asarray(n1))
+        assert int(np.asarray(r1)) == 4
+        _, c2 = exe.run(main, feed=feed, fetch_list=[gv, ctr])
+        assert int(np.asarray(c2)) == int(np.asarray(c1)) + 1
+        bad = feed.copy()
+        bad["x"] = np.full((1, 2, 4, 4), np.inf, np.float32)
+        _, i2 = exe.run(main, feed=bad, fetch_list=[gv, hi])
+        assert bool(np.asarray(i2))
+    assert any(v.name == "myparam" for v in main.all_parameters())
