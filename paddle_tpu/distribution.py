@@ -5,8 +5,9 @@ Analog of /root/reference/python/paddle/fluid/layers/distributions.py
 (Distribution:30, Uniform:100, Normal:219, Categorical:356,
 MultivariateNormalDiag:461) surfaced under the v2 name
 paddle.distribution. sample/entropy/log_prob/probs/kl_divergence follow
-the reference formulas; everything computes through the dual-mode
-tensor ops, so it works eagerly and while building a Program.
+the reference formulas. Dygraph-only surface: parameters are eager
+Tensors/arrays (static-graph Variables are rejected with a clear
+error — the reference's static While-graph build is not mirrored).
 """
 from __future__ import annotations
 
@@ -24,7 +25,16 @@ __all__ = ["Distribution", "Uniform", "Normal", "Categorical",
 def _t(v):
     if isinstance(v, Tensor):
         return v
+    from .core.program import VarDesc
+    if isinstance(v, VarDesc):
+        raise TypeError(
+            "paddle_tpu.distribution is dygraph-only: got the static "
+            "Variable %r; pass eager Tensors/arrays" % v.name)
     return Tensor(np.asarray(v, np.float32))
+
+
+def _event_shape(*ts):
+    return np.broadcast_shapes(*[tuple(t.shape) for t in ts])
 
 
 class Distribution:
@@ -53,7 +63,7 @@ class Uniform(Distribution):
         from .dygraph import tape
         from . import tensor as T
         key = tape._state.next_key()
-        base_shape = tuple(shape) + tuple(self.low.shape)
+        base_shape = tuple(shape) + _event_shape(self.low, self.high)
         u = jax.random.uniform(key, base_shape or (1,))
         un = Tensor(u)
         return T.add(self.low,
@@ -86,7 +96,7 @@ class Normal(Distribution):
         from .dygraph import tape
         from . import tensor as T
         key = tape._state.next_key()
-        base_shape = tuple(shape) + tuple(self.loc.shape)
+        base_shape = tuple(shape) + _event_shape(self.loc, self.scale)
         z = Tensor(jax.random.normal(key, base_shape or (1,)))
         return T.add(self.loc, T.multiply(z, self.scale))
 
@@ -137,7 +147,6 @@ class Categorical(Distribution):
         from .dygraph import tape
         key = tape._state.next_key()
         logits = self.logits.value
-        n = int(np.prod(shape)) if shape else 1
         draws = jax.random.categorical(
             key, logits, axis=-1,
             shape=tuple(shape) + tuple(logits.shape[:-1]) if shape
@@ -172,18 +181,17 @@ class Categorical(Distribution):
 
 
 class MultivariateNormalDiag(Distribution):
-    """N(loc, diag(scale)) with a DIAGONAL covariance passed as a full
-    matrix like the reference (distributions.py:461 uses its diagonal)."""
+    """N(loc, Σ) with Σ a diagonal COVARIANCE matrix, exactly the
+    reference contract (distributions.py:461: the scale argument is the
+    covariance; its diagonal holds the per-dim variances)."""
 
     def __init__(self, loc, scale):
         self.loc = _t(loc)
-        self.scale = _t(scale)  # [D, D] diagonal matrix
+        self.scale = _t(scale)  # [D, D] diagonal covariance
 
-    def _diag(self):
+    def _var(self):
         from . import tensor as T
-        d = self.scale.shape[-1]
-        eye = Tensor(np.eye(d, dtype=np.float32))
-        return T.sum(T.multiply(self.scale, eye), -1)
+        return T.diag(self.scale)  # [D] variances
 
     def sample(self, shape=()):
         import jax
@@ -192,28 +200,30 @@ class MultivariateNormalDiag(Distribution):
         key = tape._state.next_key()
         z = Tensor(jax.random.normal(
             key, tuple(shape) + tuple(self.loc.shape)))
-        return T.add(self.loc, T.multiply(z, self._diag()))
+        return T.add(self.loc, T.multiply(z, T.sqrt(self._var())))
 
     def entropy(self):
+        """0.5 * (k*(1+log 2π) + log det Σ) — matches the reference
+        docstring example (scale diag [0.4, 0.5] -> 2.033158)."""
         from . import tensor as T
         d = float(self.loc.shape[-1])
         const = 0.5 * d * (1.0 + math.log(2 * math.pi))
-        logdet = T.sum(T.log(self._diag()), -1)
-        return T.add(T.full_like(logdet, const), logdet)
+        logdet = T.sum(T.log(self._var()), -1)
+        return T.add(T.full_like(logdet, const),
+                     T.multiply(T.full_like(logdet, 0.5), logdet))
 
     def kl_divergence(self, other: "MultivariateNormalDiag"):
+        """0.5*(tr(Σ2^-1 Σ1) + Δμ^T Σ2^-1 Δμ - k + log det(Σ2)/det(Σ1))
+        for diagonal covariances."""
         from . import tensor as T
-        s1, s2 = self._diag(), other._diag()
-        var1 = T.multiply(s1, s1)
-        var2 = T.multiply(s2, s2)
+        var1, var2 = self._var(), other._var()
         dmu = T.subtract(self.loc, other.loc)
         t1 = T.sum(T.divide(T.add(var1, T.multiply(dmu, dmu)), var2),
                    -1)
         logdet = T.sum(T.subtract(T.log(var2), T.log(var1)), -1)
         d = float(self.loc.shape[-1])
-        half = 0.5
         return T.multiply(
-            T.full_like(t1, half),
+            T.full_like(t1, 0.5),
             T.add(T.subtract(t1, T.full_like(t1, d)), logdet))
 
 

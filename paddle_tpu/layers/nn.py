@@ -883,17 +883,35 @@ def image_resize(input: VarDesc, out_shape=None, scale=None,
           "BICUBIC": "bicubic_interp"}.get(resample.upper())
     if op is None:
         raise ValueError("image_resize: unknown resample %r" % resample)
+    if data_format not in ("NCHW",):
+        # the interp ops are NCHW; transpose around them rather than
+        # silently resizing the wrong axes
+        if data_format != "NHWC":
+            raise ValueError("image_resize: data_format must be NCHW "
+                             "or NHWC")
     helper = LayerHelper(op, name)
+    src = input
+    if data_format == "NHWC":
+        t_in = helper.create_tmp_variable(input.dtype)
+        helper.append_op("transpose2", inputs={"X": [input.name]},
+                         outputs={"Out": [t_in.name]},
+                         attrs={"axis": [0, 3, 1, 2]})
+        src = t_in
     out = helper.create_tmp_variable(input.dtype)
-    attrs = {"align_corners": align_corners, "align_mode": align_mode,
-             "data_layout": data_format}
+    attrs = {"align_corners": align_corners, "align_mode": align_mode}
     if out_shape is not None:
         attrs["out_h"], attrs["out_w"] = int(out_shape[0]), \
             int(out_shape[1])
     if scale is not None:
         attrs["scale"] = float(scale)
-    helper.append_op(op, inputs={"X": [input.name]},
+    helper.append_op(op, inputs={"X": [src.name]},
                      outputs={"Out": [out.name]}, attrs=attrs)
+    if data_format == "NHWC":
+        t_out = helper.create_tmp_variable(input.dtype)
+        helper.append_op("transpose2", inputs={"X": [out.name]},
+                         outputs={"Out": [t_out.name]},
+                         attrs={"axis": [0, 2, 3, 1]})
+        out = t_out
     return out
 
 

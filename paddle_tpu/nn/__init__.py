@@ -14,3 +14,4 @@ from .transformer import (MultiHeadAttention, Transformer,  # noqa: F401
                           TransformerEncoder, TransformerEncoderLayer)
 from .rnn import (BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN,  # noqa: F401
                   SimpleRNN, SimpleRNNCell)
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401

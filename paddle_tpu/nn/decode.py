@@ -1,0 +1,163 @@
+"""Seq2seq decoding: Decoder protocol, BeamSearchDecoder,
+dynamic_decode.
+
+Analog of /root/reference/python/paddle/fluid/layers/rnn.py
+(Decoder:~700, BeamSearchDecoder:856, dynamic_decode:1327). The
+reference builds a static While graph; here dynamic_decode drives the
+step loop eagerly (the dygraph contract) on top of the beam_search /
+gather_tree ops — inference-only machinery, wrapped in no_grad.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..dygraph import tape
+from ..dygraph.tape import Tensor, run_op
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Protocol: initialize(inits) -> (inputs, states, finished);
+    step(time, inputs, states) -> (outputs, states, next_inputs,
+    finished); optional finalize(outputs, states, seq_lens)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states):
+        raise NotImplementedError
+
+
+class BeamSearchDecoder(Decoder):
+    """rnn.py:856. Wraps a cell: inputs/states are tiled to
+    [batch * beam_size, ...]; every step scores beam continuations with
+    the beam_search op and reindexes cell states by parent beam.
+
+    cell: an nn.rnn cell (raw_step + _params); embedding_fn maps token
+    ids -> cell inputs; output_fn maps cell outputs -> vocab logits.
+    """
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn: Optional[Callable] = None,
+                 output_fn: Optional[Callable] = None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size: int):
+        """[B, ...] -> [B*beam, ...] (rnn.py:905) — for tensors the
+        cell closes over (e.g. attention memory)."""
+        import jax.numpy as jnp
+        v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+        tiled = jnp.repeat(v, beam_size, axis=0)
+        return Tensor(tiled)
+
+    def initialize(self, inits):
+        """inits: the cell's initial states with batch dim B."""
+        import jax.numpy as jnp
+        from .rnn import flatten_states, unflatten_states
+        flat = [s.value if isinstance(s, Tensor) else jnp.asarray(s)
+                for s in flatten_states(inits)]
+        B = flat[0].shape[0]
+        K = self.beam_size
+        states = [jnp.repeat(s, K, axis=0) for s in flat]
+        ids = jnp.full((B * K, 1), self.start_token, jnp.int64)
+        # only beam 0 live initially so the first step's topk does not
+        # pick K copies of the same continuation (rnn.py kInf masking)
+        scores = jnp.where(
+            (jnp.arange(B * K) % K == 0)[:, None], 0.0, -1e9
+        ).astype(jnp.float32)
+        finished = jnp.zeros((B * K,), bool)
+        return ids, (states, scores), finished
+
+    def step(self, time, inputs, states):
+        import jax
+        import jax.numpy as jnp
+        from .rnn import unflatten_states
+        cell_states, scores = states
+        tok = Tensor(inputs[:, 0])
+        emb = self.embedding_fn(tok) if self.embedding_fn else tok
+        with tape.no_grad():
+            sts = unflatten_states(
+                self.cell, [Tensor(s) for s in cell_states])
+            out, new_sts = self.cell(emb, sts)
+            logits = self.output_fn(out) if self.output_fn else out
+        logits_v = logits.value if isinstance(logits, Tensor) else logits
+        logp = jax.nn.log_softmax(logits_v.astype(jnp.float32), axis=-1)
+        o = run_op("beam_search",
+                   {"pre_ids": [Tensor(inputs)],
+                    "pre_scores": [Tensor(scores)],
+                    "ids": [Tensor(inputs)],
+                    "scores": [Tensor(logp)]},
+                   {"beam_size": self.beam_size,
+                    "end_id": self.end_token})
+        sel_ids = o["selected_ids"][0].value
+        sel_scores = o["selected_scores"][0].value
+        parent = o["parent_idx"][0].value
+        from .rnn import flatten_states
+        new_flat = [s.value if isinstance(s, Tensor) else s
+                    for s in flatten_states(new_sts)]
+        new_flat = [s[parent] for s in new_flat]
+        finished = (sel_ids[:, 0] == self.end_token)
+        outputs = {"ids": sel_ids, "parents": parent,
+                   "scores": sel_scores}
+        return outputs, (new_flat, sel_scores), sel_ids, finished
+
+
+def dynamic_decode(decoder: Decoder, inits=None,
+                   max_step_num: Optional[int] = None,
+                   output_time_major: bool = False, is_test: bool = True,
+                   return_length: bool = False, **kwargs):
+    """rnn.py:1327: run decoder.step until every sequence finished or
+    max_step_num. This driver implements the BEAM protocol (the
+    reference's dynamic_decode is likewise written against
+    BeamSearchDecoder's outputs): the decoder must expose beam_size and
+    end_token and emit {ids, parents, scores} per step. Returns
+    (ids [B, beam, T] via gather_tree backtrack — [T, B, beam] when
+    output_time_major — and scores [B, beam]; + lengths when
+    return_length)."""
+    import jax.numpy as jnp
+    if not hasattr(decoder, "beam_size") or \
+            not hasattr(decoder, "end_token"):
+        raise TypeError(
+            "dynamic_decode drives the beam protocol: the decoder needs "
+            "beam_size/end_token and step() outputs {ids, parents, "
+            "scores} (see BeamSearchDecoder)")
+    if max_step_num is None:
+        max_step_num = 100
+    inputs, states, finished = decoder.initialize(inits)
+    step_ids, step_parents = [], []
+    scores = None
+    K = decoder.beam_size
+    for t in range(int(max_step_num)):
+        outputs, states, inputs, finished = decoder.step(
+            t, inputs, states)
+        B = outputs["ids"].shape[0] // K
+        step_ids.append(np.asarray(outputs["ids"]).reshape(B, K))
+        # gather_tree wants beam-LOCAL parent indices
+        step_parents.append(np.asarray(outputs["parents"])
+                            .reshape(B, K) - (np.arange(B) * K)[:, None])
+        scores = outputs["scores"]
+        if bool(np.asarray(finished).all()):
+            break
+    ids_t = np.stack(step_ids)        # [T, B, K]
+    par_t = np.stack(step_parents)    # [T, B, K] beam-local parents
+    full = run_op("gather_tree",
+                  {"Ids": [Tensor(ids_t)], "Parents": [Tensor(par_t)]},
+                  {})["Out"][0]
+    paths = jnp.transpose(full.value, (1, 2, 0))  # [B, K, T]
+    final_scores = jnp.asarray(np.asarray(scores).reshape(-1, K))
+    out_ids = jnp.transpose(paths, (2, 0, 1)) if output_time_major \
+        else paths
+    rets = (Tensor(out_ids), Tensor(final_scores))
+    if return_length:
+        lens = (paths != decoder.end_token).sum(axis=-1)
+        rets = rets + (Tensor(lens),)
+    return rets

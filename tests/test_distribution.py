@@ -58,16 +58,20 @@ def test_categorical():
 
 
 def test_mvn_diag():
+    # the scale argument is a diagonal COVARIANCE (reference
+    # distributions.py:461); its docstring example is the oracle:
     loc = np.asarray([0.0, 1.0], np.float32)
-    scale = np.diag([1.0, 2.0]).astype(np.float32)
-    d = MultivariateNormalDiag(loc, scale)
-    ent = float(_np(d.entropy()))
-    ref = 0.5 * 2 * (1 + np.log(2 * np.pi)) + np.log(1.0) + np.log(2.0)
-    assert abs(ent - ref) < 1e-5
+    d_doc = MultivariateNormalDiag(
+        np.asarray([0.3, 0.5], np.float32),
+        np.diag([0.4, 0.5]).astype(np.float32))
+    assert abs(float(_np(d_doc.entropy())) - 2.033158) < 1e-4
+
+    cov1 = np.diag([1.0, 4.0]).astype(np.float32)
+    d = MultivariateNormalDiag(loc, cov1)
     q = MultivariateNormalDiag(loc, np.eye(2, dtype=np.float32))
     kl = float(_np(kl_divergence(d, q)))
-    # sum over dims of Normal KLs (same means):
-    # KL = log(s2/s1) + (s1^2)/(2 s2^2) - 1/2 per dim
-    ref_kl = (np.log(1 / 1) + 1 / 2 - 0.5) \
-        + (np.log(1 / 2) + 4 / 2 - 0.5)
+    # 0.5*(tr(S2^-1 S1) - k + log det(S2)/det(S1)), means equal
+    ref_kl = 0.5 * ((1 + 4) - 2 + np.log(1.0 / 4.0))
     assert abs(kl - ref_kl) < 1e-4
+    s = _np(d.sample((2000,)))
+    assert abs(s[:, 1].std() - 2.0) < 0.2  # std = sqrt(var 4)

@@ -110,3 +110,56 @@ def test_multilayer_stacked_final_states():
     _, hg = g(x)
     assert _np(hg).shape == (2, 2, 4)
     _, _ = g(x, initial_states=hg)
+
+
+def test_beam_search_decoder_dynamic_decode():
+    """BeamSearchDecoder + dynamic_decode (fluid rnn.py:856,1327):
+    train a GRU seq2seq on the reversal task, then beam-decode."""
+    rng = np.random.RandomState(9)
+    V, EMB, HID, T, BOS, EOS = 10, 12, 24, 4, 1, 0
+    emb_src = nn.Embedding(V, EMB)
+    emb_tgt = nn.Embedding(V, EMB)
+    enc = nn.GRU(EMB, HID)
+    dec_cell = nn.GRUCell(EMB, HID)
+    out_fc = nn.Linear(HID, V)
+    params = (emb_src.parameters() + emb_tgt.parameters()
+              + enc.parameters() + dec_cell.parameters()
+              + out_fc.parameters())
+    opt = pt.optimizer.Adam(5e-3, parameters=params)
+
+    def batch(n=32):
+        src = rng.randint(2, V, (n, T)).astype(np.int64)
+        tgt = src[:, ::-1].copy()
+        tin = np.concatenate([np.full((n, 1), BOS), tgt[:, :-1]], 1)
+        return src, tin.astype(np.int64), tgt
+
+    import paddle_tpu.tensor as Tn
+    for i in range(150):
+        src, tin, tgt = batch()
+        _, h = enc(emb_src(pt.to_tensor(src)))
+        h = Tn.squeeze(h, 0)
+        logits = []
+        st = h
+        for t in range(T):
+            o, st = dec_cell(emb_tgt(pt.to_tensor(tin[:, t])), st)
+            logits.append(out_fc(o))
+        loss = nn.CrossEntropyLoss()(
+            Tn.stack(logits, 1).reshape([-1, V]),
+            pt.to_tensor(tgt.reshape(-1)[:, None]))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < 0.5, float(loss)
+
+    # decode 2 sources with beam 3
+    src, _, tgt = batch(2)
+    _, h = enc(emb_src(pt.to_tensor(src)))
+    h = Tn.squeeze(h, 0)
+    dec = nn.BeamSearchDecoder(dec_cell, BOS, EOS, beam_size=3,
+                               embedding_fn=emb_tgt,
+                               output_fn=out_fc)
+    ids, scores = nn.dynamic_decode(dec, inits=h, max_step_num=T)
+    top = np.asarray(ids.value)[:, 0, :]  # best beam per source
+    acc = (top == tgt).mean()
+    assert acc >= 0.75, (top.tolist(), tgt.tolist())
+    assert np.asarray(scores.value).shape == (2, 3)
