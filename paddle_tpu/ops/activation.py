@@ -171,3 +171,11 @@ def _prelu(ctx, ins, attrs):
     if mode == "channel" and x.ndim == 4:
         alpha = alpha.reshape((1, -1, 1, 1))
     return one(jnp.where(x > 0, x, alpha * x))
+
+
+@register_op("soft_relu", inputs=("X",))
+def _soft_relu(ctx, ins, attrs):
+    """activation_op.cc SoftRelu: log(1 + exp(clip(x, -t, t)))."""
+    t = attrs.get("threshold", 40.0)
+    x = jnp.clip(ins["X"][0], -t, t)
+    return one(jnp.log1p(jnp.exp(x)))

@@ -541,3 +541,43 @@ def _ctc_greedy_decoder(ctx, ins, attrs):
     return {"Out": [res],
             "OutLength": [np.asarray([len(p) for p in paths],
                                      np.int64).reshape(-1, 1)]}
+
+
+@register_op("npair_loss", inputs=("Anchor", "Positive", "Labels"),
+             non_diff_inputs=("Labels",))
+def _npair_loss(ctx, ins, attrs):
+    """nn.py npair_loss composition: cross-entropy over
+    anchor·positiveᵀ similarities with same-label targets + L2 reg of
+    the embeddings."""
+    a = ins["Anchor"][0]        # [B, D]
+    p = ins["Positive"][0]
+    labels = ins["Labels"][0].reshape(-1)
+    l2_reg = attrs.get("l2_reg", 0.002)
+    sim = a @ p.T               # [B, B]
+    same = (labels[:, None] == labels[None, :]).astype(sim.dtype)
+    targets = same / jnp.sum(same, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(targets * logp, axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(a), 1))
+                    + jnp.mean(jnp.sum(jnp.square(p), 1))) / 2.0
+    return one((ce + reg).reshape(1))
+
+
+@register_op("sampled_softmax_with_cross_entropy",
+             inputs=("Logits", "Label"),
+             outputs=("Loss",), non_diff_inputs=("Label",))
+def _sampled_softmax_ce(ctx, ins, attrs):
+    """sample_logits-based training loss: softmax CE over the true
+    class + num_samples uniformly sampled negatives
+    (operators/sample_logits_op.cc semantics at the loss level)."""
+    logits = ins["Logits"][0]   # [B, C]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    num_samples = int(attrs.get("num_samples", 100))
+    B, C = logits.shape
+    key = ctx.rng()
+    neg = jax.random.randint(key, (B, num_samples), 0, C)
+    pos_logit = jnp.take_along_axis(logits, label[:, None], axis=1)
+    neg_logit = jnp.take_along_axis(logits, neg, axis=1)
+    all_logit = jnp.concatenate([pos_logit, neg_logit], axis=1)
+    loss = -jax.nn.log_softmax(all_logit, axis=1)[:, 0:1]
+    return {"Loss": [loss]}

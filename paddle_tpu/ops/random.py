@@ -82,3 +82,28 @@ def _sampling_id(ctx, ins, attrs):
     x = ins["X"][0]  # [batch, n] probabilities
     return one(jax.random.categorical(
         ctx.rng(), jnp.log(x + 1e-20), axis=-1).astype(jnp.int64))
+
+
+@register_op("uniform_random_batch_size_like", inputs=("Input",),
+             no_grad=True, is_random=True)
+def _uniform_random_bsl(ctx, ins, attrs):
+    """uniform_random_batch_size_like_op.cc: uniform tensor whose
+    batch dim copies the input's."""
+    x = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        x.shape[attrs.get("input_dim_idx", 0)]
+    return one(jax.random.uniform(
+        ctx.rng(), tuple(shape),
+        minval=attrs.get("min", -1.0), maxval=attrs.get("max", 1.0)))
+
+
+@register_op("gaussian_random_batch_size_like", inputs=("Input",),
+             no_grad=True, is_random=True)
+def _gaussian_random_bsl(ctx, ins, attrs):
+    x = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        x.shape[attrs.get("input_dim_idx", 0)]
+    return one(attrs.get("mean", 0.0) + attrs.get("std", 1.0)
+               * jax.random.normal(ctx.rng(), tuple(shape)))

@@ -436,3 +436,41 @@ def test_new_ops_oracles():
     p = np.asarray([[0.0, 1.0], [1.0, 0.0]], np.float32)
     o = run_op("dice_loss", {"X": p, "Label": p}, {})
     assert float(np.asarray(o["Out"][0])[0]) < 1e-4
+
+
+def test_final_op_batch():
+    r = np.random.RandomState(11)
+    # batch_size_like randoms copy the batch dim
+    x = np.zeros((5, 2), np.float32)
+    o = run_op("uniform_random_batch_size_like", {"Input": x},
+               {"shape": [-1, 7], "min": 0.0, "max": 1.0})
+    arr = np.asarray(o["Out"][0])
+    assert arr.shape == (5, 7) and (arr >= 0).all() and (arr < 1).all()
+    o = run_op("gaussian_random_batch_size_like", {"Input": x},
+               {"shape": [-1, 3], "mean": 5.0, "std": 0.1})
+    assert abs(np.asarray(o["Out"][0]).mean() - 5.0) < 0.5
+    # soft_relu oracle
+    v = np.asarray([-1.0, 0.0, 2.0], np.float32)
+    o = run_op("soft_relu", {"X": v}, {})
+    np.testing.assert_allclose(np.asarray(o["Out"][0]),
+                               np.log1p(np.exp(v)), rtol=1e-5)
+    # npair_loss: identical anchor/positive with distinct labels is a
+    # low-loss configuration; random is higher
+    a = np.eye(4, 8, dtype=np.float32) * 5
+    lbl = np.arange(4).astype(np.int64)
+    o_good = run_op("npair_loss",
+                    {"Anchor": a, "Positive": a, "Labels": lbl},
+                    {"l2_reg": 0.0})
+    o_rand = run_op("npair_loss",
+                    {"Anchor": r.randn(4, 8).astype(np.float32),
+                     "Positive": r.randn(4, 8).astype(np.float32),
+                     "Labels": lbl}, {"l2_reg": 0.0})
+    assert float(np.asarray(o_good["Out"][0])) \
+        < float(np.asarray(o_rand["Out"][0]))
+    # sampled softmax loss shape + finiteness
+    logits = r.randn(6, 50).astype(np.float32)
+    lab = r.randint(0, 50, (6, 1)).astype(np.int64)
+    o = run_op("sampled_softmax_with_cross_entropy",
+               {"Logits": logits, "Label": lab}, {"num_samples": 10})
+    out = np.asarray(o["Loss"][0])
+    assert out.shape == (6, 1) and np.isfinite(out).all()
