@@ -134,6 +134,12 @@ _OP_BACKED = {
     "multiclass_nms": ("multiclass_nms", None),
     "multiplex": ("multiplex", None),
     "nce": ("nce", None),
+    "npair_loss": ("npair_loss", None),
+    "soft_relu": ("soft_relu", None),
+    "uniform_random_batch_size_like":
+        ("uniform_random_batch_size_like", None),
+    "gaussian_random_batch_size_like":
+        ("gaussian_random_batch_size_like", None),
     "pad": ("pad", None),
     "pad2d": ("pad2d", None),
     "pad_constant_like": ("pad_constant_like", None),
@@ -216,6 +222,26 @@ def _install():
         if hasattr(_T, src):
             globals()[name] = getattr(_T, src)
             __all__.append(name)
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                        num_true=1,
+                                        remove_accidental_hits=True,
+                                        use_customized_samples=False,
+                                        customized_samples=None,
+                                        customized_probabilities=None,
+                                        seed=0, name=None):
+    """Reference signature (loss.py:1051): num_samples is a required
+    POSITIONAL parameter, so the generic slot-mapping wrapper does not
+    fit."""
+    return _run("sampled_softmax_with_cross_entropy",
+                {"Logits": [logits], "Label": [label]},
+                {"num_samples": int(num_samples),
+                 "remove_accidental_hits": bool(remove_accidental_hits)},
+                out_slot="Loss")
+
+
+__all__.append("sampled_softmax_with_cross_entropy")
 
 
 def sum(x, name=None):  # noqa: A001

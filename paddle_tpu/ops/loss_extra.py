@@ -558,26 +558,33 @@ def _npair_loss(ctx, ins, attrs):
     targets = same / jnp.sum(same, axis=1, keepdims=True)
     logp = jax.nn.log_softmax(sim, axis=1)
     ce = -jnp.mean(jnp.sum(targets * logp, axis=1))
-    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(a), 1))
-                    + jnp.mean(jnp.sum(jnp.square(p), 1))) / 2.0
+    # loss.py:1736-1747: Beta = 0.25; l2loss = (mean Σa² + mean Σp²)
+    # * Beta * l2_reg
+    reg = 0.25 * l2_reg * (jnp.mean(jnp.sum(jnp.square(a), 1))
+                           + jnp.mean(jnp.sum(jnp.square(p), 1)))
     return one((ce + reg).reshape(1))
 
 
 @register_op("sampled_softmax_with_cross_entropy",
              inputs=("Logits", "Label"),
-             outputs=("Loss",), non_diff_inputs=("Label",))
+             outputs=("Loss",), non_diff_inputs=("Label",),
+             is_random=True)
 def _sampled_softmax_ce(ctx, ins, attrs):
-    """sample_logits-based training loss: softmax CE over the true
-    class + num_samples uniformly sampled negatives
-    (operators/sample_logits_op.cc semantics at the loss level)."""
+    """The reference loss (loss.py:1051 sampled_softmax_with_cross_
+    entropy) = sample_logits (log-uniform negatives, logQ correction,
+    accidental-hit masking — ops/nn.py _sample_logits) + softmax CE on
+    the sampled logits. Composed on the existing lowering so the
+    sampling semantics live in one place."""
+    from ..core.registry import REGISTRY as _R
     logits = ins["Logits"][0]   # [B, C]
-    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
-    num_samples = int(attrs.get("num_samples", 100))
-    B, C = logits.shape
-    key = ctx.rng()
-    neg = jax.random.randint(key, (B, num_samples), 0, C)
-    pos_logit = jnp.take_along_axis(logits, label[:, None], axis=1)
-    neg_logit = jnp.take_along_axis(logits, neg, axis=1)
-    all_logit = jnp.concatenate([pos_logit, neg_logit], axis=1)
-    loss = -jax.nn.log_softmax(all_logit, axis=1)[:, 0:1]
+    label = ins["Label"][0]
+    if label.ndim == 1:
+        label = label[:, None]
+    sub = _R.get("sample_logits").lower(
+        ctx, {"Logits": [logits], "Labels": [label]},
+        {"num_samples": int(attrs.get("num_samples", 100)),
+         "remove_accidental_hits":
+             bool(attrs.get("remove_accidental_hits", True))})
+    sampled = sub["SampledLogits"][0]
+    loss = -jax.nn.log_softmax(sampled, axis=1)[:, 0:1]
     return {"Loss": [loss]}

@@ -95,6 +95,7 @@ def _uniform_random_bsl(ctx, ins, attrs):
         x.shape[attrs.get("input_dim_idx", 0)]
     return one(jax.random.uniform(
         ctx.rng(), tuple(shape),
+        dtype=to_jax_dtype(attrs.get("dtype", "float32")),
         minval=attrs.get("min", -1.0), maxval=attrs.get("max", 1.0)))
 
 
@@ -106,4 +107,6 @@ def _gaussian_random_bsl(ctx, ins, attrs):
     shape[attrs.get("output_dim_idx", 0)] = \
         x.shape[attrs.get("input_dim_idx", 0)]
     return one(attrs.get("mean", 0.0) + attrs.get("std", 1.0)
-               * jax.random.normal(ctx.rng(), tuple(shape)))
+               * jax.random.normal(
+                   ctx.rng(), tuple(shape),
+                   dtype=to_jax_dtype(attrs.get("dtype", "float32"))))
