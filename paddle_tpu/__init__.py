@@ -96,3 +96,4 @@ from .tensor import (zeros, ones, full, zeros_like, ones_like,  # noqa: F401
                      masked_select, nonzero, cumsum, kron, numel)
 from .dygraph.tape import no_grad  # noqa: F401
 from . import distribution  # noqa: F401
+from . import datasets  # noqa: F401
