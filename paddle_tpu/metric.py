@@ -153,3 +153,167 @@ class Auc(Metric):
         tpr = np.concatenate([[0.0], tpr])
         fpr = np.concatenate([[0.0], fpr])
         return float(np.trapezoid(tpr, fpr))
+
+
+class CompositeMetric(Metric):
+    """metrics.py:199 — evaluate several metrics on the same
+    (pred, label) stream."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric: Metric):
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def accumulate(self):
+        return [m.accumulate() for m in self._metrics]
+
+
+class ChunkEvaluator(Metric):
+    """metrics.py:513 — micro-F1 over chunk counts; feed it the
+    chunk_eval op's NumInferChunks/NumLabelChunks/NumCorrectChunks."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks))
+        self.num_label_chunks += int(np.asarray(num_label_chunks))
+        self.num_correct_chunks += int(np.asarray(num_correct_chunks))
+
+    def accumulate(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+
+class EditDistance(Metric):
+    """metrics.py:611 — averaged edit distance + instance error rate;
+    feed it the edit_distance op's (distances, seq_num) outputs."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num=None):
+        d = np.asarray(distances, np.float64).reshape(-1)
+        self.total_distance += float(d.sum())
+        self.seq_num += int(np.asarray(seq_num)) if seq_num is not None \
+            else len(d)
+        self.instance_error += int((d > 0).sum())
+
+    def accumulate(self):
+        if self.seq_num == 0:
+            raise ValueError("EditDistance: no data added "
+                             "(metrics.py:676 raises the same)")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+class DetectionMAP(Metric):
+    """metrics.py:805-style mean average precision over accumulated
+    detections: update() takes per-image detections
+    [[label, score, x1, y1, x2, y2], ...] and ground truths
+    [[label, x1, y1, x2, y2], ...]; accumulate() returns mAP with
+    '11point' or 'integral' averaging."""
+
+    def __init__(self, overlap_threshold: float = 0.5,
+                 map_type: str = "11point",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        if map_type not in ("11point", "integral"):
+            raise ValueError("map_type must be 11point or integral")
+        self.overlap_threshold = overlap_threshold
+        self.map_type = map_type
+        self.reset()
+
+    def reset(self):
+        self._dets = []   # (img_id, label, score, box)
+        self._gts = []    # (img_id, label, box)
+        self._img = 0
+
+    def update(self, detections, gts):
+        for d in np.asarray(detections, np.float64).reshape(-1, 6):
+            self._dets.append((self._img, int(d[0]), float(d[1]),
+                               d[2:6]))
+        for g in np.asarray(gts, np.float64).reshape(-1, 5):
+            self._gts.append((self._img, int(g[0]), g[1:5]))
+        self._img += 1
+
+    @staticmethod
+    def _iou(a, b):
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = ix * iy
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def accumulate(self):
+        labels = sorted({g[1] for g in self._gts})
+        aps = []
+        for cls in labels:
+            gts = [(i, b) for i, l, b in self._gts if l == cls]
+            npos = len(gts)
+            dets = sorted((d for d in self._dets if d[1] == cls),
+                          key=lambda d: -d[2])
+            matched = set()
+            tps, fps = [], []
+            for img, _, score, box in dets:
+                best, best_j = 0.0, None
+                for j, (gi, gb) in enumerate(gts):
+                    if gi != img or j in matched:
+                        continue
+                    o = self._iou(box, gb)
+                    if o > best:
+                        best, best_j = o, j
+                if best >= self.overlap_threshold:
+                    matched.add(best_j)
+                    tps.append(1.0)
+                    fps.append(0.0)
+                else:
+                    tps.append(0.0)
+                    fps.append(1.0)
+            if npos == 0:
+                continue
+            tp = np.cumsum(tps) if tps else np.zeros(1)
+            fp = np.cumsum(fps) if fps else np.zeros(1)
+            rec = tp / npos
+            prec = tp / np.maximum(tp + fp, 1e-12)
+            if self.map_type == "11point":
+                ap = np.mean([prec[rec >= t].max() if (rec >= t).any()
+                              else 0.0
+                              for t in np.linspace(0, 1, 11)])
+            else:  # integral
+                ap = 0.0
+                prev_r = 0.0
+                for r, p in zip(rec, prec):
+                    ap += p * (r - prev_r)
+                    prev_r = r
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0

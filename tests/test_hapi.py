@@ -219,3 +219,37 @@ def test_hapi_summary(capsys):
     info = Model(net).summary()
     assert info["total_params"] == 3 * 2 + 2
     assert "Total params" in capsys.readouterr().out
+
+
+def test_metric_long_tail():
+    """CompositeMetric / ChunkEvaluator / EditDistance / DetectionMAP
+    (fluid metrics.py:199,513,611,805)."""
+    import numpy as np
+    from paddle_tpu.metric import (Accuracy, ChunkEvaluator,
+                                   CompositeMetric, DetectionMAP,
+                                   EditDistance, Precision)
+
+    ce = ChunkEvaluator()
+    ce.update(10, 8, 6)
+    p, r, f1 = ce.accumulate()
+    assert abs(p - 0.6) < 1e-9 and abs(r - 0.75) < 1e-9
+    assert abs(f1 - 2 * 0.6 * 0.75 / 1.35) < 1e-9
+
+    ed = EditDistance()
+    ed.update(np.asarray([[0.0], [2.0]]), 2)
+    avg, err = ed.accumulate()
+    assert avg == 1.0 and err == 0.5
+
+    m = DetectionMAP(map_type="11point")
+    # one perfect detection, one missed gt
+    m.update(np.asarray([[0, 0.9, 0, 0, 10, 10]], np.float64),
+             np.asarray([[0, 0, 0, 10, 10], [0, 20, 20, 30, 30]],
+                        np.float64))
+    ap = m.accumulate()
+    assert 0.4 < ap < 0.6  # recall caps at 0.5 with full precision
+
+    comp = CompositeMetric()
+    comp.add_metric(ChunkEvaluator())
+    comp._metrics[0].update(4, 4, 4)
+    res = comp.accumulate()
+    assert res[0][2] == 1.0
