@@ -12,7 +12,9 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc",
+           "CompositeMetric", "ChunkEvaluator", "EditDistance",
+           "DetectionMAP"]
 
 
 class Metric:
@@ -170,9 +172,12 @@ class CompositeMetric(Metric):
         for m in self._metrics:
             m.reset()
 
-    def update(self, preds, labels):
+    def update(self, *args):
+        """Forward varargs so children with non-(pred,label) update
+        signatures (ChunkEvaluator etc.) are drivable through the
+        composite."""
         for m in self._metrics:
-            m.update(preds, labels)
+            m.update(*args)
 
     def accumulate(self):
         return [m.accumulate() for m in self._metrics]
@@ -292,7 +297,8 @@ class DetectionMAP(Metric):
                     o = self._iou(box, gb)
                     if o > best:
                         best, best_j = o, j
-                if best >= self.overlap_threshold:
+                if best_j is not None and \
+                        best >= self.overlap_threshold:
                     matched.add(best_j)
                     tps.append(1.0)
                     fps.append(0.0)

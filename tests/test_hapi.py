@@ -250,6 +250,15 @@ def test_metric_long_tail():
 
     comp = CompositeMetric()
     comp.add_metric(ChunkEvaluator())
-    comp._metrics[0].update(4, 4, 4)
+    comp.update(4, 4, 4)   # varargs forwarded to every child
     res = comp.accumulate()
     assert res[0][2] == 1.0
+    comp.reset()
+    assert comp.accumulate()[0] == (0.0, 0.0, 0.0)
+
+    # the threshold guard: a zero-IoU detection is never a TP even at
+    # overlap_threshold=0
+    m0 = DetectionMAP(overlap_threshold=0.0)
+    m0.update(np.asarray([[0, 0.9, 100, 100, 110, 110]], np.float64),
+              np.asarray([[0, 0, 0, 10, 10]], np.float64))
+    assert m0.accumulate() == 0.0
