@@ -97,3 +97,4 @@ from .tensor import (zeros, ones, full, zeros_like, ones_like,  # noqa: F401
 from .dygraph.tape import no_grad  # noqa: F401
 from . import distribution  # noqa: F401
 from . import datasets  # noqa: F401
+from . import vision_transforms  # noqa: F401

@@ -1,0 +1,140 @@
+"""Image transforms — the paddle.vision.transforms surface.
+
+Analog of the reference vision transform pipeline (the v2 era's
+transforms module; in the 1.8 tree the same role is played by the
+reader-decorator preprocussing in dataset/image.py). Host-side numpy
+transforms composed in the data pipeline (before device staging), HWC
+uint8/float in, as image loaders produce.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Compose", "Resize", "RandomCrop", "CenterCrop",
+           "RandomHorizontalFlip", "Normalize", "ToTensor", "Transpose"]
+
+
+class Compose:
+    def __init__(self, transforms: Sequence[Callable]):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+def _resize_bilinear_np(img: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Plain bilinear resample (HWC)."""
+    ih, iw = img.shape[:2]
+    ys = np.linspace(0, ih - 1, h)
+    xs = np.linspace(0, iw - 1, w)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, ih - 1)
+    x1 = np.minimum(x0 + 1, iw - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    im = img.astype(np.float32)
+    if im.ndim == 2:
+        im = im[..., None]
+        squeeze = True
+    else:
+        squeeze = False
+    out = ((1 - wy) * (1 - wx) * im[y0][:, x0]
+           + (1 - wy) * wx * im[y0][:, x1]
+           + wy * (1 - wx) * im[y1][:, x0]
+           + wy * wx * im[y1][:, x1])
+    if img.dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    return out[..., 0] if squeeze else out
+
+
+class Resize:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        return _resize_bilinear_np(np.asarray(img), *self.size)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        h, w = self.size
+        ih, iw = img.shape[:2]
+        top = max((ih - h) // 2, 0)
+        left = max((iw - w) // 2, 0)
+        return img[top:top + h, left:left + w]
+
+
+class RandomCrop:
+    def __init__(self, size, seed: Optional[int] = None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        h, w = self.size
+        ih, iw = img.shape[:2]
+        top = self._rng.randint(0, max(ih - h, 0) + 1)
+        left = self._rng.randint(0, max(iw - w, 0) + 1)
+        return img[top:top + h, left:left + w]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob: float = 0.5, seed: Optional[int] = None):
+        self.prob = prob
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        if self._rng.rand() < self.prob:
+            return np.asarray(img)[:, ::-1].copy()
+        return np.asarray(img)
+
+
+class Normalize:
+    """(img - mean) / std, channel-last or channel-first per
+    data_format."""
+
+    def __init__(self, mean, std, data_format: str = "CHW"):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        return (img - self.mean.reshape(shape)) \
+            / self.std.reshape(shape)
+
+
+class Transpose:
+    """HWC -> CHW (the device-side NCHW convention)."""
+
+    def __init__(self, order=(2, 0, 1)):
+        self.order = tuple(order)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[..., None]
+        return img.transpose(self.order)
+
+
+class ToTensor:
+    """uint8 HWC -> float32 CHW in [0, 1]."""
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[..., None]
+        img = img.transpose(2, 0, 1).astype(np.float32)
+        if img.max() > 1.5:
+            img = img / 255.0
+        return img

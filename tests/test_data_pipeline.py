@@ -270,3 +270,31 @@ def test_builtin_dataset_readers():
                 first = first if first is not None else float(out)
                 last = float(out)
         assert last < first * 0.5, (first, last)
+
+
+def test_vision_transforms():
+    """paddle.vision.transforms analog: host-side pipeline composing
+    into the reader path."""
+    from paddle_tpu.vision_transforms import (CenterCrop, Compose,
+                                              Normalize, RandomCrop,
+                                              RandomHorizontalFlip,
+                                              Resize, ToTensor)
+    rng = np.random.RandomState(0)
+    img = (rng.rand(32, 48, 3) * 255).astype(np.uint8)
+    t = Compose([Resize(24), CenterCrop(16), ToTensor(),
+                 Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5])])
+    out = t(img)
+    assert out.shape == (3, 16, 16) and out.dtype == np.float32
+    assert -1.01 <= out.min() and out.max() <= 1.01
+
+    rc = RandomCrop(8, seed=0)
+    assert rc(img).shape == (8, 8, 3)
+    flip = RandomHorizontalFlip(prob=1.0)
+    np.testing.assert_array_equal(flip(img), img[:, ::-1])
+
+    # bilinear resize oracle on a ramp: values interpolate linearly
+    ramp = np.tile(np.arange(8, dtype=np.float32)[None, :, None],
+                   (4, 1, 1))
+    r = Resize((4, 4))(ramp)
+    np.testing.assert_allclose(r[0, :, 0],
+                               np.linspace(0, 7, 4), rtol=1e-6)
