@@ -1,3 +1,3 @@
 from .dataset import (DatasetFactory, InMemoryDataset,  # noqa: F401
-                      QueueDataset, MultiSlotDesc)
+                      QueueDataset, MultiSlotDesc, DataFeedDesc)
 from .native import parse_multislot, using_native  # noqa: F401

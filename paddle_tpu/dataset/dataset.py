@@ -262,3 +262,92 @@ class DatasetFactory:
         if datafeed_class == "QueueDataset":
             return QueueDataset()
         raise ValueError("unknown dataset class %r" % datafeed_class)
+
+
+class DataFeedDesc:
+    """fluid.DataFeedDesc (data_feed_desc.py:85): config handle parsed
+    from a protobuf-TEXT description of a MultiSlotDataFeed. The proto
+    collapses to a dict here (the framework's JSON-IR convention), but
+    the text format the reference writes is accepted:
+
+        name: "MultiSlotDataFeed"
+        batch_size: 2
+        multi_slot_desc {
+          slots { name: "words"  type: "uint64" is_dense: false
+                  is_used: false }
+          slots { name: "label"  type: "uint64" is_dense: false
+                  is_used: false }
+        }
+    """
+
+    def __init__(self, proto_file: str):
+        import re
+        self.name = "MultiSlotDataFeed"
+        self.batch_size = 1
+        self.pipe_command = "cat"
+        self.slots = []           # dicts: name/type/is_dense/is_used
+        self._index = {}
+        with open(proto_file) as f:
+            text = f.read()
+        m = re.search(r'name:\s*"([^"]+)"', text)
+        if m:
+            self.name = m.group(1)
+        m = re.search(r"batch_size:\s*(\d+)", text)
+        if m:
+            self.batch_size = int(m.group(1))
+        for sm in re.finditer(r"slots\s*\{([^}]*)\}", text):
+            body = sm.group(1)
+            slot = {
+                "name": re.search(r'name:\s*"([^"]+)"', body).group(1),
+                "type": (re.search(r'type:\s*"([^"]+)"', body) or
+                         [None, "uint64"])[1]
+                if re.search(r'type:\s*"([^"]+)"', body) else "uint64",
+                "is_dense": "is_dense: true" in body,
+                "is_used": "is_used: true" in body,
+            }
+            self._index[slot["name"]] = len(self.slots)
+            self.slots.append(slot)
+
+    def set_batch_size(self, batch_size: int):
+        self.batch_size = int(batch_size)
+
+    def set_pipe_command(self, cmd: str):
+        self.pipe_command = cmd
+
+    def set_use_slots(self, use_slots_name):
+        for n in use_slots_name:
+            if n not in self._index:
+                raise ValueError("set_use_slots: unknown slot %r" % n)
+            self.slots[self._index[n]]["is_used"] = True
+
+    def set_dense_slots(self, dense_slots_name):
+        for n in dense_slots_name:
+            if n not in self._index:
+                raise ValueError("set_dense_slots: unknown slot %r" % n)
+            self.slots[self._index[n]]["is_dense"] = True
+
+    def desc(self) -> str:
+        """The serialized description (reference returns proto text)."""
+        lines = ['name: "%s"' % self.name,
+                 "batch_size: %d" % self.batch_size,
+                 "multi_slot_desc {"]
+        for s in self.slots:
+            lines.append(
+                '  slots { name: "%s" type: "%s" is_dense: %s '
+                "is_used: %s }" % (s["name"], s["type"],
+                                   str(s["is_dense"]).lower(),
+                                   str(s["is_used"]).lower()))
+        lines.append("}")
+        return "\n".join(lines)
+
+    def apply_to(self, dataset: "_DatasetBase"):
+        """Configure a Dataset from this desc (the seam the reference's
+        dataset.set_data_feed_desc covers via proto exchange)."""
+        dataset.set_batch_size(self.batch_size)
+        for s in self.slots:
+            if s["is_used"]:
+                dataset._slots.append(Slot(
+                    s["name"],
+                    "float" if s["type"] in ("float", "float32")
+                    else "uint64", s["is_dense"], None))
+        return dataset

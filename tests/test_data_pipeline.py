@@ -298,3 +298,34 @@ def test_vision_transforms():
     r = Resize((4, 4))(ramp)
     np.testing.assert_allclose(r[0, :, 0],
                                np.linspace(0, 7, 4), rtol=1e-6)
+
+
+def test_data_feed_desc(tmp_path):
+    """fluid.DataFeedDesc: parse proto-text, toggle slots, configure a
+    Dataset (data_feed_desc.py:85)."""
+    from paddle_tpu.dataset import DataFeedDesc, DatasetFactory
+    proto = tmp_path / "feed.prototxt"
+    proto.write_text(
+        'name: "MultiSlotDataFeed"\n'
+        "batch_size: 2\n"
+        "multi_slot_desc {\n"
+        '  slots { name: "words" type: "uint64" is_dense: false '
+        "is_used: false }\n"
+        '  slots { name: "dense_f" type: "float" is_dense: true '
+        "is_used: false }\n"
+        "}\n")
+    desc = DataFeedDesc(str(proto))
+    assert desc.batch_size == 2
+    assert [s["name"] for s in desc.slots] == ["words", "dense_f"]
+    desc.set_batch_size(4)
+    desc.set_use_slots(["words", "dense_f"])
+    out = desc.desc()
+    assert 'name: "words"' in out and "batch_size: 4" in out
+    with pytest.raises(ValueError):
+        desc.set_use_slots(["nope"])
+
+    ds = DatasetFactory().create_dataset("QueueDataset")
+    desc.apply_to(ds)
+    assert ds._batch_size == 4
+    assert [s.name for s in ds._slots] == ["words", "dense_f"]
+    assert ds._slots[1].type == "float" and ds._slots[1].is_dense
