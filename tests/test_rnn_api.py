@@ -115,6 +115,8 @@ def test_multilayer_stacked_final_states():
 def test_beam_search_decoder_dynamic_decode():
     """BeamSearchDecoder + dynamic_decode (fluid rnn.py:856,1327):
     train a GRU seq2seq on the reversal task, then beam-decode."""
+    from paddle_tpu.dygraph import tape
+    tape.seed(13)  # hermetic init: the convergence bound is tight
     rng = np.random.RandomState(9)
     V, EMB, HID, T, BOS, EOS = 10, 12, 24, 4, 1, 0
     emb_src = nn.Embedding(V, EMB)
@@ -125,7 +127,7 @@ def test_beam_search_decoder_dynamic_decode():
     params = (emb_src.parameters() + emb_tgt.parameters()
               + enc.parameters() + dec_cell.parameters()
               + out_fc.parameters())
-    opt = pt.optimizer.Adam(5e-3, parameters=params)
+    opt = pt.optimizer.Adam(1e-2, parameters=params)
 
     def batch(n=32):
         src = rng.randint(2, V, (n, T)).astype(np.int64)
@@ -134,7 +136,7 @@ def test_beam_search_decoder_dynamic_decode():
         return src, tin.astype(np.int64), tgt
 
     import paddle_tpu.tensor as Tn
-    for i in range(150):
+    for i in range(250):
         src, tin, tgt = batch()
         _, h = enc(emb_src(pt.to_tensor(src)))
         h = Tn.squeeze(h, 0)
