@@ -37,7 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 STRUCTURAL_OPS = ("while", "conditional_block", "write_to_array",
-                  "read_from_array", "array_length", "run_program")
+                  "read_from_array", "array_length", "run_program",
+                  "static_rnn")
 
 
 def _block_io(block) -> Tuple[Set[str], Set[str]]:
@@ -285,3 +286,55 @@ LOWERINGS = {
     "array_length": lower_array_length,
     "run_program": lower_run_program,
 }
+
+
+def lower_static_rnn(lowerer, op, env: Dict[str, Any]) -> None:
+    """static_rnn structural op (fluid StaticRNN, layers
+    control_flow.py:449): scan the step sub-block over the time-major
+    leading dim of the step inputs with lax.scan — memories are the
+    carry, step outputs stack to [T, ...]."""
+    from .executor import _BlockLowerer
+    from .registry import LowerCtx
+
+    program = lowerer.program
+    sub = program.blocks[int(op.attr("sub_block"))]
+    seq_names = list(op.input("X"))
+    init_names = list(op.input("Init"))
+    out_names = list(op.output("Out"))
+    step_in = list(op.attr("step_in_names"))
+    mem_pre = list(op.attr("mem_pre_names"))
+    mem_post = list(op.attr("mem_post_names"))
+    step_out = list(op.attr("step_out_names"))
+
+    seqs = [jnp.asarray(env[n]) for n in seq_names]
+    inits = [jnp.asarray(env[n]) for n in init_names]
+    outer_env = dict(env)
+    key0 = lowerer.ctx.key_out
+
+    def body(carry, xs_t):
+        mems, key = carry
+        key, sub_key = (jax.random.split(key) if key is not None
+                        else (None, None))
+        ctx2 = LowerCtx(sub_key, is_test=lowerer.ctx.is_test,
+                        mesh=lowerer.ctx.mesh)
+        env2 = dict(outer_env)
+        for n, v in zip(step_in, xs_t):
+            env2[n] = v
+        for n, v in zip(mem_pre, mems):
+            env2[n] = v
+        _BlockLowerer(program, ctx2).run_ops(sub.ops, env2)
+        new_mems = tuple(env2[n] for n in mem_post)
+        outs = tuple(env2[n] for n in step_out)
+        return (new_mems, key), outs
+
+    (final_mems, final_key), stacked = jax.lax.scan(
+        body, (tuple(inits), key0), tuple(seqs))
+    # thread the POST-loop carry key out (lower_while's discipline):
+    # rewinding to split(key0) would hand later ops keys the steps
+    # already consumed, duplicating dropout masks
+    lowerer.ctx._key = final_key
+    for n, v in zip(out_names, stacked):
+        env[n] = v
+
+
+LOWERINGS["static_rnn"] = lower_static_rnn

@@ -2,7 +2,7 @@ from .helper import (Constant, Initializer, LayerHelper, MSRA, Normal,  # noqa: 
                      ParamAttr, TruncatedNormal, Uniform, Xavier)
 from .nn import *  # noqa: F401,F403
 from . import nn  # noqa: F401
-from .control_flow import (While, Assert, Print, Switch,  # noqa: F401
+from .control_flow import (While, Assert, Print, StaticRNN, Switch,  # noqa: F401
                            case, switch_case, while_loop, array_length,
                            array_read, array_write, cond, create_array,
                            increment)

@@ -14,7 +14,7 @@ from ..core.program import VarDesc, default_main_program
 from .helper import LayerHelper
 
 __all__ = ["While", "cond", "increment", "array_write", "array_read",
-           "while_loop", "case", "switch_case", "Switch",
+           "while_loop", "case", "switch_case", "Switch", "StaticRNN",
            "array_length", "create_array", "Print", "Assert"]
 
 
@@ -348,3 +348,136 @@ class _ConditionalBlock:
             outputs={"Out": writes},
             attrs={"sub_block": self._sub.idx})
         return False
+
+
+class StaticRNN:
+    """fluid.layers.StaticRNN (control_flow.py:449): build a per-step
+    block with step_input / memory / update_memory / step_output, then
+    call the rnn to get time-stacked outputs.
+
+        rnn = StaticRNN()
+        with rnn.step():
+            word = rnn.step_input(x_t_major)       # x: [T, B, D]
+            prev = rnn.memory(init=h0)             # [B, H]
+            h = layers.fc(concat([word, prev]), H, act="tanh")
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out = rnn()                                # [T, B, H]
+
+    Lowering: ONE structural static_rnn op whose sub-block scans under
+    lax.scan (core/control_flow.py lower_static_rnn) — the reference
+    unrolls per-step ops; XLA gets a rolled loop instead."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.helper = LayerHelper("static_rnn", name)
+        self.program = default_main_program()
+        self._step_ins = []    # (outer_name, inner_name)
+        self._mems = []        # (init_name, pre_name, post_name or None)
+        self._outs = []        # (inner_name, outer_name)
+        self._sub = None
+        self._built = False
+
+    class _StepGuard:
+        def __init__(self, rnn):
+            self._rnn = rnn
+            rnn._sub = rnn.program.create_block()
+            self._guard = rnn.program.block_guard(rnn._sub)
+
+        def __enter__(self):
+            self._guard.__enter__()
+            return self._rnn
+
+        def __exit__(self, *exc):
+            self._guard.__exit__(*exc)
+            if exc and exc[0] is not None:
+                return False
+            self._rnn._finalize()
+            return False
+
+    def step(self):
+        return StaticRNN._StepGuard(self)
+
+    def _require_building(self):
+        if self._sub is None or self.program.current_block() is not \
+                self._sub:
+            raise RuntimeError("StaticRNN: call inside `with rnn.step()`")
+
+    def step_input(self, x: VarDesc) -> VarDesc:
+        self._require_building()
+        inner = self._sub.create_var(
+            self.helper.unique_name("step_in"),
+            shape=tuple(x.shape[1:]) if x.shape else None,
+            dtype=x.dtype, stop_gradient=x.stop_gradient)
+        self._step_ins.append((x.name, inner.name))
+        return inner
+
+    def memory(self, init: Optional[VarDesc] = None, shape=None,
+               batch_ref=None, init_value: float = 0.0) -> VarDesc:
+        self._require_building()
+        if init is None:
+            raise ValueError(
+                "StaticRNN.memory needs init= (value-initialized "
+                "memories: create the init var with fill_constant "
+                "outside the step block)")
+        pre = self._sub.create_var(
+            self.helper.unique_name("mem_pre"), shape=init.shape,
+            dtype=init.dtype, stop_gradient=False)
+        self._mems.append([init.name, pre.name, None])
+        return pre
+
+    def update_memory(self, mem: VarDesc, var: VarDesc):
+        self._require_building()
+        for m in self._mems:
+            if m[1] == mem.name:
+                m[2] = var.name
+                return
+        raise ValueError("update_memory: %r is not a StaticRNN memory"
+                         % mem.name)
+
+    def step_output(self, o: VarDesc):
+        self._require_building()
+        # stacked shape = (T,) + per-step shape; T from the first
+        # step_input's outer leading dim
+        t = None
+        if self._step_ins:
+            outer_in = self.program.blocks[
+                self._sub.parent_idx].var(self._step_ins[0][0])
+            if outer_in.shape:
+                t = outer_in.shape[0]
+        shape = ((t,) + tuple(o.shape)) if o.shape is not None else None
+        outer = self.program.blocks[self._sub.parent_idx].create_var(
+            self.helper.unique_name("rnn_out"),
+            shape=shape, dtype=o.dtype, stop_gradient=False)
+        self._outs.append((o.name, outer.name))
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _finalize(self):
+        if not self._step_ins:
+            raise ValueError("StaticRNN: at least one step_input "
+                             "is required")
+        for m in self._mems:
+            if m[2] is None:
+                raise ValueError("StaticRNN: memory %r never updated "
+                                 "(update_memory missing)" % m[1])
+        parent = self.program.blocks[self._sub.parent_idx]
+        parent.append_op(
+            "static_rnn",
+            inputs={"X": [o for o, _ in self._step_ins],
+                    "Init": [m[0] for m in self._mems]},
+            outputs={"Out": [outer for _, outer in self._outs]},
+            attrs={"sub_block": self._sub.idx,
+                   "step_in_names": [i for _, i in self._step_ins],
+                   "mem_pre_names": [m[1] for m in self._mems],
+                   "mem_post_names": [m[2] for m in self._mems],
+                   "step_out_names": [i for i, _ in self._outs]})
+        self._built = True
+
+    def __call__(self):
+        if not self._built:
+            raise RuntimeError("StaticRNN: build the step block first")
+        block = self.program.current_block()
+        outs = [block.var(outer) for _, outer in self._outs]
+        return outs[0] if len(outs) == 1 else outs

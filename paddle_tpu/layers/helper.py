@@ -175,7 +175,11 @@ class LayerHelper:
         default = default_initializer or \
             (Constant(0.0) if is_bias else Xavier())
         init = _init_desc(attr.initializer, shape, dtype, default)
-        param = self.block.create_parameter(
+        # parameters ALWAYS live in the global block, even when the
+        # helper is building a control-flow sub-block (framework.py
+        # create_parameter: "global_block().create_parameter") — a
+        # StaticRNN/while step must share weights across iterations
+        param = self.main_program.global_block.create_parameter(
             name, shape, dtype, initializer=init, trainable=attr.trainable)
         # mirror into startup program with its init op (reference
         # initializer.py appends ops to startup)

@@ -247,3 +247,60 @@ def test_switch_class():
     hi, = exe.run(main, feed={"step": np.asarray([[30.0]], np.float32)},
                   fetch_list=[lr])
     assert abs(float(np.asarray(hi)) - 0.01) < 1e-7
+
+
+def test_static_rnn():
+    """fluid.layers.StaticRNN (control_flow.py:449): fc recurrence over
+    a time-major sequence matches the manual numpy loop, and gradients
+    train it."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.layers.helper import ParamAttr
+
+    T, B, D, H = 5, 3, 4, 6
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [T, B, D], append_batch_size=False)
+        h0 = layers.fill_constant([B, H], value=0.0, dtype="float32")
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            word = rnn.step_input(x)
+            prev = rnn.memory(init=h0)
+            cat = layers.concat([word, prev], axis=1)
+            h = layers.fc(cat, size=H, act="tanh",
+                          param_attr=ParamAttr(name="rnn_w"),
+                          bias_attr=ParamAttr(name="rnn_b"))
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out = rnn()
+        loss = layers.mean(layers.nn.square(out))
+        pt.optimizer.SGD(0.1).minimize(loss, startup_program=startup,
+                                       program=main)
+
+    scope = pt.Scope()
+    exe = pt.Executor()
+    rng = np.random.RandomState(0)
+    xv = rng.randn(T, B, D).astype(np.float32)
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        ov, l0 = exe.run(main, feed={"x": xv}, fetch_list=[out, loss])
+        # numpy oracle with the trained-at-step-0 weights: note the
+        # fetch ran AFTER one sgd update, so re-fetch with a fresh
+        # forward-only clone for the parity check
+        infer = main.clone(for_test=True)
+        w = np.asarray(scope.find_var("rnn_w"))
+        b = np.asarray(scope.find_var("rnn_b"))
+        ov2, = exe.run(infer, feed={"x": xv}, fetch_list=[out])
+        h = np.zeros((B, H), np.float32)
+        ref = []
+        for t in range(T):
+            h = np.tanh(np.concatenate([xv[t], h], 1) @ w + b)
+            ref.append(h)
+        np.testing.assert_allclose(np.asarray(ov2), np.stack(ref),
+                                   rtol=1e-4, atol=1e-5)
+        # training drives the loss down
+        l_first = float(np.asarray(l0))
+        for i in range(20):
+            _, l_last = exe.run(main, feed={"x": xv},
+                                fetch_list=[out, loss])
+        assert float(np.asarray(l_last)) < l_first
