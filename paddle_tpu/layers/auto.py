@@ -87,6 +87,7 @@ _OP_BACKED = {
     "data_norm": ("data_norm", None),
     "deformable_conv": ("deformable_conv", None),
     "density_prior_box": ("density_prior_box", None),
+    "detection_output": ("detection_output", None),
     "dice_loss": ("dice_loss", None),
     "distribute_fpn_proposals": ("distribute_fpn_proposals", None),
     "edit_distance": ("edit_distance", None),

@@ -1586,3 +1586,32 @@ def _generate_mask_labels(ctx, ins, attrs):
     return {"MaskRois": [np.stack(mask_rois)],
             "RoiHasMaskInt32": [np.asarray(has_mask, np.int32)],
             "MaskInt32": [np.stack(targets).astype(np.int32)]}
+
+
+@register_op("detection_output",
+             inputs=("Loc", "Scores", "PriorBox", "PriorBoxVar"),
+             outputs=("Out",), no_grad=True)
+def _detection_output(ctx, ins, attrs):
+    """SSD inference head (layers detection.py detection_output):
+    decode loc predictions against the priors (box_coder
+    decode_center_size) then multiclass NMS — composed on the two
+    existing lowerings."""
+    from ..core.registry import REGISTRY as _R
+    loc = ins["Loc"][0]          # [N, M, 4]
+    scores = ins["Scores"][0]    # [N, M, C] (softmax-ed)
+    prior = ins["PriorBox"][0]   # [M, 4]
+    sub = {"PriorBox": [prior], "TargetBox": [loc]}
+    if ins.get("PriorBoxVar"):
+        sub["PriorBoxVar"] = ins["PriorBoxVar"]
+    decoded = _R.get("box_coder").lower(
+        ctx, sub, {"code_type": "decode_center_size",
+                   "box_normalized": True})["Out"][0]  # [N, M, 4]
+    nms = _R.get("multiclass_nms").lower(
+        ctx, {"BBoxes": [decoded],
+              "Scores": [jnp.swapaxes(scores, 1, 2)]},
+        {"score_threshold": attrs.get("score_threshold", 0.01),
+         "nms_threshold": attrs.get("nms_threshold", 0.45),
+         "nms_top_k": attrs.get("nms_top_k", 400),
+         "keep_top_k": attrs.get("keep_top_k", 200),
+         "background_label": attrs.get("background_label", 0)})
+    return {"Out": nms["Out"]}

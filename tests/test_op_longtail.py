@@ -474,3 +474,28 @@ def test_final_op_batch():
                {"Logits": logits, "Label": lab}, {"num_samples": 10})
     out = np.asarray(o["Loss"][0])
     assert out.shape == (6, 1) and np.isfinite(out).all()
+
+
+def test_detection_output_compose():
+    """detection_output = box_coder decode + multiclass NMS: an exact
+    loc prediction (zero deltas, unit priors) must survive with its
+    class and score."""
+    prior = np.asarray([[0.1, 0.1, 0.4, 0.4],
+                        [0.5, 0.5, 0.9, 0.9]], np.float32)
+    pvar = np.asarray([[0.1, 0.1, 0.2, 0.2]] * 2, np.float32)
+    loc = np.zeros((1, 2, 4), np.float32)  # decode -> the priors
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 0, 1] = 0.9   # box 0 -> class 1
+    scores[0, 1, 2] = 0.8   # box 1 -> class 2
+    o = run_op("detection_output",
+               {"Loc": loc, "Scores": scores, "PriorBox": prior,
+                "PriorBoxVar": pvar},
+               {"score_threshold": 0.1, "background_label": 0})
+    out = np.asarray(o["Out"][0]).reshape(-1, 6)
+    kept = out[out[:, 0] >= 0]
+    assert len(kept) == 2
+    labels = sorted(int(r[0]) for r in kept)
+    assert labels == [1, 2]
+    best = kept[np.argmax(kept[:, 1])]
+    np.testing.assert_allclose(best[2:], [0.1, 0.1, 0.4, 0.4],
+                               atol=1e-3)
