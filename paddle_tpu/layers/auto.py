@@ -88,6 +88,7 @@ _OP_BACKED = {
     "deformable_conv": ("deformable_conv", None),
     "density_prior_box": ("density_prior_box", None),
     "detection_output": ("detection_output", None),
+    "ssd_loss": ("ssd_loss", None),
     "dice_loss": ("dice_loss", None),
     "distribute_fpn_proposals": ("distribute_fpn_proposals", None),
     "edit_distance": ("edit_distance", None),

@@ -1615,3 +1615,91 @@ def _detection_output(ctx, ins, attrs):
          "keep_top_k": attrs.get("keep_top_k", 200),
          "background_label": attrs.get("background_label", 0)})
     return {"Out": nms["Out"]}
+
+
+@register_op("ssd_loss",
+             inputs=("Loc", "Confidence", "GtBox", "GtLabel", "PriorBox",
+                     "PriorBoxVar", "GtNum"),
+             outputs=("Loss",),
+             non_diff_inputs=("GtBox", "GtLabel", "PriorBox",
+                              "PriorBoxVar", "GtNum"))
+def _ssd_loss(ctx, ins, attrs):
+    """SSD multibox loss (layers detection.py ssd_loss): per image,
+    match priors to gt by IoU (plus force-matching each gt's best
+    prior), encode loc targets center-size, smooth-L1 on positives,
+    softmax CE on classes with hard negative mining at
+    neg_pos_ratio : 1 — masks + top_k keep every shape static."""
+    loc = ins["Loc"][0]           # [N, P, 4]
+    conf = ins["Confidence"][0]   # [N, P, C]
+    gt = ins["GtBox"][0]          # [N, G, 4]
+    gt_label = ins["GtLabel"][0].astype(jnp.int32)  # [N, G] or [N,G,1]
+    if gt_label.ndim == 3:
+        gt_label = gt_label[..., 0]
+    prior = ins["PriorBox"][0]    # [P, 4]
+    pvar = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None
+    N, P, C = conf.shape
+    G = gt.shape[1]
+    gt_num = ins["GtNum"][0].astype(jnp.int32).reshape(-1) \
+        if ins.get("GtNum") else jnp.full((N,), G, jnp.int32)
+    bg = int(attrs.get("background_label", 0))
+    overlap = float(attrs.get("overlap_threshold", 0.5))
+    neg_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    conf_w = float(attrs.get("conf_loss_weight", 1.0))
+    loc_w = float(attrs.get("loc_loss_weight", 1.0))
+
+    def per_image(args):
+        loc_i, conf_i, gt_i, lbl_i, ng = args
+        gvalid = jnp.arange(G) < ng
+        iou = _iou_matrix(prior, gt_i, normalized=True)       # [P, G]
+        iou = jnp.where(gvalid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)                     # [P]
+        best_iou = jnp.max(iou, axis=1)
+        # force-match: each gt's best prior is positive regardless of
+        # the threshold (the reference's bipartite stage)
+        best_prior = jnp.argmax(iou, axis=0)                  # [G]
+        forced = jnp.zeros((P,), bool).at[best_prior].set(gvalid)
+        forced_gt = jnp.zeros((P,), jnp.int32).at[best_prior].set(
+            jnp.where(gvalid, jnp.arange(G), 0).astype(jnp.int32))
+        pos = (best_iou >= overlap) | forced
+        match = jnp.where(forced, forced_gt, best_gt)
+
+        # loc targets: encode matched gt against priors (center-size)
+        pw = prior[:, 2] - prior[:, 0]
+        ph = prior[:, 3] - prior[:, 1]
+        pcx = (prior[:, 0] + prior[:, 2]) / 2
+        pcy = (prior[:, 1] + prior[:, 3]) / 2
+        g = gt_i[match]
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-6)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-6)
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        tgt = jnp.stack([(gcx - pcx) / pw, (gcy - pcy) / ph,
+                         jnp.log(gw / pw), jnp.log(gh / ph)], axis=1)
+        if pvar is not None:
+            tgt = tgt / pvar
+        diff = loc_i - tgt
+        ad = jnp.abs(diff)
+        smooth = jnp.where(ad < 1.0, 0.5 * ad * ad, ad - 0.5)
+        loc_loss = jnp.sum(jnp.where(pos[:, None], smooth, 0.0))
+
+        # conf loss: CE with matched label on positives, background on
+        # the mined negatives
+        labels = jnp.where(pos, lbl_i[match], bg)
+        logp = jax.nn.log_softmax(conf_i, axis=-1)
+        ce = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+        npos = jnp.sum(pos)
+        # hard negative mining: negatives ranked by background CE
+        neg_score = jnp.where(pos, -jnp.inf, ce)
+        k = P  # static top_k; selection by rank-vs-quota mask
+        order = jnp.argsort(-neg_score)
+        rank = jnp.zeros((P,), jnp.int32).at[order].set(
+            jnp.arange(P, dtype=jnp.int32))
+        n_neg = jnp.minimum((neg_ratio * npos).astype(jnp.int32),
+                            P - npos)
+        neg = (~pos) & (rank < n_neg)
+        conf_loss = jnp.sum(jnp.where(pos | neg, ce, 0.0))
+        denom = jnp.maximum(npos.astype(jnp.float32), 1.0)
+        return (conf_w * conf_loss + loc_w * loc_loss) / denom
+
+    losses = jax.lax.map(per_image, (loc, conf, gt, gt_label, gt_num))
+    return {"Loss": [losses.reshape(N, 1)]}

@@ -499,3 +499,36 @@ def test_detection_output_compose():
     best = kept[np.argmax(kept[:, 1])]
     np.testing.assert_allclose(best[2:], [0.1, 0.1, 0.4, 0.4],
                                atol=1e-3)
+
+
+def test_ssd_loss_semantics():
+    """ssd_loss: perfect predictions on matched priors cost ~0; a
+    wrong-class confident prediction costs more; negatives are mined."""
+    prior = np.asarray([[0.1, 0.1, 0.4, 0.4],
+                        [0.6, 0.6, 0.9, 0.9],
+                        [0.0, 0.0, 0.05, 0.05]], np.float32)
+    gt = np.asarray([[[0.1, 0.1, 0.4, 0.4]]], np.float32)  # matches p0
+    gt_label = np.asarray([[1]], np.int64)
+    C = 3
+    # perfect: loc deltas 0 for the matched prior, confident class 1
+    loc = np.zeros((1, 3, 4), np.float32)
+    conf = np.full((1, 3, C), -8.0, np.float32)
+    conf[0, 0, 1] = 8.0    # positive prior: class 1
+    conf[0, 1, 0] = 8.0    # negatives: background
+    conf[0, 2, 0] = 8.0
+    o = run_op("ssd_loss", {"Loc": loc, "Confidence": conf,
+                            "GtBox": gt, "GtLabel": gt_label,
+                            "PriorBox": prior},
+               {"background_label": 0})
+    good = float(np.asarray(o["Loss"][0])[0, 0])
+    assert good < 0.1, good
+
+    conf_bad = conf.copy()
+    conf_bad[0, 0, 1] = -8.0
+    conf_bad[0, 0, 2] = 8.0  # confident WRONG class
+    o2 = run_op("ssd_loss", {"Loc": loc, "Confidence": conf_bad,
+                             "GtBox": gt, "GtLabel": gt_label,
+                             "PriorBox": prior},
+                {"background_label": 0})
+    bad = float(np.asarray(o2["Loss"][0])[0, 0])
+    assert bad > good + 1.0, (good, bad)
