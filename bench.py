@@ -161,9 +161,25 @@ def _bench_resnet(on_tpu):
     return imgs_per_sec, mfu
 
 
-def main():
+def _run_worker(backend):
+    """Run one full bench on the requested backend and print the JSON line.
+
+    `backend == "cpu"` forces the CPU platform *before* any jax op runs —
+    the axon sitecustomize bakes JAX_PLATFORMS=axon, so the env-var route
+    does not work; jax.config.update after import does.
+    """
     import jax
+    if backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     on_tpu = jax.default_backend() not in ("cpu",)
+    if backend == "tpu" and not on_tpu:
+        # the axon plugin silently failed to register: exiting nonzero
+        # (instead of printing CPU-smoke numbers) makes the orchestrator's
+        # retry ladder engage rather than shipping smoke as the round's
+        # headline metric
+        print("ERROR: tpu worker landed on backend=%s" %
+              jax.default_backend(), file=sys.stderr)
+        sys.exit(3)
 
     bert_tps, bert_mfu, attn_path, mosaic_ok = _bench_bert(on_tpu)
     rn_ips, rn_mfu = _bench_resnet(on_tpu)
@@ -176,6 +192,7 @@ def main():
         "value": round(bert_tps, 1),
         "unit": "tokens/s",
         "vs_baseline": round(vs, 4),
+        "backend": jax.default_backend() if on_tpu else "cpu-fallback",
         "bert_tokens_per_sec": round(bert_tps, 1),
         "bert_mfu": round(bert_mfu, 4),
         "resnet50_images_per_sec": round(rn_ips, 1),
@@ -185,5 +202,92 @@ def main():
     }))
 
 
+def _spawn(backend, timeout):
+    """Run `bench.py --worker <backend>` in a subprocess; return
+    (json_line_or_None, timed_out). A subprocess is mandatory: when the
+    axon tunnel is wedged, jax.devices() HANGS with no error (round-3
+    postmortem) — only a process-level timeout can recover from that.
+    On timeout the worker gets SIGTERM + a 30s grace before SIGKILL:
+    killing it mid remote_compile RPC is itself what wedges the tunnel."""
+    import signal
+    import subprocess
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker", backend],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
+
+    def _signal_group(sig):
+        try:
+            os.killpg(proc.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    try:
+        out, err = proc.communicate(timeout=timeout)
+        timed_out = False
+    except subprocess.TimeoutExpired:
+        # TERM the whole process group (axon helper children inherit the
+        # pipes; killing only the direct child would leave them holding
+        # the fds and the final communicate would block on EOF forever)
+        _signal_group(signal.SIGTERM)
+        try:
+            out, err = proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            _signal_group(signal.SIGKILL)
+            try:
+                out, err = proc.communicate(timeout=15)
+            except subprocess.TimeoutExpired:
+                out, err = "", ""  # abandon the pipes rather than hang
+        print("WARN: %s bench timed out after %ds" % (backend, timeout),
+              file=sys.stderr)
+        timed_out = True
+    if err:
+        sys.stderr.write(err)
+    for line in reversed((out or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                json.loads(line)
+                return line, timed_out
+            except ValueError:
+                continue
+    if not timed_out:
+        print("WARN: %s bench exited rc=%d with no JSON line" %
+              (backend, proc.returncode), file=sys.stderr)
+    return None, timed_out
+
+
+def main():
+    # Orchestrator: TPU attempt -> one retry after a lease wait (only if
+    # the first attempt FAILED rather than hung: a hang means the tunnel
+    # is wedged and re-probing before the server-side lease expires just
+    # burns another timeout) -> CPU smoke -> last-resort stub. ALWAYS
+    # prints one JSON line and exits 0: BENCH_r03.json was rc=1 because
+    # a tunnel outage crashed the bench outright and the round shipped
+    # no perf evidence at all.
+    line, timed_out = _spawn("tpu", timeout=2400)
+    if line is None and not timed_out:
+        print("WARN: TPU attempt 1 failed; waiting 120s for tunnel lease",
+              file=sys.stderr)
+        time.sleep(120)
+        line, _ = _spawn("tpu", timeout=2400)
+    if line is None:
+        line, _ = _spawn("cpu", timeout=1200)
+    if line is None:
+        line = json.dumps({
+            "metric": "bench-unavailable (TPU tunnel down, CPU smoke failed)",
+            "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+            "backend": "none"})
+    print(line)
+
+
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        idx = sys.argv.index("--worker")
+        backend = sys.argv[idx + 1] if idx + 1 < len(sys.argv) else ""
+        if backend not in ("tpu", "cpu"):
+            print("usage: bench.py [--worker tpu|cpu]", file=sys.stderr)
+            sys.exit(2)
+        _run_worker(backend)
+    else:
+        main()

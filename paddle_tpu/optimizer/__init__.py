@@ -12,6 +12,7 @@ from .static_opt import (Adadelta, AdadeltaOptimizer, Adagrad,  # noqa: F401
                          LarsMomentumOptimizer, Momentum, MomentumOptimizer,
                          Optimizer, RMSProp, RMSPropOptimizer, SGD,
                          SGDOptimizer,
-                         ExponentialMovingAverage, ModelAverage)
+                         ExponentialMovingAverage, LookaheadOptimizer,
+                         ModelAverage)
 
 Dpsgd = DpSGD  # reference spelling (fluid/optimizer.py Dpsgd)

@@ -1011,3 +1011,131 @@ class ModelAverage:
             if name in self._backup:
                 self._set(h, self._backup[name], scope)
         self._backup = {}
+
+
+class LookaheadOptimizer:
+    """fluid.optimizer.LookaheadOptimizer (optimizer.py:4828): two sets
+    of weights — the inner optimizer advances the fast params every
+    step; every k steps the slow params catch up,
+    slow += alpha * (fast - slow), and the fast params reset to slow
+    (https://arxiv.org/abs/1907.08610).
+
+    TPU-native formulation: the reference schedules the sync with a
+    switch block (layers.Switch on step mod k); here the sync is
+    branchless — gate = float(step % k == 0) scales the update, so the
+    whole training step stays one straight-line XLA program (a
+    data-dependent branch inside jit costs more than the few fused
+    elementwise ops it would save, and XLA fuses the gate through both
+    assignments). Static-graph only, like the reference (optimizer.py:
+    4885 raises under dygraph)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        assert inner_optimizer is not None, "inner optimizer can not be None"
+        assert 0.0 <= alpha <= 1.0, \
+            "alpha should be larger or equal to 0.0, and less or equal " \
+            "than 1.0"
+        assert isinstance(k, int) and k > 0, "k should be a positive integer"
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self.type = "lookahead"
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, program=None):
+        if not isinstance(loss, VarDesc):
+            raise RuntimeError(
+                "In dygraph, don't support LookaheadOptimizer "
+                "(reference optimizer.py:4885)")
+        result = self.inner_optimizer.minimize(
+            loss, startup_program=startup_program, program=program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+        program = program or default_main_program()
+        startup = startup_program or default_startup_program()
+        block = program.global_block
+        sblock = startup.global_block
+
+        params = [v.name for v in program.all_parameters()]
+        for name in params:
+            fast = block.var(name)
+            for blk in (block, sblock):
+                blk.create_var(name + "@SLOW", shape=list(fast.shape),
+                               dtype=fast.dtype, persistable=True,
+                               stop_gradient=True)
+            # slow params start as a copy of the initialised fast params
+            sblock.append_op("assign", inputs={"X": [name]},
+                             outputs={"Out": [name + "@SLOW"]})
+
+        step_name = program._unique_name("lookahead_step")
+        for blk in (block, sblock):
+            blk.create_var(step_name, shape=(), dtype="int32",
+                           persistable=True, stop_gradient=True)
+        sblock.append_op("fill_constant", inputs={},
+                         outputs={"Out": [step_name]},
+                         attrs={"shape": [], "value": 0, "dtype": "int32"})
+
+        def tmp(suffix, shape=(), dtype="float32"):
+            name = program._unique_name("lookahead_" + suffix)
+            block.create_var(name, shape=list(shape), dtype=dtype,
+                             stop_gradient=True)
+            return name
+
+        block.append_op("increment", inputs={"X": [step_name]},
+                        outputs={"Out": [step_name]}, attrs={"step": 1})
+        k_name = tmp("k", dtype="int32")
+        block.append_op("fill_constant", inputs={},
+                        outputs={"Out": [k_name]},
+                        attrs={"shape": [], "value": self.k,
+                               "dtype": "int32"})
+        zero_name = tmp("zero", dtype="int32")
+        block.append_op("fill_constant", inputs={},
+                        outputs={"Out": [zero_name]},
+                        attrs={"shape": [], "value": 0, "dtype": "int32"})
+        mod_name = tmp("mod", dtype="int32")
+        block.append_op("elementwise_mod",
+                        inputs={"X": [step_name], "Y": [k_name]},
+                        outputs={"Out": [mod_name]})
+        eq_name = tmp("sync", dtype="bool")
+        block.append_op("equal", inputs={"X": [mod_name], "Y": [zero_name]},
+                        outputs={"Out": [eq_name]})
+        gates = {}  # one cast gate per param dtype
+
+        for name in params:
+            fast = block.var(name)
+            slow = name + "@SLOW"
+            dtype = fast.dtype
+            if dtype not in gates:
+                g = tmp("gate_" + str(dtype), dtype=dtype)
+                block.append_op("cast", inputs={"X": [eq_name]},
+                                outputs={"Out": [g]},
+                                attrs={"out_dtype": dtype})
+                gates[dtype] = g
+            gate = gates[dtype]
+            # slow' = slow + gate * alpha * (fast - slow)
+            diff = tmp(name + "_diff", fast.shape, dtype)
+            block.append_op("elementwise_sub",
+                            inputs={"X": [name], "Y": [slow]},
+                            outputs={"Out": [diff]})
+            scaled = tmp(name + "_scaled", fast.shape, dtype)
+            block.append_op("scale", inputs={"X": [diff]},
+                            outputs={"Out": [scaled]},
+                            attrs={"scale": self.alpha})
+            gated = tmp(name + "_gated", fast.shape, dtype)
+            block.append_op("elementwise_mul",
+                            inputs={"X": [scaled], "Y": [gate]},
+                            outputs={"Out": [gated]})
+            block.append_op("elementwise_add",
+                            inputs={"X": [slow], "Y": [gated]},
+                            outputs={"Out": [slow]})
+            # fast' = fast + gate * (slow' - fast)  (== slow' when gated)
+            diff2 = tmp(name + "_diff2", fast.shape, dtype)
+            block.append_op("elementwise_sub",
+                            inputs={"X": [slow], "Y": [name]},
+                            outputs={"Out": [diff2]})
+            gated2 = tmp(name + "_gated2", fast.shape, dtype)
+            block.append_op("elementwise_mul",
+                            inputs={"X": [diff2], "Y": [gate]},
+                            outputs={"Out": [gated2]})
+            block.append_op("elementwise_add",
+                            inputs={"X": [name], "Y": [gated2]},
+                            outputs={"Out": [name]})
+        return result

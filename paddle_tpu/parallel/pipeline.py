@@ -128,6 +128,47 @@ class PipelineLayer:
         self._functional_call = functional_call
         self._Tensor = Tensor
         states = [state_of(b) for b in blocks]
+        # One scanned stage_fn serves every stage, so the block at
+        # within-stage position i must be structurally identical across
+        # stages: same class (same forward code) and same param pytree.
+        # A heterogeneous list would silently run stage 0's code with the
+        # other stages' params — refuse it up front.
+        def spec(st):
+            # shape/dtype only — jnp.result_type reads the dtype without
+            # materializing numpy-backed leaves on device
+            return (jax.tree.structure(st),
+                    jax.tree.map(lambda x: (jnp.shape(x),
+                                            jnp.result_type(x)), st))
+
+        def config(b):
+            # scalar constructor config (dropout p, eps, axis flags...):
+            # two same-type blocks with different config would otherwise
+            # pass the param check and silently run stage 0's settings
+            return {k: v for k, v in vars(b).items()
+                    if isinstance(v, (int, float, str, bool, type(None)))}
+
+        specs0 = [spec(states[i]) for i in range(self.per_stage)]
+        for s in range(1, n_stages):
+            for i in range(self.per_stage):
+                a, b = blocks[i], blocks[s * self.per_stage + i]
+                if type(a) is not type(b):
+                    raise TypeError(
+                        "PipelineLayer requires structurally identical "
+                        "stages: block %d of stage %d is %s but stage 0's "
+                        "is %s. Run heterogeneous layers (embeddings, "
+                        "heads) replicated outside the pipelined middle."
+                        % (i, s, type(b).__name__, type(a).__name__))
+                if spec(states[s * self.per_stage + i]) != specs0[i]:
+                    raise ValueError(
+                        "PipelineLayer stage %d block %d param structure "
+                        "differs from stage 0's — stages must be "
+                        "structurally identical" % (s, i))
+                if config(a) != config(b):
+                    raise ValueError(
+                        "PipelineLayer stage %d block %d config %r differs "
+                        "from stage 0's %r — one scanned stage_fn runs "
+                        "stage 0's configuration for every stage"
+                        % (s, i, config(b), config(a)))
         # group block states per stage, then stack across stages
         self._keys = sorted(states[0])
         grouped = []
@@ -143,7 +184,10 @@ class PipelineLayer:
         for i in range(self.per_stage):
             st = {k.split("_", 1)[1]: v for k, v in params.items()
                   if k.startswith("b%d_" % i)}
-            r, _ = self._functional_call(self.blocks[0], st,
+            # blocks[i] is stage 0's block at within-stage position i —
+            # by the construction-time check it is structurally
+            # representative of every stage's block i
+            r, _ = self._functional_call(self.blocks[i], st,
                                          self._Tensor(out), training=False)
             out = r.value if hasattr(r, "value") else r
         return out

@@ -89,6 +89,44 @@ def test_gpipe_jit_compiles_once():
 
 
 # ---------------------------------------------------------------------------
+# PipelineLayer (dygraph blocks -> gpipe)
+# ---------------------------------------------------------------------------
+
+def test_pipeline_layer_mixed_blocks_within_stage():
+    # [Linear, LayerNorm] per stage x 2 stages: within-stage positions
+    # differ by type (legal); the old _stage_fn called blocks[0] for
+    # every position and would run Linear twice
+    from paddle_tpu.parallel import PipelineLayer
+    d = 8
+    pt.seed(0)
+    blocks = [pt.nn.Linear(d, d), pt.nn.LayerNorm(d),
+              pt.nn.Linear(d, d), pt.nn.LayerNorm(d)]
+    mesh = _mesh("pp", 2)
+    pl = PipelineLayer(blocks, mesh, num_microbatches=4)
+    x = jnp.asarray(np.random.RandomState(7).randn(16, d), jnp.float32)
+
+    h = x
+    for b in blocks:
+        r = b(pt.to_tensor(np.asarray(h)))
+        h = r.value if hasattr(r, "value") else r
+    out = pl(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(h),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_layer_rejects_heterogeneous_stages():
+    from paddle_tpu.parallel import PipelineLayer
+    d = 8
+    mesh = _mesh("pp", 2)
+    with pytest.raises(TypeError, match="structurally identical"):
+        PipelineLayer([pt.nn.Linear(d, d), pt.nn.LayerNorm(d)], mesh,
+                      num_microbatches=2)
+    with pytest.raises(ValueError, match="param structure"):
+        PipelineLayer([pt.nn.Linear(d, d), pt.nn.Linear(d, 2 * d)], mesh,
+                      num_microbatches=2)
+
+
+# ---------------------------------------------------------------------------
 # device_guard / static sections
 # ---------------------------------------------------------------------------
 
@@ -151,3 +189,11 @@ def test_ulysses_attention_matches_reference(causal):
     out = ulysses_attention(q, k, v, mesh, causal=causal)
     ref = attention_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_pipeline_layer_rejects_config_mismatch():
+    from paddle_tpu.parallel import PipelineLayer
+    mesh = _mesh("pp", 2)
+    with pytest.raises(ValueError, match="config"):
+        PipelineLayer([pt.nn.Dropout(0.1), pt.nn.Dropout(0.5)], mesh,
+                      num_microbatches=2)

@@ -200,3 +200,50 @@ def test_var_builders_and_misc_layers():
         _, i2 = exe.run(main, feed=bad, fetch_list=[gv, hi])
         assert bool(np.asarray(i2))
     assert any(v.name == "myparam" for v in main.all_parameters())
+
+
+def test_lookahead_optimizer():
+    """fluid.optimizer.LookaheadOptimizer: fast params step every
+    iteration, slow params sync every k; training still converges
+    (reference test_lookahead.py discipline: mechanics + loss)."""
+    main, startup = _fresh_programs()
+    rng = np.random.RandomState(4)
+    true_w = rng.randn(4, 1).astype(np.float32)
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.nn.square(
+            layers.elementwise_sub(pred, y)))
+        opt = pt.optimizer.LookaheadOptimizer(
+            pt.optimizer.SGD(learning_rate=0.05), alpha=0.5, k=5)
+        opt.minimize(loss, startup_program=startup, program=main)
+
+    pname = next(v.name for v in main.all_parameters()
+                 if "w" in v.name or v.shape == [4, 1])
+    scope = pt.Scope()
+    exe = pt.Executor()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        slow0 = np.asarray(scope.find_var(pname + "@SLOW")).copy()
+        fast0 = np.asarray(scope.find_var(pname)).copy()
+        np.testing.assert_allclose(slow0, fast0)  # startup copy
+
+        losses = []
+        for i in range(1, 26):
+            xb = rng.randn(32, 4).astype(np.float32)
+            yb = xb @ true_w
+            out, = exe.run(main, feed={"x": xb, "y": yb},
+                           fetch_list=[loss])
+            losses.append(float(out))
+            fast = np.asarray(scope.find_var(pname))
+            slow = np.asarray(scope.find_var(pname + "@SLOW"))
+            if i % 5 == 0:
+                # sync step: fast reset to the updated slow
+                np.testing.assert_allclose(fast, slow, rtol=1e-5,
+                                           atol=1e-6)
+            elif i < 5:
+                # before the first sync the slow params never move
+                np.testing.assert_allclose(slow, slow0, rtol=1e-6)
+                assert not np.allclose(fast, slow)
+    assert losses[-1] < losses[0] * 0.5, losses[::5]
