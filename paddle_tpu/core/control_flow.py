@@ -277,6 +277,13 @@ def lower_run_program(lowerer, op, env: Dict[str, Any]) -> None:
             env[n] = env2[n]
 
 
+def lower_pipeline_train(lowerer, op, env: Dict[str, Any]) -> None:
+    # deferred import: the schedule lives with the rest of the pipeline
+    # machinery in parallel/, which imports core
+    from ..parallel.pipeline_static import lower_pipeline_train as impl
+    impl(lowerer, op, env)
+
+
 LOWERINGS = {
     "while": lower_while,
     "conditional_block": lower_conditional_block,
@@ -285,6 +292,7 @@ LOWERINGS = {
     "read_from_array": lower_read_from_array,
     "array_length": lower_array_length,
     "run_program": lower_run_program,
+    "pipeline_train": lower_pipeline_train,
 }
 
 

@@ -1097,7 +1097,18 @@ class LookaheadOptimizer:
         eq_name = tmp("sync", dtype="bool")
         block.append_op("equal", inputs={"X": [mod_name], "Y": [zero_name]},
                         outputs={"Out": [eq_name]})
-        gates = {}  # one cast gate per param dtype
+        # reference Switch's first case (optimizer.py:4959): at step 1 the
+        # slow params are re-based to the once-updated fast params, and
+        # ONLY that case runs (Switch takes the first true branch), so the
+        # periodic sync is additionally gated on step != 1
+        one_name = tmp("one", dtype="int32")
+        block.append_op("fill_constant", inputs={},
+                        outputs={"Out": [one_name]},
+                        attrs={"shape": [], "value": 1, "dtype": "int32"})
+        eq1_name = tmp("is_step1", dtype="bool")
+        block.append_op("equal", inputs={"X": [step_name], "Y": [one_name]},
+                        outputs={"Out": [eq1_name]})
+        gates = {}  # per param dtype: (step1_gate, sync_gate)
 
         for name in params:
             fast = block.var(name)
@@ -1108,8 +1119,32 @@ class LookaheadOptimizer:
                 block.append_op("cast", inputs={"X": [eq_name]},
                                 outputs={"Out": [g]},
                                 attrs={"out_dtype": dtype})
-                gates[dtype] = g
-            gate = gates[dtype]
+                g1 = tmp("gate1_" + str(dtype), dtype=dtype)
+                block.append_op("cast", inputs={"X": [eq1_name]},
+                                outputs={"Out": [g1]},
+                                attrs={"out_dtype": dtype})
+                not_g1 = tmp("notgate1_" + str(dtype), dtype=dtype)
+                block.append_op("scale", inputs={"X": [g1]},
+                                outputs={"Out": [not_g1]},
+                                attrs={"scale": -1.0, "bias": 1.0})
+                g2 = tmp("syncgate_" + str(dtype), dtype=dtype)
+                block.append_op("elementwise_mul",
+                                inputs={"X": [g], "Y": [not_g1]},
+                                outputs={"Out": [g2]})
+                gates[dtype] = (g1, g2)
+            gate1, gate = gates[dtype]
+            # step 1: slow = fast (gated re-base)
+            d0 = tmp(name + "_d0", fast.shape, dtype)
+            block.append_op("elementwise_sub",
+                            inputs={"X": [name], "Y": [slow]},
+                            outputs={"Out": [d0]})
+            a0 = tmp(name + "_a0", fast.shape, dtype)
+            block.append_op("elementwise_mul",
+                            inputs={"X": [d0], "Y": [gate1]},
+                            outputs={"Out": [a0]})
+            block.append_op("elementwise_add",
+                            inputs={"X": [slow], "Y": [a0]},
+                            outputs={"Out": [slow]})
             # slow' = slow + gate * alpha * (fast - slow)
             diff = tmp(name + "_diff", fast.shape, dtype)
             block.append_op("elementwise_sub",

@@ -147,6 +147,18 @@ class PipelineLayer:
             return {k: v for k, v in vars(b).items()
                     if isinstance(v, (int, float, str, bool, type(None)))}
 
+        for i, b in enumerate(blocks):
+            cfg = config(b)
+            p = cfg.get("p", cfg.get("dropout_prob", 0)) or 0
+            if isinstance(p, (int, float)) and p > 0:
+                import warnings
+                warnings.warn(
+                    "PipelineLayer block %d (%s) has dropout prob %g but "
+                    "the pipelined stage_fn runs blocks in eval mode (no "
+                    "rng is threaded through the scan) — dropout will NOT "
+                    "apply inside the pipeline" % (i, type(b).__name__, p))
+                break
+
         specs0 = [spec(states[i]) for i in range(self.per_stage)]
         for s in range(1, n_stages):
             for i in range(self.per_stage):
@@ -224,23 +236,36 @@ def split_program_by_device(program):
 
 
 class PipelineOptimizer:
-    """optimizer.py:3666 PipelineOptimizer API shell for the static path:
-    validates device_guard sections and delegates minimize to the inner
-    optimizer (single-program semantics are unchanged on one chip — the
-    executor compiles the whole block; XLA schedules across the stamped
-    sections). The *throughput* pipeline path on TPU is gpipe() /
-    PipelineLayer above, where stages live on a real mesh axis."""
+    """optimizer.py:3666 PipelineOptimizer for the static path: rewrites
+    the device_guard-stamped forward into one `pipeline_train` meta-op
+    (per-section sub-blocks driven by the SPMD GPipe schedule of
+    pipeline_static.py — the reference's SectionWorker threads + queues
+    become one shard_map'ed scan over the `pp` mesh axis), then appends
+    the inner optimizer's update ops against the grads the schedule
+    produces. num_microbatches=1 keeps the rewrite but degenerates to
+    sequential stages (still correct, no overlap)."""
 
     def __init__(self, optimizer, num_microbatches: int = 1):
         self._inner = optimizer
         self.num_microbatches = num_microbatches
+        self.sections = None
 
     def minimize(self, loss, startup_program=None, program=None,
                  parameter_list=None):
-        result = self._inner.minimize(loss, startup_program=startup_program,
-                                      program=program,
-                                      parameter_list=parameter_list)
-        from ..core.program import default_main_program
+        from ..core.program import (default_main_program,
+                                    default_startup_program)
+        from .pipeline_static import rewrite_pipeline_program
+        if not hasattr(self._inner, "apply_gradients"):
+            raise TypeError(
+                "PipelineOptimizer needs a base optimizer exposing "
+                "apply_gradients (got %s); wrap the base optimizer "
+                "directly, as the reference requires (optimizer.py:3666)"
+                % type(self._inner).__name__)
         prog = program if program is not None else default_main_program()
-        self.sections = split_program_by_device(prog)
-        return result
+        startup = startup_program if startup_program is not None \
+            else default_startup_program()
+        params_grads = rewrite_pipeline_program(
+            prog, loss, self.num_microbatches,
+            parameter_list=parameter_list)
+        self._inner.apply_gradients(params_grads, prog, startup)
+        return None, params_grads

@@ -197,3 +197,143 @@ def test_pipeline_layer_rejects_config_mismatch():
     with pytest.raises(ValueError, match="config"):
         PipelineLayer([pt.nn.Dropout(0.1), pt.nn.Dropout(0.5)], mesh,
                       num_microbatches=2)
+
+
+# ---------------------------------------------------------------------------
+# static-graph pipeline execution (pipeline_train meta-op)
+# ---------------------------------------------------------------------------
+
+def _build_mlp_pipeline(use_guard):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [8])
+        y = pt.layers.data("y", [1])
+        guards = [pt.device_guard("gpu:%d" % i) for i in range(4)] \
+            if use_guard else [None] * 4
+        import contextlib
+        with guards[0] or contextlib.nullcontext():
+            h0 = pt.layers.fc(x, 16, act="tanh")
+        with guards[1] or contextlib.nullcontext():
+            h1 = pt.layers.fc(h0, 16, act="tanh")
+        with guards[2] or contextlib.nullcontext():
+            # skip connection: h0 (stage 0) consumed at stage 2 rides
+            # through stage 1's boundary buffer untouched
+            h2 = pt.layers.elementwise_add(pt.layers.fc(h1, 16), h0)
+        with guards[3] or contextlib.nullcontext():
+            pred = pt.layers.fc(h2, 1)
+            loss = pt.layers.mean(pt.layers.nn.square(
+                pt.layers.elementwise_sub(pred, y)))
+    return main, startup, loss
+
+
+def test_static_pipeline_matches_single_device():
+    from paddle_tpu.parallel import PipelineOptimizer
+    rng = np.random.RandomState(11)
+    true_w = rng.randn(8, 1).astype(np.float32)
+
+    main_a, startup_a, loss_a = _build_mlp_pipeline(use_guard=False)
+    with pt.program_guard(main_a, startup_a):
+        pt.optimizer.SGD(0.05).minimize(loss_a, startup_program=startup_a,
+                                        program=main_a)
+    main_b, startup_b, loss_b = _build_mlp_pipeline(use_guard=True)
+    with pt.program_guard(main_b, startup_b):
+        PipelineOptimizer(pt.optimizer.SGD(0.05), num_microbatches=4) \
+            .minimize(loss_b, startup_program=startup_b, program=main_b)
+    # the rewrite replaced the stamped forward with one meta-op
+    assert [o.type for o in main_b.global_block.ops
+            if o.type == "pipeline_train"] == ["pipeline_train"]
+
+    exe = pt.Executor()
+    scope_a, scope_b = pt.Scope(), pt.Scope()
+    with pt.scope_guard(scope_a):
+        exe.run(startup_a)
+    with pt.scope_guard(scope_b):
+        exe.run(startup_b)
+        # identical initial params (same auto names in both programs)
+        for v in main_a.all_parameters():
+            scope_b.set(v.name, np.asarray(scope_a.find_var(v.name)))
+
+    la, lb = [], []
+    for i in range(8):
+        xb = rng.randn(16, 8).astype(np.float32)
+        yb = (xb @ true_w).astype(np.float32)
+        with pt.scope_guard(scope_a):
+            out, = exe.run(main_a, feed={"x": xb, "y": yb},
+                           fetch_list=[loss_a])
+        la.append(float(out))
+        with pt.scope_guard(scope_b):
+            out, = exe.run(main_b, feed={"x": xb, "y": yb},
+                           fetch_list=[loss_b])
+        lb.append(float(out))
+    np.testing.assert_allclose(la, lb, rtol=2e-4, atol=1e-5)
+    assert la[-1] < la[0]  # and it actually trains
+
+
+def test_static_pipeline_heterogeneous_shapes():
+    """conv->fc pipeline: the boundary activation changes shape and rank
+    at every cut (the packed-buffer case the reference's queues handle
+    dynamically)."""
+    from paddle_tpu.parallel import PipelineOptimizer
+    rng = np.random.RandomState(12)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = pt.layers.data("img", [1, 8, 8])
+        label = pt.layers.data("label", [1], dtype="int64")
+        with pt.device_guard("gpu:0"):
+            c = pt.layers.conv2d(img, num_filters=4, filter_size=3,
+                                 act="relu")
+        with pt.device_guard("gpu:1"):
+            p = pt.layers.pool2d(c, pool_size=2, pool_stride=2)
+            logits = pt.layers.fc(p, size=10)
+            loss = pt.layers.mean(
+                pt.layers.softmax_with_cross_entropy(logits, label))
+        PipelineOptimizer(pt.optimizer.SGD(0.02), num_microbatches=2) \
+            .minimize(loss, startup_program=startup, program=main)
+
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for i in range(10):
+            xb = rng.randn(8, 1, 8, 8).astype(np.float32)
+            yb = (xb.mean(axis=(1, 2, 3), keepdims=False) > 0)\
+                .astype(np.int64).reshape(8, 1) * 9
+            out, = exe.run(main, feed={"img": xb, "label": yb},
+                           fetch_list=[loss])
+            losses.append(float(out))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_static_pipeline_parameter_list_freezes():
+    from paddle_tpu.parallel import PipelineOptimizer
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [4])
+        y = pt.layers.data("y", [1])
+        with pt.device_guard("gpu:0"):
+            h = pt.layers.fc(x, 8, act="tanh")
+        with pt.device_guard("gpu:1"):
+            pred = pt.layers.fc(h, 1)
+            loss = pt.layers.mean(pt.layers.nn.square(
+                pt.layers.elementwise_sub(pred, y)))
+        frozen = main.all_parameters()[0].name  # stage-0 weight
+        train = [v.name for v in main.all_parameters() if v.name != frozen]
+        PipelineOptimizer(pt.optimizer.SGD(0.1), num_microbatches=2) \
+            .minimize(loss, startup_program=startup, program=main,
+                      parameter_list=train)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    rng = np.random.RandomState(3)
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(scope.find_var(frozen)).copy()
+        t0 = {n: np.asarray(scope.find_var(n)).copy() for n in train}
+        xb = rng.randn(8, 4).astype(np.float32)
+        exe.run(main, feed={"x": xb, "y": xb[:, :1].copy()},
+                fetch_list=[loss])
+        np.testing.assert_array_equal(np.asarray(scope.find_var(frozen)),
+                                      w0)
+        assert any(not np.allclose(np.asarray(scope.find_var(n)), t0[n])
+                   for n in train)

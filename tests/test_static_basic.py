@@ -230,6 +230,7 @@ def test_lookahead_optimizer():
         np.testing.assert_allclose(slow0, fast0)  # startup copy
 
         losses = []
+        slow_base = None
         for i in range(1, 26):
             xb = rng.randn(32, 4).astype(np.float32)
             yb = xb @ true_w
@@ -238,12 +239,19 @@ def test_lookahead_optimizer():
             losses.append(float(out))
             fast = np.asarray(scope.find_var(pname))
             slow = np.asarray(scope.find_var(pname + "@SLOW"))
-            if i % 5 == 0:
+            if i == 1:
+                # reference Switch first case: slow re-based to the
+                # once-updated fast params at step 1 (optimizer.py:4959)
+                np.testing.assert_allclose(slow, fast, rtol=1e-5,
+                                           atol=1e-6)
+                assert not np.allclose(slow, slow0)
+                slow_base = slow.copy()
+            elif i % 5 == 0:
                 # sync step: fast reset to the updated slow
                 np.testing.assert_allclose(fast, slow, rtol=1e-5,
                                            atol=1e-6)
             elif i < 5:
-                # before the first sync the slow params never move
-                np.testing.assert_allclose(slow, slow0, rtol=1e-6)
+                # between step 1 and the first k-sync slow stays put
+                np.testing.assert_allclose(slow, slow_base, rtol=1e-6)
                 assert not np.allclose(fast, slow)
     assert losses[-1] < losses[0] * 0.5, losses[::5]
