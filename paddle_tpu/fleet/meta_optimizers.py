@@ -188,12 +188,41 @@ class LarsMetaOptimizer(MetaOptimizerBase):
         super().__init__(lars)
 
 
+def dgc_compress(g, u, v, momentum: float, sparsity: float):
+    """Traced DGC step for one gradient leaf (operators/dgc_op.h):
+    momentum correction u' = m*u + g, accumulation v' = v + u', top-k
+    selection on |v'| via lax.top_k, selected positions leave u/v (they
+    were transmitted), unselected stay as local residual.
+
+    Returns (sparse_grad, u_out, v_out); caller psums sparse_grad on the
+    dp axis — the dense-allreduce-of-encoded-sparse of the reference
+    (dgc_op + allreduce) becomes one masked psum riding ICI."""
+    import jax
+    import jax.numpy as jnp
+    u2 = momentum * u + g
+    v2 = v + u2
+    flat = jnp.abs(v2).ravel()
+    k = max(1, int(round(flat.size * (1.0 - sparsity))))
+    thresh = jax.lax.top_k(flat, k)[0][-1]
+    mask = jnp.abs(v2) >= thresh
+    sparse = jnp.where(mask, v2, 0.0)
+    return sparse, jnp.where(mask, 0.0, u2), jnp.where(mask, 0.0, v2)
+
+
 class DGCMomentumOptimizer(MetaOptimizerBase):
     """optimizer.py:1181 DGCMomentumOptimizer / dgc_optimizer.py — deep
     gradient compression: after rampup, keep only the top-k fraction of
     each grad (by magnitude), accumulate the rest locally with momentum
     correction (operators/dgc_op.*). The dense allreduce of the sparse
-    residual maps to the dp-axis psum of the masked grad."""
+    residual maps to the dp-axis psum of the masked grad.
+
+    Device path: build_spmd_step() returns a jitted dp-sharded training
+    step where each device compresses its local grad (dgc_compress),
+    pmeans ONLY the selected entries, and applies SGD (momentum lives
+    inside the correction, exactly the dgc_op formulation). Before
+    rampup_begin_step the step degrades to dense-psum momentum SGD, the
+    reference's rampup behavior, selected branchlessly so the whole
+    schedule stays one XLA program."""
 
     def __init__(self, inner, rampup_begin_step: int = 0,
                  sparsity: float = 0.999):
@@ -204,8 +233,8 @@ class DGCMomentumOptimizer(MetaOptimizerBase):
         self._residual = {}
 
     def compress(self, name: str, grad: np.ndarray) -> np.ndarray:
-        """Eager-path compression (tested host-side; device path is the
-        same arithmetic under jit)."""
+        """Eager/host-path compression (plain residual, no momentum
+        correction — the PS/geo transport hook)."""
         self._step += 1
         if self._step <= self._rampup:
             return grad
@@ -217,14 +246,94 @@ class DGCMomentumOptimizer(MetaOptimizerBase):
         self._residual[name] = np.where(mask, 0.0, g)
         return np.where(mask, g, 0.0)
 
+    def build_spmd_step(self, loss_fn, mesh, lr: float,
+                        momentum: float = 0.9, axis: str = "dp"):
+        """(step_fn, init_state). step_fn(params, state, batch) ->
+        (params, state, loss): params/loss replicated, state carries the
+        per-device u/v residuals (leading dp dim) + the step counter,
+        batch is globally batched and sharded over `axis` inside.
+
+        loss_fn(params, batch) -> scalar mean loss."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        n = mesh.shape[axis]
+        sparsity, rampup = self._sparsity, self._rampup
+
+        def body(params, uv, step, batch):
+            u_tree, v_tree = uv
+            squeeze = lambda t: jax.tree.map(lambda x: x[0], t)
+            u_tree, v_tree = squeeze(u_tree), squeeze(v_tree)
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            ramped = step > rampup  # reference: step_id > rampup begins DGC
+
+            def sparse_leaf(g, u, v):
+                sparse, u_s, v_s = dgc_compress(g, u, v, momentum, sparsity)
+                # the ONLY collective of the compressed path: everything
+                # but the top-k entries is zero, so this pmean is the
+                # dense-allreduce-of-sparse-encoding of the reference
+                return jax.lax.pmean(sparse, axis), u_s, v_s
+
+            def dense_leaf(g, u, v):
+                # rampup: plain momentum on the dense pmean; v unused
+                u_d = momentum * u + jax.lax.pmean(g, axis)
+                zeros = jnp.zeros_like(v)
+                if axis not in getattr(jax.typeof(zeros), "vma", (axis,)):
+                    zeros = jax.lax.pcast(zeros, (axis,), to="varying")
+                # u_d is replicated in VALUE (identical pmean'ed grads ->
+                # identical momentum) but typed varying via u; pcast-by-
+                # pmean keeps branch output types equal to sparse_leaf's
+                return jax.lax.pmean(u_d, axis), u_d, zeros
+
+            def leaf(g, u, v):
+                if rampup <= 0:  # static: never a dense step, no
+                    return sparse_leaf(g, u, v)  # dense collective at all
+                return jax.lax.cond(ramped, sparse_leaf, dense_leaf,
+                                    g, u, v)
+
+            g_l, treedef = jax.tree.flatten(grads)
+            res = [leaf(g, u, v) for g, u, v in zip(
+                g_l, jax.tree.leaves(u_tree), jax.tree.leaves(v_tree))]
+            upd = treedef.unflatten([r[0] for r in res])
+            u_new = treedef.unflatten([r[1] for r in res])
+            v_new = treedef.unflatten([r[2] for r in res])
+            params = jax.tree.map(lambda p, d: p - lr * d, params, upd)
+            loss = jax.lax.pmean(loss, axis)
+            expand = lambda t: jax.tree.map(lambda x: x[None], t)
+            return params, (expand(u_new), expand(v_new)), loss
+
+        sharded = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), (P(axis), P(axis)), P(), P(axis)),
+            out_specs=(P(), (P(axis), P(axis)), P()))
+
+        @jax.jit
+        def step_fn(params, state, batch):
+            uv, step = state
+            step = step + 1
+            params, uv, loss = sharded(params, uv, step, batch)
+            return params, (uv, step), loss
+
+        def init_state(params):
+            zeros = lambda: jax.tree.map(
+                lambda p: jnp.zeros((n,) + jnp.shape(p),
+                                    jnp.result_type(p)), params)
+            return (zeros(), zeros()), jnp.zeros((), jnp.int32)
+
+        return step_fn, init_state
+
 
 class LocalSGDOptimizer(MetaOptimizerBase):
     """localsgd_optimizer.py:78-140 — run k local steps, then average
-    parameters across the data-parallel group. Single-controller SPMD
-    keeps params replicated, so the averaging step is the identity
-    unless params are intentionally de-synced (per-device shard_map
-    training); provided for strategy parity with the periodic-psum
-    formulation documented here."""
+    parameters across the data-parallel group.
+
+    Device path: build_spmd_round() returns a jitted round function in
+    which each dp-mesh device runs k SGD steps on its OWN divergent copy
+    of the parameters (a lax.scan inside shard_map — the de-synced local
+    training the reference implements with per-worker programs plus a
+    snapshot/allreduce), then jax.lax.pmean re-syncs the parameters, the
+    reference's communicate() allreduce over the snapshot delta."""
 
     def __init__(self, inner, k_steps: int = 1):
         super().__init__(inner)
@@ -241,3 +350,40 @@ class LocalSGDOptimizer(MetaOptimizerBase):
                 lambda x: jax.lax.pmean(x, axis),
                 mesh=mesh, in_specs=P(), out_specs=P())(p)
         return jax.tree.map(avg, params)
+
+    def build_spmd_round(self, loss_fn, mesh, lr: float, axis: str = "dp"):
+        """round_fn(params, batches) -> (params, mean_final_loss).
+        batches: pytree of [k_steps, B_global, ...] arrays; the global
+        batch dim shards over `axis`, so device d sees its own k local
+        microbatches. Params enter and leave replicated (in-round copies
+        diverge, pmean re-syncs). loss_fn(params, batch) -> scalar."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        k = self.k_steps
+
+        def body(params, batches):
+            def one(p, batch):
+                loss, g = jax.value_and_grad(loss_fn)(p, batch)
+                p = jax.tree.map(lambda a, b: a - lr * b, p, g)
+                return p, loss
+
+            p, losses = jax.lax.scan(one, params, batches)
+            p = jax.tree.map(lambda x: jax.lax.pmean(x, axis), p)
+            return p, jax.lax.pmean(losses[-1], axis)
+
+        sharded = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(None, axis)), out_specs=(P(), P()))
+        jitted = jax.jit(lambda params, batches: sharded(params, batches))
+
+        def round_fn(params, batches):
+            steps = {jnp.shape(b)[0] for b in jax.tree.leaves(batches)}
+            if steps != {k}:
+                raise ValueError(
+                    "LocalSGD round expects k_steps=%d leading microbatch "
+                    "dim, got %s" % (k, sorted(steps)))
+            return jitted(params, batches)
+
+        return round_fn

@@ -313,3 +313,77 @@ def test_multi_trainer_propagates_worker_error():
 
     with pytest.raises(ValueError, match="exploded"):
         MultiTrainer(thread_num=2).run([1, 2, 3], bad)
+
+
+# ---------------------------------------------------------------------------
+# PSLib descriptor layer (pslib/node.py + optimizer_factory.py)
+# ---------------------------------------------------------------------------
+
+def test_pslib_descriptor_validation_and_text():
+    from paddle_tpu.distributed import DownpourDescriptor
+    d = DownpourDescriptor()
+    with pytest.raises(ValueError, match="not support"):
+        d.server.add_sparse_table(0, {"bogus_key": 1})
+    with pytest.raises(ValueError, match="accessor_class"):
+        d.server.add_sparse_table(0, {"sparse_accessor_class": "Nope"})
+    d.sparse_table("emb", strategy={
+        "sparse_accessor_class": "DownpourCtrAccessor",
+        "sparse_learning_rate": 0.5, "sparse_embedx_dim": 4})
+    txt = d.server.to_text()
+    assert "DownpourCtrAccessor" in txt and "PS_SPARSE_TABLE" in txt
+    assert "embedx_dim: 4" in txt
+
+
+def test_pslib_descriptor_drives_wide_deep_ctr():
+    """Wide&Deep-style CTR run configured entirely through the Downpour
+    descriptor (optimizer_factory.py DistributedAdam builds protos ->
+    pslib runtime; here desc -> LargeScaleKV + DownpourWorker): sparse
+    wide embedding on the PS, dense deep tower on-device."""
+    from paddle_tpu.distributed import DownpourDescriptor
+    rng = np.random.RandomState(2)
+    vocab, dim, B, T = 200, 4, 32, 3
+
+    desc = DownpourDescriptor()
+    desc.sparse_table("wide_emb", strategy={
+        "sparse_accessor_class": "DownpourCtrAccessor",
+        "sparse_learning_rate": 0.5,
+        "sparse_initial_range": 0.1,
+        "sparse_embedx_dim": dim,
+        "sparse_seed": 0})
+    server, workers = desc.build_runtime()
+    worker = workers["wide_emb"]
+    assert server.sparse["wide_emb"].cfg.optimizer == "adagrad"
+
+    true_w = rng.randn(vocab) * 2
+    deep_w = jnp.zeros((dim * T, 8))
+    deep_v = jnp.zeros((8,))
+
+    def make_batch():
+        ids = rng.randint(0, vocab, (B, T))
+        y = (true_w[ids].sum(1) > 0).astype(np.float32)
+        return ids, y
+
+    @jax.jit
+    def step(rows, deep_w, deep_v, y):
+        def loss_fn(rows, deep_w, deep_v):
+            wide = rows.sum(axis=(1, 2))
+            h = jax.nn.relu(rows.reshape(rows.shape[0], -1) @ deep_w)
+            deep = h @ deep_v
+            p = jax.nn.sigmoid(wide + deep)
+            return -jnp.mean(y * jnp.log(p + 1e-7) +
+                             (1 - y) * jnp.log(1 - p + 1e-7))
+        l, g = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+            rows, deep_w, deep_v)
+        return l, g
+
+    losses = []
+    for i in range(60):
+        ids, y = make_batch()
+        rows = worker.pull(ids)
+        l, (g_rows, g_w, g_v) = step(jnp.asarray(rows), deep_w, deep_v,
+                                     jnp.asarray(y))
+        worker.push(ids, np.asarray(g_rows))
+        deep_w = deep_w - 0.1 * g_w
+        deep_v = deep_v - 0.1 * g_v
+        losses.append(float(l))
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.6, losses[:5]
