@@ -21,8 +21,9 @@ import ctypes
 import hashlib
 import hmac
 import os
-import subprocess
 from typing import Optional
+
+from .native_build import build_native_lib
 
 _LIB = None
 _LIB_FAILED = False
@@ -35,28 +36,10 @@ IV_BYTES = 16
 
 def _build_lib() -> Optional[ctypes.CDLL]:
     global _LIB_FAILED
-    if not os.path.exists(_SRC):
+    lib = build_native_lib(_SRC, "crypto")
+    if lib is None:
         _LIB_FAILED = True
         return None
-    with open(_SRC, "rb") as f:
-        tag = hashlib.md5(f.read()).hexdigest()[:12]
-    cache_dir = os.path.join(os.path.dirname(_SRC), "build")
-    so_path = os.path.join(cache_dir, "libcrypto_%s.so" % tag)
-    if not os.path.exists(so_path):
-        os.makedirs(cache_dir, exist_ok=True)
-        tmp = so_path + ".tmp.%d" % os.getpid()
-        try:
-            subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
-                check=True, capture_output=True)
-            os.replace(tmp, so_path)
-        except (OSError, subprocess.CalledProcessError) as e:
-            import logging
-            logging.getLogger("paddle_tpu").warning(
-                "native AES build failed: %r", e)
-            _LIB_FAILED = True
-            return None
-    lib = ctypes.CDLL(so_path)
     lib.aes_ctr_crypt.restype = ctypes.c_longlong
     lib.aes_ctr_crypt.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,

@@ -9,9 +9,7 @@ Dataset API.
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
-import subprocess
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -25,44 +23,11 @@ _SRC = os.path.join(os.path.dirname(os.path.dirname(
 
 def _build_lib() -> Optional[ctypes.CDLL]:
     global _LIB_FAILED
-    if not os.path.exists(_SRC):
+    from ..native_build import build_native_lib
+    lib = build_native_lib(_SRC, "data_feed")
+    if lib is None:
         _LIB_FAILED = True
         return None
-    with open(_SRC, "rb") as f:
-        tag = hashlib.md5(f.read()).hexdigest()[:12]
-    cache_dir = os.path.join(os.path.dirname(_SRC), "build")
-    so_path = os.path.join(cache_dir, "libdata_feed_%s.so" % tag)
-    if not os.path.exists(so_path):
-        os.makedirs(cache_dir, exist_ok=True)
-        tmp = so_path + ".tmp.%d" % os.getpid()
-        # two attempts: a fork under a memory-pressured multithreaded
-        # parent (the full test suite) can fail transiently, and one
-        # such failure must not latch the numpy fallback for the whole
-        # process
-        last_err = None
-        for _ in range(2):
-            try:
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
-                    check=True, capture_output=True)
-                os.replace(tmp, so_path)
-                last_err = None
-                break
-            except FileNotFoundError as e:
-                last_err = e  # no toolchain: retrying cannot help
-                break
-            except (subprocess.CalledProcessError, OSError) as e:
-                last_err = e
-        if last_err is not None:
-            import logging
-            logging.getLogger("paddle_tpu").warning(
-                "native MultiSlot parser build failed, using the numpy "
-                "fallback: %r%s", last_err,
-                (b"\n" + last_err.stderr).decode(errors="replace")[:500]
-                if getattr(last_err, "stderr", None) else "")
-            _LIB_FAILED = True
-            return None
-    lib = ctypes.CDLL(so_path)
     lib.mslot_count.restype = ctypes.c_longlong
     lib.mslot_count.argtypes = [
         ctypes.c_char_p, ctypes.c_longlong, ctypes.c_int, ctypes.c_char_p,

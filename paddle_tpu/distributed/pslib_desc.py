@@ -55,14 +55,18 @@ class SparseTableDesc:
     optimizer: Optional[str] = None  # explicit override of the accessor map
     seed: int = 0
 
-    def to_runtime_config(self, name: str) -> SparseTableConfig:
-        """Map the accessor descriptor onto a LargeScaleKV config —
-        the act the pslib runtime performs when instantiating the
-        accessor from the proto (node.py:138-160 field mapping)."""
+    def __post_init__(self):
+        # single validation point: hand-built descs and strategy-dict
+        # built ones both pass through here
         if self.accessor_class not in SPARSE_ACCESSORS:
             raise ValueError(
                 "support sparse_accessor_class: %s, but actual %s"
                 % (list(SPARSE_ACCESSORS), self.accessor_class))
+
+    def to_runtime_config(self, name: str) -> SparseTableConfig:
+        """Map the accessor descriptor onto a LargeScaleKV config —
+        the act the pslib runtime performs when instantiating the
+        accessor from the proto (node.py:138-160 field mapping)."""
         if self.optimizer:
             opt = self.optimizer
         elif self.accessor_class == "DownpourSparseValueAccessor":
@@ -122,10 +126,6 @@ class DownpourServerDesc:
             compress_in_save=strategy.get("sparse_compress_in_save", True),
             optimizer=strategy.get("sparse_optimizer"),
             seed=strategy.get("sparse_seed", 0))
-        if d.accessor_class not in SPARSE_ACCESSORS:
-            raise ValueError(
-                "support sparse_accessor_class: %s, but actual %s"
-                % (list(SPARSE_ACCESSORS), d.accessor_class))
         self.sparse_tables[table_id] = d
         return d
 

@@ -2,6 +2,8 @@
 /root/reference/paddle/fluid/imperative/ + python/paddle/fluid/dygraph/."""
 from .tape import (GradNode, Tensor, grad, no_grad, run_backward, run_op,  # noqa: F401
                    seed, to_tensor, to_variable)
+from .dygraph_to_static import (ConversionError, ProgramTranslator,  # noqa: F401
+                                convert_to_static, declarative)
 
 
 class guard:

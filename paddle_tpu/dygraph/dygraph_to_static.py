@@ -1,0 +1,465 @@
+"""dygraph->static control-flow capture: AST conversion of Python
+`if`/`while` on traced values into lax.cond / lax.while_loop.
+
+Analog of the reference's ProgramTranslator
+(/root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:680 and ifelse_transformer.py / loop_transformer.py):
+the reference AST-rewrites data-dependent Python control flow into
+cond_op/while_op graph ops. Here the same rewrite targets JAX's
+structured control flow: a transformed `if` calls `_pt_cond`, which takes
+the plain Python branch when the predicate is concrete and lax.cond when
+it is a tracer (both branches traced, one executed on device); a
+transformed `while` likewise becomes `_pt_while` -> lax.while_loop.
+Without this, tracing a data-dependent branch raises
+TracerBoolConversionError (loud but dead-end); with it, both branches
+compile — the reference's `to_static` contract.
+
+Scope (fail-loud beyond it): `if`/`elif`/`else` and `while` are
+converted; `return`/`break`/`continue` INSIDE a converted block raise a
+conversion error (the reference has dedicated transformers for those);
+`for` loops are left as Python (static unrolling — correct under jit for
+python iterables, the common case).
+
+Variable convention (ifelse_transformer.py's modified-name analysis):
+every name assigned inside a branch/loop body becomes an output of the
+generated branch function; a name assigned in only one `if` branch falls
+back to the outer value (or an Undefined sentinel that raises on use —
+utils.UndefinedVar's contract). Loop-carried names must be defined
+before the loop, the lax.while_loop requirement the reference's
+loop_transformer meets with to_static-time name creation.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import warnings
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["convert_to_static", "ProgramTranslator", "declarative",
+           "ConversionError"]
+
+
+class ConversionError(RuntimeError):
+    pass
+
+
+class _Undefined:
+    """utils.UndefinedVar analog: a name assigned in only one branch;
+    touching it after the cond raises with the variable's name."""
+
+    def __init__(self, name):
+        self._name = name
+
+    def _die(self, *a, **k):
+        raise NameError(
+            "variable %r is undefined on one branch of a converted `if` "
+            "and was used afterwards" % self._name)
+
+    __bool__ = __call__ = __getattr__ = __getitem__ = _die
+    __add__ = __radd__ = __mul__ = __rmul__ = __sub__ = _die
+
+
+def _is_tracer(x) -> bool:
+    from .tape import Tensor
+    if isinstance(x, Tensor):
+        x = x.value
+    return isinstance(x, jax.core.Tracer)
+
+
+def _unwrap_tree(x):
+    from .tape import Tensor
+    is_t = lambda v: isinstance(v, Tensor)
+    flags = jax.tree.map(lambda v: is_t(v), x, is_leaf=is_t)
+    vals = jax.tree.map(lambda v: v.value if is_t(v) else v, x, is_leaf=is_t)
+    return vals, flags
+
+
+def _rewrap_tree(vals, flags):
+    from .tape import Tensor
+    return jax.tree.map(
+        lambda v, f: Tensor(v) if f else v, vals, flags)
+
+
+def _pred_value(pred):
+    from .tape import Tensor
+    return pred.value if isinstance(pred, Tensor) else pred
+
+
+def _isolated_keys(fn):
+    """Run fn with the global dygraph rng key snapshotted and restored:
+    ops inside a lax.cond/while sub-trace would otherwise store a
+    sub-trace tracer into tape._state.key, which leaks (and crashes)
+    once the sub-trace closes. Consequence: random ops inside a
+    converted branch/loop draw from the key as of block entry (each
+    while iteration reuses it) — matching the reference's behavior of
+    seeding sub-block ops from the enclosing generator state."""
+    from . import tape
+
+    def run(*a):
+        # read the RAW slot: the lazy `key` property would materialize
+        # PRNGKey(0) as a tracer of the current trace on first access,
+        # leaving a stale tracer in global state after the trace closes
+        old = tape._state._key
+        try:
+            return fn(*a)
+        finally:
+            tape._state._key = old
+    return run
+
+
+def _restore_and_advance_key(old_key):
+    """Put the entry key back after a converted block, then advance it
+    once so ops after the block draw fresh randomness — but only when
+    the advance cannot leak a tracer into global state: either we are
+    not tracing at all, or the key is already a tracer of an enclosing
+    managed trace (functional_call restores it). Under a raw jax.jit
+    with a concrete global key, skip the advance (post-block rng
+    correlates with block-entry rng; restoring beats leaking)."""
+    from . import tape
+    tape._state._key = old_key
+    if old_key is None:
+        return
+    try:
+        from jax._src import core as _core
+        tracing = not _core.trace_state_clean()
+    except Exception:
+        tracing = True  # unknown -> be conservative
+    if isinstance(old_key, jax.core.Tracer) or not tracing:
+        tape._state.next_key()
+
+
+def _pt_cond(pred, true_fn, false_fn, args=()):
+    """Runtime of a converted `if`: python branch on concrete predicates,
+    lax.cond on traced ones (convert_ifelse in the reference's
+    convert_operators.py). `args` carries the current values of every
+    name either branch assigns (possibly _Undefined), passed as branch
+    function parameters so read-modify patterns see the outer value."""
+    pv = _pred_value(pred)
+    if not _is_tracer(pv):
+        return true_fn(*args) if bool(pv) else false_fn(*args)
+    from . import tape
+    old_key = tape._state._key
+    flag_box = {}
+
+    def wrap(fn, tag):
+        @_isolated_keys
+        def run():
+            out = fn(*args)
+            vals, flags = _unwrap_tree(out)
+            flag_box[tag] = flags
+            return vals
+        return run
+
+    pv = jnp.reshape(jnp.asarray(pv), ()).astype(bool)
+    try:
+        vals = jax.lax.cond(pv, wrap(true_fn, "t"), wrap(false_fn, "f"))
+    except TypeError as e:
+        raise ConversionError(
+            "converted `if` branches produced mismatched outputs (a "
+            "variable assigned in only one branch with no prior value, "
+            "or different shapes/dtypes per branch): %s" % e) from None
+    finally:
+        _restore_and_advance_key(old_key)
+    if flag_box.get("t") != flag_box.get("f"):
+        raise ConversionError(
+            "converted `if` branches disagree on which outputs are "
+            "Tensors vs raw arrays — assign the same kind on both "
+            "branches (flags: true=%s false=%s)"
+            % (flag_box.get("t"), flag_box.get("f")))
+    return _rewrap_tree(vals, flag_box["t"])
+
+
+def _pt_while(cond_fn, body_fn, init):
+    """Runtime of a converted `while` (convert_while_loop analog)."""
+    from . import tape
+    old_key = tape._state._key
+    first = _isolated_keys(cond_fn)(*init)
+    if not _is_tracer(first) and not any(
+            _is_tracer(v) for v in jax.tree.leaves(_unwrap_tree(init)[0])):
+        vars_ = tuple(init)
+        while bool(_pred_value(cond_fn(*vars_))):
+            vars_ = tuple(body_fn(*vars_))
+        return vars_
+
+    vals, flags = _unwrap_tree(tuple(init))
+
+    @_isolated_keys
+    def cond(c):
+        r = cond_fn(*_rewrap_tree(c, flags))
+        return jnp.reshape(jnp.asarray(_pred_value(r)), ()).astype(bool)
+
+    @_isolated_keys
+    def body(c):
+        out = body_fn(*_rewrap_tree(c, flags))
+        new_vals, _ = _unwrap_tree(tuple(out))
+        return new_vals
+
+    try:
+        final = jax.lax.while_loop(cond, body, vals)
+    except TypeError as e:
+        raise ConversionError(
+            "converted `while` carry changed structure/shape/dtype "
+            "across an iteration (lax.while_loop needs loop-invariant "
+            "types): %s" % e) from None
+    finally:
+        _restore_and_advance_key(old_key)
+    return _rewrap_tree(final, flags)
+
+
+def _pt_undef(name):
+    return _Undefined(name)
+
+
+# ---------------------------------------------------------------------------
+# AST transformation
+# ---------------------------------------------------------------------------
+
+def _assigned_names(stmts) -> set:
+    names = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                names.add(node.id)
+
+        def visit_FunctionDef(self, node):  # don't descend into defs
+            names.add(node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+    for s in stmts:
+        V().visit(s)
+    return names
+
+
+def _has_flow_escape(stmts) -> bool:
+    """Return/break/continue inside the block: such a statement cannot
+    become a lax.cond/while (the reference rewrites these with dedicated
+    return/break_continue transformers). Blocks containing them stay
+    plain Python — correct for concrete predicates (the overwhelmingly
+    common `if mask is None: return ...` pattern), and a data-dependent
+    predicate still fails loudly with TracerBoolConversionError."""
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, (ast.Return, ast.Break, ast.Continue)):
+                return True
+    return False
+
+
+def _try_capture(target_id, name):
+    """`try: <target> = <name>; except NameError: <target> =
+    _pt_undef('<name>')` — used both to snapshot outer values into
+    branch-call arguments and (kept for safety) inside branch returns."""
+    return ast.Try(
+        body=[ast.Assign(
+            targets=[ast.Name(id=target_id, ctx=ast.Store())],
+            value=ast.Name(id=name, ctx=ast.Load()))],
+        handlers=[ast.ExceptHandler(
+            type=ast.Name(id="NameError", ctx=ast.Load()), name=None,
+            body=[ast.Assign(
+                targets=[ast.Name(id=target_id, ctx=ast.Store())],
+                value=ast.Call(
+                    func=ast.Name(id="_pt_undef", ctx=ast.Load()),
+                    args=[ast.Constant(name)], keywords=[]))])],
+        orelse=[], finalbody=[])
+
+
+def _capture_stmts(names):
+    """Per-name capture + final `return (__pt_r0, ...)` for a branch
+    function body. Names are function parameters (see visit_If), so the
+    try normally succeeds; the except arm only fires for exotic `del`."""
+    out = [_try_capture("__pt_r%d" % i, n)
+           for i, n in enumerate(sorted(names))]
+    out.append(ast.Return(value=ast.Tuple(
+        elts=[ast.Name(id="__pt_r%d" % i, ctx=ast.Load())
+              for i in range(len(names))], ctx=ast.Load())))
+    return out
+
+
+class _CtrlFlow(ast.NodeTransformer):
+    def __init__(self):
+        self.n = 0
+
+    def visit_If(self, node):
+        node = self.generic_visit(node)
+        if _has_flow_escape(node.body + node.orelse):
+            return node
+        names = sorted(_assigned_names(node.body) |
+                       _assigned_names(node.orelse))
+        self.n += 1
+        t_name, f_name = "__pt_true%d" % self.n, "__pt_false%d" % self.n
+        # branch fns take every branch-assigned name as a PARAMETER:
+        # a branch that reads y before (or without) assigning it sees
+        # the outer value instead of hitting UnboundLocalError from
+        # python's local-if-assigned rule
+        fargs = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        t_def = ast.FunctionDef(
+            name=t_name, args=fargs,
+            body=list(node.body) + _capture_stmts(names),
+            decorator_list=[], type_params=[])
+        f_def = ast.FunctionDef(
+            name=f_name, args=fargs,
+            body=(list(node.orelse) or [ast.Pass()]) +
+            _capture_stmts(names), decorator_list=[], type_params=[])
+        # snapshot outer values (possibly undefined) into call arguments
+        caps = [_try_capture("__pt_a%d_%d" % (self.n, i), n)
+                for i, n in enumerate(names)]
+        arg_tuple = ast.Tuple(
+            elts=[ast.Name(id="__pt_a%d_%d" % (self.n, i), ctx=ast.Load())
+                  for i in range(len(names))], ctx=ast.Load())
+        call = ast.Call(func=ast.Name(id="_pt_cond", ctx=ast.Load()),
+                        args=[node.test,
+                              ast.Name(id=t_name, ctx=ast.Load()),
+                              ast.Name(id=f_name, ctx=ast.Load()),
+                              arg_tuple],
+                        keywords=[])
+        if names:
+            assign = ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+                    ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [t_def, f_def] + caps + [assign]
+
+    def visit_While(self, node):
+        node = self.generic_visit(node)
+        if node.orelse or _has_flow_escape(node.body):
+            return node  # stays python; loud TracerBoolConversionError
+        names = sorted(_assigned_names(node.body))  # if data-dependent
+        self.n += 1
+        c_name, b_name = "__pt_wcond%d" % self.n, "__pt_wbody%d" % self.n
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        c_def = ast.FunctionDef(
+            name=c_name, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            type_params=[])
+        b_def = ast.FunctionDef(
+            name=b_name, args=args,
+            body=list(node.body) + [ast.Return(value=ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+                ctx=ast.Load()))],
+            decorator_list=[], type_params=[])
+        call = ast.Call(
+            func=ast.Name(id="_pt_while", ctx=ast.Load()),
+            args=[ast.Name(id=c_name, ctx=ast.Load()),
+                  ast.Name(id=b_name, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                  for n in names], ctx=ast.Load())],
+            keywords=[])
+        if names:
+            assign = ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+                    ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [c_def, b_def, assign]
+
+
+def _noargs():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+_cache: Dict[Any, Callable] = {}
+
+
+def convert_to_static(fn: Callable) -> Callable:
+    """AST-convert fn's `if`/`while` into _pt_cond/_pt_while calls.
+    Returns fn unchanged (with a warning) when the source is unavailable
+    or the function has closure cells the rebuild would lose."""
+    key = getattr(fn, "__wrapped__", fn)
+    if key in _cache:
+        return _cache[key]
+    has_ctrl = False
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        fdef.decorator_list = []
+        # only statements the transformer would actually convert count:
+        # if/while containing return/break/continue stay python anyway,
+        # so a guard-return function must not trigger the closure warn
+        has_ctrl = any(
+            (isinstance(n, ast.If)
+             and not _has_flow_escape(n.body + n.orelse))
+            or (isinstance(n, ast.While) and not n.orelse
+                and not _has_flow_escape(n.body))
+            for n in ast.walk(fdef))
+        if has_ctrl and fn.__closure__:
+            warnings.warn(
+                "to_static cannot convert %r: rebuilding a closure "
+                "function loses its cells; tracing as-is" % (fn,))
+            has_ctrl = False
+        if has_ctrl:
+            new_fdef = _CtrlFlow().visit(fdef)
+            tree = ast.fix_missing_locations(ast.Module(
+                body=[new_fdef], type_ignores=[]))
+            ns = dict(fn.__globals__)
+            ns.update({"_pt_cond": _pt_cond, "_pt_while": _pt_while,
+                       "_pt_undef": _pt_undef})
+            code = compile(tree, "<paddle_tpu.to_static %s>"
+                           % getattr(fn, "__qualname__", fn.__name__),
+                           "exec")
+            exec(code, ns)
+            converted = functools.wraps(fn)(ns[fdef.name])
+        else:
+            converted = fn
+    except ConversionError:
+        raise
+    except (OSError, TypeError, SyntaxError) as e:
+        warnings.warn(
+            "to_static could not convert %r (%s); tracing as-is — "
+            "data-dependent Python control flow will fail with "
+            "TracerBoolConversionError" % (fn, e))
+        converted = fn
+    _cache[key] = converted
+    return converted
+
+
+class ProgramTranslator:
+    """program_translator.py ProgramTranslator singleton: enable(False)
+    turns conversion off globally (to_static then traces as-is)."""
+    _instance = None
+    enabled = True
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def enable(self, flag: bool):
+        type(self).enabled = bool(flag)
+
+
+def declarative(fn):
+    """@declarative / @paddle.jit.to_static decorator for plain
+    functions and Layer.forward methods (dygraph/jit.py declarative)."""
+    conv = convert_to_static(fn)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not ProgramTranslator.enabled:
+            return fn(*args, **kwargs)
+        return conv(*args, **kwargs)
+
+    wrapper.__converted__ = conv
+    return wrapper

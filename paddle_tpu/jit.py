@@ -59,7 +59,10 @@ def functional_call(layer: Layer, state: Dict[str, Any], *args,
     old_vals = {n: t.value for n, t in everything.items()}
     old_training = layer.training
     old_is_test = tape._state.is_test
-    old_key = tape._state.key
+    # raw slot, NOT the lazy property: reading .key inside a jax trace
+    # would materialize PRNGKey(0) as a tracer of this trace and the
+    # finally-restore below would then persist a stale tracer globally
+    old_key = tape._state._key
     if rng is not None:
         tape._state.key = rng
     if training:
@@ -99,13 +102,35 @@ def load_state(layer: Layer, state: Dict[str, Any]):
 
 def to_static(layer_or_fn, example_inputs=None, donate_state: bool = False):
     """Compile a Layer's forward (inference) or a plain fn into one jitted
-    XLA computation — TracedLayer analog (dygraph/jit.py)."""
+    XLA computation — TracedLayer analog (dygraph/jit.py).
+
+    Data-dependent Python `if`/`while` in the forward are AST-converted
+    to lax.cond/lax.while_loop first (dygraph_to_static module — the
+    reference's ProgramTranslator pipeline), so both branches compile
+    instead of the trace silently specializing or dying on a tracer
+    bool."""
+    import types
+    from .dygraph.dygraph_to_static import (ProgramTranslator,
+                                            convert_to_static)
     if isinstance(layer_or_fn, Layer):
         layer = layer_or_fn
+        fwd_fn = type(layer).forward
+        if ProgramTranslator.enabled:
+            fwd_fn = convert_to_static(fwd_fn)
 
         @jax.jit
         def fwd(state, *args):
-            out, _ = functional_call(layer, state, *map(_wrap, args))
+            # bind the converted forward for the duration of the trace
+            # (same temporary-rebinding discipline as the params above)
+            old = layer.__dict__.get("forward")
+            layer.forward = types.MethodType(fwd_fn, layer)
+            try:
+                out, _ = functional_call(layer, state, *map(_wrap, args))
+            finally:
+                if old is None:
+                    layer.__dict__.pop("forward", None)
+                else:
+                    layer.forward = old
             return out
 
         def run(*args):
@@ -113,7 +138,10 @@ def to_static(layer_or_fn, example_inputs=None, donate_state: bool = False):
 
         run._jitted = fwd
         return run
-    return jax.jit(layer_or_fn)
+    fn = layer_or_fn
+    if ProgramTranslator.enabled:
+        fn = convert_to_static(fn)
+    return jax.jit(fn)
 
 
 def _wrap(x):

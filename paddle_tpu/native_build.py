@@ -1,0 +1,48 @@
+"""Shared ctypes build-and-cache loader for the csrc/ native helpers
+(data_feed.cc, crypto.cc): compile the .so on first use with g++, cache
+next to the source keyed by a content hash, warn-and-return-None when no
+toolchain is available so callers can fall back."""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import Optional
+
+
+def build_native_lib(src_path: str, name: str) -> Optional[ctypes.CDLL]:
+    if not os.path.exists(src_path):
+        return None
+    with open(src_path, "rb") as f:
+        tag = hashlib.md5(f.read()).hexdigest()[:12]
+    cache_dir = os.path.join(os.path.dirname(src_path), "build")
+    so_path = os.path.join(cache_dir, "lib%s_%s.so" % (name, tag))
+    if not os.path.exists(so_path):
+        os.makedirs(cache_dir, exist_ok=True)
+        tmp = so_path + ".tmp.%d" % os.getpid()
+        # two attempts: a fork under a memory-pressured multithreaded
+        # parent (the full test suite) can fail transiently, and one
+        # such failure must not latch the fallback for the process
+        last_err = None
+        for _ in range(2):
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src_path],
+                    check=True, capture_output=True)
+                os.replace(tmp, so_path)
+                last_err = None
+                break
+            except FileNotFoundError as e:
+                last_err = e  # no toolchain: retrying cannot help
+                break
+            except (subprocess.CalledProcessError, OSError) as e:
+                last_err = e
+        if last_err is not None:
+            import logging
+            logging.getLogger("paddle_tpu").warning(
+                "native %s build failed: %r%s", name, last_err,
+                (b"\n" + last_err.stderr).decode(errors="replace")[:500]
+                if getattr(last_err, "stderr", None) else "")
+            return None
+    return ctypes.CDLL(so_path)

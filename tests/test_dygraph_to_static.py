@@ -1,0 +1,158 @@
+"""dygraph->static control-flow conversion tests (reference
+test_program_translator / test_ifelse / test_loop discipline)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.dygraph import (ConversionError, ProgramTranslator,
+                                convert_to_static, declarative)
+from paddle_tpu.jit import to_static
+
+
+def test_data_dependent_if_both_branches_execute():
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = x - 10.0
+        return y
+
+    import jax.numpy as jnp
+    g = to_static(f)
+    pos = jnp.ones((3,))
+    neg = -jnp.ones((3,))
+    np.testing.assert_allclose(np.asarray(g(pos)), 2 * np.ones(3))
+    np.testing.assert_allclose(np.asarray(g(neg)), -11 * np.ones(3))
+
+
+def test_data_dependent_while_loop():
+    def f(x):
+        s = x * 0.0
+        while s.sum() < 10.0:
+            s = s + x
+        return s
+
+    import jax.numpy as jnp
+    g = to_static(f)
+    out = g(jnp.ones((2,)) * 3.0)  # 3,6,9,12 -> stops at 12
+    np.testing.assert_allclose(np.asarray(out), [6.0, 6.0])
+
+
+def test_python_condition_stays_python():
+    calls = []
+
+    def f(x, flag=True):
+        if flag:
+            calls.append("t")
+            return x + 1
+        return x - 1
+
+    conv = convert_to_static(f)
+    assert float(np.asarray(conv(np.zeros(()), True))) == 1.0
+    assert calls == ["t"]
+
+
+def test_layer_with_branch_through_to_static():
+    class Gated(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = pt.nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+            if h.sum() > 0:
+                out = h * 2.0
+            else:
+                out = h * 0.5
+            return out
+
+    pt.seed(0)
+    layer = Gated()
+    run = to_static(layer)
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    out_pos = np.asarray(run(pt.to_tensor(x)))
+    out_neg = np.asarray(run(pt.to_tensor(-x * 100)))
+    # eager references (python branching on concrete values)
+    ref_pos = np.asarray(layer(pt.to_tensor(x)).value)
+    ref_neg = np.asarray(layer(pt.to_tensor(-x * 100)).value)
+    np.testing.assert_allclose(out_pos, ref_pos, rtol=1e-5)
+    np.testing.assert_allclose(out_neg, ref_neg, rtol=1e-5)
+
+
+def test_undefined_var_sentinel_raises_on_use():
+    def f(x):
+        if x.sum() > 0:
+            only_true = x * 2
+        else:
+            pass
+        return only_true  # noqa: F821
+
+    import jax.numpy as jnp
+    g = to_static(f)
+    with pytest.raises(Exception, match="undefined|mismatch"):
+        g(jnp.ones((2,)))
+
+
+def test_return_inside_branch_stays_python_and_fails_loudly():
+    # `if` with an early return is NOT converted (reference needs its
+    # return transformer): concrete predicates keep exact python
+    # semantics; a data-dependent one fails loudly at trace time.
+    def f(x):
+        if x.sum() > 0:
+            return x
+        return -x
+
+    import jax
+    import jax.numpy as jnp
+    conv = convert_to_static(f)
+    np.testing.assert_allclose(np.asarray(conv(np.ones(2))), np.ones(2))
+    with pytest.raises(jax.errors.TracerBoolConversionError):
+        jax.jit(conv)(jnp.ones(2))
+
+
+def test_translator_disable_restores_trace_behavior():
+    def f(x):
+        if x.sum() > 0:
+            y = x
+        else:
+            y = -x
+        return y
+
+    import jax
+    import jax.numpy as jnp
+    ProgramTranslator().enable(False)
+    try:
+        g = to_static(f)
+        with pytest.raises(jax.errors.TracerBoolConversionError):
+            g(jnp.ones((2,)))
+    finally:
+        ProgramTranslator().enable(True)
+
+
+def test_declarative_decorator():
+    @declarative
+    def f(x):
+        s = x
+        while s.sum() < 5:
+            s = s * 2
+        return s
+
+    import jax
+    out = jax.jit(f)(np.ones(2, np.float32))
+    np.testing.assert_allclose(np.asarray(out), [4.0, 4.0])
+
+
+def test_read_modify_inside_branch():
+    # the read-modify accumulator: y read and assigned in the branch
+    def f(x):
+        y = x + 1.0
+        if x.sum() > 0:
+            y = y * 2.0
+        return y
+
+    import jax.numpy as jnp
+    conv = convert_to_static(f)
+    np.testing.assert_allclose(np.asarray(conv(np.ones(2))), [4.0, 4.0])
+    g = to_static(f)
+    np.testing.assert_allclose(np.asarray(g(jnp.ones(2))), [4.0, 4.0])
+    np.testing.assert_allclose(np.asarray(g(-jnp.ones(2))), [0.0, 0.0])
