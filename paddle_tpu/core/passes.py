@@ -110,3 +110,253 @@ def _fuse_add_act(program: Program, attrs: dict) -> Program:
     preceding GEMM); the pass validates the pattern exists and is a
     no-op rewrite — kept so strategy plumbing round-trips."""
     return program
+
+
+# --------------------------------------------------------------------------
+# inference fusion passes (paddle_pass_builder.cc:104 GPU list — the
+# SEMANTIC members XLA cannot recover from the op graph: they reroute
+# subgraphs onto this repo's fused/Pallas kernels)
+# --------------------------------------------------------------------------
+
+def _producer_map(ops):
+    prod = {}
+    for op in ops:
+        for names in op.outputs.values():
+            for n in names:
+                prod[n] = op
+    return prod
+
+
+def _consumer_counts(ops):
+    cnt: Dict[str, int] = {}
+    for op in ops:
+        for names in op.inputs.values():
+            for n in names:
+                cnt[n] = cnt.get(n, 0) + 1
+    return cnt
+
+
+@register_pass("embedding_eltwise_layernorm_fuse")
+def _emb_ln_fuse(program: Program, attrs: dict) -> Program:
+    """embedding_eltwise_layernorm_fuse_pass.cc: N lookup_table lookups
+    summed by elementwise_add and layer-normalized -> one
+    fused_embedding_eltwise_layernorm op (the BERT embedding block).
+
+    attrs["protected"]: var names that must keep their producers
+    (fetch targets — the Predictor passes its fetch list)."""
+    from .program import OpDesc
+    blk = program.global_block
+    ops = blk.ops
+    prod = _producer_map(ops)
+    cnt = _consumer_counts(ops)
+    protected = set(attrs.get("protected", ()))
+
+    def fusible(name):
+        # an intermediate may be deleted only if the fused op fully
+        # replaces it: one op-to-op consumer, not fetched
+        return cnt.get(name, 0) == 1 and name not in protected
+
+    def lookup_leaves(name, acc):
+        """Walk the elementwise_add tree feeding `name`; collect
+        (ids, emb) per lookup leaf, or return None if any leaf is not a
+        single-consumer lookup."""
+        op = prod.get(name)
+        if op is None:
+            return None
+        if op.type in ("lookup_table", "lookup_table_v2"):
+            if not fusible(name):
+                return None
+            acc.append((op.input("Ids")[0], op.input("W")[0], op))
+            return acc
+        if op.type == "elementwise_add" and fusible(name):
+            for side in (op.input("X")[0], op.input("Y")[0]):
+                if lookup_leaves(side, acc) is None:
+                    return None
+            acc.append((None, None, op))
+            return acc
+        return None
+
+    changed = True
+    while changed:
+        changed = False
+        for ln in ops:
+            if ln.type != "layer_norm":
+                continue
+            # the fused lowering normalizes the TRAILING dim of the
+            # rank-3 [B,S,H] embedding sum with an affine: anything
+            # else (default begin_norm_axis=1, scale/shift off) must
+            # stay unfused
+            if ln.attr("begin_norm_axis", 1) != 2 or \
+                    not ln.input("Scale") or not ln.input("Bias"):
+                continue
+            acc = lookup_leaves(ln.input("X")[0], [])
+            leaves = [(i, w) for i, w, _ in (acc or []) if i is not None]
+            if acc is None or len(leaves) < 2:
+                continue
+            dead = {id(op) for _, _, op in acc} | {id(ln)}
+            fused = OpDesc(
+                "fused_embedding_eltwise_layernorm",
+                {"Ids": [i for i, _ in leaves],
+                 "Embs": [w for _, w in leaves],
+                 "Scale": ln.input("Scale"), "Bias": ln.input("Bias")},
+                {"Out": ln.output("Y")},
+                {"epsilon": ln.attr("epsilon", 1e-5)})
+            idx = next(i for i, op in enumerate(ops) if id(op) == id(ln))
+            blk.ops = [op for op in ops[:idx] if id(op) not in dead] + \
+                [fused] + [op for op in ops[idx + 1:]
+                           if id(op) not in dead]
+            ops = blk.ops
+            prod = _producer_map(ops)
+            cnt = _consumer_counts(ops)
+            changed = True
+            break
+    return program
+
+
+def _match_proj(prod, t_op, input_name=None):
+    """transpose2([0,2,1,3]) <- reshape2([0,0,nh,d]) <-
+    elementwise_add(bias) <- mul(x, W). Returns (x, W, b, nh, d) or
+    None."""
+    if t_op is None or t_op.type != "transpose2" or \
+            list(t_op.attr("axis", [])) != [0, 2, 1, 3]:
+        return None
+    r_op = prod.get(t_op.input("X")[0])
+    if r_op is None or r_op.type != "reshape2":
+        return None
+    shape = list(r_op.attr("shape", []))
+    if len(shape) != 4:
+        return None
+    nh, d = shape[2], shape[3]
+    a_op = prod.get(r_op.input("X")[0])
+    if a_op is None or a_op.type != "elementwise_add":
+        return None
+    m_op = prod.get(a_op.input("X")[0])
+    if m_op is None or m_op.type != "mul":
+        return None
+    x = m_op.input("X")[0]
+    if input_name is not None and x != input_name:
+        return None
+    return (x, m_op.input("Y")[0], a_op.input("Y")[0], nh, d,
+            [t_op, r_op, a_op, m_op])
+
+
+@register_pass("multihead_matmul_fuse")
+def _multihead_fuse(program: Program, attrs: dict) -> Program:
+    """multihead_matmul_fuse_pass.cc: the canonical q/k/v mul+add ->
+    reshape2/transpose2 -> scaled matmul (+mask) -> softmax -> matmul
+    -> transpose2/reshape2 subgraph becomes ONE multihead_matmul op,
+    whose lowering runs the Pallas flash-attention kernel. The packed
+    [H,3,H] weight / [3H] bias the reference pass materializes on the
+    CPU are built here as in-graph reshape+concat ops — XLA constant-
+    folds them at compile time, so no scope access is needed."""
+    from .program import OpDesc
+    blk = program.global_block
+    protected = set(attrs.get("protected", ()))
+
+    def try_fuse():
+        ops = blk.ops
+        prod = _producer_map(ops)
+        cons: Dict[str, list] = {}
+        for op in ops:
+            for names in op.inputs.values():
+                for n in names:
+                    cons.setdefault(n, []).append(op)
+
+        def sole(name):
+            # deletable intermediate: exactly one op-to-op consumer and
+            # not a fetch target — a probs/activation tap anywhere in
+            # the subgraph keeps the whole pattern unfused
+            return len(cons.get(name, ())) == 1 and name not in protected
+
+        for sm in ops:
+            if sm.type != "softmax":
+                continue
+            pre = prod.get(sm.input("X")[0])
+            mask = None
+            dead_mask = []
+            if pre is not None and pre.type == "elementwise_add":
+                if not sole(pre.output("Out")[0]):
+                    continue
+                mask = pre.input("Y")[0]
+                dead_mask = [pre]
+                pre = prod.get(pre.input("X")[0])
+            if pre is None or pre.type != "matmul" or \
+                    not pre.attr("transpose_Y", False):
+                continue
+            alpha = pre.attr("alpha", 1.0)
+            q = _match_proj(prod, prod.get(pre.input("X")[0]))
+            k = _match_proj(prod, prod.get(pre.input("Y")[0]),
+                            input_name=q[0] if q else None)
+            if q is None or k is None:
+                continue
+            ctx_list = cons.get(sm.output("Out")[0], [])
+            if len(ctx_list) != 1 or ctx_list[0].type != "matmul":
+                continue
+            ctx = ctx_list[0]
+            v = _match_proj(prod, prod.get(ctx.input("Y")[0]),
+                            input_name=q[0])
+            if v is None:
+                continue
+            t2_list = cons.get(ctx.output("Out")[0], [])
+            if len(t2_list) != 1 or t2_list[0].type != "transpose2" or \
+                    list(t2_list[0].attr("axis", [])) != [0, 2, 1, 3]:
+                continue
+            t2 = t2_list[0]
+            r2_list = cons.get(t2.output("Out")[0], [])
+            if len(r2_list) != 1 or r2_list[0].type != "reshape2":
+                continue
+            r2 = r2_list[0]
+            x_name, nh, d = q[0], q[3], q[4]
+            if (k[3], k[4]) != (nh, d) or (v[3], v[4]) != (nh, d):
+                continue
+            # every matched op's output must be a deletable
+            # intermediate (q[5] etc = [transpose2, reshape2, add, mul])
+            matched = [sm, pre, ctx, t2] + q[5] + k[5] + v[5]
+            if not all(sole(o) for op in matched
+                       for o in op.output("Out")):
+                continue
+            H = nh * d
+
+            def tmp(suffix, shape):
+                name = program._unique_name("mha_fuse_" + suffix)
+                blk.create_var(name, shape=list(shape), dtype="float32",
+                               stop_gradient=True)
+                return name
+
+            new_ops = []
+            packed_w = []
+            for tag, (_, w, _b, *_rest) in (("q", q), ("k", k), ("v", v)):
+                rw = tmp(tag + "_w3", (H, 1, H))
+                xs = tmp(tag + "_w3_xs", (0,))
+                new_ops.append(OpDesc("reshape2", {"X": [w]},
+                                      {"Out": [rw], "XShape": [xs]},
+                                      {"shape": [H, 1, H]}))
+                packed_w.append(rw)
+            w_all = tmp("w", (H, 3, H))
+            new_ops.append(OpDesc("concat", {"X": packed_w},
+                                  {"Out": [w_all]}, {"axis": 1}))
+            b_all = tmp("b", (3 * H,))
+            new_ops.append(OpDesc("concat", {"X": [q[2], k[2], v[2]]},
+                                  {"Out": [b_all]}, {"axis": 0}))
+            fused_inputs = {"Input": [x_name], "W": [w_all],
+                            "Bias": [b_all]}
+            if mask is not None:
+                fused_inputs["BiasQK"] = [mask]
+            new_ops.append(OpDesc(
+                "multihead_matmul", fused_inputs,
+                {"Out": r2.output("Out")},
+                {"head_number": nh, "alpha": alpha}))
+
+            dead = {id(o) for o in ([sm, pre, ctx, t2, r2] + dead_mask +
+                                    q[5] + k[5] + v[5])}
+            idx = next(i for i, op in enumerate(ops)
+                       if id(op) == id(r2))
+            blk.ops = [op for op in ops[:idx]
+                       if id(op) not in dead] + new_ops + \
+                [op for op in ops[idx + 1:] if id(op) not in dead]
+            return True  # rewrote one head; caller rescans
+        return False
+
+    while try_fuse():
+        pass
+    return program

@@ -23,7 +23,8 @@ from .core.scope import Scope
 from . import io as _io
 
 __all__ = ["Config", "AnalysisConfig", "Predictor", "create_predictor",
-           "PredictorTensor", "PassStrategy", "TpuPassStrategy"]
+           "PredictorTensor", "PassStrategy", "TpuPassStrategy",
+           "SerializedPredictor"]
 
 
 class PassStrategy:
@@ -52,13 +53,17 @@ class PassStrategy:
 class TpuPassStrategy(PassStrategy):
     """Default TPU pipeline. The reference GPU order
     (paddle_pass_builder.cc:104: is_test -> conv/bn + attention +
-    fc fusions -> runtime cache) keeps only its SEMANTIC members here —
-    eval-mode cleanup and the fusion markers — because XLA performs the
-    instruction-level fusions (conv+bias+act, fc, attention epilogues)
-    during compilation."""
+    fc fusions -> runtime cache) keeps its SEMANTIC members here —
+    eval-mode cleanup plus the two subgraph fusions XLA cannot recover
+    from the op graph (attention -> Pallas flash kernel, BERT embedding
+    block -> one fused lookup+layernorm) — while the instruction-level
+    fusions (conv+bias+act, fc, epilogues) stay XLA's job."""
 
     def __init__(self):
-        super().__init__(["drop_dropout_eval", "fuse_elewise_add_act"])
+        super().__init__(["drop_dropout_eval",
+                          "embedding_eltwise_layernorm_fuse",
+                          "multihead_matmul_fuse",
+                          "fuse_elewise_add_act"])
 
 
 class Config:
@@ -139,7 +144,10 @@ class Predictor:
         if config._ir_optim:
             from .core.passes import apply_pass
             for name in config.pass_builder().passes():
-                self.program = apply_pass(self.program, name)
+                # fetch targets must keep their producers through any
+                # subgraph-deleting fusion
+                self.program = apply_pass(self.program, name,
+                                          protected=set(self.fetch_names))
         if config._bf16:
             self._cast_params_bf16()
         self._feeds: Dict[str, np.ndarray] = {}
@@ -183,6 +191,86 @@ class Predictor:
                             scope=self.scope)
         self._outputs = dict(zip(self.fetch_names, outs))
         return [self._outputs[n] for n in self.fetch_names]
+
+    # --- AOT serving artifact ------------------------------------------
+    def export_serialized(self, path: str, example_feeds: Sequence):
+        """Serialize the pass-optimized, traced computation as a serving
+        artifact: params (npz) + jax.export StableHLO bytes per entry
+        signature. A second process serves it via SerializedPredictor
+        WITHOUT the Program IR, the op registry, or Python re-tracing —
+        the analog of the reference's save-optimized-model +
+        serialized-engine flow (analysis_predictor.cc
+        SaveOptimModel:900; TRT engine serialization). XLA's own binary
+        compilation of the deserialized StableHLO is cached by the
+        jit compilation cache, the reference's runtime-context-cache
+        analog."""
+        import jax
+        import jax.export
+
+        if len(example_feeds) != len(self.feed_names):
+            raise ValueError("expected %d example feeds (%s), got %d"
+                             % (len(self.feed_names), self.feed_names,
+                                len(example_feeds)))
+        feeds = {n: np.asarray(v)
+                 for n, v in zip(self.feed_names, example_feeds)}
+        state = {v.name: np.asarray(self.scope.find_var(v.name))
+                 for v in self.program.persistable_vars()
+                 if self.scope.has(v.name)}
+
+        def fwd(state, feeds):
+            from .core.executor import _BlockLowerer
+            from .core.registry import LowerCtx
+            import jax.numpy as jnp
+            env = dict(state)
+            env.update(feeds)
+            lowerer = _BlockLowerer(self.program, LowerCtx(
+                jax.random.PRNGKey(0), is_test=True))
+            lowerer.run_ops(self.program.global_block.ops, env,
+                            initial_env=dict(env),
+                            initial_key=jax.random.PRNGKey(0))
+            return [env[n] for n in self.fetch_names]
+
+        exported = jax.export.export(jax.jit(fwd))(state, feeds)
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "model.stablehlo"), "wb") as f:
+            f.write(exported.serialize())
+        np.savez(os.path.join(path, "params.npz"), **state)
+        import json
+        with open(os.path.join(path, "signature.json"), "w") as f:
+            json.dump({"feed_names": list(self.feed_names),
+                       "fetch_names": list(self.fetch_names)}, f)
+
+
+class SerializedPredictor:
+    """Serve an export_serialized() artifact: no Program, no registry,
+    no re-trace — deserialize the StableHLO and call."""
+
+    def __init__(self, path: str):
+        import json
+        import jax.export
+        with open(os.path.join(path, "model.stablehlo"), "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        sig = json.load(open(os.path.join(path, "signature.json")))
+        self.feed_names = sig["feed_names"]
+        self.fetch_names = sig["fetch_names"]
+        loaded = np.load(os.path.join(path, "params.npz"))
+        self._state = {k: loaded[k] for k in loaded.files}
+
+    def get_input_names(self):
+        return list(self.feed_names)
+
+    def get_output_names(self):
+        return list(self.fetch_names)
+
+    def run(self, feeds: Sequence[np.ndarray]):
+        if len(feeds) != len(self.feed_names):
+            raise ValueError("expected %d feeds (%s), got %d"
+                             % (len(self.feed_names), self.feed_names,
+                                len(feeds)))
+        feed_map = {n: np.asarray(v)
+                    for n, v in zip(self.feed_names, feeds)}
+        outs = self._exported.call(self._state, feed_map)
+        return [np.asarray(o) for o in outs]
 
 
 def create_predictor(config: Config) -> Predictor:

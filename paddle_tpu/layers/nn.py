@@ -975,3 +975,46 @@ def is_empty(x: VarDesc, name: Optional[str] = None) -> VarDesc:
 def rank(input: VarDesc) -> VarDesc:
     """layers.rank (nn.py:11587): static rank as a 0-d int constant."""
     return fill_constant([1], value=len(input.shape), dtype="int32")
+
+
+def multi_head_attention(queries: VarDesc, num_heads: int,
+                         attn_mask: Optional[VarDesc] = None,
+                         param_prefix: Optional[str] = None,
+                         name: Optional[str] = None) -> VarDesc:
+    """Canonical UNFUSED self-attention subgraph: three mul+add
+    projections, reshape2/transpose2 into heads, scaled q@k^T (+mask),
+    softmax, @v, transpose2/reshape2 back — exactly the op pattern the
+    reference's multihead_matmul_fuse_pass matches
+    (/root/reference/paddle/fluid/framework/ir/multihead_matmul_fuse_pass.cc)
+    and this repo's `multihead_matmul_fuse` IR pass rewrites onto the
+    fused flash-attention op. queries: [B, S, H]."""
+    import math as _math
+    helper = LayerHelper(param_prefix or "mha", name)
+    B_S_H = queries.shape
+    H = int(B_S_H[-1])
+    assert H % num_heads == 0, (H, num_heads)
+    d = H // num_heads
+
+    def proj(tag):
+        w = helper.create_parameter(None, [H, H], queries.dtype)
+        b = helper.create_parameter(None, [H], queries.dtype,
+                                    is_bias=True)
+        out = mul(queries, w, x_num_col_dims=2)
+        return elementwise_add(out, b), w, b
+
+    q, wq, bq = proj("q")
+    k, wk, bk = proj("k")
+    v, wv, bv = proj("v")
+
+    def heads(x):
+        return transpose(reshape(x, [0, 0, num_heads, d]), [0, 2, 1, 3])
+
+    qh, kh, vh = heads(q), heads(k), heads(v)
+    score = matmul(qh, kh, transpose_y=True,
+                   alpha=1.0 / _math.sqrt(d))
+    if attn_mask is not None:
+        score = elementwise_add(score, attn_mask)
+    weights = softmax(score)
+    ctx = matmul(weights, vh)
+    ctx = transpose(ctx, [0, 2, 1, 3])
+    return reshape(ctx, [0, 0, H])
