@@ -166,6 +166,11 @@ def _emb_ln_fuse(program: Program, attrs: dict) -> Program:
         if op.type in ("lookup_table", "lookup_table_v2"):
             if not fusible(name):
                 return None
+            # the fused lowering has no padding_idx handling: a lookup
+            # that zeroes padding rows must stay unfused or outputs
+            # silently change
+            if op.attr("padding_idx", -1) not in (-1, None):
+                return None
             acc.append((op.input("Ids")[0], op.input("W")[0], op))
             return acc
         if op.type == "elementwise_add" and fusible(name):
@@ -188,6 +193,11 @@ def _emb_ln_fuse(program: Program, attrs: dict) -> Program:
             # stay unfused
             if ln.attr("begin_norm_axis", 1) != 2 or \
                     not ln.input("Scale") or not ln.input("Bias"):
+                continue
+            # the fused op has no Mean/Variance outputs: a consumed or
+            # fetched saved-stat keeps the pattern unfused
+            stats = ln.output("Mean") + ln.output("Variance")
+            if any(cnt.get(nm, 0) > 0 or nm in protected for nm in stats):
                 continue
             acc = lookup_leaves(ln.input("X")[0], [])
             leaves = [(i, w) for i, w, _ in (acc or []) if i is not None]
@@ -231,7 +241,8 @@ def _match_proj(prod, t_op, input_name=None):
     if a_op is None or a_op.type != "elementwise_add":
         return None
     m_op = prod.get(a_op.input("X")[0])
-    if m_op is None or m_op.type != "mul":
+    if m_op is None or m_op.type != "mul" or \
+            m_op.attr("x_num_col_dims", 1) != 2:
         return None
     x = m_op.input("X")[0]
     if input_name is not None and x != input_name:
@@ -281,7 +292,8 @@ def _multihead_fuse(program: Program, attrs: dict) -> Program:
                 dead_mask = [pre]
                 pre = prod.get(pre.input("X")[0])
             if pre is None or pre.type != "matmul" or \
-                    not pre.attr("transpose_Y", False):
+                    not pre.attr("transpose_Y", False) or \
+                    pre.attr("transpose_X", False):
                 continue
             alpha = pre.attr("alpha", 1.0)
             q = _match_proj(prod, prod.get(pre.input("X")[0]))
@@ -293,6 +305,12 @@ def _multihead_fuse(program: Program, attrs: dict) -> Program:
             if len(ctx_list) != 1 or ctx_list[0].type != "matmul":
                 continue
             ctx = ctx_list[0]
+            # probs @ V must be a plain matmul: a non-default alpha or
+            # transpose has no slot in the fused op — skip, don't corrupt
+            if ctx.attr("alpha", 1.0) != 1.0 or \
+                    ctx.attr("transpose_X", False) or \
+                    ctx.attr("transpose_Y", False):
+                continue
             v = _match_proj(prod, prod.get(ctx.input("Y")[0]),
                             input_name=q[0])
             if v is None:

@@ -4,6 +4,8 @@ from .communicator import (Communicator, AsyncCommunicator,  # noqa: F401
                            GeoCommunicator, HalfAsyncCommunicator,
                            ParamServer, SyncCommunicator)
 from .ps_worker import DownpourWorker, HeterWorker  # noqa: F401
+from .heter_service import (HeterClient, HeterCpuWorker,  # noqa: F401
+                            HeterService)
 from .pslib_desc import (DownpourDescriptor, DownpourServerDesc,  # noqa: F401
                          DownpourWorkerDesc, SparseTableDesc)
 from .multi_trainer import (MultiTrainer, recompute,  # noqa: F401

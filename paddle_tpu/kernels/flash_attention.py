@@ -44,6 +44,22 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _drop_keep_tile(seed_ref, qi, ki, shape, keep_prob):
+    """In-kernel attention-probs dropout tile: seed the per-core PRNG
+    with (base_seed, b, h, q_tile, k_tile) so every kernel (forward, dQ,
+    dK/dV) regenerates the IDENTICAL keep pattern for a tile without any
+    [B,H,Sq,Sk] mask in HBM — the hardware-PRNG analog of the rbg8
+    trick in ops/nn dropout. Returns keep/keep_prob (0 or 1/keep_prob),
+    ready to multiply into the probs."""
+    pltpu.prng_seed(seed_ref[0, 0], pl.program_id(0), pl.program_id(1),
+                    qi, ki)
+    bits = pltpu.prng_random_bits(shape)
+    bits = jax.lax.bitcast_convert_type(bits, jnp.uint32)
+    thresh = jnp.uint32(min(int((1.0 - keep_prob) * 4294967296.0),
+                            4294967295))
+    return jnp.where(bits >= thresh, 1.0 / keep_prob, 0.0)
+
+
 # ---------------------------------------------------------------------------
 # reference (composed) implementation — CPU path and test oracle
 # ---------------------------------------------------------------------------
@@ -82,8 +98,9 @@ def attention_reference(q, k, v, bias=None, causal=False, sm_scale=None,
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, drop_ref, o_ref, lse_ref, *,
-                sm_scale, causal, block_k, sk, sq_total, keep_prob):
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, drop_ref, seed_ref, o_ref,
+                lse_ref, *, sm_scale, causal, block_k, sk, sq_total,
+                keep_prob):
     # blocks: q [1,1,bq,d]; k/v [1,1,sk,d]; bias [1,1,bq|1,sk] or None;
     # drop (keep-mask) [1,1,bq,sk] or None; value-indexed with [0, 0, ...]
     # (ref views of <128-lane dims don't lower on Mosaic)
@@ -128,6 +145,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, drop_ref, o_ref, lse_ref, *,
             dm = drop_ref[0, 0, :, pl.ds(ki * block_k, block_k)] \
                 .astype(jnp.float32)
             p_acc = p * dm * (1.0 / keep_prob)
+        elif seed_ref is not None:
+            p_acc = p * _drop_keep_tile(seed_ref, qi, ki,
+                                        (bq, block_k), keep_prob)
         else:
             p_acc = p
         acc = acc * alpha[:, None] + jax.lax.dot_general(
@@ -170,8 +190,8 @@ def _bias_spec(bias, b_axis, h_axis, blk_q, sk, block_q_axis=2):
     return pl.BlockSpec(blk, idx)
 
 
-def _fwd(q, k, v, bias, drop_mask, causal, sm_scale, block_q, block_k,
-         interpret, keep_prob):
+def _fwd(q, k, v, bias, drop_mask, drop_seed, causal, sm_scale, block_q,
+         block_k, interpret, keep_prob):
     batch, heads, sq, d = q.shape
     sk = k.shape[2]
     blk_q = min(block_q, sq)
@@ -192,15 +212,19 @@ def _fwd(q, k, v, bias, drop_mask, causal, sm_scale, block_q, block_k,
         in_specs.append(
             pl.BlockSpec((1, 1, blk_q, sk), lambda b, h, i: (b, h, i, 0)))
         args.append(drop_mask)
+    if drop_seed is not None:
+        in_specs.append(pl.BlockSpec((1, 1), lambda b, h, i: (0, 0)))
+        args.append(drop_seed)
 
     def kern(q_ref, k_ref, v_ref, *rest):
         rest = list(rest)
         b_ref = rest.pop(0) if bias is not None else None
         dm_ref = rest.pop(0) if drop_mask is not None else None
+        s_ref = rest.pop(0) if drop_seed is not None else None
         o_ref, lse_ref = rest
-        _fwd_kernel(q_ref, k_ref, v_ref, b_ref, dm_ref, o_ref, lse_ref,
-                    sm_scale=sm_scale, causal=causal, block_k=blk_k, sk=sk,
-                    sq_total=sq, keep_prob=keep_prob)
+        _fwd_kernel(q_ref, k_ref, v_ref, b_ref, dm_ref, s_ref, o_ref,
+                    lse_ref, sm_scale=sm_scale, causal=causal, block_k=blk_k,
+                    sk=sk, sq_total=sq, keep_prob=keep_prob)
 
     # lse carries a trailing singleton dim: Mosaic requires the last two
     # block dims to be (8k, 128m) or equal to the array dims
@@ -231,9 +255,9 @@ def _fwd(q, k, v, bias, drop_mask, causal, sm_scale, block_q, block_k,
 # backward kernels
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, drop_ref, do_ref, lse_ref,
-                   delta_ref, dq_ref, *, sm_scale, causal, block_k, sk,
-                   sq_total, keep_prob):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, drop_ref, seed_ref,
+                   do_ref, lse_ref, delta_ref, dq_ref, *, sm_scale, causal,
+                   block_k, sk, sq_total, keep_prob):
     bq, d = q_ref.shape[2], q_ref.shape[3]
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)
@@ -269,6 +293,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, drop_ref, do_ref, lse_ref,
             dm = drop_ref[0, 0, :, pl.ds(ki * block_k, block_k)] \
                 .astype(jnp.float32)
             dp = dp * dm * (1.0 / keep_prob)
+        elif seed_ref is not None:
+            dp = dp * _drop_keep_tile(seed_ref, qi, ki, (bq, block_k),
+                                      keep_prob)
         ds = p * (dp - delta[:, None]) * sm_scale
         return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
@@ -277,9 +304,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, drop_ref, do_ref, lse_ref,
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, drop_ref, do_ref, lse_ref,
-                    delta_ref, dk_ref, dv_ref, *, sm_scale, causal,
-                    block_q, sq, sk_total, keep_prob):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, drop_ref, seed_ref,
+                    do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, sm_scale,
+                    causal, block_q, sq, sk_total, keep_prob):
     bk, d = k_ref.shape[2], k_ref.shape[3]
     ki = pl.program_id(2)
     k = k_ref[0, 0].astype(jnp.float32)
@@ -315,14 +342,23 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, drop_ref, do_ref, lse_ref,
             dm = drop_ref[0, 0, pl.ds(qi * block_q, block_q), :] \
                 .astype(jnp.float32) * (1.0 / keep_prob)
             p_drop = p * dm
+        elif seed_ref is not None:
+            # NOTE tile coords: this kernel's (qi, ki) are (loop index,
+            # grid index) — the same absolute (q-tile, k-tile) pair the
+            # forward used, and block_q/bk here equal the forward's
+            # (blk_q, blk_k), so the regenerated pattern is identical
+            dm = _drop_keep_tile(seed_ref, qi, ki, (block_q, bk),
+                                 keep_prob)
+            p_drop = p * dm
         else:
+            dm = None
             p_drop = p
         dv_new = dv + jax.lax.dot_general(
             p_drop, do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        if drop_ref is not None:
+        if drop_ref is not None or seed_ref is not None:
             dp = dp * dm
         ds = p * (dp - delta_blk[:, None]) * sm_scale
         dk_new = dk + jax.lax.dot_general(
@@ -337,7 +373,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, drop_ref, do_ref, lse_ref,
 
 
 def _bwd(causal, sm_scale, block_q, block_k, interpret, keep_prob, res, g):
-    q, k, v, bias, drop_mask, o, lse = res
+    q, k, v, bias, drop_mask, drop_seed, o, lse = res
     do = g
     batch, heads, sq, d = q.shape
     sk = k.shape[2]
@@ -357,6 +393,9 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, keep_prob, res, g):
     # ---- dQ: grid over q blocks
     in_specs = [qspec, kfull, kfull, qspec, lse_blk, lse_blk]
     args = [q, k, v, do, lse4, delta4]
+    if drop_seed is not None:
+        in_specs.insert(3, pl.BlockSpec((1, 1), lambda b, h, i: (0, 0)))
+        args.insert(3, drop_seed)
     if drop_mask is not None:
         in_specs.insert(3, pl.BlockSpec((1, 1, blk_q, sk),
                                         lambda b, h, i: (b, h, i, 0)))
@@ -371,9 +410,10 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, keep_prob, res, g):
         rest = refs[3:]
         b_r = rest.pop(0) if bias is not None else None
         dm_r = rest.pop(0) if drop_mask is not None else None
+        s_r = rest.pop(0) if drop_seed is not None else None
         do_r, lse_r, dl_r, dq_r = rest
-        _bwd_dq_kernel(q_r, k_r, v_r, b_r, dm_r, do_r, lse_r, dl_r, dq_r,
-                       sm_scale=sm_scale, causal=causal,
+        _bwd_dq_kernel(q_r, k_r, v_r, b_r, dm_r, s_r, do_r, lse_r, dl_r,
+                       dq_r, sm_scale=sm_scale, causal=causal,
                        block_k=blk_k, sk=sk, sq_total=sq,
                        keep_prob=keep_prob)
 
@@ -389,6 +429,9 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, keep_prob, res, g):
     # ---- dK/dV: grid over k blocks
     in_specs2 = [qfull, kspec, kspec, qfull, lse_full, lse_full]
     args2 = [q, k, v, do, lse4, delta4]
+    if drop_seed is not None:
+        in_specs2.insert(3, pl.BlockSpec((1, 1), lambda b, h, i: (0, 0)))
+        args2.insert(3, drop_seed)
     if drop_mask is not None:
         in_specs2.insert(3, pl.BlockSpec((1, 1, sq, blk_k),
                                          lambda b, h, i: (b, h, 0, i)))
@@ -410,8 +453,9 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, keep_prob, res, g):
         rest = refs[3:]
         b_r = rest.pop(0) if bias is not None else None
         dm_r = rest.pop(0) if drop_mask is not None else None
+        s_r = rest.pop(0) if drop_seed is not None else None
         do_r, lse_r, dl_r, dk_r, dv_r = rest
-        _bwd_dkv_kernel(q_r, k_r, v_r, b_r, dm_r, do_r, lse_r, dl_r,
+        _bwd_dkv_kernel(q_r, k_r, v_r, b_r, dm_r, s_r, do_r, lse_r, dl_r,
                         dk_r, dv_r,
                         sm_scale=sm_scale, causal=causal, block_q=blk_q,
                         sq=sq, sk_total=sk, keep_prob=keep_prob)
@@ -491,28 +535,31 @@ def _supported(q, k, sq, sk, d, blk_q, blk_k):
             d % 8 == 0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
-def _flash(q, k, v, bias, drop_mask, causal, sm_scale, block_q, block_k,
-           interpret, keep_prob):
-    o, _ = _fwd(q, k, v, bias, drop_mask, causal, sm_scale, block_q,
-                block_k, interpret, keep_prob)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, bias, drop_mask, drop_seed, causal, sm_scale, block_q,
+           block_k, interpret, keep_prob):
+    o, _ = _fwd(q, k, v, bias, drop_mask, drop_seed, causal, sm_scale,
+                block_q, block_k, interpret, keep_prob)
     return o
 
 
-def _flash_fwd(q, k, v, bias, drop_mask, causal, sm_scale, block_q, block_k,
-               interpret, keep_prob):
-    o, lse = _fwd(q, k, v, bias, drop_mask, causal, sm_scale, block_q,
-                  block_k, interpret, keep_prob)
-    return o, (q, k, v, bias, drop_mask, o, lse)
+def _flash_fwd(q, k, v, bias, drop_mask, drop_seed, causal, sm_scale,
+               block_q, block_k, interpret, keep_prob):
+    o, lse = _fwd(q, k, v, bias, drop_mask, drop_seed, causal, sm_scale,
+                  block_q, block_k, interpret, keep_prob)
+    return o, (q, k, v, bias, drop_mask, drop_seed, o, lse)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, keep_prob,
                res, g):
     dq, dk, dv, dbias = _bwd(causal, sm_scale, block_q, block_k, interpret,
                              keep_prob, res, g)
-    drop_mask = res[4]
+    drop_mask, drop_seed = res[4], res[5]
     ddrop = None if drop_mask is None else jnp.zeros_like(drop_mask)
-    return dq, dk, dv, dbias, ddrop
+    # integer seed: float0 tangent (non-differentiable input)
+    dseed = None if drop_seed is None else \
+        jnp.zeros(drop_seed.shape, jax.dtypes.float0)
+    return dq, dk, dv, dbias, ddrop, dseed
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -571,8 +618,19 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
             bias = jnp.broadcast_to(
                 bias, bias.shape[:3] + (sk,))
     drop_mask = None
+    drop_seed = None
     if want_drop:
-        drop_mask = dropout_keep_mask(
-            dropout_rng, dropout_rate, (batch, heads, sq, sk), q.dtype)
-    return _flash(q, k, v, bias, drop_mask, causal, sm_scale, block_q,
-                  block_k, _use_interpret(), keep_prob)
+        if bias is None and not _use_interpret() and _HAS_PLTPU:
+            # in-kernel hardware-PRNG dropout: no [B,H,Sq,Sk] mask in
+            # HBM at all. Constrained to bias=None because the dbias
+            # blockwise-recompute path (plain XLA, outside Pallas)
+            # cannot regenerate the in-kernel pattern.
+            import numpy as _np
+            drop_seed = jax.random.randint(
+                dropout_rng, (1, 1), 0, _np.iinfo(_np.int32).max,
+                dtype=jnp.int32)
+        else:
+            drop_mask = dropout_keep_mask(
+                dropout_rng, dropout_rate, (batch, heads, sq, sk), q.dtype)
+    return _flash(q, k, v, bias, drop_mask, drop_seed, causal, sm_scale,
+                  block_q, block_k, _use_interpret(), keep_prob)

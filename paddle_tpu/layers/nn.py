@@ -996,9 +996,10 @@ def multi_head_attention(queries: VarDesc, num_heads: int,
     d = H // num_heads
 
     def proj(tag):
-        w = helper.create_parameter(None, [H, H], queries.dtype)
-        b = helper.create_parameter(None, [H], queries.dtype,
-                                    is_bias=True)
+        w = helper.create_parameter(helper.unique_name(tag + "_w"),
+                                    [H, H], queries.dtype)
+        b = helper.create_parameter(helper.unique_name(tag + "_b"),
+                                    [H], queries.dtype, is_bias=True)
         out = mul(queries, w, x_num_col_dims=2)
         return elementwise_add(out, b), w, b
 

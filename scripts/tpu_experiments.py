@@ -1,0 +1,76 @@
+"""Run when the TPU tunnel returns: bench + BERT breakdown + scatter cost."""
+import time, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+def timeit(f, *a, n=10):
+    float(jnp.sum(jax.tree_util.tree_leaves(f(*a))[0].astype(jnp.float32)))
+    t0=time.time()
+    for _ in range(n): r=f(*a)
+    float(jnp.sum(jax.tree_util.tree_leaves(r)[0].astype(jnp.float32)))
+    return (time.time()-t0)/n
+
+# 1. embedding-grad strategies at BERT scale
+V, H, N = 30522, 768, 16384
+ids = jax.device_put(np.random.randint(0, V, (N,)).astype(np.int32))
+g = jax.device_put((np.random.randn(N, H)*0.01).astype(np.bfloat16))
+
+@jax.jit
+def scatter_grad(ids, g):
+    z = jnp.zeros((V, H), jnp.float32)
+    return z.at[ids].add(g.astype(jnp.float32))
+
+@jax.jit
+def onehot_grad(ids, g):
+    oh = jax.nn.one_hot(ids, V, dtype=jnp.bfloat16)  # [N, V]
+    return jax.lax.dot_general(oh, g, (((0,),(0,)),((),())),
+                               preferred_element_type=jnp.float32)
+
+print("scatter dW: %.2fms" % (timeit(scatter_grad, ids, g)*1e3))
+print("one-hot dW: %.2fms" % (timeit(onehot_grad, ids, g)*1e3))
+
+# 2. flash crossover at long S (small n to be quick)
+from paddle_tpu.kernels.flash_attention import flash_attention
+Hh, D = 12, 64
+for S, B in [(1024, 16), (2048, 8)]:
+    q = jnp.asarray(np.random.randn(B,Hh,S,D)*0.1, jnp.bfloat16)
+    k = jnp.asarray(np.random.randn(B,Hh,S,D)*0.1, jnp.bfloat16)
+    v = jnp.asarray(np.random.randn(B,Hh,S,D)*0.1, jnp.bfloat16)
+    @jax.jit
+    def ffb(q,k,v):
+        def loss(q,k,v):
+            return jnp.sum(flash_attention(q,k,v, sm_scale=0.125).astype(jnp.float32))
+        return jax.grad(loss, argnums=(0,1,2))(q,k,v)[0]
+    @jax.jit
+    def cfb(q,k,v):
+        def loss(q,k,v):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k)*0.125
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, v).astype(jnp.float32))
+        return jax.grad(loss, argnums=(0,1,2))(q,k,v)[0]
+    tf = timeit(ffb,q,k,v,n=5); tc = timeit(cfb,q,k,v,n=5)
+    print("S=%4d: flash %.2fms composed %.2fms ratio %.2f" % (S,tf*1e3,tc*1e3,tf/tc))
+
+# 3. BERT step at B=32 and B=64 with current code
+import paddle_tpu as pt
+from paddle_tpu.models.bert import BertConfig, BertForPretraining, pretraining_loss
+from paddle_tpu.jit import TrainStep
+for B in (32, 64):
+    cfg = BertConfig()
+    S, M = 512, 80
+    model = BertForPretraining(cfg)
+    opt = pt.optimizer.Adam(1e-4, parameters=model.parameters())
+    step = TrainStep(model, pretraining_loss, opt, amp_dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    ids = jax.device_put(rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
+    pos = jax.device_put(np.stack([rng.choice(S, M, replace=False) for _ in range(B)]).astype(np.int32))
+    mlm = jax.device_put(np.take_along_axis(np.asarray(ids), np.asarray(pos), 1).astype(np.int32))
+    nsp = jax.device_put(rng.randint(0, 2, (B, 1)).astype(np.int32))
+    inputs = (ids, None, None, pos); labels = (mlm, nsp)
+    for _ in range(2): float(step(inputs, labels))
+    t0=time.time(); n=15
+    for _ in range(n): loss = step(inputs, labels)
+    float(loss); dt=(time.time()-t0)/n
+    Hd, L, Vv, I = 768, 12, 30522, 3072
+    fl = (6*L*(4*Hd*Hd+2*Hd*I) + 12*L*Hd*S)*B*S + (6*(Hd*Hd+Hd*Vv)*M+6*(Hd*Hd+2*Hd))*B
+    print("BERT B=%d: %.1fms %.0f tok/s mfu=%.3f" % (B, dt*1e3, B*S/dt, fl/dt/197e12))

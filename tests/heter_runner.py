@@ -1,0 +1,38 @@
+"""Accelerator-side process for the heter service test: hosts a
+HeterService around a jitted dense logistic-regression stage."""
+import json
+import sys
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from paddle_tpu.distributed import HeterService  # noqa: E402
+
+
+def main():
+    port = sys.argv[1]
+    @jax.jit
+    def step(rows, y):
+        def loss_fn(rows):
+            logit = rows.sum(axis=(1, 2))
+            p = jax.nn.sigmoid(logit)
+            return -jnp.mean(y * jnp.log(p + 1e-7)
+                             + (1 - y) * jnp.log(1 - p + 1e-7))
+        loss, g = jax.value_and_grad(loss_fn)(rows)
+        return loss, g
+
+    def dense_fn(feeds):
+        loss, g_rows = step(jnp.asarray(feeds["rows"]),
+                            jnp.asarray(feeds["y"]))
+        return {"loss": np.asarray(loss), "row_grads": np.asarray(g_rows)}
+
+    svc = HeterService(dense_fn, ["loss", "row_grads"],
+                       endpoint="127.0.0.1:%s" % port)
+    print(json.dumps({"endpoint": svc.endpoint}), flush=True)
+    svc.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -380,3 +380,38 @@ def test_fuse_passes_respect_taps_and_protected():
     apply_pass(fused2, "multihead_matmul_fuse")
     assert "multihead_matmul" in \
         [op.type for op in fused2.global_block.ops]
+
+
+def test_fuse_passes_skip_unsupported_variants():
+    """padding_idx lookups, non-default probs@V alpha, consumed
+    layer_norm stats: all must skip fusion (silent-corruption guards)."""
+    from paddle_tpu.core.passes import apply_pass
+    # padding_idx embedding stays unfused
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        a = pt.layers.data("a", [4, 1], dtype="int64")
+        b = pt.layers.data("b", [4, 1], dtype="int64")
+        s = pt.layers.elementwise_add(
+            pt.layers.embedding(a, size=[10, 8], padding_idx=0),
+            pt.layers.embedding(b, size=[10, 8]))
+        pt.layers.layer_norm(s, begin_norm_axis=2)
+    apply_pass(main, "embedding_eltwise_layernorm_fuse")
+    assert "fused_embedding_eltwise_layernorm" not in \
+        [op.type for op in main.global_block.ops]
+
+    # consumed layer_norm Mean keeps the pattern unfused
+    main2, startup2 = pt.Program(), pt.Program()
+    with pt.program_guard(main2, startup2):
+        a = pt.layers.data("a", [4, 1], dtype="int64")
+        b = pt.layers.data("b", [4, 1], dtype="int64")
+        s = pt.layers.elementwise_add(
+            pt.layers.embedding(a, size=[10, 8]),
+            pt.layers.embedding(b, size=[10, 8]))
+        pt.layers.layer_norm(s, begin_norm_axis=2)
+        mean_name = next(op.output("Mean")[0]
+                         for op in main2.global_block.ops
+                         if op.type == "layer_norm")
+    apply_pass(main2, "embedding_eltwise_layernorm_fuse",
+               protected={mean_name})
+    assert "fused_embedding_eltwise_layernorm" not in \
+        [op.type for op in main2.global_block.ops]

@@ -387,3 +387,61 @@ def test_pslib_descriptor_drives_wide_deep_ctr():
         deep_v = deep_v - 0.1 * g_v
         losses.append(float(l))
     assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.6, losses[:5]
+
+
+# ---------------------------------------------------------------------------
+# heter service split (heterxpu_trainer.cc RegisterServiceHandler +
+# hetercpu_worker.cc): sparse stage in THIS process, dense stage in a
+# real accelerator-service subprocess over the framed-socket wire
+# ---------------------------------------------------------------------------
+
+def test_heter_service_two_process_training():
+    import os
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    runner = os.path.join(os.path.dirname(__file__), "heter_runner.py")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen([sys.executable, runner, str(port)],
+                            stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        line = proc.stdout.readline()
+        assert line, "service died on startup"
+        from paddle_tpu.distributed import (HeterClient, HeterCpuWorker,
+                                            ParamServer)
+        client = HeterClient("127.0.0.1:%d" % port)
+        assert client.output_names == ["loss", "row_grads"]
+
+        server = ParamServer()
+        server.create_sparse_table(SparseTableConfig(
+            name="emb", dim=4, initializer="gaussian", init_scale=0.1,
+            optimizer="adagrad", lr=0.5, seed=0))
+        worker = HeterCpuWorker(server, "emb", client)
+
+        rng = np.random.RandomState(0)
+        true_w = rng.randn(100) * 2
+        losses = []
+        for i in range(50):
+            ids = rng.randint(0, 100, (32, 3))
+            y = (true_w[ids].sum(1) > 0).astype(np.float32)
+            loss = worker.train_batch(ids, {"y": y})
+            losses.append(float(np.asarray(loss)))
+        client.end_pass()
+        client.stop()
+        assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.6, \
+            losses[:5]
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
