@@ -98,3 +98,4 @@ from .dygraph.tape import no_grad  # noqa: F401
 from . import distribution  # noqa: F401
 from . import datasets  # noqa: F401
 from . import vision_transforms  # noqa: F401
+from .async_executor import AsyncExecutor  # noqa: F401,E402

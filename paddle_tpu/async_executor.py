@@ -1,0 +1,56 @@
+"""Legacy AsyncExecutor facade.
+
+Analog of /root/reference/paddle/fluid/framework/async_executor.h:63
+(AsyncExecutor::RunFromFile: spin up per-thread DataFeeds + ExecutorThreadWorkers
+over a filelist and drain it through the program). The reference itself
+superseded this class with the Trainer/Dataset path
+(Executor.train_from_dataset); this facade keeps the legacy call shape
+alive by building a QueueDataset from the DataFeedDesc + filelist and
+delegating to exactly that successor — the same consolidation the
+reference performed.
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Optional, Sequence
+
+from .core.executor import Executor
+from .dataset import DataFeedDesc, DatasetFactory
+
+__all__ = ["AsyncExecutor"]
+
+
+class AsyncExecutor:
+    def __init__(self, place=None, run_mode: str = ""):
+        warnings.warn(
+            "AsyncExecutor is the legacy surface; prefer "
+            "Executor.train_from_dataset (the reference deprecated it "
+            "the same way)", DeprecationWarning)
+        self._exe = Executor(place)
+
+    def run(self, program, data_feed: DataFeedDesc,
+            filelist: Sequence[str], thread_num: int,
+            fetch_names: Optional[Sequence] = None,
+            mode: str = "", debug: bool = False):
+        """async_executor.h RunFromFile: filelist + DataFeedDesc ->
+        thread_num workers draining batches through `program`."""
+        if thread_num <= 0:
+            raise ValueError("thread_num must be positive")
+        ds = DatasetFactory().create_dataset("QueueDataset")
+        ds.set_filelist(list(filelist))
+        ds.set_batch_size(data_feed.batch_size)
+        if data_feed.pipe_command:
+            ds.set_pipe_command(data_feed.pipe_command)
+        ds.set_thread(thread_num)
+
+        class _V:  # slot name/dtype carriers for set_use_var
+            def __init__(self, name, dtype):
+                self.name, self.dtype = name, dtype
+
+        type_map = {"uint64": "int64", "float": "float32"}
+        ds.set_use_var([
+            _V(s["name"], type_map.get(s["type"], s["type"]))
+            for s in data_feed.slots if s["is_used"]])
+        return self._exe.train_from_dataset(
+            program=program, dataset=ds, thread=thread_num,
+            debug=debug, fetch_list=list(fetch_names or []))

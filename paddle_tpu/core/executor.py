@@ -514,5 +514,67 @@ class Executor:
 
         return step
 
+    # ------------------------------------------------------------------
+    # dataset-driven training (reference executor.py:1597
+    # train_from_dataset / :1520 infer_from_dataset — the Trainer-path
+    # successor of the legacy AsyncExecutor)
+    # ------------------------------------------------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        """Consume every batch of a fluid Dataset through this program.
+
+        `thread` is accepted for API parity and recorded, but the batch
+        loop is sequential: the device step must serialize anyway (the
+        jitted step donates the scope's state buffers — two in-flight
+        runs would donate the same arrays), and the host-side overlap
+        the reference's DeviceWorker threads buy lives in the Dataset's
+        OWN parser thread pool here (dataset.py _parse_all). A wrapper
+        thread pool on top would add locks without concurrency."""
+        return self._run_from_dataset(program, dataset, scope, thread,
+                                      debug, fetch_list, fetch_info,
+                                      print_period, fetch_handler,
+                                      is_infer=False)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        return self._run_from_dataset(program, dataset, scope, thread,
+                                      debug, fetch_list, fetch_info,
+                                      print_period, fetch_handler,
+                                      is_infer=True)
+
+    def _run_from_dataset(self, program, dataset, scope, thread, debug,
+                          fetch_list, fetch_info, print_period,
+                          fetch_handler, is_infer):
+        if dataset is None:
+            raise ValueError("dataset is required")
+        program = program if program is not None else \
+            default_main_program()
+        if is_infer:
+            program = program.clone(for_test=True)
+        fetch_names = [f.name if isinstance(f, VarDesc) else str(f)
+                       for f in (fetch_list or [])]
+        infos = list(fetch_info or fetch_names)
+        results = []
+        for n, batch in enumerate(dataset, start=1):
+            outs = self.run(program, feed=batch,
+                            fetch_list=fetch_names, scope=scope)
+            results.append(outs[0] if outs else None)
+            if fetch_names and (debug or n % max(print_period, 1) == 0):
+                import logging
+                logging.getLogger("paddle_tpu").info(
+                    "batch %d: %s", n,
+                    ", ".join("%s=%s" % (i, np.asarray(v).ravel()[:4])
+                              for i, v in zip(infos, outs)))
+                if fetch_handler is not None:
+                    # reference FetchHandler contract: user callback on
+                    # the fetched vars (time-based there; per
+                    # print_period here, the same observability hook)
+                    fetch_handler.handler(dict(zip(fetch_names, outs)))
+        return results
+
     def close(self):
         self._cache.clear()

@@ -82,7 +82,7 @@ class HeterService:
                     op, name, arrays = decode_request(payload)
                     if op == HETER_STOP:
                         sock.sendall(encode_reply([]))
-                        service._server.shutdown()
+                        service.stop()
                         return
                     fn = service._handlers.get(op)
                     try:
@@ -131,6 +131,7 @@ class HeterService:
 
     def stop(self):
         self._server.shutdown()
+        self._server.server_close()  # release the listening fd/port
 
 
 class HeterClient:

@@ -9,8 +9,9 @@ import os
 import numpy as np
 import pytest
 
-from paddle_tpu.dataset import (DatasetFactory, parse_multislot,
-                                using_native)
+import paddle_tpu as pt
+from paddle_tpu.dataset import (DataFeedDesc, DatasetFactory,
+                                parse_multislot, using_native)
 from paddle_tpu.dataset.native import _parse_python
 from paddle_tpu.reader import (BatchSampler, DataLoader, Dataset,
                                IterableDataset, TensorDataset, batch,
@@ -329,3 +330,79 @@ def test_data_feed_desc(tmp_path):
     assert ds._batch_size == 4
     assert [s.name for s in ds._slots] == ["words", "dense_f"]
     assert ds._slots[1].type == "float" and ds._slots[1].is_dense
+
+
+def test_executor_train_from_dataset(tmp_path):
+    """Executor.train_from_dataset (reference executor.py:1597): drain a
+    QueueDataset through a static program, threaded."""
+    paths = _write_files(tmp_path, n_files=2, lines_per=4)
+    ds = DatasetFactory().create_dataset("QueueDataset")
+    ds.set_filelist(paths)
+    ds.set_batch_size(2)
+    ds.set_thread(2)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        dense = pt.layers.data("dense", [2])
+        click = pt.layers.data("click", [1], dtype="int64")
+        pred = pt.layers.fc(dense, 1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(
+            pred, pt.layers.cast(click, "float32")))
+        pt.optimizer.SGD(0.01).minimize(loss, startup_program=startup,
+                                        program=main)
+
+    class V:
+        def __init__(self, name, dtype):
+            self.name, self.dtype = name, dtype
+    ds.set_use_var([V("click", "int64"), V("show", "int64"),
+                    V("feat", "int64"), V("dense", "float32")])
+
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        losses = exe.train_from_dataset(program=main, dataset=ds,
+                                        thread=2, fetch_list=[loss],
+                                        print_period=1)
+    assert len(losses) == 4  # 8 instances / batch 2 / 2 files
+    assert all(np.isfinite(float(np.asarray(l))) for l in losses)
+
+
+def test_async_executor_legacy_facade(tmp_path):
+    """AsyncExecutor.run (async_executor.h RunFromFile shape) delegates
+    to the Dataset/Trainer path."""
+    import warnings
+    paths = _write_files(tmp_path, n_files=1, lines_per=4)
+    proto = tmp_path / "feed.prototxt"
+    proto.write_text(
+        'name: "MultiSlotDataFeed"\n'
+        'batch_size: 2\n'
+        'multi_slot_desc {\n'
+        '  slots { name: "click" type: "uint64" is_dense: false '
+        'is_used: true }\n'
+        '  slots { name: "show" type: "uint64" is_dense: false '
+        'is_used: true }\n'
+        '  slots { name: "feat" type: "uint64" is_dense: false '
+        'is_used: true }\n'
+        '  slots { name: "dense" type: "float" is_dense: true '
+        'is_used: true }\n'
+        '}\n')
+    feed_desc = DataFeedDesc(str(proto))
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        dense = pt.layers.data("dense", [2])
+        pred = pt.layers.fc(dense, 1)
+        loss = pt.layers.mean(pt.layers.nn.square(pred))
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        ae = pt.AsyncExecutor()
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        losses = ae.run(main, feed_desc, paths, thread_num=2,
+                        fetch_names=[loss])
+    assert len(losses) == 2 and all(
+        np.isfinite(float(np.asarray(l))) for l in losses)
