@@ -54,12 +54,16 @@ CUDAPlace = TPUPlace  # scripts written against the reference keep working
 
 
 def set_global_seed(seed: int):
-    """Seed the static executor RNG chain + dygraph RNG."""
+    """Seed the static executor RNG chain + dygraph RNG (reference
+    paddle.seed seeds BOTH the program generator and the imperative
+    generator — framework.py manual_seed)."""
     default_main_program().random_seed = seed
     from .core.scope import global_scope as _gs
     from .core.executor import RNG_VAR
     import jax
     _gs().set(RNG_VAR, jax.random.PRNGKey(seed))
+    from .dygraph import tape as _tape
+    _tape.seed(seed)  # eager key chain: layer init + dygraph dropout
 
 
 seed = set_global_seed

@@ -34,6 +34,11 @@ _DEFS: Dict[str, Any] = {
     # False so a broken kernel can never silently ship — the round-2
     # bench measured the fallback without anyone noticing.
     "FLAGS_flash_attention_fallback": False,
+    # embedding dW strategy: True = chunked one-hot MXU matmuls instead
+    # of XLA scatter-add (the BERT embedding-backward experiment;
+    # scripts/tpu_experiments.py measures both). Trace-time flag — flip
+    # before building the step.
+    "FLAGS_embedding_onehot_grad": False,
     # collectives — inert (XLA combiner thresholds are compiler flags)
     "FLAGS_fuse_parameter_memory_size": -1,
     "FLAGS_fuse_parameter_groups_size": 3,

@@ -178,7 +178,11 @@ class TrainStep:
         self.amp_dtype = amp_dtype
         self._step_fn = None
         self._opt_state: Dict[str, Any] = {}
-        self._rng = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        # derive the per-step rng from the seeded eager chain, NOT the
+        # numpy global: paddle.seed must make a whole training run
+        # reproducible (reference manual_seed contract); np.random here
+        # made every TrainStep's dropout stream irreproducible
+        self._rng = tape._state.next_key()
         params, buffers = _named_state(model)
         self.param_names = list(params)
         self.buffer_names = list(buffers)

@@ -11,6 +11,8 @@ kernel contract.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -594,6 +596,75 @@ def _dropout(ctx, ins, attrs):
     return {"Out": [out], "Mask": [mask]}
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _gather_rows_onehot(vocab, w, ids):
+    return jnp.take(w, ids, axis=0)
+
+
+def _gather_rows_onehot_fwd(vocab, w, ids):
+    # residuals must be jax types: a zero-size array carries w's dtype
+    return jnp.take(w, ids, axis=0), (ids, jnp.zeros((0,), w.dtype))
+
+
+def _gather_rows_onehot_bwd(vocab, res, g):
+    """dW as chunked one-hot MATMULS instead of a scatter-add: the MXU
+    eats [chunk, V] @ [chunk, H] contractions, while the TPU scatter
+    path serializes through update cells (the round-3 open question on
+    the BERT embedding backward; scripts/tpu_experiments.py measures
+    both). Chunks of N keep the one-hot working set ~chunk*V*2B; the
+    [V, H] fp32 accumulator rides the scan carry. Padding the tail
+    chunk with id == V makes one_hot emit an all-zero row — no
+    contribution, no masking.
+
+    Contract note: ids must be in [0, V). The scatter path clips an
+    out-of-range id to the edge row (XLA gather/scatter clip mode);
+    here it contributes ZERO dW — both are garbage-in behaviors, but
+    they differ, so invalid ids train differently per flag."""
+    ids, w_proto = res
+    V = vocab
+    n = ids.shape[0]
+    # size the one-hot block by its ACTUAL bytes (dtype-aware: fp32
+    # grads double the block the old fixed 4096 budgeted) — ~256MB cap;
+    # under AMP the one-hot rides bf16, the accumulator stays fp32
+    itemsize = jnp.dtype(g.dtype).itemsize
+    chunk = max(256, min(4096, (256 << 20) // max(V * itemsize, 1)))
+    chunk = min(chunk, max(256, n))
+    n_pad = (-n) % chunk
+    ids_p = jnp.concatenate(
+        [ids, jnp.full((n_pad,), V, ids.dtype)]) if n_pad else ids
+    g_p = jnp.concatenate(
+        [g, jnp.zeros((n_pad,) + g.shape[1:], g.dtype)]) if n_pad else g
+    steps = ids_p.shape[0] // chunk
+
+    def body(dw, i):
+        sl_ids = jax.lax.dynamic_slice(ids_p, (i * chunk,), (chunk,))
+        sl_g = jax.lax.dynamic_slice_in_dim(g_p, i * chunk, chunk, 0)
+        oh = jax.nn.one_hot(sl_ids, V, dtype=sl_g.dtype)
+        return dw + jax.lax.dot_general(
+            oh, sl_g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32), None
+
+    dw, _ = jax.lax.scan(body, jnp.zeros((V,) + g.shape[1:], jnp.float32),
+                         jnp.arange(steps))
+    return (dw.astype(w_proto.dtype),
+            jnp.zeros(ids.shape, jax.dtypes.float0))
+
+
+_gather_rows_onehot.defvjp(_gather_rows_onehot_fwd, _gather_rows_onehot_bwd)
+
+
+def _embedding_take(w, ids):
+    """Row gather whose dW strategy is flag-selected at trace time:
+    FLAGS_embedding_onehot_grad=True routes the backward through MXU
+    one-hot matmuls; default is XLA's scatter-add."""
+    from ..flags import get_flag
+    if get_flag("FLAGS_embedding_onehot_grad", False):
+        flat = ids.reshape(-1).astype(jnp.int32)
+        out = _gather_rows_onehot(int(w.shape[0]), w, flat)
+        return out.reshape(tuple(ids.shape) + (w.shape[-1],))
+    return jnp.take(w, ids.astype(jnp.int32), axis=0)
+
+
 @register_op("lookup_table", inputs=("W", "Ids"), non_diff_inputs=("Ids",))
 def _lookup_table(ctx, ins, attrs):
     # operators/lookup_table_op.cc — Ids shaped [..., 1]; padding_idx rows
@@ -602,7 +673,7 @@ def _lookup_table(ctx, ins, attrs):
     if ids.shape and ids.shape[-1] == 1:
         ids = jnp.squeeze(ids, -1)
     padding_idx = attrs.get("padding_idx", -1)
-    out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    out = _embedding_take(w, ids)
     if padding_idx != -1:
         pad = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
         out = jnp.where((ids == pad)[..., None], 0.0, out)
@@ -614,7 +685,7 @@ def _lookup_table(ctx, ins, attrs):
 def _lookup_table_v2(ctx, ins, attrs):
     w, ids = ins["W"][0], ins["Ids"][0]
     padding_idx = attrs.get("padding_idx", -1)
-    out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    out = _embedding_take(w, ids)
     if padding_idx != -1:
         pad = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
         out = jnp.where((ids == pad)[..., None], 0.0, out)

@@ -51,11 +51,15 @@ for S, B in [(1024, 16), (2048, 8)]:
     tf = timeit(ffb,q,k,v,n=5); tc = timeit(cfb,q,k,v,n=5)
     print("S=%4d: flash %.2fms composed %.2fms ratio %.2f" % (S,tf*1e3,tc*1e3,tf/tc))
 
-# 3. BERT step at B=32 and B=64 with current code
+# 3. BERT step at B=32 and B=64 with current code, each with the
+# embedding-dW strategy flag off/on (FLAGS_embedding_onehot_grad)
 import paddle_tpu as pt
 from paddle_tpu.models.bert import BertConfig, BertForPretraining, pretraining_loss
 from paddle_tpu.jit import TrainStep
-for B in (32, 64):
+import itertools
+for B, onehot in itertools.product((32, 64), (False, True)):
+    pt.set_flags({"FLAGS_embedding_onehot_grad": onehot})
+    print("=== B=%d onehot_dW=%s" % (B, onehot))
     cfg = BertConfig()
     S, M = 512, 80
     model = BertForPretraining(cfg)

@@ -372,3 +372,37 @@ def test_dropout_test_mode():
            attrs={"dropout_prob": 0.5, "is_test": True,
                   "dropout_implementation": "upscale_in_train"}
            ).check_output()
+
+
+def test_embedding_onehot_grad_matches_scatter():
+    """FLAGS_embedding_onehot_grad reroutes the embedding dW through
+    chunked one-hot matmuls; grads must match the scatter path exactly
+    (incl. duplicate ids and a non-chunk-aligned N)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.core.registry import REGISTRY, LowerCtx
+
+    rng = np.random.RandomState(0)
+    V, H = 37, 8
+    w = jnp.asarray(rng.randn(V, H), jnp.float32)
+    ids = jnp.asarray(rng.randint(0, V, (5, 7)), jnp.int32)  # dups likely
+    g_out = jnp.asarray(rng.randn(5, 7, H), jnp.float32)
+
+    def run_grad():
+        def f(w):
+            outs = REGISTRY.get("lookup_table_v2").lower(
+                LowerCtx(), {"W": [w], "Ids": [ids]}, {})
+            return jnp.sum(outs["Out"][0] * g_out)
+        return jax.grad(f)(w)
+
+    pt.set_flags({"FLAGS_embedding_onehot_grad": False})
+    dw_scatter = run_grad()
+    pt.set_flags({"FLAGS_embedding_onehot_grad": True})
+    try:
+        dw_onehot = run_grad()
+    finally:
+        pt.set_flags({"FLAGS_embedding_onehot_grad": False})
+    np.testing.assert_allclose(np.asarray(dw_onehot),
+                               np.asarray(dw_scatter), rtol=1e-5,
+                               atol=1e-5)
