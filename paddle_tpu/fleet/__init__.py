@@ -157,6 +157,27 @@ class Fleet:
                 use_dynamic_loss_scaling=cfg.get(
                     "use_dynamic_loss_scaling"),
                 dest_dtype=cfg.get("dest_dtype", "bfloat16"))
+        if st.pipeline:
+            # outermost: the pipeline rewrite owns the backward (the
+            # GPipe schedule differentiates the whole program), so it
+            # wraps the finished chain and drives its apply_gradients.
+            # Compositions whose semantics the rewrite would silently
+            # drop are refused up front.
+            bad = [f for f in ("amp", "gradient_merge", "recompute",
+                               "dgc", "localsgd") if getattr(st, f)]
+            if bad:
+                raise NotImplementedError(
+                    "strategy.pipeline does not compose with %s: the "
+                    "pipeline rewrite owns the backward, so those "
+                    "rewrites would be silently skipped. Use "
+                    "num_microbatches for accumulation, TrainStep "
+                    "amp_dtype for mixed precision, and the "
+                    "DGC/LocalSGD SPMD builders for dp compression."
+                    % bad)
+            from ..parallel import PipelineOptimizer
+            opt = PipelineOptimizer(
+                opt, num_microbatches=st.pipeline_configs.get(
+                    "accumulate_steps", 1))
         return opt
 
     def minimize(self, loss, startup_program=None, parameter_list=None,

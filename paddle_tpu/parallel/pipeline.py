@@ -251,16 +251,37 @@ class PipelineOptimizer:
         self.sections = None
 
     def minimize(self, loss, startup_program=None, program=None,
-                 parameter_list=None):
+                 parameter_list=None, no_grad_set=None):
         from ..core.program import (default_main_program,
                                     default_startup_program)
         from .pipeline_static import rewrite_pipeline_program
-        if not hasattr(self._inner, "apply_gradients"):
+        if no_grad_set:
+            # append_backward's no_grad_set contract, via its own name
+            # normalizer + trainable/stop_gradient filter
+            from ..core.backward import _var_name
+            names = {_var_name(p) for p in no_grad_set}
+            if parameter_list is None:
+                parameter_list = [
+                    v.name for v in (
+                        program or default_main_program())
+                    .all_parameters()
+                    if v.name not in names and v.trainable
+                    and not v.stop_gradient]
+            else:
+                parameter_list = [p for p in parameter_list
+                                  if _var_name(p) not in names]
+        # hasattr is NOT enough: MetaOptimizerBase.__getattr__ delegates
+        # to the innermost optimizer, which would silently bypass the
+        # wrapper's semantics (gradient merge, DGC...) — require
+        # apply_gradients defined on the class itself
+        if not any("apply_gradients" in vars(k)
+                   for k in type(self._inner).__mro__):
             raise TypeError(
-                "PipelineOptimizer needs a base optimizer exposing "
-                "apply_gradients (got %s); wrap the base optimizer "
-                "directly, as the reference requires (optimizer.py:3666)"
-                % type(self._inner).__name__)
+                "PipelineOptimizer needs a base optimizer DEFINING "
+                "apply_gradients (got %s — a wrapper whose rewrite the "
+                "pipeline schedule would silently drop); wrap the base "
+                "optimizer directly, as the reference requires "
+                "(optimizer.py:3666)" % type(self._inner).__name__)
         prog = program if program is not None else default_main_program()
         startup = startup_program if startup_program is not None \
             else default_startup_program()

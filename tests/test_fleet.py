@@ -348,3 +348,35 @@ def test_dgc_rampup_transition():
         if i <= 5:
             assert vmass == 0.0, (i, vmass)  # dense phase: no residual
     assert vmass > 0.0  # compression engaged after rampup
+
+
+def test_fleet_pipeline_strategy_runs_schedule():
+    """strategy.pipeline=True routes minimize through the real
+    pipeline_train rewrite (pipeline_optimizer.py analog)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        with pt.device_guard("gpu:0"):
+            h = layers.fc(x, 8, act="tanh")
+        with pt.device_guard("gpu:1"):
+            loss = layers.mean(layers.square_error_cost(
+                layers.fc(h, 1), y))
+        st = DistributedStrategy()
+        st.pipeline = True
+        st.pipeline_configs = {"accumulate_steps": 2}
+        f = Fleet().init(UserDefinedRoleMaker())
+        f.distributed_optimizer(pt.optimizer.SGD(0.05), st)
+        f.minimize(loss, startup_program=startup, program=main)
+    assert "pipeline_train" in [op.type for op in main.global_block.ops]
+    exe = pt.Executor()
+    rng = np.random.RandomState(0)
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        losses = []
+        for i in range(6):
+            xb = rng.randn(8, 4).astype(np.float32)
+            out, = exe.run(main, feed={"x": xb, "y": xb[:, :1].copy()},
+                           fetch_list=[loss])
+            losses.append(float(out))
+    assert losses[-1] < losses[0], losses
