@@ -202,19 +202,12 @@ def _run_worker(backend):
     }))
 
 
-def _spawn(backend, timeout):
-    """Run `bench.py --worker <backend>` in a subprocess; return
-    (json_line_or_None, timed_out). A subprocess is mandatory: when the
-    axon tunnel is wedged, jax.devices() HANGS with no error (round-3
-    postmortem) — only a process-level timeout can recover from that.
-    On timeout the worker gets SIGTERM + a 30s grace before SIGKILL:
-    killing it mid remote_compile RPC is itself what wedges the tunnel."""
+def _graceful_group_kill(proc):
+    """SIGTERM the child's process group, 30s grace, then SIGKILL +
+    bounded reap. Killing mid remote_compile RPC is itself what wedges
+    the axon tunnel, and helper children inherit the pipes — the group
+    + grace protocol is mandatory for every timed-out child."""
     import signal
-    import subprocess
-    proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--worker", backend],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        start_new_session=True)
 
     def _signal_group(sig):
         try:
@@ -222,22 +215,34 @@ def _spawn(backend, timeout):
         except (ProcessLookupError, PermissionError):
             pass
 
+    _signal_group(signal.SIGTERM)
+    try:
+        return proc.communicate(timeout=30)
+    except subprocess.TimeoutExpired:
+        _signal_group(signal.SIGKILL)
+        try:
+            return proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            return "", ""  # abandon the pipes rather than hang
+
+
+import subprocess  # noqa: E402  (used by _spawn/_tpu_probe below)
+
+
+def _spawn(backend, timeout):
+    """Run `bench.py --worker <backend>` in a subprocess; return
+    (json_line_or_None, timed_out). A subprocess is mandatory: when the
+    axon tunnel is wedged, jax.devices() HANGS with no error (round-3
+    postmortem) — only a process-level timeout can recover from that."""
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker", backend],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
     try:
         out, err = proc.communicate(timeout=timeout)
         timed_out = False
     except subprocess.TimeoutExpired:
-        # TERM the whole process group (axon helper children inherit the
-        # pipes; killing only the direct child would leave them holding
-        # the fds and the final communicate would block on EOF forever)
-        _signal_group(signal.SIGTERM)
-        try:
-            out, err = proc.communicate(timeout=30)
-        except subprocess.TimeoutExpired:
-            _signal_group(signal.SIGKILL)
-            try:
-                out, err = proc.communicate(timeout=15)
-            except subprocess.TimeoutExpired:
-                out, err = "", ""  # abandon the pipes rather than hang
+        out, err = _graceful_group_kill(proc)
         print("WARN: %s bench timed out after %ds" % (backend, timeout),
               file=sys.stderr)
         timed_out = True
@@ -257,15 +262,44 @@ def _spawn(backend, timeout):
     return None, timed_out
 
 
+def _tpu_probe(timeout=180):
+    """Cheap wedge detector -> "ok" | "failed" | "hung". A wedged axon
+    tunnel HANGS jax.devices() without erroring; probing first turns a
+    40-minute doomed bench attempt into a 3-minute skip. Only the HUNG
+    verdict bypasses the TPU tier — a fast failure (e.g. a lease still
+    held) proceeds to the normal attempt + lease-wait retry."""
+    code = ("import jax, jax.numpy as jnp; "
+            "assert jax.default_backend() not in ('cpu',); "
+            "print(float(jnp.sum(jnp.ones((8, 8)))))")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, start_new_session=True)
+    try:
+        _out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _graceful_group_kill(proc)
+        return "hung"
+    if proc.returncode == 0:
+        return "ok"
+    sys.stderr.write(err or "")
+    return "failed"
+
+
 def main():
-    # Orchestrator: TPU attempt -> one retry after a lease wait (only if
-    # the first attempt FAILED rather than hung: a hang means the tunnel
-    # is wedged and re-probing before the server-side lease expires just
-    # burns another timeout) -> CPU smoke -> last-resort stub. ALWAYS
-    # prints one JSON line and exits 0: BENCH_r03.json was rc=1 because
-    # a tunnel outage crashed the bench outright and the round shipped
-    # no perf evidence at all.
-    line, timed_out = _spawn("tpu", timeout=2400)
+    # Orchestrator: probe -> TPU attempt -> one retry after a lease wait
+    # (only if the first attempt FAILED rather than hung: a hang means
+    # the tunnel is wedged and re-probing before the server-side lease
+    # expires just burns another timeout) -> CPU smoke -> last-resort
+    # stub. ALWAYS prints one JSON line and exits 0: BENCH_r03.json was
+    # rc=1 because a tunnel outage crashed the bench outright and the
+    # round shipped no perf evidence at all.
+    verdict = _tpu_probe()
+    if verdict == "hung":
+        print("WARN: TPU probe hung (wedged tunnel); skipping the TPU "
+              "tier", file=sys.stderr)
+        line, timed_out = None, True  # fall through to the CPU tier
+    else:
+        line, timed_out = _spawn("tpu", timeout=2400)
     if line is None and not timed_out:
         print("WARN: TPU attempt 1 failed; waiting 120s for tunnel lease",
               file=sys.stderr)

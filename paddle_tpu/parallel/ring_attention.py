@@ -78,14 +78,26 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = SP_AXIS,
             # (my - i) mod n
             src = (my - i) % n
             if causal:
-                q_pos = my * s_loc + jnp.arange(s_loc)[:, None]
-                k_pos = src * s_loc + jnp.arange(s_loc)[None, :]
-                mask = q_pos >= k_pos
-                mask = mask[None, None]
+                def compute(args):
+                    acc, m, l = args
+                    q_pos = my * s_loc + jnp.arange(s_loc)[:, None]
+                    k_pos = src * s_loc + jnp.arange(s_loc)[None, :]
+                    mask = (q_pos >= k_pos)[None, None]
+                    return _online_block(q_l, k_cur, v_cur, acc, m, l,
+                                         sm_scale, mask)
+
+                # a K/V shard strictly in this device's future (src > my)
+                # is FULLY masked: skip the whole score/PV block. The
+                # predicate is per-device (divergent branches are fine —
+                # no collective inside; the ppermutes below run
+                # unconditionally on every device). Saves ~(n-1)/2n of
+                # the causal schedule's FLOPs, the shard-level analog of
+                # the flash kernel's nk_live loop bound.
+                acc, m, l = jax.lax.cond(src <= my, compute,
+                                         lambda args: args, (acc, m, l))
             else:
-                mask = None
-            acc, m, l = _online_block(q_l, k_cur, v_cur, acc, m, l,
-                                      sm_scale, mask)
+                acc, m, l = _online_block(q_l, k_cur, v_cur, acc, m, l,
+                                          sm_scale, None)
             k_nxt = jax.lax.ppermute(k_cur, axis, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)
             return (acc, m, l, k_nxt, v_nxt), None
