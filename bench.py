@@ -36,7 +36,10 @@ def _bench_bert(on_tpu):
 
     if on_tpu:
         cfg = BertConfig()  # BERT-base, real training config (dropout on)
-        B, S, M, steps = 32, 512, 80, 30
+        # BENCH_BERT_B: flip to 64 per the PERF_NOTES.md run sheet
+        # without a code edit once the B-sweep says it wins
+        B = int(os.environ.get("BENCH_BERT_B", "32"))
+        S, M, steps = 512, 80, 30
     else:  # CI / smoke fallback
         cfg = BertConfig(vocab_size=1000, hidden_size=128,
                          num_hidden_layers=2, num_attention_heads=4,
