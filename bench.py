@@ -122,7 +122,7 @@ def _bench_bert(on_tpu):
     head = 6 * (H * H + H * V) * M + 6 * (H * H + 2 * H)
     flops_step = flops_token * B * S + head * B
     mfu = (flops_step / dt) / (197e12 if on_tpu else 1e12)
-    return tokens_per_sec, mfu, attention_path, mosaic_in_hlo
+    return tokens_per_sec, mfu, attention_path, mosaic_in_hlo, B
 
 
 def _bench_resnet(on_tpu):
@@ -184,7 +184,7 @@ def _run_worker(backend):
               jax.default_backend(), file=sys.stderr)
         sys.exit(3)
 
-    bert_tps, bert_mfu, attn_path, mosaic_ok = _bench_bert(on_tpu)
+    bert_tps, bert_mfu, attn_path, mosaic_ok, bert_b = _bench_bert(on_tpu)
     rn_ips, rn_mfu = _bench_resnet(on_tpu)
 
     vs = min(bert_mfu, rn_mfu) / 0.45
@@ -196,6 +196,7 @@ def _run_worker(backend):
         "unit": "tokens/s",
         "vs_baseline": round(vs, 4),
         "backend": jax.default_backend() if on_tpu else "cpu-fallback",
+        "bert_batch": bert_b,
         "bert_tokens_per_sec": round(bert_tps, 1),
         "bert_mfu": round(bert_mfu, 4),
         "resnet50_images_per_sec": round(rn_ips, 1),

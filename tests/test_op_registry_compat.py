@@ -1,0 +1,25 @@
+"""API-compat guard (SURVEY §4.6 — the reference's check_op_desc.py
+golden-spec diffing): the live registry must not silently drop ops or
+change signatures vs tools/op_registry_golden.json."""
+import json
+import os
+import subprocess
+import sys
+
+
+def test_registry_matches_golden():
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "check_op_registry.py")
+    proc = subprocess.run([sys.executable, tools], capture_output=True,
+                          text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_golden_has_full_surface():
+    golden = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "op_registry_golden.json")
+    ops = json.load(open(golden))
+    assert len(ops) >= 476
+    # spot-check signature capture of a mutating optimizer op
+    assert ops["sgd"]["inplace_map"].get("ParamOut") == "Param"
+    assert ops["lookup_table_v2"]["non_diff_inputs"] == ["Ids"]

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Op-registry API-compat checker.
+
+Analog of the reference's golden-spec tooling
+(/root/reference/tools/check_op_desc.py + check_api_approvals.sh: dump
+every op's proto — inputs/outputs/attrs — and diff against a reviewed
+golden file so an op signature can't change silently). Here the golden
+is tools/op_registry_golden.json, capturing each registered op's
+name, input/output slots, differentiability, host/random markers and
+inplace map.
+
+Usage:
+    python tools/check_op_registry.py            # diff vs golden
+    python tools/check_op_registry.py --update   # regenerate golden
+Exit 0 = compatible (additions are fine); nonzero lists removals and
+signature changes — the two classes of silent API breakage.
+"""
+import json
+import os
+import sys
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "op_registry_golden.json")
+
+
+def dump_registry():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu  # noqa: F401 - registers everything
+    from paddle_tpu.core.registry import REGISTRY
+    out = {}
+    for name in REGISTRY.names():
+        d = REGISTRY.get(name)
+        out[name] = {
+            "inputs": list(d.input_slots),
+            "outputs": list(d.output_slots),
+            "no_grad": bool(d.no_grad),
+            "is_random": bool(d.is_random),
+            "non_diff_inputs": list(d.non_diff_inputs),
+            "inplace_map": dict(d.inplace_map),
+            "host": bool(d.host),
+        }
+    return out
+
+
+def main():
+    cur = dump_registry()
+    if "--update" in sys.argv:
+        with open(GOLDEN, "w") as f:
+            json.dump(cur, f, indent=1, sort_keys=True)
+        print("golden updated: %d ops" % len(cur))
+        return 0
+    with open(GOLDEN) as f:
+        gold = json.load(f)
+    removed = sorted(set(gold) - set(cur))
+    changed = sorted(n for n in set(gold) & set(cur) if gold[n] != cur[n])
+    added = sorted(set(cur) - set(gold))
+    if added:
+        print("new ops (fine, run --update to bless): %s" % added)
+    if removed:
+        print("REMOVED ops: %s" % removed)
+    for n in changed:
+        print("CHANGED op %r:\n  golden: %s\n  now:    %s"
+              % (n, gold[n], cur[n]))
+    if removed or changed:
+        print("op registry drifted from the golden spec "
+              "(tools/op_registry_golden.json); if intentional, "
+              "rerun with --update and review the diff")
+        return 1
+    print("op registry compatible: %d ops (%d new)" % (len(cur),
+                                                       len(added)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
