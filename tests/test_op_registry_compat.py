@@ -23,3 +23,17 @@ def test_golden_has_full_surface():
     # spot-check signature capture of a mutating optimizer op
     assert ops["sgd"]["inplace_map"].get("ParamOut") == "Param"
     assert ops["lookup_table_v2"]["non_diff_inputs"] == ["Ids"]
+
+
+def test_tpu_scripts_parse():
+    """The run-sheet scripts are TPU-only (never executed in CI); at
+    least guarantee they stay syntactically valid."""
+    import ast
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    checked = 0
+    for fn in sorted(os.listdir(root)):
+        if fn.endswith(".py"):
+            ast.parse(open(os.path.join(root, fn)).read(), filename=fn)
+            checked += 1
+    assert checked >= 2
