@@ -27,6 +27,7 @@ grads reuse ParamServer's numpy paths — the device never sees the RPC
 the reference's CPU-side pserver)."""
 from __future__ import annotations
 
+import os
 import socket
 import socketserver
 import struct
@@ -195,6 +196,16 @@ def _recv_frame(sock: socket.socket) -> memoryview:
     return memoryview(_recv_exact(sock, ln))
 
 
+def _parse_endpoint(endpoint: str):
+    """-> ("unix", path) | ("tcp", (host, port_str)). One parser for
+    both sides of the channel so client and server scheme handling
+    cannot drift."""
+    if endpoint.startswith("uds://"):
+        return "unix", endpoint[len("uds://"):]
+    host, port = endpoint.rsplit(":", 1)
+    return "tcp", (host, port)
+
+
 class PsServer:
     """Socket server hosting a ParamServer (listen_and_serv_op.cc:330
     RunSyncLoop / RunAsyncLoop analog — one handler thread per trainer
@@ -205,7 +216,17 @@ class PsServer:
         from .communicator import ParamServer  # noqa: F401  (type)
         self.ps = param_server
         self.n_trainers = n_trainers
-        host, port = endpoint.rsplit(":", 1)
+        # second transport (the reference ships TWO interchangeable RPC
+        # stacks, grpc + brpc, behind one interface —
+        # operators/distributed/*_rpc_server.*): `uds://<path>` selects
+        # unix-domain sockets (lower latency for same-host
+        # trainer/pserver co-location, the brpc deployment's sweet
+        # spot); the default host:port stays TCP. Same framing, same
+        # handler, same client surface either way.
+        kind, addr = _parse_endpoint(endpoint)
+        self._uds = kind == "unix"
+        if not self._uds:
+            host, port = addr
         # barrier action: the last trainer to arrive applies the merged
         # sync-window grads (RunSyncLoop's optimize-after-barrier)
         self._barrier = _DynamicBarrier(n_trainers,
@@ -216,7 +237,9 @@ class PsServer:
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 sock = self.request
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                if sock.family == socket.AF_INET:
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
                 # connection-level heartbeat (heart_beat_monitor.h:54
                 # analog): each trainer holds ONE persistent channel, so
                 # a dropped connection IS a missed heartbeat. A trainer
@@ -259,8 +282,34 @@ class PsServer:
             allow_reuse_address = True
             daemon_threads = True
 
-        self._srv = Server((host, int(port)), Handler)
-        self.endpoint = "%s:%d" % (host, self._srv.server_address[1])
+        if self._uds:
+            # defined lazily: ThreadingUnixStreamServer only exists on
+            # platforms with AF_UNIX
+            class UnixServer(socketserver.ThreadingUnixStreamServer):
+                daemon_threads = True
+
+            path = addr
+            if os.path.exists(path):
+                # unlink only a STALE file (nothing accepting): blindly
+                # unlinking would silently hijack a live server's
+                # endpoint where TCP fails loudly with EADDRINUSE
+                probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                try:
+                    probe.connect(path)
+                    probe.close()
+                    raise OSError(
+                        "uds endpoint %s is in use by a live server"
+                        % endpoint)
+                except (ConnectionRefusedError, FileNotFoundError):
+                    os.unlink(path)
+                finally:
+                    probe.close()
+            self._srv = UnixServer(path, Handler)
+            self._uds_path = path
+            self.endpoint = endpoint
+        else:
+            self._srv = Server((host, int(port)), Handler)
+            self.endpoint = "%s:%d" % (host, self._srv.server_address[1])
         self._thread: Optional[threading.Thread] = None
 
     # -- dispatch ---------------------------------------------------------
@@ -339,6 +388,12 @@ class PsServer:
         self._stop.set()
         self._srv.shutdown()
         self._srv.server_close()
+        path = getattr(self, "_uds_path", None)
+        if path is not None:
+            try:
+                os.unlink(path)  # no stale socket file left behind
+            except OSError:
+                pass
 
 
 class PsClient:
@@ -348,11 +403,18 @@ class PsClient:
     connection per endpoint = one channel)."""
 
     def __init__(self, endpoint: str, timeout: float = 120.0):
-        host, port = endpoint.rsplit(":", 1)
         self.endpoint = endpoint
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        kind, addr = _parse_endpoint(endpoint)
+        if kind == "unix":
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.settimeout(timeout)
+            self._sock.connect(addr)
+        else:
+            host, port = addr
+            self._sock = socket.create_connection((host, int(port)),
+                                                  timeout=timeout)
+            self._sock.setsockopt(socket.IPPROTO_TCP,
+                                  socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
 
     def _call(self, op: int, name: str = "",

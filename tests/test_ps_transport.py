@@ -299,3 +299,34 @@ def test_geo_communicator_over_transport():
     finally:
         cli.stop_server()
         cli.close()
+
+
+def test_uds_second_transport(tmp_path):
+    """uds:// endpoints select the unix-domain transport behind the same
+    PsServer/PsClient interface — the reference's interchangeable
+    grpc/brpc dual-stack contract."""
+    from paddle_tpu.distributed import ParamServer
+    from paddle_tpu.distributed.rpc import PsClient, PsServer
+    ep = "uds://%s" % (tmp_path / "ps.sock")
+    srv = PsServer(ParamServer(lr=0.1), endpoint=ep, n_trainers=1)
+    srv.start()
+    try:
+        c = PsClient(ep)
+        c.init_param("w", np.ones(4, np.float32))
+        c.send_grad("w", np.ones(4, np.float32))
+        out = c.get_param("w")
+        np.testing.assert_allclose(out, 0.9 * np.ones(4), rtol=1e-6)
+        c.complete()
+        c.close()
+        # a second server on the SAME live path must fail loudly, and
+        # stop() must remove the socket file
+        import pytest as _pt
+        srv2 = None
+        with _pt.raises(OSError, match="in use"):
+            from paddle_tpu.distributed import ParamServer as _PS
+            srv2 = PsServer(_PS(), endpoint=ep)
+        assert srv2 is None
+    finally:
+        srv.stop()
+    import os
+    assert not os.path.exists(str(tmp_path / "ps.sock"))
