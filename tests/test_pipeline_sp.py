@@ -337,3 +337,34 @@ def test_static_pipeline_parameter_list_freezes():
                                       w0)
         assert any(not np.allclose(np.asarray(scope.find_var(n)), t0[n])
                    for n in train)
+
+
+def test_static_pipeline_program_json_roundtrip():
+    """A pipeline_train program (sub-blocks + meta-op) must survive the
+    JSON IR round trip — pipelined models stay saveable/loadable."""
+    from paddle_tpu.core.program import Program
+    from paddle_tpu.parallel import PipelineOptimizer
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [4])
+        y = pt.layers.data("y", [1])
+        with pt.device_guard("gpu:0"):
+            h = pt.layers.fc(x, 8, act="tanh")
+        with pt.device_guard("gpu:1"):
+            loss = pt.layers.mean(pt.layers.square_error_cost(
+                pt.layers.fc(h, 1), y))
+        PipelineOptimizer(pt.optimizer.SGD(0.05), num_microbatches=2) \
+            .minimize(loss, startup_program=startup, program=main)
+    main2 = Program.from_json(main.to_json())
+    startup2 = Program.from_json(startup.to_json())
+    exe = pt.Executor()
+    rng = np.random.RandomState(0)
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup2)
+        losses = []
+        for i in range(4):
+            xb = rng.randn(8, 4).astype(np.float32)
+            out, = exe.run(main2, feed={"x": xb, "y": xb[:, :1].copy()},
+                           fetch_list=[loss.name])
+            losses.append(float(out))
+    assert losses[-1] < losses[0], losses
