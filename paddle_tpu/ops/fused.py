@@ -333,3 +333,97 @@ def _squared_mat_sub(ctx, ins, attrs):
     identical contract, delegated so the FM-interaction formula lives in
     one place."""
     return _fusion_squared_mat_sub(ctx, ins, attrs)
+
+
+_ALSTM_ACTS = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+               "relu": jax.nn.relu, "identity": lambda v: v}
+
+
+@register_op("attention_lstm",
+             inputs=("X", "C0", "H0", "AttentionWeight", "AttentionBias",
+                     "AttentionScalar", "AttentionScalarBias",
+                     "LSTMWeight", "LSTMBias", "SeqLen"),
+             outputs=("Hidden", "Cell", "AttentionedX", "AttentionFCOut",
+                      "LSTMX", "LSTMOUT"),
+             non_diff_inputs=("SeqLen",))
+def _attention_lstm(ctx, ins, attrs):
+    """operators/attention_lstm_op.cc: per step t the previous cell
+    state attends over the whole input sequence —
+    relu(x@aw[:M] + c_{t-1}@aw[M:]) (+ optional scalar/bias relu) →
+    masked softmax → attention-pooled lstm_x [1,M] — then one LSTM step
+    with combined weight [[Wh; Wx]] of gate order
+    {forget, input, output, candidate} (attention_lstm_op.cc:403-432).
+    Ragged convention: padded X [B,T,M] + SeqLen (ops/sequence.py
+    docstring) instead of the reference's packed LoD rows; the softmax
+    masks positions >= SeqLen and state freezes past the valid length.
+    """
+    act_gate = _ALSTM_ACTS[attrs.get("gate_activation", "sigmoid")]
+    act_cell = _ALSTM_ACTS[attrs.get("cell_activation", "tanh")]
+    act_cand = _ALSTM_ACTS[attrs.get("candidate_activation", "tanh")]
+    x = ins["X"][0]                       # [B, T, M]
+    B, T, M = x.shape
+    aw = ins["AttentionWeight"][0].reshape(-1)      # [M+D]
+    lw = ins["LSTMWeight"][0]                        # [D+M, 4D]
+    lb = ins["LSTMBias"][0].reshape(-1)              # [4D]
+    D = lw.shape[1] // 4
+    c0 = ins["C0"][0]                                # [B, D]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros_like(c0)
+    ab = ins["AttentionBias"][0].reshape(()) if ins.get("AttentionBias") \
+        else None
+    a_scal = ins["AttentionScalar"][0].reshape(()) \
+        if ins.get("AttentionScalar") else None
+    a_scal_b = ins["AttentionScalarBias"][0].reshape(()) \
+        if ins.get("AttentionScalarBias") else None
+    if ins.get("SeqLen"):
+        lens = ins["SeqLen"][0].astype(jnp.int32)
+    else:
+        lens = jnp.full((B,), T, jnp.int32)
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lens[:, None]
+
+    # x part of the attention fc, shared across steps ([B, T])
+    atted_x = x @ aw[:M]
+    if ab is not None:
+        atted_x = atted_x + ab
+    neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+
+    def step(carry, t):
+        # the last-valid-step workspace values (gates/lstm_x/probs)
+        # ride the carry so emitting them doesn't force per-step
+        # stacks (and their cotangents) to materialize
+        h, c, last_g, last_lx, last_p = carry
+        score = jax.nn.relu(atted_x + (c @ aw[M:])[:, None])  # [B, T]
+        if a_scal is not None:
+            score = score * a_scal
+            if a_scal_b is not None:
+                score = score + a_scal_b
+            score = jax.nn.relu(score)
+        probs = jax.nn.softmax(jnp.where(valid, score, neg), axis=1)
+        lstm_x = jnp.einsum("bt,btm->bm", probs, x)
+        gates = lstm_x @ lw[D:] + h @ lw[:D] + lb    # [B, 4D]
+        f = act_gate(gates[:, :D])
+        i = act_gate(gates[:, D:2 * D])
+        o = act_gate(gates[:, 2 * D:3 * D])
+        cand = act_cand(gates[:, 3 * D:])
+        c_new = f * c + i * cand
+        h_new = act_cell(c_new) * o
+        live = (t < lens)[:, None]
+        h2 = jnp.where(live, h_new, h)
+        c2 = jnp.where(live, c_new, c)
+        return ((h2, c2, jnp.where(live, gates, last_g),
+                 jnp.where(live, lstm_x, last_lx),
+                 jnp.where(live, probs, last_p)),
+                (jnp.where(live, h_new, 0.0),
+                 jnp.where(live, c_new, 0.0)))
+
+    (_, _, last_gates, last_lstm_x, last_probs), (hs, cs) = jax.lax.scan(
+        step,
+        (h0, c0, jnp.zeros((B, 4 * D), x.dtype),
+         jnp.zeros((B, M), x.dtype), jnp.zeros((B, T), x.dtype)),
+        jnp.arange(T, dtype=jnp.int32))
+    hs = jnp.moveaxis(hs, 0, 1)                      # [B, T, D]
+    cs = jnp.moveaxis(cs, 0, 1)
+    return {"Hidden": [hs], "Cell": [cs],
+            "AttentionedX": [atted_x[..., None]],
+            "AttentionFCOut": [last_probs[..., None]],
+            "LSTMX": [last_lstm_x],
+            "LSTMOUT": [last_gates]}

@@ -19,10 +19,12 @@ from .common import one
 
 
 def _out_hw(ins, attrs, ndim_spatial=2):
-    if ins.get("OutSize"):
-        raise NotImplementedError(
-            "interp with a tensor OutSize is data-dependent; pass the "
-            "static out_h/out_w attrs (XLA needs static shapes)")
+    for slot in ("OutSize", "SizeTensor"):
+        if ins.get(slot):
+            raise NotImplementedError(
+                "interp with a tensor %s is data-dependent; pass the "
+                "static out_d/out_h/out_w attrs (XLA needs static "
+                "shapes)" % slot)
     if ndim_spatial == 1:
         return (attrs.get("out_w", -1),)
     if ndim_spatial == 3:
@@ -34,33 +36,52 @@ def _out_hw(ins, attrs, ndim_spatial=2):
 def _interp(ctx, ins, attrs, method, ndim_spatial=2):
     x = ins["X"][0]  # NCHW / NCW / NCDHW
     sizes = _out_hw(ins, attrs, ndim_spatial)
+    # v1 declares scale as a scalar float; v2 as vector<float>, one per
+    # spatial dim (interpolate_v2_op.cc:414) with a 1-element vector
+    # broadcasting.  A concrete Scale input tensor acts like the attr.
     scale = attrs.get("scale", 0.0)
+    if ins.get("Scale"):
+        import jax.core as _jcore
+        if isinstance(ins["Scale"][0], _jcore.Tracer):
+            raise NotImplementedError(
+                "interp with a traced Scale tensor is data-dependent; "
+                "pass the static scale attr (XLA needs static shapes)")
+        scale = [float(v) for v in np.asarray(ins["Scale"][0]).reshape(-1)]
     spatial = x.shape[2:]
     if any(s <= 0 for s in sizes):
-        assert scale > 0, "need out sizes or scale"
-        sizes = tuple(int(s * scale) for s in spatial)
+        scales = list(scale) if isinstance(scale, (list, tuple)) \
+            else [scale] * ndim_spatial
+        if len(scales) == 1:
+            scales = scales * ndim_spatial
+        assert len(scales) == ndim_spatial and all(s > 0 for s in scales), \
+            "need out sizes or positive scale(s)"
+        sizes = tuple(int(s * f) for s, f in zip(spatial, scales))
     align_corners = attrs.get("align_corners", True)
     out_shape = x.shape[:2] + tuple(sizes)
-    if align_corners and method != "nearest":
-        # jax.image has no align_corners; build coordinates explicitly
-        def resize_one(img):  # [spatial...]
-            coords = []
-            for i, (so, si) in enumerate(zip(sizes, spatial)):
-                if so == 1:
-                    c = jnp.zeros((so,))
-                else:
-                    c = jnp.linspace(0, si - 1, so)
-                coords.append(c)
-            mesh = jnp.meshgrid(*coords, indexing="ij")
-            return jax.scipy.ndimage.map_coordinates(
-                img, [m.reshape(-1) for m in mesh], order=1,
-                mode="nearest").reshape(sizes)
-        flat = x.reshape((-1,) + spatial)
-        out = jax.vmap(resize_one)(flat)
-        return one(out.reshape(out_shape).astype(x.dtype))
     jmethod = {"bilinear": "linear", "linear": "linear",
                "trilinear": "linear", "nearest": "nearest",
                "bicubic": "cubic"}[method]
+    if align_corners and method != "nearest":
+        # jax.image.resize is half-pixel-centers only; align_corners
+        # sampling (in = out * (si-1)/(so-1)) is expressed through
+        # scale_and_translate, which keeps the true method kernel
+        # (incl. cubic) and stays on the XLA-native resize path.
+        scales, trans = [], []
+        for so, si in zip(sizes, spatial):
+            if so == 1 or si == 1:
+                scales.append(1.0)
+                trans.append(0.0)   # in = out - 0, samples coord 0
+            else:
+                k = (so - 1) / (si - 1)
+                scales.append(k)
+                trans.append(0.5 - 0.5 * k)
+        dims = tuple(range(2, x.ndim))
+        out = jax.image.scale_and_translate(
+            x.astype(jnp.float32), out_shape, dims,
+            jnp.asarray(scales, jnp.float32),
+            jnp.asarray(trans, jnp.float32), jmethod,
+            antialias=False)  # the reference point-samples on downscale
+        return one(out.astype(x.dtype))
     return one(jax.image.resize(x, out_shape, jmethod).astype(x.dtype))
 
 
@@ -68,12 +89,16 @@ def _interp(ctx, ins, attrs, method, ndim_spatial=2):
 for _name, _m, _nd in [("bilinear_interp_v2", "bilinear", 2),
                        ("nearest_interp_v2", "nearest", 2),
                        ("linear_interp", "linear", 1),
+                       ("linear_interp_v2", "linear", 1),
                        ("bicubic_interp", "bicubic", 2),
                        ("bicubic_interp_v2", "bicubic", 2),
-                       ("trilinear_interp", "trilinear", 3)]:
+                       ("trilinear_interp", "trilinear", 3),
+                       ("trilinear_interp_v2", "trilinear", 3)]:
     def _mk(name, m, nd):
-        @register_op(name, inputs=("X", "OutSize"),
-                     non_diff_inputs=("OutSize",))
+        # v2 variants additionally carry SizeTensor/Scale tensor inputs
+        extra = ("SizeTensor", "Scale") if name.endswith("_v2") else ()
+        @register_op(name, inputs=("X", "OutSize") + extra,
+                     non_diff_inputs=("OutSize",) + extra)
         def _op(ctx, ins, attrs, _m=m, _nd=nd):
             return _interp(ctx, ins, attrs, _m, _nd)
     _mk(_name, _m, _nd)

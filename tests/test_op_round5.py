@@ -1,0 +1,121 @@
+"""Round-5 op closures: attention_lstm + linear/trilinear_interp_v2
+(VERDICT r4 missing #2/#3), each against a step-by-step numpy oracle.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from test_op_sweep_r3 import run_op
+
+
+def _sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def _attention_lstm_oracle(x, lens, c0, h0, aw, ab, lw, lb,
+                           scal=None, scal_b=None):
+    """Direct transcription of attention_lstm_op.cc:383-437 on the
+    padded [B,T,M] layout."""
+    B, T, M = x.shape
+    D = lw.shape[1] // 4
+    hs = np.zeros((B, T, D))
+    cs = np.zeros((B, T, D))
+    for b in range(B):
+        L = int(lens[b])
+        h, c = h0[b].copy(), c0[b].copy()
+        for t in range(L):
+            score = x[b, :L] @ aw[:M] + (ab if ab is not None else 0.0) \
+                + c @ aw[M:]
+            score = np.maximum(score, 0.0)
+            if scal is not None:
+                score = score * scal
+                if scal_b is not None:
+                    score = score + scal_b
+                score = np.maximum(score, 0.0)
+            e = np.exp(score - score.max())
+            p = e / e.sum()
+            lstm_x = p @ x[b, :L]                       # [M]
+            gates = lstm_x @ lw[D:] + h @ lw[:D] + lb   # [4D]
+            f = _sigmoid(gates[:D])
+            i = _sigmoid(gates[D:2 * D])
+            o = _sigmoid(gates[2 * D:3 * D])
+            cand = np.tanh(gates[3 * D:])
+            c = f * c + i * cand
+            h = np.tanh(c) * o
+            hs[b, t] = h
+            cs[b, t] = c
+    return hs, cs
+
+
+@pytest.mark.parametrize("with_scalar", [False, True])
+def test_attention_lstm_vs_oracle(with_scalar):
+    rng = np.random.RandomState(5)
+    B, T, M, D = 3, 6, 4, 5
+    x = rng.randn(B, T, M).astype(np.float32)
+    lens = np.array([6, 4, 2], np.int32)
+    c0 = rng.randn(B, D).astype(np.float32) * 0.1
+    h0 = rng.randn(B, D).astype(np.float32) * 0.1
+    aw = rng.randn(M + D, 1).astype(np.float32)
+    ab = np.array([[0.3]], np.float32)
+    lw = (rng.randn(D + M, 4 * D) * 0.2).astype(np.float32)
+    lb = rng.randn(1, 4 * D).astype(np.float32) * 0.1
+    scal = np.array([[1.7]], np.float32) if with_scalar else None
+    scal_b = np.array([[-0.2]], np.float32) if with_scalar else None
+    ins = {"X": x, "C0": c0, "H0": h0, "AttentionWeight": aw,
+           "AttentionBias": ab, "LSTMWeight": lw, "LSTMBias": lb,
+           "SeqLen": lens}
+    if with_scalar:
+        ins["AttentionScalar"] = scal
+        ins["AttentionScalarBias"] = scal_b
+    out = run_op("attention_lstm", ins, {})
+    hs, cs = _attention_lstm_oracle(
+        x, lens, c0, h0, aw.reshape(-1), 0.3, lw, lb.reshape(-1),
+        1.7 if with_scalar else None, -0.2 if with_scalar else None)
+    got_h = np.asarray(out["Hidden"][0])
+    got_c = np.asarray(out["Cell"][0])
+    np.testing.assert_allclose(got_h, hs, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(got_c, cs, rtol=2e-5, atol=2e-5)
+    # padded positions are zeroed
+    assert np.all(got_h[1, 4:] == 0) and np.all(got_c[2, 2:] == 0)
+
+
+def test_attention_lstm_grads_flow():
+    rng = np.random.RandomState(1)
+    B, T, M, D = 2, 4, 3, 4
+    x = jnp.asarray(rng.randn(B, T, M).astype(np.float32))
+    c0 = jnp.asarray(rng.randn(B, D).astype(np.float32) * 0.1)
+    aw = jnp.asarray(rng.randn(M + D, 1).astype(np.float32))
+    lw = jnp.asarray((rng.randn(D + M, 4 * D) * 0.2).astype(np.float32))
+    lb = jnp.asarray(np.zeros((1, 4 * D), np.float32))
+
+    def loss(lw_):
+        out = run_op("attention_lstm",
+                     {"X": x, "C0": c0, "AttentionWeight": aw,
+                      "LSTMWeight": lw_, "LSTMBias": lb}, {})
+        return jnp.sum(out["Hidden"][0] ** 2)
+
+    g = jax.grad(loss)(lw)
+    assert np.isfinite(np.asarray(g)).all()
+    assert float(jnp.abs(g).sum()) > 0
+
+
+def test_linear_interp_v2():
+    x = np.arange(8, dtype=np.float32).reshape(1, 1, 8)
+    out = run_op("linear_interp_v2", {"X": x},
+                 {"out_w": 4, "align_corners": True})
+    got = np.asarray(out["Out"][0])
+    exp = np.linspace(0, 7, 4, dtype=np.float32).reshape(1, 1, 4)
+    np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-5)
+
+
+def test_trilinear_interp_v2():
+    x = np.random.RandomState(0).randn(1, 2, 4, 4, 4).astype(np.float32)
+    out = run_op("trilinear_interp_v2", {"X": x},
+                 {"out_d": 8, "out_h": 8, "out_w": 8,
+                  "align_corners": False})
+    got = np.asarray(out["Out"][0])
+    assert got.shape == (1, 2, 8, 8, 8)
+    # nearest-resampled back recovers means approximately
+    assert abs(got.mean() - x.mean()) < 0.05
