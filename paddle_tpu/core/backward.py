@@ -5,8 +5,9 @@ API analog of /root/reference/python/paddle/fluid/backward.py
 appends one grad OpDesc per forward op via C++-registered GradOpMakers; the
 TPU-native design instead appends a single `backward` meta-op whose lowering
 (core/executor.py:_lower_backward) differentiates the traced forward section
-with jax.grad — XLA sees one fused forward+backward computation, which is
-both simpler and faster than per-op grad kernels.
+with one jax.value_and_grad pass whose primal values supersede the outer
+forward (dead-code-eliminated by XLA) — one fused forward+backward
+computation, simpler and faster than per-op grad kernels.
 
 Recompute segments (reference backward.py:37 ProgramStats,
 :145 modify_forward_desc_for_recompute) are carried as op-index ranges in the

@@ -17,8 +17,11 @@ optimizer ops (sgd/adam ParamOut) become functional state updates with buffer
 donation — the TPU analog of the reference's in-place kernel writes.
 
 The backward op appended by core/backward.py:append_backward is lowered here
-with jax.grad over the replayed forward section (XLA CSE dedupes the primal
-computation), replacing the reference's per-op GradOpMaker machinery
+with one jax.value_and_grad pass over the replayed forward section; the
+replay's primal values overwrite the eagerly-lowered forward's env entries,
+leaving the outer copy dead for XLA DCE (see _lower_backward — CSE was
+measured NOT to dedupe the two copies on transformer blocks). This replaces
+the reference's per-op GradOpMaker machinery
 (/root/reference/python/paddle/fluid/backward.py:1215).
 """
 from __future__ import annotations
@@ -116,8 +119,15 @@ class _BlockLowerer:
         """Lower the `backward` meta-op: grads of loss wrt parameter_list.
 
         Replays ops[0:idx] as a pure function of the parameters with the
-        *same* rng key chain, so dropout masks etc. match the primal pass
-        and XLA CSE merges the duplicate forward work.
+        *same* rng key chain inside ONE jax.value_and_grad pass, then
+        overwrites every forward output in env with the replay's primal
+        values.  The overwrite makes the eagerly-lowered outer forward
+        dead code — nothing downstream (fetches, optimizer ops) refers
+        to it — so XLA DCE removes it.  Relying on XLA CSE to merge the
+        two forwards instead was measured to FAIL on transformer blocks
+        (tools/check_backward_replay.py: 12-layer bert-shaped step held
+        ~80 duplicate forward dots); DCE of dead values is structural
+        and cannot fail that way.
         """
         op = ops[idx]
         loss_name = op.input("Loss")[0]
@@ -155,7 +165,7 @@ class _BlockLowerer:
             loss = env2[loss_name]
             if loss.ndim != 0:
                 loss = jnp.sum(loss)
-            return loss * jnp.asarray(scale, loss.dtype)
+            return loss * jnp.asarray(scale, loss.dtype), env2
 
         primal = {}
         for p in param_names:
@@ -165,9 +175,18 @@ class _BlockLowerer:
                 primal[p] = env[p]
             else:
                 raise KeyError(f"gradient target {p!r} has no primal value")
-        grads = jax.grad(fwd)(primal)
+        (_, fwd_env), grads = jax.value_and_grad(fwd, has_aux=True)(primal)
         for p in param_names:
             env[p + GRAD_SUFFIX] = grads[p]
+        # replace the outer forward's outputs with the replay's primal
+        # values so the outer copy is dead and XLA DCEs it (see
+        # docstring).  Walk fwd_env rather than declared output names:
+        # structural ops (while/conditional_block) publish carry vars
+        # beyond their declared Out slots, and an overwrite that misses
+        # one keeps the outer forward live through that name.
+        for n, v in fwd_env.items():
+            if n not in initial_env or v is not initial_env[n]:
+                env[n] = v
 
 
 def _run_with_remat(lowerer: _BlockLowerer, ops, env, segments):

@@ -430,10 +430,13 @@ def _layer_norm(ctx, ins, attrs):
     mean = jnp.mean(x, axis=red, keepdims=True)
     var = jnp.mean(jnp.square(x - mean), axis=red, keepdims=True)
     y = (x - mean) * jax.lax.rsqrt(var + eps)
+    # Scale/Bias are stored flat [prod(norm_dims)] (layer_norm_op.cc
+    # contract); fold them back over the normalized region so a
+    # begin_norm_axis < ndim-1 (multi-dim region) broadcasts correctly
     if ins.get("Scale"):
-        y = y * ins["Scale"][0]
+        y = y * ins["Scale"][0].reshape(x.shape[axis:])
     if ins.get("Bias"):
-        y = y + ins["Bias"][0]
+        y = y + ins["Bias"][0].reshape(x.shape[axis:])
     lead = int(np.prod(x.shape[:axis]))
     return {"Y": [y], "Mean": [mean.reshape(lead)],
             "Variance": [var.reshape(lead)]}
