@@ -1,0 +1,73 @@
+/* Pure-C smoke client for the paddle_tpu inference C API — the analog
+ * of the reference's non-Python inference clients
+ * (/root/reference/go/paddle/predictor.go:1, capi tests).
+ *
+ * Usage: capi_client_demo <artifact_dir> <n_floats> [v0 v1 ...]
+ * Feeds one float32 tensor of shape [1, n_floats] (values from argv or
+ * a ramp), prints each output as "OUT <i> <dtype> <ndim> <shape...>:"
+ * followed by up to 8 leading values — parsed by the pytest harness and
+ * compared against the Python SerializedPredictor on the same feeds. */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "pt_c_api.h"
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <artifact_dir> <n_floats> [values...]\n",
+            argv[0]);
+    return 2;
+  }
+  const char *dir = argv[1];
+  int n = atoi(argv[2]);
+  float *vals = (float *)malloc(sizeof(float) * (size_t)n);
+  for (int i = 0; i < n; ++i)
+    vals[i] = (argc > 3 + i) ? (float)atof(argv[3 + i]) : 0.01f * (float)i;
+
+  PT_Predictor *p = PT_NewPredictor(dir);
+  if (!p) {
+    fprintf(stderr, "create failed: %s\n", PT_GetLastError());
+    return 1;
+  }
+  printf("inputs=%d outputs=%d in0=%s\n", PT_GetInputNum(p),
+         PT_GetOutputNum(p), PT_GetInputName(p, 0));
+
+  PT_Tensor in;
+  in.dtype = PT_FLOAT32;
+  in.ndim = 2;
+  in.shape[0] = 1;
+  in.shape[1] = n;
+  in.data = vals;
+
+  PT_Tensor outs[8];
+  int n_out = PT_PredictorRun(p, &in, 1, outs, 8);
+  if (n_out < 0) {
+    fprintf(stderr, "run failed: %s\n", PT_GetLastError());
+    PT_DeletePredictor(p);
+    return 1;
+  }
+  for (int i = 0; i < n_out; ++i) {
+    long count = 1;
+    printf("OUT %d dtype=%d ndim=%d shape=", i, outs[i].dtype,
+           outs[i].ndim);
+    for (int d = 0; d < outs[i].ndim; ++d) {
+      printf("%s%lld", d ? "x" : "", (long long)outs[i].shape[d]);
+      count *= (long)outs[i].shape[d];
+    }
+    printf(" :");
+    if (outs[i].dtype == PT_FLOAT32) {
+      const float *f = (const float *)outs[i].data;
+      for (long k = 0; k < count && k < 8; ++k) printf(" %.6f", f[k]);
+    } else if (outs[i].dtype == PT_INT64) {
+      const long long *q = (const long long *)outs[i].data;
+      for (long k = 0; k < count && k < 8; ++k) printf(" %lld", q[k]);
+    }
+    printf("\n");
+  }
+  /* second run with the same predictor exercises buffer reuse */
+  n_out = PT_PredictorRun(p, &in, 1, outs, 8);
+  printf("second_run=%d\n", n_out);
+  PT_DeletePredictor(p);
+  free(vals);
+  return 0;
+}

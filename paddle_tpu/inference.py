@@ -239,22 +239,25 @@ class Predictor:
         with open(os.path.join(path, "signature.json"), "w") as f:
             json.dump({"feed_names": list(self.feed_names),
                        "fetch_names": list(self.fetch_names)}, f)
+        # ship the framework-free loader with the artifact so non-Python
+        # hosts (csrc/capi.cc embeds CPython) can serve it standalone
+        import shutil
+        shutil.copy(os.path.join(os.path.dirname(__file__),
+                                 "serving_core.py"),
+                    os.path.join(path, "serving_core.py"))
 
 
 class SerializedPredictor:
     """Serve an export_serialized() artifact: no Program, no registry,
-    no re-trace — deserialize the StableHLO and call."""
+    no re-trace — deserialize the StableHLO and call. Thin facade over
+    serving_core.SerializedCore (the framework-free loader shipped
+    inside the artifact for the C API)."""
 
     def __init__(self, path: str):
-        import json
-        import jax.export
-        with open(os.path.join(path, "model.stablehlo"), "rb") as f:
-            self._exported = jax.export.deserialize(f.read())
-        sig = json.load(open(os.path.join(path, "signature.json")))
-        self.feed_names = sig["feed_names"]
-        self.fetch_names = sig["fetch_names"]
-        loaded = np.load(os.path.join(path, "params.npz"))
-        self._state = {k: loaded[k] for k in loaded.files}
+        from .serving_core import SerializedCore
+        self._core = SerializedCore(path)
+        self.feed_names = self._core.feed_names
+        self.fetch_names = self._core.fetch_names
 
     def get_input_names(self):
         return list(self.feed_names)
@@ -263,14 +266,7 @@ class SerializedPredictor:
         return list(self.fetch_names)
 
     def run(self, feeds: Sequence[np.ndarray]):
-        if len(feeds) != len(self.feed_names):
-            raise ValueError("expected %d feeds (%s), got %d"
-                             % (len(self.feed_names), self.feed_names,
-                                len(feeds)))
-        feed_map = {n: np.asarray(v)
-                    for n, v in zip(self.feed_names, feeds)}
-        outs = self._exported.call(self._state, feed_map)
-        return [np.asarray(o) for o in outs]
+        return self._core.run(feeds)
 
 
 def create_predictor(config: Config) -> Predictor:

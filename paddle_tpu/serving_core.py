@@ -1,0 +1,71 @@
+"""Standalone serving core for export_serialized() artifacts.
+
+Deliberately free of any paddle_tpu package dependency (imports: json,
+os, numpy, jax) so non-Python hosts can load it without pulling the
+framework in: `export_serialized` copies this file INTO the artifact
+directory, and the inference C API (csrc/capi.cc) embeds a CPython
+interpreter and loads `<artifact>/serving_core.py` by path — the
+TPU-native analog of the reference shipping a self-contained serialized
+engine behind its C API
+(/root/reference/paddle/fluid/inference/capi/c_api.cc:1,
+analysis_predictor.cc SaveOptimModel:900).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+__all__ = ["SerializedCore"]
+
+# order IS the C ABI dtype enum (csrc/pt_c_api.h) — append only
+_DTYPES = ["float32", "int32", "int64", "float64", "uint8",
+           "float16", "bfloat16", "bool"]
+
+
+def _np_dtype(code: int):
+    name = _DTYPES[code]
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+class SerializedCore:
+    """Load + run a serialized artifact (StableHLO + params + signature).
+
+    run() takes/returns plain numpy arrays; dtype_code()/shape helpers
+    exist for flat-ABI callers (the C API) that speak in enums.
+    """
+
+    def __init__(self, path: str):
+        import jax.export
+        with open(os.path.join(path, "model.stablehlo"), "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        with open(os.path.join(path, "signature.json")) as f:
+            sig = json.load(f)
+        self.feed_names = list(sig["feed_names"])
+        self.fetch_names = list(sig["fetch_names"])
+        loaded = np.load(os.path.join(path, "params.npz"))
+        self._state = {k: loaded[k] for k in loaded.files}
+
+    def run(self, feeds):
+        if len(feeds) != len(self.feed_names):
+            raise ValueError("expected %d feeds (%s), got %d"
+                             % (len(self.feed_names), self.feed_names,
+                                len(feeds)))
+        feed_map = {n: np.asarray(v)
+                    for n, v in zip(self.feed_names, feeds)}
+        outs = self._exported.call(self._state, feed_map)
+        return [np.ascontiguousarray(np.asarray(o)) for o in outs]
+
+    # --- flat-ABI helpers for the C API --------------------------------
+    @staticmethod
+    def dtype_code(arr) -> int:
+        return _DTYPES.index(str(arr.dtype))
+
+    @staticmethod
+    def from_flat(buf: bytes, dtype_code: int, shape) -> np.ndarray:
+        return np.frombuffer(buf, dtype=_np_dtype(dtype_code)).reshape(
+            [int(s) for s in shape]).copy()
