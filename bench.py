@@ -187,23 +187,34 @@ def _run_worker(backend):
     bert_tps, bert_mfu, attn_path, mosaic_ok, bert_b = _bench_bert(on_tpu)
     rn_ips, rn_mfu = _bench_resnet(on_tpu)
 
+    # vs_baseline is only meaningful on TPU; a CPU smoke writing a tiny
+    # number into the same field would chart as a 99% regression, so
+    # off-TPU runs null it and carry their numbers in cpu_smoke instead
+    # (BENCH JSON schema, PERF_NOTES.md)
     vs = min(bert_mfu, rn_mfu) / 0.45
-    print(json.dumps({
+    rec = {
         "metric": "tokens/sec/chip BERT-base (S=512, masked-LM, bf16) + "
                   "images/sec/chip ResNet-50 (224px, B=256, bf16)"
         if on_tpu else "cpu smoke (tiny BERT + resnet18)",
         "value": round(bert_tps, 1),
         "unit": "tokens/s",
-        "vs_baseline": round(vs, 4),
+        "vs_baseline": round(vs, 4) if on_tpu else None,
         "backend": jax.default_backend() if on_tpu else "cpu-fallback",
+        "attention_path": attn_path,
+        "mosaic_kernels_in_hlo": bool(mosaic_ok),
+    }
+    detail = {
         "bert_batch": bert_b,
         "bert_tokens_per_sec": round(bert_tps, 1),
         "bert_mfu": round(bert_mfu, 4),
         "resnet50_images_per_sec": round(rn_ips, 1),
         "resnet50_mfu": round(rn_mfu, 4),
-        "attention_path": attn_path,
-        "mosaic_kernels_in_hlo": bool(mosaic_ok),
-    }))
+    }
+    if on_tpu:
+        rec.update(detail)
+    else:
+        rec["cpu_smoke"] = detail
+    print(json.dumps(rec))
 
 
 def _graceful_group_kill(proc):

@@ -37,7 +37,9 @@ struct PT_Predictor {
   std::vector<std::vector<char>> out_bufs; /* last-run output storage */
 };
 
-static std::string g_last_error;
+/* thread_local: concurrent host threads each get their own error slot
+ * (unsynchronized writes to one global std::string would be UB) */
+static thread_local std::string g_last_error;
 
 static const size_t kItemSize[] = {4, 4, 8, 8, 1, 2, 2, 1};
 static const int kNumDtypes = 8;

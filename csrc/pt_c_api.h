@@ -51,6 +51,7 @@ const char *PT_GetOutputName(PT_Predictor *, int i);
 int PT_PredictorRun(PT_Predictor *, const PT_Tensor *ins, int n_in,
                     PT_Tensor *outs, int max_out);
 
+/* Last error of THIS thread (thread-local storage). */
 const char *PT_GetLastError(void);
 void PT_DeletePredictor(PT_Predictor *);
 

@@ -581,7 +581,9 @@ class Executor:
         for n, batch in enumerate(dataset, start=1):
             outs = self.run(program, feed=batch,
                             fetch_list=fetch_names, scope=scope)
-            results.append(outs[0] if outs else None)
+            # full fetch_list per batch (single-var callers index [0]);
+            # ADVICE r4: keeping only outs[0] silently dropped the rest
+            results.append(list(outs) if outs else None)
             if fetch_names and (debug or n % max(print_period, 1) == 0):
                 import logging
                 logging.getLogger("paddle_tpu").info(

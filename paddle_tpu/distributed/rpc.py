@@ -289,22 +289,40 @@ class PsServer:
                 daemon_threads = True
 
             path = addr
-            if os.path.exists(path):
-                # unlink only a STALE file (nothing accepting): blindly
-                # unlinking would silently hijack a live server's
-                # endpoint where TCP fails loudly with EADDRINUSE
-                probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-                try:
-                    probe.connect(path)
-                    probe.close()
-                    raise OSError(
-                        "uds endpoint %s is in use by a live server"
-                        % endpoint)
-                except (ConnectionRefusedError, FileNotFoundError):
-                    os.unlink(path)
-                finally:
-                    probe.close()
-            self._srv = UnixServer(path, Handler)
+            # serialize the probe-unlink-bind sequence through a
+            # flock'd persistent lock file: two servers starting
+            # concurrently could otherwise both observe a dead socket,
+            # both unlink, and the second bind would silently steal the
+            # endpoint the first just claimed (ADVICE r4 TOCTOU).
+            # flock (not O_EXCL create) because the kernel releases it
+            # automatically if the holder dies mid-bind — no stale-lock
+            # takeover logic, which would itself be racy.
+            import fcntl
+            lock_path = path + ".lock"
+            lock_fd = os.open(lock_path, os.O_CREAT | os.O_WRONLY, 0o644)
+            fcntl.flock(lock_fd, fcntl.LOCK_EX)
+            try:
+                if os.path.exists(path):
+                    # unlink only a STALE file (nothing accepting):
+                    # blindly unlinking would silently hijack a live
+                    # server's endpoint where TCP fails loudly with
+                    # EADDRINUSE
+                    probe = socket.socket(socket.AF_UNIX,
+                                          socket.SOCK_STREAM)
+                    try:
+                        probe.connect(path)
+                        probe.close()
+                        raise OSError(
+                            "uds endpoint %s is in use by a live server"
+                            % endpoint)
+                    except (ConnectionRefusedError, FileNotFoundError):
+                        os.unlink(path)
+                    finally:
+                        probe.close()
+                self._srv = UnixServer(path, Handler)
+            finally:
+                fcntl.flock(lock_fd, fcntl.LOCK_UN)
+                os.close(lock_fd)  # lock file stays (persistent lock)
             self._uds_path = path
             self.endpoint = endpoint
         else:

@@ -34,6 +34,11 @@ _DEFS: Dict[str, Any] = {
     # False so a broken kernel can never silently ship — the round-2
     # bench measured the fallback without anyone noticing.
     "FLAGS_flash_attention_fallback": False,
+    # in-kernel hardware-PRNG flash dropout: OFF until validated against
+    # the mask oracle on real TPU (ADVICE r4: the seed path has no
+    # interpret-mode coverage, so a Mosaic lowering bug would corrupt
+    # grads silently). scripts/tpu_experiments.py flips it for the A/B.
+    "FLAGS_flash_inkernel_dropout": False,
     # embedding dW strategy: True = chunked one-hot MXU matmuls instead
     # of XLA scatter-add (the BERT embedding-backward experiment;
     # scripts/tpu_experiments.py measures both). Trace-time flag — flip

@@ -620,11 +620,16 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
     drop_mask = None
     drop_seed = None
     if want_drop:
-        if bias is None and not _use_interpret() and _HAS_PLTPU:
+        from ..flags import get_flag
+        if (bias is None and not _use_interpret() and _HAS_PLTPU
+                and get_flag("FLAGS_flash_inkernel_dropout")):
             # in-kernel hardware-PRNG dropout: no [B,H,Sq,Sk] mask in
             # HBM at all. Constrained to bias=None because the dbias
             # blockwise-recompute path (plain XLA, outside Pallas)
-            # cannot regenerate the in-kernel pattern.
+            # cannot regenerate the in-kernel pattern. Opt-in flag: the
+            # seed path has no interpret-mode oracle, so it stays off
+            # until the TPU-only parity test has passed on hardware
+            # (tests/test_kernels.py::test_flash_inkernel_dropout_tpu).
             import numpy as _np
             drop_seed = jax.random.randint(
                 dropout_rng, (1, 1), 0, _np.iinfo(_np.int32).max,

@@ -338,8 +338,28 @@ def lower_pipeline_train(lowerer, op, env: Dict[str, Any]) -> None:
             src = jnp.clip(t - stage, 0, n_mb - 1)
             feeds_mb = {k: v[src] for k, v in feeds_all.items()}
             key_t = jax.random.fold_in(key, t)
-            fb2, ib2, loss_mb = jax.lax.switch(
-                stage, branches, fb, ib, feeds_mb, params, extras, key_t)
+            # warmup/drain ticks (stage idle on the GPipe diagonal) must
+            # not RUN the section at all: zero-filled boundary buffers
+            # drive ops with unbounded backward at 0 (log, sqrt, div) to
+            # inf, and 0-cotangent * inf = NaN would poison the psum'd
+            # parameter grads (ADVICE r4). lax.cond skips the compute —
+            # also saving the warmup/drain FLOPs — and passes the
+            # buffers through unchanged, which downstream stages only
+            # ever read on their own live ticks.
+            live = jnp.logical_and(t >= stage, t - stage < n_mb)
+
+            def run_tick(fb, ib, feeds_mb, params, extras, key_t):
+                return jax.lax.switch(stage, branches, fb, ib, feeds_mb,
+                                      params, extras, key_t)
+
+            def skip_tick(fb, ib, feeds_mb, params, extras, key_t):
+                # fb[0]*0: a device-varying zero (fresh constants are
+                # unvarying and would mismatch the live branch's vma)
+                return fb, ib, fb[0] * 0.0
+
+            fb2, ib2, loss_mb = jax.lax.cond(
+                live, run_tick, skip_tick, fb, ib, feeds_mb, params,
+                extras, key_t)
             valid = jnp.logical_and(stage == n_stages - 1,
                                     t >= n_stages - 1)
             loss_acc = loss_acc + jnp.where(valid, loss_mb, 0.0)

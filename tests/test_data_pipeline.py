@@ -365,7 +365,9 @@ def test_executor_train_from_dataset(tmp_path):
                                         thread=2, fetch_list=[loss],
                                         print_period=1)
     assert len(losses) == 4  # 8 instances / batch 2 / 2 files
-    assert all(np.isfinite(float(np.asarray(l))) for l in losses)
+    # each entry is the full fetch_list for that batch
+    assert all(len(l) == 1 and np.isfinite(float(np.asarray(l[0])))
+               for l in losses)
 
 
 def test_async_executor_legacy_facade(tmp_path):

@@ -122,10 +122,13 @@ def test_rank_failure_kills_pod():
     surviving ranks and report failure (fleet/launch.py; reference
     launch_utils.py TrainerProc watchdog)."""
     env = _env(2)
+    # 420s budget: the rank processes each import jax from scratch,
+    # which under an oversubscribed -n 8 host can take minutes before
+    # the watchdog even gets a chance to observe the rank-1 death
     r = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.fleet.launch",
          "--nproc_per_node", "2", RUNNER, "die"],
-        env=env, capture_output=True, timeout=120)
+        env=env, capture_output=True, timeout=420)
     # rank 1 exits 17; the watchdog must kill hanging rank 0 and
     # report a nonzero pod exit — NOT run the full 120s sleep
     assert r.returncode != 0, r.stdout.decode() + r.stderr.decode()

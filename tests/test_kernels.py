@@ -253,8 +253,12 @@ def test_flash_block_divisor_fallback():
 @pytest.mark.skipif(jax.default_backend() != "tpu",
                     reason="in-kernel PRNG dropout needs the real TPU "
                            "(pltpu.prng has no interpret-mode impl)")
-def test_flash_inkernel_dropout_tpu():
+def test_flash_inkernel_dropout_tpu(request):
     from paddle_tpu.kernels.flash_attention import flash_attention
+    from paddle_tpu.flags import set_flags
+    set_flags({"FLAGS_flash_inkernel_dropout": True})  # opt-in path
+    request.addfinalizer(
+        lambda: set_flags({"FLAGS_flash_inkernel_dropout": False}))
     B, H, S, D = 2, 4, 1024, 64
     rng = np.random.RandomState(0)
     q = jnp.asarray(rng.randn(B, H, S, D) * 0.1, jnp.bfloat16)

@@ -368,3 +368,43 @@ def test_static_pipeline_program_json_roundtrip():
                            fetch_list=[loss.name])
             losses.append(float(out))
     assert losses[-1] < losses[0], losses
+
+
+def test_static_pipeline_log_section_grads_finite():
+    """A section whose op has unbounded backward at 0 (log) must not
+    NaN the parameter grads via warmup/drain ticks: idle ticks are
+    lax.cond-skipped, never running sections on zero boundary buffers
+    (ADVICE r4 pipeline_static finding)."""
+    from paddle_tpu.parallel import PipelineOptimizer
+    rng = np.random.RandomState(13)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [8])
+        y = pt.layers.data("y", [1])
+        with pt.device_guard("gpu:0"):
+            h0 = pt.layers.fc(x, 16, act="sigmoid")
+        with pt.device_guard("gpu:1"):
+            # log of a strictly-positive activation: finite on real
+            # data, inf at the zero-filled idle-tick buffers
+            h1 = pt.layers.nn.log(h0)
+        with pt.device_guard("gpu:2"):
+            pred = pt.layers.fc(h1, 1)
+            loss = pt.layers.mean(pt.layers.nn.square(
+                pt.layers.elementwise_sub(pred, y)))
+        PipelineOptimizer(pt.optimizer.SGD(0.01), num_microbatches=4) \
+            .minimize(loss, startup_program=startup, program=main)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(4):
+            xb = rng.randn(8, 8).astype(np.float32)
+            yb = rng.randn(8, 1).astype(np.float32)
+            out, = exe.run(main, feed={"x": xb, "y": yb},
+                           fetch_list=[loss])
+            losses.append(float(out))
+        assert np.isfinite(losses).all(), losses
+        for v in main.all_parameters():
+            arr = np.asarray(scope.find_var(v.name))
+            assert np.isfinite(arr).all(), v.name
