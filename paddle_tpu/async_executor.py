@@ -51,6 +51,10 @@ class AsyncExecutor:
         ds.set_use_var([
             _V(s["name"], type_map.get(s["type"], s["type"]))
             for s in data_feed.slots if s["is_used"]])
-        return self._exe.train_from_dataset(
+        results = self._exe.train_from_dataset(
             program=program, dataset=ds, thread=thread_num,
             debug=debug, fetch_list=list(fetch_names or []))
+        # legacy call shape: one value per batch (train_from_dataset
+        # itself now returns the full fetch_list per batch)
+        return [r[0] if isinstance(r, list) and len(r) == 1 else r
+                for r in results]

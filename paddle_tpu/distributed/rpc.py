@@ -316,7 +316,12 @@ class PsServer:
                             "uds endpoint %s is in use by a live server"
                             % endpoint)
                     except (ConnectionRefusedError, FileNotFoundError):
-                        os.unlink(path)
+                        # a dying server's shutdown may unlink between
+                        # our exists() check and here
+                        try:
+                            os.unlink(path)
+                        except FileNotFoundError:
+                            pass
                     finally:
                         probe.close()
                 self._srv = UnixServer(path, Handler)

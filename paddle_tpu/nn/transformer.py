@@ -83,6 +83,14 @@ def _attention_core(q, k, v, attn_mask, dropout_p, training, is_causal=False):
     import jax.numpy as jnp
     scale = 1.0 / math.sqrt(q.shape[-1])
     want_dropout = bool(dropout_p) and training
+    if attn_mask is not None:
+        # attn_mask is a padding/visibility mask derived from input ids
+        # — non-differentiable by contract (matching the reference's
+        # usage; a LEARNABLE attention bias should call the functional
+        # flash_attention with bias_needs_grad=True instead). Making it
+        # explicit here lets the flash path skip the dbias recompute
+        # and keeps the in-kernel dropout path eligible.
+        attn_mask = jax.lax.stop_gradient(attn_mask)
     if routes_to_flash(q.shape[1], q.shape[-1]):
         try:
             from ..kernels.flash_attention import flash_attention
@@ -93,7 +101,7 @@ def _attention_core(q, k, v, attn_mask, dropout_p, training, is_causal=False):
                 jnp.transpose(v, (0, 2, 1, 3)),
                 bias=attn_mask, causal=is_causal, sm_scale=scale,
                 dropout_rate=float(dropout_p) if want_dropout else 0.0,
-                dropout_rng=rng)
+                dropout_rng=rng, bias_needs_grad=False)
             _PATH_LOG.append("flash")
             return jnp.transpose(out, (0, 2, 1, 3))
         except Exception:

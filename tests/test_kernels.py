@@ -296,3 +296,55 @@ def test_flash_inkernel_dropout_tpu(request):
           - float(scalar_f(qf - eps * dq_dir))) / (2 * eps)
     analytic = float(jnp.sum(g * dq_dir))
     np.testing.assert_allclose(fd, analytic, rtol=5e-2, atol=1e-3)
+
+
+def test_flash_bias_needs_grad_false_matches_reference():
+    """bias_needs_grad=False must not change q/k/v grads (the dbias
+    recompute is skipped, its cotangent is zeros) — the padding-mask
+    contract that makes in-kernel dropout eligible with a bias."""
+    from paddle_tpu.kernels.flash_attention import (attention_reference,
+                                                    flash_attention)
+    rng = np.random.RandomState(2)
+    B, H, S, D = 1, 2, 256, 64
+    q = jnp.asarray(rng.randn(B, H, S, D) * 0.1, jnp.float32)
+    k = jnp.asarray(rng.randn(B, H, S, D) * 0.1, jnp.float32)
+    v = jnp.asarray(rng.randn(B, H, S, D) * 0.1, jnp.float32)
+    mask = np.zeros((B, 1, 1, S), np.float32)
+    mask[..., -32:] = -1e9
+    bias = jnp.asarray(mask)
+
+    def loss_flash(q, k, v, b):
+        return jnp.sum(flash_attention(q, k, v, bias=b, sm_scale=0.125,
+                                       block_q=128, block_k=128,
+                                       bias_needs_grad=False) ** 2)
+
+    def loss_ref(q, k, v, b):
+        return jnp.sum(attention_reference(q, k, v, bias=b,
+                                           sm_scale=0.125) ** 2)
+
+    gq, gk, gv, gb = jax.grad(loss_flash, argnums=(0, 1, 2, 3))(q, k, v,
+                                                                bias)
+    rq, rk, rv, _ = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(rq),
+                               atol=2e-4, rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(rk),
+                               atol=2e-4, rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(rv),
+                               atol=2e-4, rtol=2e-3)
+    assert np.all(np.asarray(gb) == 0.0)  # declared non-differentiable
+
+
+def test_attention_core_mask_is_stop_gradiented():
+    """The nn router treats attn_mask as non-differentiable by contract
+    (both composed and flash paths)."""
+    from paddle_tpu.nn.transformer import _attention_core
+    rng = np.random.RandomState(3)
+    B, S, H, D = 2, 64, 4, 16
+    q = jnp.asarray(rng.randn(B, S, H, D) * 0.1, jnp.float32)
+    mask = jnp.zeros((B, 1, 1, S), jnp.float32)
+
+    def loss(m):
+        return jnp.sum(_attention_core(q, q, q, m, 0.0, False) ** 2)
+
+    g = jax.grad(loss)(mask)
+    assert np.all(np.asarray(g) == 0.0)
