@@ -14,19 +14,23 @@ Without this, tracing a data-dependent branch raises
 TracerBoolConversionError (loud but dead-end); with it, both branches
 compile — the reference's `to_static` contract.
 
-Scope (fail-loud beyond it): `if`/`elif`/`else` and `while` are
+Scope (fail-loud beyond it): `if`/`elif`/`else`, `while`, and
+`for i in range(...)` (desugared into the while conversion, with a
+statically-signed step; loop_transformer.py's for-range path) are
 converted; `return`/`break`/`continue` INSIDE a converted block raise a
 conversion error (the reference has dedicated transformers for those);
-`for` loops are left as Python (static unrolling — correct under jit for
-python iterables, the common case).
+non-range `for` iterables and variable-signed steps are left as Python
+(static unrolling — correct under jit for python iterables).
 
 Variable convention (ifelse_transformer.py's modified-name analysis):
 every name assigned inside a branch/loop body becomes an output of the
 generated branch function; a name assigned in only one `if` branch falls
 back to the outer value (or an Undefined sentinel that raises on use —
-utils.UndefinedVar's contract). Loop-carried names must be defined
-before the loop, the lax.while_loop requirement the reference's
-loop_transformer meets with to_static-time name creation.
+utils.UndefinedVar's contract). A loop-carried name undefined before
+the loop enters as the Undefined sentinel: fine for a python-dispatch
+loop (overwritten on iteration 1), a named ConversionError for a traced
+one (lax.while_loop needs initialized carries — the requirement the
+reference's loop_transformer meets with to_static-time name creation).
 """
 from __future__ import annotations
 
@@ -187,6 +191,13 @@ def _pt_while(cond_fn, body_fn, init):
         return vars_
 
     vals, flags = _unwrap_tree(tuple(init))
+    for v in jax.tree.leaves(vals):
+        if isinstance(v, _Undefined):
+            raise ConversionError(
+                "converted loop carries %r, which is undefined before "
+                "the loop; a TRACED (lax.while_loop) loop needs every "
+                "carried variable initialized with its loop-invariant "
+                "shape/dtype before the loop starts" % v._name)
 
     @_isolated_keys
     def cond(c):
@@ -355,12 +366,20 @@ class _CtrlFlow(ast.NodeTransformer):
                 elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
                 ctx=ast.Load()))],
             decorator_list=[], type_params=[])
+        # snapshot carried names tolerantly: a body-local temp (assigned
+        # inside the loop, undefined before it) enters as _Undefined —
+        # the python dispatch just overwrites it on iteration 1, and the
+        # traced dispatch reports it by name instead of UnboundLocalError
+        caps = [_try_capture("__pt_w%d_%d" % (self.n, i), n)
+                for i, n in enumerate(names)]
         call = ast.Call(
             func=ast.Name(id="_pt_while", ctx=ast.Load()),
             args=[ast.Name(id=c_name, ctx=ast.Load()),
                   ast.Name(id=b_name, ctx=ast.Load()),
-                  ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
-                                  for n in names], ctx=ast.Load())],
+                  ast.Tuple(elts=[
+                      ast.Name(id="__pt_w%d_%d" % (self.n, i),
+                               ctx=ast.Load())
+                      for i in range(len(names))], ctx=ast.Load())],
             keywords=[])
         if names:
             assign = ast.Assign(
@@ -370,7 +389,72 @@ class _CtrlFlow(ast.NodeTransformer):
                 value=call)
         else:
             assign = ast.Expr(value=call)
-        return [c_def, b_def, assign]
+        return [c_def, b_def] + caps + [assign]
+
+    def visit_For(self, node):
+        """`for i in range(...)` desugars to the while conversion
+        (the reference's loop_transformer.py for_loop path), so a
+        TENSOR trip count lowers to lax.while_loop instead of dying in
+        python's range(). Non-range iterables and loops with
+        break/continue/else stay python (concrete iterables unroll
+        under trace, which is already correct). After a converted loop
+        the loop var holds `stop` (first non-iterated value), not
+        python's last-iterated value — same off-by-one the reference's
+        conversion has."""
+        node = self.generic_visit(node)
+        if node.orelse or _has_flow_escape(node.body):
+            return node
+        if not (isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and not node.iter.keywords
+                and isinstance(node.target, ast.Name)):
+            return node
+        a = node.iter.args
+        if not 1 <= len(a) <= 3 or any(isinstance(x, ast.Starred)
+                                       for x in a):
+            return node
+        start = a[0] if len(a) >= 2 else ast.Constant(value=0)
+        stop = a[1] if len(a) >= 2 else a[0]
+        step = a[2] if len(a) == 3 else ast.Constant(value=1)
+        # the loop test direction needs the step's SIGN at conversion
+        # time; a non-literal step stays python rather than silently
+        # running zero iterations under the wrong comparison
+        if isinstance(step, ast.UnaryOp) and isinstance(step.op, ast.USub) \
+                and isinstance(step.operand, ast.Constant):
+            desc = True
+        elif isinstance(step, ast.Constant) \
+                and isinstance(step.value, (int, float)):
+            desc = step.value < 0
+        else:
+            return node
+        self.n += 1
+        ivar = node.target.id
+        lim = "__pt_flim%d" % self.n
+        stp = "__pt_fstep%d" % self.n
+        # evaluate stop/step BEFORE binding the loop variable: a bound
+        # expression may reference the loop var's prior value
+        # (`for i in range(0, i)`)
+        init = [
+            ast.Assign(targets=[ast.Name(id=lim, ctx=ast.Store())],
+                       value=stop),
+            ast.Assign(targets=[ast.Name(id=stp, ctx=ast.Store())],
+                       value=step),
+            ast.Assign(targets=[ast.Name(id=ivar, ctx=ast.Store())],
+                       value=start),
+        ]
+        test = ast.Compare(
+            left=ast.Name(id=ivar, ctx=ast.Load()),
+            ops=[ast.Gt() if desc else ast.Lt()],
+            comparators=[ast.Name(id=lim, ctx=ast.Load())])
+        inc = ast.AugAssign(
+            target=ast.Name(id=ivar, ctx=ast.Store()), op=ast.Add(),
+            value=ast.Name(id=stp, ctx=ast.Load()))
+        wl = ast.While(test=test, body=list(node.body) + [inc], orelse=[])
+        converted = self.visit_While(wl)
+        if not isinstance(converted, list):
+            converted = [converted]
+        return init + converted
 
 
 def _noargs():
@@ -403,6 +487,11 @@ def convert_to_static(fn: Callable) -> Callable:
              and not _has_flow_escape(n.body + n.orelse))
             or (isinstance(n, ast.While) and not n.orelse
                 and not _has_flow_escape(n.body))
+            or (isinstance(n, ast.For) and not n.orelse
+                and not _has_flow_escape(n.body)
+                and isinstance(n.iter, ast.Call)
+                and isinstance(n.iter.func, ast.Name)
+                and n.iter.func.id == "range")
             for n in ast.walk(fdef))
         if has_ctrl and fn.__closure__:
             warnings.warn(

@@ -1,2 +1,4 @@
 from .auto_checkpoint import train_epoch_range  # noqa: F401
 from .checkpoint_saver import CheckpointSaver  # noqa: F401
+from .sharded import (ShardedCheckpointer,  # noqa: F401
+                      restore_train_step, save_train_step)

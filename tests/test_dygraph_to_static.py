@@ -156,3 +156,165 @@ def test_read_modify_inside_branch():
     g = to_static(f)
     np.testing.assert_allclose(np.asarray(g(jnp.ones(2))), [4.0, 4.0])
     np.testing.assert_allclose(np.asarray(g(-jnp.ones(2))), [0.0, 0.0])
+
+
+def test_for_range_tensor_bound_converts():
+    """for i in range(tensor) lowers through the while conversion to
+    lax.while_loop (reference loop_transformer for-range path)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.dygraph.dygraph_to_static import convert_to_static
+
+    def f(n, x):
+        acc = x * 0.0
+        for i in range(n):
+            acc = acc + x * (i + 1)
+        return acc
+
+    conv = convert_to_static(f)
+    x = jnp.asarray([1.0, 2.0])
+    # concrete bound: matches python
+    np.testing.assert_allclose(np.asarray(conv(3, x)),
+                               np.asarray(f(3, x)), rtol=1e-6)
+    # traced (tensor) bound: must compile and match
+    out = jax.jit(conv)(jnp.asarray(4), x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(f(4, x)),
+                               rtol=1e-6)
+
+
+def test_for_range_start_stop_step_and_descending():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.dygraph.dygraph_to_static import convert_to_static
+
+    def f(n, x):
+        acc = x * 0.0
+        for i in range(2, n, 2):
+            acc = acc + x * i
+        return acc
+
+    conv = convert_to_static(f)
+    x = jnp.asarray([1.0])
+    out = jax.jit(conv)(jnp.asarray(9), x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(f(9, x)),
+                               rtol=1e-6)
+
+    def g(x):
+        acc = x * 0.0
+        for i in range(5, 0, -1):
+            acc = acc + x * i
+        return acc
+
+    convg = convert_to_static(g)
+    np.testing.assert_allclose(np.asarray(convg(x)), np.asarray(g(x)),
+                               rtol=1e-6)
+
+
+def test_for_nonrange_stays_python():
+    from paddle_tpu.dygraph.dygraph_to_static import convert_to_static
+
+    def f(xs):
+        total = 0.0
+        for v in xs:       # list iterable: unrolls, stays python
+            total = total + v
+        return total
+
+    conv = convert_to_static(f)
+    assert conv([1.0, 2.0, 3.0]) == 6.0
+
+
+def test_for_with_break_stays_python():
+    from paddle_tpu.dygraph.dygraph_to_static import convert_to_static
+
+    def f(n):
+        total = 0
+        for i in range(n):
+            if i == 2:
+                break
+            total += i
+        return total
+
+    conv = convert_to_static(f)
+    assert conv(5) == f(5) == 1
+
+
+def test_for_body_fresh_temp_var():
+    """A temp assigned only inside the loop body must not crash the
+    conversion (python dispatch overwrites the Undefined sentinel)."""
+    from paddle_tpu.dygraph.dygraph_to_static import convert_to_static
+    import jax.numpy as jnp
+
+    def f(x):
+        acc = x * 0.0
+        for i in range(3):
+            tmp = x * (i + 1)
+            acc = acc + tmp
+        return acc
+
+    conv = convert_to_static(f)
+    np.testing.assert_allclose(np.asarray(conv(jnp.ones(2))), [6.0, 6.0])
+
+
+def test_for_traced_bound_with_fresh_temp_raises_named_error():
+    from paddle_tpu.dygraph.dygraph_to_static import (ConversionError,
+                                                      convert_to_static)
+    import jax
+    import jax.numpy as jnp
+
+    def f(n, x):
+        acc = x * 0.0
+        for i in range(n):
+            tmp = x * i
+            acc = acc + tmp
+        return acc
+
+    conv = convert_to_static(f)
+    with pytest.raises(ConversionError, match="tmp"):
+        jax.jit(conv)(jnp.asarray(3), jnp.ones(2))
+
+
+def test_for_variable_step_stays_python():
+    from paddle_tpu.dygraph.dygraph_to_static import convert_to_static
+
+    def f(s):
+        acc = 0
+        for i in range(5, 0, s):
+            acc += i
+        return acc
+
+    conv = convert_to_static(f)
+    assert conv(-1) == f(-1) == 15
+    assert conv(-2) == f(-2) == 5 + 3 + 1
+
+
+def test_for_bound_references_loop_var_prior_value():
+    from paddle_tpu.dygraph.dygraph_to_static import convert_to_static
+
+    def h(i):
+        acc = 0
+        for i in range(0, i):
+            acc += 1
+        return acc
+
+    conv = convert_to_static(h)
+    assert conv(5) == h(5) == 5
+
+
+def test_for_starred_range_args_stay_python():
+    from paddle_tpu.dygraph.dygraph_to_static import convert_to_static
+    import jax.numpy as jnp
+
+    def f(x, n):
+        acc = x * 0.0
+        for i in range(*(0, 3)):
+            acc = acc + x
+        # a convertible data-dependent while must STILL convert even
+        # though the starred-range loop stays python
+        while (acc < n).all():
+            acc = acc + 1.0
+        return acc
+
+    import jax
+    conv = convert_to_static(f)
+    out = jax.jit(conv)(jnp.asarray([0.0]), jnp.asarray(5.0))
+    assert float(out[0]) >= 5.0

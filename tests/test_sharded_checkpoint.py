@@ -1,0 +1,84 @@
+"""Orbax-backed sharded checkpointing on the virtual 8-device mesh:
+save a dp x mp sharded train state, restore with identical shardings
+and values, resume training bit-exact.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.incubate.checkpoint.sharded import (ShardedCheckpointer,
+                                                    restore_train_step,
+                                                    save_train_step)
+
+
+def _mesh():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    return Mesh(np.array(devs[:8]).reshape(4, 2), ("dp", "mp"))
+
+
+def test_sharded_pytree_roundtrip(tmp_path):
+    mesh = _mesh()
+    rng = np.random.RandomState(0)
+    tree = {
+        "w_mp": jax.device_put(rng.randn(16, 8).astype(np.float32),
+                               NamedSharding(mesh, P(None, "mp"))),
+        "w_dp": jax.device_put(rng.randn(8, 4).astype(np.float32),
+                               NamedSharding(mesh, P("dp", None))),
+        "scalar": jnp.float32(3.5),
+        "step": jnp.int32(7),
+    }
+    ck = ShardedCheckpointer(str(tmp_path / "ck"), max_to_keep=2)
+    assert ck.save(1, tree)
+    assert ck.save(2, jax.tree.map(lambda a: a * 0 if a.dtype.kind == "f"
+                                   else a, tree))
+    assert ck.all_steps() == [1, 2] and ck.latest_step() == 2
+
+    got = ck.restore(1, template=tree)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(got[k]),
+                                      np.asarray(tree[k]))
+    # shardings preserved, not just values
+    assert got["w_mp"].sharding.spec == P(None, "mp")
+    assert got["w_dp"].sharding.spec == P("dp", None)
+    ck.close()
+
+
+def test_train_step_checkpoint_resume_bit_exact(tmp_path):
+    _mesh()
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    from paddle_tpu.dygraph import tape
+    from paddle_tpu.jit import TrainStep
+
+    def build():
+        tape.seed(11)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 1))
+        opt = pt.optimizer.Adam(1e-2, parameters=model.parameters())
+        return TrainStep(model, lambda o, y: ((o - y) ** 2).mean(), opt)
+
+    rng = np.random.RandomState(1)
+    batches = [(rng.randn(4, 8).astype(np.float32),
+                rng.randn(4, 1).astype(np.float32)) for _ in range(6)]
+
+    ts = build()
+    for x, y in batches[:3]:
+        loss3 = float(ts((x,), (y,)))
+    ck = ShardedCheckpointer(str(tmp_path / "ck2"))
+    save_train_step(ck, 3, ts)
+    for x, y in batches[3:]:
+        straight = float(ts((x,), (y,)))
+
+    ts2 = build()
+    # state materializes lazily: run one step, then restore over it
+    ts2((batches[0][0],), (batches[0][1],))
+    assert restore_train_step(ck, ts2) == 3
+    for x, y in batches[3:]:
+        resumed = float(ts2((x,), (y,)))
+    assert straight == resumed, (straight, resumed)
+    ck.close()
