@@ -193,7 +193,8 @@ class Predictor:
         return [self._outputs[n] for n in self.fetch_names]
 
     # --- AOT serving artifact ------------------------------------------
-    def export_serialized(self, path: str, example_feeds: Sequence):
+    def export_serialized(self, path: str, example_feeds: Sequence,
+                          dynamic_batch: bool = False):
         """Serialize the pass-optimized, traced computation as a serving
         artifact: params (npz) + jax.export StableHLO bytes per entry
         signature. A second process serves it via SerializedPredictor
@@ -203,7 +204,13 @@ class Predictor:
         SaveOptimModel:900; TRT engine serialization). XLA's own binary
         compilation of the deserialized StableHLO is cached by the
         jit compilation cache, the reference's runtime-context-cache
-        analog."""
+        analog.
+
+        dynamic_batch=True exports with a SYMBOLIC leading batch dim
+        (jax.export shape polymorphism), so one artifact serves any
+        batch size — the reference predictor's variable-batch contract
+        — at the cost of restricting the traced program to
+        batch-polymorphic ops."""
         import jax
         import jax.export
 
@@ -213,6 +220,11 @@ class Predictor:
                                 len(example_feeds)))
         feeds = {n: np.asarray(v)
                  for n, v in zip(self.feed_names, example_feeds)}
+        if dynamic_batch:
+            # one shared symbolic var: every feed's leading dim is THE
+            # batch; trailing dims stay concrete from the examples
+            feeds = jax.export.symbolic_args_specs(
+                feeds, {n: "b, ..." for n in feeds})
         state = {v.name: np.asarray(self.scope.find_var(v.name))
                  for v in self.program.persistable_vars()
                  if self.scope.has(v.name)}

@@ -1,0 +1,40 @@
+#!/bin/bash
+# One-command round-5 TPU run sheet. Run the MOMENT the tunnel answers.
+# Order matters: cheap liveness first, then the parity test that gates
+# the in-kernel-dropout flag, then experiments, then the headline bench.
+# SERIAL execution only — two concurrent TPU jobs wedge the axon tunnel.
+set -u
+cd /root/repo
+LOG=tpu_runsheet_$(date -u +%H%M).log
+exec > >(tee "$LOG") 2>&1
+
+echo "=== 0. liveness ($(date -u +%FT%TZ))"
+timeout 120 python -c "
+import jax; print(jax.devices())
+import jax.numpy as jnp
+x = jnp.ones((256,256), jnp.bfloat16); print(float(jnp.sum(x @ x)))
+" || { echo 'TUNNEL DEAD — aborting'; exit 1; }
+
+echo "=== 1. in-kernel dropout parity (gates FLAGS_flash_inkernel_dropout)"
+timeout 900 python -m pytest \
+  tests/test_kernels.py::test_flash_inkernel_dropout_tpu -q -p no:cacheprovider
+INKERNEL_OK=$?
+
+echo "=== 2. experiments (dW strategies, S-crossovers incl. scored S=512)"
+timeout 1800 python scripts/tpu_experiments.py
+
+echo "=== 3. BERT profile breakdown"
+timeout 900 python scripts/profile_bert.py || true
+
+echo "=== 4. headline bench (B=32)"
+timeout 1800 python bench.py
+
+echo "=== 5. headline bench (B=64 comparison)"
+BENCH_BERT_B=64 timeout 1800 python bench.py
+
+echo "=== done. inkernel_parity_rc=$INKERNEL_OK"
+echo "Decisions to make from $LOG:"
+echo " - _FLASH_MIN_SEQ (nn/transformer.py) from section 2's S=512 line"
+echo " - FLAGS_flash_inkernel_dropout default iff parity rc=0 AND faster"
+echo " - FLAGS_embedding_onehot_grad default from section 2 dW sweep"
+echo " - bench B from 4 vs 5; then re-run bench.py and record PERF_NOTES"

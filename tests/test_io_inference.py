@@ -415,3 +415,29 @@ def test_fuse_passes_skip_unsupported_variants():
                protected={mean_name})
     assert "fused_embedding_eltwise_layernorm" not in \
         [op.type for op in main2.global_block.ops]
+
+
+def test_export_serialized_dynamic_batch(tmp_path):
+    """dynamic_batch=True: one artifact serves ANY batch size (jax
+    shape polymorphism) — the reference predictor's variable-batch
+    contract."""
+    main, startup, pred, loss = _build_regression()
+    exe = pt.Executor()
+    exe.run(startup)
+    _train(exe, main, loss)
+    d = str(tmp_path / "m")
+    pt.save_inference_model(d, ["x"], [pred], exe, main)
+    from paddle_tpu.inference import (Config, SerializedPredictor,
+                                      create_predictor)
+    predictor = create_predictor(Config(model_dir=d))
+    xb = np.random.RandomState(5).randn(6, 4).astype(np.float32)
+    art = str(tmp_path / "art_dyn")
+    predictor.export_serialized(art, [xb], dynamic_batch=True)
+    sp = SerializedPredictor(art)
+    for b in (1, 6, 13):
+        x = np.random.RandomState(b).randn(b, 4).astype(np.float32)
+        got, = sp.run([x])
+        expect, = predictor.run([x])
+        assert got.shape[0] == b
+        np.testing.assert_allclose(got, np.asarray(expect),
+                                   rtol=1e-5, atol=1e-6)
