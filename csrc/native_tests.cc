@@ -1,0 +1,133 @@
+/* Native unit tests for the csrc/ components — the analog of the
+ * reference's co-located cc_test gtest files
+ * (/root/reference/paddle/fluid/framework/lod_tensor_test.cc,
+ *  scope_test.cc, memory/allocation/\*_test.cc; SURVEY.md §4.2).
+ * Plain asserts instead of gtest (not in this image); built and run by
+ * tests/test_native_cc.py. Exit code 0 = all pass; each failure prints
+ * file:line.
+ */
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+extern "C" {
+long long aes_encrypt_block(const unsigned char *key, int key_len,
+                            const unsigned char in[16],
+                            unsigned char out[16]);
+long long aes_ctr_crypt(const unsigned char *key, int key_len,
+                        const unsigned char iv[16], unsigned char *buf,
+                        long long len);
+long long mslot_count(const char *buf, long long len, int num_slots,
+                      const char *slot_types, long long *out_counts);
+long long mslot_fill(const char *buf, long long len, int num_slots,
+                     const char *slot_types, void **value_ptrs,
+                     int *lengths);
+}
+
+static int g_failures = 0;
+#define CHECK_TRUE(x)                                              \
+  do {                                                             \
+    if (!(x)) {                                                    \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, \
+                   #x);                                            \
+      ++g_failures;                                                \
+    }                                                              \
+  } while (0)
+
+/* FIPS-197 appendix C.1: AES-128 known-answer test */
+static void test_aes128_kat() {
+  const unsigned char key[16] = {0x00, 0x01, 0x02, 0x03, 0x04, 0x05,
+                                 0x06, 0x07, 0x08, 0x09, 0x0a, 0x0b,
+                                 0x0c, 0x0d, 0x0e, 0x0f};
+  const unsigned char pt[16] = {0x00, 0x11, 0x22, 0x33, 0x44, 0x55,
+                                0x66, 0x77, 0x88, 0x99, 0xaa, 0xbb,
+                                0xcc, 0xdd, 0xee, 0xff};
+  const unsigned char expect[16] = {0x69, 0xc4, 0xe0, 0xd8, 0x6a, 0x7b,
+                                    0x04, 0x30, 0xd8, 0xcd, 0xb7, 0x80,
+                                    0x70, 0xb4, 0xc5, 0x5a};
+  unsigned char out[16];
+  CHECK_TRUE(aes_encrypt_block(key, 16, pt, out) == 0);
+  CHECK_TRUE(std::memcmp(out, expect, 16) == 0);
+}
+
+/* FIPS-197 C.3: AES-256 KAT */
+static void test_aes256_kat() {
+  unsigned char key[32];
+  for (int i = 0; i < 32; ++i) key[i] = (unsigned char)i;
+  const unsigned char pt[16] = {0x00, 0x11, 0x22, 0x33, 0x44, 0x55,
+                                0x66, 0x77, 0x88, 0x99, 0xaa, 0xbb,
+                                0xcc, 0xdd, 0xee, 0xff};
+  const unsigned char expect[16] = {0x8e, 0xa2, 0xb7, 0xca, 0x51, 0x67,
+                                    0x45, 0xbf, 0xea, 0xfc, 0x49, 0x90,
+                                    0x4b, 0x49, 0x60, 0x89};
+  unsigned char out[16];
+  CHECK_TRUE(aes_encrypt_block(key, 32, pt, out) == 0);
+  CHECK_TRUE(std::memcmp(out, expect, 16) == 0);
+}
+
+static void test_ctr_roundtrip_and_counter_carry() {
+  const unsigned char key[16] = {1, 2, 3};
+  /* iv ending in 0xff..ff forces the big-endian carry across bytes */
+  unsigned char iv[16];
+  std::memset(iv, 0, 16);
+  iv[14] = 0xff;
+  iv[15] = 0xff;
+  unsigned char buf[45];
+  for (int i = 0; i < 45; ++i) buf[i] = (unsigned char)(i * 7);
+  unsigned char orig[45];
+  std::memcpy(orig, buf, 45);
+  CHECK_TRUE(aes_ctr_crypt(key, 16, iv, buf, 45) == 0);
+  CHECK_TRUE(std::memcmp(buf, orig, 45) != 0); /* actually encrypted */
+  CHECK_TRUE(aes_ctr_crypt(key, 16, iv, buf, 45) == 0);
+  CHECK_TRUE(std::memcmp(buf, orig, 45) == 0); /* CTR is an involution */
+  CHECK_TRUE(aes_encrypt_block(key, 15, orig, buf) == -1); /* bad len */
+}
+
+static void test_mslot_count_and_malformed() {
+  /* 2 slots: uint64 then float; 2 instances; trailing \t allowed */
+  const char *data = "2 11 22 1 0.5\n1 33 2 1.5 2.5\t\n";
+  long long counts[2];
+  long long n = mslot_count(data, (long long)std::strlen(data), 2, "uf",
+                            counts);
+  CHECK_TRUE(n == 2);
+  CHECK_TRUE(counts[0] == 3 && counts[1] == 3);
+  const char *bad = "0 1 0.5\n"; /* zero-count slot is malformed */
+  CHECK_TRUE(mslot_count(bad, (long long)std::strlen(bad), 2, "uf",
+                         counts) == -1);
+  const char *junk = "2 11 22 1 0.5 junk\n"; /* non-space trailer */
+  CHECK_TRUE(mslot_count(junk, (long long)std::strlen(junk), 2, "uf",
+                         counts) == -1);
+}
+
+static void test_mslot_fill_values() {
+  const char *data = "2 11 22 1 0.5\n1 33 2 1.5 2.5\n";
+  long long counts[2];
+  long long n = mslot_count(data, (long long)std::strlen(data), 2, "uf",
+                            counts);
+  CHECK_TRUE(n == 2 && counts[0] == 3 && counts[1] == 3);
+  uint64_t uvals[3];
+  float fvals[3];
+  void *ptrs[2] = {uvals, fvals};
+  int lengths[4];
+  CHECK_TRUE(mslot_fill(data, (long long)std::strlen(data), 2, "uf",
+                        ptrs, lengths) == 2);
+  CHECK_TRUE(uvals[0] == 11 && uvals[1] == 22 && uvals[2] == 33);
+  CHECK_TRUE(fvals[0] == 0.5f && fvals[1] == 1.5f && fvals[2] == 2.5f);
+  CHECK_TRUE(lengths[0] == 2 && lengths[1] == 1 && lengths[2] == 1 &&
+             lengths[3] == 2);
+}
+
+int main() {
+  test_aes128_kat();
+  test_aes256_kat();
+  test_ctr_roundtrip_and_counter_carry();
+  test_mslot_count_and_malformed();
+  test_mslot_fill_values();
+  if (g_failures) {
+    std::fprintf(stderr, "%d native test failure(s)\n", g_failures);
+    return 1;
+  }
+  std::printf("native tests OK\n");
+  return 0;
+}

@@ -1,34 +1,45 @@
 #!/bin/bash
 # One-command round-5 TPU run sheet. Run the MOMENT the tunnel answers.
-# Order matters: cheap liveness first, then the parity test that gates
+# Order matters: cheap liveness first, then the parity check that gates
 # the in-kernel-dropout flag, then experiments, then the headline bench.
-# SERIAL execution only — two concurrent TPU jobs wedge the axon tunnel.
+# SERIAL execution only — two concurrent TPU jobs wedge the axon tunnel
+# — and the tunnel is RE-PROBED between sections: a timeout-killed
+# section can wedge it, and marching on would burn every later
+# section's full timeout against a dead tunnel.
 set -u
 cd /root/repo
 LOG=tpu_runsheet_$(date -u +%H%M).log
 exec > >(tee "$LOG") 2>&1
 
-echo "=== 0. liveness ($(date -u +%FT%TZ))"
-timeout 120 python -c "
+probe() {
+  timeout 120 python -c "
 import jax; print(jax.devices())
 import jax.numpy as jnp
 x = jnp.ones((256,256), jnp.bfloat16); print(float(jnp.sum(x @ x)))
-" || { echo 'TUNNEL DEAD — aborting'; exit 1; }
+"
+}
+
+echo "=== 0. liveness ($(date -u +%FT%TZ))"
+probe || { echo 'TUNNEL DEAD — aborting'; exit 1; }
 
 echo "=== 1. in-kernel dropout parity (gates FLAGS_flash_inkernel_dropout)"
-timeout 900 python -m pytest \
-  tests/test_kernels.py::test_flash_inkernel_dropout_tpu -q -p no:cacheprovider
+# NOT via pytest: tests/conftest.py pins every pytest session to CPU
+timeout 900 python scripts/inkernel_parity.py
 INKERNEL_OK=$?
 
+probe || { echo "TUNNEL WEDGED after section 1 ($(date -u +%FT%TZ))"; exit 1; }
 echo "=== 2. experiments (dW strategies, S-crossovers incl. scored S=512)"
 timeout 1800 python scripts/tpu_experiments.py
 
+probe || { echo "TUNNEL WEDGED after section 2 ($(date -u +%FT%TZ))"; exit 1; }
 echo "=== 3. BERT profile breakdown"
 timeout 900 python scripts/profile_bert.py || true
 
+probe || { echo "TUNNEL WEDGED after section 3 ($(date -u +%FT%TZ))"; exit 1; }
 echo "=== 4. headline bench (B=32)"
 timeout 1800 python bench.py
 
+probe || { echo "TUNNEL WEDGED after section 4 ($(date -u +%FT%TZ))"; exit 1; }
 echo "=== 5. headline bench (B=64 comparison)"
 BENCH_BERT_B=64 timeout 1800 python bench.py
 

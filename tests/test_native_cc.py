@@ -1,0 +1,27 @@
+"""Build + run the native C++ unit tests (csrc/native_tests.cc) — the
+cc_test analog of the reference's co-located framework tests
+(SURVEY.md §4.2)."""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CSRC = os.path.join(REPO, "csrc")
+
+
+def test_native_cc_suite(tmp_path):
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    exe = str(tmp_path / "native_tests")
+    subprocess.run(
+        ["g++", "-O2", "-o", exe,
+         os.path.join(CSRC, "native_tests.cc"),
+         os.path.join(CSRC, "crypto.cc"),
+         os.path.join(CSRC, "data_feed.cc")],
+        check=True, capture_output=True)
+    proc = subprocess.run([exe], capture_output=True, text=True,
+                          timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "native tests OK" in proc.stdout

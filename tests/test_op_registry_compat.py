@@ -27,13 +27,21 @@ def test_golden_has_full_surface():
 
 def test_tpu_scripts_parse():
     """The run-sheet scripts are TPU-only (never executed in CI); at
-    least guarantee they stay syntactically valid."""
+    least guarantee they stay syntactically valid (.py via ast, .sh via
+    bash -n)."""
     import ast
+    import shutil
+    import subprocess
     root = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "scripts")
     checked = 0
     for fn in sorted(os.listdir(root)):
+        path = os.path.join(root, fn)
         if fn.endswith(".py"):
-            ast.parse(open(os.path.join(root, fn)).read(), filename=fn)
+            ast.parse(open(path).read(), filename=fn)
             checked += 1
-    assert checked >= 2
+        elif fn.endswith(".sh") and shutil.which("bash"):
+            subprocess.run(["bash", "-n", path], check=True,
+                           capture_output=True)
+            checked += 1
+    assert checked >= 3
