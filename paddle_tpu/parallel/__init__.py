@@ -8,4 +8,6 @@ from .collective import (all_gather, all_reduce, all_to_all, barrier,  # noqa: F
 from .pipeline import (gpipe, stack_stage_params, PipelineLayer,  # noqa: F401
                        PipelineOptimizer, split_program_by_device)
 from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
+from .moe import (init_moe_params, moe_ffn,  # noqa: F401
+                  moe_ffn_sharded)
 from .data_parallel import DataParallel, spawn  # noqa: F401
