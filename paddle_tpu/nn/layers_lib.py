@@ -355,3 +355,57 @@ class SmoothL1Loss(Layer):
 
     def forward(self, input, label):
         return F.smooth_l1_loss(input, label, self.reduction, self.delta)
+
+
+class MoELayer(Layer):
+    """Mixture-of-Experts FFN layer over parallel.moe (GShard/Switch
+    top-k dispatch; no reference analog — v1.8 predates MoE). Input
+    [B, S, M] (or [T, M]); returns same shape. `.aux_loss` holds the
+    LAST forward's load-balance loss — add it to the training loss
+    after each call (it is overwritten per forward, not accumulated:
+    a model invoking the layer multiple times per step must sum it
+    call by call). Pass `mesh`/`axis` to shard experts over an ep
+    mesh axis; the axis size must divide BOTH num_experts and the
+    flattened token count."""
+
+    def __init__(self, d_model: int, d_ff: int, num_experts: int,
+                 k: int = 2, capacity_factor: float = 1.25,
+                 mesh=None, axis: str = "ep", name=None):
+        super().__init__()
+        self._k = k
+        self._cf = capacity_factor
+        self._mesh = mesh
+        self._axis = axis
+        self.router = self.create_parameter(
+            [d_model, num_experts],
+            default_initializer=Normal(0.0, 1.0 / math.sqrt(d_model)))
+        self.w_in = self.create_parameter(
+            [num_experts, d_model, d_ff],
+            default_initializer=Normal(0.0, 1.0 / math.sqrt(d_model)))
+        self.w_out = self.create_parameter(
+            [num_experts, d_ff, d_model],
+            default_initializer=Normal(0.0, 1.0 / math.sqrt(d_ff)))
+        self.aux_loss = 0.0
+
+    def forward(self, x):
+        from ..dygraph import tape
+        from ..parallel.moe import moe_ffn, moe_ffn_sharded
+
+        def run(xv, router, w_in, w_out):
+            shape = xv.shape
+            flat = xv.reshape(-1, shape[-1])
+            params = {"router": router, "w_in": w_in, "w_out": w_out}
+            if self._mesh is not None:
+                y, aux = moe_ffn_sharded(flat, params, self._mesh,
+                                         self._axis, k=self._k,
+                                         capacity_factor=self._cf)
+            else:
+                y, aux = moe_ffn(flat, params, k=self._k,
+                                 capacity_factor=self._cf)
+            # apply_fn contract: list of raw arrays out
+            return [y.reshape(shape), aux]
+
+        out, aux = tape.apply_fn(run, x, self.router, self.w_in,
+                                 self.w_out)
+        self.aux_loss = aux
+        return out

@@ -118,3 +118,31 @@ def test_sharded_grads_flow_to_experts():
         arr = np.asarray(g[name])
         assert np.isfinite(arr).all(), name
         assert np.abs(arr).sum() > 0, name
+
+
+def test_nn_moe_layer_trains_with_aux_loss():
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    from paddle_tpu.dygraph import tape
+    tape.seed(7)
+    layer = nn.MoELayer(8, 16, num_experts=4, k=2)
+    opt = pt.optimizer.Adam(1e-2, parameters=layer.parameters())
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 6, 8).astype(np.float32)
+    target = rng.randn(2, 6, 8).astype(np.float32)
+    l0 = None
+    g_router = None
+    for _ in range(15):
+        out = layer(pt.to_tensor(x))
+        loss = ((out - pt.to_tensor(target)) ** 2).mean() \
+            + 0.01 * layer.aux_loss
+        loss.backward()
+        # snapshot BEFORE clear_grad: router must participate in
+        # training (combine-weight + aux-loss gradients)
+        g_router = np.asarray(layer.router.gradient)
+        opt.step()
+        opt.clear_grad()
+        l0 = float(loss) if l0 is None else l0
+    assert float(loss) < l0, (l0, float(loss))
+    assert np.isfinite(g_router).all() and np.abs(g_router).sum() > 0
+    assert out.shape == (2, 6, 8)
