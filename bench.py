@@ -87,7 +87,10 @@ def _bench_bert(on_tpu):
         ("composed(xla)" if paths == {"composed"} else
          "mixed:%s" % sorted(paths))
     head_dim = cfg.hidden_size // cfg.num_attention_heads
-    if _tr.routes_to_flash(S, head_dim) and attention_path != "flash":
+    # the bench config trains with dropout on, so the dropout-active
+    # crossover governs the router's prediction
+    if (_tr.routes_to_flash(S, head_dim, dropout_active=True)
+            and attention_path != "flash"):
         print("WARN: router predicts flash at S=%d d=%d but the traced "
               "path was %s — kernel silently dropped out!"
               % (S, head_dim, attention_path), file=sys.stderr)

@@ -34,16 +34,20 @@ _DEFS: Dict[str, Any] = {
     # False so a broken kernel can never silently ship — the round-2
     # bench measured the fallback without anyone noticing.
     "FLAGS_flash_attention_fallback": False,
-    # in-kernel hardware-PRNG flash dropout: OFF until validated against
-    # the mask oracle on real TPU (ADVICE r4: the seed path has no
-    # interpret-mode coverage, so a Mosaic lowering bug would corrupt
-    # grads silently). scripts/tpu_experiments.py flips it for the A/B.
-    "FLAGS_flash_inkernel_dropout": False,
+    # in-kernel hardware-PRNG flash dropout: validated on v5e hardware
+    # round 5 (scripts/inkernel_parity.py — determinism, fwd/bwd mask
+    # agreement by finite differences, bias+dropout combination) and
+    # 1.5x faster than flash+HBM-mask at the scored S=512 config
+    # (8.54ms vs 12.71ms f+b, tpu_experiments.py 2b). The ADVICE-r4
+    # caveat (no interpret-mode oracle) is discharged by that on-chip
+    # parity gate, which the run sheet re-runs every session.
+    "FLAGS_flash_inkernel_dropout": True,
     # embedding dW strategy: True = chunked one-hot MXU matmuls instead
-    # of XLA scatter-add (the BERT embedding-backward experiment;
-    # scripts/tpu_experiments.py measures both). Trace-time flag — flip
-    # before building the step.
-    "FLAGS_embedding_onehot_grad": False,
+    # of XLA scatter-add. Decided by the round-5 end-to-end B=32 BERT
+    # measurement: one-hot 204.6ms/step vs scatter 221.8ms (the scatter
+    # MICRObench wins 7.9ms vs 11.0ms, but in-step the one-hot path
+    # fuses into the surrounding matmul schedule better).
+    "FLAGS_embedding_onehot_grad": True,
     # collectives — inert (XLA combiner thresholds are compiler flags)
     "FLAGS_fuse_parameter_memory_size": -1,
     "FLAGS_fuse_parameter_groups_size": 3,

@@ -46,13 +46,23 @@ def _use_interpret() -> bool:
 
 def _drop_keep_tile(seed_ref, qi, ki, shape, keep_prob):
     """In-kernel attention-probs dropout tile: seed the per-core PRNG
-    with (base_seed, b, h, q_tile, k_tile) so every kernel (forward, dQ,
+    from (base_seed, b, h, q_tile, k_tile) so every kernel (forward, dQ,
     dK/dV) regenerates the IDENTICAL keep pattern for a tile without any
     [B,H,Sq,Sk] mask in HBM — the hardware-PRNG analog of the rbg8
     trick in ops/nn dropout. Returns keep/keep_prob (0 or 1/keep_prob),
-    ready to multiply into the probs."""
-    pltpu.prng_seed(seed_ref[0, 0], pl.program_id(0), pl.program_id(1),
-                    qi, ki)
+    ready to multiply into the probs.
+
+    Mosaic's tpu.prng_set_seed_32 accepts at most TWO seed words (a
+    5-word call fails to compile on hardware), so the four tile
+    coordinates are hash-combined into one word with distinct odd
+    multipliers (xxhash/fxhash-style; int32 wraparound is the intended
+    mixing). Determinism across the three kernels only needs equal
+    tuples -> equal seeds, which a pure function of the tuple gives."""
+    ident = (pl.program_id(0) * jnp.int32(-1640531535)   # 0x9E3779B1
+             + pl.program_id(1) * jnp.int32(-2048144777)  # 0x85EBCA77
+             + qi * jnp.int32(-1028477379)                # 0xC2B2AE3D
+             + ki * jnp.int32(668265263))                 # 0x27D4EB2F
+    pltpu.prng_seed(seed_ref[0, 0], ident)
     bits = pltpu.prng_random_bits(shape)
     bits = jax.lax.bitcast_convert_type(bits, jnp.uint32)
     thresh = jnp.uint32(min(int((1.0 - keep_prob) * 4294967296.0),
@@ -649,10 +659,9 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
             # HBM at all. Needs a non-differentiable bias (or none)
             # because the dbias blockwise-recompute path (plain XLA,
             # outside Pallas) cannot regenerate the in-kernel pattern.
-            # Opt-in flag: the seed path has no interpret-mode oracle,
-            # so it stays off until the TPU-only parity test has passed
-            # on hardware
-            # (tests/test_kernels.py::test_flash_inkernel_dropout_tpu).
+            # Default-on since the round-5 on-chip parity run
+            # (scripts/inkernel_parity.py; the run sheet re-gates every
+            # session) — the flag remains the kill switch.
             import numpy as _np
             drop_seed = jax.random.randint(
                 dropout_rng, (1, 1), 0, _np.iinfo(_np.int32).max,

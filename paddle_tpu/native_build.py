@@ -21,11 +21,16 @@ def build_native_lib(src_path: str, name: str) -> Optional[ctypes.CDLL]:
     if not os.path.exists(so_path):
         os.makedirs(cache_dir, exist_ok=True)
         tmp = so_path + ".tmp.%d" % os.getpid()
-        # two attempts: a fork under a memory-pressured multithreaded
-        # parent (the full test suite) can fail transiently, and one
-        # such failure must not latch the fallback for the process
+        # three attempts with backoff: a fork under a memory-pressured
+        # multithreaded parent (the full test suite next to a TPU bench
+        # compile) can fail transiently — observed latching the numpy
+        # fallback in round 5 when two back-to-back attempts both landed
+        # inside the same pressure spike
         last_err = None
-        for _ in range(2):
+        for attempt in range(3):
+            if attempt:
+                import time
+                time.sleep(2.0 * attempt)
             try:
                 subprocess.run(
                     ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src_path],

@@ -28,13 +28,18 @@ def set_flash_attention(enabled: bool):
     _USE_FLASH = enabled
 
 
-# Routing point measured on v5e (B=32,H=12,D=64, bf16): at S=512 the
-# XLA composed path wins f+b (~2.8ms vs ~4ms/call — the whole score
-# tile fits comfortably and batched matmuls amortize better than many
-# small Pallas programs); the flash kernel's O(S^2)-memory advantage
-# pays from S>=1024 where the composed path's materialized probs
-# dominate HBM traffic.
-_FLASH_MIN_SEQ = 1024
+# Routing points measured on v5e (B=32,H=12,D=64, bf16):
+# - WITHOUT dropout (eval/inference): composed wins at S=512 (~2.8ms vs
+#   ~4ms f+b — the score tile fits HBM traffic easily); flash pays from
+#   S>=1024 where the materialized probs dominate.
+# - WITH dropout (training, the benchmark's scored config, re-measured
+#   round 5 with a padding mask, fwd+bwd): flash+in-kernel-dropout
+#   8.54ms vs flash+HBM-mask 12.71ms vs composed 13.21ms at S=512 —
+#   any flash variant wins once the composed path must materialize the
+#   [B,H,S,S] keep-mask, and flash keeps winning at 1024 (0.74x) and
+#   2048 (0.90x) (scripts/tpu_experiments.py sections 2/2b).
+_FLASH_MIN_SEQ = 1024          # no-dropout crossover
+_FLASH_MIN_SEQ_DROPOUT = 512   # dropout-active crossover
 
 # trace-time record of which attention path ACTUALLY lowered (the
 # round-2 postmortem: a bench must never infer the path from config —
@@ -50,12 +55,17 @@ def attention_paths_taken():
     return list(_PATH_LOG)
 
 
-def routes_to_flash(seq_len: int, head_dim: int) -> bool:
+def routes_to_flash(seq_len: int, head_dim: int,
+                    dropout_active: bool = False) -> bool:
     """The router's own predicate (kept next to it so they cannot
-    drift): whether _attention_core will attempt the Pallas kernel."""
+    drift): whether _attention_core will attempt the Pallas kernel.
+    dropout_active lowers the crossover to _FLASH_MIN_SEQ_DROPOUT —
+    once the composed path must materialize a [B,H,S,S] keep-mask,
+    flash wins from shorter sequences (round-5 measurement above)."""
     import jax
+    min_seq = _FLASH_MIN_SEQ_DROPOUT if dropout_active else _FLASH_MIN_SEQ
     return (_USE_FLASH and jax.default_backend() == "tpu"
-            and seq_len >= _FLASH_MIN_SEQ and head_dim in (64, 128, 256))
+            and seq_len >= min_seq and head_dim in (64, 128, 256))
 
 
 def _attention_core(q, k, v, attn_mask, dropout_p, training, is_causal=False):
@@ -91,7 +101,7 @@ def _attention_core(q, k, v, attn_mask, dropout_p, training, is_causal=False):
         # explicit here lets the flash path skip the dbias recompute
         # and keeps the in-kernel dropout path eligible.
         attn_mask = jax.lax.stop_gradient(attn_mask)
-    if routes_to_flash(q.shape[1], q.shape[-1]):
+    if routes_to_flash(q.shape[1], q.shape[-1], dropout_active=want_dropout):
         try:
             from ..kernels.flash_attention import flash_attention
             rng = tape._state.next_key() if want_dropout else None

@@ -1,0 +1,17 @@
+"""Shared launch shim for the run-sheet scripts.
+
+`python scripts/x.py` puts scripts/ (not the repo root) on sys.path, so
+each script's first import is `import _bootstrap`, which:
+- inserts the repo root so `paddle_tpu` resolves regardless of cwd;
+- honors PT_FORCE_CPU via jax.config — env JAX_PLATFORMS=cpu does NOT
+  survive the axon sitecustomize, and a stray TPU job from CI would
+  wedge a concurrent run-sheet session on the tunnel (observed round 5).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("PT_FORCE_CPU"):
+    import jax
+    jax.config.update("jax_platforms", "cpu")

@@ -7,8 +7,11 @@ forces the CPU backend for every pytest session). Exit 0 = parity holds;
 the FLAGS_flash_inkernel_dropout default may only flip after this
 passes on hardware.
 """
+import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401  (repo-root sys.path + PT_FORCE_CPU)
 import numpy as np
 
 
@@ -21,6 +24,8 @@ def check_inkernel_dropout_parity():
     if jax.default_backend() != "tpu":
         raise RuntimeError("parity check needs the real TPU backend, "
                            "got %r" % jax.default_backend())
+    from paddle_tpu.flags import get_flags
+    prior = get_flags(["FLAGS_flash_inkernel_dropout"])
     set_flags({"FLAGS_flash_inkernel_dropout": True})
     try:
         B, H, S, D = 2, 4, 1024, 64
@@ -69,7 +74,7 @@ def check_inkernel_dropout_parity():
                              bias_needs_grad=False)
         assert np.isfinite(np.asarray(ob, np.float32)).all()
     finally:
-        set_flags({"FLAGS_flash_inkernel_dropout": False})
+        set_flags(prior)  # restore the shipped default, whatever it is
 
 
 if __name__ == "__main__":

@@ -6,8 +6,12 @@ with jax.profiler tooling or feed the xplane into the round's analysis.
 The round-3 profile showed the forward healthy (~3.5ms/layer) and the
 backward + embedding dW unaccounted; this captures exactly that split.
 """
+import os
+import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401  (repo-root sys.path + PT_FORCE_CPU)
 import numpy as np
 import jax
 

@@ -8,6 +8,10 @@
 # section's full timeout against a dead tunnel.
 set -u
 cd /root/repo
+# Redundant belt-and-suspenders: every script self-inserts the repo
+# root via scripts/_bootstrap.py (and CI verifies that with PYTHONPATH
+# stripped); this only protects ad-hoc copies that forget the shim.
+export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
 LOG=tpu_runsheet_$(date -u +%H%M).log
 exec > >(tee "$LOG") 2>&1
 
@@ -36,6 +40,10 @@ echo "=== 3. BERT profile breakdown"
 timeout 900 python scripts/profile_bert.py || true
 
 probe || { echo "TUNNEL WEDGED after section 3 ($(date -u +%FT%TZ))"; exit 1; }
+echo "=== 3b. ResNet-50 phase breakdown (MFU-gap attribution)"
+timeout 900 python scripts/profile_resnet.py || true
+
+probe || { echo "TUNNEL WEDGED after section 3b ($(date -u +%FT%TZ))"; exit 1; }
 echo "=== 4. headline bench (B=32)"
 timeout 1800 python bench.py
 

@@ -45,3 +45,43 @@ def test_tpu_scripts_parse():
                            capture_output=True)
             checked += 1
     assert checked >= 3
+
+
+def test_tpu_scripts_import():
+    """ast.parse let a broken run sheet through in round 5: the scripts
+    were invoked as `python scripts/x.py` (so the repo root was NOT on
+    sys.path) and one used the nonexistent np.bfloat16 — every section
+    died on the live tunnel. Actually EXECUTE the scripts' import +
+    setup surface on CPU, from a cwd that is not the repo root, exactly
+    how the run sheet launches them."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # PT_FORCE_CPU, not JAX_PLATFORMS: the axon sitecustomize overrides
+    # the env var, and a stray TPU job from CI would wedge a concurrent
+    # run-sheet session on the tunnel (observed in round 5)
+    env = dict(os.environ, PT_FORCE_CPU="1")
+    env.pop("PYTHONPATH", None)  # scripts must self-insert the repo root
+
+    # tpu_experiments --selftest runs imports + tiny-shape jits, rc=0
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "tpu_experiments.py"),
+         "--selftest"], capture_output=True, text=True, timeout=300,
+        cwd="/tmp", env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "selftest OK" in proc.stdout
+
+    # the TPU-asserting scripts must die on the backend check (meaning
+    # all their imports resolved), not on any import failure. NB: a bare
+    # `'tpu' in err` would match 'paddle_tpu' inside any traceback — the
+    # checks must pin the actual backend-assert message.
+    for script in ("inkernel_parity.py", "profile_bert.py",
+                   "profile_resnet.py"):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "scripts", script)],
+            capture_output=True, text=True, timeout=300, cwd="/tmp",
+            env=env)
+        assert proc.returncode != 0
+        err = proc.stdout + proc.stderr
+        assert "ModuleNotFoundError" not in err, (script, err)
+        assert "ImportError" not in err, (script, err)
+        assert ("AssertionError: cpu" in err
+                or "real TPU backend" in err), (script, err)

@@ -396,13 +396,14 @@ def test_embedding_onehot_grad_matches_scatter():
             return jnp.sum(outs["Out"][0] * g_out)
         return jax.grad(f)(w)
 
-    pt.set_flags({"FLAGS_embedding_onehot_grad": False})
-    dw_scatter = run_grad()
-    pt.set_flags({"FLAGS_embedding_onehot_grad": True})
+    prior = pt.get_flags(["FLAGS_embedding_onehot_grad"])
     try:
+        pt.set_flags({"FLAGS_embedding_onehot_grad": False})
+        dw_scatter = run_grad()
+        pt.set_flags({"FLAGS_embedding_onehot_grad": True})
         dw_onehot = run_grad()
     finally:
-        pt.set_flags({"FLAGS_embedding_onehot_grad": False})
+        pt.set_flags(prior)  # restore the shipped default, whatever it is
     np.testing.assert_allclose(np.asarray(dw_onehot),
                                np.asarray(dw_scatter), rtol=1e-5,
                                atol=1e-5)
