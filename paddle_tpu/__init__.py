@@ -99,6 +99,58 @@ from .tensor import (zeros, ones, full, zeros_like, ones_like,  # noqa: F401
                      argmin, argsort, sort, topk, where, index_select,
                      masked_select, nonzero, cumsum, kron, numel)
 from .dygraph.tape import no_grad  # noqa: F401
+# ---------------------------------------------------------------------------
+# top-level parity closure (round 5): every non-commented name exported
+# by the reference's python/paddle/__init__.py resolves here too —
+# tools/check_api_surface.py diffs the two surfaces in CI.
+# ---------------------------------------------------------------------------
+from .tensor import (ceil, diag, floor, floor_divide,  # noqa: F401
+                     increment, index_sample, logical_xor, max, min,
+                     mean, mod, prod, reciprocal, round, scatter_nd_add,
+                     shape, sign, slice, std, strided_slice, sum, t,
+                     var, sin, cos, sinh, cosh, asin, acos, atan, rsqrt,
+                     log1p, erf, mm, addmm, addcmul, inverse, cholesky,
+                     trace, dist, logsumexp, isinf, meshgrid, bernoulli,
+                     equal_all, broadcast_to, standard_normal, histogram,
+                     shuffle, remainder, floor_mod, elementwise_sum)
+from .layers import (crop_tensor, elementwise_add,  # noqa: F401
+                     elementwise_div, elementwise_floordiv,
+                     elementwise_mod, elementwise_pow, elementwise_sub,
+                     fill_constant, has_inf, has_nan, is_empty,
+                     multiplex, rank, reduce_all, reduce_any, reduce_max,
+                     reduce_mean, reduce_min, reduce_prod, reduce_sum,
+                     scale, scatter_nd, shard_index, stanh, sums, tanh,
+                     unbind, unique_with_counts, create_global_var,
+                     create_parameter, data)
+from .core.lod import LoDTensor, LoDTensorArray  # noqa: F401
+from .core.program import VarDesc as Variable  # noqa: F401
+from .dygraph.tape import Tensor  # noqa: F401  (paddle.Tensor = VarBase)
+VarBase = Tensor
+from .dygraph import to_variable  # noqa: F401
+from .parallel.data_parallel import DataParallel  # noqa: F401
+from .optimizer import (CosineDecay, ExponentialDecay,  # noqa: F401
+                        InverseTimeDecay, NaturalExpDecay, NoamDecay,
+                        PiecewiseDecay, PolynomialDecay)
+from .framework_api import (ComplexTensor, ComplexVariable,  # noqa: F401
+                            SaveLoadConfig, disable_dygraph,
+                            disable_imperative, enable_dygraph,
+                            enable_imperative, get_cuda_rng_state,
+                            get_cudnn_version, get_default_dtype,
+                            get_device, get_rng_state,
+                            monkey_patch_math_varbase,
+                            monkey_patch_variable, set_cuda_rng_state,
+                            set_default_dtype, set_device, set_rng_state,
+                            summary)
+from .hapi import callbacks  # noqa: F401
+manual_seed = set_global_seed
+no_grad_ = no_grad  # the reference aliases fluid's no_grad_ to no_grad
+from . import compat  # noqa: F401
+from . import device  # noqa: F401
+from . import framework  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import text  # noqa: F401
+from . import vision  # noqa: F401
+from .incubate import complex  # noqa: F401
 from . import distribution  # noqa: F401
 from . import datasets  # noqa: F401
 from . import vision_transforms  # noqa: F401

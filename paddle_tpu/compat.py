@@ -1,0 +1,77 @@
+"""paddle.compat — string/number compatibility helpers.
+
+Analog of /root/reference/python/paddle/compat.py (a py2/py3 shim).
+This codebase is py3-only, so the implementations are the py3 branches
+of the same contracts: to_text/to_bytes convert strings and (optionally
+in place) their containers, round is the away-from-zero float round the
+reference standardizes on, floor_division is // and
+get_exception_message extracts e.args[0].
+"""
+from __future__ import annotations
+
+import math
+from typing import Any
+
+__all__ = ["long_type", "to_text", "to_bytes", "round",
+           "floor_division", "get_exception_message"]
+
+long_type = int  # py2 `long` unified into int
+
+
+def _convert(obj: Any, conv, inplace: bool):
+    if obj is None or isinstance(obj, (int, float)):
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_convert(o, conv, inplace) for o in obj]
+            return obj
+        return [_convert(o, conv, inplace) for o in obj]
+    if isinstance(obj, set):
+        new = {_convert(o, conv, inplace) for o in obj}
+        if inplace:
+            obj.clear()
+            obj.update(new)
+            return obj
+        return new
+    if isinstance(obj, dict):
+        new = {_convert(k, conv, False): _convert(v, conv, False)
+               for k, v in obj.items()}
+        if inplace:
+            obj.clear()
+            obj.update(new)
+            return obj
+        return new
+    return conv(obj)
+
+
+def to_text(obj, encoding: str = "utf-8", inplace: bool = False):
+    """bytes -> str (deep through list/set/dict when given one)."""
+    def conv(o):
+        return o.decode(encoding) if isinstance(o, bytes) else str(o)
+    return _convert(obj, conv, inplace)
+
+
+def to_bytes(obj, encoding: str = "utf-8", inplace: bool = False):
+    """str -> bytes (deep through list/set/dict when given one)."""
+    def conv(o):
+        return o.encode(encoding) if isinstance(o, str) else bytes(o)
+    return _convert(obj, conv, inplace)
+
+
+def round(x, d=0):  # noqa: A001
+    """Half-away-from-zero rounding (the reference pins py2 round
+    semantics; py3 builtin round is banker's rounding)."""
+    if x is None:
+        return None
+    p = 10 ** d
+    if x >= 0:
+        return float(math.floor((x * p) + 0.5)) / p
+    return float(math.ceil((x * p) - 0.5)) / p
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc) -> str:
+    return str(exc.args[0]) if getattr(exc, "args", None) else str(exc)

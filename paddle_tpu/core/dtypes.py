@@ -38,10 +38,38 @@ _ALIASES = {
 }
 
 
+# process-wide default float dtype (reference framework.py
+# set_default_dtype / get_default_dtype): consulted wherever a float
+# dtype is omitted (tensor creation, layer parameter init)
+_DEFAULT_DTYPE = "float32"
+
+
+def set_default_dtype(d) -> None:
+    global _DEFAULT_DTYPE
+    name = convert_dtype(d)
+    if name not in ("float16", "bfloat16", "float32", "float64"):
+        raise TypeError(
+            "set_default_dtype only accepts float dtypes, got %r" % (d,))
+    # jax truncates 64-bit dtypes unless x64 mode is on; a float64
+    # default is an explicit user request, so turn x64 ON for it (TPU
+    # emulates f64 — slow but correct, matching the reference's CPU f64
+    # contract). Never force it OFF: the user may have enabled x64
+    # independently, and the reference's set_default_dtype('float32')
+    # is side-effect-free.
+    if name == "float64":
+        import jax
+        jax.config.update("jax_enable_x64", True)
+    _DEFAULT_DTYPE = name
+
+
+def get_default_dtype() -> str:
+    return _DEFAULT_DTYPE
+
+
 def convert_dtype(dtype) -> str:
     """Normalise any dtype spec (str, np dtype, jnp dtype) to a canonical name."""
     if dtype is None:
-        return "float32"
+        return _DEFAULT_DTYPE
     if isinstance(dtype, str):
         name = _ALIASES.get(dtype, dtype)
         if name not in _DTYPES:

@@ -1,0 +1,119 @@
+"""LoDTensor — the feedable variable-length batch container.
+
+API analog of the reference's LoD (level-of-detail) tensor
+(/root/reference/paddle/fluid/framework/lod_tensor.h:104): a packed
+[total_items, ...] buffer plus nested sequence offsets. The TPU-native
+compute representation is padded+lengths (ops/sequence.py — XLA needs
+static shapes), so this class is the BRIDGE: it stores the packed numpy
+buffer + recursive sequence lengths the way user feed code expects, and
+converts to/from the padded form the sequence ops consume.
+
+Kept host-side on purpose: LoD bookkeeping is data-pipeline work; only
+the padded dense result ships to the chip.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def _lengths_to_offsets(lengths: Sequence[int]) -> List[int]:
+    out = [0]
+    for n in lengths:
+        out.append(out[-1] + int(n))
+    return out
+
+
+def _offsets_to_lengths(offsets: Sequence[int]) -> List[int]:
+    return [int(b) - int(a) for a, b in zip(offsets[:-1], offsets[1:])]
+
+
+class LoDTensor:
+    """Packed data + nested sequence structure.
+
+    `lod()` returns offset-style LoD (reference lod_tensor.h), while
+    `recursive_sequence_lengths()` returns length-style — both setters
+    accept the matching style, mirroring fluid.LoDTensor's pybind API.
+    """
+
+    def __init__(self, data=None, recursive_seq_lens=None):
+        self._data = None if data is None else np.asarray(data)
+        self._rsl: List[List[int]] = []
+        if recursive_seq_lens is not None:
+            self.set_recursive_sequence_lengths(recursive_seq_lens)
+
+    # -- buffer -----------------------------------------------------------
+    def set(self, data, place=None):
+        """place is accepted for API parity; jax owns real placement."""
+        self._data = np.asarray(data)
+
+    def shape(self):
+        return () if self._data is None else tuple(self._data.shape)
+
+    def __array__(self, dtype=None):
+        arr = self._data if self._data is not None else np.empty((0,))
+        return arr.astype(dtype) if dtype is not None else arr
+
+    # -- structure --------------------------------------------------------
+    def set_lod(self, lod: Sequence[Sequence[int]]):
+        self._rsl = [_offsets_to_lengths(level) for level in lod]
+
+    def lod(self) -> List[List[int]]:
+        return [_lengths_to_offsets(level) for level in self._rsl]
+
+    def set_recursive_sequence_lengths(self, rsl: Sequence[Sequence[int]]):
+        self._rsl = [[int(n) for n in level] for level in rsl]
+
+    def recursive_sequence_lengths(self) -> List[List[int]]:
+        return [list(level) for level in self._rsl]
+
+    def has_valid_recursive_sequence_lengths(self) -> bool:
+        if not self._rsl:
+            return True
+        # each level's sequences must tile the level below; the last
+        # level must tile the leading data dim (lod_tensor.cc CheckLoD)
+        expect = None
+        for level in self._rsl:
+            if expect is not None and len(level) != expect:
+                return False
+            expect = sum(level)
+        return self._data is None or expect == self._data.shape[0]
+
+    # -- bridge to the TPU-native padded representation -------------------
+    def to_padded(self, pad_value=0.0):
+        """(padded [B, T_max, ...], lengths int32 [B]) for the finest
+        level — the layout ops/sequence.py consumes."""
+        if self._data is None:
+            raise ValueError("LoDTensor has no data")
+        if not self._rsl:
+            return self._data[None], np.asarray(
+                [self._data.shape[0]], np.int32)
+        lengths = self._rsl[-1]
+        t_max = max(lengths) if lengths else 0
+        trail = self._data.shape[1:]
+        out = np.full((len(lengths), t_max) + trail, pad_value,
+                      dtype=self._data.dtype)
+        ofs = 0
+        for i, n in enumerate(lengths):
+            out[i, :n] = self._data[ofs:ofs + n]
+            ofs += n
+        return out, np.asarray(lengths, np.int32)
+
+    @staticmethod
+    def from_padded(padded, lengths) -> "LoDTensor":
+        padded = np.asarray(padded)
+        lengths = [int(n) for n in np.asarray(lengths)]
+        packed = np.concatenate(
+            [padded[i, :n] for i, n in enumerate(lengths)], axis=0) \
+            if lengths else padded.reshape((0,) + padded.shape[2:])
+        return LoDTensor(packed, [lengths])
+
+    def __repr__(self):
+        return "LoDTensor(shape=%s, recursive_sequence_lengths=%s)" % (
+            self.shape(), self._rsl)
+
+
+class LoDTensorArray(list):
+    """fluid.LoDTensorArray — a list of LoDTensors (the reference's
+    pybind type is a std::vector<LoDTensor> with list semantics)."""

@@ -1,0 +1,37 @@
+"""paddle.device — device query/control module.
+
+Analog of /root/reference/python/paddle/device.py (set_device /
+get_device / get_cudnn_version / is_compiled_with_cuda). Placement is
+owned by jax/XLA; these report and pin the expected backend. CUDA
+predicates answer False/None honestly — the accelerator here is a TPU.
+"""
+from __future__ import annotations
+
+from .framework_api import (get_cudnn_version,  # noqa: F401
+                            get_device, set_device)
+
+__all__ = ["get_cudnn_version", "get_device", "set_device",
+           "is_compiled_with_cuda", "is_compiled_with_xpu",
+           "is_compiled_with_tpu", "XPUPlace"]
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    """True when the jax TPU backend is importable (the build always
+    includes it; runtime availability is what set_device checks)."""
+    return True
+
+
+class XPUPlace:
+    """Kept for API parity (reference fluid.XPUPlace); jax owns
+    placement."""
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id

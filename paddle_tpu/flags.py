@@ -42,6 +42,13 @@ _DEFS: Dict[str, Any] = {
     # caveat (no interpret-mode oracle) is discharged by that on-chip
     # parity gate, which the run sheet re-runs every session.
     "FLAGS_flash_inkernel_dropout": True,
+    # dropout backward-residual strategy: "xla" leaves storage to XLA's
+    # cost model (observed: 4 bytes/element u32 buffers), "u8" pins a
+    # uint8 mask residual via custom_vjp (4x less mask HBM), "seed"
+    # stores only the PRNG key and regenerates the mask in backward
+    # (zero mask bytes; rbg re-run in bwd). Measured on-chip before
+    # defaulting — see PERF_NOTES round 5.
+    "FLAGS_dropout_storage": "xla",
     # embedding dW strategy: True = chunked one-hot MXU matmuls instead
     # of XLA scatter-add. Decided by the round-5 end-to-end B=32 BERT
     # measurement: one-hot 204.6ms/step vs scatter 221.8ms (the scatter

@@ -1,0 +1,53 @@
+"""paddle.framework — the framework-namespace module.
+
+Analog of /root/reference/python/paddle/framework/__init__.py: re-groups
+the core framework types and utilities (default dtype, manual_seed,
+Variable/ComplexVariable, ParamAttr, CPUPlace/CUDAPlace, dygraph
+switches, save/load) under `paddle.framework.*` so reference imports
+like `from paddle.framework import get_default_dtype` port verbatim.
+"""
+from __future__ import annotations
+
+from .core.dtypes import (get_default_dtype,  # noqa: F401
+                          set_default_dtype)
+from .core.program import VarDesc as Variable  # noqa: F401
+from .framework_api import (ComplexVariable,  # noqa: F401
+                            SaveLoadConfig, disable_dygraph,
+                            enable_dygraph)
+from .layers.helper import ParamAttr  # noqa: F401
+from .dygraph import to_variable  # noqa: F401
+from .dygraph.tape import no_grad  # noqa: F401
+from .io import save, load  # noqa: F401
+
+__all__ = ["get_default_dtype", "set_default_dtype", "manual_seed",
+           "Variable", "ComplexVariable", "SaveLoadConfig", "ParamAttr",
+           "to_variable", "no_grad", "save", "load", "seed",
+           "enable_dygraph", "disable_dygraph", "CPUPlace", "CUDAPlace",
+           "random"]
+
+
+def manual_seed(s: int):
+    """paddle.framework.random.manual_seed."""
+    from . import set_global_seed
+    return set_global_seed(s)
+
+
+seed = manual_seed
+
+
+class _RandomNS:
+    """paddle.framework.random submodule surface."""
+    manual_seed = staticmethod(manual_seed)
+
+
+random = _RandomNS()
+
+
+def __getattr__(name):
+    # CPUPlace/CUDAPlace live on the package root (circular at import
+    # time); resolve lazily so `paddle.framework.CPUPlace` works.
+    if name in ("CPUPlace", "CUDAPlace", "TPUPlace"):
+        from . import CPUPlace, CUDAPlace, TPUPlace
+        return {"CPUPlace": CPUPlace, "CUDAPlace": CUDAPlace,
+                "TPUPlace": TPUPlace}[name]
+    raise AttributeError(name)

@@ -1,0 +1,179 @@
+"""Top-level framework API odds and ends.
+
+The reference's python/paddle/__init__.py exports a set of framework
+utilities beyond the tensor library (device control, default dtype,
+dygraph switches, the ComplexTensor wrapper, save/load config, VarBase
+monkey-patching). This module provides their TPU-native homes; the
+package __init__ re-exports them so reference user code ports verbatim.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .core.dtypes import (get_default_dtype,  # noqa: F401 (re-exported)
+                          set_default_dtype)
+
+
+# -- device control (reference fluid/framework.py _current_expected_place,
+#    paddle.set_device / get_device) --------------------------------------
+
+_DEVICE: Optional[str] = None
+
+
+def set_device(device: str) -> str:
+    """Accepts 'cpu', 'tpu', 'tpu:0', and — for porting convenience —
+    'gpu[:N]' which maps to the accelerator (there is no CUDA here;
+    scripts written against the reference keep working). Placement
+    itself is owned by jax/XLA; this sets the EXPECTED device and
+    errors early when the accelerator is requested but absent."""
+    global _DEVICE
+    import jax
+    name = device.lower()
+    kind = name.split(":")[0]
+    if kind not in ("cpu", "tpu", "gpu", "xpu"):
+        raise ValueError("set_device: unknown device %r" % (device,))
+    if kind in ("tpu", "gpu", "xpu"):
+        if jax.default_backend() == "cpu":
+            raise RuntimeError(
+                "set_device(%r): no accelerator backend is available "
+                "(jax.default_backend()=cpu)" % (device,))
+        _DEVICE = "tpu:" + (name.split(":")[1] if ":" in name else "0")
+    else:
+        _DEVICE = "cpu"
+    return _DEVICE
+
+
+def get_device() -> str:
+    if _DEVICE is not None:
+        return _DEVICE
+    import jax
+    return ("tpu:0" if jax.default_backend() not in ("cpu",) else "cpu")
+
+
+def get_cudnn_version():
+    """None: not built with cuDNN (the reference returns None exactly
+    when the install has no CUDA)."""
+    return None
+
+
+# -- generator state (reference paddle.get/set_cuda_rng_state; the TPU
+#    analog is the eager PRNG key chain that paddle.seed seeds) -----------
+
+def get_rng_state():
+    from .dygraph import tape
+    return tape._state.key
+
+
+def set_rng_state(state) -> None:
+    from .dygraph import tape
+    tape._state.key = state
+
+
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+
+# -- dygraph switches ------------------------------------------------------
+
+def enable_dygraph(place=None) -> None:
+    """paddle.enable_imperative/enable_dygraph (framework.py): dygraph
+    IS the default here, matching paddle 2.x; this flips back from a
+    prior enable_static()."""
+    from .core import disable_static
+    disable_static()
+
+
+def disable_dygraph() -> None:
+    from .core import enable_static
+    enable_static()
+
+
+enable_imperative = enable_dygraph
+disable_imperative = disable_dygraph
+
+
+# -- ComplexTensor ---------------------------------------------------------
+
+class ComplexVariable:
+    """Pair of real tensors representing a complex tensor (reference
+    fluid/framework.py:1742 ComplexVariable / paddle.ComplexTensor).
+    Arithmetic composes the real-number ops, so it works in dygraph and
+    under jit capture alike."""
+
+    def __init__(self, real, imag):
+        if tuple(real.shape) != tuple(imag.shape):
+            raise ValueError("real/imag shape mismatch: %s vs %s"
+                             % (real.shape, imag.shape))
+        self.real = real
+        self.imag = imag
+
+    @property
+    def shape(self):
+        return self.real.shape
+
+    @property
+    def dtype(self):
+        from .core.dtypes import convert_dtype
+        return ("complex64"
+                if convert_dtype(self.real.dtype) in ("float16", "float32")
+                else "complex128")
+
+    def numpy(self):
+        import numpy as np
+        return (np.asarray(self.real.numpy())
+                + 1j * np.asarray(self.imag.numpy()))
+
+    def __add__(self, o):
+        return ComplexVariable(self.real + o.real, self.imag + o.imag)
+
+    def __sub__(self, o):
+        return ComplexVariable(self.real - o.real, self.imag - o.imag)
+
+    def __mul__(self, o):
+        return ComplexVariable(self.real * o.real - self.imag * o.imag,
+                               self.real * o.imag + self.imag * o.real)
+
+    def __repr__(self):
+        return "ComplexTensor(shape=%s, dtype=%s)" % (tuple(self.shape),
+                                                      self.dtype)
+
+
+ComplexTensor = ComplexVariable
+
+
+# -- SaveLoadConfig (reference fluid/dygraph/jit.py) -----------------------
+
+class SaveLoadConfig:
+    """Options bag for jit/inference save+load (model_filename,
+    params_filename, output_spec, separate_params, keep_name_table).
+    io.save_inference_model / jit honor the filename fields; the rest
+    are carried for API parity."""
+
+    def __init__(self):
+        self.output_spec = None
+        self.model_filename = "__model__"
+        self.params_filename = None
+        self.separate_params = False
+        self.keep_name_table = False
+
+
+# -- VarBase monkey patching ----------------------------------------------
+
+def monkey_patch_variable() -> None:
+    """The reference grafts math methods onto static Variable at import
+    (fluid/layers/math_op_patch.py). Here VarDesc/Tensor carry their
+    operator methods natively (dygraph/tape.py, core/program.py), so
+    the patch is a no-op kept so `paddle.monkey_patch_variable()` call
+    sites in ported code keep working."""
+
+
+def monkey_patch_math_varbase() -> None:
+    """See monkey_patch_variable — dygraph Tensors have native
+    operators; nothing to graft."""
+
+
+def summary(net, input_size, dtypes=None):
+    """paddle.summary (hapi): layer table + param counts for a Layer.
+    Delegates to hapi.Model.summary via a throwaway Model wrapper."""
+    from .hapi import Model
+    return Model(net).summary(input_size)

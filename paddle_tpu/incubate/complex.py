@@ -1,0 +1,95 @@
+"""paddle.incubate.complex (exported as paddle.complex) — complex-tensor
+math over ComplexVariable pairs.
+
+Analog of /root/reference/python/paddle/incubate/complex/tensor/
+(elementwise add/sub/mul/div, kron, trace, sum, matmul, reshape,
+transpose on (real, imag) pairs). TPUs have no native complex MXU path,
+so every op composes the real-number ops on the two parts — which is
+exactly what the reference does (its ComplexVariable kernels are
+real-pair compositions too, helper.py), and lets jax autodiff flow
+through both parts.
+"""
+from __future__ import annotations
+
+from ..framework_api import ComplexVariable
+from .. import tensor as _t
+
+__all__ = ["elementwise_add", "elementwise_sub", "elementwise_mul",
+           "elementwise_div", "kron", "trace", "sum", "matmul",
+           "reshape", "transpose"]
+
+
+def _cv(x):
+    if isinstance(x, ComplexVariable):
+        return x
+    return ComplexVariable(x, _t.zeros_like(x))
+
+
+def elementwise_add(x, y, name=None):
+    x, y = _cv(x), _cv(y)
+    return ComplexVariable(x.real + y.real, x.imag + y.imag)
+
+
+def elementwise_sub(x, y, name=None):
+    x, y = _cv(x), _cv(y)
+    return ComplexVariable(x.real - y.real, x.imag - y.imag)
+
+
+def elementwise_mul(x, y, name=None):
+    x, y = _cv(x), _cv(y)
+    return ComplexVariable(x.real * y.real - x.imag * y.imag,
+                           x.real * y.imag + x.imag * y.real)
+
+
+def elementwise_div(x, y, name=None):
+    x, y = _cv(x), _cv(y)
+    den = y.real * y.real + y.imag * y.imag
+    return ComplexVariable(
+        (x.real * y.real + x.imag * y.imag) / den,
+        (x.imag * y.real - x.real * y.imag) / den)
+
+
+def kron(x, y, name=None):
+    x, y = _cv(x), _cv(y)
+    return ComplexVariable(
+        _t.kron(x.real, y.real) - _t.kron(x.imag, y.imag),
+        _t.kron(x.real, y.imag) + _t.kron(x.imag, y.real))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    x = _cv(x)
+    return ComplexVariable(_t.trace(x.real, offset, axis1, axis2),
+                           _t.trace(x.imag, offset, axis1, axis2))
+
+
+def sum(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    x = _cv(x)
+    return ComplexVariable(_t.sum(x.real, axis, keepdim),
+                           _t.sum(x.imag, axis, keepdim))
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
+           name=None):
+    x, y = _cv(x), _cv(y)
+
+    def mm(a, b):
+        return _t.matmul(a, b, transpose_x, transpose_y)
+
+    real = mm(x.real, y.real) - mm(x.imag, y.imag)
+    imag = mm(x.real, y.imag) + mm(x.imag, y.real)
+    if alpha != 1.0:
+        real = real * alpha
+        imag = imag * alpha
+    return ComplexVariable(real, imag)
+
+
+def reshape(x, shape, name=None):
+    x = _cv(x)
+    return ComplexVariable(_t.reshape(x.real, shape),
+                           _t.reshape(x.imag, shape))
+
+
+def transpose(x, perm, name=None):
+    x = _cv(x)
+    return ComplexVariable(_t.transpose(x.real, perm),
+                           _t.transpose(x.imag, perm))

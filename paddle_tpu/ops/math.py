@@ -133,6 +133,44 @@ def _trace(ctx, ins, attrs):
                          axis2=attrs.get("axis2", 1)))
 
 
+@register_op("histogram", inputs=("X",), no_grad=True)
+def _histogram(ctx, ins, attrs):
+    """histogram_op.cu contract: `bins` equal-width buckets over
+    [min, max]; when min==max==0 the range comes from the data (and a
+    constant input widens to [v-1, v+1] like the reference's epsilon
+    guard). Values outside the range are dropped. Static-shape friendly:
+    the output is always int32[bins]."""
+    x = ins["X"][0].astype(jnp.float32).reshape(-1)
+    bins = int(attrs.get("bins", 100))
+    lo = float(attrs.get("min", 0))
+    hi = float(attrs.get("max", 0))
+    if lo > hi:
+        raise ValueError(
+            "histogram: min (%g) must not exceed max (%g) "
+            "(histogram_op.cc CheckAttrs contract)" % (lo, hi))
+    if lo == hi:
+        # reference semantics: an empty range takes the data's range;
+        # a constant input widens by +-1 (epsilon guard — also keeps
+        # the width strictly positive below)
+        lo_v, hi_v = jnp.min(x), jnp.max(x)
+        same = hi_v <= lo_v
+        lo_v = jnp.where(same, lo_v - 1.0, lo_v)
+        hi_v = jnp.where(same, hi_v + 1.0, hi_v)
+    else:
+        lo_v = jnp.float32(lo)
+        hi_v = jnp.float32(hi)
+    width = (hi_v - lo_v) / bins
+    idx = jnp.floor((x - lo_v) / width).astype(jnp.int32)
+    # the right edge is inclusive (reference: last bucket absorbs max)
+    idx = jnp.where(x == hi_v, bins - 1, idx)
+    valid = (x >= lo_v) & (x <= hi_v)
+    idx = jnp.where(valid, idx, bins)  # out-of-range -> overflow slot
+    # int32 counts: >2^31 elements per bin is unreachable, and int64
+    # would truncate (with a warning) in the default x64-off process
+    counts = jnp.zeros((bins + 1,), jnp.int32).at[idx].add(1)
+    return one(counts[:bins])
+
+
 @register_op("cholesky", inputs=("X",))
 def _cholesky(ctx, ins, attrs):
     x = ins["X"][0]

@@ -466,26 +466,104 @@ def _batch_norm(ctx, ins, attrs):
     bshape[c_axis] = x.shape[c_axis]
 
     # statistics always accumulate in fp32 (the reference kernel's
-    # BatchNormParamType promotes fp16/bf16 stats the same way); the
-    # normalized output stays in x's dtype so a bf16 residual stream is
-    # not silently promoted to fp32 — under AMP that doubles the HBM
-    # traffic of every BN/relu/add chain on TPU
-    xs = x.astype(jnp.float32)
+    # BatchNormParamType promotes fp16/bf16 stats the same way). The
+    # normalize is FOLDED into a per-channel affine y = x*a + b with
+    # a = scale*rsqrt(var+eps), b = bias - mean*a computed in fp32 on
+    # [C]-sized vectors only — the full [N,C,H,W] activation is never
+    # round-tripped through fp32, so under AMP the BN/relu/add chain
+    # stays bf16-wide in HBM.
     if use_global:
         mean, var = mean_in, var_in
-        saved_mean, saved_var = mean_in, var_in
-        mean_out, var_out = mean_in, var_in
-    else:
-        mean = jnp.mean(xs, axis=red)
-        var = jnp.mean(jnp.square(xs), axis=red) - jnp.square(mean)
-        saved_mean, saved_var = mean, var
-        mean_out = momentum * mean_in + (1 - momentum) * mean
-        var_out = momentum * var_in + (1 - momentum) * var
-    inv = jax.lax.rsqrt(var.reshape(bshape) + eps)
-    y = ((xs - mean.reshape(bshape)) * inv * scale.reshape(bshape)
-         + bias.reshape(bshape)).astype(x.dtype)
+        a = scale.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
+        b = bias.astype(jnp.float32) - mean * a
+        y = (x * a.reshape(bshape).astype(x.dtype)
+             + b.reshape(bshape).astype(x.dtype))
+        return {"Y": [y], "MeanOut": [mean_in], "VarianceOut": [var_in],
+                "SavedMean": [mean_in], "SavedVariance": [var_in]}
+    # training mode: custom-vjp BN — the round-5 TPU trace showed 33%
+    # of the ResNet-50 step inside reduce fusions, most of them the
+    # autodiff backward of the stats composition; the canonical BN
+    # backward needs exactly TWO reductions (sum dy, sum dy*xhat)
+    y, mean, var = _bn_train(red, float(eps), x, scale, bias)
+    mean_out = momentum * mean_in + (1 - momentum) * mean
+    var_out = momentum * var_in + (1 - momentum) * var
     return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
-            "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
+            "SavedMean": [mean], "SavedVariance": [var]}
+
+
+def _bn_bshape(x, red):
+    return [1 if i in red else x.shape[i] for i in range(x.ndim)]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _bn_train(red, eps, x, scale, bias):
+    y, mean, var, _ = _bn_train_fwd_impl(red, eps, x, scale, bias)
+    return y, mean, var
+
+
+def _bn_train_fwd_impl(red, eps, x, scale, bias):
+    xs = x.astype(jnp.float32)
+    mean = jnp.mean(xs, axis=red)
+    var = jnp.mean(jnp.square(xs), axis=red) - jnp.square(mean)
+    inv = jax.lax.rsqrt(var + eps)
+    a = scale.astype(jnp.float32) * inv
+    b = bias.astype(jnp.float32) - mean * a
+    bshape = _bn_bshape(x, red)
+    y = (x * a.reshape(bshape).astype(x.dtype)
+         + b.reshape(bshape).astype(x.dtype))
+    return y, mean, var, inv
+
+
+def _bn_train_fwd(red, eps, x, scale, bias):
+    # symbolic_zeros=True wraps each primal in a CustomVJPPrimal
+    x, scale, bias = x.value, scale.value, bias.value
+    y, mean, var, inv = _bn_train_fwd_impl(red, eps, x, scale, bias)
+    return (y, mean, var), (x, scale, mean, inv)
+
+
+def _bn_train_bwd(red, eps, residuals, cts):
+    """Canonical two-reduction batch-norm backward (the closed form the
+    reference's batch_norm_grad kernel implements,
+    batch_norm_op.cc KernelBackward):
+        dbias  = sum(dy);  dscale = sum(dy * xhat)
+        dx     = (scale*inv/N) * (N*dy - dbias - xhat*dscale)
+    plus the mean/var output paths — SymbolicZero on the training hot
+    path (they only feed the non-differentiated running-stat update),
+    so their full-shape terms are genuinely skipped, not left for XLA
+    zero-folding. A consumer of SavedMean/SavedVariance still
+    differentiates exactly."""
+    from jax.custom_derivatives import SymbolicZero
+    dy, dmean_ct, dvar_ct = cts
+    x, scale, mean, inv = residuals
+    bshape = _bn_bshape(x, red)
+    n = 1
+    for i in red:
+        n *= x.shape[i]
+    xs = x.astype(jnp.float32)
+    xhat = (xs - mean.reshape(bshape)) * inv.reshape(bshape)
+    if isinstance(dy, SymbolicZero):
+        dx = jnp.zeros(x.shape, jnp.float32)
+        dscale = jnp.zeros(scale.shape, jnp.float32)
+        dbias = jnp.zeros(scale.shape, jnp.float32)
+    else:
+        g = dy.astype(jnp.float32)
+        dbias = jnp.sum(g, axis=red)
+        dscale = jnp.sum(g * xhat, axis=red)
+        a = scale.astype(jnp.float32) * inv
+        dx = (a / n).reshape(bshape) * (
+            n * g - dbias.reshape(bshape)
+            - xhat * dscale.reshape(bshape))
+    # d mean/dx = 1/N; d var/dx = 2*(x-mean)/N
+    if not isinstance(dmean_ct, SymbolicZero):
+        dx = dx + (dmean_ct / n).reshape(bshape)
+    if not isinstance(dvar_ct, SymbolicZero):
+        dx = dx + dvar_ct.reshape(bshape) * (2.0 / n) * (
+            xs - mean.reshape(bshape))
+    return (dx.astype(x.dtype), dscale.astype(scale.dtype),
+            dbias.astype(scale.dtype))
+
+
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd, symbolic_zeros=True)
 
 
 @register_op("instance_norm", inputs=("X", "Scale", "Bias"),
@@ -590,13 +668,75 @@ def _dropout(ctx, ins, attrs):
         # common "disabled" config; generating a full mask of ones cost
         # more than the surrounding matmul)
         return {"Out": [x], "Mask": [jnp.ones_like(x)]}
+    from ..flags import get_flag
+    strategy = get_flag("FLAGS_dropout_storage", "xla")
+    upscale = impl == "upscale_in_train"
+    # NB: jnp.issubdtype, not dtype.kind == "f" — bfloat16's numpy kind
+    # is 'V' (void), and AMP bf16 activations are the main beneficiary
+    if strategy in ("u8", "seed") and jnp.issubdtype(x.dtype,
+                                                     jnp.floating):
+        key = ctx.rng()
+        out, mask = _drop_custom(1.0 - p, upscale, strategy == "u8",
+                                 x, key)
+        return {"Out": [out], "Mask": [mask]}
     keep = _keep_mask(ctx.rng(), 1.0 - p, x.shape)
     mask = keep.astype(x.dtype)
-    if impl == "upscale_in_train":
+    if upscale:
         out = jnp.where(keep, x / max(1.0 - p, 1e-12), 0.0)
     else:
         out = x * mask
     return {"Out": [out], "Mask": [mask]}
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _drop_custom(keep_prob, upscale, store_u8, x, key):
+    """Dropout whose backward residual is CHOSEN, not left to XLA's
+    cost model: the round-5 B=64 OOM dump showed XLA materializing
+    4 bytes/element (u32 full-shape buffers) for every keep decision —
+    [B,512,3072] FFN masks alone were 4.6G. store_u8=True pins the
+    residual to a uint8 mask (1 byte/elem); False stores only the PRNG
+    KEY and regenerates the identical mask in the backward from the
+    deterministic _keep_mask(key, ...) — zero mask bytes in HBM at the
+    price of re-running the rbg in the bwd (the flash kernel's
+    in-kernel dropout, kernels/flash_attention.py, is the same idea
+    one level lower). Selected by FLAGS_dropout_storage."""
+    out, mask, _ = _drop_fwd_impl(keep_prob, upscale, store_u8, x, key)
+    return out, mask
+
+
+def _drop_fwd_impl(keep_prob, upscale, store_u8, x, key):
+    keep = _keep_mask(key, keep_prob, x.shape)
+    if upscale:
+        out = jnp.where(keep, x / max(keep_prob, 1e-12), 0.0)
+    else:
+        out = jnp.where(keep, x, 0.0)
+    return out, keep.astype(x.dtype), keep
+
+
+def _drop_custom_fwd(keep_prob, upscale, store_u8, x, key):
+    out, mask, keep = _drop_fwd_impl(keep_prob, upscale, store_u8,
+                                     x, key)
+    res = keep.astype(jnp.uint8) if store_u8 else key
+    return (out, mask), (res, x.shape)
+
+
+def _drop_custom_bwd(keep_prob, upscale, store_u8, residuals, gs):
+    g_out, _g_mask = gs  # the Mask output is fwd-only
+    res, shape = residuals
+    if store_u8:
+        keep = res != 0
+    else:
+        keep = _keep_mask(res, keep_prob, shape)
+    if upscale:
+        dx = jnp.where(keep, g_out / max(keep_prob, 1e-12), 0.0)
+    else:
+        dx = jnp.where(keep, g_out, 0.0)
+    import numpy as _np
+    dkey = _np.zeros((2,), jax.dtypes.float0)  # uint32 key: zero-tangent
+    return dx.astype(g_out.dtype), dkey
+
+
+_drop_custom.defvjp(_drop_custom_fwd, _drop_custom_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))

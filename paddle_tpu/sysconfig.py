@@ -1,0 +1,25 @@
+"""paddle.sysconfig — include/lib path queries.
+
+Analog of /root/reference/python/paddle/sysconfig.py: get_include() and
+get_lib() point native extension builds at the framework's headers and
+shared objects. Here the native surface is csrc/ (the C inference API
+header pt_c_api.h and the ctypes-loaded helper libraries built into
+csrc/build), so those are the paths returned.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def get_include() -> str:
+    """Directory containing pt_c_api.h (the C serving API header)."""
+    return os.path.join(_ROOT, "csrc")
+
+
+def get_lib() -> str:
+    """Directory containing the built native helper libraries."""
+    return os.path.join(_ROOT, "csrc", "build")

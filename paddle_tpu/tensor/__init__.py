@@ -48,7 +48,8 @@ __all__ = [
 
 
 def _dt(dtype):
-    return _dtypes.convert_dtype(dtype or "float32")
+    # None defers to the process default (paddle.set_default_dtype)
+    return _dtypes.convert_dtype(dtype)
 
 
 # --------------------------------------------------------------------------
@@ -448,7 +449,15 @@ logical_and = _binary("logical_and")
 logical_or = _binary("logical_or")
 logical_xor = _binary("logical_xor")
 logical_not = _unary("logical_not")
-isfinite = _unary("isfinite")
+
+
+def isfinite(x, name=None):
+    """Elementwise (reference tensor/math.py:1844 isfinite_v2 — the
+    scalar any-reduce form is fluid's layers.isfinite/has_inf family):
+    x - x is 0 only for finite values (inf-inf and nan-nan are NaN,
+    and NaN compares unequal to everything)."""
+    d = subtract(x, x)
+    return equal(d, zeros_like(d))
 
 
 def isnan(x, name=None):
@@ -597,3 +606,129 @@ def numel(x, name=None):
 
 def shape(x):
     return _run("shape", {"Input": [x]}, {})
+
+
+# --------------------------------------------------------------------------
+# round-5 top-level parity closure: every name the reference exports
+# from python/paddle/__init__.py (non-commented DEFINE_ALIAS lines) has
+# a working top-level home here (tools/check_api_surface.py guards it).
+# --------------------------------------------------------------------------
+
+sin = _unary("sin")
+cos = _unary("cos")
+sinh = _unary("sinh")
+cosh = _unary("cosh")
+asin = _unary("asin")
+acos = _unary("acos")
+atan = _unary("atan")
+rsqrt = _unary("rsqrt")
+log1p = _unary("log1p")
+erf = _unary("erf")
+
+
+def mm(input, mat2, name=None):
+    """paddle.mm — matmul without the transpose flags."""
+    return matmul(input, mat2)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _run("addmm", {"Input": [input], "X": [x], "Y": [y]},
+                {"Alpha": float(alpha), "Beta": float(beta)})
+
+
+def addcmul(input, tensor1, tensor2, value=1.0, name=None):
+    """input + value * tensor1 * tensor2 (reference tensor/math.py
+    addcmul — composed; no dedicated kernel in the reference either)."""
+    prod_ = multiply(tensor1, tensor2)
+    if value != 1.0:
+        prod_ = _run("scale", {"X": [prod_]},
+                     {"scale": float(value), "bias": 0.0})
+    return add(input, prod_)
+
+
+def inverse(x, name=None):
+    return _run("inverse", {"Input": [x]}, {}, out_slot="Output")
+
+
+def cholesky(x, upper=False, name=None):
+    return _run("cholesky", {"X": [x]}, {"upper": bool(upper)})
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _run("trace", {"Input": [x]},
+                {"offset": int(offset), "axis1": int(axis1),
+                 "axis2": int(axis2)})
+
+
+def dist(x, y, p=2.0, name=None):
+    return _run("dist", {"X": [x], "Y": [y]}, {"p": float(p)})
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    attrs = {"keepdim": bool(keepdim), "reduce_all": axis is None}
+    if axis is not None:
+        attrs["axis"] = (list(axis) if isinstance(axis, (list, tuple))
+                         else [int(axis)])
+    return _run("logsumexp", {"X": [x]}, attrs)
+
+
+def isinf(x, name=None):
+    """Elementwise isinf (reference tensor/math.py:1895 isinf_v2; the
+    reduce-any scalar form lives at layers.has_inf / the `isinf` op):
+    inf = not finite and not nan."""
+    return logical_and(logical_not(isfinite(x)), logical_not(isnan(x)))
+
+
+def meshgrid(*args, name=None):
+    xs = list(args[0]) if len(args) == 1 and isinstance(
+        args[0], (list, tuple)) else list(args)
+    n = len(xs)
+    if in_dygraph_mode():
+        from ..dygraph import tape
+        return tape.run_op("meshgrid", {"X": xs}, {},
+                           n_outs={"Out": n})["Out"]
+    from ..layers.helper import LayerHelper
+    helper = LayerHelper("meshgrid")
+    outs = [helper.create_tmp_variable() for _ in range(n)]
+    helper.append_op("meshgrid", inputs={"X": [x.name for x in xs]},
+                     outputs={"Out": [o.name for o in outs]}, attrs={})
+    return outs
+
+
+def bernoulli(x, name=None):
+    return _run("bernoulli", {"X": [x]}, {})
+
+
+def equal_all(x, y, name=None):
+    """Scalar bool: all elements equal (reference tensor/logic.py)."""
+    eq = equal(x, y)
+    return _run("reduce_all", {"X": [eq]}, {"reduce_all": True})
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    return _run("histogram", {"X": [input]},
+                {"bins": int(bins), "min": float(min), "max": float(max)})
+
+
+def shuffle(x, name=None):
+    """Random permutation along axis 0 (reference tensor/random.py
+    shuffle -> the fluid shuffle pass over rows)."""
+    perm = randperm(int(x.shape[0]), dtype="int64")
+    return index_select(x, perm, axis=0)
+
+
+remainder = mod
+floor_mod = mod
+
+
+def elementwise_sum(inputs, name=None):
+    """Sum a list of tensors (reference sum_op over N inputs)."""
+    return _run("sum", {"X": list(inputs)}, {})

@@ -1,0 +1,23 @@
+"""paddle.text — text models and datasets namespace.
+
+Analog of /root/reference/python/paddle/text/__init__.py. The reference
+module re-exports seq2seq/RNN building blocks (text.py) and the text
+dataset classes (datasets/). Those capabilities live in nn.rnn,
+nn.decode, nn.transformer and datasets.py here; this package gives them
+the reference import paths.
+"""
+from ..nn.rnn import (RNN, LSTM, GRU, LSTMCell, GRUCell,  # noqa: F401
+                      RNNCellBase)
+from ..nn.decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
+from ..nn.transformer import (MultiHeadAttention,  # noqa: F401
+                              TransformerEncoder,
+                              TransformerEncoderLayer)
+from . import datasets  # noqa: F401
+from .datasets import *  # noqa: F401,F403
+
+# reference text.py aliases (BasicLSTMCell/BasicGRUCell are the
+# pre-2.0 names of the same cells; RNNCell is the cell base protocol)
+RNNCell = RNNCellBase
+BasicLSTMCell = LSTMCell
+BasicGRUCell = GRUCell
+DynamicDecode = dynamic_decode

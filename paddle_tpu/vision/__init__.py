@@ -1,0 +1,14 @@
+"""paddle.vision — models, transforms and datasets for vision work.
+
+Analog of /root/reference/python/paddle/vision/__init__.py which
+re-exports models/transforms/datasets. The implementations live in
+models/ (ResNet, VGG, MobileNetV2, LeNet — built TPU-first) and
+vision_transforms.py; this package gives them the reference's import
+paths (`paddle.vision.models.resnet50`, `paddle.vision.transforms.*`,
+`paddle.vision.datasets.MNIST`).
+"""
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from .models import *  # noqa: F401,F403
+from .datasets import *  # noqa: F401,F403

@@ -1,0 +1,53 @@
+"""paddle.vision.datasets — map-style Dataset classes (MNIST, Cifar10,
+Cifar100 — reference python/paddle/vision/datasets) over the package's
+dataset readers (datasets.py: cached real files when present, loud
+deterministic synthetic corpus otherwise — this container is
+zero-egress)."""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..reader import Dataset
+from .. import datasets as _readers
+
+__all__ = ["MNIST", "Cifar10", "Cifar100"]
+
+
+class _ReaderDataset(Dataset):
+    """Materializes a reader-creator's sample stream once (the built-in
+    corpora are small) and serves it map-style with optional transform."""
+
+    def __init__(self, reader, transform: Optional[Callable] = None):
+        self._samples = list(reader())
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        img, label = self._samples[idx]
+        img = np.asarray(img)
+        if self._transform is not None:
+            img = self._transform(img)
+        return img, np.asarray(label, np.int64)
+
+    def __len__(self):
+        return len(self._samples)
+
+
+class MNIST(_ReaderDataset):
+    def __init__(self, mode: str = "train", transform=None, **kw):
+        reader = (_readers.mnist.train() if mode == "train"
+                  else _readers.mnist.test())
+        super().__init__(reader, transform)
+
+
+class Cifar10(_ReaderDataset):
+    def __init__(self, mode: str = "train", transform=None, **kw):
+        reader = (_readers.cifar.train() if mode == "train"
+                  else _readers.cifar.test())
+        super().__init__(reader, transform)
+
+
+class Cifar100(Cifar10):
+    """Same corpus surface; the synthetic reader serves 10 classes —
+    documented drift until a real cifar-100 cache is mounted."""

@@ -1,0 +1,12 @@
+"""paddle.vision.models — the model zoo under its reference path
+(/root/reference/python/paddle/vision/models: resnet, vgg, mobilenet,
+lenet)."""
+from ..models.resnet import (ResNet, resnet18, resnet34,  # noqa: F401
+                             resnet50, resnet101, resnet152)
+from ..models.lenet import LeNet  # noqa: F401
+from ..models.vision_zoo import (MobileNetV2, VGG,  # noqa: F401
+                                 mobilenet_v2, vgg11, vgg16, vgg19)
+
+__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+           "resnet152", "LeNet", "MobileNetV2", "mobilenet_v2", "VGG",
+           "vgg11", "vgg16", "vgg19"]

@@ -1,0 +1,5 @@
+"""paddle.vision.transforms — re-export of the transform pipeline
+(vision_transforms.py: Compose, Resize, crops, flips, Normalize,
+Transpose, ToTensor — reference python/paddle/vision/transforms)."""
+from ..vision_transforms import *  # noqa: F401,F403
+from ..vision_transforms import __all__  # noqa: F401

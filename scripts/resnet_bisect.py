@@ -1,0 +1,165 @@
+"""ResNet-50 forward MFU bisect (round-5: fwd is 35% vs the conv
+microbench's ~80% — find where the other half goes).
+
+Times, on the real chip at B=256 bf16:
+  1. the EXACT conv set of ResNet-50 as one jitted chain-free program,
+     NCHW vs NHWC dimension numbers;
+  2. conv+BN+relu per layer (the fused glue);
+  3. the full model forward (the number being diagnosed).
+
+If (1) is far above the microbench's implied time, the conv SHAPES
+(1x1 bottlenecks, stride-2, the 7x7 stem) are the cost and layout is
+secondary; if (1) is fast and (2) is slow, BN/relu glue is the cost.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+B = 256
+PEAK = 197e12
+
+# (C_in, H, O, k, stride) per unique conv; count = occurrences in r50.
+# Bottleneck v1.5 (stride in the 3x3), torchvision/reference layout.
+CONVS = [
+    (3,   224, 64,  7, 2, 1),
+    # stage 1 @56: in 64
+    (64,  56, 64, 1, 1, 1), (64, 56, 64, 3, 1, 3), (64, 56, 256, 1, 1, 3),
+    (64,  56, 256, 1, 1, 1),            # projection
+    (256, 56, 64, 1, 1, 2),             # later blocks' reduce
+    # stage 2 @28
+    (256, 56, 128, 1, 1, 1), (128, 56, 128, 3, 2, 1),   # block 1 reduce+s2
+    (128, 28, 128, 3, 1, 3), (128, 28, 512, 1, 1, 4),
+    (256, 56, 512, 1, 2, 1),            # projection s2
+    (512, 28, 128, 1, 1, 3),
+    # stage 3 @14
+    (512, 28, 256, 1, 1, 1), (256, 28, 256, 3, 2, 1),
+    (256, 14, 256, 3, 1, 5), (256, 14, 1024, 1, 1, 6),
+    (512, 28, 1024, 1, 2, 1),
+    (1024, 14, 256, 1, 1, 5),
+    # stage 4 @7
+    (1024, 14, 512, 1, 1, 1), (512, 14, 512, 3, 2, 1),
+    (512, 7, 512, 3, 1, 2), (512, 7, 2048, 1, 1, 3),
+    (1024, 14, 2048, 1, 2, 1),
+    (2048, 7, 512, 1, 1, 2),
+]
+
+
+def flops():
+    total = 0
+    for c, h, o, k, s, n in CONVS:
+        ho = h // s
+        total += n * 2 * B * o * ho * ho * c * k * k
+    return total
+
+
+def timeit(f, *a, n=10):
+    jax.block_until_ready(f(*a))
+    t0 = time.time()
+    for _ in range(n):
+        r = f(*a)
+    jax.block_until_ready(r)
+    return (time.time() - t0) / n
+
+
+def build(layout, with_bn_relu=False):
+    rng = np.random.RandomState(0)
+    xs, ws, dns, strides, scales = [], [], [], [], []
+    for c, h, o, k, s, cnt in CONVS:
+        if layout == "NCHW":
+            x = jnp.asarray(rng.randn(B, c, h, h) * 0.1, jnp.bfloat16)
+            spec = ("NCHW", "OIHW", "NCHW")
+        else:
+            x = jnp.asarray(rng.randn(B, h, h, c) * 0.1, jnp.bfloat16)
+            spec = ("NHWC", "HWIO", "NHWC")
+        w_shape = ((o, c, k, k) if layout == "NCHW" else (k, k, c, o))
+        w = jnp.asarray(rng.randn(*w_shape) * 0.05, jnp.bfloat16)
+        xs.append(x)
+        ws.append(w)
+        dns.append(jax.lax.conv_dimension_numbers(x.shape, w.shape, spec))
+        strides.append(s)
+        scales.append(jnp.asarray(rng.rand(o) + 0.5, jnp.float32))
+
+    def f(xs, ws):
+        acc = jnp.zeros((), jnp.float32)
+        for (c, h, o, k, s, cnt), x, w, dn, sc in zip(
+                CONVS, xs, ws, dns, scales):
+            pad = [(k // 2, k // 2)] * 2
+            y = jax.lax.conv_general_dilated(
+                x, w, (s, s), pad, dimension_numbers=dn)
+            if with_bn_relu:
+                red = (0, 2, 3) if layout == "NCHW" else (0, 1, 2)
+                yf = y.astype(jnp.float32)
+                m = jnp.mean(yf, axis=red)
+                v = jnp.mean(jnp.square(yf), axis=red) - jnp.square(m)
+                a = sc * jax.lax.rsqrt(v + 1e-5)
+                b = -m * a
+                shp = ([1, o, 1, 1] if layout == "NCHW" else [1, 1, 1, o])
+                y = jax.nn.relu(y * a.reshape(shp).astype(y.dtype)
+                                + b.reshape(shp).astype(y.dtype))
+            # weight each unique conv by its occurrence count via the
+            # accumulator only (running it cnt times would recompute;
+            # the per-conv cost is what we scale analytically below)
+            acc = acc + jnp.sum(y.astype(jnp.float32)) * cnt
+        return acc
+    return jax.jit(f), xs, ws
+
+
+def main():
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    fl = flops()
+    print("analytic conv FLOPs (x counts): %.2f G/img" % (fl / B / 1e9))
+    for layout in ("NCHW", "NHWC"):
+        f, xs, ws = build(layout, with_bn_relu=False)
+        t1 = timeit(f, xs, ws)
+        f2, xs2, ws2 = build(layout, with_bn_relu=True)
+        t2 = timeit(f2, xs2, ws2)
+        # t measures each UNIQUE conv once; scale to the counted set
+        uniq = 0
+        for c, h, o, k, s, cnt in CONVS:
+            ho = h // s
+            uniq += 2 * B * o * ho * ho * c * k * k
+        scale = fl / uniq
+        print("%s: unique-conv pass %.2fms (counted-est %.2fms, "
+              "mfu-est %.3f); +bn/relu %.2fms (est %.2fms)"
+              % (layout, t1 * 1e3, t1 * scale * 1e3,
+                 fl / (t1 * scale) / PEAK,
+                 t2 * 1e3, t2 * scale * 1e3))
+
+    from paddle_tpu.models.resnet import resnet50
+    from paddle_tpu.jit import to_static
+    import paddle_tpu as pt
+    pt.seed(0)
+    model = resnet50(num_classes=1000)
+    model.eval()
+    rng = np.random.RandomState(0)
+    x = jax.device_put(rng.randn(B, 3, 224, 224).astype(np.float32))
+    from paddle_tpu.jit import functional_call, tape
+    from paddle_tpu.jit import Tensor as _T
+    from paddle_tpu.jit import _named_state
+    params, buffers = _named_state(model)
+    full = {**{k: v.value for k, v in params.items()},
+            **{k: v.value for k, v in buffers.items()}}
+
+    def fwd(state, xx):
+        old = tape._state.amp_dtype
+        tape._state.amp_dtype = "bfloat16"
+        try:
+            out, _ = functional_call(model, state, _T(xx), training=False)
+        finally:
+            tape._state.amp_dtype = old
+        return jnp.sum(out.value.astype(jnp.float32))
+
+    jf = jax.jit(fwd)
+    t = timeit(jf, full, x)
+    print("full model fwd (eval): %.2fms  mfu=%.3f"
+          % (t * 1e3, 2 * 4.09e9 * B / t / PEAK))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""The custom two-reduction batch-norm backward (ops/nn.py _bn_train)
+must match jax autodiff of the plain stats composition exactly — it
+exists for speed (round-5 TPU trace: 33% of the ResNet step in reduce
+fusions), not for different math."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.nn import _bn_train
+
+
+def _composition(red, eps, x, scale, bias):
+    xs = x.astype(jnp.float32)
+    mean = jnp.mean(xs, axis=red)
+    var = jnp.mean(jnp.square(xs), axis=red) - jnp.square(mean)
+    inv = jax.lax.rsqrt(var + eps)
+    a = scale.astype(jnp.float32) * inv
+    b = bias.astype(jnp.float32) - mean * a
+    bshape = [1 if i in red else x.shape[i] for i in range(x.ndim)]
+    y = (x * a.reshape(bshape).astype(x.dtype)
+         + b.reshape(bshape).astype(x.dtype))
+    return y, mean, var
+
+
+def test_bn_custom_vjp_matches_autodiff():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 6, 5, 5), jnp.float32)
+    scale = jnp.asarray(rng.rand(6) + 0.5, jnp.float32)
+    bias = jnp.asarray(rng.randn(6), jnp.float32)
+    red, eps = (0, 2, 3), 1e-5
+    ct = jnp.asarray(rng.randn(4, 6, 5, 5), jnp.float32)
+
+    def loss_custom(x, s, b):
+        y, mean, var = _bn_train(red, eps, x, s, b)
+        return jnp.sum(y * ct)
+
+    def loss_ref(x, s, b):
+        y, mean, var = _composition(red, eps, x, s, b)
+        return jnp.sum(y * ct)
+
+    g_c = jax.grad(loss_custom, argnums=(0, 1, 2))(x, scale, bias)
+    g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(x, scale, bias)
+    for gc, gr, name in zip(g_c, g_r, ("dx", "dscale", "dbias")):
+        np.testing.assert_allclose(np.asarray(gc), np.asarray(gr),
+                                   rtol=2e-5, atol=2e-5, err_msg=name)
+
+
+def test_bn_custom_vjp_mean_var_cotangents():
+    """A loss consuming SavedMean/SavedVariance still differentiates
+    exactly (the dmean/dvar paths in _bn_train_bwd)."""
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(3, 4, 6), jnp.float32)
+    scale = jnp.asarray(rng.rand(4) + 0.5, jnp.float32)
+    bias = jnp.asarray(rng.randn(4), jnp.float32)
+    red, eps = (0, 2), 1e-5
+
+    def loss_custom(x):
+        y, mean, var = _bn_train(red, eps, x, scale, bias)
+        return jnp.sum(y) + 2.0 * jnp.sum(mean) + 0.5 * jnp.sum(var)
+
+    def loss_ref(x):
+        y, mean, var = _composition(red, eps, x, scale, bias)
+        return jnp.sum(y) + 2.0 * jnp.sum(mean) + 0.5 * jnp.sum(var)
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(loss_custom)(x)),
+        np.asarray(jax.grad(loss_ref)(x)), rtol=2e-5, atol=2e-5)
+
+
+def test_bn_bf16_stays_bf16():
+    x = jnp.asarray(np.random.RandomState(2).randn(2, 3, 4, 4),
+                    jnp.bfloat16)
+    scale = jnp.ones((3,), jnp.float32)
+    bias = jnp.zeros((3,), jnp.float32)
+    y, mean, var = _bn_train((0, 2, 3), 1e-5, x, scale, bias)
+    assert y.dtype == jnp.bfloat16
+    assert mean.dtype == jnp.float32 and var.dtype == jnp.float32
+    g = jax.grad(lambda xx: jnp.sum(
+        _bn_train((0, 2, 3), 1e-5, xx, scale, bias)[0]
+        .astype(jnp.float32)))(x)
+    assert g.dtype == jnp.bfloat16
+    assert np.isfinite(np.asarray(g, np.float32)).all()

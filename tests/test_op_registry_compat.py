@@ -25,6 +25,18 @@ def test_golden_has_full_surface():
     assert ops["lookup_table_v2"]["non_diff_inputs"] == ["Ids"]
 
 
+def test_api_surface_matches_reference():
+    """Top-level name parity with the reference's python/paddle
+    __init__ (tools/check_api_surface.py; reference analog:
+    tools/check_api_compatible.py)."""
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "check_api_surface.py")
+    env = dict(os.environ, PT_FORCE_CPU="1")
+    proc = subprocess.run([sys.executable, tool], capture_output=True,
+                          text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
 def test_tpu_scripts_parse():
     """The run-sheet scripts are TPU-only (never executed in CI); at
     least guarantee they stay syntactically valid (.py via ast, .sh via
