@@ -13,6 +13,16 @@ idiomatic JAX/XLA/Pallas stack for TPU:
   (parallel/), not NCCL/gRPC translation.
 """
 __version__ = "0.1.0"
+# version metadata the reference exports from paddle/version.py
+full_version = __version__
+commit = "unknown"  # stamped by release tooling; dev trees have none
+
+
+def check_import_scipy(os_name=None):
+    """The reference's windows scipy-DLL preflight (paddle/check_import_
+    scipy.py). Nothing to check on linux/TPU images — scipy is either
+    importable or absent by design; kept for call-site parity."""
+    return True
 
 from . import core  # noqa: F401
 from . import ops  # noqa: F401  (registers the op library)

@@ -21,6 +21,8 @@ long_type = int  # py2 `long` unified into int
 def _convert(obj: Any, conv, inplace: bool):
     if obj is None or isinstance(obj, (int, float)):
         return obj
+    if isinstance(obj, tuple):  # immutable: inplace is meaningless
+        return tuple(_convert(o, conv, False) for o in obj)
     if isinstance(obj, list):
         if inplace:
             obj[:] = [_convert(o, conv, inplace) for o in obj]

@@ -11,3 +11,61 @@ from .pslib_desc import (DownpourDescriptor, DownpourServerDesc,  # noqa: F401
 from .multi_trainer import (MultiTrainer, recompute,  # noqa: F401
                             train_from_dataset)
 from .trainer_factory import TrainerDesc, TrainerFactory  # noqa: F401
+
+# ---------------------------------------------------------------------------
+# round-5 parity closure (reference python/paddle/distributed/__init__):
+# the collective/dygraph-parallel entry points live in paddle_tpu.parallel
+# (jax.distributed + mesh env); re-export them under the reference paths.
+# ---------------------------------------------------------------------------
+from ..parallel import (collective, get_rank,  # noqa: F401,E402
+                        get_world_size, init_parallel_env)
+from ..parallel import env as parallel  # noqa: F401,E402
+from ..parallel.env import DistEnv as ParallelEnv  # noqa: F401,E402
+
+
+def prepare_context(strategy=None):
+    """Legacy dygraph parallel-context bootstrap (reference
+    parallel.py prepare_context): init_parallel_env is the working
+    entry point; returns the environment for compatibility."""
+    return init_parallel_env()
+
+
+def _spawn_worker(func, rank, nprocs, args):
+    """Per-worker bootstrap: publish the rank identity through the
+    cluster-contract env vars BEFORE user code runs, exactly how the
+    reference's spawn primes PADDLE_TRAINER_ID for init_parallel_env
+    (distributed/spawn.py _func_wrapper)."""
+    import os as _os
+    _os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    _os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    _os.environ["PADDLE_RANK_IN_NODE"] = str(rank)
+    func(*args)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Multi-process launcher (reference distributed/spawn.py). On TPU
+    pods the launcher is fleet.launch / jax.distributed (one process
+    per host, XLA owns intra-host chips), so spawn maps to local
+    multiprocessing for CPU-mesh testing and small-scale use. Each
+    worker gets its rank via the PADDLE_TRAINER_ID env contract (read
+    by init_parallel_env / get_rank)."""
+    import multiprocessing as mp
+
+    if nprocs <= 0:
+        nprocs = 1
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_worker,
+                        args=(func, rank, nprocs, tuple(args)),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode:
+                raise RuntimeError(
+                    "spawn: a worker exited with code %d" % p.exitcode)
+    return procs

@@ -274,3 +274,12 @@ def load_dygraph(model_path):
     params = load(base + ".pdparams")
     opt = load(base + ".pdopt") if os.path.exists(base + ".pdopt") else None
     return params, opt
+
+
+# ---------------------------------------------------------------------------
+# round-5 parity closure: the reference's paddle.io exports the data
+# loading surface too (python/paddle/io/__init__.py) — same objects as
+# paddle_tpu.reader
+# ---------------------------------------------------------------------------
+from .reader import (BatchSampler, DataLoader, Dataset,  # noqa: F401,E402
+                     IterableDataset, TensorDataset, shuffle)

@@ -159,7 +159,9 @@ _OP_BACKED = {
     "roi_perspective_transform": ("roi_perspective_transform", None),
     "roi_pool": ("roi_pool", None),
     "row_conv": ("row_conv", None),
+    "retinanet_target_assign": ("retinanet_target_assign", None),
     "rpn_target_assign": ("rpn_target_assign", None),
+    "deformable_roi_pooling": ("deformable_roi_pooling", None),
     "sampling_id": ("sampling_id", None),
     "scatter_nd": ("scatter_nd", None),
     "selu": ("selu", None),

@@ -323,3 +323,27 @@ class DetectionMAP(Metric):
                     prev_r = r
             aps.append(ap)
         return float(np.mean(aps)) if aps else 0.0
+
+
+# ---------------------------------------------------------------------------
+# round-5 parity closure: fluid metric functions + the `metrics`
+# submodule name (reference python/paddle/metric/__init__.py re-exports
+# `from . import metrics` whose contents are this module)
+# ---------------------------------------------------------------------------
+import sys as _sys
+
+metrics = _sys.modules[__name__]
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k batch accuracy (fluid layers.accuracy / accuracy_op.cc)."""
+    from .layers import accuracy as _acc
+    return _acc(input, label, k, correct, total)
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """Chunk-level P/R/F1 (chunk_eval_op.cc) via the layers surface."""
+    from .layers import chunk_eval as _ce
+    return _ce(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types, seq_length)

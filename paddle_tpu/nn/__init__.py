@@ -14,4 +14,47 @@ from .transformer import (MultiHeadAttention, Transformer,  # noqa: F401
                           TransformerEncoder, TransformerEncoderLayer)
 from .rnn import (BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN,  # noqa: F401
                   SimpleRNN, SimpleRNNCell)
-from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
+from .decode import (BeamSearchDecoder, Decoder,  # noqa: F401
+                     beam_search, beam_search_decode, dynamic_decode)
+
+# ---------------------------------------------------------------------------
+# round-5 parity closure: the remaining names the reference exports from
+# python/paddle/nn/__init__.py (2.0-beta aliases, pad/pool/1d-3d-conv
+# layer classes, norm variants, weight-norm hooks, fluid re-exports).
+# ---------------------------------------------------------------------------
+from .compat import (  # noqa: F401
+    AdaptiveAvgPool1d, AdaptiveAvgPool2d, AdaptiveAvgPool3d,
+    AdaptiveMaxPool1d, AdaptiveMaxPool2d, AdaptiveMaxPool3d,
+    AlphaDropout, AvgPool1d, AvgPool2d, AvgPool3d, BatchNorm1d,
+    BatchNorm2d, BatchNorm3d, Bilinear, BilinearTensorProduct, CTCLoss,
+    ConstantPad1d, ConstantPad2d, ConstantPad3d, Conv1d, Conv2d, Conv3d,
+    ConvTranspose1d, ConvTranspose2d, ConvTranspose3d, CosineSimilarity,
+    Dropout2d, Dropout3d, ELU, HSigmoid, Hardshrink, Hardtanh,
+    InstanceNorm, InstanceNorm1d, InstanceNorm2d, InstanceNorm3d,
+    LogSigmoid, LogSoftmax, MarginRankingLoss, MaxPool1d, MaxPool2d,
+    MaxPool3d, PReLU, Pad2D, PairwiseDistance, PixelShuffle, Pool2D,
+    ReflectionPad1d, ReflectionPad2d, ReplicationPad1d, ReplicationPad2d,
+    ReplicationPad3d, RowConv, SELU, Softshrink, Softsign, SpectralNorm,
+    SyncBatchNorm, Tanhshrink, Upsample, UpsamplingBilinear2d,
+    UpsamplingNearest2d, ZeroPad2d, remove_weight_norm, weight_norm)
+from . import compat as weight_norm_hook  # noqa: F401  (hook module home)
+from . import initializer  # noqa: F401
+from ..optimizer import (GradientClipByGlobalNorm,  # noqa: F401
+                         GradientClipByNorm, GradientClipByValue)
+
+# the reference's nn namespace re-groups functional submodules and a few
+# fluid layer functions at nn.* — resolve them from the same homes
+from .functional import (common, conv, extension, loss, norm,  # noqa: F401
+                         pooling, vision)
+
+
+def __getattr__(name):
+    # fluid layer functions the reference re-exports at nn.* (clip,
+    # control flow, beam search); lazy to avoid an import cycle with
+    # layers -> nn.functional
+    if name in ("case", "clip", "clip_by_norm", "cond", "gather_tree",
+                "switch_case", "while_loop"):
+        from .. import layers
+        return getattr(layers, name)
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

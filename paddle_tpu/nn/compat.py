@@ -1,0 +1,607 @@
+"""nn parity closure (round 5): the layer classes the reference exports
+from python/paddle/nn/__init__.py that don't already live in
+layers_lib/transformer/rnn. Three kinds:
+- 2.0-beta lowercase-`d` aliases of the existing `D` classes (this fork
+  predates the capitalization change);
+- thin Layer wrappers over the nn.functional parity surface (pads,
+  pools, 1d/3d convs, activations, losses);
+- norm variants (InstanceNorm*, SpectralNorm, SyncBatchNorm — the last
+  is BatchNorm itself: under pjit/GSPMD the batch-stat reductions run
+  over the GLOBAL sharded batch with XLA-inserted collectives, which IS
+  sync-BN semantics; the reference needs a dedicated NCCL kernel,
+  operators/sync_batch_norm_op.cu).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..layers.helper import Constant, Normal, ParamAttr
+from . import functional as F
+from .layer import Layer
+from .layers_lib import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm,
+                         BatchNorm1D, BatchNorm2D, BatchNorm3D, Conv2D,
+                         Conv2DTranspose, Dropout, MaxPool2D)
+
+
+# -- 2.0-beta lowercase aliases --------------------------------------------
+
+Conv2d = Conv2D
+ConvTranspose2d = Conv2DTranspose
+BatchNorm1d = BatchNorm1D
+BatchNorm2d = BatchNorm2D
+BatchNorm3d = BatchNorm3D
+MaxPool2d = MaxPool2D
+AvgPool2d = AvgPool2D
+AdaptiveAvgPool2d = AdaptiveAvgPool2D
+
+
+# -- activations -----------------------------------------------------------
+
+def _act(name, fn, arg_names=(), **defaults):
+    class _A(Layer):
+        def __init__(self, *args, **kw):
+            super().__init__()
+            kw.pop("name", None)
+            self._kw = {**defaults, **dict(zip(arg_names, args)), **kw}
+
+        def forward(self, x):
+            return fn(x, **self._kw)
+
+    _A.__name__ = name
+    _A.__qualname__ = name
+    return _A
+
+
+ELU = _act("ELU", lambda x, alpha=1.0: F._run(
+    "elu", {"X": [x]}, {"alpha": float(alpha)}), ("alpha",))
+SELU = _act("SELU", lambda x: F._run("selu", {"X": [x]}, {}))
+Hardshrink = _act("Hardshrink", lambda x, threshold=0.5: F._run(
+    "hard_shrink", {"X": [x]}, {"threshold": float(threshold)}),
+    ("threshold",))
+Softshrink = _act("Softshrink", lambda x, threshold=0.5: F._run(
+    "soft_shrink", {"X": [x]}, {"lambda": float(threshold)}),
+    ("threshold",))
+Tanhshrink = _act("Tanhshrink", lambda x: F.tanhshrink(x))
+Softsign = _act("Softsign", lambda x: F._run("softsign", {"X": [x]}, {}))
+LogSigmoid = _act("LogSigmoid", lambda x: F.logsigmoid(x))
+Hardtanh = _act("Hardtanh",
+                lambda x, min=-1.0, max=1.0: F.hardtanh(x, min, max),
+                ("min", "max"))
+LogSoftmax = _act("LogSoftmax",
+                  lambda x, axis=-1: F.log_softmax(x, axis), ("axis",))
+
+
+class PReLU(Layer):
+    """Learnable leaky-relu slope (prelu_op.cc; num_parameters=1 is the
+    'all' mode, =C the 'channel' mode)."""
+
+    def __init__(self, num_parameters: int = 1, init: float = 0.25,
+                 weight_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight)
+
+
+class HSigmoid(Layer):
+    """Hierarchical sigmoid classification head (hsigmoid_op.cc)."""
+
+    def __init__(self, feature_size: int, num_classes: int,
+                 weight_attr=None, bias_attr=None, is_custom=False,
+                 is_sparse=False, name=None):
+        super().__init__()
+        self._num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr,
+            default_initializer=Normal(
+                0.0, 1.0 / math.sqrt(feature_size)))
+        self.bias = self.create_parameter([num_classes - 1, 1],
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid(input, label, self._num_classes, self.weight,
+                          self.bias, path_table, path_code)
+
+
+# -- dropout variants ------------------------------------------------------
+
+class AlphaDropout(Layer):
+    def __init__(self, p: float = 0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, self.p, training=self.training)
+
+
+class Dropout2d(Layer):
+    def __init__(self, p: float = 0.5, data_format="NCHW", name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.dropout2d(x, self.p, training=self.training)
+
+
+class Dropout3d(Layer):
+    def __init__(self, p: float = 0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.dropout3d(x, self.p, training=self.training)
+
+
+# -- padding ---------------------------------------------------------------
+
+def _pad_layer(name, mode, spatial):
+    """2.0-style pad layers: `padding` is last-spatial-dim-first pairs
+    ([left,right] 1d; [l,r,t,b] 2d; [l,r,t,b,front,back] 3d — the torch
+    convention the reference classes adopt, nn/layer/common.py). The
+    pad2d OP takes [top,bottom,left,right] and pad3d takes
+    [l,r,t,b,front,back]; 1d routes through pad2d with a unit height."""
+
+    class _P(Layer):
+        def __init__(self, padding, value: float = 0.0,
+                     data_format=None, name=None):
+            super().__init__()
+            if isinstance(padding, int):
+                padding = [padding] * (2 * spatial)
+            self._padding = list(padding)
+            self._value = value
+
+        def forward(self, x):
+            p = self._padding
+            if spatial == 1:
+                x4 = F._run("unsqueeze2", {"X": [x]}, {"axes": [2]})
+                out = F.pad(x4, [0, 0, p[0], p[1]], mode=mode,
+                            value=self._value)
+                return F._run("squeeze2", {"X": [out]}, {"axes": [2]})
+            if spatial == 2:
+                op_pad = [p[2], p[3], p[0], p[1]]  # -> [t,b,l,r]
+            else:
+                op_pad = p  # pad3d already takes [l,r,t,b,front,back]
+            return F.pad(x, op_pad, mode=mode, value=self._value)
+
+    _P.__name__ = name
+    _P.__qualname__ = name
+    return _P
+
+
+ConstantPad1d = _pad_layer("ConstantPad1d", "constant", 1)
+ConstantPad2d = _pad_layer("ConstantPad2d", "constant", 2)
+ConstantPad3d = _pad_layer("ConstantPad3d", "constant", 3)
+ZeroPad2d = _pad_layer("ZeroPad2d", "constant", 2)
+ReflectionPad1d = _pad_layer("ReflectionPad1d", "reflect", 1)
+ReflectionPad2d = _pad_layer("ReflectionPad2d", "reflect", 2)
+ReplicationPad1d = _pad_layer("ReplicationPad1d", "edge", 1)
+ReplicationPad2d = _pad_layer("ReplicationPad2d", "edge", 2)
+ReplicationPad3d = _pad_layer("ReplicationPad3d", "edge", 3)
+
+
+class Pad2D(Layer):
+    """fluid-style Pad2D (mode constant/reflect/edge)."""
+
+    def __init__(self, paddings=0, mode="constant", pad_value=0.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        if isinstance(paddings, int):
+            paddings = [paddings] * 4
+        self._padding = list(paddings)
+        self._mode = mode
+        self._value = pad_value
+
+    def forward(self, x):
+        return F.pad(x, self._padding, mode=self._mode,
+                     value=self._value)
+
+
+# -- pooling ---------------------------------------------------------------
+
+def _pool_layer(name, fn, has_stride=True):
+    class _P(Layer):
+        def __init__(self, kernel_size=None, stride=None, padding=0,
+                     ceil_mode=False, output_size=None, name=None,
+                     **kw):
+            super().__init__()
+            self._args = (kernel_size if output_size is None
+                          else output_size, stride, padding, ceil_mode)
+            self._adaptive = output_size is not None or not has_stride
+
+        def forward(self, x):
+            k, s, p, cm = self._args
+            if self._adaptive:
+                return fn(x, k)
+            return fn(x, k, s, p, cm)
+
+    _P.__name__ = name
+    _P.__qualname__ = name
+    return _P
+
+
+MaxPool1d = _pool_layer("MaxPool1d", F.max_pool1d)
+AvgPool1d = _pool_layer("AvgPool1d", F.avg_pool1d)
+MaxPool3d = _pool_layer("MaxPool3d", F.max_pool3d)
+AvgPool3d = _pool_layer("AvgPool3d", F.avg_pool3d)
+AdaptiveAvgPool1d = _pool_layer("AdaptiveAvgPool1d",
+                                F.adaptive_avg_pool1d, has_stride=False)
+AdaptiveAvgPool3d = _pool_layer("AdaptiveAvgPool3d",
+                                F.adaptive_avg_pool3d, has_stride=False)
+AdaptiveMaxPool1d = _pool_layer("AdaptiveMaxPool1d",
+                                F.adaptive_max_pool1d, has_stride=False)
+AdaptiveMaxPool2d = _pool_layer("AdaptiveMaxPool2d",
+                                F.adaptive_max_pool2d, has_stride=False)
+AdaptiveMaxPool3d = _pool_layer("AdaptiveMaxPool3d",
+                                F.adaptive_max_pool3d, has_stride=False)
+
+
+class Pool2D(Layer):
+    """fluid.dygraph.Pool2D (pool_type max/avg)."""
+
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, ceil_mode=False,
+                 exclusive=True, name=None):
+        super().__init__()
+        self._a = (pool_size, pool_type, pool_stride, pool_padding,
+                   global_pooling, ceil_mode, exclusive)
+
+    def forward(self, x):
+        k, t, s, p, gp, cm, ex = self._a
+        from .functional import _pool2d
+        return _pool2d(x, k if k != -1 else list(x.shape[2:]), s, p, t,
+                       cm, ex, global_pool=gp)
+
+
+# -- 1d/3d convs -----------------------------------------------------------
+
+class Conv1d(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCL"):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, int) else \
+            kernel_size[0]
+        self._cfg = (stride, padding, dilation, groups)
+        fan_in = in_channels // groups * k
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, k], attr=weight_attr,
+            default_initializer=Normal(0.0, math.sqrt(2.0 / fan_in)))
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        s, p, d, g = self._cfg
+        return F.conv1d(x, self.weight, self.bias, s, p, d, g)
+
+
+class Conv3d(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = [kernel_size] * 3
+        self._cfg = (stride, padding, dilation, groups)
+        fan_in = in_channels // groups * int(np.prod(kernel_size))
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups] + list(kernel_size),
+            attr=weight_attr,
+            default_initializer=Normal(0.0, math.sqrt(2.0 / fan_in)))
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        s, p, d, g = self._cfg
+        return F.conv3d(x, self.weight, self.bias, s, p, d, g)
+
+
+class ConvTranspose1d(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, int) else \
+            kernel_size[0]
+        self._cfg = (stride, padding, dilation, groups)
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups, k], attr=weight_attr)
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        s, p, d, g = self._cfg
+        return F.conv_transpose1d(x, self.weight, self.bias, s, p,
+                                  groups=g, dilation=d)
+
+
+class ConvTranspose3d(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = [kernel_size] * 3
+        self._cfg = (stride, padding, dilation, groups)
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups] + list(kernel_size),
+            attr=weight_attr)
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        s, p, d, g = self._cfg
+        return F.conv_transpose3d(x, self.weight, self.bias, s, p,
+                                  groups=g, dilation=d)
+
+
+ConvTranspose2d = Conv2DTranspose
+
+
+# -- norms -----------------------------------------------------------------
+
+class InstanceNorm(Layer):
+    def __init__(self, num_features: int, epsilon: float = 1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._eps = epsilon
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, self.weight, self.bias, self._eps)
+
+
+InstanceNorm1d = InstanceNorm
+InstanceNorm2d = InstanceNorm
+InstanceNorm3d = InstanceNorm
+
+
+class SyncBatchNorm(BatchNorm2D):
+    """Cross-replica batch norm. Design-discharged on TPU: under
+    pjit/GSPMD with a batch-sharded input, the batch-stat reductions in
+    F.batch_norm run over the GLOBAL batch (XLA inserts the cross-chip
+    collectives), which is exactly sync-BN; the reference needs a
+    dedicated NCCL allreduce kernel (sync_batch_norm_op.cu) because its
+    per-GPU graphs see only local shards.
+
+    convert_sync_batchnorm mirrors the reference helper for porting."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer  # BatchNorm already IS sync under GSPMD
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization of a weight tensor (spectral_norm_op.cc):
+    power-iteration u/v buffers; returns weight / sigma."""
+
+    def __init__(self, weight_shape, dim: int = 0,
+                 power_iters: int = 1, eps: float = 1e-12, name=None):
+        super().__init__()
+        self._dim, self._power_iters, self._eps = dim, power_iters, eps
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        u = self.create_parameter([h], default_initializer=Normal(0, 1),
+                                  attr=ParamAttr(trainable=False))
+        v = self.create_parameter([w], default_initializer=Normal(0, 1),
+                                  attr=ParamAttr(trainable=False))
+        self.weight_u = self.register_buffer("weight_u", u)
+        self.weight_v = self.register_buffer("weight_v", v)
+
+    def forward(self, weight):
+        return F._run(
+            "spectral_norm",
+            {"Weight": [weight], "U": [self.weight_u],
+             "V": [self.weight_v]},
+            {"dim": self._dim, "power_iters": self._power_iters,
+             "eps": self._eps})
+
+
+# -- losses / similarity ---------------------------------------------------
+
+class CTCLoss(Layer):
+    def __init__(self, blank: int = 0, reduction: str = "mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths):
+        return F.ctc_loss(log_probs, labels, input_lengths,
+                          label_lengths, self.blank, self.reduction)
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin: float = 0.0, reduction: str = "mean",
+                 name=None):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input, other, label):
+        return F.margin_ranking_loss(input, other, label, self.margin,
+                                     self.reduction)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis: int = 1, eps: float = 1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, self.axis, self.eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p: float = 2.0, epsilon: float = 1e-6,
+                 keepdim: bool = False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        from .. import tensor as T
+        d = T.subtract(x, y)
+        # the reference adds epsilon to the difference before the norm
+        # (dist_op composition, nn/layer/distance.py)
+        d = F._run("scale", {"X": [d]},
+                   {"scale": 1.0, "bias": float(self.epsilon)})
+        return T.norm(d, self.p, axis=1, keepdim=self.keepdim)
+
+
+# -- misc ------------------------------------------------------------------
+
+class Bilinear(Layer):
+    """paddle.nn.Bilinear / BilinearTensorProduct
+    (bilinear_tensor_product_op.cc)."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features], attr=weight_attr)
+        self.bias = self.create_parameter([1, out_features],
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+BilinearTensorProduct = Bilinear
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor: int, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._f = upscale_factor
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self._f)
+
+
+class RowConv(Layer):
+    """Lookahead row convolution (row_conv_op.cc)."""
+
+    def __init__(self, num_channels: int, future_context_size: int,
+                 param_attr=None, act=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [future_context_size + 1, num_channels], attr=param_attr)
+        self._act = act
+
+    def forward(self, x):
+        out = F._run("row_conv", {"X": [x], "Filter": [self.weight]},
+                     {})
+        if self._act:
+            out = F._run(self._act, {"X": [out]}, {})
+        return out
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, align_mode=0, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._a = (size, scale_factor, mode, align_corners)
+
+    def forward(self, x):
+        size, sf, mode, ac = self._a
+        return F.interpolate(x, size, sf, mode, ac)
+
+
+class UpsamplingBilinear2d(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._a = (size, scale_factor)
+
+    def forward(self, x):
+        return F.interpolate(x, self._a[0], self._a[1], "bilinear",
+                             align_corners=True)
+
+
+class UpsamplingNearest2d(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._a = (size, scale_factor)
+
+    def forward(self, x):
+        return F.interpolate(x, self._a[0], self._a[1], "nearest")
+
+
+# -- weight norm hooks (reference nn/utils/weight_norm_hook.py) ------------
+
+def _wn_norm_except(v, dim):
+    from .. import tensor as T
+    nd = len(v.shape)
+    if dim is None:
+        return T.norm(T.reshape(v, [-1]), 2, axis=0)
+    axes = [i for i in range(nd) if i != dim]
+    sq = T.multiply(v, v)
+    s = T.sum(sq, axis=axes, keepdim=True)
+    return T.sqrt(s)
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """Reparameterize layer.<name> as g * v / ||v|| (Salimans & Kingma;
+    reference weight_norm_hook.py). The recompute runs at the start of
+    every forward, so autodiff flows to weight_g/weight_v — under jit
+    the recompute fuses into the consuming matmul/conv."""
+    import types
+
+    from .. import tensor as T
+    from ..dygraph.tape import Tensor as EagerTensor
+
+    w = getattr(layer, name)
+    v0 = w
+    g0 = _wn_norm_except(w, dim)
+    layer._parameters.pop(name, None)
+    gp = EagerTensor(g0.value if hasattr(g0, "value") else g0,
+                     stop_gradient=False, trainable=True)
+    vp = EagerTensor(v0.value if hasattr(v0, "value") else v0,
+                     stop_gradient=False, trainable=True)
+    gp.is_param = True
+    vp.is_param = True
+    # plain setattr: Layer.__setattr__ registers is_param Tensors in
+    # _parameters AND binds the attribute the forward hook reads
+    setattr(layer, name + "_g", gp)
+    setattr(layer, name + "_v", vp)
+    layer._wn_cfg = (name, dim)
+    orig_forward = layer.forward
+
+    def forward(self, *args, **kwargs):
+        nm, d = self._wn_cfg
+        g = getattr(self, nm + "_g")
+        v = getattr(self, nm + "_v")
+        norm = _wn_norm_except(v, d)
+        object.__setattr__(self, nm,
+                           T.multiply(T.divide(v, norm), g))
+        return orig_forward(*args, **kwargs)
+
+    layer.forward = types.MethodType(forward, layer)
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    nm, d = getattr(layer, "_wn_cfg", (name, 0))
+    g = getattr(layer, nm + "_g")
+    v = getattr(layer, nm + "_v")
+    from .. import tensor as T
+    w = T.multiply(T.divide(v, _wn_norm_except(v, d)), g)
+    layer._parameters.pop(nm + "_g", None)
+    layer._parameters.pop(nm + "_v", None)
+    from ..dygraph.tape import Tensor as EagerTensor
+    wt = EagerTensor(w.value if hasattr(w, "value") else w,
+                     stop_gradient=False, trainable=True)
+    wt.is_param = True
+    setattr(layer, nm, wt)
+    # restore the class forward
+    layer.__dict__.pop("forward", None)
+    return layer

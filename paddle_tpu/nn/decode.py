@@ -161,3 +161,117 @@ def dynamic_decode(decoder: Decoder, inits=None,
         lens = (paths != decoder.end_token).sum(axis=-1)
         rets = rets + (Tensor(lens),)
     return rets
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    """One beam-search step (fluid layers/rnn.py beam_search /
+    operators/beam_search_op.cc): flat top-k over beam*vocab candidate
+    scores per batch row. The reference encodes parenthood in the
+    output LoD; XLA needs static shapes, so the parent beam indices are
+    an explicit tensor — pass return_parent_idx=True (the default
+    output pair still matches the reference's positional contract).
+
+    pre_ids/pre_scores: [B*beam, 1]; ids/scores: [B*beam, K] candidate
+    token ids and (log-prob) scores. Finished beams (pre_id == end_id)
+    only propagate themselves with their accumulated score.
+    Returns (selected_ids [B*beam, 1], selected_scores [B*beam, 1]
+    [, parent_idx [B*beam]])."""
+    import jax.numpy as jnp
+
+    pid = pre_ids.value if isinstance(pre_ids, Tensor) else pre_ids
+    psc = pre_scores.value if isinstance(pre_scores, Tensor) \
+        else pre_scores
+    cid = ids.value if isinstance(ids, Tensor) else ids
+    csc = scores.value if isinstance(scores, Tensor) else scores
+    bb, k = csc.shape
+    b = bb // beam_size
+    pid = pid.reshape(b, beam_size)
+    psc = psc.reshape(b, beam_size).astype(jnp.float32)
+    cid = cid.reshape(b, beam_size, k)
+    csc = csc.reshape(b, beam_size, k).astype(jnp.float32)
+    # is_accumulated=False: candidates are probabilities — accumulate
+    # in log space (beam_search_op.cc:256 pre_score + log(prob))
+    total = csc if is_accumulated else (
+        psc[..., None] + jnp.log(jnp.maximum(csc, 1e-30)))
+    finished = pid == end_id
+    # a finished beam contributes exactly one candidate: itself, at its
+    # accumulated score (beam_search_op.cc Grow: finished branches keep
+    # their score and re-emit end_id)
+    neg = jnp.full_like(total, -1e9)
+    total = jnp.where(finished[..., None], neg, total)
+    self_cand = jnp.where(finished, psc, -1e9)        # [b, beam]
+    flat = jnp.concatenate([total.reshape(b, beam_size * k),
+                            self_cand], axis=1)       # [b, beam*k+beam]
+    top_sc, top_ix = jax.lax.top_k(flat, beam_size)   # [b, beam]
+    is_self = top_ix >= beam_size * k
+    parent = jnp.where(is_self, top_ix - beam_size * k,
+                       top_ix // k)
+    tok_k = jnp.where(is_self, 0, top_ix % k)
+    sel_id = jnp.where(
+        is_self, jnp.full_like(parent, end_id),
+        jnp.take_along_axis(
+            cid.reshape(b, beam_size * k),
+            jnp.clip(top_ix, 0, beam_size * k - 1), axis=1))
+    del tok_k
+    out_ids = Tensor(sel_id.reshape(bb, 1).astype(pid.dtype))
+    out_scores = Tensor(top_sc.reshape(bb, 1))
+    if return_parent_idx:
+        off = jnp.arange(b)[:, None] * beam_size
+        return out_ids, out_scores, Tensor(
+            (parent + off).reshape(bb).astype(jnp.int32))
+    return out_ids, out_scores
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None):
+    """Backtrack full hypotheses from per-step beam selections (fluid
+    beam_search_decode_op.cc). The reference walks TensorArrays with
+    LoD-encoded parents; here `ids`/`scores` are [T, B*beam] (or lists
+    of per-step [B*beam(,1)] tensors, e.g. a TensorArray's contents)
+    plus the parent indices carried alongside — pass a tuple
+    (ids_steps, parent_steps) as `ids`. Returns (full_ids [T, B*beam],
+    full_scores [T, B*beam]) with each column a complete hypothesis
+    read from t=0..T-1, the gather_tree contract."""
+    import jax.numpy as jnp
+
+    if isinstance(ids, tuple):
+        ids_steps, parent_steps = ids
+    else:
+        raise ValueError(
+            "beam_search_decode: pass ids=(ids_steps, parent_steps) — "
+            "the static-shape analog of the reference's LoD parents")
+
+    def to_arr(steps):
+        vals = [s.value if isinstance(s, Tensor) else jnp.asarray(s)
+                for s in steps]
+        return jnp.stack([v.reshape(-1) for v in vals])  # [T, B*beam]
+
+    idt = to_arr(ids_steps)
+    par = to_arr(parent_steps).astype(jnp.int32)
+    if isinstance(scores, (list, tuple)):
+        sct = to_arr(scores).astype(jnp.float32)
+    else:
+        sv = scores.value if isinstance(scores, Tensor) else \
+            jnp.asarray(scores)
+        sct = jnp.broadcast_to(sv.reshape(1, -1).astype(jnp.float32),
+                               idt.shape)
+    # gather_tree: walk parents backward so row t holds the token (and
+    # its step score — the reference re-threads score_tensor along the
+    # SAME parent chain, beam_search_decode_op.h) of each FINAL
+    # hypothesis
+    def back(carry, xs):
+        beam_ix = carry
+        ids_t, par_t, sc_t = xs
+        tok = ids_t[beam_ix]
+        sc = sc_t[beam_ix]
+        prev = par_t[beam_ix]
+        return prev, (tok, sc)
+
+    init = jnp.arange(idt.shape[1], dtype=jnp.int32)
+    _, (toks, scs) = jax.lax.scan(back, init,
+                                  (idt[::-1], par[::-1], sct[::-1]))
+    return Tensor(toks[::-1]), Tensor(scs[::-1])
+
+
+import jax  # noqa: E402  (used by the beam ops above)

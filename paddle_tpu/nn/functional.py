@@ -343,3 +343,58 @@ def interpolate(x, size=None, scale_factor=None, mode: str = "nearest",
 
 def label_smooth(label, epsilon: float = 0.1, name=None):
     return _run("label_smooth", {"X": [label]}, {"epsilon": epsilon})
+
+
+# ---------------------------------------------------------------------------
+# round-5 parity closure: 1d/3d conv+pool variants, compositions, and
+# lr-decay functions live in functional_compat; fluid-surface functions
+# (detection, sequence, image ops) resolve lazily from layers so the
+# full reference nn.functional namespace works without import cycles.
+# ---------------------------------------------------------------------------
+from .functional_compat import *  # noqa: F401,F403,E402
+from . import functional_compat as _fc  # noqa: E402
+
+_LAYER_ALIASES = frozenset((
+    "adaptive_pool2d", "add_position_encoding", "affine_channel",
+    "affine_grid", "anchor_generator", "assign", "bipartite_match",
+    "box_clip", "box_coder", "box_decoder_and_assign", "bpr_loss",
+    "center_loss", "collect_fpn_proposals", "continuous_value_model",
+    "density_prior_box", "detection_output", "dice_loss",
+    "distribute_fpn_proposals", "edit_distance", "erf",
+    "filter_by_instag", "fsp_matrix", "generate_mask_labels",
+    "generate_proposal_labels", "generate_proposals", "hard_sigmoid",
+    "hard_swish", "hash", "huber_loss", "image_resize", "iou_similarity",
+    "l2_normalize", "log_loss", "lrn", "maxout", "multiclass_nms",
+    "npair_loss", "pad2d", "pad_constant_like", "pixel_shuffle",
+    "polygon_box_transform", "pool2d", "prior_box", "prroi_pool",
+    "psroi_pool", "random_crop", "rank_loss", "resize_bilinear",
+    "resize_nearest", "resize_trilinear", "retinanet_detection_output",
+    "retinanet_target_assign", "roi_align", "roi_perspective_transform",
+    "roi_pool", "row_conv", "rpn_target_assign",
+    "sampled_softmax_with_cross_entropy", "shuffle_channel",
+    "sigmoid_cross_entropy_with_logits", "sigmoid_focal_loss",
+    "similarity_focus", "smooth_l1", "soft_relu",
+    "softmax_with_cross_entropy", "space_to_depth", "square_error_cost",
+    "ssd_loss", "target_assign", "teacher_student_sigmoid_loss",
+    "temporal_shift", "unfold", "warpctc", "yolo_box", "yolov3_loss",
+    "deformable_roi_pooling",
+))
+
+# the reference organizes nn.functional as submodules (conv.py,
+# pooling.py, loss.py, ...) star-imported into one flat namespace;
+# here the flat namespace IS the module, so the submodule names
+# resolve back to it (F.conv.conv2d == F.conv2d)
+import sys as _sys  # noqa: E402
+activation = common = conv = extension = loss = norm = pooling = \
+    vision = input = _sys.modules[__name__]  # noqa: A001
+# NB: `rnn` stays the FUNCTION from functional_compat (callable), not a
+# module self-alias — the reference's later `from .rnn import rnn`-style
+# import shadows its submodule the same way.
+
+
+def __getattr__(name):
+    if name in _LAYER_ALIASES:
+        from .. import layers
+        return getattr(layers, name)
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

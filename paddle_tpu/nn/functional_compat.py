@@ -1,0 +1,481 @@
+"""nn.functional parity closure (round 5).
+
+Every name the reference exports from python/paddle/nn/functional/
+resolves on paddle_tpu.nn.functional. Three kinds live here:
+- 1d/3d variants of conv/pool families, lowered onto the existing 2d/3d
+  ops (a 1d conv/pool is the 2d op with a unit height — XLA folds the
+  reshape into the convolution, so this is not a perf compromise);
+- compositions with no dedicated reference kernel either (normalize,
+  cosine_similarity, diag_embed, alpha_dropout, dropout2d/3d, ...);
+- lr-decay functions, returning the optimizer's LRScheduler objects
+  (the TPU-native schedule representation — reference fluid's decay
+  ops build global-step graphs instead, layers/learning_rate_scheduler.py).
+"""
+from __future__ import annotations
+
+from . import functional as F
+from .functional import _run, _run_multi, _reduce
+
+
+def _sq(x, axis):
+    return _run("squeeze2", {"X": [x]}, {"axes": [axis]})
+
+
+def _unsq(x, axis):
+    return _run("unsqueeze2", {"X": [x]}, {"axes": [axis]})
+
+
+# -- conv family -----------------------------------------------------------
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NCL", name=None):
+    """[N,C,L] conv via the conv2d op with unit height."""
+    s = stride if isinstance(stride, int) else stride[0]
+    p = padding if isinstance(padding, int) else padding[0]
+    d = dilation if isinstance(dilation, int) else dilation[0]
+    x4 = _unsq(x, 2)          # [N,C,1,L]
+    w4 = _unsq(weight, 2)     # [O,I,1,k]
+    out = F.conv2d(x4, w4, bias, stride=[1, s], padding=[0, p],
+                   dilation=[1, d], groups=groups)
+    return _sq(out, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NCDHW", name=None):
+    def trip(v):
+        return [v] * 3 if isinstance(v, int) else list(v)
+    out = _run("conv3d", {"Input": [x], "Filter": [weight]},
+               {"strides": trip(stride), "paddings": trip(padding),
+                "dilations": trip(dilation), "groups": groups,
+                "data_format": data_format}, out_slot="Output")
+    if bias is not None:
+        out = _run("elementwise_add", {"X": [out], "Y": [bias]},
+                   {"axis": 1})
+    return out
+
+
+def conv_transpose1d(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL", name=None):
+    s = stride if isinstance(stride, int) else stride[0]
+    p = padding if isinstance(padding, int) else padding[0]
+    d = dilation if isinstance(dilation, int) else dilation[0]
+    x4 = _unsq(x, 2)
+    w4 = _unsq(weight, 2)
+    out = F.conv2d_transpose(x4, w4, bias, stride=[1, s],
+                             padding=[0, p], dilation=[1, d],
+                             groups=groups)
+    return _sq(out, 2)
+
+
+conv_transpose2d = F.conv2d_transpose
+
+
+def conv_transpose3d(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", name=None):
+    def trip(v):
+        return [v] * 3 if isinstance(v, int) else list(v)
+    out = _run("conv3d_transpose", {"Input": [x], "Filter": [weight]},
+               {"strides": trip(stride), "paddings": trip(padding),
+                "dilations": trip(dilation), "groups": groups},
+               out_slot="Output")
+    if bias is not None:
+        out = _run("elementwise_add", {"X": [out], "Y": [bias]},
+                   {"axis": 1})
+    return out
+
+
+# -- pool family -----------------------------------------------------------
+
+def _pool1d(x, ksize, stride, padding, ptype, ceil_mode=False,
+            exclusive=True, adaptive=False):
+    k = ksize if isinstance(ksize, int) else ksize[0]
+    s = k if stride is None else (
+        stride if isinstance(stride, int) else stride[0])
+    p = padding if isinstance(padding, int) else padding[0]
+    x4 = _unsq(x, 2)
+    out = _run("pool2d", {"X": [x4]},
+               {"ksize": [1, k], "strides": [1, s], "paddings": [0, p],
+                "pooling_type": ptype, "ceil_mode": ceil_mode,
+                "exclusive": exclusive, "adaptive": adaptive,
+                "global_pooling": False})
+    return _sq(out, 2)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, name=None):
+    return _pool1d(x, kernel_size, stride, padding, "max", ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool1d(x, kernel_size, stride, padding, "avg", ceil_mode,
+                   exclusive)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    o = output_size if isinstance(output_size, int) else output_size[0]
+    return _pool1d(x, o, o, 0, "avg", adaptive=True)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    o = output_size if isinstance(output_size, int) else output_size[0]
+    return _pool1d(x, o, o, 0, "max", adaptive=True)
+
+
+def _pool3d_f(x, ksize, stride, padding, ptype, ceil_mode=False,
+              exclusive=True, adaptive=False, global_pool=False):
+    def trip(v):
+        return [v] * 3 if isinstance(v, int) else list(v)
+    stride = ksize if stride is None else stride
+    return _run("pool3d", {"X": [x]},
+                {"ksize": trip(ksize), "strides": trip(stride),
+                 "paddings": trip(padding), "pooling_type": ptype,
+                 "ceil_mode": ceil_mode, "exclusive": exclusive,
+                 "adaptive": adaptive, "global_pooling": global_pool})
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, name=None):
+    return _pool3d_f(x, kernel_size, stride, padding, "max", ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, name=None):
+    return _pool3d_f(x, kernel_size, stride, padding, "avg", ceil_mode,
+                     exclusive)
+
+
+def adaptive_avg_pool3d(x, output_size, name=None):
+    return _pool3d_f(x, output_size, output_size, 0, "avg",
+                     adaptive=True)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _pool3d_f(x, output_size, output_size, 0, "max",
+                     adaptive=True)
+
+
+def adaptive_pool3d(x, pool_size, pool_type="max", name=None):
+    return _pool3d_f(x, pool_size, pool_size, 0, pool_type,
+                     adaptive=True)
+
+
+def pool3d(x, pool_size=2, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, name=None):
+    return _pool3d_f(x, pool_size, pool_stride, pool_padding, pool_type,
+                     ceil_mode, exclusive, global_pool=global_pooling)
+
+
+# -- activations -----------------------------------------------------------
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _run("brelu", {"X": [x]},
+                {"t_min": float(t_min), "t_max": float(t_max)})
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return brelu(x, min, max)
+
+
+def logsigmoid(x, name=None):
+    return _run("logsigmoid", {"X": [x]}, {})
+
+
+log_sigmoid = logsigmoid
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return _run("thresholded_relu", {"X": [x]},
+                {"threshold": float(threshold)})
+
+
+def hsigmoid(input, label, num_classes, weight, bias=None,
+             path_table=None, path_code=None, is_sparse=False,
+             name=None):
+    """Hierarchical sigmoid loss (hsigmoid_op.cc)."""
+    ins = {"X": [input], "W": [weight], "Label": [label]}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    if path_table is not None:
+        ins["PathTable"] = [path_table]
+    if path_code is not None:
+        ins["PathCode"] = [path_code]
+    return _run("hsigmoid", ins, {"num_classes": int(num_classes)},
+                out_slot="Out")
+
+
+# -- dropout variants ------------------------------------------------------
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    """SELU-matched dropout (reference common.py alpha_dropout): dropped
+    positions take alpha' and the result is affinely rescaled so mean /
+    variance are preserved under the SELU self-normalizing regime."""
+    if not training or p == 0.0:
+        return x
+    alpha_p = -1.7580993408473766
+    keep = 1.0 - p
+    a = (keep + alpha_p * alpha_p * keep * p) ** -0.5
+    b = -a * alpha_p * p
+    # mask: 1 where kept, 0 where dropped (deterministic via op rng)
+    _, mask = _run_multi("dropout", {"X": [x]},
+                         {"dropout_prob": p,
+                          "dropout_implementation": "downgrade_in_infer"},
+                         ["Out", "Mask"])
+    one_minus = _run("scale", {"X": [mask]}, {"scale": -1.0, "bias": 1.0})
+    kept = _run("elementwise_mul", {"X": [x], "Y": [mask]}, {})
+    dropped = _run("scale", {"X": [one_minus]},
+                   {"scale": alpha_p, "bias": 0.0})
+    mixed = _run("elementwise_add", {"X": [kept], "Y": [dropped]}, {})
+    return _run("scale", {"X": [mixed]}, {"scale": a, "bias": b})
+
+
+def _channel_dropout(x, p, training, spatial_dims):
+    """One keep decision per (N, C): the whole channel map drops
+    together (reference common.py dropout2d/3d contract)."""
+    if not training or p == 0.0:
+        return x
+    shape = list(x.shape[:2]) + [1] * spatial_dims
+    ones = _run("fill_constant", {},
+                {"shape": shape, "value": 1.0, "dtype": "float32"})
+    _, mask = _run_multi("dropout", {"X": [ones]},
+                         {"dropout_prob": p,
+                          "dropout_implementation": "downgrade_in_infer"},
+                         ["Out", "Mask"])
+    scaled = _run("scale", {"X": [mask]},
+                  {"scale": 1.0 / max(1.0 - p, 1e-12), "bias": 0.0})
+    return _run("elementwise_mul", {"X": [x], "Y": [scaled]}, {"axis": 0})
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    return _channel_dropout(x, p, training, 2)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    return _channel_dropout(x, p, training, 3)
+
+
+# -- similarity / norms ----------------------------------------------------
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    prod = _run("elementwise_mul", {"X": [x1], "Y": [x2]}, {})
+    num = _run("reduce_sum", {"X": [prod]},
+               {"dim": [axis], "keep_dim": False, "reduce_all": False})
+    sq1 = _run("reduce_sum", {"X": [_run("elementwise_mul",
+                                         {"X": [x1], "Y": [x1]}, {})]},
+               {"dim": [axis], "keep_dim": False, "reduce_all": False})
+    sq2 = _run("reduce_sum", {"X": [_run("elementwise_mul",
+                                         {"X": [x2], "Y": [x2]}, {})]},
+               {"dim": [axis], "keep_dim": False, "reduce_all": False})
+    den = _run("elementwise_mul", {"X": [_run("sqrt", {"X": [sq1]}, {})],
+                                   "Y": [_run("sqrt", {"X": [sq2]}, {})]},
+               {})
+    den = _run("clip", {"X": [den]}, {"min": float(eps), "max": 3.4e38})
+    return _run("elementwise_div", {"X": [num], "Y": [den]}, {})
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    if p == 2:
+        sq = _run("elementwise_mul", {"X": [x], "Y": [x]}, {})
+        s = _run("reduce_sum", {"X": [sq]},
+                 {"dim": [axis], "keep_dim": True, "reduce_all": False})
+        n = _run("sqrt", {"X": [s]}, {})
+    else:
+        a = _run("abs", {"X": [x]}, {})
+        pw = _run("pow", {"X": [a]}, {"factor": float(p)})
+        s = _run("reduce_sum", {"X": [pw]},
+                 {"dim": [axis], "keep_dim": True, "reduce_all": False})
+        n = _run("pow", {"X": [s]}, {"factor": 1.0 / float(p)})
+    n = _run("clip", {"X": [n]}, {"min": float(epsilon), "max": 3.4e38})
+    return _run("elementwise_div", {"X": [x], "Y": [n]}, {})
+
+
+# -- losses ----------------------------------------------------------------
+
+def margin_ranking_loss(input, other, label, margin=0.0,
+                        reduction="mean", name=None):
+    out = _run("margin_rank_loss",
+               {"X1": [input], "X2": [other], "Label": [label]},
+               {"margin": float(margin)})
+    return _reduce(out, reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths,
+             blank=0, reduction="mean"):
+    loss = _run("warpctc",
+                {"Logits": [log_probs], "Label": [labels],
+                 "LogitsLength": [input_lengths],
+                 "LabelLength": [label_lengths]},
+                {"blank": int(blank), "norm_by_times": False},
+                out_slot="Loss")
+    return _reduce(loss, reduction)
+
+
+# -- misc ------------------------------------------------------------------
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    ins = {"X": [x1], "Y": [x2], "Weight": [weight]}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    return _run("bilinear_tensor_product", ins, {})
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal embedding: out[..., i, i+offset] = input[..., i]
+    (reference functional/extension.py diag_embed). Composition over
+    existing ops: multiply the input against the first L rows of an
+    identity rolled to the requested diagonal, pad square, and handle a
+    negative offset by transposing the positive-offset result."""
+    nd = len(input.shape)
+    if (dim1 % (nd + 2), dim2 % (nd + 2)) != (nd, nd + 1):
+        raise NotImplementedError(
+            "diag_embed: only the default dim1=-2, dim2=-1 placement is "
+            "supported")
+    off = abs(int(offset))
+    L = int(input.shape[-1])
+    n = L + off
+    eye = _run("eye", {}, {"num_rows": n, "num_columns": n,
+                           "dtype": "float32"})
+    if off:
+        # row i gets its 1 at column i+off; no wraparound inside the
+        # first L rows since i+off <= L-1+off = n-1
+        eye = _run("roll", {"X": [eye]},
+                   {"shifts": [off], "axis": [1]})
+        eye = _run("slice", {"Input": [eye]},
+                   {"axes": [0], "starts": [0], "ends": [L]})
+    rows = eye  # [L, n]
+    xe = _run("unsqueeze2", {"X": [input]}, {"axes": [nd]})  # [...,L,1]
+    out = _run("elementwise_mul", {"X": [xe], "Y": [rows]}, {})
+    if off:
+        # pad the row axis back to n so the result is square [..., n, n]
+        paddings = [0, 0] * (nd - 1) + [0, off] + [0, 0]
+        out = _run("pad", {"X": [out]},
+                   {"paddings": paddings, "pad_value": 0.0})
+    if int(offset) < 0:
+        perm = list(range(nd + 1))
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+        out = _run("transpose2", {"X": [out]}, {"axis": perm})
+    return out
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    return _run("grid_sampler", {"X": [x], "Grid": [grid]},
+                {"mode": mode, "padding_mode": padding_mode,
+                 "align_corners": bool(align_corners)},
+                out_slot="Output")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _run("pixel_shuffle", {"X": [x]},
+                {"upscale_factor": int(upscale_factor),
+                 "data_format": data_format})
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Functional rnn over a cell (reference functional/rnn.py) —
+    delegates to the nn.RNN scan layer."""
+    from .rnn import RNN as _RNN
+    return _RNN(cell, is_reverse=is_reverse,
+                time_major=time_major)(inputs, initial_states,
+                                       sequence_length)
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    from .rnn import BiRNN as _BiRNN
+    return _BiRNN(cell_fw, cell_bw,
+                  time_major=time_major)(inputs, initial_states,
+                                         sequence_length)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return F.interpolate(x, size, scale_factor, mode, align_corners)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the SHORT side equals out_short_len, keeping aspect
+    (reference layers/nn.py image_resize_short). Shapes are static at
+    trace time, so the target size is computed in python."""
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short = min(h, w)
+    scale = out_short_len / float(short)
+    out = [int(round(h * scale)), int(round(w * scale))]
+    mode = "bilinear" if resample.upper() == "BILINEAR" else "nearest"
+    return F.interpolate(input, size=out, mode=mode)
+
+
+# -- lr decay functions -> LRScheduler objects -----------------------------
+
+def _decay_doc(fn):
+    fn.__doc__ = (fn.__doc__ or "") + (
+        "\n\nReturns the optimizer LRScheduler object — the TPU-native "
+        "schedule representation (pass as learning_rate=). The fluid "
+        "form built global-step graph ops instead "
+        "(layers/learning_rate_scheduler.py).")
+    return fn
+
+
+@_decay_doc
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    from ..optimizer import CosineDecay
+    return CosineDecay(learning_rate, step_each_epoch, epochs)
+
+
+@_decay_doc
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    from ..optimizer import ExponentialDecay
+    return ExponentialDecay(learning_rate, decay_steps, decay_rate,
+                            staircase)
+
+
+@_decay_doc
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    from ..optimizer import NaturalExpDecay
+    return NaturalExpDecay(learning_rate, decay_steps, decay_rate,
+                           staircase)
+
+
+@_decay_doc
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    from ..optimizer import InverseTimeDecay
+    return InverseTimeDecay(learning_rate, decay_steps, decay_rate,
+                            staircase)
+
+
+@_decay_doc
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    from ..optimizer import PolynomialDecay
+    return PolynomialDecay(learning_rate, decay_steps,
+                           end_learning_rate, power, cycle)
+
+
+@_decay_doc
+def piecewise_decay(boundaries, values):
+    from ..optimizer import PiecewiseDecay
+    return PiecewiseDecay(boundaries, values)
+
+
+@_decay_doc
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    from ..optimizer import NoamDecay
+    return NoamDecay(d_model, warmup_steps, learning_rate)
+
+
+@_decay_doc
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    from ..optimizer import lr_scheduler as _lrs
+    if not isinstance(learning_rate, _lrs.LRScheduler):
+        learning_rate = _lrs.PiecewiseDecay([2 ** 31],
+                                            [float(learning_rate)] * 2)
+    return _lrs.linear_lr_warmup(learning_rate, warmup_steps, start_lr,
+                                 end_lr)

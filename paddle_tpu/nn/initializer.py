@@ -1,0 +1,65 @@
+"""paddle.nn.initializer — initializer classes under their 2.0 names.
+
+Analog of /root/reference/python/paddle/nn/initializer/__init__.py.
+The descriptors (layers/helper.py) receive the parameter shape at
+creation, so the fan-based variants compute their scale there exactly
+like the reference's Initializer subclasses (fluid/initializer.py)."""
+import math
+
+from ..layers.helper import (Constant, Initializer, Normal,  # noqa: F401
+                             TruncatedNormal, Uniform, Xavier)
+
+XavierNormal = Xavier
+XavierUniform = Xavier
+
+
+class KaimingNormal(Initializer):
+    """He normal: std = sqrt(2 / fan_in) (fluid/initializer.py MSRA)."""
+
+    def __init__(self, fan_in=None):
+        self.fan_in = fan_in
+
+    def desc(self, shape, dtype):
+        import numpy as np
+        fan_in = self.fan_in
+        if fan_in is None:
+            fan_in = (int(np.prod(shape[1:])) if len(shape) > 1
+                      else shape[0])
+        return Normal(0.0, math.sqrt(2.0 / max(fan_in, 1))).desc(
+            shape, dtype)
+
+
+class KaimingUniform(Initializer):
+    """He uniform: limit = sqrt(6 / fan_in)."""
+
+    def __init__(self, fan_in=None):
+        self.fan_in = fan_in
+
+    def desc(self, shape, dtype):
+        import numpy as np
+        fan_in = self.fan_in
+        if fan_in is None:
+            fan_in = (int(np.prod(shape[1:])) if len(shape) > 1
+                      else shape[0])
+        limit = math.sqrt(6.0 / max(fan_in, 1))
+        return Uniform(-limit, limit).desc(shape, dtype)
+
+
+class Assign(Initializer):
+    """Initialize from a concrete array (NumpyArrayInitializer)."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def desc(self, shape, dtype):
+        import numpy as np
+        return {"type": "assign_value",
+                "attrs": {"shape": list(shape),
+                          "values": np.asarray(self.value)
+                          .astype("float32").reshape(-1).tolist(),
+                          "dtype": dtype}}
+
+
+__all__ = ["Constant", "Normal", "Uniform", "Xavier", "XavierNormal",
+           "XavierUniform", "TruncatedNormal", "KaimingNormal",
+           "KaimingUniform", "Assign"]

@@ -1703,3 +1703,163 @@ def _ssd_loss(ctx, ins, attrs):
 
     losses = jax.lax.map(per_image, (loc, conf, gt, gt_label, gt_num))
     return {"Loss": [losses.reshape(N, 1)]}
+
+
+@register_op("retinanet_target_assign",
+             inputs=("Anchor", "GtBoxes", "GtLabels", "IsCrowd",
+                     "ImInfo", "GtNum"),
+             outputs=("LocationIndex", "ScoreIndex", "TargetBBox",
+                      "TargetLabel", "BBoxInsideWeight",
+                      "ForegroundNumber"),
+             no_grad=True)
+def _retinanet_target_assign(ctx, ins, attrs):
+    """Focal-loss anchor assignment
+    (operators/detection/retinanet_target_assign_op.cc): unlike RPN
+    there is NO subsampling — every anchor with max-IoU >=
+    positive_overlap (plus each gt's argmax anchor) is foreground with
+    the gt's CLASS label; anchors below negative_overlap are background
+    (label 0); the rest are ignored. TPU-static: per-image via
+    lax.map, indices padded with -1 where the reference emits
+    dynamic-length lists; ForegroundNumber feeds the focal-loss
+    normalizer."""
+    anchors = ins["Anchor"][0]                 # [A, 4]
+    gt = ins["GtBoxes"][0]                     # [N, G, 4] padded
+    gt_label = ins["GtLabels"][0].reshape(gt.shape[0], gt.shape[1])
+    gt_num = ins["GtNum"][0].astype(jnp.int32) if ins.get("GtNum") else \
+        jnp.full((gt.shape[0],), gt.shape[1], jnp.int32)
+    if ins.get("IsCrowd"):
+        is_crowd = ins["IsCrowd"][0].reshape(gt.shape[0], gt.shape[1])
+    else:
+        is_crowd = jnp.zeros(gt.shape[:2], jnp.int32)
+    pos_ov = float(attrs.get("positive_overlap", 0.5))
+    neg_ov = float(attrs.get("negative_overlap", 0.4))
+    a = anchors.shape[0]
+
+    def per_image(args):
+        gt_i, lab_i, ng, crowd_i = args
+        # crowd gt boxes are excluded from assignment entirely
+        # (rpn_target_assign_op.cc FilterCrowdGtBoxLabel)
+        gvalid = (jnp.arange(gt_i.shape[0]) < ng) & (crowd_i == 0)
+        iou = _iou_matrix(anchors, gt_i, normalized=False)
+        iou = jnp.where(gvalid[None, :], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        max_iou = jnp.max(iou, axis=1)
+        best_anchor = jnp.argmax(iou, axis=0)          # [G]
+        force_pos = jnp.zeros((a,), bool).at[best_anchor].max(gvalid)
+        is_pos = (max_iou >= pos_ov) | force_pos
+        is_neg = (max_iou < neg_ov) & ~is_pos
+        idx = jnp.arange(a, dtype=jnp.int32)
+        loc_index = jnp.where(is_pos, idx, -1)
+        score_index = jnp.where(is_pos | is_neg, idx, -1)
+        tgt = _encode_deltas(anchors, gt_i[best_gt])
+        tgt = jnp.where(is_pos[:, None], tgt, 0.0)
+        label = jnp.where(is_pos, lab_i[best_gt].astype(jnp.int32),
+                          jnp.where(is_neg, 0, -1))
+        inside_w = jnp.where(is_pos[:, None], jnp.ones_like(tgt), 0.0)
+        fg = is_pos.sum().astype(jnp.int32)
+        return (loc_index, score_index, tgt, label.astype(jnp.int32),
+                inside_w, fg)
+
+    li, si, tb, tl, bw, fg = jax.lax.map(
+        per_image, (gt, gt_label, gt_num, is_crowd))
+    return {"LocationIndex": [li], "ScoreIndex": [si],
+            "TargetBBox": [tb], "TargetLabel": [tl],
+            "BBoxInsideWeight": [bw],
+            "ForegroundNumber": [fg.reshape(-1, 1)]}
+
+
+@register_op("deformable_roi_pooling",
+             inputs=("Input", "ROIs", "Trans", "BatchRoINums"),
+             outputs=("Output",),
+             non_diff_inputs=("ROIs", "BatchRoINums"))
+def _deformable_roi_pooling(ctx, ins, attrs):
+    """Deformable (PS-)ROI pooling
+    (operators/deformable_psroi_pooling_op.cu, Deformable ConvNets):
+    each output bin samples sample_per_part^2 bilinear taps whose
+    positions are shifted by the learned per-bin offsets in Trans
+    (scaled by trans_std); position_sensitive selects the R-FCN channel
+    slice per bin. Differentiable w.r.t. Input AND Trans via the
+    bilinear-sample composition (jax autodiff), matching the CUDA
+    kernel's two grad paths."""
+    x = ins["Input"][0]                         # [N, C, H, W]
+    rois = ins["ROIs"][0]                       # [R, 4]
+    trans = ins["Trans"][0] if ins.get("Trans") else None
+    roi_batch = ins["BatchRoINums"][0].astype(jnp.int32) \
+        if ins.get("BatchRoINums") else jnp.zeros(
+            (rois.shape[0],), jnp.int32)
+    no_trans = bool(attrs.get("no_trans", False))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    part_h, part_w = attrs.get("part_size", [ph, pw]) or [ph, pw]
+    spp = int(attrs.get("sample_per_part", 1))
+    trans_std = float(attrs.get("trans_std", 0.1))
+    pos_sensitive = bool(attrs.get("position_sensitive", False))
+    n, c, h, w = x.shape
+    oc = c // (ph * pw) if pos_sensitive else c
+
+    def bilinear(img, yy, xx):
+        """img [C,H,W]; yy/xx broadcastable sample grids."""
+        y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        wy = jnp.clip(yy - y0, 0.0, 1.0)
+        wx = jnp.clip(xx - x0, 0.0, 1.0)
+        y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+        x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+        v00 = img[:, y0i, x0i]
+        v01 = img[:, y0i, x1i]
+        v10 = img[:, y1i, x0i]
+        v11 = img[:, y1i, x1i]
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    def one_roi(roi, bidx, t):
+        # reference: roi corners on the feature grid, min size 0.1
+        x1 = roi[0] * scale - 0.5
+        y1 = roi[1] * scale - 0.5
+        x2 = (roi[2] + 1.0) * scale - 0.5
+        y2 = (roi[3] + 1.0) * scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / pw, rh / ph
+        sub_w, sub_h = bin_w / spp, bin_h / spp
+        i = jnp.arange(ph, dtype=jnp.float32)[:, None, None, None]
+        j = jnp.arange(pw, dtype=jnp.float32)[None, :, None, None]
+        si = jnp.arange(spp, dtype=jnp.float32)[None, None, :, None]
+        sj = jnp.arange(spp, dtype=jnp.float32)[None, None, None, :]
+        if no_trans or t is None:
+            dx = dy = jnp.zeros((ph, pw, 1, 1), jnp.float32)
+        else:
+            # trans [2, part_h, part_w]: per-part normalized offsets
+            pi = jnp.clip((i[..., 0, 0] * part_h // ph).astype(jnp.int32),
+                          0, part_h - 1)
+            pj = jnp.clip((j[..., 0, 0] * part_w // pw).astype(jnp.int32),
+                          0, part_w - 1)
+            dy = (t[0][pi, pj] * trans_std * rh)[..., None, None]
+            dx = (t[1][pi, pj] * trans_std * rw)[..., None, None]
+        yy = y1 + i * bin_h + (si + 0.5) * sub_h + dy   # [ph,pw,spp,spp]
+        xx = x1 + j * bin_w + (sj + 0.5) * sub_w + dx
+        inside = ((yy >= -0.5) & (yy < h - 0.5)
+                  & (xx >= -0.5) & (xx < w - 0.5))
+        vals = bilinear(x[bidx], jnp.clip(yy, 0, h - 1),
+                        jnp.clip(xx, 0, w - 1))        # [C,ph,pw,s,s]
+        vals = jnp.where(inside[None], vals, 0.0)
+        cnt = jnp.maximum(inside.sum(axis=(-1, -2)), 1.0)  # [ph,pw]
+        pooled = vals.sum(axis=(-1, -2)) / cnt          # [C,ph,pw]
+        if pos_sensitive:
+            # output channel k of bin (i,j) reads input channel
+            # k*ph*pw + i*pw + j (R-FCN layout)
+            sel = pooled.reshape(oc, ph, pw, ph, pw)
+            ii = jnp.arange(ph)[:, None]
+            jj = jnp.arange(pw)[None, :]
+            pooled = sel[:, ii, jj, ii, jj]
+        return pooled
+
+    if trans is not None and not no_trans:
+        # Trans [R, 2, part_h, part_w]
+        out = jax.vmap(one_roi)(rois, roi_batch, trans)
+    else:
+        out = jax.vmap(lambda r, b: one_roi(r, b, None))(rois, roi_batch)
+    return {"Output": [out]}

@@ -161,8 +161,10 @@ def _histogram(ctx, ins, attrs):
         hi_v = jnp.float32(hi)
     width = (hi_v - lo_v) / bins
     idx = jnp.floor((x - lo_v) / width).astype(jnp.int32)
-    # the right edge is inclusive (reference: last bucket absorbs max)
-    idx = jnp.where(x == hi_v, bins - 1, idx)
+    # clip, don't trust the division: float32 rounding can push a value
+    # just below max to floor(...) == bins (e.g. max=0.3, bins=3), and
+    # the right edge is inclusive anyway (last bucket absorbs max)
+    idx = jnp.minimum(idx, bins - 1)
     valid = (x >= lo_v) & (x <= hi_v)
     idx = jnp.where(valid, idx, bins)  # out-of-range -> overflow slot
     # int32 counts: >2^31 elements per bin is unreachable, and int64

@@ -62,3 +62,84 @@ def load(program: Program, model_path: str, executor=None):
                       os.path.dirname(model_path) or ".",
                       main_program=program,
                       filename=os.path.basename(model_path) + ".pdparams")
+
+
+# ---------------------------------------------------------------------------
+# round-5 parity closure (reference python/paddle/static surface)
+# ---------------------------------------------------------------------------
+import contextlib as _contextlib
+
+from ..layers import Print  # noqa: F401
+from ..layers.helper import ParamAttr as _ParamAttr
+
+
+class WeightNormParamAttr(_ParamAttr):
+    """ParamAttr requesting weight normalization on the parameter
+    (reference param_attr.py WeightNormParamAttr): `dim` selects the
+    norm axis; layers honor it through nn.weight_norm's g*v/||v||
+    reparameterization."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+
+@_contextlib.contextmanager
+def name_scope(prefix: str = ""):
+    """Scoped op-name prefix for program visualization (framework.py
+    name_scope). Naming is cosmetic here — variable uniquing is owned
+    by LayerHelper — so the scope tracks the prefix stack for tooling
+    and yields."""
+    _NAME_SCOPES.append(prefix)
+    try:
+        yield
+    finally:
+        _NAME_SCOPES.pop()
+
+
+_NAME_SCOPES = []
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Host-python op inside a static program (reference
+    py_func_op.cc): runs `func` on numpy values at execution time via
+    the host-op executor segmentation (core/executor.py host ops).
+    The callable is registered in the process-local table and the op
+    carries its id (the reference stores a callable id attr the same
+    way, py_func_op.cc kForwardPythonCallableId)."""
+    from ..nn.functional import _run
+    from ..ops.io_ops import register_py_func
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return _run("py_func", {"X": list(xs)},
+                {"forward_callable_id": register_py_func(func)})
+
+
+class ParallelExecutor:
+    """Legacy fluid.ParallelExecutor facade over CompiledProgram — the
+    reference's multi-device SSA-graph executor
+    (framework/parallel_executor.cc). Here replication is GSPMD: the
+    compiled program shards the batch over the mesh (compiler.py), so
+    this class just binds (program, loss_name) to an Executor run."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        from ..core import default_main_program
+        from ..compiler import CompiledProgram
+        self._program = main_program or default_main_program()
+        self._compiled = CompiledProgram(self._program)
+        if loss_name is not None:
+            self._compiled.with_data_parallel(loss_name=loss_name,
+                                              build_strategy=build_strategy,
+                                              exec_strategy=exec_strategy)
+        self._scope = scope
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True):
+        from ..core import Executor
+        exe = Executor()
+        return exe.run(self._compiled, feed=feed or feed_dict,
+                       fetch_list=fetch_list, scope=self._scope,
+                       return_numpy=return_numpy)

@@ -732,3 +732,32 @@ floor_mod = mod
 def elementwise_sum(inputs, name=None):
     """Sum a list of tensors (reference sum_op over N inputs)."""
     return _run("sum", {"X": list(inputs)}, {})
+
+
+# ---------------------------------------------------------------------------
+# round-5 parity closure: the reference's paddle.tensor also re-exports
+# the fluid layer functions and io save/load; resolve them lazily to
+# avoid import cycles (layers itself builds on the op registry).
+# ---------------------------------------------------------------------------
+_LAYER_NAMES = frozenset((
+    "crop_tensor", "elementwise_add", "elementwise_div",
+    "elementwise_floordiv", "elementwise_mod", "elementwise_mul",
+    "elementwise_pow", "elementwise_sub", "fill_constant", "has_inf",
+    "has_nan", "is_empty", "multiplex", "rank", "reduce_all",
+    "reduce_any", "reduce_max", "reduce_mean", "reduce_min",
+    "reduce_prod", "reduce_sum", "scale", "scatter_nd", "shard_index",
+    "stanh", "sums", "tanh", "unbind", "unique_with_counts"))
+
+
+def __getattr__(name):
+    if name in _LAYER_NAMES:
+        from .. import layers
+        return getattr(layers, name)
+    if name in ("save", "load"):
+        from .. import io
+        return getattr(io, name)
+    if name == "to_tensor":
+        from ..dygraph import to_tensor
+        return to_tensor
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

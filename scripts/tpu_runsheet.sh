@@ -36,12 +36,16 @@ echo "=== 2. experiments (dW strategies, S-crossovers incl. scored S=512)"
 timeout 1800 python scripts/tpu_experiments.py
 
 probe || { echo "TUNNEL WEDGED after section 2 ($(date -u +%FT%TZ))"; exit 1; }
+# Timeouts are generous on purpose: SIGTERM-killing a section mid
+# remote-compile RPC is what WEDGES the tunnel (observed round 5 —
+# profile_resnet killed at 900s while compiling wedged it for hours).
+# Better to wait out a slow compile than to kill it.
 echo "=== 3. BERT profile breakdown"
-timeout 900 python scripts/profile_bert.py || true
+timeout 1800 python scripts/profile_bert.py || true
 
 probe || { echo "TUNNEL WEDGED after section 3 ($(date -u +%FT%TZ))"; exit 1; }
 echo "=== 3b. ResNet-50 phase breakdown (MFU-gap attribution)"
-timeout 900 python scripts/profile_resnet.py || true
+timeout 1800 python scripts/profile_resnet.py || true
 
 probe || { echo "TUNNEL WEDGED after section 3b ($(date -u +%FT%TZ))"; exit 1; }
 echo "=== 4. headline bench (B=32)"
@@ -53,7 +57,7 @@ BENCH_BERT_B=64 timeout 1800 python bench.py
 
 echo "=== done. inkernel_parity_rc=$INKERNEL_OK"
 echo "Decisions to make from $LOG:"
-echo " - _FLASH_MIN_SEQ (nn/transformer.py) from section 2's S=512 line"
-echo " - FLAGS_flash_inkernel_dropout default iff parity rc=0 AND faster"
-echo " - FLAGS_embedding_onehot_grad default from section 2 dW sweep"
-echo " - bench B from 4 vs 5; then re-run bench.py and record PERF_NOTES"
+echo " - FLAGS_dropout_storage default = fastest B=32 strategy (sec 3)"
+echo " - BENCH_BERT_B=64 iff a B=64 strategy fits AND beats B=32 MFU"
+echo " - ResNet next lever from section 3b's phase split"
+echo " - then re-run bench.py and record PERF_NOTES"

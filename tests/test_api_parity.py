@@ -60,6 +60,13 @@ def test_histogram_matches_numpy():
     # numpy: values outside [0,1] dropped, right edge inclusive
     expect, _ = np.histogram(vals[vals <= 1.0], bins=4, range=(0, 1))
     np.testing.assert_array_equal(np.asarray(h.value), expect)
+    # float32 rounding edge: width=0.3/3 rounds down; a value just
+    # below max must land in the LAST bin, not the dropped overflow
+    v2 = np.asarray([0.29999998], np.float32)
+    h2 = pt.histogram(pt.to_tensor(v2), bins=3, min=0.0, max=0.3)
+    assert np.asarray(h2.value).sum() == 1
+    with pytest.raises(ValueError):
+        pt.histogram(pt.to_tensor(vals), bins=3, min=2.0, max=1.0)
 
 
 def test_meshgrid_broadcast_shuffle():
@@ -112,6 +119,8 @@ def test_compat_module():
     assert pt.compat.to_text(b"abc") == "abc"
     assert pt.compat.to_bytes("abc") == b"abc"
     assert pt.compat.to_text([b"a", b"b"]) == ["a", "b"]
+    assert pt.compat.to_text((b"a", b"b")) == ("a", "b")
+    assert pt.compat.to_bytes(("a",)) == (b"a",)
     assert pt.compat.round(2.5) == 3.0
     assert pt.compat.round(-2.5) == -3.0
     assert pt.compat.floor_division(7, 2) == 3

@@ -1,0 +1,262 @@
+"""Round-5 nn parity surface: the new classes/functions must forward
+(and where sensible, backward) with correct shapes and finite values —
+name resolution alone is checked by tools/check_api_surface.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def _t(a):
+    return pt.to_tensor(np.asarray(a, np.float32))
+
+
+def _np(x):
+    return np.asarray(x.value if hasattr(x, "value") else x)
+
+
+def test_conv1d_layer_and_functional():
+    pt.seed(0)
+    layer = nn.Conv1d(4, 8, 3, padding=1)
+    x = _t(np.random.RandomState(0).randn(2, 4, 16))
+    y = layer(x)
+    assert tuple(y.shape) == (2, 8, 16)
+    assert np.isfinite(_np(y)).all()
+
+
+def test_conv3d_and_transpose3d():
+    pt.seed(0)
+    y = nn.Conv3d(2, 4, 3, padding=1)(_t(
+        np.random.RandomState(1).randn(1, 2, 6, 6, 6)))
+    assert tuple(y.shape) == (1, 4, 6, 6, 6)
+    yt = nn.ConvTranspose1d(4, 2, 3, padding=1)(_t(
+        np.random.RandomState(2).randn(2, 4, 10)))
+    assert tuple(yt.shape) == (2, 2, 10)
+
+
+def test_pool_1d_3d_and_adaptive():
+    x = _t(np.random.RandomState(0).randn(2, 3, 16))
+    assert tuple(nn.MaxPool1d(2)(x).shape) == (2, 3, 8)
+    assert tuple(nn.AdaptiveAvgPool1d(4)(x).shape) == (2, 3, 4)
+    x3 = _t(np.random.RandomState(1).randn(2, 3, 8, 8, 8))
+    assert tuple(nn.AvgPool3d(2)(x3).shape) == (2, 3, 4, 4, 4)
+    assert tuple(nn.AdaptiveMaxPool3d(2)(x3).shape) == (2, 3, 2, 2, 2)
+    # adaptive avg pool averages exactly its bin
+    v = _t(np.arange(8, dtype=np.float32).reshape(1, 1, 8))
+    out = _np(F.adaptive_avg_pool1d(v, 2))
+    np.testing.assert_allclose(out.reshape(-1), [1.5, 5.5])
+
+
+def test_pads():
+    x = _t(np.ones((1, 2, 4, 4)))
+    y = nn.ZeroPad2d(1)(x)
+    assert tuple(y.shape) == (1, 2, 6, 6)
+    assert float(_np(y)[0, 0, 0, 0]) == 0.0
+    r = nn.ReflectionPad2d(1)(x)
+    assert tuple(r.shape) == (1, 2, 6, 6)
+    e = nn.ReplicationPad2d([1, 1, 0, 0])(x)
+    assert tuple(e.shape) == (1, 2, 4, 6)
+
+
+def test_activation_layers():
+    x = _t([-2.0, -0.3, 0.0, 0.3, 2.0])
+    np.testing.assert_allclose(
+        _np(nn.Hardtanh()(x)), [-1, -0.3, 0, 0.3, 1], rtol=1e-6)
+    np.testing.assert_allclose(
+        _np(nn.LogSigmoid()(x)),
+        np.log(1 / (1 + np.exp(-np.asarray([-2, -0.3, 0, 0.3, 2.0])))),
+        rtol=1e-5, atol=1e-6)
+    s = _np(nn.Softshrink(0.5)(x))
+    np.testing.assert_allclose(s, [-1.5, 0, 0, 0, 1.5], rtol=1e-6)
+    assert np.isfinite(_np(nn.SELU()(x))).all()
+    assert np.isfinite(_np(nn.ELU()(x))).all()
+    ls = _np(nn.LogSoftmax()(_t([[1.0, 2.0, 3.0]])))
+    np.testing.assert_allclose(np.exp(ls).sum(), 1.0, rtol=1e-5)
+
+
+def test_prelu_learns():
+    pt.seed(3)
+    layer = nn.PReLU(1, init=0.25)
+    x = _t([-4.0, 2.0])
+    y = layer(x)
+    np.testing.assert_allclose(_np(y), [-1.0, 2.0], rtol=1e-6)
+    loss = pt.tensor.mean(y)
+    loss.backward()
+    assert layer.weight.grad is not None
+
+
+def test_norm_variants():
+    pt.seed(0)
+    x = _t(np.random.RandomState(0).randn(4, 3, 8, 8))
+    inorm = nn.InstanceNorm2d(3)
+    y = _np(inorm(x))
+    # per-(N,C) maps are standardized
+    np.testing.assert_allclose(y.mean(axis=(2, 3)),
+                               np.zeros((4, 3)), atol=1e-4)
+    sbn = nn.SyncBatchNorm(3)
+    assert np.isfinite(_np(sbn(x))).all()
+    assert nn.SyncBatchNorm.convert_sync_batchnorm(sbn) is sbn
+
+
+def test_losses_and_similarity():
+    pt.seed(0)
+    a = _t(np.random.RandomState(0).randn(4, 8))
+    b = _t(np.random.RandomState(1).randn(4, 8))
+    cs = _np(nn.CosineSimilarity(axis=1)(a, b))
+    ref = (np.sum(_np(a) * _np(b), 1)
+           / (np.linalg.norm(_np(a), axis=1)
+              * np.linalg.norm(_np(b), axis=1)))
+    np.testing.assert_allclose(cs, ref, rtol=1e-5)
+    lab = _t(np.sign(np.random.RandomState(2).randn(4)))
+    mrl = nn.MarginRankingLoss(margin=0.1)(
+        _t(np.random.RandomState(3).randn(4)),
+        _t(np.random.RandomState(4).randn(4)), lab)
+    assert float(_np(mrl)) >= 0
+    pd = nn.PairwiseDistance()(a, b)
+    np.testing.assert_allclose(
+        _np(pd), np.linalg.norm(_np(a) - _np(b) + 1e-6, axis=1),
+        rtol=1e-4)
+
+
+def test_bilinear_and_pixel_shuffle():
+    pt.seed(0)
+    bl = nn.Bilinear(4, 5, 6)
+    y = bl(_t(np.random.RandomState(0).randn(3, 4)),
+           _t(np.random.RandomState(1).randn(3, 5)))
+    assert tuple(y.shape) == (3, 6)
+    ps = nn.PixelShuffle(2)(_t(np.random.RandomState(2).randn(1, 8, 4, 4)))
+    assert tuple(ps.shape) == (1, 2, 8, 8)
+
+
+def test_dropout_channel_variants():
+    pt.seed(11)
+    x = _t(np.ones((8, 16, 4, 4)))
+    d2 = nn.Dropout2d(0.5)
+    d2.train()
+    y = _np(d2(x))
+    # whole channels are zero or upscaled together
+    per_chan = y.reshape(8, 16, -1)
+    is_zero = (per_chan == 0).all(axis=2)
+    is_scaled = np.isclose(per_chan, 2.0).all(axis=2)
+    assert (is_zero | is_scaled).all()
+    assert is_zero.any() and is_scaled.any()
+    d2.eval()
+    np.testing.assert_array_equal(_np(d2(x)), _np(x))
+    assert np.isfinite(_np(nn.AlphaDropout(0.3)(x))).all()
+    d2.train()  # .eval() flips the GLOBAL tracer test-mode; restore it
+
+
+def test_weight_norm_hooks():
+    pt.seed(0)
+    layer = nn.Linear(6, 4)
+    w0 = _np(layer.weight).copy()
+    nn.weight_norm(layer, "weight", dim=0)
+    names = [n for n, _ in layer.named_parameters()]
+    assert "weight_g" in names and "weight_v" in names
+    x = _t(np.random.RandomState(0).randn(2, 6))
+    y1 = _np(layer(x))
+    assert np.isfinite(y1).all()
+    # g*v/||v|| with untouched params reproduces the original weight
+    np.testing.assert_allclose(_np(layer.weight), w0, rtol=1e-5,
+                               atol=1e-6)
+    nn.remove_weight_norm(layer)
+    names = [n for n, _ in layer.named_parameters()]
+    assert "weight_g" not in names
+    np.testing.assert_allclose(_np(layer(x)), y1, rtol=1e-5, atol=1e-6)
+
+
+def test_beam_search_step_and_decode():
+    # 1 batch row, beam=2, vocab candidates K=3 with known scores
+    pre_ids = _t(np.asarray([[5], [6]], np.float32)).astype("int64") \
+        if False else pt.to_tensor(np.asarray([[5], [6]], np.int64))
+    pre_scores = _t([[0.0], [-1.0]])
+    ids = pt.to_tensor(np.asarray([[10, 11, 12], [20, 21, 22]], np.int64))
+    scores = _t([[-0.1, -2.0, -3.0], [-0.2, -0.3, -4.0]])
+    sel_ids, sel_scores, parent = nn.beam_search(
+        pre_ids, pre_scores, ids, scores, beam_size=2, end_id=0,
+        return_parent_idx=True)
+    # best two: beam0 token 10 (-0.1), beam1 token 20 (-0.2)
+    assert sorted(_np(sel_ids).reshape(-1).tolist()) == [10, 20]
+    assert set(_np(parent).tolist()) == {0, 1}
+
+    # finished beam (pre_id == end_id) re-emits end_id with its score
+    pre_ids2 = pt.to_tensor(np.asarray([[0], [6]], np.int64))
+    sel2, sc2, par2 = nn.beam_search(
+        pre_ids2, _t([[5.0], [-1.0]]), ids, scores, beam_size=2,
+        end_id=0, return_parent_idx=True)
+    assert 0 in _np(sel2).reshape(-1).tolist()
+    assert 5.0 in _np(sc2).reshape(-1).tolist()
+
+    # decode: backtrack a 3-step beam history
+    ids_steps = [pt.to_tensor(np.asarray([1, 2], np.int64)),
+                 pt.to_tensor(np.asarray([3, 4], np.int64)),
+                 pt.to_tensor(np.asarray([5, 6], np.int64))]
+    parents = [pt.to_tensor(np.asarray([0, 1], np.int32)),
+               pt.to_tensor(np.asarray([1, 0], np.int32)),
+               pt.to_tensor(np.asarray([0, 0], np.int32))]
+    score_steps = [_t([0.1, 0.2]), _t([0.3, 0.4]), _t([0.5, 0.6])]
+    full, full_sc = nn.beam_search_decode(
+        (ids_steps, parents), score_steps, beam_size=2, end_id=0)
+    # hypothesis 0 at t=2 token 5, parent 0 -> t=1 token 3, whose
+    # parent 1 -> t=0 token 2; scores re-thread along the SAME chain
+    np.testing.assert_array_equal(_np(full)[:, 0], [2, 3, 5])
+    np.testing.assert_allclose(_np(full_sc)[:, 0], [0.2, 0.3, 0.5],
+                               rtol=1e-6)
+
+    # is_accumulated=False: probabilities accumulate in LOG space
+    probs = _t([[0.9, 0.05, 0.05], [0.5, 0.3, 0.2]])
+    si3, ss3 = nn.beam_search(pre_ids, _t([[0.0], [0.0]]), ids, probs,
+                              beam_size=2, end_id=0,
+                              is_accumulated=False)
+    top = sorted(_np(ss3).reshape(-1).tolist(), reverse=True)
+    np.testing.assert_allclose(top, [np.log(0.9), np.log(0.5)],
+                               rtol=1e-5)
+
+
+def test_functional_compat_extras():
+    x = _t(np.random.RandomState(0).randn(2, 6))
+    n = _np(F.normalize(x, axis=1))
+    np.testing.assert_allclose(np.linalg.norm(n, axis=1),
+                               np.ones(2), rtol=1e-5)
+    de = _np(F.diag_embed(_t([[1.0, 2.0], [3.0, 4.0]])))
+    assert de.shape == (2, 2, 2)
+    np.testing.assert_allclose(de[0], [[1, 0], [0, 2]])
+    de1 = _np(F.diag_embed(_t([1.0, 2.0]), offset=1))
+    assert de1.shape == (3, 3)
+    np.testing.assert_allclose(de1[0, 1], 1.0)
+    np.testing.assert_allclose(de1[1, 2], 2.0)
+    sched = F.cosine_decay(0.1, 100, 10)
+    from paddle_tpu.optimizer.lr_scheduler import LRScheduler
+    assert isinstance(sched, LRScheduler)
+
+
+def test_static_parity_surface():
+    pt.enable_static()
+    try:
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            with pt.static.name_scope("block1"):
+                x = pt.layers.data("x", [4])
+                y = pt.layers.fc(x, 3)
+        assert y is not None
+        cfg = pt.static.WeightNormParamAttr(dim=0)
+        assert cfg.dim == 0
+    finally:
+        pt.disable_static()
+
+
+def test_initializer_namespace():
+    pt.seed(0)
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.w = self.create_parameter(
+                [64, 32],
+                default_initializer=nn.initializer.KaimingNormal())
+
+    m = M()
+    w = _np(m.w)
+    assert abs(w.std() - np.sqrt(2.0 / 32)) < 0.05

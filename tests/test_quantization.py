@@ -247,6 +247,10 @@ def test_imperative_qat_linear():
     def train(quantize):
         tape.seed(21)  # identical init for both runs
         tape._state.amp_dtype = None  # immune to a leaked autocast
+        # immune to a leaked eval(): Layer.eval flips the GLOBAL
+        # tracer test-mode (reference dygraph _train_mode semantics),
+        # and test-mode fake-quant during training diverges
+        tape._state.is_test = False
         model = nn.Sequential(nn.Linear(8, 16), nn.Linear(16, 1))
         if quantize:
             quanter = ImperativeQuantAware()

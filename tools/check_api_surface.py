@@ -25,6 +25,30 @@ ALLOWED_ABSENT = {
 }
 
 
+REF_ROOT = "/root/reference/python/paddle"
+
+# second-level namespaces diffed the same way (module path -> attr path)
+SUB_NAMESPACES = [
+    "nn", "nn/functional", "optimizer", "metric", "static", "io",
+    "distributed", "tensor",
+]
+
+
+def _ref_names(path):
+    names = set()
+    for line in open(path):
+        line = line.strip()
+        if line.startswith("#") or "__future__" in line:
+            continue
+        m = re.match(r"from [.\w]+ import (\w+)", line)
+        if m and not m.group(1).startswith("_"):
+            names.add(m.group(1))
+        m = re.match(r"import paddle\.(\w+)", line)
+        if m:
+            names.add(m.group(1))
+    return names
+
+
 def main() -> int:
     if os.environ.get("PT_FORCE_CPU"):
         # the axon sitecustomize overrides env JAX_PLATFORMS; only the
@@ -34,31 +58,40 @@ def main() -> int:
     if not os.path.exists(REF_INIT):
         print("reference __init__.py not found; skipping")
         return 0
-    names = set()
-    for line in open(REF_INIT):
-        line = line.strip()
-        if line.startswith("#"):
-            continue
-        m = re.match(r"from \.[.\w]* import (\w+)", line)
-        if m:
-            names.add(m.group(1))
-        m = re.match(r"import paddle\.(\w+)", line)
-        if m:
-            names.add(m.group(1))
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     import paddle_tpu as pt
+
+    rc = 0
+    names = _ref_names(REF_INIT)
     missing = sorted(n for n in names
                      if not hasattr(pt, n) and n not in ALLOWED_ABSENT)
-    print("reference top-level names: %d; missing here: %d"
+    print("top-level: %d reference names, %d missing"
           % (len(names), len(missing)))
     if missing:
-        print("MISSING:", missing)
-        return 1
+        print("MISSING top-level:", missing)
+        rc = 1
+
+    for sub in SUB_NAMESPACES:
+        path = os.path.join(REF_ROOT, sub, "__init__.py")
+        if not os.path.exists(path):
+            continue
+        mod = pt
+        for part in sub.split("/"):
+            mod = getattr(mod, part)
+        sub_names = _ref_names(path)
+        sub_missing = sorted(n for n in sub_names if not hasattr(mod, n))
+        print("%-14s %d reference names, %d missing"
+              % (sub.replace("/", "."), len(sub_names),
+                 len(sub_missing)))
+        if sub_missing:
+            print("MISSING %s:" % sub, sub_missing)
+            rc = 1
+
     stale = sorted(n for n in ALLOWED_ABSENT if hasattr(pt, n))
     if stale:
         print("NOTE: ALLOWED_ABSENT entries now present (prune):", stale)
-    return 0
+    return rc
 
 
 if __name__ == "__main__":
