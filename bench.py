@@ -215,6 +215,19 @@ def _run_worker(backend):
     }
     if on_tpu:
         rec.update(detail)
+        # persist the evidence: a later wedged-tunnel session (or the
+        # round-end driver run) falling back to CPU smoke can still
+        # surface the last REAL measurement, clearly labeled
+        try:
+            import datetime
+            with open(os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)),
+                    "bench_last_tpu.json"), "w") as f:
+                json.dump({**rec, "measured_at_utc":
+                           datetime.datetime.utcnow().isoformat()}, f)
+        except OSError as e:
+            print("WARN: could not persist TPU result: %r" % (e,),
+                  file=sys.stderr)
     else:
         rec["cpu_smoke"] = detail
     print(json.dumps(rec))
@@ -330,6 +343,21 @@ def main():
             "metric": "bench-unavailable (TPU tunnel down, CPU smoke failed)",
             "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
             "backend": "none"})
+    # a non-TPU line still carries the last REAL measurement (clearly
+    # labeled with its timestamp) so a wedged tunnel cannot erase the
+    # round's hardware evidence
+    try:
+        rec = json.loads(line)
+        if rec.get("backend") not in ("tpu",) and "TPU" not in str(
+                rec.get("backend", "")):
+            cache = os.path.join(os.path.dirname(os.path.abspath(
+                __file__)), "bench_last_tpu.json")
+            if os.path.exists(cache):
+                with open(cache) as f:
+                    rec["last_tpu_result"] = json.load(f)
+                line = json.dumps(rec)
+    except (ValueError, OSError):
+        pass
     print(line)
 
 

@@ -156,6 +156,7 @@ manual_seed = set_global_seed
 no_grad_ = no_grad  # the reference aliases fluid's no_grad_ to no_grad
 from . import compat  # noqa: F401
 from . import device  # noqa: F401
+from . import fluid  # noqa: F401  (the v1.8-era primary user namespace)
 from . import framework  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import text  # noqa: F401
@@ -165,3 +166,5 @@ from . import distribution  # noqa: F401
 from . import datasets  # noqa: F401
 from . import vision_transforms  # noqa: F401
 from .async_executor import AsyncExecutor  # noqa: F401,E402
+from .tensor import reverse  # noqa: F401,E402
+from .core import in_dygraph_mode as in_dynamic_mode  # noqa: F401,E402

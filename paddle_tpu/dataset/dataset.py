@@ -351,3 +351,70 @@ class DataFeedDesc:
                     "float" if s["type"] in ("float", "float32")
                     else "uint64", s["is_dense"], None))
         return dataset
+
+
+class MultiSlotDataGenerator:
+    """User-subclassable MultiSlot sample generator (reference
+    fluid/incubate/data_generator/__init__.py): implement
+    generate_sample(line) returning an iterator of
+    [(slot_name, [values...]), ...] records; run_from_stdin/_memory
+    serialize them to the MultiSlot text format the native parser
+    (csrc/data_feed.cc) and _DatasetBase consume:
+        <len> v1 ... vn  per slot, space-joined per sample line.
+    """
+
+    def __init__(self):
+        self._batch = 1
+
+    def set_batch(self, batch_size: int):
+        self._batch = int(batch_size)
+
+    # -- to be overridden -------------------------------------------------
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclass MultiSlotDataGenerator and implement "
+            "generate_sample(line)")
+
+    def generate_batch(self, samples):
+        """Optional batch-level hook (identity by default)."""
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    # -- serialization ----------------------------------------------------
+    @staticmethod
+    def _serialize(record) -> str:
+        parts = []
+        for _name, values in record:
+            vals = list(values)
+            parts.append(str(len(vals)))
+            parts.extend(str(v) for v in vals)
+        return " ".join(parts)
+
+    def _iter_records(self, lines):
+        batch = []
+        for line in lines:
+            it = self.generate_sample(line)
+            if it is None:
+                continue
+            for record in it():
+                batch.append(record)
+                if len(batch) >= self._batch:
+                    for r in self.generate_batch(batch)():
+                        yield r
+                    batch = []
+        if batch:
+            for r in self.generate_batch(batch)():
+                yield r
+
+    def run_from_stdin(self):
+        import sys
+        for record in self._iter_records(sys.stdin):
+            sys.stdout.write(self._serialize(record) + "\n")
+
+    def run_from_memory(self, lines=None):
+        """Return the serialized sample lines (the reference prints to
+        stdout; returning the list is the testable form)."""
+        return [self._serialize(r)
+                for r in self._iter_records(lines or [None])]

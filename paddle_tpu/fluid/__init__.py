@@ -1,0 +1,143 @@
+"""paddle.fluid — the legacy namespace ported user code imports.
+
+The reference's primary user-facing import in the v1.8 era is
+`import paddle.fluid as fluid` (python/paddle/fluid/__init__.py). Every
+fluid member maps onto this package's native home: Program/Executor
+(core/), layers, dygraph (tape), optimizer, io, ParamAttr, transpiler,
+CompiledProgram, places, LoDTensor. Real submodule files (fluid.layers,
+fluid.dygraph, ...) make dotted imports like
+`import paddle.fluid.layers as L` work verbatim.
+"""
+# framework / executor surface
+from ..core import (Executor, Program, Scope,  # noqa: F401
+                    append_backward, default_main_program,
+                    default_startup_program, device_guard,
+                    disable_static, enable_static, global_scope,
+                    gradients, in_dygraph_mode, program_guard,
+                    scope_guard)
+from ..core.program import VarDesc as Variable  # noqa: F401
+from ..core.lod import LoDTensor, LoDTensorArray  # noqa: F401
+from ..layers.helper import ParamAttr  # noqa: F401
+from ..static import WeightNormParamAttr, name_scope  # noqa: F401
+from ..compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
+                        ExecutionStrategy)
+from ..static import ParallelExecutor  # noqa: F401
+from ..transpiler import DistributeTranspiler  # noqa: F401
+from .. import (CPUPlace, CUDAPlace, TPUPlace)  # noqa: F401
+from ..device import XPUPlace  # noqa: F401
+from ..framework_api import ComplexVariable  # noqa: F401
+
+# submodules (real files in this package -> dotted imports work)
+from . import layers  # noqa: F401
+from . import framework  # noqa: F401
+from . import executor  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from . import initializer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from . import nets  # noqa: F401
+from . import metrics  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import average  # noqa: F401
+from . import unique_name  # noqa: F401
+from . import profiler  # noqa: F401
+from . import transpiler  # noqa: F401
+from . import contrib  # noqa: F401
+from . import incubate  # noqa: F401
+from . import dataset  # noqa: F401
+from . import backward  # noqa: F401
+from .backward import gradients  # noqa: F401,F811
+from . import core  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
+from .data_feed_desc import DataFeedDesc  # noqa: F401
+from .lod_tensor import (create_lod_tensor,  # noqa: F401
+                         create_random_int_lodtensor)
+from .input import embedding, one_hot  # noqa: F401
+from . import input  # noqa: F401
+
+# data layer + one-stop helpers the reference hoists to fluid.*
+from ..layers import data  # noqa: F401
+from ..io import (load_inference_model, load_params,  # noqa: F401
+                  load_persistables, save_inference_model, save_params,
+                  save_persistables)
+from ..io import save, save_dygraph  # noqa: F401
+from .initializer import set_global_initializer  # noqa: F401
+from .. import compiler  # noqa: F401
+from ..framework_api import (enable_dygraph,  # noqa: F401
+                             monkey_patch_math_varbase as
+                             monkey_patch_varbase,
+                             monkey_patch_variable)
+from .. import fleet  # noqa: F401
+from ..distributed import (TrainerDesc as trainer_desc_cls,  # noqa: F401
+                           TrainerDesc)
+from . import incubate as _incubate_mod
+data_generator = _incubate_mod.data_generator
+from . import executor as parallel_executor  # noqa: F401  (PE home)
+from . import trainer_desc  # noqa: F401
+from . import generator  # noqa: F401
+from . import distribute_lookup_table  # noqa: F401
+
+
+def install_check():
+    """paddle.fluid.install_check.run_check analog: a tiny train step
+    proves the install works (reference install_check.py)."""
+    import numpy as np
+
+    from ..dygraph import to_tensor
+    from ..nn import Linear
+    lin = Linear(2, 1)
+    out = lin(to_tensor(np.ones((2, 2), np.float32)))
+    assert np.isfinite(np.asarray(out.value)).all()
+    print("Your paddle_tpu works well. The install is successful.")
+    return True
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def cuda_places(device_ids=None):
+    """Reference device helpers: on this stack jax owns placement; the
+    accelerator list is jax.devices()."""
+    import jax
+    return [TPUPlace(i) for i, _ in enumerate(jax.devices())
+            if jax.default_backend() != "cpu"]
+
+
+def cpu_places(device_count=None):
+    return [CPUPlace() for _ in range(device_count or 1)]
+
+
+def device_count():
+    import jax
+    return len(jax.devices())
+
+# remaining reference fluid.* names (multi-name import lines)
+from ..framework_api import disable_dygraph  # noqa: F401,E402
+from ..io import (load, load_dygraph,  # noqa: F401,E402
+                  load_program_state, set_program_state)
+from ..transpiler import DistributeTranspilerConfig  # noqa: F401,E402
+
+
+class CUDAPinnedPlace:
+    """Pinned-host-memory tag (no CUDA here; jax owns staging — the
+    DataLoader's device prefetcher is the pinned-transfer analog)."""
+
+
+def memory_optimize(*args, **kwargs):
+    """DEPRECATED in the reference itself (fluid/__init__.py warns and
+    no-ops: memory optimization is strategy-driven there, and XLA
+    buffer assignment owns it here)."""
+    import logging
+    logging.getLogger("paddle_tpu").warning(
+        "fluid.memory_optimize is deprecated and has no effect "
+        "(XLA buffer assignment owns memory planning)")
+
+
+def release_memory(*args, **kwargs):
+    """Deprecated no-op, mirroring the reference."""
+    import logging
+    logging.getLogger("paddle_tpu").warning(
+        "fluid.release_memory is deprecated and has no effect")

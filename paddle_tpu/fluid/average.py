@@ -1,0 +1,23 @@
+"""fluid.average (reference fluid/average.py WeightedAverage)."""
+import numpy as np
+
+
+class WeightedAverage:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._total = 0.0
+        self._weight = 0.0
+
+    def add(self, value, weight=1):
+        value = float(np.asarray(value).reshape(-1)[0]) \
+            if np.asarray(value).size else 0.0
+        self._total += value * weight
+        self._weight += weight
+
+    def eval(self):
+        if self._weight <= 0:
+            raise ValueError(
+                "WeightedAverage.eval: no values accumulated")
+        return self._total / self._weight

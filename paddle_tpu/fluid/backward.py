@@ -1,0 +1,2 @@
+"""fluid.backward (reference fluid/backward.py)."""
+from ..core import append_backward, gradients  # noqa: F401

@@ -1,0 +1,3 @@
+"""fluid.clip (reference fluid/clip.py)."""
+from ..optimizer import (GradientClipByGlobalNorm,  # noqa: F401
+                         GradientClipByNorm, GradientClipByValue)

@@ -1,0 +1,5 @@
+"""fluid.contrib (reference fluid/contrib/)."""
+from ..contrib import *  # noqa: F401,F403
+from .. import contrib as _c
+
+slim = _c.slim if hasattr(_c, "slim") else None

@@ -1,0 +1,13 @@
+"""fluid.core — the pybind surface. The C++ core collapses into
+jax/XLA here; this module keeps the names ported code touches."""
+from ..core import Scope  # noqa: F401
+from ..core.lod import LoDTensor, LoDTensorArray  # noqa: F401
+from .. import CPUPlace, CUDAPlace, TPUPlace  # noqa: F401
+from ..device import XPUPlace  # noqa: F401
+from ..core.program import VarDesc  # noqa: F401
+
+_Scope = Scope
+
+
+def is_compiled_with_cuda():
+    return False

@@ -1,0 +1,3 @@
+"""fluid.data_feed_desc — re-export of the Dataset pipeline's
+DataFeedDesc (dataset/dataset.py; reference fluid/data_feed_desc.py)."""
+from ..dataset.dataset import DataFeedDesc  # noqa: F401

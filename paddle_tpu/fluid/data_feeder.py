@@ -1,0 +1,28 @@
+"""fluid.data_feeder (reference fluid/data_feeder.py DataFeeder):
+converts minibatch sample tuples into the executor feed dict."""
+import numpy as np
+
+from ..core.lod import LoDTensor
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self._names = [v if isinstance(v, str) else v.name
+                       for v in feed_list]
+
+    def feed(self, iterable):
+        """iterable of sample tuples -> {name: batched ndarray}; ragged
+        fields become padded LoDTensors (the TPU-native ragged form)."""
+        cols = list(zip(*iterable))
+        out = {}
+        for name, col in zip(self._names, cols):
+            arrs = [np.asarray(v) for v in col]
+            shapes = {a.shape for a in arrs}
+            if len(shapes) == 1:
+                out[name] = np.stack(arrs)
+            else:  # variable-length: pack + lengths via LoDTensor
+                packed = np.concatenate(
+                    [a.reshape(len(a), -1) for a in arrs])
+                lt = LoDTensor(packed, [[len(a) for a in arrs]])
+                out[name] = lt.to_padded()[0]
+        return out

@@ -1,0 +1,3 @@
+"""fluid.dataset (reference fluid/dataset.py DatasetFactory et al)."""
+from ..dataset import *  # noqa: F401,F403
+from ..dataset import DatasetFactory  # noqa: F401

@@ -1,0 +1,33 @@
+"""fluid.dygraph (reference fluid/dygraph/): eager mode surface."""
+from ..dygraph import (grad, to_tensor, to_variable)  # noqa: F401
+from ..dygraph.tape import Tensor, no_grad  # noqa: F401
+from ..framework_api import (disable_dygraph,  # noqa: F401
+                             enable_dygraph)
+from ..nn import Layer, LayerList, Sequential  # noqa: F401
+from ..nn.layers_lib import (BatchNorm, Embedding,  # noqa: F401
+                             LayerNorm, Linear)
+from ..nn.compat import Conv2D  # noqa: F401  (fluid.dygraph.Conv2D)
+from ..nn.compat import Pool2D  # noqa: F401
+from ..parallel.data_parallel import DataParallel  # noqa: F401
+from ..jit import to_static as TracedLayer  # noqa: F401  (jit.py:105)
+from ..io import load_dygraph, save_dygraph  # noqa: F401
+
+guard = enable_dygraph  # fluid.dygraph.guard() context analog
+
+
+class ProgramTranslator:
+    """dygraph_to_static facade (reference program_translator.py)."""
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self):
+        self.enable_to_static = True
+
+    def enable(self, enable_to_static: bool):
+        self.enable_to_static = bool(enable_to_static)

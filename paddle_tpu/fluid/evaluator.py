@@ -1,0 +1,5 @@
+"""fluid.evaluator — legacy Evaluator classes; the reference deprecates
+them in favor of fluid.metrics (evaluator.py docstring), so they alias
+the metrics implementations here."""
+from .metrics import (ChunkEvaluator, DetectionMAP,  # noqa: F401
+                      EditDistance)

@@ -1,0 +1,2 @@
+"""fluid.executor (reference fluid/executor.py)."""
+from ..core import (Executor, global_scope, scope_guard)  # noqa: F401

@@ -1,0 +1,12 @@
+"""fluid.framework (reference fluid/framework.py)."""
+from ..core import (Program, default_main_program,  # noqa: F401
+                    default_startup_program, in_dygraph_mode,
+                    program_guard)
+from ..core.program import VarDesc as Variable  # noqa: F401
+from ..framework_api import ComplexVariable  # noqa: F401
+from ..static import name_scope  # noqa: F401
+from .. import CPUPlace, CUDAPlace  # noqa: F401
+
+
+def _non_static_mode():
+    return in_dygraph_mode()

@@ -1,0 +1,20 @@
+"""fluid.generator (reference fluid/generator.py Generator): the RNG
+seed handle — seeds the eager chain + static executor RNG."""
+
+
+class Generator:
+    def __init__(self, place=None):
+        self._seed = 0
+
+    def manual_seed(self, seed: int):
+        from .. import set_global_seed
+        self._seed = int(seed)
+        set_global_seed(self._seed)
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def seed(self) -> int:
+        import random
+        return self.manual_seed(random.randint(0, 2**31 - 1))._seed

@@ -1,0 +1,15 @@
+"""fluid.incubate (reference fluid/incubate/): fleet + data_generator."""
+import sys as _sys
+import types as _types
+
+from .. import fleet  # noqa: F401
+from .. import incubate as _inc
+from ..dataset.dataset import MultiSlotDataGenerator
+
+checkpoint = getattr(_inc, "checkpoint", None)
+
+# fluid.incubate.data_generator.MultiSlotDataGenerator is the reference
+# import path (incubate/data_generator/__init__.py)
+data_generator = _types.ModuleType(__name__ + ".data_generator")
+data_generator.MultiSlotDataGenerator = MultiSlotDataGenerator
+_sys.modules[data_generator.__name__] = data_generator

@@ -1,0 +1,25 @@
+"""fluid.initializer (reference fluid/initializer.py)."""
+from ..layers.helper import (Constant, Initializer, Normal,  # noqa: F401
+                             TruncatedNormal, Uniform, Xavier)
+from ..nn.initializer import (Assign, KaimingNormal,  # noqa: F401
+                              KaimingUniform)
+
+ConstantInitializer = Constant
+NormalInitializer = Normal
+UniformInitializer = Uniform
+XavierInitializer = Xavier
+TruncatedNormalInitializer = TruncatedNormal
+MSRAInitializer = KaimingNormal
+NumpyArrayInitializer = Assign
+
+_global_weight_initializer = None
+_global_bias_initializer = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """reference initializer.py set_global_initializer: records the
+    process-wide defaults consulted by create_parameter when a layer
+    passes no initializer."""
+    global _global_weight_initializer, _global_bias_initializer
+    _global_weight_initializer = weight_init
+    _global_bias_initializer = bias_init

@@ -1,0 +1,2 @@
+"""fluid.input (reference fluid/input.py): embedding + one_hot."""
+from ..nn.functional import embedding, one_hot  # noqa: F401

@@ -1,0 +1,6 @@
+"""fluid.io (reference fluid/io.py)."""
+from ..io import *  # noqa: F401,F403
+from ..io import (load_inference_model, save_inference_model,  # noqa: F401
+                  load_params, save_params, load_persistables,
+                  save_persistables)
+from ..reader import (DataLoader, batch, buffered, shuffle)  # noqa: F401

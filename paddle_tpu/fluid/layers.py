@@ -1,0 +1,20 @@
+"""fluid.layers — the op-level layer functions (maps to
+paddle_tpu.layers; reference python/paddle/fluid/layers/)."""
+from ..layers import *  # noqa: F401,F403
+from ..layers import data, Print  # noqa: F401
+from ..nn.decode import beam_search, beam_search_decode  # noqa: F401
+from ..tensor import (zeros, ones, concat, cast, argmax,  # noqa: F401
+                      argmin, argsort, reshape, transpose, squeeze,
+                      unsqueeze, stack, gather, gather_nd, where)
+
+
+def __getattr__(name):
+    # anything else the reference hoists into fluid.layers that lives
+    # in the tensor/functional namespaces here
+    from .. import tensor as _t
+    from ..nn import functional as _f
+    for mod in (_t, _f):
+        if hasattr(mod, name):
+            return getattr(mod, name)
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

@@ -1,0 +1,4 @@
+"""fluid.metrics (reference fluid/metrics.py)."""
+from ..metric import (Accuracy, Auc, ChunkEvaluator,  # noqa: F401
+                      CompositeMetric, DetectionMAP, EditDistance,
+                      Metric, Precision, Recall)

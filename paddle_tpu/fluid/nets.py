@@ -1,0 +1,75 @@
+"""fluid.nets (reference fluid/nets.py): the composed convenience
+networks, built over the layers surface the same way the reference
+composes them over fluid.layers."""
+from ..nn import functional as F
+from .. import tensor as T
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1,
+                         conv_padding=0, conv_dilation=1, conv_groups=1,
+                         param_attr=None, bias_attr=None, act=None,
+                         use_cudnn=True):
+    from ..layers import conv2d, pool2d
+    conv_out = conv2d(input, num_filters, filter_size,
+                      stride=conv_stride, padding=conv_padding,
+                      dilation=conv_dilation, groups=conv_groups,
+                      param_attr=param_attr, bias_attr=bias_attr,
+                      act=act)
+    return pool2d(conv_out, pool_size=pool_size, pool_type=pool_type,
+                  pool_stride=pool_stride, pool_padding=pool_padding,
+                  global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size,
+                   conv_padding=1, conv_filter_size=3, conv_act=None,
+                   param_attr=None, conv_with_batchnorm=False,
+                   conv_batchnorm_drop_rate=0.0, pool_stride=1,
+                   pool_type="max", use_cudnn=True):
+    from ..layers import batch_norm, conv2d, dropout, pool2d
+    tmp = input
+    for i, nf in enumerate(conv_num_filter):
+        tmp = conv2d(tmp, nf, conv_filter_size, padding=conv_padding,
+                     param_attr=param_attr,
+                     act=None if conv_with_batchnorm else conv_act)
+        if conv_with_batchnorm:
+            tmp = batch_norm(tmp, act=conv_act)
+            rate = conv_batchnorm_drop_rate
+            if abs(rate) > 1e-5:
+                tmp = dropout(tmp, dropout_prob=rate)
+    return pool2d(tmp, pool_size=pool_size, pool_type=pool_type,
+                  pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None):
+    from ..layers import sequence_conv, sequence_pool
+    conv_out = sequence_conv(input, num_filters, filter_size,
+                             param_attr=param_attr, act=act,
+                             bias_attr=bias_attr)
+    return sequence_pool(conv_out, pool_type)
+
+
+def glu(input, dim=-1):
+    """Gated linear unit: split in half on `dim`, a * sigmoid(b)."""
+    a, b = T.split(input, 2, axis=dim)
+    return T.multiply(a, F.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Composed multi-head attention over the layers surface
+    (fluid/nets.py:~500). For the fused TPU path use
+    nn.MultiHeadAttention (Pallas flash kernel)."""
+    import math
+    from ..layers import fc
+    d = queries.shape[-1]
+    q, k, v = queries, keys, values
+    scores = T.matmul(q, k, transpose_y=True)
+    scores = T.multiply(scores, T.full_like(scores,
+                                            1.0 / math.sqrt(d)))
+    weights = F.softmax(scores)
+    if dropout_rate:
+        weights = F.dropout(weights, dropout_rate)
+    return T.matmul(weights, v)

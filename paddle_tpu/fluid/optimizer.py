@@ -1,0 +1,2 @@
+"""fluid.optimizer (reference fluid/optimizer.py)."""
+from ..optimizer import *  # noqa: F401,F403

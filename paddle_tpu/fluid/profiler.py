@@ -1,0 +1,2 @@
+"""fluid.profiler (reference fluid/profiler.py)."""
+from ..profiler import *  # noqa: F401,F403

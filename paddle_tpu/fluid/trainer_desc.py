@@ -1,0 +1,2 @@
+"""fluid.trainer_desc (reference fluid/trainer_desc.py)."""
+from ..distributed import TrainerDesc, TrainerFactory  # noqa: F401

@@ -1,0 +1,2 @@
+"""fluid.transpiler (reference fluid/transpiler/)."""
+from ..transpiler import *  # noqa: F401,F403

@@ -1,0 +1,37 @@
+"""fluid.unique_name (reference fluid/unique_name.py): the global name
+generator + guard/switch used by layer builders."""
+import contextlib
+
+_counters = {}
+_prefix = []
+
+
+def generate(key: str) -> str:
+    full = "".join(_prefix) + key
+    idx = _counters.get(full, 0)
+    _counters[full] = idx + 1
+    return "%s_%d" % (full, idx)
+
+
+def switch(new_generator=None):
+    """Reset (or swap) the counter state; returns the old state."""
+    global _counters
+    old = _counters
+    _counters = new_generator if isinstance(new_generator, dict) else {}
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    if isinstance(new_generator, str):
+        _prefix.append(new_generator)
+        try:
+            yield
+        finally:
+            _prefix.pop()
+        return
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -283,3 +283,44 @@ def load_dygraph(model_path):
 # ---------------------------------------------------------------------------
 from .reader import (BatchSampler, DataLoader, Dataset,  # noqa: F401,E402
                      IterableDataset, TensorDataset, shuffle)
+from .reader import (DistributedBatchSampler, RandomSampler,  # noqa: F401,E402
+                     Sampler, SequenceSampler, batch, buffered, cache,
+                     chain, compose, firstn, get_worker_info,
+                     map_readers, xmap_readers)
+
+
+def load_program_state(model_path, var_list=None):
+    """fluid.io.load_program_state: read a persistables file (the npz
+    save_vars writes) into a {name: ndarray} dict without touching any
+    scope. Accepts the exact file path, a directory containing the
+    default __params__.npz, or a path needing the suffix."""
+    import numpy as _np
+    candidates = [model_path,
+                  os.path.join(model_path, "__params__.npz"),
+                  model_path + ".npz", model_path + ".pdparams"]
+    path = next((p for p in candidates if os.path.isfile(p)), None)
+    if path is None:
+        raise FileNotFoundError(
+            "load_program_state: none of %r exist" % (candidates,))
+    with open(path, "rb") as f:
+        data = _np.load(f, allow_pickle=True)
+        state = {k: data[k] for k in data.files}
+    if var_list is not None:
+        names = {v if isinstance(v, str) else v.name for v in var_list}
+        state = {k: v for k, v in state.items() if k in names}
+    return state
+
+
+def set_program_state(program, state_dict):
+    """fluid.io.set_program_state: write a {name: ndarray} dict into
+    the global scope's variables for `program`."""
+    import jax.numpy as _jnp
+    from .core import global_scope
+    scope = global_scope()
+    missing = []
+    for name, value in state_dict.items():
+        if name in program.global_block.vars:
+            scope.set(name, _jnp.asarray(value))
+        else:
+            missing.append(name)
+    return missing

@@ -189,7 +189,74 @@ class LayerHelper:
                                     trainable=attr.trainable)
             sblock.append_op(init["type"], inputs={},
                              outputs={"Out": [name]}, attrs=init["attrs"])
+        if hasattr(attr, "dim") and not is_bias:
+            # WeightNormParamAttr: reparameterize as w = g * v/||v||
+            # (reference layer_helper.py _create_weight_normalize — it
+            # builds the same norm/div/scale op chain). v takes the
+            # requested init; g starts at ||v|| so the initial w equals
+            # the plain init. The ops live in the MAIN block, so the
+            # backward meta-op differentiates into g and v.
+            return self._weight_normalize(param, shape, dtype, attr.dim)
         return param
+
+    def _weight_normalize(self, v_param, shape, dtype, dim):
+        # dim=None (the reference default): one scalar g over the whole
+        # tensor; dim=k: one g per slice of axis k
+        if dim is None:
+            axes, g_shape, reduce_all = [], [], True
+        else:
+            axes = [i for i in range(len(shape)) if i != dim]
+            g_shape = [shape[dim]]
+            reduce_all = False
+        g_name = v_param.name + "@wn_g"
+        self.main_program.global_block.create_parameter(
+            g_name, g_shape, dtype,
+            initializer={"type": "fill_constant",
+                         "attrs": {"shape": g_shape, "value": 1.0,
+                                   "dtype": dtype}},
+            trainable=True)
+        # g is initialized to ||v|| computed in the startup program from
+        # the freshly-initialized v, so training starts at w == v
+        sblock = self.startup_program.global_block
+        if g_name not in sblock.vars:
+            sblock.create_parameter(g_name, g_shape, dtype)
+            sq0 = sblock.create_var(g_name + "@sq0", shape=list(shape),
+                                    dtype=dtype)
+            ssum = sblock.create_var(g_name + "@sum", shape=g_shape,
+                                     dtype=dtype)
+            sblock.append_op("elementwise_mul",
+                             {"X": [v_param.name], "Y": [v_param.name]},
+                             {"Out": [sq0.name]}, {})
+            sblock.append_op("reduce_sum", {"X": [sq0.name]},
+                             {"Out": [ssum.name]},
+                             {"dim": axes, "keep_dim": False,
+                              "reduce_all": reduce_all})
+            sblock.append_op("sqrt", {"X": [ssum.name]},
+                             {"Out": [g_name]}, {})
+        # main block: w = v * (g / ||v||) broadcast along dim
+        sq = self.create_tmp_variable(dtype)
+        self.append_op("elementwise_mul",
+                       inputs={"X": [v_param.name], "Y": [v_param.name]},
+                       outputs={"Out": [sq.name]})
+        nrm = self.create_tmp_variable(dtype)
+        self.append_op("reduce_sum", inputs={"X": [sq.name]},
+                       outputs={"Out": [nrm.name]},
+                       attrs={"dim": axes, "keep_dim": False,
+                              "reduce_all": reduce_all})
+        nrm_s = self.create_tmp_variable(dtype)
+        self.append_op("sqrt", inputs={"X": [nrm.name]},
+                       outputs={"Out": [nrm_s.name]})
+        ratio = self.create_tmp_variable(dtype)
+        self.append_op("elementwise_div",
+                       inputs={"X": [g_name], "Y": [nrm_s.name]},
+                       outputs={"Out": [ratio.name]})
+        w = self.create_tmp_variable(dtype)
+        self.append_op("elementwise_mul",
+                       inputs={"X": [v_param.name], "Y": [ratio.name]},
+                       outputs={"Out": [w.name]},
+                       attrs={} if dim is None else {"axis": dim})
+        w.shape = tuple(shape)
+        return w
 
     def create_tmp_variable(self, dtype="float32", shape=None,
                             stop_gradient=False) -> VarDesc:

@@ -347,3 +347,33 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
     from .layers import chunk_eval as _ce
     return _ce(input, label, chunk_scheme, num_chunk_types,
                excluded_chunk_types, seq_length)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Streaming AUC (fluid layers.auc / auc_op.cc): creates the
+    stat-bucket state (zeros for a fresh evaluation — the fluid layer
+    creates persistable zero buckets the same way) and runs the op;
+    returns (auc, [stat_pos_out, stat_neg_out]) so callers can feed the
+    states back in for streaming updates."""
+    from . import tensor as _t
+    from .nn.functional import _run_multi
+    stat_pos = _t.zeros([num_thresholds + 1], dtype="int64")
+    stat_neg = _t.zeros([num_thresholds + 1], dtype="int64")
+    out, sp, sn = _run_multi(
+        "auc", {"Predict": [input], "Label": [label],
+                "StatPos": [stat_pos], "StatNeg": [stat_neg]},
+        {"curve": curve, "num_thresholds": num_thresholds},
+        ["AUC", "StatPosOut", "StatNegOut"])
+    return out, [sp, sn]
+
+
+def cos_sim(X, Y):
+    """Cosine similarity rows (cos_sim_op.cc) via the layers surface."""
+    from .layers import cos_sim as _cs
+    return _cs(X, Y)
+
+
+def mean_iou(input, label, num_classes):
+    from .layers import mean_iou as _mi
+    return _mi(input, label, num_classes)

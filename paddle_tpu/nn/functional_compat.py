@@ -232,12 +232,17 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     return _run("scale", {"X": [mixed]}, {"scale": a, "bias": b})
 
 
-def _channel_dropout(x, p, training, spatial_dims):
+def _channel_dropout(x, p, training, spatial_dims, channels_last):
     """One keep decision per (N, C): the whole channel map drops
-    together (reference common.py dropout2d/3d contract)."""
+    together (reference common.py dropout2d/3d contract). The mask
+    broadcasts along the spatial axes, wherever the channel axis is."""
     if not training or p == 0.0:
         return x
-    shape = list(x.shape[:2]) + [1] * spatial_dims
+    nd = spatial_dims + 2
+    if channels_last:
+        shape = [x.shape[0]] + [1] * spatial_dims + [x.shape[nd - 1]]
+    else:
+        shape = list(x.shape[:2]) + [1] * spatial_dims
     ones = _run("fill_constant", {},
                 {"shape": shape, "value": 1.0, "dtype": "float32"})
     _, mask = _run_multi("dropout", {"X": [ones]},
@@ -250,11 +255,11 @@ def _channel_dropout(x, p, training, spatial_dims):
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
-    return _channel_dropout(x, p, training, 2)
+    return _channel_dropout(x, p, training, 2, data_format == "NHWC")
 
 
 def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
-    return _channel_dropout(x, p, training, 3)
+    return _channel_dropout(x, p, training, 3, data_format == "NDHWC")
 
 
 # -- similarity / norms ----------------------------------------------------
@@ -329,7 +334,9 @@ def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
     identity rolled to the requested diagonal, pad square, and handle a
     negative offset by transposing the positive-offset result."""
     nd = len(input.shape)
-    if (dim1 % (nd + 2), dim2 % (nd + 2)) != (nd, nd + 1):
+    out_rank = nd + 1
+    if (dim1 % out_rank, dim2 % out_rank) != (out_rank - 2,
+                                              out_rank - 1):
         raise NotImplementedError(
             "diag_embed: only the default dim1=-2, dim2=-1 placement is "
             "supported")

@@ -13,6 +13,18 @@ XavierNormal = Xavier
 XavierUniform = Xavier
 
 
+def _fan_in(shape):
+    """fluid/initializer.py _compute_fans: matrices use shape[0] (rows
+    = input features in the [in, out] fc layout); conv kernels
+    [out, in, k, k] use in * prod(kernel)."""
+    import numpy as np
+    if len(shape) < 2:
+        return shape[0] if shape else 1
+    if len(shape) == 2:
+        return shape[0]
+    return int(np.prod(shape[1:]))
+
+
 class KaimingNormal(Initializer):
     """He normal: std = sqrt(2 / fan_in) (fluid/initializer.py MSRA)."""
 
@@ -20,11 +32,8 @@ class KaimingNormal(Initializer):
         self.fan_in = fan_in
 
     def desc(self, shape, dtype):
-        import numpy as np
-        fan_in = self.fan_in
-        if fan_in is None:
-            fan_in = (int(np.prod(shape[1:])) if len(shape) > 1
-                      else shape[0])
+        fan_in = self.fan_in if self.fan_in is not None else \
+            _fan_in(shape)
         return Normal(0.0, math.sqrt(2.0 / max(fan_in, 1))).desc(
             shape, dtype)
 
@@ -36,11 +45,8 @@ class KaimingUniform(Initializer):
         self.fan_in = fan_in
 
     def desc(self, shape, dtype):
-        import numpy as np
-        fan_in = self.fan_in
-        if fan_in is None:
-            fan_in = (int(np.prod(shape[1:])) if len(shape) > 1
-                      else shape[0])
+        fan_in = self.fan_in if self.fan_in is not None else \
+            _fan_in(shape)
         limit = math.sqrt(6.0 / max(fan_in, 1))
         return Uniform(-limit, limit).desc(shape, dtype)
 

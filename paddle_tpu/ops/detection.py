@@ -1843,18 +1843,41 @@ def _deformable_roi_pooling(ctx, ins, attrs):
         xx = x1 + j * bin_w + (sj + 0.5) * sub_w + dx
         inside = ((yy >= -0.5) & (yy < h - 0.5)
                   & (xx >= -0.5) & (xx < w - 0.5))
-        vals = bilinear(x[bidx], jnp.clip(yy, 0, h - 1),
-                        jnp.clip(xx, 0, w - 1))        # [C,ph,pw,s,s]
+        yyc = jnp.clip(yy, 0, h - 1)
+        xxc = jnp.clip(xx, 0, w - 1)
+        if pos_sensitive:
+            # R-FCN layout: bin (i,j)'s output channel k reads input
+            # channel k*ph*pw + i*pw + j. Select the per-bin channel
+            # slice BEFORE sampling (a reshape, no copy) so only 1 of
+            # the ph*pw channel-bin combinations is ever tapped — the
+            # all-channels-then-discard form does ph*pw times the
+            # bilinear work
+            img = x[bidx].reshape(oc, ph, pw, h, w)
+            ii = jnp.broadcast_to(
+                jnp.arange(ph)[:, None, None, None], yy.shape)
+            jj = jnp.broadcast_to(
+                jnp.arange(pw)[None, :, None, None], yy.shape)
+
+            def tap(yi, xi):
+                return img[:, ii, jj, yi, xi]          # [oc,ph,pw,s,s]
+
+            y0 = jnp.clip(jnp.floor(yyc), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xxc), 0, w - 1)
+            y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+            wy = jnp.clip(yyc - y0, 0.0, 1.0)
+            wx = jnp.clip(xxc - x0, 0.0, 1.0)
+            y0i = y0.astype(jnp.int32)
+            x0i = x0.astype(jnp.int32)
+            vals = (tap(y0i, x0i) * (1 - wy) * (1 - wx)
+                    + tap(y0i, x1i) * (1 - wy) * wx
+                    + tap(y1i, x0i) * wy * (1 - wx)
+                    + tap(y1i, x1i) * wy * wx)
+        else:
+            vals = bilinear(x[bidx], yyc, xxc)         # [C,ph,pw,s,s]
         vals = jnp.where(inside[None], vals, 0.0)
         cnt = jnp.maximum(inside.sum(axis=(-1, -2)), 1.0)  # [ph,pw]
-        pooled = vals.sum(axis=(-1, -2)) / cnt          # [C,ph,pw]
-        if pos_sensitive:
-            # output channel k of bin (i,j) reads input channel
-            # k*ph*pw + i*pw + j (R-FCN layout)
-            sel = pooled.reshape(oc, ph, pw, ph, pw)
-            ii = jnp.arange(ph)[:, None]
-            jj = jnp.arange(pw)[None, :]
-            pooled = sel[:, ii, jj, ii, jj]
+        pooled = vals.sum(axis=(-1, -2)) / cnt
         return pooled
 
     if trans is not None and not no_trans:

@@ -16,3 +16,58 @@ from .static_opt import (Adadelta, AdadeltaOptimizer, Adagrad,  # noqa: F401
                          ModelAverage)
 
 Dpsgd = DpSGD  # reference spelling (fluid/optimizer.py Dpsgd)
+
+# ---------------------------------------------------------------------------
+# round-5 parity closure: 2.0-style scheduler classes + the wrapper
+# optimizers the reference's optimizer/__init__.py exports
+# ---------------------------------------------------------------------------
+from .lr_scheduler import (CosineAnnealingLR, ExponentialLR,  # noqa: F401
+                           InverseTimeLR, LambdaLR, LinearLrWarmup,
+                           MultiStepLR, NaturalExpLR, NoamLR,
+                           PiecewiseLR, PolynomialLR, ReduceLROnPlateau,
+                           StepLR)
+
+DpsgdOptimizer = DpSGDOptimizer  # reference spelling
+
+
+def __getattr__(name):
+    # heavy wrapper optimizers resolve lazily (their homes import this
+    # package back — fleet.meta_optimizers / parallel.pipeline)
+    if name == "DGCMomentumOptimizer":
+        from ..fleet.meta_optimizers import DGCMomentumOptimizer as c
+        return c
+    if name == "PipelineOptimizer":
+        from ..parallel.pipeline import PipelineOptimizer as c
+        return c
+    if name == "RecomputeOptimizer":
+        return _make_recompute_optimizer()
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))
+
+
+def _make_recompute_optimizer():
+    from ..distributed import recompute as _recompute
+
+    class RecomputeOptimizer:
+        """optimizer.py:~4600 RecomputeOptimizer: wraps an inner
+        optimizer and rematerializes the listed checkpoint segments in
+        backward. Here remat is jax.checkpoint (distributed.recompute)
+        applied by the model/segment code; the wrapper keeps the reference's
+        call shape and delegates optimization to the inner optimizer."""
+
+        def __init__(self, optimizer):
+            self._inner = optimizer
+            self._checkpoints = None
+
+        def _set_checkpoints(self, checkpoints):
+            self._checkpoints = checkpoints
+
+        def minimize(self, loss, startup_program=None, program=None,
+                     parameter_list=None, no_grad_set=None):
+            return self._inner.minimize(
+                loss, startup_program=startup_program, program=program)
+
+        def __getattr__(self, item):
+            return getattr(self._inner, item)
+
+    return RecomputeOptimizer

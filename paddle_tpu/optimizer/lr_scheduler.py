@@ -74,6 +74,26 @@ def _lr_schedule(ctx, ins, attrs):
         values = jnp.asarray(attrs["values"], jnp.float32)
         idx = jnp.sum((step >= bounds).astype(jnp.int32))
         lr = values[idx]
+    elif kind == "cosine_annealing":
+        t_max = attrs["T_max"]
+        eta_min = attrs.get("eta_min", 0.0)
+        lr = eta_min + (base - eta_min) * 0.5 * (
+            1 + jnp.cos(math.pi * step / t_max))
+    elif kind == "step_decay":
+        size = attrs["step_size"]
+        gamma = attrs.get("gamma", 0.1)
+        lr = base * jnp.power(gamma, jnp.floor(step / size))
+    elif kind == "multistep":
+        gamma = attrs.get("gamma", 0.1)
+        ms = jnp.asarray(attrs["milestones"], jnp.float32)
+        n_passed = jnp.sum((step >= ms).astype(jnp.float32))
+        lr = base * jnp.power(gamma, n_passed)
+    elif kind == "lambda":
+        # the multiplier callable must be jax-traceable (plain
+        # arithmetic of the step); carried in-memory only — a program
+        # with a LambdaLR does not survive JSON serialization, exactly
+        # like the reference cannot proto-serialize a python lambda
+        lr = base * attrs["lr_lambda"](step)
     else:
         raise ValueError(f"unknown lr schedule {kind!r}")
     warmup_steps = attrs.get("warmup_steps_linear", 0)
@@ -185,3 +205,137 @@ def linear_lr_warmup(scheduler: LRScheduler, warmup_steps, start_lr, end_lr):
                              "warmup_start_lr": start_lr,
                              "warmup_end_lr": end_lr})
     return scheduler
+
+
+# ---------------------------------------------------------------------------
+# 2.0-style scheduler classes (the reference's optimizer/__init__.py
+# exports these *LR names alongside the fluid decay classes; the 2.0
+# API counts scheduler.step() EPOCHS where fluid counts global steps —
+# under the step-driven lr_schedule op both reduce to functions of the
+# step var, which is the TPU-native form: one fused scalar computation
+# inside the jitted train step)
+# ---------------------------------------------------------------------------
+
+class CosineAnnealingLR(LRScheduler):
+    kind = "cosine_annealing"
+
+    def __init__(self, learning_rate, T_max, eta_min=0.0, **kw):
+        super().__init__(learning_rate, T_max=T_max,
+                         eta_min=float(eta_min))
+
+
+class StepLR(LRScheduler):
+    kind = "step_decay"
+
+    def __init__(self, learning_rate, step_size, gamma=0.1, **kw):
+        super().__init__(learning_rate, step_size=int(step_size),
+                         gamma=float(gamma))
+
+
+class MultiStepLR(LRScheduler):
+    kind = "multistep"
+
+    def __init__(self, learning_rate, milestones, gamma=0.1, **kw):
+        super().__init__(learning_rate,
+                         milestones=[int(m) for m in milestones],
+                         gamma=float(gamma))
+
+
+class LambdaLR(LRScheduler):
+    kind = "lambda"
+
+    def __init__(self, learning_rate, lr_lambda, **kw):
+        super().__init__(learning_rate, lr_lambda=lr_lambda)
+
+
+class ExponentialLR(ExponentialDecay):
+    """lr * gamma^step (2.0 signature over the exponential kind)."""
+
+    def __init__(self, learning_rate, gamma, **kw):
+        super().__init__(learning_rate, decay_steps=1, decay_rate=gamma,
+                         staircase=True)
+
+
+class NaturalExpLR(NaturalExpDecay):
+    def __init__(self, learning_rate, gamma, **kw):
+        super().__init__(learning_rate, decay_steps=1, decay_rate=gamma)
+
+
+class InverseTimeLR(InverseTimeDecay):
+    def __init__(self, learning_rate, gamma, **kw):
+        super().__init__(learning_rate, decay_steps=1, decay_rate=gamma)
+
+
+class PolynomialLR(PolynomialDecay):
+    def __init__(self, learning_rate, decay_steps,
+                 end_lr=0.0001, power=1.0, cycle=False, **kw):
+        super().__init__(learning_rate, decay_steps, end_lr, power,
+                         cycle)
+
+
+class PiecewiseLR(PiecewiseDecay):
+    pass
+
+
+class NoamLR(NoamDecay):
+    pass
+
+
+class LinearLrWarmup(LRScheduler):
+    """Warmup wrapper as a class (2.0 form of linear_lr_warmup)."""
+
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
+                 **kw):
+        if isinstance(learning_rate, LRScheduler):
+            self.__class__ = type(learning_rate)  # adopt the wrapped kind
+            self.__dict__ = dict(learning_rate.__dict__)
+            linear_lr_warmup(self, warmup_steps, start_lr, end_lr)
+        else:
+            super().__init__(float(learning_rate))
+            linear_lr_warmup(self, warmup_steps, start_lr, end_lr)
+
+
+class ReduceLROnPlateau(LRScheduler):
+    """Metric-driven decay (reference ReduceLROnPlateau): HOST-side
+    state — call step(metric) each eval; eager optimizers read the
+    updated value every step. Inside a jitted TrainStep the lr is
+    traced per compile, so a plateau drop takes effect on the next
+    (re)trace — the data-dependent schedule is inherently host logic,
+    matching the reference's python-side implementation."""
+    kind = "constant"
+
+    def __init__(self, learning_rate, mode="min", factor=0.1,
+                 patience=10, threshold=1e-4, cooldown=0, min_lr=0.0,
+                 **kw):
+        super().__init__(float(learning_rate))
+        self.mode, self.factor = mode, float(factor)
+        self.patience, self.threshold = int(patience), float(threshold)
+        self.cooldown, self.min_lr = int(cooldown), float(min_lr)
+        self._best = None
+        self._bad = 0
+        self._cool = 0
+
+    def get_lr(self):
+        return self.learning_rate
+
+    def step(self, metrics):
+        import numpy as np
+        m = float(np.asarray(metrics).reshape(-1)[0])
+        better = (self._best is None
+                  or (self.mode == "min"
+                      and m < self._best - self.threshold)
+                  or (self.mode == "max"
+                      and m > self._best + self.threshold))
+        if better:
+            self._best = m
+            self._bad = 0
+        elif self._cool > 0:
+            self._cool -= 1
+        else:
+            self._bad += 1
+            if self._bad > self.patience:
+                self.learning_rate = max(
+                    self.learning_rate * self.factor, self.min_lr)
+                self._bad = 0
+                self._cool = self.cooldown
+        return self.learning_rate

@@ -533,3 +533,162 @@ def _dataloader_from_generator(feed_list=None, capacity: int = 16,
 
 
 DataLoader.from_generator = staticmethod(_dataloader_from_generator)
+
+
+# ---------------------------------------------------------------------------
+# round-5 parity closure: sampler classes + reader decorators the
+# reference exports from paddle.io / fluid.io (reader/decorator.py)
+# ---------------------------------------------------------------------------
+
+class Sampler:
+    """Map-style index sampler base (fluid/dataloader/sampler.py)."""
+
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num = num_samples
+
+    def __len__(self):
+        return self._num if self._num is not None else \
+            len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        k = len(self)
+        if self.replacement:
+            return iter(np.random.randint(0, n, (k,)).tolist())
+        perm = np.random.permutation(n)[:k]
+        return iter(perm.tolist())
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Per-rank shard of the dataset (fluid/dataloader/batch_sampler.py
+    DistributedBatchSampler): rank/world size come from the cluster
+    contract env (the mesh's dp axis in SPMD jobs)."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        super().__init__(dataset=dataset, batch_size=batch_size,
+                         shuffle=shuffle, drop_last=drop_last)
+        from .parallel import get_rank, get_world_size
+        self.nranks = num_replicas if num_replicas is not None \
+            else get_world_size()
+        self.rank = rank if rank is not None else get_rank()
+        self.epoch = 0
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __len__(self):
+        per = (len(self.dataset) + self.nranks - 1) // self.nranks
+        if self.drop_last:
+            return per // self.batch_size
+        return (per + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        n = len(self.dataset)
+        idx = list(range(n))
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            rng.shuffle(idx)
+        # pad to a multiple of nranks so every rank sees equal batches
+        # (the reference appends the head of the list)
+        pad = (self.nranks - n % self.nranks) % self.nranks
+        idx += idx[:pad]
+        local = idx[self.rank::self.nranks]
+        batch = []
+        for i in local:
+            batch.append(i)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+
+def get_worker_info():
+    """None in the main process (fluid/dataloader/worker.py contract);
+    the prefetch pipeline uses threads, not forked workers."""
+    return None
+
+
+def map_readers(func, *readers):
+    """reader/decorator.py map_readers: zip readers, map func."""
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return reader
+
+
+def cache(reader):
+    """Materialize once, replay from memory."""
+    all_data = []
+    filled = []
+
+    def cached():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        return iter(all_data)
+    return cached
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            for item in r():
+                yield item
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into combined samples (decorator.py compose):
+    tuple outputs are flattened unless check_alignment is violated."""
+    check = kwargs.get("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        its = [r() for r in readers]
+        while True:
+            outs = []
+            stop = 0
+            for it in its:
+                try:
+                    outs.append(make_tuple(next(it)))
+                except StopIteration:
+                    stop += 1
+            if stop:
+                if check and stop != len(its):
+                    raise ValueError(
+                        "compose: readers have different lengths")
+                return
+            yield sum(outs, ())
+    return reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                return
+            yield item
+    return firstn_reader

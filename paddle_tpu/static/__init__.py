@@ -76,8 +76,12 @@ from ..layers.helper import ParamAttr as _ParamAttr
 class WeightNormParamAttr(_ParamAttr):
     """ParamAttr requesting weight normalization on the parameter
     (reference param_attr.py WeightNormParamAttr): `dim` selects the
-    norm axis; layers honor it through nn.weight_norm's g*v/||v||
-    reparameterization."""
+    norm axis (None = one scalar g over the whole tensor).
+    LayerHelper.create_parameter detects this attr and builds the
+    w = g * v/||v|| op chain into the program, with g initialized to
+    ||v|| in startup so training starts at the plain init
+    (layers/helper.py _weight_normalize; reference layer_helper.py
+    _create_weight_normalize). For eager Layers use nn.weight_norm."""
 
     def __init__(self, dim=None, **kwargs):
         super().__init__(**kwargs)

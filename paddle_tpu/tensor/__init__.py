@@ -761,3 +761,9 @@ def __getattr__(name):
         return to_tensor
     raise AttributeError("module %r has no attribute %r"
                          % (__name__, name))
+
+
+def reverse(x, axis, name=None):
+    """paddle.reverse (reverse_op.cc) — flip along the listed axes."""
+    axes = list(axis) if isinstance(axis, (list, tuple)) else [axis]
+    return _run("reverse", {"X": [x]}, {"axis": axes})

@@ -1,0 +1,132 @@
+"""Round-5 detection additions: retinanet_target_assign and
+deformable_roi_pooling (ops/detection.py)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import REGISTRY, LowerCtx
+
+
+def _run(name, ins, attrs):
+    return REGISTRY.get(name).lower(LowerCtx(), ins, attrs)
+
+
+def test_retinanet_target_assign_basics():
+    anchors = jnp.asarray([[0, 0, 10, 10], [20, 20, 30, 30],
+                           [100, 100, 110, 110]], jnp.float32)
+    gt = jnp.asarray([[[0, 0, 10, 10], [21, 21, 30, 30]]], jnp.float32)
+    labels = jnp.asarray([[[3], [7]]], jnp.float32)
+    outs = _run("retinanet_target_assign",
+                {"Anchor": [anchors], "GtBoxes": [gt],
+                 "GtLabels": [labels],
+                 "GtNum": [jnp.asarray([2], jnp.int32)]},
+                {"positive_overlap": 0.5, "negative_overlap": 0.4})
+    tl = np.asarray(outs["TargetLabel"][0])[0]
+    fg = int(np.asarray(outs["ForegroundNumber"][0])[0, 0])
+    # anchor0 matches gt0 (class 3), anchor1 matches gt1 (class 7),
+    # anchor2 is background (label 0)
+    assert tl[0] == 3 and tl[1] == 7 and tl[2] == 0
+    assert fg == 2
+    li = np.asarray(outs["LocationIndex"][0])[0]
+    assert set(li[li >= 0].tolist()) == {0, 1}
+
+
+def test_retinanet_crowd_boxes_excluded():
+    anchors = jnp.asarray([[0, 0, 10, 10]], jnp.float32)
+    gt = jnp.asarray([[[0, 0, 10, 10]]], jnp.float32)
+    labels = jnp.asarray([[[5]]], jnp.float32)
+    outs = _run("retinanet_target_assign",
+                {"Anchor": [anchors], "GtBoxes": [gt],
+                 "GtLabels": [labels],
+                 "IsCrowd": [jnp.asarray([[1]], jnp.int32)],
+                 "GtNum": [jnp.asarray([1], jnp.int32)]}, {})
+    # the only gt is crowd -> no positives; the anchor becomes
+    # background (its max IoU vs valid gts is 0 < negative_overlap)
+    assert int(np.asarray(outs["ForegroundNumber"][0])[0, 0]) == 0
+    assert np.asarray(outs["TargetLabel"][0])[0, 0] == 0
+
+
+def _ref_plain_roi_pool(x, roi, scale, ph, pw, spp):
+    """Naive python oracle for the no-trans, non-PS path."""
+    h, w = x.shape[1:]
+    x1 = roi[0] * scale - 0.5
+    y1 = roi[1] * scale - 0.5
+    x2 = (roi[2] + 1.0) * scale - 0.5
+    y2 = (roi[3] + 1.0) * scale - 0.5
+    rw = max(x2 - x1, 0.1)
+    rh = max(y2 - y1, 0.1)
+    bw, bh = rw / pw, rh / ph
+    out = np.zeros((x.shape[0], ph, pw), np.float32)
+    for i in range(ph):
+        for j in range(pw):
+            acc = np.zeros(x.shape[0])
+            cnt = 0
+            for si in range(spp):
+                for sj in range(spp):
+                    yy = y1 + i * bh + (si + 0.5) * bh / spp
+                    xx = x1 + j * bw + (sj + 0.5) * bw / spp
+                    if not (-0.5 <= yy < h - 0.5
+                            and -0.5 <= xx < w - 0.5):
+                        continue
+                    yc, xc = np.clip(yy, 0, h - 1), np.clip(xx, 0, w - 1)
+                    y0, x0 = int(np.floor(yc)), int(np.floor(xc))
+                    y1i, x1i = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+                    wy, wx = yc - y0, xc - x0
+                    acc += (x[:, y0, x0] * (1 - wy) * (1 - wx)
+                            + x[:, y0, x1i] * (1 - wy) * wx
+                            + x[:, y1i, x0] * wy * (1 - wx)
+                            + x[:, y1i, x1i] * wy * wx)
+                    cnt += 1
+            out[:, i, j] = acc / max(cnt, 1)
+    return out
+
+
+def test_deformable_roi_pooling_matches_oracle():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 8, 12, 12).astype(np.float32)
+    rois = np.asarray([[1.0, 1.0, 8.0, 8.0]], np.float32)
+    outs = _run("deformable_roi_pooling",
+                {"Input": [jnp.asarray(x)], "ROIs": [jnp.asarray(rois)],
+                 "BatchRoINums": [jnp.asarray([0], jnp.int32)]},
+                {"no_trans": True, "spatial_scale": 1.0,
+                 "pooled_height": 2, "pooled_width": 2,
+                 "sample_per_part": 2})
+    got = np.asarray(outs["Output"][0])[0]
+    ref = _ref_plain_roi_pool(x[0], rois[0], 1.0, 2, 2, 2)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_deformable_roi_pooling_position_sensitive_and_grads():
+    rng = np.random.RandomState(1)
+    ph = pw = 2
+    oc = 3
+    x = jnp.asarray(rng.randn(1, oc * ph * pw, 10, 10), jnp.float32)
+    rois = jnp.asarray([[0.0, 0.0, 7.0, 7.0]], jnp.float32)
+    trans = jnp.asarray(rng.randn(1, 2, ph, pw) * 0.5, jnp.float32)
+
+    def pooled_sum(xx, tt):
+        outs = _run("deformable_roi_pooling",
+                    {"Input": [xx], "ROIs": [rois], "Trans": [tt],
+                     "BatchRoINums": [jnp.asarray([0], jnp.int32)]},
+                    {"no_trans": False, "spatial_scale": 1.0,
+                     "pooled_height": ph, "pooled_width": pw,
+                     "sample_per_part": 2, "trans_std": 0.1,
+                     "position_sensitive": True})
+        return jnp.sum(outs["Output"][0]), outs["Output"][0]
+
+    (s, out), grads = jax.value_and_grad(
+        pooled_sum, argnums=(0, 1), has_aux=True)(x, trans)
+    assert out.shape == (1, oc, ph, pw)
+    gx, gt = grads
+    assert np.isfinite(np.asarray(gx)).all()
+    # offsets shift sample positions -> the Trans grad path is live
+    # (matching the CUDA kernel's second grad output)
+    assert np.abs(np.asarray(gt)).sum() > 0
+    # PS channel routing: zeroing the channels of bin (0,0) must zero
+    # ONLY that bin's outputs
+    x0 = np.asarray(x).copy()
+    x0[:, 0::ph * pw] = 0.0  # channel k*ph*pw + 0 feeds bin (0,0)
+    _, out0 = pooled_sum(jnp.asarray(x0), trans)
+    np.testing.assert_allclose(np.asarray(out0)[0, :, 0, 0], 0.0,
+                               atol=1e-6)
+    assert np.abs(np.asarray(out0)[0, :, 1, 1]).sum() > 0

@@ -1,0 +1,125 @@
+"""`import paddle.fluid as fluid` is how v1.8-era user code consumes
+the framework; the fluid package must execute that code verbatim, not
+just resolve names (tools/check_api_surface.py checks resolution)."""
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+
+
+def test_fluid_static_train_loop():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        fc = fluid.layers.fc(x, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(fc, y))
+        fluid.optimizer.SGD(0.1).minimize(loss, startup_program=startup,
+                                          program=main)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xv = rng.randn(64, 4).astype(np.float32)
+    yv = (xv.sum(1, keepdims=True) > 0).astype(np.int64)
+    losses = []
+    for _ in range(20):
+        out, = exe.run(main, feed={"x": xv, "y": yv},
+                       fetch_list=[loss])
+        losses.append(float(np.asarray(out)))
+    assert losses[-1] < losses[0]
+
+
+def test_fluid_dygraph_surface():
+    import paddle_tpu.fluid.dygraph as dg
+    lin = dg.Linear(4, 3)
+    out = lin(dg.to_variable(np.ones((2, 4), np.float32)))
+    assert tuple(out.shape) == (2, 3)
+    with dg.no_grad():
+        out2 = lin(dg.to_variable(np.ones((1, 4), np.float32)))
+    assert np.isfinite(np.asarray(out2.value)).all()
+    pt_mod = dg.ProgramTranslator.get_instance()
+    pt_mod.enable(False)
+    assert pt_mod.enable_to_static is False
+    pt_mod.enable(True)
+
+
+def test_fluid_nets():
+    import paddle_tpu.fluid.dygraph as dg
+    g = fluid.nets.glu(dg.to_variable(
+        np.random.RandomState(0).randn(2, 8).astype(np.float32)))
+    assert tuple(g.shape) == (2, 4)
+    q = dg.to_variable(np.random.RandomState(1)
+                       .randn(2, 5, 16).astype(np.float32))
+    att = fluid.nets.scaled_dot_product_attention(q, q, q)
+    assert tuple(att.shape) == (2, 5, 16)
+
+
+def test_fluid_unique_name_and_generator():
+    with fluid.unique_name.guard():
+        a = fluid.unique_name.generate("fc")
+        b = fluid.unique_name.generate("fc")
+    assert a != b and a.startswith("fc")
+    with fluid.unique_name.guard("prefix_"):
+        c = fluid.unique_name.generate("fc")
+    assert c.startswith("prefix_fc")
+    gen = fluid.generator.Generator().manual_seed(7)
+    assert gen.initial_seed() == 7
+
+
+def test_fluid_lod_and_feeder():
+    lt = fluid.create_lod_tensor(
+        np.arange(6, dtype=np.float32).reshape(6, 1), [[2, 4]])
+    assert lt.recursive_sequence_lengths() == [[2, 4]]
+    rlt = fluid.create_random_int_lodtensor([[2, 3]], [4], None, 0, 9)
+    assert np.asarray(rlt).shape == (5, 4)
+    fd = fluid.DataFeeder(["a", "b"])
+    feed = fd.feed([(np.ones(3, np.float32), 0),
+                    (np.zeros(3, np.float32), 1)])
+    assert feed["a"].shape == (2, 3) and feed["b"].shape == (2,)
+
+
+def test_fluid_data_generator_roundtrip():
+    from paddle_tpu.fluid.incubate import data_generator
+
+    class G(data_generator.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("words", [4, 5, 6]), ("label", [1])]
+                yield [("words", [7]), ("label", [0])]
+            return it
+
+    lines = G().run_from_memory()
+    assert lines == ["3 4 5 6 1 1", "1 7 1 0"]
+    # the native MultiSlot parser consumes exactly this format
+    from paddle_tpu.dataset import parse_multislot
+    values, lengths = parse_multislot(
+        ("\n".join(lines) + "\n").encode(), ["uint64", "uint64"])
+    np.testing.assert_array_equal(lengths, [[3, 1], [1, 1]])
+    np.testing.assert_array_equal(values[0], [4, 5, 6, 7])
+
+
+def test_fluid_misc():
+    assert fluid.install_check() is True
+    assert fluid.is_compiled_with_cuda() is False
+    assert fluid.cpu_places(2) and len(fluid.cpu_places(2)) == 2
+    assert fluid.regularizer.L2DecayRegularizer is not None
+    assert fluid.initializer.MSRAInitializer is not None
+    assert fluid.metrics.Accuracy is not None
+    assert fluid.evaluator.ChunkEvaluator is not None
+    w = fluid.average.WeightedAverage()
+    w.add(2.0, 1)
+    w.add(4.0, 3)
+    assert abs(w.eval() - 3.5) < 1e-6
+
+
+def test_fluid_distribute_lookup_table():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        pass
+    main.global_block.append_op(
+        "lookup_table", {"W": ["emb_table"], "Ids": ["ids"]},
+        {"Out": ["out"]}, {"is_distributed": True})
+    from paddle_tpu.fluid.distribute_lookup_table import (
+        find_distributed_lookup_table)
+    assert find_distributed_lookup_table(main) == "emb_table"

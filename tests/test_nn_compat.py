@@ -247,6 +247,38 @@ def test_static_parity_surface():
         pt.disable_static()
 
 
+def test_weight_norm_param_attr_reparameterizes():
+    """WeightNormParamAttr must actually build the g*v/||v|| chain in
+    the program (reference layer_helper.py _create_weight_normalize),
+    with gradients flowing into BOTH g and v."""
+    pt.enable_static()
+    try:
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [4])
+            y = pt.layers.data("y", [1], dtype="int64")
+            fc = pt.layers.fc(
+                x, 8, param_attr=pt.static.WeightNormParamAttr(dim=1))
+            loss = pt.layers.mean(
+                pt.layers.softmax_with_cross_entropy(
+                    pt.layers.fc(fc, 4), y))
+            pt.optimizer.SGD(0.1).minimize(loss, startup_program=startup,
+                                           program=main)
+        g_params = [n for n in main.global_block.vars if "@wn_g" in n]
+        assert g_params, "no weight-norm g parameter created"
+        exe = pt.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(32, 4).astype(np.float32)
+        yv = (xv.sum(1, keepdims=True) > 0).astype(np.int64) * 3
+        losses = [float(np.asarray(exe.run(
+            main, feed={"x": xv, "y": yv}, fetch_list=[loss])[0]))
+            for _ in range(15)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses[::5]
+    finally:
+        pt.disable_static()
+
+
 def test_initializer_namespace():
     pt.seed(0)
 
@@ -256,7 +288,13 @@ def test_initializer_namespace():
             self.w = self.create_parameter(
                 [64, 32],
                 default_initializer=nn.initializer.KaimingNormal())
+            self.k = self.create_parameter(
+                [16, 8, 3, 3],
+                default_initializer=nn.initializer.KaimingNormal())
 
     m = M()
-    w = _np(m.w)
-    assert abs(w.std() - np.sqrt(2.0 / 32)) < 0.05
+    # matrices: fan_in = rows (the reference's [in, out] fc layout,
+    # fluid/initializer.py _compute_fans)
+    assert abs(_np(m.w).std() - np.sqrt(2.0 / 64)) < 0.05
+    # conv kernels: fan_in = in_channels * prod(kernel)
+    assert abs(_np(m.k).std() - np.sqrt(2.0 / (8 * 9))) < 0.05

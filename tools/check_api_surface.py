@@ -30,19 +30,40 @@ REF_ROOT = "/root/reference/python/paddle"
 # second-level namespaces diffed the same way (module path -> attr path)
 SUB_NAMESPACES = [
     "nn", "nn/functional", "optimizer", "metric", "static", "io",
-    "distributed", "tensor",
+    "distributed", "tensor", "fluid",
 ]
+
+# fluid members that are deliberately absent (documented design
+# discharge; everything else must resolve)
+FLUID_ALLOWED_ABSENT = {
+    # pybind/C++ internals with no python-facing role here: the C++
+    # core IS jax/XLA (fluid/core.py keeps the names ported code uses)
+    "core_avx", "core_noavx", "libpaddle",
+    # py2 compat module (reference imports `sys` etc. — filtered by
+    # regex already)
+}
 
 
 def _ref_names(path):
+    """All top-level names a reference __init__ binds via from-imports
+    (EVERY name on multi-name lines, including backslash
+    continuations) and `import paddle.x` statements."""
     names = set()
-    for line in open(path):
+    text = open(path).read().replace("\\\n", " ")
+    for line in text.splitlines():
         line = line.strip()
         if line.startswith("#") or "__future__" in line:
             continue
-        m = re.match(r"from [.\w]+ import (\w+)", line)
-        if m and not m.group(1).startswith("_"):
-            names.add(m.group(1))
+        m = re.match(r"from [.\w]+ import (.+)", line)
+        if m:
+            frag = m.group(1).split("#")[0]
+            for item in frag.split(","):
+                item = item.strip().strip("()")
+                if " as " in item:
+                    item = item.split(" as ")[1].strip()
+                if re.fullmatch(r"\w+", item) and not \
+                        item.startswith("_"):
+                    names.add(item)
         m = re.match(r"import paddle\.(\w+)", line)
         if m:
             names.add(m.group(1))
@@ -80,7 +101,9 @@ def main() -> int:
         for part in sub.split("/"):
             mod = getattr(mod, part)
         sub_names = _ref_names(path)
-        sub_missing = sorted(n for n in sub_names if not hasattr(mod, n))
+        allowed = FLUID_ALLOWED_ABSENT if sub == "fluid" else set()
+        sub_missing = sorted(n for n in sub_names
+                             if not hasattr(mod, n) and n not in allowed)
         print("%-14s %d reference names, %d missing"
               % (sub.replace("/", "."), len(sub_names),
                  len(sub_missing)))
