@@ -587,6 +587,7 @@ class DistributedBatchSampler(BatchSampler):
                  shuffle=False, drop_last=False):
         super().__init__(dataset=dataset, batch_size=batch_size,
                          shuffle=shuffle, drop_last=drop_last)
+        self.dataset = dataset  # the base class keeps only len()
         from .parallel import get_rank, get_world_size
         self.nranks = num_replicas if num_replicas is not None \
             else get_world_size()

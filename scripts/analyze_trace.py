@@ -1,0 +1,101 @@
+"""Aggregate a jax.profiler trace (the Chrome trace.json.gz inside an
+xplane dir) into per-category device-time totals.
+
+Round-5 example: this analysis attributed 33% of the ResNet-50 step to
+BN-statistics reduce fusions (multiply_reduce_fusion.*), which drove
+the custom two-reduction BN backward (ops/nn.py _bn_train). Usage:
+
+    python scripts/analyze_trace.py /tmp/resnet_profile [steps]
+
+`steps` (default 5) divides totals into per-step numbers; pass the
+step count used while tracing.
+"""
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+# pure-stdlib on purpose: no jax/paddle_tpu import, so it runs anywhere
+# (including while the chip is busy) with zero startup cost
+
+
+def newest_trace(root):
+    cands = sorted(glob.glob(os.path.join(
+        root, "plugins", "profile", "*", "*.trace.json.gz")))
+    if not cands:
+        raise FileNotFoundError("no trace.json.gz under %r" % root)
+    return cands[-1]
+
+
+def categorize(name):
+    # XLA spells unfused HLO instruction names with DASHES
+    # (all-reduce.1, select-and-scatter.3); fusion names use
+    # underscores (multiply_reduce_fusion.2) — normalize first
+    n = name.replace("-", "_")
+    if "convert" in n:
+        return "dtype converts (unfused)"
+    if "convolution" in n:
+        return "convolution (unfused)"
+    if "multiply_reduce" in n or "reduce_fusion" in n:
+        return "reduce fusions (norm stats & grads)"
+    if "select_and_scatter" in n:
+        return "maxpool backward"
+    if "reduce_window" in n:
+        return "pool forward"
+    if ("all_reduce" in n or "all_gather" in n or "all_to_all" in n
+            or "reduce_scatter" in n or "collective" in n
+            or "psum" in n):
+        return "collectives"
+    if "copy" in n or "transpose" in n or "bitcast" in n:
+        return "data movement"
+    if "custom_call" in n:
+        return "pallas kernels / custom calls"
+    if "fusion" in n:
+        return "other fusions (conv/matmul + elementwise)"
+    if "dynamic" in n or "slice" in n:
+        return "slicing"
+    return "misc: " + n.split(".")[0][:24]
+
+
+def main():
+    root = sys.argv[1] if len(sys.argv) > 1 else "/tmp/resnet_profile"
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    path = newest_trace(root)
+    d = json.load(gzip.open(path))
+    events = d.get("traceEvents", [])
+    pid_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e["pid"]] = e["args"].get("name", "")
+    cat = collections.Counter()
+    op = collections.Counter()
+    total = 0
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        if "TPU" not in pid_names.get(e.get("pid"), ""):
+            continue
+        n = e.get("name", "")
+        # skip whole-step umbrella spans (jit_* parents, bare step ids)
+        if n.startswith("jit_") or re.fullmatch(r"\d+", n):
+            continue
+        total += e["dur"]
+        cat[categorize(n)] += e["dur"]
+        op[n[:60]] += e["dur"]
+    print("trace: %s" % path)
+    print("device child time %.1fms over %d steps -> %.2fms/step"
+          % (total / 1e3, steps, total / steps / 1e3))
+    print("\nby category:")
+    for c, us in cat.most_common(12):
+        print("  %8.2f ms/step  %5.1f%%  %s"
+              % (us / steps / 1e3, 100 * us / max(total, 1), c))
+    print("\ntop ops:")
+    for n, us in op.most_common(15):
+        print("  %8.2f ms/step  %s" % (us / steps / 1e3, n))
+
+
+if __name__ == "__main__":
+    main()

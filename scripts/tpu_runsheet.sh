@@ -47,6 +47,11 @@ probe || { echo "TUNNEL WEDGED after section 3 ($(date -u +%FT%TZ))"; exit 1; }
 echo "=== 3b. ResNet-50 phase breakdown (MFU-gap attribution)"
 timeout 1800 python scripts/profile_resnet.py || true
 
+# trace aggregation is pure-stdlib (no jax import): safe anywhere
+echo "=== 3c. trace breakdowns (analyze_trace.py; CPU-side)"
+timeout 300 python scripts/analyze_trace.py /tmp/bert_profile || true
+timeout 300 python scripts/analyze_trace.py /tmp/resnet_profile || true
+
 probe || { echo "TUNNEL WEDGED after section 3b ($(date -u +%FT%TZ))"; exit 1; }
 echo "=== 4. headline bench (B=32)"
 timeout 1800 python bench.py
