@@ -1,1 +1,2 @@
 from . import checkpoint  # noqa: F401
+from . import reader  # noqa: F401  (paddle.incubate.reader)

@@ -1,0 +1,19 @@
+"""paddle.incubate.reader (reference fluid/contrib/reader/): the
+distributed reader shard decorator."""
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    """Shard a batch reader across trainers (reference
+    distributed_reader.py:21): each rank keeps every nranks-th batch,
+    rank/world size from the cluster-contract env."""
+    from ..parallel import get_rank, get_world_size
+
+    def decorated():
+        rank = get_rank()
+        nranks = max(get_world_size(), 1)
+        for i, batch in enumerate(batch_reader()):
+            if i % nranks == rank:
+                yield batch
+    return decorated

@@ -610,9 +610,13 @@ class DistributedBatchSampler(BatchSampler):
             rng = np.random.RandomState(self.epoch)
             rng.shuffle(idx)
         # pad to a multiple of nranks so every rank sees equal batches
-        # (the reference appends the head of the list)
-        pad = (self.nranks - n % self.nranks) % self.nranks
-        idx += idx[:pad]
+        # (the reference appends the head of the list); loop because a
+        # dataset SMALLER than nranks needs to wrap more than once —
+        # a truncated pad would give high ranks zero batches and
+        # desynchronize a lockstep SPMD loop
+        target = ((n + self.nranks - 1) // self.nranks) * self.nranks
+        while len(idx) < target:
+            idx += idx[:target - len(idx)]
         local = idx[self.rank::self.nranks]
         batch = []
         for i in local:

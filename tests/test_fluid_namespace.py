@@ -113,6 +113,52 @@ def test_fluid_misc():
     assert abs(w.eval() - 3.5) < 1e-6
 
 
+def test_fluid_book_recognize_digits():
+    """The fluid book's recognize_digits_conv flow verbatim: data ->
+    simple_img_conv_pool x2 -> fc softmax -> cross_entropy -> Adam,
+    trained through fluid.Executor with a DataFeeder — the exact shape
+    of reference-era user code (book/04.recognize_digits)."""
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [1, 28, 28])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        conv1 = fluid.nets.simple_img_conv_pool(
+            img, num_filters=8, filter_size=5, pool_size=2,
+            pool_stride=2, act="relu")
+        conv2 = fluid.nets.simple_img_conv_pool(
+            conv1, num_filters=16, filter_size=5, pool_size=2,
+            pool_stride=2, act="relu")
+        pred = fluid.layers.fc(conv2, 10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(pred, label))
+        acc = fluid.layers.accuracy(pred, label)
+        fluid.optimizer.Adam(1e-3).minimize(
+            loss, startup_program=startup, program=main)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feeder = fluid.DataFeeder(["img", "label"])
+    rng = np.random.RandomState(0)
+    # two separable classes of synthetic digits
+    samples = []
+    for _ in range(64):
+        y = rng.randint(0, 2)
+        x = rng.randn(1, 28, 28).astype(np.float32) * 0.1
+        x[0, 5:20, 5:20] += (2.0 if y else -2.0)
+        samples.append((x, np.asarray([y], np.int64)))
+    losses = []
+    for _ in range(8):
+        feed = feeder.feed(samples)
+        out = exe.run(main, feed=feed, fetch_list=[loss, acc])
+        losses.append(float(np.asarray(out[0])))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    final_acc = float(np.asarray(out[1]))
+    assert final_acc > 0.8, final_acc
+
+
 def test_fluid_distribute_lookup_table():
     main = fluid.Program()
     with fluid.program_guard(main, fluid.Program()):

@@ -63,6 +63,12 @@ def test_distributed_batch_sampler_partitions():
     e1 = [i for b in s for i in b]
     assert e0 != e1
     assert R.get_worker_info() is None
+    # dataset smaller than nranks: every rank still gets len(sampler)
+    # batches (wrapping pad), so lockstep SPMD loops stay in sync
+    for rank in range(4):
+        tiny = R.DistributedBatchSampler([42], batch_size=1,
+                                         num_replicas=4, rank=rank)
+        assert len(list(tiny)) == len(tiny) == 1
 
 
 def test_io_program_state_roundtrip():

@@ -30,7 +30,7 @@ REF_ROOT = "/root/reference/python/paddle"
 # second-level namespaces diffed the same way (module path -> attr path)
 SUB_NAMESPACES = [
     "nn", "nn/functional", "optimizer", "metric", "static", "io",
-    "distributed", "tensor", "fluid",
+    "distributed", "tensor", "fluid", "incubate",
 ]
 
 # fluid members that are deliberately absent (documented design
