@@ -298,3 +298,91 @@ def test_initializer_namespace():
     assert abs(_np(m.w).std() - np.sqrt(2.0 / 64)) < 0.05
     # conv kernels: fan_in = in_channels * prod(kernel)
     assert abs(_np(m.k).std() - np.sqrt(2.0 / (8 * 9))) < 0.05
+
+
+def test_hsigmoid_layer():
+    pt.seed(0)
+    layer = nn.HSigmoid(feature_size=8, num_classes=6)
+    x = _t(np.random.RandomState(0).randn(4, 8))
+    label = pt.to_tensor(np.random.RandomState(1)
+                         .randint(0, 6, (4, 1)).astype(np.int64))
+    loss = layer(x, label)
+    assert np.isfinite(_np(loss)).all()
+    total = pt.tensor.mean(loss)
+    total.backward()
+    assert layer.weight.grad is not None
+    assert np.abs(np.asarray(layer.weight.grad)).sum() > 0
+
+
+def test_spectral_norm_layer():
+    pt.seed(1)
+    sn = nn.SpectralNorm([6, 4], dim=0, power_iters=4)
+    w = _t(np.random.RandomState(2).randn(6, 4) * 3)
+    out = _np(sn(w))
+    # the normalized weight's spectral norm is ~1 (few power iters ->
+    # approximate; BOTH bounds so zeros/over-normalization also fail)
+    s = np.linalg.svd(out, compute_uv=False)
+    assert 0.5 < s[0] < 1.8, s[0]
+
+
+def test_row_conv_layer():
+    pt.seed(2)
+    rc = nn.RowConv(num_channels=5, future_context_size=2)
+    x = _t(np.random.RandomState(3).randn(2, 7, 5))
+    y = rc(x)
+    assert tuple(y.shape) == (2, 7, 5)
+    assert np.isfinite(_np(y)).all()
+
+
+def test_ctc_loss_layer():
+    pt.seed(3)
+    B, T, C, L = 2, 8, 5, 3
+    logits = _t(np.random.RandomState(4).randn(B, T, C))
+    labels = pt.to_tensor(np.random.RandomState(5)
+                          .randint(1, C, (B, L)).astype(np.int32))
+    ilen = pt.to_tensor(np.asarray([T, T], np.int64))
+    llen = pt.to_tensor(np.asarray([L, 2], np.int64))
+    loss = nn.CTCLoss(blank=0)(logits, labels, ilen, llen)
+    v = float(_np(loss))
+    assert np.isfinite(v) and v > 0
+
+
+def test_upsample_family():
+    x = _t(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    up = nn.UpsamplingNearest2d(scale_factor=2)(x)
+    assert tuple(up.shape) == (1, 1, 8, 8)
+    # nearest 2x repeats each source pixel into a 2x2 block
+    u2 = _np(up)[0, 0]
+    np.testing.assert_array_equal(
+        u2, np.repeat(np.repeat(np.arange(16).reshape(4, 4), 2, 0),
+                      2, 1))
+    ub = nn.UpsamplingBilinear2d(size=[6, 6])(x)
+    assert tuple(ub.shape) == (1, 1, 6, 6)
+    ubv = _np(ub)
+    assert np.isfinite(ubv).all()
+    # align_corners=True keeps the 4 corners exactly
+    np.testing.assert_allclose(
+        [ubv[0, 0, 0, 0], ubv[0, 0, 0, -1],
+         ubv[0, 0, -1, 0], ubv[0, 0, -1, -1]],
+        [0.0, 3.0, 12.0, 15.0], atol=1e-5)
+    u = nn.Upsample(scale_factor=2, mode="bilinear")(x)
+    assert tuple(u.shape) == (1, 1, 8, 8)
+    assert np.isfinite(_np(u)).all()
+
+
+def test_pool2d_fluid_class():
+    x = _t(np.random.RandomState(6).randn(2, 3, 8, 8))
+    p = nn.Pool2D(pool_size=2, pool_type="avg", pool_stride=2)
+    assert tuple(p(x).shape) == (2, 3, 4, 4)
+    g = nn.Pool2D(global_pooling=True, pool_type="max")
+    assert tuple(g(x).shape) == (2, 3, 1, 1)
+
+
+def test_constant_pad3d_and_conv_transpose3d():
+    x = _t(np.ones((1, 2, 3, 3, 3)))
+    padded = nn.ConstantPad3d(1, value=0.5)(x)
+    assert tuple(padded.shape) == (1, 2, 5, 5, 5)
+    assert float(_np(padded)[0, 0, 0, 0, 0]) == 0.5
+    ct = nn.ConvTranspose3d(2, 4, 3, padding=1)
+    y = ct(_t(np.random.RandomState(7).randn(1, 2, 4, 4, 4)))
+    assert tuple(y.shape) == (1, 4, 4, 4, 4)
