@@ -1,0 +1,63 @@
+"""2.0-style eager training: nn.Layer subclass + DataLoader +
+optimizer.step/clear_grad — the paddle 2.x idiom on the dygraph tape."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+class SimpleCNN(nn.Layer):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.conv1 = nn.Conv2D(1, 16, 3, padding=1)
+        self.conv2 = nn.Conv2D(16, 32, 3, padding=1, stride=2)
+        self.head = nn.Linear(32 * 14 * 14, num_classes)
+
+    def forward(self, x):
+        x = F.relu(self.conv1(x))
+        x = F.relu(self.conv2(x))
+        x = paddle.flatten(x, 1)
+        return self.head(x)
+
+
+class SyntheticDigits(paddle.io.Dataset):
+    """Map-style dataset of separable synthetic digits."""
+
+    def __init__(self, n=512, seed=0):
+        rng = np.random.RandomState(seed)
+        self.y = rng.randint(0, 10, (n,)).astype(np.int64)
+        self.x = rng.randn(n, 1, 28, 28).astype(np.float32) * 0.1
+        for i, yi in enumerate(self.y):
+            self.x[i, 0, yi + 4, 4:24] += 2.0
+
+    def __getitem__(self, i):
+        return self.x[i], np.asarray([self.y[i]], np.int64)
+
+    def __len__(self):
+        return len(self.y)
+
+
+def main():
+    paddle.seed(0)
+    model = SimpleCNN()
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    loader = paddle.io.DataLoader(SyntheticDigits(), batch_size=32,
+                                  shuffle=True)
+    for step, (x, y) in enumerate(loader):
+        logits = model(paddle.to_tensor(x))
+        loss = F.cross_entropy(logits, paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if step % 5 == 0:
+            print("step %d loss %.4f" % (step, float(loss)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Fluid-style static-graph training — the book's recognize_digits flow
+ported verbatim (reference book/04): Program + Executor + DataFeeder.
+Toy scale on CPU; raise EPOCHS/BATCH and feed real MNIST for the full
+run (paddle_tpu.datasets.mnist serves cached-or-synthetic data)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import datasets
+
+BATCH, EPOCHS = 64, 2
+
+
+def network(img, label):
+    conv1 = fluid.nets.simple_img_conv_pool(
+        img, num_filters=20, filter_size=5, pool_size=2, pool_stride=2,
+        act="relu")
+    conv2 = fluid.nets.simple_img_conv_pool(
+        conv1, num_filters=50, filter_size=5, pool_size=2,
+        pool_stride=2, act="relu")
+    pred = fluid.layers.fc(conv2, 10, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    acc = fluid.layers.accuracy(pred, label)
+    return loss, acc
+
+
+def main():
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        img = fluid.layers.data("img", [1, 28, 28])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        loss, acc = network(img, label)
+        fluid.optimizer.Adam(1e-3).minimize(
+            loss, startup_program=startup, program=main_prog)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feeder = fluid.DataFeeder(["img", "label"])
+    train_reader = fluid.io.batch(datasets.mnist.train(), BATCH)
+    for epoch in range(EPOCHS):
+        for step, batch in enumerate(train_reader()):
+            samples = [(np.asarray(x, np.float32).reshape(1, 28, 28),
+                        np.asarray([y], np.int64)) for x, y in batch]
+            lv, av = exe.run(main_prog, feed=feeder.feed(samples),
+                             fetch_list=[loss, acc])
+            if step % 20 == 0:
+                print("epoch %d step %d loss %.4f acc %.3f"
+                      % (epoch, step, float(np.asarray(lv)),
+                         float(np.asarray(av))))
+            if step >= 40:  # toy run
+                break
+
+
+if __name__ == "__main__":
+    main()
