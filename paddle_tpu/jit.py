@@ -154,6 +154,14 @@ def _unwrap(x):
     return x.value if isinstance(x, Tensor) else jnp.asarray(x)
 
 
+def _accum_init(p, fill, is_scalar):
+    """One optimizer-accumulator default (shared by the TrainStep
+    pre-build and _opt_update's in-trace fallback so their structures
+    and dtypes cannot drift)."""
+    return (jnp.asarray(fill, jnp.float32) if is_scalar
+            else jnp.full_like(p, fill))
+
+
 class TrainStep:
     """One fused forward+backward+update XLA computation with donated
     parameter/optimizer state.
@@ -216,8 +224,7 @@ class TrainStep:
             for in_slot, out_slot, key, fill, is_scalar in accums:
                 cur = st.get(key)
                 if cur is None:
-                    cur = (jnp.asarray(fill, jnp.float32) if is_scalar
-                           else jnp.full_like(p, fill))
+                    cur = _accum_init(p, fill, is_scalar)
                 ins[in_slot] = [cur]
             outs = opdef.lower(LowerCtx(), ins, attrs)
             new_params[name] = outs["ParamOut"][0]
@@ -275,6 +282,40 @@ class TrainStep:
             jit_kwargs["donate_argnums"] = (0, 1)
         return jax.jit(step, **jit_kwargs)
 
+    def _init_opt_state(self, state):
+        """Pre-build the optimizer accumulator pytree so the jitted
+        step compiles ONCE: without this, call 1 compiles with an
+        empty opt_state and call 2 recompiles with the populated
+        structure — paying double compile time and briefly holding two
+        executables' buffers (which matters on a 16G chip). Uses the
+        SAME _accum_init as _opt_update's in-trace fallback, so the
+        pre-built pytree cannot structurally drift from what the
+        fallback would create."""
+        op_type, attrs, accums = self.optimizer._eager_spec()
+        del op_type, attrs
+
+        def place_scalar(v):
+            if self.mesh is not None:
+                # multi-process SPMD: every jit input must be a GLOBAL
+                # array over the mesh, scalars included (same treatment
+                # as _lr_step)
+                from jax.sharding import NamedSharding, PartitionSpec
+                v = jax.device_put(np.asarray(v), NamedSharding(
+                    self.mesh, PartitionSpec()))
+            return v
+
+        opt_state = {}
+        for name in self.param_names:
+            p = state[name]
+            st = {}
+            for in_slot, out_slot, key, fill, is_scalar in accums:
+                # full_like inherits p's sharding, so accumulators lay
+                # out exactly like their (possibly mesh-sharded) params
+                v = _accum_init(p, fill, is_scalar)
+                st[key] = place_scalar(v) if is_scalar else v
+            opt_state[name] = st
+        return opt_state
+
     def __call__(self, inputs, labels):
         if self._step_fn is None:
             self._step_fn = self._build()
@@ -294,6 +335,11 @@ class TrainStep:
                     for n, v in self._state.items()}
                 self._lr_step = jax.device_put(
                     self._lr_step, NamedSharding(self.mesh, P()))
+            if not self._opt_state:
+                # AFTER the mesh device_put: full_like then inherits
+                # each (possibly sharded) parameter's sharding, so the
+                # accumulators lay out exactly like their params
+                self._opt_state = self._init_opt_state(self._state)
         inputs = tuple(_unwrap(x) for x in (
             inputs if isinstance(inputs, (tuple, list)) else (inputs,)))
         labels = tuple(_unwrap(x) for x in (

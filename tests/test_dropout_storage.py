@@ -124,3 +124,30 @@ def test_trainstep_runs_under_each_strategy():
             assert np.isfinite(losses).all(), (strategy, losses)
         finally:
             pt.set_flags(prior)
+
+
+def test_trainstep_compiles_once():
+    """The optimizer accumulator pytree is pre-built, so the jitted
+    step must have exactly ONE cache entry after many calls — the old
+    empty-then-populated opt_state structure compiled twice, paying
+    double compile time and briefly holding two executables' buffers
+    (jit.py _init_opt_state)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.nn import functional as F
+
+    pt.seed(0)
+    model = nn.Linear(8, 4)
+    opt = pt.optimizer.Adam(1e-3, parameters=model.parameters())
+    step = TrainStep(model, lambda o, y: F.cross_entropy(o, y), opt)
+    x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 4, (4, 1))
+    losses = [float(step((x,), (y,))) for _ in range(4)]
+    assert np.isfinite(losses).all()
+    assert step._step_fn._cache_size() == 1, step._step_fn._cache_size()
+    # Adam accumulators exist and update from step 1 (not zeros-only)
+    m = step._opt_state[step.param_names[0]]
+    assert any(np.abs(np.asarray(v)).sum() > 0 for v in m.values())
