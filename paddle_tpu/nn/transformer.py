@@ -134,10 +134,13 @@ def _attention_core(q, k, v, attn_mask, dropout_p, training, is_causal=False):
         scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores, axis=-1)
     if want_dropout:
-        from ..ops.nn import _keep_mask
-        key = tape._state.next_key()
-        keep = _keep_mask(key, 1.0 - dropout_p, probs.shape)
-        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+        # the [B,H,Sq,Sk] keep decision is the composed path's biggest
+        # backward residual; apply_probs_dropout honors
+        # FLAGS_dropout_storage (u8 = 1 byte/elem, seed = key-only)
+        # through the same dispatch the dropout op uses
+        from ..ops.nn import apply_probs_dropout
+        probs = apply_probs_dropout(probs, 1.0 - dropout_p,
+                                    tape._state.next_key())
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(probs.dtype))
 
 

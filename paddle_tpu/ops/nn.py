@@ -739,6 +739,46 @@ def _drop_custom_bwd(keep_prob, upscale, store_u8, residuals, gs):
 _drop_custom.defvjp(_drop_custom_fwd, _drop_custom_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _drop_custom_nomask(keep_prob, upscale, store_u8, x, key):
+    """_drop_custom without the Mask output: for call sites that never
+    consume it (attention probs dropout) — in EAGER execution the
+    discarded full-size float Mask would otherwise materialize per
+    layer (jit DCEs it, eager cannot)."""
+    out, _, _ = _drop_fwd_impl(keep_prob, upscale, store_u8, x, key)
+    return out
+
+
+def _drop_nomask_fwd(keep_prob, upscale, store_u8, x, key):
+    out, _, keep = _drop_fwd_impl(keep_prob, upscale, store_u8, x, key)
+    res = keep.astype(jnp.uint8) if store_u8 else key
+    return out, (res, x.shape)
+
+
+def _drop_nomask_bwd(keep_prob, upscale, store_u8, residuals, g_out):
+    dx, dkey = _drop_custom_bwd(keep_prob, upscale, store_u8,
+                                residuals, (g_out, None))
+    return dx, dkey
+
+
+_drop_custom_nomask.defvjp(_drop_nomask_fwd, _drop_nomask_bwd)
+
+
+def apply_probs_dropout(x, keep_prob, key):
+    """Upscale-in-train dropout on a probability tensor, honoring
+    FLAGS_dropout_storage — the ONE dispatch site shared by the dropout
+    op and the composed-attention path (so strategy behavior cannot
+    drift between them)."""
+    from ..flags import get_flag
+    strategy = get_flag("FLAGS_dropout_storage", "xla")
+    if strategy in ("u8", "seed") and jnp.issubdtype(x.dtype,
+                                                    jnp.floating):
+        return _drop_custom_nomask(keep_prob, True, strategy == "u8",
+                                   x, key)
+    keep = _keep_mask(key, keep_prob, x.shape)
+    return jnp.where(keep, x / max(keep_prob, 1e-12), 0.0)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
 def _gather_rows_onehot(vocab, w, ids):
     return jnp.take(w, ids, axis=0)

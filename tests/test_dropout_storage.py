@@ -151,3 +151,40 @@ def test_trainstep_compiles_once():
     # Adam accumulators exist and update from step 1 (not zeros-only)
     m = step._opt_state[step.param_names[0]]
     assert any(np.abs(np.asarray(v)).sum() > 0 for v in m.values())
+
+
+def test_composed_attention_honors_storage_strategy():
+    """The composed attention path's probs-dropout must produce the
+    SAME output under every storage strategy for the same seed (the
+    [B,H,S,S] keep decision is its biggest backward residual)."""
+    from paddle_tpu import nn
+    from paddle_tpu.nn import transformer as tr
+
+    outs = {}
+    for strategy in ("xla", "u8", "seed"):
+        prior = pt.get_flags(["FLAGS_dropout_storage"])
+        pt.set_flags({"FLAGS_dropout_storage": strategy})
+        try:
+            pt.seed(13)
+            mha = nn.MultiHeadAttention(32, 4, dropout=0.3)
+            x = pt.to_tensor(np.random.RandomState(0)
+                             .randn(2, 6, 32).astype(np.float32))
+            tr.reset_attention_path_log()
+            y = mha(x, x, x)
+            assert tr.attention_paths_taken() == ["composed"]
+            loss = pt.tensor.mean(y)
+            loss.backward()
+            g = np.asarray(mha.q_proj.weight.grad)
+            assert np.isfinite(g).all()
+            outs[strategy] = np.asarray(y.value)
+        finally:
+            pt.set_flags(prior)
+    np.testing.assert_array_equal(outs["xla"], outs["u8"])
+    np.testing.assert_array_equal(outs["xla"], outs["seed"])
+    # dropout actually engaged (same model/seed without dropout differs)
+    pt.seed(13)
+    mha2 = nn.MultiHeadAttention(32, 4, dropout=0.0)
+    x = pt.to_tensor(np.random.RandomState(0)
+                     .randn(2, 6, 32).astype(np.float32))
+    y2 = np.asarray(mha2(x, x, x).value)
+    assert not np.allclose(outs["xla"], y2)
