@@ -1,3 +1,67 @@
 from .dataset import (DatasetFactory, InMemoryDataset,  # noqa: F401
                       QueueDataset, MultiSlotDesc, DataFeedDesc)
 from .native import parse_multislot, using_native  # noqa: F401
+
+# ---------------------------------------------------------------------------
+# round-5: the reference's `paddle.dataset` is the READER package
+# (python/paddle/dataset/ — mnist.train() etc.), while this package is
+# the Dataset PIPELINE (fluid.dataset DatasetFactory). Expose the
+# reader modules here too so both reference import styles work:
+#   import paddle.dataset         -> paddle_tpu.dataset.mnist.train()
+#   fluid.DatasetFactory()        -> unchanged
+# ---------------------------------------------------------------------------
+from ..datasets import (cifar, conll05, flowers, imdb,  # noqa: F401
+                        imikolov, mnist, movielens, mq2007, sentiment,
+                        uci_housing, voc2012, wmt14, wmt16)
+from .. import dataset_image as image  # noqa: F401
+
+
+import sys as _sys
+import types as _types
+
+
+class _CommonModule(_types.ModuleType):
+    """paddle.dataset.common surface (download/md5 helpers). DATA_HOME
+    delegates to paddle_tpu.datasets.DATA_HOME — ONE source of truth,
+    so reassigning it (the reference's documented cache-root knob)
+    actually moves every reader's probe path. This container is
+    zero-egress: download() serves cached files (md5-verified when a
+    checksum is given) and otherwise raises with the path to mount."""
+
+    @property
+    def DATA_HOME(self):
+        from .. import datasets
+        return datasets.DATA_HOME
+
+    @DATA_HOME.setter
+    def DATA_HOME(self, value):
+        from .. import datasets
+        datasets.DATA_HOME = value
+
+    @staticmethod
+    def md5file(fname):
+        import hashlib
+        h = hashlib.md5()
+        with open(fname, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+
+    def download(self, url, module_name, md5sum, save_name=None):
+        import os
+        path = os.path.join(self.DATA_HOME, module_name,
+                            save_name or url.split("/")[-1])
+        if os.path.exists(path):
+            if md5sum and self.md5file(path) != md5sum:
+                raise RuntimeError(
+                    "cached file %s fails its md5 check (%s expected) — "
+                    "re-mount a good copy; zero-egress container cannot "
+                    "re-download" % (path, md5sum))
+            return path
+        raise RuntimeError(
+            "zero-egress container: cannot download %r; mount the file "
+            "at %s" % (url, path))
+
+
+common = _CommonModule(__name__ + ".common")
+_sys.modules[common.__name__] = common

@@ -1,0 +1,91 @@
+"""paddle.dataset.image — numpy image utilities (reference
+python/paddle/dataset/image.py: the cv2-backed helpers the book data
+pipelines use). Implemented over numpy + the vision_transforms
+resampling core; no cv2 dependency."""
+from __future__ import annotations
+
+import numpy as np
+
+from .vision_transforms import _resize_bilinear_np
+
+__all__ = ["load_image", "resize_short", "center_crop", "random_crop",
+           "left_right_flip", "to_chw", "simple_transform",
+           "load_and_transform"]
+
+
+def load_image(file_path, is_color=True):
+    """Decode an image file to HWC uint8. PNG/BMP decode via the
+    stdlib-adjacent paths; for the synthetic pipelines a .npy file is
+    accepted directly (the zero-egress corpus format)."""
+    if str(file_path).endswith(".npy"):
+        img = np.load(file_path)
+    else:
+        try:
+            from PIL import Image  # pillow if present
+            img = np.asarray(Image.open(file_path))
+        except ImportError as e:
+            raise RuntimeError(
+                "load_image needs pillow for %r (or use .npy inputs)"
+                % (file_path,)) from e
+    if not is_color:
+        # reference parity: grayscale is a 2-D uint8 array
+        if img.ndim == 3:
+            img = img.mean(axis=2)
+        return img.round().astype(np.uint8) \
+            if img.dtype != np.uint8 else img
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def resize_short(im, size):
+    """Resize so the SHORT side equals `size`, keeping aspect (HWC)."""
+    h, w = im.shape[:2]
+    scale = size / float(min(h, w))
+    out_h, out_w = int(round(h * scale)), int(round(w * scale))
+    return _resize_bilinear_np(im.astype(np.float32), out_h, out_w)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = max((h - size) // 2, 0)
+    w0 = max((w - size) // 2, 0)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = np.random.randint(0, max(h - size, 0) + 1)
+    w0 = np.random.randint(0, max(w - size, 0) + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return im.transpose(order)
+
+
+def simple_transform(im, resize_size, crop_size, is_train,
+                     is_color=True, mean=None):
+    """The reference's one-stop train/eval transform: resize short side,
+    crop (random+flip in train, center in eval), CHW, mean-subtract."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size)
+        if np.random.randint(2):
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    im = to_chw(np.asarray(im, np.float32))
+    if mean is not None:
+        im -= np.asarray(mean, np.float32).reshape(-1, 1, 1)
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

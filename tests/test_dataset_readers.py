@@ -1,0 +1,130 @@
+"""paddle.dataset reader-package parity (reference python/paddle/
+dataset/): every module serves schema-identical samples (synthetic
+when no cache is mounted), and the reader surface coexists with the
+fluid Dataset pipeline under the same paddle_tpu.dataset package."""
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def _first(reader):
+    return next(iter(reader()))
+
+
+def test_reader_modules_exist_and_serve():
+    d = pt.dataset
+    for name in ("mnist", "cifar", "imdb", "uci_housing", "conll05",
+                 "imikolov", "movielens", "sentiment", "wmt14", "wmt16",
+                 "flowers", "voc2012", "mq2007"):
+        mod = getattr(d, name)
+        s = _first(mod.train())
+        assert s is not None, name
+    # the pipeline factory still lives here too
+    assert d.DatasetFactory is not None
+
+
+def test_schemas():
+    s = _first(pt.dataset.conll05.test())
+    assert len(s) == 9  # word + 5 ctx + pred + mark + label
+    assert len(s[0]) == len(s[8])
+    u, g, a, j, m, cats, title, rating = _first(
+        pt.dataset.movielens.train())
+    assert 0 <= u < 6040 and g in (0, 1) and 1.0 <= rating <= 5.0
+    src, trg, trg_next = _first(pt.dataset.wmt14.train())
+    assert len(trg) == len(trg_next)
+    img, lbl = _first(pt.dataset.flowers.train())
+    assert img.shape[0] == 3 and 0 <= lbl < 102
+    img, seg = _first(pt.dataset.voc2012.train())
+    assert img.shape[1:] == seg.shape
+    label, qid, feats = _first(pt.dataset.mq2007.train())
+    assert feats.shape == (46,)
+    words, pol = _first(pt.dataset.sentiment.train())
+    assert pol in (0, 1) and len(words) >= 5
+    assert len(_first(pt.dataset.imikolov.train())) == 5
+
+
+def test_dict_helpers():
+    w, v, l = pt.dataset.conll05.get_dict()
+    assert len(l) == 67
+    assert len(pt.dataset.imikolov.build_dict()) == 2000
+    assert pt.dataset.movielens.max_user_id() == 6040
+    assert len(pt.dataset.sentiment.get_word_dict()) == 5000
+
+
+def test_image_utils():
+    im = (np.random.RandomState(0).rand(40, 60, 3) * 255)
+    short = pt.dataset.image.resize_short(im, 32)
+    assert min(short.shape[:2]) == 32
+    cc = pt.dataset.image.center_crop(short, 28)
+    assert cc.shape[:2] == (28, 28)
+    chw = pt.dataset.image.to_chw(cc)
+    assert chw.shape[0] == 3
+    tr = pt.dataset.image.simple_transform(im, 36, 32, is_train=True,
+                                           mean=[1.0, 2.0, 3.0])
+    assert tr.shape == (3, 32, 32)
+    ev = pt.dataset.image.simple_transform(im, 36, 32, is_train=False)
+    assert ev.shape == (3, 32, 32)
+    assert np.array_equal(pt.dataset.image.left_right_flip(cc),
+                          cc[:, ::-1])
+
+
+def test_common_zero_egress():
+    import pytest
+    with pytest.raises(RuntimeError):
+        pt.dataset.common.download("http://example.com/x.tgz", "x",
+                                   "0" * 32)
+
+
+def test_determinism():
+    a = list(pt.dataset.sentiment.test()())
+    b = list(pt.dataset.sentiment.test()())
+    assert len(a) == len(b) == 256
+    assert a[0][1] == b[0][1] and a[0][0] == b[0][0]
+
+
+def test_data_home_single_source_of_truth():
+    """Reassigning common.DATA_HOME must move every reader's probe path
+    (the reference's documented cache-root knob)."""
+    import tempfile
+
+    from paddle_tpu import datasets
+    old = pt.dataset.common.DATA_HOME
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            pt.dataset.common.DATA_HOME = d
+            assert datasets.DATA_HOME == d
+            assert datasets._cache_path("x") .startswith(d)
+            # md5-verified download of a cached file
+            import os
+            os.makedirs(os.path.join(d, "m"), exist_ok=True)
+            fp = os.path.join(d, "m", "f.bin")
+            open(fp, "wb").write(b"hello")
+            good = pt.dataset.common.md5file(fp)
+            assert pt.dataset.common.download("http://x/f.bin", "m",
+                                              good) == fp
+            import pytest
+            with pytest.raises(RuntimeError):
+                pt.dataset.common.download("http://x/f.bin", "m",
+                                           "0" * 32)
+    finally:
+        pt.dataset.common.DATA_HOME = old
+
+
+def test_wmt14_dict_tuple_contract():
+    src, trg = pt.dataset.wmt14.get_dict(1000)
+    assert len(src) == len(trg) == 1000
+    one = pt.dataset.wmt16.get_dict("en", 500)
+    assert len(one) == 500
+
+
+def test_grayscale_image_parity():
+    im = (np.random.RandomState(1).rand(10, 12, 3) * 255).astype(
+        np.uint8)
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "img.npy")
+        np.save(p, im)
+        g = pt.dataset.image.load_image(p, is_color=False)
+        assert g.ndim == 2 and g.dtype == np.uint8
+        c = pt.dataset.image.load_image(p, is_color=True)
+        assert c.shape == (10, 12, 3)
