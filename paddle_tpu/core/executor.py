@@ -26,13 +26,15 @@ the reference's per-op GradOpMaker machinery
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.export
 import jax.numpy as jnp
 import numpy as np
 
-from . import dtypes
+from . import dtypes, program_cache
 from .program import (Block, OpDesc, Program, VarDesc, default_main_program)
 from .registry import REGISTRY, LowerCtx
 from .scope import Scope, global_scope
@@ -234,6 +236,45 @@ def _as_host(v):
     return np.asarray(v)
 
 
+def _sds(v) -> jax.ShapeDtypeStruct:
+    if not (hasattr(v, "shape") and hasattr(v, "dtype")):
+        v = np.asarray(v)
+    try:
+        # jit canonicalizes feeds (int64->int32 under disabled x64), so
+        # exported in_avals hold the canonical dtype; compare apples to
+        # apples or every int64-fed program re-exports on warm start
+        dt = jax.dtypes.canonicalize_dtype(v.dtype)
+    except TypeError:  # extended dtypes (typed PRNG keys) pass through
+        dt = v.dtype
+    return jax.ShapeDtypeStruct(tuple(v.shape), dt)
+
+
+def _single_device(v) -> bool:
+    """Exported modules are single-logical-device; a value already
+    sharded across a mesh must take the plain jit path."""
+    s = getattr(v, "sharding", None)
+    if s is None:
+        return True
+    try:
+        return len(s.device_set) <= 1
+    except Exception:
+        return False
+
+
+def _avals_match(exported, example_args) -> bool:
+    """A disk entry is only used when its recorded input avals agree
+    exactly with what this process would pass — the last line of
+    defense (after the fingerprint) against serving a stale or
+    colliding entry with wrong shapes."""
+    ours = [_sds(x) for x in jax.tree.leaves(example_args)]
+    theirs = list(exported.in_avals)
+    if len(ours) != len(theirs):
+        return False
+    return all(tuple(a.shape) == tuple(b.shape)
+               and np.dtype(a.dtype) == np.dtype(b.dtype)
+               for a, b in zip(ours, theirs))
+
+
 class Executor:
     """Runs Programs. API mirrors fluid.Executor
     (/root/reference/python/paddle/fluid/executor.py:474): run(program, feed,
@@ -243,12 +284,39 @@ class Executor:
     by jax/XLA (and by CompiledProgram shardings for multi-chip).
     """
 
-    def __init__(self, place=None):
+    def __init__(self, place=None, program_cache_dir: Optional[str] = None):
         self.place = place
-        self._cache: Dict[tuple, Any] = {}
+        # in-memory compiled-step cache: LRU bounded by
+        # FLAGS_executor_cache_capacity; whole entries are evicted so
+        # no partially-dropped donated-buffer bookkeeping survives
+        self._cache: "OrderedDict[tuple, Any]" = OrderedDict()
+        # per-Executor disk-cache override: None follows
+        # FLAGS_program_cache_dir, "" disables for this Executor only
+        self._program_cache_dir = program_cache_dir
+        # fingerprints whose lowering cannot round-trip jax.export
+        # (host callbacks etc.) — remembered so the failed export's
+        # extra trace is paid once, not per run
+        self._unexportable: set = set()
         self._seed_counter = 0
         self._warned_uneven: set = set()
         self._unused_checked: set = set()
+
+    def _cache_get(self, key):
+        entry = self._cache.get(key)
+        if entry is not None:
+            self._cache.move_to_end(key)
+        return entry
+
+    def _cache_put(self, key, entry) -> None:
+        from ..flags import get_flag
+        self._cache[key] = entry
+        self._cache.move_to_end(key)
+        cap = int(get_flag("FLAGS_executor_cache_capacity", 64) or 0)
+        if cap > 0:
+            while len(self._cache) > cap:
+                self._cache.popitem(last=False)
+                from ..monitor import stat_add
+                stat_add("STAT_executor_cache_evict")
 
     # ------------------------------------------------------------------
     def run(self, program: Optional[Program] = None,
@@ -310,18 +378,6 @@ class Executor:
         # with no feeds/fetches execute eagerly into the scope.
         block = program.global_block
         state_names = self._state_names(program, scope)
-        key = (id(program), program._version, _feed_sig(feed),
-               tuple(fetch_names), tuple(state_names))
-        entry = self._cache.get(key) if use_program_cache else None
-        if entry is None:
-            from ..monitor import stat_add
-            stat_add("STAT_executor_compile")
-            entry = self._compile(program, block, sorted(feed), fetch_names,
-                                  state_names)
-            if use_program_cache:
-                self._cache[key] = entry
-        fn = entry
-
         state = {n: scope.find_var(n) for n in state_names}
         rng = scope.find_var(RNG_VAR)
         if rng is None:
@@ -331,7 +387,24 @@ class Executor:
                 seed = self._seed_counter
             rng = jax.random.PRNGKey(seed)
 
-        from ..flags import get_flag
+        # lowering-relevant flags are part of the compiled artifact: the
+        # key snapshots them so flipping e.g. FLAGS_dropout_storage
+        # mid-process recompiles instead of returning a stale executable
+        from ..flags import get_flag, lowering_snapshot
+        key = (id(program), program._version, _feed_sig(feed),
+               tuple(fetch_names), tuple(state_names), lowering_snapshot())
+        entry = self._cache_get(key) if use_program_cache else None
+        if entry is None:
+            from ..monitor import stat_add
+            stat_add("STAT_executor_compile")
+            example = None
+            if use_program_cache and dp_mesh is None:
+                example = (state, feed, rng)
+            entry = self._compile(program, block, sorted(feed), fetch_names,
+                                  state_names, example=example)
+            if use_program_cache:
+                self._cache_put(key, entry)
+        fn = entry
         if get_flag("FLAGS_enable_unused_var_check"):
             self._warn_unused_vars(program, fetch_names)
 
@@ -402,7 +475,7 @@ class Executor:
 
     def _compile(self, program: Program, block: Block,
                  feed_names: List[str], fetch_names: List[str],
-                 state_names: List[str]):
+                 state_names: List[str], example=None):
         persistable = {v.name for v in program.persistable_vars()}
         has_host = any(REGISTRY.has(op.type) and REGISTRY.get(op.type).host
                        for op in block.ops)
@@ -431,8 +504,64 @@ class Executor:
                 new_state.setdefault(n, state[n])
             return fetches, new_state, ctx.key_out
 
+        aot = self._aot_entry(program, step, example, fetch_names)
+        if aot is not None:
+            return aot
         jitted = jax.jit(step, donate_argnums=(0,))
         return jitted
+
+    # ------------------------------------------------------------------
+    def _aot_entry(self, program: Program, step, example,
+                   fetch_names: Sequence[str]):
+        """Disk-backed AOT path (core/program_cache.py): serve the step
+        from a StableHLO trace-cache entry, exporting and storing one on
+        miss. Both hit and miss execute the DESERIALIZED module (the
+        miss round-trips its own bytes) so the XLA persistent-cache key
+        is identical across processes and the warm process skips the
+        binary compile as well. Returns None whenever this program/run
+        cannot be disk-cached — caller falls back to plain jit."""
+        if example is None:
+            return None
+        cache_dir = program_cache.resolve_dir(self._program_cache_dir)
+        if cache_dir is None:
+            return None
+        state, feed, rng = example
+        if not all(_single_device(v) for v in
+                   jax.tree.leaves((state, feed, rng))):
+            return None
+        feed_sig = _feed_sig(feed)
+        state_sig = tuple((n, tuple(np.shape(v)), str(_sds(v).dtype))
+                          for n, v in state.items())
+        fp = program.fingerprint(feed_sig, tuple(fetch_names), state_sig)
+        if fp is None or fp in self._unexportable:
+            return None
+        program_cache.ensure_xla_cache(cache_dir)
+        avals = jax.tree.map(_sds, (state, dict(feed), rng))
+        exported = None
+        payload = program_cache.load_trace(cache_dir, fp)
+        if payload is not None:
+            try:
+                cand = jax.export.deserialize(payload)
+                if _avals_match(cand, avals):
+                    exported = cand
+                else:
+                    raise ValueError("aval mismatch")
+            except Exception:
+                from ..monitor import stat_add
+                stat_add("STAT_program_cache_corrupt")
+                program_cache.discard_trace(cache_dir, fp)
+                exported = None
+        if exported is None:
+            try:
+                data = jax.export.export(jax.jit(step))(*avals).serialize()
+                exported = jax.export.deserialize(data)
+            except Exception:
+                self._unexportable.add(fp)
+                from ..monitor import stat_add
+                stat_add("STAT_program_cache_unexportable")
+                return None
+            program_cache.store_trace(cache_dir, fp, data)
+        return jax.jit(exported.call, donate_argnums=(0,))
 
     def _compile_segmented(self, program: Program, block: Block,
                            feed_names: List[str], fetch_names: List[str],

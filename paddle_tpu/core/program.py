@@ -133,7 +133,7 @@ def _jsonable_attrs(attrs: dict) -> dict:
     for k, v in attrs.items():
         if isinstance(v, (list, tuple)):
             v = [x.item() if hasattr(x, "item") else x for x in v]
-        elif hasattr(v, "item"):
+        elif hasattr(v, "item") and getattr(v, "size", 1) == 1:
             v = v.item()
         out[k] = v
     return out
@@ -327,6 +327,52 @@ class Program:
     @staticmethod
     def from_json(s: str) -> "Program":
         return Program.from_dict(json.loads(s))
+
+    def fingerprint(self, feed_sig: Sequence = (),
+                    fetch_names: Sequence[str] = (),
+                    state_sig: Sequence = (),
+                    extra: Sequence = ()) -> Optional[str]:
+        """Stable cross-process identity of the COMPILED computation:
+        canonical JSON of every op desc/attr and var desc, the feed and
+        state shapes+dtypes, the fetch list, every lowering-relevant
+        FLAGS_* value (flags.lowering_snapshot), the jax/jaxlib/backend
+        versions, and a framework source token (op-lowering code is
+        part of the computation — see program_cache.framework_token).
+        Keys the disk AOT cache (core/program_cache.py). Returns None
+        when the program holds an attr that cannot be canonicalized —
+        such programs are simply not disk-cached.
+        """
+        import hashlib
+
+        def _default(o):
+            # ndarray-valued attrs hash by content; truncated reprs
+            # (numpy elides large arrays) must never collide entries
+            if hasattr(o, "tobytes") and hasattr(o, "dtype"):
+                return {"__nd__": [str(o.dtype), list(getattr(o, "shape", ())),
+                                   hashlib.sha256(o.tobytes()).hexdigest()]}
+            if isinstance(o, bytes):
+                return {"__b__": hashlib.sha256(o).hexdigest()}
+            raise TypeError(type(o).__name__)
+
+        try:
+            body = json.dumps(self.to_dict(), sort_keys=True,
+                              default=_default)
+        except (TypeError, ValueError):
+            return None
+        from ..flags import lowering_snapshot
+        from . import program_cache
+        import jax
+        import jaxlib
+        h = hashlib.sha256()
+        for part in (
+                "ptaot%d" % program_cache.FORMAT_VERSION, body,
+                repr(tuple(sorted(feed_sig))), repr(tuple(fetch_names)),
+                repr(tuple(sorted(state_sig))), repr(lowering_snapshot()),
+                jax.__version__, jaxlib.__version__, jax.default_backend(),
+                program_cache.framework_token(), repr(tuple(extra))):
+            h.update(part.encode() if isinstance(part, str) else part)
+            h.update(b"\x00")
+        return h.hexdigest()
 
     def clone(self, for_test: bool = False) -> "Program":
         """Deep-copy; with for_test=True keep only the FORWARD section

@@ -1,0 +1,214 @@
+"""Persistent AOT program cache: disk-backed trace + compile reuse.
+
+Kills the retrace+recompile cold start the reference pays per process
+(PERF_NOTES: ~3.3 s trace + ~21 s XLA compile for the 12-layer
+BERT-shaped train step, again in EVERY interpreter). Two disk layers
+share one directory (FLAGS_program_cache_dir, default
+~/.cache/paddle_tpu/aot, env override PADDLE_TPU_PROGRAM_CACHE_DIR):
+
+  <dir>/trace/<fingerprint>.stablehlo
+      jax.export bytes of the fully-lowered Executor step, keyed by
+      Program.fingerprint() (op descs/attrs + feed/state signatures +
+      lowering-relevant FLAGS + jax/backend versions + a framework
+      source token). A hit skips the Python retrace entirely.
+  <dir>/xla/
+      jax's persistent compilation cache — XLA binaries keyed by HLO.
+      Both the cold and the warm path execute the SAME deserialized
+      StableHLO module (the cold path round-trips its own bytes), so
+      the warm process's XLA key matches and compilation is skipped
+      too: warm start pays neither trace nor compile.
+
+Every entry is written via temp-file + atomic os.replace so concurrent
+processes can share one directory; a truncated/corrupt/version-skewed
+entry is deleted and falls back to a clean recompile (never a crash,
+never wrong fetches — the caller re-exports and overwrites). Counters
+land in monitor.py: STAT_program_cache_trace_hit / _trace_miss /
+_corrupt / _unexportable / _bytes_read / _bytes_written.
+
+The role model is the reference's serialized-engine flow
+(analysis_predictor.cc SaveOptimModel:900 + TRT engine cache), promoted
+from a one-off inference artifact into the framework-wide execution
+path for both Executor.run and the inference Predictor.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Optional
+
+MAGIC = b"PTAOT1\n"
+FORMAT_VERSION = 1
+
+# set once per process by ensure_xla_cache(); remembered so we re-point
+# only a dir WE configured (a user's own jax_compilation_cache_dir
+# setting is never overridden)
+_xla_cache_dir_set: Optional[str] = None
+_framework_token: Optional[str] = None
+
+
+def _stat_add(name: str, value: float = 1.0) -> None:
+    from ..monitor import stat_add
+    stat_add(name, value)
+
+
+def default_dir() -> str:
+    """The auto cache location: env override, else the home cache."""
+    env = os.environ.get("PADDLE_TPU_PROGRAM_CACHE_DIR")
+    if env is not None:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "paddle_tpu", "aot")
+
+
+def resolve_dir(override: Optional[str] = None) -> Optional[str]:
+    """Effective cache dir or None when disabled. Precedence:
+    per-Executor override > FLAGS_program_cache_dir > env > home
+    default; "" at any level disables."""
+    d = override
+    if d is None:
+        from ..flags import get_flag
+        d = get_flag("FLAGS_program_cache_dir")
+    if d is None:
+        d = default_dir()
+    return d or None
+
+
+def framework_token() -> str:
+    """Hash over the paddle_tpu source tree's (path, mtime, size) — the
+    op-lowering code IS part of the traced computation, so a source
+    change must invalidate disk entries (same pyc-style heuristic as
+    CPython's import system). Memoized per process."""
+    global _framework_token
+    if _framework_token is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        h = hashlib.sha256()
+        for dirpath, dirnames, filenames in sorted(os.walk(root)):
+            dirnames.sort()
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                p = os.path.join(dirpath, fn)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                h.update(("%s:%d:%d;" % (os.path.relpath(p, root),
+                                         st.st_mtime_ns,
+                                         st.st_size)).encode())
+        _framework_token = h.hexdigest()
+    return _framework_token
+
+
+def ensure_xla_cache(cache_dir: str) -> None:
+    """Point jax's persistent compilation cache at <cache_dir>/xla with
+    a zero min-compile-time threshold (small CPU test programs must
+    cache too). Never overrides a dir the user configured themselves."""
+    global _xla_cache_dir_set
+    try:
+        import jax
+        current = jax.config.jax_compilation_cache_dir
+        if current and current != _xla_cache_dir_set:
+            return  # user-configured; leave it alone
+        xla_dir = os.path.join(cache_dir, "xla")
+        if current == xla_dir:
+            return
+        os.makedirs(xla_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", xla_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        _xla_cache_dir_set = xla_dir
+        # jax latches its cache state at the process's FIRST compile
+        # (_initialize_cache runs "at most once"), and the Executor has
+        # usually jitted something (PRNG fold-in, state prep) before we
+        # get here — un-latch so the next compile picks up the new dir
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:  # config knob skew across jax versions: cache is
+        pass           # an optimization, never a hard dependency
+
+
+def _trace_path(cache_dir: str, fingerprint: str) -> str:
+    return os.path.join(cache_dir, "trace", fingerprint + ".stablehlo")
+
+
+def _header_bytes(fingerprint: str) -> bytes:
+    import jax
+    import jaxlib
+    return json.dumps({
+        "format": FORMAT_VERSION,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "fingerprint": fingerprint,
+    }, sort_keys=True).encode() + b"\n"
+
+
+def load_trace(cache_dir: str, fingerprint: str) -> Optional[bytes]:
+    """Return the serialized jax.export payload for `fingerprint`, or
+    None on miss. Any malformed/truncated/version-skewed entry is
+    deleted (counted STAT_program_cache_corrupt) so the caller's fresh
+    export overwrites it."""
+    path = _trace_path(cache_dir, fingerprint)
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        _stat_add("STAT_program_cache_trace_miss")
+        return None
+    try:
+        if not blob.startswith(MAGIC):
+            raise ValueError("bad magic")
+        rest = blob[len(MAGIC):]
+        nl = rest.index(b"\n")
+        hdr = json.loads(rest[:nl])
+        payload = rest[nl + 1:]
+        import jax
+        import jaxlib
+        if (hdr.get("format") != FORMAT_VERSION
+                or hdr.get("jax") != jax.__version__
+                or hdr.get("jaxlib") != jaxlib.__version__
+                or hdr.get("fingerprint") != fingerprint
+                or not payload):
+            raise ValueError("header mismatch")
+    except (ValueError, KeyError):
+        _stat_add("STAT_program_cache_corrupt")
+        _stat_add("STAT_program_cache_trace_miss")
+        discard_trace(cache_dir, fingerprint)
+        return None
+    _stat_add("STAT_program_cache_trace_hit")
+    _stat_add("STAT_program_cache_bytes_read", len(blob))
+    return payload
+
+
+def store_trace(cache_dir: str, fingerprint: str, payload: bytes) -> bool:
+    """Atomically publish an entry (temp file + os.replace) so a
+    concurrent reader sees either nothing or a complete file. IO
+    failure disables nothing — it just means no cache this time."""
+    path = _trace_path(cache_dir, fingerprint)
+    blob = MAGIC + _header_bytes(fingerprint) + payload
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   prefix=".tmp_" + fingerprint[:16])
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except OSError:
+        return False
+    _stat_add("STAT_program_cache_bytes_written", len(blob))
+    return True
+
+
+def discard_trace(cache_dir: str, fingerprint: str) -> None:
+    try:
+        os.unlink(_trace_path(cache_dir, fingerprint))
+    except OSError:
+        pass

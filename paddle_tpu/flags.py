@@ -59,9 +59,35 @@ _DEFS: Dict[str, Any] = {
     "FLAGS_fuse_parameter_memory_size": -1,
     "FLAGS_fuse_parameter_groups_size": 3,
     "FLAGS_sync_nccl_allreduce": True,
+    # persistent AOT program cache (core/program_cache.py). None = auto:
+    # $PADDLE_TPU_PROGRAM_CACHE_DIR if set, else ~/.cache/paddle_tpu/aot;
+    # "" disables the disk cache entirely.
+    "FLAGS_program_cache_dir": None,
+    # in-memory Executor cache bound (entries, LRU eviction)
+    "FLAGS_executor_cache_capacity": 64,
 }
 
 _values: Dict[str, Any] = dict(_DEFS)
+
+# Flags read DURING op lowering: their value is baked into the traced
+# computation, so every compilation cache key (the Executor's in-memory
+# dict and the disk fingerprint) must snapshot them — flipping one
+# mid-process must be a cache MISS, never a stale executable
+# (ISSUE 1 satellite: FLAGS_embedding_onehot_grad / FLAGS_dropout_storage
+# could previously return a pre-flip executable).
+_LOWERING_FLAGS = [
+    "FLAGS_check_nan_inf",
+    "FLAGS_dropout_storage",
+    "FLAGS_embedding_onehot_grad",
+    "FLAGS_flash_attention_fallback",
+    "FLAGS_flash_inkernel_dropout",
+]
+
+
+def lowering_snapshot() -> tuple:
+    """Sorted (name, value) tuple of every lowering-relevant flag —
+    hashable, for use inside compilation cache keys."""
+    return tuple((k, _values.get(k)) for k in sorted(_LOWERING_FLAGS))
 
 
 def _canon(name: str) -> str:
@@ -94,5 +120,7 @@ def get_flag(name: str, default: Any = None) -> Any:
     return _values.get(_canon(name), default)
 
 
-def register_flag(name: str, default: Any) -> None:
+def register_flag(name: str, default: Any, lowering: bool = False) -> None:
     _values.setdefault(_canon(name), default)
+    if lowering and _canon(name) not in _LOWERING_FLAGS:
+        _LOWERING_FLAGS.append(_canon(name))

@@ -80,6 +80,21 @@ class Config:
         self._ir_optim = True
         self._bf16 = False
         self._pass_builder: Optional[PassStrategy] = None
+        # persistent AOT program cache (core/program_cache.py): None
+        # follows FLAGS_program_cache_dir, a path pins it for this
+        # predictor, "" opts this predictor out
+        self._program_cache_dir: Optional[str] = None
+
+    def enable_program_cache(self, cache_dir: Optional[str] = None):
+        """Serve this predictor's traced+compiled program from the
+        persistent AOT cache (docs/program_cache.md) — the analog of
+        the reference's serialized-engine warm start. Default dir:
+        FLAGS_program_cache_dir resolution."""
+        from .core import program_cache
+        self._program_cache_dir = cache_dir or program_cache.default_dir()
+
+    def disable_program_cache(self):
+        self._program_cache_dir = ""
 
     # parity knobs (no-ops or simple flags)
     def disable_gpu(self):
@@ -132,7 +147,8 @@ class Predictor:
     def __init__(self, config: Config, scope: Optional[Scope] = None):
         self.config = config
         self.scope = scope or Scope()
-        self.exe = Executor()
+        self.exe = Executor(
+            program_cache_dir=getattr(config, "_program_cache_dir", None))
         if config.model_dir is None:
             raise ValueError("Config.model_dir is required")
         self.program, self.feed_names, self.fetch_names = \

@@ -9,6 +9,12 @@ dispatches, bytes fed); thread-safe, process-global.
     from paddle_tpu.monitor import stat_add, get_float_stats
     stat_add("STAT_executor_compile", 1)
     get_float_stats()  # {"STAT_executor_compile": 1.0, ...}
+
+Well-known counters include STAT_executor_compile (in-memory cache
+miss -> trace), STAT_executor_cache_evict (LRU bound hit), and the
+persistent AOT program cache set (core/program_cache.py):
+STAT_program_cache_trace_hit / _trace_miss / _corrupt / _unexportable
+and _bytes_read / _bytes_written.
 """
 from __future__ import annotations
 

@@ -22,6 +22,19 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Hermetic tests: the persistent AOT program cache (default
+# ~/.cache/paddle_tpu/aot) must not leak state between CI runs or
+# pollute the user's home; "" disables it. Cache tests opt back in with
+# explicit tmp dirs via FLAGS_program_cache_dir / Executor kwarg, which
+# both take precedence over this env default.
+os.environ.setdefault("PADDLE_TPU_PROGRAM_CACHE_DIR", "")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: heavyweight perf/compile tests excluded from "
+        "the tier-1 `-m 'not slow'` run")
+
 
 def pytest_sessionstart(session):
     n = len(jax.devices())

@@ -86,16 +86,19 @@ def check_dense_chain(L=6, width=256, batch=32):
     return ok, dots
 
 
-def time_bert_shaped_compile():
-    """12-layer BERT-base-shaped static program: trace+compile wall."""
+def build_bert_shaped(layers_n=12, H=768, FF=3072, HEADS=12, S=128, B=8):
+    """L-layer BERT-shaped static train program (attention + FFN +
+    Adam). Shared by this tool, bench.py's `compile` block, and the
+    program-cache cold/warm tests — it IS the 12-layer program whose
+    ~3.3 s trace + ~21 s CPU compile the AOT cache exists to kill.
+    Returns (main, startup, loss, feed)."""
     import paddle_tpu as pt
     from paddle_tpu import layers
-    H, FF, HEADS, S, B = 768, 3072, 12, 128, 8  # S shrunk: CPU compile
     main, startup = pt.Program(), pt.Program()
     with pt.program_guard(main, startup):
         x = layers.data("x", [S, H])
         h = x
-        for _ in range(12):
+        for _ in range(layers_n):
             a = layers.multi_head_attention(h, HEADS)
             h = layers.reshape(  # layer_norm drops static shape metadata
                 layers.layer_norm(layers.elementwise_add(a, h)),
@@ -111,6 +114,15 @@ def time_bert_shaped_compile():
         loss = layers.mean(h)
         pt.optimizer.Adam(1e-4).minimize(loss, startup_program=startup,
                                          program=main)
+    feed = {"x": np.zeros((B, S, H), np.float32)}
+    return main, startup, loss, feed
+
+
+def time_bert_shaped_compile():
+    """12-layer BERT-base-shaped static program: trace+compile wall."""
+    import paddle_tpu as pt
+    main, startup, loss, _feed = build_bert_shaped()
+    S, H, B = 128, 768, 8
     exe = pt.Executor()
     exe.run(startup)
     feed = {"x": np.zeros((B, S, H), np.float32)}
