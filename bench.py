@@ -286,6 +286,105 @@ def bench_compile():
     }
 
 
+def bench_pipeline():
+    """sync-vs-pipelined `train_from_dataset` block (ISSUE 2, docs/
+    async_pipeline.md): one input-bound static train program run twice
+    through the SAME compiled executable — once with
+    FLAGS_executor_inflight_steps=1 (the old dispatch->sync->dispatch
+    loop) and once with the default bounded window (dispatch-ahead +
+    background feed staging + off-critical-path drains). Host work
+    (batch synthesis + device_put staging + fetch materialization) is
+    deliberately inside the timed loop: that is the per-step overhead
+    the pipeline overlaps with device execution. CPU numbers are real —
+    XLA:CPU executes on background threads, so the overlap exists
+    off-TPU too — and the fetch digests prove the fast loop computes
+    bitwise-identical results."""
+    import hashlib
+    import paddle_tpu as pt
+    from paddle_tpu.flags import get_flags
+
+    B, H, steps, io_s = 64, 640, 60, 0.005
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [H])
+        y = pt.layers.data("y", [1])
+        h1 = pt.layers.fc(x, H, act="relu")
+        h2 = pt.layers.fc(h1, H, act="relu")
+        pred = pt.layers.fc(h2, 1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.SGD(0.01).minimize(loss, startup_program=startup,
+                                        program=main)
+    main.random_seed = 7
+    startup.random_seed = 7
+
+    # the batch pool is synthesized ONCE, outside every timed region:
+    # the generator then models a latency-bound reader (disk/network
+    # wait per batch, cheap hand-off) — the common real input pipeline.
+    # The sync loop serializes that wait with the device step; the
+    # pipelined loop hides it behind in-flight compute (the prefetcher
+    # thread blocks on it while the device runs)
+    rng = np.random.RandomState(0)
+    pool = [{"x": rng.rand(B, H).astype(np.float32),
+             "y": rng.rand(B, 1).astype(np.float32)}
+            for _ in range(steps)]
+
+    def batches(n):
+        for i in range(n):
+            time.sleep(io_s)
+            yield pool[i % steps]
+
+    exe = pt.Executor()
+    saved = get_flags(["FLAGS_executor_inflight_steps"])
+    try:
+        # warmup/compile on a throwaway scope: the in-flight window is
+        # not a lowering flag, so both timed runs share this executable
+        wscope = pt.Scope()
+        with pt.scope_guard(wscope):
+            exe.run(startup)
+            exe.train_from_dataset(program=main, dataset=batches(2),
+                                   fetch_list=[loss])
+
+        def timed(window):
+            pt.set_flags({"FLAGS_executor_inflight_steps": window})
+            scope = pt.Scope()
+            with pt.scope_guard(scope):
+                exe.run(startup)
+                t0 = time.time()
+                res = exe.train_from_dataset(program=main,
+                                             dataset=batches(steps),
+                                             fetch_list=[loss])
+                dt = time.time() - t0  # includes the final drain
+            digest = hashlib.sha256(
+                b"".join(np.ascontiguousarray(o).tobytes()
+                         for r in res for o in r)).hexdigest()
+            return steps / dt, digest
+
+        window = max(2, int(saved.get("FLAGS_executor_inflight_steps", 2)
+                            or 2))
+        # best-of-3 per mode: the first run in a fresh process pays
+        # thread-pool/allocator warmup, and on small containers the
+        # scheduler jitters individual runs — best-of is the steady state
+        reps = [(timed(1), timed(window)) for _ in range(3)]
+        sync_sps, sync_digest = max((s for s, _ in reps),
+                                    key=lambda r: r[0])
+        pipe_sps, pipe_digest = max((p for _, p in reps),
+                                    key=lambda r: r[0])
+        digests = {d for pair in reps for (_, d) in pair}
+    finally:
+        pt.set_flags(saved)
+    return {
+        "workload": "fc3-H%d-B%d x%d steps (input-bound: %.1fms "
+                    "simulated read latency/batch, SGD)"
+                    % (H, B, steps, io_s * 1e3),
+        "window": window,
+        "sync_steps_per_sec": round(sync_sps, 1),
+        "pipelined_steps_per_sec": round(pipe_sps, 1),
+        "speedup": round(pipe_sps / sync_sps, 2),
+        "fetch_bitwise_identical": len(digests) == 1,
+    }
+
+
 def _run_worker(backend):
     """Run one full bench on the requested backend and print the JSON line.
 
@@ -336,6 +435,10 @@ def _run_worker(backend):
         # AOT program-cache cold/warm start (CPU compile times are real
         # numbers off-TPU too, unlike MFU — ISSUE 1)
         rec["compile"] = bench_compile()
+    if not os.environ.get("PT_SKIP_PIPELINE_BENCH"):
+        # async dispatch pipeline: sync vs dispatch-ahead dataset loop
+        # (host-overlap is real on CPU too — ISSUE 2)
+        rec["pipeline"] = bench_pipeline()
     if on_tpu:
         rec.update(detail)
         # persist the evidence: a later wedged-tunnel session (or the

@@ -26,7 +26,7 @@ def check_import_scipy(os_name=None):
 
 from . import core  # noqa: F401
 from . import ops  # noqa: F401  (registers the op library)
-from .core import (Executor, Program, append_backward,  # noqa: F401
+from .core import (Executor, FetchHandle, Program, append_backward,  # noqa: F401
                    default_main_program, default_startup_program,
                    device_guard, disable_static, enable_static,
                    global_scope, gradients, in_dygraph_mode, in_static_mode,

@@ -1,6 +1,7 @@
 from . import dtypes  # noqa: F401
 from .backward import append_backward, gradients  # noqa: F401
 from .executor import Executor  # noqa: F401
+from .fetch import FetchHandle  # noqa: F401
 from .program import (  # noqa: F401
     Block, OpDesc, Program, VarDesc, default_main_program,
     default_startup_program, device_guard, disable_static, enable_static,

@@ -230,10 +230,30 @@ def _feed_sig(feed: Dict[str, np.ndarray]) -> tuple:
                         for k, v in feed.items()))
 
 
-def _as_host(v):
+def _as_feed(v):
+    """Normalize one feed value. Host values become numpy; a value the
+    caller already staged with jax.device_put (the pipelined dataset
+    loop, reader.py's _DevicePrefetcher) stays ON DEVICE — np.asarray
+    here would block on a device→host copy and re-serialize the very
+    loop the async pipeline overlaps."""
     if isinstance(v, (np.ndarray, np.generic)):
         return v
+    if isinstance(v, jax.Array):
+        return v
     return np.asarray(v)
+
+
+def _donate_state() -> bool:
+    """Resolve FLAGS_executor_donate_state. Donation aliases each state
+    input to its output buffer (in-place updates), but XLA:CPU executes
+    donated computations SYNCHRONOUSLY — dispatch blocks until the step
+    finishes, re-serializing the async pipeline (docs/async_pipeline.md).
+    "auto" donates on every backend except cpu."""
+    from ..flags import get_flag
+    v = get_flag("FLAGS_executor_donate_state", "auto")
+    if isinstance(v, str) and v.lower() == "auto":
+        return jax.default_backend() != "cpu"
+    return bool(v)
 
 
 def _sds(v) -> jax.ShapeDtypeStruct:
@@ -323,8 +343,19 @@ class Executor:
             feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[Sequence] = None,
             scope: Optional[Scope] = None,
-            return_numpy: bool = True,
+            return_numpy=True,
             use_program_cache: bool = True):
+        """Run one step. `return_numpy` selects the fetch mode:
+
+        - True (default): block and return numpy arrays — the
+          reference's synchronous FetchOp contract.
+        - False: return the raw on-device jax arrays.
+        - "lazy": NON-BLOCKING — return FetchHandle objects that pay
+          the device→host transfer only when read (core/fetch.py). The
+          jitted step is dispatched and control returns immediately;
+          donation keeps state on-device between steps, so a caller
+          looping over run() gets a dispatch-ahead pipeline for free.
+        """
         # CompiledProgram.with_data_parallel (compiler.py): unwrap and
         # stage feeds sharded over the mesh dp axis — GSPMD partitions
         # the step and inserts the grad all-reduces (the ParallelExecutor
@@ -342,7 +373,7 @@ class Executor:
         fetch_names = [f.name if isinstance(f, VarDesc) else str(f)
                        for f in (fetch_list or [])]
 
-        feed = {k: _as_host(v) for k, v in feed.items()}
+        feed = {k: _as_feed(v) for k, v in feed.items()}
         if dp_mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             n = dp_mesh.shape["dp"]
@@ -409,28 +440,51 @@ class Executor:
             self._warn_unused_vars(program, fetch_names)
 
         fetches, new_state, new_rng = fn(state, feed, rng)
+        from ..monitor import stat_add
+        stat_add("STAT_executor_dispatch")
         for n, v in new_state.items():
             scope.set(n, v)
         scope.set(RNG_VAR, new_rng)
 
         if get_flag("FLAGS_fast_check_nan_inf") and \
                 not get_flag("check_nan_inf"):
-            # FLAGS_fast_check_nan_inf (operator.cc:1037): instead of the
-            # per-op traced scan, only the fetched values are checked —
-            # one cheap host-side pass after the step. The host copies
-            # replace `fetches` only under return_numpy, so the flag
-            # never changes the caller's on-device return type.
-            from .enforce import EnforceNotMet
-            host = [np.asarray(v) for v in fetches]
-            for name, arr in zip(fetch_names, host):
-                if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            # FLAGS_fast_check_nan_inf (operator.cc:1037): instead of
+            # the per-op traced scan, only the fetched values are
+            # checked. The reduction runs ON DEVICE (all fetches
+            # all-reduced into one bool), so the check costs ONE scalar
+            # transfer instead of host-copying every fetch — the
+            # per-fetch host pass it replaced forced a full sync even
+            # under return_numpy=False. The flag never changes the
+            # caller's return type; the per-fetch copies happen only on
+            # the failure path, to name the offending fetch.
+            finite = None
+            for v in fetches:
+                if hasattr(v, "dtype") and \
+                        jnp.issubdtype(v.dtype, jnp.floating):
+                    f = jnp.all(jnp.isfinite(v))
+                    finite = f if finite is None else \
+                        jnp.logical_and(finite, f)
+            if finite is not None:
+                stat_add("STAT_executor_sync")
+                if not bool(finite):
+                    from .enforce import EnforceNotMet
+                    for name, v in zip(fetch_names, fetches):
+                        arr = np.asarray(v)
+                        if arr.dtype.kind == "f" and \
+                                not np.isfinite(arr).all():
+                            raise EnforceNotMet(
+                                "fast_check_nan_inf: fetch %r contains "
+                                "nan/inf" % name)
                     raise EnforceNotMet(
-                        "fast_check_nan_inf: fetch %r contains "
-                        "nan/inf" % name)
-            if return_numpy:
-                return host
+                        "fast_check_nan_inf: a fetch contains nan/inf")
 
+        if return_numpy == "lazy":
+            # non-blocking: handles convert to numpy only when read
+            from .fetch import FetchHandle
+            return [FetchHandle(v) for v in fetches]
         if return_numpy:
+            if any(isinstance(v, jax.Array) for v in fetches):
+                stat_add("STAT_executor_sync")
             fetches = [np.asarray(v) for v in fetches]
         return fetches
 
@@ -507,7 +561,8 @@ class Executor:
         aot = self._aot_entry(program, step, example, fetch_names)
         if aot is not None:
             return aot
-        jitted = jax.jit(step, donate_argnums=(0,))
+        jitted = jax.jit(step,
+                         donate_argnums=(0,) if _donate_state() else ())
         return jitted
 
     # ------------------------------------------------------------------
@@ -561,7 +616,8 @@ class Executor:
                 stat_add("STAT_program_cache_unexportable")
                 return None
             program_cache.store_trace(cache_dir, fp, data)
-        return jax.jit(exported.call, donate_argnums=(0,))
+        return jax.jit(exported.call,
+                       donate_argnums=(0,) if _donate_state() else ())
 
     def _compile_segmented(self, program: Program, block: Block,
                            feed_names: List[str], fetch_names: List[str],
@@ -670,33 +726,47 @@ class Executor:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           fetch_handler=None):
+                           fetch_handler=None, keep_results=True):
         """Consume every batch of a fluid Dataset through this program.
 
-        `thread` is accepted for API parity and recorded, but the batch
-        loop is sequential: the device step must serialize anyway (the
-        jitted step donates the scope's state buffers — two in-flight
-        runs would donate the same arrays), and the host-side overlap
-        the reference's DeviceWorker threads buy lives in the Dataset's
-        OWN parser thread pool here (dataset.py _parse_all). A wrapper
-        thread pool on top would add locks without concurrency."""
+        The batch loop is PIPELINED with a bounded in-flight window
+        (FLAGS_executor_inflight_steps, default 2): batch N+1 is parsed
+        and staged onto the device by a prefetch thread while step N
+        executes, the step is dispatched without blocking (lazy
+        fetches), and completed fetches drain to host off the critical
+        path. Pipelining is donation-safe: step N+1 donates the state
+        buffers step N *produced* (fresh futures), never the ones step
+        N consumed — the chain holds with any window depth. Window 1
+        restores the old dispatch→sync→dispatch loop.
+
+        `thread` is accepted for API parity and recorded; host-side
+        parse parallelism lives in the Dataset's own thread pool
+        (dataset.py _parse_all) plus the prefetch stage here.
+
+        `keep_results=False` drops per-batch fetches after the
+        print_period / fetch_handler hooks have seen them (returns
+        None) — an epoch over a large dataset otherwise accumulates
+        every batch's fetches in host memory. FLAGS_dataset_results_window
+        (> 0) instead keeps only the last N batches."""
         return self._run_from_dataset(program, dataset, scope, thread,
                                       debug, fetch_list, fetch_info,
                                       print_period, fetch_handler,
-                                      is_infer=False)
+                                      is_infer=False,
+                                      keep_results=keep_results)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           fetch_handler=None):
+                           fetch_handler=None, keep_results=True):
         return self._run_from_dataset(program, dataset, scope, thread,
                                       debug, fetch_list, fetch_info,
                                       print_period, fetch_handler,
-                                      is_infer=True)
+                                      is_infer=True,
+                                      keep_results=keep_results)
 
     def _run_from_dataset(self, program, dataset, scope, thread, debug,
                           fetch_list, fetch_info, print_period,
-                          fetch_handler, is_infer):
+                          fetch_handler, is_infer, keep_results=True):
         if dataset is None:
             raise ValueError("dataset is required")
         program = program if program is not None else \
@@ -706,25 +776,69 @@ class Executor:
         fetch_names = [f.name if isinstance(f, VarDesc) else str(f)
                        for f in (fetch_list or [])]
         infos = list(fetch_info or fetch_names)
-        results = []
-        for n, batch in enumerate(dataset, start=1):
-            outs = self.run(program, feed=batch,
-                            fetch_list=fetch_names, scope=scope)
-            # full fetch_list per batch (single-var callers index [0]);
-            # ADVICE r4: keeping only outs[0] silently dropped the rest
-            results.append(list(outs) if outs else None)
+
+        from collections import deque
+        from ..flags import get_flag
+        window = max(1, int(get_flag("FLAGS_executor_inflight_steps", 2)
+                            or 1))
+        rwin = int(get_flag("FLAGS_dataset_results_window", 0) or 0)
+        if not keep_results:
+            results = None
+        elif rwin > 0:
+            # bounded result history: an epoch over a large dataset
+            # must not accumulate every batch's fetches on the host
+            results = deque(maxlen=rwin)
+        else:
+            results = []
+        from ..compiler import CompiledProgram as _CP
+        stage = window > 1 and not isinstance(program, _CP)
+        batches = iter(dataset)
+        if stage:
+            # prefetch thread: parse/collate batch N+1 and start its
+            # host→device transfer while step N executes (reader.py's
+            # buffered_reader analog, shared with DataLoader)
+            from ..reader import _DevicePrefetcher
+            batches = _DevicePrefetcher(batches, depth=window)
+        pending = deque()  # (batch_no, lazy fetch handles)
+
+        def drain_one():
+            n, outs = pending.popleft()
+            # materialize off the critical path: by drain time the step
+            # is `window` dispatches old and usually already complete
+            host = [h.numpy() for h in outs]
+            if results is not None:
+                # full fetch_list per batch (single-var callers index
+                # [0]); ADVICE r4: keeping only outs[0] silently
+                # dropped the rest
+                results.append(host if host else None)
             if fetch_names and (debug or n % max(print_period, 1) == 0):
+                # logging reads the already-drained host copies — the
+                # print_period boundary forces no extra sync
                 import logging
                 logging.getLogger("paddle_tpu").info(
                     "batch %d: %s", n,
-                    ", ".join("%s=%s" % (i, np.asarray(v).ravel()[:4])
-                              for i, v in zip(infos, outs)))
+                    ", ".join("%s=%s" % (i, v.ravel()[:4])
+                              for i, v in zip(infos, host)))
                 if fetch_handler is not None:
                     # reference FetchHandler contract: user callback on
                     # the fetched vars (time-based there; per
                     # print_period here, the same observability hook)
-                    fetch_handler.handler(dict(zip(fetch_names, outs)))
-        return results
+                    fetch_handler.handler(dict(zip(fetch_names, host)))
+
+        # If the loop raises mid-window (bad batch, nan check, dataset
+        # error), the pending handles are simply dropped: fetches are
+        # never donated and the scope already holds the LAST DISPATCHED
+        # step's state futures, so `scope` stays consistent — exactly
+        # the state after that many completed sequential steps.
+        for n, batch in enumerate(batches, start=1):
+            outs = self.run(program, feed=batch, fetch_list=fetch_names,
+                            scope=scope, return_numpy="lazy")
+            pending.append((n, outs))
+            if len(pending) >= window:
+                drain_one()
+        while pending:
+            drain_one()
+        return list(results) if isinstance(results, deque) else results
 
     def close(self):
         self._cache.clear()

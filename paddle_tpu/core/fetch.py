@@ -1,0 +1,144 @@
+"""FetchHandle: a lazy fetch result for the async dispatch pipeline.
+
+jax dispatches asynchronously — the jitted step returns futures and the
+host is free to stage the next batch while the device computes.  The
+reference's Executor instead blocks on a device→host FetchOp copy every
+step (/root/reference/paddle/fluid/framework/executor.cc:474 hot loop +
+fetch_op.cc), and our `Executor.run(return_numpy=True)` inherited that
+bubble through `np.asarray(fetch)`.  `FetchHandle` is the non-blocking
+alternative (`return_numpy="lazy"`): it wraps the on-device value and
+only pays the device→host transfer when the caller actually reads it —
+the same deferred-sync contract as TF's async executor fetches
+(PAPERS.md, arXiv:1605.08695 §4.1) and jax's own DeviceArray.
+
+Reading is any of: `numpy()`, `np.asarray(handle)`, `float()`/`int()`,
+indexing, or comparison.  Metadata (`shape`/`dtype`/`ndim`/`size`) and
+`block_until_ready()` never copy to host.  Every first materialization
+of a device value bumps `STAT_executor_sync` (monitor.py), so forced
+syncs on the hot path are visible in tests and benchmarks.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+__all__ = ["FetchHandle"]
+
+
+class FetchHandle:
+    """Holds one fetched value on device; converts to numpy on read.
+
+    The host copy is computed once and cached — repeated reads are
+    free.  Handles are safe to keep after the Executor dispatched more
+    steps: fetches are never donated, so the underlying buffer stays
+    valid for the handle's lifetime.
+    """
+
+    __slots__ = ("_device", "_host")
+
+    def __init__(self, value: Any):
+        if isinstance(value, FetchHandle):  # idempotent wrap
+            self._device = value._device
+            self._host = value._host
+            return
+        if isinstance(value, (np.ndarray, np.generic)):
+            self._device = None
+            self._host = np.asarray(value)
+        else:
+            self._device = value
+            self._host = None
+
+    # -- metadata: never syncs -------------------------------------------
+    @property
+    def value(self):
+        """The wrapped value as-is (on-device when not yet read)."""
+        return self._host if self._device is None else self._device
+
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    @property
+    def ndim(self):
+        return len(self.value.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.value.shape)) if self.value.shape else 1
+
+    def is_materialized(self) -> bool:
+        """True once the host copy exists (no sync to ask)."""
+        return self._host is not None
+
+    def block_until_ready(self) -> "FetchHandle":
+        """Wait for the device computation WITHOUT copying to host —
+        the in-flight-window drain uses this so bounding the pipeline
+        costs no transfer."""
+        v = self._device
+        if v is not None and hasattr(v, "block_until_ready"):
+            v.block_until_ready()
+        return self
+
+    # -- reads: first one pays the device->host transfer -----------------
+    def numpy(self) -> np.ndarray:
+        if self._host is None:
+            from ..monitor import stat_add
+            stat_add("STAT_executor_sync")
+            self._host = np.asarray(self._device)
+        return self._host
+
+    def __array__(self, dtype=None, copy=None):
+        a = self.numpy()
+        if dtype is not None and a.dtype != np.dtype(dtype):
+            return a.astype(dtype)
+        if copy:
+            return a.copy()
+        return a
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __len__(self):
+        shape = self.shape
+        if not shape:
+            raise TypeError("len() of a 0-d fetch")
+        return shape[0]
+
+    def __getitem__(self, idx):
+        return self.numpy()[idx]
+
+    def __iter__(self):
+        return iter(self.numpy())
+
+    def __eq__(self, other):
+        return self.numpy() == other
+
+    def __lt__(self, other):
+        return self.numpy() < other
+
+    def __le__(self, other):
+        return self.numpy() <= other
+
+    def __gt__(self, other):
+        return self.numpy() > other
+
+    def __ge__(self, other):
+        return self.numpy() >= other
+
+    __hash__ = None  # mutable-ish container semantics, like ndarray
+
+    def __repr__(self):
+        state = "host" if self._host is not None else "device"
+        return "FetchHandle(shape=%s, dtype=%s, %s)" % (
+            self.shape, self.dtype, state)

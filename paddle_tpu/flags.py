@@ -65,6 +65,25 @@ _DEFS: Dict[str, Any] = {
     "FLAGS_program_cache_dir": None,
     # in-memory Executor cache bound (entries, LRU eviction)
     "FLAGS_executor_cache_capacity": 64,
+    # async dispatch pipeline (docs/async_pipeline.md): max jitted
+    # steps in flight in the dataset/TrainStep loops before the host
+    # waits for the oldest. 2 = classic double-buffering (host stages
+    # batch N+1 while the device runs step N); 1 restores the fully
+    # synchronous dispatch->fetch->dispatch loop.
+    "FLAGS_executor_inflight_steps": 2,
+    # train/infer_from_dataset result history: 0 keeps every batch's
+    # fetches (reference behavior — unbounded host memory over a large
+    # epoch), N > 0 keeps only the last N batches. The print_period /
+    # fetch_handler hooks see every batch either way.
+    "FLAGS_dataset_results_window": 0,
+    # state-buffer donation in the jitted train step. Donation aliases
+    # each state input to its output buffer (in-place updates, halves
+    # peak param memory) but XLA:CPU runs donated executions
+    # SYNCHRONOUSLY — dispatch blocks until the step completes, which
+    # re-serializes the async pipeline (measured: the window=2 loop ran
+    # at window=1 speed). "auto" = donate on every backend except cpu;
+    # True/False force it.
+    "FLAGS_executor_donate_state": "auto",
 }
 
 _values: Dict[str, Any] = dict(_DEFS)
@@ -81,6 +100,9 @@ _LOWERING_FLAGS = [
     "FLAGS_embedding_onehot_grad",
     "FLAGS_flash_attention_fallback",
     "FLAGS_flash_inkernel_dropout",
+    # not read during lowering, but it changes the COMPILED executable
+    # (jit donate_argnums): a mid-process flip must miss the caches
+    "FLAGS_executor_donate_state",
 ]
 
 

@@ -65,10 +65,19 @@ class Model:
 
     # --- single-batch API (model.py train_batch:996) ----------------------
     def train_batch(self, inputs, labels=None):
+        return [self._train_batch_lazy(inputs, labels).numpy()]
+
+    def _train_batch_lazy(self, inputs, labels=None):
+        """fit's hot path: dispatch the fused TrainStep and return the
+        loss as a lazy FetchHandle (core/fetch.py). The per-batch
+        np.asarray(loss) the public train_batch keeps for API parity
+        blocked the host on every step; fit syncs only at log/metric
+        boundaries instead and lets dispatch run ahead."""
         assert self._train_step is not None, "call prepare() first"
+        from ..core.fetch import FetchHandle
         self.network.train()
         loss = self._train_step(_to_list(inputs), _to_list(labels))
-        return [np.asarray(loss)]
+        return FetchHandle(loss)
 
     def _build_eval(self):
         import jax
@@ -109,14 +118,19 @@ class Model:
         return outs
 
     def predict_batch(self, inputs):
+        return [np.asarray(o) for o in self._predict_batch_device(inputs)]
+
+    def _predict_batch_device(self, inputs):
+        """Jitted forward returning the ON-DEVICE outputs — evaluate's
+        loop computes the loss from these directly instead of round-
+        tripping every batch's outputs through host numpy."""
         if self._eval_fn is None:
             self._build_eval()
         self.network.eval()
         import jax.numpy as jnp
-        outs = self._eval_fn(self._current_state(),
+        return self._eval_fn(self._current_state(),
                              tuple(jnp.asarray(np.asarray(x))
                                    for x in _to_list(inputs)))
-        return [np.asarray(o) for o in outs]
 
     # --- fit (model.py:1243) ---------------------------------------------
     def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
@@ -141,14 +155,34 @@ class Model:
         self.stop_training = False
         cbks.on_train_begin()
         history = {"loss": []}
+        # async dispatch pipeline (docs/async_pipeline.md): the loop
+        # dispatches each fused step and hands callbacks a LAZY loss
+        # handle — only a callback that actually reads it (ProgBarLogger
+        # at log_freq boundaries) pays a device sync. The in-flight
+        # window bounds how far dispatch runs ahead of the device; the
+        # waits are block_until_ready (no transfer).
+        from collections import deque
+        from ..core.fetch import FetchHandle  # noqa: F401 (docs ref)
+        from ..flags import get_flag
+        window = max(1, int(get_flag("FLAGS_executor_inflight_steps", 2)
+                            or 1))
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
+            epoch_start = len(history["loss"])
+            inflight = deque()
             for step, batch in enumerate(loader):
                 cbks.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
-                loss = self.train_batch(inputs, labels)[0]
-                history["loss"].append(float(loss))
+                loss = self._train_batch_lazy(inputs, labels)
+                history["loss"].append(loss)
+                inflight.append(loss)
+                if len(inflight) >= window:
+                    inflight.popleft().block_until_ready()
                 cbks.on_train_batch_end(step, {"loss": loss})
+            # epoch boundary: one drain of the epoch's losses to floats
+            # (every step is complete by now — no pipeline stall)
+            history["loss"][epoch_start:] = [
+                float(h) for h in history["loss"][epoch_start:]]
             logs = {"loss": history["loss"][-1]}
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_loader, batch_size=None,
@@ -169,26 +203,33 @@ class Model:
         for m in self._metrics:
             m.reset()
         cbks.on_eval_begin()
+        # per-batch syncs happen only at metric boundaries: the loss is
+        # computed from the ON-DEVICE outputs and kept as a lazy handle
+        # (one drain at the end); metric updates need host values, so
+        # outputs materialize only when metrics are registered
+        from ..core.fetch import FetchHandle
         losses = []
         for step, batch in enumerate(loader):
             inputs, labels = self._split_batch(batch)
-            outs = self.predict_batch(inputs)
+            dev_outs = self._predict_batch_device(inputs)
             if self._loss is not None and labels:
                 import jax.numpy as jnp
-                lv = self._loss(*[Tensor(jnp.asarray(o)) for o in outs],
+                lv = self._loss(*[Tensor(o) for o in dev_outs],
                                 *[Tensor(jnp.asarray(np.asarray(x)))
                                   for x in labels])
-                losses.append(float(np.asarray(
-                    lv.value if isinstance(lv, Tensor) else lv)))
-            for m in self._metrics:
+                losses.append(FetchHandle(
+                    lv.value if isinstance(lv, Tensor) else lv))
+            if self._metrics:
+                outs = [np.asarray(o) for o in dev_outs]
                 largs = [np.asarray(x) for x in labels]
-                args = m.compute(*outs, *largs) if largs else \
-                    m.compute(outs[0], None)
-                m.update(*args)
+                for m in self._metrics:
+                    args = m.compute(*outs, *largs) if largs else \
+                        m.compute(outs[0], None)
+                    m.update(*args)
             cbks.on_eval_batch_end(step)
         logs = {}
         if losses:
-            logs["loss"] = float(np.mean(losses))
+            logs["loss"] = float(np.mean([h.numpy() for h in losses]))
         for m in self._metrics:
             logs[m.name()] = m.accumulate()
         cbks.on_eval_end(logs)

@@ -233,7 +233,13 @@ class TrainStep:
             new_opt[name] = nst
         return new_params, new_opt
 
-    def _build(self, donate: bool = True):
+    def _build(self, donate: bool = None):
+        if donate is None:
+            # same policy as the static Executor: donation is free
+            # memory on TPU but serializes dispatch on XLA:CPU, which
+            # would defeat run_loop/fit's dispatch-ahead window
+            from .core.executor import _donate_state
+            donate = _donate_state()
         model, loss_fn = self.model, self.loss_fn
 
         def step(state, opt_state, lr_step, rng, batch):
@@ -359,6 +365,39 @@ class TrainStep:
             self._state, self._opt_state, self._lr_step, sub,
             (inputs, labels))
         return loss
+
+    def run_loop(self, batches, window: Optional[int] = None):
+        """Dispatch-ahead training loop: generator over (inputs, labels)
+        pairs yielding one lazy FetchHandle loss per step.
+
+        jax dispatch is asynchronous, so each __call__ returns futures
+        immediately; the loop's only job is to BOUND how far the host
+        runs ahead (each in-flight step pins its feed buffers — an
+        unbounded queue is unbounded memory). After dispatching step N
+        the loop waits for step N-window+1 via block_until_ready — a
+        readiness wait, not a transfer, so no fetch is forced to host.
+        Pipelining is donation-safe: step N+1 donates the state pytree
+        step N *produced*, never buffers a still-running step reads.
+
+        window=None reads FLAGS_executor_inflight_steps (default 2);
+        window=1 restores the synchronous per-step loop. hapi
+        Model.fit and the pipeline bench drive their loops through the
+        same discipline.
+        """
+        from collections import deque
+        from .core.fetch import FetchHandle
+        from .flags import get_flag
+        if window is None:
+            window = int(get_flag("FLAGS_executor_inflight_steps", 2)
+                         or 1)
+        window = max(1, window)
+        pending: "deque[FetchHandle]" = deque()
+        for inputs, labels in batches:
+            handle = FetchHandle(self(inputs, labels))
+            pending.append(handle)
+            if len(pending) >= window:
+                pending.popleft().block_until_ready()
+            yield handle
 
     def sync_model(self):
         """Write compiled-state back into the Layer's Tensors (for eval /

@@ -15,6 +15,16 @@ miss -> trace), STAT_executor_cache_evict (LRU bound hit), and the
 persistent AOT program cache set (core/program_cache.py):
 STAT_program_cache_trace_hit / _trace_miss / _corrupt / _unexportable
 and _bytes_read / _bytes_written.
+
+The async dispatch pipeline (docs/async_pipeline.md) exposes:
+- STAT_executor_dispatch: jitted steps dispatched by Executor.run
+  (bumped at dispatch, before any fetch is read), and
+- STAT_executor_sync: blocking device->host materialization events
+  (Executor.run's return_numpy=True conversion, a FetchHandle's first
+  read, the fast_check_nan_inf scalar check).
+The dispatch/sync ratio is the pipeline's health signal: a loop that
+should be dispatch-ahead but shows sync == dispatch has a forced sync
+on its hot path, and tests pin the ratio so regressions are visible.
 """
 from __future__ import annotations
 

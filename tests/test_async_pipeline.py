@@ -1,0 +1,400 @@
+"""Async dispatch pipeline tests (docs/async_pipeline.md).
+
+Covers the lazy fetch mode (core/fetch.py FetchHandle), the pipelined
+train_from_dataset loop with its bounded in-flight window, donation
+safety under pipelining (two in-flight steps never alias the same
+donated state buffers — bitwise-identical results against the
+synchronous loop are the proof), scope consistency when the loop raises
+mid-window, the bounded result-history knobs, the on-device
+FLAGS_fast_check_nan_inf, and the hapi fit loop's no-per-batch-sync
+contract.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.fetch import FetchHandle
+from paddle_tpu.monitor import stat_get, stat_reset
+
+
+def _set_flags(**kw):
+    pt.set_flags({k: v for k, v in kw.items()})
+
+
+@pytest.fixture
+def pipeline_flags():
+    """Restore the pipeline flags after each test that pokes them."""
+    from paddle_tpu.flags import get_flags
+    keys = ["FLAGS_executor_inflight_steps", "FLAGS_dataset_results_window",
+            "FLAGS_fast_check_nan_inf"]
+    saved = get_flags(keys)
+    yield
+    pt.set_flags(saved)
+
+
+def _build_sgd_program(seed=7):
+    """fc + SGD: the parameters are donated state updated every step by
+    a data-dependent amount — exactly the aliasing hazard the in-flight
+    window must stay safe against."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [4])
+        y = pt.layers.data("y", [1])
+        pred = pt.layers.fc(x, 1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.SGD(0.1).minimize(loss, startup_program=startup,
+                                       program=main)
+    main.random_seed = seed
+    startup.random_seed = seed
+    return main, startup, loss
+
+
+def _batches(n, seed=1):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        yield {"x": rng.rand(8, 4).astype(np.float32),
+               "y": rng.rand(8, 1).astype(np.float32)}
+
+
+def _state_snapshot(program, scope):
+    return {v.name: np.asarray(scope.find_var(v.name))
+            for v in program.persistable_vars()
+            if scope.has(v.name)}
+
+
+# ---------------------------------------------------------------------------
+# FetchHandle semantics
+# ---------------------------------------------------------------------------
+
+def test_fetch_handle_lazy_semantics():
+    import jax.numpy as jnp
+    dev = jnp.arange(6, dtype=jnp.float32).reshape(2, 3)
+    h = FetchHandle(dev)
+    # metadata never materializes
+    assert h.shape == (2, 3) and h.ndim == 2 and h.size == 6
+    assert not h.is_materialized()
+    h.block_until_ready()
+    assert not h.is_materialized()  # readiness wait is not a transfer
+    stat_reset("STAT_executor_sync")
+    a = h.numpy()
+    assert h.is_materialized()
+    assert stat_get("STAT_executor_sync") == 1
+    np.testing.assert_array_equal(a, np.arange(6).reshape(2, 3))
+    # second read is cached — no extra sync
+    assert h.numpy() is a
+    assert stat_get("STAT_executor_sync") == 1
+    np.testing.assert_array_equal(np.asarray(h), a)
+    assert float(FetchHandle(jnp.float32(2.5))) == 2.5
+    assert int(FetchHandle(jnp.int32(3))) == 3
+    # numpy values wrap without counting a device sync
+    stat_reset("STAT_executor_sync")
+    hn = FetchHandle(np.ones(3))
+    assert hn.is_materialized() and stat_get("STAT_executor_sync") == 0
+    # idempotent wrap shares the underlying value
+    assert FetchHandle(h).numpy() is a
+    # comparisons / indexing go through numpy
+    assert (FetchHandle(jnp.float32(1.0)) < 2.0) and h[0, 1] == 1.0
+
+
+def test_executor_lazy_run_matches_sync_bitwise(pipeline_flags):
+    main, startup, loss = _build_sgd_program()
+    exe = pt.Executor()
+    got = {}
+    for mode in ("sync", "lazy"):
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe.run(startup)
+            outs = []
+            for batch in _batches(4):
+                rn = True if mode == "sync" else "lazy"
+                out, = exe.run(main, feed=batch, fetch_list=[loss],
+                               return_numpy=rn)
+                if mode == "lazy":
+                    assert isinstance(out, FetchHandle)
+                    assert not out.is_materialized()
+                outs.append(np.asarray(out))
+            got[mode] = (outs, _state_snapshot(main, scope))
+    for a, b in zip(got["sync"][0], got["lazy"][0]):
+        np.testing.assert_array_equal(a, b)
+    for name, arr in got["sync"][1].items():
+        np.testing.assert_array_equal(arr, got["lazy"][1][name])
+
+
+def test_run_dispatch_and_sync_counters(pipeline_flags):
+    main, startup, loss = _build_sgd_program()
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        batch = next(_batches(1))
+        exe.run(main, feed=batch, fetch_list=[loss])  # warm the cache
+        stat_reset("STAT_executor_dispatch")
+        stat_reset("STAT_executor_sync")
+        h, = exe.run(main, feed=batch, fetch_list=[loss],
+                     return_numpy="lazy")
+        # a lazy run dispatches without a single forced sync
+        assert stat_get("STAT_executor_dispatch") == 1
+        assert stat_get("STAT_executor_sync") == 0
+        h.numpy()
+        assert stat_get("STAT_executor_sync") == 1
+        # the blocking mode pays its sync inside run()
+        exe.run(main, feed=batch, fetch_list=[loss], return_numpy=True)
+        assert stat_get("STAT_executor_sync") == 2
+
+
+# ---------------------------------------------------------------------------
+# pipelined train_from_dataset: donation safety + exactness
+# ---------------------------------------------------------------------------
+
+def test_pipelined_loop_bitwise_equals_sync_loop(pipeline_flags):
+    """Use-after-donate guard: with window 3 the loop keeps multiple
+    steps in flight, each donating the state pytree the previous step
+    produced. If any two in-flight steps aliased the same donated
+    buffers, jax would raise (deleted/donated buffer) or the updates
+    would corrupt — bitwise identity of every per-batch fetch AND the
+    final parameter state against the window-1 synchronous loop proves
+    neither happens."""
+    main, startup, loss = _build_sgd_program()
+    exe = pt.Executor()
+    runs = {}
+    for window in (1, 3):
+        _set_flags(FLAGS_executor_inflight_steps=window)
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe.run(startup)
+            res = exe.train_from_dataset(program=main,
+                                         dataset=_batches(6),
+                                         fetch_list=[loss],
+                                         print_period=2)
+            runs[window] = (res, _state_snapshot(main, scope))
+    res1, state1 = runs[1]
+    res3, state3 = runs[3]
+    assert len(res1) == len(res3) == 6
+    for a, b in zip(res1, res3):
+        np.testing.assert_array_equal(a[0], b[0])
+    for name, arr in state1.items():
+        np.testing.assert_array_equal(arr, state3[name])
+
+
+def test_pipeline_exception_mid_window_keeps_scope_consistent(
+        pipeline_flags):
+    """A dataset error mid-window must leave `scope` exactly at the
+    state after the dispatched steps — the in-flight futures complete,
+    nothing is lost or double-applied."""
+    main, startup, loss = _build_sgd_program()
+    exe = pt.Executor()
+
+    class Boom(Exception):
+        pass
+
+    def bad_batches():
+        for i, b in enumerate(_batches(6)):
+            if i == 3:
+                raise Boom("bad batch")
+            yield b
+
+    _set_flags(FLAGS_executor_inflight_steps=3)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(Boom):
+            exe.train_from_dataset(program=main, dataset=bad_batches(),
+                                   fetch_list=[loss])
+        got = _state_snapshot(main, scope)
+        # the executor stays usable on the same scope afterwards
+        out, = exe.run(main, feed=next(_batches(1, seed=9)),
+                       fetch_list=[loss])
+        assert np.isfinite(out).all()
+
+    # reference: 3 synchronous steps over the same stream
+    _set_flags(FLAGS_executor_inflight_steps=1)
+    scope2 = pt.Scope()
+    with pt.scope_guard(scope2):
+        exe.run(startup)
+        exe.train_from_dataset(program=main, dataset=_batches(3),
+                               fetch_list=[loss])
+        want = _state_snapshot(main, scope2)
+    for name, arr in want.items():
+        np.testing.assert_array_equal(arr, got[name])
+
+
+def test_dataset_results_window_bounds_history(pipeline_flags):
+    main, startup, loss = _build_sgd_program()
+    exe = pt.Executor()
+
+    # full history first, for the expected tail values
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        full = exe.train_from_dataset(program=main, dataset=_batches(5),
+                                      fetch_list=[loss])
+    assert len(full) == 5
+
+    _set_flags(FLAGS_dataset_results_window=2)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        res = exe.train_from_dataset(program=main, dataset=_batches(5),
+                                     fetch_list=[loss])
+    assert isinstance(res, list) and len(res) == 2
+    np.testing.assert_array_equal(res[0][0], full[3][0])
+    np.testing.assert_array_equal(res[1][0], full[4][0])
+
+
+def test_keep_results_false_still_feeds_fetch_handler(pipeline_flags):
+    main, startup, loss = _build_sgd_program()
+    exe = pt.Executor()
+
+    class Handler:
+        def __init__(self):
+            self.seen = []
+
+        def handler(self, d):
+            self.seen.append(dict(d))
+
+    h = Handler()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        res = exe.train_from_dataset(program=main, dataset=_batches(4),
+                                     fetch_list=[loss], print_period=1,
+                                     fetch_handler=h, keep_results=False)
+    assert res is None
+    assert len(h.seen) == 4
+    assert all(loss.name in d and np.isfinite(d[loss.name]).all()
+               for d in h.seen)
+
+
+# ---------------------------------------------------------------------------
+# on-device fast_check_nan_inf
+# ---------------------------------------------------------------------------
+
+def test_fast_check_nan_inf_return_types_unchanged(pipeline_flags):
+    import jax
+    # forward-only program: repeated runs are pure, so the three fetch
+    # modes must agree bitwise
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [4])
+        pred = pt.layers.fc(x, 1)
+        loss = pt.layers.mean(pred)
+    main.random_seed = 7
+    startup.random_seed = 7
+    exe = pt.Executor()
+    _set_flags(FLAGS_fast_check_nan_inf=True)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        batch = {"x": next(_batches(1))["x"]}
+        out, = exe.run(main, feed=batch, fetch_list=[loss],
+                       return_numpy=True)
+        assert isinstance(out, np.ndarray)
+        dev, = exe.run(main, feed=batch, fetch_list=[loss],
+                       return_numpy=False)
+        assert isinstance(dev, jax.Array)  # never host-copied back
+        lazy, = exe.run(main, feed=batch, fetch_list=[loss],
+                        return_numpy="lazy")
+        assert isinstance(lazy, FetchHandle)
+        np.testing.assert_array_equal(out, np.asarray(lazy))
+
+
+def test_fast_check_nan_inf_detects_and_names_fetch(pipeline_flags):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [2])
+        good = pt.layers.mean(x)
+        bad = pt.layers.log(pt.layers.elementwise_sub(x, x))  # log(0)
+    _set_flags(FLAGS_fast_check_nan_inf=True)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        feed = {"x": np.ones((3, 2), np.float32)}
+        with pytest.raises(pt.EnforceNotMet, match=bad.name):
+            exe.run(main, feed=feed, fetch_list=[good, bad])
+        # finite programs pass and the check is ONE scalar sync
+        stat_reset("STAT_executor_sync")
+        outs = exe.run(main, feed=feed, fetch_list=[good],
+                       return_numpy=False)
+        assert stat_get("STAT_executor_sync") == 1
+        assert np.isfinite(np.asarray(outs[0])).all()
+
+
+# ---------------------------------------------------------------------------
+# TrainStep.run_loop + hapi fit
+# ---------------------------------------------------------------------------
+
+def _mlp_batches(n, seed=3):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        x = rng.rand(8, 4).astype(np.float32)
+        y = rng.randint(0, 2, (8, 1)).astype(np.int64)
+        yield ([x], [y])
+
+
+def _make_train_step(seed=11):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.nn import functional as F
+    pt.seed(seed)
+    model = nn.Linear(4, 2)
+    opt = pt.optimizer.SGD(0.1, parameters=model.parameters())
+
+    def loss_fn(logits, label):
+        return F.cross_entropy(logits, label, reduction="mean")
+
+    return TrainStep(model, loss_fn, opt)
+
+
+def test_trainstep_run_loop_matches_manual_loop(pipeline_flags):
+    step_a = _make_train_step()
+    manual = [np.asarray(step_a(i, l)) for i, l in _mlp_batches(5)]
+
+    step_b = _make_train_step()
+    looped = list(step_b.run_loop(_mlp_batches(5), window=3))
+    assert all(isinstance(h, FetchHandle) for h in looped)
+    for a, b in zip(manual, looped):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_hapi_fit_defers_loss_sync_to_log_boundaries(pipeline_flags):
+    from paddle_tpu.hapi.callbacks import Callback
+    from paddle_tpu.reader import TensorDataset
+    import paddle_tpu.nn as nn
+    from paddle_tpu.nn import functional as F
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 4).astype(np.float32)
+    y = rng.randint(0, 2, (64, 1)).astype(np.int64)
+
+    class Spy(Callback):
+        def __init__(self):
+            super().__init__()
+            self.batch_losses = []
+            self.materialized_in_loop = []
+
+        def on_train_batch_end(self, step, logs=None):
+            # record whether the handle was already host-materialized AT
+            # CALLBACK TIME — fit's own epoch-end drain touches these
+            # same objects later, so the check must happen here
+            self.batch_losses.append(logs["loss"])
+            self.materialized_in_loop.append(
+                logs["loss"].is_materialized())
+
+    spy = Spy()
+    pt.seed(5)
+    model = pt.Model(nn.Linear(4, 2))
+    model.prepare(pt.optimizer.SGD(0.1, parameters=model.parameters()),
+                  lambda logits, label: F.cross_entropy(
+                      logits, label, reduction="mean"))
+    hist = model.fit(TensorDataset(x, y), batch_size=8, epochs=1,
+                     verbose=0, shuffle=False, callbacks=[spy])
+    # the loop hands callbacks LAZY handles and (verbose=0) nothing in
+    # the loop forces them to host — fit itself never blocks per batch
+    assert len(spy.batch_losses) == 8
+    assert all(isinstance(l, FetchHandle) for l in spy.batch_losses)
+    assert not any(spy.materialized_in_loop)
+    # history drains to plain floats at the epoch boundary
+    assert all(isinstance(v, float) for v in hist["loss"])
+    np.testing.assert_allclose(
+        hist["loss"], [float(l) for l in spy.batch_losses], rtol=0)
