@@ -286,6 +286,25 @@ def bench_compile():
     }
 
 
+def _build_fc3(B, H):
+    """The pipeline/observability bench workload: a 3-layer fc train
+    program (shared so the two blocks' steps/s numbers compare)."""
+    import paddle_tpu as pt
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [H])
+        y = pt.layers.data("y", [1])
+        h1 = pt.layers.fc(x, H, act="relu")
+        h2 = pt.layers.fc(h1, H, act="relu")
+        pred = pt.layers.fc(h2, 1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.SGD(0.01).minimize(loss, startup_program=startup,
+                                        program=main)
+    main.random_seed = 7
+    startup.random_seed = 7
+    return main, startup, loss
+
+
 def bench_pipeline():
     """sync-vs-pipelined `train_from_dataset` block (ISSUE 2, docs/
     async_pipeline.md): one input-bound static train program run twice
@@ -304,19 +323,7 @@ def bench_pipeline():
     from paddle_tpu.flags import get_flags
 
     B, H, steps, io_s = 64, 640, 60, 0.005
-
-    main, startup = pt.Program(), pt.Program()
-    with pt.program_guard(main, startup):
-        x = pt.layers.data("x", [H])
-        y = pt.layers.data("y", [1])
-        h1 = pt.layers.fc(x, H, act="relu")
-        h2 = pt.layers.fc(h1, H, act="relu")
-        pred = pt.layers.fc(h2, 1)
-        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
-        pt.optimizer.SGD(0.01).minimize(loss, startup_program=startup,
-                                        program=main)
-    main.random_seed = 7
-    startup.random_seed = 7
+    main, startup, loss = _build_fc3(B, H)
 
     # the batch pool is synthesized ONCE, outside every timed region:
     # the generator then models a latency-bound reader (disk/network
@@ -385,6 +392,136 @@ def bench_pipeline():
     }
 
 
+def bench_observability():
+    """telemetry-overhead block (ISSUE 3, docs/observability.md): the
+    SAME pipelined train_from_dataset workload as bench_pipeline, run
+    with FLAGS_telemetry off (the instrumented code's disabled fast
+    path — directly comparable to the pipeline block's
+    pipelined_steps_per_sec and to earlier rounds' BENCH artifacts)
+    and with telemetry on (spans + timers + flight recorder live).
+    Also proves the step-correlation contract on the exported chrome
+    trace, validates the Prometheus export, and carries the counter
+    deltas of the telemetry-on run via tools/stat_diff.py."""
+    import json as _json
+    import re
+    import tempfile
+    import paddle_tpu as pt
+    from paddle_tpu import monitor, profiler, telemetry
+    from paddle_tpu.flags import get_flags
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import stat_diff
+
+    B, H, steps, io_s = 64, 640, 60, 0.005
+    main, startup, loss = _build_fc3(B, H)
+    rng = np.random.RandomState(0)
+    pool = [{"x": rng.rand(B, H).astype(np.float32),
+             "y": rng.rand(B, 1).astype(np.float32)}
+            for _ in range(steps)]
+
+    def batches(n):
+        for i in range(n):
+            time.sleep(io_s)
+            yield pool[i % steps]
+
+    exe = pt.Executor()
+    saved = get_flags(["FLAGS_executor_inflight_steps",
+                       "FLAGS_telemetry"])
+    try:
+        window = max(2, int(saved.get("FLAGS_executor_inflight_steps", 2)
+                            or 2))
+        pt.set_flags({"FLAGS_executor_inflight_steps": window,
+                      "FLAGS_telemetry": False})
+        wscope = pt.Scope()
+        with pt.scope_guard(wscope):
+            exe.run(startup)
+            exe.train_from_dataset(program=main, dataset=batches(2),
+                                   fetch_list=[loss])
+
+        def timed(telemetry_on):
+            pt.set_flags({"FLAGS_telemetry": telemetry_on})
+            scope = pt.Scope()
+            with pt.scope_guard(scope):
+                exe.run(startup)
+                t0 = time.time()
+                exe.train_from_dataset(program=main,
+                                       dataset=batches(steps),
+                                       fetch_list=[loss],
+                                       keep_results=False)
+                return steps / (time.time() - t0)
+
+        # best-of-3 per mode (same rationale as bench_pipeline)
+        snap0 = monitor.snapshot()
+        off_sps = max(timed(False) for _ in range(3))
+        snap1 = monitor.snapshot()
+        profiler.reset_profiler()
+        telemetry.flight_reset()
+        on_sps = max(timed(True) for _ in range(3))
+        snap2 = monitor.snapshot()
+        flight_depth = len(telemetry.flight_records())
+    finally:
+        pt.set_flags(saved)
+
+    def counter_delta(a, b):
+        return {k: b["counters"].get(k, 0.0) - a["counters"].get(k, 0.0)
+                for k in b["counters"]
+                if b["counters"].get(k, 0.0) != a["counters"].get(k, 0.0)}
+
+    # the off and on runs do IDENTICAL work, so their counter deltas
+    # must match: telemetry adding syncs/misses/evictions would show
+    # here as a stat_diff regression of the on-delta over the off-delta
+    delta_off = counter_delta(snap0, snap1)
+    delta_on = counter_delta(snap1, snap2)
+
+    # step-correlation proof: the exported chrome trace must show
+    # dispatch/feed-stage/drain spans sharing a step id
+    correlated = False
+    try:
+        fd, tpath = tempfile.mkstemp(suffix=".json")
+        os.close(fd)
+        try:
+            profiler.export_chrome_tracing(tpath)
+            with open(tpath) as f:
+                trace = _json.load(f)["traceEvents"]
+        finally:
+            os.unlink(tpath)
+        by_step = {}
+        for e in trace:
+            step = (e.get("args") or {}).get("step")
+            if e.get("ph") == "X" and step is not None:
+                by_step.setdefault(step, set()).add(e["name"])
+        correlated = any({"pipeline/dispatch", "pipeline/drain",
+                          "pipeline/feed_stage"} <= names
+                         for names in by_step.values())
+    except Exception as e:
+        print("WARN: trace correlation check failed: %r" % (e,),
+              file=sys.stderr)
+
+    prom = monitor.to_prometheus()
+    prom_re = re.compile(
+        r"^(#.*|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [-+0-9.eEinfa]+)$")
+    prom_valid = all(prom_re.match(ln) for ln in prom.splitlines() if ln)
+
+    d = stat_diff.diff_snapshots({"counters": delta_off},
+                                 {"counters": delta_on})
+    overhead = (1.0 - on_sps / off_sps) * 100.0 if off_sps else None
+    return {
+        "workload": "fc3-H%d-B%d x%d steps (%.1fms read latency/batch, "
+                    "pipelined window=%d) — same as the pipeline block"
+                    % (H, B, steps, io_s * 1e3, window),
+        "telemetry_off_steps_per_sec": round(off_sps, 1),
+        "telemetry_on_steps_per_sec": round(on_sps, 1),
+        "enabled_overhead_pct": round(overhead, 2)
+        if overhead is not None else None,
+        "trace_step_correlated": correlated,
+        "prometheus_valid": prom_valid,
+        "flight_recorder_steps": flight_depth,
+        "stat_deltas_per_run_counters": {
+            k: v for k, v in sorted(delta_on.items())[:12]},
+        "stat_regressions_on_vs_off": stat_diff.find_regressions(d),
+    }
+
+
 def _run_worker(backend):
     """Run one full bench on the requested backend and print the JSON line.
 
@@ -439,6 +576,10 @@ def _run_worker(backend):
         # async dispatch pipeline: sync vs dispatch-ahead dataset loop
         # (host-overlap is real on CPU too — ISSUE 2)
         rec["pipeline"] = bench_pipeline()
+    if not os.environ.get("PT_SKIP_OBS_BENCH"):
+        # unified telemetry: disabled-path overhead vs the pipelined
+        # baseline + enabled-run trace/stat evidence (ISSUE 3)
+        rec["observability"] = bench_observability()
     if on_tpu:
         rec.update(detail)
         # persist the evidence: a later wedged-tunnel session (or the

@@ -27,6 +27,7 @@ the reference's per-op GradOpMaker machinery
 from __future__ import annotations
 
 from collections import OrderedDict
+from contextlib import nullcontext as _nullcontext
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -320,6 +321,10 @@ class Executor:
         self._seed_counter = 0
         self._warned_uneven: set = set()
         self._unused_checked: set = set()
+        # telemetry step ids: monotonically counts run() calls; the
+        # dataset loops install their own batch-number step scope and
+        # run() then inherits it instead (telemetry.py step_scope)
+        self._step_id = 0
 
     def _cache_get(self, key):
         entry = self._cache.get(key)
@@ -356,6 +361,40 @@ class Executor:
           donation keeps state on-device between steps, so a caller
           looping over run() gets a dispatch-ahead pipeline for free.
         """
+        from .. import telemetry as _tm
+        if not _tm.enabled():
+            return self._run_impl(program, feed, fetch_list, scope,
+                                  return_numpy, use_program_cache)
+        # telemetry wrapper: step scope (inherited by inner spans and
+        # FetchHandles), a flight record, and the exception-note dump
+        step = _tm.current_step()
+        if step is None:
+            self._step_id += 1
+            step = self._step_id
+        prog = program
+        from ..compiler import CompiledProgram as _CP0
+        if isinstance(prog, _CP0):
+            prog = prog._program
+        if prog is None:
+            prog = default_main_program()
+        _tm.flight_begin(step, program="%x:%d" % (id(prog) & 0xffffffff,
+                                                  prog._version))
+        with _tm.step_scope(step):
+            try:
+                with _tm.span("executor/run", step=step, track="dispatch",
+                              timer="TIMER_executor_run_us"):
+                    out = self._run_impl(program, feed, fetch_list, scope,
+                                         return_numpy, use_program_cache)
+            except Exception as e:
+                _tm.flight_note(step, "error", repr(e)[:200])
+                _tm.attach_flight(e)
+                raise
+        _tm.counter_sample("STAT_executor_dispatch")
+        _tm.counter_sample("STAT_executor_sync")
+        return out
+
+    def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
+                  use_program_cache):
         # CompiledProgram.with_data_parallel (compiler.py): unwrap and
         # stage feeds sharded over the mesh dp axis — GSPMD partitions
         # the step and inserts the grad all-reduces (the ParallelExecutor
@@ -424,6 +463,7 @@ class Executor:
         from ..flags import get_flag, lowering_snapshot
         key = (id(program), program._version, _feed_sig(feed),
                tuple(fetch_names), tuple(state_names), lowering_snapshot())
+        from .. import telemetry as _tm
         entry = self._cache_get(key) if use_program_cache else None
         if entry is None:
             from ..monitor import stat_add
@@ -431,17 +471,23 @@ class Executor:
             example = None
             if use_program_cache and dp_mesh is None:
                 example = (state, feed, rng)
-            entry = self._compile(program, block, sorted(feed), fetch_names,
-                                  state_names, example=example)
+            with _tm.span("executor/compile", track="compile",
+                          timer="TIMER_executor_compile_us"):
+                entry = self._compile(program, block, sorted(feed),
+                                      fetch_names, state_names,
+                                      example=example)
             if use_program_cache:
                 self._cache_put(key, entry)
         fn = entry
         if get_flag("FLAGS_enable_unused_var_check"):
             self._warn_unused_vars(program, fetch_names)
 
-        fetches, new_state, new_rng = fn(state, feed, rng)
+        with _tm.span("executor/dispatch", track="dispatch",
+                      timer="TIMER_executor_dispatch_us"):
+            fetches, new_state, new_rng = fn(state, feed, rng)
         from ..monitor import stat_add
         stat_add("STAT_executor_dispatch")
+        _tm.flight_note(_tm.current_step(), "dispatched_us", _tm.now_us())
         for n, v in new_state.items():
             scope.set(n, v)
         scope.set(RNG_VAR, new_rng)
@@ -466,7 +512,11 @@ class Executor:
                         jnp.logical_and(finite, f)
             if finite is not None:
                 stat_add("STAT_executor_sync")
-                if not bool(finite):
+                _tm.flight_note(_tm.current_step(), "sync_count", add=1)
+                with _tm.span("executor/nan_check_sync", track="sync",
+                              timer="TIMER_executor_sync_us"):
+                    finite_host = bool(finite)
+                if not finite_host:
                     from .enforce import EnforceNotMet
                     for name, v in zip(fetch_names, fetches):
                         arr = np.asarray(v)
@@ -485,7 +535,10 @@ class Executor:
         if return_numpy:
             if any(isinstance(v, jax.Array) for v in fetches):
                 stat_add("STAT_executor_sync")
-            fetches = [np.asarray(v) for v in fetches]
+                _tm.flight_note(_tm.current_step(), "sync_count", add=1)
+            with _tm.span("executor/fetch_sync", track="sync",
+                          timer="TIMER_executor_sync_us"):
+                fetches = [np.asarray(v) for v in fetches]
         return fetches
 
     def _warn_unused_vars(self, program: Program, fetch_names):
@@ -801,11 +854,16 @@ class Executor:
             batches = _DevicePrefetcher(batches, depth=window)
         pending = deque()  # (batch_no, lazy fetch handles)
 
+        from .. import telemetry as _tm
+
         def drain_one():
             n, outs = pending.popleft()
             # materialize off the critical path: by drain time the step
             # is `window` dispatches old and usually already complete
-            host = [h.numpy() for h in outs]
+            with _tm.span("pipeline/drain", step=n, track="drain",
+                          timer="TIMER_pipeline_drain_us"):
+                host = [h.numpy() for h in outs]
+            _tm.flight_note(n, "drained_us", _tm.now_us())
             if results is not None:
                 # full fetch_list per batch (single-var callers index
                 # [0]); ADVICE r4: keeping only outs[0] silently
@@ -830,14 +888,29 @@ class Executor:
         # never donated and the scope already holds the LAST DISPATCHED
         # step's state futures, so `scope` stays consistent — exactly
         # the state after that many completed sequential steps.
-        for n, batch in enumerate(batches, start=1):
-            outs = self.run(program, feed=batch, fetch_list=fetch_names,
-                            scope=scope, return_numpy="lazy")
-            pending.append((n, outs))
-            if len(pending) >= window:
+        try:
+            for n, batch in enumerate(batches, start=1):
+                # the batch number is the pipeline's step id: dispatch
+                # N, feed-stage N+1 (prefetch thread), and drain
+                # N−window land on separate trace tracks correlated by
+                # it (docs/observability.md)
+                with _tm.step_scope(n) if _tm.enabled() else \
+                        _nullcontext():
+                    with _tm.span("pipeline/dispatch", step=n,
+                                  track="dispatch"):
+                        outs = self.run(program, feed=batch,
+                                        fetch_list=fetch_names,
+                                        scope=scope, return_numpy="lazy")
+                pending.append((n, outs))
+                if len(pending) >= window:
+                    drain_one()
+            while pending:
                 drain_one()
-        while pending:
-            drain_one()
+        except Exception as e:
+            # a failed step's window is dropped (see comment above) —
+            # but its last-N timeline survives in the exception notes
+            _tm.attach_flight(e)
+            raise
         return list(results) if isinstance(results, deque) else results
 
     def close(self):

@@ -35,12 +35,20 @@ class FetchHandle:
     valid for the handle's lifetime.
     """
 
-    __slots__ = ("_device", "_host")
+    __slots__ = ("_device", "_host", "_step")
 
     def __init__(self, value: Any):
+        # step-correlated telemetry: remember which pipeline step
+        # produced this fetch (the dispatching step_scope), so the
+        # first-read sync span lands on the right step id even though
+        # the read happens window steps later (docs/observability.md)
+        from .. import telemetry as _tm
+        self._step = _tm.current_step() if _tm.enabled() else None
         if isinstance(value, FetchHandle):  # idempotent wrap
             self._device = value._device
             self._host = value._host
+            self._step = value._step if value._step is not None \
+                else self._step
             return
         if isinstance(value, (np.ndarray, np.generic)):
             self._device = None
@@ -89,7 +97,11 @@ class FetchHandle:
         if self._host is None:
             from ..monitor import stat_add
             stat_add("STAT_executor_sync")
-            self._host = np.asarray(self._device)
+            from .. import telemetry as _tm
+            with _tm.span("fetch/sync", step=self._step, track="sync",
+                          timer="TIMER_fetch_sync_us"):
+                self._host = np.asarray(self._device)
+            _tm.flight_note(self._step, "sync_count", add=1)
         return self._host
 
     def __array__(self, dtype=None, copy=None):

@@ -36,6 +36,7 @@ import hashlib
 import json
 import os
 import tempfile
+import time
 from typing import Optional
 
 MAGIC = b"PTAOT1\n"
@@ -51,6 +52,27 @@ _framework_token: Optional[str] = None
 def _stat_add(name: str, value: float = 1.0) -> None:
     from ..monitor import stat_add
     stat_add(name, value)
+
+
+class _timed:
+    """Record wall time of the enclosed disk operation into a monitor
+    latency histogram (always on: these are once-per-program cold
+    paths, and their latency is exactly what the hit/miss counters
+    can't show — docs/observability.md)."""
+
+    __slots__ = ("name", "_t0")
+
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        from ..monitor import timer_observe
+        timer_observe(self.name, (time.perf_counter() - self._t0) * 1e6)
+        return False
 
 
 def default_dir() -> str:
@@ -151,7 +173,8 @@ def load_trace(cache_dir: str, fingerprint: str) -> Optional[bytes]:
     export overwrites it."""
     path = _trace_path(cache_dir, fingerprint)
     try:
-        with open(path, "rb") as f:
+        with _timed("TIMER_program_cache_load_us"), \
+                open(path, "rb") as f:
             blob = f.read()
     except OSError:
         _stat_add("STAT_program_cache_trace_miss")
@@ -188,19 +211,20 @@ def store_trace(cache_dir: str, fingerprint: str, payload: bytes) -> bool:
     path = _trace_path(cache_dir, fingerprint)
     blob = MAGIC + _header_bytes(fingerprint) + payload
     try:
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
-                                   prefix=".tmp_" + fingerprint[:16])
-        try:
-            with os.fdopen(fd, "wb") as f:
-                f.write(blob)
-            os.replace(tmp, path)
-        except BaseException:
+        with _timed("TIMER_program_cache_store_us"):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                       prefix=".tmp_" + fingerprint[:16])
             try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+                with os.fdopen(fd, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
     except OSError:
         return False
     _stat_add("STAT_program_cache_bytes_written", len(blob))

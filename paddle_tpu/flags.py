@@ -76,6 +76,16 @@ _DEFS: Dict[str, Any] = {
     # epoch), N > 0 keeps only the last N batches. The print_period /
     # fetch_handler hooks see every batch either way.
     "FLAGS_dataset_results_window": 0,
+    # unified runtime telemetry (telemetry.py, docs/observability.md):
+    # master gate for step-correlated trace spans, TIMER_* latency
+    # histograms, and the flight recorder. Off by default — the
+    # disabled fast path is one dict lookup per instrumentation site
+    # (bench.py's observability block pins the overhead).
+    "FLAGS_telemetry": False,
+    # flight recorder depth: last N step records (step id, program key,
+    # dispatch/drain timestamps, fetch sync count) kept in memory and
+    # dumped into the exception notes when a step raises
+    "FLAGS_telemetry_flight_steps": 64,
     # state-buffer donation in the jitted train step. Donation aliases
     # each state input to its output buffer (in-place updates, halves
     # peak param memory) but XLA:CPU runs donated executions

@@ -162,27 +162,42 @@ class Model:
         # window bounds how far dispatch runs ahead of the device; the
         # waits are block_until_ready (no transfer).
         from collections import deque
+        from contextlib import nullcontext
+        from .. import telemetry as _tm
         from ..core.fetch import FetchHandle  # noqa: F401 (docs ref)
         from ..flags import get_flag
         window = max(1, int(get_flag("FLAGS_executor_inflight_steps", 2)
                             or 1))
+        gstep = 0  # telemetry step id, monotonic across epochs
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
             epoch_start = len(history["loss"])
             inflight = deque()
             for step, batch in enumerate(loader):
+                gstep += 1
                 cbks.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
-                loss = self._train_batch_lazy(inputs, labels)
+                with _tm.step_scope(gstep) if _tm.enabled() \
+                        else nullcontext():
+                    loss = self._train_batch_lazy(inputs, labels)
                 history["loss"].append(loss)
-                inflight.append(loss)
+                inflight.append((gstep, loss))
                 if len(inflight) >= window:
-                    inflight.popleft().block_until_ready()
-                cbks.on_train_batch_end(step, {"loss": loss})
+                    dn, h = inflight.popleft()
+                    with _tm.span("hapi/drain_wait", step=dn,
+                                  track="drain"):
+                        h.block_until_ready()
+                # callback time is aggregate-only (trace=False): a span
+                # per batch would dominate the event buffer at scale
+                with _tm.span("hapi/callbacks", trace=False,
+                              timer="TIMER_hapi_callback_us"):
+                    cbks.on_train_batch_end(step, {"loss": loss})
             # epoch boundary: one drain of the epoch's losses to floats
             # (every step is complete by now — no pipeline stall)
-            history["loss"][epoch_start:] = [
-                float(h) for h in history["loss"][epoch_start:]]
+            with _tm.span("hapi/epoch_drain", step=gstep, track="drain",
+                          timer="TIMER_hapi_epoch_drain_us"):
+                history["loss"][epoch_start:] = [
+                    float(h) for h in history["loss"][epoch_start:]]
             logs = {"loss": history["loss"][-1]}
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_loader, batch_size=None,

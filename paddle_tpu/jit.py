@@ -323,8 +323,11 @@ class TrainStep:
         return opt_state
 
     def __call__(self, inputs, labels):
+        from . import telemetry as _tm
         if self._step_fn is None:
-            self._step_fn = self._build()
+            with _tm.span("trainstep/build", track="compile",
+                          timer="TIMER_trainstep_build_us"):
+                self._step_fn = self._build()
             self._state = state_of(self.model)
             self._lr_step = jnp.zeros((), jnp.int32)
             if self.mesh is not None:
@@ -361,9 +364,24 @@ class TrainStep:
             from jax.sharding import NamedSharding, PartitionSpec as P
             sub = jax.device_put(np.asarray(sub),
                                  NamedSharding(self.mesh, P()))
-        loss, self._state, self._opt_state, self._lr_step = self._step_fn(
-            self._state, self._opt_state, self._lr_step, sub,
-            (inputs, labels))
+        step_id = None
+        if _tm.enabled():
+            # inherit the loop's step scope (run_loop / hapi fit) or
+            # count our own calls when driven directly
+            step_id = _tm.current_step()
+            if step_id is None:
+                self._tm_step = getattr(self, "_tm_step", 0) + 1
+                step_id = self._tm_step
+            _tm.flight_begin(step_id, program="trainstep:%s"
+                             % type(self.model).__name__)
+        with _tm.span("trainstep/dispatch", step=step_id,
+                      track="dispatch",
+                      timer="TIMER_trainstep_dispatch_us"):
+            loss, self._state, self._opt_state, self._lr_step = \
+                self._step_fn(self._state, self._opt_state,
+                              self._lr_step, sub, (inputs, labels))
+        if step_id is not None:
+            _tm.flight_note(step_id, "dispatched_us", _tm.now_us())
         return loss
 
     def run_loop(self, batches, window: Optional[int] = None):
@@ -385,18 +403,27 @@ class TrainStep:
         same discipline.
         """
         from collections import deque
+        from contextlib import nullcontext
+        from . import telemetry as _tm
         from .core.fetch import FetchHandle
         from .flags import get_flag
         if window is None:
             window = int(get_flag("FLAGS_executor_inflight_steps", 2)
                          or 1)
         window = max(1, window)
-        pending: "deque[FetchHandle]" = deque()
-        for inputs, labels in batches:
-            handle = FetchHandle(self(inputs, labels))
-            pending.append(handle)
+        pending: "deque" = deque()  # (step_no, FetchHandle)
+        for n, (inputs, labels) in enumerate(batches, start=1):
+            # scope covers the FetchHandle wrap too, so the handle's
+            # eventual first read syncs under this step's id
+            with _tm.step_scope(n) if _tm.enabled() else nullcontext():
+                handle = FetchHandle(self(inputs, labels))
+            pending.append((n, handle))
             if len(pending) >= window:
-                pending.popleft().block_until_ready()
+                dn, h = pending.popleft()
+                with _tm.span("trainstep/drain_wait", step=dn,
+                              track="drain",
+                              timer="TIMER_pipeline_drain_us"):
+                    h.block_until_ready()
             yield handle
 
     def sync_model(self):

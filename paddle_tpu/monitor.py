@@ -1,14 +1,29 @@
-"""Runtime stats registry — the platform monitor analog.
+"""Runtime stats registry — the platform monitor analog, grown into a
+typed-instrument registry.
 
 Analog of /root/reference/paddle/fluid/platform/monitor.{h,cc} (the
 STAT_ADD/STAT_RESET int64 registry) exposed to python as
-get_float_stats/get_int_stats (pybind.cc:1664 get_float_stats). Stats
-are named counters any subsystem bumps (executor compiles, host-op
-dispatches, bytes fed); thread-safe, process-global.
+get_float_stats/get_int_stats (pybind.cc:1664 get_float_stats), extended
+with the instrument kinds a runtime that wants to explain its own time
+needs (docs/observability.md):
 
-    from paddle_tpu.monitor import stat_add, get_float_stats
+- **counters** — monotonically accumulated floats (`stat_add`). The
+  original STAT registry; every legacy call keeps working unchanged.
+- **gauges** — last-written values (`gauge_set`): queue depths,
+  in-flight windows, cache sizes.
+- **timers** — latency histograms (`timer_observe`, microseconds by
+  convention, TIMER_* names): count/sum/min/max plus p50/p95 computed
+  over a bounded ring of the most recent samples.
+
+`snapshot()` returns all three as one plain dict; `dump()` serializes it
+to JSON and `to_prometheus()` to Prometheus text exposition format, so a
+bench artifact and a scrape endpoint read the same registry.
+Everything is thread-safe and process-global.
+
+    from paddle_tpu.monitor import stat_add, timer_observe, snapshot
     stat_add("STAT_executor_compile", 1)
-    get_float_stats()  # {"STAT_executor_compile": 1.0, ...}
+    timer_observe("TIMER_executor_dispatch_us", 412.0)
+    snapshot()  # {"counters": {...}, "gauges": {...}, "timers": {...}}
 
 Well-known counters include STAT_executor_compile (in-memory cache
 miss -> trace), STAT_executor_cache_evict (LRU bound hit), and the
@@ -25,15 +40,74 @@ The async dispatch pipeline (docs/async_pipeline.md) exposes:
 The dispatch/sync ratio is the pipeline's health signal: a loop that
 should be dispatch-ahead but shows sync == dispatch has a forced sync
 on its hot path, and tests pin the ratio so regressions are visible.
+
+Timer latencies land here when FLAGS_telemetry is on (telemetry.py):
+TIMER_executor_compile_us / _dispatch_us / _sync_us,
+TIMER_program_cache_load_us / _store_us, TIMER_fetch_sync_us,
+TIMER_pipeline_drain_us / _feed_stage_us, TIMER_trainstep_dispatch_us,
+TIMER_hapi_epoch_drain_us / _callback_us.
 """
 from __future__ import annotations
 
+import json
 import threading
-from typing import Dict
+from typing import Dict, List, Optional
 
 _LOCK = threading.Lock()
 _STATS: Dict[str, float] = {}
+_GAUGES: Dict[str, float] = {}
+_TIMERS: Dict[str, "_Timer"] = {}
 
+# quantiles are computed over a bounded ring of recent samples: exact
+# for short runs, a sliding-window estimate for long ones — never
+# unbounded memory
+_TIMER_RING = 1024
+
+
+class _Timer:
+    """One latency histogram. All mutation happens under _LOCK."""
+
+    __slots__ = ("count", "sum", "min", "max", "ring", "idx")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.ring: List[float] = []
+        self.idx = 0
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        if len(self.ring) < _TIMER_RING:
+            self.ring.append(v)
+        else:
+            self.ring[self.idx] = v
+            self.idx = (self.idx + 1) % _TIMER_RING
+
+    def stats(self) -> Dict[str, float]:
+        if not self.count:
+            return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                    "p50": 0.0, "p95": 0.0}
+        s = sorted(self.ring)
+        n = len(s)
+
+        def q(p: float) -> float:
+            return s[min(n - 1, int(p * (n - 1) + 0.5))]
+
+        return {"count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max,
+                "p50": q(0.50), "p95": q(0.95)}
+
+
+# ---------------------------------------------------------------------------
+# counters — the original STAT registry (API unchanged)
+# ---------------------------------------------------------------------------
 
 def stat_add(name: str, value: float = 1.0) -> None:
     with _LOCK:
@@ -60,3 +134,105 @@ def get_float_stats() -> Dict[str, float]:
 def get_int_stats() -> Dict[str, int]:
     with _LOCK:
         return {k: int(v) for k, v in _STATS.items()}
+
+
+# ---------------------------------------------------------------------------
+# gauges
+# ---------------------------------------------------------------------------
+
+def gauge_set(name: str, value: float) -> None:
+    with _LOCK:
+        _GAUGES[name] = float(value)
+
+
+def gauge_get(name: str, default: float = 0.0) -> float:
+    with _LOCK:
+        return _GAUGES.get(name, default)
+
+
+# ---------------------------------------------------------------------------
+# timers (latency histograms)
+# ---------------------------------------------------------------------------
+
+def timer_observe(name: str, value: float) -> None:
+    """Record one latency sample (microseconds by convention)."""
+    with _LOCK:
+        t = _TIMERS.get(name)
+        if t is None:
+            t = _TIMERS[name] = _Timer()
+        t.observe(float(value))
+
+
+def timer_get(name: str) -> Dict[str, float]:
+    """count/sum/min/max/p50/p95 for one timer (zeros when absent)."""
+    with _LOCK:
+        t = _TIMERS.get(name)
+        return t.stats() if t is not None else _Timer().stats()
+
+
+# ---------------------------------------------------------------------------
+# whole-registry export
+# ---------------------------------------------------------------------------
+
+def snapshot() -> Dict[str, Dict]:
+    """One consistent view of every instrument: a single lock
+    acquisition covers all three registries, so a snapshot taken under
+    concurrent writers never shows a counter ahead of the timer that
+    timed it being updated mid-read."""
+    with _LOCK:
+        return {
+            "counters": dict(_STATS),
+            "gauges": dict(_GAUGES),
+            "timers": {k: t.stats() for k, t in _TIMERS.items()},
+        }
+
+
+def dump(path: Optional[str] = None) -> str:
+    """Serialize snapshot() to JSON; optionally also write it to
+    `path` (the format tools/stat_diff.py consumes)."""
+    text = json.dumps(snapshot(), sort_keys=True, indent=1)
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    if not out or not (out[0].isalpha() or out[0] == "_"):
+        out = "_" + out
+    return out
+
+
+def to_prometheus(prefix: str = "paddle_tpu") -> str:
+    """Prometheus text exposition format: counters as `<name>_total`,
+    gauges as-is, timers as summaries (`_count`/`_sum` + quantile
+    samples). One scrape-able string, same registry as dump()."""
+    snap = snapshot()
+    lines: List[str] = []
+    for name, v in sorted(snap["counters"].items()):
+        m = "%s_%s_total" % (prefix, _prom_name(name))
+        lines.append("# TYPE %s counter" % m)
+        lines.append("%s %.17g" % (m, v))
+    for name, v in sorted(snap["gauges"].items()):
+        m = "%s_%s" % (prefix, _prom_name(name))
+        lines.append("# TYPE %s gauge" % m)
+        lines.append("%s %.17g" % (m, v))
+    for name, st in sorted(snap["timers"].items()):
+        m = "%s_%s" % (prefix, _prom_name(name))
+        lines.append("# TYPE %s summary" % m)
+        lines.append('%s{quantile="0.5"} %.17g' % (m, st["p50"]))
+        lines.append('%s{quantile="0.95"} %.17g' % (m, st["p95"]))
+        lines.append("%s_sum %.17g" % (m, st["sum"]))
+        lines.append("%s_count %d" % (m, st["count"]))
+        lines.append("%s_min %.17g" % (m, st["min"] if st["count"] else 0))
+        lines.append("%s_max %.17g" % (m, st["max"] if st["count"] else 0))
+    return "\n".join(lines) + "\n"
+
+
+def reset_all() -> None:
+    """Clear every instrument (bench/test isolation)."""
+    with _LOCK:
+        _STATS.clear()
+        _GAUGES.clear()
+        _TIMERS.clear()

@@ -8,9 +8,19 @@ trace). The device side maps onto jax.profiler (XPlane/TensorBoard
 traces capture the real TPU timeline); the host side keeps the
 RecordEvent span tree, aggregate tables, and a chrome://tracing JSON
 exporter so tools/timeline.py-style workflows keep working.
+
+Beyond RecordEvent's synchronous thread-stack spans, the telemetry
+layer (telemetry.py, docs/observability.md) records *step-correlated*
+events here: spans carry a `step` id and a named `track` (dispatch /
+feed-stage / drain / sync), so a pipelined `train_from_dataset` trace
+shows dispatch N, feed-stage N+1, and drain N−window as separate rows
+of one chrome://tracing timeline, correlated by `args.step` and by a
+shared async id. Monitor counters ride along as chrome counter events
+("C" phase) via add_counter_event.
 """
 from __future__ import annotations
 
+import functools
 import json
 import os
 import threading
@@ -20,16 +30,35 @@ from typing import Dict, List, Optional
 
 __all__ = ["RecordEvent", "profiler", "start_profiler", "stop_profiler",
            "reset_profiler", "export_chrome_tracing", "summary",
-           "start_device_trace", "stop_device_trace"]
+           "start_device_trace", "stop_device_trace",
+           "set_device_trace_dir", "add_trace_event", "add_counter_event"]
 
 _lock = threading.Lock()
 _enabled = False
 _events: List[dict] = []
 _tls = threading.local()
 
+# hard bound on buffered events: a telemetry-on service loop must not
+# grow host memory without limit; overflow drops new events and counts
+# them (STAT_profiler_events_dropped)
+_MAX_EVENTS = 200_000
+
 
 def _now_us() -> float:
     return time.perf_counter() * 1e6
+
+
+def _append_event(e: dict) -> bool:
+    with _lock:
+        if len(_events) >= _MAX_EVENTS:
+            dropped = True
+        else:
+            _events.append(e)
+            dropped = False
+    if dropped:
+        from .monitor import stat_add
+        stat_add("STAT_profiler_events_dropped")
+    return not dropped
 
 
 class RecordEvent:
@@ -56,34 +85,102 @@ class RecordEvent:
             stack = _tls.stack
             full = "/".join(stack)
             stack.pop()
-            with _lock:
-                _events.append({
-                    "name": self.name, "full_name": full,
-                    "cat": self.event_type, "ts": self._t0,
-                    "dur": t1 - self._t0,
-                    "tid": threading.get_ident() % 100000,
-                })
+            _append_event({
+                "name": self.name, "full_name": full,
+                "cat": self.event_type, "ts": self._t0,
+                "dur": t1 - self._t0,
+                "tid": threading.get_ident() % 100000,
+            })
         return False
 
     def __call__(self, fn):
+        @functools.wraps(fn)
         def wrapped(*a, **k):
             with RecordEvent(self.name, self.event_type):
                 return fn(*a, **k)
         return wrapped
 
 
+# --- step-correlated telemetry events (gated by the CALLER, not by
+# start_profiler: telemetry.py records whenever FLAGS_telemetry is on) ----
+
+def add_trace_event(name: str, ts_us: float, dur_us: float, *,
+                    cat: str = "telemetry", track: Optional[str] = None,
+                    step: Optional[int] = None,
+                    args: Optional[dict] = None) -> None:
+    """Record one complete span on a named track. `track` becomes its
+    own chrome-trace row (thread_name metadata); `step` lands in
+    args.step AND as the event id, which is what lets chrome://tracing
+    highlight every span of one pipeline step together."""
+    e = {"name": name, "cat": cat, "ts": ts_us, "dur": dur_us,
+         "tid": threading.get_ident() % 100000}
+    if track is not None:
+        e["track"] = track
+    if step is not None:
+        e["step"] = int(step)
+    if args:
+        e["args"] = dict(args)
+    _append_event(e)
+
+
+def add_counter_event(name: str, value: float,
+                      ts_us: Optional[float] = None) -> None:
+    """Chrome counter event ("C" phase): monitor counters sampled into
+    the same timeline as the spans."""
+    _append_event({"name": name, "cat": "counter", "ph": "C",
+                   "ts": _now_us() if ts_us is None else ts_us,
+                   "value": float(value)})
+
+
+# --- start/stop (ProfilerState honored — ISSUE 3 satellite) --------------
+
+# where state='All'/'GPU' sends the device trace; configured via
+# set_device_trace_dir() or $PADDLE_TPU_DEVICE_TRACE_DIR. No dir
+# configured -> host-only profiling, exactly the old behavior.
+_device_trace_dir_cfg: Optional[str] = None
+_device_trace_started_here = False
+
+
+def set_device_trace_dir(log_dir: Optional[str]) -> None:
+    """Configure where start_profiler(state='All'/'GPU') writes the jax
+    device trace. None disables the device tier again."""
+    global _device_trace_dir_cfg
+    _device_trace_dir_cfg = log_dir
+
+
 def start_profiler(state: str = "CPU", tracer_option: str = "Default"):
-    """fluid/profiler.py start_profiler. state 'All'/'GPU' additionally
-    starts a jax.profiler device trace when a trace dir is configured via
-    start_device_trace()."""
-    global _enabled
+    """fluid/profiler.py start_profiler. `state` selects the tiers
+    (ProfilerState, profiler.h:39): 'CPU' records host spans only;
+    'All'/'GPU' ADDITIONALLY starts a jax.profiler device trace when a
+    trace dir is configured (set_device_trace_dir /
+    $PADDLE_TPU_DEVICE_TRACE_DIR) — stop_profiler stops it again."""
+    global _enabled, _device_trace_started_here
     _enabled = True
+    if str(state) in ("All", "GPU"):
+        d = _device_trace_dir_cfg or \
+            os.environ.get("PADDLE_TPU_DEVICE_TRACE_DIR")
+        if d and _device_trace_dir is None:
+            try:
+                start_device_trace(d)
+                _device_trace_started_here = True
+            except Exception:
+                # device tracing is an optimization tier, never a hard
+                # dependency (e.g. no profiler plugin on this backend)
+                _device_trace_started_here = False
 
 
 def stop_profiler(sorted_key: Optional[str] = "total",
                   profile_path: Optional[str] = None):
-    global _enabled
+    global _enabled, _device_trace_started_here
     _enabled = False
+    if _device_trace_started_here:
+        # symmetric with start_profiler(state='All'/'GPU'); a trace the
+        # USER started via start_device_trace stays theirs to stop
+        _device_trace_started_here = False
+        try:
+            stop_device_trace()
+        except Exception:
+            pass
     if profile_path:
         export_chrome_tracing(profile_path)
     return summary(sorted_key)
@@ -95,17 +192,19 @@ def reset_profiler():
 
 
 class profiler:
-    """Context manager: `with profiler.profiler('CPU', ...)` parity
-    (fluid/profiler.py:context)."""
+    """Context manager: `with profiler.profiler('All', ...)` parity
+    (fluid/profiler.py:context). `state` is forwarded to
+    start_profiler, so 'All'/'GPU' capture the device tier too."""
 
     def __init__(self, state: str = "CPU", sorted_key: str = "total",
                  profile_path: Optional[str] = None):
+        self._state = state
         self._path = profile_path
         self._key = sorted_key
 
     def __enter__(self):
         reset_profiler()
-        start_profiler()
+        start_profiler(self._state)
         return self
 
     def __exit__(self, *exc):
@@ -115,11 +214,14 @@ class profiler:
 
 def summary(sorted_key: Optional[str] = "total") -> List[dict]:
     """Aggregate table like the reference's profiler report: per name
-    {calls, total_us, avg_us, max_us}."""
+    {calls, total_us, avg_us, max_us}. Counter events carry no
+    duration and stay out of the table."""
     agg: Dict[str, dict] = defaultdict(
         lambda: {"calls": 0, "total_us": 0.0, "max_us": 0.0})
     with _lock:
         for e in _events:
+            if e.get("ph") == "C":
+                continue
             a = agg[e["name"]]
             a["calls"] += 1
             a["total_us"] += e["dur"]
@@ -136,16 +238,45 @@ def summary(sorted_key: Optional[str] = "total") -> List[dict]:
 
 
 def export_chrome_tracing(path: str):
-    """tools/timeline.py analog: write chrome://tracing JSON."""
+    """tools/timeline.py analog: write chrome://tracing JSON.
+
+    Track-tagged telemetry events render as named rows (thread_name
+    metadata per track) and keep their step id both in args.step and as
+    the event id; counter events export as "C" phases."""
     with _lock:
-        trace = {
-            "traceEvents": [
-                {"name": e["name"], "cat": e["cat"], "ph": "X",
-                 "ts": e["ts"], "dur": e["dur"], "pid": 0, "tid": e["tid"],
-                 "args": {"full_name": e["full_name"]}}
-                for e in _events
-            ]
-        }
+        events = list(_events)
+    track_tids: Dict[str, int] = {}
+    trace_events: List[dict] = []
+    for e in events:
+        if e.get("ph") == "C":
+            trace_events.append({
+                "name": e["name"], "cat": e.get("cat", "counter"),
+                "ph": "C", "ts": e["ts"], "pid": 0, "tid": 0,
+                "args": {"value": e["value"]}})
+            continue
+        track = e.get("track")
+        if track is not None:
+            tid = track_tids.get(track)
+            if tid is None:
+                # track rows get stable small tids well clear of the
+                # hashed thread ids RecordEvent spans use
+                tid = track_tids[track] = 1 + len(track_tids)
+        else:
+            tid = e["tid"]
+        out = {"name": e["name"], "cat": e.get("cat", "op"), "ph": "X",
+               "ts": e["ts"], "dur": e["dur"], "pid": 0, "tid": tid,
+               "args": dict(e.get("args") or ())}
+        if "full_name" in e:
+            out["args"]["full_name"] = e["full_name"]
+        if "step" in e:
+            out["args"]["step"] = e["step"]
+            out["id"] = str(e["step"])
+        trace_events.append(out)
+    meta = [{"name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+             "args": {"name": track}}
+            for track, tid in sorted(track_tids.items(), key=lambda kv:
+                                     kv[1])]
+    trace = {"traceEvents": meta + trace_events}
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

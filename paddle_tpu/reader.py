@@ -291,12 +291,21 @@ class _DevicePrefetcher:
 
     def _run(self):
         jax = self._jax
+        from . import telemetry as _tm
         try:
-            for item in self._it:
-                staged = jax.tree.map(
-                    lambda x: jax.device_put(np.asarray(x))
-                    if isinstance(x, np.ndarray) or np.isscalar(x) else x,
-                    item)
+            # item numbers align with the dataset loop's batch
+            # numbering (enumerate start=1), so the feed-stage span for
+            # batch N+1 carries step N+1 while step N is dispatching —
+            # the prefetch thread runs one step ahead by construction
+            for i, item in enumerate(self._it, start=1):
+                with _tm.span("pipeline/feed_stage", step=i,
+                              track="feed-stage",
+                              timer="TIMER_feed_stage_us"):
+                    staged = jax.tree.map(
+                        lambda x: jax.device_put(np.asarray(x))
+                        if isinstance(x, np.ndarray) or np.isscalar(x)
+                        else x,
+                        item)
                 self._q.put(("item", staged))
         except Exception as e:
             self._q.put(("err", e))

@@ -1,0 +1,255 @@
+"""Unified runtime telemetry: the gate, step-correlated spans, and the
+flight recorder (docs/observability.md).
+
+The TensorFlow lineage treats timeline/metrics instrumentation as a
+first-class subsystem (Abadi et al., arXiv:1605.08695 §5); this module
+is that subsystem for paddle_tpu. It ties the two existing halves
+together behind ONE switch:
+
+- spans land in profiler.py as step-correlated chrome-trace events
+  (named tracks: dispatch / feed-stage / drain / sync / compile), and
+- latencies land in monitor.py timer histograms (TIMER_* names),
+
+so one `FLAGS_telemetry=True` run yields both a timeline and
+aggregates. Everything here is OFF by default: the disabled fast path
+of `span()` is a single dict lookup returning a shared no-op context
+manager (bench.py's observability block pins the disabled overhead).
+
+Step correlation: the executor (or any loop) enters `step_scope(n)`;
+every span and FetchHandle created under it inherits step id `n`, so a
+pipelined `train_from_dataset` trace shows dispatch N, feed-stage N+1,
+and drain N−window as separate rows correlated by `args.step`.
+
+Flight recorder: a bounded deque of the last FLAGS_telemetry_flight_steps
+(default 64) step records — step id, program key, dispatch/drain
+timestamps, fetch sync count. When a step raises, `attach_flight`
+appends the dump to the exception notes, turning "NaN at some step"
+into a reconstructable timeline.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from . import monitor, profiler
+from .flags import get_flag
+
+__all__ = ["enabled", "span", "step_scope", "current_step",
+           "counter_sample", "flight_begin", "flight_note",
+           "flight_records", "flight_dump", "flight_reset",
+           "attach_flight"]
+
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    """The master gate (FLAGS_telemetry). Cheap: one dict lookup."""
+    return bool(get_flag("FLAGS_telemetry"))
+
+
+def now_us() -> float:
+    return time.perf_counter() * 1e6
+
+
+# ---------------------------------------------------------------------------
+# step scope: thread-local current-step id
+# ---------------------------------------------------------------------------
+
+class _StepScope:
+    __slots__ = ("_step", "_prev")
+
+    def __init__(self, step: Optional[int]):
+        self._step = step
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "step", None)
+        _tls.step = self._step
+        return self
+
+    def __exit__(self, *exc):
+        _tls.step = self._prev
+        return False
+
+
+def step_scope(step: Optional[int]) -> _StepScope:
+    """Bind `step` as the thread's current step id; spans and
+    FetchHandles created inside inherit it."""
+    return _StepScope(step)
+
+
+def current_step() -> Optional[int]:
+    return getattr(_tls, "step", None)
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "step", "track", "cat", "timer", "trace", "_t0")
+
+    def __init__(self, name, step, track, cat, timer, trace):
+        self.name = name
+        self.step = step
+        self.track = track
+        self.cat = cat
+        self.timer = timer
+        self.trace = trace
+
+    def __enter__(self):
+        self._t0 = now_us()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = now_us()
+        dur = t1 - self._t0
+        if self.trace:
+            profiler.add_trace_event(self.name, self._t0, dur,
+                                     cat=self.cat, track=self.track,
+                                     step=self.step)
+        if self.timer:
+            monitor.timer_observe(self.timer, dur)
+        return False
+
+
+def span(name: str, *, step: Optional[int] = None,
+         track: Optional[str] = None, cat: str = "telemetry",
+         timer: Optional[str] = None, trace: bool = True):
+    """Context manager timing one region. No-op (shared object, no
+    allocation) when telemetry is off. `step=None` inherits the
+    thread's step_scope. `timer` additionally records the duration in
+    the named monitor histogram; `trace=False` keeps high-frequency
+    timers out of the chrome timeline (aggregate-only)."""
+    if not enabled():
+        return _NOOP
+    if step is None:
+        step = current_step()
+    return _Span(name, step, track, cat, timer, trace)
+
+
+def counter_sample(name: str, value: Optional[float] = None) -> None:
+    """Embed one monitor counter sample into the chrome trace as a "C"
+    event (value defaults to the counter's current reading)."""
+    if not enabled():
+        return
+    if value is None:
+        value = monitor.stat_get(name)
+    profiler.add_counter_event(name, value)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+_FLIGHT_LOCK = threading.Lock()
+_flight: deque = deque(maxlen=64)
+_NOTE_TAG = "telemetry flight recorder"
+
+
+def _resize_locked() -> None:
+    cap = int(get_flag("FLAGS_telemetry_flight_steps", 64) or 64)
+    global _flight
+    if _flight.maxlen != cap:
+        _flight = deque(_flight, maxlen=max(1, cap))
+
+
+def flight_begin(step: int, **fields: Any) -> Dict[str, Any]:
+    """Open (or update) the flight record for `step`. Records hold
+    step id, t_begin_us, and whatever the caller annotates via
+    flight_note (program key, dispatch/drain timestamps, sync count)."""
+    with _FLIGHT_LOCK:
+        _resize_locked()
+        for rec in reversed(_flight):
+            if rec.get("step") == step:
+                rec.update(fields)
+                return rec
+        rec = {"step": step, "t_begin_us": now_us(), **fields}
+        _flight.append(rec)
+        return rec
+
+
+def flight_note(step: Optional[int], key: str, value: Any = None,
+                add: Optional[float] = None) -> None:
+    """Annotate the record for `step` (searched newest-first; no-op if
+    it already scrolled off). `add` increments a numeric field instead
+    of assigning."""
+    if step is None:
+        return
+    with _FLIGHT_LOCK:
+        for rec in reversed(_flight):
+            if rec.get("step") == step:
+                if add is not None:
+                    rec[key] = rec.get(key, 0) + add
+                else:
+                    rec[key] = value
+                return
+
+
+def flight_records() -> List[Dict[str, Any]]:
+    with _FLIGHT_LOCK:
+        return [dict(r) for r in _flight]
+
+
+def flight_reset() -> None:
+    with _FLIGHT_LOCK:
+        _flight.clear()
+
+
+def flight_dump() -> str:
+    """Human-readable dump of the last N step records, newest last."""
+    recs = flight_records()
+    if not recs:
+        return "%s: empty" % _NOTE_TAG
+    lines = ["%s (last %d steps):" % (_NOTE_TAG, len(recs))]
+    for r in recs:
+        parts = ["step=%s" % r.get("step")]
+        for k in sorted(r):
+            if k in ("step",):
+                continue
+            v = r[k]
+            if isinstance(v, float):
+                parts.append("%s=%.1f" % (k, v))
+            else:
+                parts.append("%s=%s" % (k, v))
+        lines.append("  " + " ".join(parts))
+    return "\n".join(lines)
+
+
+def attach_flight(exc: BaseException) -> None:
+    """Append the flight dump to `exc` (PEP 678 notes) exactly once —
+    the exception message path that turns 'NaN at some step' into a
+    reconstructable timeline."""
+    if not enabled():
+        return
+    notes = getattr(exc, "__notes__", None) or ()
+    if any(_NOTE_TAG in n for n in notes):
+        return
+    note = flight_dump()
+    try:
+        exc.add_note(note)
+    except AttributeError:
+        # pre-3.11: no add_note, but __notes__ is just an attribute and
+        # 3.11+ traceback formatting (and our tests) read it the same way
+        try:
+            if getattr(exc, "__notes__", None) is None:
+                exc.__notes__ = []
+            exc.__notes__.append(note)
+        except Exception:
+            pass
+    except Exception:
+        pass

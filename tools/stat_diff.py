@@ -1,0 +1,135 @@
+"""Diff two monitor snapshots and print regressions.
+
+Consumes the JSON that `paddle_tpu.monitor.dump()` writes (the typed
+{"counters", "gauges", "timers"} shape) or a flat name->value dict (the
+legacy `get_float_stats()` shape), so snapshots from any PR round
+compare. Used two ways:
+
+- CLI: `python tools/stat_diff.py old.json new.json [--threshold 10]
+  [--strict]` — prints every changed instrument, marks cost-counter /
+  timer-latency increases beyond the threshold as REGRESSION, exits 1
+  under --strict when any exist.
+- library: bench.py's observability block calls diff_snapshots() /
+  find_regressions() on in-memory snapshots so every BENCH artifact
+  carries counter deltas.
+
+"Cost" counters are the ones where up == worse: syncs, cache misses /
+corruption / eviction, dropped events. Throughput counters (dispatches,
+hits, bytes) change freely without flagging.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Optional
+
+# counter-name suffixes where an increase is a cost, not throughput
+COST_SUFFIXES = ("_sync", "_miss", "_corrupt", "_evict", "_dropped",
+                 "_unexportable")
+
+
+def _as_snapshot(d: Dict) -> Dict:
+    """Normalize: flat stat dicts become {"counters": d}."""
+    if any(k in d for k in ("counters", "gauges", "timers")):
+        return {"counters": d.get("counters", {}),
+                "gauges": d.get("gauges", {}),
+                "timers": d.get("timers", {})}
+    return {"counters": dict(d), "gauges": {}, "timers": {}}
+
+
+def load_snapshot(path: str) -> Dict:
+    with open(path) as f:
+        return _as_snapshot(json.load(f))
+
+
+def _delta(old: float, new: float) -> Dict:
+    d = new - old
+    pct = (d / old * 100.0) if old else (100.0 if d else 0.0)
+    return {"old": old, "new": new, "delta": d, "pct": round(pct, 2)}
+
+
+def diff_snapshots(old: Dict, new: Dict) -> Dict:
+    """Per-instrument deltas between two snapshots. Counters/gauges
+    diff on value; timers diff on count, sum, and p95."""
+    old, new = _as_snapshot(old), _as_snapshot(new)
+    out: Dict[str, Dict] = {"counters": {}, "gauges": {}, "timers": {}}
+    for kind in ("counters", "gauges"):
+        for name in sorted(set(old[kind]) | set(new[kind])):
+            a = float(old[kind].get(name, 0.0))
+            b = float(new[kind].get(name, 0.0))
+            if a != b:
+                out[kind][name] = _delta(a, b)
+    for name in sorted(set(old["timers"]) | set(new["timers"])):
+        a = old["timers"].get(name) or {}
+        b = new["timers"].get(name) or {}
+        entry = {}
+        for k in ("count", "sum", "p95"):
+            av, bv = float(a.get(k, 0.0)), float(b.get(k, 0.0))
+            if av != bv:
+                entry[k] = _delta(av, bv)
+        if entry:
+            # always carry count so find_regressions can judge sample
+            # size even when it didn't change between snapshots
+            entry.setdefault("count", _delta(float(a.get("count", 0.0)),
+                                             float(b.get("count", 0.0))))
+            out["timers"][name] = entry
+    return out
+
+
+def find_regressions(d: Dict, threshold_pct: float = 10.0) -> List[str]:
+    """Lines describing deltas that read as regressions: cost counters
+    up by more than threshold_pct, or a timer's p95 up by more than
+    threshold_pct (with a non-trivial sample count)."""
+    regs: List[str] = []
+    for name, e in d.get("counters", {}).items():
+        if name.endswith(COST_SUFFIXES) and e["delta"] > 0 \
+                and e["pct"] > threshold_pct:
+            regs.append("counter %s: %g -> %g (+%.1f%%)"
+                        % (name, e["old"], e["new"], e["pct"]))
+    for name, e in d.get("timers", {}).items():
+        p95 = e.get("p95")
+        cnt = e.get("count", {})
+        if p95 and p95["delta"] > 0 and p95["pct"] > threshold_pct \
+                and float(cnt.get("new", 1) or 1) >= 5:
+            regs.append("timer %s p95: %.1f -> %.1f us (+%.1f%%)"
+                        % (name, p95["old"], p95["new"], p95["pct"]))
+    return regs
+
+
+def format_diff(d: Dict, regressions: Optional[List[str]] = None) -> str:
+    lines: List[str] = []
+    for kind in ("counters", "gauges"):
+        for name, e in d.get(kind, {}).items():
+            lines.append("%-9s %-45s %12g -> %-12g (%+.1f%%)"
+                         % (kind[:-1], name, e["old"], e["new"], e["pct"]))
+    for name, e in d.get("timers", {}).items():
+        for k, v in e.items():
+            lines.append("%-9s %-45s %12g -> %-12g (%+.1f%%)"
+                         % ("timer." + k, name, v["old"], v["new"],
+                            v["pct"]))
+    if not lines:
+        lines.append("no differences")
+    for r in (regressions if regressions is not None else []):
+        lines.append("REGRESSION: " + r)
+    return "\n".join(lines)
+
+
+def main(argv: List[str]) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        description="diff two paddle_tpu monitor snapshots")
+    p.add_argument("old")
+    p.add_argument("new")
+    p.add_argument("--threshold", type=float, default=10.0,
+                   help="regression threshold in percent (default 10)")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 when regressions are found")
+    ns = p.parse_args(argv)
+    d = diff_snapshots(load_snapshot(ns.old), load_snapshot(ns.new))
+    regs = find_regressions(d, ns.threshold)
+    print(format_diff(d, regs))
+    return 1 if regs and ns.strict else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
