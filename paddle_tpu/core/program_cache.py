@@ -236,3 +236,41 @@ def discard_trace(cache_dir: str, fingerprint: str) -> None:
         os.unlink(_trace_path(cache_dir, fingerprint))
     except OSError:
         pass
+
+
+def has_trace(cache_dir: str, fingerprint: str) -> bool:
+    """Cheap existence probe (no counters, no validation) — lets a
+    warmup loop report disk-warm vs fresh-compile without paying a
+    load. The entry is still fully validated on the real load path."""
+    try:
+        return os.path.getsize(_trace_path(cache_dir, fingerprint)) > \
+            len(MAGIC)
+    except OSError:
+        return False
+
+
+def warmup_ladder(buckets, compile_one) -> dict:
+    """Compile-ahead of a shape-bucket ladder (docs/serving.md): run
+    `compile_one(bucket)` for every bucket size, ascending, and report
+    per-bucket wall time plus whether the trace came from disk —
+    the serving analog of the reference pre-building one TRT engine
+    per optimization profile. Counters: STAT_program_cache_warm per
+    bucket compiled; failures are recorded, not raised (a bucket the
+    program cannot trace at must not take the whole ladder down)."""
+    from ..monitor import stat_get
+    report = {}
+    for b in sorted(set(int(x) for x in buckets)):
+        h0 = stat_get("STAT_program_cache_trace_hit")
+        t0 = time.perf_counter()
+        try:
+            compile_one(b)
+        except Exception as e:
+            report[b] = {"error": repr(e)[:200]}
+            continue
+        _stat_add("STAT_program_cache_warm")
+        report[b] = {
+            "seconds": round(time.perf_counter() - t0, 4),
+            "disk_warm":
+                stat_get("STAT_program_cache_trace_hit") > h0,
+        }
+    return report

@@ -86,6 +86,19 @@ _DEFS: Dict[str, Any] = {
     # dispatch/drain timestamps, fetch sync count) kept in memory and
     # dumped into the exception notes when a step raises
     "FLAGS_telemetry_flight_steps": 64,
+    # serving-grade Predictor (docs/serving.md). The bucket ladder:
+    # comma-separated sizes ("1,2,4,8,16") or "pow2:N" (powers of two
+    # up to N). Variable leading dims are padded UP to the nearest
+    # bucket so steady-state traffic hits a small warm set of compiled
+    # executables; "" disables bucketing even when a predictor asks.
+    "FLAGS_predictor_shape_buckets": "pow2:128",
+    # dynamic micro-batching (serving.py PredictorPool): max coalesced
+    # rows per executed batch, how long the batcher waits for more
+    # requests once it holds one, and the bounded request-queue depth
+    # (backpressure: submit blocks, then raises ServingQueueFull)
+    "FLAGS_predictor_max_batch": 32,
+    "FLAGS_predictor_batch_timeout_ms": 2.0,
+    "FLAGS_predictor_queue_depth": 256,
     # state-buffer donation in the jitted train step. Donation aliases
     # each state input to its output buffer (in-place updates, halves
     # peak param memory) but XLA:CPU runs donated executions

@@ -24,7 +24,41 @@ from . import io as _io
 
 __all__ = ["Config", "AnalysisConfig", "Predictor", "create_predictor",
            "PredictorTensor", "PassStrategy", "TpuPassStrategy",
-           "SerializedPredictor"]
+           "SerializedPredictor", "parse_bucket_ladder", "bucket_for"]
+
+
+def parse_bucket_ladder(spec) -> List[int]:
+    """Parse a bucket-ladder spec (FLAGS_predictor_shape_buckets): a
+    list/tuple of sizes, a comma string ("1,2,4,8,16"), or "pow2:N"
+    (powers of two up to N). Returns the sorted, deduplicated ladder;
+    empty/None specs return [] (bucketing disabled)."""
+    if spec is None:
+        return []
+    if isinstance(spec, (list, tuple)):
+        ladder = [int(x) for x in spec]
+    else:
+        s = str(spec).strip()
+        if not s:
+            return []
+        if s.startswith("pow2:"):
+            cap = int(s[len("pow2:"):])
+            ladder, b = [], 1
+            while b <= cap:
+                ladder.append(b)
+                b *= 2
+        else:
+            ladder = [int(x) for x in s.split(",") if x.strip()]
+    return sorted({b for b in ladder if b > 0})
+
+
+def bucket_for(n: int, ladder: Sequence[int]) -> Optional[int]:
+    """Smallest bucket >= n, or None when n overflows the ladder cap
+    (the caller then runs the exact shape — loud via counters, never
+    wrong)."""
+    for b in ladder:
+        if b >= n:
+            return b
+    return None
 
 
 class PassStrategy:
@@ -84,6 +118,10 @@ class Config:
         # follows FLAGS_program_cache_dir, a path pins it for this
         # predictor, "" opts this predictor out
         self._program_cache_dir: Optional[str] = None
+        # shape bucketing (docs/serving.md): None = off, True = ladder
+        # from FLAGS_predictor_shape_buckets, a list pins the ladder
+        self._shape_buckets = None
+        self._bucket_axes = (0,)
 
     def enable_program_cache(self, cache_dir: Optional[str] = None):
         """Serve this predictor's traced+compiled program from the
@@ -95,6 +133,29 @@ class Config:
 
     def disable_program_cache(self):
         self._program_cache_dir = ""
+
+    def switch_shape_bucketing(self, x: bool = True, buckets=None,
+                               axes: Sequence[int] = (0,)):
+        """Pad variable leading dims to a bucket ladder so steady-state
+        traffic hits a small, warm set of compiled executables instead
+        of recompiling per distinct input shape (docs/serving.md).
+        `buckets` pins the ladder (list or spec string); default
+        follows FLAGS_predictor_shape_buckets. `axes` selects which
+        dims bucket: axis 0 (the batch — results are sliced back to
+        the true batch) and optionally axis 1 (sequence — the model
+        must mask padding itself; outputs are NOT sliced)."""
+        if not x:
+            self._shape_buckets = None
+            return
+        self._shape_buckets = True if buckets is None else \
+            parse_bucket_ladder(buckets)
+        self._bucket_axes = tuple(sorted(set(int(a) for a in axes)))
+        if not self._bucket_axes or self._bucket_axes[0] != 0:
+            raise ValueError("bucket axes must include axis 0 (batch)")
+
+    def enable_shape_bucketing(self, buckets=None,
+                               axes: Sequence[int] = (0,)):
+        self.switch_shape_bucketing(True, buckets, axes)
 
     # parity knobs (no-ops or simple flags)
     def disable_gpu(self):
@@ -168,6 +229,10 @@ class Predictor:
             self._cast_params_bf16()
         self._feeds: Dict[str, np.ndarray] = {}
         self._outputs: Dict[str, np.ndarray] = {}
+        # bucket signatures this predictor has already executed —
+        # distinguishes steady-state bucket hits from first-touch
+        # compiles in the serving counters
+        self._warm_sigs: set = set()
 
     def _cast_params_bf16(self):
         import jax.numpy as jnp
@@ -196,17 +261,151 @@ class Predictor:
 
     def run(self, feeds: Optional[Sequence[np.ndarray]] = None):
         """Positional run (Run: analysis_predictor.cc:288) or ZeroCopyRun
-        over handles set via copy_from_cpu."""
+        over handles set via copy_from_cpu. With shape bucketing enabled
+        (Config.switch_shape_bucketing, docs/serving.md) variable
+        leading dims are padded up to the bucket ladder and results
+        sliced back to the true batch — padded rows are bitwise inert
+        for the row-independent programs inference serves."""
         if feeds is not None:
             self._feeds = dict(zip(self.feed_names, feeds))
         missing = [n for n in self.feed_names if n not in self._feeds]
         if missing:
             raise RuntimeError("missing inputs: %s" % missing)
-        outs = self.exe.run(self.program, feed=dict(self._feeds),
-                            fetch_list=list(self.fetch_names),
-                            scope=self.scope)
+        from . import telemetry as _tm
+        with _tm.span("serving/predict", track="serving",
+                      timer="TIMER_predictor_run_us"):
+            ladder = self._ladder()
+            if ladder:
+                outs = self._run_bucketed(dict(self._feeds), ladder)
+            else:
+                outs = self.exe.run(self.program, feed=dict(self._feeds),
+                                    fetch_list=list(self.fetch_names),
+                                    scope=self.scope)
         self._outputs = dict(zip(self.fetch_names, outs))
         return [self._outputs[n] for n in self.fetch_names]
+
+    # --- shape bucketing (docs/serving.md) ------------------------------
+    def _ladder(self) -> List[int]:
+        sb = getattr(self.config, "_shape_buckets", None)
+        if sb is None:
+            return []
+        if sb is True:
+            from .flags import get_flag
+            return parse_bucket_ladder(
+                get_flag("FLAGS_predictor_shape_buckets"))
+        return list(sb)
+
+    def _bucket_sig(self, arrs: Dict[str, np.ndarray]) -> tuple:
+        return tuple(sorted((n, tuple(v.shape), str(v.dtype))
+                            for n, v in arrs.items()))
+
+    def _run_bucketed(self, feeds: Dict[str, Any], ladder: List[int]):
+        from .monitor import stat_add
+        arrs = {n: np.asarray(v) for n, v in feeds.items()}
+        # the shared leading dim IS the batch; feeds that disagree on
+        # it (lookup tables fed by name, scalars) pass through unpadded
+        batches = {v.shape[0] for v in arrs.values() if v.ndim}
+        if len(batches) != 1:
+            stat_add("STAT_predictor_bucket_skip")
+            return self.exe.run(self.program, feed=arrs,
+                                fetch_list=list(self.fetch_names),
+                                scope=self.scope)
+        b = batches.pop()
+        target = bucket_for(b, ladder)
+        if target is None:
+            # louder than silent: an overflow compiles the exact shape
+            stat_add("STAT_predictor_bucket_overflow")
+            target = b
+        axes = getattr(self.config, "_bucket_axes", (0,))
+        padded = {}
+        pad_elems = 0
+        for n, v in arrs.items():
+            if not v.ndim:
+                padded[n] = v
+                continue
+            widths = [(0, 0)] * v.ndim
+            widths[0] = (0, target - v.shape[0])
+            for ax in axes:
+                # sequence-style axes bucket per-feed: the model must
+                # mask padding (docs/serving.md); outputs keep the
+                # padded extent there
+                if ax and ax < v.ndim:
+                    t = bucket_for(v.shape[ax], ladder)
+                    if t is not None and t != v.shape[ax]:
+                        widths[ax] = (0, t - v.shape[ax])
+            if any(w for _, w in widths):
+                nv = np.pad(v, widths)
+                pad_elems += nv.size - v.size
+                padded[n] = nv
+            else:
+                padded[n] = v
+        if pad_elems:
+            stat_add("STAT_predictor_pad_elements", pad_elems)
+        if target != b:
+            stat_add("STAT_predictor_pad_rows", target - b)
+        sig = self._bucket_sig(padded)
+        if sig in self._warm_sigs:
+            stat_add("STAT_predictor_bucket_hit")
+        else:
+            self._warm_sigs.add(sig)
+            stat_add("STAT_predictor_bucket_cold")
+        outs = self.exe.run(self.program, feed=padded,
+                            fetch_list=list(self.fetch_names),
+                            scope=self.scope)
+        if target != b:
+            outs = [o[:b] if getattr(o, "ndim", 0) and
+                    o.shape[0] == target else o for o in outs]
+        return outs
+
+    def warmup_buckets(self, example_feeds: Sequence,
+                       max_bucket: Optional[int] = None) -> Dict:
+        """Compile-ahead of the bucket ladder through the persistent
+        AOT program cache (core/program_cache.py warmup_ladder): one
+        zero-filled execution per bucket size, so the first real
+        request of any bucketed shape hits a warm executable. Trailing
+        dims/dtypes come from `example_feeds` (one example per feed,
+        positional like run()). Returns the per-bucket report
+        ({bucket: {"seconds", "disk_warm"} | {"error"}})."""
+        ladder = self._ladder()
+        if not ladder:
+            raise RuntimeError(
+                "shape bucketing is not enabled on this predictor "
+                "(Config.switch_shape_bucketing) or the ladder is empty")
+        if max_bucket is not None:
+            ladder = [x for x in ladder if x <= max_bucket] or \
+                ladder[:1]
+        if len(example_feeds) != len(self.feed_names):
+            raise ValueError("expected %d example feeds (%s), got %d"
+                             % (len(self.feed_names), self.feed_names,
+                                len(example_feeds)))
+        examples = {n: np.asarray(v)
+                    for n, v in zip(self.feed_names, example_feeds)}
+
+        full = self._ladder()
+        axes = getattr(self.config, "_bucket_axes", (0,))
+
+        def compile_one(bkt):
+            feeds = {}
+            for n, v in examples.items():
+                if not v.ndim:
+                    feeds[n] = v
+                    continue
+                shape = [bkt] + list(v.shape[1:])
+                for ax in axes:
+                    # extra axes pad exactly like _run_bucketed, so the
+                    # warm signature matches what serving will execute
+                    if ax and ax < v.ndim:
+                        t = bucket_for(v.shape[ax], full)
+                        if t is not None:
+                            shape[ax] = t
+                feeds[n] = np.zeros(tuple(shape), v.dtype)
+            self.exe.run(self.program, feed=feeds,
+                         fetch_list=list(self.fetch_names),
+                         scope=self.scope)
+            self._warm_sigs.add(self._bucket_sig(feeds))
+
+        from .core import program_cache
+        return program_cache.warmup_ladder(ladder, compile_one)
 
     # --- AOT serving artifact ------------------------------------------
     def export_serialized(self, path: str, example_feeds: Sequence,

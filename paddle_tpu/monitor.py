@@ -46,6 +46,19 @@ TIMER_executor_compile_us / _dispatch_us / _sync_us,
 TIMER_program_cache_load_us / _store_us, TIMER_fetch_sync_us,
 TIMER_pipeline_drain_us / _feed_stage_us, TIMER_trainstep_dispatch_us,
 TIMER_hapi_epoch_drain_us / _callback_us.
+
+The serving path (docs/serving.md) exposes:
+- bucketing: STAT_predictor_bucket_hit / _cold (warm-signature vs
+  newly-compiled bucketed calls), _skip / _overflow (calls that
+  bypassed bucketing), STAT_predictor_pad_rows / _pad_elements
+  (padding waste), STAT_program_cache_warm (warmup_buckets compiles);
+- the PredictorPool batcher: STAT_serving_requests / _batches /
+  _batched_rows (rows/batches = the amortization factor), _rejected
+  (ServingQueueFull backpressure), _batch_errors,
+  GAUGE_serving_queue_depth / _last_batch_rows, and the always-on
+  TIMER_serving_queue_wait_us / _batch_us histograms (queue wait and
+  batch execution are the serving SLO — recorded without
+  FLAGS_telemetry, like the program-cache timers).
 """
 from __future__ import annotations
 

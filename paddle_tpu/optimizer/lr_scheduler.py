@@ -282,17 +282,26 @@ class NoamLR(NoamDecay):
 
 
 class LinearLrWarmup(LRScheduler):
-    """Warmup wrapper as a class (2.0 form of linear_lr_warmup)."""
+    """Warmup wrapper as a class (2.0 form of linear_lr_warmup).
+
+    Wrapping a scheduler copies its kind/lr/params onto this instance
+    (`kind` as an instance attribute — the lr_schedule op reads the
+    wrapped formula, while the class stays LinearLrWarmup so
+    isinstance keeps working). The wrapped scheduler itself is left
+    untouched: the seed's `__class__` reassignment + shared `__dict__`
+    made `linear_lr_warmup` write the warmup attrs into the WRAPPED
+    object's params in place, silently turning it into a warmup
+    schedule for every other optimizer that used it (ADVICE.md)."""
 
     def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
                  **kw):
         if isinstance(learning_rate, LRScheduler):
-            self.__class__ = type(learning_rate)  # adopt the wrapped kind
-            self.__dict__ = dict(learning_rate.__dict__)
-            linear_lr_warmup(self, warmup_steps, start_lr, end_lr)
+            super().__init__(learning_rate.learning_rate,
+                             **dict(learning_rate.params))
+            self.kind = learning_rate.kind
         else:
             super().__init__(float(learning_rate))
-            linear_lr_warmup(self, warmup_steps, start_lr, end_lr)
+        linear_lr_warmup(self, warmup_steps, start_lr, end_lr)
 
 
 class ReduceLROnPlateau(LRScheduler):
@@ -305,11 +314,17 @@ class ReduceLROnPlateau(LRScheduler):
     kind = "constant"
 
     def __init__(self, learning_rate, mode="min", factor=0.1,
-                 patience=10, threshold=1e-4, cooldown=0, min_lr=0.0,
-                 **kw):
+                 patience=10, threshold=1e-4, threshold_mode="rel",
+                 cooldown=0, min_lr=0.0, **kw):
         super().__init__(float(learning_rate))
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max', got %r" % mode)
+        if threshold_mode not in ("rel", "abs"):
+            raise ValueError("threshold_mode must be 'rel' or 'abs', "
+                             "got %r" % threshold_mode)
         self.mode, self.factor = mode, float(factor)
         self.patience, self.threshold = int(patience), float(threshold)
+        self.threshold_mode = threshold_mode
         self.cooldown, self.min_lr = int(cooldown), float(min_lr)
         self._best = None
         self._bad = 0
@@ -318,24 +333,36 @@ class ReduceLROnPlateau(LRScheduler):
     def get_lr(self):
         return self.learning_rate
 
+    def _is_better(self, m):
+        if self._best is None:
+            return True
+        rel = self.threshold_mode == "rel"
+        if self.mode == "min":
+            bar = (self._best * (1.0 - self.threshold) if rel
+                   else self._best - self.threshold)
+            return m < bar
+        bar = (self._best * (1.0 + self.threshold) if rel
+               else self._best + self.threshold)
+        return m > bar
+
     def step(self, metrics):
         import numpy as np
         m = float(np.asarray(metrics).reshape(-1)[0])
-        better = (self._best is None
-                  or (self.mode == "min"
-                      and m < self._best - self.threshold)
-                  or (self.mode == "max"
-                      and m > self._best + self.threshold))
-        if better:
+        if self._is_better(m):
             self._best = m
             self._bad = 0
-        elif self._cool > 0:
-            self._cool -= 1
         else:
             self._bad += 1
-            if self._bad > self.patience:
-                self.learning_rate = max(
-                    self.learning_rate * self.factor, self.min_lr)
-                self._bad = 0
-                self._cool = self.cooldown
+        if self._cool > 0:
+            # cooldown ticks down EVERY epoch and suppresses the
+            # bad-epoch count entirely while active (the seed only
+            # decremented it on non-better epochs, so improving epochs
+            # froze the cooldown — ADVICE.md)
+            self._cool -= 1
+            self._bad = 0
+        if self._bad > self.patience:
+            self.learning_rate = max(
+                self.learning_rate * self.factor, self.min_lr)
+            self._cool = self.cooldown
+            self._bad = 0
         return self.learning_rate

@@ -1,0 +1,336 @@
+"""Dynamic micro-batching front-end for concurrent inference serving.
+
+The plain Predictor is single-request: N client threads calling run()
+serialize on the GIL-released XLA call and each pays full per-dispatch
+overhead. PredictorPool is the serving analog of the reference's
+multi-threaded AnalysisPredictor deployments: concurrent run() calls
+land in one bounded queue, a single batcher thread coalesces
+compatible requests (same trailing shape + dtype per feed) into one
+row-concatenated execution, and the Predictor's shape bucketing
+(docs/serving.md) pads that coalesced batch to a warm compiled
+executable. Per-request outputs are de-multiplexed by row and are
+bitwise identical to serial execution (row independence verified on
+XLA:CPU — tests/test_serving.py pins it).
+
+Knobs (flags.py): FLAGS_predictor_max_batch (coalesced-row cap),
+FLAGS_predictor_batch_timeout_ms (how long the batcher holds an
+under-full batch waiting for company), FLAGS_predictor_queue_depth
+(bounded queue — submit() blocks, then raises ServingQueueFull).
+
+Instruments (monitor.py / telemetry.py, track="serving"):
+STAT_serving_requests / _batches / _batched_rows / _rejected /
+_batch_errors, GAUGE_serving_queue_depth / _last_batch_rows,
+TIMER_serving_batch_us / _queue_wait_us.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from . import telemetry as _tm
+from .flags import get_flag
+from .monitor import gauge_set, stat_add, timer_observe
+
+__all__ = ["PredictorPool", "ServingQueueFull", "serve"]
+
+
+class ServingQueueFull(RuntimeError):
+    """Backpressure: the bounded request queue stayed full for the
+    whole submit timeout. Callers shed load or retry with backoff."""
+
+
+class _Future:
+    """Per-request completion handle (Event-based; no asyncio — the
+    serving front-end must work from plain threads)."""
+
+    __slots__ = ("_event", "_outputs", "_error", "t_submit")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._outputs = None
+        self._error = None
+        self.t_submit = time.perf_counter()
+
+    def _set(self, outputs) -> None:
+        self._outputs = outputs
+        self._event.set()
+
+    def _set_error(self, exc: BaseException) -> None:
+        self._error = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[np.ndarray]:
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving request not completed in time")
+        if self._error is not None:
+            raise self._error
+        return self._outputs
+
+
+class _Request:
+    __slots__ = ("feeds", "rows", "sig", "future")
+
+    def __init__(self, feeds, rows, sig):
+        self.feeds = feeds
+        self.rows = rows
+        self.sig = sig
+        self.future = _Future()
+
+
+_solo = object()
+
+
+def _request_sig(arrs: Sequence[np.ndarray]):
+    """Coalescing key: requests whose feeds agree on everything except
+    the leading dim can be row-concatenated into one execution. ndim
+    is part of the key (a 0-d and a 1-d feed both have trailing shape
+    ()). A request with any 0-d feed gets a never-matching key — its
+    scalar VALUE can differ between requests, so it must run alone."""
+    if any(v.ndim == 0 for v in arrs):
+        return (_solo, object())
+    return tuple((v.ndim, v.shape[1:], str(v.dtype)) for v in arrs)
+
+
+class PredictorPool:
+    """Coalesce concurrent run() calls into batched Predictor
+    executions.
+
+    `predictor` is a Config (a Predictor is created, with shape
+    bucketing switched on unless `bucketing=False`) or an existing
+    Predictor (left as configured unless `bucketing=True` forces the
+    ladder on). Only the internal batcher thread ever touches the
+    wrapped Predictor, so its feed/fetch state needs no locking.
+
+    Usage::
+
+        pool = serving.serve(config)          # or PredictorPool(...)
+        outs = pool.run([x])                  # thread-safe
+        fut = pool.submit([x]); ... fut.result()
+        pool.close()                          # or `with` block
+    """
+
+    def __init__(self, predictor, *, max_batch: Optional[int] = None,
+                 batch_timeout_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 bucketing: Optional[bool] = None,
+                 _start: bool = True):
+        from .inference import Config, create_predictor
+        if isinstance(predictor, Config):
+            if bucketing is None:
+                bucketing = True
+            if bucketing and predictor._shape_buckets is None:
+                predictor.switch_shape_bucketing(True)
+            predictor = create_predictor(predictor)
+        elif bucketing and predictor.config._shape_buckets is None:
+            predictor.config.switch_shape_bucketing(True)
+        self.predictor = predictor
+        self.max_batch = int(max_batch if max_batch is not None
+                             else get_flag("FLAGS_predictor_max_batch"))
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        t = (batch_timeout_ms if batch_timeout_ms is not None
+             else get_flag("FLAGS_predictor_batch_timeout_ms"))
+        self.batch_timeout_s = max(0.0, float(t)) / 1e3
+        self.queue_depth = int(queue_depth if queue_depth is not None
+                               else get_flag("FLAGS_predictor_queue_depth"))
+        if self.queue_depth < 1:
+            raise ValueError("queue_depth must be >= 1")
+        self._queue: deque = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._closed = False
+        self._worker: Optional[threading.Thread] = None
+        if _start:
+            self.start()
+
+    # --- lifecycle -----------------------------------------------------
+
+    def start(self) -> "PredictorPool":
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("pool is closed")
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._serve_loop, name="pt-serving-batcher",
+                    daemon=True)
+                self._worker.start()
+        return self
+
+    def close(self) -> None:
+        """Drain queued requests (the batcher finishes them), then stop
+        the batcher. Requests queued while never started get errored."""
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+            worker = self._worker
+        if worker is not None:
+            worker.join(timeout=60.0)
+        with self._lock:
+            while self._queue:
+                self._queue.popleft().future._set_error(
+                    RuntimeError("PredictorPool closed"))
+            gauge_set("GAUGE_serving_queue_depth", 0)
+
+    def __enter__(self) -> "PredictorPool":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # --- client API ----------------------------------------------------
+
+    def warmup(self, example_feeds: Sequence, max_bucket=None) -> dict:
+        """Compile-ahead of the bucket ladder (delegates to
+        Predictor.warmup_buckets) so steady-state traffic never
+        compiles. Call before opening the pool to traffic."""
+        return self.predictor.warmup_buckets(
+            example_feeds, max_bucket=max_bucket)
+
+    def submit(self, feeds: Sequence, timeout: Optional[float] = None):
+        """Enqueue one request; returns a future with .result(timeout).
+        Blocks while the queue is at FLAGS_predictor_queue_depth, then
+        raises ServingQueueFull (timeout=None blocks indefinitely)."""
+        arrs = [np.asarray(v) for v in feeds]
+        names = self.predictor.feed_names
+        if len(arrs) != len(names):
+            raise ValueError("expected %d feeds (%s), got %d"
+                             % (len(names), names, len(arrs)))
+        rows = {v.shape[0] for v in arrs if v.ndim}
+        if len(rows) != 1:
+            raise ValueError(
+                "a pooled request needs one shared leading (batch) dim "
+                "across feeds; got shapes %s"
+                % ([tuple(v.shape) for v in arrs],))
+        req = _Request(arrs, rows.pop(), _request_sig(arrs))
+        if req.rows == 0:
+            raise ValueError("empty-batch request")
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._not_full:
+            while not self._closed and len(self._queue) >= self.queue_depth:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    stat_add("STAT_serving_rejected")
+                    raise ServingQueueFull(
+                        "serving queue full (depth %d) for %.3fs"
+                        % (self.queue_depth, timeout))
+                self._not_full.wait(remaining)
+            if self._closed:
+                raise RuntimeError("PredictorPool closed")
+            self._queue.append(req)
+            stat_add("STAT_serving_requests")
+            gauge_set("GAUGE_serving_queue_depth", len(self._queue))
+            self._not_empty.notify()
+        return req.future
+
+    def run(self, feeds: Sequence,
+            timeout: Optional[float] = None) -> List[np.ndarray]:
+        """Blocking submit+wait — the thread-safe drop-in for
+        Predictor.run(feeds)."""
+        return self.submit(feeds, timeout=timeout).result(timeout)
+
+    # --- batcher -------------------------------------------------------
+
+    def _take_compatible_locked(self, sig, budget: int):
+        """Pop the first queued request that can join the batch being
+        built (same signature, fits the row budget). FIFO order within
+        a signature; other signatures keep their place for the next
+        batch."""
+        for i, r in enumerate(self._queue):
+            if r.sig == sig and r.rows <= budget:
+                del self._queue[i]
+                return r
+        return None
+
+    def _serve_loop(self) -> None:
+        while True:
+            with self._not_empty:
+                while not self._queue and not self._closed:
+                    self._not_empty.wait()
+                if not self._queue and self._closed:
+                    return
+                head = self._queue.popleft()
+                batch, rows = [head], head.rows
+                deadline = time.monotonic() + self.batch_timeout_s
+                while rows < self.max_batch and not self._closed:
+                    nxt = self._take_compatible_locked(
+                        head.sig, self.max_batch - rows)
+                    if nxt is not None:
+                        batch.append(nxt)
+                        rows += nxt.rows
+                        continue
+                    if self._queue:
+                        # backlog of incompatible/oversize requests:
+                        # nothing to wait for — execute now, they lead
+                        # the next batch immediately
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._not_empty.wait(remaining)
+                gauge_set("GAUGE_serving_queue_depth", len(self._queue))
+                self._not_full.notify_all()
+            self._execute(batch, rows)
+
+    def _execute(self, batch: List[_Request], rows: int) -> None:
+        t0 = time.perf_counter()
+        for r in batch:
+            timer_observe("TIMER_serving_queue_wait_us",
+                          (t0 - r.future.t_submit) * 1e6)
+        try:
+            if len(batch) == 1:
+                feeds: List[Any] = list(batch[0].feeds)
+            else:
+                feeds = [np.concatenate([r.feeds[i] for r in batch],
+                                        axis=0)
+                         for i in range(len(batch[0].feeds))]
+            t_exec = time.perf_counter()
+            # span for trace correlation only; the timer is observed
+            # directly so the latency histogram (the serving SLO) is
+            # populated even with FLAGS_telemetry off
+            with _tm.span("serving/batch", track="serving"):
+                outs = self.predictor.run(feeds)
+            timer_observe("TIMER_serving_batch_us",
+                          (time.perf_counter() - t_exec) * 1e6)
+            outs = [np.asarray(o) for o in outs]
+            stat_add("STAT_serving_batches")
+            stat_add("STAT_serving_batched_rows", rows)
+            gauge_set("GAUGE_serving_last_batch_rows", rows)
+            _tm.counter_sample("STAT_serving_batched_rows")
+            off = 0
+            for r in batch:
+                # per-row outputs demux by offset; non-batch outputs
+                # (e.g. a fetched weight) are shared by every request
+                r.future._set([o[off:off + r.rows]
+                               if o.ndim and o.shape[0] == rows else o
+                               for o in outs])
+                off += r.rows
+        except Exception as e:
+            stat_add("STAT_serving_batch_errors")
+            if len(batch) == 1:
+                batch[0].future._set_error(e)
+                return
+            # error isolation: one malformed request must not fail its
+            # batch-mates — retry each request alone
+            for r in batch:
+                try:
+                    outs = self.predictor.run(list(r.feeds))
+                    r.future._set([np.asarray(o) for o in outs])
+                except Exception as e2:
+                    r.future._set_error(e2)
+
+
+def serve(predictor, **kwargs) -> PredictorPool:
+    """One-call serving front-end: `pool = serving.serve(config)`."""
+    return PredictorPool(predictor, **kwargs)

@@ -23,6 +23,24 @@ __all__ = ["SerializedCore"]
 _DTYPES = ["float32", "int32", "int64", "float64", "uint8",
            "float16", "bfloat16", "bool"]
 
+# shape-bucket ladder for variable-batch serving (env because this file
+# ships framework-free inside the artifact; same spec grammar as
+# FLAGS_predictor_shape_buckets, "" disables)
+_BUCKET_ENV = "PADDLE_TPU_SHAPE_BUCKETS"
+
+
+def _bucket_ladder():
+    s = os.environ.get(_BUCKET_ENV, "pow2:128").strip()
+    if not s:
+        return []
+    if s.startswith("pow2:"):
+        cap, ladder, b = int(s[len("pow2:"):]), [], 1
+        while b <= cap:
+            ladder.append(b)
+            b *= 2
+        return ladder
+    return sorted({int(x) for x in s.split(",") if x.strip()} - {0})
+
 
 def _np_dtype(code: int):
     name = _DTYPES[code]
@@ -85,6 +103,69 @@ class SerializedCore:
         # of re-staging the exported call, and the compile itself lands
         # in (or comes from) the persistent cache enabled above
         self._call = jax.jit(self._exported.call)
+        self._batch_spec = self._recover_batch_spec()
+        # visible serving behavior for callers with no metrics registry
+        self.stats = {"calls": 0, "padded_calls": 0, "pad_rows": 0}
+
+    def _recover_batch_spec(self):
+        """The artifact's recorded leading dim per feed: an int for a
+        static export (smaller batches pad UP to it — one compiled
+        program serves any b <= B), the string "dyn" for a symbolic
+        dynamic_batch export (batches pad to the env bucket ladder so
+        steady traffic hits a few warm XLA specializations), or None
+        when the export structure can't be recovered (no padding)."""
+        try:
+            import jax
+            args, _kw = jax.tree.unflatten(self._exported.in_tree,
+                                           list(self._exported.in_avals))
+            spec = {}
+            for n, av in args[1].items():
+                if not len(av.shape):
+                    continue
+                d = av.shape[0]
+                spec[n] = int(d) if isinstance(d, int) else "dyn"
+            return spec or None
+        except Exception:
+            return None
+
+    def _pad_plan(self, feed_map):
+        """(padded_feed_map, true_rows, target) — true_rows is None
+        when no row padding happened (outputs returned as-is); target
+        is the padded batch (only outputs with that leading dim are
+        sliced back, so non-batch outputs pass through untouched)."""
+        if not self._batch_spec:
+            return feed_map, None, None
+        dims = {v.shape[0] for v in feed_map.values() if v.ndim}
+        if len(dims) != 1:
+            return feed_map, None, None
+        b = dims.pop()
+        kinds = set(self._batch_spec.values())
+        if kinds == {"dyn"}:
+            ladder = _bucket_ladder()
+            target = next((t for t in ladder if t >= b), None)
+            if target is None or target == b:
+                return feed_map, None, None
+        elif "dyn" not in kinds and len(kinds) == 1:
+            target = kinds.pop()
+            if b == target:
+                return feed_map, None, None
+            if b > target:
+                raise ValueError(
+                    "batch %d exceeds the artifact's compiled batch %d "
+                    "(re-export with a larger example batch or "
+                    "dynamic_batch=True)" % (b, target))
+        else:
+            return feed_map, None, None
+        padded = {}
+        for n, v in feed_map.items():
+            if v.ndim:
+                padded[n] = np.pad(v, [(0, target - v.shape[0])]
+                                   + [(0, 0)] * (v.ndim - 1))
+            else:
+                padded[n] = v
+        self.stats["padded_calls"] += 1
+        self.stats["pad_rows"] += target - b
+        return padded, b, target
 
     def run(self, feeds):
         if len(feeds) != len(self.feed_names):
@@ -93,8 +174,14 @@ class SerializedCore:
                                 len(feeds)))
         feed_map = {n: np.asarray(v)
                     for n, v in zip(self.feed_names, feeds)}
+        feed_map, true_rows, target = self._pad_plan(feed_map)
+        self.stats["calls"] += 1
         outs = self._call(self._state, feed_map)
-        return [np.ascontiguousarray(np.asarray(o)) for o in outs]
+        host = [np.ascontiguousarray(np.asarray(o)) for o in outs]
+        if true_rows is not None:
+            host = [o[:true_rows] if o.ndim and
+                    o.shape[0] == target else o for o in host]
+        return host
 
     # --- flat-ABI helpers for the C API --------------------------------
     @staticmethod

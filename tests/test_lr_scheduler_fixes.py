@@ -1,0 +1,106 @@
+"""LinearLrWarmup / ReduceLROnPlateau satellite fixes (ISSUE 4,
+ADVICE.md): the warmup wrapper must not mutate the wrapped scheduler
+or break isinstance, and the plateau scheduler must implement the
+reference 'rel' threshold mode and tick its cooldown every epoch."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.optimizer.lr_scheduler import (ExponentialDecay,
+                                               LinearLrWarmup,
+                                               LRScheduler,
+                                               ReduceLROnPlateau)
+
+
+def test_warmup_preserves_isinstance_and_wrapped():
+    inner = ExponentialDecay(0.1, decay_steps=10, decay_rate=0.9)
+    before = dict(inner.params)
+    w = LinearLrWarmup(inner, warmup_steps=5, start_lr=0.0, end_lr=0.1)
+    assert isinstance(w, LinearLrWarmup)
+    assert isinstance(w, LRScheduler)
+    # the wrapped scheduler is untouched and reusable elsewhere
+    assert inner.params == before
+    assert type(inner) is ExponentialDecay
+    # the wrapper adopted the wrapped formula + warmup attrs
+    assert w.kind == "exponential"
+    assert w.params["warmup_steps_linear"] == 5
+    assert w.params["decay_steps"] == 10
+
+
+def test_warmup_of_float_lr():
+    w = LinearLrWarmup(0.5, warmup_steps=3, start_lr=0.0, end_lr=0.5)
+    assert isinstance(w, LinearLrWarmup)
+    assert w.kind == "constant"
+    assert w.learning_rate == 0.5
+
+
+def test_warmup_schedule_values():
+    """The built lr var warms 0 -> end over warmup_steps, then follows
+    the wrapped exponential formula, inside a real executed program."""
+    inner = ExponentialDecay(0.1, decay_steps=1, decay_rate=0.5,
+                             staircase=True)
+    w = LinearLrWarmup(inner, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        lr_name = w._build(main, startup)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        seen = [float(np.asarray(
+            exe.run(main, fetch_list=[lr_name])[0])) for _ in range(6)]
+    np.testing.assert_allclose(seen[:4], [0.0, 0.025, 0.05, 0.075],
+                               rtol=1e-6)
+    np.testing.assert_allclose(seen[4:], [0.1 * 0.5 ** 4, 0.1 * 0.5 ** 5],
+                               rtol=1e-6)
+
+
+def test_plateau_rel_threshold_default():
+    s = ReduceLROnPlateau(0.1, patience=1, threshold=1e-2)
+    s.step(1.0)
+    # 0.995 is NOT an improvement in rel mode (needs < 0.99)
+    s.step(0.995)
+    assert s._best == 1.0 and s._bad == 1
+    s.step(0.995)  # second bad epoch > patience -> reduce
+    assert s.learning_rate == pytest.approx(0.01)
+    # 0.98 IS an improvement (< 0.995... best still 1.0 -> < 0.99)
+    s.step(0.98)
+    assert s._best == 0.98 and s._bad == 0
+
+
+def test_plateau_abs_threshold_mode():
+    s = ReduceLROnPlateau(0.1, mode="max", patience=0, threshold=0.5,
+                          threshold_mode="abs")
+    s.step(1.0)
+    s.step(1.4)  # not > 1.0 + 0.5 -> bad -> immediate reduce
+    assert s.learning_rate == pytest.approx(0.01)
+    s.step(1.6)  # > 1.0 + 0.5 -> new best
+    assert s._best == 1.6
+
+
+def test_plateau_cooldown_ticks_every_epoch():
+    s = ReduceLROnPlateau(0.1, patience=0, cooldown=3,
+                          threshold=0.0, threshold_mode="abs")
+    s.step(1.0)
+    s.step(2.0)  # bad -> reduce, cooldown starts
+    assert s.learning_rate == pytest.approx(0.01) and s._cool == 3
+    s.step(0.5)  # IMPROVING epoch: cooldown must still tick (the seed
+    assert s._cool == 2  # froze it on better epochs)
+    s.step(0.4)
+    s.step(9.0)  # bad inside cooldown: suppressed, cooldown expires
+    assert s._cool == 0 and s._bad == 0
+    assert s.learning_rate == pytest.approx(0.01)  # no double drop
+    s.step(9.0)  # cooldown over: bad epoch reduces again
+    assert s.learning_rate == pytest.approx(0.001)
+
+
+def test_plateau_min_lr_floor_and_validation():
+    s = ReduceLROnPlateau(0.1, patience=0, factor=0.1, min_lr=0.05,
+                          threshold_mode="abs", threshold=0.0)
+    s.step(1.0)
+    s.step(2.0)
+    assert s.learning_rate == pytest.approx(0.05)  # clamped
+    with pytest.raises(ValueError):
+        ReduceLROnPlateau(0.1, mode="between")
+    with pytest.raises(ValueError):
+        ReduceLROnPlateau(0.1, threshold_mode="relative")

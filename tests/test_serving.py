@@ -1,0 +1,249 @@
+"""Serving-grade Predictor tests (ISSUE 4, docs/serving.md).
+
+Covers the tentpole: the bucket ladder parser, shape-bucketed
+Predictor execution (bitwise parity with exact shapes + pinned
+STAT_executor_compile deltas), compile-ahead warmup through the AOT
+program cache (zero steady-state recompiles), the PredictorPool
+micro-batcher (multi-threaded mixed-shape stress with bitwise parity
+vs serial execution, serving counter deltas, queue backpressure,
+error isolation, lifecycle), and the framework-free SerializedCore
+batch padding (static pad-up + overflow, env-ladder for
+dynamic-batch exports).
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, serving
+from paddle_tpu.inference import (Config, bucket_for, create_predictor,
+                                  parse_bucket_ladder)
+from paddle_tpu.monitor import stat_get
+
+
+@pytest.fixture
+def model_dir(tmp_path):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [6])
+        h = layers.fc(x, 16, act="relu")
+        y = layers.fc(h, 3, name="out")
+    exe = pt.Executor()
+    exe.run(startup)
+    d = str(tmp_path / "model")
+    pt.io.save_inference_model(d, ["x"], [y], exe, main_program=main)
+    return d
+
+
+def _reqs(sizes, width=6, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randn(int(b), width).astype(np.float32) for b in sizes]
+
+
+# ---------------------------------------------------------------------------
+# ladder parsing / bucket selection
+# ---------------------------------------------------------------------------
+
+def test_parse_bucket_ladder():
+    assert parse_bucket_ladder("pow2:16") == [1, 2, 4, 8, 16]
+    assert parse_bucket_ladder("8, 1,4,4") == [1, 4, 8]
+    assert parse_bucket_ladder([3, 1, 3]) == [1, 3]
+    assert parse_bucket_ladder("") == []
+    assert parse_bucket_ladder(None) == []
+
+
+def test_bucket_for():
+    ladder = [1, 2, 4, 8]
+    assert bucket_for(1, ladder) == 1
+    assert bucket_for(3, ladder) == 4
+    assert bucket_for(8, ladder) == 8
+    assert bucket_for(9, ladder) is None  # overflow -> exact shape
+    assert bucket_for(1, []) is None
+
+
+def test_bad_bucket_config(model_dir):
+    cfg = Config(model_dir)
+    with pytest.raises(ValueError):
+        cfg.switch_shape_bucketing(True, axes=(1,))  # must include 0
+
+
+# ---------------------------------------------------------------------------
+# bucketed Predictor
+# ---------------------------------------------------------------------------
+
+def test_bucketed_parity_and_compile_count(model_dir):
+    sizes = [1, 3, 5, 6, 7, 2, 3, 5]  # 6 distinct -> 4 buckets
+    reqs = _reqs(sizes)
+
+    plain = create_predictor(Config(model_dir))
+    expected = [np.asarray(plain.run([r])[0]) for r in reqs]
+
+    cfg = Config(model_dir)
+    cfg.switch_shape_bucketing(True, buckets=[1, 2, 4, 8])
+    bucketed = create_predictor(cfg)
+    c0 = stat_get("STAT_executor_compile")
+    h0 = stat_get("STAT_predictor_bucket_hit")
+    outs = [np.asarray(bucketed.run([r])[0]) for r in reqs]
+    compiles = stat_get("STAT_executor_compile") - c0
+
+    for o, e in zip(outs, expected):
+        assert o.shape == e.shape
+        np.testing.assert_array_equal(o, e)  # bitwise: rows independent
+    # 8 requests, 6 distinct sizes, but only buckets {1,2,4,8} compile
+    assert compiles == 4
+    assert stat_get("STAT_predictor_bucket_hit") - h0 == 4
+
+
+def test_bucket_overflow_runs_exact(model_dir):
+    cfg = Config(model_dir)
+    cfg.switch_shape_bucketing(True, buckets=[1, 2, 4])
+    p = create_predictor(cfg)
+    o0 = stat_get("STAT_predictor_bucket_overflow")
+    (r,) = _reqs([9])
+    out = np.asarray(p.run([r])[0])
+    assert out.shape[0] == 9
+    assert stat_get("STAT_predictor_bucket_overflow") - o0 == 1
+
+
+def test_warmup_kills_steady_state_recompiles(model_dir, tmp_path):
+    cfg = Config(model_dir)
+    cfg.switch_shape_bucketing(True, buckets="pow2:8")
+    cfg.enable_program_cache(str(tmp_path / "aot"))
+    p = create_predictor(cfg)
+    report = p.warmup_buckets([np.zeros((1, 6), np.float32)])
+    assert sorted(report) == [1, 2, 4, 8]
+    assert all("error" not in v for v in report.values())
+
+    c0 = stat_get("STAT_executor_compile")
+    for r in _reqs([1, 2, 3, 5, 8, 4, 7]):
+        p.run([r])
+    assert stat_get("STAT_executor_compile") - c0 == 0
+
+
+def test_warmup_requires_bucketing(model_dir):
+    p = create_predictor(Config(model_dir))
+    with pytest.raises(RuntimeError):
+        p.warmup_buckets([np.zeros((1, 6), np.float32)])
+
+
+# ---------------------------------------------------------------------------
+# PredictorPool
+# ---------------------------------------------------------------------------
+
+def test_pool_concurrent_parity_and_counters(model_dir):
+    sizes = np.random.RandomState(3).randint(1, 9, size=48)
+    reqs = _reqs(sizes, seed=1)
+    ref = create_predictor(Config(model_dir))
+    expected = [np.asarray(ref.run([r])[0]) for r in reqs]
+
+    cfg = Config(model_dir)
+    cfg.switch_shape_bucketing(True, buckets="pow2:32")
+    with serving.PredictorPool(cfg, max_batch=32,
+                               batch_timeout_ms=5.0) as pool:
+        pool.warmup([np.zeros((1, 6), np.float32)])
+        q0 = stat_get("STAT_serving_requests")
+        b0 = stat_get("STAT_serving_batches")
+        rw0 = stat_get("STAT_serving_batched_rows")
+        c0 = stat_get("STAT_executor_compile")
+
+        outs = [None] * len(reqs)
+
+        def worker(tid):
+            for i in range(tid, len(reqs), 8):
+                outs[i] = np.asarray(pool.run([reqs[i]])[0])
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        for o, e in zip(outs, expected):
+            np.testing.assert_array_equal(o, e)  # bitwise vs serial
+        assert stat_get("STAT_executor_compile") - c0 == 0
+        assert stat_get("STAT_serving_requests") - q0 == len(reqs)
+        batches = stat_get("STAT_serving_batches") - b0
+        assert 1 <= batches < len(reqs)  # actually coalesced
+        assert stat_get("STAT_serving_batched_rows") - rw0 == \
+            sum(int(s) for s in sizes)
+
+
+def test_pool_backpressure(model_dir):
+    cfg = Config(model_dir)
+    pred = create_predictor(cfg)
+    pool = serving.PredictorPool(pred, queue_depth=2, bucketing=False,
+                                 _start=False)  # batcher never drains
+    (r,) = _reqs([2])
+    f1, f2 = pool.submit([r]), pool.submit([r])
+    rej0 = stat_get("STAT_serving_rejected")
+    with pytest.raises(serving.ServingQueueFull):
+        pool.submit([r], timeout=0.05)
+    assert stat_get("STAT_serving_rejected") - rej0 == 1
+    pool.close()
+    # queued-but-never-run requests fail loudly, not silently hang
+    with pytest.raises(RuntimeError):
+        f1.result(timeout=1.0)
+    with pytest.raises(RuntimeError):
+        f2.result(timeout=1.0)
+    with pytest.raises(RuntimeError):
+        pool.submit([r])  # closed pool rejects new work
+
+
+def test_pool_error_isolation(model_dir):
+    cfg = Config(model_dir)
+    cfg.switch_shape_bucketing(True, buckets="pow2:8")
+    with serving.PredictorPool(cfg, batch_timeout_ms=1.0) as pool:
+        (good,) = _reqs([2])
+        expected = np.asarray(pool.run([good])[0])
+        with pytest.raises(Exception):
+            pool.run([np.zeros((2, 5), np.float32)])  # wrong width
+        # the pool survives a poisoned request
+        np.testing.assert_array_equal(
+            np.asarray(pool.run([good])[0]), expected)
+
+
+def test_pool_rejects_mismatched_feeds(model_dir):
+    with serving.PredictorPool(Config(model_dir)) as pool:
+        with pytest.raises(ValueError):
+            pool.submit([])  # wrong feed count
+        with pytest.raises(ValueError):
+            pool.submit([np.zeros((0, 6), np.float32)])  # empty batch
+
+
+# ---------------------------------------------------------------------------
+# SerializedCore padding (framework-free path)
+# ---------------------------------------------------------------------------
+
+def _export(model_dir, tmp_path, batch, **kw):
+    p = create_predictor(Config(model_dir))
+    d = str(tmp_path / ("artifact_b%d" % batch))
+    p.export_serialized(d, [np.zeros((batch, 6), np.float32)], **kw)
+    return d
+
+
+def test_serialized_static_pad_up(model_dir, tmp_path):
+    from paddle_tpu.serving_core import SerializedCore
+    d = _export(model_dir, tmp_path, batch=8)
+    core = SerializedCore(d)
+    ref = create_predictor(Config(model_dir))
+    (r,) = _reqs([3])
+    out = core.run([r])[0]
+    assert out.shape[0] == 3
+    np.testing.assert_array_equal(out, np.asarray(ref.run([r])[0]))
+    assert core.stats["padded_calls"] == 1
+    assert core.stats["pad_rows"] == 5
+    with pytest.raises(ValueError):  # b > compiled batch is loud
+        core.run([np.zeros((9, 6), np.float32)])
+
+
+def test_serialized_bucket_env_disable(model_dir, tmp_path, monkeypatch):
+    from paddle_tpu.serving_core import _bucket_ladder
+    monkeypatch.setenv("PADDLE_TPU_SHAPE_BUCKETS", "")
+    assert _bucket_ladder() == []
+    monkeypatch.setenv("PADDLE_TPU_SHAPE_BUCKETS", "1,2,4")
+    assert _bucket_ladder() == [1, 2, 4]
+    monkeypatch.delenv("PADDLE_TPU_SHAPE_BUCKETS")
+    assert _bucket_ladder() == [2 ** i for i in range(8)]
