@@ -40,6 +40,7 @@ from .io import (save, load, save_persistables, load_persistables,  # noqa: F401
                  load_inference_model, save_dygraph, load_dygraph)
 from . import inference  # noqa: F401
 from . import serving  # noqa: F401
+from . import generation  # noqa: F401
 from . import incubate  # noqa: F401
 from . import reader  # noqa: F401
 from .reader import DataLoader, batch  # noqa: F401

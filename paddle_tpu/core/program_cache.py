@@ -249,6 +249,68 @@ def has_trace(cache_dir: str, fingerprint: str) -> bool:
         return False
 
 
+def fn_fingerprint(tag: str, meta: dict) -> str:
+    """Disk-cache key for a non-Program jitted function (the generation
+    engine's prefill/decode steps): sha256 over a caller-provided tag +
+    JSON-able metadata (config, shapes, bucket) + lowering-relevant
+    FLAGS + jax/backend versions + the framework source token — the
+    same invalidation surface as Program.fingerprint, for computations
+    that never had a Program."""
+    import jax
+    import jaxlib
+    from ..flags import lowering_snapshot
+    h = hashlib.sha256()
+    h.update(json.dumps({
+        "tag": tag,
+        "meta": meta,
+        "flags": lowering_snapshot(),
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": jax.default_backend(),
+        "framework": framework_token(),
+    }, sort_keys=True, default=str).encode())
+    return h.hexdigest()
+
+
+def exported_entry(cache_dir: str, fingerprint: str, fn, avals):
+    """Generic disk-backed AOT entry: the Executor._aot_entry recipe
+    (load -> deserialize -> aval check -> jit(exported.call); on miss
+    export, round-trip the bytes, store) for any jit-able `fn` called
+    as `fn(*avals)`. Returns the callable, or None when this function
+    cannot be disk-cached (unexportable lowering, IO trouble) — the
+    caller falls back to plain jax.jit(fn)."""
+    import jax
+    import jax.export
+    ensure_xla_cache(cache_dir)
+    exported = None
+    payload = load_trace(cache_dir, fingerprint)
+    if payload is not None:
+        try:
+            cand = jax.export.deserialize(payload)
+            ours = [(tuple(a.shape), str(a.dtype))
+                    for a in jax.tree.leaves(
+                        jax.eval_shape(lambda *xs: xs, *avals))]
+            theirs = [(tuple(a.shape), str(a.dtype))
+                      for a in cand.in_avals]
+            if ours == theirs:
+                exported = cand
+            else:
+                raise ValueError("aval mismatch")
+        except Exception:
+            _stat_add("STAT_program_cache_corrupt")
+            discard_trace(cache_dir, fingerprint)
+            exported = None
+    if exported is None:
+        try:
+            data = jax.export.export(jax.jit(fn))(*avals).serialize()
+            exported = jax.export.deserialize(data)
+        except Exception:
+            _stat_add("STAT_program_cache_unexportable")
+            return None
+        store_trace(cache_dir, fingerprint, data)
+    return jax.jit(exported.call)
+
+
 def warmup_ladder(buckets, compile_one) -> dict:
     """Compile-ahead of a shape-bucket ladder (docs/serving.md): run
     `compile_one(bucket)` for every bucket size, ascending, and report

@@ -40,7 +40,11 @@ _DEFS: Dict[str, Any] = {
     # 1.5x faster than flash+HBM-mask at the scored S=512 config
     # (8.54ms vs 12.71ms f+b, tpu_experiments.py 2b). The ADVICE-r4
     # caveat (no interpret-mode oracle) is discharged by that on-chip
-    # parity gate, which the run sheet re-runs every session.
+    # parity gate, which the run sheet re-runs every session — and
+    # enforced at runtime by the parity-freshness stamp the parity run
+    # writes (kernel-source-hash marker; flash_attention falls back to
+    # the HBM-mask path with a one-time warning when it is missing or
+    # stale — ADVICE r5).
     "FLAGS_flash_inkernel_dropout": True,
     # dropout backward-residual strategy: "xla" leaves storage to XLA's
     # cost model (observed: 4 bytes/element u32 buffers), "u8" pins a
@@ -99,6 +103,30 @@ _DEFS: Dict[str, Any] = {
     "FLAGS_predictor_max_batch": 32,
     "FLAGS_predictor_batch_timeout_ms": 2.0,
     "FLAGS_predictor_queue_depth": 256,
+    # autoregressive generation engine (paddle_tpu/generation/,
+    # docs/generation.md). The paged KV cache is a FIXED preallocated
+    # pool: kv_blocks blocks of block_size tokens per layer, shared by
+    # every in-flight sequence (block 0 is a reserved scratch block for
+    # inactive decode lanes). decode_width is the fixed width of the
+    # continuous decode batch — sequences join/leave slots without
+    # changing the compiled shape. prefill_buckets is the prompt-length
+    # ladder (same grammar as FLAGS_predictor_shape_buckets); the
+    # prompt is right-padded to the bucket so prefill hits a small warm
+    # set of executables.
+    "FLAGS_generation_kv_blocks": 128,
+    "FLAGS_generation_block_size": 16,
+    "FLAGS_generation_decode_width": 8,
+    "FLAGS_generation_prefill_buckets": "pow2:512",
+    # bounded request queue of the continuous-batching scheduler
+    # (generation.GenerationPool): submit blocks, then raises
+    # ServingQueueFull — same backpressure contract as PredictorPool
+    "FLAGS_generation_queue_depth": 256,
+    # paged-attention decode path (kernels/paged_attention.py):
+    # "reference" = gather + masked softmax in plain XLA (runs
+    # everywhere, the parity oracle), "pallas" = the blocked Pallas
+    # kernel (scalar-prefetched block tables; interpret-mode on CPU).
+    # Read at trace time -> part of every generation compile key.
+    "FLAGS_paged_attention_kernel": "reference",
     # state-buffer donation in the jitted train step. Donation aliases
     # each state input to its output buffer (in-place updates, halves
     # peak param memory) but XLA:CPU runs donated executions
@@ -123,6 +151,7 @@ _LOWERING_FLAGS = [
     "FLAGS_embedding_onehot_grad",
     "FLAGS_flash_attention_fallback",
     "FLAGS_flash_inkernel_dropout",
+    "FLAGS_paged_attention_kernel",
     # not read during lowering, but it changes the COMPILED executable
     # (jit donate_argnums): a mid-process flip must miss the caches
     "FLAGS_executor_donate_state",

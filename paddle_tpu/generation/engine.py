@@ -1,0 +1,620 @@
+"""GenerationEngine: bucketed prefill + fixed-shape continuous decode.
+
+The engine owns the device state (params, the per-layer K/V block
+pools) and a fixed-width decode batch of `decode_width` LANES. A
+sequence's life: admitted -> blocks allocated -> prefill at a bucket
+from FLAGS_generation_prefill_buckets (one compiled prefill per bucket,
+PR-4 ladder grammar) -> parked in a free lane -> advanced one token per
+`step()` by ONE compiled decode executable shared by every lane ->
+leaves at EOS/max_new_tokens, blocks freed, lane reusable. Inactive
+lanes point their block table at the trash block and are never sampled.
+
+Fixed shapes everywhere mean the steady state replays exactly the warm
+executables: STAT_generation_compile counts engine-level compilations
+(tests pin it at zero across a mixed-length continuous stream), and
+when the persistent program cache (PR 1) is enabled the prefill/decode
+steps are exported through program_cache.exported_entry so even a
+fresh process skips retrace+recompile.
+
+Pool pressure: if a mid-decode block extension finds the pool empty,
+the YOUNGEST sequence is preempted — blocks freed, request re-queued by
+the scheduler — and because sampling is deterministic per (seed, step)
+its replay regenerates the identical prefix (sampling.py).
+
+Instruments (track="generation"): STAT_generation_requests /
+_tokens / _prefills / _evictions / _compile / _errors,
+GAUGE_generation_active_seqs (+ kv_cache block gauges),
+TIMER_generation_prefill_us / _decode_step_us.
+"""
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry as _tm
+from ..core import program_cache
+from ..flags import get_flag
+from ..inference import bucket_for, parse_bucket_ladder
+from ..monitor import gauge_set, stat_add, timer_observe
+from .kv_cache import TRASH_BLOCK, BlockPoolExhausted, KVCacheManager
+from .model import DecoderConfig, forward_full, forward_paged
+from .sampling import SamplingParams, sample_tokens
+
+__all__ = ["GenerationEngine", "GenerationRequest", "GenerationResult",
+           "NaiveGenerator"]
+
+
+@dataclass
+class GenerationRequest:
+    """One decoding job: prompt token ids + termination + sampling."""
+    prompt: Sequence[int]
+    max_new_tokens: int = 16
+    eos_token: Optional[int] = None
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    request_id: Any = None
+
+
+@dataclass
+class GenerationResult:
+    request_id: Any
+    prompt_len: int
+    tokens: List[int]              # generated ids (no prompt, no EOS)
+    finish_reason: str             # "eos" | "length"
+    evictions: int = 0             # times this request was replayed
+
+
+class _Seq:
+    """Host-side state of one in-flight sequence."""
+
+    __slots__ = ("req", "ctx", "generated", "lane", "admit_order",
+                 "evictions", "t_last_token")
+
+    def __init__(self, req: GenerationRequest, admit_order: int):
+        self.req = req
+        self.ctx = 0               # tokens currently in the KV pool
+        self.generated: List[int] = []
+        self.lane = -1
+        self.admit_order = admit_order
+        self.evictions = 0
+        self.t_last_token = time.perf_counter()
+
+
+class GenerationEngine:
+    """Continuous-batching decode engine over the paged KV cache.
+
+    `submit()` admits a request (prefill happens on the next `step()`),
+    `step()` advances every active lane one token and returns the
+    requests that finished, `generate()` is the batteries-included
+    run-to-completion loop. The engine is NOT thread-safe — the
+    scheduler (generation.GenerationPool) is the concurrent front-end.
+    """
+
+    def __init__(self, cfg: DecoderConfig, params: Dict[str, Any], *,
+                 num_blocks: Optional[int] = None,
+                 block_size: Optional[int] = None,
+                 decode_width: Optional[int] = None,
+                 prefill_buckets=None,
+                 program_cache_dir: Optional[str] = None):
+        self.cfg = cfg
+        self.params = jax.tree.map(jnp.asarray, params)
+        nb = int(num_blocks if num_blocks is not None
+                 else get_flag("FLAGS_generation_kv_blocks"))
+        bs = int(block_size if block_size is not None
+                 else get_flag("FLAGS_generation_block_size"))
+        self.decode_width = int(
+            decode_width if decode_width is not None
+            else get_flag("FLAGS_generation_decode_width"))
+        if self.decode_width < 1:
+            raise ValueError("decode_width must be >= 1")
+        spec = (prefill_buckets if prefill_buckets is not None
+                else get_flag("FLAGS_generation_prefill_buckets"))
+        self.prefill_ladder = [b for b in parse_bucket_ladder(spec)
+                               if b <= cfg.max_seq_len]
+        if not self.prefill_ladder:
+            self.prefill_ladder = [cfg.max_seq_len]
+        self.kv = KVCacheManager(nb, bs)
+        # table width: enough blocks for a max-length context
+        self.max_blocks_per_seq = self.kv.blocks_for_tokens(
+            cfg.max_seq_len)
+        # fixed attention lane count shared by prefill and decode —
+        # the bitwise-parity requirement (model.forward_full docstring)
+        self.attn_lanes = self.max_blocks_per_seq * bs
+        shape = (cfg.layers, nb, bs, cfg.heads, cfg.head_dim)
+        self.k_pools = jnp.zeros(shape, jnp.float32)
+        self.v_pools = jnp.zeros(shape, jnp.float32)
+        self._program_cache_dir = program_cache_dir
+        # compiled-step registry: dict miss == an engine compilation
+        # (STAT_generation_compile — the zero-steady-state-recompile
+        # pin counts THIS, plus the fixed shapes make jax's own cache
+        # hit whenever this dict does)
+        self._fns: Dict[Any, Any] = {}
+        # decode lanes (fixed width): parallel host arrays
+        w = self.decode_width
+        self._lane_seq: List[Optional[_Seq]] = [None] * w
+        self._tables = np.zeros((w, self.max_blocks_per_seq), np.int32)
+        self._ctx = np.zeros((w,), np.int32)
+        self._temps = np.zeros((w,), np.float32)
+        self._top_ks = np.zeros((w,), np.int32)
+        self._top_ps = np.ones((w,), np.float32)
+        self._seeds = np.zeros((w,), np.int32)
+        self._pending: List[_Seq] = []     # admitted, awaiting prefill
+        self._admit_counter = 0
+        # per-request error sink: the scheduler points this at the
+        # request's future; the bare engine re-raises
+        self.on_request_error = None
+
+    # --- compiled-step registry ---------------------------------------
+
+    def _get_fn(self, kind: str, bucket: int = 0):
+        key = (kind, bucket)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        stat_add("STAT_generation_compile")
+        cfg = self.cfg
+        if kind == "prefill":
+            lanes = self.attn_lanes
+
+            def raw(params, tokens, lengths):
+                return forward_full(cfg, params, tokens, lengths,
+                                    attn_lanes=lanes)
+            avals = (
+                jax.tree.map(_sds, self.params),
+                jax.ShapeDtypeStruct((1, bucket), jnp.int32),
+                jax.ShapeDtypeStruct((1,), jnp.int32),
+            )
+        elif kind == "decode":
+            def raw(params, kp, vp, tables, ctx, tokens, temps, tks,
+                    tps, seeds, steps):
+                logits, kp2, vp2 = forward_paged(
+                    cfg, params, kp, vp, tables, ctx, tokens)
+                nxt = sample_tokens(logits, temps, tks, tps, seeds,
+                                    steps)
+                return nxt, kp2, vp2
+            w, m = self.decode_width, self.max_blocks_per_seq
+            i32 = jnp.int32
+            avals = (
+                jax.tree.map(_sds, self.params),
+                _sds(self.k_pools), _sds(self.v_pools),
+                jax.ShapeDtypeStruct((w, m), i32),
+                jax.ShapeDtypeStruct((w,), i32),
+                jax.ShapeDtypeStruct((w,), i32),
+                jax.ShapeDtypeStruct((w,), jnp.float32),
+                jax.ShapeDtypeStruct((w,), i32),
+                jax.ShapeDtypeStruct((w,), jnp.float32),
+                jax.ShapeDtypeStruct((w,), i32),
+                jax.ShapeDtypeStruct((w,), i32),
+            )
+        else:
+            raise ValueError(kind)
+        fn = self._aot_or_jit(kind, bucket, raw, avals)
+        self._fns[key] = fn
+        return fn
+
+    def _aot_or_jit(self, kind: str, bucket: int, raw, avals):
+        """Route the step through the persistent AOT program cache
+        (PR 1) when a cache dir resolves; plain jit otherwise."""
+        cache_dir = program_cache.resolve_dir(self._program_cache_dir)
+        if cache_dir is not None:
+            meta = dict(self.cfg.meta(), kind=kind, bucket=bucket,
+                        blocks=self.kv.num_blocks,
+                        block_size=self.kv.block_size,
+                        width=self.decode_width,
+                        table=self.max_blocks_per_seq,
+                        lanes=self.attn_lanes)
+            fp = program_cache.fn_fingerprint("generation_step", meta)
+            fn = program_cache.exported_entry(cache_dir, fp, raw, avals)
+            if fn is not None:
+                return fn
+        return jax.jit(raw)
+
+    def warmup(self, buckets=None) -> dict:
+        """Compile-ahead: the decode step plus every prefill bucket
+        (or the given subset). Steady state then never compiles."""
+        report = {}
+        t0 = time.perf_counter()
+        self._warm_decode()
+        report["decode"] = round(time.perf_counter() - t0, 4)
+        for b in sorted(set(buckets) if buckets is not None
+                        else self.prefill_ladder):
+            t0 = time.perf_counter()
+            self._warm_prefill(int(b))
+            report[int(b)] = round(time.perf_counter() - t0, 4)
+        return report
+
+    def _warm_prefill(self, bucket: int) -> None:
+        fn = self._get_fn("prefill", bucket)
+        _, kc, vc = fn(self.params, jnp.zeros((1, bucket), jnp.int32),
+                       jnp.ones((1,), jnp.int32))
+        # the cache scatter is an eager op with bucket-shaped index
+        # arrays — compile it now too (into the trash block, harmless)
+        bs = self.kv.block_size
+        blk = np.zeros(bucket, np.int32)  # TRASH_BLOCK
+        off = (np.arange(bucket) % bs).astype(np.int32)
+        self.k_pools = self.k_pools.at[:, blk, off].set(kc[:, 0])
+        self.v_pools = self.v_pools.at[:, blk, off].set(vc[:, 0])
+
+    def _warm_decode(self) -> None:
+        fn = self._get_fn("decode")
+        w = self.decode_width
+        z = jnp.zeros((w,), jnp.int32)
+        fn(self.params, self.k_pools, self.v_pools,
+           jnp.zeros((w, self.max_blocks_per_seq), jnp.int32), z, z,
+           jnp.zeros((w,), jnp.float32), z, jnp.ones((w,), jnp.float32),
+           z, z)
+
+    # --- admission -----------------------------------------------------
+
+    def submit(self, req: GenerationRequest) -> None:
+        """Validate + queue a request. Raises ValueError on a request
+        that can never run (too long, empty) — per-request isolation:
+        a bad request touches no shared state."""
+        prompt = list(int(t) for t in req.prompt)
+        if not prompt:
+            raise ValueError("empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = len(prompt) + int(req.max_new_tokens)
+        if total > self.cfg.max_seq_len:
+            raise ValueError(
+                "prompt (%d) + max_new_tokens (%d) exceeds max_seq_len "
+                "%d" % (len(prompt), req.max_new_tokens,
+                        self.cfg.max_seq_len))
+        if bucket_for(len(prompt), self.prefill_ladder) is None:
+            raise ValueError(
+                "prompt length %d overflows the prefill ladder %r"
+                % (len(prompt), self.prefill_ladder))
+        if self.kv.blocks_for_tokens(total) > self.kv.num_blocks - 1:
+            raise ValueError(
+                "request needs %d blocks but the pool only has %d "
+                "(FLAGS_generation_kv_blocks) — it could never run"
+                % (self.kv.blocks_for_tokens(total),
+                   self.kv.num_blocks - 1))
+        req = replace(req, prompt=prompt)
+        seq = _Seq(req, self._admit_counter)
+        self._admit_counter += 1
+        self._pending.append(seq)
+        stat_add("STAT_generation_requests")
+
+    @property
+    def active_count(self) -> int:
+        return sum(s is not None for s in self._lane_seq)
+
+    @property
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+    @property
+    def idle(self) -> bool:
+        return self.active_count == 0 and not self._pending
+
+    # --- the step ------------------------------------------------------
+
+    def step(self) -> List[GenerationResult]:
+        """One scheduler tick: admit pending requests into free lanes
+        (prefill), advance every active lane one token, retire finished
+        sequences. Returns the finished results (possibly empty)."""
+        self._admit()
+        if self.active_count == 0:
+            return []
+        return self._decode_once()
+
+    def _admit(self) -> None:
+        """Prefill pending requests into free lanes, oldest first.
+        Pool exhaustion stops admission (decode continues; completions
+        will free blocks)."""
+        for lane in range(self.decode_width):
+            if not self._pending or self._lane_seq[lane] is not None:
+                continue
+            seq = self._pending[0]
+            try:
+                if not self._prefill_into(seq, lane):
+                    break                      # pool full: try later
+            except Exception as e:
+                # per-request isolation: a prefill failure kills only
+                # this request
+                self._pending.pop(0)
+                stat_add("STAT_generation_errors")
+                self._deliver_error(seq, e)
+                continue
+            self._pending.pop(0)
+        gauge_set("GAUGE_generation_active_seqs", self.active_count)
+
+    def _prefill_into(self, seq: _Seq, lane: int) -> bool:
+        """Run bucketed prefill for `seq` and park it in `lane`.
+        Returns False (untouched state) when the pool can't hold the
+        prompt right now."""
+        prompt = seq.req.prompt
+        n = len(prompt)
+        need = self.kv.blocks_for_tokens(n + 1)  # room for 1st decode
+        if need > self.kv.free_blocks:
+            return False
+        bucket = bucket_for(n, self.prefill_ladder)
+        t0 = time.perf_counter()
+        with _tm.span("generation/prefill", track="generation"):
+            fn = self._get_fn("prefill", bucket)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :n] = prompt
+            logits, kc, vc = fn(self.params, jnp.asarray(toks),
+                                jnp.asarray([n], np.int32))
+            sid = id(seq)
+            self.kv.alloc(sid, need)
+            table = self.kv.table(sid, self.max_blocks_per_seq)
+            # scatter the prefill K/V into the pool: positions 0..n-1
+            # land at (table[pos//bs], pos%bs). The index arrays span
+            # the whole BUCKET, not just n — a length-n scatter would
+            # compile once per distinct prompt length (measured ~80ms
+            # each on CPU), a bucket-length one compiles once per
+            # ladder rung. Pad positions land in the trash block (via
+            # the trash-padded table) or in allocated-but-unwritten
+            # slots; neither is ever visible (the position mask only
+            # exposes slots the decode loop has since overwritten).
+            bs = self.kv.block_size
+            pos = np.arange(bucket)
+            tbl = np.asarray(table, np.int32)
+            blk = tbl[np.minimum(pos // bs, len(tbl) - 1)]
+            off = (pos % bs).astype(np.int32)
+            self.k_pools = self.k_pools.at[:, blk, off].set(
+                kc[:, 0, :bucket])
+            self.v_pools = self.v_pools.at[:, blk, off].set(
+                vc[:, 0, :bucket])
+        timer_observe("TIMER_generation_prefill_us",
+                      (time.perf_counter() - t0) * 1e6)
+        stat_add("STAT_generation_prefills")
+        # the prompt's "next token" comes from the prefill logits: feed
+        # it to the first decode step via the sampler's step counter 0
+        first = self._sample_host(seq, np.asarray(logits)[0], step=0)
+        seq.generated.append(first)
+        seq.ctx = n
+        seq.lane = lane
+        seq.t_last_token = time.perf_counter()
+        self._lane_seq[lane] = seq
+        sp = seq.req.sampling
+        self._tables[lane] = table
+        self._ctx[lane] = n
+        self._temps[lane] = sp.temperature
+        self._top_ks[lane] = sp.top_k
+        self._top_ps[lane] = sp.top_p
+        self._seeds[lane] = sp.seed
+        stat_add("STAT_generation_tokens")
+        return True
+
+    def _sample_host(self, seq: _Seq, logits_row: np.ndarray,
+                     step: int) -> int:
+        """Sample ONE token outside the decode batch (prefill's first
+        token) — same vmapped sampler as the decode step, width-1, so
+        the token stream is identical to an all-device run."""
+        out = sample_tokens(
+            jnp.asarray(logits_row)[None],
+            jnp.asarray([seq.req.sampling.temperature], jnp.float32),
+            jnp.asarray([seq.req.sampling.top_k], jnp.int32),
+            jnp.asarray([seq.req.sampling.top_p], jnp.float32),
+            jnp.asarray([seq.req.sampling.seed], jnp.int32),
+            jnp.asarray([step], jnp.int32))
+        return int(np.asarray(out)[0])
+
+    def _decode_once(self) -> List[GenerationResult]:
+        """Advance all active lanes one token (inactive lanes spin on
+        the trash block)."""
+        finished: List[GenerationResult] = []
+        # retire sequences whose PREVIOUS token already terminated them
+        for lane, seq in enumerate(self._lane_seq):
+            if seq is None:
+                continue
+            done = self._finish_reason(seq)
+            if done is not None:
+                finished.append(self._retire(lane, done))
+        self._ensure_blocks()
+        w = self.decode_width
+        tokens = np.zeros((w,), np.int32)
+        steps = np.zeros((w,), np.int32)
+        active = [ln for ln, s in enumerate(self._lane_seq)
+                  if s is not None]
+        if not active:
+            gauge_set("GAUGE_generation_active_seqs", 0)
+            return finished
+        for ln in active:
+            seq = self._lane_seq[ln]
+            tokens[ln] = seq.generated[-1]
+            steps[ln] = len(seq.generated)
+        t0 = time.perf_counter()
+        with _tm.span("generation/decode_step", track="generation"):
+            fn = self._get_fn("decode")
+            nxt, self.k_pools, self.v_pools = fn(
+                self.params, self.k_pools, self.v_pools,
+                jnp.asarray(self._tables), jnp.asarray(self._ctx),
+                jnp.asarray(tokens), jnp.asarray(self._temps),
+                jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
+                jnp.asarray(self._seeds), jnp.asarray(steps))
+            nxt = np.asarray(nxt)
+        timer_observe("TIMER_generation_decode_step_us",
+                      (time.perf_counter() - t0) * 1e6)
+        now = time.perf_counter()
+        for ln in active:
+            seq = self._lane_seq[ln]
+            seq.ctx += 1
+            self._ctx[ln] = seq.ctx
+            seq.generated.append(int(nxt[ln]))
+            timer_observe("TIMER_generation_inter_token_us",
+                          (now - seq.t_last_token) * 1e6)
+            seq.t_last_token = now
+            stat_add("STAT_generation_tokens")
+            done = self._finish_reason(seq)
+            if done is not None:
+                finished.append(self._retire(ln, done))
+        gauge_set("GAUGE_generation_active_seqs", self.active_count)
+        return finished
+
+    def _finish_reason(self, seq: _Seq) -> Optional[str]:
+        eos = seq.req.eos_token
+        if eos is not None and seq.generated and \
+                seq.generated[-1] == eos:
+            return "eos"
+        if len(seq.generated) >= seq.req.max_new_tokens:
+            return "length"
+        return None
+
+    def _retire(self, lane: int, reason: str) -> GenerationResult:
+        seq = self._lane_seq[lane]
+        self._lane_seq[lane] = None
+        self.kv.free(id(seq))
+        self._tables[lane] = TRASH_BLOCK
+        self._ctx[lane] = 0
+        toks = list(seq.generated)
+        if reason == "eos":
+            toks = toks[:-1]
+        return GenerationResult(
+            request_id=seq.req.request_id,
+            prompt_len=len(seq.req.prompt), tokens=toks,
+            finish_reason=reason, evictions=seq.evictions)
+
+    def _ensure_blocks(self) -> None:
+        """Before a decode step, every active lane whose NEXT write
+        position crosses into an unowned block gets one more block.
+        Pool empty -> preempt the youngest sequence (deterministic
+        replay) until the survivors fit."""
+        while True:
+            try:
+                for lane, seq in enumerate(self._lane_seq):
+                    if seq is None:
+                        continue
+                    sid = id(seq)
+                    need = self.kv.blocks_for_tokens(seq.ctx + 1)
+                    while len(self.kv.owned(sid)) < need:
+                        self.kv.extend(sid)
+                        self._tables[lane] = self.kv.table(
+                            sid, self.max_blocks_per_seq)
+                return
+            except BlockPoolExhausted:
+                if not self._preempt_youngest():
+                    raise
+
+    def _preempt_youngest(self) -> bool:
+        """Evict the most recently admitted active sequence: free its
+        blocks, requeue it at the FRONT of pending (it keeps priority
+        over never-started requests). Replay is deterministic — same
+        seed, same per-step fold_in — so the regenerated prefix is
+        identical and the client observes only latency."""
+        cand = None
+        for seq in self._lane_seq:
+            if seq is None:
+                continue
+            if cand is None or seq.admit_order > cand.admit_order:
+                cand = seq
+        if cand is None:
+            return False
+        lane = cand.lane
+        self._lane_seq[lane] = None
+        self.kv.evict(id(cand))
+        self._tables[lane] = TRASH_BLOCK
+        self._ctx[lane] = 0
+        fresh = _Seq(cand.req, cand.admit_order)
+        fresh.evictions = cand.evictions + 1
+        self._pending.insert(0, fresh)
+        return True
+
+    def _deliver_error(self, seq: _Seq, exc: Exception) -> None:
+        """Per-request failure (prefill raised): routed to the
+        scheduler's future via on_request_error when set, else
+        re-raised (bare-engine usage)."""
+        if self.on_request_error is not None:
+            self.on_request_error(seq.req, exc)
+        else:
+            raise exc
+
+    # --- convenience ---------------------------------------------------
+
+    def generate(self, reqs: Sequence[GenerationRequest],
+                 max_steps: Optional[int] = None
+                 ) -> List[GenerationResult]:
+        """Run a batch of requests to completion (continuous batching:
+        more requests than decode_width stream through the lanes).
+        Results come back in completion order; match by request_id."""
+        for i, r in enumerate(reqs):
+            if r.request_id is None:
+                r = replace(r, request_id=i)
+            self.submit(r)
+        out: List[GenerationResult] = []
+        steps = 0
+        limit = (max_steps if max_steps is not None
+                 else (self.cfg.max_seq_len + 2) * max(1, len(reqs)))
+        while not self.idle and steps < limit:
+            out.extend(self.step())
+            steps += 1
+        if not self.idle:
+            raise RuntimeError("generation did not converge in %d steps"
+                               % limit)
+        return out
+
+
+def _sds(v) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(jnp.shape(v), jnp.asarray(v).dtype)
+
+
+class NaiveGenerator:
+    """The O(N^2) baseline the bench compares against: every new token
+    re-runs full-context attention over the whole prefix (what PR 4's
+    stateless Predictor forces an LLM workload to do). Same model
+    functions, same sampler, same bucketing of the growing context —
+    so its token streams are comparable and its cost is honest."""
+
+    def __init__(self, cfg: DecoderConfig, params, buckets=None,
+                 attn_lanes: int = 0):
+        self.cfg = cfg
+        self.params = jax.tree.map(jnp.asarray, params)
+        spec = (buckets if buckets is not None
+                else get_flag("FLAGS_generation_prefill_buckets"))
+        self.ladder = [b for b in parse_bucket_ladder(spec)
+                       if b <= cfg.max_seq_len] or [cfg.max_seq_len]
+        # pass the paged engine's attn_lanes to make this oracle
+        # bitwise-comparable (model.forward_full docstring)
+        self.attn_lanes = int(attn_lanes)
+        self._fns: Dict[int, Any] = {}
+
+    def _fn(self, bucket: int):
+        fn = self._fns.get(bucket)
+        if fn is None:
+            cfg = self.cfg
+            lanes = self.attn_lanes
+            fn = jax.jit(lambda p, t, l: forward_full(
+                cfg, p, t, l, attn_lanes=lanes)[0])
+            self._fns[bucket] = fn
+        return fn
+
+    def generate(self, req: GenerationRequest) -> GenerationResult:
+        toks = list(int(t) for t in req.prompt)
+        n0 = len(toks)
+        sp = req.sampling
+        out: List[int] = []
+        reason = "length"
+        for step in range(req.max_new_tokens):
+            n = len(toks)
+            bucket = bucket_for(n, self.ladder)
+            if bucket is None:
+                bucket = self.cfg.max_seq_len
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n] = toks
+            logits = self._fn(bucket)(
+                self.params, jnp.asarray(padded),
+                jnp.asarray([n], np.int32))
+            nxt = sample_tokens(
+                logits, jnp.asarray([sp.temperature], jnp.float32),
+                jnp.asarray([sp.top_k], jnp.int32),
+                jnp.asarray([sp.top_p], jnp.float32),
+                jnp.asarray([sp.seed], jnp.int32),
+                jnp.asarray([step], jnp.int32))
+            tok = int(np.asarray(nxt)[0])
+            if req.eos_token is not None and tok == req.eos_token:
+                reason = "eos"
+                break
+            out.append(tok)
+            toks.append(tok)
+        return GenerationResult(request_id=req.request_id,
+                                prompt_len=n0, tokens=out,
+                                finish_reason=reason)

@@ -1,0 +1,149 @@
+"""Paged KV cache: fixed block pool + per-sequence block tables.
+
+The decode-side analog of the reference's contiguous per-request KV
+buffers: instead of one `[S_max]` allocation per sequence (worst-case
+memory, realloc on growth, a fresh XLA shape per length), every layer
+owns ONE preallocated pool `[num_blocks, block_size, heads, head_dim]`
+and a sequence holds an ordered list of pool block indices (its block
+table). Growth is "append one index", completion is "return the
+indices" — the device arrays never change shape, so every decode step
+replays one compiled executable (docs/generation.md).
+
+Block 0 is reserved as the TRASH block: inactive decode lanes and the
+right-padding of short block tables all point at it. Writes to it are
+harmless (nothing reads it unmasked) and it makes every block table a
+dense `[max_blocks_per_seq]` int32 array — fixed-shape again.
+
+Host-side accounting only: this class owns WHICH blocks belong to
+whom; the pool arrays themselves live in the engine's device state and
+are updated functionally inside the jitted steps.
+
+Instruments: GAUGE_generation_blocks_free / _blocks_used,
+STAT_generation_blocks_allocated / _blocks_freed / _evictions.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..monitor import gauge_set, stat_add
+
+__all__ = ["KVCacheManager", "BlockPoolExhausted", "TRASH_BLOCK"]
+
+TRASH_BLOCK = 0
+
+
+class BlockPoolExhausted(RuntimeError):
+    """The free list is empty. The scheduler handles this by evicting
+    (preempting) its youngest sequence and replaying it later — callers
+    of the raw manager see the exception."""
+
+
+class KVCacheManager:
+    """Host-side ledger of the paged pool.
+
+    `alloc(seq_id, n)` claims n blocks for a new sequence, `extend`
+    appends one, `free` returns them all. `table(seq_id, width)` gives
+    the dense int32 block table (trash-padded) the device step wants.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is reserved)")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # block 0 reserved; allocation order is FIFO-recycled so a
+        # freed block rests as long as possible before reuse (helps
+        # debugging: stale data survives longer, masked anyway)
+        self._free: deque = deque(range(1, self.num_blocks))
+        self._tables: Dict[object, List[int]] = {}
+        self._publish()
+
+    # --- queries -------------------------------------------------------
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    def blocks_for_tokens(self, tokens: int) -> int:
+        """ceil(tokens / block_size) — blocks needed to hold a context
+        of `tokens` positions."""
+        return -(-int(tokens) // self.block_size)
+
+    def owned(self, seq_id) -> List[int]:
+        return list(self._tables[seq_id])
+
+    def table(self, seq_id, width: int) -> List[int]:
+        """Dense block table of length `width`, right-padded with the
+        trash block — exactly what the fixed-shape decode step feeds."""
+        blocks = self._tables[seq_id]
+        if len(blocks) > width:
+            raise ValueError("sequence %r holds %d blocks > table width %d"
+                             % (seq_id, len(blocks), width))
+        return blocks + [TRASH_BLOCK] * (width - len(blocks))
+
+    # --- mutation ------------------------------------------------------
+
+    def alloc(self, seq_id, n_blocks: int) -> List[int]:
+        """Claim `n_blocks` for a new sequence — all or nothing (a
+        partially provisioned prefill is useless)."""
+        if seq_id in self._tables:
+            raise ValueError("sequence %r already has blocks" % (seq_id,))
+        if n_blocks < 1:
+            raise ValueError("n_blocks must be >= 1")
+        if n_blocks > len(self._free):
+            raise BlockPoolExhausted(
+                "need %d blocks, %d free (pool %d x %d tokens)"
+                % (n_blocks, len(self._free), self.num_blocks,
+                   self.block_size))
+        blocks = [self._free.popleft() for _ in range(n_blocks)]
+        self._tables[seq_id] = blocks
+        stat_add("STAT_generation_blocks_allocated", n_blocks)
+        self._publish()
+        return list(blocks)
+
+    def extend(self, seq_id) -> int:
+        """Append one block to a live sequence (its context is about to
+        cross a block boundary)."""
+        if seq_id not in self._tables:
+            raise KeyError("unknown sequence %r" % (seq_id,))
+        if not self._free:
+            raise BlockPoolExhausted(
+                "no free block to extend sequence %r" % (seq_id,))
+        b = self._free.popleft()
+        self._tables[seq_id].append(b)
+        stat_add("STAT_generation_blocks_allocated")
+        self._publish()
+        return b
+
+    def free(self, seq_id) -> int:
+        """Return every block the sequence holds (EOS/max-len/error).
+        Unknown ids are a no-op: the double-free of an already-evicted
+        sequence must not corrupt the ledger."""
+        blocks = self._tables.pop(seq_id, None)
+        if not blocks:
+            return 0
+        self._free.extend(blocks)
+        stat_add("STAT_generation_blocks_freed", len(blocks))
+        self._publish()
+        return len(blocks)
+
+    def evict(self, seq_id) -> int:
+        """free() counted as an eviction (scheduler preemption under
+        pool pressure — the sequence will be replayed from scratch)."""
+        n = self.free(seq_id)
+        if n:
+            stat_add("STAT_generation_evictions")
+        return n
+
+    # --- internals -----------------------------------------------------
+
+    def _publish(self) -> None:
+        gauge_set("GAUGE_generation_blocks_free", len(self._free))
+        gauge_set("GAUGE_generation_blocks_used", self.used_blocks)

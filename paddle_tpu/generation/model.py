@@ -1,0 +1,208 @@
+"""Pure-functional decoder model for the generation engine.
+
+A small GPT-style pre-LN transformer expressed as (config, params dict,
+forward functions) — no layers framework, no Program: the generation
+subsystem needs a model whose full-context and paged-incremental
+forwards can be proven BITWISE equal, so both are written here against
+the same primitive ops in the same order.
+
+The parity contract (tests/test_generation.py pins it):
+
+    forward_full(tokens[:, :t+1]) logits at position t
+        == forward_paged(token t, pools holding positions 0..t-1)
+
+and it holds bitwise on XLA:CPU because (a) both paths route attention
+through kernels.paged_attention.attend_reference (same einsums, same
+finite NEG_INF masking — padded/masked lanes contribute exact 0.0),
+(b) per-position work (LN, QKV/MLP matmuls) is row-independent on this
+backend (tests/test_serving.py pins row independence for the same
+reason), and (c) everything runs float32.
+
+Params are a flat dict of jnp arrays — pytree-friendly for jit and for
+program_cache.exported_entry avals.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..kernels.paged_attention import (NEG_INF, attend_reference,
+                                       paged_attention)
+
+__all__ = ["DecoderConfig", "init_params", "forward_full",
+           "forward_paged"]
+
+
+@dataclass(frozen=True)
+class DecoderConfig:
+    vocab_size: int = 128
+    hidden: int = 64
+    layers: int = 2
+    heads: int = 4
+    max_seq_len: int = 512
+    mlp_ratio: int = 4
+
+    @property
+    def head_dim(self) -> int:
+        if self.hidden % self.heads:
+            raise ValueError("hidden %d not divisible by heads %d"
+                             % (self.hidden, self.heads))
+        return self.hidden // self.heads
+
+    def meta(self) -> dict:
+        """JSON-able identity for program_cache.fn_fingerprint."""
+        return {"vocab": self.vocab_size, "hidden": self.hidden,
+                "layers": self.layers, "heads": self.heads,
+                "max_seq_len": self.max_seq_len,
+                "mlp_ratio": self.mlp_ratio}
+
+
+def init_params(cfg: DecoderConfig, seed: int = 0) -> dict:
+    """Gaussian init, numpy RNG (host-side, deterministic by seed)."""
+    rng = np.random.default_rng(seed)
+    h, v = cfg.hidden, cfg.vocab_size
+    m = cfg.mlp_ratio * h
+
+    def w(*shape, scale=None):
+        if scale is None:
+            scale = 1.0 / math.sqrt(shape[0])
+        return jnp.asarray(rng.normal(0.0, scale, shape),
+                           dtype=jnp.float32)
+
+    p = {
+        "tok_emb": w(v, h, scale=0.02),
+        "pos_emb": w(cfg.max_seq_len, h, scale=0.02),
+        "ln_f_g": jnp.ones((h,), jnp.float32),
+        "ln_f_b": jnp.zeros((h,), jnp.float32),
+        "unembed": w(h, v),
+    }
+    for i in range(cfg.layers):
+        p.update({
+            "l%d_ln1_g" % i: jnp.ones((h,), jnp.float32),
+            "l%d_ln1_b" % i: jnp.zeros((h,), jnp.float32),
+            "l%d_wqkv" % i: w(h, 3 * h),
+            "l%d_wo" % i: w(h, h),
+            "l%d_ln2_g" % i: jnp.ones((h,), jnp.float32),
+            "l%d_ln2_b" % i: jnp.zeros((h,), jnp.float32),
+            "l%d_w1" % i: w(h, m),
+            "l%d_b1" % i: jnp.zeros((m,), jnp.float32),
+            "l%d_w2" % i: w(m, h),
+            "l%d_b2" % i: jnp.zeros((h,), jnp.float32),
+        })
+    return p
+
+
+def _ln(x, g, b):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+
+def _qkv(cfg: DecoderConfig, params: dict, i: int, x):
+    """x [..., h] -> q, k, v each [..., heads, head_dim]."""
+    qkv = x @ params["l%d_wqkv" % i]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shp = x.shape[:-1] + (cfg.heads, cfg.head_dim)
+    return q.reshape(shp), k.reshape(shp), v.reshape(shp)
+
+
+def _mlp(params: dict, i: int, x):
+    h = jax.nn.gelu(x @ params["l%d_w1" % i] + params["l%d_b1" % i],
+                    approximate=False)
+    return h @ params["l%d_w2" % i] + params["l%d_b2" % i]
+
+
+def forward_full(cfg: DecoderConfig, params: dict, tokens, lengths,
+                 attn_lanes: int = 0):
+    """Full-context forward: tokens `[B, S]` int32, lengths `[B]`
+    (visible prefix per row; padding beyond it is masked out of
+    attention). Returns (logits `[B, vocab]` at position lengths-1,
+    k_cache, v_cache each `[layers, B, S, heads, head_dim]`) — the
+    caches feed prefill's scatter into the block pool.
+
+    `attn_lanes` (static) pads the attention K/V axis to a FIXED lane
+    count — the bitwise-parity requirement: XLA regroups a reduction
+    when its length changes (Tk=16 vs Tk=32 sums associate nonzero
+    elements differently, measured 1-ulp drift), so the full-context
+    and paged paths must reduce over the SAME number of lanes. The
+    engine passes its pool-table span (max_blocks_per_seq *
+    block_size); 0 keeps the raw S lanes (standalone use).
+    """
+    b, s = tokens.shape
+    pos = jnp.arange(s, dtype=jnp.int32)
+    x = params["tok_emb"][tokens] + params["pos_emb"][pos][None]
+    lanes = int(attn_lanes) if attn_lanes else s
+    if lanes < s:
+        raise ValueError("attn_lanes %d < sequence length %d"
+                         % (lanes, s))
+    kpos = jnp.arange(lanes, dtype=jnp.int32)
+    # causal AND within the visible prefix (padding lanes always off)
+    visible = kpos[None, :] < lengths[:, None]             # [B, L]
+    causal = pos[None, :, None] >= kpos[None, None, :]     # [1, S, L]
+    mask = (causal & visible[:, None, :])[:, None]         # [B,1,S,L]
+    pad = ((0, 0), (0, lanes - s), (0, 0), (0, 0))
+    sm_scale = 1.0 / math.sqrt(cfg.head_dim)
+    ks, vs = [], []
+    for i in range(cfg.layers):
+        xn = _ln(x, params["l%d_ln1_g" % i], params["l%d_ln1_b" % i])
+        q, k, v = _qkv(cfg, params, i, xn)                 # [B,S,H,D]
+        ks.append(k)
+        vs.append(v)
+        o = attend_reference(q.transpose(0, 2, 1, 3),
+                             jnp.pad(k, pad).transpose(0, 2, 1, 3),
+                             jnp.pad(v, pad).transpose(0, 2, 1, 3),
+                             mask, sm_scale)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.hidden)
+        x = x + o @ params["l%d_wo" % i]
+        x = x + _mlp(params, i, _ln(x, params["l%d_ln2_g" % i],
+                                    params["l%d_ln2_b" % i]))
+    x = _ln(x, params["ln_f_g"], params["ln_f_b"])
+    logits = x @ params["unembed"]                         # [B, S, V]
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None].astype(jnp.int32),
+        axis=1)[:, 0]
+    return last, jnp.stack(ks), jnp.stack(vs)
+
+
+def forward_paged(cfg: DecoderConfig, params: dict, k_pools, v_pools,
+                  block_tables, ctx_lens, tokens):
+    """Single-token decode step: tokens `[B]` (the NEW token at
+    position ctx_lens), pools `[layers, N, bs, H, D]`, block_tables
+    `[B, M]`, ctx_lens `[B]` int32 (tokens already in the cache).
+    Writes each layer's new K/V into the pool at the flat slot
+    `table[ctx // bs] * bs + ctx % bs`, attends over ctx+1 positions,
+    returns (logits `[B, vocab]`, k_pools', v_pools').
+
+    Inactive lanes (the scheduler parks them) carry ctx_lens whose
+    block-table slot is the trash block — their writes land in trash
+    and their logits are garbage the scheduler never samples from.
+    """
+    b = tokens.shape[0]
+    bs = k_pools.shape[2]
+    x = params["tok_emb"][tokens] + params["pos_emb"][ctx_lens]  # [B,h]
+    sm_scale = 1.0 / math.sqrt(cfg.head_dim)
+    rows = jnp.arange(b)
+    blk = jnp.take_along_axis(
+        block_tables, (ctx_lens // bs)[:, None].astype(jnp.int32),
+        axis=1)[:, 0]                                      # [B]
+    off = ctx_lens % bs
+    new_k, new_v = [], []
+    for i in range(cfg.layers):
+        xn = _ln(x, params["l%d_ln1_g" % i], params["l%d_ln1_b" % i])
+        q, k, v = _qkv(cfg, params, i, xn)                 # [B,H,D]
+        kp = k_pools[i].at[blk, off].set(k)                # scatter new
+        vp = v_pools[i].at[blk, off].set(v)
+        new_k.append(kp)
+        new_v.append(vp)
+        o = paged_attention(q, kp, vp, block_tables, ctx_lens + 1,
+                            sm_scale=sm_scale)             # [B,H,D]
+        x = x + o.reshape(b, cfg.hidden) @ params["l%d_wo" % i]
+        x = x + _mlp(params, i, _ln(x, params["l%d_ln2_g" % i],
+                                    params["l%d_ln2_b" % i]))
+    x = _ln(x, params["ln_f_g"], params["ln_f_b"])
+    logits = x @ params["unembed"]                         # [B, V]
+    return logits, jnp.stack(new_k), jnp.stack(new_v)

@@ -1,0 +1,220 @@
+"""GenerationPool: the concurrent continuous-batching front-end.
+
+serving.PredictorPool's batcher coalesces whole REQUESTS into one
+execution; generation needs a step-level scheduler instead — requests
+join the in-flight decode batch at prefill, ride it one token per
+step, and leave at EOS/max-len while their batch-mates keep going.
+This class is that extension: the same bounded-queue + condition-
+variable front door and the same `_Future` completion handles as the
+serving pool (literally reused), but the worker loop drives
+GenerationEngine.step() continuously instead of executing one batch
+per wakeup.
+
+Contracts, matching PredictorPool:
+- backpressure: the request queue is bounded
+  (FLAGS_generation_queue_depth); submit() blocks, then raises
+  serving.ServingQueueFull.
+- per-request error isolation: a request the engine rejects
+  (too-long prompt, bad sampling params) fails ONLY its own future.
+  A decode-step failure is a batch-level fault: every in-flight
+  future gets the error, the engine is rebuilt, and the pool keeps
+  serving (STAT_generation_errors counts both).
+- close() drains: already-queued and in-flight requests finish
+  before the worker exits (like PredictorPool.close).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..flags import get_flag
+from ..monitor import gauge_set, stat_add
+from ..serving import ServingQueueFull, _Future
+from .engine import GenerationEngine, GenerationRequest
+
+__all__ = ["GenerationPool"]
+
+
+class GenerationPool:
+    """Thread-safe continuous-batching wrapper around one
+    GenerationEngine. Only the worker thread ever touches the engine,
+    so its lane/pool state needs no locking.
+
+    Usage::
+
+        pool = GenerationPool(engine)
+        fut = pool.submit(GenerationRequest(prompt=[1, 2, 3]))
+        result = fut.result(timeout=30)     # GenerationResult
+        pool.close()                        # or `with` block
+    """
+
+    def __init__(self, engine: GenerationEngine, *,
+                 queue_depth: Optional[int] = None,
+                 _start: bool = True):
+        self.engine = engine
+        self.queue_depth = int(
+            queue_depth if queue_depth is not None
+            else get_flag("FLAGS_generation_queue_depth"))
+        if self.queue_depth < 1:
+            raise ValueError("queue_depth must be >= 1")
+        self._queue: deque = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._closed = False
+        self._worker: Optional[threading.Thread] = None
+        # engine-side request_id -> future, owned by the worker thread
+        self._inflight: Dict[int, _Future] = {}
+        self._next_id = 0
+        # scheduler-side eviction replay happens inside the engine;
+        # the future survives it untouched
+        engine.on_request_error = self._on_request_error
+        if _start:
+            self.start()
+
+    def _on_request_error(self, req: GenerationRequest,
+                          exc: Exception) -> None:
+        """Engine-reported per-request failure (prefill raised): fail
+        only that request's future; batch-mates are untouched."""
+        fut = self._inflight.pop(req.request_id, None)
+        if fut is not None:
+            fut._set_error(exc)
+
+    # --- lifecycle -----------------------------------------------------
+
+    def start(self) -> "GenerationPool":
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("pool is closed")
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._serve_loop, name="pt-generation-sched",
+                    daemon=True)
+                self._worker.start()
+        return self
+
+    def close(self) -> None:
+        """Drain: queued and in-flight sequences run to completion,
+        then the worker exits."""
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+            worker = self._worker
+        if worker is not None:
+            worker.join(timeout=300.0)
+        with self._lock:
+            while self._queue:
+                _, fut = self._queue.popleft()
+                fut._set_error(RuntimeError("GenerationPool closed"))
+            gauge_set("GAUGE_generation_queue_depth", 0)
+
+    def __enter__(self) -> "GenerationPool":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # --- client API ----------------------------------------------------
+
+    def submit(self, req: GenerationRequest,
+               timeout: Optional[float] = None) -> _Future:
+        """Enqueue one request; returns a future whose .result() is a
+        GenerationResult. Blocks while the queue is full, then raises
+        ServingQueueFull — the same backpressure contract as
+        serving.PredictorPool.submit."""
+        fut = _Future()
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._not_full:
+            while not self._closed and \
+                    len(self._queue) >= self.queue_depth:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    stat_add("STAT_generation_rejected")
+                    raise ServingQueueFull(
+                        "generation queue full (depth %d) for %.3fs"
+                        % (self.queue_depth, timeout))
+                self._not_full.wait(remaining)
+            if self._closed:
+                raise RuntimeError("GenerationPool closed")
+            self._queue.append((req, fut))
+            gauge_set("GAUGE_generation_queue_depth", len(self._queue))
+            self._not_empty.notify()
+        return fut
+
+    def run(self, req: GenerationRequest,
+            timeout: Optional[float] = None):
+        """Blocking submit+wait."""
+        return self.submit(req, timeout=timeout).result(timeout)
+
+    # --- worker --------------------------------------------------------
+
+    def _admit_locked(self) -> None:
+        """Move queued requests into the engine while it has headroom
+        (pending + active < 2x decode_width keeps prefill fed without
+        hoarding the whole queue in engine-pending state). Engine
+        rejections (ValueError) fail only that request's future."""
+        eng = self.engine
+        headroom = 2 * eng.decode_width
+        while self._queue and \
+                eng.pending_count + eng.active_count < headroom:
+            req, fut = self._queue.popleft()
+            rid = self._next_id
+            self._next_id += 1
+            try:
+                from dataclasses import replace
+                eng.submit(replace(req, request_id=rid))
+            except Exception as e:
+                stat_add("STAT_generation_errors")
+                fut._set_error(e)
+                continue
+            self._inflight[rid] = fut
+        gauge_set("GAUGE_generation_queue_depth", len(self._queue))
+        self._not_full.notify_all()
+
+    def _serve_loop(self) -> None:
+        eng = self.engine
+        while True:
+            with self._not_empty:
+                while not self._queue and eng.idle and not self._closed:
+                    self._not_empty.wait()
+                if self._closed and not self._queue and eng.idle:
+                    return
+                self._admit_locked()
+            # step OUTSIDE the lock: the decode executable can run
+            # while submitters enqueue
+            try:
+                finished = eng.step()
+            except Exception as e:
+                # batch-level fault: fail everything in flight; the
+                # pool itself survives (next submits get a clean slate
+                # of lanes — the engine retires state via fresh
+                # futures' error paths)
+                stat_add("STAT_generation_errors")
+                for fut in self._inflight.values():
+                    fut._set_error(e)
+                self._inflight.clear()
+                self._reset_engine()
+                continue
+            for res in finished:
+                fut = self._inflight.pop(res.request_id, None)
+                if fut is not None:
+                    fut._set(res)
+
+    def _reset_engine(self) -> None:
+        """After a batch-level fault: rebuild the engine's sequence
+        state (fresh KV ledger + lanes) reusing its compiled steps and
+        device pools — in-flight sequences are gone, their futures
+        already hold the error."""
+        eng = self.engine
+        eng.kv = type(eng.kv)(eng.kv.num_blocks, eng.kv.block_size)
+        eng._lane_seq = [None] * eng.decode_width
+        eng._tables[:] = 0
+        eng._ctx[:] = 0
+        eng._pending = []
+        gauge_set("GAUGE_generation_active_seqs", 0)

@@ -24,7 +24,8 @@ from . import io as _io
 
 __all__ = ["Config", "AnalysisConfig", "Predictor", "create_predictor",
            "PredictorTensor", "PassStrategy", "TpuPassStrategy",
-           "SerializedPredictor", "parse_bucket_ladder", "bucket_for"]
+           "SerializedPredictor", "parse_bucket_ladder", "bucket_for",
+           "bucket_or_exact"]
 
 
 def parse_bucket_ladder(spec) -> List[int]:
@@ -59,6 +60,22 @@ def bucket_for(n: int, ladder: Sequence[int]) -> Optional[int]:
         if b >= n:
             return b
     return None
+
+
+def bucket_or_exact(n: int, ladder: Sequence[int],
+                    overflow_stat: Optional[str] = None) -> int:
+    """The shared pad-target policy of every bucketed caller (the
+    Predictor's `_run_bucketed`, the generation prefill): the smallest
+    bucket >= n, falling back to the EXACT size on ladder overflow —
+    louder than silent (bumps `overflow_stat` when given), never
+    wrong."""
+    b = bucket_for(n, ladder)
+    if b is not None:
+        return b
+    if overflow_stat:
+        from .monitor import stat_add
+        stat_add(overflow_stat)
+    return n
 
 
 class PassStrategy:
@@ -311,11 +328,9 @@ class Predictor:
                                 fetch_list=list(self.fetch_names),
                                 scope=self.scope)
         b = batches.pop()
-        target = bucket_for(b, ladder)
-        if target is None:
-            # louder than silent: an overflow compiles the exact shape
-            stat_add("STAT_predictor_bucket_overflow")
-            target = b
+        # an overflow compiles the exact shape — loud, never wrong
+        target = bucket_or_exact(b, ladder,
+                                 "STAT_predictor_bucket_overflow")
         axes = getattr(self.config, "_bucket_axes", (0,))
         padded = {}
         pad_elems = 0

@@ -44,6 +44,102 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# ---------------------------------------------------------------------------
+# in-kernel dropout parity-freshness stamp (ADVICE round 5)
+#
+# FLAGS_flash_inkernel_dropout defaults on, but its only oracle runs on
+# real TPU hardware (scripts/inkernel_parity.py — interpret mode cannot
+# reproduce the hardware PRNG stream). The freshness stamp closes that
+# gap: the parity run writes a marker stamped with a hash of THIS
+# kernel source, and the flag only engages while the marker matches —
+# edit the kernel without re-running the parity check and the runtime
+# quietly (one warning) falls back to the HBM-mask reference path
+# instead of shipping an unvalidated PRNG pattern.
+# ---------------------------------------------------------------------------
+
+_parity_memo: Optional[bool] = None  # per-process; reset for tests
+
+
+def kernel_parity_hash() -> str:
+    """sha256 of this module's source — the identity the on-hardware
+    parity run certifies. Any edit to the kernel changes it."""
+    import hashlib
+    with open(__file__, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def parity_stamp_path() -> str:
+    """Stamp location: $PADDLE_TPU_PARITY_STAMP overrides; default
+    lives next to the AOT program cache in the user cache dir."""
+    import os
+    env = os.environ.get("PADDLE_TPU_PARITY_STAMP")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "paddle_tpu", "inkernel_parity.json")
+
+
+def write_parity_stamp(path: Optional[str] = None) -> str:
+    """Record that scripts/inkernel_parity.py just PASSED on hardware:
+    stamp the current kernel hash (atomic replace, like the program
+    cache). Returns the path written."""
+    import json
+    import os
+    import tempfile
+    import time
+    p = path or parity_stamp_path()
+    os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+    blob = json.dumps({
+        "kernel_hash": kernel_parity_hash(),
+        "backend": jax.default_backend(),
+        "jax": jax.__version__,
+        "time": time.time(),
+    }, sort_keys=True).encode()
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(p) or ".",
+                               prefix=".tmp_parity")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, p)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    global _parity_memo
+    _parity_memo = None  # re-read on next check
+    return p
+
+
+def _inkernel_parity_ok() -> bool:
+    """True while the parity stamp exists and certifies the CURRENT
+    kernel source. Memoized per process; on the first False a single
+    warning explains the silent fallback to the HBM-mask path."""
+    global _parity_memo
+    if _parity_memo is not None:
+        return _parity_memo
+    import json
+    ok = False
+    try:
+        with open(parity_stamp_path(), "rb") as f:
+            stamp = json.load(f)
+        ok = stamp.get("kernel_hash") == kernel_parity_hash()
+    except (OSError, ValueError):
+        ok = False
+    if not ok:
+        import warnings
+        warnings.warn(
+            "FLAGS_flash_inkernel_dropout is on but the parity stamp "
+            "(%s) is missing or stale for this kernel source — using "
+            "the HBM-mask dropout path. Re-run "
+            "scripts/inkernel_parity.py on TPU hardware to restore "
+            "the in-kernel path." % parity_stamp_path(),
+            RuntimeWarning, stacklevel=2)
+    _parity_memo = ok
+    return ok
+
+
 def _drop_keep_tile(seed_ref, qi, ki, shape, keep_prob):
     """In-kernel attention-probs dropout tile: seed the per-core PRNG
     from (base_seed, b, h, q_tile, k_tile) so every kernel (forward, dQ,
@@ -654,14 +750,17 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
         from ..flags import get_flag
         if ((bias is None or not bias_needs_grad)
                 and not _use_interpret() and _HAS_PLTPU
-                and get_flag("FLAGS_flash_inkernel_dropout")):
+                and get_flag("FLAGS_flash_inkernel_dropout")
+                and _inkernel_parity_ok()):
             # in-kernel hardware-PRNG dropout: no [B,H,Sq,Sk] mask in
             # HBM at all. Needs a non-differentiable bias (or none)
             # because the dbias blockwise-recompute path (plain XLA,
             # outside Pallas) cannot regenerate the in-kernel pattern.
             # Default-on since the round-5 on-chip parity run
             # (scripts/inkernel_parity.py; the run sheet re-gates every
-            # session) — the flag remains the kill switch.
+            # session), and additionally gated on the parity-freshness
+            # stamp (_inkernel_parity_ok, checked LAST so CPU runs
+            # never warn) — the flag remains the kill switch.
             import numpy as _np
             drop_seed = jax.random.randint(
                 dropout_rng, (1, 1), 0, _np.iinfo(_np.int32).max,

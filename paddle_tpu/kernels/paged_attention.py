@@ -1,0 +1,239 @@
+"""Paged attention for autoregressive decode (docs/generation.md).
+
+The single-token decode step of the generation engine attends over a
+sequence whose K/V live scattered across a fixed block pool
+(`[num_blocks, block_size, H, D]` per layer) instead of one contiguous
+array — the "Ragged Paged Attention" shape (PAPERS.md): every sequence
+owns an ordered *block table* of pool indices, and attention gathers
+keys through the table, masking positions at or beyond the sequence's
+current context length. Because the pool, the tables, and the decode
+batch are all fixed-shape, the decode step compiles ONCE and every
+mixed-length continuous batch reuses it.
+
+Two execution paths, selected by FLAGS_paged_attention_kernel:
+
+- "reference" (default): gather + masked softmax in plain XLA. This is
+  the parity oracle — `attend_reference` here is the SAME function the
+  generation model uses for full-context prefill, so a paged decode
+  step is bitwise-identical to a full-context recompute of the same
+  position (masked lanes contribute exp(-1e30 - m) == 0.0 exactly, and
+  adding exact zeros never perturbs the reduction).
+- "pallas": the blocked kernel below — grid over (batch, blocks),
+  block tables scalar-prefetched so each grid step's BlockSpec
+  index_map DMAs exactly one pool block into VMEM, online-softmax
+  (m, l, acc) carried in VMEM scratch across the sequential grid.
+  Interpret mode runs it on CPU; on TPU hardware the same structure is
+  the Mosaic-ready seam (one block resident at a time, MXU dots, no
+  [S] contiguous KV ever materialized).
+
+Layouts: q `[B, H, D]` (one new token per sequence), pools
+`[N, block_size, H, D]`, block_tables `[B, max_blocks]` int32,
+ctx_lens `[B]` int32 (number of VISIBLE keys, i.e. the new token's
+position + 1). Returns `[B, H, D]`.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports on CPU too (interpret mode)
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+# finite "minus infinity", matching kernels/flash_attention.py: after
+# the running-max subtraction exp(NEG_INF - m) underflows to exactly
+# 0.0, so masked lanes are bitwise inert in every reduction
+NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# shared masked-softmax attention core (prefill AND decode use this)
+# ---------------------------------------------------------------------------
+
+def attend_reference(q, k, v, mask, sm_scale):
+    """Masked attention, fp32 accumulation: q `[B, H, Tq, D]`,
+    k/v `[B, H, Tk, D]`, mask `[B, 1, Tq, Tk]` bool (True = visible).
+
+    This one function is the numerics contract of the generation
+    subsystem: the model's full-context prefill and the paged decode
+    reference both route through it, so prefill/decode parity is
+    structural rather than coincidental. Two deliberate choices make
+    the parity BITWISE on XLA:CPU (tests/test_generation.py pins it):
+
+    - scores and PV are broadcast-multiply + jnp.sum reductions, NOT
+      dot_general. A GEMM (Tq=bucket prefill) and a GEMV (Tq=1 decode)
+      accumulate the same dot product in different orders — measured
+      1e-7 drift — while an explicit last-axis reduce lowers
+      identically for both query shapes AND for padded-vs-exact Tk.
+    - masked lanes score NEG_INF (finite): exp(NEG_INF - m) underflows
+      to exactly 0.0, so padding lanes are bitwise inert in every sum,
+      and a row with NO visible key (inactive decode lane) degrades to
+      a finite uniform average instead of NaN."""
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # [B,H,Tq,Tk,D] -> sum over D
+    s = jnp.sum(qf[:, :, :, None, :] * kf[:, :, None, :, :],
+                axis=-1) * sm_scale
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    p = e / jnp.sum(e, axis=-1, keepdims=True)
+    # [B,H,Tq,Tk,1] * [B,H,1,Tk,D] -> sum over Tk
+    out = jnp.sum(p[..., None] * vf[:, :, None, :, :], axis=-2)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# reference paged path
+# ---------------------------------------------------------------------------
+
+def paged_attention_reference(q, k_pool, v_pool, block_tables, ctx_lens,
+                              sm_scale: Optional[float] = None):
+    """Gather-from-block-table decode attention in plain XLA.
+
+    The gather materializes each sequence's `[max_blocks * block_size]`
+    logical KV view (positions beyond ctx_len are masked, so stale or
+    foreign blocks behind the table are invisible), then runs the
+    shared attend_reference core with Tq == 1."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    b, h, d = q.shape
+    n, bs, _, _ = k_pool.shape
+    m = block_tables.shape[1]
+    # [B, M, bs, H, D] -> [B, H, M*bs, D]
+    k = jnp.transpose(k_pool[block_tables], (0, 3, 1, 2, 4)
+                      ).reshape(b, h, m * bs, d)
+    v = jnp.transpose(v_pool[block_tables], (0, 3, 1, 2, 4)
+                      ).reshape(b, h, m * bs, d)
+    pos = jnp.arange(m * bs, dtype=jnp.int32)
+    mask = (pos[None, :] < ctx_lens[:, None])[:, None, None, :]
+    out = attend_reference(q[:, :, None, :], k, v, mask, sm_scale)
+    return out[:, :, 0, :]
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel: one pool block in VMEM per grid step
+# ---------------------------------------------------------------------------
+
+def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, block_size, sm_scale,
+                  num_blocks):
+    """Grid (B, max_blocks): sequential online-softmax over the
+    sequence's blocks. tables/lens arrive via scalar prefetch — the
+    index maps already used tables_ref to pick this (k, v) block, so
+    the body only handles masking and the (m, l, acc) recurrence."""
+    b = pl.program_id(0)
+    mi = pl.program_id(1)
+
+    @pl.when(mi == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    ctx = lens_ref[b]
+
+    # blocks entirely at/after the context end contribute nothing;
+    # skipping the math (the DMA already happened) keeps the scratch
+    # recurrence exact for ragged lengths
+    @pl.when(mi * block_size < ctx)
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * sm_scale          # [H, D]
+        k = k_ref[0].astype(jnp.float32)                     # [bs, H, D]
+        v = v_ref[0].astype(jnp.float32)
+        # batch over heads, contract D: [H, bs]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        pos = mi * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < ctx, s, NEG_INF)
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+        m_ref[:, 0] = m_new
+        # [H, bs] x [bs, H, D] -> per-head value rows: batch over H
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)              # [H, D]
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + pv
+
+    @pl.when(mi == num_blocks - 1)
+    def _finish():
+        l = l_ref[:, 0]
+        l_safe = jnp.where(l <= 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def paged_attention_pallas(q, k_pool, v_pool, block_tables, ctx_lens,
+                           sm_scale: Optional[float] = None,
+                           interpret: Optional[bool] = None):
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = _use_interpret()
+    b, h, d = q.shape
+    _, bs, _, _ = k_pool.shape
+    m = block_tables.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block_tables, ctx_lens
+        grid=(b, m),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda bi, mi, tbl, lens: (bi, 0, 0)),
+            pl.BlockSpec((1, bs, h, d),
+                         lambda bi, mi, tbl, lens: (tbl[bi, mi], 0, 0, 0)),
+            pl.BlockSpec((1, bs, h, d),
+                         lambda bi, mi, tbl, lens: (tbl[bi, mi], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d),
+                               lambda bi, mi, tbl, lens: (bi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),   # acc
+            pltpu.VMEM((h, 1), jnp.float32),   # running max
+            pltpu.VMEM((h, 1), jnp.float32),   # running denom
+        ],
+    )
+    kern = functools.partial(_paged_kernel, block_size=bs,
+                             sm_scale=sm_scale, num_blocks=m)
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32),
+      q, k_pool, v_pool)
+
+
+# ---------------------------------------------------------------------------
+# public entry: flag-routed seam
+# ---------------------------------------------------------------------------
+
+def paged_attention(q, k_pool, v_pool, block_tables, ctx_lens,
+                    sm_scale: Optional[float] = None):
+    """Decode-step attention over the paged KV pool. Routed by
+    FLAGS_paged_attention_kernel (a lowering flag: it is baked into
+    every generation compile key): "reference" is the bitwise parity
+    path; "pallas" runs the blocked kernel (interpret mode off-TPU)."""
+    from ..flags import get_flag
+    mode = get_flag("FLAGS_paged_attention_kernel")
+    if mode == "pallas" and _HAS_PLTPU:
+        return paged_attention_pallas(q, k_pool, v_pool, block_tables,
+                                      ctx_lens, sm_scale)
+    return paged_attention_reference(q, k_pool, v_pool, block_tables,
+                                     ctx_lens, sm_scale)

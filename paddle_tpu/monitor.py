@@ -59,6 +59,19 @@ The serving path (docs/serving.md) exposes:
   TIMER_serving_queue_wait_us / _batch_us histograms (queue wait and
   batch execution are the serving SLO — recorded without
   FLAGS_telemetry, like the program-cache timers).
+
+The generation engine (docs/generation.md) exposes:
+- STAT_generation_requests / _prefills / _tokens (throughput),
+  _compile (engine-level compilations — the zero-steady-state-
+  recompile pin counts THIS standing still), _evictions (pool-pressure
+  preemptions), _errors, _rejected (ServingQueueFull backpressure),
+  STAT_generation_blocks_allocated / _blocks_freed (KV ledger churn);
+- GAUGE_generation_blocks_free / _blocks_used (pool occupancy),
+  _active_seqs, _queue_depth;
+- always-on TIMER_generation_prefill_us / _decode_step_us /
+  _inter_token_us histograms (tokens/s and p95 inter-token latency are
+  the generation SLO; bench.py's generation block gates on the
+  decode-step p95 via tools/stat_diff.py).
 """
 from __future__ import annotations
 

@@ -321,8 +321,17 @@ class PredictorPool:
             if len(batch) == 1:
                 batch[0].future._set_error(e)
                 return
-            # error isolation: one malformed request must not fail its
-            # batch-mates — retry each request alone
+            # Error isolation: one malformed request must not fail its
+            # batch-mates — retry each request alone. ORDER/IDENTITY
+            # CONTRACT (tests/test_serving.py pins it): the retry walks
+            # `batch` in the order the batcher popped it (FIFO within a
+            # signature), and each retry binds its outputs to THAT
+            # request's future — a concurrent submitter always gets the
+            # outputs of its own feeds, never a batch-mate's, and
+            # requests queued behind the failing batch are untouched
+            # (still in self._queue; the batcher resumes FIFO after the
+            # retries). Retries run on the batcher thread, so they also
+            # serialize BEFORE any later batch executes.
             for r in batch:
                 try:
                     outs = self.predictor.run(list(r.feeds))

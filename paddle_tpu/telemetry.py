@@ -7,7 +7,9 @@ is that subsystem for paddle_tpu. It ties the two existing halves
 together behind ONE switch:
 
 - spans land in profiler.py as step-correlated chrome-trace events
-  (named tracks: dispatch / feed-stage / drain / sync / compile), and
+  (named tracks: dispatch / feed-stage / drain / sync / compile,
+  serving, and generation — the decode engine's prefill/decode-step
+  spans ride the "generation" track), and
 - latencies land in monitor.py timer histograms (TIMER_* names),
 
 so one `FLAGS_telemetry=True` run yields both a timeline and

@@ -73,11 +73,17 @@ def check_inkernel_dropout_parity():
                              dropout_rate=0.3, dropout_rng=key,
                              bias_needs_grad=False)
         assert np.isfinite(np.asarray(ob, np.float32)).all()
+        # all asserts passed on real hardware: write the freshness
+        # stamp that lets FLAGS_flash_inkernel_dropout engage
+        # (kernels/flash_attention._inkernel_parity_ok)
+        from paddle_tpu.kernels.flash_attention import write_parity_stamp
+        write_parity_stamp()
     finally:
         set_flags(prior)  # restore the shipped default, whatever it is
 
 
 if __name__ == "__main__":
     check_inkernel_dropout_parity()
-    print("in-kernel dropout parity OK")
+    from paddle_tpu.kernels.flash_attention import parity_stamp_path
+    print("in-kernel dropout parity OK; stamp ->", parity_stamp_path())
     sys.exit(0)

@@ -319,3 +319,76 @@ def test_attention_core_mask_is_stop_gradiented():
 
     g = jax.grad(loss)(mask)
     assert np.all(np.asarray(g) == 0.0)
+
+
+# ---------------------------------------------------------------------------
+# in-kernel dropout parity-freshness stamp (ADVICE r5)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _stamp_env(tmp_path, monkeypatch):
+    """Point the stamp at a throwaway path and reset the per-process
+    memo around each test."""
+    from paddle_tpu.kernels import flash_attention as fa
+    p = tmp_path / "inkernel_parity.json"
+    monkeypatch.setenv("PADDLE_TPU_PARITY_STAMP", str(p))
+    fa._parity_memo = None
+    yield str(p)
+    fa._parity_memo = None
+
+
+def test_parity_stamp_fresh_engages(_stamp_env):
+    from paddle_tpu.kernels import flash_attention as fa
+    written = fa.write_parity_stamp()
+    assert written == _stamp_env
+    import json
+    with open(written) as f:
+        stamp = json.load(f)
+    assert stamp["kernel_hash"] == fa.kernel_parity_hash()
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any warning fails the test
+        assert fa._inkernel_parity_ok() is True
+
+
+def test_parity_stamp_missing_warns_once_and_falls_back(_stamp_env):
+    from paddle_tpu.kernels import flash_attention as fa
+    with pytest.warns(RuntimeWarning, match="parity stamp"):
+        assert fa._inkernel_parity_ok() is False
+    # memoized: the second call neither warns nor re-reads
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert fa._inkernel_parity_ok() is False
+
+
+def test_parity_stamp_stale_hash_rejected(_stamp_env):
+    from paddle_tpu.kernels import flash_attention as fa
+    fa.write_parity_stamp()
+    import json
+    with open(_stamp_env) as f:
+        stamp = json.load(f)
+    stamp["kernel_hash"] = "0" * 64  # kernel edited since the run
+    with open(_stamp_env, "w") as f:
+        json.dump(stamp, f)
+    fa._parity_memo = None
+    with pytest.warns(RuntimeWarning, match="missing or stale"):
+        assert fa._inkernel_parity_ok() is False
+
+
+def test_parity_stamp_corrupt_json_rejected(_stamp_env):
+    from paddle_tpu.kernels import flash_attention as fa
+    with open(_stamp_env, "w") as f:
+        f.write("{not json")
+    with pytest.warns(RuntimeWarning):
+        assert fa._inkernel_parity_ok() is False
+
+
+def test_write_parity_stamp_resets_memo(_stamp_env):
+    """The parity run un-sticks a previously-failed memo: after a pass
+    writes a fresh stamp, the gate re-opens without a process restart."""
+    from paddle_tpu.kernels import flash_attention as fa
+    with pytest.warns(RuntimeWarning):
+        assert fa._inkernel_parity_ok() is False
+    fa.write_parity_stamp()
+    assert fa._inkernel_parity_ok() is True

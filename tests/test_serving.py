@@ -205,6 +205,74 @@ def test_pool_error_isolation(model_dir):
             np.asarray(pool.run([good])[0]), expected)
 
 
+def test_pool_batch_retry_preserves_order_and_identity(model_dir):
+    """Regression for the _execute ORDER/IDENTITY CONTRACT: when a
+    coalesced batch raises, the retry walks the batch in FIFO-pop
+    order and binds each retry's outputs to ITS OWN request's future —
+    a concurrent submitter never receives a batch-mate's rows, and no
+    request is dropped or reordered by the fault."""
+    inner = create_predictor(Config(model_dir))
+
+    class FaultOnce:
+        """Predictor proxy: the first multi-row (coalesced) execution
+        raises; every run is logged so the retry order is observable."""
+
+        def __init__(self, p):
+            self._p = p
+            self.calls = []
+            self.retry_order = []
+            self.faulted = False
+
+        @property
+        def feed_names(self):
+            return self._p.feed_names
+
+        def run(self, feeds):
+            self.calls.append(int(feeds[0].shape[0]))
+            if not self.faulted and feeds[0].shape[0] > 1:
+                self.faulted = True
+                raise RuntimeError("injected batch fault")
+            if self.faulted and feeds[0].shape[0] == 1:
+                self.retry_order.append(float(feeds[0][0, 0]))
+            return self._p.run(feeds)
+
+    proxy = FaultOnce(inner)
+    pool = serving.PredictorPool(proxy, max_batch=32, bucketing=False,
+                                 batch_timeout_ms=50.0, _start=False)
+    n = 6
+    # each submitter's feed encodes its identity in the row values
+    reqs = [np.full((1, 6), float(i), np.float32) for i in range(n)]
+    futs = [None] * n
+
+    def worker(i):
+        futs[i] = pool.submit([reqs[i]])
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # the FIFO order the batcher will pop (whatever the thread race
+    # produced) — read it before the batcher starts
+    fifo = [float(r.feeds[0][0, 0]) for r in pool._queue]
+    assert sorted(fifo) == [float(i) for i in range(n)]
+    pool.start()
+    try:
+        for i in range(n):
+            out = np.asarray(futs[i].result(timeout=60.0)[0])
+            expected = np.asarray(inner.run([reqs[i]])[0])
+            # identity: submitter i's future carries the outputs of
+            # submitter i's feeds, bit for bit
+            np.testing.assert_array_equal(out, expected)
+    finally:
+        pool.close()
+    # one faulted coalesced run, then per-request retries in FIFO order
+    assert proxy.calls[0] == n
+    assert proxy.calls[1:n + 1] == [1] * n
+    assert proxy.retry_order == fifo
+
+
 def test_pool_rejects_mismatched_feeds(model_dir):
     with serving.PredictorPool(Config(model_dir)) as pool:
         with pytest.raises(ValueError):
