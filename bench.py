@@ -808,6 +808,153 @@ def bench_generation():
     }
 
 
+def _spmd_worker():
+    """spmd block worker (ISSUE 6, docs/spmd.md): runs in a FRESH
+    process (env: JAX_PLATFORMS=cpu + --xla_force_host_platform_
+    device_count=8 set by _spawn_spmd before python starts) because the
+    8 virtual devices must exist before jax initializes its backend —
+    the main worker has already committed to the real one.
+
+    Workload: a 12-layer BERT-shaped fused train step (forward +
+    backward + adam) under three plans — single-device, dp4 (the
+    data-parallel scaling claim), and dp4xmp2 with Megatron-style
+    tensor-parallel rules (the parity claim: same seeds must give the
+    same per-step losses as single-device to fp32 tolerance, with zero
+    steady-state recompiles).
+
+    HONESTY GATE: the >=1.5x dp4-vs-dp1 acceptance is physically
+    impossible when the container has fewer host cores than mesh
+    devices — 4 fake devices time-slice one core. The block reports the
+    measured speedup as-is and sets core_limited=true LOUDLY instead of
+    faking a pass (the round-2 lesson: never silently bench the wrong
+    thing)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    import paddle_tpu as pt
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.mesh import ShardingPlan
+    from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
+                                        pretraining_loss)
+
+    assert jax.default_backend() == "cpu", jax.default_backend()
+    assert len(jax.devices()) >= 8, len(jax.devices())
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cores = os.cpu_count() or 1
+
+    # dropout off: the parity claim needs a deterministic forward, and
+    # the dropout key stream legitimately differs between the fused
+    # (single-device) and unfused (mesh) attention traces — different
+    # valid masks, not wrong math (docs/spmd.md, "Dropout under a mesh")
+    cfg = BertConfig(vocab_size=512, hidden_size=128,
+                     num_hidden_layers=12, num_attention_heads=4,
+                     intermediate_size=256, max_position_embeddings=64,
+                     hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+    B, S, parity_steps, timed_steps = 8, 32, 3, 5
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    mlm = np.where(rng.rand(B, S) < 0.15, ids, -100).astype(np.int32)
+    nsp = rng.randint(0, 2, (B, 1)).astype(np.int32)
+
+    def mp_rules(name, shape):
+        if len(shape) == 2:
+            if ("linear1" in name or "q_proj" in name
+                    or "k_proj" in name or "v_proj" in name):
+                return P(None, "mp")
+            if "linear2" in name or "out_proj" in name:
+                return P("mp", None)
+        return P()
+
+    def run(plan):
+        pt.dygraph.seed(0)
+        np.random.seed(0)
+        model = BertForPretraining(cfg)
+        opt = pt.optimizer.Adam(1e-3, parameters=model.parameters())
+        step = TrainStep(model, pretraining_loss, opt, plan=plan)
+        losses = [float(step((ids,), (mlm, nsp)))
+                  for _ in range(parity_steps)]
+        cache0 = step._step_fn._cache_size()
+        t0 = time.perf_counter()
+        for _ in range(timed_steps):
+            loss = step((ids,), (mlm, nsp))
+        float(loss)  # sync
+        dt = time.perf_counter() - t0
+        recompiles = step._step_fn._cache_size() - cache0
+        return timed_steps / dt, losses, recompiles
+
+    sps1, losses1, rc1 = run(None)
+    sps4, _, rc4 = run(ShardingPlan("dp4"))
+    spsmp, losses_mp, rcmp = run(
+        ShardingPlan("dp4xmp2", params=mp_rules))
+
+    speedup = sps4 / sps1
+    max_diff = max(abs(a - b) for a, b in zip(losses1, losses_mp))
+    parity_ok = max_diff < 5e-4  # fp32 tolerance over a 12-layer stack
+    core_limited = cores < 8
+    gate = speedup >= 1.5
+    if not gate and core_limited:
+        print("WARN: dp4 speedup %.2fx < 1.5x with only %d host "
+              "core(s) backing 8 fake devices — core_limited, not a "
+              "scaling regression (docs/spmd.md)" % (speedup, cores),
+              file=sys.stderr)
+    print(json.dumps({
+        "workload": "BERT-shaped L%d-H%d fused train step (B=%d, S=%d, "
+                    "fp32, adam) on 8 virtual CPU devices"
+                    % (cfg.num_hidden_layers, cfg.hidden_size, B, S),
+        "host_cores": cores,
+        "dp1_steps_per_sec": round(sps1, 3),
+        "dp4_steps_per_sec": round(sps4, 3),
+        "dp4_speedup": round(speedup, 3),
+        "dp4_speedup_gate_1p5x": bool(gate),
+        "core_limited": bool(core_limited),
+        "dp4xmp2_steps_per_sec": round(spsmp, 3),
+        "dp4xmp2_loss_max_abs_diff": float(max_diff),
+        "dp4xmp2_loss_parity_fp32": bool(parity_ok),
+        "steady_state_recompiles": {"dp1": rc1, "dp4": rc4,
+                                    "dp4xmp2": rcmp},
+        "per_step_losses_dp1": [round(v, 6) for v in losses1],
+        "per_step_losses_dp4xmp2": [round(v, 6) for v in losses_mp],
+    }))
+
+
+def _spawn_spmd(timeout=900):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    import re as _re
+    flags = _re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                    env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--spmd-worker"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        out, err = _graceful_group_kill(proc)
+    sys.stderr.write(err or "")
+    for line in reversed((out or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def bench_spmd():
+    """spmd block (ISSUE 6): dp/mp scaling + loss parity of the
+    mesh-native runtime, measured in a subprocess that owns the 8 fake
+    CPU devices (see _spmd_worker)."""
+    rec = _spawn_spmd()
+    return rec if rec is not None else {
+        "error": "spmd worker produced no result (see stderr)"}
+
+
 def _git(*args):
     try:
         p = subprocess.run(
@@ -920,6 +1067,11 @@ def _run_worker(backend):
         # paged-KV continuous batching (the KV-cache reuse win is real
         # on CPU too — ISSUE 5)
         rec["generation"] = bench_generation()
+    if not os.environ.get("PT_SKIP_SPMD_BENCH"):
+        # mesh-native SPMD runtime: dp scaling + dp4xmp2 loss parity on
+        # 8 fake CPU devices; subprocess-isolated because the virtual
+        # devices must predate jax backend init (ISSUE 6)
+        rec["spmd"] = bench_spmd()
     # VERDICT Weak-#3: the FLOPs-accounting change (honest-MFU, module
     # docstring) redefined the vs_baseline denominator mid-trajectory
     rec["schema_note"] = (
@@ -1093,6 +1245,8 @@ if __name__ == "__main__":
                   file=sys.stderr)
             sys.exit(2)
         _compile_worker(sys.argv[idx + 1])
+    elif "--spmd-worker" in sys.argv:
+        _spmd_worker()
     elif "--worker" in sys.argv:
         idx = sys.argv.index("--worker")
         backend = sys.argv[idx + 1] if idx + 1 < len(sys.argv) else ""

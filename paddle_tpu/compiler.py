@@ -92,6 +92,7 @@ class CompiledProgram:
         self._is_data_parallel = False
         self._loss_name: Optional[str] = None
         self._mesh = None
+        self._plan = None
 
     def with_data_parallel(self, loss_name: Optional[str] = None,
                            build_strategy: Optional[BuildStrategy] = None,
@@ -131,6 +132,23 @@ class CompiledProgram:
         else:
             self._n_devices = None
         return self
+
+    def _get_plan(self):
+        """The ShardingPlan the Executor stages this program with.
+
+        with_data_parallel() programs build (once) a dp plan over
+        _get_mesh() — batch feeds shard over "dp", state replicates,
+        GSPMD inserts the grad all-reduces. Plain CompiledPrograms defer
+        to the globally active plan (mesh.install_plan / use_plan), so a
+        mesh-native caller controls placement without the legacy
+        wrapper."""
+        if not self._is_data_parallel:
+            from .mesh.plan import current_plan
+            return current_plan()
+        if self._plan is None:
+            from .mesh.plan import ShardingPlan
+            self._plan = ShardingPlan(self._get_mesh(), data_axis="dp")
+        return self._plan
 
     def _get_mesh(self):
         if self._mesh is not None:

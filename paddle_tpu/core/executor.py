@@ -270,6 +270,35 @@ def _sds(v) -> jax.ShapeDtypeStruct:
     return jax.ShapeDtypeStruct(tuple(v.shape), dt)
 
 
+def _plan_jit_kwargs(plan, step, example) -> Dict[str, Any]:
+    """Explicit jit shardings for a plan-staged step: inputs pinned to
+    their staged placements, persistable state OUTPUTS pinned to their
+    input shardings (so steady-state steps hand the next step buffers
+    that need no re-placement), the rng threaded replicated, and
+    fetches left unconstrained (None prefix — GSPMD decides).
+
+    The new_state pytree can gain keys the input state lacks (optimizer
+    accumulators materialized by the first step), and an op is allowed
+    to skip a declared output slot — so the output STRUCTURE is taken
+    from an abstract trace (jax.eval_shape: trace-only, no XLA
+    compile), not predicted from op descs. Keys without an input-state
+    sharding get a None leaf (unconstrained)."""
+    state, feed, rng = example
+    rep = plan.replicated()
+
+    def _sh(v):
+        s = getattr(v, "sharding", None)
+        return s if s is not None else rep
+
+    state_sh = {n: _sh(v) for n, v in state.items()}
+    feed_sh = {n: _sh(v) for n, v in feed.items()}
+    avals = jax.tree.map(_sds, (state, dict(feed), rng))
+    _, new_state_struct, _ = jax.eval_shape(step, *avals)
+    out_state_sh = {n: state_sh.get(n) for n in new_state_struct}
+    return dict(in_shardings=(state_sh, feed_sh, _sh(rng)),
+                out_shardings=(None, out_state_sh, rep))
+
+
 def _single_device(v) -> bool:
     """Exported modules are single-logical-device; a value already
     sharded across a mesh must take the plain jit path."""
@@ -319,7 +348,6 @@ class Executor:
         # extra trace is paid once, not per run
         self._unexportable: set = set()
         self._seed_counter = 0
-        self._warned_uneven: set = set()
         self._unused_checked: set = set()
         # telemetry step ids: monotonically counts run() calls; the
         # dataset loops install their own batch-number step scope and
@@ -395,17 +423,21 @@ class Executor:
 
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
                   use_program_cache):
-        # CompiledProgram.with_data_parallel (compiler.py): unwrap and
-        # stage feeds sharded over the mesh dp axis — GSPMD partitions
-        # the step and inserts the grad all-reduces (the ParallelExecutor
-        # + AllReduceOpHandle pipeline of the reference)
-        dp_mesh = None
+        # Resolve the ShardingPlan (mesh/plan.py) this run stages
+        # through. CompiledProgram.with_data_parallel builds a dp plan
+        # over its mesh — GSPMD partitions the step and inserts the
+        # grad all-reduces (the ParallelExecutor + AllReduceOpHandle
+        # pipeline of the reference); everything else picks up the
+        # globally active plan (mesh.install_plan / use_plan), so
+        # mesh-native callers drive placement with no wrapper at all.
         from ..compiler import CompiledProgram as _CP
+        from ..mesh.plan import current_plan, plan_topology
         if isinstance(program, _CP):
             cp = program
             program = cp._program
-            if cp._is_data_parallel:
-                dp_mesh = cp._get_mesh()
+            plan = cp._get_plan()
+        else:
+            plan = current_plan()
         program = program if program is not None else default_main_program()
         scope = scope if scope is not None else global_scope()
         feed = dict(feed or {})
@@ -413,42 +445,27 @@ class Executor:
                        for f in (fetch_list or [])]
 
         feed = {k: _as_feed(v) for k, v in feed.items()}
-        if dp_mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            n = dp_mesh.shape["dp"]
-            staged = {}
-            for k, v in feed.items():
-                arr = np.asarray(v)
-                if arr.ndim >= 1 and arr.shape[0] % n == 0:
-                    spec = P("dp", *([None] * (arr.ndim - 1)))
-                    staged[k] = jax.device_put(
-                        arr, NamedSharding(dp_mesh, spec))
-                else:
-                    # a feed whose batch doesn't divide the dp mesh
-                    # REPLICATES to every device: every replica computes
-                    # the same full batch — correct but n-times the
-                    # work. Loud, once per (feed, shape): the reference
-                    # errors on uneven batches; we keep them running but
-                    # never silently (round-2 weak #9).
-                    if arr.ndim >= 1 and \
-                            (k, arr.shape[0]) not in self._warned_uneven:
-                        import logging
-                        self._warned_uneven.add((k, arr.shape[0]))
-                        logging.getLogger("paddle_tpu").warning(
-                            "feed %r batch %d does not divide the "
-                            "dp=%d mesh; replicating the whole feed "
-                            "(n-times redundant compute) — pad or "
-                            "drop_last to avoid this", k,
-                            arr.shape[0], n)
-                    staged[k] = jax.device_put(
-                        arr, NamedSharding(dp_mesh, P()))
-            feed = staged
+        if plan is not None:
+            # batch feeds shard over the plan's data axis (uneven
+            # batches replicate, loudly — plan.input_sharding owns the
+            # one-time warning the old ad-hoc dp path used to emit)
+            feed = plan.stage_feeds(feed)
 
         # run initializer-style programs (startup): ops writing persistables
         # with no feeds/fetches execute eagerly into the scope.
         block = program.global_block
         state_names = self._state_names(program, scope)
         state = {n: scope.find_var(n) for n in state_names}
+        if plan is not None and state:
+            # place persistable state per the plan's param rules and
+            # write the placed buffers back, so every later step finds
+            # them resident (plan.place skips equal shardings — steady
+            # state does zero device_puts here)
+            placed = plan.place_state(state)
+            for n, v in placed.items():
+                if v is not state[n]:
+                    scope.set(n, v)
+            state = placed
         rng = scope.find_var(RNG_VAR)
         if rng is None:
             seed = program.random_seed
@@ -456,26 +473,33 @@ class Executor:
                 self._seed_counter += 1
                 seed = self._seed_counter
             rng = jax.random.PRNGKey(seed)
+        if plan is not None:
+            rng = plan.place(rng, plan.replicated())
 
         # lowering-relevant flags are part of the compiled artifact: the
         # key snapshots them so flipping e.g. FLAGS_dropout_storage
         # mid-process recompiles instead of returning a stale executable
         from ..flags import get_flag, lowering_snapshot
+        # plan_topology folds the mesh (axis names+sizes+device kind)
+        # into the key: flipping the mesh recompiles instead of serving
+        # an executable partitioned for the old topology; no plan keeps
+        # the key byte-identical to the pre-mesh era.
         key = (id(program), program._version, _feed_sig(feed),
-               tuple(fetch_names), tuple(state_names), lowering_snapshot())
+               tuple(fetch_names), tuple(state_names), lowering_snapshot(),
+               plan_topology(plan))
         from .. import telemetry as _tm
         entry = self._cache_get(key) if use_program_cache else None
         if entry is None:
             from ..monitor import stat_add
             stat_add("STAT_executor_compile")
             example = None
-            if use_program_cache and dp_mesh is None:
+            if use_program_cache:
                 example = (state, feed, rng)
             with _tm.span("executor/compile", track="compile",
                           timer="TIMER_executor_compile_us"):
                 entry = self._compile(program, block, sorted(feed),
                                       fetch_names, state_names,
-                                      example=example)
+                                      example=example, plan=plan)
             if use_program_cache:
                 self._cache_put(key, entry)
         fn = entry
@@ -582,7 +606,7 @@ class Executor:
 
     def _compile(self, program: Program, block: Block,
                  feed_names: List[str], fetch_names: List[str],
-                 state_names: List[str], example=None):
+                 state_names: List[str], example=None, plan=None):
         persistable = {v.name for v in program.persistable_vars()}
         has_host = any(REGISTRY.has(op.type) and REGISTRY.get(op.type).host
                        for op in block.ops)
@@ -611,38 +635,62 @@ class Executor:
                 new_state.setdefault(n, state[n])
             return fetches, new_state, ctx.key_out
 
-        aot = self._aot_entry(program, step, example, fetch_names)
+        aot = self._aot_entry(program, step, example, fetch_names,
+                              plan=plan)
         if aot is not None:
             return aot
+        jit_kwargs = {}
+        if plan is not None and example is not None:
+            jit_kwargs = _plan_jit_kwargs(plan, step, example)
         jitted = jax.jit(step,
-                         donate_argnums=(0,) if _donate_state() else ())
+                         donate_argnums=(0,) if _donate_state() else (),
+                         **jit_kwargs)
         return jitted
 
     # ------------------------------------------------------------------
     def _aot_entry(self, program: Program, step, example,
-                   fetch_names: Sequence[str]):
+                   fetch_names: Sequence[str], plan=None):
         """Disk-backed AOT path (core/program_cache.py): serve the step
         from a StableHLO trace-cache entry, exporting and storing one on
         miss. Both hit and miss execute the DESERIALIZED module (the
         miss round-trips its own bytes) so the XLA persistent-cache key
         is identical across processes and the warm process skips the
         binary compile as well. Returns None whenever this program/run
-        cannot be disk-cached — caller falls back to plain jit."""
+        cannot be disk-cached — caller falls back to plain jit.
+
+        Under a ShardingPlan the exported module is partitioned: the
+        export carries the plan's explicit in/out shardings and the
+        fingerprint carries the mesh topology, so an entry can only be
+        served to a process with the IDENTICAL mesh (axis names, sizes,
+        device kind) — a chip-count change is a fingerprint change,
+        never a stale hit."""
         if example is None:
             return None
         cache_dir = program_cache.resolve_dir(self._program_cache_dir)
         if cache_dir is None:
             return None
         state, feed, rng = example
-        if not all(_single_device(v) for v in
-                   jax.tree.leaves((state, feed, rng))):
+        if plan is None and not all(_single_device(v) for v in
+                                    jax.tree.leaves((state, feed, rng))):
+            # values sharded by some means OTHER than the plan (manual
+            # device_put by the caller) are not reproducible from the
+            # fingerprint — leave them to the JIT path
             return None
         feed_sig = _feed_sig(feed)
         state_sig = tuple((n, tuple(np.shape(v)), str(_sds(v).dtype))
                           for n, v in state.items())
-        fp = program.fingerprint(feed_sig, tuple(fetch_names), state_sig)
+        extra = (("mesh",) + tuple(plan.topology()),) if plan is not None \
+            else ()
+        fp = program.fingerprint(feed_sig, tuple(fetch_names), state_sig,
+                                 extra=extra)
         if fp is None or fp in self._unexportable:
             return None
+        # the deserialized module demands exactly this many devices in
+        # the call context: the plan's mesh, or 1 when unplanned. A
+        # program whose ops *internally* shard_map over a mesh (static
+        # pipeline's pp axis) exports as a multi-device module that a
+        # 1-device call can never run — it must stay on the jit path.
+        want_devices = plan.spec.size if plan is not None else 1
         program_cache.ensure_xla_cache(cache_dir)
         avals = jax.tree.map(_sds, (state, dict(feed), rng))
         exported = None
@@ -650,6 +698,10 @@ class Executor:
         if payload is not None:
             try:
                 cand = jax.export.deserialize(payload)
+                if cand.nr_devices != want_devices:
+                    # only the pre-guard buggy path wrote such entries
+                    # (fingerprints already separate mesh topologies)
+                    raise ValueError("device count mismatch")
                 if _avals_match(cand, avals):
                     exported = cand
                 else:
@@ -661,9 +713,17 @@ class Executor:
                 exported = None
         if exported is None:
             try:
-                data = jax.export.export(jax.jit(step))(*avals).serialize()
+                jit_kwargs = {} if plan is None else \
+                    _plan_jit_kwargs(plan, step, example)
+                data = jax.export.export(
+                    jax.jit(step, **jit_kwargs))(*avals).serialize()
                 exported = jax.export.deserialize(data)
             except Exception:
+                self._unexportable.add(fp)
+                from ..monitor import stat_add
+                stat_add("STAT_program_cache_unexportable")
+                return None
+            if exported.nr_devices != want_devices:
                 self._unexportable.add(fp)
                 from ..monitor import stat_add
                 stat_add("STAT_program_cache_unexportable")

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..mesh.compat import shard_map as _shard_map
 from ..parallel.env import MP_AXIS
 
 
@@ -51,7 +52,7 @@ def sharded_lookup(table_local: jax.Array, ids: jax.Array, mesh: Mesh,
         rows = jnp.where(mine[:, None], rows, 0)
         return jax.lax.psum(rows, axis)
 
-    out = jax.shard_map(
+    out = _shard_map(
         body, mesh=mesh,
         in_specs=(P(axis, None), P()),
         out_specs=P(),

@@ -127,6 +127,14 @@ _DEFS: Dict[str, Any] = {
     # kernel (scalar-prefetched block tables; interpret-mode on CPU).
     # Read at trace time -> part of every generation compile key.
     "FLAGS_paged_attention_kernel": "reference",
+    # mesh-native SPMD runtime (paddle_tpu/mesh/, docs/spmd.md): a mesh
+    # spec string ("dp4", "dp=4,mp=2", "dp4xmp2") builds a process-wide
+    # default ShardingPlan that Executor / TrainStep / hapi / Predictor
+    # pick up when nothing installed one explicitly
+    # (mesh.install_plan / use_plan override; "" disables). The mesh
+    # topology rides in every compilation cache key and disk
+    # fingerprint, NOT via lowering_snapshot — see executor.py.
+    "FLAGS_mesh_spec": "",
     # state-buffer donation in the jitted train step. Donation aliases
     # each state input to its output buffer (in-place updates, halves
     # peak param memory) but XLA:CPU runs donated executions

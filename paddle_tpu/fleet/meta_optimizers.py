@@ -15,6 +15,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..mesh.compat import pcast as _pcast, shard_map as _shard_map, \
+    typeof as _typeof
 from ..core.backward import append_backward
 from ..core.program import OpDesc, default_main_program, \
     default_startup_program
@@ -279,8 +281,8 @@ class DGCMomentumOptimizer(MetaOptimizerBase):
                 # rampup: plain momentum on the dense pmean; v unused
                 u_d = momentum * u + jax.lax.pmean(g, axis)
                 zeros = jnp.zeros_like(v)
-                if axis not in getattr(jax.typeof(zeros), "vma", (axis,)):
-                    zeros = jax.lax.pcast(zeros, (axis,), to="varying")
+                if axis not in getattr(_typeof(zeros), "vma", (axis,)):
+                    zeros = _pcast(zeros, (axis,), to="varying")
                 # u_d is replicated in VALUE (identical pmean'ed grads ->
                 # identical momentum) but typed varying via u; pcast-by-
                 # pmean keeps branch output types equal to sparse_leaf's
@@ -303,7 +305,7 @@ class DGCMomentumOptimizer(MetaOptimizerBase):
             expand = lambda t: jax.tree.map(lambda x: x[None], t)
             return params, (expand(u_new), expand(v_new)), loss
 
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             body, mesh=mesh,
             in_specs=(P(), (P(axis), P(axis)), P(), P(axis)),
             out_specs=(P(), (P(axis), P(axis)), P()))
@@ -346,7 +348,7 @@ class LocalSGDOptimizer(MetaOptimizerBase):
         from jax.sharding import PartitionSpec as P
 
         def avg(p):
-            return jax.shard_map(
+            return _shard_map(
                 lambda x: jax.lax.pmean(x, axis),
                 mesh=mesh, in_specs=P(), out_specs=P())(p)
         return jax.tree.map(avg, params)
@@ -373,7 +375,7 @@ class LocalSGDOptimizer(MetaOptimizerBase):
             p = jax.tree.map(lambda x: jax.lax.pmean(x, axis), p)
             return p, jax.lax.pmean(losses[-1], axis)
 
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             body, mesh=mesh,
             in_specs=(P(), P(None, axis)), out_specs=(P(), P()))
         jitted = jax.jit(lambda params, batches: sharded(params, batches))

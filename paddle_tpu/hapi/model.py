@@ -38,7 +38,13 @@ class Model:
 
     # --- prepare (model.py:1180) -----------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, plan=None):
+        """``plan`` (mesh-native SPMD, docs/spmd.md): a ShardingPlan —
+        or anything ShardingPlan accepts ("dp4xmp2", {"dp": 8},
+        MeshSpec) — threaded into the fused TrainStep; batches shard
+        over the plan's data axis, params place per its rules. Omitted,
+        the TrainStep still picks up a globally installed plan
+        (paddle_tpu.mesh.install_plan)."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
@@ -56,7 +62,7 @@ class Model:
                 # split: network outputs first, labels after
                 return self._call_loss(loss, outs_and_labels)
             self._train_step = TrainStep(self.network, loss_fn, optimizer,
-                                         amp_dtype=amp)
+                                         amp_dtype=amp, plan=plan)
         return self
 
     @staticmethod

@@ -139,6 +139,25 @@ class Config:
         # from FLAGS_predictor_shape_buckets, a list pins the ladder
         self._shape_buckets = None
         self._bucket_axes = (0,)
+        # mesh-native SPMD serving (docs/spmd.md): a ShardingPlan the
+        # predictor activates around every execution
+        self._spmd_plan = None
+
+    def enable_spmd(self, plan_or_spec, data_axis: str = "dp"):
+        """Serve under a ShardingPlan (docs/spmd.md): batch feeds shard
+        over the plan's data axis across the mesh, params place per the
+        plan's rules, and the program-cache fingerprint carries the
+        mesh topology so AOT entries never cross topologies. Accepts a
+        ShardingPlan or anything one is built from ("dp4", {"dp": 8},
+        a MeshSpec, an existing jax Mesh)."""
+        from .mesh.plan import ShardingPlan
+        if not isinstance(plan_or_spec, ShardingPlan):
+            plan_or_spec = ShardingPlan(plan_or_spec, data_axis=data_axis)
+        self._spmd_plan = plan_or_spec
+        return self
+
+    def disable_spmd(self):
+        self._spmd_plan = None
 
     def enable_program_cache(self, cache_dir: Optional[str] = None):
         """Serve this predictor's traced+compiled program from the
@@ -250,6 +269,17 @@ class Predictor:
         # distinguishes steady-state bucket hits from first-touch
         # compiles in the serving counters
         self._warm_sigs: set = set()
+        self._plan = getattr(config, "_spmd_plan", None)
+
+    def _plan_ctx(self):
+        """Activate this predictor's plan (Config.enable_spmd) around
+        an execution. No plan configured → null context, so a globally
+        installed plan (mesh.install_plan) still applies."""
+        if self._plan is None:
+            from contextlib import nullcontext
+            return nullcontext()
+        from .mesh.plan import use_plan
+        return use_plan(self._plan)
 
     def _cast_params_bf16(self):
         import jax.numpy as jnp
@@ -290,7 +320,7 @@ class Predictor:
             raise RuntimeError("missing inputs: %s" % missing)
         from . import telemetry as _tm
         with _tm.span("serving/predict", track="serving",
-                      timer="TIMER_predictor_run_us"):
+                      timer="TIMER_predictor_run_us"), self._plan_ctx():
             ladder = self._ladder()
             if ladder:
                 outs = self._run_bucketed(dict(self._feeds), ladder)
@@ -414,9 +444,10 @@ class Predictor:
                         if t is not None:
                             shape[ax] = t
                 feeds[n] = np.zeros(tuple(shape), v.dtype)
-            self.exe.run(self.program, feed=feeds,
-                         fetch_list=list(self.fetch_names),
-                         scope=self.scope)
+            with self._plan_ctx():
+                self.exe.run(self.program, feed=feeds,
+                             fetch_list=list(self.fetch_names),
+                             scope=self.scope)
             self._warm_sigs.add(self._bucket_sig(feeds))
 
         from .core import program_cache

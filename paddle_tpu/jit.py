@@ -176,12 +176,18 @@ class TrainStep:
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  mesh=None, batch_spec=None, param_rules=None,
-                 grad_accum_steps: int = 1, amp_dtype: Optional[str] = None):
+                 grad_accum_steps: int = 1, amp_dtype: Optional[str] = None,
+                 plan=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh
         self.param_rules = param_rules
+        # mesh-native path: a ShardingPlan (or anything ShardingPlan
+        # accepts — MeshSpec, "dp4xmp2", {"dp": 8}) supersedes the raw
+        # mesh/param_rules pair; with nothing passed the step picks up
+        # the globally installed plan (mesh.install_plan) at build time
+        self.plan = plan
         self.grad_accum_steps = grad_accum_steps
         self.amp_dtype = amp_dtype
         self._step_fn = None
@@ -325,6 +331,22 @@ class TrainStep:
     def __call__(self, inputs, labels):
         from . import telemetry as _tm
         if self._step_fn is None:
+            plan = self.plan
+            if plan is None and self.mesh is None and \
+                    self.param_rules is None:
+                from .mesh.plan import current_plan
+                plan = current_plan()
+            if plan is not None:
+                from .mesh.plan import ShardingPlan
+                if not isinstance(plan, ShardingPlan):
+                    plan = ShardingPlan(plan)
+                self.plan = plan
+                self.mesh = plan.mesh
+                if self.param_rules is None:
+                    # param_sharding returns full NamedShardings; the
+                    # annotate block below accepts both spellings
+                    self.param_rules = \
+                        lambda n, s, _p=plan: _p.param_sharding(n, s)
             with _tm.span("trainstep/build", track="compile",
                           timer="TIMER_trainstep_build_us"):
                 self._step_fn = self._build()
@@ -338,9 +360,14 @@ class TrainStep:
                 # mesh, scalars included
                 from jax.sharding import NamedSharding, PartitionSpec as P
                 rules = self.param_rules or (lambda n, s: P())
+
+                def _psh(n, v):
+                    sp = rules(n, tuple(v.shape))
+                    return sp if isinstance(sp, NamedSharding) \
+                        else NamedSharding(self.mesh, sp)
+
                 self._state = {
-                    n: jax.device_put(np.asarray(v), NamedSharding(
-                        self.mesh, rules(n, tuple(v.shape))))
+                    n: jax.device_put(np.asarray(v), _psh(n, v))
                     for n, v in self._state.items()}
                 self._lr_step = jax.device_put(
                     self._lr_step, NamedSharding(self.mesh, P()))
@@ -353,7 +380,19 @@ class TrainStep:
             inputs if isinstance(inputs, (tuple, list)) else (inputs,)))
         labels = tuple(_unwrap(x) for x in (
             labels if isinstance(labels, (tuple, list)) else (labels,)))
-        if self.mesh is not None:
+        if self.plan is not None:
+            # plan-staged batches: the input rule decides (default
+            # shards dim 0 over the plan's data axis), and the
+            # STAT_mesh_* instruments see the traffic
+            def _stage(prefix, vals):
+                return tuple(
+                    None if x is None else self.plan.place(
+                        x, self.plan.input_sharding(
+                            "%s%d" % (prefix, i), np.shape(x)))
+                    for i, x in enumerate(vals))
+            inputs = _stage("input", inputs)
+            labels = _stage("label", labels)
+        elif self.mesh is not None:
             # shard with THIS step's mesh — the global parallel-env mesh
             # may be a different (even differently-sized) mesh
             from .parallel.env import shard_batch
@@ -374,9 +413,19 @@ class TrainStep:
                 step_id = self._tm_step
             _tm.flight_begin(step_id, program="trainstep:%s"
                              % type(self.model).__name__)
+        # the plan is active while the step runs so trace-time mesh
+        # checks (MultiHeadAttention's fused-QKV bypass, parallel/env
+        # world size) see it — jax.jit traces lazily on the FIRST
+        # dispatch, not in _build()
+        if self.plan is not None:
+            from .mesh.plan import use_plan
+            plan_ctx = use_plan(self.plan)
+        else:
+            import contextlib
+            plan_ctx = contextlib.nullcontext()
         with _tm.span("trainstep/dispatch", step=step_id,
                       track="dispatch",
-                      timer="TIMER_trainstep_dispatch_us"):
+                      timer="TIMER_trainstep_dispatch_us"), plan_ctx:
             loss, self._state, self._opt_state, self._lr_step = \
                 self._step_fn(self._state, self._opt_state,
                               self._lr_step, sub, (inputs, labels))

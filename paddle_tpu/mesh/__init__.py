@@ -1,0 +1,19 @@
+"""Mesh-native SPMD runtime (docs/spmd.md).
+
+``MeshSpec`` names the device topology ("dp4xmp2"), ``ShardingPlan``
+maps program params/inputs/outputs onto it and compiles callables with
+explicit in/out shardings; ``install_plan``/``use_plan`` make a plan
+ambient so Executor, TrainStep, hapi, and the Predictor pick it up.
+``compat`` owns the jax-version shims (shard_map location/kwargs,
+axis_size) every manual-collective path goes through.
+"""
+from .spec import MeshSpec, spec_of
+from .plan import (ShardingPlan, current_plan, install_plan, plan_topology,
+                   use_plan)
+from . import compat
+
+__all__ = [
+    "MeshSpec", "ShardingPlan", "spec_of",
+    "current_plan", "install_plan", "use_plan", "plan_topology",
+    "compat",
+]

@@ -1,0 +1,261 @@
+"""ShardingPlan — the placement policy threaded through the runtime.
+
+A :class:`ShardingPlan` binds a resolved mesh (from
+:class:`paddle_tpu.mesh.spec.MeshSpec`) to three placement rules:
+
+- **inputs** (feeds / batches): default shards the leading dim over the
+  plan's data axis when it divides evenly, else replicates with a
+  one-time warning — the same contract the Executor's old ad-hoc
+  ``dp_mesh`` path had, now owned here;
+- **params** (model/optimizer state): default replicates; a rule
+  callable ``(name, shape) -> PartitionSpec`` (or a dict of exact names)
+  opts tensors into model parallelism — Megatron-style column/row splits
+  over ``"mp"`` for example;
+- **outputs**: fetches default to "let XLA decide" (None leaf), state
+  outputs are pinned to their input shardings so steady-state steps
+  never reshard or recompile.
+
+The plan also owns the two integration seams the rest of the runtime
+uses: :meth:`compile` (jax.jit with explicit in/out shardings + the
+TIMER_mesh_compile_us instrument) and :meth:`topology` (the hashable
+mesh token folded into program-cache fingerprints). A process-global
+*active plan* (:func:`install_plan` / :func:`use_plan` /
+:func:`current_plan`) is what Executor, hapi, and parallel/env.py
+consult when no plan is passed explicitly.
+
+Instruments (monitor.py, always-on like the program-cache timers):
+STAT_mesh_placements / STAT_mesh_reshard_bytes (device_put work the
+plan actually did vs. values already resident with the right
+sharding), STAT_mesh_collective_<axis> (host-level collective launches
+per axis, bumped in parallel/collective.py), TIMER_mesh_compile_us
+(jit-with-shardings compile walltime), GAUGE_mesh_devices.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import warnings
+from typing import Any, Callable, Dict, Mapping, Optional, Union
+
+import numpy as np
+
+from .spec import MeshSpec, spec_of
+
+Rule = Union[None, Mapping[str, Any], Callable[[str, tuple], Any]]
+
+
+def _as_rule(rule: Rule) -> Optional[Callable[[str, tuple], Any]]:
+    if rule is None or callable(rule):
+        return rule
+    table = dict(rule)
+    return lambda name, shape: table.get(name)
+
+
+class ShardingPlan:
+    """Placement policy for one mesh. See module docstring."""
+
+    def __init__(self, spec: Union[MeshSpec, str, Mapping[str, int], Any],
+                 *, params: Rule = None, inputs: Rule = None,
+                 data_axis: str = "dp", devices=None):
+        import jax
+        from jax.sharding import Mesh
+
+        if isinstance(spec, Mesh):
+            self.mesh = spec
+            self.spec = spec_of(spec)
+        else:
+            if not isinstance(spec, MeshSpec):
+                spec = MeshSpec(spec)
+            self.spec = spec
+            self.mesh = spec.build(devices)
+        self.data_axis = data_axis if data_axis in self.spec else None
+        self._params = _as_rule(params)
+        self._inputs = _as_rule(inputs)
+        self._warned_uneven: set = set()
+        from ..monitor import gauge_set
+        gauge_set("GAUGE_mesh_devices", float(self.spec.size))
+
+    # -- shardings --------------------------------------------------------
+    def replicated(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P())
+
+    def _named(self, pspec):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if pspec is None:
+            return NamedSharding(self.mesh, P())
+        if isinstance(pspec, NamedSharding):
+            return pspec
+        if not isinstance(pspec, P):
+            pspec = P(*pspec) if isinstance(pspec, (tuple, list)) else P(pspec)
+        return NamedSharding(self.mesh, pspec)
+
+    def param_sharding(self, name: str, shape=()) -> Any:
+        """NamedSharding for a named state/param tensor (default
+        replicated; the ``params`` rule opts into splits)."""
+        pspec = self._params(name, tuple(shape)) if self._params else None
+        return self._named(pspec)
+
+    def input_sharding(self, name: str, shape) -> Any:
+        """NamedSharding for a feed/batch tensor. ``inputs`` rule wins;
+        default shards dim 0 over the data axis when divisible."""
+        from jax.sharding import PartitionSpec as P
+        shape = tuple(shape)
+        if self._inputs is not None:
+            pspec = self._inputs(name, shape)
+            if pspec is not None:
+                return self._named(pspec)
+        if self.data_axis is None or not shape:
+            return self.replicated()
+        dp = self.spec.axis_size(self.data_axis)
+        if dp > 1 and shape[0] % dp == 0:
+            return self._named(P(self.data_axis,
+                                 *([None] * (len(shape) - 1))))
+        if dp > 1 and name not in self._warned_uneven:
+            self._warned_uneven.add(name)
+            warnings.warn(
+                "feed %r leading dim %s not divisible by %s=%d; "
+                "replicating instead of sharding" %
+                (name, shape[:1], self.data_axis, dp), stacklevel=2)
+        return self.replicated()
+
+    # -- placement --------------------------------------------------------
+    def place(self, value, sharding):
+        """device_put onto ``sharding``, skipping values already
+        resident with an equivalent sharding; counts reshard traffic."""
+        import jax
+        cur = getattr(value, "sharding", None)
+        if cur is not None and cur == sharding:
+            return value
+        from ..monitor import stat_add
+        stat_add("STAT_mesh_placements")
+        nbytes = getattr(value, "nbytes", None)
+        if nbytes is None:
+            nbytes = int(np.asarray(value).nbytes)
+        stat_add("STAT_mesh_reshard_bytes", float(nbytes))
+        return jax.device_put(value, sharding)
+
+    def stage_feeds(self, feeds: Dict[str, Any]) -> Dict[str, Any]:
+        """Place a feed dict per the input rule (the Executor's feed-
+        staging seam)."""
+        return {n: self.place(v, self.input_sharding(n, np.shape(v)))
+                for n, v in feeds.items()}
+
+    def place_state(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        """Place a flat name->tensor state dict per the param rule."""
+        return {n: self.place(v, self.param_sharding(n, np.shape(v)))
+                for n, v in state.items()}
+
+    def shardings_of(self, tree):
+        """Pytree of the *current* shardings of already-placed values —
+        what compile() pins as in_shardings."""
+        import jax
+        return jax.tree_util.tree_map(
+            lambda v: getattr(v, "sharding", None) or self.replicated(),
+            tree)
+
+    # -- compile ----------------------------------------------------------
+    def compile(self, fn, *, in_shardings=None, out_shardings=None,
+                **jit_kwargs):
+        """``jax.jit`` with explicit shardings; observes
+        TIMER_mesh_compile_us around the first (tracing+compiling) call.
+
+        None leaves in either pytree mean "unconstrained" — jax treats
+        them as unspecified, so fetches can stay wherever GSPMD puts
+        them while state outputs are pinned."""
+        import jax
+        kw = dict(jit_kwargs)
+        if in_shardings is not None:
+            kw["in_shardings"] = in_shardings
+        if out_shardings is not None:
+            kw["out_shardings"] = out_shardings
+        jitted = jax.jit(fn, **kw)
+
+        def timed_first_call(*args, **kwargs):
+            from ..monitor import timer_observe
+            t0 = time.perf_counter()
+            out = jitted(*args, **kwargs)
+            timer_observe("TIMER_mesh_compile_us",
+                          (time.perf_counter() - t0) * 1e6)
+            return out
+
+        timed_first_call.jitted = jitted
+        return timed_first_call
+
+    # -- identity ---------------------------------------------------------
+    def topology(self) -> tuple:
+        """Hashable mesh token (axis names+sizes+device kind) for cache
+        keys and disk fingerprints."""
+        devs = self.mesh.devices.reshape(-1)
+        return self.spec.topology(devices=list(devs))
+
+    def __repr__(self) -> str:
+        return "ShardingPlan(%r, data_axis=%r)" % (self.spec, self.data_axis)
+
+
+# -- active-plan registry -------------------------------------------------
+_active = threading.local()
+_global_plan: Optional[ShardingPlan] = None
+_lock = threading.Lock()
+
+
+def install_plan(plan: Optional[ShardingPlan]) -> Optional[ShardingPlan]:
+    """Install (or clear, with None) the process-global active plan.
+    Returns the previous one."""
+    global _global_plan
+    with _lock:
+        prev, _global_plan = _global_plan, plan
+    return prev
+
+
+_flag_plans: Dict[str, ShardingPlan] = {}
+
+
+def _flag_plan() -> Optional[ShardingPlan]:
+    """Plan from FLAGS_mesh_spec (flags.py) — the lowest-precedence
+    default, consulted only when nothing installed a plan. Built once
+    per distinct spec string, so flipping the flag mid-process switches
+    plans without rebuilding meshes per step."""
+    from ..flags import get_flag
+    spec = get_flag("FLAGS_mesh_spec")
+    if not spec:
+        return None
+    plan = _flag_plans.get(spec)
+    if plan is None:
+        with _lock:
+            plan = _flag_plans.get(spec)
+            if plan is None:
+                plan = _flag_plans[spec] = ShardingPlan(spec)
+    return plan
+
+
+def current_plan() -> Optional[ShardingPlan]:
+    """The active plan: innermost ``use_plan`` scope on this thread,
+    else the installed global plan, else the FLAGS_mesh_spec default,
+    else None."""
+    stack = getattr(_active, "stack", None)
+    if stack:
+        return stack[-1]
+    if _global_plan is not None:
+        return _global_plan
+    return _flag_plan()
+
+
+@contextlib.contextmanager
+def use_plan(plan: Optional[ShardingPlan]):
+    """Thread-local scoped activation (nests; None masks the global)."""
+    stack = getattr(_active, "stack", None)
+    if stack is None:
+        stack = _active.stack = []
+    stack.append(plan)
+    try:
+        yield plan
+    finally:
+        stack.pop()
+
+
+def plan_topology(plan: Optional[ShardingPlan]) -> tuple:
+    """Cache-key token for an optional plan (() when no plan — keeps
+    single-device keys identical to the pre-mesh era)."""
+    return plan.topology() if plan is not None else ()

@@ -1,0 +1,154 @@
+"""MeshSpec — named device-mesh topology for the SPMD runtime.
+
+A :class:`MeshSpec` is the declarative half of the mesh subsystem: an
+ordered mapping of axis names to sizes ("dp"=4, "mp"=2) that can be
+resolved against whatever devices the process actually has — real TPU
+chips or CPU fake devices forced with
+``XLA_FLAGS=--xla_force_host_platform_device_count=N``. The resolved
+``jax.sharding.Mesh`` is what :class:`paddle_tpu.mesh.plan.ShardingPlan`
+builds NamedShardings against; the spec itself (axis names + sizes +
+device kind) is what goes into program-cache fingerprints so AOT
+entries never collide across chip counts (docs/spmd.md).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Mapping, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+_AXIS_RE = re.compile(r"([A-Za-z_][A-Za-z0-9_]*?)(\d+)$")
+
+
+class MeshSpec:
+    """Ordered named mesh axes, e.g. ``MeshSpec({"dp": 4, "mp": 2})``.
+
+    Also parses the compact string grammar used by flags/env vars:
+    ``"dp4xmp2"`` -> dp=4, mp=2; ``"dp8"`` -> dp=8. Axis order is
+    significant — it is the device-grid order and part of the topology
+    fingerprint.
+    """
+
+    def __init__(self, axes: Union[str, Mapping[str, int],
+                                   Sequence[Tuple[str, int]]]):
+        if isinstance(axes, str):
+            axes = _parse_axes(axes)
+        elif isinstance(axes, Mapping):
+            axes = list(axes.items())
+        pairs = []
+        for name, size in axes:
+            size = int(size)
+            if not name or not isinstance(name, str):
+                raise ValueError("mesh axis name must be a non-empty "
+                                 "string, got %r" % (name,))
+            if size < 1:
+                raise ValueError("mesh axis %r must have size >= 1, got %d"
+                                 % (name, size))
+            pairs.append((name, size))
+        if not pairs:
+            raise ValueError("MeshSpec needs at least one axis")
+        names = [n for n, _ in pairs]
+        if len(set(names)) != len(names):
+            raise ValueError("duplicate mesh axis names: %r" % (names,))
+        self._axes: Tuple[Tuple[str, int], ...] = tuple(pairs)
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def axes(self) -> Tuple[Tuple[str, int], ...]:
+        return self._axes
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self._axes)
+
+    def axis_size(self, name: str) -> int:
+        for n, s in self._axes:
+            if n == name:
+                return s
+        raise KeyError("mesh axis %r not in spec %s" % (name, self))
+
+    @property
+    def size(self) -> int:
+        total = 1
+        for _, s in self._axes:
+            total *= s
+        return total
+
+    def __contains__(self, name: str) -> bool:
+        return any(n == name for n, _ in self._axes)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, MeshSpec) and other._axes == self._axes
+
+    def __hash__(self) -> int:
+        return hash(self._axes)
+
+    def __repr__(self) -> str:
+        return "MeshSpec(%s)" % "x".join(
+            "%s%d" % (n, s) for n, s in self._axes)
+
+    # -- resolution -------------------------------------------------------
+    def build(self, devices: Optional[Sequence] = None):
+        """Resolve against real devices -> ``jax.sharding.Mesh``.
+
+        Uses the first ``self.size`` of ``devices`` (default
+        ``jax.devices()``) reshaped to the axis grid. Raises with the
+        fake-device recipe when the process doesn't have enough."""
+        import jax
+        from jax.sharding import Mesh
+
+        if devices is None:
+            devices = jax.devices()
+        need = self.size
+        if len(devices) < need:
+            raise RuntimeError(
+                "MeshSpec %s needs %d devices but the process has %d. "
+                "On CPU, run with XLA_FLAGS=--xla_force_host_platform_"
+                "device_count=%d (and JAX_PLATFORMS=cpu) to get fake "
+                "devices — see docs/spmd.md." % (self, need, len(devices),
+                                                 need))
+        grid = np.asarray(devices[:need], dtype=object).reshape(
+            [s for _, s in self._axes])
+        return Mesh(grid, self.axis_names)
+
+    def topology(self, devices: Optional[Sequence] = None) -> tuple:
+        """Hashable topology token for cache keys / fingerprints:
+        ``(("dp", 4), ("mp", 2), "cpu")``. Includes the device kind so a
+        plan resolved on different hardware never shares an AOT entry."""
+        kind = _device_kind(devices)
+        return self._axes + (kind,)
+
+
+def _parse_axes(text: str):
+    """``"dp4xmp2"`` -> [("dp", 4), ("mp", 2)]. Also accepts
+    comma-separated ``"dp=4,mp=2"``."""
+    text = text.strip()
+    if not text:
+        raise ValueError("empty mesh spec string")
+    pairs = []
+    if "=" in text:
+        for part in re.split(r"[,x]", text):
+            name, _, size = part.partition("=")
+            pairs.append((name.strip(), int(size)))
+        return pairs
+    for part in text.split("x"):
+        m = _AXIS_RE.fullmatch(part.strip())
+        if not m:
+            raise ValueError(
+                "cannot parse mesh axis %r (expected e.g. 'dp4' or "
+                "'dp=4'; full spec like 'dp4xmp2')" % (part,))
+        pairs.append((m.group(1), int(m.group(2))))
+    return pairs
+
+
+def _device_kind(devices: Optional[Sequence] = None) -> str:
+    import jax
+    if devices is None:
+        devices = jax.devices()
+    return getattr(devices[0], "device_kind", None) or devices[0].platform
+
+
+def spec_of(mesh) -> "MeshSpec":
+    """MeshSpec describing an existing ``jax.sharding.Mesh``."""
+    return MeshSpec(list(zip(mesh.axis_names,
+                             [int(s) for s in mesh.devices.shape])))

@@ -72,6 +72,20 @@ The generation engine (docs/generation.md) exposes:
   _inter_token_us histograms (tokens/s and p95 inter-token latency are
   the generation SLO; bench.py's generation block gates on the
   decode-step p95 via tools/stat_diff.py).
+
+The mesh-native SPMD runtime (paddle_tpu/mesh/, docs/spmd.md)
+exposes (always-on, like the serving timers):
+- STAT_mesh_placements / STAT_mesh_reshard_bytes: device_put work a
+  ShardingPlan actually performed (values already resident with the
+  right sharding are skipped) — a steady-state training loop must show
+  these standing still, or state is ping-ponging between layouts;
+- STAT_mesh_collective_<axis>: host-level collective launches per mesh
+  axis (parallel/collective.py — all_reduce/all_gather/broadcast/
+  all_to_all outside shard_map), the per-axis traffic census
+  MULTICHIP_r06.json records;
+- GAUGE_mesh_devices: device count of the most recently built plan;
+- TIMER_mesh_compile_us: walltime of plan.compile()'s first
+  (trace+compile) call with explicit in/out shardings.
 """
 from __future__ import annotations
 

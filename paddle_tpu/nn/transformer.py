@@ -178,6 +178,21 @@ class MultiHeadAttention(Layer):
             all(p.bias is not None for p in (self.q_proj, self.k_proj,
                                              self.v_proj))
         if self_attn:
+            # under a device mesh the fused path is WRONG: the XLA SPMD
+            # partitioner miscompiles concatenate along a sharded dim
+            # (observed on CPU: outputs scaled by the replicated-axis
+            # size), and the fused QKV concat runs along exactly the dim
+            # Megatron-style rules shard (P(None, "mp")). The unfused
+            # three-matmul path partitions exactly, and under SPMD the
+            # one-big-matmul fusion dissolves into per-shard matmuls
+            # anyway. Trace-time check: TrainStep/Executor activate
+            # their ShardingPlan while tracing, and init_parallel_env
+            # sets the env mesh, so get_mesh() sees both.
+            from ..parallel.env import get_mesh
+            mesh = get_mesh()
+            if mesh is not None and mesh.size > 1:
+                self_attn = False
+        if self_attn:
             # fused QKV: ONE [E, 3E] matmul instead of three — the chip
             # pays a fixed cost per matmul op, so fewer+bigger wins; the
             # parameters stay separate (state-dict parity with the

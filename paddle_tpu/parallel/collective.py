@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..core.registry import register_op
+from ..mesh import compat as _compat
 from . import env as _envmod
 
 # ring_id -> axis name registry: the analog of NCCLCommContext's ring table
@@ -44,11 +45,14 @@ def ring_axis(ring_id: int) -> str:
 
 
 def _in_shard_map(axis: str) -> bool:
-    try:
-        jax.lax.axis_size(axis)
-        return True
-    except (NameError, KeyError, ValueError):
-        return False
+    return _compat.in_named_axis(axis)
+
+
+def _count_launch(axis: str) -> None:
+    """Per-axis host-level collective counter (STAT_mesh_collective_dp
+    etc.) — the mesh instrument family, docs/spmd.md."""
+    from ..monitor import stat_add
+    stat_add("STAT_mesh_collective_%s" % axis)
 
 
 def _host_collective(fn, x, axis):
@@ -74,8 +78,9 @@ def _host_collective(fn, x, axis):
                    for a in (entry if isinstance(entry, tuple) else (entry,))]
         if axis in in_axes:
             spec = sh.spec
-    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=spec,
-                                 out_specs=spec, check_vma=False))(x)
+    _count_launch(axis)
+    return jax.jit(_compat.shard_map(fn, mesh=mesh, in_specs=spec,
+                                     out_specs=spec, check_vma=False))(x)
 
 
 _REDUCERS = {
@@ -121,8 +126,9 @@ def all_gather(x, axis: Optional[str] = None, ring_id: int = 0,
 
     def f(shard):
         return jax.lax.all_gather(shard, axis, axis=tensor_axis, tiled=True)
-    out = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=spec_in,
-                                out_specs=spec_out, check_vma=False))(val)
+    _count_launch(axis)
+    out = jax.jit(_compat.shard_map(f, mesh=mesh, in_specs=spec_in,
+                                    out_specs=spec_out, check_vma=False))(val)
     return _rewrap(x, out)
 
 
@@ -141,12 +147,12 @@ def broadcast(x, src: int = 0, axis: Optional[str] = None, ring_id: int = 0):
     """c_broadcast analog: everyone takes rank `src`'s shard."""
     axis = axis or ring_axis(ring_id)
     if _in_shard_map(axis):
-        n = jax.lax.axis_size(axis)
+        n = _compat.axis_size(axis)
         return jax.lax.ppermute(x, axis, [(src, i) for i in range(n)])
     val = x.value if hasattr(x, "value") else x
 
     def f(shard):
-        n = jax.lax.axis_size(axis)
+        n = _compat.axis_size(axis)
         return jax.lax.ppermute(shard, axis, [(src, i) for i in range(n)])
     out = _host_collective(f, val, axis)
     return _rewrap(x, out)
@@ -161,7 +167,34 @@ def all_to_all(x, axis: Optional[str] = None, ring_id: int = 0,
     if _in_shard_map(axis):
         return jax.lax.all_to_all(x, axis, split_axis=split_axis,
                                   concat_axis=concat_axis, tiled=True)
-    raise NotImplementedError("host-level all_to_all: use inside shard_map")
+    # host level: the value's dim 0 is the stacked per-rank axis (the
+    # reference alltoall's in_tensor_list flattened) — same contract as
+    # all_gather above. Shard it over `axis` if it isn't already, run
+    # the tiled lax.all_to_all per shard, keep the same layout out
+    # (per-shard shapes are uniform, so in/out specs agree).
+    from jax.sharding import NamedSharding
+    mesh = _envmod.get_mesh()
+    val = x.value if hasattr(x, "value") else x
+    if mesh is None or axis not in mesh.axis_names or \
+            mesh.shape[axis] == 1:
+        return x  # single rank: identity, matches reference nranks==1
+    n = mesh.shape[axis]
+    if jnp.shape(val)[0] % n != 0:
+        raise ValueError(
+            "all_to_all: leading dim %d not divisible by axis %r size %d"
+            % (jnp.shape(val)[0], axis, n))
+    spec = P(*([axis] + [None] * (jnp.ndim(val) - 1)))
+    sh = getattr(val, "sharding", None)
+    if not (isinstance(sh, NamedSharding) and sh.spec == spec):
+        val = jax.device_put(val, NamedSharding(mesh, spec))
+
+    def f(shard):
+        return jax.lax.all_to_all(shard, axis, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
+    _count_launch(axis)
+    out = jax.jit(_compat.shard_map(f, mesh=mesh, in_specs=spec,
+                                    out_specs=spec, check_vma=False))(val)
+    return _rewrap(x, out)
 
 
 def ppermute(x, perm, axis: Optional[str] = None, ring_id: int = 0):
@@ -211,7 +244,7 @@ def _c_broadcast(ctx, ins, attrs):
     axis = attrs.get("axis") or ring_axis(attrs.get("ring_id", 0))
     root = attrs.get("root", 0)
     if _in_shard_map(axis):
-        n = jax.lax.axis_size(axis)
+        n = _compat.axis_size(axis)
         return {"Out": [jax.lax.ppermute(
             x, axis, [(root, i) for i in range(n)])]}
     return {"Out": [x]}
@@ -302,7 +335,7 @@ def _c_scatter(ctx, ins, attrs):
     nranks = int(attrs.get("nranks", 1))
     if _in_shard_map(axis):
         i = jax.lax.axis_index(axis)
-        per = x.shape[0] // jax.lax.axis_size(axis)
+        per = x.shape[0] // _compat.axis_size(axis)
         return {"Out": [jax.lax.dynamic_slice_in_dim(x, i * per, per, 0)]}
     # single-controller: emit the full split stack; GSPMD shards it
     return {"Out": [x.reshape((nranks, x.shape[0] // nranks)

@@ -28,16 +28,36 @@ SP_AXIS = "sp"
 ALL_AXES = (DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS)
 
 
+def _plan_mesh() -> Optional[Mesh]:
+    """Mesh of the active ShardingPlan (paddle_tpu.mesh), if one is
+    installed — lazy import to keep env importable standalone."""
+    try:
+        from ..mesh.plan import current_plan
+    except ImportError:  # pragma: no cover - partial install
+        return None
+    plan = current_plan()
+    return plan.mesh if plan is not None else None
+
+
 class DistEnv:
     """Global parallel environment (ParallelEnv analog,
-    dygraph/parallel.py:96)."""
+    dygraph/parallel.py:96).
+
+    Topology resolution order: the explicit mesh from
+    init_parallel_env(), else the active ShardingPlan's mesh
+    (mesh.install_plan / use_plan), else single-rank — so collective
+    helpers and the plan always agree on world size."""
 
     def __init__(self, mesh: Optional[Mesh] = None):
         self.mesh = mesh
 
+    def _mesh(self) -> Optional[Mesh]:
+        return self.mesh if self.mesh is not None else _plan_mesh()
+
     @property
     def nranks(self) -> int:
-        return self.mesh.size if self.mesh is not None else 1
+        mesh = self._mesh()
+        return mesh.size if mesh is not None else 1
 
     @property
     def world_size(self) -> int:
@@ -46,17 +66,25 @@ class DistEnv:
     @property
     def rank(self) -> int:
         # single-controller SPMD: the host drives all devices; per-device
-        # rank only exists inside shard_map'ped code (lax.axis_index)
-        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        # rank only exists inside shard_map'ped code (lax.axis_index).
+        # Under the cluster contract PADDLE_TRAINER_ID wins; with only a
+        # plan installed the process index is the rank.
+        tid = os.environ.get("PADDLE_TRAINER_ID")
+        if tid is not None:
+            return int(tid)
+        if self._mesh() is not None:
+            return int(jax.process_index())
+        return 0
 
     @property
     def local_rank(self) -> int:
         return self.rank
 
     def axis_size(self, axis: str) -> int:
-        if self.mesh is None or axis not in self.mesh.axis_names:
+        mesh = self._mesh()
+        if mesh is None or axis not in mesh.axis_names:
             return 1
-        return self.mesh.shape[axis]
+        return mesh.shape[axis]
 
 
 _env = DistEnv()
@@ -169,7 +197,9 @@ def get_env() -> DistEnv:
 
 
 def get_mesh() -> Optional[Mesh]:
-    return _env.mesh
+    """The ambient mesh: init_parallel_env's, else the active
+    ShardingPlan's (docs/spmd.md)."""
+    return _env._mesh()
 
 
 def get_world_size() -> int:
@@ -183,9 +213,11 @@ def get_rank() -> int:
 def sharding(*spec) -> NamedSharding:
     """NamedSharding over the global mesh with the given PartitionSpec
     entries, e.g. sharding('dp', None) for batch-sharded 2-D data."""
-    if _env.mesh is None:
-        raise RuntimeError("init_parallel_env() first")
-    return NamedSharding(_env.mesh, PartitionSpec(*spec))
+    mesh = _env._mesh()
+    if mesh is None:
+        raise RuntimeError("init_parallel_env() or install a ShardingPlan "
+                           "first")
+    return NamedSharding(mesh, PartitionSpec(*spec))
 
 
 def shard_batch(batch, axis: str = DP_AXIS, mesh=None):
@@ -198,7 +230,7 @@ def shard_batch(batch, axis: str = DP_AXIS, mesh=None):
     process's LOCAL shard (standard SPMD data loading — each trainer reads
     its own files, as the reference's DataFeed does) and is assembled into
     a global array spanning all hosts."""
-    use_mesh = mesh if mesh is not None else _env.mesh
+    use_mesh = mesh if mesh is not None else _env._mesh()
     axis_n = (use_mesh.shape.get(axis, 1) if use_mesh is not None
               else 1)
     multiproc = jax.process_count() > 1

@@ -24,6 +24,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..mesh.compat import shard_map as _shard_map
+
 __all__ = ["router_topk", "moe_ffn", "moe_ffn_sharded",
            "init_moe_params"]
 
@@ -161,8 +163,13 @@ def moe_ffn_sharded(x, params, mesh, axis: str = "ep", k: int = 2,
                * jax.lax.pmean(ce, axis)).sum() * E
         return y.astype(xs.dtype), aux
 
-    return jax.shard_map(
+    return _shard_map(
         body, mesh=mesh,
         in_specs=(P(axis), P(), P(axis), P(axis)),
         out_specs=(P(axis), P()),
+        # old-jax rep-rewrite chokes on the symbolic-zero cotangent of
+        # a discarded aux output ('Zero' has no reshape); with the
+        # checker off, the router (the one unmentioned input) gets its
+        # cotangent psum from the explicit transpose path instead
+        check_vma=False,
     )(x, params["router"], params["w_in"], params["w_out"])

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..mesh.compat import pcast as _pcast, shard_map as _shard_map
 from .env import PP_AXIS
 
 
@@ -70,9 +71,9 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
         stage = jax.lax.axis_index(axis)
         # mark the carries as device-varying along the pp axis (jax>=0.9
         # shard_map vma tracking; the loop body makes them varying)
-        zero = jax.lax.pcast(jnp.zeros(x_all.shape[1:], x_all.dtype),
+        zero = _pcast(jnp.zeros(x_all.shape[1:], x_all.dtype),
                              (axis,), to="varying")
-        outs0 = jax.lax.pcast(jnp.zeros_like(x_all), (axis,), to="varying")
+        outs0 = _pcast(jnp.zeros_like(x_all), (axis,), to="varying")
 
         def tick(carry, t):
             recv, outs = carry
@@ -96,7 +97,7 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
             axis)
 
     pspec_params = jax.tree.map(lambda _: P(axis), stacked_params)
-    out = jax.shard_map(
+    out = _shard_map(
         shard_body, mesh=mesh,
         in_specs=(pspec_params, P()),
         out_specs=P(),

@@ -52,6 +52,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..mesh.compat import pcast as _pcast, shard_map as _shard_map, \
+    typeof as _typeof
 from .env import PP_AXIS
 
 GRAD_SUFFIX = "@GRAD"
@@ -304,9 +306,9 @@ def lower_pipeline_train(lowerer, op, env: Dict[str, Any]) -> None:
             # (unvarying) must match one whose outputs came through the
             # device-varying buffers
             def vary(x):
-                if axis in getattr(jax.typeof(x), "vma", ()):
+                if axis in getattr(_typeof(x), "vma", ()):
                     return x  # already device-varying on this axis
-                return jax.lax.pcast(x, (axis,), to="varying")
+                return _pcast(x, (axis,), to="varying")
             return vary(fb), vary(ib), vary(loss)
         return branch
 
@@ -317,7 +319,10 @@ def lower_pipeline_train(lowerer, op, env: Dict[str, Any]) -> None:
 
     def shard_body(feeds_all, params, extras, key):
         stage = jax.lax.axis_index(axis)
-        to_vary = lambda x: jax.lax.pcast(x, (axis,), to="varying")
+        # params arrive stage-tiled (leading pp dim of 1 per shard, see
+        # pipe_loss below); drop the tile dim
+        params = jax.tree.map(lambda p: p[0], params)
+        to_vary = lambda x: _pcast(x, (axis,), to="varying")
         # cast ALL inputs to device-varying before the scan: a branch
         # closing over a replicated (unvarying) value would get a psum
         # inserted inside the switch when transposed for the backward
@@ -372,12 +377,32 @@ def lower_pipeline_train(lowerer, op, env: Dict[str, Any]) -> None:
         return jax.lax.psum(loss_acc, axis) / n_mb
 
     from jax.sharding import PartitionSpec as P
-    sharded = jax.shard_map(
+    # Differentiated params enter TILED over the pp axis (one identical
+    # slice per stage — per-device memory is unchanged vs replicated)
+    # so their in_spec mentions the axis: with the rep-checker off
+    # (which old jax's lax.switch typing forces, and new jax's vma
+    # pcasts make redundant) an unmentioned differentiated input has no
+    # transpose rule to psum its cotangent, while the tile's own
+    # transpose sums the per-stage partial grads for free.
+    sharded = _shard_map(
         shard_body, mesh=mesh,
-        in_specs=(P(), P(), P(), P()), out_specs=P())
+        in_specs=(P(), P(axis), P(), P()), out_specs=P(),
+        check_vma=False)
+
+    # remat the whole sharded region: under partial eval (the executor
+    # traces this inside jit) old jax names dim 0 of every shard_map
+    # residual, so a RANK-0 residual (the scalar loss carry) cannot
+    # cross the forward/backward split — recomputing from the (all
+    # rank>=1) inputs sidesteps it, and a pipeline recomputes its
+    # stages under remat anyway
+    sharded = jax.checkpoint(
+        sharded, policy=jax.checkpoint_policies.nothing_saveable)
 
     def pipe_loss(params):
-        return sharded(feeds_stacked, params, extras_env, key0)
+        tiled = jax.tree.map(
+            lambda p: jnp.tile(p[None], (n_stages,) + (1,) * p.ndim),
+            params)
+        return sharded(feeds_stacked, tiled, extras_env, key0)
 
     loss_val, grads = jax.value_and_grad(pipe_loss)(params_env)
     env[loss_name] = loss_val

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..mesh.compat import pcast as _pcast, shard_map as _shard_map
 from .env import SP_AXIS
 
 
@@ -65,11 +66,11 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = SP_AXIS,
         my = jax.lax.axis_index(axis)
         s_loc = q_l.shape[2]
         # device-varying initial accumulators (jax>=0.9 shard_map vma)
-        acc = jax.lax.pcast(jnp.zeros(q_l.shape, jnp.float32), (axis,),
+        acc = _pcast(jnp.zeros(q_l.shape, jnp.float32), (axis,),
                             to="varying")
-        m = jax.lax.pcast(jnp.full(q_l.shape[:3], -1e30, jnp.float32),
+        m = _pcast(jnp.full(q_l.shape[:3], -1e30, jnp.float32),
                           (axis,), to="varying")
-        l = jax.lax.pcast(jnp.zeros(q_l.shape[:3], jnp.float32), (axis,),
+        l = _pcast(jnp.zeros(q_l.shape[:3], jnp.float32), (axis,),
                           to="varying")
 
         def step(carry, i):
@@ -107,10 +108,14 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = SP_AXIS,
         l = jnp.maximum(l, 1e-30)
         return (acc / l[..., None]).astype(q_l.dtype)
 
-    return jax.shard_map(
+    return _shard_map(
         body, mesh=mesh,
         in_specs=(P(None, None, axis, None),) * 3,
         out_specs=P(None, None, axis, None),
+        # old-jax rep-checker can't type the cond/scan ring (jax says:
+        # workaround check_rep=False); every in_spec mentions the axis,
+        # so the transpose needs no replication rewrite either
+        check_vma=False,
     )(q, k, v)
 
 
@@ -146,8 +151,12 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = SP_AXIS,
                        vh.astype(jnp.float32)).astype(q_l.dtype)
         return head2seq(o)
 
-    return jax.shard_map(
+    return _shard_map(
         body, mesh=mesh,
         in_specs=(P(None, None, axis, None),) * 3,
         out_specs=P(None, None, axis, None),
+        # old-jax rep-checker can't type the cond/scan ring (jax says:
+        # workaround check_rep=False); every in_spec mentions the axis,
+        # so the transpose needs no replication rewrite either
+        check_vma=False,
     )(q, k, v)

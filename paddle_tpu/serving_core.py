@@ -28,6 +28,52 @@ _DTYPES = ["float32", "int32", "int64", "float64", "uint8",
 # FLAGS_predictor_shape_buckets, "" disables)
 _BUCKET_ENV = "PADDLE_TPU_SHAPE_BUCKETS"
 
+# mesh for single-host SPMD serving (same spec grammar as
+# paddle_tpu.mesh.MeshSpec — "dp4", "dp=4,mp=2", "dp4xmp2"; unset/""
+# serves single-device). The exported StableHLO is single-logical-
+# device; jit re-partitions it across the mesh from the feeds' input
+# shardings (batch dim sharded over the data axis), so one artifact
+# serves both layouts.
+_MESH_ENV = "PADDLE_TPU_MESH"
+
+
+def _mesh_from_env():
+    """Parse PADDLE_TPU_MESH into (jax Mesh, data_axis) over the first
+    prod(sizes) local devices, or (None, None) when unset. Framework-
+    free twin of paddle_tpu.mesh.MeshSpec: axes split on 'x'/',' with
+    each axis 'name<size>', 'name=<size>' or 'name:<size>'."""
+    s = os.environ.get(_MESH_ENV, "").strip()
+    if not s:
+        return None, None
+    import re
+    import jax
+    from jax.sharding import Mesh
+    axes = []
+    for part in re.split(r"[x,]", s):
+        part = part.strip()
+        if not part:
+            continue
+        m = re.match(r"^([A-Za-z_][A-Za-z_0-9]*?)[=:]?([0-9]+)$", part)
+        if m is None:
+            raise ValueError("bad %s axis %r (want e.g. dp4 or dp=4)"
+                             % (_MESH_ENV, part))
+        axes.append((m.group(1), int(m.group(2))))
+    if not axes:
+        return None, None
+    n = 1
+    for _, k in axes:
+        n *= k
+    devs = jax.devices()
+    if len(devs) < n:
+        raise RuntimeError(
+            "%s=%r needs %d devices but only %d are visible — on CPU "
+            "set XLA_FLAGS=--xla_force_host_platform_device_count=%d"
+            % (_MESH_ENV, s, n, len(devs), n))
+    grid = np.array(devs[:n]).reshape([k for _, k in axes])
+    mesh = Mesh(grid, tuple(name for name, _ in axes))
+    data_axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
+    return mesh, data_axis
+
 
 def _bucket_ladder():
     s = os.environ.get(_BUCKET_ENV, "pow2:128").strip()
@@ -99,13 +145,23 @@ class SerializedCore:
         self.fetch_names = list(sig["fetch_names"])
         loaded = np.load(os.path.join(path, "params.npz"))
         self._state = {k: loaded[k] for k in loaded.files}
+        # PADDLE_TPU_MESH: replicate params over the mesh once at load;
+        # run() stages each batch sharded and jit partitions the module
+        self._mesh, self._data_axis = _mesh_from_env()
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            rep = NamedSharding(self._mesh, PartitionSpec())
+            self._state = {k: jax.device_put(v, rep)
+                           for k, v in self._state.items()}
         # jit once: repeated run() hits the compiled executable instead
         # of re-staging the exported call, and the compile itself lands
         # in (or comes from) the persistent cache enabled above
         self._call = jax.jit(self._exported.call)
         self._batch_spec = self._recover_batch_spec()
         # visible serving behavior for callers with no metrics registry
-        self.stats = {"calls": 0, "padded_calls": 0, "pad_rows": 0}
+        self.stats = {"calls": 0, "padded_calls": 0, "pad_rows": 0,
+                      "mesh_devices": int(self._mesh.size)
+                      if self._mesh is not None else 0}
 
     def _recover_batch_spec(self):
         """The artifact's recorded leading dim per feed: an int for a
@@ -175,6 +231,8 @@ class SerializedCore:
         feed_map = {n: np.asarray(v)
                     for n, v in zip(self.feed_names, feeds)}
         feed_map, true_rows, target = self._pad_plan(feed_map)
+        if self._mesh is not None:
+            feed_map = self._place_mesh(feed_map)
         self.stats["calls"] += 1
         outs = self._call(self._state, feed_map)
         host = [np.ascontiguousarray(np.asarray(o)) for o in outs]
@@ -182,6 +240,23 @@ class SerializedCore:
             host = [o[:true_rows] if o.ndim and
                     o.shape[0] == target else o for o in host]
         return host
+
+    def _place_mesh(self, feed_map):
+        """PADDLE_TPU_MESH serving: stage feeds over the mesh — batch
+        dim sharded over the data axis when it divides evenly, else
+        replicated — so jit partitions the deserialized module across
+        the local devices (single-host SPMD, no framework import)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        n = self._mesh.shape[self._data_axis]
+        placed = {}
+        for k, v in feed_map.items():
+            if v.ndim and n > 1 and v.shape[0] % n == 0:
+                spec = P(self._data_axis, *([None] * (v.ndim - 1)))
+            else:
+                spec = P()
+            placed[k] = jax.device_put(v, NamedSharding(self._mesh, spec))
+        return placed
 
     # --- flat-ABI helpers for the C API --------------------------------
     @staticmethod

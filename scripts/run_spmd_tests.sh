@@ -1,0 +1,104 @@
+#!/usr/bin/env bash
+# Run the mesh-native SPMD runtime suite (-m spmd, docs/spmd.md) on the
+# 8-device virtual CPU mesh and emit MULTICHIP_r06.json: the usual
+# multichip dryrun transcript (same shape as MULTICHIP_r0{1..5}.json)
+# plus the mesh plan and the per-axis host-collective census
+# (STAT_mesh_collective_<axis>, monitor.py).
+#
+# Usage: scripts/run_spmd_tests.sh [extra pytest args...]
+set -u
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+# conftest.py also forces this, but the census below runs without pytest
+export XLA_FLAGS="$(echo "${XLA_FLAGS:-}" \
+    | sed 's/--xla_force_host_platform_device_count=[0-9]*//') \
+    --xla_force_host_platform_device_count=8"
+
+echo "== spmd-marked tests (8 virtual CPU devices) =="
+python -m pytest tests/ -q -m spmd -p no:cacheprovider "$@"
+test_rc=$?
+
+echo "== multichip dryrun + mesh census -> MULTICHIP_r06.json =="
+python - "$test_rc" <<'EOF'
+import io
+import json
+import sys
+from contextlib import redirect_stdout
+
+test_rc = int(sys.argv[1])
+buf = io.StringIO()
+rc, err = 0, None
+try:
+    with redirect_stdout(buf):
+        import __graft_entry__ as g
+        g.dryrun_multichip(8)
+except Exception as e:  # noqa: BLE001 - artifact must record the failure
+    rc, err = 1, "%s: %s" % (type(e).__name__, e)
+
+# mesh census: train a real Executor program under a dp4xmp2 plan and
+# drive one host-level collective per axis so the per-axis counters in
+# the artifact are demonstrably live
+import numpy as np
+import jax
+import paddle_tpu as pt
+import paddle_tpu.parallel as dist
+from paddle_tpu import layers, monitor
+from paddle_tpu.mesh import ShardingPlan, use_plan
+
+plan = ShardingPlan("dp4xmp2")
+main, startup = pt.Program(), pt.Program()
+with pt.program_guard(main, startup):
+    x = layers.data("x", [4])
+    y = layers.data("y", [1])
+    loss = layers.mean(layers.square_error_cost(
+        layers.fc(x, 1, name="p"), y))
+    pt.optimizer.SGD(0.05).minimize(loss, startup_program=startup,
+                                    program=main)
+losses = []
+with use_plan(plan):
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        for _ in range(4):
+            xb = rng.randn(16, 4).astype(np.float32)
+            yb = (xb.sum(1, keepdims=True)).astype(np.float32)
+            out, = exe.run(main, feed={"x": xb, "y": yb},
+                           fetch_list=[loss])
+            losses.append(float(out))
+    dist.init_parallel_env({"dp": 4, "mp": 2})
+    dist.all_reduce(np.ones((4,), np.float32), axis="dp")
+    dist.all_to_all(np.arange(64, dtype=np.float32).reshape(16, 4),
+                    axis="dp")
+    dist.all_reduce(np.ones((4,), np.float32), axis="mp")
+
+counters = monitor.get_float_stats()
+artifact = {
+    "n_devices": len(jax.devices()),
+    "rc": rc,
+    "ok": rc == 0 and test_rc == 0,
+    "skipped": False,
+    "spmd_tests_rc": test_rc,
+    "mesh_plan": {
+        "spec": "dp4xmp2",
+        "topology": [list(t) if isinstance(t, tuple) else t
+                     for t in plan.topology()],
+        "data_axis": plan.data_axis,
+        "executor_losses": losses,
+    },
+    "collectives": {k: v for k, v in sorted(counters.items())
+                    if k.startswith("STAT_mesh_collective_")},
+    "mesh_counters": {k: v for k, v in sorted(counters.items())
+                      if k.startswith("STAT_mesh_")},
+    "tail": buf.getvalue() + ("" if err is None else err + "\n"),
+}
+with open("MULTICHIP_r06.json", "w") as f:
+    json.dump(artifact, f, indent=1)
+    f.write("\n")
+print(json.dumps({k: artifact[k] for k in
+                  ("n_devices", "rc", "ok", "spmd_tests_rc",
+                   "collectives")}, indent=1))
+sys.exit(0 if artifact["ok"] else 1)
+EOF
+exit $?

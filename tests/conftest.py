@@ -34,6 +34,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: heavyweight perf/compile tests excluded from "
         "the tier-1 `-m 'not slow'` run")
+    config.addinivalue_line(
+        "markers", "spmd: mesh-native SPMD runtime tests (docs/spmd.md) "
+        "— need the 8-device virtual mesh; scripts/run_spmd_tests.sh "
+        "runs just these and emits MULTICHIP_r06.json")
 
 
 def pytest_sessionstart(session):

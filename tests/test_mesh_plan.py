@@ -1,0 +1,433 @@
+"""Mesh-native SPMD runtime tests (paddle_tpu/mesh/, docs/spmd.md).
+
+Covers the three layers of the subsystem on the 8-device virtual CPU
+mesh (conftest.py): MeshSpec parsing/resolution, ShardingPlan placement
+rules + instruments + the active-plan registry, and the runtime seams
+the plan is threaded through — Executor (loss parity + zero
+steady-state recompiles), TrainStep, the host-level all_to_all
+collective, and the framework-free serving-core mesh parser.
+
+The parity bar throughout is the reference's dist-vs-local loss
+contract (test_dist_base.py:594): same program + same seeds must give
+the same per-step losses whether the plan shards it or not.
+"""
+import os
+import warnings
+
+import numpy as np
+import pytest
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as pt
+import paddle_tpu.parallel as dist
+from paddle_tpu import layers, monitor
+from paddle_tpu.mesh import (MeshSpec, ShardingPlan, current_plan,
+                             install_plan, use_plan)
+from paddle_tpu.mesh.plan import plan_topology
+
+pytestmark = pytest.mark.spmd
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh_state():
+    """Each test starts with no active plan, no flag default, and no
+    ambient parallel mesh — and leaves none behind."""
+    prev_flag = pt.get_flags("FLAGS_mesh_spec")["FLAGS_mesh_spec"]
+    prev_mesh = dist.get_env().mesh
+    prev_plan = install_plan(None)
+    pt.set_flags({"FLAGS_mesh_spec": ""})
+    dist.get_env().mesh = None
+    yield
+    install_plan(prev_plan)
+    pt.set_flags({"FLAGS_mesh_spec": prev_flag})
+    dist.get_env().mesh = prev_mesh
+
+
+# ---------------------------------------------------------------------------
+# MeshSpec
+# ---------------------------------------------------------------------------
+
+def test_meshspec_parsing_grammars():
+    assert MeshSpec("dp4xmp2").axes == (("dp", 4), ("mp", 2))
+    assert MeshSpec("dp=4,mp=2").axes == (("dp", 4), ("mp", 2))
+    assert MeshSpec("dp8").axes == (("dp", 8),)
+    assert MeshSpec({"dp": 2, "mp": 2, "pp": 2}).axes == \
+        (("dp", 2), ("mp", 2), ("pp", 2))
+    assert MeshSpec([("a", 3), ("b", 2)]).axis_names == ("a", "b")
+    # axis order is significant: it is the device-grid order
+    assert MeshSpec("mp2xdp4").axes == (("mp", 2), ("dp", 4))
+
+
+def test_meshspec_introspection():
+    s = MeshSpec("dp4xmp2")
+    assert s.size == 8
+    assert s.axis_size("mp") == 2
+    assert "dp" in s and "pp" not in s
+    with pytest.raises(KeyError):
+        s.axis_size("pp")
+    assert s == MeshSpec({"dp": 4, "mp": 2})
+    assert s != MeshSpec("dp8")
+    assert hash(s) == hash(MeshSpec("dp4xmp2"))
+    assert "dp4" in repr(s) and "mp2" in repr(s)
+
+
+def test_meshspec_validation_errors():
+    with pytest.raises(ValueError):
+        MeshSpec("")
+    with pytest.raises(ValueError):
+        MeshSpec("4dp")  # size-first is not an axis token
+    with pytest.raises(ValueError):
+        MeshSpec({"dp": 0})
+    with pytest.raises(ValueError):
+        MeshSpec([("dp", 4), ("dp", 2)])  # duplicate axis
+    with pytest.raises(ValueError):
+        MeshSpec([])
+
+
+def test_meshspec_build_and_recipe_error():
+    mesh = MeshSpec("dp4xmp2").build()
+    assert mesh.axis_names == ("dp", "mp")
+    assert mesh.devices.shape == (4, 2)
+    # more devices than the process has -> error message carries the
+    # fake-device recipe verbatim (docs/spmd.md)
+    with pytest.raises(RuntimeError,
+                       match="xla_force_host_platform_device_count=16"):
+        MeshSpec("dp16").build()
+
+
+def test_meshspec_topology_token():
+    topo = MeshSpec("dp4xmp2").topology()
+    assert topo[:2] == (("dp", 4), ("mp", 2))
+    assert isinstance(topo[-1], str) and topo[-1]  # device kind
+    assert hash(topo)  # hashable: usable in cache keys
+
+
+# ---------------------------------------------------------------------------
+# ShardingPlan: rules, placement, instruments
+# ---------------------------------------------------------------------------
+
+def test_plan_default_rules():
+    plan = ShardingPlan("dp4xmp2")
+    # params default replicated
+    assert plan.param_sharding("w", (8, 8)).spec == P()
+    # inputs: dim 0 over the data axis when divisible...
+    assert plan.input_sharding("x", (8, 3)).spec == P("dp", None)
+    # ...else replicated, with a one-time warning
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert plan.input_sharding("x7", (7, 3)).spec == P()
+        assert plan.input_sharding("x7", (7, 3)).spec == P()
+    assert len([w for w in rec if "not divisible" in str(w.message)]) == 1
+    # scalars replicate
+    assert plan.input_sharding("s", ()).spec == P()
+
+
+def test_plan_param_rule_forms():
+    rule = {"w1": P(None, "mp"), "w2": ("mp", None)}
+    plan = ShardingPlan("dp4xmp2", params=rule)
+    assert plan.param_sharding("w1", (8, 16)).spec == P(None, "mp")
+    assert plan.param_sharding("w2", (16, 4)).spec == P("mp", None)
+    assert plan.param_sharding("other", (3,)).spec == P()  # dict miss
+
+    plan2 = ShardingPlan(
+        "dp4xmp2",
+        params=lambda n, s: P(None, "mp") if len(s) == 2 else None)
+    assert plan2.param_sharding("k", (4, 4)).spec == P(None, "mp")
+    assert plan2.param_sharding("b", (4,)).spec == P()
+
+
+def test_plan_accepts_existing_mesh_and_missing_data_axis():
+    mesh = dist.init_parallel_env({"dp": 8}).mesh
+    plan = ShardingPlan(mesh)
+    assert plan.mesh is mesh
+    assert plan.data_axis == "dp"
+    # a mesh without the data axis degrades to replicate-everything
+    plan2 = ShardingPlan("mp8")
+    assert plan2.data_axis is None
+    assert plan2.input_sharding("x", (8, 2)).spec == P()
+
+
+def test_plan_place_skips_resident_values_and_counts():
+    plan = ShardingPlan("dp4xmp2")
+    x = np.ones((8, 4), np.float32)
+    sh = plan.input_sharding("x", x.shape)
+    n0 = monitor.stat_get("STAT_mesh_placements")
+    b0 = monitor.stat_get("STAT_mesh_reshard_bytes")
+    placed = plan.place(x, sh)
+    assert monitor.stat_get("STAT_mesh_placements") == n0 + 1
+    assert monitor.stat_get("STAT_mesh_reshard_bytes") == b0 + x.nbytes
+    assert placed.sharding == NamedSharding(plan.mesh, P("dp", None))
+    # already resident with the right sharding: a no-op, not a reshard
+    again = plan.place(placed, sh)
+    assert again is placed
+    assert monitor.stat_get("STAT_mesh_placements") == n0 + 1
+    assert monitor.gauge_get("GAUGE_mesh_devices") == 8.0
+
+
+def test_plan_compile_observes_timer():
+    plan = ShardingPlan("dp4")
+    rep = plan.replicated()
+    c0 = monitor.timer_get("TIMER_mesh_compile_us")["count"]
+    f = plan.compile(lambda a: a * 2.0, in_shardings=(rep,),
+                     out_shardings=rep)
+    out = f(np.ones((4,), np.float32))
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    assert monitor.timer_get("TIMER_mesh_compile_us")["count"] == c0 + 1
+
+
+def test_plan_topology_helper():
+    assert plan_topology(None) == ()
+    topo = plan_topology(ShardingPlan("dp4xmp2"))
+    assert topo[:2] == (("dp", 4), ("mp", 2))
+
+
+# ---------------------------------------------------------------------------
+# active-plan registry: use_plan > install_plan > FLAGS_mesh_spec
+# ---------------------------------------------------------------------------
+
+def test_plan_registry_precedence():
+    assert current_plan() is None
+    flag_plan_spec = "dp8"
+    pt.set_flags({"FLAGS_mesh_spec": flag_plan_spec})
+    fp = current_plan()
+    assert fp is not None and fp.spec == MeshSpec(flag_plan_spec)
+    assert current_plan() is fp  # cached per spec string
+
+    g = ShardingPlan("dp4xmp2")
+    assert install_plan(g) is None
+    assert current_plan() is g  # global beats the flag default
+
+    s = ShardingPlan("dp2")
+    with use_plan(s):
+        assert current_plan() is s  # scope beats global
+        with use_plan(None):
+            assert current_plan() is None  # None masks everything
+        assert current_plan() is s
+    assert current_plan() is g
+
+    install_plan(None)
+    assert current_plan() is fp  # back to the flag default
+    pt.set_flags({"FLAGS_mesh_spec": ""})
+    assert current_plan() is None
+
+
+def test_parallel_env_sees_plan_mesh():
+    """Satellite: world size / rank resolve from the active plan so
+    collectives and the plan always agree on topology."""
+    assert dist.get_world_size() == 1
+    with use_plan(ShardingPlan("dp4xmp2")):
+        assert dist.get_world_size() == 8
+        assert dist.get_mesh() is current_plan().mesh
+        assert dist.get_rank() == 0
+    assert dist.get_world_size() == 1
+    assert dist.get_mesh() is None
+
+
+# ---------------------------------------------------------------------------
+# host-level all_to_all (parallel/collective.py)
+# ---------------------------------------------------------------------------
+
+def test_all_to_all_single_rank_identity():
+    # no mesh at all -> identity (reference nranks==1 early-out)
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    assert dist.all_to_all(x) is x
+
+
+def test_all_to_all_permutes_rank_blocks():
+    """Host-level all_to_all is the block transpose: global dim 0 is the
+    stacked per-rank axis; rank i's j-th chunk lands at rank j's i-th
+    slot (the alltoall contract, distributed/collective.py:376)."""
+    dist.init_parallel_env({"dp": 8})
+    n, d = 8, 3
+    x = np.arange(64 * d, dtype=np.float32).reshape(64, d)
+    c0 = monitor.stat_get("STAT_mesh_collective_dp")
+    out = np.asarray(dist.all_to_all(x))
+    assert monitor.stat_get("STAT_mesh_collective_dp") == c0 + 1
+    m = 64 // n  # rows per rank
+    exp = x.reshape(n, n, m // n, d).transpose(1, 0, 2, 3).reshape(64, d)
+    np.testing.assert_array_equal(out, exp)
+    # involution: exchanging twice restores the original
+    np.testing.assert_array_equal(
+        np.asarray(dist.all_to_all(out)), x)
+
+
+def test_all_to_all_rejects_indivisible_leading_dim():
+    dist.init_parallel_env({"dp": 8})
+    with pytest.raises(ValueError, match="not divisible"):
+        dist.all_to_all(np.ones((10, 3), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# Executor threading: parity + zero steady-state recompiles
+# ---------------------------------------------------------------------------
+
+def _build_program(width=4):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [width])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, 1, name="p")
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.SGD(0.05).minimize(loss, startup_program=startup,
+                                        program=main)
+    return main, startup, loss
+
+
+def _batches(width=4, n=6):
+    rng = np.random.RandomState(0)
+    w = rng.randn(width, 1).astype(np.float32)
+    return [(xb, (xb @ w + 0.1).astype(np.float32))
+            for xb in (rng.randn(16, width).astype(np.float32)
+                       for _ in range(n))]
+
+
+def test_executor_plan_matches_single_device():
+    """The tentpole acceptance: a dp4xmp2 plan trains the same program
+    to the same per-step losses as single-device, with zero recompiles
+    after the first step."""
+    batches = _batches()
+
+    main, startup, loss = _build_program()
+    exe = pt.Executor()
+    single = []
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        for xb, yb in batches:
+            out, = exe.run(main, feed={"x": xb, "y": yb},
+                           fetch_list=[loss])
+            single.append(float(out))
+
+    main2, startup2, loss2 = _build_program()
+    exe2 = pt.Executor()
+    planned = []
+    with use_plan(ShardingPlan("dp4xmp2")):
+        with pt.scope_guard(pt.Scope()):
+            exe2.run(startup2)
+            for i, (xb, yb) in enumerate(batches):
+                if i == 1:  # steady state starts after the first step
+                    compiles0 = monitor.stat_get("STAT_executor_compile")
+                out, = exe2.run(main2, feed={"x": xb, "y": yb},
+                                fetch_list=[loss2])
+                planned.append(float(out))
+            steady = monitor.stat_get("STAT_executor_compile") - compiles0
+
+    np.testing.assert_allclose(planned, single, rtol=1e-4, atol=1e-5)
+    assert steady == 0, "steady-state recompile under the plan"
+
+
+def test_executor_state_stays_put_in_steady_state():
+    """After step 1 the params are resident with the plan's shardings:
+    further steps must not reshard state (only the per-step host feeds
+    are staged)."""
+    batches = _batches(n=4)
+    main, startup, loss = _build_program()
+    exe = pt.Executor()
+    with use_plan(ShardingPlan("dp4xmp2")):
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            exe.run(main, feed={"x": batches[0][0], "y": batches[0][1]},
+                    fetch_list=[loss])
+            p0 = monitor.stat_get("STAT_mesh_placements")
+            for xb, yb in batches[1:]:
+                exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+            placements = monitor.stat_get("STAT_mesh_placements") - p0
+    # each steady step stages exactly its 2 fresh host feeds (new numpy
+    # arrays have no sharding) — state placement would add more
+    assert placements == 2 * (len(batches) - 1), placements
+
+
+# ---------------------------------------------------------------------------
+# TrainStep threading
+# ---------------------------------------------------------------------------
+
+def _ts_build(seed=42):
+    from paddle_tpu import nn
+    pt.dygraph.seed(seed)
+    np.random.seed(seed)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    o = pt.optimizer.SGD(0.1, parameters=m.parameters())
+    return m, o
+
+
+def _ts_loss(out, label):
+    import paddle_tpu.nn.functional as F
+    return F.cross_entropy(out, label)
+
+
+def test_trainstep_plan_matches_single_device():
+    from paddle_tpu.jit import TrainStep
+    plan = ShardingPlan(
+        "dp4xmp2",
+        params=lambda n, s: P(None, "mp") if s == (8, 16) else
+        (P("mp", None) if s == (16, 4) else None))
+    m1, o1 = _ts_build()
+    s1 = TrainStep(m1, _ts_loss, o1)
+    m2, o2 = _ts_build()
+    s2 = TrainStep(m2, _ts_loss, o2, plan=plan)
+    rng = np.random.RandomState(0)
+    for i in range(5):
+        x = rng.randn(16, 8).astype(np.float32)
+        y = rng.randint(0, 4, (16, 1)).astype(np.int32)
+        l1 = float(s1((x,), (y,)))
+        l2 = float(s2((x,), (y,)))
+        assert abs(l1 - l2) < 1e-4, (i, l1, l2)
+    assert s2.mesh is plan.mesh
+
+
+def test_trainstep_picks_up_ambient_plan():
+    from paddle_tpu.jit import TrainStep
+    m, o = _ts_build(seed=3)
+    s = TrainStep(m, _ts_loss, o)
+    plan = ShardingPlan("dp8")
+    with use_plan(plan):
+        x = np.random.RandomState(1).randn(16, 8).astype(np.float32)
+        y = np.zeros((16, 1), np.int32)
+        assert np.isfinite(float(s((x,), (y,))))
+    assert s.plan is plan and s.mesh is plan.mesh
+
+
+# ---------------------------------------------------------------------------
+# serving: framework-free mesh parser + Predictor config
+# ---------------------------------------------------------------------------
+
+def test_serving_core_mesh_from_env(monkeypatch):
+    from paddle_tpu.serving_core import _MESH_ENV, _mesh_from_env
+    monkeypatch.delenv(_MESH_ENV, raising=False)
+    assert _mesh_from_env() == (None, None)
+
+    monkeypatch.setenv(_MESH_ENV, "dp4xmp2")
+    mesh, axis = _mesh_from_env()
+    assert mesh.axis_names == ("dp", "mp") and axis == "dp"
+    assert mesh.devices.shape == (4, 2)
+
+    # all three axis grammars; no dp axis -> first axis is the data axis
+    monkeypatch.setenv(_MESH_ENV, "batch=8")
+    mesh, axis = _mesh_from_env()
+    assert mesh.axis_names == ("batch",) and axis == "batch"
+
+    monkeypatch.setenv(_MESH_ENV, "dp:2,mp:2")
+    mesh, axis = _mesh_from_env()
+    assert mesh.axis_names == ("dp", "mp") and axis == "dp"
+
+    monkeypatch.setenv(_MESH_ENV, "bogus!")
+    with pytest.raises(ValueError, match="bad PADDLE_TPU_MESH axis"):
+        _mesh_from_env()
+
+    monkeypatch.setenv(_MESH_ENV, "dp16")
+    with pytest.raises(RuntimeError,
+                       match="device_count=16"):
+        _mesh_from_env()
+
+
+def test_predictor_config_enable_spmd():
+    from paddle_tpu.inference import Config
+    cfg = Config()
+    cfg.enable_spmd("dp4")
+    assert isinstance(cfg._spmd_plan, ShardingPlan)
+    assert cfg._spmd_plan.spec == MeshSpec("dp4")
+    plan = ShardingPlan("dp4xmp2")
+    assert cfg.enable_spmd(plan) is cfg
+    assert cfg._spmd_plan is plan
+    cfg.disable_spmd()
+    assert cfg._spmd_plan is None

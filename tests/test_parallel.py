@@ -41,8 +41,9 @@ def test_shard_map_collectives(env):
         return s + 0 * m
 
     x = np.ones((8, 4), np.float32) * np.arange(8, dtype=np.float32)[:, None]
-    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("dp", None),
-                              out_specs=P("dp", None)))
+    from paddle_tpu.mesh.compat import shard_map
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("dp", None),
+                          out_specs=P("dp", None)))
     out = np.asarray(f(x))
     np.testing.assert_allclose(out[0], np.full(4, 28.0), rtol=1e-6)
 

@@ -252,6 +252,81 @@ def test_int64_feed_warm_hit_no_corrupt(cache_root):
 
 
 # ---------------------------------------------------------------------------
+# mesh-aware fingerprints (ISSUE-6 satellite: the mesh topology — axis
+# names+sizes+device kind — rides in the in-memory key and the disk
+# fingerprint, so entries never cross topologies)
+# ---------------------------------------------------------------------------
+@pytest.mark.spmd
+def test_inmemory_key_includes_mesh_topology():
+    from paddle_tpu.mesh import ShardingPlan, use_plan
+    main, startup, loss = _build(width=18)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    feed = {"x": np.ones((8, 18), np.float32)}
+    exe.run(main, feed=feed, fetch_list=[loss.name], scope=scope)
+    before = stat_get("STAT_executor_compile")
+    exe.run(main, feed=feed, fetch_list=[loss.name], scope=scope)
+    assert stat_get("STAT_executor_compile") == before  # cached
+    # same Executor under a dp4xmp2 plan: MISS (an executable
+    # partitioned for one topology must never serve another)
+    plan = ShardingPlan("dp4xmp2")
+    with use_plan(plan):
+        exe.run(main, feed=feed, fetch_list=[loss.name], scope=scope)
+        assert stat_get("STAT_executor_compile") == before + 1
+        # identical mesh: steady state, no recompiles
+        exe.run(main, feed=feed, fetch_list=[loss.name], scope=scope)
+        assert stat_get("STAT_executor_compile") == before + 1
+    # chip-count flip (dp8): its own entry, never the dp4xmp2 one
+    with use_plan(ShardingPlan("dp8")):
+        exe.run(main, feed=feed, fetch_list=[loss.name], scope=scope)
+    assert stat_get("STAT_executor_compile") == before + 2
+
+
+@pytest.mark.spmd
+def test_disk_cache_mesh_topology_round_trip(cache_root):
+    """1-device and dp4xmp2 runs of the SAME program get distinct disk
+    entries; an identical mesh in a fresh Executor hits its entry; a
+    chip-count change misses (stale executables are structurally
+    impossible — the topology is inside the fingerprint)."""
+    from paddle_tpu.mesh import ShardingPlan, use_plan
+    main, startup, loss = _build(width=19)
+    feed = {"x": np.ones((8, 19), np.float32)}
+
+    out_single = _run_fresh(main, startup, loss, feed,
+                            cache_dir=cache_root)
+    # dp4xmp2: same program + feed, distinct fingerprint -> trace MISS
+    plan = ShardingPlan("dp4xmp2")
+    miss0 = stat_get("STAT_program_cache_trace_miss")
+    with use_plan(plan):
+        out_mesh = _run_fresh(main, startup, loss, feed,
+                              cache_dir=cache_root)
+    assert stat_get("STAT_program_cache_trace_miss") > miss0
+    # partitioned numerics match the single-device run
+    np.testing.assert_allclose(np.asarray(out_mesh[0]),
+                               np.asarray(out_single[0]),
+                               rtol=1e-5, atol=1e-6)
+    # identical mesh, fresh Executor/Scope: disk AOT HIT, same bits
+    hit0 = stat_get("STAT_program_cache_trace_hit")
+    with use_plan(plan):
+        out_warm = _run_fresh(main, startup, loss, feed,
+                              cache_dir=cache_root)
+    assert stat_get("STAT_program_cache_trace_hit") > hit0
+    assert out_warm[0].tobytes() == out_mesh[0].tobytes()
+    # chip-count change (dp8): never served a dp4xmp2 entry
+    miss1 = stat_get("STAT_program_cache_trace_miss")
+    hit1 = stat_get("STAT_program_cache_trace_hit")
+    with use_plan(ShardingPlan("dp8")):
+        out_dp8 = _run_fresh(main, startup, loss, feed,
+                             cache_dir=cache_root)
+    assert stat_get("STAT_program_cache_trace_miss") > miss1
+    assert stat_get("STAT_program_cache_trace_hit") == hit1
+    np.testing.assert_allclose(np.asarray(out_dp8[0]),
+                               np.asarray(out_single[0]),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
 # cross-process reuse (satellite: subprocess A populates, B hits)
 # ---------------------------------------------------------------------------
 _XPROC = """
