@@ -348,6 +348,11 @@ class Executor:
         # extra trace is paid once, not per run
         self._unexportable: set = set()
         self._seed_counter = 0
+        # live introspection server (introspect.py): one flag lookup
+        # when FLAGS_introspect_port is unset, a running /metrics +
+        # /statusz endpoint when it names a port
+        from ..introspect import maybe_start
+        maybe_start()
         self._unused_checked: set = set()
         # telemetry step ids: monotonically counts run() calls; the
         # dataset loops install their own batch-number step scope and
@@ -499,7 +504,8 @@ class Executor:
                           timer="TIMER_executor_compile_us"):
                 entry = self._compile(program, block, sorted(feed),
                                       fetch_names, state_names,
-                                      example=example, plan=plan)
+                                      example=example, plan=plan,
+                                      acct_key=key)
             if use_program_cache:
                 self._cache_put(key, entry)
         fn = entry
@@ -606,7 +612,8 @@ class Executor:
 
     def _compile(self, program: Program, block: Block,
                  feed_names: List[str], fetch_names: List[str],
-                 state_names: List[str], example=None, plan=None):
+                 state_names: List[str], example=None, plan=None,
+                 acct_key=None):
         persistable = {v.name for v in program.persistable_vars()}
         has_host = any(REGISTRY.has(op.type) and REGISTRY.get(op.type).host
                        for op in block.ops)
@@ -638,14 +645,38 @@ class Executor:
         aot = self._aot_entry(program, step, example, fetch_names,
                               plan=plan)
         if aot is not None:
-            return aot
-        jit_kwargs = {}
-        if plan is not None and example is not None:
-            jit_kwargs = _plan_jit_kwargs(plan, step, example)
-        jitted = jax.jit(step,
-                         donate_argnums=(0,) if _donate_state() else (),
-                         **jit_kwargs)
-        return jitted
+            entry = aot
+        else:
+            jit_kwargs = {}
+            if plan is not None and example is not None:
+                jit_kwargs = _plan_jit_kwargs(plan, step, example)
+            entry = jax.jit(step,
+                            donate_argnums=(0,) if _donate_state() else (),
+                            **jit_kwargs)
+        return self._account(entry, example, acct_key, feed_names,
+                             fetch_names)
+
+    def _account(self, entry, example, acct_key, feed_names,
+                 fetch_names):
+        """XLA program accounting (core/program_accounting.py): AOT-
+        compile the entry against the example args, record
+        cost_analysis()/memory_analysis() under a per-entry tag, and
+        serve the compiled executable itself. The first call would have
+        paid the identical trace+compile anyway, so steady-state cost
+        is zero; any capture failure returns `entry` unchanged. Entries
+        compiled under an ambient tag scope (the Predictor's bucket
+        runner) are labeled by it, so /programz tells an executor step
+        from a predictor bucket."""
+        if example is None or acct_key is None:
+            return entry
+        from . import program_accounting as _acct
+        base = _acct.current_tag() or "executor"
+        tag = _acct.safe_tag("%s_%s" % (base, _acct.key_token(acct_key)))
+        return _acct.accounted(
+            entry, (example[0], dict(example[1]), example[2]),
+            tag=tag, key=_acct.key_token(acct_key),
+            meta={"feeds": list(feed_names),
+                  "fetches": list(fetch_names)})
 
     # ------------------------------------------------------------------
     def _aot_entry(self, program: Program, step, example,

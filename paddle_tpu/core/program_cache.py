@@ -272,13 +272,20 @@ def fn_fingerprint(tag: str, meta: dict) -> str:
     return h.hexdigest()
 
 
-def exported_entry(cache_dir: str, fingerprint: str, fn, avals):
+def exported_entry(cache_dir: str, fingerprint: str, fn, avals,
+                   tag: Optional[str] = None, meta: Optional[dict] = None):
     """Generic disk-backed AOT entry: the Executor._aot_entry recipe
     (load -> deserialize -> aval check -> jit(exported.call); on miss
     export, round-trip the bytes, store) for any jit-able `fn` called
     as `fn(*avals)`. Returns the callable, or None when this function
     cannot be disk-cached (unexportable lowering, IO trouble) — the
-    caller falls back to plain jax.jit(fn)."""
+    caller falls back to plain jax.jit(fn).
+
+    With `tag`, the entry is routed through the XLA program accounting
+    registry (core/program_accounting.py): compiled at once from the
+    avals, cost/memory analysis recorded under the tag, and the
+    compiled executable served directly — this is how the generation
+    engine's fn_fingerprint entries show up in /programz."""
     import jax
     import jax.export
     ensure_xla_cache(cache_dir)
@@ -308,7 +315,13 @@ def exported_entry(cache_dir: str, fingerprint: str, fn, avals):
             _stat_add("STAT_program_cache_unexportable")
             return None
         store_trace(cache_dir, fingerprint, data)
-    return jax.jit(exported.call)
+    entry = jax.jit(exported.call)
+    if tag is not None:
+        from . import program_accounting
+        entry = program_accounting.accounted(
+            entry, avals, tag=program_accounting.safe_tag(tag),
+            key=fingerprint[:12], meta=meta)
+    return entry
 
 
 def warmup_ladder(buckets, compile_one) -> dict:

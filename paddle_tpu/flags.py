@@ -135,6 +135,16 @@ _DEFS: Dict[str, Any] = {
     # topology rides in every compilation cache key and disk
     # fingerprint, NOT via lowering_snapshot — see executor.py.
     "FLAGS_mesh_spec": "",
+    # live introspection server (introspect.py, docs/observability.md):
+    # port for the stdlib ThreadingHTTPServer serving /metrics,
+    # /healthz, /readyz, /statusz, /flightz, /programz. 0 (default) =
+    # off: maybe_start() is one dict lookup and returns — zero threads,
+    # zero sockets. A positive port starts the server on first
+    # maybe_start() (Executor construction, pool start()); tests and
+    # tooling call introspect.start(port=0) for an OS-assigned
+    # ephemeral port.
+    "FLAGS_introspect_port": 0,
+    "FLAGS_introspect_host": "127.0.0.1",
     # state-buffer donation in the jitted train step. Donation aliases
     # each state input to its output buffer (in-place updates, halves
     # peak param memory) but XLA:CPU runs donated executions

@@ -148,6 +148,8 @@ class GenerationEngine:
         # per-request error sink: the scheduler points this at the
         # request's future; the bare engine re-raises
         self.on_request_error = None
+        # flipped by warmup(): the GenerationPool's /readyz probe
+        self._warmed = False
 
     # --- compiled-step registry ---------------------------------------
 
@@ -199,20 +201,30 @@ class GenerationEngine:
 
     def _aot_or_jit(self, kind: str, bucket: int, raw, avals):
         """Route the step through the persistent AOT program cache
-        (PR 1) when a cache dir resolves; plain jit otherwise."""
+        (PR 1) when a cache dir resolves; plain jit otherwise. Both
+        paths register with the XLA program accounting registry
+        (core/program_accounting.py) so /programz shows every prefill
+        bucket and the decode step with compile-time flops/bytes."""
+        tag = ("generation_prefill_b%d" % bucket if kind == "prefill"
+               else "generation_decode")
+        meta = dict(self.cfg.meta(), kind=kind, bucket=bucket,
+                    blocks=self.kv.num_blocks,
+                    block_size=self.kv.block_size,
+                    width=self.decode_width,
+                    table=self.max_blocks_per_seq,
+                    lanes=self.attn_lanes)
         cache_dir = program_cache.resolve_dir(self._program_cache_dir)
         if cache_dir is not None:
-            meta = dict(self.cfg.meta(), kind=kind, bucket=bucket,
-                        blocks=self.kv.num_blocks,
-                        block_size=self.kv.block_size,
-                        width=self.decode_width,
-                        table=self.max_blocks_per_seq,
-                        lanes=self.attn_lanes)
             fp = program_cache.fn_fingerprint("generation_step", meta)
-            fn = program_cache.exported_entry(cache_dir, fp, raw, avals)
+            fn = program_cache.exported_entry(cache_dir, fp, raw, avals,
+                                              tag=tag, meta=meta)
             if fn is not None:
                 return fn
-        return jax.jit(raw)
+        from ..core import program_accounting
+        return program_accounting.accounted(
+            jax.jit(raw), avals, tag=program_accounting.safe_tag(tag),
+            key=program_accounting.key_token(sorted(meta.items())),
+            meta=meta)
 
     def warmup(self, buckets=None) -> dict:
         """Compile-ahead: the decode step plus every prefill bucket
@@ -226,6 +238,7 @@ class GenerationEngine:
             t0 = time.perf_counter()
             self._warm_prefill(int(b))
             report[int(b)] = round(time.perf_counter() - t0, 4)
+        self._warmed = True
         return report
 
     def _warm_prefill(self, bucket: int) -> None:

@@ -93,6 +93,13 @@ class GenerationPool:
                     target=self._serve_loop, name="pt-generation-sched",
                     daemon=True)
                 self._worker.start()
+        # a started-but-unwarmed pool reads as unready on /readyz until
+        # engine.warmup() flips _warmed (introspect.py readiness)
+        from .. import introspect
+        introspect.register_readiness(
+            "generation_pool_%d" % id(self),
+            lambda: getattr(self.engine, "_warmed", False))
+        introspect.maybe_start()
         return self
 
     def close(self) -> None:
@@ -110,6 +117,8 @@ class GenerationPool:
                 _, fut = self._queue.popleft()
                 fut._set_error(RuntimeError("GenerationPool closed"))
             gauge_set("GAUGE_generation_queue_depth", 0)
+        from .. import introspect
+        introspect.unregister_readiness("generation_pool_%d" % id(self))
 
     def __enter__(self) -> "GenerationPool":
         return self

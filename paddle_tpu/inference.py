@@ -394,9 +394,13 @@ class Predictor:
         else:
             self._warm_sigs.add(sig)
             stat_add("STAT_predictor_bucket_cold")
-        outs = self.exe.run(self.program, feed=padded,
-                            fetch_list=list(self.fetch_names),
-                            scope=self.scope)
+        # ambient tag: an executor compile triggered here lands in
+        # /programz as predictor_b<bucket>_* instead of executor_*
+        from .core import program_accounting
+        with program_accounting.tag_scope("predictor_b%d" % target):
+            outs = self.exe.run(self.program, feed=padded,
+                                fetch_list=list(self.fetch_names),
+                                scope=self.scope)
         if target != b:
             outs = [o[:b] if getattr(o, "ndim", 0) and
                     o.shape[0] == target else o for o in outs]
@@ -444,7 +448,9 @@ class Predictor:
                         if t is not None:
                             shape[ax] = t
                 feeds[n] = np.zeros(tuple(shape), v.dtype)
-            with self._plan_ctx():
+            from .core import program_accounting
+            with self._plan_ctx(), \
+                    program_accounting.tag_scope("predictor_b%d" % bkt):
                 self.exe.run(self.program, feed=feeds,
                              fetch_list=list(self.fetch_names),
                              scope=self.scope)

@@ -1,0 +1,339 @@
+"""Live introspection server (docs/observability.md).
+
+A stdlib :class:`ThreadingHTTPServer` that makes the process's
+observability surface — PR 3's monitor registry and flight recorder,
+the XLA program accounting (core/program_accounting.py), pool queue
+depths, KV-block occupancy, mesh topology — reachable from OUTSIDE the
+process, so a load balancer, autoscaler, Prometheus scraper, or a
+human with curl can read the signals that until now only in-process
+code could. Endpoints:
+
+- ``/metrics``   Prometheus text exposition (monitor.to_prometheus)
+- ``/healthz``   liveness: 200 while the server thread runs
+- ``/readyz``    readiness: 200 only when every registered warmup
+                 probe passes (PredictorPool / GenerationPool register
+                 on start(), flip on warmup()) and, when a process-
+                 global ShardingPlan is active, it has placed state
+- ``/statusz``   JSON: uptime, jax/backend/devices, mesh topology +
+                 per-axis collective counters, program accounting
+                 totals, pool queue depths, KV block-pool occupancy
+- ``/flightz``   flight-recorder tail (text; ``?format=json`` for the
+                 raw records)
+- ``/programz``  per-program XLA cost/memory accounting
+
+Lifecycle: **off by default, zero overhead when off.**
+``FLAGS_introspect_port`` is 0 → :func:`maybe_start` (called from
+Executor construction and pool ``start()``) is one dict lookup; no
+thread, no socket. Set the flag to a positive port (host via
+``FLAGS_introspect_host``, default 127.0.0.1) and the first
+``maybe_start()`` brings the server up. Tests and tooling call
+``start(port=0)`` for an OS-assigned ephemeral port and ``stop()`` to
+tear it down.
+
+Readiness semantics: with no registered probes and no active plan,
+``/readyz`` is trivially ready — a bare Executor process serves
+traffic the moment it can compile. Each serving pool registers an
+"unready until warmed" probe on ``start()`` and unregisters on
+``close()``, so a scraping load balancer only routes to a process
+whose compile-ahead actually finished. ``/readyz`` and ``/statusz``
+read the *process-global* plan (``mesh.install_plan`` /
+``FLAGS_mesh_spec``); thread-local ``use_plan`` scopes on other
+threads are invisible to the server thread by design.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+__all__ = ["start", "stop", "maybe_start", "server",
+           "register_readiness", "unregister_readiness", "readiness"]
+
+_T0 = time.time()
+
+_SERVER_LOCK = threading.Lock()
+_SERVER: Optional["IntrospectServer"] = None
+
+_READY_LOCK = threading.Lock()
+_READY_PROBES: Dict[str, Callable[[], bool]] = {}
+
+
+# ---------------------------------------------------------------------------
+# readiness registry
+# ---------------------------------------------------------------------------
+
+def register_readiness(name: str, probe: Callable[[], bool]) -> None:
+    """Register a named readiness probe (re-registering replaces).
+    /readyz returns 200 only when every registered probe is truthy."""
+    with _READY_LOCK:
+        _READY_PROBES[name] = probe
+
+
+def unregister_readiness(name: str) -> None:
+    with _READY_LOCK:
+        _READY_PROBES.pop(name, None)
+
+
+def readiness() -> Tuple[bool, Dict[str, bool]]:
+    """(ready, per-check dict). A probe that raises reads as unready.
+    When a process-global ShardingPlan is active, it must have placed
+    state at least once (Executor.run under the plan does this on its
+    first step)."""
+    with _READY_LOCK:
+        items = list(_READY_PROBES.items())
+    checks: Dict[str, bool] = {}
+    for name, probe in items:
+        try:
+            checks[name] = bool(probe())
+        except Exception:
+            checks[name] = False
+    try:
+        from .mesh.plan import current_plan
+        plan = current_plan()
+    except Exception:
+        plan = None
+    if plan is not None:
+        checks["mesh_plan_placed"] = bool(getattr(plan, "_placed", False))
+    return (all(checks.values()) if checks else True), checks
+
+
+# ---------------------------------------------------------------------------
+# payload builders (shared by the handler and tests)
+# ---------------------------------------------------------------------------
+
+def statusz() -> Dict[str, Any]:
+    import jax
+    from . import telemetry
+    from .core import program_accounting
+    from .monitor import gauge_get, snapshot
+
+    snap = snapshot()
+    counters = snap["counters"]
+
+    try:
+        devices = jax.devices()
+        jax_info = {
+            "version": jax.__version__,
+            "backend": jax.default_backend(),
+            "device_count": len(devices),
+            "device_kinds": sorted({d.platform for d in devices}),
+        }
+    except Exception as e:  # pre-init / wedged backend: report, don't die
+        jax_info = {"error": repr(e)}
+
+    mesh: Dict[str, Any] = {"active": False}
+    try:
+        from .mesh.plan import current_plan
+        plan = current_plan()
+    except Exception:
+        plan = None
+    if plan is not None:
+        mesh = {
+            "active": True,
+            "topology": [list(t) if isinstance(t, tuple) else t
+                         for t in plan.topology()],
+            "devices": int(plan.spec.size),
+            "data_axis": plan.data_axis,
+            "placed": bool(getattr(plan, "_placed", False)),
+        }
+    # per-axis host-collective census rides along even without a live
+    # plan (parallel/collective.py counts them process-globally)
+    mesh["collectives"] = {
+        k[len("STAT_mesh_collective_"):]: v
+        for k, v in sorted(counters.items())
+        if k.startswith("STAT_mesh_collective_")}
+
+    program_accounting.refresh_throughput()
+    programs = dict(program_accounting.totals())
+    programs["achieved_flops_per_s"] = gauge_get(
+        "GAUGE_programs_achieved_flops_per_s")
+
+    ready, checks = readiness()
+    return {
+        "uptime_s": round(time.time() - _T0, 3),
+        "pid": __import__("os").getpid(),
+        "jax": jax_info,
+        "mesh": mesh,
+        "programs": programs,
+        "program_cache": {
+            k: v for k, v in sorted(counters.items())
+            if k.startswith("STAT_program_cache_")
+            or k == "STAT_executor_compile"},
+        "serving": {
+            "queue_depth": gauge_get("GAUGE_serving_queue_depth"),
+            "last_batch_rows": gauge_get("GAUGE_serving_last_batch_rows"),
+        },
+        "generation": {
+            "queue_depth": gauge_get("GAUGE_generation_queue_depth"),
+            "active_seqs": gauge_get("GAUGE_generation_active_seqs"),
+            "kv_blocks": {
+                "free": gauge_get("GAUGE_generation_blocks_free"),
+                "used": gauge_get("GAUGE_generation_blocks_used"),
+                "total": gauge_get("GAUGE_generation_blocks_free")
+                + gauge_get("GAUGE_generation_blocks_used"),
+            },
+        },
+        "flight_recorder_steps": len(telemetry.flight_records()),
+        "readiness": {"ready": ready, "checks": checks},
+    }
+
+
+def programz() -> Dict[str, Any]:
+    from .core import program_accounting
+    program_accounting.refresh_throughput()
+    from .monitor import gauge_get
+    totals = dict(program_accounting.totals())
+    totals["achieved_flops_per_s"] = gauge_get(
+        "GAUGE_programs_achieved_flops_per_s")
+    return {
+        "uptime_s": round(time.time() - _T0, 3),
+        "totals": totals,
+        "programs": program_accounting.programs(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# HTTP plumbing
+# ---------------------------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-introspect/1.0"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # no stderr spam per scrape
+        pass
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        payload = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _json(self, obj: Any, code: int = 200) -> None:
+        self._send(code, json.dumps(obj, indent=1, default=str) + "\n",
+                   "application/json; charset=utf-8")
+
+    def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler contract)
+        url = urlsplit(self.path)
+        try:
+            if url.path == "/metrics":
+                from .core import program_accounting
+                from .monitor import to_prometheus
+                program_accounting.refresh_throughput()
+                self._send(
+                    200, to_prometheus(),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            elif url.path == "/healthz":
+                self._send(200, "ok\n", "text/plain; charset=utf-8")
+            elif url.path == "/readyz":
+                ready, checks = readiness()
+                self._json({"ready": ready, "checks": checks},
+                           code=200 if ready else 503)
+            elif url.path == "/statusz":
+                self._json(statusz())
+            elif url.path == "/programz":
+                self._json(programz())
+            elif url.path == "/flightz":
+                from . import telemetry
+                q = parse_qs(url.query)
+                if q.get("format", [""])[0] == "json":
+                    self._json(telemetry.flight_records())
+                else:
+                    self._send(200, telemetry.flight_dump() + "\n",
+                               "text/plain; charset=utf-8")
+            elif url.path == "/":
+                self._send(
+                    200,
+                    "paddle_tpu introspection: /metrics /healthz "
+                    "/readyz /statusz /flightz /programz\n",
+                    "text/plain; charset=utf-8")
+            else:
+                self._send(404, "not found: %s\n" % url.path,
+                           "text/plain; charset=utf-8")
+        except BrokenPipeError:
+            pass  # scraper went away mid-response
+        except Exception as e:
+            try:
+                self._json({"error": repr(e)}, code=500)
+            except Exception:
+                pass
+
+
+class IntrospectServer:
+    """Handle on a running server: .port, .host, .url, .stop()."""
+
+    def __init__(self, httpd: ThreadingHTTPServer,
+                 thread: threading.Thread):
+        self._httpd = httpd
+        self._thread = thread
+        self.host, self.port = httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        return "http://%s:%d" % (self.host, self.port)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=10.0)
+
+
+def server() -> Optional[IntrospectServer]:
+    return _SERVER
+
+
+def maybe_start() -> Optional[IntrospectServer]:
+    """Start the server iff FLAGS_introspect_port is a positive port.
+    The disabled path is one flag lookup — no imports beyond flags, no
+    thread, no socket. Idempotent; call sites are Executor
+    construction and pool start()."""
+    if _SERVER is not None:
+        return _SERVER
+    from .flags import get_flag
+    try:
+        port = int(get_flag("FLAGS_introspect_port", 0) or 0)
+    except (TypeError, ValueError):
+        return None
+    if port <= 0:
+        return None
+    return start(port=port)
+
+
+def start(port: Optional[int] = None,
+          host: Optional[str] = None) -> IntrospectServer:
+    """Start the server (idempotent — returns the running one). `port`
+    None reads FLAGS_introspect_port; 0 binds an OS-assigned ephemeral
+    port (tests/tooling — the flag value 0 still means *off* through
+    maybe_start)."""
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is not None:
+            return _SERVER
+        from .flags import get_flag
+        if port is None:
+            port = int(get_flag("FLAGS_introspect_port", 0) or 0)
+        if host is None:
+            host = str(get_flag("FLAGS_introspect_host",
+                                "127.0.0.1") or "127.0.0.1")
+        httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        httpd.daemon_threads = True
+        thread = threading.Thread(target=httpd.serve_forever,
+                                  kwargs={"poll_interval": 0.2},
+                                  name="pt-introspect", daemon=True)
+        thread.start()
+        _SERVER = IntrospectServer(httpd, thread)
+        return _SERVER
+
+
+def stop() -> None:
+    """Shut the server down and release the socket (idempotent)."""
+    global _SERVER
+    with _SERVER_LOCK:
+        srv, _SERVER = _SERVER, None
+    if srv is not None:
+        srv.stop()

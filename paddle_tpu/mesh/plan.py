@@ -73,6 +73,9 @@ class ShardingPlan:
         self._params = _as_rule(params)
         self._inputs = _as_rule(inputs)
         self._warned_uneven: set = set()
+        # flipped by place()/place_state(): introspect.py's /readyz
+        # treats an installed-but-never-placed plan as not ready
+        self._placed = False
         from ..monitor import gauge_set
         gauge_set("GAUGE_mesh_devices", float(self.spec.size))
 
@@ -125,6 +128,7 @@ class ShardingPlan:
         """device_put onto ``sharding``, skipping values already
         resident with an equivalent sharding; counts reshard traffic."""
         import jax
+        self._placed = True
         cur = getattr(value, "sharding", None)
         if cur is not None and cur == sharding:
             return value

@@ -86,6 +86,17 @@ exposes (always-on, like the serving timers):
 - GAUGE_mesh_devices: device count of the most recently built plan;
 - TIMER_mesh_compile_us: walltime of plan.compile()'s first
   (trace+compile) call with explicit in/out shardings.
+
+XLA program accounting (core/program_accounting.py, scraped live via
+introspect.py /programz):
+- GAUGE_program_flops_<tag> / _bytes_accessed_<tag> / _temp_bytes_<tag>
+  / _hbm_bytes_<tag>: per compiled program, captured at compile time
+  from compiled.cost_analysis() / memory_analysis();
+- GAUGE_programs_count / _hbm_bytes (process-wide compiled HBM
+  footprint) / _flops_compiled / _achieved_flops_per_s (FLOPs
+  dispatched per wall-second over the process lifetime);
+- STAT_program_account_fallback: accounted executions that fell back
+  to the plain jitted path (input mismatch — costs one recompile).
 """
 from __future__ import annotations
 
@@ -265,7 +276,12 @@ def to_prometheus(prefix: str = "paddle_tpu") -> str:
         lines.append('%s{quantile="0.95"} %.17g' % (m, st["p95"]))
         lines.append("%s_sum %.17g" % (m, st["sum"]))
         lines.append("%s_count %d" % (m, st["count"]))
+        # a summary family may only contain {quantile}/_sum/_count
+        # samples — strict scrapers reject anything else inside it, so
+        # min/max go out as their own gauge families
+        lines.append("# TYPE %s_min gauge" % m)
         lines.append("%s_min %.17g" % (m, st["min"] if st["count"] else 0))
+        lines.append("# TYPE %s_max gauge" % m)
         lines.append("%s_max %.17g" % (m, st["max"] if st["count"] else 0))
     return "\n".join(lines) + "\n"
 

@@ -148,6 +148,8 @@ class PredictorPool:
         self._not_full = threading.Condition(self._lock)
         self._closed = False
         self._worker: Optional[threading.Thread] = None
+        # flipped by warmup(): the pool's /readyz probe (introspect.py)
+        self._warmed = False
         if _start:
             self.start()
 
@@ -162,6 +164,12 @@ class PredictorPool:
                     target=self._serve_loop, name="pt-serving-batcher",
                     daemon=True)
                 self._worker.start()
+        # unready on /readyz until warmup() runs the compile-ahead
+        from . import introspect
+        introspect.register_readiness(
+            "serving_pool_%d" % id(self),
+            lambda: self._warmed)
+        introspect.maybe_start()
         return self
 
     def close(self) -> None:
@@ -179,6 +187,8 @@ class PredictorPool:
                 self._queue.popleft().future._set_error(
                     RuntimeError("PredictorPool closed"))
             gauge_set("GAUGE_serving_queue_depth", 0)
+        from . import introspect
+        introspect.unregister_readiness("serving_pool_%d" % id(self))
 
     def __enter__(self) -> "PredictorPool":
         return self
@@ -192,9 +202,12 @@ class PredictorPool:
     def warmup(self, example_feeds: Sequence, max_bucket=None) -> dict:
         """Compile-ahead of the bucket ladder (delegates to
         Predictor.warmup_buckets) so steady-state traffic never
-        compiles. Call before opening the pool to traffic."""
-        return self.predictor.warmup_buckets(
+        compiles. Call before opening the pool to traffic; /readyz
+        reports the pool ready only after this returns."""
+        report = self.predictor.warmup_buckets(
             example_feeds, max_bucket=max_bucket)
+        self._warmed = True
+        return report
 
     def submit(self, feeds: Sequence, timeout: Optional[float] = None):
         """Enqueue one request; returns a future with .result(timeout).

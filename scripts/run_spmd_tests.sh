@@ -73,11 +73,51 @@ with use_plan(plan):
                     axis="dp")
     dist.all_reduce(np.ones((4,), np.float32), axis="mp")
 
+# introspection smoke (PR 7): start the server on an ephemeral port,
+# scrape /metrics and /statusz from a real HTTP client, assert every
+# paddle_tpu_* family parses with a # TYPE line, stop. Proves the
+# serving surface works in exactly the multichip environment the rest
+# of this artifact documents.
+import re
+import urllib.request
+from paddle_tpu import introspect
+
+from paddle_tpu.mesh.plan import install_plan
+
+intro = {"ok": False}
+try:
+    # the server thread reads the PROCESS-GLOBAL plan (use_plan above
+    # is thread-local and already exited) — install for the scrape
+    install_plan(plan)
+    srv = introspect.start(port=0)
+    body = urllib.request.urlopen(srv.url + "/metrics",
+                                  timeout=10).read().decode()
+    fams = re.findall(r"^# TYPE (paddle_tpu_\S+) (counter|gauge|summary)$",
+                      body, re.M)
+    sample_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [-+0-9.eEinfa]+$")
+    samples_ok = all(ln.startswith("#") or sample_re.match(ln)
+                     for ln in body.splitlines() if ln)
+    statusz = json.load(urllib.request.urlopen(srv.url + "/statusz",
+                                               timeout=10))
+    intro = {
+        "ok": bool(fams) and samples_ok
+        and statusz["mesh"]["active"] is True,
+        "metric_families": len(fams),
+        "samples_parse": samples_ok,
+        "statusz_mesh": statusz["mesh"],
+    }
+except Exception as e:  # noqa: BLE001 - artifact records the failure
+    intro["error"] = "%s: %s" % (type(e).__name__, e)
+finally:
+    introspect.stop()
+    install_plan(None)
+
 counters = monitor.get_float_stats()
 artifact = {
     "n_devices": len(jax.devices()),
     "rc": rc,
-    "ok": rc == 0 and test_rc == 0,
+    "ok": rc == 0 and test_rc == 0 and intro.get("ok", False),
     "skipped": False,
     "spmd_tests_rc": test_rc,
     "mesh_plan": {
@@ -87,6 +127,7 @@ artifact = {
         "data_axis": plan.data_axis,
         "executor_losses": losses,
     },
+    "introspect": intro,
     "collectives": {k: v for k, v in sorted(counters.items())
                     if k.startswith("STAT_mesh_collective_")},
     "mesh_counters": {k: v for k, v in sorted(counters.items())
@@ -98,7 +139,7 @@ with open("MULTICHIP_r06.json", "w") as f:
     f.write("\n")
 print(json.dumps({k: artifact[k] for k in
                   ("n_devices", "rc", "ok", "spmd_tests_rc",
-                   "collectives")}, indent=1))
+                   "introspect", "collectives")}, indent=1))
 sys.exit(0 if artifact["ok"] else 1)
 EOF
 exit $?

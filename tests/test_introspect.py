@@ -1,0 +1,328 @@
+"""Introspection server tests (paddle_tpu/introspect.py, PR 7).
+
+What is pinned here:
+- /metrics is valid Prometheus text exposition: every sample belongs
+  to a declared ``# TYPE`` family, and a summary family contains ONLY
+  {quantile}/_sum/_count samples — the timer min/max must ship as
+  separate gauge families (the monitor.to_prometheus fix this PR).
+- /readyz flips 503 -> 200 only when warmup actually completes
+  (PredictorPool probe) and when an installed process-global
+  ShardingPlan has placed state.
+- /statusz carries the mesh topology and the KV block-pool occupancy.
+- concurrent scrapes during executor load all succeed with parseable
+  payloads.
+- FLAGS_introspect_port=0 (the default) spawns NO thread and NO
+  socket: constructing Executors/pools must not start a server.
+"""
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import introspect, layers, monitor
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=10):
+    """(status, body) — 4xx/5xx return their status instead of raising."""
+    try:
+        r = urllib.request.urlopen(url, timeout=timeout)
+        return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _parse_exposition(text):
+    """(families, samples) with format assertions. families maps name
+    -> kind; samples are (name, labels, value_str)."""
+    fams = {}
+    samples = []
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# TYPE "):
+            parts = ln.split()
+            assert len(parts) == 4, "bad TYPE line: %r" % ln
+            _, _, name, kind = parts
+            assert name not in fams, "family %s declared twice" % name
+            assert kind in ("counter", "gauge", "summary"), ln
+            fams[name] = kind
+        elif ln.startswith("#"):
+            continue
+        else:
+            m = re.match(
+                r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (\S+)$", ln)
+            assert m, "unparseable sample: %r" % ln
+            float(m.group(3))  # value must parse (inf/nan included)
+            samples.append((m.group(1), m.group(2) or "", m.group(3)))
+    return fams, samples
+
+
+def _check_family_membership(fams, samples):
+    """Every sample belongs to a declared family, in a role its kind
+    allows. This is exactly what a strict scraper enforces."""
+    for name, labels, _ in samples:
+        if name in fams:
+            fam, kind = name, fams[name]
+            if kind == "summary":
+                assert "quantile=" in labels, \
+                    "bare %s sample inside summary family" % name
+            else:
+                assert labels == "", \
+                    "%s family %s sample has labels %s" % (kind, name,
+                                                           labels)
+            continue
+        base = next((name[:-len(s)] for s in ("_sum", "_count")
+                     if name.endswith(s)
+                     and fams.get(name[:-len(s)]) == "summary"), None)
+        assert base is not None, \
+            "sample %s belongs to no declared family" % name
+        assert labels == "", "summary %s sample has labels" % name
+
+
+@pytest.fixture
+def server():
+    """Ephemeral-port server, torn down (with its socket) per test."""
+    srv = introspect.start(port=0)
+    try:
+        yield srv
+    finally:
+        introspect.stop()
+
+
+@pytest.fixture
+def fc_model_dir(tmp_path):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [6])
+        y = layers.fc(x, 3, name="out")
+    exe = pt.Executor()
+    exe.run(startup)
+    d = str(tmp_path / "model")
+    pt.io.save_inference_model(d, ["x"], [y], exe, main_program=main)
+    return d
+
+
+def _run_small_program(steps=1):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8])
+        y = layers.fc(x, 4)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        for _ in range(steps):
+            exe.run(main, feed={"x": np.ones((4, 8), np.float32)},
+                    fetch_list=[y])
+
+
+# ---------------------------------------------------------------------------
+# /metrics exposition validity
+# ---------------------------------------------------------------------------
+
+def test_metrics_every_family_valid(server):
+    _run_small_program()
+    monitor.timer_observe("TIMER_test_introspect_us", 100.0)
+    monitor.timer_observe("TIMER_test_introspect_us", 300.0)
+    code, body = _get(server.url + "/metrics")
+    assert code == 200
+    fams, samples = _parse_exposition(body)
+    assert fams, "no families scraped"
+    assert samples, "no samples scraped"
+    _check_family_membership(fams, samples)
+    # all three instrument kinds present
+    assert "counter" in fams.values()
+    assert "gauge" in fams.values()
+    assert "summary" in fams.values()
+
+
+def test_timer_min_max_are_separate_gauge_families(server):
+    """Regression for the summary-family bug: min/max samples may not
+    live inside the summary — they must be their own gauge families."""
+    monitor.timer_observe("TIMER_test_minmax_us", 5.0)
+    monitor.timer_observe("TIMER_test_minmax_us", 25.0)
+    _, body = _get(server.url + "/metrics")
+    fams, samples = _parse_exposition(body)
+    base = "paddle_tpu_TIMER_test_minmax_us"
+    assert fams[base] == "summary"
+    assert fams[base + "_min"] == "gauge"
+    assert fams[base + "_max"] == "gauge"
+    by_name = {n: v for n, labels, v in samples if not labels}
+    assert float(by_name[base + "_min"]) == 5.0
+    assert float(by_name[base + "_max"]) == 25.0
+    # and the summary family itself holds only quantile/_sum/_count
+    _check_family_membership(fams, samples)
+
+
+def test_program_accounting_gauges_scraped(server):
+    _run_small_program()
+    _, body = _get(server.url + "/metrics")
+    fams, samples = _parse_exposition(body)
+    names = {n for n, _, _ in samples}
+    assert "paddle_tpu_GAUGE_programs_count" in names
+    assert "paddle_tpu_GAUGE_programs_hbm_bytes" in names
+    assert any(n.startswith("paddle_tpu_GAUGE_program_flops_executor")
+               for n in names), sorted(names)
+
+
+# ---------------------------------------------------------------------------
+# readiness
+# ---------------------------------------------------------------------------
+
+def test_readyz_flips_only_after_pool_warmup(server, fc_model_dir):
+    from paddle_tpu import serving
+    from paddle_tpu.inference import Config
+    code, body = _get(server.url + "/readyz")
+    assert code == 200, body  # nothing registered -> trivially ready
+
+    cfg = Config(fc_model_dir)
+    cfg.switch_shape_bucketing(True, buckets=[2, 4])
+    with serving.PredictorPool(cfg, max_batch=4) as pool:
+        code, body = _get(server.url + "/readyz")
+        checks = json.loads(body)["checks"]
+        assert code == 503 and any(
+            k.startswith("serving_pool_") and not v
+            for k, v in checks.items()), body
+        pool.warmup([np.zeros((1, 6), np.float32)])
+        code, body = _get(server.url + "/readyz")
+        assert code == 200, body
+        assert all(json.loads(body)["checks"].values())
+    # close() unregisters the probe
+    code, body = _get(server.url + "/readyz")
+    assert code == 200 and json.loads(body)["checks"] == {}
+
+
+def test_readyz_requires_installed_plan_placed(server):
+    from paddle_tpu.mesh import ShardingPlan
+    from paddle_tpu.mesh.plan import install_plan
+    plan = ShardingPlan("dp4xmp2")
+    install_plan(plan)
+    try:
+        code, body = _get(server.url + "/readyz")
+        assert code == 503
+        assert json.loads(body)["checks"]["mesh_plan_placed"] is False
+        plan.place_state({"w": np.ones((8, 2), np.float32)})
+        code, body = _get(server.url + "/readyz")
+        assert code == 200
+        assert json.loads(body)["checks"]["mesh_plan_placed"] is True
+    finally:
+        install_plan(None)
+
+
+# ---------------------------------------------------------------------------
+# /statusz and /programz payloads
+# ---------------------------------------------------------------------------
+
+def test_statusz_mesh_topology_and_kv_occupancy(server):
+    from paddle_tpu.generation.kv_cache import KVCacheManager
+    from paddle_tpu.mesh import ShardingPlan
+    from paddle_tpu.mesh.plan import install_plan
+    kv = KVCacheManager(16, 4)
+    blocks = kv.alloc("seq0", kv.blocks_for_tokens(8))
+    install_plan(ShardingPlan("dp4xmp2"))
+    try:
+        code, body = _get(server.url + "/statusz")
+        assert code == 200
+        d = json.loads(body)
+        assert d["jax"]["device_count"] == 8
+        assert d["mesh"]["active"] is True
+        assert ["dp", 4] in d["mesh"]["topology"]
+        assert d["mesh"]["devices"] == 8
+        kvb = d["generation"]["kv_blocks"]
+        assert kvb["used"] >= len(blocks)
+        assert kvb["total"] == kvb["free"] + kvb["used"]
+        assert d["uptime_s"] >= 0
+        assert "readiness" in d
+    finally:
+        install_plan(None)
+        kv.free("seq0")
+
+
+def test_programz_lists_accounted_programs(server):
+    _run_small_program(steps=2)
+    code, body = _get(server.url + "/programz")
+    assert code == 200
+    d = json.loads(body)
+    assert d["totals"]["count"] >= 1
+    assert d["totals"]["hbm_bytes"] > 0
+    tags = [p["tag"] for p in d["programs"]]
+    assert any(t.startswith("executor_") for t in tags), tags
+    for p in d["programs"]:
+        assert p["flops"] >= 0
+        assert p["hbm_bytes"] >= 0
+        assert p["calls"] >= 0
+    # repeat executions bump calls without adding entries
+    ent = next(p for p in d["programs"]
+               if p["tag"].startswith("executor_") and p["calls"] >= 2)
+    assert ent["key"]
+
+
+def test_healthz_flightz_and_404(server):
+    assert _get(server.url + "/healthz")[0] == 200
+    assert _get(server.url + "/flightz")[0] == 200
+    code, body = _get(server.url + "/flightz?format=json")
+    assert code == 200
+    json.loads(body)
+    assert _get(server.url + "/nope")[0] == 404
+
+
+# ---------------------------------------------------------------------------
+# concurrency + lifecycle
+# ---------------------------------------------------------------------------
+
+def test_concurrent_scrape_under_load(server):
+    errors = []
+
+    def scrape(n):
+        for _ in range(n):
+            try:
+                code, body = _get(server.url + "/metrics")
+                assert code == 200
+                _parse_exposition(body)
+                code, body = _get(server.url + "/statusz")
+                assert code == 200
+                json.loads(body)
+            except Exception as e:  # noqa: BLE001 - collected for the assert
+                errors.append(e)
+                return
+    threads = [threading.Thread(target=scrape, args=(5,))
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    _run_small_program(steps=10)   # executor load during the scrapes
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+
+
+def test_flag_port_zero_spawns_nothing():
+    """The off-by-default contract: flag 0 means maybe_start is a
+    no-op — no server object, no pt-introspect thread — even as
+    Executors (which call maybe_start) are constructed."""
+    introspect.stop()
+    assert introspect.maybe_start() is None
+    _run_small_program()
+    assert introspect.server() is None
+    assert not [t for t in threading.enumerate()
+                if t.name == "pt-introspect"]
+
+
+def test_start_idempotent_and_stop_releases():
+    srv = introspect.start(port=0)
+    try:
+        assert introspect.start(port=0) is srv
+        assert introspect.maybe_start() is srv
+        assert _get(srv.url + "/healthz")[0] == 200
+    finally:
+        introspect.stop()
+    assert introspect.server() is None
+    with pytest.raises(Exception):
+        urllib.request.urlopen(srv.url + "/healthz", timeout=2)

@@ -16,6 +16,11 @@ compare. Used two ways:
 "Cost" counters are the ones where up == worse: syncs, cache misses /
 corruption / eviction, dropped events. Throughput counters (dispatches,
 hits, bytes) change freely without flagging.
+
+Instruments present in the baseline but absent from the candidate are
+regressions of their own kind (``missing <kind> <name>`` lines): a
+counter that disappears usually means its publishing code path was
+lost, not that the cost went to zero.
 """
 from __future__ import annotations
 
@@ -50,28 +55,39 @@ def _delta(old: float, new: float) -> Dict:
 
 def diff_snapshots(old: Dict, new: Dict) -> Dict:
     """Per-instrument deltas between two snapshots. Counters/gauges
-    diff on value; timers diff on count, sum, and p95."""
+    diff on value; timers diff on count, sum, and p95. An instrument
+    present in `old` but absent from `new` is flagged
+    (``"missing": True``) even when its value would diff as zero — a
+    disappeared instrument usually means the code path that published
+    it was lost, which no value threshold can catch."""
     old, new = _as_snapshot(old), _as_snapshot(new)
     out: Dict[str, Dict] = {"counters": {}, "gauges": {}, "timers": {}}
     for kind in ("counters", "gauges"):
         for name in sorted(set(old[kind]) | set(new[kind])):
             a = float(old[kind].get(name, 0.0))
             b = float(new[kind].get(name, 0.0))
-            if a != b:
-                out[kind][name] = _delta(a, b)
+            missing = name in old[kind] and name not in new[kind]
+            if a != b or missing:
+                e = _delta(a, b)
+                if missing:
+                    e["missing"] = True
+                out[kind][name] = e
     for name in sorted(set(old["timers"]) | set(new["timers"])):
         a = old["timers"].get(name) or {}
         b = new["timers"].get(name) or {}
-        entry = {}
+        missing = name in old["timers"] and name not in new["timers"]
+        entry: Dict = {}
         for k in ("count", "sum", "p95"):
             av, bv = float(a.get(k, 0.0)), float(b.get(k, 0.0))
             if av != bv:
                 entry[k] = _delta(av, bv)
-        if entry:
+        if entry or missing:
             # always carry count so find_regressions can judge sample
             # size even when it didn't change between snapshots
             entry.setdefault("count", _delta(float(a.get("count", 0.0)),
                                              float(b.get("count", 0.0))))
+            if missing:
+                entry["missing"] = True
             out["timers"][name] = entry
     return out
 
@@ -93,6 +109,15 @@ def find_regressions(d: Dict, threshold_pct: float = 10.0) -> List[str]:
                 and float(cnt.get("new", 1) or 1) >= 5:
             regs.append("timer %s p95: %.1f -> %.1f us (+%.1f%%)"
                         % (name, p95["old"], p95["new"], p95["pct"]))
+    # disappeared instruments regress regardless of threshold; the
+    # "missing" prefix keeps them distinct from value regressions for
+    # callers that filter lines by kind (bench.py's generation gate)
+    for kind in ("counters", "gauges", "timers"):
+        for name, e in d.get(kind, {}).items():
+            if e.get("missing"):
+                regs.append(
+                    "missing %s %s: present in baseline, absent from "
+                    "candidate" % (kind[:-1], name))
     return regs
 
 
@@ -104,6 +129,8 @@ def format_diff(d: Dict, regressions: Optional[List[str]] = None) -> str:
                          % (kind[:-1], name, e["old"], e["new"], e["pct"]))
     for name, e in d.get("timers", {}).items():
         for k, v in e.items():
+            if k == "missing":
+                continue
             lines.append("%-9s %-45s %12g -> %-12g (%+.1f%%)"
                          % ("timer." + k, name, v["old"], v["new"],
                             v["pct"]))
