@@ -670,8 +670,9 @@ def bench_serving():
     import tempfile
     import threading
     import paddle_tpu as pt
-    from paddle_tpu import serving
-    from paddle_tpu.monitor import stat_get
+    from paddle_tpu import serving, tracing
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.monitor import stat_get, timer_get
 
     T, R, H_IN = 8, 240, 32
     model_dir = tempfile.mkdtemp(prefix="pt_serving_bench_")
@@ -785,6 +786,8 @@ def bench_serving():
             r0 = stat_get("STAT_serving_batched_rows")
             pad0 = stat_get("STAT_predictor_pad_rows")
             c0 = stat_get("STAT_executor_compile")
+            tc0 = stat_get("STAT_trace_completed")
+            nm0 = stat_get("STAT_trace_nonmonotonic")
             wall, lat, outs = min((clients(
                 lambda i: pool.run([reqs[i]])[0]) for _ in range(2)),
                 key=lambda r: r[0])
@@ -800,6 +803,67 @@ def bench_serving():
                     round(rows / batches, 1) if batches else None,
                 "padded_rows": int(
                     stat_get("STAT_predictor_pad_rows") - pad0)}
+
+            # --- request tracing: latency decomposition + overhead ----
+            # every pooled request must have produced one complete,
+            # monotonically ordered trace (2 client passes of R each)
+            def _pcts(timer):
+                st = timer_get(timer)
+                if not st["count"]:
+                    return None
+                return {"p50_us": round(st["p50"], 1),
+                        "p95_us": round(st["p95"], 1)}
+
+            sample = tracing.recent()[-1]
+            offs = [t for _, t in sample["stages"]]
+            report["tracing"] = {
+                "traces_completed": int(
+                    stat_get("STAT_trace_completed") - tc0),
+                "expected_traces": 2 * R,
+                "all_complete": int(stat_get("STAT_trace_completed")
+                                    - tc0) == 2 * R,
+                "nonmonotonic": int(
+                    stat_get("STAT_trace_nonmonotonic") - nm0),
+                "sample_stages": [s for s, _ in sample["stages"]],
+                "sample_monotonic": offs == sorted(offs),
+                "queue_wait": _pcts("TIMER_serving_queue_wait_us"),
+                "execute": _pcts("TIMER_serving_execute_us"),
+                "total": _pcts("TIMER_serving_total_us"),
+            }
+
+            # tracing-on-vs-off overhead, same interleaved best-of
+            # methodology as the PR 7 scrape-cost block: run-to-run
+            # jitter dwarfs a <1% effect, so interleave the pairs and
+            # compare the max of each arm
+            on_runs, off_runs = [], []
+            try:
+                for _ in range(5):
+                    set_flags({"FLAGS_request_tracing": False})
+                    w, _, _ = clients(
+                        lambda i: pool.run([reqs[i]])[0])
+                    off_runs.append(total_rows / w)
+                    set_flags({"FLAGS_request_tracing": True})
+                    w, _, _ = clients(
+                        lambda i: pool.run([reqs[i]])[0])
+                    on_runs.append(total_rows / w)
+            finally:
+                set_flags({"FLAGS_request_tracing": True})
+            off_rps, on_rps = max(off_runs), max(on_runs)
+            report["tracing"]["overhead"] = {
+                "tracing_off_rows_per_sec": round(off_rps, 1),
+                "tracing_on_rows_per_sec": round(on_rps, 1),
+                "overhead_pct": round((1.0 - on_rps / off_rps) * 100.0,
+                                      2),
+                # the honest unit: added wall per request. The percent
+                # above is GIL-amplified on this CPU bench — requests
+                # here finish in ~1ms, so ~10us of pure-Python trace
+                # bookkeeping reads as several percent; against real
+                # serving latencies the same microseconds are <1%
+                # (docs/observability.md).
+                "overhead_us_per_request": round(
+                    (total_rows / on_rps - total_rows / off_rps)
+                    / R * 1e6, 1),
+            }
         parity["pooled"] = all(np.array_equal(o, e)
                                for o, e in zip(outs, expected))
     finally:
@@ -840,7 +904,8 @@ def bench_generation():
                                        NaiveGenerator, SamplingParams,
                                        init_params)
     from paddle_tpu import monitor
-    from paddle_tpu.monitor import stat_get
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.monitor import stat_get, timer_get
 
     cfg = DecoderConfig(vocab_size=128, hidden=64, layers=4, heads=4,
                         max_seq_len=128)
@@ -876,6 +941,8 @@ def bench_generation():
     # --- paged: continuous batching at fixed width ---------------------
     eng.warmup()
     c0 = stat_get("STAT_generation_compile")
+    tc0 = stat_get("STAT_trace_completed")
+    nm0 = stat_get("STAT_trace_nonmonotonic")
     snap0 = monitor.snapshot()
     for r in reqs:
         eng.submit(r)
@@ -892,6 +959,31 @@ def bench_generation():
     parity = all(results[i].tokens == expected[i].tokens
                  for i in range(R))
     p95_ms = round(sorted(step_s)[int(0.95 * len(step_s))] * 1e3, 3)
+
+    # --- request tracing: every submitted request yields one complete
+    # trace; TTFT/TPOT/queue-wait come from the trace timers ----------
+    def _pcts(timer):
+        st = timer_get(timer)
+        if not st["count"]:
+            return None
+        return {"p50_us": round(st["p50"], 1),
+                "p95_us": round(st["p95"], 1)}
+
+    from paddle_tpu import tracing as _tracing
+    sample = _tracing.recent()[-1] if _tracing.recent() else None
+    trace_report = {
+        "traces_completed": int(stat_get("STAT_trace_completed") - tc0),
+        "expected_traces": R,
+        "all_complete":
+            int(stat_get("STAT_trace_completed") - tc0) == R,
+        "nonmonotonic": int(
+            stat_get("STAT_trace_nonmonotonic") - nm0),
+        "sample_stages": ([s for s, _ in sample["stages"]]
+                          if sample else None),
+        "ttft": _pcts("TIMER_generation_ttft_us"),
+        "tpot": _pcts("TIMER_generation_tpot_us"),
+        "queue_wait": _pcts("TIMER_generation_queue_wait_us"),
+    }
 
     # --- stat_diff: decode-step p95 vs the previous run's snapshot ----
     keep = lambda name: "generation" in name  # noqa: E731
@@ -925,6 +1017,37 @@ def bench_generation():
         pass
     del snap0  # per-run deltas live in the persisted snapshot diff
 
+    # --- tracing on-vs-off overhead (interleaved, AFTER the stat_diff
+    # snapshot so the extra passes never perturb the gated timers) ----
+    def paged_pass():
+        for r in reqs:
+            eng.submit(r)
+        t0 = time.perf_counter()
+        drained = []
+        while not eng.idle:
+            drained.extend(eng.step())
+        return total_new / (time.perf_counter() - t0)
+
+    on_runs, off_runs = [], []
+    try:
+        for _ in range(2):
+            set_flags({"FLAGS_request_tracing": False})
+            off_runs.append(paged_pass())
+            set_flags({"FLAGS_request_tracing": True})
+            on_runs.append(paged_pass())
+    finally:
+        set_flags({"FLAGS_request_tracing": True})
+    off_tps, on_tps = max(off_runs), max(on_runs)
+    trace_report["overhead"] = {
+        "tracing_off_tokens_per_sec": round(off_tps, 1),
+        "tracing_on_tokens_per_sec": round(on_tps, 1),
+        "overhead_pct": round((1.0 - on_tps / off_tps) * 100.0, 2),
+        # per-token cost in wall time — the unit that transfers to
+        # real decode-step latencies (docs/observability.md)
+        "overhead_us_per_token": round(
+            (1.0 / on_tps - 1.0 / off_tps) * 1e6, 2),
+    }
+
     return {
         "workload": "decoder L%d-H%d (vocab %d): %d requests, "
                     "prompts 4..28, %d new tokens"
@@ -937,6 +1060,7 @@ def bench_generation():
         "steady_state_recompiles": recompiles,
         "tokens_bitwise_identical": bool(parity),
         "decode_step_p95_regressions": regressions,
+        "tracing": trace_report,
     }
 
 

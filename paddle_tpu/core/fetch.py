@@ -35,20 +35,28 @@ class FetchHandle:
     valid for the handle's lifetime.
     """
 
-    __slots__ = ("_device", "_host", "_step")
+    __slots__ = ("_device", "_host", "_step", "_trace")
 
     def __init__(self, value: Any):
-        # step-correlated telemetry: remember which pipeline step
-        # produced this fetch (the dispatching step_scope), so the
-        # first-read sync span lands on the right step id even though
-        # the read happens window steps later (docs/observability.md)
+        # step-correlated telemetry: remember which pipeline step (and
+        # which request traces, when created under a trace_scope)
+        # produced this fetch, so the first-read sync span lands on the
+        # right step id / trace ids even though the read happens window
+        # steps later (docs/observability.md)
         from .. import telemetry as _tm
-        self._step = _tm.current_step() if _tm.enabled() else None
+        if _tm.enabled():
+            self._step = _tm.current_step()
+            self._trace = _tm.current_trace()
+        else:
+            self._step = None
+            self._trace = None
         if isinstance(value, FetchHandle):  # idempotent wrap
             self._device = value._device
             self._host = value._host
             self._step = value._step if value._step is not None \
                 else self._step
+            self._trace = value._trace if value._trace is not None \
+                else self._trace
             return
         if isinstance(value, (np.ndarray, np.generic)):
             self._device = None
@@ -98,10 +106,14 @@ class FetchHandle:
             from ..monitor import stat_add
             stat_add("STAT_executor_sync")
             from .. import telemetry as _tm
-            with _tm.span("fetch/sync", step=self._step, track="sync",
-                          timer="TIMER_fetch_sync_us"):
+            with _tm.trace_scope(self._trace), \
+                    _tm.span("fetch/sync", step=self._step,
+                             track="sync",
+                             timer="TIMER_fetch_sync_us"):
                 self._host = np.asarray(self._device)
             _tm.flight_note(self._step, "sync_count", add=1)
+            if self._trace is not None:
+                _tm.flight_note(self._step, "trace", self._trace)
         return self._host
 
     def __array__(self, dtype=None, copy=None):

@@ -145,6 +145,18 @@ _DEFS: Dict[str, Any] = {
     # ephemeral port.
     "FLAGS_introspect_port": 0,
     "FLAGS_introspect_host": "127.0.0.1",
+    # request-lifecycle tracing (tracing.py, docs/observability.md):
+    # per-request trace ids + monotonic stage timestamps through the
+    # serving/generation pools, TTFT/TPOT + latency-decomposition
+    # timers, deadline budgets, the /tracez exemplar ring. ON by
+    # default — tracing is how serving explains itself; the disabled
+    # path (begin() returns the shared no-op trace) is one dict lookup
+    # per request and bench.py pins the enabled overhead under 1%.
+    "FLAGS_request_tracing": True,
+    # exemplar-ring bound: the N slowest + all errored/deadline-missed
+    # requests kept with full timelines for /tracez (gauge-retracting
+    # eviction, like FLAGS-less program_accounting's 512 bound)
+    "FLAGS_tracing_exemplars": 32,
     # state-buffer donation in the jitted train step. Donation aliases
     # each state input to its output buffer (in-place updates, halves
     # peak param memory) but XLA:CPU runs donated executions

@@ -25,6 +25,15 @@ Instruments (track="generation"): STAT_generation_requests /
 _tokens / _prefills / _evictions / _compile / _errors,
 GAUGE_generation_active_seqs (+ kv_cache block gauges),
 TIMER_generation_prefill_us / _decode_step_us.
+
+Request tracing (tracing.py, docs/observability.md): every request
+carries a RequestTrace (opened by GenerationPool.submit, or by
+engine.submit for bare-engine use) staged submit → admit →
+prefill_start → first_token → done. token() observes TTFT on the first
+token and TPOT deltas after — preemption replays re-observe TPOT (the
+client really waits through the replay) but TTFT only once — and
+preempt/replay land as trace events, so /tracez shows exactly which
+requests paid for pool pressure.
 """
 from __future__ import annotations
 
@@ -38,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import telemetry as _tm
+from .. import tracing as _tr
 from ..core import program_cache
 from ..flags import get_flag
 from ..inference import bucket_for, parse_bucket_ladder
@@ -52,12 +62,16 @@ __all__ = ["GenerationEngine", "GenerationRequest", "GenerationResult",
 
 @dataclass
 class GenerationRequest:
-    """One decoding job: prompt token ids + termination + sampling."""
+    """One decoding job: prompt token ids + termination + sampling.
+    `trace` is the request's RequestTrace (tracing.py) — stamped by
+    GenerationPool.submit, or opened by engine.submit when absent;
+    callers never set it by hand."""
     prompt: Sequence[int]
     max_new_tokens: int = 16
     eos_token: Optional[int] = None
     sampling: SamplingParams = field(default_factory=SamplingParams)
     request_id: Any = None
+    trace: Any = field(default=None, repr=False, compare=False)
 
 
 @dataclass
@@ -289,7 +303,13 @@ class GenerationEngine:
                 "(FLAGS_generation_kv_blocks) — it could never run"
                 % (self.kv.blocks_for_tokens(total),
                    self.kv.num_blocks - 1))
-        req = replace(req, prompt=prompt)
+        # bare-engine use opens the trace here; pooled requests arrive
+        # with the pool's trace already attached (ONE flag lookup per
+        # request either way — begin() is the only lookup site)
+        tr = req.trace if req.trace is not None \
+            else _tr.begin("generation")
+        req = replace(req, prompt=prompt, trace=tr)
+        tr.stage("admit")
         seq = _Seq(req, self._admit_counter)
         self._admit_counter += 1
         self._pending.append(seq)
@@ -334,6 +354,7 @@ class GenerationEngine:
                 # this request
                 self._pending.pop(0)
                 stat_add("STAT_generation_errors")
+                seq.req.trace.finish(error=e)
                 self._deliver_error(seq, e)
                 continue
             self._pending.pop(0)
@@ -348,9 +369,14 @@ class GenerationEngine:
         need = self.kv.blocks_for_tokens(n + 1)  # room for 1st decode
         if need > self.kv.free_blocks:
             return False
+        tr = seq.req.trace
+        tr.stage("prefill_start")
+        if seq.evictions:
+            tr.event("replay", evictions=seq.evictions)
         bucket = bucket_for(n, self.prefill_ladder)
         t0 = time.perf_counter()
-        with _tm.span("generation/prefill", track="generation"):
+        with _tm.trace_scope(tr.trace_id), \
+                _tm.span("generation/prefill", track="generation"):
             fn = self._get_fn("prefill", bucket)
             toks = np.zeros((1, bucket), np.int32)
             toks[0, :n] = prompt
@@ -383,6 +409,9 @@ class GenerationEngine:
         # the prompt's "next token" comes from the prefill logits: feed
         # it to the first decode step via the sampler's step counter 0
         first = self._sample_host(seq, np.asarray(logits)[0], step=0)
+        # TTFT lands here (first call only — a preemption replay keeps
+        # the original first-token time; replays re-observe TPOT)
+        tr.token()
         seq.generated.append(first)
         seq.ctx = n
         seq.lane = lane
@@ -437,7 +466,14 @@ class GenerationEngine:
             tokens[ln] = seq.generated[-1]
             steps[ln] = len(seq.generated)
         t0 = time.perf_counter()
-        with _tm.span("generation/decode_step", track="generation"):
+        # chrome-trace lanes carry which requests rode this step; the
+        # join only matters (and only costs) when telemetry is on
+        tids = ",".join(
+            t for t in (self._lane_seq[ln].req.trace.trace_id
+                        for ln in active) if t) \
+            if _tm.enabled() else None
+        with _tm.trace_scope(tids), \
+                _tm.span("generation/decode_step", track="generation"):
             fn = self._get_fn("decode")
             nxt, self.k_pools, self.v_pools = fn(
                 self.params, self.k_pools, self.v_pools,
@@ -454,6 +490,7 @@ class GenerationEngine:
             seq.ctx += 1
             self._ctx[ln] = seq.ctx
             seq.generated.append(int(nxt[ln]))
+            seq.req.trace.token()
             timer_observe("TIMER_generation_inter_token_us",
                           (now - seq.t_last_token) * 1e6)
             seq.t_last_token = now
@@ -482,6 +519,9 @@ class GenerationEngine:
         toks = list(seq.generated)
         if reason == "eos":
             toks = toks[:-1]
+        seq.req.trace.finish(finish_reason=reason,
+                             tokens=len(toks),
+                             evictions=seq.evictions)
         return GenerationResult(
             request_id=seq.req.request_id,
             prompt_len=len(seq.req.prompt), tokens=toks,
@@ -527,6 +567,9 @@ class GenerationEngine:
         self.kv.evict(id(cand))
         self._tables[lane] = TRASH_BLOCK
         self._ctx[lane] = 0
+        cand.req.trace.event("preempt", lane=lane,
+                             ctx=int(cand.ctx),
+                             generated=len(cand.generated))
         fresh = _Seq(cand.req, cand.admit_order)
         fresh.evictions = cand.evictions + 1
         self._pending.insert(0, fresh)

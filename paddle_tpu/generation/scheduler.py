@@ -29,6 +29,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from .. import tracing as _tr
 from ..flags import get_flag
 from ..monitor import gauge_set, stat_add
 from ..serving import ServingQueueFull, _Future
@@ -115,7 +116,9 @@ class GenerationPool:
         with self._lock:
             while self._queue:
                 _, fut = self._queue.popleft()
-                fut._set_error(RuntimeError("GenerationPool closed"))
+                exc = RuntimeError("GenerationPool closed")
+                fut.trace.finish(error=exc)
+                fut._set_error(exc)
             gauge_set("GAUGE_generation_queue_depth", 0)
         from .. import introspect
         introspect.unregister_readiness("generation_pool_%d" % id(self))
@@ -130,36 +133,51 @@ class GenerationPool:
     # --- client API ----------------------------------------------------
 
     def submit(self, req: GenerationRequest,
-               timeout: Optional[float] = None) -> _Future:
+               timeout: Optional[float] = None,
+               deadline: Optional[float] = None) -> _Future:
         """Enqueue one request; returns a future whose .result() is a
         GenerationResult. Blocks while the queue is full, then raises
         ServingQueueFull — the same backpressure contract as
-        serving.PredictorPool.submit."""
+        serving.PredictorPool.submit. `deadline` arms a latency budget
+        (seconds) on the request's trace: STAT_generation_deadline_missed
+        + per-stage budget burn when blown (never cancels)."""
         fut = _Future()
-        deadline = (None if timeout is None
-                    else time.monotonic() + timeout)
+        fut.trace = _tr.begin("generation", deadline=deadline)
+        wait_deadline = (None if timeout is None
+                         else time.monotonic() + timeout)
         with self._not_full:
             while not self._closed and \
                     len(self._queue) >= self.queue_depth:
-                remaining = (None if deadline is None
-                             else deadline - time.monotonic())
+                remaining = (None if wait_deadline is None
+                             else wait_deadline - time.monotonic())
                 if remaining is not None and remaining <= 0:
                     stat_add("STAT_generation_rejected")
-                    raise ServingQueueFull(
+                    exc = ServingQueueFull(
                         "generation queue full (depth %d) for %.3fs"
                         % (self.queue_depth, timeout))
+                    fut.trace.finish(error=exc)
+                    raise exc
                 self._not_full.wait(remaining)
             if self._closed:
-                raise RuntimeError("GenerationPool closed")
+                exc = RuntimeError("GenerationPool closed")
+                fut.trace.finish(error=exc)
+                raise exc
             self._queue.append((req, fut))
             gauge_set("GAUGE_generation_queue_depth", len(self._queue))
             self._not_empty.notify()
         return fut
 
     def run(self, req: GenerationRequest,
-            timeout: Optional[float] = None):
-        """Blocking submit+wait."""
-        return self.submit(req, timeout=timeout).result(timeout)
+            timeout: Optional[float] = None,
+            deadline: Optional[float] = None):
+        """Blocking submit+wait. `timeout` is ONE budget shared by the
+        enqueue wait and the result wait (it used to be handed to both,
+        so a 1 s budget could block ~2 s)."""
+        if timeout is None:
+            return self.submit(req, deadline=deadline).result()
+        t_end = time.monotonic() + timeout
+        fut = self.submit(req, timeout=timeout, deadline=deadline)
+        return fut.result(max(0.0, t_end - time.monotonic()))
 
     # --- worker --------------------------------------------------------
 
@@ -177,9 +195,11 @@ class GenerationPool:
             self._next_id += 1
             try:
                 from dataclasses import replace
-                eng.submit(replace(req, request_id=rid))
+                eng.submit(replace(req, request_id=rid,
+                                   trace=fut.trace))
             except Exception as e:
                 stat_add("STAT_generation_errors")
+                fut.trace.finish(error=e)
                 fut._set_error(e)
                 continue
             self._inflight[rid] = fut
@@ -206,6 +226,7 @@ class GenerationPool:
                 # futures' error paths)
                 stat_add("STAT_generation_errors")
                 for fut in self._inflight.values():
+                    fut.trace.finish(error=e)
                     fut._set_error(e)
                 self._inflight.clear()
                 self._reset_engine()

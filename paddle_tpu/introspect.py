@@ -20,6 +20,10 @@ code could. Endpoints:
 - ``/flightz``   flight-recorder tail (text; ``?format=json`` for the
                  raw records)
 - ``/programz``  per-program XLA cost/memory accounting
+- ``/tracez``    request-lifecycle traces (tracing.py): rolling
+                 TTFT/TPOT/stage-decomposition latencies, recently
+                 completed traces, and the slow/errored exemplar ring
+                 (text; ``?format=json`` for the raw payload)
 
 Lifecycle: **off by default, zero overhead when off.**
 ``FLAGS_introspect_port`` is 0 → :func:`maybe_start` (called from
@@ -177,7 +181,27 @@ def statusz() -> Dict[str, Any]:
             },
         },
         "flight_recorder_steps": len(telemetry.flight_records()),
+        "tracing": _tracing_status(counters),
         "readiness": {"ready": ready, "checks": checks},
+    }
+
+
+def _tracing_status(counters: Dict[str, Any]) -> Dict[str, Any]:
+    """The /statusz "tracing" section: completion counters + rolling
+    TTFT/TPOT/total latencies from the request-trace decomposition
+    timers (tracing.rolling)."""
+    from . import tracing
+    from .flags import get_flag
+    return {
+        "enabled": bool(get_flag("FLAGS_request_tracing")),
+        "completed": counters.get("STAT_trace_completed", 0),
+        "errored": counters.get("STAT_trace_errored", 0),
+        "deadline_missed": {
+            k[len("STAT_"):-len("_deadline_missed")]: v
+            for k, v in sorted(counters.items())
+            if k.endswith("_deadline_missed")},
+        "exemplars": len(tracing.exemplars()),
+        "rolling_us": tracing.rolling(),
     }
 
 
@@ -238,6 +262,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(statusz())
             elif url.path == "/programz":
                 self._json(programz())
+            elif url.path == "/tracez":
+                from . import tracing
+                q = parse_qs(url.query)
+                if q.get("format", [""])[0] == "json":
+                    self._json(tracing.tracez())
+                else:
+                    self._send(200, tracing.tracez_text() + "\n",
+                               "text/plain; charset=utf-8")
             elif url.path == "/flightz":
                 from . import telemetry
                 q = parse_qs(url.query)
@@ -250,7 +282,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(
                     200,
                     "paddle_tpu introspection: /metrics /healthz "
-                    "/readyz /statusz /flightz /programz\n",
+                    "/readyz /statusz /flightz /programz /tracez\n",
                     "text/plain; charset=utf-8")
             else:
                 self._send(404, "not found: %s\n" % url.path,

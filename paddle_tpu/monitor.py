@@ -97,6 +97,21 @@ introspect.py /programz):
   dispatched per wall-second over the process lifetime);
 - STAT_program_account_fallback: accounted executions that fell back
   to the plain jitted path (input mismatch — costs one recompile).
+
+Request-lifecycle tracing (tracing.py, /tracez — always-on like the
+serving timers, gated by FLAGS_request_tracing):
+- stage-decomposition timers observed at trace finish:
+  TIMER_serving_admit_us / _batch_join_us / _dispatch_us / _execute_us
+  / _fetch_us / _total_us and TIMER_generation_queue_wait_us /
+  _decode_us / _total_us — plus TIMER_generation_ttft_us (first token,
+  observed once per request) and TIMER_generation_tpot_us (per-decode-
+  token deltas), observed inline as tokens arrive;
+- STAT_trace_completed / _errored / _nonmonotonic (ordering audit),
+  STAT_<kind>_deadline_missed and STAT_<kind>_budget_<stage>_us for
+  deadline-armed submits (where deadlined traffic burns its budget);
+- GAUGE_tracing_exemplars + GAUGE_trace_exemplar_us_<id> per kept
+  slow/errored exemplar (retracted on ring eviction,
+  STAT_tracing_exemplar_evict).
 """
 from __future__ import annotations
 
@@ -219,6 +234,21 @@ def timer_get(name: str) -> Dict[str, float]:
     with _LOCK:
         t = _TIMERS.get(name)
         return t.stats() if t is not None else _Timer().stats()
+
+
+def observe_many(timers=(), stats=()) -> None:
+    """Record several timer samples and counter increments under ONE
+    lock acquisition — for hot paths that emit a burst of instruments
+    per event (tracing.RequestTrace.finish observes a whole latency
+    decomposition at once)."""
+    with _LOCK:
+        for name, v in timers:
+            t = _TIMERS.get(name)
+            if t is None:
+                t = _TIMERS[name] = _Timer()
+            t.observe(float(v))
+        for name, v in stats:
+            _STATS[name] = _STATS.get(name, 0.0) + float(v)
 
 
 # ---------------------------------------------------------------------------

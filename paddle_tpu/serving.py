@@ -21,6 +21,14 @@ Instruments (monitor.py / telemetry.py, track="serving"):
 STAT_serving_requests / _batches / _batched_rows / _rejected /
 _batch_errors, GAUGE_serving_queue_depth / _last_batch_rows,
 TIMER_serving_batch_us / _queue_wait_us.
+
+Request tracing (tracing.py, docs/observability.md): every submit()
+opens a RequestTrace (kind="serving") staged through admit →
+batch_join → dispatch → execute → fetch → done, giving the
+TIMER_serving_admit/batch_join/dispatch/execute/fetch/total_us
+decomposition, /tracez exemplars, and chrome-trace lanes tagged with
+the batch's trace ids. `submit(..., deadline=seconds)` arms a latency
+budget (STAT_serving_deadline_missed + per-stage budget burn).
 """
 from __future__ import annotations
 
@@ -32,6 +40,7 @@ from typing import Any, List, Optional, Sequence
 import numpy as np
 
 from . import telemetry as _tm
+from . import tracing as _tr
 from .flags import get_flag
 from .monitor import gauge_set, stat_add, timer_observe
 
@@ -45,15 +54,20 @@ class ServingQueueFull(RuntimeError):
 
 class _Future:
     """Per-request completion handle (Event-based; no asyncio — the
-    serving front-end must work from plain threads)."""
+    serving front-end must work from plain threads). `t_submit` is
+    time.monotonic() — the SAME clock every deadline/timeout
+    computation uses (it used to be perf_counter, which is allowed to
+    run on a different timebase; mixing the two made the queue-wait
+    timer and run()'s deadline math silently incomparable)."""
 
-    __slots__ = ("_event", "_outputs", "_error", "t_submit")
+    __slots__ = ("_event", "_outputs", "_error", "t_submit", "trace")
 
     def __init__(self):
         self._event = threading.Event()
         self._outputs = None
         self._error = None
-        self.t_submit = time.perf_counter()
+        self.t_submit = time.monotonic()
+        self.trace = _tr.NOOP_TRACE
 
     def _set(self, outputs) -> None:
         self._outputs = outputs
@@ -68,7 +82,12 @@ class _Future:
 
     def result(self, timeout: Optional[float] = None) -> List[np.ndarray]:
         if not self._event.wait(timeout):
-            raise TimeoutError("serving request not completed in time")
+            elapsed = time.monotonic() - self.t_submit
+            stage = self.trace.last_stage()
+            raise TimeoutError(
+                "request not completed in time (%.3fs elapsed, last "
+                "completed stage: %s)"
+                % (elapsed, stage if stage is not None else "unknown"))
         if self._error is not None:
             raise self._error
         return self._outputs
@@ -184,8 +203,10 @@ class PredictorPool:
             worker.join(timeout=60.0)
         with self._lock:
             while self._queue:
-                self._queue.popleft().future._set_error(
-                    RuntimeError("PredictorPool closed"))
+                fut = self._queue.popleft().future
+                exc = RuntimeError("PredictorPool closed")
+                fut.trace.finish(error=exc)
+                fut._set_error(exc)
             gauge_set("GAUGE_serving_queue_depth", 0)
         from . import introspect
         introspect.unregister_readiness("serving_pool_%d" % id(self))
@@ -209,10 +230,15 @@ class PredictorPool:
         self._warmed = True
         return report
 
-    def submit(self, feeds: Sequence, timeout: Optional[float] = None):
+    def submit(self, feeds: Sequence, timeout: Optional[float] = None,
+               deadline: Optional[float] = None):
         """Enqueue one request; returns a future with .result(timeout).
         Blocks while the queue is at FLAGS_predictor_queue_depth, then
-        raises ServingQueueFull (timeout=None blocks indefinitely)."""
+        raises ServingQueueFull (timeout=None blocks indefinitely).
+        `deadline` arms a latency budget in seconds on the request's
+        trace: a trace finishing past it bumps
+        STAT_serving_deadline_missed and attributes the budget burn
+        per stage (it does NOT cancel the request)."""
         arrs = [np.asarray(v) for v in feeds]
         names = self.predictor.feed_names
         if len(arrs) != len(names):
@@ -227,31 +253,45 @@ class PredictorPool:
         req = _Request(arrs, rows.pop(), _request_sig(arrs))
         if req.rows == 0:
             raise ValueError("empty-batch request")
-        deadline = (None if timeout is None
-                    else time.monotonic() + timeout)
+        tr = _tr.begin("serving", deadline=deadline)
+        req.future.trace = tr
+        tr.note(rows=req.rows)
+        wait_deadline = (None if timeout is None
+                         else time.monotonic() + timeout)
         with self._not_full:
             while not self._closed and len(self._queue) >= self.queue_depth:
-                remaining = (None if deadline is None
-                             else deadline - time.monotonic())
+                remaining = (None if wait_deadline is None
+                             else wait_deadline - time.monotonic())
                 if remaining is not None and remaining <= 0:
                     stat_add("STAT_serving_rejected")
-                    raise ServingQueueFull(
+                    exc = ServingQueueFull(
                         "serving queue full (depth %d) for %.3fs"
                         % (self.queue_depth, timeout))
+                    tr.finish(error=exc)
+                    raise exc
                 self._not_full.wait(remaining)
             if self._closed:
-                raise RuntimeError("PredictorPool closed")
+                exc = RuntimeError("PredictorPool closed")
+                tr.finish(error=exc)
+                raise exc
+            tr.stage("admit")
             self._queue.append(req)
             stat_add("STAT_serving_requests")
             gauge_set("GAUGE_serving_queue_depth", len(self._queue))
             self._not_empty.notify()
         return req.future
 
-    def run(self, feeds: Sequence,
-            timeout: Optional[float] = None) -> List[np.ndarray]:
+    def run(self, feeds: Sequence, timeout: Optional[float] = None,
+            deadline: Optional[float] = None) -> List[np.ndarray]:
         """Blocking submit+wait — the thread-safe drop-in for
-        Predictor.run(feeds)."""
-        return self.submit(feeds, timeout=timeout).result(timeout)
+        Predictor.run(feeds). `timeout` is ONE budget shared by the
+        enqueue wait and the result wait (it used to be handed to both,
+        so a 1 s budget could block ~2 s)."""
+        if timeout is None:
+            return self.submit(feeds, deadline=deadline).result()
+        t_end = time.monotonic() + timeout
+        fut = self.submit(feeds, timeout=timeout, deadline=deadline)
+        return fut.result(max(0.0, t_end - time.monotonic()))
 
     # --- batcher -------------------------------------------------------
 
@@ -274,12 +314,14 @@ class PredictorPool:
                 if not self._queue and self._closed:
                     return
                 head = self._queue.popleft()
+                head.future.trace.stage("batch_join")
                 batch, rows = [head], head.rows
                 deadline = time.monotonic() + self.batch_timeout_s
                 while rows < self.max_batch and not self._closed:
                     nxt = self._take_compatible_locked(
                         head.sig, self.max_batch - rows)
                     if nxt is not None:
+                        nxt.future.trace.stage("batch_join")
                         batch.append(nxt)
                         rows += nxt.rows
                         continue
@@ -297,10 +339,12 @@ class PredictorPool:
             self._execute(batch, rows)
 
     def _execute(self, batch: List[_Request], rows: int) -> None:
-        t0 = time.perf_counter()
+        t0 = time.monotonic()
         for r in batch:
             timer_observe("TIMER_serving_queue_wait_us",
                           (t0 - r.future.t_submit) * 1e6)
+        tids = ",".join(r.future.trace.trace_id for r in batch
+                        if r.future.trace.trace_id)
         try:
             if len(batch) == 1:
                 feeds: List[Any] = list(batch[0].feeds)
@@ -308,14 +352,21 @@ class PredictorPool:
                 feeds = [np.concatenate([r.feeds[i] for r in batch],
                                         axis=0)
                          for i in range(len(batch[0].feeds))]
+            for r in batch:
+                r.future.trace.stage("dispatch")
             t_exec = time.perf_counter()
             # span for trace correlation only; the timer is observed
             # directly so the latency histogram (the serving SLO) is
-            # populated even with FLAGS_telemetry off
-            with _tm.span("serving/batch", track="serving"):
-                outs = self.predictor.run(feeds)
+            # populated even with FLAGS_telemetry off. trace_scope
+            # stamps the batch's trace ids into the span (and any
+            # FetchHandle sync underneath it).
+            with _tm.trace_scope(tids):
+                with _tm.span("serving/batch", track="serving"):
+                    outs = self.predictor.run(feeds)
             timer_observe("TIMER_serving_batch_us",
                           (time.perf_counter() - t_exec) * 1e6)
+            for r in batch:
+                r.future.trace.stage("execute")
             outs = [np.asarray(o) for o in outs]
             stat_add("STAT_serving_batches")
             stat_add("STAT_serving_batched_rows", rows)
@@ -325,6 +376,10 @@ class PredictorPool:
             for r in batch:
                 # per-row outputs demux by offset; non-batch outputs
                 # (e.g. a fetched weight) are shared by every request
+                r.future.trace.stage("fetch")
+                # finish BEFORE releasing the future: a client thread
+                # returning from result() must find a completed trace
+                r.future.trace.finish()
                 r.future._set([o[off:off + r.rows]
                                if o.ndim and o.shape[0] == rows else o
                                for o in outs])
@@ -332,6 +387,7 @@ class PredictorPool:
         except Exception as e:
             stat_add("STAT_serving_batch_errors")
             if len(batch) == 1:
+                batch[0].future.trace.finish(error=e)
                 batch[0].future._set_error(e)
                 return
             # Error isolation: one malformed request must not fail its
@@ -346,10 +402,17 @@ class PredictorPool:
             # retries). Retries run on the batcher thread, so they also
             # serialize BEFORE any later batch executes.
             for r in batch:
+                tr = r.future.trace
+                tr.event("retry", batch_rows=rows)
                 try:
-                    outs = self.predictor.run(list(r.feeds))
+                    with _tm.trace_scope(tr.trace_id):
+                        outs = self.predictor.run(list(r.feeds))
+                    tr.stage("execute")
+                    tr.stage("fetch")
+                    tr.finish()
                     r.future._set([np.asarray(o) for o in outs])
                 except Exception as e2:
+                    tr.finish(error=e2)
                     r.future._set_error(e2)
 
 

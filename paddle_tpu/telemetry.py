@@ -39,9 +39,9 @@ from . import monitor, profiler
 from .flags import get_flag
 
 __all__ = ["enabled", "span", "step_scope", "current_step",
-           "counter_sample", "flight_begin", "flight_note",
-           "flight_records", "flight_dump", "flight_reset",
-           "attach_flight"]
+           "trace_scope", "current_trace", "counter_sample",
+           "flight_begin", "flight_note", "flight_records",
+           "flight_dump", "flight_reset", "attach_flight"]
 
 _tls = threading.local()
 
@@ -86,6 +86,42 @@ def current_step() -> Optional[int]:
 
 
 # ---------------------------------------------------------------------------
+# trace scope: thread-local request-trace id(s)
+# ---------------------------------------------------------------------------
+# The request-tracing analog of step_scope (tracing.py owns the traces;
+# this lives here so tracing can depend on telemetry without a cycle).
+# The serving batcher / generation engine binds the batch's trace ids
+# around execution; every span and FetchHandle created inside inherits
+# them, so chrome-trace lanes and flight notes carry "which requests".
+
+class _TraceScope:
+    __slots__ = ("_tid", "_prev")
+
+    def __init__(self, tid: str):
+        self._tid = tid
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "trace", None)
+        _tls.trace = self._tid
+        return self
+
+    def __exit__(self, *exc):
+        _tls.trace = self._prev
+        return False
+
+
+def trace_scope(tid: Optional[str]):
+    """Bind `tid` (a trace id, or comma-joined ids for a coalesced
+    batch) as the thread's current request trace. Falsy tid — tracing
+    disabled, no real ids in the batch — is the shared no-op."""
+    return _TraceScope(tid) if tid else _NOOP
+
+
+def current_trace() -> Optional[str]:
+    return getattr(_tls, "trace", None)
+
+
+# ---------------------------------------------------------------------------
 # spans
 # ---------------------------------------------------------------------------
 
@@ -103,15 +139,18 @@ _NOOP = _NoopSpan()
 
 
 class _Span:
-    __slots__ = ("name", "step", "track", "cat", "timer", "trace", "_t0")
+    __slots__ = ("name", "step", "track", "cat", "timer", "trace",
+                 "tid", "args", "_t0")
 
-    def __init__(self, name, step, track, cat, timer, trace):
+    def __init__(self, name, step, track, cat, timer, trace, tid, args):
         self.name = name
         self.step = step
         self.track = track
         self.cat = cat
         self.timer = timer
         self.trace = trace
+        self.tid = tid
+        self.args = args
 
     def __enter__(self):
         self._t0 = now_us()
@@ -121,9 +160,13 @@ class _Span:
         t1 = now_us()
         dur = t1 - self._t0
         if self.trace:
+            args = self.args
+            if self.tid is not None:
+                args = dict(args) if args else {}
+                args["trace"] = self.tid
             profiler.add_trace_event(self.name, self._t0, dur,
                                      cat=self.cat, track=self.track,
-                                     step=self.step)
+                                     step=self.step, args=args)
         if self.timer:
             monitor.timer_observe(self.timer, dur)
         return False
@@ -131,17 +174,22 @@ class _Span:
 
 def span(name: str, *, step: Optional[int] = None,
          track: Optional[str] = None, cat: str = "telemetry",
-         timer: Optional[str] = None, trace: bool = True):
+         timer: Optional[str] = None, trace: bool = True,
+         args: Optional[Dict[str, Any]] = None):
     """Context manager timing one region. No-op (shared object, no
     allocation) when telemetry is off. `step=None` inherits the
-    thread's step_scope. `timer` additionally records the duration in
-    the named monitor histogram; `trace=False` keeps high-frequency
-    timers out of the chrome timeline (aggregate-only)."""
+    thread's step_scope; the thread's trace_scope ids (if any) land in
+    the event's args.trace, correlating chrome-trace lanes with
+    /tracez. `timer` additionally records the duration in the named
+    monitor histogram; `trace=False` keeps high-frequency timers out of
+    the chrome timeline (aggregate-only); `args` adds extra chrome-
+    trace event args."""
     if not enabled():
         return _NOOP
     if step is None:
         step = current_step()
-    return _Span(name, step, track, cat, timer, trace)
+    return _Span(name, step, track, cat, timer, trace,
+                 current_trace(), args)
 
 
 def counter_sample(name: str, value: Optional[float] = None) -> None:

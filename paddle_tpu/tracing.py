@@ -1,0 +1,478 @@
+"""Request-lifecycle tracing: where did THIS request's time go?
+
+PR 3/7 built the aggregate half of observability — every counter,
+timer, and chrome-trace lane describes the process. This module is the
+per-request half, the tracing subsystem the TensorFlow lineage treats
+as first-class (arXiv:1605.08695 §5) and the metric set TPU serving
+deployments are actually judged on (TTFT / TPOT / queue-wait
+decomposition — the Gemma-on-TPU serving comparison in PAPERS.md):
+
+- :class:`RequestTrace` — a process-unique trace id plus monotonic
+  stage timestamps (serving: submit → admit → batch_join → dispatch →
+  execute → fetch → done; generation: submit → admit → prefill_start →
+  first_token → done, with per-decode-token deltas and
+  preemption/replay events). Created by ``begin(kind)`` at the pool
+  front door (serving.PredictorPool.submit / GenerationPool.submit /
+  GenerationEngine.submit) and carried on the request through every
+  layer; telemetry spans executed under :func:`telemetry.trace_scope`
+  carry the id into the chrome trace, and errored requests land in the
+  flight recorder keyed ``req:<trace_id>``.
+- latency-decomposition timers — ``finish()`` observes one monitor
+  histogram per stage interval (TIMER_serving_admit_us /
+  _batch_join_us / _dispatch_us / _execute_us / _fetch_us / _total_us,
+  TIMER_generation_queue_wait_us / _ttft_us / _tpot_us / _decode_us /
+  _total_us), so /metrics exports the same decomposition /tracez shows
+  per exemplar.
+- deadlines — ``begin(kind, deadline=seconds)`` arms a latency budget:
+  ``finish()`` bumps STAT_<kind>_deadline_missed when the budget is
+  blown and accumulates per-stage budget burn into
+  STAT_<kind>_budget_<stage>_us counters (where deadlined traffic
+  spends its budget is the signal SLO-aware scheduling needs).
+- exemplar ring — a bounded registry keeping the N slowest plus every
+  errored/deadline-missed request with full timeline, events, and a
+  flight-recorder slice. Per-exemplar gauges
+  (GAUGE_trace_exemplar_us_<id>) are retracted on eviction, like
+  core/program_accounting.py's registry bound.
+- ``/tracez`` (introspect.py) — recent completions + exemplars +
+  rolling TTFT/TPOT, text or ``?format=json``.
+
+Gate: ``FLAGS_request_tracing`` (default ON — tracing is how serving
+explains itself; bench.py measures the enabled overhead under 1% on
+the serving workload). The disabled path is ONE flag lookup:
+``begin()`` returns the shared :data:`NOOP_TRACE`, whose methods are
+no-ops, so threaded code never branches and never re-reads the flag.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import itertools
+
+from .flags import get_flag
+from .monitor import (gauge_set, observe_many, stat_add, timer_get,
+                      timer_observe)
+
+__all__ = ["RequestTrace", "NOOP_TRACE", "begin", "recent", "exemplars",
+           "tracez", "tracez_text", "reset"]
+
+_LOCK = threading.Lock()
+# trace ids without a lock: next() on itertools.count is atomic in
+# CPython, and begin() sits on the request hot path
+_NEXT_ID = itertools.count(1)
+
+# recently completed traces (summaries), newest last — the /tracez
+# "recent" table. Bounded; the exemplar ring below is what keeps the
+# interesting ones beyond this horizon.
+_RECENT_CAP = 128
+_RECENT: deque = deque(maxlen=_RECENT_CAP)
+
+# exemplar ring: trace_id -> full record. Bounded by
+# FLAGS_tracing_exemplars; eviction retracts the exemplar's gauge.
+_EXEMPLARS: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+
+# cached admission floor: the smallest total_us among kept CLEAN
+# exemplars, or None when it must be rescanned. Keeps the steady-state
+# finish() path (ring full, request faster than every kept exemplar) at
+# one float comparison instead of an O(cap) scan per request.
+_CLEAN_FLOOR: List[Optional[float]] = [None]
+
+# stage-interval decomposition per kind: (label, from_stage, to_stage).
+# finish() observes TIMER_<kind>_<label>_us for every interval whose
+# stages both happened (retries/replays use the LAST occurrence), and
+# mirrors the same intervals into STAT_<kind>_budget_<label>_us
+# counters for deadline-armed traces. TTFT/TPOT are observed inline by
+# token() — sampling them at finish would misdate a long decode.
+_DECOMP: Dict[str, Tuple[Tuple[str, str, str], ...]] = {
+    "serving": (
+        ("admit", "submit", "admit"),
+        ("batch_join", "admit", "batch_join"),
+        ("dispatch", "batch_join", "dispatch"),
+        ("execute", "dispatch", "execute"),
+        ("fetch", "execute", "fetch"),
+        ("total", "submit", "done"),
+    ),
+    "generation": (
+        ("queue_wait", "submit", "prefill_start"),
+        ("decode", "first_token", "done"),
+        ("total", "submit", "done"),
+    ),
+}
+
+# instrument names are precomputed per kind — finish() runs once per
+# request and should not pay %-formatting for every interval
+_DECOMP_NAMES: Dict[str, Tuple[Tuple[str, str, str, str], ...]] = {
+    kind: tuple(("TIMER_%s_%s_us" % (kind, label),
+                 "STAT_%s_budget_%s_us" % (kind, label), frm, to)
+                for label, frm, to in rows)
+    for kind, rows in _DECOMP.items()
+}
+_TTFT_TIMER = {k: "TIMER_%s_ttft_us" % k for k in _DECOMP}
+_TPOT_TIMER = {k: "TIMER_%s_tpot_us" % k for k in _DECOMP}
+
+
+class _NoopTrace:
+    """Shared do-nothing trace: what ``begin()`` returns with
+    FLAGS_request_tracing off. Callers thread it exactly like a real
+    trace — no None-guards, no second flag lookup anywhere."""
+
+    __slots__ = ()
+    trace_id = None
+    deadline_s = None
+
+    def stage(self, name: str) -> None:
+        pass
+
+    def event(self, name: str, **fields: Any) -> None:
+        pass
+
+    def token(self) -> None:
+        pass
+
+    def note(self, **fields: Any) -> None:
+        pass
+
+    def finish(self, error: Optional[BaseException] = None,
+               **fields: Any) -> None:
+        pass
+
+    def last_stage(self) -> Optional[str]:
+        return None
+
+
+NOOP_TRACE = _NoopTrace()
+
+
+class RequestTrace:
+    """One request's lifecycle: monotonic stage timestamps, token
+    timing, events, and an optional latency budget. NOT thread-safe by
+    itself — the pools hand a request (and its trace) between threads
+    through locked queues, so every touch is ordered by a
+    happens-before edge already."""
+
+    __slots__ = ("trace_id", "kind", "t0", "deadline_s", "stages",
+                 "events", "tokens", "t_first_token", "t_last_token",
+                 "fields", "error", "_done", "_total_us", "_missed")
+
+    def __init__(self, trace_id: str, kind: str,
+                 deadline: Optional[float] = None):
+        now = time.monotonic()
+        self.trace_id = trace_id
+        self.kind = kind
+        self.t0 = now
+        self.deadline_s = None if deadline is None else float(deadline)
+        self.stages: List[Tuple[str, float]] = [("submit", now)]
+        self.events: List[Dict[str, Any]] = []
+        self.tokens = 0
+        self.t_first_token: Optional[float] = None
+        self.t_last_token: Optional[float] = None
+        self.fields: Dict[str, Any] = {}
+        self.error: Optional[str] = None
+        self._done = False
+        self._total_us = 0.0
+        self._missed = False
+
+    # --- recording ----------------------------------------------------
+
+    def stage(self, name: str) -> None:
+        """Timestamp one lifecycle stage (monotonic clock — the same
+        clock every deadline computation uses)."""
+        self.stages.append((name, time.monotonic()))
+
+    def event(self, name: str, **fields: Any) -> None:
+        """Record a non-stage occurrence (preempt, replay, retry)."""
+        e = {"name": name, "t_us": (time.monotonic() - self.t0) * 1e6}
+        e.update(fields)
+        self.events.append(e)
+
+    def token(self) -> None:
+        """One generated token: the first records the ``first_token``
+        stage and TTFT; every later one records a TPOT delta."""
+        now = time.monotonic()
+        self.tokens += 1
+        if self.t_first_token is None:
+            self.t_first_token = now
+            self.stages.append(("first_token", now))
+            timer_observe(_TTFT_TIMER.get(self.kind)
+                          or "TIMER_%s_ttft_us" % self.kind,
+                          (now - self.t0) * 1e6)
+        else:
+            timer_observe(_TPOT_TIMER.get(self.kind)
+                          or "TIMER_%s_tpot_us" % self.kind,
+                          (now - self.t_last_token) * 1e6)
+        self.t_last_token = now
+
+    def note(self, **fields: Any) -> None:
+        """Attach free-form metadata (rows, finish_reason, ...)."""
+        self.fields.update(fields)
+
+    def last_stage(self) -> Optional[str]:
+        return self.stages[-1][0] if self.stages else None
+
+    # --- completion ---------------------------------------------------
+
+    def finish(self, error: Optional[BaseException] = None,
+               **fields: Any) -> None:
+        """Close the trace (idempotent): records ``done``, observes the
+        per-stage decomposition timers, burns the deadline budget, and
+        files the trace into the recent + exemplar rings."""
+        if self._done:
+            return
+        self._done = True
+        if fields:
+            self.fields.update(fields)
+        if error is not None:
+            self.error = repr(error)
+        now = time.monotonic()
+        if self.stages[-1][0] != "done":
+            self.stages.append(("done", now))
+        total_us = (self.stages[-1][1] - self.t0) * 1e6
+        # one batched monitor flush below: the whole decomposition plus
+        # the completion counters go in under a single registry lock
+        timers: List[Tuple[str, float]] = []
+        stats: List[Tuple[str, float]] = [("STAT_trace_completed", 1.0)]
+        # monotonic-ordering audit: stage appends are ordered by the
+        # pool locks, so a violation means a real threading bug
+        ts = [t for _, t in self.stages]
+        if any(b < a for a, b in zip(ts, ts[1:])):
+            stats.append(("STAT_trace_nonmonotonic", 1.0))
+        # last-occurrence stage index (retries/replays re-stamp)
+        at = {name: t for name, t in self.stages}
+        deadlined = self.deadline_s is not None
+        for timer, budget, frm, to in _DECOMP_NAMES.get(self.kind, ()):
+            if frm in at and to in at and at[to] >= at[frm]:
+                dur_us = (at[to] - at[frm]) * 1e6
+                timers.append((timer, dur_us))
+                if deadlined:
+                    stats.append((budget, dur_us))
+        self._total_us = total_us
+        self._missed = deadlined and (now - self.t0) > self.deadline_s
+        if self._missed:
+            stats.append(("STAT_%s_deadline_missed" % self.kind, 1.0))
+        if self.error is not None:
+            stats.append(("STAT_trace_errored", 1.0))
+        observe_many(timers, stats)
+        if self.error is not None:
+            # errored requests join the flight recorder keyed by trace
+            # id, so /flightz and exception notes can correlate them
+            from . import telemetry
+            telemetry.flight_begin("req:%s" % self.trace_id,
+                                   kind=self.kind, error=self.error,
+                                   total_us=round(total_us, 1))
+        _file(self)
+
+    def _record(self) -> Dict[str, Any]:
+        """Build the display/JSON record. Deliberately NOT called on
+        the finish() hot path — the rings keep the trace object and
+        format lazily when /tracez or recent() actually reads it."""
+        rec = {
+            "trace_id": self.trace_id,
+            "kind": self.kind,
+            "total_us": round(self._total_us, 1),
+            "stages": [(name, round((t - self.t0) * 1e6, 1))
+                       for name, t in self.stages],
+            "error": self.error,
+        }
+        if self.events:
+            rec["events"] = list(self.events)
+        if self.tokens:
+            rec["tokens"] = self.tokens
+            if self.t_first_token is not None:
+                rec["ttft_us"] = round(
+                    (self.t_first_token - self.t0) * 1e6, 1)
+        if self.deadline_s is not None:
+            rec["deadline_us"] = round(self.deadline_s * 1e6, 1)
+            rec["deadline_missed"] = self._missed
+        if self.fields:
+            rec["fields"] = dict(self.fields)
+        return rec
+
+
+def begin(kind: str, deadline: Optional[float] = None):
+    """Open a trace for one request. THE disabled fast path: exactly
+    one flag lookup, returning the shared no-op trace. ``deadline`` is
+    a latency budget in seconds from now (monotonic)."""
+    if not get_flag("FLAGS_request_tracing"):
+        return NOOP_TRACE
+    return RequestTrace("t%06d" % next(_NEXT_ID), kind,
+                        deadline=deadline)
+
+
+# ---------------------------------------------------------------------------
+# rings: recent completions + slow/errored exemplars
+# ---------------------------------------------------------------------------
+
+def _exemplar_cap() -> int:
+    try:
+        return max(1, int(get_flag("FLAGS_tracing_exemplars", 32) or 32))
+    except (TypeError, ValueError):
+        return 32
+
+
+def _file(tr: RequestTrace) -> None:
+    """File one finished trace: always into the recent ring; into the
+    exemplar ring when errored/deadline-missed or while it ranks among
+    the slowest. Eviction drops the fastest clean exemplar first
+    (errored ones persist until only errored remain, then oldest-first)
+    and retracts its gauge — totals stay honest, like
+    program_accounting."""
+    cap = _exemplar_cap()
+    with _LOCK:
+        _RECENT.append(tr)
+        interesting = tr.error is not None or tr._missed
+        if not interesting and len(_EXEMPLARS) >= cap:
+            if _CLEAN_FLOOR[0] is None:
+                clean = [r["total_us"] for r in _EXEMPLARS.values()
+                         if r["error"] is None
+                         and not r.get("deadline_missed")]
+                _CLEAN_FLOOR[0] = min(clean) if clean else -1.0
+            if 0.0 <= _CLEAN_FLOOR[0] and \
+                    _CLEAN_FLOOR[0] >= tr._total_us:
+                return  # faster than every kept clean exemplar
+        rec = tr._record()  # admitted: now pay for the full record
+        if interesting:
+            # a flight-recorder slice makes the exemplar
+            # self-contained even after the deque scrolls
+            from . import telemetry
+            rec["flight"] = telemetry.flight_records()[-6:]
+        _EXEMPLARS[rec["trace_id"]] = rec
+        _CLEAN_FLOOR[0] = None  # membership changed: rescan lazily
+        gauge_set("GAUGE_trace_exemplar_us_%s" % rec["trace_id"],
+                  rec["total_us"])
+        while len(_EXEMPLARS) > cap:
+            _evict_locked()
+        gauge_set("GAUGE_tracing_exemplars", float(len(_EXEMPLARS)))
+
+
+def _evict_locked() -> None:
+    victim = None
+    for tid, r in _EXEMPLARS.items():
+        if r["error"] is None and not r.get("deadline_missed"):
+            if victim is None or r["total_us"] \
+                    < _EXEMPLARS[victim]["total_us"]:
+                victim = tid
+    if victim is None:  # all errored: oldest goes
+        victim = next(iter(_EXEMPLARS))
+    _EXEMPLARS.pop(victim)
+    _CLEAN_FLOOR[0] = None
+    from .monitor import _GAUGES, _LOCK as _MLOCK
+    with _MLOCK:
+        _GAUGES.pop("GAUGE_trace_exemplar_us_%s" % victim, None)
+    stat_add("STAT_tracing_exemplar_evict")
+
+
+def recent() -> List[Dict[str, Any]]:
+    """Recently completed traces, newest last (records are built here,
+    lazily — the ring stores the trace objects)."""
+    with _LOCK:
+        return [t._record() for t in _RECENT]
+
+
+def exemplars() -> List[Dict[str, Any]]:
+    """The kept slow/errored exemplars, oldest first."""
+    with _LOCK:
+        return [dict(r) for r in _EXEMPLARS.values()]
+
+
+def reset() -> None:
+    """Clear both rings and retract exemplar gauges (test/bench
+    isolation). Monitor counters/timers are left alone — use
+    monitor.reset_all for those."""
+    with _LOCK:
+        _RECENT.clear()
+        from .monitor import _GAUGES, _LOCK as _MLOCK
+        with _MLOCK:
+            for tid in _EXEMPLARS:
+                _GAUGES.pop("GAUGE_trace_exemplar_us_%s" % tid, None)
+            _GAUGES.pop("GAUGE_tracing_exemplars", None)
+        _EXEMPLARS.clear()
+        _CLEAN_FLOOR[0] = None
+
+
+# ---------------------------------------------------------------------------
+# /tracez payloads (introspect.py serves these)
+# ---------------------------------------------------------------------------
+
+_ROLLING = (
+    ("serving_queue_wait", "TIMER_serving_queue_wait_us"),
+    ("serving_execute", "TIMER_serving_execute_us"),
+    ("serving_total", "TIMER_serving_total_us"),
+    ("generation_ttft", "TIMER_generation_ttft_us"),
+    ("generation_tpot", "TIMER_generation_tpot_us"),
+    ("generation_total", "TIMER_generation_total_us"),
+)
+
+
+def rolling() -> Dict[str, Dict[str, float]]:
+    """Rolling latency summary (us) from the decomposition timers —
+    only families that have samples appear."""
+    out = {}
+    for label, timer in _ROLLING:
+        st = timer_get(timer)
+        if st["count"]:
+            out[label] = {"count": st["count"], "p50": st["p50"],
+                          "p95": st["p95"], "max": st["max"]}
+    return out
+
+
+def tracez() -> Dict[str, Any]:
+    """The ``/tracez?format=json`` payload."""
+    return {
+        "enabled": bool(get_flag("FLAGS_request_tracing")),
+        "rolling_us": rolling(),
+        "recent": recent(),
+        "exemplars": exemplars(),
+    }
+
+
+def _fmt_trace(rec: Dict[str, Any], verbose: bool) -> List[str]:
+    head = "%s %-10s total=%.0fus" % (rec["trace_id"], rec["kind"],
+                                      rec["total_us"])
+    if rec.get("tokens"):
+        head += " tokens=%d" % rec["tokens"]
+        if "ttft_us" in rec:
+            head += " ttft=%.0fus" % rec["ttft_us"]
+    if rec.get("deadline_missed"):
+        head += " DEADLINE_MISSED(budget=%.0fus)" % rec["deadline_us"]
+    if rec["error"] is not None:
+        head += " ERROR %s" % rec["error"]
+    if not verbose:
+        return [head + "  stages: " + " ".join(
+            "%s+%.0f" % (n, t) for n, t in rec["stages"])]
+    lines = [head]
+    lines.extend("    %-14s +%.0fus" % (n, t) for n, t in rec["stages"])
+    for e in rec.get("events", ()):
+        extra = " ".join("%s=%s" % (k, v) for k, v in sorted(e.items())
+                         if k not in ("name", "t_us"))
+        lines.append("    event %-8s +%.0fus %s"
+                     % (e["name"], e["t_us"], extra))
+    return lines
+
+
+def tracez_text() -> str:
+    """The human ``/tracez`` page: rolling decomposition, the recent
+    tail, and every exemplar with its full timeline."""
+    snap = tracez()
+    lines = ["request traces (FLAGS_request_tracing=%s)"
+             % ("on" if snap["enabled"] else "off"), ""]
+    lines.append("rolling latency (us):")
+    if snap["rolling_us"]:
+        for label, st in sorted(snap["rolling_us"].items()):
+            lines.append("  %-22s n=%-6d p50=%-10.0f p95=%-10.0f "
+                         "max=%.0f" % (label, st["count"], st["p50"],
+                                       st["p95"], st["max"]))
+    else:
+        lines.append("  (no samples yet)")
+    lines.append("")
+    lines.append("recent (last %d of cap %d, newest last):"
+                 % (len(snap["recent"]), _RECENT_CAP))
+    for rec in snap["recent"][-32:]:
+        lines.extend("  " + ln for ln in _fmt_trace(rec, verbose=False))
+    lines.append("")
+    lines.append("exemplars (slowest + errored, cap %d):"
+                 % _exemplar_cap())
+    for rec in snap["exemplars"]:
+        lines.extend("  " + ln for ln in _fmt_trace(rec, verbose=True))
+    return "\n".join(lines)

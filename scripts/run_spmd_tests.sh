@@ -73,14 +73,15 @@ with use_plan(plan):
                     axis="dp")
     dist.all_reduce(np.ones((4,), np.float32), axis="mp")
 
-# introspection smoke (PR 7): start the server on an ephemeral port,
-# scrape /metrics and /statusz from a real HTTP client, assert every
-# paddle_tpu_* family parses with a # TYPE line, stop. Proves the
-# serving surface works in exactly the multichip environment the rest
-# of this artifact documents.
+# introspection smoke (PR 7, /tracez added in PR 8): start the server
+# on an ephemeral port, scrape /metrics, /statusz and /tracez (text +
+# JSON) from a real HTTP client, assert every paddle_tpu_* family
+# parses with a # TYPE line, stop. Proves the serving surface works in
+# exactly the multichip environment the rest of this artifact
+# documents.
 import re
 import urllib.request
-from paddle_tpu import introspect
+from paddle_tpu import introspect, tracing
 
 from paddle_tpu.mesh.plan import install_plan
 
@@ -89,6 +90,11 @@ try:
     # the server thread reads the PROCESS-GLOBAL plan (use_plan above
     # is thread-local and already exited) — install for the scrape
     install_plan(plan)
+    # complete one traced request lifecycle under the mesh so the
+    # /tracez scrape below exercises a real record, not an empty ring
+    _tr = tracing.begin("serving")
+    _tr.stage("admit")
+    _tr.finish()
     srv = introspect.start(port=0)
     body = urllib.request.urlopen(srv.url + "/metrics",
                                   timeout=10).read().decode()
@@ -100,12 +106,23 @@ try:
                      for ln in body.splitlines() if ln)
     statusz = json.load(urllib.request.urlopen(srv.url + "/statusz",
                                                timeout=10))
+    tracez_text = urllib.request.urlopen(srv.url + "/tracez",
+                                         timeout=10).read().decode()
+    tracez = json.load(urllib.request.urlopen(
+        srv.url + "/tracez?format=json", timeout=10))
     intro = {
         "ok": bool(fams) and samples_ok
-        and statusz["mesh"]["active"] is True,
+        and statusz["mesh"]["active"] is True
+        and tracez["enabled"] is True
+        and any(r["trace_id"] == _tr.trace_id
+                for r in tracez["recent"])
+        and _tr.trace_id in tracez_text,
         "metric_families": len(fams),
         "samples_parse": samples_ok,
         "statusz_mesh": statusz["mesh"],
+        "statusz_tracing": statusz.get("tracing"),
+        "tracez_recent": len(tracez["recent"]),
+        "tracez_rolling_families": sorted(tracez["rolling_us"]),
     }
 except Exception as e:  # noqa: BLE001 - artifact records the failure
     intro["error"] = "%s: %s" % (type(e).__name__, e)

@@ -315,3 +315,91 @@ def test_serialized_bucket_env_disable(model_dir, tmp_path, monkeypatch):
     assert _bucket_ladder() == [1, 2, 4]
     monkeypatch.delenv("PADDLE_TPU_SHAPE_BUCKETS")
     assert _bucket_ladder() == [2 ** i for i in range(8)]
+
+
+# ---------------------------------------------------------------------------
+# run() timeout budget (regression: timeout was double-spent)
+# ---------------------------------------------------------------------------
+
+def test_run_timeout_is_one_shared_budget(model_dir):
+    """run(timeout=T) used to hand T to submit() AND result(), so a
+    request that spent 0.4s blocked on a full queue still got the full
+    T to wait for a result — a 1s budget could block ~1.4s. With the
+    serve loop stalled (never started), total wall time must stay ~T."""
+    import time
+    pool = serving.PredictorPool(Config(model_dir), queue_depth=1,
+                                 _start=False)
+    try:
+        pool.submit(_reqs([1]))  # fill the queue: next submit blocks
+
+        def free_slot_later():
+            time.sleep(0.4)
+            with pool._lock:
+                pool._queue.popleft()
+                pool._not_full.notify_all()
+
+        t = threading.Thread(target=free_slot_later)
+        t.start()
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            pool.run(_reqs([1]), timeout=1.0)
+        elapsed = time.monotonic() - t0
+        t.join()
+        # submit consumed ~0.4s of the budget; result() must only get
+        # the remainder. The double-spend bug made this ~1.4s.
+        assert 0.85 <= elapsed <= 1.3, elapsed
+    finally:
+        pool.close()
+
+
+def test_future_timeout_reports_elapsed_and_stage(model_dir):
+    """A timed-out result() says how long it actually waited and the
+    last lifecycle stage the request reached — and t_submit is on the
+    monotonic clock (it was perf_counter, a different epoch than every
+    deadline computation)."""
+    import time
+    pool = serving.PredictorPool(Config(model_dir), _start=False)
+    try:
+        fut = pool.submit(_reqs([2]))
+        assert abs(fut.t_submit - time.monotonic()) < 5.0
+        with pytest.raises(TimeoutError) as ei:
+            fut.result(timeout=0.05)
+        msg = str(ei.value)
+        assert "elapsed" in msg
+        assert "last completed stage: admit" in msg
+    finally:
+        pool.close()
+
+
+def test_generation_run_timeout_is_one_shared_budget():
+    """GenerationPool.run had the identical double-spend; same stalled
+    serve-loop setup through the generation front door."""
+    import time
+    from paddle_tpu.generation import (DecoderConfig, GenerationEngine,
+                                       GenerationRequest, init_params)
+    from paddle_tpu.generation.scheduler import GenerationPool
+    cfg = DecoderConfig(vocab_size=64, hidden=32, layers=2, heads=4,
+                        max_seq_len=32)
+    eng = GenerationEngine(cfg, init_params(cfg, seed=0), num_blocks=16,
+                           block_size=4, decode_width=2)
+    pool = GenerationPool(eng, queue_depth=1, _start=False)
+    try:
+        pool.submit(GenerationRequest(prompt=[1, 2], max_new_tokens=2))
+
+        def free_slot_later():
+            time.sleep(0.4)
+            with pool._lock:
+                pool._queue.popleft()
+                pool._not_full.notify_all()
+
+        t = threading.Thread(target=free_slot_later)
+        t.start()
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            pool.run(GenerationRequest(prompt=[3, 4], max_new_tokens=2),
+                     timeout=1.0)
+        elapsed = time.monotonic() - t0
+        t.join()
+        assert 0.85 <= elapsed <= 1.3, elapsed
+    finally:
+        pool.close()
