@@ -512,6 +512,8 @@ class Executor:
         if get_flag("FLAGS_enable_unused_var_check"):
             self._warn_unused_vars(program, fetch_names)
 
+        from ..failpoints import failpoint
+        failpoint("executor.dispatch")
         with _tm.span("executor/dispatch", track="dispatch",
                       timer="TIMER_executor_dispatch_us"):
             fetches, new_state, new_rng = fn(state, feed, rng)
@@ -566,6 +568,7 @@ class Executor:
             if any(isinstance(v, jax.Array) for v in fetches):
                 stat_add("STAT_executor_sync")
                 _tm.flight_note(_tm.current_step(), "sync_count", add=1)
+            failpoint("executor.fetch")
             with _tm.span("executor/fetch_sync", track="sync",
                           timer="TIMER_executor_sync_us"):
                 fetches = [np.asarray(v) for v in fetches]

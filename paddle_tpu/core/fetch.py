@@ -105,6 +105,8 @@ class FetchHandle:
         if self._host is None:
             from ..monitor import stat_add
             stat_add("STAT_executor_sync")
+            from ..failpoints import failpoint
+            failpoint("executor.fetch")
             from .. import telemetry as _tm
             with _tm.trace_scope(self._trace), \
                     _tm.span("fetch/sync", step=self._step,

@@ -179,6 +179,11 @@ def load_trace(cache_dir: str, fingerprint: str) -> Optional[bytes]:
     except OSError:
         _stat_add("STAT_program_cache_trace_miss")
         return None
+    from ..failpoints import failpoint
+    # corrupt/truncate injection lands BEFORE validation: the header +
+    # payload checks below must catch the damage and self-heal (discard
+    # + fresh export), which is exactly what the chaos tests prove
+    blob = failpoint("program_cache.load", blob)
     try:
         if not blob.startswith(MAGIC):
             raise ValueError("bad magic")
@@ -210,6 +215,8 @@ def store_trace(cache_dir: str, fingerprint: str, payload: bytes) -> bool:
     failure disables nothing — it just means no cache this time."""
     path = _trace_path(cache_dir, fingerprint)
     blob = MAGIC + _header_bytes(fingerprint) + payload
+    from ..failpoints import failpoint
+    blob = failpoint("program_cache.store", blob)
     try:
         with _timed("TIMER_program_cache_store_us"):
             os.makedirs(os.path.dirname(path), exist_ok=True)

@@ -1,0 +1,337 @@
+"""Named failpoints: deterministic fault injection for robustness tests.
+
+Every hardened path in the stack declares a *failpoint site* — a named
+hook such as ``serving.execute`` or ``program_cache.load`` — by calling
+:func:`failpoint` inline.  When the site is disarmed (the default, and
+the only state production ever sees) the call is a **single dict
+lookup** that returns its payload untouched; the same zero-overhead
+contract as ``tracing.begin`` (one flag lookup when tracing is off),
+pinned by a test the same way.
+
+Arming a site attaches an *action* (what to inject) gated by a
+*trigger* (when to inject it):
+
+    actions   raise[(msg)]      raise InjectedFault at the site
+              delay(ms)         sleep ms milliseconds, then pass through
+              corrupt[(n)]      flip n bytes of a bytes payload (default 8)
+              truncate[(n)]     keep only the first n bytes (default half)
+
+    triggers  always            every call (default)
+              once              first call only, then auto-disarm
+              every(N)          calls N, 2N, 3N, ...
+              after(N)          every call after the first N
+              prob(p,seed)      Bernoulli(p) from an explicit seeded PRNG
+
+Sites are armed from a spec string — clauses ``site=action@trigger``
+joined by ``;``::
+
+    serving.execute=raise@once
+    generation.decode=raise@after(3);program_cache.load=corrupt@every(2)
+    executor.dispatch=delay(5)@prob(0.5,7)
+
+via (in precedence order) the ``/failpointz`` HTTP endpoint (POST),
+``set_flags({"FLAGS_failpoints": spec})``, the ``PADDLE_TPU_FAILPOINTS``
+environment variable (read once at import), or programmatically with
+:func:`arm` / :func:`arm_spec` / the :func:`armed` context manager.
+
+Hit counts (calls seen while armed / faults actually fired) are kept
+per site and survive disarming, so a chaos harness can arm, drive load,
+disarm, and then assert the counts via GET ``/failpointz``.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "InjectedFault",
+    "failpoint",
+    "arm",
+    "arm_spec",
+    "disarm",
+    "armed",
+    "sites",
+    "reset_counts",
+    "KNOWN_SITES",
+]
+
+# Declared sites, kept in sync with the failpoint() call sites threaded
+# through the stack.  Arming an undeclared site is allowed (tests invent
+# private sites), but /failpointz always lists at least these.
+KNOWN_SITES: Tuple[str, ...] = (
+    "executor.dispatch",
+    "executor.fetch",
+    "program_cache.load",
+    "program_cache.store",
+    "serving.execute",
+    "generation.prefill",
+    "generation.decode",
+    "generation.kv_alloc",
+    "checkpoint.save",
+    "checkpoint.load",
+    "trainstep.step",
+)
+
+
+class InjectedFault(RuntimeError):
+    """The error a ``raise`` action injects; carries the site name."""
+
+    def __init__(self, site: str, msg: Optional[str] = None):
+        super().__init__(msg or "injected fault at %s" % site)
+        self.site = site
+
+
+class _Failpoint:
+    """One armed site: action + trigger + deterministic state."""
+
+    __slots__ = ("site", "action", "action_arg", "trigger", "trigger_arg",
+                 "spec", "_calls", "_rng", "_lock")
+
+    def __init__(self, site: str, action: str, action_arg: Any,
+                 trigger: str, trigger_arg: Any, spec: str):
+        self.site = site
+        self.action = action
+        self.action_arg = action_arg
+        self.trigger = trigger
+        self.trigger_arg = trigger_arg
+        self.spec = spec
+        self._calls = 0
+        self._rng = (random.Random(trigger_arg[1])
+                     if trigger == "prob" else None)
+        self._lock = threading.Lock()
+
+    def _should_fire(self) -> bool:
+        with self._lock:
+            self._calls += 1
+            n = self._calls
+            if self.trigger == "always":
+                return True
+            if self.trigger == "once":
+                return n == 1
+            if self.trigger == "every":
+                return n % self.trigger_arg == 0
+            if self.trigger == "after":
+                return n > self.trigger_arg
+            if self.trigger == "prob":
+                return self._rng.random() < self.trigger_arg[0]
+            return False
+
+    def __call__(self, payload: Any) -> Any:
+        _count(self.site, "calls")
+        fired = self._should_fire()
+        if self.trigger == "once" and self._calls >= 1:
+            # auto-disarm after the first call regardless of outcome so
+            # "once" never fires twice even under races
+            _ARMED.pop(self.site, None)
+        if not fired:
+            return payload
+        _count(self.site, "fires")
+        if self.action == "raise":
+            raise InjectedFault(self.site, self.action_arg)
+        if self.action == "delay":
+            time.sleep(self.action_arg / 1000.0)
+            return payload
+        if self.action == "corrupt":
+            return _corrupt(payload, self.action_arg)
+        if self.action == "truncate":
+            return _truncate(payload, self.action_arg)
+        return payload
+
+
+def _corrupt(payload: Any, n: int) -> Any:
+    if not isinstance(payload, (bytes, bytearray)) or not payload:
+        return payload
+    buf = bytearray(payload)
+    # deterministic: flip n evenly spaced bytes
+    k = max(1, min(n, len(buf)))
+    for i in range(k):
+        pos = (i * len(buf)) // k
+        buf[pos] ^= 0xFF
+    return bytes(buf)
+
+
+def _truncate(payload: Any, n: Optional[int]) -> Any:
+    if not isinstance(payload, (bytes, bytearray)):
+        return payload
+    keep = len(payload) // 2 if n is None else n
+    return bytes(payload[:keep])
+
+
+# site -> armed failpoint.  The hot path below reads this without a
+# lock (CPython dict reads are atomic); arm/disarm replace entries
+# under _REG_LOCK.
+_ARMED: Dict[str, _Failpoint] = {}
+_COUNTS: Dict[str, Dict[str, int]] = {}
+_REG_LOCK = threading.Lock()
+
+
+def failpoint(site: str, payload: Any = None) -> Any:
+    """The inline hook.  Disarmed: one dict lookup, payload returned
+    untouched.  Armed: may raise :class:`InjectedFault`, sleep, or
+    return a transformed payload (corrupt/truncate for bytes)."""
+    fp = _ARMED.get(site)
+    if fp is None:
+        return payload
+    return fp(payload)
+
+
+def _count(site: str, key: str) -> None:
+    with _REG_LOCK:
+        c = _COUNTS.setdefault(site, {"calls": 0, "fires": 0})
+        c[key] += 1
+
+
+# ---------------------------------------------------------------------------
+# spec parsing
+# ---------------------------------------------------------------------------
+
+def _parse_call(text: str) -> Tuple[str, Optional[str]]:
+    """``name`` or ``name(arg)`` -> (name, arg-or-None)."""
+    text = text.strip()
+    if "(" in text:
+        if not text.endswith(")"):
+            raise ValueError("malformed failpoint term: %r" % text)
+        name, arg = text[:-1].split("(", 1)
+        return name.strip(), arg.strip()
+    return text, None
+
+
+_ACTIONS = ("raise", "delay", "corrupt", "truncate")
+_TRIGGERS = ("always", "once", "every", "after", "prob")
+
+
+def _parse_clause(clause: str) -> Tuple[str, str, Any, str, Any]:
+    if "=" not in clause:
+        raise ValueError(
+            "failpoint clause %r: expected site=action[@trigger]" % clause)
+    site, rest = clause.split("=", 1)
+    site = site.strip()
+    if not site:
+        raise ValueError("failpoint clause %r: empty site" % clause)
+    if "@" in rest:
+        action_text, trigger_text = rest.split("@", 1)
+    else:
+        action_text, trigger_text = rest, "always"
+    action, a_arg = _parse_call(action_text)
+    trigger, t_arg = _parse_call(trigger_text)
+    if action not in _ACTIONS:
+        raise ValueError("unknown failpoint action %r (want one of %s)"
+                         % (action, "/".join(_ACTIONS)))
+    if trigger not in _TRIGGERS:
+        raise ValueError("unknown failpoint trigger %r (want one of %s)"
+                         % (trigger, "/".join(_TRIGGERS)))
+    # normalize action arg
+    if action == "delay":
+        if a_arg is None:
+            raise ValueError("delay needs a millisecond arg: delay(ms)")
+        action_arg: Any = float(a_arg)
+    elif action == "corrupt":
+        action_arg = int(a_arg) if a_arg else 8
+    elif action == "truncate":
+        action_arg = int(a_arg) if a_arg else None
+    else:  # raise
+        action_arg = a_arg  # optional message
+    # normalize trigger arg
+    if trigger in ("every", "after"):
+        if t_arg is None:
+            raise ValueError("%s needs a count arg: %s(N)"
+                             % (trigger, trigger))
+        trigger_arg: Any = int(t_arg)
+        if trigger_arg < 1:
+            raise ValueError("%s(N) needs N >= 1" % trigger)
+    elif trigger == "prob":
+        if t_arg is None or "," not in t_arg:
+            raise ValueError(
+                "prob needs an explicit seed: prob(p,seed) — "
+                "unseeded probabilistic faults are not reproducible")
+        p_text, seed_text = t_arg.split(",", 1)
+        p = float(p_text)
+        if not 0.0 <= p <= 1.0:
+            raise ValueError("prob(p,seed) needs 0 <= p <= 1")
+        trigger_arg = (p, int(seed_text))
+    else:
+        trigger_arg = None
+    return site, action, action_arg, trigger, trigger_arg
+
+
+def arm_spec(spec: str) -> List[str]:
+    """Arm every ``site=action@trigger`` clause in *spec* (``;``-joined).
+    Returns the list of sites armed.  An empty/blank spec is a no-op."""
+    armed_sites = []
+    for clause in (spec or "").split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        site, action, a_arg, trigger, t_arg = _parse_clause(clause)
+        with _REG_LOCK:
+            _ARMED[site] = _Failpoint(site, action, a_arg,
+                                      trigger, t_arg, clause)
+            _COUNTS.setdefault(site, {"calls": 0, "fires": 0})
+        armed_sites.append(site)
+    return armed_sites
+
+
+def arm(site: str, action: str = "raise", trigger: str = "always") -> None:
+    """Programmatic single-site arm: ``arm("serving.execute", "raise",
+    "once")`` — action/trigger use the same grammar as the spec."""
+    arm_spec("%s=%s@%s" % (site, action, trigger))
+
+
+def disarm(site: Optional[str] = None) -> None:
+    """Disarm one site, or every site when *site* is None/"all".
+    Hit counts are retained (see :func:`reset_counts`)."""
+    with _REG_LOCK:
+        if site is None or site == "all":
+            _ARMED.clear()
+        else:
+            _ARMED.pop(site, None)
+
+
+class armed:
+    """Context manager for tests: ``with failpoints.armed("x=raise@once"):``
+    arms the spec on entry and disarms those sites on exit."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self._sites: List[str] = []
+
+    def __enter__(self) -> "armed":
+        self._sites = arm_spec(self.spec)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        for s in self._sites:
+            disarm(s)
+
+
+def sites() -> Dict[str, Dict[str, Any]]:
+    """Snapshot for /failpointz: every known/armed/counted site with its
+    armed spec (or None) and cumulative calls/fires counts."""
+    with _REG_LOCK:
+        names = set(KNOWN_SITES) | set(_ARMED) | set(_COUNTS)
+        out = {}
+        for name in sorted(names):
+            c = _COUNTS.get(name, {"calls": 0, "fires": 0})
+            fp = _ARMED.get(name)
+            out[name] = {
+                "armed": fp.spec if fp is not None else None,
+                "calls": c["calls"],
+                "fires": c["fires"],
+            }
+        return out
+
+
+def reset_counts() -> None:
+    with _REG_LOCK:
+        _COUNTS.clear()
+
+
+# Env arming happens once at import so a process can be launched with
+# faults pre-armed (chaos smoke, kill-and-resume child processes).
+_env_spec = os.environ.get("PADDLE_TPU_FAILPOINTS", "")
+if _env_spec:
+    arm_spec(_env_spec)

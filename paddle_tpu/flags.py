@@ -157,6 +157,29 @@ _DEFS: Dict[str, Any] = {
     # requests kept with full timelines for /tracez (gauge-retracting
     # eviction, like FLAGS-less program_accounting's 512 bound)
     "FLAGS_tracing_exemplars": 32,
+    # fault injection (failpoints.py, docs/robustness.md): a spec
+    # string of site=action@trigger clauses joined by ";" — e.g.
+    # "serving.execute=raise@once;program_cache.load=corrupt@every(2)".
+    # Setting it re-arms the registry (a previously armed site absent
+    # from the new spec stays armed; use "" + failpoints.disarm() to
+    # clear). Disarmed sites cost ONE dict lookup — the same
+    # zero-overhead contract as FLAGS_request_tracing, pinned by test.
+    "FLAGS_failpoints": "",
+    # supervised pool recovery (serving.PredictorPool /
+    # generation.GenerationPool): on a worker-loop crash the pool
+    # restarts the serve loop with capped exponential backoff, failing
+    # in-flight futures with a typed PoolRestarted error. max_restarts
+    # bounds the total restarts before the pool goes terminally failed;
+    # backoff doubles from backoff_ms and is capped at 32x.
+    "FLAGS_pool_max_restarts": 3,
+    "FLAGS_pool_restart_backoff_ms": 50.0,
+    # crash-safe training (incubate/checkpoint/, docs/robustness.md):
+    # N > 0 makes TrainStep.run_loop / hapi fit write an atomic
+    # checkpoint (tmp+fsync+rename, manifest with step/fingerprint/mesh
+    # topology) every N steps into FLAGS_checkpoint_dir and auto-resume
+    # from the newest valid one on restart. 0 disables.
+    "FLAGS_auto_checkpoint_steps": 0,
+    "FLAGS_checkpoint_dir": "",
     # state-buffer donation in the jitted train step. Donation aliases
     # each state input to its output buffer (in-place updates, halves
     # peak param memory) but XLA:CPU runs donated executions
@@ -206,6 +229,13 @@ def set_flags(flags: Dict[str, Any]) -> None:
             raise ValueError("unknown flag %r (known: %d flags)"
                              % (k, len(_values)))
         _values[k] = v
+        if k == "FLAGS_failpoints" and v:
+            # arm the registry from the spec as a side effect — the
+            # natural scripting surface (set_flags is how every other
+            # behavior flag is driven). Lazy import: failpoints must
+            # import nothing from flags at module level and vice versa.
+            from paddle_tpu import failpoints as _fp
+            _fp.arm_spec(v)
 
 
 def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, Any]:

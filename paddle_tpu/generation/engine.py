@@ -49,6 +49,7 @@ import numpy as np
 from .. import telemetry as _tm
 from .. import tracing as _tr
 from ..core import program_cache
+from ..failpoints import failpoint
 from ..flags import get_flag
 from ..inference import bucket_for, parse_bucket_ladder
 from ..monitor import gauge_set, stat_add, timer_observe
@@ -369,6 +370,10 @@ class GenerationEngine:
         need = self.kv.blocks_for_tokens(n + 1)  # room for 1st decode
         if need > self.kv.free_blocks:
             return False
+        # before any state mutation: an injected raise leaves the
+        # engine consistent (the request is still pending; _admit's
+        # per-request isolation turns it into a delivered error)
+        failpoint("generation.prefill")
         tr = seq.req.trace
         tr.stage("prefill_start")
         if seq.evictions:
@@ -444,6 +449,11 @@ class GenerationEngine:
     def _decode_once(self) -> List[GenerationResult]:
         """Advance all active lanes one token (inactive lanes spin on
         the trash block)."""
+        # before the retire loop and any lane mutation: a caller that
+        # catches the InjectedFault can call step() again and the batch
+        # resumes exactly where it was (basis of the replay-under-fault
+        # determinism test)
+        failpoint("generation.decode")
         finished: List[GenerationResult] = []
         # retire sequences whose PREVIOUS token already terminated them
         for lane, seq in enumerate(self._lane_seq):

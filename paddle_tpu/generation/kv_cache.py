@@ -26,6 +26,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..failpoints import failpoint
 from ..monitor import gauge_set, stat_add
 
 __all__ = ["KVCacheManager", "BlockPoolExhausted", "TRASH_BLOCK"]
@@ -97,6 +98,7 @@ class KVCacheManager:
             raise ValueError("sequence %r already has blocks" % (seq_id,))
         if n_blocks < 1:
             raise ValueError("n_blocks must be >= 1")
+        failpoint("generation.kv_alloc")
         if n_blocks > len(self._free):
             raise BlockPoolExhausted(
                 "need %d blocks, %d free (pool %d x %d tokens)"

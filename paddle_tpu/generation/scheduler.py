@@ -17,8 +17,14 @@ Contracts, matching PredictorPool:
 - per-request error isolation: a request the engine rejects
   (too-long prompt, bad sampling params) fails ONLY its own future.
   A decode-step failure is a batch-level fault: every in-flight
-  future gets the error, the engine is rebuilt, and the pool keeps
-  serving (STAT_generation_errors counts both).
+  future fails with a typed PoolRestarted carrying its trace id, the
+  engine is rebuilt, and the SUPERVISOR restarts the worker with
+  capped exponential backoff (FLAGS_pool_max_restarts /
+  FLAGS_pool_restart_backoff_ms; /readyz reads unready during the
+  restart; budget exhaustion is terminal — docs/robustness.md).
+- deadline-aware shedding: a request whose deadline budget is burned
+  before admit is rejected with DeadlineBurned
+  (STAT_generation_shed_at_admit) instead of occupying a lane.
 - close() drains: already-queued and in-flight requests finish
   before the worker exits (like PredictorPool.close).
 """
@@ -32,7 +38,8 @@ from typing import Dict, List, Optional
 from .. import tracing as _tr
 from ..flags import get_flag
 from ..monitor import gauge_set, stat_add
-from ..serving import ServingQueueFull, _Future
+from ..serving import (DeadlineBurned, PoolRestarted, ServingQueueFull,
+                       _Future, _WorkerCrash)
 from .engine import GenerationEngine, GenerationRequest
 
 __all__ = ["GenerationPool"]
@@ -69,6 +76,12 @@ class GenerationPool:
         # engine-side request_id -> future, owned by the worker thread
         self._inflight: Dict[int, _Future] = {}
         self._next_id = 0
+        # supervision state (docs/robustness.md)
+        self._healthy = True
+        self._failed = False
+        self._fail_cause: Optional[BaseException] = None
+        self._ok_since_restart = False
+        self._last_step_s = 0.0
         # scheduler-side eviction replay happens inside the engine;
         # the future survives it untouched
         engine.on_request_error = self._on_request_error
@@ -91,15 +104,17 @@ class GenerationPool:
                 raise RuntimeError("pool is closed")
             if self._worker is None:
                 self._worker = threading.Thread(
-                    target=self._serve_loop, name="pt-generation-sched",
+                    target=self._supervisor, name="pt-generation-sched",
                     daemon=True)
                 self._worker.start()
         # a started-but-unwarmed pool reads as unready on /readyz until
-        # engine.warmup() flips _warmed (introspect.py readiness)
+        # engine.warmup() flips _warmed (introspect.py readiness); a
+        # restarting pool reads unready for the backoff window
         from .. import introspect
         introspect.register_readiness(
             "generation_pool_%d" % id(self),
-            lambda: getattr(self.engine, "_warmed", False))
+            lambda: getattr(self.engine, "_warmed", False)
+            and self._healthy)
         introspect.maybe_start()
         return self
 
@@ -143,23 +158,53 @@ class GenerationPool:
         + per-stage budget burn when blown (never cancels)."""
         fut = _Future()
         fut.trace = _tr.begin("generation", deadline=deadline)
-        wait_deadline = (None if timeout is None
-                         else time.monotonic() + timeout)
+        # ONE shared budget: the enqueue wait is bounded by timeout AND
+        # by the request's own deadline (serving.PredictorPool.submit
+        # has the same contract)
+        timeout_end = (None if timeout is None
+                       else fut.t_submit + timeout)
+        deadline_end = (None if deadline is None
+                        else fut.t_submit + deadline)
+        ends = [e for e in (timeout_end, deadline_end) if e is not None]
+        wait_deadline = min(ends) if ends else None
         with self._not_full:
-            while not self._closed and \
+            while not self._closed and not self._failed and \
                     len(self._queue) >= self.queue_depth:
+                now = time.monotonic()
+                if deadline_end is not None and now >= deadline_end:
+                    stat_add("STAT_generation_shed_at_admit")
+                    exc: BaseException = DeadlineBurned(
+                        "deadline (%.3fs) burned waiting for a queue "
+                        "slot" % deadline, trace_id=fut.trace.trace_id)
+                    fut.trace.finish(error=exc)
+                    raise exc
                 remaining = (None if wait_deadline is None
-                             else wait_deadline - time.monotonic())
+                             else wait_deadline - now)
                 if remaining is not None and remaining <= 0:
                     stat_add("STAT_generation_rejected")
                     exc = ServingQueueFull(
                         "generation queue full (depth %d) for %.3fs"
-                        % (self.queue_depth, timeout))
+                        % (self.queue_depth, now - fut.t_submit),
+                        queue_depth=len(self._queue),
+                        retry_after_s=max(
+                            0.01, self._last_step_s) * len(self._queue))
                     fut.trace.finish(error=exc)
                     raise exc
                 self._not_full.wait(remaining)
-            if self._closed:
-                exc = RuntimeError("GenerationPool closed")
+            if self._closed or self._failed:
+                exc = PoolRestarted(
+                    "GenerationPool failed (restart budget exhausted)",
+                    trace_id=fut.trace.trace_id,
+                    cause=self._fail_cause) if self._failed \
+                    else RuntimeError("GenerationPool closed")
+                fut.trace.finish(error=exc)
+                raise exc
+            if deadline is not None and \
+                    time.monotonic() - fut.t_submit >= deadline:
+                stat_add("STAT_generation_shed_at_admit")
+                exc = DeadlineBurned(
+                    "deadline (%.3fs) burned before admit" % deadline,
+                    trace_id=fut.trace.trace_id)
                 fut.trace.finish(error=exc)
                 raise exc
             self._queue.append((req, fut))
@@ -206,6 +251,65 @@ class GenerationPool:
         gauge_set("GAUGE_generation_queue_depth", len(self._queue))
         self._not_full.notify_all()
 
+    def _supervisor(self) -> None:
+        """Worker thread top-level: run the serve loop; on a batch-level
+        fault fail every in-flight future with a typed PoolRestarted,
+        rebuild the engine, and restart with capped exponential backoff.
+        FLAGS_pool_max_restarts bounds consecutive faulty restarts (a
+        healthy step since the last restart refunds the budget);
+        exhaustion is terminal."""
+        base = max(1e-3, float(
+            get_flag("FLAGS_pool_restart_backoff_ms", 50.0))) / 1e3
+        max_restarts = int(get_flag("FLAGS_pool_max_restarts", 3))
+        restarts = 0
+        while True:
+            try:
+                self._serve_loop()
+                return  # clean close()
+            except BaseException as e:  # noqa: BLE001 - supervisor
+                cause = getattr(e, "cause", None) or e
+                self._healthy = False
+                stat_add("STAT_generation_errors")
+                self._fail_inflight(cause)
+                self._reset_engine()
+                if self._closed:
+                    return
+                if self._ok_since_restart:
+                    restarts = 0  # healthy period earns the budget back
+                self._ok_since_restart = False
+                if restarts >= max_restarts:
+                    stat_add("STAT_generation_restart_exhausted")
+                    self._enter_failed(cause)
+                    return
+                restarts += 1
+                stat_add("STAT_generation_restarts")
+                time.sleep(min(base * (2 ** (restarts - 1)), base * 32))
+                self._healthy = True
+
+    def _fail_inflight(self, cause: BaseException) -> None:
+        for fut in self._inflight.values():
+            exc = PoolRestarted(
+                "generation worker restarted mid-stream",
+                trace_id=fut.trace.trace_id, cause=cause)
+            fut.trace.finish(error=exc)
+            fut._set_error(exc)
+        self._inflight.clear()
+
+    def _enter_failed(self, cause: BaseException) -> None:
+        with self._lock:
+            self._failed = True
+            self._fail_cause = cause
+            while self._queue:
+                _, fut = self._queue.popleft()
+                exc = PoolRestarted(
+                    "GenerationPool failed (restart budget exhausted)",
+                    trace_id=fut.trace.trace_id, cause=cause)
+                fut.trace.finish(error=exc)
+                fut._set_error(exc)
+            gauge_set("GAUGE_generation_queue_depth", 0)
+            self._not_full.notify_all()
+            self._not_empty.notify_all()
+
     def _serve_loop(self) -> None:
         eng = self.engine
         while True:
@@ -217,20 +321,16 @@ class GenerationPool:
                 self._admit_locked()
             # step OUTSIDE the lock: the decode executable can run
             # while submitters enqueue
+            t0 = time.monotonic()
             try:
                 finished = eng.step()
             except Exception as e:
-                # batch-level fault: fail everything in flight; the
-                # pool itself survives (next submits get a clean slate
-                # of lanes — the engine retires state via fresh
-                # futures' error paths)
-                stat_add("STAT_generation_errors")
-                for fut in self._inflight.values():
-                    fut.trace.finish(error=e)
-                    fut._set_error(e)
-                self._inflight.clear()
-                self._reset_engine()
-                continue
+                # batch-level fault: escalate to the supervisor, which
+                # fails the in-flight futures (PoolRestarted), rebuilds
+                # the engine and restarts this loop with backoff
+                raise _WorkerCrash(e)
+            self._last_step_s = time.monotonic() - t0
+            self._ok_since_restart = True
             for res in finished:
                 fut = self._inflight.pop(res.request_id, None)
                 if fut is not None:
@@ -240,11 +340,20 @@ class GenerationPool:
         """After a batch-level fault: rebuild the engine's sequence
         state (fresh KV ledger + lanes) reusing its compiled steps and
         device pools — in-flight sequences are gone, their futures
-        already hold the error."""
+        already hold the error. EVERY generation occupancy gauge is
+        retracted here, not lazily at the next allocation: a monitoring
+        scrape between the fault and the next request must see the
+        true (empty) state, not the pre-fault occupancy (pinned by
+        tests/test_failpoints.py)."""
         eng = self.engine
         eng.kv = type(eng.kv)(eng.kv.num_blocks, eng.kv.block_size)
         eng._lane_seq = [None] * eng.decode_width
         eng._tables[:] = 0
         eng._ctx[:] = 0
         eng._pending = []
+        # kv.__init__ republished the block gauges; retract the rest
+        # explicitly so the reset is retraction-COMPLETE even if the
+        # ledger's publish set ever narrows
+        gauge_set("GAUGE_generation_blocks_free", eng.kv.num_blocks - 1)
+        gauge_set("GAUGE_generation_blocks_used", 0)
         gauge_set("GAUGE_generation_active_seqs", 0)

@@ -174,6 +174,21 @@ class Model:
         from ..flags import get_flag
         window = max(1, int(get_flag("FLAGS_executor_inflight_steps", 2)
                             or 1))
+        # crash-safe auto-checkpointing (docs/robustness.md): with
+        # FLAGS_auto_checkpoint_steps > 0 + FLAGS_checkpoint_dir set,
+        # fit writes an atomic checkpoint every N global steps and
+        # resumes from the newest valid one, skipping the first k
+        # batches of the (assumed deterministic) loader stream
+        ck, ck_every, resume_step = None, 0, 0
+        if self._train_step is not None:
+            ck, ck_every = self._train_step._auto_checkpointer()
+        if ck is not None:
+            latest = ck.load_latest()
+            if latest is not None:
+                resume_step, arrays, _manifest = latest
+                self._train_step.restore_snapshot(arrays)
+                from ..monitor import stat_add
+                stat_add("STAT_checkpoint_resumes")
         gstep = 0  # telemetry step id, monotonic across epochs
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
@@ -181,6 +196,8 @@ class Model:
             inflight = deque()
             for step, batch in enumerate(loader):
                 gstep += 1
+                if gstep <= resume_step:
+                    continue  # fast-forward already-trained batches
                 cbks.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
                 with _tm.step_scope(gstep) if _tm.enabled() \
@@ -193,6 +210,8 @@ class Model:
                     with _tm.span("hapi/drain_wait", step=dn,
                                   track="drain"):
                         h.block_until_ready()
+                if ck is not None and gstep % ck_every == 0:
+                    ck.save(gstep, self._train_step.state_snapshot())
                 # callback time is aggregate-only (trace=False): a span
                 # per batch would dominate the event buffer at scale
                 with _tm.span("hapi/callbacks", trace=False,
@@ -204,7 +223,10 @@ class Model:
                           timer="TIMER_hapi_epoch_drain_us"):
                 history["loss"][epoch_start:] = [
                     float(h) for h in history["loss"][epoch_start:]]
-            logs = {"loss": history["loss"][-1]}
+            # an epoch fully fast-forwarded by resume trains nothing
+            # and has no loss to report
+            logs = {"loss": history["loss"][-1]} if history["loss"] \
+                else {}
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_loader, batch_size=None,
                                           verbose=0, _callbacks=cbks)

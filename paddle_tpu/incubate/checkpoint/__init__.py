@@ -1,3 +1,4 @@
+from .atomic import AtomicCheckpointer, CheckpointCorrupt  # noqa: F401
 from .auto_checkpoint import train_epoch_range  # noqa: F401
 from .checkpoint_saver import CheckpointSaver  # noqa: F401
 from .sharded import (ShardedCheckpointer,  # noqa: F401

@@ -1,0 +1,235 @@
+"""Atomic single-host checkpoints with manifest-committed writes.
+
+The crash-safety layer under FLAGS_auto_checkpoint_steps
+(docs/robustness.md). The sharded orbax path (sharded.py) covers
+multi-host; this module is the dependency-free analog with an explicit
+commit protocol a kill test can reason about:
+
+1. the payload (one .npz of flat name->array entries) is serialized to
+   bytes, fingerprinted (sha256), written to a temp file in the
+   checkpoint directory, fsync'd, and os.replace'd into place — a
+   reader sees the old file or the new file, never a torn one;
+2. the MANIFEST (json: step, payload fingerprint + byte size, mesh
+   topology, array names) is written the same way, strictly AFTER the
+   payload. The manifest is the commit record: a payload without a
+   valid matching manifest does not exist.
+
+Load walks manifests newest-first and verifies the payload fingerprint
+before trusting it, so a checkpoint truncated or corrupted mid-write
+(process killed between steps 1 and 2, disk damage, an armed
+``checkpoint.save=corrupt`` failpoint) falls back to the previous one
+(STAT_checkpoint_corrupt_fallback) instead of wedging the resume.
+
+Failpoint sites (failpoints.py): ``checkpoint.save`` transforms the
+payload bytes before the write (corrupt/truncate model torn writes),
+``checkpoint.load`` transforms them after the read.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import re
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...failpoints import failpoint
+from ...monitor import stat_add, timer_observe
+
+__all__ = ["AtomicCheckpointer", "CheckpointCorrupt"]
+
+_MANIFEST_RE = re.compile(r"^ckpt_(\d{8})\.json$")
+FORMAT_VERSION = 1
+
+
+class CheckpointCorrupt(RuntimeError):
+    """No loadable checkpoint: every manifest present failed
+    validation (missing/truncated/fingerprint-mismatched payload)."""
+
+
+def _mesh_topology() -> Optional[list]:
+    try:
+        from ...mesh.plan import current_plan
+        plan = current_plan()
+        if plan is None:
+            return None
+        return [list(t) if isinstance(t, tuple) else t
+                for t in plan.topology()]
+    except Exception:
+        return None
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """tmp + write + fsync + os.replace: the publish is all-or-nothing
+    (same idiom as program_cache.store_trace, plus a directory fsync so
+    the rename itself is durable)."""
+    d = os.path.dirname(path)
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp_ckpt_")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    try:
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # platform without directory fsync: rename is still atomic
+
+
+class AtomicCheckpointer:
+    """Step-indexed atomic checkpoints of flat name->ndarray dicts.
+
+    >>> ck = AtomicCheckpointer(root, keep=3)
+    >>> ck.save(120, {"w": w, "rng": key})
+    >>> step, arrays, manifest = ck.load_latest()
+    """
+
+    def __init__(self, root: str, keep: int = 3):
+        if not root:
+            raise ValueError("checkpoint root must be a path")
+        if keep < 1:
+            raise ValueError("keep must be >= 1")
+        self.root = str(root)
+        self.keep = int(keep)
+
+    # --- paths ---------------------------------------------------------
+
+    def _payload_path(self, step: int) -> str:
+        return os.path.join(self.root, "ckpt_%08d.npz" % step)
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.root, "ckpt_%08d.json" % step)
+
+    def steps(self) -> List[int]:
+        """Committed steps (manifest present), ascending. Payload
+        validity is checked at load, not here."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            m = _MANIFEST_RE.match(n)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    # --- save ----------------------------------------------------------
+
+    def save(self, step: int, arrays: Dict[str, Any],
+             extra_meta: Optional[Dict[str, Any]] = None) -> str:
+        """Write one committed checkpoint; returns the manifest path.
+        `arrays` is a flat name->array dict (callers flatten nested
+        training state with '//'-joined keys, io.save_dygraph style)."""
+        import time as _time
+        t0 = _time.perf_counter()
+        step = int(step)
+        buf = io.BytesIO()
+        np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+        payload = buf.getvalue()
+        payload = failpoint("checkpoint.save", payload)
+        _atomic_write(self._payload_path(step), payload)
+        manifest = {
+            "format": FORMAT_VERSION,
+            "step": step,
+            "fingerprint": hashlib.sha256(payload).hexdigest(),
+            "payload_bytes": len(payload),
+            "mesh_topology": _mesh_topology(),
+            "arrays": sorted(arrays),
+        }
+        if extra_meta:
+            manifest["meta"] = extra_meta
+        _atomic_write(self._manifest_path(step),
+                      json.dumps(manifest, indent=1,
+                                 sort_keys=True).encode() + b"\n")
+        stat_add("STAT_checkpoint_saves")
+        timer_observe("TIMER_checkpoint_save_us",
+                      (_time.perf_counter() - t0) * 1e6)
+        self._retain()
+        return self._manifest_path(step)
+
+    def _retain(self) -> None:
+        for step in self.steps()[:-self.keep]:
+            for p in (self._payload_path(step),
+                      self._manifest_path(step)):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+    # --- load ----------------------------------------------------------
+
+    def _load_step(self, step: int) -> Tuple[Dict[str, np.ndarray],
+                                             Dict[str, Any]]:
+        with open(self._manifest_path(step), "rb") as f:
+            manifest = json.loads(f.read())
+        with open(self._payload_path(step), "rb") as f:
+            payload = f.read()
+        payload = failpoint("checkpoint.load", payload)
+        if manifest.get("format") != FORMAT_VERSION:
+            raise CheckpointCorrupt("manifest format %r != %d"
+                                    % (manifest.get("format"),
+                                       FORMAT_VERSION))
+        fp = hashlib.sha256(payload).hexdigest()
+        if fp != manifest.get("fingerprint") \
+                or len(payload) != manifest.get("payload_bytes"):
+            raise CheckpointCorrupt(
+                "payload fingerprint mismatch at step %d "
+                "(%d bytes on disk, manifest says %s)"
+                % (step, len(payload), manifest.get("payload_bytes")))
+        try:
+            with np.load(io.BytesIO(payload)) as z:
+                arrays = {k: z[k] for k in z.files}
+        except Exception as e:
+            # a torn/corrupt payload the fingerprint could not catch
+            # (e.g. the checkpoint.save failpoint truncates BEFORE
+            # fingerprinting, so the manifest matches unreadable bytes;
+            # np.load then raises zipfile.BadZipFile, outside the OSError
+            # family) — normalize to CheckpointCorrupt so load_latest
+            # falls back
+            raise CheckpointCorrupt(
+                "unreadable payload at step %d: %s: %s"
+                % (step, type(e).__name__, e))
+        if sorted(arrays) != manifest.get("arrays"):
+            raise CheckpointCorrupt(
+                "array set mismatch at step %d" % step)
+        return arrays, manifest
+
+    def load_latest(self) -> Optional[Tuple[int, Dict[str, np.ndarray],
+                                            Dict[str, Any]]]:
+        """(step, arrays, manifest) for the newest VALID checkpoint —
+        a corrupt/truncated latest falls back to the previous one
+        (STAT_checkpoint_corrupt_fallback per skip). None when the
+        directory holds no committed checkpoint at all; raises
+        CheckpointCorrupt when manifests exist but none validates."""
+        steps = self.steps()
+        if not steps:
+            return None
+        last_err: Optional[Exception] = None
+        for step in reversed(steps):
+            try:
+                arrays, manifest = self._load_step(step)
+                stat_add("STAT_checkpoint_loads")
+                return step, arrays, manifest
+            except (OSError, ValueError, KeyError, json.JSONDecodeError,
+                    CheckpointCorrupt) as e:
+                stat_add("STAT_checkpoint_corrupt_fallback")
+                last_err = e
+        raise CheckpointCorrupt(
+            "no valid checkpoint under %s (%d manifests, newest "
+            "failure: %s)" % (self.root, len(steps), last_err))

@@ -24,6 +24,11 @@ code could. Endpoints:
                  TTFT/TPOT/stage-decomposition latencies, recently
                  completed traces, and the slow/errored exemplar ring
                  (text; ``?format=json`` for the raw payload)
+- ``/failpointz`` fault injection (failpoints.py, docs/robustness.md):
+                 GET lists every known site with its armed spec and
+                 calls/fires hit counts; POST arms
+                 (``?arm=site%3Draise%40once`` or a spec-string body)
+                 and disarms (``?disarm=site`` or ``?disarm=all``)
 
 Lifecycle: **off by default, zero overhead when off.**
 ``FLAGS_introspect_port`` is 0 → :func:`maybe_start` (called from
@@ -182,8 +187,17 @@ def statusz() -> Dict[str, Any]:
         },
         "flight_recorder_steps": len(telemetry.flight_records()),
         "tracing": _tracing_status(counters),
+        "failpoints_armed": _armed_failpoints(),
         "readiness": {"ready": ready, "checks": checks},
     }
+
+
+def _armed_failpoints() -> Dict[str, str]:
+    """site -> armed spec, armed sites only (/failpointz has the full
+    table with hit counts)."""
+    from . import failpoints
+    return {s: info["armed"] for s, info in failpoints.sites().items()
+            if info["armed"]}
 
 
 def _tracing_status(counters: Dict[str, Any]) -> Dict[str, Any]:
@@ -278,17 +292,57 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._send(200, telemetry.flight_dump() + "\n",
                                "text/plain; charset=utf-8")
+            elif url.path == "/failpointz":
+                from . import failpoints
+                self._json({"sites": failpoints.sites()})
             elif url.path == "/":
                 self._send(
                     200,
                     "paddle_tpu introspection: /metrics /healthz "
-                    "/readyz /statusz /flightz /programz /tracez\n",
+                    "/readyz /statusz /flightz /programz /tracez "
+                    "/failpointz\n",
                     "text/plain; charset=utf-8")
             else:
                 self._send(404, "not found: %s\n" % url.path,
                            "text/plain; charset=utf-8")
         except BrokenPipeError:
             pass  # scraper went away mid-response
+        except Exception as e:
+            try:
+                self._json({"error": repr(e)}, code=500)
+            except Exception:
+                pass
+
+    def do_POST(self):  # noqa: N802 (BaseHTTPRequestHandler contract)
+        url = urlsplit(self.path)
+        try:
+            if url.path != "/failpointz":
+                self._send(404, "not found: %s\n" % url.path,
+                           "text/plain; charset=utf-8")
+                return
+            from . import failpoints
+            q = parse_qs(url.query)
+            armed_now: list = []
+            disarmed: list = []
+            try:
+                for spec in q.get("arm", []):
+                    armed_now += failpoints.arm_spec(spec)
+                for site in q.get("disarm", []):
+                    failpoints.disarm(site)
+                    disarmed.append(site)
+                n = int(self.headers.get("Content-Length") or 0)
+                if n:
+                    body = self.rfile.read(n).decode("utf-8").strip()
+                    if body:
+                        armed_now += failpoints.arm_spec(body)
+            except ValueError as e:
+                self._json({"error": str(e),
+                            "sites": failpoints.sites()}, code=400)
+                return
+            self._json({"armed": armed_now, "disarmed": disarmed,
+                        "sites": failpoints.sites()})
+        except BrokenPipeError:
+            pass
         except Exception as e:
             try:
                 self._json({"error": repr(e)}, code=500)

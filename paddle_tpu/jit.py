@@ -197,6 +197,9 @@ class TrainStep:
         # reproducible (reference manual_seed contract); np.random here
         # made every TrainStep's dropout stream irreproducible
         self._rng = tape._state.next_key()
+        # a restore_snapshot() on a not-yet-built step parks the arrays
+        # here; __call__ applies them right after the lazy build
+        self._pending_restore: Optional[Dict[str, Any]] = None
         params, buffers = _named_state(model)
         self.param_names = list(params)
         self.buffer_names = list(buffers)
@@ -330,6 +333,11 @@ class TrainStep:
 
     def __call__(self, inputs, labels):
         from . import telemetry as _tm
+        from .failpoints import failpoint
+        # kill site for crash-injection tests: BEFORE the rng split and
+        # any state mutation, so a caught crash leaves the step exactly
+        # as it was after the last completed call
+        failpoint("trainstep.step")
         if self._step_fn is None:
             plan = self.plan
             if plan is None and self.mesh is None and \
@@ -376,6 +384,8 @@ class TrainStep:
                 # each (possibly sharded) parameter's sharding, so the
                 # accumulators lay out exactly like their params
                 self._opt_state = self._init_opt_state(self._state)
+        if self._pending_restore is not None:
+            self._apply_restore()
         inputs = tuple(_unwrap(x) for x in (
             inputs if isinstance(inputs, (tuple, list)) else (inputs,)))
         labels = tuple(_unwrap(x) for x in (
@@ -433,6 +443,75 @@ class TrainStep:
             _tm.flight_note(step_id, "dispatched_us", _tm.now_us())
         return loss
 
+    # -- crash-safe checkpointing (incubate/checkpoint/atomic.py) --------
+
+    def state_snapshot(self) -> Dict[str, Any]:
+        """Flat name->ndarray dict of the COMPLETE resume state:
+        params+buffers, optimizer slots, lr step, and the host-side
+        PRNG chain (each __call__ splits self._rng, so omitting it
+        would fork the dropout/shuffle stream on resume — the kill-and-
+        resume bitwise test fails without it). Forces a device sync (a
+        checkpoint costs one barrier)."""
+        if self._step_fn is None:
+            raise RuntimeError(
+                "TrainStep has not run yet — snapshot after at least "
+                "one step (its state materializes lazily)")
+        out: Dict[str, Any] = {}
+        for n, v in self._state.items():
+            out["state//%s" % n] = np.asarray(v)
+        for pname, st in self._opt_state.items():
+            for k, v in st.items():
+                out["opt//%s//%s" % (pname, k)] = np.asarray(v)
+        out["lr_step"] = np.asarray(self._lr_step)
+        out["rng"] = np.asarray(self._rng)
+        return out
+
+    def restore_snapshot(self, arrays: Dict[str, Any]) -> None:
+        """Inverse of state_snapshot. Works on a fresh TrainStep (the
+        arrays are parked and applied right after the lazy build, with
+        the built state's shardings) or a running one (applied now)."""
+        if self._step_fn is None:
+            self._pending_restore = dict(arrays)
+            return
+        self._pending_restore = dict(arrays)
+        self._apply_restore()
+
+    def _apply_restore(self) -> None:
+        arrays = self._pending_restore
+        self._pending_restore = None
+
+        def _like(old, key):
+            if key not in arrays:
+                raise KeyError(
+                    "checkpoint missing %r — saved from a different "
+                    "model/optimizer?" % key)
+            new = arrays[key]
+            sh = getattr(old, "sharding", None)
+            if self.mesh is not None and sh is not None:
+                return jax.device_put(np.asarray(new), sh)
+            return jnp.asarray(new)
+
+        self._state = {n: _like(v, "state//%s" % n)
+                       for n, v in self._state.items()}
+        self._opt_state = {
+            pname: {k: _like(v, "opt//%s//%s" % (pname, k))
+                    for k, v in st.items()}
+            for pname, st in self._opt_state.items()}
+        self._lr_step = _like(self._lr_step, "lr_step")
+        self._rng = jnp.asarray(arrays["rng"])
+
+    def _auto_checkpointer(self):
+        """(checkpointer, every) per FLAGS_auto_checkpoint_steps /
+        FLAGS_checkpoint_dir, or (None, 0) when auto-checkpointing is
+        off. Shared by run_loop and hapi Model.fit."""
+        from .flags import get_flag
+        every = int(get_flag("FLAGS_auto_checkpoint_steps", 0) or 0)
+        ckdir = str(get_flag("FLAGS_checkpoint_dir", "") or "")
+        if every <= 0 or not ckdir:
+            return None, 0
+        from .incubate.checkpoint.atomic import AtomicCheckpointer
+        return AtomicCheckpointer(ckdir), every
+
     def run_loop(self, batches, window: Optional[int] = None):
         """Dispatch-ahead training loop: generator over (inputs, labels)
         pairs yielding one lazy FetchHandle loss per step.
@@ -450,18 +529,37 @@ class TrainStep:
         window=1 restores the synchronous per-step loop. hapi
         Model.fit and the pipeline bench drive their loops through the
         same discipline.
+
+        Crash safety (docs/robustness.md): with
+        FLAGS_auto_checkpoint_steps > 0 and FLAGS_checkpoint_dir set,
+        the loop writes an atomic checkpoint every N steps and, on a
+        fresh start, auto-resumes from the newest valid one — the first
+        k batches of the (assumed deterministic) batch stream are
+        consumed WITHOUT dispatch so step numbering and the data
+        stream line up; skipped steps yield no handle.
         """
         from collections import deque
         from contextlib import nullcontext
         from . import telemetry as _tm
         from .core.fetch import FetchHandle
         from .flags import get_flag
+        from .monitor import stat_add
         if window is None:
             window = int(get_flag("FLAGS_executor_inflight_steps", 2)
                          or 1)
         window = max(1, window)
+        ck, ck_every = self._auto_checkpointer()
+        start_step = 0
+        if ck is not None:
+            latest = ck.load_latest()
+            if latest is not None:
+                start_step, arrays, _manifest = latest
+                self.restore_snapshot(arrays)
+                stat_add("STAT_checkpoint_resumes")
         pending: "deque" = deque()  # (step_no, FetchHandle)
         for n, (inputs, labels) in enumerate(batches, start=1):
+            if n <= start_step:
+                continue  # fast-forward the deterministic batch stream
             # scope covers the FetchHandle wrap too, so the handle's
             # eventual first read syncs under this step's id
             with _tm.step_scope(n) if _tm.enabled() else nullcontext():
@@ -473,6 +571,11 @@ class TrainStep:
                               track="drain",
                               timer="TIMER_pipeline_drain_us"):
                     h.block_until_ready()
+            if ck is not None and n % ck_every == 0:
+                # state_snapshot syncs, so the checkpoint holds step
+                # n's COMPLETED state (in-flight younger steps were
+                # dispatched after it and don't touch saved buffers)
+                ck.save(n, self.state_snapshot())
             yield handle
 
     def sync_model(self):

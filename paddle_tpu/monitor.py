@@ -112,6 +112,18 @@ serving timers, gated by FLAGS_request_tracing):
 - GAUGE_tracing_exemplars + GAUGE_trace_exemplar_us_<id> per kept
   slow/errored exemplar (retracted on ring eviction,
   STAT_tracing_exemplar_evict).
+
+The robustness layer (failpoints.py, docs/robustness.md):
+- self-healing pools: STAT_serving_restarts / _restart_exhausted and
+  STAT_generation_restarts / _restart_exhausted (supervised worker
+  restarts and terminal budget exhaustion — tools/stat_diff.py treats
+  the whole _shed_/_restart families as cost counters);
+- deadline shedding: STAT_serving_shed_at_admit /
+  STAT_generation_shed_at_admit (requests whose deadline burned while
+  queuing — rejected before any device work);
+- crash-safe checkpoints (incubate/checkpoint/atomic.py):
+  STAT_checkpoint_saves / _loads / _resumes / _corrupt_fallback and
+  TIMER_checkpoint_save_us.
 """
 from __future__ import annotations
 

@@ -19,8 +19,19 @@ under-full batch waiting for company), FLAGS_predictor_queue_depth
 
 Instruments (monitor.py / telemetry.py, track="serving"):
 STAT_serving_requests / _batches / _batched_rows / _rejected /
-_batch_errors, GAUGE_serving_queue_depth / _last_batch_rows,
+_batch_errors / _shed_at_admit / _restarts / _restart_exhausted,
+GAUGE_serving_queue_depth / _last_batch_rows,
 TIMER_serving_batch_us / _queue_wait_us.
+
+Robustness (docs/robustness.md): the batcher thread is SUPERVISED — a
+crash (or two consecutive batches with zero successful requests)
+restarts the serve loop with capped exponential backoff
+(FLAGS_pool_max_restarts / FLAGS_pool_restart_backoff_ms), failing
+stranded in-flight futures with a typed PoolRestarted that carries the
+trace id. Requests whose deadline is already burned at admit are shed
+immediately (DeadlineBurned, STAT_serving_shed_at_admit). The
+"serving.execute" failpoint site (failpoints.py) sits on the batch
+execution path for chaos testing.
 
 Request tracing (tracing.py, docs/observability.md): every submit()
 opens a RequestTrace (kind="serving") staged through admit →
@@ -41,15 +52,60 @@ import numpy as np
 
 from . import telemetry as _tm
 from . import tracing as _tr
+from .failpoints import failpoint
 from .flags import get_flag
 from .monitor import gauge_set, stat_add, timer_observe
 
-__all__ = ["PredictorPool", "ServingQueueFull", "serve"]
+__all__ = ["PredictorPool", "ServingQueueFull", "PoolRestarted",
+           "DeadlineBurned", "serve"]
 
 
 class ServingQueueFull(RuntimeError):
     """Backpressure: the bounded request queue stayed full for the
-    whole submit timeout. Callers shed load or retry with backoff."""
+    whole submit timeout. Callers shed load or retry with backoff.
+    Carries the observed `queue_depth` and a `retry_after_s` hint
+    (rough time for the batcher to drain one queue's worth) so clients
+    can back off proportionally instead of hammering."""
+
+    def __init__(self, msg: str, queue_depth: int = 0,
+                 retry_after_s: float = 0.0):
+        super().__init__(msg)
+        self.queue_depth = queue_depth
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineBurned(RuntimeError):
+    """Load shedding: the request's deadline budget was already spent
+    (queue wait) by the time it would have been admitted — rejecting
+    now is strictly better than occupying a batch slot to produce an
+    answer nobody is waiting for. STAT_<kind>_shed_at_admit counts
+    these."""
+
+    def __init__(self, msg: str, trace_id: Optional[str] = None):
+        super().__init__(msg)
+        self.trace_id = trace_id
+
+
+class PoolRestarted(RuntimeError):
+    """The pool's worker crashed and the supervisor restarted it (or
+    gave up after FLAGS_pool_max_restarts). Every in-flight future the
+    crash stranded resolves with ONE of these, carrying its request's
+    trace id and the causal error — never a hang."""
+
+    def __init__(self, msg: str, trace_id: Optional[str] = None,
+                 cause: Optional[BaseException] = None):
+        super().__init__(msg)
+        self.trace_id = trace_id
+        self.cause = cause
+
+
+class _WorkerCrash(RuntimeError):
+    """Internal: raised by a serve loop to escalate a persistent batch
+    fault to its supervisor (see PredictorPool._serve_loop)."""
+
+    def __init__(self, cause: Optional[BaseException]):
+        super().__init__("worker crash: %r" % (cause,))
+        self.cause = cause
 
 
 class _Future:
@@ -169,6 +225,16 @@ class PredictorPool:
         self._worker: Optional[threading.Thread] = None
         # flipped by warmup(): the pool's /readyz probe (introspect.py)
         self._warmed = False
+        # supervision state (docs/robustness.md): _healthy goes False
+        # for the duration of a restart (readiness degrades honestly),
+        # _failed is terminal — the restart budget ran out
+        self._healthy = True
+        self._failed = False
+        self._fail_cause: Optional[BaseException] = None
+        self._active_batch: Optional[List[_Request]] = None
+        self._ok_since_restart = False
+        # batcher-thread-only timing for the retry_after_s hint
+        self._last_batch_s = 0.0
         if _start:
             self.start()
 
@@ -180,14 +246,15 @@ class PredictorPool:
                 raise RuntimeError("pool is closed")
             if self._worker is None:
                 self._worker = threading.Thread(
-                    target=self._serve_loop, name="pt-serving-batcher",
+                    target=self._supervisor, name="pt-serving-batcher",
                     daemon=True)
                 self._worker.start()
-        # unready on /readyz until warmup() runs the compile-ahead
+        # unready on /readyz until warmup() runs the compile-ahead,
+        # and again while the supervisor is restarting a crashed loop
         from . import introspect
         introspect.register_readiness(
             "serving_pool_%d" % id(self),
-            lambda: self._warmed)
+            lambda: self._warmed and self._healthy)
         introspect.maybe_start()
         return self
 
@@ -256,22 +323,56 @@ class PredictorPool:
         tr = _tr.begin("serving", deadline=deadline)
         req.future.trace = tr
         tr.note(rows=req.rows)
-        wait_deadline = (None if timeout is None
-                         else time.monotonic() + timeout)
+        # ONE shared budget (PR 8 contract, extended): the enqueue wait
+        # is bounded by timeout AND by the request's own deadline — a
+        # request with 50 ms of deadline left never blocks 2 s for a
+        # queue slot it could not use anyway
+        timeout_end = (None if timeout is None
+                       else req.future.t_submit + timeout)
+        deadline_end = (None if deadline is None
+                        else req.future.t_submit + deadline)
+        ends = [e for e in (timeout_end, deadline_end) if e is not None]
+        wait_deadline = min(ends) if ends else None
         with self._not_full:
-            while not self._closed and len(self._queue) >= self.queue_depth:
+            while not self._closed and not self._failed \
+                    and len(self._queue) >= self.queue_depth:
+                now = time.monotonic()
+                if deadline_end is not None and now >= deadline_end:
+                    stat_add("STAT_serving_shed_at_admit")
+                    exc: BaseException = DeadlineBurned(
+                        "deadline (%.3fs) burned waiting for a queue "
+                        "slot" % deadline, trace_id=tr.trace_id)
+                    tr.finish(error=exc)
+                    raise exc
                 remaining = (None if wait_deadline is None
-                             else wait_deadline - time.monotonic())
+                             else wait_deadline - now)
                 if remaining is not None and remaining <= 0:
                     stat_add("STAT_serving_rejected")
                     exc = ServingQueueFull(
                         "serving queue full (depth %d) for %.3fs"
-                        % (self.queue_depth, timeout))
+                        % (self.queue_depth,
+                           now - req.future.t_submit),
+                        queue_depth=len(self._queue),
+                        retry_after_s=self._retry_after_locked())
                     tr.finish(error=exc)
                     raise exc
                 self._not_full.wait(remaining)
-            if self._closed:
-                exc = RuntimeError("PredictorPool closed")
+            if self._closed or self._failed:
+                exc: BaseException = PoolRestarted(
+                    "PredictorPool failed (restart budget exhausted)",
+                    trace_id=tr.trace_id, cause=self._fail_cause) \
+                    if self._failed else RuntimeError(
+                        "PredictorPool closed")
+                tr.finish(error=exc)
+                raise exc
+            # deadline already burned by the queue wait: shed NOW
+            # instead of spending a batch slot on a dead request
+            if deadline is not None and \
+                    time.monotonic() - req.future.t_submit >= deadline:
+                stat_add("STAT_serving_shed_at_admit")
+                exc = DeadlineBurned(
+                    "deadline (%.3fs) burned before admit"
+                    % deadline, trace_id=tr.trace_id)
                 tr.finish(error=exc)
                 raise exc
             tr.stage("admit")
@@ -280,6 +381,13 @@ class PredictorPool:
             gauge_set("GAUGE_serving_queue_depth", len(self._queue))
             self._not_empty.notify()
         return req.future
+
+    def _retry_after_locked(self) -> float:
+        """Suggested client backoff: batches the queue holds right now
+        times the worst of (recent batch latency, batch timeout)."""
+        per_batch = max(self._last_batch_s, self.batch_timeout_s, 1e-3)
+        batches = max(1, -(-len(self._queue) // self.max_batch))
+        return per_batch * batches
 
     def run(self, feeds: Sequence, timeout: Optional[float] = None,
             deadline: Optional[float] = None) -> List[np.ndarray]:
@@ -306,7 +414,75 @@ class PredictorPool:
                 return r
         return None
 
+    def _supervisor(self) -> None:
+        """The worker thread's top-level function: run the serve loop,
+        and when it crashes restart it with capped exponential backoff.
+        Restarts are budgeted by FLAGS_pool_max_restarts (a healthy
+        batch since the last restart refunds the budget); exhaustion is
+        terminal — queued and future requests fail with PoolRestarted.
+        While restarting, _healthy is False so /readyz degrades
+        honestly."""
+        base = max(1e-3, float(
+            get_flag("FLAGS_pool_restart_backoff_ms", 50.0))) / 1e3
+        max_restarts = int(get_flag("FLAGS_pool_max_restarts", 3))
+        restarts = 0
+        while True:
+            try:
+                self._serve_loop()
+                return  # clean close()
+            except BaseException as e:  # noqa: BLE001 - supervisor
+                cause = getattr(e, "cause", None) or e
+                self._healthy = False
+                self._fail_stranded(cause)
+                if self._closed:
+                    return
+                if self._ok_since_restart:
+                    restarts = 0  # healthy period earns the budget back
+                self._ok_since_restart = False
+                if restarts >= max_restarts:
+                    stat_add("STAT_serving_restart_exhausted")
+                    self._enter_failed(cause)
+                    return
+                restarts += 1
+                stat_add("STAT_serving_restarts")
+                time.sleep(min(base * (2 ** (restarts - 1)), base * 32))
+                self._healthy = True
+
+    def _fail_stranded(self, cause: BaseException) -> None:
+        """Resolve every future the crash stranded mid-execute with a
+        typed PoolRestarted carrying its trace id — no request ever
+        hangs on a restart."""
+        batch, self._active_batch = self._active_batch, None
+        for r in batch or ():
+            if not r.future.done():
+                exc = PoolRestarted(
+                    "serving worker restarted mid-batch",
+                    trace_id=r.future.trace.trace_id, cause=cause)
+                r.future.trace.finish(error=exc)
+                r.future._set_error(exc)
+
+    def _enter_failed(self, cause: BaseException) -> None:
+        with self._lock:
+            self._failed = True
+            self._fail_cause = cause
+            while self._queue:
+                fut = self._queue.popleft().future
+                exc = PoolRestarted(
+                    "PredictorPool failed (restart budget exhausted)",
+                    trace_id=fut.trace.trace_id, cause=cause)
+                fut.trace.finish(error=exc)
+                fut._set_error(exc)
+            gauge_set("GAUGE_serving_queue_depth", 0)
+            self._not_full.notify_all()
+            self._not_empty.notify_all()
+
     def _serve_loop(self) -> None:
+        # Escalation rule: per-batch error isolation (the retry path in
+        # _execute) stays, but TWO consecutive batches in which NO
+        # request succeeded mean the predictor itself is sick — escalate
+        # to the supervisor for a backoff restart. A one-off malformed
+        # request whose batch-mates succeed never trips this.
+        fail_streak = 0
         while True:
             with self._not_empty:
                 while not self._queue and not self._closed:
@@ -336,9 +512,18 @@ class PredictorPool:
                     self._not_empty.wait(remaining)
                 gauge_set("GAUGE_serving_queue_depth", len(self._queue))
                 self._not_full.notify_all()
-            self._execute(batch, rows)
+            self._active_batch = batch
+            n_ok, last_err = self._execute(batch, rows)
+            self._active_batch = None
+            if n_ok:
+                fail_streak = 0
+                self._ok_since_restart = True
+            else:
+                fail_streak += 1
+                if fail_streak >= 2:
+                    raise _WorkerCrash(last_err)
 
-    def _execute(self, batch: List[_Request], rows: int) -> None:
+    def _execute(self, batch: List[_Request], rows: int):
         t0 = time.monotonic()
         for r in batch:
             timer_observe("TIMER_serving_queue_wait_us",
@@ -355,6 +540,7 @@ class PredictorPool:
             for r in batch:
                 r.future.trace.stage("dispatch")
             t_exec = time.perf_counter()
+            failpoint("serving.execute")
             # span for trace correlation only; the timer is observed
             # directly so the latency histogram (the serving SLO) is
             # populated even with FLAGS_telemetry off. trace_scope
@@ -363,8 +549,9 @@ class PredictorPool:
             with _tm.trace_scope(tids):
                 with _tm.span("serving/batch", track="serving"):
                     outs = self.predictor.run(feeds)
+            self._last_batch_s = time.perf_counter() - t_exec
             timer_observe("TIMER_serving_batch_us",
-                          (time.perf_counter() - t_exec) * 1e6)
+                          self._last_batch_s * 1e6)
             for r in batch:
                 r.future.trace.stage("execute")
             outs = [np.asarray(o) for o in outs]
@@ -384,12 +571,13 @@ class PredictorPool:
                                if o.ndim and o.shape[0] == rows else o
                                for o in outs])
                 off += r.rows
+            return len(batch), None
         except Exception as e:
             stat_add("STAT_serving_batch_errors")
             if len(batch) == 1:
                 batch[0].future.trace.finish(error=e)
                 batch[0].future._set_error(e)
-                return
+                return 0, e
             # Error isolation: one malformed request must not fail its
             # batch-mates — retry each request alone. ORDER/IDENTITY
             # CONTRACT (tests/test_serving.py pins it): the retry walks
@@ -401,19 +589,24 @@ class PredictorPool:
             # (still in self._queue; the batcher resumes FIFO after the
             # retries). Retries run on the batcher thread, so they also
             # serialize BEFORE any later batch executes.
+            n_ok, last_err = 0, e
             for r in batch:
                 tr = r.future.trace
                 tr.event("retry", batch_rows=rows)
                 try:
+                    failpoint("serving.execute")
                     with _tm.trace_scope(tr.trace_id):
                         outs = self.predictor.run(list(r.feeds))
                     tr.stage("execute")
                     tr.stage("fetch")
                     tr.finish()
                     r.future._set([np.asarray(o) for o in outs])
+                    n_ok += 1
                 except Exception as e2:
                     tr.finish(error=e2)
                     r.future._set_error(e2)
+                    last_err = e2
+            return n_ok, last_err
 
 
 def serve(predictor, **kwargs) -> PredictorPool:

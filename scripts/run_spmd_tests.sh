@@ -2,8 +2,9 @@
 # Run the mesh-native SPMD runtime suite (-m spmd, docs/spmd.md) on the
 # 8-device virtual CPU mesh and emit MULTICHIP_r06.json: the usual
 # multichip dryrun transcript (same shape as MULTICHIP_r0{1..5}.json)
-# plus the mesh plan and the per-axis host-collective census
-# (STAT_mesh_collective_<axis>, monitor.py).
+# plus the mesh plan, the per-axis host-collective census
+# (STAT_mesh_collective_<axis>, monitor.py), and the chaos smoke
+# (failpoints armed over /failpointz, recovery asserted — ISSUE 9).
 #
 # Usage: scripts/run_spmd_tests.sh [extra pytest args...]
 set -u
@@ -130,11 +131,75 @@ finally:
     introspect.stop()
     install_plan(None)
 
+# chaos smoke (ISSUE 9, docs/robustness.md): arm failpoints over the
+# live /failpointz endpoint under the same dp4xmp2 mesh, prove (a) the
+# executor surfaces an injected dispatch fault and the very next run
+# succeeds, (b) a torn checkpoint write (truncated payload) falls back
+# to the previous committed step on load, then assert the cumulative
+# hit counts via GET /failpointz — counts survive the auto-disarm.
+chaos = {"ok": False}
+try:
+    import tempfile
+    from paddle_tpu.failpoints import InjectedFault
+    from paddle_tpu.incubate.checkpoint import AtomicCheckpointer
+
+    install_plan(plan)
+    srv = introspect.start(port=0)
+
+    def fp_post(q):
+        return json.load(urllib.request.urlopen(
+            srv.url + "/failpointz?" + q, data=b"", timeout=10))
+
+    dispatch_faulted = False
+    with use_plan(plan):
+        exe2 = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe2.run(startup)
+            # arm AFTER startup: the startup program dispatches too,
+            # and @once must spend its one shot on the train step
+            fp_post("arm=executor.dispatch=raise@once")
+            xb = np.ones((16, 4), np.float32)
+            yb = np.ones((16, 1), np.float32)
+            try:
+                exe2.run(main, feed={"x": xb, "y": yb},
+                         fetch_list=[loss])
+            except InjectedFault:
+                dispatch_faulted = True
+            out2, = exe2.run(main, feed={"x": xb, "y": yb},
+                             fetch_list=[loss])  # recovered
+
+    ckdir = tempfile.mkdtemp(prefix="pt_chaos_ck_")
+    ck = AtomicCheckpointer(ckdir)
+    ck.save(1, {"w": np.arange(4.0)})
+    fp_post("arm=checkpoint.save=truncate@once")
+    ck.save(2, {"w": np.arange(4.0) * 2})  # torn write
+    ck_step, _arrays, _m = ck.load_latest()  # must fall back to step 1
+
+    fpz = json.load(urllib.request.urlopen(srv.url + "/failpointz",
+                                           timeout=10))["sites"]
+    chaos = {
+        "ok": dispatch_faulted and np.isfinite(float(out2))
+        and ck_step == 1
+        and fpz["executor.dispatch"]["fires"] >= 1
+        and fpz["checkpoint.save"]["fires"] >= 1
+        and fpz["executor.dispatch"]["armed"] is None,
+        "dispatch_fault_recovered": dispatch_faulted,
+        "checkpoint_fallback_step": ck_step,
+        "hit_counts": {s: fpz[s]
+                       for s in ("executor.dispatch", "checkpoint.save")},
+    }
+except Exception as e:  # noqa: BLE001 - artifact records the failure
+    chaos["error"] = "%s: %s" % (type(e).__name__, e)
+finally:
+    introspect.stop()
+    install_plan(None)
+
 counters = monitor.get_float_stats()
 artifact = {
     "n_devices": len(jax.devices()),
     "rc": rc,
-    "ok": rc == 0 and test_rc == 0 and intro.get("ok", False),
+    "ok": rc == 0 and test_rc == 0 and intro.get("ok", False)
+    and chaos.get("ok", False),
     "skipped": False,
     "spmd_tests_rc": test_rc,
     "mesh_plan": {
@@ -145,6 +210,7 @@ artifact = {
         "executor_losses": losses,
     },
     "introspect": intro,
+    "chaos": chaos,
     "collectives": {k: v for k, v in sorted(counters.items())
                     if k.startswith("STAT_mesh_collective_")},
     "mesh_counters": {k: v for k, v in sorted(counters.items())
@@ -156,7 +222,7 @@ with open("MULTICHIP_r06.json", "w") as f:
     f.write("\n")
 print(json.dumps({k: artifact[k] for k in
                   ("n_devices", "rc", "ok", "spmd_tests_rc",
-                   "introspect", "collectives")}, indent=1))
+                   "introspect", "chaos", "collectives")}, indent=1))
 sys.exit(0 if artifact["ok"] else 1)
 EOF
 exit $?

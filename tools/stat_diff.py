@@ -31,6 +31,16 @@ from typing import Dict, List, Optional
 # counter-name suffixes where an increase is a cost, not throughput
 COST_SUFFIXES = ("_sync", "_miss", "_corrupt", "_evict", "_dropped",
                  "_unexportable")
+# infix families for the robustness counters (docs/robustness.md):
+# STAT_<kind>_shed_at_admit, STAT_<kind>_restarts /
+# _restart_exhausted — shed and restart events are always costs, for
+# any pool kind, so match on substring rather than enumerating kinds
+COST_INFIXES = ("_shed_", "_restart")
+
+
+def _is_cost_counter(name: str) -> bool:
+    return name.endswith(COST_SUFFIXES) \
+        or any(infix in name for infix in COST_INFIXES)
 
 
 def _as_snapshot(d: Dict) -> Dict:
@@ -98,7 +108,7 @@ def find_regressions(d: Dict, threshold_pct: float = 10.0) -> List[str]:
     threshold_pct (with a non-trivial sample count)."""
     regs: List[str] = []
     for name, e in d.get("counters", {}).items():
-        if name.endswith(COST_SUFFIXES) and e["delta"] > 0 \
+        if _is_cost_counter(name) and e["delta"] > 0 \
                 and e["pct"] > threshold_pct:
             regs.append("counter %s: %g -> %g (+%.1f%%)"
                         % (name, e["old"], e["new"], e["pct"]))
