@@ -69,6 +69,7 @@ KNOWN_SITES: Tuple[str, ...] = (
     "program_cache.store",
     "serving.execute",
     "generation.prefill",
+    "generation.prefill_chunk",
     "generation.decode",
     "generation.kv_alloc",
     "checkpoint.save",

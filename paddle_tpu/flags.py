@@ -117,6 +117,16 @@ _DEFS: Dict[str, Any] = {
     "FLAGS_generation_block_size": 16,
     "FLAGS_generation_decode_width": 8,
     "FLAGS_generation_prefill_buckets": "pow2:512",
+    # chunked prefill (PR 10, docs/generation.md "Chunked prefill"):
+    # prompts stream through the SAME fixed-shape mixed step that
+    # advances decode lanes, prefill_chunk prompt tokens per step.
+    # 0 disables chunking and restores the two-phase bucketed-prefill
+    # engine (FLAGS_generation_prefill_buckets then matters again; in
+    # chunked mode it is a compat shim — see MIGRATION.md).
+    # token_budget is the mixed batch's slot count (decode lanes +
+    # prefill slots per step); 0 = auto (decode_width + prefill_chunk).
+    "FLAGS_generation_prefill_chunk": 8,
+    "FLAGS_generation_token_budget": 0,
     # bounded request queue of the continuous-batching scheduler
     # (generation.GenerationPool): submit blocks, then raises
     # ServingQueueFull — same backpressure contract as PredictorPool

@@ -1,13 +1,25 @@
-"""GenerationEngine: bucketed prefill + fixed-shape continuous decode.
+"""GenerationEngine: chunked prefill + fixed-shape mixed decode.
 
 The engine owns the device state (params, the per-layer K/V block
-pools) and a fixed-width decode batch of `decode_width` LANES. A
-sequence's life: admitted -> blocks allocated -> prefill at a bucket
-from FLAGS_generation_prefill_buckets (one compiled prefill per bucket,
-PR-4 ladder grammar) -> parked in a free lane -> advanced one token per
-`step()` by ONE compiled decode executable shared by every lane ->
-leaves at EOS/max_new_tokens, blocks freed, lane reusable. Inactive
-lanes point their block table at the trash block and are never sampled.
+pools) and a fixed-width decode batch of `decode_width` LANES. In the
+default CHUNKED mode (FLAGS_generation_prefill_chunk > 0, PR 10) every
+step runs ONE compiled mixed executable over a fixed
+`token_budget`-slot batch: each decode lane contributes one slot (its
+next token), each prefilling lane contributes up to `prefill_chunk`
+slots (consecutive prompt tokens at their true positions, sharing the
+lane's block table), and leftover slots spin on the trash block. A
+sequence's life: admitted -> blocks allocated (whole prompt + first
+decode, all-or-nothing) -> parked in a free lane -> its prompt streams
+through the mixed step chunk by chunk WHILE other lanes keep decoding
+(no head-of-line blocking) -> the final chunk's logits sample the
+first token -> decode one token per step -> leaves at
+EOS/max_new_tokens, blocks freed, lane reusable.
+
+With FLAGS_generation_prefill_chunk = 0 the engine falls back to the
+PR-5 two-phase scheme: bucketed whole-prompt prefill
+(FLAGS_generation_prefill_buckets, one compiled prefill per ladder
+rung) followed by fixed-width fused decode. In chunked mode the ladder
+is a compat shim collapsed to [max_seq_len] — see MIGRATION.md.
 
 Fixed shapes everywhere mean the steady state replays exactly the warm
 executables: STAT_generation_compile counts engine-level compilations
@@ -51,7 +63,7 @@ from .. import tracing as _tr
 from ..core import program_cache
 from ..failpoints import failpoint
 from ..flags import get_flag
-from ..inference import bucket_for, parse_bucket_ladder
+from ..inference import bucket_for, bucket_or_exact, parse_bucket_ladder
 from ..monitor import gauge_set, stat_add, timer_observe
 from .kv_cache import TRASH_BLOCK, BlockPoolExhausted, KVCacheManager
 from .model import DecoderConfig, forward_full, forward_paged
@@ -59,6 +71,10 @@ from .sampling import SamplingParams, sample_tokens
 
 __all__ = ["GenerationEngine", "GenerationRequest", "GenerationResult",
            "NaiveGenerator"]
+
+# consecutive transient re-admission failures a REPLAYED (preempted)
+# request survives before the per-request kill — see _admit()
+_REPLAY_ADMIT_RETRIES = 8
 
 
 @dataclass
@@ -88,7 +104,8 @@ class _Seq:
     """Host-side state of one in-flight sequence."""
 
     __slots__ = ("req", "ctx", "generated", "lane", "admit_order",
-                 "evictions", "t_last_token")
+                 "evictions", "t_last_token", "prefilled",
+                 "admit_failures")
 
     def __init__(self, req: GenerationRequest, admit_order: int):
         self.req = req
@@ -98,6 +115,8 @@ class _Seq:
         self.admit_order = admit_order
         self.evictions = 0
         self.t_last_token = time.perf_counter()
+        self.prefilled = 0         # prompt tokens already in the pool
+        self.admit_failures = 0    # consecutive transient re-admit fails
 
 
 class GenerationEngine:
@@ -115,6 +134,8 @@ class GenerationEngine:
                  block_size: Optional[int] = None,
                  decode_width: Optional[int] = None,
                  prefill_buckets=None,
+                 prefill_chunk: Optional[int] = None,
+                 token_budget: Optional[int] = None,
                  program_cache_dir: Optional[str] = None):
         self.cfg = cfg
         self.params = jax.tree.map(jnp.asarray, params)
@@ -127,12 +148,33 @@ class GenerationEngine:
             else get_flag("FLAGS_generation_decode_width"))
         if self.decode_width < 1:
             raise ValueError("decode_width must be >= 1")
-        spec = (prefill_buckets if prefill_buckets is not None
-                else get_flag("FLAGS_generation_prefill_buckets"))
-        self.prefill_ladder = [b for b in parse_bucket_ladder(spec)
-                               if b <= cfg.max_seq_len]
-        if not self.prefill_ladder:
+        self.prefill_chunk = int(
+            prefill_chunk if prefill_chunk is not None
+            else get_flag("FLAGS_generation_prefill_chunk"))
+        if self.prefill_chunk < 0:
+            raise ValueError("prefill_chunk must be >= 0")
+        if self.prefill_chunk:
+            # chunked mode: prompts stream through the mixed step, so
+            # the bucket ladder is a compat shim with one rung
+            # (MIGRATION.md) — submit still validates against it
             self.prefill_ladder = [cfg.max_seq_len]
+            tb = int(token_budget if token_budget is not None
+                     else get_flag("FLAGS_generation_token_budget"))
+            self.token_budget = (tb if tb > 0 else
+                                 self.decode_width + self.prefill_chunk)
+            if self.token_budget < self.decode_width:
+                raise ValueError(
+                    "token_budget %d < decode_width %d: every decode "
+                    "lane needs a slot each step" % (self.token_budget,
+                                                     self.decode_width))
+        else:
+            self.token_budget = self.decode_width
+            spec = (prefill_buckets if prefill_buckets is not None
+                    else get_flag("FLAGS_generation_prefill_buckets"))
+            self.prefill_ladder = [b for b in parse_bucket_ladder(spec)
+                                   if b <= cfg.max_seq_len]
+            if not self.prefill_ladder:
+                self.prefill_ladder = [cfg.max_seq_len]
         self.kv = KVCacheManager(nb, bs)
         # table width: enough blocks for a max-length context
         self.max_blocks_per_seq = self.kv.blocks_for_tokens(
@@ -208,6 +250,39 @@ class GenerationEngine:
                 jax.ShapeDtypeStruct((w,), i32),
                 jax.ShapeDtypeStruct((w,), i32),
             )
+        elif kind == "mixed":
+            # ONE executable for every step of the chunked engine: T =
+            # token_budget SLOTS of (block-table row, position, token)
+            # — a decode lane's next token or one prompt token of a
+            # prefill chunk; forward_paged scatters every slot's K/V
+            # before attending, so chunk-mates see each other and the
+            # step is the ragged mixed batch of the paper. The sampler
+            # reads W lanes' logits through sample_slots (a lane's LAST
+            # slot this step); mid-prefill and idle lanes' samples are
+            # discarded on the host.
+            def raw(params, kp, vp, tables, positions, tokens,
+                    sample_slots, temps, tks, tps, seeds, steps):
+                logits, kp2, vp2 = forward_paged(
+                    cfg, params, kp, vp, tables, positions, tokens)
+                nxt = sample_tokens(logits[sample_slots], temps, tks,
+                                    tps, seeds, steps)
+                return nxt, kp2, vp2
+            w, m = self.decode_width, self.max_blocks_per_seq
+            t = self.token_budget
+            i32 = jnp.int32
+            avals = (
+                jax.tree.map(_sds, self.params),
+                _sds(self.k_pools), _sds(self.v_pools),
+                jax.ShapeDtypeStruct((t, m), i32),
+                jax.ShapeDtypeStruct((t,), i32),
+                jax.ShapeDtypeStruct((t,), i32),
+                jax.ShapeDtypeStruct((w,), i32),
+                jax.ShapeDtypeStruct((w,), jnp.float32),
+                jax.ShapeDtypeStruct((w,), i32),
+                jax.ShapeDtypeStruct((w,), jnp.float32),
+                jax.ShapeDtypeStruct((w,), i32),
+                jax.ShapeDtypeStruct((w,), i32),
+            )
         else:
             raise ValueError(kind)
         fn = self._aot_or_jit(kind, bucket, raw, avals)
@@ -221,13 +296,15 @@ class GenerationEngine:
         (core/program_accounting.py) so /programz shows every prefill
         bucket and the decode step with compile-time flops/bytes."""
         tag = ("generation_prefill_b%d" % bucket if kind == "prefill"
-               else "generation_decode")
+               else "generation_%s" % kind)
         meta = dict(self.cfg.meta(), kind=kind, bucket=bucket,
                     blocks=self.kv.num_blocks,
                     block_size=self.kv.block_size,
                     width=self.decode_width,
                     table=self.max_blocks_per_seq,
-                    lanes=self.attn_lanes)
+                    lanes=self.attn_lanes,
+                    chunk=self.prefill_chunk,
+                    slots=self.token_budget)
         cache_dir = program_cache.resolve_dir(self._program_cache_dir)
         if cache_dir is not None:
             fp = program_cache.fn_fingerprint("generation_step", meta)
@@ -242,9 +319,18 @@ class GenerationEngine:
             meta=meta)
 
     def warmup(self, buckets=None) -> dict:
-        """Compile-ahead: the decode step plus every prefill bucket
-        (or the given subset). Steady state then never compiles."""
+        """Compile-ahead. Chunked mode warms the ONE mixed-step
+        executable (there is nothing else to compile — the collapsed
+        ladder never runs); two-phase mode warms the decode step plus
+        every prefill bucket (or the given subset). Steady state then
+        never compiles."""
         report = {}
+        if self.prefill_chunk:
+            t0 = time.perf_counter()
+            self._warm_mixed()
+            report["mixed"] = round(time.perf_counter() - t0, 4)
+            self._warmed = True
+            return report
         t0 = time.perf_counter()
         self._warm_decode()
         report["decode"] = round(time.perf_counter() - t0, 4)
@@ -276,6 +362,16 @@ class GenerationEngine:
            jnp.zeros((w, self.max_blocks_per_seq), jnp.int32), z, z,
            jnp.zeros((w,), jnp.float32), z, jnp.ones((w,), jnp.float32),
            z, z)
+
+    def _warm_mixed(self) -> None:
+        fn = self._get_fn("mixed")
+        t, w = self.token_budget, self.decode_width
+        zt = jnp.zeros((t,), jnp.int32)
+        zw = jnp.zeros((w,), jnp.int32)
+        fn(self.params, self.k_pools, self.v_pools,
+           jnp.zeros((t, self.max_blocks_per_seq), jnp.int32), zt, zt,
+           zw, jnp.zeros((w,), jnp.float32), zw,
+           jnp.ones((w,), jnp.float32), zw, zw)
 
     # --- admission -----------------------------------------------------
 
@@ -331,28 +427,51 @@ class GenerationEngine:
     # --- the step ------------------------------------------------------
 
     def step(self) -> List[GenerationResult]:
-        """One scheduler tick: admit pending requests into free lanes
-        (prefill), advance every active lane one token, retire finished
-        sequences. Returns the finished results (possibly empty)."""
+        """One scheduler tick: admit pending requests into free lanes,
+        advance every active lane (one mixed or decode batch), retire
+        finished sequences. Returns the finished results (possibly
+        empty)."""
         self._admit()
         if self.active_count == 0:
             return []
+        if self.prefill_chunk:
+            return self._mixed_once()
         return self._decode_once()
 
     def _admit(self) -> None:
-        """Prefill pending requests into free lanes, oldest first.
-        Pool exhaustion stops admission (decode continues; completions
-        will free blocks)."""
+        """Admit pending requests into free lanes, oldest first (the
+        preemption replay path re-queues at the FRONT, so an evicted
+        in-progress request always beats a never-started one — the
+        fairness contract). Pool exhaustion stops admission (decode
+        continues; completions will free blocks).
+
+        Error handling is two-tier: a never-started request whose
+        admission raises is killed (per-request isolation), but a
+        REPLAYED request (evictions > 0) already streamed tokens to a
+        client — killing it on a transient admission fault (e.g. an
+        injected generation.kv_alloc raise) would turn a recoverable
+        hiccup into a dropped stream AND let newer requests overtake
+        it. Replayed admission faults are retried (request stays at the
+        front, STAT_generation_replay_retries) up to
+        _REPLAY_ADMIT_RETRIES consecutive failures before the kill."""
         for lane in range(self.decode_width):
             if not self._pending or self._lane_seq[lane] is not None:
                 continue
             seq = self._pending[0]
             try:
-                if not self._prefill_into(seq, lane):
+                ok = (self._admit_chunked(seq, lane)
+                      if self.prefill_chunk
+                      else self._prefill_into(seq, lane))
+                if not ok:
                     break                      # pool full: try later
             except Exception as e:
-                # per-request isolation: a prefill failure kills only
-                # this request
+                if seq.evictions and \
+                        seq.admit_failures < _REPLAY_ADMIT_RETRIES:
+                    seq.admit_failures += 1
+                    stat_add("STAT_generation_replay_retries")
+                    break                      # keep at front, retry
+                # per-request isolation: an admission failure kills
+                # only this request
                 self._pending.pop(0)
                 stat_add("STAT_generation_errors")
                 seq.req.trace.finish(error=e)
@@ -360,6 +479,39 @@ class GenerationEngine:
                 continue
             self._pending.pop(0)
         gauge_set("GAUGE_generation_active_seqs", self.active_count)
+
+    def _admit_chunked(self, seq: _Seq, lane: int) -> bool:
+        """Park `seq` in `lane` for chunked prefill: allocate blocks
+        for the WHOLE prompt plus the first decode token all-or-nothing
+        (a half-provisioned prompt would stall mid-prefill holding
+        blocks), then let the mixed step stream the prompt in. Returns
+        False (untouched state) when the pool can't hold it yet."""
+        n = len(seq.req.prompt)
+        need = self.kv.blocks_for_tokens(n + 1)
+        if need > self.kv.free_blocks:
+            return False
+        # before any state mutation: an injected raise leaves the
+        # engine consistent (the request is still pending)
+        failpoint("generation.prefill")
+        tr = seq.req.trace
+        tr.stage("prefill_start")
+        if seq.evictions:
+            tr.event("replay", evictions=seq.evictions)
+        sid = id(seq)
+        self.kv.alloc(sid, need)
+        seq.lane = lane
+        seq.prefilled = 0
+        seq.ctx = 0
+        self._lane_seq[lane] = seq
+        sp = seq.req.sampling
+        self._tables[lane] = self.kv.table(sid, self.max_blocks_per_seq)
+        self._ctx[lane] = 0
+        self._temps[lane] = sp.temperature
+        self._top_ks[lane] = sp.top_k
+        self._top_ps[lane] = sp.top_p
+        self._seeds[lane] = sp.seed
+        stat_add("STAT_generation_prefills")
+        return True
 
     def _prefill_into(self, seq: _Seq, lane: int) -> bool:
         """Run bucketed prefill for `seq` and park it in `lane`.
@@ -378,7 +530,11 @@ class GenerationEngine:
         tr.stage("prefill_start")
         if seq.evictions:
             tr.event("replay", evictions=seq.evictions)
-        bucket = bucket_for(n, self.prefill_ladder)
+        # pad accounting (STAT_generation_pad_tokens): the bucketed
+        # prefill pays bucket - n wasted token slots — the waste the
+        # chunked/ragged path exists to remove
+        bucket = bucket_or_exact(n, self.prefill_ladder,
+                                 pad_stat="STAT_generation_pad_tokens")
         t0 = time.perf_counter()
         with _tm.trace_scope(tr.trace_id), \
                 _tm.span("generation/prefill", track="generation"):
@@ -446,6 +602,142 @@ class GenerationEngine:
             jnp.asarray([step], jnp.int32))
         return int(np.asarray(out)[0])
 
+    def _mixed_once(self) -> List[GenerationResult]:
+        """One MIXED step (chunked mode): assemble up to token_budget
+        slots — every decoding lane's next token first (decode never
+        waits on a prefill: the no-head-of-line-blocking contract),
+        then up to prefill_chunk prompt tokens per prefilling lane in
+        lane order — and run the single compiled mixed executable.
+        Unused slots spin on the trash block (counted in
+        STAT_generation_pad_tokens).
+
+        Everything before the compiled call only reads engine state, so
+        a failpoint raise (generation.decode at the top,
+        generation.prefill_chunk between chunks) aborts the step with
+        nothing mutated: a caller that catches the InjectedFault can
+        call step() again and the batch resumes exactly where it was —
+        no token duplication, the basis of the mid-prompt fault
+        recovery test."""
+        failpoint("generation.decode")
+        finished: List[GenerationResult] = []
+        # retire sequences whose PREVIOUS token already terminated them
+        for lane, seq in enumerate(self._lane_seq):
+            if seq is None:
+                continue
+            done = self._finish_reason(seq)
+            if done is not None:
+                finished.append(self._retire(lane, done))
+        self._ensure_blocks()
+        t, w = self.token_budget, self.decode_width
+        m = self.max_blocks_per_seq
+        decode_lanes = []
+        prefill_lanes = []
+        for ln, s in enumerate(self._lane_seq):
+            if s is None:
+                continue
+            if s.prefilled >= len(s.req.prompt):
+                decode_lanes.append(ln)
+            else:
+                prefill_lanes.append(ln)
+        if not decode_lanes and not prefill_lanes:
+            gauge_set("GAUGE_generation_active_seqs", 0)
+            return finished
+        tables = np.full((t, m), TRASH_BLOCK, np.int32)
+        positions = np.zeros((t,), np.int32)
+        tokens = np.zeros((t,), np.int32)
+        sample_slots = np.zeros((w,), np.int32)
+        steps = np.zeros((w,), np.int32)
+        slot = 0
+        for ln in decode_lanes:
+            seq = self._lane_seq[ln]
+            tables[slot] = self._tables[ln]
+            positions[slot] = seq.ctx
+            tokens[slot] = seq.generated[-1]
+            sample_slots[ln] = slot
+            steps[ln] = len(seq.generated)
+            slot += 1
+        # (lane, seq, chunk start, chunk width)
+        chunk_plan = []
+        for ln in prefill_lanes:
+            seq = self._lane_seq[ln]
+            n = len(seq.req.prompt)
+            take = min(self.prefill_chunk, n - seq.prefilled, t - slot)
+            if take <= 0:
+                continue
+            if seq.prefilled:
+                # between chunks of one prompt — still pre-mutation
+                failpoint("generation.prefill_chunk")
+            start = seq.prefilled
+            for j in range(take):
+                tables[slot] = self._tables[ln]
+                positions[slot] = start + j
+                tokens[slot] = seq.req.prompt[start + j]
+                slot += 1
+            # the lane samples from its LAST slot: meaningful (step 0,
+            # the first generated token) only when this chunk reaches
+            # the end of the prompt — otherwise discarded below
+            sample_slots[ln] = slot - 1
+            steps[ln] = 0
+            chunk_plan.append((ln, seq, start, take))
+        stat_add("STAT_generation_pad_tokens", t - slot)
+        t0 = time.perf_counter()
+        riders = decode_lanes + [c[0] for c in chunk_plan]
+        tids = ",".join(
+            tid for tid in (self._lane_seq[ln].req.trace.trace_id
+                            for ln in riders) if tid) \
+            if _tm.enabled() else None
+        with _tm.trace_scope(tids), \
+                _tm.span("generation/mixed_step", track="generation"):
+            fn = self._get_fn("mixed")
+            nxt, self.k_pools, self.v_pools = fn(
+                self.params, self.k_pools, self.v_pools,
+                jnp.asarray(tables), jnp.asarray(positions),
+                jnp.asarray(tokens), jnp.asarray(sample_slots),
+                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                jnp.asarray(self._top_ps), jnp.asarray(self._seeds),
+                jnp.asarray(steps))
+            nxt = np.asarray(nxt)
+        dt_us = (time.perf_counter() - t0) * 1e6
+        timer_observe("TIMER_generation_mixed_step_us", dt_us)
+        # the mixed step IS the decode step of this engine — keep the
+        # historic SLO timer (and its bench regression gate) alive
+        timer_observe("TIMER_generation_decode_step_us", dt_us)
+        now = time.perf_counter()
+        for ln in decode_lanes:
+            seq = self._lane_seq[ln]
+            seq.ctx += 1
+            self._ctx[ln] = seq.ctx
+            seq.generated.append(int(nxt[ln]))
+            seq.req.trace.token()
+            timer_observe("TIMER_generation_inter_token_us",
+                          (now - seq.t_last_token) * 1e6)
+            seq.t_last_token = now
+            stat_add("STAT_generation_tokens")
+            done = self._finish_reason(seq)
+            if done is not None:
+                finished.append(self._retire(ln, done))
+        for ln, seq, start, take in chunk_plan:
+            seq.prefilled = start + take
+            seq.ctx = seq.prefilled
+            self._ctx[ln] = seq.ctx
+            seq.req.trace.event("prefill_chunk", start=start,
+                                width=take)
+            if seq.prefilled == len(seq.req.prompt):
+                # final chunk: its last slot's logits sampled the first
+                # generated token (sampler step 0 — identical fold_in
+                # to the two-phase prefill, so streams match bitwise)
+                seq.generated.append(int(nxt[ln]))
+                # TTFT lands at the TRUE first sampled token (first
+                # token() call only; replays re-observe TPOT)
+                seq.req.trace.token()
+                seq.t_last_token = now
+                stat_add("STAT_generation_tokens")
+                done = self._finish_reason(seq)
+                if done is not None:
+                    finished.append(self._retire(ln, done))
+        gauge_set("GAUGE_generation_active_seqs", self.active_count)
+        return finished
+
     def _decode_once(self) -> List[GenerationResult]:
         """Advance all active lanes one token (inactive lanes spin on
         the trash block)."""
@@ -471,6 +763,8 @@ class GenerationEngine:
         if not active:
             gauge_set("GAUGE_generation_active_seqs", 0)
             return finished
+        # idle lanes ride the fixed-width batch as padding
+        stat_add("STAT_generation_pad_tokens", w - len(active))
         for ln in active:
             seq = self._lane_seq[ln]
             tokens[ln] = seq.generated[-1]
@@ -608,8 +902,13 @@ class GenerationEngine:
             self.submit(r)
         out: List[GenerationResult] = []
         steps = 0
+        # chunked mode spends up to ceil(prompt/chunk) extra steps per
+        # request streaming the prompt in — double the per-request
+        # allowance so long prompts converge
+        per_req = ((2 if self.prefill_chunk else 1)
+                   * self.cfg.max_seq_len + 4)
         limit = (max_steps if max_steps is not None
-                 else (self.cfg.max_seq_len + 2) * max(1, len(reqs)))
+                 else per_req * max(1, len(reqs)))
         while not self.idle and steps < limit:
             out.extend(self.step())
             steps += 1

@@ -170,14 +170,23 @@ def forward_full(cfg: DecoderConfig, params: dict, tokens, lengths,
 
 def forward_paged(cfg: DecoderConfig, params: dict, k_pools, v_pools,
                   block_tables, ctx_lens, tokens):
-    """Single-token decode step: tokens `[B]` (the NEW token at
-    position ctx_lens), pools `[layers, N, bs, H, D]`, block_tables
+    """One-token-per-slot paged step: tokens `[B]` (each slot's token
+    at position ctx_lens), pools `[layers, N, bs, H, D]`, block_tables
     `[B, M]`, ctx_lens `[B]` int32 (tokens already in the cache).
     Writes each layer's new K/V into the pool at the flat slot
     `table[ctx // bs] * bs + ctx % bs`, attends over ctx+1 positions,
     returns (logits `[B, vocab]`, k_pools', v_pools').
 
-    Inactive lanes (the scheduler parks them) carry ctx_lens whose
+    This is the engine's MIXED step, not just decode.  A batch row is a
+    *slot*: either a decode lane's next token or one prompt token of a
+    prefill chunk.  Chunk-mates of the same sequence occupy adjacent
+    slots with duplicated table rows and consecutive positions; because
+    every layer scatters all slots' K/V before the attention gather,
+    later chunk-mates see earlier ones' keys within the same call, so a
+    prompt streamed through this step is bitwise-identical to
+    `forward_full` at every position (pinned in tests/test_kernels.py).
+
+    Inactive slots (the scheduler parks them) carry ctx_lens whose
     block-table slot is the trash block — their writes land in trash
     and their logits are garbage the scheduler never samples from.
     """

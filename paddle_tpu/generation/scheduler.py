@@ -2,8 +2,10 @@
 
 serving.PredictorPool's batcher coalesces whole REQUESTS into one
 execution; generation needs a step-level scheduler instead — requests
-join the in-flight decode batch at prefill, ride it one token per
-step, and leave at EOS/max-len while their batch-mates keep going.
+join the in-flight batch at admission, stream their prompt through the
+mixed step a chunk at a time (chunked prefill; engine.py), ride the
+batch one token per step, and leave at EOS/max-len while their
+batch-mates keep going.
 This class is that extension: the same bounded-queue + condition-
 variable front door and the same `_Future` completion handles as the
 serving pool (literally reused), but the worker loop drives

@@ -63,14 +63,20 @@ def bucket_for(n: int, ladder: Sequence[int]) -> Optional[int]:
 
 
 def bucket_or_exact(n: int, ladder: Sequence[int],
-                    overflow_stat: Optional[str] = None) -> int:
+                    overflow_stat: Optional[str] = None,
+                    pad_stat: Optional[str] = None) -> int:
     """The shared pad-target policy of every bucketed caller (the
     Predictor's `_run_bucketed`, the generation prefill): the smallest
     bucket >= n, falling back to the EXACT size on ladder overflow —
     louder than silent (bumps `overflow_stat` when given), never
-    wrong."""
+    wrong. `pad_stat` names a counter for the padding waste
+    (padded-minus-real elements, e.g. STAT_generation_pad_tokens) so
+    /statusz and bench can show the waste the ragged path removes."""
     b = bucket_for(n, ladder)
     if b is not None:
+        if pad_stat and b > n:
+            from .monitor import stat_add
+            stat_add(pad_stat, b - n)
         return b
     if overflow_stat:
         from .monitor import stat_add

@@ -30,6 +30,16 @@ Layouts: q `[B, H, D]` (one new token per sequence), pools
 `[N, block_size, H, D]`, block_tables `[B, max_blocks]` int32,
 ctx_lens `[B]` int32 (number of VISIBLE keys, i.e. the new token's
 position + 1). Returns `[B, H, D]`.
+
+RAGGED entry (PR 10, chunked prefill): `ragged_paged_attention` takes
+q `[B, Cq, H, D]` where row b carries `q_lens[b]` real queries — 1 for
+a decode step, a chunk width for prefill — starting at absolute
+position `ctx_lens[b]` (here ctx_lens counts the keys BEFORE the
+chunk, not the visible total). Query j of row b sees pool positions
+`<= ctx_lens[b] + j`: causal inside the chunk, full history before it.
+The single-token functions above are the Cq == 1 specialization and
+delegate here, so decode parity pins cover the ragged core by
+construction.
 """
 from __future__ import annotations
 
@@ -97,20 +107,31 @@ def attend_reference(q, k, v, mask, sm_scale):
 
 
 # ---------------------------------------------------------------------------
-# reference paged path
+# reference paged path (ragged core + Cq == 1 decode specialization)
 # ---------------------------------------------------------------------------
 
-def paged_attention_reference(q, k_pool, v_pool, block_tables, ctx_lens,
-                              sm_scale: Optional[float] = None):
-    """Gather-from-block-table decode attention in plain XLA.
+def ragged_paged_attention_reference(q, k_pool, v_pool, block_tables,
+                                     q_lens, ctx_lens,
+                                     sm_scale: Optional[float] = None):
+    """Ragged gather-from-block-table attention in plain XLA.
+
+    q `[B, Cq, H, D]`: row b holds `q_lens[b]` real queries at absolute
+    positions `ctx_lens[b] .. ctx_lens[b] + q_lens[b] - 1` (the chunk's
+    own K/V must already be scattered into the pool). Query j sees pool
+    positions `<= ctx_lens[b] + j` — causal within the chunk, the full
+    paged history before it. Rows `j >= q_lens[b]` are fully masked and
+    come back as the finite uniform-average degradation of
+    attend_reference (never NaN, never read by callers).
 
     The gather materializes each sequence's `[max_blocks * block_size]`
-    logical KV view (positions beyond ctx_len are masked, so stale or
-    foreign blocks behind the table are invisible), then runs the
-    shared attend_reference core with Tq == 1."""
+    logical KV view (masked positions hide stale or foreign blocks
+    behind the table), then runs the shared attend_reference core with
+    Tq == Cq — the same ops and reduction shapes as full-context
+    prefill, which is what makes the chunked path bitwise-comparable to
+    `forward_full` recompute (tests/test_kernels.py)."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    b, h, d = q.shape
+    b, cq, h, d = q.shape
     n, bs, _, _ = k_pool.shape
     m = block_tables.shape[1]
     # [B, M, bs, H, D] -> [B, H, M*bs, D]
@@ -119,22 +140,45 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, ctx_lens,
     v = jnp.transpose(v_pool[block_tables], (0, 3, 1, 2, 4)
                       ).reshape(b, h, m * bs, d)
     pos = jnp.arange(m * bs, dtype=jnp.int32)
-    mask = (pos[None, :] < ctx_lens[:, None])[:, None, None, :]
-    out = attend_reference(q[:, :, None, :], k, v, mask, sm_scale)
-    return out[:, :, 0, :]
+    qi = jnp.arange(cq, dtype=jnp.int32)
+    # [B, Cq, L]: pool position visible to query j of row b
+    visible = pos[None, None, :] <= \
+        (ctx_lens[:, None] + qi[None, :])[:, :, None]
+    live = (qi[None, :] < q_lens[:, None])[:, :, None]
+    mask = (visible & live)[:, None, :, :]            # [B, 1, Cq, L]
+    out = attend_reference(jnp.transpose(q, (0, 2, 1, 3)), k, v, mask,
+                           sm_scale)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def paged_attention_reference(q, k_pool, v_pool, block_tables, ctx_lens,
+                              sm_scale: Optional[float] = None):
+    """Single-token decode attention: the Cq == 1 specialization of the
+    ragged path. ctx_lens here counts VISIBLE keys (position + 1), so
+    the ragged call gets `ctx_lens - 1` keys-before-the-query and a
+    q_len of 1 — `pos <= ctx - 1` is the same mask booleans as the
+    historic `pos < ctx`, keeping this delegation bitwise-identical to
+    the pre-ragged decode path."""
+    ctx = jnp.asarray(ctx_lens)
+    out = ragged_paged_attention_reference(
+        q[:, None], k_pool, v_pool, block_tables,
+        jnp.ones_like(ctx), ctx - 1, sm_scale)
+    return out[:, 0]
 
 
 # ---------------------------------------------------------------------------
 # Pallas kernel: one pool block in VMEM per grid step
 # ---------------------------------------------------------------------------
 
-def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                  acc_ref, m_ref, l_ref, *, block_size, sm_scale,
-                  num_blocks):
+def _ragged_kernel(tables_ref, qlens_ref, lens_ref, q_ref, k_ref, v_ref,
+                   o_ref, acc_ref, m_ref, l_ref, *, block_size, sm_scale,
+                   num_blocks):
     """Grid (B, max_blocks): sequential online-softmax over the
-    sequence's blocks. tables/lens arrive via scalar prefetch — the
-    index maps already used tables_ref to pick this (k, v) block, so
-    the body only handles masking and the (m, l, acc) recurrence."""
+    sequence's blocks, Cq queries per row. tables/q_lens/ctx_lens
+    arrive via scalar prefetch — the index maps already used tables_ref
+    to pick this (k, v) block, so the body only handles the causal
+    chunk mask and the (m, l, acc) recurrence carried per (head,
+    query)."""
     b = pl.program_id(0)
     mi = pl.program_id(1)
 
@@ -145,79 +189,105 @@ def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     ctx = lens_ref[b]
+    qlen = qlens_ref[b]
 
-    # blocks entirely at/after the context end contribute nothing;
-    # skipping the math (the DMA already happened) keeps the scratch
-    # recurrence exact for ragged lengths
-    @pl.when(mi * block_size < ctx)
+    # blocks entirely past the chunk's last visible key (position
+    # ctx + qlen - 1) contribute nothing; skipping the math (the DMA
+    # already happened) keeps the scratch recurrence exact for ragged
+    # lengths
+    @pl.when(mi * block_size < ctx + qlen)
     def _body():
-        q = q_ref[0].astype(jnp.float32) * sm_scale          # [H, D]
-        k = k_ref[0].astype(jnp.float32)                     # [bs, H, D]
+        q = q_ref[0].astype(jnp.float32) * sm_scale      # [Cq, H, D]
+        k = k_ref[0].astype(jnp.float32)                 # [bs, H, D]
         v = v_ref[0].astype(jnp.float32)
-        # batch over heads, contract D: [H, bs]
+        # batch over heads, contract D: [H, Cq, bs]
         s = jax.lax.dot_general(
-            q, k, (((1,), (2,)), ((0,), (1,))),
+            q, k, (((2,), (2,)), ((1,), (1,))),
             preferred_element_type=jnp.float32)
         pos = mi * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)
-        s = jnp.where(pos < ctx, s, NEG_INF)
-        m_prev = m_ref[:, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
+            jnp.int32, s.shape, 2)
+        qi = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where((pos <= ctx + qi) & (qi < qlen), s, NEG_INF)
+        m_prev = m_ref[...]                              # [H, Cq]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2))
+        p = jnp.exp(s - m_new[:, :, None])
         p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         alpha = jnp.exp(m_prev - m_new)
-        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
-        m_ref[:, 0] = m_new
-        # [H, bs] x [bs, H, D] -> per-head value rows: batch over H
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=2)
+        m_ref[...] = m_new
+        # [H, Cq, bs] x [bs, H, D] -> [H, Cq, D]: batch over H
         pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32)              # [H, D]
-        acc_ref[...] = acc_ref[...] * alpha[:, None] + pv
+            p, v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha[:, :, None] + pv
 
     @pl.when(mi == num_blocks - 1)
     def _finish():
-        l = l_ref[:, 0]
+        l = l_ref[...]
         l_safe = jnp.where(l <= 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+        o_ref[0] = jnp.transpose(acc_ref[...] / l_safe[:, :, None],
+                                 (1, 0, 2)).astype(o_ref.dtype)
+
+
+def ragged_paged_attention_pallas(q, k_pool, v_pool, block_tables,
+                                  q_lens, ctx_lens,
+                                  sm_scale: Optional[float] = None,
+                                  interpret: Optional[bool] = None):
+    """Blocked ragged kernel: same grid over (sequence, pool block) as
+    the decode kernel, but each VMEM tile scores the whole Cq-wide
+    chunk against one resident block, so prefill chunks and decode
+    singles share one executable shape."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = _use_interpret()
+    b, cq, h, d = q.shape
+    _, bs, _, _ = k_pool.shape
+    m = block_tables.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # block_tables, q_lens, ctx_lens
+        grid=(b, m),
+        in_specs=[
+            pl.BlockSpec((1, cq, h, d),
+                         lambda bi, mi, tbl, qls, lens: (bi, 0, 0, 0)),
+            pl.BlockSpec(
+                (1, bs, h, d),
+                lambda bi, mi, tbl, qls, lens: (tbl[bi, mi], 0, 0, 0)),
+            pl.BlockSpec(
+                (1, bs, h, d),
+                lambda bi, mi, tbl, qls, lens: (tbl[bi, mi], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, cq, h, d),
+            lambda bi, mi, tbl, qls, lens: (bi, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, cq, d), jnp.float32),   # acc
+            pltpu.VMEM((h, cq), jnp.float32),      # running max
+            pltpu.VMEM((h, cq), jnp.float32),      # running denom
+        ],
+    )
+    kern = functools.partial(_ragged_kernel, block_size=bs,
+                             sm_scale=sm_scale, num_blocks=m)
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, cq, h, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), q_lens.astype(jnp.int32),
+      ctx_lens.astype(jnp.int32), q, k_pool, v_pool)
 
 
 def paged_attention_pallas(q, k_pool, v_pool, block_tables, ctx_lens,
                            sm_scale: Optional[float] = None,
                            interpret: Optional[bool] = None):
-    if sm_scale is None:
-        sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    if interpret is None:
-        interpret = _use_interpret()
-    b, h, d = q.shape
-    _, bs, _, _ = k_pool.shape
-    m = block_tables.shape[1]
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # block_tables, ctx_lens
-        grid=(b, m),
-        in_specs=[
-            pl.BlockSpec((1, h, d), lambda bi, mi, tbl, lens: (bi, 0, 0)),
-            pl.BlockSpec((1, bs, h, d),
-                         lambda bi, mi, tbl, lens: (tbl[bi, mi], 0, 0, 0)),
-            pl.BlockSpec((1, bs, h, d),
-                         lambda bi, mi, tbl, lens: (tbl[bi, mi], 0, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, h, d),
-                               lambda bi, mi, tbl, lens: (bi, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((h, d), jnp.float32),   # acc
-            pltpu.VMEM((h, 1), jnp.float32),   # running max
-            pltpu.VMEM((h, 1), jnp.float32),   # running denom
-        ],
-    )
-    kern = functools.partial(_paged_kernel, block_size=bs,
-                             sm_scale=sm_scale, num_blocks=m)
-    return pl.pallas_call(
-        kern,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
-        interpret=interpret,
-    )(block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32),
-      q, k_pool, v_pool)
+    """Single-token decode kernel: Cq == 1 delegation to the ragged
+    kernel (same visible-count ctx_lens convention as the reference
+    specialization above)."""
+    ctx = jnp.asarray(ctx_lens)
+    out = ragged_paged_attention_pallas(
+        q[:, None], k_pool, v_pool, block_tables,
+        jnp.ones_like(ctx), ctx - 1, sm_scale, interpret)
+    return out[:, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -237,3 +307,18 @@ def paged_attention(q, k_pool, v_pool, block_tables, ctx_lens,
                                       ctx_lens, sm_scale)
     return paged_attention_reference(q, k_pool, v_pool, block_tables,
                                      ctx_lens, sm_scale)
+
+
+def ragged_paged_attention(q, k_pool, v_pool, block_tables, q_lens,
+                           ctx_lens, sm_scale: Optional[float] = None):
+    """Mixed prefill+decode attention over the paged KV pool: q
+    `[B, Cq, H, D]` with per-row true query length (1 = decode, chunk
+    width = prefill). Routed by the same FLAGS_paged_attention_kernel
+    seam as the decode entry."""
+    from ..flags import get_flag
+    mode = get_flag("FLAGS_paged_attention_kernel")
+    if mode == "pallas" and _HAS_PLTPU:
+        return ragged_paged_attention_pallas(
+            q, k_pool, v_pool, block_tables, q_lens, ctx_lens, sm_scale)
+    return ragged_paged_attention_reference(
+        q, k_pool, v_pool, block_tables, q_lens, ctx_lens, sm_scale)

@@ -641,3 +641,68 @@ def test_preemption_replay_under_injected_decode_fault(gparams):
     got = {r.request_id: r.tokens for r in out}
     assert got == want
     assert timer_get("TIMER_generation_ttft_us")["count"] == t0 + 3
+
+
+def test_prefill_chunk_fault_resumes_with_no_duplication(gparams):
+    """PR-10 satellite: a generation.prefill_chunk fault fires BETWEEN
+    chunks of a mid-flight prompt, before the step mutates anything —
+    re-stepping resumes the prompt stream exactly where it stopped.
+    Stream equality with a fault-free run proves no prompt token was
+    scattered twice (a duplicated write would corrupt the KV pool and
+    diverge the logits)."""
+    def req():
+        return GenerationRequest(prompt=[3, 1, 4, 1, 5, 9, 2, 6, 5, 3],
+                                 max_new_tokens=6,
+                                 sampling=SamplingParams(temperature=0.8,
+                                                         seed=2),
+                                 request_id="A")
+
+    base = _gengine(gparams, prefill_chunk=4).generate([req()])[0]
+    eng = _gengine(gparams, prefill_chunk=4)  # 10-token prompt: 3 chunks
+    eng.submit(req())
+    failpoints.arm_spec("generation.prefill_chunk=raise@every(2)")
+    faults, out, steps = 0, [], 0
+    try:
+        while not eng.idle and steps < 500:
+            steps += 1
+            try:
+                out.extend(eng.step())
+            except InjectedFault:
+                faults += 1  # re-step resumes the same chunk
+    finally:
+        failpoints.disarm("generation.prefill_chunk")
+    assert eng.idle and faults >= 1  # fired at a chunk boundary
+    assert out[0].tokens == base.tokens
+
+
+def test_generation_pool_recovers_mid_prompt_chunk_fault(flag_guard,
+                                                         gparams):
+    """PR-10 satellite: a fault injected mid-prompt (between prefill
+    chunks) crashes the worker; the PR-9 supervisor restarts the pool
+    and a resubmitted request regenerates the identical stream — no
+    token duplicated, none lost."""
+    pt.set_flags({"FLAGS_pool_restart_backoff_ms": 1.0,
+                  "FLAGS_pool_max_restarts": 3})
+    pool = GenerationPool(_gengine(gparams, prefill_chunk=4))
+    try:
+        def req():
+            return GenerationRequest(prompt=[2] * 11, max_new_tokens=5,
+                                     sampling=SamplingParams(seed=1))
+        base = pool.run(req(), timeout=120.0)
+        r0 = stat_get("STAT_generation_restarts")
+        failpoints.arm_spec("generation.prefill_chunk=raise@once")
+        with pytest.raises(PoolRestarted) as ei:
+            pool.run(req(), timeout=120.0)
+        assert ei.value.trace_id
+        out, deadline = None, time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            try:
+                out = pool.run(req(), timeout=10.0)
+                break
+            except (PoolRestarted, ServingQueueFull, TimeoutError):
+                time.sleep(0.05)
+        assert out is not None
+        assert out.tokens == base.tokens
+        assert stat_get("STAT_generation_restarts") == r0 + 1
+    finally:
+        pool.close()

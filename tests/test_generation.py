@@ -449,6 +449,158 @@ def test_decode_width_one_matches_width_four(params):
 
 
 # ---------------------------------------------------------------------------
+# chunked prefill + mixed step (PR 10)
+# ---------------------------------------------------------------------------
+
+def test_chunked_streams_match_two_phase_and_naive(params):
+    """The chunked mixed step (default) produces bitwise the SAME token
+    streams as the PR-5 two-phase engine (prefill_chunk=0) and the
+    naive full-recompute oracle — the sampler step indices and the
+    paged logits are identical in all three."""
+    rng = np.random.default_rng(11)
+    reqs = [GenerationRequest(
+        prompt=list(rng.integers(1, CFG.vocab_size,
+                                 int(rng.integers(2, 14)))),
+        max_new_tokens=int(rng.integers(3, 9)),
+        sampling=SamplingParams(temperature=0.7 if i % 2 else 0.0,
+                                seed=i),
+        request_id=i) for i in range(6)]
+    chunked = _engine(params, prefill_chunk=3)
+    two_phase = _engine(params, prefill_chunk=0)
+    a = {r.request_id: r.tokens for r in chunked.generate(
+        [GenerationRequest(**r.__dict__) for r in reqs])}
+    b = {r.request_id: r.tokens for r in two_phase.generate(
+        [GenerationRequest(**r.__dict__) for r in reqs])}
+    assert a == b
+    naive = NaiveGenerator(CFG, params, buckets="pow2:16",
+                           attn_lanes=chunked.attn_lanes)
+    for r in reqs:
+        assert naive.generate(r).tokens == a[r.request_id]
+
+
+def test_decode_advances_during_chunked_prefill(params):
+    """No head-of-line blocking: while a long prompt streams through
+    chunked prefill, every already-decoding lane gains exactly one
+    token per step (the acceptance pin)."""
+    eng = _engine(params, decode_width=2, prefill_chunk=2)
+    eng.submit(GenerationRequest(prompt=[3, 1, 4], max_new_tokens=20,
+                                 request_id="A"))
+    eng.step()  # admit + first chunk(s) of A
+    a_seq = next(s for s in eng._lane_seq
+                 if s is not None and s.req.request_id == "A")
+    while not a_seq.generated:
+        eng.step()  # finish A's prefill: A is now decoding
+    eng.submit(GenerationRequest(prompt=[2] * 24, max_new_tokens=2,
+                                 request_id="B"))
+    eng.step()  # admits B; its 24-token prompt needs 12 chunked steps
+    b_seq = next(s for s in eng._lane_seq
+                 if s is not None and s.req.request_id == "B")
+    assert b_seq.prefilled < len(b_seq.req.prompt)
+    steps_during_prefill = 0
+    while b_seq.prefilled < len(b_seq.req.prompt):
+        before = len(a_seq.generated)
+        eng.step()
+        steps_during_prefill += 1
+        assert len(a_seq.generated) == before + 1, \
+            "decode lane stalled while B prefilled"
+    assert steps_during_prefill >= 5  # B really was long
+
+
+def test_pad_tokens_stat_emitted(params):
+    """STAT_generation_pad_tokens: the two-phase engine pays bucket
+    padding per prefill, the chunked engine only unused mixed-batch
+    slots — both emit the stat (satellite: pad waste is observable)."""
+    p0 = stat_get("STAT_generation_pad_tokens")
+    two_phase = _engine(params, prefill_chunk=0)
+    two_phase.generate([GenerationRequest(prompt=[1] * 5,
+                                          max_new_tokens=2,
+                                          request_id=0)])
+    # prompt 5 pads to bucket 8: at least 3 pad tokens from prefill
+    assert stat_get("STAT_generation_pad_tokens") >= p0 + 3
+    p1 = stat_get("STAT_generation_pad_tokens")
+    chunked = _engine(params, prefill_chunk=4)
+    chunked.generate([GenerationRequest(prompt=[1] * 5,
+                                        max_new_tokens=2,
+                                        request_id=0)])
+    # mixed steps with one lone sequence leave unused slots
+    assert stat_get("STAT_generation_pad_tokens") > p1
+
+
+def test_replayed_request_survives_admit_fault_and_keeps_priority(
+        params):
+    """Scheduler fairness regression (satellite): a transient fault on
+    a REPLAYED request's re-admission (injected generation.kv_alloc
+    raise) must neither kill the request nor let a never-started
+    request overtake it."""
+    from paddle_tpu import failpoints as fp
+    eng = _engine(params)
+    eng.submit(GenerationRequest(
+        prompt=[5, 4, 3], max_new_tokens=8,
+        sampling=SamplingParams(temperature=0.8, seed=9),
+        request_id="A"))
+    eng.step()
+    for _ in range(3):
+        eng.step()  # A decodes a few tokens
+    assert eng._preempt_youngest()  # manufacture a replay of A
+    assert eng._pending[0].req.request_id == "A"
+    assert eng._pending[0].evictions == 1
+    eng.submit(GenerationRequest(prompt=[7, 7], max_new_tokens=2,
+                                 request_id="B"))  # never started
+    r0 = stat_get("STAT_generation_replay_retries")
+    e0 = stat_get("STAT_generation_errors")
+    fp.arm_spec("generation.kv_alloc=raise@once")
+    try:
+        eng.step()  # re-admission faults: must NOT raise or kill A
+    finally:
+        fp.disarm("generation.kv_alloc")
+    assert stat_get("STAT_generation_replay_retries") == r0 + 1
+    assert stat_get("STAT_generation_errors") == e0
+    # fairness: A still first in line, B did not overtake it
+    assert [s.req.request_id for s in eng._pending] == ["A", "B"]
+    out = {}
+    while not eng.idle:
+        for r in eng.step():
+            out[r.request_id] = r.tokens
+    # deterministic replay straight through the fault
+    relaxed = _engine(params).generate([GenerationRequest(
+        prompt=[5, 4, 3], max_new_tokens=8,
+        sampling=SamplingParams(temperature=0.8, seed=9),
+        request_id="A")])[0]
+    assert out["A"] == relaxed.tokens
+
+
+def test_preemption_replay_through_mid_prefill_chunk(params):
+    """Eviction determinism extended to chunked prefill: preempting a
+    sequence WHILE its prompt is mid-chunk-stream replays the whole
+    prompt from scratch and regenerates the identical stream."""
+    eng = _engine(params, prefill_chunk=2)
+    req = GenerationRequest(prompt=[2] * 14, max_new_tokens=6,
+                            sampling=SamplingParams(temperature=0.9,
+                                                    seed=4),
+                            request_id="A")
+    eng.submit(GenerationRequest(**req.__dict__))
+    eng.step()  # admitted, first chunk in
+    seq = next(s for s in eng._lane_seq if s is not None)
+    assert 0 < seq.prefilled < len(seq.req.prompt)  # mid-prefill
+    assert eng._preempt_youngest()
+    out = {}
+    while not eng.idle:
+        for r in eng.step():
+            out[r.request_id] = r
+    assert out["A"].evictions == 1
+    relaxed = _engine(params).generate(
+        [GenerationRequest(**req.__dict__)])[0]
+    assert out["A"].tokens == relaxed.tokens
+
+
+def test_token_budget_validation(params):
+    with pytest.raises(ValueError):
+        _engine(params, prefill_chunk=4, token_budget=2)  # < width 4
+    eng = _engine(params, prefill_chunk=4, token_budget=0)
+    assert eng.token_budget == eng.decode_width + 4
+
+
+# ---------------------------------------------------------------------------
 # acceptance bench (slow: runs the full bench.py generation block)
 # ---------------------------------------------------------------------------
 
@@ -470,3 +622,26 @@ def test_generation_bench_acceptance(tmp_path, monkeypatch):
     assert block["steady_state_recompiles"] == 0
     assert block["speedup_paged_vs_naive"] >= 2.0
     assert block["decode_step_p95_regressions"] == []
+
+
+@pytest.mark.slow
+def test_generation_mixed_bench_acceptance(tmp_path, monkeypatch):
+    """ISSUE-10 acceptance: chunked prefill >= 1.3x two-phase
+    generated tokens/s AND lower decode-TPOT p95 on the prompt-heavy
+    mixed workload, zero steady-state recompiles, streams bitwise
+    identical across naive/two-phase/chunked."""
+    import importlib.util
+    import os
+    monkeypatch.setenv("PT_GENERATION_MIXED_BENCH_SNAPSHOT",
+                       str(tmp_path / "gen_mixed_snap.json"))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "pt_bench", os.path.join(repo, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    block = mod.bench_generation_mixed()
+    assert block["tokens_bitwise_identical"] is True
+    assert block["chunked"]["steady_state_recompiles"] == 0
+    assert block["meets_1p3x"] is True
+    assert block["decode_tpot_p95_improved"] is True
+    assert block["chunked"]["pad_ratio"] < block["two_phase"]["pad_ratio"]

@@ -392,3 +392,151 @@ def test_write_parity_stamp_resets_memo(_stamp_env):
         assert fa._inkernel_parity_ok() is False
     fa.write_parity_stamp()
     assert fa._inkernel_parity_ok() is True
+
+
+# ---------------------------------------------------------------------------
+# ragged paged attention (PR 10): mixed prefill+decode batches
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.generation import (DecoderConfig, KVCacheManager,  # noqa: E402
+                                   forward_full, forward_paged,
+                                   init_params)
+from paddle_tpu.kernels.paged_attention import (  # noqa: E402
+    paged_attention_reference, ragged_paged_attention,
+    ragged_paged_attention_pallas, ragged_paged_attention_reference)
+
+
+def _bits(a):
+    return np.asarray(a, np.float32).view(np.uint32)
+
+
+def _ragged_case(seed=0):
+    rng = np.random.default_rng(seed)
+    b, cq, h, d, bs, n, m = 4, 4, 4, 8, 4, 16, 4
+    q = jnp.asarray(rng.normal(size=(b, cq, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(n, bs, h, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(n, bs, h, d)), jnp.float32)
+    tbl = jnp.asarray(rng.integers(1, n, (b, m)), jnp.int32)
+    # mixed batch: full chunk, decode single, short chunk, decode single
+    q_lens = jnp.asarray([4, 1, 2, 1], jnp.int32)
+    ctx = jnp.asarray([5, 9, 0, 3], jnp.int32)
+    return q, kp, vp, tbl, q_lens, ctx
+
+
+def test_ragged_reference_bitwise_matches_per_token_decode():
+    """Every real query row of the ragged reference equals the Cq == 1
+    decode path at the same absolute position, bit for bit — chunked
+    prefill and single-token decode share one numerics contract."""
+    q, kp, vp, tbl, q_lens, ctx = _ragged_case()
+    out = ragged_paged_attention_reference(q, kp, vp, tbl, q_lens, ctx)
+    for i in range(q.shape[0]):
+        for j in range(int(q_lens[i])):
+            one = paged_attention_reference(
+                q[i:i + 1, j], kp, vp, tbl[i:i + 1], ctx[i:i + 1] + j + 1)
+            assert np.array_equal(_bits(out[i, j]), _bits(one[0])), \
+                "row %d query %d diverged" % (i, j)
+
+
+def test_ragged_pallas_interpret_matches_reference():
+    q, kp, vp, tbl, q_lens, ctx = _ragged_case(seed=3)
+    ref = ragged_paged_attention_reference(q, kp, vp, tbl, q_lens, ctx)
+    pal = ragged_paged_attention_pallas(q, kp, vp, tbl, q_lens, ctx)
+    # compare only real rows: fully-masked rows intentionally differ
+    # (reference degrades to a uniform average, the kernel emits 0)
+    for i in range(q.shape[0]):
+        for j in range(int(q_lens[i])):
+            np.testing.assert_allclose(
+                np.asarray(pal[i, j]), np.asarray(ref[i, j]),
+                atol=2e-5, rtol=2e-5)
+
+
+def test_ragged_flag_seam():
+    """FLAGS_paged_attention_kernel routes the ragged entry exactly
+    like the decode entry."""
+    from paddle_tpu.flags import get_flags, set_flags
+    q, kp, vp, tbl, q_lens, ctx = _ragged_case(seed=7)
+    ref = ragged_paged_attention_reference(q, kp, vp, tbl, q_lens, ctx)
+    prior = get_flags(["FLAGS_paged_attention_kernel"])
+    try:
+        set_flags({"FLAGS_paged_attention_kernel": "pallas"})
+        pal = ragged_paged_attention(q, kp, vp, tbl, q_lens, ctx)
+    finally:
+        set_flags(prior)
+    for i in range(q.shape[0]):
+        for j in range(int(q_lens[i])):
+            np.testing.assert_allclose(
+                np.asarray(pal[i, j]), np.asarray(ref[i, j]),
+                atol=2e-5, rtol=2e-5)
+    routed = ragged_paged_attention(q, kp, vp, tbl, q_lens, ctx)
+    assert np.array_equal(_bits(routed), _bits(ref))
+
+
+def test_chunked_prefill_mixed_batch_bitwise_vs_forward_full():
+    """PR-5's paged==full parity pin extended to chunked prefill: a
+    prompt streamed through the mixed step in 4-token chunks — sharing
+    its batch with a concurrently DECODING sequence — produces, at
+    every prompt position and every decode step, logits bitwise equal
+    to a full-context forward_full recompute."""
+    cfg = DecoderConfig(vocab_size=64, hidden=32, layers=2, heads=4,
+                        max_seq_len=32)
+    params = init_params(cfg, seed=0)
+    bs, nblocks, t_slots = 4, 32, 5
+    m = -(-cfg.max_seq_len // bs)
+    lanes = m * bs
+    rng = np.random.default_rng(5)
+    pa = [int(x) for x in rng.integers(1, cfg.vocab_size, 13)]
+    pb = [int(x) for x in rng.integers(1, cfg.vocab_size, 5)]
+    sb = 32
+    ff = jax.jit(lambda p, t, l: forward_full(cfg, p, t, l,
+                                              attn_lanes=lanes))
+
+    def oracle(tokens):
+        padded = np.zeros((1, sb), np.int32)
+        padded[0, :len(tokens)] = tokens
+        return ff(params, jnp.asarray(padded),
+                  jnp.asarray([len(tokens)], np.int32))[0][0]
+
+    step = jax.jit(lambda p, k, v, tb, c, x: forward_paged(
+        cfg, p, k, v, tb, c, x))
+    mgr = KVCacheManager(nblocks, bs)
+    shape = (cfg.layers, nblocks, bs, cfg.heads, cfg.head_dim)
+    kp = jnp.zeros(shape, jnp.float32)
+    vp = jnp.zeros(shape, jnp.float32)
+    mgr.alloc("A", mgr.blocks_for_tokens(len(pa) + 1))
+    mgr.alloc("B", mgr.blocks_for_tokens(len(pb) + 6))
+    ta = np.asarray(mgr.table("A", m), np.int32)
+    tb_ = np.asarray(mgr.table("B", m), np.int32)
+
+    tables = np.zeros((t_slots, m), np.int32)
+    pos = np.zeros((t_slots,), np.int32)
+    toks = np.zeros((t_slots,), np.int32)
+    # step 0: B's whole prompt rides in as one chunk
+    for j in range(len(pb)):
+        tables[j], pos[j], toks[j] = tb_, j, pb[j]
+    logits, kp, vp = step(params, kp, vp, jnp.asarray(tables),
+                          jnp.asarray(pos), jnp.asarray(toks))
+    for j in range(len(pb)):
+        assert np.array_equal(_bits(logits[j]), _bits(oracle(pb[:j + 1])))
+    btoks = pb + [int(np.argmax(np.asarray(logits[len(pb) - 1])))]
+    # A's 13-token prompt streams in chunks of 4 while B greedy-decodes
+    filled = 0
+    while filled < len(pa):
+        take = min(4, len(pa) - filled)
+        tables[:], pos[:], toks[:] = 0, 0, 0
+        tables[0], pos[0], toks[0] = tb_, len(btoks) - 1, btoks[-1]
+        for j in range(take):
+            tables[1 + j] = ta
+            pos[1 + j] = filled + j
+            toks[1 + j] = pa[filled + j]
+        logits, kp, vp = step(params, kp, vp, jnp.asarray(tables),
+                              jnp.asarray(pos), jnp.asarray(toks))
+        assert np.array_equal(_bits(logits[0]), _bits(oracle(btoks))), \
+            "decode lane diverged while chunk [%d:%d) prefilled" \
+            % (filled, filled + take)
+        for j in range(take):
+            assert np.array_equal(
+                _bits(logits[1 + j]),
+                _bits(oracle(pa[:filled + j + 1]))), \
+                "chunked prefill diverged at position %d" % (filled + j)
+        btoks.append(int(np.argmax(np.asarray(logits[0]))))
+        filled += take
