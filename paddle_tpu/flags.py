@@ -175,6 +175,15 @@ _DEFS: Dict[str, Any] = {
     # clear). Disarmed sites cost ONE dict lookup — the same
     # zero-overhead contract as FLAGS_request_tracing, pinned by test.
     "FLAGS_failpoints": "",
+    # SLO engine (slo.py, docs/observability.md): windowed metrics +
+    # objective evaluation + burn-rate alerts + /sloz. OFF by default;
+    # the disabled path (slo.evaluate returns None) is one dict lookup,
+    # same contract as FLAGS_request_tracing/FLAGS_failpoints, pinned
+    # by test. Enabling turns on monitor windowed aggregation with
+    # FLAGS_slo_bucket_s sub-buckets x FLAGS_slo_buckets of history.
+    "FLAGS_slo": False,
+    "FLAGS_slo_bucket_s": 10.0,
+    "FLAGS_slo_buckets": 360,
     # supervised pool recovery (serving.PredictorPool /
     # generation.GenerationPool): on a worker-loop crash the pool
     # restarts the serve loop with capped exponential backoff, failing
@@ -246,6 +255,13 @@ def set_flags(flags: Dict[str, Any]) -> None:
             # import nothing from flags at module level and vice versa.
             from paddle_tpu import failpoints as _fp
             _fp.arm_spec(v)
+        elif k == "FLAGS_slo":
+            # activate/deactivate the SLO engine (windowed aggregation
+            # + default objectives) as a side effect, mirroring the
+            # failpoints arm_spec wiring above. Lazy import for the
+            # same no-cycle reason.
+            from paddle_tpu import slo as _slo
+            _slo._sync_from_flag(bool(v))
 
 
 def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, Any]:

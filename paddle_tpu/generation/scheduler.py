@@ -151,15 +151,19 @@ class GenerationPool:
 
     def submit(self, req: GenerationRequest,
                timeout: Optional[float] = None,
-               deadline: Optional[float] = None) -> _Future:
+               deadline: Optional[float] = None,
+               tenant: Optional[str] = None) -> _Future:
         """Enqueue one request; returns a future whose .result() is a
         GenerationResult. Blocks while the queue is full, then raises
         ServingQueueFull — the same backpressure contract as
         serving.PredictorPool.submit. `deadline` arms a latency budget
         (seconds) on the request's trace: STAT_generation_deadline_missed
-        + per-stage budget burn when blown (never cancels)."""
+        + per-stage budget burn when blown (never cancels). `tenant`
+        attributes the request to a workload (labeled per-tenant
+        series at finish; /tracez?tenant= filter)."""
         fut = _Future()
-        fut.trace = _tr.begin("generation", deadline=deadline)
+        fut.trace = _tr.begin("generation", deadline=deadline,
+                              tenant=tenant)
         # ONE shared budget: the enqueue wait is bounded by timeout AND
         # by the request's own deadline (serving.PredictorPool.submit
         # has the same contract)
@@ -216,14 +220,17 @@ class GenerationPool:
 
     def run(self, req: GenerationRequest,
             timeout: Optional[float] = None,
-            deadline: Optional[float] = None):
+            deadline: Optional[float] = None,
+            tenant: Optional[str] = None):
         """Blocking submit+wait. `timeout` is ONE budget shared by the
         enqueue wait and the result wait (it used to be handed to both,
         so a 1 s budget could block ~2 s)."""
         if timeout is None:
-            return self.submit(req, deadline=deadline).result()
+            return self.submit(req, deadline=deadline,
+                               tenant=tenant).result()
         t_end = time.monotonic() + timeout
-        fut = self.submit(req, timeout=timeout, deadline=deadline)
+        fut = self.submit(req, timeout=timeout, deadline=deadline,
+                          tenant=tenant)
         return fut.result(max(0.0, t_end - time.monotonic()))
 
     # --- worker --------------------------------------------------------

@@ -23,7 +23,13 @@ code could. Endpoints:
 - ``/tracez``    request-lifecycle traces (tracing.py): rolling
                  TTFT/TPOT/stage-decomposition latencies, recently
                  completed traces, and the slow/errored exemplar ring
-                 (text; ``?format=json`` for the raw payload)
+                 (text; ``?format=json`` for the raw payload;
+                 ``?tenant=`` filters recent/exemplars to one tenant)
+- ``/sloz``      SLO engine (slo.py, FLAGS_slo): objectives with
+                 windowed good-ratios, error-budget remaining,
+                 fast/slow burn rates and alert state, autoscaling
+                 signals, per-tenant accounting (text;
+                 ``?format=json`` for the raw payload)
 - ``/failpointz`` fault injection (failpoints.py, docs/robustness.md):
                  GET lists every known site with its armed spec and
                  calls/fires hit counts; POST arms
@@ -187,9 +193,17 @@ def statusz() -> Dict[str, Any]:
         },
         "flight_recorder_steps": len(telemetry.flight_records()),
         "tracing": _tracing_status(counters),
+        "slo": _slo_status(),
         "failpoints_armed": _armed_failpoints(),
         "readiness": {"ready": ready, "checks": checks},
     }
+
+
+def _slo_status() -> Dict[str, Any]:
+    """The /statusz "slo" section (slo.status_summary: enabled +
+    objective count + firing alerts + autoscaling signals)."""
+    from . import slo
+    return slo.status_summary()
 
 
 def _armed_failpoints() -> Dict[str, str]:
@@ -279,10 +293,20 @@ class _Handler(BaseHTTPRequestHandler):
             elif url.path == "/tracez":
                 from . import tracing
                 q = parse_qs(url.query)
+                tenant = q.get("tenant", [None])[0]
                 if q.get("format", [""])[0] == "json":
-                    self._json(tracing.tracez())
+                    self._json(tracing.tracez(tenant=tenant))
                 else:
-                    self._send(200, tracing.tracez_text() + "\n",
+                    self._send(
+                        200, tracing.tracez_text(tenant=tenant) + "\n",
+                        "text/plain; charset=utf-8")
+            elif url.path == "/sloz":
+                from . import slo
+                q = parse_qs(url.query)
+                if q.get("format", [""])[0] == "json":
+                    self._json(slo.sloz())
+                else:
+                    self._send(200, slo.sloz_text(),
                                "text/plain; charset=utf-8")
             elif url.path == "/flightz":
                 from . import telemetry
@@ -300,7 +324,7 @@ class _Handler(BaseHTTPRequestHandler):
                     200,
                     "paddle_tpu introspection: /metrics /healthz "
                     "/readyz /statusz /flightz /programz /tracez "
-                    "/failpointz\n",
+                    "/sloz /failpointz\n",
                     "text/plain; charset=utf-8")
             else:
                 self._send(404, "not found: %s\n" % url.path,

@@ -129,7 +129,9 @@ from __future__ import annotations
 
 import json
 import threading
-from typing import Dict, List, Optional
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
 
 _LOCK = threading.Lock()
 _STATS: Dict[str, float] = {}
@@ -171,6 +173,7 @@ class _Timer:
     def stats(self) -> Dict[str, float]:
         if not self.count:
             return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                    "ring_min": 0.0, "ring_max": 0.0,
                     "p50": 0.0, "p95": 0.0}
         s = sorted(self.ring)
         n = len(s)
@@ -178,9 +181,296 @@ class _Timer:
         def q(p: float) -> float:
             return s[min(n - 1, int(p * (n - 1) + 0.5))]
 
+        # min/max are ALL-TIME extremes; p50/p95 come from the ring of
+        # the last _TIMER_RING samples. ring_min/ring_max share the
+        # ring's time base so one scrape line can be read consistently
+        # against the quantiles (pinned by test_telemetry).
         return {"count": self.count, "sum": self.sum,
                 "min": self.min, "max": self.max,
+                "ring_min": s[0], "ring_max": s[-1],
                 "p50": q(0.50), "p95": q(0.95)}
+
+
+# ---------------------------------------------------------------------------
+# time-windowed aggregation (docs/observability.md, slo.py)
+# ---------------------------------------------------------------------------
+#
+# All-time counters can't rate and the _Timer ring can't answer "p95
+# over the last 5 minutes", so SLO evaluation needs a second, windowed
+# view. Multi-resolution on the cheap: every write lands in a
+# fixed-duration sub-bucket (default 10s); any window (1m/5m/1h) is
+# composed from sub-buckets at READ time, so one write feeds every
+# window. Buckets live in sparse bounded deques — an idle instrument
+# costs nothing, a busy one is capped at n_buckets entries.
+#
+# Disabled by default: when _WINDOWS is None the only cost on the hot
+# write paths is one attribute load + `is not None` test under the
+# already-held _LOCK. slo.py enables this from FLAGS_slo; monitor stays
+# flag-free.
+
+# per-bucket sample reservoir for windowed quantiles: deterministic
+# overwrite (newest wins) keeps memory bounded without randomness
+_WINDOW_RESERVOIR = 64
+
+
+class _Windows:
+    """Sub-bucketed rolling state for every instrument kind.
+
+    Bucket entries (mutated in place while current):
+      counters: [bucket_id, sum]
+      timers:   [bucket_id, count, sum, min, max, samples]
+      gauges:   [bucket_id, last_value]
+    All access happens under the registry _LOCK.
+    """
+
+    __slots__ = ("bucket_s", "n_buckets", "clock",
+                 "counters", "timers", "gauges")
+
+    def __init__(self, bucket_s: float = 10.0, n_buckets: int = 360,
+                 clock=None):
+        self.bucket_s = float(bucket_s)
+        self.n_buckets = int(n_buckets)
+        self.clock = clock if clock is not None else time.monotonic
+        self.counters: Dict[str, deque] = {}
+        self.timers: Dict[str, deque] = {}
+        self.gauges: Dict[str, deque] = {}
+
+    def _bid(self) -> int:
+        return int(self.clock() / self.bucket_s)
+
+    def record_counter(self, name: str, v: float) -> None:
+        bid = self._bid()
+        dq = self.counters.get(name)
+        if dq is None:
+            dq = self.counters[name] = deque(maxlen=self.n_buckets)
+        if dq and dq[-1][0] == bid:
+            dq[-1][1] += v
+        else:
+            dq.append([bid, v])
+
+    def record_timer(self, name: str, v: float) -> None:
+        bid = self._bid()
+        dq = self.timers.get(name)
+        if dq is None:
+            dq = self.timers[name] = deque(maxlen=self.n_buckets)
+        if dq and dq[-1][0] == bid:
+            e = dq[-1]
+            e[1] += 1
+            e[2] += v
+            if v < e[3]:
+                e[3] = v
+            if v > e[4]:
+                e[4] = v
+            if len(e[5]) < _WINDOW_RESERVOIR:
+                e[5].append(v)
+            else:
+                e[5][e[1] % _WINDOW_RESERVOIR] = v
+        else:
+            dq.append([bid, 1, v, v, v, [v]])
+
+    def record_gauge(self, name: str, v: float) -> None:
+        bid = self._bid()
+        dq = self.gauges.get(name)
+        if dq is None:
+            dq = self.gauges[name] = deque(maxlen=self.n_buckets)
+        if dq and dq[-1][0] == bid:
+            dq[-1][1] = v
+        else:
+            dq.append([bid, v])
+
+    def _min_bid(self, window_s: float, now: float) -> int:
+        # include buckets whose start lies within (now - window_s, now]
+        return int((now - window_s) / self.bucket_s) + 1
+
+
+_WINDOWS: Optional[_Windows] = None
+
+
+def enable_windows(bucket_s: float = 10.0, n_buckets: int = 360,
+                   clock=None) -> None:
+    """Turn on windowed aggregation (idempotent for same config;
+    reconfiguring discards accumulated window state)."""
+    global _WINDOWS
+    with _LOCK:
+        w = _WINDOWS
+        if w is not None and w.bucket_s == float(bucket_s) \
+                and w.n_buckets == int(n_buckets) and clock is None:
+            return
+        _WINDOWS = _Windows(bucket_s, n_buckets, clock)
+
+
+def disable_windows() -> None:
+    global _WINDOWS
+    with _LOCK:
+        _WINDOWS = None
+
+
+def windows_enabled() -> bool:
+    return _WINDOWS is not None
+
+
+def window_config() -> Optional[Dict[str, float]]:
+    with _LOCK:
+        w = _WINDOWS
+        if w is None:
+            return None
+        return {"bucket_s": w.bucket_s, "n_buckets": w.n_buckets,
+                "span_s": w.bucket_s * w.n_buckets}
+
+
+def counter_window_sum(name: str, window_s: float,
+                       now: Optional[float] = None) -> float:
+    """Sum of a counter's increments over the trailing window (0.0 when
+    windows are disabled or the counter never fired in-window)."""
+    with _LOCK:
+        w = _WINDOWS
+        if w is None:
+            return 0.0
+        dq = w.counters.get(name)
+        if not dq:
+            return 0.0
+        t = w.clock() if now is None else now
+        lo = w._min_bid(window_s, t)
+        return float(sum(e[1] for e in dq if e[0] >= lo))
+
+
+def counter_rate(name: str, window_s: float,
+                 now: Optional[float] = None) -> float:
+    """Per-second rate of a counter over the trailing window — QPS,
+    error rate, shed rate. 0.0 when windows are disabled."""
+    with _LOCK:
+        w = _WINDOWS
+        if w is None:
+            return 0.0
+        dq = w.counters.get(name)
+        if not dq:
+            return 0.0
+        t = w.clock() if now is None else now
+        lo = w._min_bid(window_s, t)
+        total = sum(e[1] for e in dq if e[0] >= lo)
+        elapsed = max(t - lo * w.bucket_s, w.bucket_s)
+        return float(total) / elapsed
+
+
+def timer_window(name: str, window_s: float,
+                 now: Optional[float] = None) -> Dict[str, float]:
+    """count/sum/min/max/p50/p95 merged over the trailing window's
+    sub-buckets (quantiles estimated from the per-bucket reservoirs).
+    All-zero when windows are disabled or no samples landed."""
+    zero = {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+            "p50": 0.0, "p95": 0.0}
+    with _LOCK:
+        w = _WINDOWS
+        if w is None:
+            return zero
+        dq = w.timers.get(name)
+        if not dq:
+            return zero
+        t = w.clock() if now is None else now
+        lo = w._min_bid(window_s, t)
+        count, total = 0, 0.0
+        mn, mx = float("inf"), float("-inf")
+        samples: List[float] = []
+        for e in dq:
+            if e[0] < lo:
+                continue
+            count += e[1]
+            total += e[2]
+            if e[3] < mn:
+                mn = e[3]
+            if e[4] > mx:
+                mx = e[4]
+            samples.extend(e[5])
+        if not count:
+            return zero
+        samples.sort()
+        n = len(samples)
+
+        def q(p: float) -> float:
+            return samples[min(n - 1, int(p * (n - 1) + 0.5))]
+
+        return {"count": count, "sum": total, "min": mn, "max": mx,
+                "p50": q(0.50), "p95": q(0.95)}
+
+
+def timer_window_frac_le(name: str, threshold: float, window_s: float,
+                         now: Optional[float] = None) -> Optional[float]:
+    """Estimated fraction of in-window samples <= threshold — the
+    good-ratio a latency SLO reads. Per-bucket reservoir fractions are
+    weighted by true bucket counts. None when windows are disabled or
+    no samples landed in-window."""
+    with _LOCK:
+        w = _WINDOWS
+        if w is None:
+            return None
+        dq = w.timers.get(name)
+        if not dq:
+            return None
+        t = w.clock() if now is None else now
+        lo = w._min_bid(window_s, t)
+        total, good = 0, 0.0
+        for e in dq:
+            if e[0] < lo or not e[5]:
+                continue
+            total += e[1]
+            frac = sum(1 for s in e[5] if s <= threshold) / len(e[5])
+            good += frac * e[1]
+        if not total:
+            return None
+        return good / total
+
+
+def gauge_trend(name: str, window_s: float,
+                now: Optional[float] = None) -> float:
+    """Per-second slope of a gauge over the trailing window — (last −
+    first)/dt across in-window buckets. 0.0 when windows are disabled
+    or fewer than two in-window buckets exist (no trend computable)."""
+    with _LOCK:
+        w = _WINDOWS
+        if w is None:
+            return 0.0
+        dq = w.gauges.get(name)
+        if not dq:
+            return 0.0
+        t = w.clock() if now is None else now
+        lo = w._min_bid(window_s, t)
+        ent = [e for e in dq if e[0] >= lo]
+        if len(ent) < 2:
+            return 0.0
+        dt = (ent[-1][0] - ent[0][0]) * w.bucket_s
+        return (ent[-1][1] - ent[0][1]) / dt if dt else 0.0
+
+
+# ---------------------------------------------------------------------------
+# labels — per-tenant / per-model series in one family
+# ---------------------------------------------------------------------------
+
+def labeled(name: str, labels: Dict[str, str]) -> str:
+    """Compose a labeled series name, Prometheus-style:
+    labeled("STAT_serving_requests", {"tenant": "acme"}) ->
+    'STAT_serving_requests{tenant="acme"}'. The composed string is an
+    ordinary registry key — stat_add/timer_observe/observe_many take it
+    unchanged — and to_prometheus() groups all series of one family
+    under a single # TYPE line. Label keys sort so the same label set
+    always composes the same key; values are escaped per the
+    exposition format."""
+    if not labels:
+        return name
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k]).replace("\\", "\\\\") \
+            .replace('"', '\\"').replace("\n", "\\n")
+        parts.append('%s="%s"' % (k, v))
+    return "%s{%s}" % (name, ",".join(parts))
+
+
+def _split_series(name: str) -> Tuple[str, str]:
+    """Split a (possibly labeled) registry key into (family,
+    label_block) — label_block keeps its braces, '' when unlabeled."""
+    i = name.find("{")
+    if i < 0:
+        return name, ""
+    return name[:i], name[i:]
 
 
 # ---------------------------------------------------------------------------
@@ -190,6 +480,9 @@ class _Timer:
 def stat_add(name: str, value: float = 1.0) -> None:
     with _LOCK:
         _STATS[name] = _STATS.get(name, 0.0) + float(value)
+        w = _WINDOWS
+        if w is not None:
+            w.record_counter(name, float(value))
 
 
 def stat_reset(name: str, value: float = 0.0) -> None:
@@ -221,6 +514,9 @@ def get_int_stats() -> Dict[str, int]:
 def gauge_set(name: str, value: float) -> None:
     with _LOCK:
         _GAUGES[name] = float(value)
+        w = _WINDOWS
+        if w is not None:
+            w.record_gauge(name, float(value))
 
 
 def gauge_get(name: str, default: float = 0.0) -> float:
@@ -239,6 +535,9 @@ def timer_observe(name: str, value: float) -> None:
         if t is None:
             t = _TIMERS[name] = _Timer()
         t.observe(float(value))
+        w = _WINDOWS
+        if w is not None:
+            w.record_timer(name, float(value))
 
 
 def timer_get(name: str) -> Dict[str, float]:
@@ -254,13 +553,18 @@ def observe_many(timers=(), stats=()) -> None:
     per event (tracing.RequestTrace.finish observes a whole latency
     decomposition at once)."""
     with _LOCK:
+        w = _WINDOWS
         for name, v in timers:
             t = _TIMERS.get(name)
             if t is None:
                 t = _TIMERS[name] = _Timer()
             t.observe(float(v))
+            if w is not None:
+                w.record_timer(name, float(v))
         for name, v in stats:
             _STATS[name] = _STATS.get(name, 0.0) + float(v)
+            if w is not None:
+                w.record_counter(name, float(v))
 
 
 # ---------------------------------------------------------------------------
@@ -297,40 +601,82 @@ def _prom_name(name: str) -> str:
     return out
 
 
+def _group_families(series: Dict) -> List[Tuple[str, List[Tuple[str, object]]]]:
+    """Group (possibly labeled) registry keys by family: returns
+    [(family, [(label_block, value), ...])] with families sorted and
+    each family's label blocks sorted — labeled series don't sort
+    adjacent to their base name, so explicit grouping keeps every
+    family's samples contiguous under one # TYPE line."""
+    fams: Dict[str, List[Tuple[str, object]]] = {}
+    for name, v in series.items():
+        fam, lbl = _split_series(name)
+        fams.setdefault(fam, []).append((lbl, v))
+    return [(f, sorted(fams[f])) for f in sorted(fams)]
+
+
+def _merge_label(lbl: str, extra: str) -> str:
+    """Merge one extra label into an existing label block:
+    '{tenant="a"}' + 'quantile="0.5"' -> '{tenant="a",quantile="0.5"}'."""
+    if not lbl:
+        return "{%s}" % extra
+    return lbl[:-1] + "," + extra + "}"
+
+
 def to_prometheus(prefix: str = "paddle_tpu") -> str:
     """Prometheus text exposition format: counters as `<name>_total`,
     gauges as-is, timers as summaries (`_count`/`_sum` + quantile
-    samples). One scrape-able string, same registry as dump()."""
+    samples). Labeled series (see labeled()) render as label blocks on
+    their family's samples, one # TYPE per family. One scrape-able
+    string, same registry as dump()."""
     snap = snapshot()
     lines: List[str] = []
-    for name, v in sorted(snap["counters"].items()):
-        m = "%s_%s_total" % (prefix, _prom_name(name))
+    for fam, entries in _group_families(snap["counters"]):
+        m = "%s_%s_total" % (prefix, _prom_name(fam))
         lines.append("# TYPE %s counter" % m)
-        lines.append("%s %.17g" % (m, v))
-    for name, v in sorted(snap["gauges"].items()):
-        m = "%s_%s" % (prefix, _prom_name(name))
+        for lbl, v in entries:
+            lines.append("%s%s %.17g" % (m, lbl, v))
+    for fam, entries in _group_families(snap["gauges"]):
+        m = "%s_%s" % (prefix, _prom_name(fam))
         lines.append("# TYPE %s gauge" % m)
-        lines.append("%s %.17g" % (m, v))
-    for name, st in sorted(snap["timers"].items()):
-        m = "%s_%s" % (prefix, _prom_name(name))
+        for lbl, v in entries:
+            lines.append("%s%s %.17g" % (m, lbl, v))
+    timer_fams = _group_families(snap["timers"])
+    for fam, entries in timer_fams:
+        m = "%s_%s" % (prefix, _prom_name(fam))
         lines.append("# TYPE %s summary" % m)
-        lines.append('%s{quantile="0.5"} %.17g' % (m, st["p50"]))
-        lines.append('%s{quantile="0.95"} %.17g' % (m, st["p95"]))
-        lines.append("%s_sum %.17g" % (m, st["sum"]))
-        lines.append("%s_count %d" % (m, st["count"]))
-        # a summary family may only contain {quantile}/_sum/_count
-        # samples — strict scrapers reject anything else inside it, so
-        # min/max go out as their own gauge families
-        lines.append("# TYPE %s_min gauge" % m)
-        lines.append("%s_min %.17g" % (m, st["min"] if st["count"] else 0))
-        lines.append("# TYPE %s_max gauge" % m)
-        lines.append("%s_max %.17g" % (m, st["max"] if st["count"] else 0))
+        for lbl, st in entries:
+            lines.append("%s%s %.17g"
+                         % (m, _merge_label(lbl, 'quantile="0.5"'),
+                            st["p50"]))
+            lines.append("%s%s %.17g"
+                         % (m, _merge_label(lbl, 'quantile="0.95"'),
+                            st["p95"]))
+            lines.append("%s_sum%s %.17g" % (m, lbl, st["sum"]))
+            lines.append("%s_count%s %d" % (m, lbl, st["count"]))
+    # a summary family may only contain {quantile}/_sum/_count
+    # samples — strict scrapers reject anything else inside it, so
+    # min/max (all-time) and ring_min/ring_max (quantile window) go
+    # out as their own gauge families
+    for suffix, key in (("min", "min"), ("max", "max"),
+                        ("ring_min", "ring_min"), ("ring_max", "ring_max")):
+        for fam, entries in timer_fams:
+            m = "%s_%s_%s" % (prefix, _prom_name(fam), suffix)
+            lines.append("# TYPE %s gauge" % m)
+            for lbl, st in entries:
+                lines.append("%s%s %.17g"
+                             % (m, lbl, st[key] if st["count"] else 0))
     return "\n".join(lines) + "\n"
 
 
 def reset_all() -> None:
-    """Clear every instrument (bench/test isolation)."""
+    """Clear every instrument (bench/test isolation). Window state is
+    cleared too but the window configuration survives."""
     with _LOCK:
         _STATS.clear()
         _GAUGES.clear()
         _TIMERS.clear()
+        w = _WINDOWS
+        if w is not None:
+            w.counters.clear()
+            w.timers.clear()
+            w.gauges.clear()

@@ -127,6 +127,18 @@ def init_distributed_runtime(coordinator_address: Optional[str] = None,
         raise RuntimeError(
             "multi-process init needs PADDLE_TRAINER_ENDPOINTS or "
             "PADDLE_COORDINATOR_ENDPOINT (launch/spawn set these)")
+    # CPU backends need an explicit cross-process collectives impl:
+    # without it XLA:CPU refuses multi-process computations outright
+    # ("Multiprocess computations aren't implemented on the CPU
+    # backend"). Gloo ships in jaxlib; select it before the backend
+    # initializes. TPU/GPU use their native fabrics and ignore this.
+    platforms = jax.config.jax_platforms \
+        or os.environ.get("JAX_PLATFORMS", "")
+    if platforms == "cpu":
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # pragma: no cover - jaxlib without gloo
+            pass
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=n, process_id=rank)
     _dist_initialized = True

@@ -298,14 +298,18 @@ class PredictorPool:
         return report
 
     def submit(self, feeds: Sequence, timeout: Optional[float] = None,
-               deadline: Optional[float] = None):
+               deadline: Optional[float] = None,
+               tenant: Optional[str] = None):
         """Enqueue one request; returns a future with .result(timeout).
         Blocks while the queue is at FLAGS_predictor_queue_depth, then
         raises ServingQueueFull (timeout=None blocks indefinitely).
         `deadline` arms a latency budget in seconds on the request's
         trace: a trace finishing past it bumps
         STAT_serving_deadline_missed and attributes the budget burn
-        per stage (it does NOT cancel the request)."""
+        per stage (it does NOT cancel the request). `tenant` attributes
+        the request to a workload: its trace and the labeled per-tenant
+        counter/timer series (slo.tenants(), /tracez?tenant=) carry
+        it."""
         arrs = [np.asarray(v) for v in feeds]
         names = self.predictor.feed_names
         if len(arrs) != len(names):
@@ -320,7 +324,7 @@ class PredictorPool:
         req = _Request(arrs, rows.pop(), _request_sig(arrs))
         if req.rows == 0:
             raise ValueError("empty-batch request")
-        tr = _tr.begin("serving", deadline=deadline)
+        tr = _tr.begin("serving", deadline=deadline, tenant=tenant)
         req.future.trace = tr
         tr.note(rows=req.rows)
         # ONE shared budget (PR 8 contract, extended): the enqueue wait
@@ -390,15 +394,18 @@ class PredictorPool:
         return per_batch * batches
 
     def run(self, feeds: Sequence, timeout: Optional[float] = None,
-            deadline: Optional[float] = None) -> List[np.ndarray]:
+            deadline: Optional[float] = None,
+            tenant: Optional[str] = None) -> List[np.ndarray]:
         """Blocking submit+wait — the thread-safe drop-in for
         Predictor.run(feeds). `timeout` is ONE budget shared by the
         enqueue wait and the result wait (it used to be handed to both,
         so a 1 s budget could block ~2 s)."""
         if timeout is None:
-            return self.submit(feeds, deadline=deadline).result()
+            return self.submit(feeds, deadline=deadline,
+                               tenant=tenant).result()
         t_end = time.monotonic() + timeout
-        fut = self.submit(feeds, timeout=timeout, deadline=deadline)
+        fut = self.submit(feeds, timeout=timeout, deadline=deadline,
+                          tenant=tenant)
         return fut.result(max(0.0, t_end - time.monotonic()))
 
     # --- batcher -------------------------------------------------------

@@ -1,0 +1,466 @@
+"""SLO engine: objectives, error budgets, burn-rate alerts, /sloz.
+
+ROADMAP item 3's front door (multi-tenant quotas, SLO-aware shedding,
+autoscaling) needs windowed SLO state to consume — raw counters and
+all-time quantiles can't answer "are we inside the TTFT objective over
+the last 5 minutes, and how fast are we burning budget". This module is
+that layer, built on monitor.py's windowed aggregation (enable_windows)
+in the SRE-workbook style:
+
+- **objectives** — a registry of `Objective`s, two kinds:
+  * latency: "p95-style" objectives expressed as a good-ratio — the
+    fraction of TIMER_* samples under a threshold must stay >= target
+    ("95% of serving requests complete in < 250ms over 5m");
+  * ratio: 1 - bad/total over a counter pair must stay >= target
+    ("deadline-miss ratio < 1% over 5m").
+- **error budgets** — budget consumed = (1-good)/(1-target) over the
+  objective's main window; remaining = 1 - consumed, clamped to [0,1].
+- **burn-rate alerts** — multi-window, multi-severity (SRE workbook
+  ch.5): a *page* fires when the burn rate over `fast_window_s` AND its
+  short confirmation window (fast/12, >= one bucket) both exceed
+  `fast_burn`; a *ticket* likewise over `slow_window_s` at `slow_burn`.
+  The short window makes alerts trip fast on a real storm; requiring
+  the long window too keeps blips from paging. An alert clears as soon
+  as its condition stops holding (the short window recovers first).
+- **autoscaling signals** — derived gauges an external autoscaler can
+  scrape without re-deriving pool internals: queue-depth trend
+  (slope/s), TPOT saturation (windowed p95 / budget), KV-block
+  occupancy headroom.
+
+Gated by FLAGS_slo (default off). The disabled path is ONE dict lookup
+(`evaluate()` returns None after a single get_flag), the same contract
+as FLAGS_request_tracing and FLAGS_failpoints, pinned by test.
+Enabling — `set_flags({"FLAGS_slo": True})` or `slo.enable()` — turns
+on monitor windowed aggregation and installs the default objective set
+on first activation.
+
+Exported state (all via monitor, so /metrics carries them too):
+- GAUGE_slo_burn_rate{objective=...,window=fast|slow}
+- GAUGE_slo_error_budget_remaining{objective=...}
+- GAUGE_slo_alert_firing{objective=...} (0/1)
+- STAT_slo_alert_fired{objective=...,severity=...} / _cleared{...}
+- GAUGE_slo_queue_depth_trend{pool=serving|generation},
+  GAUGE_slo_tpot_saturation, GAUGE_slo_kv_block_headroom
+
+/sloz (introspect.py) serves sloz_text() / sloz(); /statusz embeds
+status_summary().
+"""
+from __future__ import annotations
+
+import re
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from .flags import get_flag, set_flags
+from . import monitor
+from .monitor import (counter_window_sum, gauge_get, gauge_set,
+                      gauge_trend, labeled, stat_add, timer_window,
+                      timer_window_frac_le)
+
+_SLO_LOCK = threading.Lock()
+
+# TPOT saturation denominator when no "tpot" objective overrides it:
+# 50ms/token is the serving-quality budget docs/generation.md benches
+_TPOT_BUDGET_US = 50_000.0
+
+
+@dataclass
+class Objective:
+    """One SLO. `target` is the required good-ratio (e.g. 0.95 = 95% of
+    events good). Latency objectives read `timer` against
+    `threshold_us`; ratio objectives read the `bad`/`total` counter
+    pair. Windows are seconds; burn thresholds are multiples of the
+    sustainable burn rate (1.0 = budget exactly exhausted at window
+    end)."""
+    name: str
+    kind: str                         # "latency" | "ratio"
+    target: float
+    timer: str = ""                   # latency: TIMER_* family
+    threshold_us: float = 0.0         # latency: good means <= this
+    bad: str = ""                     # ratio: STAT_* numerator
+    total: str = ""                   # ratio: STAT_* denominator
+    window_s: float = 300.0           # budget window
+    fast_window_s: float = 60.0       # page pair (long half)
+    slow_window_s: float = 3600.0     # ticket pair (long half)
+    fast_burn: float = 14.0
+    slow_burn: float = 6.0
+    description: str = ""
+
+    def __post_init__(self):
+        if self.kind not in ("latency", "ratio"):
+            raise ValueError("Objective kind must be 'latency' or "
+                             "'ratio', got %r" % (self.kind,))
+        if not 0.0 < self.target < 1.0:
+            raise ValueError("Objective target must be in (0, 1), got %r"
+                             % (self.target,))
+        if self.kind == "latency" and not self.timer:
+            raise ValueError("latency Objective needs timer=")
+        if self.kind == "ratio" and not (self.bad and self.total):
+            raise ValueError("ratio Objective needs bad= and total=")
+
+
+class _AlertState:
+    __slots__ = ("firing", "severity", "since", "trips", "clears")
+
+    def __init__(self):
+        self.firing = False
+        self.severity: Optional[str] = None
+        self.since: Optional[float] = None
+        self.trips = 0
+        self.clears = 0
+
+
+_REGISTRY: Dict[str, Objective] = {}
+_ALERTS: Dict[str, _AlertState] = {}
+_ACTIVE = False
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def register(obj: Objective) -> Objective:
+    with _SLO_LOCK:
+        _REGISTRY[obj.name] = obj
+        _ALERTS[obj.name] = _AlertState()
+    return obj
+
+
+def unregister(name: str) -> None:
+    with _SLO_LOCK:
+        _REGISTRY.pop(name, None)
+        _ALERTS.pop(name, None)
+
+
+def objectives() -> List[Objective]:
+    with _SLO_LOCK:
+        return list(_REGISTRY.values())
+
+
+def clear_objectives() -> None:
+    with _SLO_LOCK:
+        _REGISTRY.clear()
+        _ALERTS.clear()
+
+
+def install_default_objectives() -> None:
+    """The stack's own serving/generation SLOs (docs/observability.md).
+    Idempotent: re-registering replaces by name."""
+    register(Objective(
+        name="serving_total_p95", kind="latency", target=0.95,
+        timer="TIMER_serving_total_us", threshold_us=250_000.0,
+        description="95% of serving requests complete in < 250ms"))
+    register(Objective(
+        name="generation_ttft_p95", kind="latency", target=0.95,
+        timer="TIMER_generation_ttft_us", threshold_us=500_000.0,
+        description="95% of generation requests see first token "
+                    "in < 500ms"))
+    register(Objective(
+        name="serving_deadline_miss", kind="ratio", target=0.99,
+        bad="STAT_serving_deadline_missed",
+        total="STAT_serving_requests",
+        description="< 1% of serving requests miss their deadline"))
+    register(Objective(
+        name="generation_deadline_miss", kind="ratio", target=0.99,
+        bad="STAT_generation_deadline_missed",
+        total="STAT_generation_requests",
+        description="< 1% of generation requests miss their deadline"))
+
+
+# ---------------------------------------------------------------------------
+# activation (FLAGS_slo side-effect wiring, failpoints precedent)
+# ---------------------------------------------------------------------------
+
+def _activate(bucket_s: Optional[float] = None,
+              n_buckets: Optional[int] = None, clock=None) -> None:
+    global _ACTIVE
+    if bucket_s is None:
+        bucket_s = float(get_flag("FLAGS_slo_bucket_s", 10.0) or 10.0)
+    if n_buckets is None:
+        n_buckets = int(get_flag("FLAGS_slo_buckets", 360) or 360)
+    monitor.enable_windows(bucket_s, n_buckets, clock)
+    with _SLO_LOCK:
+        empty = not _REGISTRY
+    if empty:
+        install_default_objectives()
+    _ACTIVE = True
+
+
+def _deactivate() -> None:
+    global _ACTIVE
+    monitor.disable_windows()
+    _ACTIVE = False
+
+
+def _sync_from_flag(on: bool) -> None:
+    """set_flags({"FLAGS_slo": ...}) side effect (flags.py). Reentrancy
+    guard: enable() activates first and THEN sets the flag, so the
+    side-effect must no-op when state already matches."""
+    if on and not _ACTIVE:
+        _activate()
+    elif not on and _ACTIVE:
+        _deactivate()
+
+
+def enable(bucket_s: Optional[float] = None,
+           n_buckets: Optional[int] = None, clock=None) -> None:
+    """Programmatic enable with optional custom window config (tests
+    and benches shrink bucket_s to trip alerts in wall-clock seconds).
+    Equivalent to set_flags({"FLAGS_slo": True}) plus config."""
+    _activate(bucket_s, n_buckets, clock)
+    set_flags({"FLAGS_slo": True})
+
+
+def disable() -> None:
+    set_flags({"FLAGS_slo": False})
+
+
+def enabled() -> bool:
+    return bool(get_flag("FLAGS_slo"))
+
+
+# ---------------------------------------------------------------------------
+# evaluation
+# ---------------------------------------------------------------------------
+
+def _good_ratio(obj: Objective, window_s: float,
+                now: Optional[float]) -> Optional[float]:
+    """Fraction of good events over the window; None = no data (an SLO
+    with no traffic neither fires nor clears on emptiness)."""
+    if obj.kind == "latency":
+        return timer_window_frac_le(obj.timer, obj.threshold_us,
+                                    window_s, now=now)
+    total = counter_window_sum(obj.total, window_s, now=now)
+    if not total:
+        return None
+    bad = counter_window_sum(obj.bad, window_s, now=now)
+    return max(0.0, 1.0 - bad / total)
+
+
+def _burn(obj: Objective, window_s: float,
+          now: Optional[float]) -> Optional[float]:
+    """Burn rate over a window: (1-good)/(1-target). 1.0 = burning
+    budget exactly as fast as the objective tolerates; 14 = the whole
+    window's budget gone in window/14."""
+    good = _good_ratio(obj, window_s, now)
+    if good is None:
+        return None
+    return (1.0 - good) / max(1.0 - obj.target, 1e-9)
+
+
+def _short_window(obj: Objective, long_s: float) -> float:
+    cfg = monitor.window_config()
+    bucket = cfg["bucket_s"] if cfg else 10.0
+    return max(bucket, long_s / 12.0)
+
+
+def _eval_objective(obj: Objective, st: _AlertState,
+                    now: Optional[float], t_wall: float) -> Dict[str, Any]:
+    burns: Dict[str, Optional[float]] = {}
+    firing_sev = None
+    # page outranks ticket; check fast pair first
+    for sev, long_s, thr in (("page", obj.fast_window_s, obj.fast_burn),
+                             ("ticket", obj.slow_window_s, obj.slow_burn)):
+        short_s = _short_window(obj, long_s)
+        b_long = _burn(obj, long_s, now)
+        b_short = _burn(obj, short_s, now)
+        key = "fast" if sev == "page" else "slow"
+        burns[key] = b_long
+        burns[key + "_short"] = b_short
+        if firing_sev is None and b_long is not None \
+                and b_short is not None \
+                and b_long >= thr and b_short >= thr:
+            firing_sev = sev
+    if firing_sev and not st.firing:
+        st.firing, st.severity, st.since = True, firing_sev, t_wall
+        st.trips += 1
+        stat_add(labeled("STAT_slo_alert_fired",
+                         {"objective": obj.name,
+                          "severity": firing_sev}))
+    elif st.firing and not firing_sev:
+        st.firing, st.severity, st.since = False, None, None
+        st.clears += 1
+        stat_add(labeled("STAT_slo_alert_cleared",
+                         {"objective": obj.name}))
+    elif st.firing:
+        st.severity = firing_sev
+
+    good_main = _good_ratio(obj, obj.window_s, now)
+    budget = None
+    if good_main is not None:
+        consumed = (1.0 - good_main) / max(1.0 - obj.target, 1e-9)
+        budget = max(0.0, 1.0 - consumed)
+
+    olbl = {"objective": obj.name}
+    if burns.get("fast") is not None:
+        gauge_set(labeled("GAUGE_slo_burn_rate",
+                          dict(olbl, window="fast")), burns["fast"])
+    if burns.get("slow") is not None:
+        gauge_set(labeled("GAUGE_slo_burn_rate",
+                          dict(olbl, window="slow")), burns["slow"])
+    if budget is not None:
+        gauge_set(labeled("GAUGE_slo_error_budget_remaining", olbl),
+                  budget)
+    gauge_set(labeled("GAUGE_slo_alert_firing", olbl),
+              1.0 if st.firing else 0.0)
+
+    return {
+        "name": obj.name, "kind": obj.kind, "target": obj.target,
+        "description": obj.description,
+        "window_s": obj.window_s,
+        "good_ratio": good_main,
+        "error_budget_remaining": budget,
+        "burn_rate": {k: v for k, v in burns.items()},
+        "burn_thresholds": {"fast": obj.fast_burn,
+                            "slow": obj.slow_burn},
+        "alert": {"firing": st.firing, "severity": st.severity,
+                  "since": st.since, "trips": st.trips,
+                  "clears": st.clears},
+    }
+
+
+def _signals(now: Optional[float]) -> Dict[str, float]:
+    """Derived autoscaling signals, exported as gauges every
+    evaluation so an autoscaler can scrape /metrics alone."""
+    sig: Dict[str, float] = {}
+    for pool in ("serving", "generation"):
+        trend = gauge_trend("GAUGE_%s_queue_depth" % pool, 60.0, now=now)
+        sig["queue_depth_trend_%s" % pool] = trend
+        gauge_set(labeled("GAUGE_slo_queue_depth_trend", {"pool": pool}),
+                  trend)
+    tpot = timer_window("TIMER_generation_tpot_us", 60.0, now=now)
+    sat = (tpot["p95"] / _TPOT_BUDGET_US) if tpot["count"] else 0.0
+    sig["tpot_saturation"] = sat
+    gauge_set("GAUGE_slo_tpot_saturation", sat)
+    free = gauge_get("GAUGE_generation_blocks_free")
+    used = gauge_get("GAUGE_generation_blocks_used")
+    headroom = free / (free + used) if (free + used) > 0 else 1.0
+    sig["kv_block_headroom"] = headroom
+    gauge_set("GAUGE_slo_kv_block_headroom", headroom)
+    return sig
+
+
+def evaluate(now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+    """Evaluate every objective: refresh burn rates, budgets, alert
+    state and autoscaling-signal gauges. Returns the full evaluation
+    dict, or None when FLAGS_slo is off — the disabled path is exactly
+    this one flag lookup (pinned by test)."""
+    if not get_flag("FLAGS_slo"):
+        return None
+    t_wall = time.time()
+    with _SLO_LOCK:
+        objs = [(o, _ALERTS[o.name]) for o in _REGISTRY.values()]
+        results = [_eval_objective(o, st, now, t_wall)
+                   for o, st in objs]
+        return {
+            "objectives": results,
+            "signals": _signals(now),
+            "firing": [r["name"] for r in results
+                       if r["alert"]["firing"]],
+        }
+
+
+# ---------------------------------------------------------------------------
+# per-tenant accounting (tracing.py writes the labeled series)
+# ---------------------------------------------------------------------------
+
+_TENANT_RE = re.compile(
+    r'^STAT_(serving|generation)_(requests|errors|deadline_missed)'
+    r'\{tenant="((?:[^"\\]|\\.)*)"\}$')
+
+
+def tenants() -> Dict[str, Dict[str, float]]:
+    """Per-tenant request accounting parsed back out of the labeled
+    counter families tracing.finish() maintains."""
+    out: Dict[str, Dict[str, float]] = {}
+    for name, v in monitor.get_float_stats().items():
+        m = _TENANT_RE.match(name)
+        if not m:
+            continue
+        kind, what, tenant = m.groups()
+        t = out.setdefault(tenant, {})
+        t["%s_%s" % (kind, what)] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# /sloz + /statusz surfaces
+# ---------------------------------------------------------------------------
+
+def sloz(now: Optional[float] = None) -> Dict[str, Any]:
+    """The /sloz JSON body. Runs a fresh evaluation when enabled so a
+    scrape always reflects current windows."""
+    if not get_flag("FLAGS_slo"):
+        return {"enabled": False, "objectives": [], "signals": {},
+                "tenants": {}, "windows": None}
+    ev = evaluate(now) or {"objectives": [], "signals": {},
+                           "firing": []}
+    return {
+        "enabled": True,
+        "windows": monitor.window_config(),
+        "objectives": ev["objectives"],
+        "signals": ev["signals"],
+        "firing": ev["firing"],
+        "tenants": tenants(),
+    }
+
+
+def sloz_text(now: Optional[float] = None) -> str:
+    """Human-readable /sloz."""
+    z = sloz(now)
+    if not z["enabled"]:
+        return ("slo: disabled (set_flags({'FLAGS_slo': True}) or "
+                "slo.enable() to start windowed evaluation)\n")
+    w = z["windows"] or {}
+    lines = ["slo: enabled  bucket=%gs  history=%d buckets (%gs)"
+             % (w.get("bucket_s", 0), w.get("n_buckets", 0),
+                w.get("span_s", 0)), ""]
+    for o in z["objectives"]:
+        st = o["alert"]
+        flag = "FIRING(%s)" % st["severity"] if st["firing"] else "ok"
+        good = o["good_ratio"]
+        budget = o["error_budget_remaining"]
+        lines.append("%-28s %-12s target=%.4g  good=%s  budget=%s"
+                     % (o["name"], flag, o["target"],
+                        "n/a" if good is None else "%.4f" % good,
+                        "n/a" if budget is None else "%.1f%%"
+                        % (budget * 100)))
+        br = o["burn_rate"]
+        lines.append("    burn fast=%s/%g slow=%s/%g  trips=%d clears=%d"
+                     % ("n/a" if br.get("fast") is None
+                        else "%.2f" % br["fast"],
+                        o["burn_thresholds"]["fast"],
+                        "n/a" if br.get("slow") is None
+                        else "%.2f" % br["slow"],
+                        o["burn_thresholds"]["slow"],
+                        st["trips"], st["clears"]))
+        if o["description"]:
+            lines.append("    # " + o["description"])
+    lines.append("")
+    lines.append("signals:")
+    for k, v in sorted(z["signals"].items()):
+        lines.append("    %-28s %.6g" % (k, v))
+    if z["tenants"]:
+        lines.append("")
+        lines.append("tenants:")
+        for t, d in sorted(z["tenants"].items()):
+            lines.append("    %-16s %s" % (t, " ".join(
+                "%s=%g" % (k, d[k]) for k in sorted(d))))
+    return "\n".join(lines) + "\n"
+
+
+def status_summary() -> Dict[str, Any]:
+    """Compact SLO section for /statusz."""
+    if not get_flag("FLAGS_slo"):
+        return {"enabled": False}
+    ev = evaluate()
+    if ev is None:  # flag raced off between the check and evaluate
+        return {"enabled": False}
+    return {
+        "enabled": True,
+        "objectives": len(ev["objectives"]),
+        "firing": ev["firing"],
+        "signals": ev["signals"],
+    }

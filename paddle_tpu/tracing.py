@@ -52,8 +52,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import itertools
 
 from .flags import get_flag
-from .monitor import (gauge_set, observe_many, stat_add, timer_get,
-                      timer_observe)
+from .monitor import (gauge_set, labeled, observe_many, stat_add,
+                      timer_get, timer_observe)
 
 __all__ = ["RequestTrace", "NOOP_TRACE", "begin", "recent", "exemplars",
            "tracez", "tracez_text", "reset"]
@@ -112,6 +112,49 @@ _DECOMP_NAMES: Dict[str, Tuple[Tuple[str, str, str, str], ...]] = {
 _TTFT_TIMER = {k: "TIMER_%s_ttft_us" % k for k in _DECOMP}
 _TPOT_TIMER = {k: "TIMER_%s_tpot_us" % k for k in _DECOMP}
 
+# per-tenant attribution (docs/observability.md, slo.py): labeled
+# instrument names are precomputed per (kind, tenant) — label
+# composition costs string work finish() should pay once per tenant,
+# not once per request. Distinct tenants are capped: past the cap, new
+# tenants collapse into "__other__" so a tenant-id typo can't grow the
+# registry without bound (the standard label-cardinality defense).
+_TENANT_CAP = 64
+_TENANT_OVERFLOW = "__other__"
+_TENANT_NAMES: Dict[Tuple[str, str],
+                    Tuple[str, str, str, str, str]] = {}
+_TENANT_SEEN: set = set()
+
+
+def _tenant_names(kind: str,
+                  tenant: str) -> Tuple[str, str, str, str, str]:
+    orig_key = (kind, tenant)
+    got = _TENANT_NAMES.get(orig_key)
+    if got is not None:
+        return got
+    with _LOCK:
+        got = _TENANT_NAMES.get(orig_key)
+        if got is not None:
+            return got
+        if tenant != _TENANT_OVERFLOW and tenant not in _TENANT_SEEN \
+                and len(_TENANT_SEEN) >= _TENANT_CAP:
+            stat_add("STAT_tracing_tenant_overflow")
+            tenant = _TENANT_OVERFLOW
+        _TENANT_SEEN.add(tenant)
+        key = (kind, tenant)
+        got = _TENANT_NAMES.get(key)
+        if got is None:
+            lbl = {"tenant": tenant}
+            got = (labeled("TIMER_%s_total_us" % kind, lbl),
+                   labeled("TIMER_%s_ttft_us" % kind, lbl),
+                   labeled("STAT_%s_requests" % kind, lbl),
+                   labeled("STAT_%s_errors" % kind, lbl),
+                   labeled("STAT_%s_deadline_missed" % kind, lbl))
+            _TENANT_NAMES[key] = got
+        # overflowed tenants cache under their ORIGINAL key too, so the
+        # next request from the same tenant is one dict hit again
+        _TENANT_NAMES[orig_key] = got
+        return got
+
 
 class _NoopTrace:
     """Shared do-nothing trace: what ``begin()`` returns with
@@ -121,6 +164,7 @@ class _NoopTrace:
     __slots__ = ()
     trace_id = None
     deadline_s = None
+    tenant = None
 
     def stage(self, name: str) -> None:
         pass
@@ -152,17 +196,20 @@ class RequestTrace:
     through locked queues, so every touch is ordered by a
     happens-before edge already."""
 
-    __slots__ = ("trace_id", "kind", "t0", "deadline_s", "stages",
-                 "events", "tokens", "t_first_token", "t_last_token",
-                 "fields", "error", "_done", "_total_us", "_missed")
+    __slots__ = ("trace_id", "kind", "t0", "deadline_s", "tenant",
+                 "stages", "events", "tokens", "t_first_token",
+                 "t_last_token", "fields", "error", "_done",
+                 "_total_us", "_missed")
 
     def __init__(self, trace_id: str, kind: str,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 tenant: Optional[str] = None):
         now = time.monotonic()
         self.trace_id = trace_id
         self.kind = kind
         self.t0 = now
         self.deadline_s = None if deadline is None else float(deadline)
+        self.tenant = tenant
         self.stages: List[Tuple[str, float]] = [("submit", now)]
         self.events: List[Dict[str, Any]] = []
         self.tokens = 0
@@ -253,6 +300,19 @@ class RequestTrace:
             stats.append(("STAT_%s_deadline_missed" % self.kind, 1.0))
         if self.error is not None:
             stats.append(("STAT_trace_errored", 1.0))
+        if self.tenant:
+            # per-tenant attribution: the labeled series join the SAME
+            # single observe_many flush as the decomposition
+            tn = _tenant_names(self.kind, self.tenant)
+            timers.append((tn[0], total_us))
+            if self.t_first_token is not None:
+                timers.append((tn[1],
+                               (self.t_first_token - self.t0) * 1e6))
+            stats.append((tn[2], 1.0))
+            if self.error is not None:
+                stats.append((tn[3], 1.0))
+            if self._missed:
+                stats.append((tn[4], 1.0))
         observe_many(timers, stats)
         if self.error is not None:
             # errored requests join the flight recorder keyed by trace
@@ -275,6 +335,8 @@ class RequestTrace:
                        for name, t in self.stages],
             "error": self.error,
         }
+        if self.tenant:
+            rec["tenant"] = self.tenant
         if self.events:
             rec["events"] = list(self.events)
         if self.tokens:
@@ -290,14 +352,17 @@ class RequestTrace:
         return rec
 
 
-def begin(kind: str, deadline: Optional[float] = None):
+def begin(kind: str, deadline: Optional[float] = None,
+          tenant: Optional[str] = None):
     """Open a trace for one request. THE disabled fast path: exactly
     one flag lookup, returning the shared no-op trace. ``deadline`` is
-    a latency budget in seconds from now (monotonic)."""
+    a latency budget in seconds from now (monotonic); ``tenant`` routes
+    the request's counters/timers into labeled per-tenant series at
+    finish (capped cardinality, see _tenant_names)."""
     if not get_flag("FLAGS_request_tracing"):
         return NOOP_TRACE
     return RequestTrace("t%06d" % next(_NEXT_ID), kind,
-                        deadline=deadline)
+                        deadline=deadline, tenant=tenant)
 
 
 # ---------------------------------------------------------------------------
@@ -389,6 +454,8 @@ def reset() -> None:
             _GAUGES.pop("GAUGE_tracing_exemplars", None)
         _EXEMPLARS.clear()
         _CLEAN_FLOOR[0] = None
+        _TENANT_NAMES.clear()
+        _TENANT_SEEN.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -417,19 +484,29 @@ def rolling() -> Dict[str, Dict[str, float]]:
     return out
 
 
-def tracez() -> Dict[str, Any]:
-    """The ``/tracez?format=json`` payload."""
-    return {
+def tracez(tenant: Optional[str] = None) -> Dict[str, Any]:
+    """The ``/tracez?format=json`` payload. ``tenant`` filters recent
+    and exemplars to one tenant's traces (``/tracez?tenant=acme``)."""
+    rec, ex = recent(), exemplars()
+    if tenant is not None:
+        rec = [r for r in rec if r.get("tenant") == tenant]
+        ex = [r for r in ex if r.get("tenant") == tenant]
+    out = {
         "enabled": bool(get_flag("FLAGS_request_tracing")),
         "rolling_us": rolling(),
-        "recent": recent(),
-        "exemplars": exemplars(),
+        "recent": rec,
+        "exemplars": ex,
     }
+    if tenant is not None:
+        out["tenant"] = tenant
+    return out
 
 
 def _fmt_trace(rec: Dict[str, Any], verbose: bool) -> List[str]:
     head = "%s %-10s total=%.0fus" % (rec["trace_id"], rec["kind"],
                                       rec["total_us"])
+    if rec.get("tenant"):
+        head += " tenant=%s" % rec["tenant"]
     if rec.get("tokens"):
         head += " tokens=%d" % rec["tokens"]
         if "ttft_us" in rec:
@@ -451,12 +528,16 @@ def _fmt_trace(rec: Dict[str, Any], verbose: bool) -> List[str]:
     return lines
 
 
-def tracez_text() -> str:
+def tracez_text(tenant: Optional[str] = None) -> str:
     """The human ``/tracez`` page: rolling decomposition, the recent
-    tail, and every exemplar with its full timeline."""
-    snap = tracez()
-    lines = ["request traces (FLAGS_request_tracing=%s)"
-             % ("on" if snap["enabled"] else "off"), ""]
+    tail, and every exemplar with its full timeline. ``tenant``
+    restricts recent/exemplars to one tenant."""
+    snap = tracez(tenant=tenant)
+    head = "request traces (FLAGS_request_tracing=%s)" \
+           % ("on" if snap["enabled"] else "off")
+    if tenant is not None:
+        head += "  [tenant=%s]" % tenant
+    lines = [head, ""]
     lines.append("rolling latency (us):")
     if snap["rolling_us"]:
         for label, st in sorted(snap["rolling_us"].items()):

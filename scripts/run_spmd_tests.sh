@@ -3,8 +3,10 @@
 # 8-device virtual CPU mesh and emit MULTICHIP_r06.json: the usual
 # multichip dryrun transcript (same shape as MULTICHIP_r0{1..5}.json)
 # plus the mesh plan, the per-axis host-collective census
-# (STAT_mesh_collective_<axis>, monitor.py), and the chaos smoke
-# (failpoints armed over /failpointz, recovery asserted — ISSUE 9).
+# (STAT_mesh_collective_<axis>, monitor.py), the chaos smoke
+# (failpoints armed over /failpointz, recovery asserted — ISSUE 9),
+# and the SLO smoke (/sloz text + JSON scraped with per-tenant labeled
+# families on /metrics — ISSUE 12).
 #
 # Usage: scripts/run_spmd_tests.sh [extra pytest args...]
 set -u
@@ -243,12 +245,78 @@ try:
 except Exception as e:  # noqa: BLE001 - artifact records the failure
     generation["error"] = "%s: %s" % (type(e).__name__, e)
 
+# slo smoke (ISSUE 12, docs/observability.md): enable the windowed SLO
+# engine, drive tenant-attributed traced requests (a quarter of them
+# deadline-missed), scrape /sloz text + JSON and the tenant-filtered
+# /tracez over HTTP, then re-run the /metrics exposition parse with
+# labeled per-tenant families present — proves the label-aware
+# exporter and the SLO surface work in the same multichip environment.
+slo_smoke = {"ok": False}
+try:
+    from paddle_tpu import slo
+
+    slo.enable(bucket_s=0.25, n_buckets=240)
+    slo.clear_objectives()
+    slo.register(slo.Objective(
+        name="smoke_deadline_miss", kind="ratio", target=0.95,
+        bad="STAT_serving_deadline_missed",
+        total="STAT_serving_requests",
+        window_s=8.0, fast_window_s=2.0, slow_window_s=8.0,
+        fast_burn=2.0, slow_burn=3.0))
+    for i in range(20):
+        t = tracing.begin("serving", tenant="smoke",
+                          deadline=(0.0 if i % 4 == 0 else 30.0))
+        t.stage("admit")
+        monitor.stat_add("STAT_serving_requests")
+        t.finish()
+    srv = introspect.start(port=0)
+    sloz_text = urllib.request.urlopen(srv.url + "/sloz",
+                                       timeout=10).read().decode()
+    sloz = json.load(urllib.request.urlopen(
+        srv.url + "/sloz?format=json", timeout=10))
+    tz = json.load(urllib.request.urlopen(
+        srv.url + "/tracez?format=json&tenant=smoke", timeout=10))
+    body2 = urllib.request.urlopen(srv.url + "/metrics",
+                                   timeout=10).read().decode()
+    samples2_ok = all(ln.startswith("#") or sample_re.match(ln)
+                      for ln in body2.splitlines() if ln)
+    n_labeled = sum(1 for ln in body2.splitlines()
+                    if 'tenant="smoke"' in ln)
+    smoke_obj = next((o for o in sloz["objectives"]
+                      if o["name"] == "smoke_deadline_miss"), None)
+    slo_smoke = {
+        "ok": sloz["enabled"] is True
+        and smoke_obj is not None
+        and smoke_obj["good_ratio"] is not None
+        and "smoke" in sloz["tenants"]
+        and "smoke_deadline_miss" in sloz_text
+        and samples2_ok and n_labeled > 0
+        and len(tz["recent"]) > 0
+        and all(r.get("tenant") == "smoke" for r in tz["recent"]),
+        "objective_good_ratio":
+            None if smoke_obj is None else smoke_obj["good_ratio"],
+        "burn_fast": None if smoke_obj is None
+        else smoke_obj["burn_rate"].get("fast"),
+        "tenants": sorted(sloz["tenants"]),
+        "labeled_metric_samples": n_labeled,
+        "metrics_parse_with_labels": samples2_ok,
+        "tracez_tenant_filtered": len(tz["recent"]),
+    }
+except Exception as e:  # noqa: BLE001 - artifact records the failure
+    slo_smoke["error"] = "%s: %s" % (type(e).__name__, e)
+finally:
+    introspect.stop()
+    from paddle_tpu import slo as _slo_cleanup
+    _slo_cleanup.disable()
+    _slo_cleanup.clear_objectives()
+
 counters = monitor.get_float_stats()
 artifact = {
     "n_devices": len(jax.devices()),
     "rc": rc,
     "ok": rc == 0 and test_rc == 0 and intro.get("ok", False)
-    and chaos.get("ok", False) and generation.get("ok", False),
+    and chaos.get("ok", False) and generation.get("ok", False)
+    and slo_smoke.get("ok", False),
     "skipped": False,
     "spmd_tests_rc": test_rc,
     "mesh_plan": {
@@ -261,6 +329,7 @@ artifact = {
     "introspect": intro,
     "chaos": chaos,
     "generation": generation,
+    "slo": slo_smoke,
     "collectives": {k: v for k, v in sorted(counters.items())
                     if k.startswith("STAT_mesh_collective_")},
     "mesh_counters": {k: v for k, v in sorted(counters.items())
@@ -272,7 +341,7 @@ with open("MULTICHIP_r06.json", "w") as f:
     f.write("\n")
 print(json.dumps({k: artifact[k] for k in
                   ("n_devices", "rc", "ok", "spmd_tests_rc",
-                   "introspect", "chaos", "generation",
+                   "introspect", "chaos", "generation", "slo",
                    "collectives")}, indent=1))
 sys.exit(0 if artifact["ok"] else 1)
 EOF

@@ -1,0 +1,528 @@
+"""SLO engine tests (ISSUE 12, docs/observability.md "SLOs +
+per-tenant accounting").
+
+Covers the tentpole end to end: monitor.py windowed aggregation
+(counter sums/rates, windowed timer quantiles and good-ratio, gauge
+trends — all under a fake clock so bucket math is exact), Prometheus
+label composition + single-# TYPE family grouping, objective
+evaluation with error budgets and multi-window burn-rate alerts (trip
+AND clear), autoscaling signal gauges, tenant attribution threaded
+through a real PredictorPool into labeled series and the
+/tracez?tenant= filter, the tenant-cardinality cap, the /sloz +
+/statusz surfaces over live HTTP, a scrape-under-mutation storm, and
+the disabled-path contracts (slo.evaluate() = ONE flag dict lookup;
+windows off = the window recorder never runs).
+"""
+import json
+import re
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import introspect, layers, monitor, serving, slo, tracing
+from paddle_tpu.flags import set_flags
+from paddle_tpu.monitor import gauge_get, labeled, stat_get, timer_get
+
+PROM_LINE = re.compile(
+    r"^(#.*|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [-+0-9.eEinfa]+)$")
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(autouse=True)
+def _slo_isolation():
+    """Rings, windows, objectives, and flags reset around every test
+    (lifetime counters stay global — tests use deltas)."""
+    tracing.reset()
+    yield
+    slo.disable()
+    slo.clear_objectives()
+    monitor.disable_windows()
+    tracing.reset()
+    set_flags({"FLAGS_slo": False, "FLAGS_slo_bucket_s": 10.0,
+               "FLAGS_slo_buckets": 360})
+
+
+@pytest.fixture
+def model_dir(tmp_path):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [6])
+        h = layers.fc(x, 16, act="relu")
+        y = layers.fc(h, 3, name="out")
+    exe = pt.Executor()
+    exe.run(startup)
+    d = str(tmp_path / "model")
+    pt.io.save_inference_model(d, ["x"], [y], exe, main_program=main)
+    return d
+
+
+# ---------------------------------------------------------------------------
+# windowed aggregation primitives (fake clock: bucket math is exact)
+# ---------------------------------------------------------------------------
+
+def test_windowed_counter_sum_rate_and_expiry():
+    clk = FakeClock(5.0)
+    monitor.enable_windows(bucket_s=10.0, n_buckets=6, clock=clk)
+    name = "STAT_slo_w_counter"
+    monitor.stat_add(name, 10)                      # bucket 0
+    clk.t = 15.0
+    monitor.stat_add(name, 20)                      # bucket 1
+    assert monitor.counter_window_sum(name, 60.0, now=15.0) == 30.0
+    # a 10s window sees only the current bucket
+    assert monitor.counter_window_sum(name, 10.0, now=15.0) == 20.0
+    # rate = in-window increments / elapsed in-window seconds:
+    # lo bucket starts at -30s, so elapsed = 15-(-30) = 45s
+    assert monitor.counter_rate(name, 60.0, now=15.0) \
+        == pytest.approx(30.0 / 45.0)
+    # both buckets expire once the window moves past them
+    assert monitor.counter_window_sum(name, 60.0, now=90.0) == 0.0
+
+
+def test_windowed_timer_quantiles_and_good_ratio():
+    clk = FakeClock(5.0)
+    monitor.enable_windows(10.0, 12, clock=clk)
+    name = "TIMER_slo_w_us"
+    for v in range(1, 51):                          # 1..50 in bucket 0
+        monitor.timer_observe(name, float(v))
+    clk.t = 15.0
+    for v in range(51, 61):                         # 51..60 in bucket 1
+        monitor.timer_observe(name, float(v))
+    st = monitor.timer_window(name, 60.0, now=15.0)
+    assert st["count"] == 60
+    assert st["min"] == 1.0 and st["max"] == 60.0
+    assert st["sum"] == pytest.approx(sum(range(1, 61)))
+    assert st["p50"] == 31.0                        # nearest-rank, 1..60
+    # good-ratio: 40 of the 60 in-window samples are <= 40
+    assert monitor.timer_window_frac_le(name, 40.0, 60.0, now=15.0) \
+        == pytest.approx(40.0 / 60.0)
+    # a 10s window only sees bucket 1 (all samples above threshold)
+    assert monitor.timer_window_frac_le(name, 40.0, 10.0, now=15.0) == 0.0
+    # no in-window data -> None: an SLO must tell "good" from "idle"
+    assert monitor.timer_window_frac_le(name, 40.0, 10.0, now=95.0) is None
+
+
+def test_gauge_trend_slope():
+    clk = FakeClock(5.0)
+    monitor.enable_windows(10.0, 12, clock=clk)
+    name = "GAUGE_slo_w_depth"
+    monitor.gauge_set(name, 2.0)                    # bucket 0
+    # a single in-window bucket has no computable slope
+    assert monitor.gauge_trend(name, 60.0, now=5.0) == 0.0
+    clk.t = 25.0
+    monitor.gauge_set(name, 12.0)                   # bucket 2
+    # (12 - 2) / (2 buckets * 10s) = 0.5/s
+    assert monitor.gauge_trend(name, 60.0, now=25.0) == pytest.approx(0.5)
+
+
+def test_enable_windows_idempotent_reconfigure_discards():
+    clk = FakeClock(5.0)
+    monitor.enable_windows(10.0, 6, clock=clk)
+    monitor.stat_add("STAT_slo_w_cfg", 3)
+    monitor.enable_windows(10.0, 6)                 # same config: keeps state
+    assert monitor.counter_window_sum("STAT_slo_w_cfg", 60.0, now=5.0) == 3.0
+    assert monitor.window_config() == {"bucket_s": 10.0, "n_buckets": 6,
+                                       "span_s": 60.0}
+    monitor.enable_windows(5.0, 6)                  # reconfigure: discards
+    assert monitor.counter_window_sum("STAT_slo_w_cfg", 60.0) == 0.0
+
+
+def test_windows_disabled_reads_are_inert():
+    monitor.disable_windows()
+    assert monitor.windows_enabled() is False
+    assert monitor.window_config() is None
+    monitor.stat_add("STAT_slo_w_off", 5)
+    assert monitor.counter_window_sum("STAT_slo_w_off", 60.0) == 0.0
+    assert monitor.counter_rate("STAT_slo_w_off", 60.0) == 0.0
+    assert monitor.timer_window("TIMER_slo_w_off_us", 60.0)["count"] == 0
+    assert monitor.timer_window_frac_le("TIMER_slo_w_off_us", 1.0,
+                                        60.0) is None
+    assert monitor.gauge_trend("GAUGE_slo_w_off", 60.0) == 0.0
+
+
+def test_disabled_write_paths_touch_no_window_state(monkeypatch):
+    """With windows off the recorder never runs: the hot-path cost is
+    one `is not None` test under the already-held lock."""
+    monitor.disable_windows()
+
+    def boom(*a, **k):
+        raise AssertionError("window recorder ran while disabled")
+
+    monkeypatch.setattr(monitor._Windows, "record_counter", boom)
+    monkeypatch.setattr(monitor._Windows, "record_timer", boom)
+    monkeypatch.setattr(monitor._Windows, "record_gauge", boom)
+    monitor.stat_add("STAT_slo_off_path", 1)
+    monitor.gauge_set("GAUGE_slo_off_path", 1.0)
+    monitor.timer_observe("TIMER_slo_off_path_us", 1.0)
+    monitor.observe_many([("TIMER_slo_off_path_us", 2.0)],
+                         [("STAT_slo_off_path", 1.0)])
+
+
+# ---------------------------------------------------------------------------
+# labels
+# ---------------------------------------------------------------------------
+
+def test_labeled_composition_sorted_and_escaped():
+    assert labeled("STAT_x", {"tenant": "acme"}) == 'STAT_x{tenant="acme"}'
+    assert labeled("STAT_x", {}) == "STAT_x"
+    # keys sort so one label set always composes one registry key
+    assert labeled("STAT_x", {"b": "1", "a": "2"}) == 'STAT_x{a="2",b="1"}'
+    # exposition-format escapes: backslash, quote, newline
+    assert labeled("STAT_x", {"t": 'a"b\\c\nd'}) \
+        == 'STAT_x{t="a\\"b\\\\c\\nd"}'
+
+
+def test_prometheus_labeled_family_grouping():
+    monitor.stat_add(labeled("STAT_slo_lbl_req", {"tenant": "a"}), 2)
+    monitor.stat_add(labeled("STAT_slo_lbl_req", {"tenant": "b"}), 3)
+    monitor.stat_add("STAT_slo_lbl_req", 5)
+    for v in (10.0, 20.0, 30.0):
+        monitor.timer_observe(labeled("TIMER_slo_lbl_us", {"tenant": "a"}),
+                              v)
+    text = monitor.to_prometheus()
+    lines = text.splitlines()
+    for ln in lines:
+        if ln:
+            assert PROM_LINE.match(ln), ln
+    # exactly ONE # TYPE line for the family; all series contiguous
+    fam = "paddle_tpu_STAT_slo_lbl_req_total"
+    at = [i for i, ln in enumerate(lines)
+          if ln == "# TYPE %s counter" % fam]
+    assert len(at) == 1
+    assert set(lines[at[0] + 1:at[0] + 4]) == {
+        "%s 5" % fam,
+        '%s{tenant="a"} 2' % fam,
+        '%s{tenant="b"} 3' % fam,
+    }
+    # a labeled summary merges quantile INTO the existing label block
+    # (a second {...} block would not parse)
+    assert re.search(
+        r'paddle_tpu_TIMER_slo_lbl_us\{[^}]*quantile="0\.5"[^}]*'
+        r'tenant="a"[^}]*\} |'
+        r'paddle_tpu_TIMER_slo_lbl_us\{[^}]*tenant="a"[^}]*'
+        r'quantile="0\.5"[^}]*\} ', text)
+    assert 'paddle_tpu_TIMER_slo_lbl_us_count{tenant="a"} 3' in text
+
+
+# ---------------------------------------------------------------------------
+# objectives, budgets, burn-rate alerts
+# ---------------------------------------------------------------------------
+
+def _ratio_objective(**kw):
+    d = dict(name="slo_test_ratio", kind="ratio", target=0.9,
+             bad="STAT_slo_t_bad", total="STAT_slo_t_total",
+             window_s=60.0, fast_window_s=60.0, slow_window_s=60.0,
+             fast_burn=2.0, slow_burn=1000.0)
+    d.update(kw)
+    return slo.Objective(**d)
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError):
+        slo.Objective(name="x", kind="weird", target=0.5)
+    with pytest.raises(ValueError):
+        slo.Objective(name="x", kind="ratio", target=1.5,
+                      bad="b", total="t")
+    with pytest.raises(ValueError):
+        slo.Objective(name="x", kind="latency", target=0.9)   # no timer
+    with pytest.raises(ValueError):
+        slo.Objective(name="x", kind="ratio", target=0.9,
+                      bad="b")                                # no total
+
+
+def test_burn_rate_alert_trips_and_clears():
+    clk = FakeClock(5.0)
+    slo.enable(bucket_s=10.0, n_buckets=60, clock=clk)
+    slo.clear_objectives()
+    obj = slo.register(_ratio_objective())
+    olbl = {"objective": obj.name}
+    fired0 = stat_get(labeled("STAT_slo_alert_fired",
+                              dict(olbl, severity="page")))
+    cleared0 = stat_get(labeled("STAT_slo_alert_cleared", olbl))
+
+    monitor.stat_add("STAT_slo_t_total", 10)        # healthy bucket
+    ev = slo.evaluate(now=clk.t)
+    r = ev["objectives"][0]
+    assert r["alert"]["firing"] is False
+    assert r["good_ratio"] == 1.0
+    assert r["error_budget_remaining"] == 1.0
+    assert ev["firing"] == []
+
+    clk.t = 15.0                                    # storm bucket
+    monitor.stat_add("STAT_slo_t_total", 10)
+    monitor.stat_add("STAT_slo_t_bad", 5)
+    ev = slo.evaluate(now=clk.t)
+    r = ev["objectives"][0]
+    # long window: 5 bad / 20 total -> burn (1-0.75)/0.1 = 2.5 >= 2;
+    # short confirmation window (one bucket): 5/10 -> burn 5.0 >= 2
+    assert r["alert"]["firing"] is True
+    assert r["alert"]["severity"] == "page"
+    assert r["alert"]["trips"] == 1
+    assert r["burn_rate"]["fast"] == pytest.approx(2.5)
+    assert r["burn_rate"]["fast_short"] == pytest.approx(5.0)
+    assert r["error_budget_remaining"] == 0.0       # 2.5x budget consumed
+    assert ev["firing"] == [obj.name]
+    assert stat_get(labeled("STAT_slo_alert_fired",
+                            dict(olbl, severity="page"))) - fired0 == 1
+    assert gauge_get(labeled("GAUGE_slo_alert_firing", olbl)) == 1.0
+    assert gauge_get(labeled("GAUGE_slo_burn_rate",
+                             dict(olbl, window="fast"))) \
+        == pytest.approx(2.5)
+
+    # re-evaluating while still bad must not re-trip
+    ev = slo.evaluate(now=clk.t)
+    assert ev["objectives"][0]["alert"]["trips"] == 1
+
+    clk.t = 25.0                                    # recovery bucket
+    monitor.stat_add("STAT_slo_t_total", 10)
+    ev = slo.evaluate(now=clk.t)
+    r = ev["objectives"][0]
+    assert r["alert"]["firing"] is False
+    assert r["alert"]["clears"] == 1
+    assert ev["firing"] == []
+    assert stat_get(labeled("STAT_slo_alert_cleared", olbl)) \
+        - cleared0 == 1
+    assert gauge_get(labeled("GAUGE_slo_alert_firing", olbl)) == 0.0
+
+
+def test_latency_objective_good_ratio_and_idle_is_not_good():
+    clk = FakeClock(5.0)
+    slo.enable(bucket_s=10.0, n_buckets=60, clock=clk)
+    slo.clear_objectives()
+    slo.register(slo.Objective(
+        name="slo_test_latency", kind="latency", target=0.9,
+        timer="TIMER_slo_lat_us", threshold_us=100.0,
+        window_s=60.0, fast_window_s=60.0, slow_window_s=60.0,
+        fast_burn=3.0, slow_burn=1000.0))
+    # idle: no data -> no good-ratio, no budget, no alert either way
+    r = slo.evaluate(now=clk.t)["objectives"][0]
+    assert r["good_ratio"] is None
+    assert r["error_budget_remaining"] is None
+    assert r["alert"]["firing"] is False
+    for v in [50.0] * 8 + [500.0] * 2:              # 80% under threshold
+        monitor.timer_observe("TIMER_slo_lat_us", v)
+    r = slo.evaluate(now=clk.t)["objectives"][0]
+    assert r["good_ratio"] == pytest.approx(0.8)
+    # burn (1-0.8)/0.1 = 2.0 < fast_burn 3 -> over budget, not paging
+    assert r["burn_rate"]["fast"] == pytest.approx(2.0)
+    assert r["alert"]["firing"] is False
+    assert r["error_budget_remaining"] == 0.0
+
+
+def test_autoscaling_signals_exported():
+    clk = FakeClock(5.0)
+    slo.enable(bucket_s=10.0, n_buckets=60, clock=clk)
+    monitor.gauge_set("GAUGE_serving_queue_depth", 0.0)
+    clk.t = 25.0
+    monitor.gauge_set("GAUGE_serving_queue_depth", 10.0)
+    monitor.gauge_set("GAUGE_generation_blocks_free", 30.0)
+    monitor.gauge_set("GAUGE_generation_blocks_used", 10.0)
+    for v in (10_000.0, 20_000.0):
+        monitor.timer_observe("TIMER_generation_tpot_us", v)
+    sig = slo.evaluate(now=clk.t)["signals"]
+    assert sig["queue_depth_trend_serving"] == pytest.approx(0.5)
+    assert sig["kv_block_headroom"] == pytest.approx(0.75)
+    assert sig["tpot_saturation"] == pytest.approx(20_000.0 / 50_000.0)
+    assert gauge_get(labeled("GAUGE_slo_queue_depth_trend",
+                             {"pool": "serving"})) == pytest.approx(0.5)
+    assert gauge_get("GAUGE_slo_kv_block_headroom") == pytest.approx(0.75)
+    assert gauge_get("GAUGE_slo_tpot_saturation") == pytest.approx(0.4)
+
+
+# ---------------------------------------------------------------------------
+# flag wiring + disabled-path contract
+# ---------------------------------------------------------------------------
+
+def test_flag_side_effect_enables_and_disables():
+    assert slo.enabled() is False
+    set_flags({"FLAGS_slo_bucket_s": 5.0, "FLAGS_slo_buckets": 24})
+    set_flags({"FLAGS_slo": True})
+    assert slo.enabled() is True
+    assert monitor.windows_enabled() is True
+    assert monitor.window_config() == {"bucket_s": 5.0, "n_buckets": 24,
+                                       "span_s": 120.0}
+    # first activation installs the stack's default objective set
+    names = {o.name for o in slo.objectives()}
+    assert {"serving_total_p95", "generation_ttft_p95",
+            "serving_deadline_miss", "generation_deadline_miss"} <= names
+    set_flags({"FLAGS_slo": False})
+    assert slo.enabled() is False
+    assert monitor.windows_enabled() is False
+
+
+def test_disabled_evaluate_is_one_flag_lookup(monkeypatch):
+    """evaluate() is the only flag-lookup site on the disabled path:
+    the same one-dict-lookup contract as FLAGS_request_tracing and
+    FLAGS_failpoints."""
+    import paddle_tpu.slo as slo_mod
+    set_flags({"FLAGS_slo": False})
+    calls = []
+    real = slo_mod.get_flag
+
+    def counting(name, default=None):
+        calls.append(name)
+        return real(name, default)
+
+    monkeypatch.setattr(slo_mod, "get_flag", counting)
+    assert slo_mod.evaluate() is None
+    assert calls == ["FLAGS_slo"]
+
+
+# ---------------------------------------------------------------------------
+# per-tenant attribution
+# ---------------------------------------------------------------------------
+
+def test_pool_tenant_threading_and_tracez_filter(model_dir):
+    from paddle_tpu.inference import Config
+    a_req = labeled("STAT_serving_requests", {"tenant": "acme"})
+    a_tim = labeled("TIMER_serving_total_us", {"tenant": "acme"})
+    c0 = stat_get(a_req)
+    t0 = timer_get(a_tim)["count"]
+    with serving.PredictorPool(Config(model_dir), max_batch=8) as pool:
+        x = np.zeros((1, 6), np.float32)
+        for _ in range(3):
+            pool.run([x], timeout=60.0, tenant="acme")
+        pool.run([x], timeout=60.0, tenant="bob")
+        pool.run([x], timeout=60.0)                 # untenanted
+    assert stat_get(a_req) - c0 == 3
+    assert timer_get(a_tim)["count"] - t0 == 3
+    z = tracing.tracez(tenant="acme")
+    assert z["tenant"] == "acme"
+    assert len(z["recent"]) == 3
+    assert all(r["tenant"] == "acme" for r in z["recent"])
+    assert "tenant=acme" in tracing.tracez_text(tenant="acme")
+    t = slo.tenants()
+    assert t["acme"]["serving_requests"] == stat_get(a_req)
+    assert "bob" in t
+
+
+def test_tenant_cardinality_cap():
+    o0 = stat_get("STAT_tracing_tenant_overflow")
+    other0 = stat_get(labeled("STAT_serving_requests",
+                              {"tenant": "__other__"}))
+    for i in range(70):
+        tr = tracing.begin("serving", tenant="cap-tenant-%03d" % i)
+        tr.stage("admit")
+        tr.finish()
+    # 64 distinct tenants admitted, the remaining 6 collapse
+    assert stat_get("STAT_tracing_tenant_overflow") - o0 == 6
+    assert stat_get(labeled("STAT_serving_requests",
+                            {"tenant": "__other__"})) - other0 == 6
+    # an overflowed tenant is cached: repeats don't re-count overflow
+    tr = tracing.begin("serving", tenant="cap-tenant-069")
+    tr.finish()
+    assert stat_get("STAT_tracing_tenant_overflow") - o0 == 6
+    assert stat_get(labeled("STAT_serving_requests",
+                            {"tenant": "__other__"})) - other0 == 7
+
+
+# ---------------------------------------------------------------------------
+# HTTP surfaces
+# ---------------------------------------------------------------------------
+
+def _get_json(url):
+    return json.load(urllib.request.urlopen(url, timeout=10))
+
+
+def test_sloz_http_endpoints_and_statusz_section():
+    srv = introspect.start(port=0)
+    try:
+        z = _get_json(srv.url + "/sloz?format=json")
+        assert z["enabled"] is False
+        txt = urllib.request.urlopen(srv.url + "/sloz",
+                                     timeout=10).read().decode()
+        assert "disabled" in txt
+        st = _get_json(srv.url + "/statusz")
+        assert st["slo"] == {"enabled": False}
+
+        slo.enable(bucket_s=0.5, n_buckets=40)
+        slo.clear_objectives()
+        slo.register(_ratio_objective(name="http_ratio"))
+        monitor.stat_add("STAT_slo_t_total", 10)
+        tr = tracing.begin("serving", tenant="web")
+        tr.finish()
+        z = _get_json(srv.url + "/sloz?format=json")
+        assert z["enabled"] is True
+        assert z["windows"]["bucket_s"] == 0.5
+        assert [o["name"] for o in z["objectives"]] == ["http_ratio"]
+        assert z["objectives"][0]["good_ratio"] == 1.0
+        assert "web" in z["tenants"]
+        txt = urllib.request.urlopen(srv.url + "/sloz",
+                                     timeout=10).read().decode()
+        assert "http_ratio" in txt and "web" in txt
+        st = _get_json(srv.url + "/statusz")
+        assert st["slo"]["enabled"] is True
+        assert st["slo"]["objectives"] == 1
+        # the index advertises /sloz
+        idx = urllib.request.urlopen(srv.url + "/",
+                                     timeout=10).read().decode()
+        assert "/sloz" in idx
+        # /tracez honors the tenant query parameter over HTTP too
+        tz = _get_json(srv.url + "/tracez?format=json&tenant=web")
+        assert tz["tenant"] == "web"
+        assert all(r["tenant"] == "web" for r in tz["recent"])
+    finally:
+        introspect.stop()
+
+
+def test_scrape_under_labeled_mutation_storm():
+    """to_prometheus() / /sloz stay valid while writer threads storm
+    labeled observe_many: every exposition line parses mid-storm, and
+    after quiesce each tenant's counter equals its timer count (both
+    sides of every observe_many landed atomically — no torn buckets)."""
+    slo.enable(bucket_s=0.25, n_buckets=40)
+    stop = threading.Event()
+    errors = []
+
+    def writer(tid):
+        lbl = {"tenant": "t%d" % (tid % 3)}
+        t_name = labeled("TIMER_slo_storm_us", lbl)
+        c_name = labeled("STAT_slo_storm_req", lbl)
+        i = 0
+        while not stop.is_set() and i < 200_000:
+            try:
+                monitor.observe_many([(t_name, float(i % 997))],
+                                     [(c_name, 1.0)])
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+                return
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    srv = introspect.start(port=0)
+    try:
+        for _ in range(8):
+            body = urllib.request.urlopen(srv.url + "/metrics",
+                                          timeout=10).read().decode()
+            for ln in body.splitlines():
+                if ln:
+                    assert PROM_LINE.match(ln), ln
+            z = _get_json(srv.url + "/sloz?format=json")
+            assert z["enabled"] is True
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        introspect.stop()
+    assert not errors
+    for tn in ("t0", "t1", "t2"):
+        lbl = {"tenant": tn}
+        c = stat_get(labeled("STAT_slo_storm_req", lbl))
+        n = timer_get(labeled("TIMER_slo_storm_us", lbl))["count"]
+        assert c == n and c > 0
+        # the windowed view agrees with the lifetime view (the whole
+        # storm fits inside the 10s span)
+        assert monitor.counter_window_sum(
+            labeled("STAT_slo_storm_req", lbl), 10.0) == c

@@ -125,6 +125,29 @@ def test_timer_ring_is_sliding_window():
     assert st["p95"] > st["p50"]
 
 
+def test_timer_ring_min_max_tracks_recent_extremes():
+    """ring_min/ring_max follow the RECENT window while min/max stay
+    lifetime-exact: a startup latency spike that has rotated out of
+    the ring stops inflating ring_max, so "worst recently" and "worst
+    ever" are separately readable."""
+    name = "TIMER_tm_ring_extremes_us"
+    monitor.timer_observe(name, 1e6)  # startup spike, rotates out
+    for v in range(2000):
+        monitor.timer_observe(name, 100.0 + float(v % 50))
+    st = monitor.timer_get(name)
+    assert st["min"] == 100.0 and st["max"] == 1e6
+    assert st["ring_min"] == 100.0 and st["ring_max"] == 149.0
+    # never-observed timers read ring extremes as zeros, like the rest
+    empty = monitor.timer_get("TIMER_tm_ring_never_observed")
+    assert empty["ring_min"] == 0.0 and empty["ring_max"] == 0.0
+    # the extremes export as their own gauge families (a summary family
+    # may only carry {quantile}/_sum/_count samples)
+    text = monitor.to_prometheus()
+    assert "# TYPE paddle_tpu_%s_ring_max gauge" % name in text
+    assert "paddle_tpu_%s_ring_max 149" % name in text
+    assert "paddle_tpu_%s_max 1000000" % name in text
+
+
 def test_gauges_last_write_wins():
     monitor.gauge_set("GAUGE_tm_depth", 3)
     monitor.gauge_set("GAUGE_tm_depth", 7)

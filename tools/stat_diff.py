@@ -38,9 +38,18 @@ COST_SUFFIXES = ("_sync", "_miss", "_corrupt", "_evict", "_dropped",
 COST_INFIXES = ("_shed_", "_restart")
 
 
+def _family(name: str) -> str:
+    """Strip a Prometheus-style label block (monitor.labeled):
+    'STAT_x{tenant="a"}' -> 'STAT_x'. Classification and
+    missing-instrument checks work on the family so per-tenant /
+    windowed label sets diff like their base instrument."""
+    return name.split("{", 1)[0]
+
+
 def _is_cost_counter(name: str) -> bool:
-    return name.endswith(COST_SUFFIXES) \
-        or any(infix in name for infix in COST_INFIXES)
+    fam = _family(name)
+    return fam.endswith(COST_SUFFIXES) \
+        or any(infix in fam for infix in COST_INFIXES)
 
 
 def _as_snapshot(d: Dict) -> Dict:
@@ -72,11 +81,28 @@ def diff_snapshots(old: Dict, new: Dict) -> Dict:
     it was lost, which no value threshold can catch."""
     old, new = _as_snapshot(old), _as_snapshot(new)
     out: Dict[str, Dict] = {"counters": {}, "gauges": {}, "timers": {}}
+
+    def _vanished(kind: str, name: str) -> bool:
+        """Disappeared-instrument check, label-prefix-safe: a LABELED
+        series (per-tenant / windowed families) only counts as missing
+        when the whole family vanished — one bench run seeing tenants
+        the next run didn't is churn in the label set, not a lost
+        publishing code path. Unlabeled instruments keep the strict
+        per-name check."""
+        if name in new[kind]:
+            return False
+        if name not in old[kind]:
+            return False
+        if "{" not in name:
+            return True
+        fam = _family(name)
+        return not any(_family(k) == fam for k in new[kind])
+
     for kind in ("counters", "gauges"):
         for name in sorted(set(old[kind]) | set(new[kind])):
             a = float(old[kind].get(name, 0.0))
             b = float(new[kind].get(name, 0.0))
-            missing = name in old[kind] and name not in new[kind]
+            missing = _vanished(kind, name)
             if a != b or missing:
                 e = _delta(a, b)
                 if missing:
@@ -85,7 +111,7 @@ def diff_snapshots(old: Dict, new: Dict) -> Dict:
     for name in sorted(set(old["timers"]) | set(new["timers"])):
         a = old["timers"].get(name) or {}
         b = new["timers"].get(name) or {}
-        missing = name in old["timers"] and name not in new["timers"]
+        missing = _vanished("timers", name)
         entry: Dict = {}
         for k in ("count", "sum", "p95"):
             av, bv = float(a.get(k, 0.0)), float(b.get(k, 0.0))
