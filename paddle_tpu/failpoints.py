@@ -75,6 +75,13 @@ KNOWN_SITES: Tuple[str, ...] = (
     "checkpoint.save",
     "checkpoint.load",
     "trainstep.step",
+    # multi-process gang (launch.py, parallel/env.py): rendezvous
+    # failures, heartbeat loss (host hang), and worker step faults.
+    # Workers inherit arming through PADDLE_TPU_FAILPOINTS (read once
+    # at import), which is how the chaos tests pre-arm children.
+    "dist.rendezvous",
+    "worker.heartbeat",
+    "worker.step",
 )
 
 

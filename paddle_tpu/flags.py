@@ -192,6 +192,28 @@ _DEFS: Dict[str, Any] = {
     # backoff doubles from backoff_ms and is capped at 32x.
     "FLAGS_pool_max_restarts": 3,
     "FLAGS_pool_restart_backoff_ms": 50.0,
+    # gang launcher + supervisor (launch.py, docs/robustness.md
+    # "Multi-host fault model"). Workers beat every interval_s; a
+    # worker whose last beat is older than timeout_s is LOST (host
+    # hang) and the whole gang restarts. spawn_grace_s bounds the time
+    # from spawn to the FIRST beat (jax import + rendezvous ride inside
+    # it). Restart budget mirrors FLAGS_pool_max_restarts: capped
+    # exponential backoff from backoff_ms (doubling, capped at 32x),
+    # budget refunded once a gang incarnation makes step progress,
+    # sticky-terminal GangFailed on exhaustion.
+    "FLAGS_launch_heartbeat_interval_s": 1.0,
+    "FLAGS_launch_heartbeat_timeout_s": 10.0,
+    "FLAGS_launch_spawn_grace_s": 60.0,
+    "FLAGS_launch_max_restarts": 3,
+    "FLAGS_launch_restart_backoff_ms": 200.0,
+    # jax.distributed.initialize rendezvous bound (parallel/env.py):
+    # per-attempt timeout, retry count, and backoff between attempts.
+    # A rendezvous that cannot form inside the budget raises a typed
+    # RendezvousTimeout instead of hanging the worker. The launcher
+    # exports these to workers as PADDLE_RENDEZVOUS_* env vars.
+    "FLAGS_rendezvous_timeout_s": 60.0,
+    "FLAGS_rendezvous_retries": 2,
+    "FLAGS_rendezvous_backoff_ms": 200.0,
     # crash-safe training (incubate/checkpoint/, docs/robustness.md):
     # N > 0 makes TrainStep.run_loop / hapi fit write an atomic
     # checkpoint (tmp+fsync+rename, manifest with step/fingerprint/mesh

@@ -182,6 +182,10 @@ class Model:
         ck, ck_every, resume_step = None, 0, 0
         if self._train_step is not None:
             ck, ck_every = self._train_step._auto_checkpointer()
+        # multi-process gang (launch.py): all ranks restore, rank 0
+        # writes — same contract as TrainStep.run_loop
+        import jax as _jax
+        saver = _jax.process_count() == 1 or _jax.process_index() == 0
         if ck is not None:
             latest = ck.load_latest()
             if latest is not None:
@@ -210,7 +214,7 @@ class Model:
                     with _tm.span("hapi/drain_wait", step=dn,
                                   track="drain"):
                         h.block_until_ready()
-                if ck is not None and gstep % ck_every == 0:
+                if ck is not None and saver and gstep % ck_every == 0:
                     ck.save(gstep, self._train_step.state_snapshot())
                 # callback time is aggregate-only (trace=False): a span
                 # per batch would dominate the event buffer at scale

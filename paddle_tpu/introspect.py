@@ -35,6 +35,10 @@ code could. Endpoints:
                  calls/fires hit counts; POST arms
                  (``?arm=site%3Draise%40once`` or a spec-string body)
                  and disarms (``?disarm=site`` or ``?disarm=all``)
+- ``/workerz``   gang supervisors (launch.py, docs/robustness.md
+                 "Multi-host fault model"): per-worker state,
+                 last-heartbeat age, step progress, restart budget —
+                 read from the supervisor process
 
 Lifecycle: **off by default, zero overhead when off.**
 ``FLAGS_introspect_port`` is 0 → :func:`maybe_start` (called from
@@ -192,11 +196,21 @@ def statusz() -> Dict[str, Any]:
             },
         },
         "flight_recorder_steps": len(telemetry.flight_records()),
+        "gangs": _gang_status(),
         "tracing": _tracing_status(counters),
         "slo": _slo_status(),
         "failpoints_armed": _armed_failpoints(),
         "readiness": {"ready": ready, "checks": checks},
     }
+
+
+def _gang_status() -> list:
+    """The /statusz "gangs" section: one compact line per supervised
+    gang (/workerz has the full per-worker table)."""
+    from . import launch
+    return [{"name": g["name"], "state": g["state"],
+             "restarts": g["restarts"], "workers": len(g["workers"])}
+            for g in launch.workerz()["gangs"]]
 
 
 def _slo_status() -> Dict[str, Any]:
@@ -319,12 +333,15 @@ class _Handler(BaseHTTPRequestHandler):
             elif url.path == "/failpointz":
                 from . import failpoints
                 self._json({"sites": failpoints.sites()})
+            elif url.path == "/workerz":
+                from . import launch
+                self._json(launch.workerz())
             elif url.path == "/":
                 self._send(
                     200,
                     "paddle_tpu introspection: /metrics /healthz "
                     "/readyz /statusz /flightz /programz /tracez "
-                    "/sloz /failpointz\n",
+                    "/sloz /failpointz /workerz\n",
                     "text/plain; charset=utf-8")
             else:
                 self._send(404, "not found: %s\n" % url.path,

@@ -488,6 +488,12 @@ class TrainStep:
             new = arrays[key]
             sh = getattr(old, "sharding", None)
             if self.mesh is not None and sh is not None:
+                if jax.process_count() > 1:
+                    # gang resume (launch.py): every rank restored the
+                    # same host arrays; reassemble them as one global
+                    # array over the multi-process mesh
+                    return jax.make_array_from_process_local_data(
+                        sh, np.asarray(new))
                 return jax.device_put(np.asarray(new), sh)
             return jnp.asarray(new)
 
@@ -548,8 +554,14 @@ class TrainStep:
             window = int(get_flag("FLAGS_executor_inflight_steps", 2)
                          or 1)
         window = max(1, window)
+        from .launch import heartbeat_step
         ck, ck_every = self._auto_checkpointer()
         start_step = 0
+        # multi-process gang (launch.py): every rank RESTORES from the
+        # shared checkpoint dir (identical state everywhere), only rank
+        # 0 WRITES — the deterministic step means all ranks would write
+        # identical bytes, so the extra writers are pure waste + churn
+        saver = jax.process_count() == 1 or jax.process_index() == 0
         if ck is not None:
             latest = ck.load_latest()
             if latest is not None:
@@ -560,6 +572,10 @@ class TrainStep:
         for n, (inputs, labels) in enumerate(batches, start=1):
             if n <= start_step:
                 continue  # fast-forward the deterministic batch stream
+            # worker.step failpoint (mid-step host-loss model) + step
+            # progress into the gang heartbeat; standalone this is one
+            # dict lookup and a None check
+            heartbeat_step(n)
             # scope covers the FetchHandle wrap too, so the handle's
             # eventual first read syncs under this step's id
             with _tm.step_scope(n) if _tm.enabled() else nullcontext():
@@ -571,7 +587,7 @@ class TrainStep:
                               track="drain",
                               timer="TIMER_pipeline_drain_us"):
                     h.block_until_ready()
-            if ck is not None and n % ck_every == 0:
+            if ck is not None and saver and n % ck_every == 0:
                 # state_snapshot syncs, so the checkpoint holds step
                 # n's COMPLETED state (in-flight younger steps were
                 # dispatched after it and don't touch saved buffers)

@@ -1,0 +1,725 @@
+"""Gang launcher + supervisor for multi-process SPMD (docs/robustness.md
+"Multi-host fault model", docs/spmd.md "Launcher").
+
+The reference's distributed families both assume workers die: the
+parameter-server path heartbeats trainers from the pserver
+(/root/reference/paddle/fluid/operators/distributed/ listen-and-serve
+keeps per-trainer liveness), and the collective path restarts the whole
+gang from checkpoints. This module is that story for the mesh runtime:
+one supervisor process spawns N workers under the cluster env contract
+(fleet/launch.py's PADDLE_TRAINER_* variables), watches them through
+**monotonic-clock heartbeats**, and on any worker death (kill -9), hang
+(missed heartbeats), or raise tears the WHOLE gang down and restarts it
+— SPMD collectives make partial membership meaningless, so recovery is
+always gang-granular, exactly like the reference's collective mode.
+
+Recovery composes three existing pieces instead of inventing new ones:
+
+- restart budget: the PR-9 pool pattern (serving.py `_supervisor`) at
+  gang granularity — capped exponential backoff doubling from
+  FLAGS_launch_restart_backoff_ms (capped at 32x), budget refunded once
+  an incarnation makes step progress, sticky-terminal
+  :class:`GangFailed` on exhaustion (never a silent retry loop).
+- bounded rendezvous: workers call parallel/env.py's
+  init_distributed_runtime, which retries jax.distributed.initialize
+  under a budget and raises a typed RendezvousTimeout instead of
+  hanging; the supervisor sees the nonzero exit and restarts.
+- deterministic resume: workers run TrainStep.run_loop with
+  FLAGS_auto_checkpoint_steps; on restart the gang resumes from the
+  newest AtomicCheckpointer commit and fast-forwards the deterministic
+  batch stream, so the resumed loss stream is BITWISE-identical to an
+  uninterrupted run (pinned in tests/test_launch.py and measured by
+  bench.py's chaos_multihost block).
+
+Heartbeats ride a localhost TCP socket: each worker connects to the
+supervisor (PADDLE_LAUNCH_HEARTBEAT=host:port) and sends one JSON line
+every FLAGS_launch_heartbeat_interval_s. The supervisor stamps receipt
+with ``time.monotonic()`` — wall-clock jumps (NTP step, VM migration)
+can never fake or mask a missed-heartbeat window (the PR-8
+`_Future.t_submit` lesson, pinned by a wall-clock-jump test). A worker
+whose last beat is older than FLAGS_launch_heartbeat_timeout_s is LOST;
+a worker that never beats gets FLAGS_launch_spawn_grace_s (jax import +
+rendezvous ride inside it).
+
+Failpoint sites `dist.rendezvous`, `worker.heartbeat`, `worker.step`
+drive the chaos tests; workers inherit arming through the
+PADDLE_TPU_FAILPOINTS environment variable (read once at import).
+Observability: ``/workerz`` on the introspection server (per-worker
+state, last-heartbeat age, restart counts), STAT_launch_restarts /
+STAT_launch_worker_deaths / STAT_launch_worker_lost counters and the
+GAUGE_launch_worker_state{rank=...} series.
+
+CLI::
+
+    python -m paddle_tpu.launch --nproc 2 --cpu-devices-per-proc 1 \\
+        train.py --epochs 10
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional
+
+from .failpoints import failpoint
+from .monitor import gauge_set, labeled, stat_add
+
+__all__ = [
+    "GangFailed",
+    "GangSupervisor",
+    "heartbeat_step",
+    "main",
+    "maybe_start_worker_heartbeat",
+    "set_worker_state",
+    "workerz",
+]
+
+# GAUGE_launch_worker_state{rank=...} value encoding
+WORKER_STATE_CODES = {
+    "spawned": 0,     # process started, no heartbeat yet
+    "rendezvous": 1,  # beating, jax.distributed rendezvous in flight
+    "running": 2,     # rendezvous formed, training
+    "exited": 3,      # clean exit (rc 0)
+    "lost": 4,        # heartbeat window missed (host hang / kill -9)
+    "died": 5,        # nonzero exit / killed by signal
+}
+
+
+class GangFailed(RuntimeError):
+    """The gang exhausted its restart budget and is sticky-terminal.
+    Raised by :meth:`GangSupervisor.wait` / :meth:`run` — an in-flight
+    caller gets a typed error, never a hang. Carries the restart count
+    and the last failure cause for postmortems."""
+
+    def __init__(self, name: str, restarts: int, cause: str):
+        super().__init__(
+            "gang %r terminally failed after %d restart(s): %s"
+            % (name, restarts, cause))
+        self.name = name
+        self.restarts = restarts
+        self.cause = cause
+
+
+# ---------------------------------------------------------------------------
+# worker side: heartbeat client
+# ---------------------------------------------------------------------------
+
+class _Beater:
+    """Worker-side heartbeat thread. One JSON line per interval over the
+    supervisor's TCP socket; an immediate extra beat on every
+    state/step change so transitions reach the supervisor promptly."""
+
+    def __init__(self, addr: str, rank: int, attempt: int,
+                 interval_s: float, state: str):
+        host, _, port = addr.rpartition(":")
+        self.rank = rank
+        self.attempt = attempt
+        self.interval_s = interval_s
+        self.state = state
+        self.step = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._sock = socket.create_connection((host, int(port)), timeout=5)
+        self._thread = threading.Thread(target=self._loop,
+                                        name="pt-heartbeat", daemon=True)
+        self._thread.start()
+
+    def _send(self) -> None:
+        with self._lock:
+            msg = {"rank": self.rank, "attempt": self.attempt,
+                   "pid": os.getpid(), "state": self.state,
+                   "step": self.step}
+            self._sock.sendall((json.dumps(msg) + "\n").encode("utf-8"))
+        stat_add("STAT_worker_heartbeats_sent")
+
+    def beat(self) -> None:
+        try:
+            self._send()
+        except OSError:
+            pass  # supervisor gone; the beat loop will exit too
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            # OUTSIDE any try: an armed worker.heartbeat=raise kills
+            # this thread and the beats simply stop — the host-hang
+            # model the supervisor's missed-beat window detects.
+            # delay(ms) models a wedged-but-crawling host.
+            failpoint("worker.heartbeat")
+            try:
+                self._send()
+            except OSError:
+                return
+            self._stop.wait(self.interval_s)
+
+
+_BEATER: Optional[_Beater] = None
+_BEATER_LOCK = threading.Lock()
+
+
+def maybe_start_worker_heartbeat(state: str = "spawned") -> bool:
+    """Start the worker-side heartbeat thread iff this process was
+    spawned by a :class:`GangSupervisor` (PADDLE_LAUNCH_HEARTBEAT set).
+    Idempotent; returns True when a beater is running. Called from
+    parallel/env.py before rendezvous so a worker wedged in rendezvous
+    still reads as alive-but-stuck rather than silent."""
+    global _BEATER
+    addr = os.environ.get("PADDLE_LAUNCH_HEARTBEAT")
+    if not addr:
+        return False
+    with _BEATER_LOCK:
+        if _BEATER is not None:
+            return True
+        try:
+            _BEATER = _Beater(
+                addr,
+                rank=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+                attempt=int(os.environ.get("PADDLE_LAUNCH_ATTEMPT", "0")),
+                interval_s=float(os.environ.get(
+                    "PADDLE_LAUNCH_HEARTBEAT_INTERVAL_S", "1.0")),
+                state=state)
+        except OSError:
+            return False  # supervisor already gone; run unsupervised
+    return True
+
+
+def set_worker_state(state: str) -> None:
+    """Update this worker's reported state ('rendezvous' -> 'running');
+    no-op outside a supervised gang."""
+    b = _BEATER
+    if b is None:
+        return
+    b.state = state
+    b.beat()
+
+
+def heartbeat_step(step: int) -> None:
+    """Stamp training progress into the heartbeat stream — call once
+    per training step. Fires the `worker.step` failpoint (the
+    mid-step host-loss model for chaos tests) and, under a supervisor,
+    beats immediately so step progress refunds the restart budget
+    without waiting out the interval. No-op-cheap standalone."""
+    failpoint("worker.step")
+    b = _BEATER
+    if b is None:
+        return
+    b.step = int(step)
+    stat_add("STAT_worker_steps")
+    b.beat()
+
+
+# ---------------------------------------------------------------------------
+# supervisor side
+# ---------------------------------------------------------------------------
+
+class _Worker:
+    """Supervisor-side view of one gang member."""
+
+    __slots__ = ("rank", "proc", "state", "spawned_at", "last_beat",
+                 "beats", "step", "exit_code", "log_path")
+
+    def __init__(self, rank: int, proc: subprocess.Popen,
+                 log_path: Optional[str]):
+        self.rank = rank
+        self.proc = proc
+        self.state = "spawned"
+        self.spawned_at = time.monotonic()
+        self.last_beat: Optional[float] = None
+        self.beats = 0
+        self.step = 0
+        self.exit_code: Optional[int] = None
+        self.log_path = log_path
+
+
+_SUPERVISORS: "weakref.WeakSet[GangSupervisor]" = weakref.WeakSet()
+
+
+def workerz() -> Dict[str, Any]:
+    """The /workerz payload: every live supervisor's status."""
+    return {"gangs": [s.status() for s in list(_SUPERVISORS)]}
+
+
+def _free_ports(n: int) -> List[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class GangSupervisor:
+    """Spawn and supervise an N-process SPMD gang.
+
+    ``argv`` is the worker command (a leading ``*.py`` gets
+    ``sys.executable`` prepended); every worker runs the same command
+    and learns its rank from the cluster env contract. With
+    ``cpu_devices_per_proc`` set, workers are pinned to the CPU backend
+    with that many fake devices (this container / CI); leave it None on
+    real TPU pods where each process owns its local chips.
+
+    Lifecycle: :meth:`start` spawns the gang and the supervision
+    thread; :meth:`wait` blocks until the gang completes (returns 0) or
+    goes sticky-terminal (raises :class:`GangFailed` — never hangs);
+    :meth:`run` is start+wait+stop. All deadline arithmetic uses
+    ``time.monotonic()``.
+    """
+
+    def __init__(self, argv: List[str], nprocs: int, *,
+                 cpu_devices_per_proc: Optional[int] = None,
+                 log_dir: Optional[str] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 heartbeat_interval_s: Optional[float] = None,
+                 heartbeat_timeout_s: Optional[float] = None,
+                 spawn_grace_s: Optional[float] = None,
+                 max_restarts: Optional[int] = None,
+                 restart_backoff_ms: Optional[float] = None,
+                 rendezvous_timeout_s: Optional[float] = None,
+                 term_grace_s: float = 5.0,
+                 name: Optional[str] = None):
+        from .flags import get_flag
+
+        def _flag(v, fname, cast):
+            return cast(get_flag(fname)) if v is None else cast(v)
+
+        if argv and argv[0].endswith(".py"):
+            argv = [sys.executable] + list(argv)
+        self.argv = list(argv)
+        self.nprocs = int(nprocs)
+        self.cpu_devices_per_proc = cpu_devices_per_proc
+        self.log_dir = log_dir
+        self._base_env = dict(env) if env is not None else dict(os.environ)
+        self.heartbeat_interval_s = _flag(
+            heartbeat_interval_s, "FLAGS_launch_heartbeat_interval_s", float)
+        self.heartbeat_timeout_s = _flag(
+            heartbeat_timeout_s, "FLAGS_launch_heartbeat_timeout_s", float)
+        self.spawn_grace_s = _flag(
+            spawn_grace_s, "FLAGS_launch_spawn_grace_s", float)
+        self.max_restarts = _flag(
+            max_restarts, "FLAGS_launch_max_restarts", int)
+        self.restart_backoff_s = _flag(
+            restart_backoff_ms, "FLAGS_launch_restart_backoff_ms",
+            float) / 1e3
+        self.rendezvous_timeout_s = None if rendezvous_timeout_s is None \
+            else float(rendezvous_timeout_s)
+        self.term_grace_s = float(term_grace_s)
+        self.name = name or "gang%d" % os.getpid()
+
+        self._lock = threading.Lock()
+        self._state = "idle"  # idle -> running -> (restarting ->)
+        #                       done | failed (sticky)
+        self._attempt = 0
+        self._restarts = 0
+        self._progress_since_restart = False
+        self._failure_cause = ""
+        self._workers: Dict[int, _Worker] = {}
+        self._events: List[Dict[str, Any]] = []
+        self._stop_ev = threading.Event()
+        self._done_ev = threading.Event()
+        self._hb_sock: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+
+    # -- events / status ---------------------------------------------------
+
+    def _event(self, kind: str, **detail) -> None:
+        e = {"t_mono": time.monotonic(), "kind": kind}
+        e.update(detail)
+        with self._lock:
+            self._events.append(e)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def status(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        with self._lock:
+            workers = []
+            for w in self._workers.values():
+                workers.append({
+                    "rank": w.rank,
+                    "pid": w.proc.pid,
+                    "state": w.state,
+                    "beats": w.beats,
+                    "step": w.step,
+                    "exit_code": w.exit_code,
+                    "last_beat_age_s": (
+                        round(now - w.last_beat, 3)
+                        if w.last_beat is not None else None),
+                })
+            return {
+                "name": self.name,
+                "state": self._state,
+                "attempt": self._attempt,
+                "restarts": self._restarts,
+                "max_restarts": self.max_restarts,
+                "nprocs": self.nprocs,
+                "failure_cause": self._failure_cause or None,
+                "heartbeat": {
+                    "interval_s": self.heartbeat_interval_s,
+                    "timeout_s": self.heartbeat_timeout_s,
+                    "spawn_grace_s": self.spawn_grace_s,
+                },
+                "workers": sorted(workers, key=lambda w: w["rank"]),
+            }
+
+    def _set_worker_state(self, w: _Worker, state: str) -> None:
+        w.state = state
+        gauge_set(labeled("GAUGE_launch_worker_state",
+                          {"gang": self.name, "rank": str(w.rank)}),
+                  WORKER_STATE_CODES.get(state, -1))
+
+    # -- heartbeat server --------------------------------------------------
+
+    def _hb_serve(self) -> None:
+        assert self._hb_sock is not None
+        while not self._stop_ev.is_set():
+            try:
+                conn, _ = self._hb_sock.accept()
+            except OSError:
+                return  # socket closed by stop()
+            t = threading.Thread(target=self._hb_conn, args=(conn,),
+                                 name="pt-gang-hb", daemon=True)
+            t.start()
+
+    def _hb_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn, conn.makefile("r", encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        msg = json.loads(line)
+                    except ValueError:
+                        continue
+                    self._on_beat(msg)
+        except OSError:
+            pass
+
+    def _on_beat(self, msg: Dict[str, Any]) -> None:
+        now = time.monotonic()  # receipt-stamped on the SUPERVISOR's
+        # monotonic clock: worker clocks and wall time never enter the
+        # liveness math
+        with self._lock:
+            if int(msg.get("attempt", -1)) != self._attempt:
+                return  # stale beat from a torn-down incarnation
+            w = self._workers.get(int(msg.get("rank", -1)))
+            if w is None or w.state in ("lost", "died", "exited"):
+                return
+            w.last_beat = now
+            w.beats += 1
+            step = int(msg.get("step", 0) or 0)
+            if step > w.step:
+                w.step = step
+            state = msg.get("state")
+            if state in ("rendezvous", "running") and w.state != state:
+                self._set_worker_state(w, state)
+                first_running = state == "running"
+            else:
+                first_running = False
+            progressed = step > 0 and not self._progress_since_restart
+            if progressed:
+                self._progress_since_restart = True
+        if first_running:
+            self._event("worker_running", rank=w.rank)
+        if progressed:
+            self._event("step_progress", rank=w.rank, step=step)
+
+    # -- spawning / teardown -----------------------------------------------
+
+    def _worker_env(self, rank: int, endpoints: List[str],
+                    hb_port: int) -> Dict[str, str]:
+        env = dict(self._base_env)
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        env["PADDLE_TRAINERS_NUM"] = str(self.nprocs)
+        env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
+        env["PADDLE_COORDINATOR_ENDPOINT"] = endpoints[0]
+        env["PADDLE_CURRENT_ENDPOINT"] = endpoints[rank]
+        env["TRAINING_ROLE"] = "TRAINER"
+        env["PADDLE_LAUNCH_HEARTBEAT"] = "127.0.0.1:%d" % hb_port
+        env["PADDLE_LAUNCH_HEARTBEAT_INTERVAL_S"] = \
+            str(self.heartbeat_interval_s)
+        env["PADDLE_LAUNCH_ATTEMPT"] = str(self._attempt)
+        # Workers run `python <script>`, so sys.path[0] is the script's
+        # directory, not the supervisor's cwd. Propagate the cwd on
+        # PYTHONPATH (append, never overwrite: accelerator site dirs
+        # also ride this variable) so `import paddle_tpu` resolves the
+        # same way for workers as it did for the launcher.
+        cwd = os.getcwd()
+        paths = env.get("PYTHONPATH", "")
+        if cwd not in paths.split(os.pathsep):
+            env["PYTHONPATH"] = \
+                cwd + os.pathsep + paths if paths else cwd
+        if self.rendezvous_timeout_s is not None:
+            env["PADDLE_RENDEZVOUS_TIMEOUT_S"] = \
+                str(self.rendezvous_timeout_s)
+        if self.cpu_devices_per_proc is not None:
+            env["JAX_PLATFORMS"] = "cpu"
+            xla = [t for t in env.get("XLA_FLAGS", "").split()
+                   if not t.startswith(
+                       "--xla_force_host_platform_device_count")]
+            xla.append("--xla_force_host_platform_device_count=%d"
+                       % self.cpu_devices_per_proc)
+            env["XLA_FLAGS"] = " ".join(xla)
+        return env
+
+    def _spawn_gang(self) -> None:
+        endpoints = ["127.0.0.1:%d" % p for p in _free_ports(self.nprocs)]
+        hb_port = self._hb_sock.getsockname()[1]
+        with self._lock:
+            attempt = self._attempt
+        for rank in range(self.nprocs):
+            env = self._worker_env(rank, endpoints, hb_port)
+            log_path = None
+            out = None
+            if self.log_dir:
+                os.makedirs(self.log_dir, exist_ok=True)
+                log_path = os.path.join(
+                    self.log_dir,
+                    "worker%d.attempt%d.log" % (rank, attempt))
+                out = open(log_path, "wb")
+            try:
+                proc = subprocess.Popen(
+                    self.argv, env=env, stdout=out, stderr=out,
+                    start_new_session=True)
+            finally:
+                if out is not None:
+                    out.close()  # child holds its own fd
+            w = _Worker(rank, proc, log_path)
+            with self._lock:
+                self._workers[rank] = w
+            self._set_worker_state(w, "spawned")
+            self._event("spawn", rank=rank, pid=proc.pid, attempt=attempt)
+
+    def _kill_gang(self) -> None:
+        with self._lock:
+            workers = list(self._workers.values())
+        for sig in (signal.SIGTERM, signal.SIGKILL):
+            alive = [w for w in workers if w.proc.poll() is None]
+            if not alive:
+                break
+            for w in alive:
+                try:
+                    os.killpg(w.proc.pid, sig)
+                except (ProcessLookupError, PermissionError, OSError):
+                    try:
+                        w.proc.send_signal(sig)
+                    except Exception:
+                        pass
+            deadline = time.monotonic() + \
+                (self.term_grace_s if sig == signal.SIGTERM else 10.0)
+            for w in alive:
+                try:
+                    w.proc.wait(timeout=max(
+                        0.05, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    pass
+        for w in workers:
+            if w.proc.poll() is not None and w.exit_code is None:
+                w.exit_code = w.proc.returncode
+
+    # -- supervision loop --------------------------------------------------
+
+    def _check_gang(self) -> Optional[str]:
+        """One liveness sweep. Returns a failure cause string when the
+        gang must restart, None while healthy / still finishing."""
+        now = time.monotonic()
+        cause = None
+        done = True
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            if w.state == "exited":
+                continue
+            rc = w.proc.poll()
+            if rc is not None:
+                w.exit_code = rc
+                if rc == 0:
+                    self._set_worker_state(w, "exited")
+                    self._event("worker_exit", rank=w.rank, rc=0)
+                    continue
+                self._set_worker_state(w, "died")
+                stat_add("STAT_launch_worker_deaths")
+                self._event("worker_death", rank=w.rank, rc=rc)
+                cause = cause or ("worker %d died rc=%d" % (w.rank, rc))
+                done = False
+                continue
+            done = False
+            if w.last_beat is None:
+                if now - w.spawned_at > self.spawn_grace_s:
+                    self._set_worker_state(w, "lost")
+                    stat_add("STAT_launch_worker_lost")
+                    self._event("worker_lost", rank=w.rank,
+                                age_s=round(now - w.spawned_at, 3),
+                                phase="spawn")
+                    cause = cause or (
+                        "worker %d never heartbeat within spawn grace "
+                        "%.1fs" % (w.rank, self.spawn_grace_s))
+            elif now - w.last_beat > self.heartbeat_timeout_s:
+                self._set_worker_state(w, "lost")
+                stat_add("STAT_launch_worker_lost")
+                self._event("worker_lost", rank=w.rank,
+                            age_s=round(now - w.last_beat, 3),
+                            phase="run")
+                cause = cause or (
+                    "worker %d missed heartbeats for %.1fs (window "
+                    "%.1fs)" % (w.rank, now - w.last_beat,
+                                self.heartbeat_timeout_s))
+        if cause:
+            return cause
+        if done and workers:
+            with self._lock:
+                self._state = "done"
+            self._event("done")
+            self._done_ev.set()
+        return None
+
+    def _supervise(self) -> None:
+        while not self._stop_ev.is_set() and not self._done_ev.is_set():
+            cause = self._check_gang()
+            if cause is None:
+                self._stop_ev.wait(0.05)
+                continue
+            self._event("teardown", cause=cause)
+            self._kill_gang()
+            with self._lock:
+                # PR-9 refund: an incarnation that made step progress
+                # pays its own restart; only consecutive no-progress
+                # failures burn down the budget
+                if self._progress_since_restart:
+                    self._restarts = 0
+                self._restarts += 1
+                restarts = self._restarts
+                self._progress_since_restart = False
+                exhausted = restarts > self.max_restarts
+                if exhausted:
+                    self._state = "failed"
+                    self._failure_cause = cause
+                else:
+                    self._state = "restarting"
+                    self._attempt += 1
+            if exhausted:
+                stat_add("STAT_launch_restart_exhausted")
+                self._event("failed", restarts=restarts - 1, cause=cause)
+                self._done_ev.set()
+                return
+            stat_add("STAT_launch_restarts")
+            backoff = min(self.restart_backoff_s * 2 ** (restarts - 1),
+                          self.restart_backoff_s * 32)
+            self._event("restart", attempt=self._attempt,
+                        restarts=restarts, backoff_s=round(backoff, 3),
+                        cause=cause)
+            if self._stop_ev.wait(backoff):
+                return
+            self._spawn_gang()
+            with self._lock:
+                if self._state == "restarting":
+                    self._state = "running"
+
+    # -- public lifecycle --------------------------------------------------
+
+    def start(self) -> "GangSupervisor":
+        with self._lock:
+            if self._state != "idle":
+                return self
+            self._state = "running"
+        self._hb_sock = socket.socket()
+        self._hb_sock.bind(("127.0.0.1", 0))
+        self._hb_sock.listen(self.nprocs * 2 + 4)
+        _SUPERVISORS.add(self)
+        from . import introspect
+        introspect.register_readiness(
+            "gang_" + self.name,
+            lambda: self._state in ("running", "done"))
+        self._spawn_gang()
+        for target, nm in ((self._hb_serve, "pt-gang-accept"),
+                           (self._supervise, "pt-gang-supervise")):
+            t = threading.Thread(target=target, name=nm, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        """Block until the gang completes. Returns 0 on success; raises
+        :class:`GangFailed` when the restart budget is exhausted and
+        TimeoutError when `timeout` elapses first — never hangs."""
+        if not self._done_ev.wait(timeout):
+            raise TimeoutError(
+                "gang %r still %s after %.1fs"
+                % (self.name, self._state, timeout or 0.0))
+        with self._lock:
+            if self._state == "failed":
+                raise GangFailed(self.name, self._restarts - 1,
+                                 self._failure_cause)
+        return 0
+
+    def run(self, timeout: Optional[float] = None) -> int:
+        self.start()
+        try:
+            return self.wait(timeout)
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        """Tear everything down (idempotent). Keeps the terminal state
+        readable through status(); unregisters the readiness probe."""
+        self._stop_ev.set()
+        self._done_ev.set()
+        self._kill_gang()
+        if self._hb_sock is not None:
+            try:
+                self._hb_sock.close()
+            except OSError:
+                pass
+        from . import introspect
+        introspect.unregister_readiness("gang_" + self.name)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.launch",
+        description="supervised gang launcher for multi-process SPMD")
+    p.add_argument("--nproc", type=int, default=2)
+    p.add_argument("--cpu-devices-per-proc", type=int, default=None,
+                   help="pin workers to the CPU backend with N fake "
+                        "devices each (omit on TPU pods)")
+    p.add_argument("--log-dir", default=None)
+    p.add_argument("--max-restarts", type=int, default=None)
+    p.add_argument("--heartbeat-interval-s", type=float, default=None)
+    p.add_argument("--heartbeat-timeout-s", type=float, default=None)
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="worker command (script.py args...)")
+    ns = p.parse_args(argv)
+    cmd = ns.cmd[1:] if ns.cmd[:1] == ["--"] else ns.cmd
+    if not cmd:
+        p.error("missing worker command")
+    sup = GangSupervisor(
+        cmd, ns.nproc,
+        cpu_devices_per_proc=ns.cpu_devices_per_proc,
+        log_dir=ns.log_dir,
+        max_restarts=ns.max_restarts,
+        heartbeat_interval_s=ns.heartbeat_interval_s,
+        heartbeat_timeout_s=ns.heartbeat_timeout_s)
+    try:
+        return sup.run()
+    except GangFailed as e:
+        print("launch: %s" % e, file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        sup.stop()
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -138,6 +138,19 @@ class ShardingPlan:
         if nbytes is None:
             nbytes = int(np.asarray(value).nbytes)
         stat_add("STAT_mesh_reshard_bytes", float(nbytes))
+        if jax.process_count() > 1 and not sharding.is_fully_addressable \
+                and (not isinstance(value, jax.Array)
+                     or value.is_fully_addressable):
+            # plan spans processes (launch.py gangs): a process-local
+            # value (host array, or a single-process jax array — the
+            # TrainStep feed path materializes feeds locally before
+            # staging) is this process's LOCAL shard (for replicated
+            # shardings the local copy IS the global value), assembled
+            # into one global array — same contract as
+            # parallel.shard_batch. device_put would instead assert
+            # the value is identical on every process.
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(value))
         return jax.device_put(value, sharding)
 
     def stage_feeds(self, feeds: Dict[str, Any]) -> Dict[str, Any]:

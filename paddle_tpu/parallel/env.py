@@ -15,11 +15,30 @@ Axes (left open for every parallelism family the framework supports):
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+class RendezvousTimeout(RuntimeError):
+    """jax.distributed rendezvous could not form inside its bounded
+    budget (timeout x retries). Typed so the gang supervisor and tests
+    can tell "the cluster never assembled" from a training error —
+    and so a missing peer is a raised error, never a silent hang."""
+
+    def __init__(self, coordinator: str, attempts: int, elapsed_s: float,
+                 cause: Optional[BaseException] = None):
+        super().__init__(
+            "rendezvous with %s failed after %d attempt(s) in %.1fs%s"
+            % (coordinator, attempts, elapsed_s,
+               ": %r" % (cause,) if cause is not None else ""))
+        self.coordinator = coordinator
+        self.attempts = attempts
+        self.elapsed_s = elapsed_s
+        self.cause = cause
 
 DP_AXIS = "dp"
 MP_AXIS = "mp"
@@ -91,9 +110,31 @@ _env = DistEnv()
 _dist_initialized = False
 
 
+def _rendezvous_budget() -> Tuple[float, int, float]:
+    """(per-attempt timeout_s, retries, backoff_s). Env vars win (the
+    launcher exports them to workers); flags are the in-process
+    default. All deadline math downstream is time.monotonic()."""
+    from ..flags import get_flag
+
+    def _f(env: str, flag: str, cast):
+        v = os.environ.get(env)
+        if v is not None:
+            return cast(v)
+        return cast(get_flag(flag))
+
+    timeout_s = _f("PADDLE_RENDEZVOUS_TIMEOUT_S",
+                   "FLAGS_rendezvous_timeout_s", float)
+    retries = _f("PADDLE_RENDEZVOUS_RETRIES",
+                 "FLAGS_rendezvous_retries", int)
+    backoff_s = _f("PADDLE_RENDEZVOUS_BACKOFF_MS",
+                   "FLAGS_rendezvous_backoff_ms", float) / 1e3
+    return timeout_s, retries, backoff_s
+
+
 def init_distributed_runtime(coordinator_address: Optional[str] = None,
                              num_processes: Optional[int] = None,
-                             process_id: Optional[int] = None) -> bool:
+                             process_id: Optional[int] = None,
+                             timeout_s: Optional[float] = None) -> bool:
     """Multi-process/multi-host bootstrap — the TPU analog of the
     reference's c_gen_nccl_id -> c_comm_init op pair
     (/root/reference/python/paddle/fluid/transpiler/collective.py:113-123)
@@ -106,6 +147,14 @@ def init_distributed_runtime(coordinator_address: Optional[str] = None,
     the coordination service; jax.distributed wires every process into ONE
     global PjRt topology, after which jax.devices() spans all hosts and a
     Mesh over it rides ICI within a slice / DCN across hosts.
+
+    Rendezvous is BOUNDED: each jax.distributed.initialize attempt gets
+    `timeout_s` (default FLAGS_rendezvous_timeout_s, env-overridable as
+    PADDLE_RENDEZVOUS_TIMEOUT_S), failed attempts retry with backoff up
+    to FLAGS_rendezvous_retries, and exhaustion raises a typed
+    :class:`RendezvousTimeout` — a gang missing one peer fails loudly
+    instead of hanging until an operator notices (launch.py turns that
+    raise into a supervised gang restart).
 
     Must run before the local backend initializes. Returns True when a
     multi-process runtime was (already) formed.
@@ -127,6 +176,11 @@ def init_distributed_runtime(coordinator_address: Optional[str] = None,
         raise RuntimeError(
             "multi-process init needs PADDLE_TRAINER_ENDPOINTS or "
             "PADDLE_COORDINATOR_ENDPOINT (launch/spawn set these)")
+    # under a supervisor (launch.py), start beating BEFORE rendezvous:
+    # a worker wedged in rendezvous is alive-but-stuck, and its own
+    # RendezvousTimeout (below) is what turns that into a restart
+    from ..launch import maybe_start_worker_heartbeat
+    maybe_start_worker_heartbeat(state="rendezvous")
     # CPU backends need an explicit cross-process collectives impl:
     # without it XLA:CPU refuses multi-process computations outright
     # ("Multiprocess computations aren't implemented on the CPU
@@ -139,10 +193,40 @@ def init_distributed_runtime(coordinator_address: Optional[str] = None,
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
         except Exception:  # pragma: no cover - jaxlib without gloo
             pass
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=n, process_id=rank)
-    _dist_initialized = True
-    return True
+    from ..failpoints import failpoint
+    from ..monitor import stat_add
+    per_try, retries, backoff_s = _rendezvous_budget()
+    if timeout_s is not None:
+        per_try = float(timeout_s)
+    t0 = time.monotonic()  # monotonic: wall-clock jumps must not
+    attempts = 0           # shrink or stretch the rendezvous budget
+    last_err: Optional[BaseException] = None
+    while attempts <= retries:
+        attempts += 1
+        try:
+            # failpoint sits INSIDE the attempt loop so raise@once
+            # models a transient coordinator blip (retry succeeds)
+            # and plain raise models a peer that never shows up
+            failpoint("dist.rendezvous")
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=n, process_id=rank,
+                initialization_timeout=max(1, int(per_try)))
+            _dist_initialized = True
+            from ..launch import set_worker_state
+            set_worker_state("running")
+            return True
+        except Exception as e:
+            last_err = e
+            try:  # release any half-formed client before retrying
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            if attempts <= retries:
+                stat_add("STAT_worker_rendezvous_retries")
+                time.sleep(backoff_s * (2 ** (attempts - 1)))
+    raise RendezvousTimeout(coordinator_address, attempts,
+                            time.monotonic() - t0, last_err)
 
 
 def init_parallel_env(mesh_shape: Optional[Dict[str, int]] = None,

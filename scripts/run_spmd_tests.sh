@@ -5,8 +5,10 @@
 # plus the mesh plan, the per-axis host-collective census
 # (STAT_mesh_collective_<axis>, monitor.py), the chaos smoke
 # (failpoints armed over /failpointz, recovery asserted — ISSUE 9),
-# and the SLO smoke (/sloz text + JSON scraped with per-tenant labeled
-# families on /metrics — ISSUE 12).
+# the SLO smoke (/sloz text + JSON scraped with per-tenant labeled
+# families on /metrics — ISSUE 12), and the multi-process gang smoke
+# (2 supervised jax workers, one killed -9 mid-step, bitwise-identical
+# resumed loss stream — ISSUE 13).
 #
 # Usage: scripts/run_spmd_tests.sh [extra pytest args...]
 set -u
@@ -310,13 +312,99 @@ finally:
     _slo_cleanup.disable()
     _slo_cleanup.clear_objectives()
 
+# multi-process gang smoke (ISSUE 13, docs/robustness.md "Multi-host
+# fault model"): a REAL 2-process jax gang through the supervised
+# launcher (paddle_tpu.launch) — kill -9 one rank mid-step; the
+# supervisor must detect it, restart the gang from the newest
+# checkpoint, and the spliced loss stream must be BITWISE-identical
+# to an uninterrupted gang's.
+multihost = {"ok": False}
+try:
+    import os
+    import shutil
+    import signal
+    import tempfile
+    import time as _time
+    from paddle_tpu.launch import GangSupervisor
+
+    _tmp = tempfile.mkdtemp(prefix="pt_gang_smoke_")
+
+    def _gang(name):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.getcwd() + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        env["GANG_STEPS"] = "8"
+        env["GANG_CK_EVERY"] = "2"
+        env["GANG_CKDIR"] = os.path.join(_tmp, "ck_" + name)
+        return GangSupervisor(
+            [os.path.join("tests", "gang_runner.py")], 2,
+            cpu_devices_per_proc=1, log_dir=os.path.join(_tmp, name),
+            env=env, heartbeat_interval_s=0.2, heartbeat_timeout_s=30.0,
+            spawn_grace_s=300.0, max_restarts=2, restart_backoff_ms=50.0,
+            name="smoke_" + name)
+
+    def _losses(name):
+        out = {}
+        d = os.path.join(_tmp, name)
+        for fn in sorted(os.listdir(d)):
+            with open(os.path.join(d, fn)) as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) == 3 and parts[0] == "STEP":
+                        out[int(parts[1])] = parts[2]
+        return out
+
+    try:
+        _gang("ref").run(timeout=600)
+        ref_losses = _losses("ref")
+
+        sup = _gang("chaos")
+        sup.start()
+        t_kill = None
+        try:
+            deadline = _time.monotonic() + 480
+            while _time.monotonic() < deadline:
+                st = sup.status()
+                if st["attempt"] == 0 and \
+                        max(w["step"] for w in st["workers"]) >= 3:
+                    w1 = [w for w in st["workers"]
+                          if w["rank"] == 1][0]
+                    t_kill = _time.monotonic()
+                    os.kill(w1["pid"], signal.SIGKILL)
+                    break
+                _time.sleep(0.02)
+            sup.wait(timeout=600)
+        finally:
+            sup.stop()
+        got = _losses("chaos")
+        det = [e for e in sup.events() if t_kill is not None
+               and e["t_mono"] >= t_kill
+               and e["kind"] in ("worker_death", "worker_lost")]
+        bitwise = sorted(got) == sorted(ref_losses) == \
+            list(range(1, 9)) and got == ref_losses
+        multihost = {
+            "ok": bitwise and bool(det),
+            "workers": 2,
+            "killed_rank": 1,
+            "detection_path": det[0]["kind"] if det else None,
+            "detection_ms": round((det[0]["t_mono"] - t_kill) * 1e3, 1)
+            if det else None,
+            "restarts": sup.status()["restarts"],
+            "steps": len(got),
+            "resume_bitwise_identical": bitwise,
+        }
+    finally:
+        shutil.rmtree(_tmp, ignore_errors=True)
+except Exception as e:  # noqa: BLE001 - artifact records the failure
+    multihost["error"] = "%s: %s" % (type(e).__name__, e)
+
 counters = monitor.get_float_stats()
 artifact = {
     "n_devices": len(jax.devices()),
     "rc": rc,
     "ok": rc == 0 and test_rc == 0 and intro.get("ok", False)
     and chaos.get("ok", False) and generation.get("ok", False)
-    and slo_smoke.get("ok", False),
+    and slo_smoke.get("ok", False) and multihost.get("ok", False),
     "skipped": False,
     "spmd_tests_rc": test_rc,
     "mesh_plan": {
@@ -328,6 +416,7 @@ artifact = {
     },
     "introspect": intro,
     "chaos": chaos,
+    "multihost": multihost,
     "generation": generation,
     "slo": slo_smoke,
     "collectives": {k: v for k, v in sorted(counters.items())
@@ -341,8 +430,8 @@ with open("MULTICHIP_r06.json", "w") as f:
     f.write("\n")
 print(json.dumps({k: artifact[k] for k in
                   ("n_devices", "rc", "ok", "spmd_tests_rc",
-                   "introspect", "chaos", "generation", "slo",
-                   "collectives")}, indent=1))
+                   "introspect", "chaos", "multihost", "generation",
+                   "slo", "collectives")}, indent=1))
 sys.exit(0 if artifact["ok"] else 1)
 EOF
 exit $?

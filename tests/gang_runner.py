@@ -1,0 +1,122 @@
+"""Gang worker for launch.py tests and bench (dist_runner.py sibling).
+
+Trains the fixed-seed MLP under a ``ShardingPlan({"dp": ndev})`` that
+spans however many processes the launcher formed, through
+``TrainStep.run_loop`` — so auto-checkpoint/resume, the batch-stream
+fast-forward, and the `worker.step` failpoint all ride the REAL
+training loop. Rank 0 prints one line per completed step::
+
+    STEP <n> <loss-float32-hex>
+
+flushed immediately, so a worker killed mid-run has already emitted its
+completed prefix and the parent can splice incarnations together keyed
+by step number and compare bitwise against an uninterrupted run.
+
+Env contract (beyond the launcher's PADDLE_* variables):
+  GANG_STEPS     total steps to train (default 8)
+  GANG_CKDIR     shared checkpoint dir; enables auto-checkpointing
+  GANG_CK_EVERY  checkpoint every N steps (default 2)
+  GANG_FP        failpoint spec armed IFF this rank is GANG_FP_RANK and
+  GANG_FP_RANK   this is gang attempt 0 (so the restarted gang runs
+                 clean and recovery can be asserted)
+"""
+import os
+import sys
+
+import numpy as np
+
+
+class _Counting:
+    """Wrap the batch stream so the worker can recover the step number
+    run_loop is on when it yields (run_loop consumes exactly the
+    batches for the steps it has dispatched)."""
+
+    def __init__(self, it):
+        self.n = 0
+        self._it = it
+
+    def __iter__(self):
+        for b in self._it:
+            self.n += 1
+            yield b
+
+
+def _batches(steps, nproc, rank):
+    # a DETERMINISTIC global stream (the resume contract): every
+    # incarnation regenerates the same batches; each process feeds its
+    # LOCAL row-shard, the plan assembles the global array
+    rng = np.random.RandomState(3)
+    for _ in range(steps):
+        x = rng.randn(8, 8).astype(np.float32)
+        y = (x.sum(axis=1, keepdims=True) > 0).astype(np.float32)
+        if nproc > 1:
+            per = 8 // nproc
+            x = x[rank * per:(rank + 1) * per]
+            y = y[rank * per:(rank + 1) * per]
+        yield ((x,), (y,))
+
+
+def main():
+    steps = int(os.environ.get("GANG_STEPS", "8"))
+    ckdir = os.environ.get("GANG_CKDIR", "")
+    ck_every = int(os.environ.get("GANG_CK_EVERY", "2"))
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as pt
+    import paddle_tpu.parallel as dist
+    from paddle_tpu import nn
+    from paddle_tpu.dygraph import seed
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.mesh.plan import ShardingPlan
+
+    # chaos arming: one specific rank, first incarnation only
+    fp = os.environ.get("GANG_FP", "")
+    if fp and os.environ.get("PADDLE_TRAINER_ID", "0") == \
+            os.environ.get("GANG_FP_RANK", "0") and \
+            int(os.environ.get("PADDLE_LAUNCH_ATTEMPT", "0")) == 0:
+        from paddle_tpu import failpoints
+        failpoints.arm_spec(fp)
+
+    # bootstrap FIRST: seeding creates a PRNGKey, which would
+    # initialize the local backend before jax.distributed can form the
+    # global one (dist_runner.py discipline)
+    dist.init_distributed_runtime()
+    seed(7)
+    nproc = jax.process_count()
+    rank = jax.process_index()
+    plan = ShardingPlan({"dp": len(jax.devices())})
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(8, 16)
+            self.l2 = nn.Linear(16, 1)
+
+        def forward(self, x):
+            return self.l2(self.l1(x).tanh())
+
+    def loss_fn(pred, label):
+        return ((pred - label) * (pred - label)).mean()
+
+    model = MLP()
+    opt = pt.optimizer.SGD(0.1, parameters=model.parameters())
+    step = TrainStep(model, loss_fn, opt, plan=plan)
+
+    if ckdir:
+        pt.set_flags({"FLAGS_auto_checkpoint_steps": ck_every,
+                      "FLAGS_checkpoint_dir": ckdir})
+
+    stream = _Counting(_batches(steps, nproc, rank))
+    for h in step.run_loop(stream, window=2):
+        loss = np.float32(np.asarray(h))
+        if rank == 0:
+            print("STEP %d %s" % (stream.n, loss.tobytes().hex()),
+                  flush=True)
+    if rank == 0:
+        print("GANG_DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

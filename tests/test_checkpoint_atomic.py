@@ -67,6 +67,37 @@ def test_roundtrip_retention_and_manifest(tmp_path):
     _assert_bitwise(arrays, _arrays(3))
 
 
+def test_commit_fsyncs_payload_rename_and_directory(tmp_path, monkeypatch):
+    """Durability regression pin for _atomic_write: each publish must
+    fsync the tmp file BEFORE the rename and fsync the DIRECTORY after
+    it (a rename without the directory fsync can vanish on power loss
+    — the payload would survive but the commit record could not be
+    trusted), and the payload must be published before the manifest
+    (the manifest is the commit record)."""
+    import stat as stat_mod
+    events = []
+    real_fsync, real_replace = os.fsync, os.replace
+
+    def rec_fsync(fd):
+        kind = "dir" if stat_mod.S_ISDIR(os.fstat(fd).st_mode) else "file"
+        events.append(("fsync", kind))
+        return real_fsync(fd)
+
+    def rec_replace(src, dst):
+        events.append(("replace", os.path.basename(dst)))
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "fsync", rec_fsync)
+    monkeypatch.setattr(os, "replace", rec_replace)
+    AtomicCheckpointer(str(tmp_path)).save(1, _arrays(1))
+    assert events == [
+        ("fsync", "file"), ("replace", "ckpt_00000001.npz"),
+        ("fsync", "dir"),
+        ("fsync", "file"), ("replace", "ckpt_00000001.json"),
+        ("fsync", "dir"),
+    ]
+
+
 def test_load_latest_none_on_empty(tmp_path):
     assert AtomicCheckpointer(str(tmp_path)).load_latest() is None
     assert AtomicCheckpointer(str(tmp_path / "nonexistent")) \

@@ -28,13 +28,18 @@ import json
 import sys
 from typing import Dict, List, Optional
 
-# counter-name suffixes where an increase is a cost, not throughput
+# counter-name suffixes where an increase is a cost, not throughput;
+# the launch/worker gang families (ISSUE 13): worker deaths, lost
+# (missed-heartbeat) workers, and rendezvous retries are failures, not
+# work done — heartbeats_sent / worker_steps stay free-running
 COST_SUFFIXES = ("_sync", "_miss", "_corrupt", "_evict", "_dropped",
-                 "_unexportable")
+                 "_unexportable", "_worker_deaths", "_worker_lost",
+                 "_rendezvous_retries")
 # infix families for the robustness counters (docs/robustness.md):
 # STAT_<kind>_shed_at_admit, STAT_<kind>_restarts /
 # _restart_exhausted — shed and restart events are always costs, for
-# any pool kind, so match on substring rather than enumerating kinds
+# any pool kind (serving pools and launch gangs alike), so match on
+# substring rather than enumerating kinds
 COST_INFIXES = ("_shed_", "_restart")
 
 
