@@ -1252,6 +1252,307 @@ def bench_generation_mixed():
     }
 
 
+def bench_generation_prefix():
+    """prefix-cache generation block (ISSUE 14, docs/generation.md):
+    cache-on vs cache-off chunked engines over the SAME agent-style
+    request stream — every prompt opens with one shared 96-token
+    system prefix (two full 48-token chunks) followed by a short
+    unique suffix. The cache-on engine PERSISTS its PrefixCache across
+    passes, so after the cold first pass every admission walks the
+    cached chunk chain and starts prefill at the suffix; the cache-off
+    engine recomputes the prefix every time.
+
+    Gates (ISSUE 14 acceptance): cache-on TTFT p95 >= 2x lower than
+    cache-off, zero steady-state recompiles (admission through the
+    cache reuses the same mixed + COW executables), streams
+    bitwise-identical between the two engines keyed by request_id.
+    TIMER_generation_prefix_admit_us rides the persisted-snapshot
+    stat_diff gate (PT_GENERATION_PREFIX_BENCH_SNAPSHOT)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import stat_diff
+    from dataclasses import replace
+    from paddle_tpu.generation import (DecoderConfig, GenerationEngine,
+                                       GenerationRequest,
+                                       SamplingParams, init_params)
+    from paddle_tpu import monitor
+    from paddle_tpu import tracing as _tracing
+    from paddle_tpu.monitor import gauge_get, stat_get
+
+    cfg = DecoderConfig(vocab_size=128, hidden=64, layers=4, heads=4,
+                        max_seq_len=128)
+    params = init_params(cfg, seed=0)
+
+    rng = np.random.RandomState(14)
+    # the shared "system prompt": 96 tokens = two full 48-token chunks
+    # (chunk-aligned boundaries — the cache's hash unit). 16 requests
+    # over 8 lanes = two admission waves: enough queueing to be a real
+    # serving shape, little enough that the p95 TTFT still reflects
+    # the prefill compute the cache removes rather than queue delay.
+    system = list(rng.randint(1, cfg.vocab_size, size=96))
+    R = 16
+    reqs = []
+    for i in range(R):
+        suffix = list(rng.randint(1, cfg.vocab_size,
+                                  size=int(rng.randint(3, 7))))
+        reqs.append(GenerationRequest(
+            prompt=system + suffix,
+            max_new_tokens=int(rng.randint(3, 6)),
+            sampling=SamplingParams(
+                temperature=0.8 if i % 2 else 0.0,
+                top_k=16 if i % 3 == 0 else 0, seed=i),
+            request_id=i))
+    total_new = sum(r.max_new_tokens for r in reqs)
+
+    def _pct(xs, p):
+        if not xs:
+            return None
+        return round(sorted(xs)[int(p * (len(xs) - 1))], 1)
+
+    def run_pass(eng):
+        traces = {}
+        for r in reqs:
+            tr = _tracing.begin("generation")
+            traces[r.request_id] = tr
+            eng.submit(replace(r, trace=tr))
+        done = []
+        t0 = time.perf_counter()
+        while not eng.idle:
+            done.extend(eng.step())
+        wall = time.perf_counter() - t0
+        return wall, traces, done
+
+    def report(best):
+        wall, traces, done = best
+        ttfts = []
+        for tr in traces.values():
+            if getattr(tr, "t_first_token", None) is None:
+                continue
+            ttfts.append((tr.t_first_token - tr.t0) * 1e6)
+        return {
+            "tokens_per_sec": round(total_new / wall, 1),
+            "ttft_us": {"p50": _pct(ttfts, 0.5),
+                        "p95": _pct(ttfts, 0.95)},
+        }, {res.request_id: res.tokens for res in done}
+
+    # interleaved best-of-4 for the same reason as the mixed block:
+    # a ratio gate needs both engines sampling the same CPU-drift
+    # windows. The cache-on engine keeps its cache across passes —
+    # pass 1 is its cold pass and best-of-4 reports its WARM steady
+    # state, which is exactly the serving regime the cache targets.
+    mk = lambda **kw: GenerationEngine(  # noqa: E731
+        cfg, params, num_blocks=256, block_size=8, decode_width=8,
+        prefill_buckets="pow2:128", prefill_chunk=48, token_budget=104,
+        **kw)
+    off_eng = mk(prefix_cache=False)
+    on_eng = mk(prefix_cache=True)
+    off_eng.warmup()
+    on_eng.warmup()
+    c0 = stat_get("STAT_generation_compile")
+    h0 = stat_get("STAT_generation_prefix_hits")
+    m0 = stat_get("STAT_generation_prefix_misses")
+    w0 = stat_get("STAT_generation_prefix_cow_copies")
+    off_best = on_best = None
+    for _ in range(4):
+        for eng, which in ((off_eng, "off"), (on_eng, "on")):
+            got = run_pass(eng)
+            if which == "off":
+                if off_best is None or got[0] < off_best[0]:
+                    off_best = got
+            else:
+                if on_best is None or got[0] < on_best[0]:
+                    on_best = got
+    recompiles = int(stat_get("STAT_generation_compile") - c0)
+    off_rep, off_tokens = report(off_best)
+    on_rep, on_tokens = report(on_best)
+    on_rep["prefix_hits"] = int(
+        stat_get("STAT_generation_prefix_hits") - h0)
+    on_rep["prefix_misses"] = int(
+        stat_get("STAT_generation_prefix_misses") - m0)
+    on_rep["cow_copies"] = int(
+        stat_get("STAT_generation_prefix_cow_copies") - w0)
+    on_rep["kv_blocks_saved"] = int(gauge_get("GAUGE_kv_blocks_saved"))
+
+    parity = off_tokens == on_tokens and len(on_tokens) == R
+
+    keep = lambda name: "generation" in name  # noqa: E731
+    snap = monitor.snapshot()
+    cur = {
+        "counters": {k: v for k, v in snap["counters"].items()
+                     if keep(k)},
+        "gauges": {},
+        "timers": {k: v for k, v in snap["timers"].items()
+                   if keep(k)},
+    }
+    snap_path = os.environ.get(
+        "PT_GENERATION_PREFIX_BENCH_SNAPSHOT",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "bench_generation_prefix_last.json"))
+    regressions = []
+    try:
+        prev = stat_diff.load_snapshot(snap_path)
+        regressions = stat_diff.find_regressions(
+            stat_diff.diff_snapshots(prev, cur), threshold_pct=25.0)
+        regressions = [r for r in regressions if r.startswith("timer")]
+    except OSError:
+        pass  # first run: nothing to compare against
+    try:
+        os.makedirs(os.path.dirname(snap_path), exist_ok=True)
+        with open(snap_path, "w") as f:
+            json.dump(cur, f)
+    except OSError:
+        pass
+
+    ttft_ratio = round(off_rep["ttft_us"]["p95"]
+                       / on_rep["ttft_us"]["p95"], 2)
+    return {
+        "workload": "decoder L%d-H%d: %d requests, 96-token shared "
+                    "prefix + 3..6 suffix, %d new tokens, width 8 "
+                    "chunk 48 budget 104" % (cfg.layers, cfg.hidden,
+                                             R, total_new),
+        "cache_off": off_rep,
+        "cache_on": on_rep,
+        "ttft_p95_ratio_off_vs_on": ttft_ratio,
+        "meets_ttft_2x": ttft_ratio >= 2.0,
+        "speedup_tokens_per_sec": round(
+            on_rep["tokens_per_sec"] / off_rep["tokens_per_sec"], 2),
+        "steady_state_recompiles": recompiles,
+        "tokens_bitwise_identical": bool(parity),
+        "prefix_admit_p95_regressions": regressions,
+    }
+
+
+def bench_generation_spec():
+    """speculative-decoding generation block (ISSUE 14,
+    docs/generation.md): the ngram (prompt-lookup) drafter proposing
+    k=3 tokens per decode lane per mixed step, verified in ONE pass of
+    the same token_budget-slot executable, vs the identical engine
+    with speculation off. Greedy requests over self-similar prompts —
+    the regime prompt-lookup drafting targets (agent loops, code,
+    retrieval-heavy serving).
+
+    Gates (ISSUE 14 acceptance): streams bitwise-identical to plain
+    decode, zero steady-state recompiles, tokens/s ratio >= 1.0
+    HONESTLY measured — the draft is host-side and the verify slots
+    ride a step the engine was already paying for, so on this CPU the
+    ratio reflects real acceptance, not kernel-width accounting. The
+    acceptance rate is reported so a regression in drafter quality is
+    visible even while the ratio gate still passes."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import stat_diff
+    from paddle_tpu.generation import (DecoderConfig, GenerationEngine,
+                                       GenerationRequest, init_params)
+    from paddle_tpu import monitor
+    from paddle_tpu.monitor import stat_get
+
+    cfg = DecoderConfig(vocab_size=128, hidden=64, layers=4, heads=4,
+                        max_seq_len=128)
+    params = init_params(cfg, seed=0)
+
+    rng = np.random.RandomState(21)
+    R = 16
+    reqs = []
+    for i in range(R):
+        # self-similar prompt: a short motif repeated — untrained
+        # greedy decode settles into cycles the ngram drafter then
+        # predicts, which is the honest analog of the repetitive
+        # structure real speculative serving exploits
+        motif = list(rng.randint(1, cfg.vocab_size, size=3))
+        reqs.append(GenerationRequest(
+            prompt=(motif * 13)[:int(rng.randint(34, 40))],
+            max_new_tokens=24, request_id=i))
+    total_new = sum(r.max_new_tokens for r in reqs)
+
+    def run_pass(eng):
+        for r in reqs:
+            eng.submit(GenerationRequest(**r.__dict__))
+        done = []
+        t0 = time.perf_counter()
+        while not eng.idle:
+            done.extend(eng.step())
+        wall = time.perf_counter() - t0
+        return wall, {res.request_id: res.tokens for res in done}
+
+    # prefix cache off in both: this block isolates speculation
+    mk = lambda **kw: GenerationEngine(  # noqa: E731
+        cfg, params, num_blocks=256, block_size=8, decode_width=8,
+        prefill_buckets="pow2:128", prefill_chunk=48,
+        prefix_cache=False, **kw)
+    plain_eng = mk(spec_tokens=0)
+    spec_eng = mk(spec_tokens=3, draft="ngram")
+    plain_eng.warmup()
+    spec_eng.warmup()
+    c0 = stat_get("STAT_generation_compile")
+    p0 = stat_get("STAT_generation_spec_proposed")
+    a0 = stat_get("STAT_generation_spec_accepted")
+    plain_best = spec_best = None
+    plain_tokens = spec_tokens = None
+    for _ in range(4):
+        for eng, which in ((plain_eng, "plain"), (spec_eng, "spec")):
+            wall, toks = run_pass(eng)
+            if which == "plain":
+                plain_tokens = toks
+                if plain_best is None or wall < plain_best:
+                    plain_best = wall
+            else:
+                spec_tokens = toks
+                if spec_best is None or wall < spec_best:
+                    spec_best = wall
+    recompiles = int(stat_get("STAT_generation_compile") - c0)
+    proposed = int(stat_get("STAT_generation_spec_proposed") - p0)
+    accepted = int(stat_get("STAT_generation_spec_accepted") - a0)
+    parity = plain_tokens == spec_tokens and len(spec_tokens) == R
+
+    snap = monitor.snapshot()
+    cur = {
+        "counters": {k: v for k, v in snap["counters"].items()
+                     if "generation" in k},
+        "gauges": {},
+        "timers": {k: v for k, v in snap["timers"].items()
+                   if "generation" in k},
+    }
+    snap_path = os.environ.get(
+        "PT_GENERATION_SPEC_BENCH_SNAPSHOT",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "bench_generation_spec_last.json"))
+    regressions = []
+    try:
+        prev = stat_diff.load_snapshot(snap_path)
+        regressions = stat_diff.find_regressions(
+            stat_diff.diff_snapshots(prev, cur), threshold_pct=25.0)
+        regressions = [r for r in regressions if r.startswith("timer")]
+    except OSError:
+        pass
+    try:
+        os.makedirs(os.path.dirname(snap_path), exist_ok=True)
+        with open(snap_path, "w") as f:
+            json.dump(cur, f)
+    except OSError:
+        pass
+
+    plain_tps = round(total_new / plain_best, 1)
+    spec_tps = round(total_new / spec_best, 1)
+    ratio = round(spec_tps / plain_tps, 2)
+    return {
+        "workload": "decoder L%d-H%d: %d greedy requests, "
+                    "self-similar prompts 34..39, %d new tokens, "
+                    "ngram drafter k=3" % (cfg.layers, cfg.hidden, R,
+                                           total_new),
+        "plain_tokens_per_sec": plain_tps,
+        "spec_tokens_per_sec": spec_tps,
+        "speedup_spec_vs_plain": ratio,
+        "meets_1p0x": ratio >= 1.0,
+        "proposed": proposed,
+        "accepted": accepted,
+        "acceptance_rate": round(accepted / proposed, 3)
+        if proposed else None,
+        "steady_state_recompiles": recompiles,
+        "tokens_bitwise_identical": bool(parity),
+        "mixed_step_p95_regressions": regressions,
+    }
+
+
 def _spmd_worker():
     """spmd block worker (ISSUE 6, docs/spmd.md): runs in a FRESH
     process (env: JAX_PLATFORMS=cpu + --xla_force_host_platform_
@@ -1936,6 +2237,16 @@ def _run_worker(backend):
         # prompt-heavy mixed workload (HOL-blocking removal is real on
         # CPU too — ISSUE 10)
         rec["generation_mixed"] = bench_generation_mixed()
+    if not os.environ.get("PT_SKIP_GENERATION_PREFIX_BENCH"):
+        # cross-request prefix caching: TTFT with a warm cache vs cold
+        # recompute of a shared system prompt (the prefill compute
+        # saved is real on CPU too — ISSUE 14)
+        rec["generation_prefix"] = bench_generation_prefix()
+    if not os.environ.get("PT_SKIP_GENERATION_SPEC_BENCH"):
+        # speculative decoding: ngram-drafted verify slots riding the
+        # mixed step vs plain decode, bitwise-identical streams
+        # (ISSUE 14)
+        rec["generation_spec"] = bench_generation_spec()
     if not os.environ.get("PT_SKIP_SPMD_BENCH"):
         # mesh-native SPMD runtime: dp scaling + dp4xmp2 loss parity on
         # 8 fake CPU devices; subprocess-isolated because the virtual

@@ -72,6 +72,11 @@ KNOWN_SITES: Tuple[str, ...] = (
     "generation.prefill_chunk",
     "generation.decode",
     "generation.kv_alloc",
+    # PR 14: prefix-cache lookup at admission (fault -> cold prefill,
+    # cache not poisoned) and the drafter's propose step (fault ->
+    # plain decode, stream bitwise-unchanged)
+    "generation.prefix_lookup",
+    "generation.draft_step",
     "checkpoint.save",
     "checkpoint.load",
     "trainstep.step",

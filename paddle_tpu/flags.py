@@ -127,6 +127,24 @@ _DEFS: Dict[str, Any] = {
     # prefill slots per step); 0 = auto (decode_width + prefill_chunk).
     "FLAGS_generation_prefill_chunk": 8,
     "FLAGS_generation_token_budget": 0,
+    # cross-request prefix cache (PR 14, docs/generation.md "Prefix
+    # caching"): chunk-aligned running-hash lookup of cached prompt
+    # prefixes; hits attach the shared immutable KV blocks (refcounted,
+    # copy-on-write on divergence) and start prefill at the first
+    # uncached chunk. Chunked mode only; token streams stay
+    # bitwise-identical to cache-off runs — only completion ORDER can
+    # change (MIGRATION.md).
+    "FLAGS_generation_prefix_cache": True,
+    # speculative decoding (same doc section): k > 0 lets a drafter
+    # propose up to k tokens per decode lane, verified in ONE pass of
+    # the mixed step (auto token_budget grows to
+    # decode_width*(1+k) + prefill_chunk). Accepted streams are
+    # bitwise-identical to plain decode; draft faults degrade to plain
+    # decode. draft: "ngram" = host-side prompt-lookup (default, no
+    # weights), "model" = a small draft decoder passed to the engine
+    # ctor (draft_cfg/draft_params).
+    "FLAGS_generation_spec_tokens": 0,
+    "FLAGS_generation_draft": "ngram",
     # bounded request queue of the continuous-batching scheduler
     # (generation.GenerationPool): submit blocks, then raises
     # ServingQueueFull — same backpressure contract as PredictorPool

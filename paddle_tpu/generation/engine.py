@@ -28,15 +28,53 @@ when the persistent program cache (PR 1) is enabled the prefill/decode
 steps are exported through program_cache.exported_entry so even a
 fresh process skips retrace+recompile.
 
+PR 14 layers two latency features over the chunked mixed step, both
+preserving the bitwise-determinism contract:
+
+- PREFIX CACHE (FLAGS_generation_prefix_cache, chunked mode only):
+  admission asks the PrefixCache (kv_cache.py) for the longest cached
+  chunk chain matching the new prompt and attaches those immutable
+  blocks read-only (refcounted) — prefill starts at the first uncached
+  chunk, so a shared-prefix fleet pays prefill once and TTFT collapses
+  to ~one chunk. As a prompt streams in, every completed chunk
+  boundary is published back to the cache. Because K/V at a position
+  is a pure function of the tokens at or before it (row independence,
+  pinned in tests), a cached block is bitwise-identical to a cold
+  recompute — hit streams match cold streams exactly. Any write into
+  a still-shared block (divergence after the common prefix, or a
+  producer growing past a published partial block) goes through
+  COPY-ON-WRITE first: the ledger swaps in a private block and a
+  one-block compiled copy clones the pool rows.
+
+- SPECULATIVE DECODING (FLAGS_generation_spec_tokens = k > 0): a cheap
+  drafter — "ngram" prompt-lookup (host-side, default) or a small
+  "model" draft with its own paged pools — proposes up to k tokens per
+  decode lane; the SAME mixed executable verifies them in one pass (a
+  decode lane with q_len = k+1 is already a legal ragged row: slots at
+  positions ctx..ctx+k feeding [last_token, d1..dk]). Slot j's logits
+  are conditioned on the drafts before it, so its sample — taken with
+  the lane's own fold_in(seed, token_index) key — is the EXACT token
+  plain decode would produce iff every earlier draft matched; the
+  host emits tokens until the first mismatch. Rejected drafts need no
+  rollback: their K/V writes sit beyond the accepted frontier, where
+  no mask exposes them before the next step's feed overwrites them.
+  A draft fault degrades to plain decode (streams unchanged).
+
 Pool pressure: if a mid-decode block extension finds the pool empty,
-the YOUNGEST sequence is preempted — blocks freed, request re-queued by
-the scheduler — and because sampling is deterministic per (seed, step)
-its replay regenerates the identical prefix (sampling.py).
+cold prefix-cache entries are evicted LRU-first; if the cache is dry
+the YOUNGEST sequence is preempted — blocks freed (only its private
+ones: shared blocks survive via their other references), request
+re-queued by the scheduler — and because sampling is deterministic per
+(seed, step) its replay regenerates the identical prefix
+(sampling.py). A preempted producer can even re-admit THROUGH its own
+published prefixes.
 
 Instruments (track="generation"): STAT_generation_requests /
 _tokens / _prefills / _evictions / _compile / _errors,
-GAUGE_generation_active_seqs (+ kv_cache block gauges),
-TIMER_generation_prefill_us / _decode_step_us.
+STAT_generation_prefix_{hits,misses,hit_tokens,cow_copies} /
+_spec_{proposed,accepted} / _draft_faults,
+GAUGE_generation_active_seqs (+ kv_cache block + prefix gauges),
+TIMER_generation_prefill_us / _decode_step_us / _prefix_admit_us.
 
 Request tracing (tracing.py, docs/observability.md): every request
 carries a RequestTrace (opened by GenerationPool.submit, or by
@@ -65,7 +103,8 @@ from ..failpoints import failpoint
 from ..flags import get_flag
 from ..inference import bucket_for, bucket_or_exact, parse_bucket_ladder
 from ..monitor import gauge_set, stat_add, timer_observe
-from .kv_cache import TRASH_BLOCK, BlockPoolExhausted, KVCacheManager
+from .kv_cache import (TRASH_BLOCK, BlockPoolExhausted, KVCacheManager,
+                       PrefixCache)
 from .model import DecoderConfig, forward_full, forward_paged
 from .sampling import SamplingParams, sample_tokens
 
@@ -105,7 +144,7 @@ class _Seq:
 
     __slots__ = ("req", "ctx", "generated", "lane", "admit_order",
                  "evictions", "t_last_token", "prefilled",
-                 "admit_failures")
+                 "admit_failures", "pkeys", "published")
 
     def __init__(self, req: GenerationRequest, admit_order: int):
         self.req = req
@@ -117,6 +156,8 @@ class _Seq:
         self.t_last_token = time.perf_counter()
         self.prefilled = 0         # prompt tokens already in the pool
         self.admit_failures = 0    # consecutive transient re-admit fails
+        self.pkeys = None          # [(boundary, hash)] — PrefixCache keys
+        self.published = 0         # prompt tokens already cached
 
 
 class GenerationEngine:
@@ -136,6 +177,11 @@ class GenerationEngine:
                  prefill_buckets=None,
                  prefill_chunk: Optional[int] = None,
                  token_budget: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 spec_tokens: Optional[int] = None,
+                 draft: Optional[str] = None,
+                 draft_cfg: Optional[DecoderConfig] = None,
+                 draft_params: Optional[Dict[str, Any]] = None,
                  program_cache_dir: Optional[str] = None):
         self.cfg = cfg
         self.params = jax.tree.map(jnp.asarray, params)
@@ -153,6 +199,16 @@ class GenerationEngine:
             else get_flag("FLAGS_generation_prefill_chunk"))
         if self.prefill_chunk < 0:
             raise ValueError("prefill_chunk must be >= 0")
+        self.spec_tokens = int(
+            spec_tokens if spec_tokens is not None
+            else get_flag("FLAGS_generation_spec_tokens"))
+        if self.spec_tokens < 0:
+            raise ValueError("spec_tokens must be >= 0")
+        if self.spec_tokens and not self.prefill_chunk:
+            raise ValueError(
+                "speculative decoding rides the chunked mixed step — "
+                "FLAGS_generation_spec_tokens needs "
+                "FLAGS_generation_prefill_chunk > 0")
         if self.prefill_chunk:
             # chunked mode: prompts stream through the mixed step, so
             # the bucket ladder is a compat shim with one rung
@@ -160,15 +216,26 @@ class GenerationEngine:
             self.prefill_ladder = [cfg.max_seq_len]
             tb = int(token_budget if token_budget is not None
                      else get_flag("FLAGS_generation_token_budget"))
-            self.token_budget = (tb if tb > 0 else
-                                 self.decode_width + self.prefill_chunk)
+            # auto budget leaves room for every lane's k draft slots
+            # so speculation never starves prefill chunks
+            self.token_budget = (
+                tb if tb > 0 else
+                self.decode_width * (1 + self.spec_tokens)
+                + self.prefill_chunk)
             if self.token_budget < self.decode_width:
                 raise ValueError(
                     "token_budget %d < decode_width %d: every decode "
                     "lane needs a slot each step" % (self.token_budget,
                                                      self.decode_width))
+            # sampler rows: 1 + k per lane (a lane's plain-decode slot
+            # plus its verify slots) — the mixed fn gathers these out
+            # of the t-slot logits so the sampler's sort never runs on
+            # prompt/padding slots; with spec off this is exactly the
+            # PR-10 per-lane sampler cost
+            self.sample_width = self.decode_width * (1 + self.spec_tokens)
         else:
             self.token_budget = self.decode_width
+            self.sample_width = self.decode_width
             spec = (prefill_buckets if prefill_buckets is not None
                     else get_flag("FLAGS_generation_prefill_buckets"))
             self.prefill_ladder = [b for b in parse_bucket_ladder(spec)
@@ -185,6 +252,41 @@ class GenerationEngine:
         shape = (cfg.layers, nb, bs, cfg.heads, cfg.head_dim)
         self.k_pools = jnp.zeros(shape, jnp.float32)
         self.v_pools = jnp.zeros(shape, jnp.float32)
+        # cross-request prefix cache (chunked mode only: the chunk is
+        # the hash unit)
+        pc_on = bool(prefix_cache if prefix_cache is not None
+                     else get_flag("FLAGS_generation_prefix_cache"))
+        self.prefix_cache = (PrefixCache(self.kv, self.prefill_chunk)
+                             if pc_on and self.prefill_chunk else None)
+        # drafter for speculative decoding: "ngram" is a host-side
+        # prompt-lookup (zero device cost); "model" runs a small draft
+        # decoder over its OWN paged pools indexed by the same tables
+        self.draft_kind = str(draft if draft is not None
+                              else get_flag("FLAGS_generation_draft"))
+        self.draft_cfg = draft_cfg
+        self.draft_params = None
+        self.dk_pools = self.dv_pools = None
+        if self.spec_tokens and self.draft_kind == "model":
+            if draft_cfg is None or draft_params is None:
+                raise ValueError(
+                    "draft='model' needs draft_cfg and draft_params")
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    "draft vocab %d != target vocab %d"
+                    % (draft_cfg.vocab_size, cfg.vocab_size))
+            if draft_cfg.max_seq_len < cfg.max_seq_len:
+                raise ValueError(
+                    "draft max_seq_len %d < target %d (pos_emb must "
+                    "cover every verified position)"
+                    % (draft_cfg.max_seq_len, cfg.max_seq_len))
+            self.draft_params = jax.tree.map(jnp.asarray, draft_params)
+            dshape = (draft_cfg.layers, nb, bs, draft_cfg.heads,
+                      draft_cfg.head_dim)
+            self.dk_pools = jnp.zeros(dshape, jnp.float32)
+            self.dv_pools = jnp.zeros(dshape, jnp.float32)
+        elif self.spec_tokens and self.draft_kind != "ngram":
+            raise ValueError("unknown draft kind %r (ngram|model)"
+                             % self.draft_kind)
         self._program_cache_dir = program_cache_dir
         # compiled-step registry: dict miss == an engine compilation
         # (STAT_generation_compile — the zero-steady-state-recompile
@@ -253,13 +355,20 @@ class GenerationEngine:
         elif kind == "mixed":
             # ONE executable for every step of the chunked engine: T =
             # token_budget SLOTS of (block-table row, position, token)
-            # — a decode lane's next token or one prompt token of a
-            # prefill chunk; forward_paged scatters every slot's K/V
-            # before attending, so chunk-mates see each other and the
-            # step is the ragged mixed batch of the paper. The sampler
-            # reads W lanes' logits through sample_slots (a lane's LAST
-            # slot this step); mid-prefill and idle lanes' samples are
-            # discarded on the host.
+            # — a decode lane's next token, one of its k draft tokens
+            # to verify, or one prompt token of a prefill chunk;
+            # forward_paged scatters every slot's K/V before attending,
+            # so chunk-mates (and a lane's draft slots) see each other
+            # and the step is the ragged mixed batch of the paper. The
+            # sampler reads S = decode_width * (1 + spec_tokens) rows
+            # through sample_slots — 1 + k per LANE (PR 14: a lane's
+            # plain-decode slot plus its verify slots), each carrying
+            # the lane's sampling params and the slot's absolute token
+            # index as the fold_in step. That index is what makes a
+            # verified draft sample bitwise-identical to the plain
+            # decode sample at the same position; the gather keeps the
+            # sampler's sort cost off the (much wider) padding slots.
+            # The host decides which sample rows are emitted.
             def raw(params, kp, vp, tables, positions, tokens,
                     sample_slots, temps, tks, tps, seeds, steps):
                 logits, kp2, vp2 = forward_paged(
@@ -267,8 +376,9 @@ class GenerationEngine:
                 nxt = sample_tokens(logits[sample_slots], temps, tks,
                                     tps, seeds, steps)
                 return nxt, kp2, vp2
-            w, m = self.decode_width, self.max_blocks_per_seq
+            m = self.max_blocks_per_seq
             t = self.token_budget
+            sw = self.sample_width
             i32 = jnp.int32
             avals = (
                 jax.tree.map(_sds, self.params),
@@ -276,12 +386,46 @@ class GenerationEngine:
                 jax.ShapeDtypeStruct((t, m), i32),
                 jax.ShapeDtypeStruct((t,), i32),
                 jax.ShapeDtypeStruct((t,), i32),
-                jax.ShapeDtypeStruct((w,), i32),
-                jax.ShapeDtypeStruct((w,), jnp.float32),
-                jax.ShapeDtypeStruct((w,), i32),
-                jax.ShapeDtypeStruct((w,), jnp.float32),
-                jax.ShapeDtypeStruct((w,), i32),
-                jax.ShapeDtypeStruct((w,), i32),
+                jax.ShapeDtypeStruct((sw,), i32),
+                jax.ShapeDtypeStruct((sw,), jnp.float32),
+                jax.ShapeDtypeStruct((sw,), i32),
+                jax.ShapeDtypeStruct((sw,), jnp.float32),
+                jax.ShapeDtypeStruct((sw,), i32),
+                jax.ShapeDtypeStruct((sw,), i32),
+            )
+        elif kind in ("cow", "draft_cow"):
+            # copy-on-write: clone one pool block's rows (every layer)
+            # before a write would mutate a shared block. Scalar
+            # src/dst keep it ONE executable for any block pair.
+            def raw(kp, vp, src, dst):
+                return (kp.at[:, dst].set(kp[:, src]),
+                        vp.at[:, dst].set(vp[:, src]))
+            kp0 = self.k_pools if kind == "cow" else self.dk_pools
+            vp0 = self.v_pools if kind == "cow" else self.dv_pools
+            avals = (_sds(kp0), _sds(vp0),
+                     jax.ShapeDtypeStruct((), jnp.int32),
+                     jax.ShapeDtypeStruct((), jnp.int32))
+        elif kind == "draft_mixed":
+            # the draft model's step over the SAME slot layout and the
+            # same block tables, writing its own pools. Greedy argmax:
+            # draft choices only gate ACCEPTANCE, never token values,
+            # so the draft needs no sampler parity.
+            dcfg = self.draft_cfg
+
+            def raw(params, kp, vp, tables, positions, tokens):
+                logits, kp2, vp2 = forward_paged(
+                    dcfg, params, kp, vp, tables, positions, tokens)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return nxt, kp2, vp2
+            m = self.max_blocks_per_seq
+            t = self.token_budget
+            i32 = jnp.int32
+            avals = (
+                jax.tree.map(_sds, self.draft_params),
+                _sds(self.dk_pools), _sds(self.dv_pools),
+                jax.ShapeDtypeStruct((t, m), i32),
+                jax.ShapeDtypeStruct((t,), i32),
+                jax.ShapeDtypeStruct((t,), i32),
             )
         else:
             raise ValueError(kind)
@@ -297,14 +441,22 @@ class GenerationEngine:
         bucket and the decode step with compile-time flops/bytes."""
         tag = ("generation_prefill_b%d" % bucket if kind == "prefill"
                else "generation_%s" % kind)
-        meta = dict(self.cfg.meta(), kind=kind, bucket=bucket,
+        base = (self.draft_cfg.meta() if kind.startswith("draft")
+                else self.cfg.meta())
+        # v=2: the mixed step's PR-14 gathered-sampler signature —
+        # stale disk-cache entries must miss on the fingerprint rather
+        # than trip exported_entry's aval check; samp rides along
+        # because two engines can share every other dimension yet
+        # differ in spec_tokens
+        meta = dict(base, kind=kind, bucket=bucket, v=2,
                     blocks=self.kv.num_blocks,
                     block_size=self.kv.block_size,
                     width=self.decode_width,
                     table=self.max_blocks_per_seq,
                     lanes=self.attn_lanes,
                     chunk=self.prefill_chunk,
-                    slots=self.token_budget)
+                    slots=self.token_budget,
+                    samp=self.sample_width)
         cache_dir = program_cache.resolve_dir(self._program_cache_dir)
         if cache_dir is not None:
             fp = program_cache.fn_fingerprint("generation_step", meta)
@@ -329,6 +481,19 @@ class GenerationEngine:
             t0 = time.perf_counter()
             self._warm_mixed()
             report["mixed"] = round(time.perf_counter() - t0, 4)
+            if self.prefix_cache is not None:
+                # the COW copy must be warm too: the first write into a
+                # shared block happens in steady state, and the
+                # zero-steady-state-recompile pin counts it
+                t0 = time.perf_counter()
+                self._warm_cow("cow", self.k_pools, self.v_pools)
+                report["cow"] = round(time.perf_counter() - t0, 4)
+            if self.draft_params is not None:
+                t0 = time.perf_counter()
+                self._warm_draft()
+                self._warm_cow("draft_cow", self.dk_pools,
+                               self.dv_pools)
+                report["draft"] = round(time.perf_counter() - t0, 4)
             self._warmed = True
             return report
         t0 = time.perf_counter()
@@ -365,13 +530,32 @@ class GenerationEngine:
 
     def _warm_mixed(self) -> None:
         fn = self._get_fn("mixed")
-        t, w = self.token_budget, self.decode_width
+        t, sw = self.token_budget, self.sample_width
         zt = jnp.zeros((t,), jnp.int32)
-        zw = jnp.zeros((w,), jnp.int32)
+        zs = jnp.zeros((sw,), jnp.int32)
         fn(self.params, self.k_pools, self.v_pools,
            jnp.zeros((t, self.max_blocks_per_seq), jnp.int32), zt, zt,
-           zw, jnp.zeros((w,), jnp.float32), zw,
-           jnp.ones((w,), jnp.float32), zw, zw)
+           zs, jnp.zeros((sw,), jnp.float32), zs,
+           jnp.ones((sw,), jnp.float32), zs, zs)
+
+    def _warm_cow(self, kind: str, kp, vp) -> None:
+        # trash-block self-copy: compiles the clone, mutates nothing
+        # anyone reads
+        fn = self._get_fn(kind)
+        z = jnp.asarray(0, jnp.int32)
+        out = fn(kp, vp, z, z)
+        if kind == "cow":
+            self.k_pools, self.v_pools = out
+        else:
+            self.dk_pools, self.dv_pools = out
+
+    def _warm_draft(self) -> None:
+        fn = self._get_fn("draft_mixed")
+        t = self.token_budget
+        zt = jnp.zeros((t,), jnp.int32)
+        _, self.dk_pools, self.dv_pools = fn(
+            self.draft_params, self.dk_pools, self.dv_pools,
+            jnp.zeros((t, self.max_blocks_per_seq), jnp.int32), zt, zt)
 
     # --- admission -----------------------------------------------------
 
@@ -481,15 +665,42 @@ class GenerationEngine:
         gauge_set("GAUGE_generation_active_seqs", self.active_count)
 
     def _admit_chunked(self, seq: _Seq, lane: int) -> bool:
-        """Park `seq` in `lane` for chunked prefill: allocate blocks
-        for the WHOLE prompt plus the first decode token all-or-nothing
-        (a half-provisioned prompt would stall mid-prefill holding
-        blocks), then let the mixed step stream the prompt in. Returns
-        False (untouched state) when the pool can't hold it yet."""
+        """Park `seq` in `lane` for chunked prefill: walk the prefix
+        cache for the longest cached chunk chain, attach those shared
+        blocks plus private blocks for the rest of the prompt + the
+        first decode token all-or-nothing (a half-provisioned prompt
+        would stall mid-prefill holding blocks), then let the mixed
+        step stream in the UNCACHED suffix. Returns False (untouched
+        state) when the pool can't hold it yet.
+
+        The hit always re-runs at least the last prompt token (an
+        exact-duplicate prompt still needs its first-token logits);
+        that re-run's K/V write is bitwise-identical to the cached
+        value, and if it lands in a still-shared block the COW in
+        _provision clones it first. A prefix_lookup fault degrades to
+        cold prefill — the cache is read-only here, so it can't be
+        poisoned."""
         n = len(seq.req.prompt)
-        need = self.kv.blocks_for_tokens(n + 1)
-        if need > self.kv.free_blocks:
-            return False
+        pc = self.prefix_cache
+        t0 = time.perf_counter()
+        cached_use = 0
+        shared: List[int] = []
+        if pc is not None:
+            if seq.pkeys is None:
+                seq.pkeys = pc.keys_for(seq.req.prompt)
+            try:
+                hit = pc.match(seq.req.prompt)
+            except Exception:
+                hit = None
+            if hit is not None:
+                cached_tokens, blocks = hit
+                cached_use = min(int(cached_tokens), n - 1)
+                shared = blocks[:self.kv.blocks_for_tokens(cached_use)]
+        private_need = self.kv.blocks_for_tokens(n + 1) - len(shared)
+        if private_need > self.kv.free_blocks:
+            # pool pressure: cold cached prefixes go before we defer
+            if pc is None or not pc.evict_for(private_need):
+                return False
         # before any state mutation: an injected raise leaves the
         # engine consistent (the request is still pending)
         failpoint("generation.prefill")
@@ -498,18 +709,31 @@ class GenerationEngine:
         if seq.evictions:
             tr.event("replay", evictions=seq.evictions)
         sid = id(seq)
-        self.kv.alloc(sid, need)
+        self.kv.attach(sid, shared, private_need)
         seq.lane = lane
-        seq.prefilled = 0
-        seq.ctx = 0
+        seq.prefilled = cached_use
+        seq.ctx = cached_use
+        seq.published = cached_use
         self._lane_seq[lane] = seq
         sp = seq.req.sampling
         self._tables[lane] = self.kv.table(sid, self.max_blocks_per_seq)
-        self._ctx[lane] = 0
+        self._ctx[lane] = cached_use
         self._temps[lane] = sp.temperature
         self._top_ks[lane] = sp.top_k
         self._top_ps[lane] = sp.top_p
         self._seeds[lane] = sp.seed
+        if pc is not None:
+            if cached_use:
+                stat_add("STAT_generation_prefix_hits")
+                stat_add("STAT_generation_prefix_hit_tokens",
+                         cached_use)
+                tr.event("prefix_hit_chunks", tokens=cached_use,
+                         chunks=cached_use // self.prefill_chunk,
+                         blocks=len(shared))
+            else:
+                stat_add("STAT_generation_prefix_misses")
+            timer_observe("TIMER_generation_prefix_admit_us",
+                          (time.perf_counter() - t0) * 1e6)
         stat_add("STAT_generation_prefills")
         return True
 
@@ -627,9 +851,26 @@ class GenerationEngine:
             done = self._finish_reason(seq)
             if done is not None:
                 finished.append(self._retire(lane, done))
-        self._ensure_blocks()
-        t, w = self.token_budget, self.decode_width
+        t = self.token_budget
         m = self.max_blocks_per_seq
+        # per-lane draft budget this step (0 with speculation off)
+        s_cap = self._spec_caps()
+        # provision every lane's write horizon: block extension plus
+        # copy-on-write of shared blocks in a write range. Pool
+        # exhaustion evicts cold cached prefixes LRU-first; only a dry
+        # cache preempts the youngest sequence. Re-running _provision
+        # after either is idempotent (already-extended / already-COWed
+        # lanes are no-ops).
+        while True:
+            try:
+                self._provision(s_cap)
+                break
+            except BlockPoolExhausted:
+                if self.prefix_cache is not None and \
+                        self.prefix_cache.evict_for(1):
+                    continue
+                if not self._preempt_youngest():
+                    raise
         decode_lanes = []
         prefill_lanes = []
         for ln, s in enumerate(self._lane_seq):
@@ -642,43 +883,82 @@ class GenerationEngine:
         if not decode_lanes and not prefill_lanes:
             gauge_set("GAUGE_generation_active_seqs", 0)
             return finished
-        tables = np.full((t, m), TRASH_BLOCK, np.int32)
-        positions = np.zeros((t,), np.int32)
-        tokens = np.zeros((t,), np.int32)
-        sample_slots = np.zeros((w,), np.int32)
-        steps = np.zeros((w,), np.int32)
-        slot = 0
-        for ln in decode_lanes:
-            seq = self._lane_seq[ln]
-            tables[slot] = self._tables[ln]
-            positions[slot] = seq.ctx
-            tokens[slot] = seq.generated[-1]
-            sample_slots[ln] = slot
-            steps[ln] = len(seq.generated)
-            slot += 1
-        # (lane, seq, chunk start, chunk width)
-        chunk_plan = []
+        # chunk plan BEFORE drafting, using the conservative s_cap slot
+        # layout: the model drafter's call 0 ingests these chunk tokens
+        # into the draft pools, so the plan must be fixed first. If the
+        # drafter then proposes fewer tokens the slack slots just pad.
+        slot = len(decode_lanes) + sum(s_cap.get(ln, 0)
+                                       for ln in decode_lanes)
+        chunk_plan = []              # (lane, seq, start, take)
         for ln in prefill_lanes:
             seq = self._lane_seq[ln]
             n = len(seq.req.prompt)
             take = min(self.prefill_chunk, n - seq.prefilled, t - slot)
             if take <= 0:
                 continue
+            chunk_plan.append((ln, seq, seq.prefilled, take))
+            slot += take
+        drafts = self._propose(decode_lanes, s_cap, chunk_plan)
+        tables = np.full((t, m), TRASH_BLOCK, np.int32)
+        positions = np.zeros((t,), np.int32)
+        tokens = np.zeros((t,), np.int32)
+        # sampler arrays are [sample_width]: each LANE owns 1 + k
+        # consecutive rows (rows ln*(1+k) .. ln*(1+k)+k); a decode lane
+        # uses rows 0..len(drafts) for its verify chain, a prefill lane
+        # uses row 0 for its chunk's last slot. Unused rows gather the
+        # trash slot's logits (greedy, discarded on the host).
+        sw = self.sample_width
+        rpl = 1 + self.spec_tokens          # sampler rows per lane
+        sample_slots = np.zeros((sw,), np.int32)
+        temps = np.zeros((sw,), np.float32)
+        tks = np.zeros((sw,), np.int32)
+        tps = np.ones((sw,), np.float32)
+        seeds = np.zeros((sw,), np.int32)
+        steps = np.zeros((sw,), np.int32)
+        slot = 0
+        # (lane, seq, first sampler row, drafts riding this step)
+        decode_plan = []
+        for ln in decode_lanes:
+            seq = self._lane_seq[ln]
+            d = drafts.get(ln, [])[:s_cap.get(ln, 0)]
+            feed = [seq.generated[-1]] + d
+            base = len(seq.generated)
+            row0 = ln * rpl
+            for j in range(len(feed)):
+                tables[slot] = self._tables[ln]
+                positions[slot] = seq.ctx + j
+                tokens[slot] = feed[j]
+                sample_slots[row0 + j] = slot
+                temps[row0 + j] = self._temps[ln]
+                tks[row0 + j] = self._top_ks[ln]
+                tps[row0 + j] = self._top_ps[ln]
+                seeds[row0 + j] = self._seeds[ln]
+                # the fold_in step IS the absolute token index — row j
+                # samples exactly what plain decode would at that index
+                steps[row0 + j] = base + j
+                slot += 1
+            decode_plan.append((ln, seq, row0, d))
+        for ln, seq, start, take in chunk_plan:
             if seq.prefilled:
-                # between chunks of one prompt — still pre-mutation
+                # between chunks of one prompt — before any token-state
+                # mutation, so a caught raise resumes exactly
                 failpoint("generation.prefill_chunk")
-            start = seq.prefilled
+            sp = seq.req.sampling
             for j in range(take):
                 tables[slot] = self._tables[ln]
                 positions[slot] = start + j
                 tokens[slot] = seq.req.prompt[start + j]
                 slot += 1
-            # the lane samples from its LAST slot: meaningful (step 0,
-            # the first generated token) only when this chunk reaches
-            # the end of the prompt — otherwise discarded below
-            sample_slots[ln] = slot - 1
-            steps[ln] = 0
-            chunk_plan.append((ln, seq, start, take))
+            # only the chunk's LAST slot's sample matters (step 0, the
+            # first generated token) and only when the chunk completes
+            # the prompt — otherwise discarded on the host
+            row0 = ln * rpl
+            sample_slots[row0] = slot - 1
+            temps[row0] = sp.temperature
+            tks[row0] = sp.top_k
+            tps[row0] = sp.top_p
+            seeds[row0] = sp.seed
+            steps[row0] = 0
         stat_add("STAT_generation_pad_tokens", t - slot)
         t0 = time.perf_counter()
         riders = decode_lanes + [c[0] for c in chunk_plan]
@@ -693,9 +973,8 @@ class GenerationEngine:
                 self.params, self.k_pools, self.v_pools,
                 jnp.asarray(tables), jnp.asarray(positions),
                 jnp.asarray(tokens), jnp.asarray(sample_slots),
-                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
-                jnp.asarray(self._top_ps), jnp.asarray(self._seeds),
-                jnp.asarray(steps))
+                jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps),
+                jnp.asarray(seeds), jnp.asarray(steps))
             nxt = np.asarray(nxt)
         dt_us = (time.perf_counter() - t0) * 1e6
         timer_observe("TIMER_generation_mixed_step_us", dt_us)
@@ -703,30 +982,48 @@ class GenerationEngine:
         # historic SLO timer (and its bench regression gate) alive
         timer_observe("TIMER_generation_decode_step_us", dt_us)
         now = time.perf_counter()
-        for ln in decode_lanes:
-            seq = self._lane_seq[ln]
-            seq.ctx += 1
-            self._ctx[ln] = seq.ctx
-            seq.generated.append(int(nxt[ln]))
-            seq.req.trace.token()
-            timer_observe("TIMER_generation_inter_token_us",
-                          (now - seq.t_last_token) * 1e6)
-            seq.t_last_token = now
-            stat_add("STAT_generation_tokens")
-            done = self._finish_reason(seq)
-            if done is not None:
-                finished.append(self._retire(ln, done))
+        for ln, seq, row0, d in decode_plan:
+            s = len(d)
+            if s:
+                stat_add("STAT_generation_spec_proposed", s)
+            acc = 0
+            # row j's sample is valid iff every draft before it
+            # matched (its logits are conditioned on them); emit until
+            # the first mismatch. Rejected drafts' K/V writes sit past
+            # the new ctx — masked until next step's feed overwrites.
+            for j in range(s + 1):
+                tok = int(nxt[row0 + j])
+                seq.ctx += 1
+                self._ctx[ln] = seq.ctx
+                seq.generated.append(tok)
+                seq.req.trace.token()
+                timer_observe("TIMER_generation_inter_token_us",
+                              (now - seq.t_last_token) * 1e6)
+                seq.t_last_token = now
+                stat_add("STAT_generation_tokens")
+                done = self._finish_reason(seq)
+                if done is not None:
+                    finished.append(self._retire(ln, done))
+                    break
+                if j < s:
+                    if d[j] != tok:
+                        break
+                    acc += 1
+            if s:
+                stat_add("STAT_generation_spec_accepted", acc)
         for ln, seq, start, take in chunk_plan:
             seq.prefilled = start + take
             seq.ctx = seq.prefilled
             self._ctx[ln] = seq.ctx
             seq.req.trace.event("prefill_chunk", start=start,
                                 width=take)
+            self._publish_prefix(seq)
             if seq.prefilled == len(seq.req.prompt):
                 # final chunk: its last slot's logits sampled the first
-                # generated token (sampler step 0 — identical fold_in
-                # to the two-phase prefill, so streams match bitwise)
-                seq.generated.append(int(nxt[ln]))
+                # generated token through the lane's sampler row 0
+                # (step 0 — identical fold_in to the two-phase prefill,
+                # so streams match bitwise).
+                seq.generated.append(int(nxt[ln * rpl]))
                 # TTFT lands at the TRUE first sampled token (first
                 # token() call only; replays re-observe TPOT)
                 seq.req.trace.token()
@@ -737,6 +1034,184 @@ class GenerationEngine:
                     finished.append(self._retire(ln, done))
         gauge_set("GAUGE_generation_active_seqs", self.active_count)
         return finished
+
+    def _spec_caps(self) -> Dict[int, int]:
+        """How many draft tokens each decode lane MAY verify this step:
+        bounded by k, the request's remaining token allowance (always
+        leave room for the guaranteed plain-decode token), the position
+        embedding table, and the slot budget — every decode lane keeps
+        its one guaranteed slot, extras granted greedily in lane
+        order."""
+        k = self.spec_tokens
+        if not k:
+            return {}
+        decode = [ln for ln, s in enumerate(self._lane_seq)
+                  if s is not None
+                  and s.prefilled >= len(s.req.prompt)]
+        budget = self.token_budget - len(decode)
+        caps: Dict[int, int] = {}
+        for ln in decode:
+            seq = self._lane_seq[ln]
+            s = min(k,
+                    seq.req.max_new_tokens - len(seq.generated) - 1,
+                    self.cfg.max_seq_len - 1 - seq.ctx,
+                    budget)
+            s = max(0, int(s))
+            caps[ln] = s
+            budget -= s
+        return caps
+
+    def _provision(self, s_cap: Dict[int, int]) -> None:
+        """Make every lane's write range this step safe: extend block
+        tables to the write horizon (a decode lane writes positions
+        ctx..ctx+s; a prefill lane stays inside its admission-time
+        allocation) and COPY-ON-WRITE any still-shared block the range
+        overlaps — ledger swap (kv.cow) plus the compiled one-block
+        pool clone, draft pools included. Raises BlockPoolExhausted;
+        the caller's retry loop evicts cached prefixes / preempts and
+        re-runs this idempotently."""
+        bs = self.kv.block_size
+        for lane, seq in enumerate(self._lane_seq):
+            if seq is None:
+                continue
+            sid = id(seq)
+            n = len(seq.req.prompt)
+            if seq.prefilled >= n:
+                s = s_cap.get(lane, 0)
+                lo, hi = seq.ctx, seq.ctx + s
+                need = self.kv.blocks_for_tokens(hi + 1)
+            else:
+                # whole prompt + first decode token were allocated at
+                # admission; the chunk writes prefilled..prefilled+take
+                lo = seq.prefilled
+                hi = min(seq.prefilled + self.prefill_chunk, n) - 1
+                need = 0
+            while len(self.kv.owned(sid)) < need:
+                self.kv.extend(sid)
+            owned = self.kv.owned(sid)
+            for bi in range(lo // bs, hi // bs + 1):
+                if bi < len(owned) and \
+                        self.kv.refcount(owned[bi]) > 1:
+                    old, new = self.kv.cow(sid, bi)
+                    self._copy_block(old, new)
+                    stat_add("STAT_generation_prefix_cow_copies")
+            self._tables[lane] = self.kv.table(sid,
+                                               self.max_blocks_per_seq)
+
+    def _copy_block(self, src: int, dst: int) -> None:
+        """Clone one pool block's rows (all layers) src -> dst — the
+        device half of copy-on-write."""
+        fn = self._get_fn("cow")
+        s = jnp.asarray(src, jnp.int32)
+        d = jnp.asarray(dst, jnp.int32)
+        self.k_pools, self.v_pools = fn(self.k_pools, self.v_pools,
+                                        s, d)
+        if self.draft_params is not None:
+            dfn = self._get_fn("draft_cow")
+            self.dk_pools, self.dv_pools = dfn(
+                self.dk_pools, self.dv_pools, s, d)
+
+    def _propose(self, decode_lanes: List[int],
+                 s_cap: Dict[int, int],
+                 chunk_plan) -> Dict[int, List[int]]:
+        """Draft up to s_cap[lane] tokens per decode lane. Any fault —
+        injected via generation.draft_step or real — degrades THIS step
+        to plain decode: drafts only ever gate how many slots verify,
+        never what tokens are emitted, so the stream is unchanged."""
+        if not self.spec_tokens:
+            return {}
+        lanes = [ln for ln in decode_lanes if s_cap.get(ln, 0) > 0]
+        # the model drafter must still ingest prompt chunks into its
+        # pools on prefill-only steps; the ngram drafter has no state
+        if not lanes and self.draft_params is None:
+            return {}
+        try:
+            failpoint("generation.draft_step")
+            if self.draft_params is not None:
+                return self._propose_model(lanes, s_cap, chunk_plan)
+            out: Dict[int, List[int]] = {}
+            for ln in lanes:
+                seq = self._lane_seq[ln]
+                d = _ngram_propose(
+                    list(seq.req.prompt) + seq.generated, s_cap[ln])
+                if d:
+                    out[ln] = d
+            return out
+        except Exception:
+            stat_add("STAT_generation_draft_faults")
+            return {}
+
+    def _propose_model(self, lanes: List[int], s_cap: Dict[int, int],
+                       chunk_plan) -> Dict[int, List[int]]:
+        """Greedy draft-model proposals: max_s + 1 sequential calls of
+        the draft mixed step. Call j feeds each lane's token at
+        position ctx + j (call 0 = the last emitted token; later calls
+        = the previous call's argmax) — the EXTRA final call consumes
+        no proposal but writes the last draft's K/V, so full acceptance
+        leaves no permanent gap in the draft pools. Call 0 also ingests
+        this step's prompt chunks so the draft pools track the target's
+        context. Prefix-cache hits leave the draft pools cold for the
+        cached region — acceptance suffers, correctness doesn't; the
+        ngram drafter (default) has no such blind spot."""
+        t, m = self.token_budget, self.max_blocks_per_seq
+        fn = self._get_fn("draft_mixed")
+        max_s = max((s_cap[ln] for ln in lanes), default=0)
+        feeds = {ln: self._lane_seq[ln].generated[-1] for ln in lanes}
+        out: Dict[int, List[int]] = {ln: [] for ln in lanes}
+        for j in range(max_s + 1):
+            tables = np.full((t, m), TRASH_BLOCK, np.int32)
+            positions = np.zeros((t,), np.int32)
+            tokens = np.zeros((t,), np.int32)
+            slot = 0
+            slot_of = {}
+            for ln in lanes:
+                if j > s_cap[ln]:
+                    continue
+                seq = self._lane_seq[ln]
+                tables[slot] = self._tables[ln]
+                positions[slot] = seq.ctx + j
+                tokens[slot] = feeds[ln]
+                slot_of[ln] = slot
+                slot += 1
+            if j == 0:
+                for ln, seq, start, take in chunk_plan:
+                    for i in range(take):
+                        if slot >= t:
+                            break
+                        tables[slot] = self._tables[ln]
+                        positions[slot] = start + i
+                        tokens[slot] = seq.req.prompt[start + i]
+                        slot += 1
+            nxt, self.dk_pools, self.dv_pools = fn(
+                self.draft_params, self.dk_pools, self.dv_pools,
+                jnp.asarray(tables), jnp.asarray(positions),
+                jnp.asarray(tokens))
+            nxt = np.asarray(nxt)
+            for ln, sl in slot_of.items():
+                if j < s_cap[ln]:
+                    tok = int(nxt[sl])
+                    out[ln].append(tok)
+                    feeds[ln] = tok
+        return {ln: d for ln, d in out.items() if d}
+
+    def _publish_prefix(self, seq: _Seq) -> None:
+        """Offer every newly completed chunk boundary of `seq`'s prompt
+        to the prefix cache (the cache increfs the covering blocks).
+        The producer's own NEXT write into a just-published partial
+        block will COW first, so the published version stays frozen."""
+        pc = self.prefix_cache
+        if pc is None or seq.pkeys is None:
+            return
+        sid = id(seq)
+        for tokens_b, key in seq.pkeys:
+            if tokens_b <= seq.published:
+                continue
+            if tokens_b > seq.prefilled:
+                break
+            blocks = self.kv.owned(sid)[
+                :self.kv.blocks_for_tokens(tokens_b)]
+            pc.insert(key, tokens_b, blocks)
+            seq.published = tokens_b
 
     def _decode_once(self) -> List[GenerationResult]:
         """Advance all active lanes one token (inactive lanes spin on
@@ -916,6 +1391,28 @@ class GenerationEngine:
             raise RuntimeError("generation did not converge in %d steps"
                                % limit)
         return out
+
+
+def _ngram_propose(hist: List[int], k: int) -> List[int]:
+    """Prompt-lookup drafting (the host-side default): find the most
+    recent earlier occurrence of the current m-token suffix (m = 3, 2,
+    1) in the request's own prompt + generated history and propose the
+    k tokens that followed it. Zero device cost, no draft weights, and
+    it thrives exactly where speculation pays — repetitive output that
+    echoes the prompt. Proposals only gate acceptance, so a garbage
+    guess costs one wasted verify slot, never a wrong token."""
+    n = len(hist)
+    for mlen in (3, 2, 1):
+        if n <= mlen:
+            continue
+        suffix = hist[n - mlen:]
+        for i in range(n - mlen - 1, -1, -1):
+            if hist[i:i + mlen] == suffix:
+                out = hist[i + mlen:i + mlen + k]
+                if out:
+                    return list(out)
+                break
+    return []
 
 
 def _sds(v) -> jax.ShapeDtypeStruct:

@@ -12,6 +12,15 @@ key is fold_in(PRNGKey(seed), step), never split statefully — so an
 evicted-and-replayed sequence regenerates its prefix bitwise and a
 re-run with the same seed reproduces the same text regardless of which
 batch-mates shared its decode steps.
+
+That same contract is what makes speculative decoding EXACT (PR 14,
+engine._mixed_once): a verify slot for draft position j samples with
+step = the absolute token index it would have in plain decode, and the
+vmapped rows are independent, so when the drafts feeding it were all
+accepted its logits AND its key match the plain-decode step — the
+emitted token is bitwise the plain-decode token, by induction over the
+accepted prefix. Rejection needs no sampler rollback: later steps
+re-sample the same indices with the same fold_in keys.
 """
 from __future__ import annotations
 

@@ -356,6 +356,14 @@ class GenerationPool:
         tests/test_failpoints.py)."""
         eng = self.engine
         eng.kv = type(eng.kv)(eng.kv.num_blocks, eng.kv.block_size)
+        if eng.prefix_cache is not None:
+            # the cache is deliberately DROPPED, not carried over: a
+            # batch-level fault may have poisoned pool contents, and
+            # the fresh ledger has no refcounts for the old entries —
+            # survivors would be dangling. Rebuilding re-publishes the
+            # prefix gauges at zero.
+            eng.prefix_cache = type(eng.prefix_cache)(
+                eng.kv, eng.prefill_chunk)
         eng._lane_seq = [None] * eng.decode_width
         eng._tables[:] = 0
         eng._ctx[:] = 0
@@ -366,3 +374,7 @@ class GenerationPool:
         gauge_set("GAUGE_generation_blocks_free", eng.kv.num_blocks - 1)
         gauge_set("GAUGE_generation_blocks_used", 0)
         gauge_set("GAUGE_generation_active_seqs", 0)
+        gauge_set("GAUGE_kv_shared_blocks", 0)
+        gauge_set("GAUGE_kv_blocks_saved", 0)
+        gauge_set("GAUGE_generation_prefix_entries", 0)
+        gauge_set("GAUGE_generation_prefix_blocks", 0)

@@ -193,6 +193,18 @@ def statusz() -> Dict[str, Any]:
                 "used": gauge_get("GAUGE_generation_blocks_used"),
                 "total": gauge_get("GAUGE_generation_blocks_free")
                 + gauge_get("GAUGE_generation_blocks_used"),
+                # shared-vs-private occupancy (PR 14 prefix cache):
+                # shared = blocks referenced more than once, saved =
+                # duplicate allocations sharing avoided, private =
+                # used blocks nothing shares
+                "shared": gauge_get("GAUGE_kv_shared_blocks"),
+                "saved": gauge_get("GAUGE_kv_blocks_saved"),
+                "private": gauge_get("GAUGE_generation_blocks_used")
+                - gauge_get("GAUGE_kv_shared_blocks"),
+            },
+            "prefix_cache": {
+                "entries": gauge_get("GAUGE_generation_prefix_entries"),
+                "blocks": gauge_get("GAUGE_generation_prefix_blocks"),
             },
         },
         "flight_recorder_steps": len(telemetry.flight_records()),

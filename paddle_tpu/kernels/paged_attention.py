@@ -40,6 +40,19 @@ chunk, not the visible total). Query j of row b sees pool positions
 The single-token functions above are the Cq == 1 specialization and
 delegate here, so decode parity pins cover the ragged core by
 construction.
+
+VERIFY LANES (PR 14, speculative decoding): a decode lane carrying k
+draft tokens is encoded exactly like a prefill chunk — k+1 adjacent
+slots sharing the lane's block table at consecutive positions
+ctx..ctx+k — so the causal chunk mask above IS the verify mask: slot j
+sees the drafts before it (scattered this same call) and nothing past
+its own position. That last property is also the rollback guarantee:
+a REJECTED draft's K/V sits at a position strictly greater than every
+accepted slot's, so no mask in this step or any later one exposes it
+before the next step's feed overwrites that position. Same argument
+covers the prefix cache's shared blocks: a consumer whose context
+frontier is below a shared partial block's stale tail never has those
+positions inside its mask.
 """
 from __future__ import annotations
 

@@ -233,16 +233,70 @@ try:
         return ({r.request_id: r.tokens for r in res},
                 int(stat_get("STAT_generation_compile") - c0))
 
+    # PR 14 smokes under the same plan: (a) cross-request prefix
+    # caching — a persistent cache-on engine serves the same
+    # shared-prefix batch twice; the second (warm) pass must HIT and
+    # both passes must equal a cache-off run, keyed by request id.
+    # (b) speculative decoding — ngram-drafted verify slots in the
+    # mixed step, streams bitwise-identical to plain decode.
+    shared = [7, 3, 11, 2, 9, 14, 5, 8]     # two 4-token chunks
+    preqs = lambda: [GenerationRequest(
+        prompt=shared + [30 + i], max_new_tokens=5,
+        sampling=SamplingParams(temperature=0.8, seed=i),
+        request_id=i) for i in range(4)]
+    sreqs = lambda: [GenerationRequest(
+        prompt=[5, 9, 2] * 4, max_new_tokens=8,
+        request_id=i) for i in range(2)]
+
+    def mk_eng(**kw):
+        kw.setdefault("num_blocks", 64)
+        kw.setdefault("block_size", 4)
+        kw.setdefault("decode_width", 2)
+        kw.setdefault("prefill_buckets", "pow2:32")
+        kw.setdefault("prefill_chunk", 4)
+        return GenerationEngine(gcfg, gparams, **kw)
+
     with use_plan(plan):
         chunked_toks, chunked_compiles = gen_run(4)
         twophase_toks, _ = gen_run(0)
+
+        cold = {r.request_id: r.tokens
+                for r in mk_eng(prefix_cache=False).generate(preqs())}
+        pfx_eng = mk_eng(prefix_cache=True)
+        pass1 = {r.request_id: r.tokens
+                 for r in pfx_eng.generate(preqs())}
+        h0 = stat_get("STAT_generation_prefix_hits")
+        pass2 = {r.request_id: r.tokens
+                 for r in pfx_eng.generate(preqs())}
+        prefix_hits = int(stat_get("STAT_generation_prefix_hits") - h0)
+        prefix_identical = pass1 == cold and pass2 == cold
+
+        plain = {r.request_id: r.tokens
+                 for r in mk_eng(prefix_cache=False).generate(sreqs())}
+        p0 = stat_get("STAT_generation_spec_proposed")
+        a0 = stat_get("STAT_generation_spec_accepted")
+        spec = {r.request_id: r.tokens
+                for r in mk_eng(prefix_cache=False, spec_tokens=3,
+                                draft="ngram").generate(sreqs())}
+        spec_proposed = int(
+            stat_get("STAT_generation_spec_proposed") - p0)
+        spec_accepted = int(
+            stat_get("STAT_generation_spec_accepted") - a0)
+        spec_identical = spec == plain
     generation = {
-        "ok": chunked_toks == twophase_toks and chunked_compiles == 0,
+        "ok": (chunked_toks == twophase_toks and chunked_compiles == 0
+               and prefix_identical and prefix_hits > 0
+               and spec_identical and spec_proposed > 0),
         "streams_bitwise_identical": chunked_toks == twophase_toks,
         "steady_state_recompiles": chunked_compiles,
         "prefill_chunk": 4,
         "chunks": int(sum((len(r.prompt) + 3) // 4 for r in greqs)),
         "tokens_generated": int(sum(len(t) for t in chunked_toks.values())),
+        "prefix_warm_pass_hits": prefix_hits,
+        "prefix_streams_bitwise_identical": prefix_identical,
+        "spec_streams_bitwise_identical": spec_identical,
+        "spec_proposed": spec_proposed,
+        "spec_accepted": spec_accepted,
     }
 except Exception as e:  # noqa: BLE001 - artifact records the failure
     generation["error"] = "%s: %s" % (type(e).__name__, e)

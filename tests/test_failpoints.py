@@ -592,8 +592,14 @@ def test_reset_engine_retracts_every_occupancy_gauge(gparams):
     eng = _gengine(gparams, num_blocks=16)
     pool = GenerationPool(eng, _start=False)
     try:
-        # simulate the occupancy a mid-batch fault leaves behind
-        eng.kv.alloc("seq", 3)
+        # simulate the occupancy a mid-batch fault leaves behind —
+        # including shared blocks a (possibly poisoned) prefix cache
+        # still references; the reset DROPS the cache
+        blocks = eng.kv.alloc("seq", 3)
+        if eng.prefix_cache is not None:
+            eng.prefix_cache.insert("k", 8, blocks[:2])
+            assert gauge_get("GAUGE_kv_shared_blocks") == 2
+            assert gauge_get("GAUGE_generation_prefix_entries") == 1
         gauge_set("GAUGE_generation_active_seqs", 2)
         assert gauge_get("GAUGE_generation_blocks_used") == 3
         pool._reset_engine()
@@ -601,6 +607,10 @@ def test_reset_engine_retracts_every_occupancy_gauge(gparams):
             eng.kv.num_blocks - 1
         assert gauge_get("GAUGE_generation_blocks_used") == 0
         assert gauge_get("GAUGE_generation_active_seqs") == 0
+        assert gauge_get("GAUGE_kv_shared_blocks") == 0
+        assert gauge_get("GAUGE_kv_blocks_saved") == 0
+        assert gauge_get("GAUGE_generation_prefix_entries") == 0
+        assert gauge_get("GAUGE_generation_prefix_blocks") == 0
     finally:
         pool.close()
 
