@@ -1553,6 +1553,176 @@ def bench_generation_spec():
     }
 
 
+def bench_quantized_serving():
+    """quantized serving block (ISSUE 15, docs/quantization.md): int8
+    per-channel weights (int8 x int8 -> int32 -> scale matmuls) plus
+    the int8 KV block pool with per-token-per-head scales dequantized
+    inside the online-softmax loop, vs the identical fp32 engine.
+
+    Error budget is measured the way the paper frames it — against the
+    fp32 oracle on the SAME prompts: logit MSE, max-abs logit delta,
+    and greedy-token agreement. Capacity is measured at a FIXED pool
+    byte budget: each flavor gets as many blocks as fit, and the gate
+    is the concurrent-sequence ratio (>= 2x, ISSUE 15 acceptance).
+    Steady-state recompiles must be zero — the quantized executables
+    live in the same AOT-cached bucketed/mixed program set, keyed by
+    quant config in the fingerprint."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import stat_diff
+    import jax.numpy as jnp
+    from paddle_tpu import monitor, quant
+    from paddle_tpu.generation import (DecoderConfig, GenerationEngine,
+                                       GenerationRequest, init_params)
+    from paddle_tpu.generation.model import forward_full
+    from paddle_tpu.monitor import stat_get
+
+    cfg = DecoderConfig(vocab_size=128, hidden=64, layers=4, heads=4,
+                        max_seq_len=128)
+    params = init_params(cfg, seed=0)
+    qparams = quant.quantize_decoder_params(params, "int8")
+
+    # --- logit error budget vs the fp32 oracle -----------------------
+    rng = np.random.RandomState(23)
+    toks = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(8, 48)),
+                       jnp.int32)
+    lens = jnp.asarray(rng.randint(1, 49, size=(8,)), jnp.int32)
+    lf = np.asarray(forward_full(cfg, params, toks, lens)[0])
+    lq = np.asarray(forward_full(cfg, qparams, toks, lens)[0])
+    d = lf - lq
+    max_abs = float(np.abs(d).max())
+    mse = float((d ** 2).mean())
+    greedy_agree = float((lf.argmax(-1) == lq.argmax(-1)).mean())
+
+    # --- capacity at a fixed pool byte budget ------------------------
+    bs = 8
+    per_tok_f32 = 2 * cfg.layers * cfg.heads * (cfg.hidden //
+                                                cfg.heads) * 4
+    per_tok_i8 = per_tok_f32 // 4 + 2 * cfg.layers * cfg.heads * 4
+    budget = 256 * bs * per_tok_f32          # 256 fp32 blocks' worth
+    nb_f32 = budget // (bs * per_tok_f32)
+    nb_i8 = budget // (bs * per_tok_i8)
+
+    mk = lambda p, nb, **kw: GenerationEngine(  # noqa: E731
+        cfg, p, num_blocks=int(nb), block_size=bs, decode_width=8,
+        prefill_buckets="pow2:128", prefill_chunk=48,
+        prefix_cache=False, **kw)
+    f32_eng = mk(params, nb_f32)
+    q_eng = mk(qparams, nb_i8, quant_mode="int8", kv_dtype="int8")
+    cap_ratio = q_eng.kv_capacity_seqs() / max(
+        f32_eng.kv_capacity_seqs(), 1)
+
+    # --- throughput + stream agreement -------------------------------
+    R = 16
+    reqs = []
+    for i in range(R):
+        motif = list(rng.randint(1, cfg.vocab_size, size=3))
+        reqs.append(GenerationRequest(
+            prompt=(motif * 13)[:int(rng.randint(34, 40))],
+            max_new_tokens=24, request_id=i))
+    total_new = sum(r.max_new_tokens for r in reqs)
+
+    def run_pass(eng):
+        for r in reqs:
+            eng.submit(GenerationRequest(**r.__dict__))
+        done = []
+        t0 = time.perf_counter()
+        while not eng.idle:
+            done.extend(eng.step())
+        wall = time.perf_counter() - t0
+        return wall, {res.request_id: res.tokens for res in done}
+
+    f32_eng.warmup()
+    q_eng.warmup()
+    c0 = stat_get("STAT_generation_compile")
+    b0 = stat_get("STAT_generation_kv_quant_blocks")
+    f32_best = q_best = None
+    f32_toks = q_toks = None
+    for _ in range(4):
+        for eng, which in ((f32_eng, "fp32"), (q_eng, "int8")):
+            wall, t = run_pass(eng)
+            if which == "fp32":
+                f32_toks = t
+                if f32_best is None or wall < f32_best:
+                    f32_best = wall
+            else:
+                q_toks = t
+                if q_best is None or wall < q_best:
+                    q_best = wall
+    recompiles = int(stat_get("STAT_generation_compile") - c0)
+    kvq_blocks = int(stat_get("STAT_generation_kv_quant_blocks") - b0)
+    agree = sum(f32_toks[i] == q_toks[i] for i in range(R))
+    # agreed-prefix depth: one near-tie argmax flip diverges the rest
+    # of an untrained model's stream, so whole-stream equality
+    # understates agreement — the depth of the first divergence is the
+    # honest stream-level error metric on long generations
+    def _prefix(a, b):
+        n = 0
+        for x, y in zip(a, b):
+            if x != y:
+                break
+            n += 1
+        return n
+    mean_prefix = sum(_prefix(f32_toks[i], q_toks[i])
+                      for i in range(R)) / float(R)
+
+    snap = monitor.snapshot()
+    cur = {
+        "counters": {k: v for k, v in snap["counters"].items()
+                     if "generation" in k},
+        "gauges": {k: v for k, v in snap["gauges"].items()
+                   if "quant" in k or "kv_" in k},
+        "timers": {k: v for k, v in snap["timers"].items()
+                   if "generation" in k},
+    }
+    snap_path = os.environ.get(
+        "PT_QUANTIZED_SERVING_BENCH_SNAPSHOT",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "bench_quantized_serving_last.json"))
+    regressions = []
+    try:
+        prev = stat_diff.load_snapshot(snap_path)
+        regressions = stat_diff.find_regressions(
+            stat_diff.diff_snapshots(prev, cur), threshold_pct=25.0)
+        regressions = [r for r in regressions if r.startswith("timer")]
+    except OSError:
+        pass
+    try:
+        os.makedirs(os.path.dirname(snap_path), exist_ok=True)
+        with open(snap_path, "w") as f:
+            json.dump(cur, f)
+    except OSError:
+        pass
+
+    return {
+        "workload": "decoder L%d-H%d: %d greedy requests, %d new "
+                    "tokens; int8 weights + int8 KV vs fp32" %
+                    (cfg.layers, cfg.hidden, R, total_new),
+        "logit_max_abs_delta": round(max_abs, 5),
+        "logit_mse": round(mse, 7),
+        "greedy_token_agreement": round(greedy_agree, 4),
+        "error_budget_ok": max_abs < 0.25 and mse < 5e-3
+        and greedy_agree >= 0.999,
+        "pool_byte_budget": int(budget),
+        "fp32_blocks_at_budget": int(nb_f32),
+        "int8_blocks_at_budget": int(nb_i8),
+        "fp32_capacity_seqs": int(f32_eng.kv_capacity_seqs()),
+        "int8_capacity_seqs": int(q_eng.kv_capacity_seqs()),
+        "capacity_ratio": round(cap_ratio, 2),
+        "meets_2x_capacity": cap_ratio >= 2.0,
+        "fp32_kv_bytes_per_seq": int(f32_eng.kv_bytes_per_seq()),
+        "int8_kv_bytes_per_seq": int(q_eng.kv_bytes_per_seq()),
+        "weight_bytes_saved": int(quant.weight_bytes_saved(qparams)),
+        "fp32_tokens_per_sec": round(total_new / f32_best, 1),
+        "int8_tokens_per_sec": round(total_new / q_best, 1),
+        "greedy_streams_agree": "%d/%d" % (agree, R),
+        "mean_agreed_prefix_tokens": round(mean_prefix, 1),
+        "kv_quant_blocks_written": kvq_blocks,
+        "steady_state_recompiles": recompiles,
+        "mixed_step_p95_regressions": regressions,
+    }
+
+
 def _spmd_worker():
     """spmd block worker (ISSUE 6, docs/spmd.md): runs in a FRESH
     process (env: JAX_PLATFORMS=cpu + --xla_force_host_platform_
@@ -2247,6 +2417,12 @@ def _run_worker(backend):
         # mixed step vs plain decode, bitwise-identical streams
         # (ISSUE 14)
         rec["generation_spec"] = bench_generation_spec()
+    if not os.environ.get("PT_SKIP_QUANTIZED_SERVING_BENCH"):
+        # int8 weights + int8 KV pool vs fp32: logit error budget,
+        # >= 2x concurrent sequences at a fixed pool byte budget,
+        # greedy stream agreement, zero steady-state recompiles
+        # (ISSUE 15 — error and capacity are real on CPU too)
+        rec["quantized_serving"] = bench_quantized_serving()
     if not os.environ.get("PT_SKIP_SPMD_BENCH"):
         # mesh-native SPMD runtime: dp scaling + dp4xmp2 loss parity on
         # 8 fake CPU devices; subprocess-isolated because the virtual

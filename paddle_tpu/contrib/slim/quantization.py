@@ -308,11 +308,19 @@ class QuantizationFreezePass(_PassBase):
                         s = np.abs(w).max(axis=red)
                         bshape = [1] * w.ndim
                         bshape[axis] = w.shape[axis]
-                        sb = s.reshape(bshape)
                     else:
                         s = np.abs(w).max().reshape(1)
-                        sb = s
-                    sb = np.where(sb <= 1e-30, 1e-6, sb)
+                        bshape = None
+                    # guard BEFORE storing: the exported .quant_scale
+                    # must equal the divisor actually used, or an
+                    # all-zero channel exports scale 0.0 while its
+                    # weights were quantized with the guard value and
+                    # the export->load round trip silently diverges
+                    # (tests/test_quantization.py pins equality). The
+                    # serving loader (paddle_tpu/quant) shares this
+                    # guard contract.
+                    s = np.where(s <= 1e-30, 1e-6, s)
+                    sb = s.reshape(bshape) if bshape is not None else s
                     wq = np.round(w / sb * wbins)
                     scope.set(src, wq.astype(np.float32))
                     scope.set(src + ".quant_scale", s.astype(np.float32))

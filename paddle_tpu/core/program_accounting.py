@@ -102,6 +102,11 @@ class ProgramRecord:
             "tag": self.tag,
             "key": self.key,
             "meta": self.meta,
+            # quant mode the program was traced under (engine meta
+            # carries qm=; fp32/legacy programs report "off") — the
+            # /programz answer to "which checkpoint flavor compiled
+            # this" without digging through meta
+            "quant": str(self.meta.get("qm", "off")),
             "flops": self.flops,
             "transcendentals": self.transcendentals,
             "bytes_accessed": self.bytes_accessed,

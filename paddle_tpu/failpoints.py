@@ -77,6 +77,12 @@ KNOWN_SITES: Tuple[str, ...] = (
     # plain decode, stream bitwise-unchanged)
     "generation.prefix_lookup",
     "generation.draft_step",
+    # ISSUE 15: quantized-KV step stage — fires before the mixed
+    # executable quantizes this step's K/V rows (and before any state
+    # mutation), so a caught fault retries cleanly and a batch-level
+    # escalation rebuilds through _reset_engine, which re-derives the
+    # quant gauges
+    "generation.kv_quant",
     "checkpoint.save",
     "checkpoint.load",
     "trainstep.step",

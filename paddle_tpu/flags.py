@@ -247,6 +247,20 @@ _DEFS: Dict[str, Any] = {
     # at window=1 speed). "auto" = donate on every backend except cpu;
     # True/False force it.
     "FLAGS_executor_donate_state": "auto",
+    # quantized serving (paddle_tpu/quant/, docs/quantization.md):
+    # "off" (default) serves fp32 exactly as before — the quant path is
+    # OPT-IN and not bitwise vs fp32. "int8" = per-channel int8 weights
+    # with int8 x int8 -> int32 -> scale matmuls; "fp8" = fp8-e4m3
+    # weight storage (upcast matmul) where the backend supports it.
+    # Read at engine/predictor construction -> lowering flag, so fp32
+    # and quantized checkpoints can never share a compiled program.
+    "FLAGS_quant_mode": "off",
+    # quantized KV block pool (generation/engine.py): "auto" follows
+    # FLAGS_quant_mode (int8 KV when quant is on, fp32 otherwise);
+    # "fp32" / "int8" / "fp8" pin the pool dtype. Quantized pools store
+    # per-token-per-head absmax scales alongside and dequantize inside
+    # the online-softmax loop of kernels/paged_attention.py.
+    "FLAGS_generation_kv_quant": "auto",
 }
 
 _values: Dict[str, Any] = dict(_DEFS)
@@ -267,6 +281,11 @@ _LOWERING_FLAGS = [
     # not read during lowering, but it changes the COMPILED executable
     # (jit donate_argnums): a mid-process flip must miss the caches
     "FLAGS_executor_donate_state",
+    # quant config is baked into the traced computation (int8 matmuls,
+    # KV pool dtype): a cached fp32 program must never serve a
+    # quantized checkpoint, so both ride every compile key
+    "FLAGS_quant_mode",
+    "FLAGS_generation_kv_quant",
 ]
 
 

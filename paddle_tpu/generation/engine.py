@@ -96,6 +96,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import quant as _quant
 from .. import telemetry as _tm
 from .. import tracing as _tr
 from ..core import program_cache
@@ -182,7 +183,9 @@ class GenerationEngine:
                  draft: Optional[str] = None,
                  draft_cfg: Optional[DecoderConfig] = None,
                  draft_params: Optional[Dict[str, Any]] = None,
-                 program_cache_dir: Optional[str] = None):
+                 program_cache_dir: Optional[str] = None,
+                 quant_mode: Optional[str] = None,
+                 kv_dtype: Optional[str] = None):
         self.cfg = cfg
         self.params = jax.tree.map(jnp.asarray, params)
         nb = int(num_blocks if num_blocks is not None
@@ -209,6 +212,47 @@ class GenerationEngine:
                 "speculative decoding rides the chunked mixed step — "
                 "FLAGS_generation_spec_tokens needs "
                 "FLAGS_generation_prefill_chunk > 0")
+        # quantized serving (ISSUE 15, paddle_tpu/quant): weight quant
+        # mode + KV pool dtype. Both ride every program fingerprint
+        # (lowering flags + the v=3 meta below) so an fp32 cached
+        # program can never serve a quantized checkpoint.
+        self.quant_mode = str(quant_mode if quant_mode is not None
+                              else get_flag("FLAGS_quant_mode"))
+        if self.quant_mode not in _quant.MODES:
+            raise ValueError("unknown quant_mode %r (off|int8|fp8)"
+                             % self.quant_mode)
+        if self.quant_mode == "fp8" and not _quant.supports_fp8():
+            raise ValueError(
+                "quant_mode='fp8' needs float8_e4m3fn in this jax "
+                "build/backend (quant.supports_fp8()) — use 'int8'")
+        kvq = str(kv_dtype if kv_dtype is not None
+                  else get_flag("FLAGS_generation_kv_quant"))
+        if kvq == "auto":
+            # follow the weight mode: a quantized deployment wants the
+            # HBM saving on the pools too; fp8 KV stays opt-in
+            kvq = "int8" if self.quant_mode != "off" else "fp32"
+        if kvq not in _quant.KV_DTYPES:
+            raise ValueError("unknown kv_dtype %r (auto|fp32|int8|fp8)"
+                             % kvq)
+        if kvq == "fp8" and not _quant.supports_fp8():
+            raise ValueError(
+                "kv_dtype='fp8' needs float8_e4m3fn in this jax "
+                "build/backend (quant.supports_fp8()) — use 'int8'")
+        self.kv_dtype = kvq
+        if self.kv_dtype != "fp32" and not self.prefill_chunk:
+            raise ValueError(
+                "quantized KV rides the chunked mixed step — "
+                "FLAGS_generation_kv_quant needs "
+                "FLAGS_generation_prefill_chunk > 0")
+        if self.quant_mode != "off" and not _quant.is_quantized(
+                self.params):
+            # fp32 params are converted in-process (tests/bench
+            # convenience); pre-converted checkpoints (quant.convert
+            # CLI / load_quantized) pass through untouched
+            self.params = jax.tree.map(
+                jnp.asarray,
+                _quant.quantize_decoder_params(self.params,
+                                               self.quant_mode))
         if self.prefill_chunk:
             # chunked mode: prompts stream through the mixed step, so
             # the bucket ladder is a compat shim with one rung
@@ -250,8 +294,21 @@ class GenerationEngine:
         # the bitwise-parity requirement (model.forward_full docstring)
         self.attn_lanes = self.max_blocks_per_seq * bs
         shape = (cfg.layers, nb, bs, cfg.heads, cfg.head_dim)
-        self.k_pools = jnp.zeros(shape, jnp.float32)
-        self.v_pools = jnp.zeros(shape, jnp.float32)
+        if self.kv_dtype == "fp32":
+            self.k_pools = jnp.zeros(shape, jnp.float32)
+            self.v_pools = jnp.zeros(shape, jnp.float32)
+            self.k_scales = self.v_scales = None
+        else:
+            # quantized pool + per-token-per-head fp32 absmax scale
+            # pool (quant.quantize_kv_rows). Scales init to ONE so a
+            # trash-block / never-written row dequantizes its zero
+            # payload to exact 0.0, same as the fp32 pools
+            dt = _quant.storage_dtype(self.kv_dtype)
+            self.k_pools = jnp.zeros(shape, dt)
+            self.v_pools = jnp.zeros(shape, dt)
+            sshape = (cfg.layers, nb, bs, cfg.heads)
+            self.k_scales = jnp.ones(sshape, jnp.float32)
+            self.v_scales = jnp.ones(sshape, jnp.float32)
         # cross-request prefix cache (chunked mode only: the chunk is
         # the hash unit)
         pc_on = bool(prefix_cache if prefix_cache is not None
@@ -309,6 +366,46 @@ class GenerationEngine:
         self.on_request_error = None
         # flipped by warmup(): the GenerationPool's /readyz probe
         self._warmed = False
+        self._publish_quant_gauges()
+
+    # --- quantized serving (ISSUE 15) ----------------------------------
+
+    def kv_pool_bytes(self) -> int:
+        """Total device bytes of the K/V block pools, scale pools
+        included — the fixed budget the capacity bench holds constant
+        across dtypes."""
+        b = self.k_pools.nbytes + self.v_pools.nbytes
+        if self.k_scales is not None:
+            b += self.k_scales.nbytes + self.v_scales.nbytes
+        return int(b)
+
+    def kv_bytes_per_seq(self) -> int:
+        """Pool bytes one max-length sequence occupies (payload +
+        scales over its max_blocks_per_seq table span) — the value
+        behind GAUGE_kv_bytes_per_seq."""
+        cfg = self.cfg
+        per_tok = 2 * cfg.layers * cfg.heads * cfg.head_dim \
+            * jnp.dtype(self.k_pools.dtype).itemsize
+        if self.k_scales is not None:
+            per_tok += 2 * cfg.layers * cfg.heads * 4
+        return int(per_tok * self.kv.block_size
+                   * self.max_blocks_per_seq)
+
+    def kv_capacity_seqs(self) -> int:
+        """Concurrent max-length sequences the pool admits (block 0 is
+        the trash block). At a FIXED byte budget a quantized pool
+        affords ~4x the blocks, so this is where the 2-4x concurrency
+        headline lands (bench.py quantized_serving gates >= 2x)."""
+        return (self.kv.num_blocks - 1) // self.max_blocks_per_seq
+
+    def _publish_quant_gauges(self) -> None:
+        """(Re)publish the quant gauges. Called at construction AND by
+        the scheduler's _reset_engine, so a post-fault rebuild retracts
+        stale values (tests/test_failpoints.py pins this)."""
+        gauge_set("GAUGE_kv_bytes_per_seq", self.kv_bytes_per_seq())
+        gauge_set("GAUGE_kv_capacity_seqs", self.kv_capacity_seqs())
+        gauge_set("GAUGE_quant_weight_bytes_saved",
+                  _quant.weight_bytes_saved(self.params))
 
     # --- compiled-step registry ---------------------------------------
 
@@ -369,20 +466,38 @@ class GenerationEngine:
             # decode sample at the same position; the gather keeps the
             # sampler's sort cost off the (much wider) padding slots.
             # The host decides which sample rows are emitted.
-            def raw(params, kp, vp, tables, positions, tokens,
-                    sample_slots, temps, tks, tps, seeds, steps):
-                logits, kp2, vp2 = forward_paged(
-                    cfg, params, kp, vp, tables, positions, tokens)
-                nxt = sample_tokens(logits[sample_slots], temps, tks,
-                                    tps, seeds, steps)
-                return nxt, kp2, vp2
+            # Quantized KV threads the scale pools through the SAME
+            # executable (5-tuple state) — the dequant runs inside the
+            # attention kernel's online-softmax loop, not as a separate
+            # pass, so the step count and shapes never change.
+            if self.k_scales is not None:
+                def raw(params, kp, vp, ks, vs, tables, positions,
+                        tokens, sample_slots, temps, tks, tps, seeds,
+                        steps):
+                    logits, kp2, vp2, ks2, vs2 = forward_paged(
+                        cfg, params, kp, vp, tables, positions, tokens,
+                        k_scale_pools=ks, v_scale_pools=vs)
+                    nxt = sample_tokens(logits[sample_slots], temps,
+                                        tks, tps, seeds, steps)
+                    return nxt, kp2, vp2, ks2, vs2
+                pool_avals = (_sds(self.k_pools), _sds(self.v_pools),
+                              _sds(self.k_scales), _sds(self.v_scales))
+            else:
+                def raw(params, kp, vp, tables, positions, tokens,
+                        sample_slots, temps, tks, tps, seeds, steps):
+                    logits, kp2, vp2 = forward_paged(
+                        cfg, params, kp, vp, tables, positions, tokens)
+                    nxt = sample_tokens(logits[sample_slots], temps,
+                                        tks, tps, seeds, steps)
+                    return nxt, kp2, vp2
+                pool_avals = (_sds(self.k_pools), _sds(self.v_pools))
             m = self.max_blocks_per_seq
             t = self.token_budget
             sw = self.sample_width
             i32 = jnp.int32
             avals = (
                 jax.tree.map(_sds, self.params),
-                _sds(self.k_pools), _sds(self.v_pools),
+            ) + pool_avals + (
                 jax.ShapeDtypeStruct((t, m), i32),
                 jax.ShapeDtypeStruct((t,), i32),
                 jax.ShapeDtypeStruct((t,), i32),
@@ -396,15 +511,28 @@ class GenerationEngine:
         elif kind in ("cow", "draft_cow"):
             # copy-on-write: clone one pool block's rows (every layer)
             # before a write would mutate a shared block. Scalar
-            # src/dst keep it ONE executable for any block pair.
-            def raw(kp, vp, src, dst):
-                return (kp.at[:, dst].set(kp[:, src]),
-                        vp.at[:, dst].set(vp[:, src]))
-            kp0 = self.k_pools if kind == "cow" else self.dk_pools
-            vp0 = self.v_pools if kind == "cow" else self.dv_pools
-            avals = (_sds(kp0), _sds(vp0),
-                     jax.ShapeDtypeStruct((), jnp.int32),
-                     jax.ShapeDtypeStruct((), jnp.int32))
+            # src/dst keep it ONE executable for any block pair. A
+            # quantized target pool clones its scale rows in the same
+            # executable (draft pools are always fp32).
+            if kind == "cow" and self.k_scales is not None:
+                def raw(kp, vp, ks, vs, src, dst):
+                    return (kp.at[:, dst].set(kp[:, src]),
+                            vp.at[:, dst].set(vp[:, src]),
+                            ks.at[:, dst].set(ks[:, src]),
+                            vs.at[:, dst].set(vs[:, src]))
+                avals = (_sds(self.k_pools), _sds(self.v_pools),
+                         _sds(self.k_scales), _sds(self.v_scales),
+                         jax.ShapeDtypeStruct((), jnp.int32),
+                         jax.ShapeDtypeStruct((), jnp.int32))
+            else:
+                def raw(kp, vp, src, dst):
+                    return (kp.at[:, dst].set(kp[:, src]),
+                            vp.at[:, dst].set(vp[:, src]))
+                kp0 = self.k_pools if kind == "cow" else self.dk_pools
+                vp0 = self.v_pools if kind == "cow" else self.dv_pools
+                avals = (_sds(kp0), _sds(vp0),
+                         jax.ShapeDtypeStruct((), jnp.int32),
+                         jax.ShapeDtypeStruct((), jnp.int32))
         elif kind == "draft_mixed":
             # the draft model's step over the SAME slot layout and the
             # same block tables, writing its own pools. Greedy argmax:
@@ -443,12 +571,15 @@ class GenerationEngine:
                else "generation_%s" % kind)
         base = (self.draft_cfg.meta() if kind.startswith("draft")
                 else self.cfg.meta())
-        # v=2: the mixed step's PR-14 gathered-sampler signature —
-        # stale disk-cache entries must miss on the fingerprint rather
-        # than trip exported_entry's aval check; samp rides along
-        # because two engines can share every other dimension yet
-        # differ in spec_tokens
-        meta = dict(base, kind=kind, bucket=bucket, v=2,
+        # v=3: ISSUE-15 quantized serving — qm/kvq join the
+        # fingerprint because ctor args can override the (lowering)
+        # flags per-engine, and a cached fp32 program must NEVER serve
+        # a quantized checkpoint (or vice versa); stale disk-cache
+        # entries must miss on the fingerprint rather than trip
+        # exported_entry's aval check. samp rides along because two
+        # engines can share every other dimension yet differ in
+        # spec_tokens.
+        meta = dict(base, kind=kind, bucket=bucket, v=3,
                     blocks=self.kv.num_blocks,
                     block_size=self.kv.block_size,
                     width=self.decode_width,
@@ -456,7 +587,9 @@ class GenerationEngine:
                     lanes=self.attn_lanes,
                     chunk=self.prefill_chunk,
                     slots=self.token_budget,
-                    samp=self.sample_width)
+                    samp=self.sample_width,
+                    qm=self.quant_mode,
+                    kvq=self.kv_dtype)
         cache_dir = program_cache.resolve_dir(self._program_cache_dir)
         if cache_dir is not None:
             fp = program_cache.fn_fingerprint("generation_step", meta)
@@ -533,16 +666,25 @@ class GenerationEngine:
         t, sw = self.token_budget, self.sample_width
         zt = jnp.zeros((t,), jnp.int32)
         zs = jnp.zeros((sw,), jnp.int32)
-        fn(self.params, self.k_pools, self.v_pools,
-           jnp.zeros((t, self.max_blocks_per_seq), jnp.int32), zt, zt,
-           zs, jnp.zeros((sw,), jnp.float32), zs,
-           jnp.ones((sw,), jnp.float32), zs, zs)
+        rest = (jnp.zeros((t, self.max_blocks_per_seq), jnp.int32),
+                zt, zt, zs, jnp.zeros((sw,), jnp.float32), zs,
+                jnp.ones((sw,), jnp.float32), zs, zs)
+        if self.k_scales is not None:
+            fn(self.params, self.k_pools, self.v_pools, self.k_scales,
+               self.v_scales, *rest)
+        else:
+            fn(self.params, self.k_pools, self.v_pools, *rest)
 
     def _warm_cow(self, kind: str, kp, vp) -> None:
         # trash-block self-copy: compiles the clone, mutates nothing
         # anyone reads
         fn = self._get_fn(kind)
         z = jnp.asarray(0, jnp.int32)
+        if kind == "cow" and self.k_scales is not None:
+            (self.k_pools, self.v_pools, self.k_scales,
+             self.v_scales) = fn(kp, vp, self.k_scales, self.v_scales,
+                                 z, z)
+            return
         out = fn(kp, vp, z, z)
         if kind == "cow":
             self.k_pools, self.v_pools = out
@@ -960,6 +1102,17 @@ class GenerationEngine:
             seeds[row0] = sp.seed
             steps[row0] = 0
         stat_add("STAT_generation_pad_tokens", t - slot)
+        if self.k_scales is not None:
+            # this step's fresh K/V rows quantize inside the compiled
+            # call — the failpoint models a fault in that stage, and it
+            # sits BEFORE any state mutation so a caught InjectedFault
+            # retries the step cleanly (tests/test_failpoints.py)
+            failpoint("generation.kv_quant")
+            bs_q = self.kv.block_size
+            written = {int(tables[i][positions[i] // bs_q])
+                       for i in range(slot)}
+            written.discard(TRASH_BLOCK)
+            stat_add("STAT_generation_kv_quant_blocks", len(written))
         t0 = time.perf_counter()
         riders = decode_lanes + [c[0] for c in chunk_plan]
         tids = ",".join(
@@ -969,12 +1122,19 @@ class GenerationEngine:
         with _tm.trace_scope(tids), \
                 _tm.span("generation/mixed_step", track="generation"):
             fn = self._get_fn("mixed")
-            nxt, self.k_pools, self.v_pools = fn(
-                self.params, self.k_pools, self.v_pools,
-                jnp.asarray(tables), jnp.asarray(positions),
-                jnp.asarray(tokens), jnp.asarray(sample_slots),
-                jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps),
-                jnp.asarray(seeds), jnp.asarray(steps))
+            rest = (jnp.asarray(tables), jnp.asarray(positions),
+                    jnp.asarray(tokens), jnp.asarray(sample_slots),
+                    jnp.asarray(temps), jnp.asarray(tks),
+                    jnp.asarray(tps), jnp.asarray(seeds),
+                    jnp.asarray(steps))
+            if self.k_scales is not None:
+                (nxt, self.k_pools, self.v_pools, self.k_scales,
+                 self.v_scales) = fn(self.params, self.k_pools,
+                                     self.v_pools, self.k_scales,
+                                     self.v_scales, *rest)
+            else:
+                nxt, self.k_pools, self.v_pools = fn(
+                    self.params, self.k_pools, self.v_pools, *rest)
             nxt = np.asarray(nxt)
         dt_us = (time.perf_counter() - t0) * 1e6
         timer_observe("TIMER_generation_mixed_step_us", dt_us)
@@ -1104,8 +1264,13 @@ class GenerationEngine:
         fn = self._get_fn("cow")
         s = jnp.asarray(src, jnp.int32)
         d = jnp.asarray(dst, jnp.int32)
-        self.k_pools, self.v_pools = fn(self.k_pools, self.v_pools,
-                                        s, d)
+        if self.k_scales is not None:
+            (self.k_pools, self.v_pools, self.k_scales,
+             self.v_scales) = fn(self.k_pools, self.v_pools,
+                                 self.k_scales, self.v_scales, s, d)
+        else:
+            self.k_pools, self.v_pools = fn(self.k_pools,
+                                            self.v_pools, s, d)
         if self.draft_params is not None:
             dfn = self._get_fn("draft_cow")
             self.dk_pools, self.dv_pools = dfn(

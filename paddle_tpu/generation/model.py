@@ -32,9 +32,19 @@ import numpy as np
 
 from ..kernels.paged_attention import (NEG_INF, attend_reference,
                                        paged_attention)
+from .. import quant as _quant
 
 __all__ = ["DecoderConfig", "init_params", "forward_full",
            "forward_paged"]
+
+# every weight matmul / embedding gather routes through these seams:
+# with no '<name>::scale' key in params they reduce to the EXACT
+# `x @ params[name]` / `params[name][idx]` expressions (fp32 serving
+# stays bitwise-identical); a quantized checkpoint (paddle_tpu/quant)
+# switches them to int8 x int8 -> int32 -> scale (or fp8 upcast) and
+# gather-then-dequant respectively
+_mm = _quant.matmul
+_emb = _quant.embed
 
 
 @dataclass(frozen=True)
@@ -104,16 +114,16 @@ def _ln(x, g, b):
 
 def _qkv(cfg: DecoderConfig, params: dict, i: int, x):
     """x [..., h] -> q, k, v each [..., heads, head_dim]."""
-    qkv = x @ params["l%d_wqkv" % i]
+    qkv = _mm(params, "l%d_wqkv" % i, x)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     shp = x.shape[:-1] + (cfg.heads, cfg.head_dim)
     return q.reshape(shp), k.reshape(shp), v.reshape(shp)
 
 
 def _mlp(params: dict, i: int, x):
-    h = jax.nn.gelu(x @ params["l%d_w1" % i] + params["l%d_b1" % i],
+    h = jax.nn.gelu(_mm(params, "l%d_w1" % i, x) + params["l%d_b1" % i],
                     approximate=False)
-    return h @ params["l%d_w2" % i] + params["l%d_b2" % i]
+    return _mm(params, "l%d_w2" % i, h) + params["l%d_b2" % i]
 
 
 def forward_full(cfg: DecoderConfig, params: dict, tokens, lengths,
@@ -134,7 +144,8 @@ def forward_full(cfg: DecoderConfig, params: dict, tokens, lengths,
     """
     b, s = tokens.shape
     pos = jnp.arange(s, dtype=jnp.int32)
-    x = params["tok_emb"][tokens] + params["pos_emb"][pos][None]
+    x = _emb(params, "tok_emb", tokens) + _emb(params, "pos_emb",
+                                               pos)[None]
     lanes = int(attn_lanes) if attn_lanes else s
     if lanes < s:
         raise ValueError("attn_lanes %d < sequence length %d"
@@ -157,11 +168,11 @@ def forward_full(cfg: DecoderConfig, params: dict, tokens, lengths,
                              jnp.pad(v, pad).transpose(0, 2, 1, 3),
                              mask, sm_scale)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.hidden)
-        x = x + o @ params["l%d_wo" % i]
+        x = x + _mm(params, "l%d_wo" % i, o)
         x = x + _mlp(params, i, _ln(x, params["l%d_ln2_g" % i],
                                     params["l%d_ln2_b" % i]))
     x = _ln(x, params["ln_f_g"], params["ln_f_b"])
-    logits = x @ params["unembed"]                         # [B, S, V]
+    logits = _mm(params, "unembed", x)                     # [B, S, V]
     last = jnp.take_along_axis(
         logits, (lengths - 1)[:, None, None].astype(jnp.int32),
         axis=1)[:, 0]
@@ -169,7 +180,8 @@ def forward_full(cfg: DecoderConfig, params: dict, tokens, lengths,
 
 
 def forward_paged(cfg: DecoderConfig, params: dict, k_pools, v_pools,
-                  block_tables, ctx_lens, tokens):
+                  block_tables, ctx_lens, tokens,
+                  k_scale_pools=None, v_scale_pools=None):
     """One-token-per-slot paged step: tokens `[B]` (each slot's token
     at position ctx_lens), pools `[layers, N, bs, H, D]`, block_tables
     `[B, M]`, ctx_lens `[B]` int32 (tokens already in the cache).
@@ -189,29 +201,53 @@ def forward_paged(cfg: DecoderConfig, params: dict, k_pools, v_pools,
     Inactive slots (the scheduler parks them) carry ctx_lens whose
     block-table slot is the trash block — their writes land in trash
     and their logits are garbage the scheduler never samples from.
+
+    QUANTIZED KV (ISSUE 15): with `k_scale_pools`/`v_scale_pools`
+    given (`[layers, N, bs, H]` fp32 absmax), the pools store int8/fp8:
+    each slot's fresh K/V rows quantize per-token-per-head
+    (quant.quantize_kv_rows) before the scatter, the scale rows scatter
+    alongside, and attention dequantizes inside the kernel. Returns a
+    5-tuple (logits, k_pools', v_pools', k_scale_pools',
+    v_scale_pools'); the fp32 call keeps the 3-tuple and the exact
+    pre-quant expressions.
     """
     b = tokens.shape[0]
     bs = k_pools.shape[2]
-    x = params["tok_emb"][tokens] + params["pos_emb"][ctx_lens]  # [B,h]
+    x = _emb(params, "tok_emb", tokens) \
+        + _emb(params, "pos_emb", ctx_lens)                # [B,h]
     sm_scale = 1.0 / math.sqrt(cfg.head_dim)
     rows = jnp.arange(b)
     blk = jnp.take_along_axis(
         block_tables, (ctx_lens // bs)[:, None].astype(jnp.int32),
         axis=1)[:, 0]                                      # [B]
     off = ctx_lens % bs
-    new_k, new_v = [], []
+    quant_kv = k_scale_pools is not None
+    new_k, new_v, new_ks, new_vs = [], [], [], []
     for i in range(cfg.layers):
         xn = _ln(x, params["l%d_ln1_g" % i], params["l%d_ln1_b" % i])
         q, k, v = _qkv(cfg, params, i, xn)                 # [B,H,D]
+        if quant_kv:
+            k, ksc = _quant.quantize_kv_rows(k, k_pools.dtype)
+            v, vsc = _quant.quantize_kv_rows(v, v_pools.dtype)
+            ksp = k_scale_pools[i].at[blk, off].set(ksc)
+            vsp = v_scale_pools[i].at[blk, off].set(vsc)
+            new_ks.append(ksp)
+            new_vs.append(vsp)
+        else:
+            ksp = vsp = None
         kp = k_pools[i].at[blk, off].set(k)                # scatter new
         vp = v_pools[i].at[blk, off].set(v)
         new_k.append(kp)
         new_v.append(vp)
         o = paged_attention(q, kp, vp, block_tables, ctx_lens + 1,
-                            sm_scale=sm_scale)             # [B,H,D]
-        x = x + o.reshape(b, cfg.hidden) @ params["l%d_wo" % i]
+                            sm_scale=sm_scale,
+                            k_scales=ksp, v_scales=vsp)    # [B,H,D]
+        x = x + _mm(params, "l%d_wo" % i, o.reshape(b, cfg.hidden))
         x = x + _mlp(params, i, _ln(x, params["l%d_ln2_g" % i],
                                     params["l%d_ln2_b" % i]))
     x = _ln(x, params["ln_f_g"], params["ln_f_b"])
-    logits = x @ params["unembed"]                         # [B, V]
+    logits = _mm(params, "unembed", x)                     # [B, V]
+    if quant_kv:
+        return (logits, jnp.stack(new_k), jnp.stack(new_v),
+                jnp.stack(new_ks), jnp.stack(new_vs))
     return logits, jnp.stack(new_k), jnp.stack(new_v)

@@ -378,3 +378,7 @@ class GenerationPool:
         gauge_set("GAUGE_kv_blocks_saved", 0)
         gauge_set("GAUGE_generation_prefix_entries", 0)
         gauge_set("GAUGE_generation_prefix_blocks", 0)
+        # the quant gauges are derived from surviving engine state
+        # (pool dtype, quantized params), so re-deriving them IS the
+        # retraction — a rebuilt fp32 engine publishes zeros
+        eng._publish_quant_gauges()

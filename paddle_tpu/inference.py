@@ -148,6 +148,29 @@ class Config:
         # mesh-native SPMD serving (docs/spmd.md): a ShardingPlan the
         # predictor activates around every execution
         self._spmd_plan = None
+        # weight-only quantized serving (docs/quantization.md): None
+        # follows FLAGS_quant_mode, enable_quant()/disable_quant() pin
+        # it for this predictor
+        self._quant_mode: Optional[str] = None
+
+    def enable_quant(self, mode: str = "int8"):
+        """Serve with weight-only quantization: at load, every
+        matmul-family weight in the program is stored int8 in scope
+        (+ a `<name>.quant_scale` absmax var) with a
+        fake_channel_wise_dequantize_max_abs feeding its consumers —
+        the slim QAT dialect, so frozen-QAT and post-training programs
+        serve identically. Opt-in and NOT bitwise vs fp32
+        (docs/quantization.md has the error budget)."""
+        from . import quant
+        if mode not in ("off", "int8"):
+            raise ValueError(
+                "Predictor quant mode %r not supported (off|int8; fp8 "
+                "is flat-checkpoint only — quant.py)" % (mode,))
+        self._quant_mode = mode
+        return self
+
+    def disable_quant(self):
+        self._quant_mode = "off"
 
     def enable_spmd(self, plan_or_spec, data_axis: str = "dp"):
         """Serve under a ShardingPlan (docs/spmd.md): batch feeds shard
@@ -267,6 +290,20 @@ class Predictor:
                 # subgraph-deleting fusion
                 self.program = apply_pass(self.program, name,
                                           protected=set(self.fetch_names))
+        from .flags import get_flag as _gf
+        qm = config._quant_mode if config._quant_mode is not None \
+            else str(_gf("FLAGS_quant_mode"))
+        self._quant_mode = qm if qm in ("off", "int8") else "off"
+        if self._quant_mode != "off" and config._bf16:
+            raise ValueError(
+                "enable_quant and bf16 are mutually exclusive: the "
+                "bf16 cast would truncate the fp32 quant scales")
+        if self._quant_mode != "off":
+            from . import quant
+            from .monitor import gauge_set
+            saved = quant.quantize_program_weights(
+                self.program, self.scope, self._quant_mode)
+            gauge_set("GAUGE_quant_weight_bytes_saved", saved)
         if config._bf16:
             self._cast_params_bf16()
         self._feeds: Dict[str, np.ndarray] = {}
@@ -276,6 +313,15 @@ class Predictor:
         # compiles in the serving counters
         self._warm_sigs: set = set()
         self._plan = getattr(config, "_spmd_plan", None)
+
+    def _prog_tag(self, bucket: int) -> str:
+        """/programz tag for a bucketed execution — the quant mode is
+        appended ("predictor_b8_int8") so fp32 and quantized serving
+        never look alike in the accounting UI."""
+        tag = "predictor_b%d" % bucket
+        if self._quant_mode != "off":
+            tag += "_%s" % self._quant_mode
+        return tag
 
     def _plan_ctx(self):
         """Activate this predictor's plan (Config.enable_spmd) around
@@ -401,9 +447,11 @@ class Predictor:
             self._warm_sigs.add(sig)
             stat_add("STAT_predictor_bucket_cold")
         # ambient tag: an executor compile triggered here lands in
-        # /programz as predictor_b<bucket>_* instead of executor_*
+        # /programz as predictor_b<bucket>_* instead of executor_*;
+        # the quant mode rides the tag so a quantized predictor's
+        # programs are distinguishable at a glance
         from .core import program_accounting
-        with program_accounting.tag_scope("predictor_b%d" % target):
+        with program_accounting.tag_scope(self._prog_tag(target)):
             outs = self.exe.run(self.program, feed=padded,
                                 fetch_list=list(self.fetch_names),
                                 scope=self.scope)
@@ -456,7 +504,7 @@ class Predictor:
                 feeds[n] = np.zeros(tuple(shape), v.dtype)
             from .core import program_accounting
             with self._plan_ctx(), \
-                    program_accounting.tag_scope("predictor_b%d" % bkt):
+                    program_accounting.tag_scope(self._prog_tag(bkt)):
                 self.exe.run(self.program, feed=feeds,
                              fetch_list=list(self.fetch_names),
                              scope=self.scope)

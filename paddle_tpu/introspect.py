@@ -206,6 +206,11 @@ def statusz() -> Dict[str, Any]:
                 "entries": gauge_get("GAUGE_generation_prefix_entries"),
                 "blocks": gauge_get("GAUGE_generation_prefix_blocks"),
             },
+            # quantized serving (ISSUE 15): modes read from the flags
+            # (the configured deployment); the numeric gauges are
+            # published by the live engine, so a per-engine ctor
+            # override shows up in the numbers
+            "quant": _quant_status(counters),
         },
         "flight_recorder_steps": len(telemetry.flight_records()),
         "gangs": _gang_status(),
@@ -213,6 +218,24 @@ def statusz() -> Dict[str, Any]:
         "slo": _slo_status(),
         "failpoints_armed": _armed_failpoints(),
         "readiness": {"ready": ready, "checks": checks},
+    }
+
+
+def _quant_status(counters: Dict[str, Any]) -> Dict[str, Any]:
+    """The /statusz generation.quant section (docs/quantization.md):
+    quant mode + KV pool dtype, pool capacity in max-length sequences,
+    and the byte-saving gauges the engine publishes."""
+    from .flags import get_flag
+    from .monitor import gauge_get
+    return {
+        "mode": str(get_flag("FLAGS_quant_mode")),
+        "kv_dtype": str(get_flag("FLAGS_generation_kv_quant")),
+        "kv_capacity_seqs": gauge_get("GAUGE_kv_capacity_seqs"),
+        "kv_bytes_per_seq": gauge_get("GAUGE_kv_bytes_per_seq"),
+        "weight_bytes_saved": gauge_get(
+            "GAUGE_quant_weight_bytes_saved"),
+        "kv_quant_blocks": counters.get(
+            "STAT_generation_kv_quant_blocks", 0),
     }
 
 

@@ -81,6 +81,15 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _inv_grid(pool_dtype) -> float:
+    """1/GRID for a quantized pool's storage dtype — the dequant
+    constant of the shared absmax scale contract (paddle_tpu/quant):
+    stored * scale / GRID recovers the value. Derived from the pool
+    itself so callers never thread a mode string into the kernel."""
+    from ..quant import grid_for_dtype
+    return 1.0 / grid_for_dtype(pool_dtype)
+
+
 # ---------------------------------------------------------------------------
 # shared masked-softmax attention core (prefill AND decode use this)
 # ---------------------------------------------------------------------------
@@ -125,7 +134,8 @@ def attend_reference(q, k, v, mask, sm_scale):
 
 def ragged_paged_attention_reference(q, k_pool, v_pool, block_tables,
                                      q_lens, ctx_lens,
-                                     sm_scale: Optional[float] = None):
+                                     sm_scale: Optional[float] = None,
+                                     k_scales=None, v_scales=None):
     """Ragged gather-from-block-table attention in plain XLA.
 
     q `[B, Cq, H, D]`: row b holds `q_lens[b]` real queries at absolute
@@ -141,17 +151,34 @@ def ragged_paged_attention_reference(q, k_pool, v_pool, block_tables,
     behind the table), then runs the shared attend_reference core with
     Tq == Cq — the same ops and reduction shapes as full-context
     prefill, which is what makes the chunked path bitwise-comparable to
-    `forward_full` recompute (tests/test_kernels.py)."""
+    `forward_full` recompute (tests/test_kernels.py).
+
+    QUANTIZED KV (ISSUE 15): int8/fp8 pools ride with per-token-per-head
+    absmax scales `k_scales`/`v_scales` `[N, bs, H]` — the gather pulls
+    stored values AND scales through the same block table and
+    dequantizes (stored * scale / GRID) right at the softmax input, the
+    XLA-fused analog of the in-loop dequant in the Pallas kernel below.
+    `None` scales take the EXACT pre-quant expressions, keeping the
+    fp32 path bitwise-identical."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     b, cq, h, d = q.shape
     n, bs, _, _ = k_pool.shape
     m = block_tables.shape[1]
-    # [B, M, bs, H, D] -> [B, H, M*bs, D]
-    k = jnp.transpose(k_pool[block_tables], (0, 3, 1, 2, 4)
-                      ).reshape(b, h, m * bs, d)
-    v = jnp.transpose(v_pool[block_tables], (0, 3, 1, 2, 4)
-                      ).reshape(b, h, m * bs, d)
+    if k_scales is None:
+        # [B, M, bs, H, D] -> [B, H, M*bs, D]
+        k = jnp.transpose(k_pool[block_tables], (0, 3, 1, 2, 4)
+                          ).reshape(b, h, m * bs, d)
+        v = jnp.transpose(v_pool[block_tables], (0, 3, 1, 2, 4)
+                          ).reshape(b, h, m * bs, d)
+    else:
+        inv = _inv_grid(k_pool.dtype)
+        kg = k_pool[block_tables].astype(jnp.float32) \
+            * (k_scales[block_tables] * inv)[..., None]
+        vg = v_pool[block_tables].astype(jnp.float32) \
+            * (v_scales[block_tables] * inv)[..., None]
+        k = jnp.transpose(kg, (0, 3, 1, 2, 4)).reshape(b, h, m * bs, d)
+        v = jnp.transpose(vg, (0, 3, 1, 2, 4)).reshape(b, h, m * bs, d)
     pos = jnp.arange(m * bs, dtype=jnp.int32)
     qi = jnp.arange(cq, dtype=jnp.int32)
     # [B, Cq, L]: pool position visible to query j of row b
@@ -165,7 +192,8 @@ def ragged_paged_attention_reference(q, k_pool, v_pool, block_tables,
 
 
 def paged_attention_reference(q, k_pool, v_pool, block_tables, ctx_lens,
-                              sm_scale: Optional[float] = None):
+                              sm_scale: Optional[float] = None,
+                              k_scales=None, v_scales=None):
     """Single-token decode attention: the Cq == 1 specialization of the
     ragged path. ctx_lens here counts VISIBLE keys (position + 1), so
     the ragged call gets `ctx_lens - 1` keys-before-the-query and a
@@ -175,7 +203,8 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, ctx_lens,
     ctx = jnp.asarray(ctx_lens)
     out = ragged_paged_attention_reference(
         q[:, None], k_pool, v_pool, block_tables,
-        jnp.ones_like(ctx), ctx - 1, sm_scale)
+        jnp.ones_like(ctx), ctx - 1, sm_scale,
+        k_scales=k_scales, v_scales=v_scales)
     return out[:, 0]
 
 
@@ -242,14 +271,73 @@ def _ragged_kernel(tables_ref, qlens_ref, lens_ref, q_ref, k_ref, v_ref,
                                  (1, 0, 2)).astype(o_ref.dtype)
 
 
+def _ragged_kernel_quant(tables_ref, qlens_ref, lens_ref, q_ref, k_ref,
+                         v_ref, ks_ref, vs_ref, o_ref, acc_ref, m_ref,
+                         l_ref, *, block_size, sm_scale, num_blocks,
+                         inv_grid):
+    """Quantized-KV twin of _ragged_kernel: the block's int8/fp8 K/V
+    tile arrives in VMEM with its `[bs, H]` absmax scale rows (same
+    tbl[bi, mi] index maps), and dequant (stored * scale / GRID) runs
+    INSIDE the online-softmax loop — the fp32 KV never exists outside
+    this block's VMEM residency, which is the whole HBM win."""
+    b = pl.program_id(0)
+    mi = pl.program_id(1)
+
+    @pl.when(mi == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    ctx = lens_ref[b]
+    qlen = qlens_ref[b]
+
+    @pl.when(mi * block_size < ctx + qlen)
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * sm_scale      # [Cq, H, D]
+        # in-loop dequant: [bs, H, D] stored * [bs, H, 1] scale/GRID
+        k = k_ref[0].astype(jnp.float32) \
+            * (ks_ref[0].astype(jnp.float32) * inv_grid)[:, :, None]
+        v = v_ref[0].astype(jnp.float32) \
+            * (vs_ref[0].astype(jnp.float32) * inv_grid)[:, :, None]
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((1,), (1,))),
+            preferred_element_type=jnp.float32)
+        pos = mi * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 2)
+        qi = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where((pos <= ctx + qi) & (qi < qlen), s, NEG_INF)
+        m_prev = m_ref[...]                              # [H, Cq]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2))
+        p = jnp.exp(s - m_new[:, :, None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=2)
+        m_ref[...] = m_new
+        pv = jax.lax.dot_general(
+            p, v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha[:, :, None] + pv
+
+    @pl.when(mi == num_blocks - 1)
+    def _finish():
+        l = l_ref[...]
+        l_safe = jnp.where(l <= 0.0, 1.0, l)
+        o_ref[0] = jnp.transpose(acc_ref[...] / l_safe[:, :, None],
+                                 (1, 0, 2)).astype(o_ref.dtype)
+
+
 def ragged_paged_attention_pallas(q, k_pool, v_pool, block_tables,
                                   q_lens, ctx_lens,
                                   sm_scale: Optional[float] = None,
-                                  interpret: Optional[bool] = None):
+                                  interpret: Optional[bool] = None,
+                                  k_scales=None, v_scales=None):
     """Blocked ragged kernel: same grid over (sequence, pool block) as
     the decode kernel, but each VMEM tile scores the whole Cq-wide
     chunk against one resident block, so prefill chunks and decode
-    singles share one executable shape."""
+    singles share one executable shape. Quantized pools (k_scales /
+    v_scales given) route to the _ragged_kernel_quant twin — the fp32
+    kernel is untouched so the quant-off executable stays identical."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
@@ -257,19 +345,39 @@ def ragged_paged_attention_pallas(q, k_pool, v_pool, block_tables,
     b, cq, h, d = q.shape
     _, bs, _, _ = k_pool.shape
     m = block_tables.shape[1]
+    in_specs = [
+        pl.BlockSpec((1, cq, h, d),
+                     lambda bi, mi, tbl, qls, lens: (bi, 0, 0, 0)),
+        pl.BlockSpec(
+            (1, bs, h, d),
+            lambda bi, mi, tbl, qls, lens: (tbl[bi, mi], 0, 0, 0)),
+        pl.BlockSpec(
+            (1, bs, h, d),
+            lambda bi, mi, tbl, qls, lens: (tbl[bi, mi], 0, 0, 0)),
+    ]
+    operands = [q, k_pool, v_pool]
+    if k_scales is not None:
+        # scale rows ride the SAME block-table index map as their
+        # payload tile, one [bs, H] row set per resident block
+        in_specs += [
+            pl.BlockSpec(
+                (1, bs, h),
+                lambda bi, mi, tbl, qls, lens: (tbl[bi, mi], 0, 0)),
+            pl.BlockSpec(
+                (1, bs, h),
+                lambda bi, mi, tbl, qls, lens: (tbl[bi, mi], 0, 0)),
+        ]
+        operands += [k_scales, v_scales]
+        kern = functools.partial(
+            _ragged_kernel_quant, block_size=bs, sm_scale=sm_scale,
+            num_blocks=m, inv_grid=_inv_grid(k_pool.dtype))
+    else:
+        kern = functools.partial(_ragged_kernel, block_size=bs,
+                                 sm_scale=sm_scale, num_blocks=m)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,  # block_tables, q_lens, ctx_lens
         grid=(b, m),
-        in_specs=[
-            pl.BlockSpec((1, cq, h, d),
-                         lambda bi, mi, tbl, qls, lens: (bi, 0, 0, 0)),
-            pl.BlockSpec(
-                (1, bs, h, d),
-                lambda bi, mi, tbl, qls, lens: (tbl[bi, mi], 0, 0, 0)),
-            pl.BlockSpec(
-                (1, bs, h, d),
-                lambda bi, mi, tbl, qls, lens: (tbl[bi, mi], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, cq, h, d),
             lambda bi, mi, tbl, qls, lens: (bi, 0, 0, 0)),
@@ -279,27 +387,27 @@ def ragged_paged_attention_pallas(q, k_pool, v_pool, block_tables,
             pltpu.VMEM((h, cq), jnp.float32),      # running denom
         ],
     )
-    kern = functools.partial(_ragged_kernel, block_size=bs,
-                             sm_scale=sm_scale, num_blocks=m)
     return pl.pallas_call(
         kern,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, cq, h, d), q.dtype),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), q_lens.astype(jnp.int32),
-      ctx_lens.astype(jnp.int32), q, k_pool, v_pool)
+      ctx_lens.astype(jnp.int32), *operands)
 
 
 def paged_attention_pallas(q, k_pool, v_pool, block_tables, ctx_lens,
                            sm_scale: Optional[float] = None,
-                           interpret: Optional[bool] = None):
+                           interpret: Optional[bool] = None,
+                           k_scales=None, v_scales=None):
     """Single-token decode kernel: Cq == 1 delegation to the ragged
     kernel (same visible-count ctx_lens convention as the reference
     specialization above)."""
     ctx = jnp.asarray(ctx_lens)
     out = ragged_paged_attention_pallas(
         q[:, None], k_pool, v_pool, block_tables,
-        jnp.ones_like(ctx), ctx - 1, sm_scale, interpret)
+        jnp.ones_like(ctx), ctx - 1, sm_scale, interpret,
+        k_scales=k_scales, v_scales=v_scales)
     return out[:, 0]
 
 
@@ -308,30 +416,42 @@ def paged_attention_pallas(q, k_pool, v_pool, block_tables, ctx_lens,
 # ---------------------------------------------------------------------------
 
 def paged_attention(q, k_pool, v_pool, block_tables, ctx_lens,
-                    sm_scale: Optional[float] = None):
+                    sm_scale: Optional[float] = None,
+                    k_scales=None, v_scales=None):
     """Decode-step attention over the paged KV pool. Routed by
     FLAGS_paged_attention_kernel (a lowering flag: it is baked into
     every generation compile key): "reference" is the bitwise parity
-    path; "pallas" runs the blocked kernel (interpret mode off-TPU)."""
+    path; "pallas" runs the blocked kernel (interpret mode off-TPU).
+    k_scales/v_scales (quantized pools, paddle_tpu/quant) flow to the
+    dequant-fused forms of both paths; None = the untouched fp32
+    path."""
     from ..flags import get_flag
     mode = get_flag("FLAGS_paged_attention_kernel")
     if mode == "pallas" and _HAS_PLTPU:
         return paged_attention_pallas(q, k_pool, v_pool, block_tables,
-                                      ctx_lens, sm_scale)
+                                      ctx_lens, sm_scale,
+                                      k_scales=k_scales,
+                                      v_scales=v_scales)
     return paged_attention_reference(q, k_pool, v_pool, block_tables,
-                                     ctx_lens, sm_scale)
+                                     ctx_lens, sm_scale,
+                                     k_scales=k_scales,
+                                     v_scales=v_scales)
 
 
 def ragged_paged_attention(q, k_pool, v_pool, block_tables, q_lens,
-                           ctx_lens, sm_scale: Optional[float] = None):
+                           ctx_lens, sm_scale: Optional[float] = None,
+                           k_scales=None, v_scales=None):
     """Mixed prefill+decode attention over the paged KV pool: q
     `[B, Cq, H, D]` with per-row true query length (1 = decode, chunk
     width = prefill). Routed by the same FLAGS_paged_attention_kernel
-    seam as the decode entry."""
+    seam as the decode entry; k_scales/v_scales select the
+    quantized-KV dequant-fused forms."""
     from ..flags import get_flag
     mode = get_flag("FLAGS_paged_attention_kernel")
     if mode == "pallas" and _HAS_PLTPU:
         return ragged_paged_attention_pallas(
-            q, k_pool, v_pool, block_tables, q_lens, ctx_lens, sm_scale)
+            q, k_pool, v_pool, block_tables, q_lens, ctx_lens, sm_scale,
+            k_scales=k_scales, v_scales=v_scales)
     return ragged_paged_attention_reference(
-        q, k_pool, v_pool, block_tables, q_lens, ctx_lens, sm_scale)
+        q, k_pool, v_pool, block_tables, q_lens, ctx_lens, sm_scale,
+        k_scales=k_scales, v_scales=v_scales)

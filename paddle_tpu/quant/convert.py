@@ -1,0 +1,73 @@
+"""Checkpoint conversion CLI (docs/quantization.md).
+
+    python -m paddle_tpu.quant.convert --in ckpt.npz --out q.npz \
+        --mode int8
+
+Converts a flat fp32 decoder checkpoint (generation/model.py layout,
+npz of name -> array) to the quantized serving layout: per-channel
+int8 (or fp8-e4m3 where supported) weights + `<name>::scale` fp32
+absmax arrays, saved with the mode stamped in so
+GenerationEngine(params, quant_mode=...) and load_quantized() agree.
+
+--demo skips the input and converts a freshly initialized demo decoder
+(the bench/test model) so the CLI is runnable end to end in this
+container. --from-qat treats the input as a contrib/slim export
+(`<name>.quant_scale` naming) and adapts it losslessly instead of
+re-quantizing.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from . import (from_qat, load_quantized, quantize_decoder_params,
+               save_quantized, supports_fp8, weight_bytes_saved)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="convert an fp32 checkpoint to the quantized "
+                    "serving layout")
+    p.add_argument("--in", dest="inp", default=None,
+                   help="input npz checkpoint (name -> fp32 array)")
+    p.add_argument("--out", required=True, help="output npz path")
+    p.add_argument("--mode", default="int8", choices=("int8", "fp8"))
+    p.add_argument("--from-qat", action="store_true",
+                   help="input uses contrib/slim '<name>.quant_scale' "
+                        "naming; adapt scales verbatim (lossless)")
+    p.add_argument("--demo", action="store_true",
+                   help="ignore --in; convert a freshly initialized "
+                        "demo decoder (DecoderConfig defaults)")
+    ns = p.parse_args(argv)
+
+    if ns.mode == "fp8" and not supports_fp8():
+        print("fp8-e4m3 unsupported by this jax build/backend; "
+              "use --mode int8", file=sys.stderr)
+        return 2
+
+    if ns.demo:
+        from ..generation.model import DecoderConfig, init_params
+        params = init_params(DecoderConfig(), seed=0)
+    elif ns.inp:
+        data = np.load(ns.inp, allow_pickle=False)
+        params = {k: data[k] for k in data.files
+                  if k != "__quant_mode__"}
+    else:
+        p.error("--in or --demo is required")
+
+    if ns.from_qat:
+        q = from_qat(params, ns.mode)
+    else:
+        q = quantize_decoder_params(params, ns.mode)
+    save_quantized(ns.out, q, ns.mode)
+    back, mode = load_quantized(ns.out)
+    assert mode == ns.mode and len(back) == len(q)
+    print("wrote %s: %d arrays, mode=%s, weight bytes saved=%d"
+          % (ns.out, len(q), mode, weight_bytes_saved(q)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

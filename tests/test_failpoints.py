@@ -602,6 +602,11 @@ def test_reset_engine_retracts_every_occupancy_gauge(gparams):
             assert gauge_get("GAUGE_generation_prefix_entries") == 1
         gauge_set("GAUGE_generation_active_seqs", 2)
         assert gauge_get("GAUGE_generation_blocks_used") == 3
+        # poison the quant gauges too: the reset must re-derive them
+        # from surviving engine state (fp32 here -> saved == 0)
+        gauge_set("GAUGE_quant_weight_bytes_saved", 999)
+        gauge_set("GAUGE_kv_bytes_per_seq", -1)
+        gauge_set("GAUGE_kv_capacity_seqs", -1)
         pool._reset_engine()
         assert gauge_get("GAUGE_generation_blocks_free") == \
             eng.kv.num_blocks - 1
@@ -611,6 +616,11 @@ def test_reset_engine_retracts_every_occupancy_gauge(gparams):
         assert gauge_get("GAUGE_kv_blocks_saved") == 0
         assert gauge_get("GAUGE_generation_prefix_entries") == 0
         assert gauge_get("GAUGE_generation_prefix_blocks") == 0
+        assert gauge_get("GAUGE_quant_weight_bytes_saved") == 0
+        assert gauge_get("GAUGE_kv_bytes_per_seq") == \
+            eng.kv_bytes_per_seq()
+        assert gauge_get("GAUGE_kv_capacity_seqs") == \
+            eng.kv_capacity_seqs()
     finally:
         pool.close()
 
@@ -714,5 +724,76 @@ def test_generation_pool_recovers_mid_prompt_chunk_fault(flag_guard,
         assert out is not None
         assert out.tokens == base.tokens
         assert stat_get("STAT_generation_restarts") == r0 + 1
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# quantized-KV failpoint (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+def test_kv_quant_fault_aborts_cleanly_and_resumes(gparams):
+    """generation.kv_quant fires before the mixed step's compiled call
+    quantizes this step's K/V rows — and before ANY state mutation, so
+    a caught fault re-steps to the identical stream. The site only
+    exists on the quantized path: an fp32 engine never calls it."""
+    assert "generation.kv_quant" in failpoints.KNOWN_SITES
+
+    def reqs():
+        return [GenerationRequest(request_id=i, prompt=[i + 1] * 6,
+                                  max_new_tokens=6,
+                                  sampling=SamplingParams(seed=i))
+                for i in range(2)]
+
+    clean = _gengine(gparams, prefill_chunk=4, quant_mode="int8")
+    want = {r.request_id: r.tokens for r in clean.generate(reqs())}
+
+    eng = _gengine(gparams, prefill_chunk=4, quant_mode="int8")
+    for r in reqs():
+        eng.submit(r)
+    failpoints.arm_spec("generation.kv_quant=raise@every(3)")
+    faults, out, steps = 0, [], 0
+    try:
+        while not eng.idle and steps < 1000:
+            steps += 1
+            try:
+                out.extend(eng.step())
+            except InjectedFault:
+                faults += 1  # re-step: nothing was mutated
+    finally:
+        failpoints.disarm("generation.kv_quant")
+    assert eng.idle and faults > 0
+    assert {r.request_id: r.tokens for r in out} == want
+    # the quantize path really ran (blocks counted through it)
+    assert stat_get("STAT_generation_kv_quant_blocks") > 0
+
+    # fp32 engines never reach the site: armed 'raise' cannot fire
+    fp32 = _gengine(gparams, prefill_chunk=4)
+    failpoints.arm_spec("generation.kv_quant=raise")
+    try:
+        res = fp32.generate(reqs())
+    finally:
+        failpoints.disarm("generation.kv_quant")
+    assert {r.request_id: r.tokens for r in res} == want
+
+
+def test_reset_engine_retracts_quant_gauges_for_quantized_engine(
+        gparams):
+    """A QUANTIZED engine rebuilt by the supervisor must republish its
+    true quant gauges (nonzero saved bytes, quantized kv_bytes_per_seq)
+    — retraction means re-derivation, not zeroing."""
+    eng = _gengine(gparams, prefill_chunk=4, quant_mode="int8",
+                   num_blocks=16)
+    pool = GenerationPool(eng, _start=False)
+    try:
+        saved = gauge_get("GAUGE_quant_weight_bytes_saved")
+        per_seq = gauge_get("GAUGE_kv_bytes_per_seq")
+        assert saved > 0
+        gauge_set("GAUGE_quant_weight_bytes_saved", 1)
+        gauge_set("GAUGE_kv_bytes_per_seq", 1)
+        pool._reset_engine()
+        assert gauge_get("GAUGE_quant_weight_bytes_saved") == saved
+        assert gauge_get("GAUGE_kv_bytes_per_seq") == per_seq
+        assert per_seq == eng.kv_bytes_per_seq()
     finally:
         pool.close()

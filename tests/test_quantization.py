@@ -345,3 +345,52 @@ def test_freeze_keeps_second_tier_dequantized():
     # frozen output stays in the float domain, close to the QAT output
     np.testing.assert_allclose(np.asarray(frozen), np.asarray(ref),
                                atol=0.2, rtol=0.2)
+
+
+def test_freeze_scale_roundtrip_with_zero_channel():
+    """ISSUE-15 satellite: the exported .quant_scale must equal the
+    divisor the freeze pass ACTUALLY used. An all-zero output channel
+    used to export scale 0.0 while its weights were quantized with the
+    1e-6 guard — export -> serving load silently diverged. Pins the
+    shared contract (paddle_tpu/quant): dequantizing the stored
+    int-grid weight with the STORED scale reproduces the fp32 weight
+    to grid precision, dead channels included, and quant.from_qat
+    carries the scales over verbatim (lossless)."""
+    from paddle_tpu import quant
+
+    main, startup = pt.Program(), pt.Program()
+    rng = np.random.RandomState(7)
+    _build_fc_net(main, startup, rng)
+    scope = pt.Scope()
+    tp = QuantizationTransformPass(scope=scope, startup_program=startup)
+    tp.apply(main)
+    exe = pt.Executor()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        wname = next(
+            n for n in scope.local_names()
+            if getattr(scope.find_var(n), "ndim", 0) == 2
+            and np.asarray(scope.find_var(n)).shape[0] == 8)
+        w0 = np.array(np.asarray(scope.find_var(wname)), np.float32)
+        w0[:, 0] = 0.0                     # an entirely dead channel
+        scope.set(wname, w0)
+        infer = main.clone(for_test=True)
+        QuantizationFreezePass(scope=scope).apply(infer)
+        wq = np.asarray(scope.find_var(wname))
+        s = np.asarray(scope.find_var(wname + ".quant_scale"))
+    assert s.shape == (w0.shape[1],)
+    assert np.all(s > 0), "guard value must be STORED, not just used"
+    # round trip under the shared contract: w ~= q * s / 127
+    back = wq * s[None, :] / 127.0
+    np.testing.assert_allclose(back, w0,
+                               atol=float(s.max()) / 254 + 1e-9)
+    assert np.all(wq[:, 0] == 0) and np.all(back[:, 0] == 0)
+    # serving-side adapter: scales verbatim, dequant identical
+    served = quant.from_qat({wname: wq,
+                             wname + ".quant_scale": s})
+    np.testing.assert_array_equal(
+        np.asarray(served[wname + quant.SCALE_SUFFIX]), s)
+    np.testing.assert_allclose(
+        np.asarray(quant.dequantize_array(
+            served[wname], served[wname + quant.SCALE_SUFFIX], 1)),
+        back, rtol=0, atol=1e-6)

@@ -39,8 +39,13 @@ COST_SUFFIXES = ("_sync", "_miss", "_corrupt", "_evict", "_dropped",
 # STAT_<kind>_shed_at_admit, STAT_<kind>_restarts /
 # _restart_exhausted — shed and restart events are always costs, for
 # any pool kind (serving pools and launch gangs alike), so match on
-# substring rather than enumerating kinds
-COST_INFIXES = ("_shed_", "_restart")
+# substring rather than enumerating kinds. The quant family
+# (docs/quantization.md) rides along: STAT_generation_kv_quant_blocks
+# counts pool blocks written through the quantize path, so any growth
+# in a quant-OFF baseline run means the fp32 path silently started
+# quantizing — a correctness regression the percentage gate must flag
+# regardless of magnitude.
+COST_INFIXES = ("_shed_", "_restart", "_kv_quant_")
 
 
 def _family(name: str) -> str:
