@@ -1861,6 +1861,161 @@ def _spawn_spmd(timeout=900):
     return None
 
 
+def bench_autotune():
+    """adaptive kernel dispatch block (ISSUE 16, docs/autotune.md):
+    the auto-tuned ragged-step geometry vs (a) the WORST candidate the
+    tuner verified eligible and (b) the hand-set flag defaults, over a
+    prompt-heavy request stream (the regime where chunk geometry
+    dominates: ~70-token prompts stream through the mixed step a chunk
+    at a time, so a 4x larger chunk cuts prefill step count ~4x).
+
+    Gates (ISSUE 16 acceptance): tuned >= 1.15x generated tokens/s vs
+    the worst eligible candidate AND >= 1.0x vs the defaults; streams
+    bitwise-identical across all three forms keyed by request_id; zero
+    steady-state recompiles after the tuning phase, INCLUDING across a
+    simulated process restart that reloads the persisted policy (zero
+    new trials, zero trace-cache misses, identical streams). Passes
+    interleave tuned/defaults/worst per round with best-of-N per
+    engine — same honest-margin methodology as the PR-10 mixed block."""
+    import tempfile
+    from dataclasses import replace
+    from paddle_tpu import autotune
+    from paddle_tpu.generation import (DecoderConfig, GenerationEngine,
+                                       GenerationRequest,
+                                       SamplingParams, init_params)
+    from paddle_tpu.monitor import stat_get
+
+    cfg = DecoderConfig(vocab_size=128, hidden=64, layers=2, heads=4,
+                        max_seq_len=128)
+    params = init_params(cfg, seed=0)
+    cache = tempfile.mkdtemp(prefix="pt_autotune_bench_")
+
+    # One request set PER PASS: re-draining identical prompts would
+    # hit the engines' prefix caches from pass 2 on and measure the
+    # cache-hit regime, where prefill geometry is irrelevant — real
+    # serving sees distinct prompts, and distinct prompts are what the
+    # tuner's probe optimizes for
+    R, PASSES = 24, 4
+
+    def mkreqs(seed):
+        rng = np.random.RandomState(seed)
+        return [GenerationRequest(
+            prompt=list(rng.randint(1, cfg.vocab_size,
+                                    size=int(rng.randint(60, 91)))),
+            max_new_tokens=int(rng.randint(4, 9)),
+            sampling=SamplingParams(
+                temperature=0.8 if i % 2 else 0.0,
+                top_k=16 if i % 3 == 0 else 0, seed=i),
+            request_id=i) for i in range(R)]
+
+    pass_reqs = [mkreqs(11 + p) for p in range(PASSES)]
+
+    mk = lambda **kw: GenerationEngine(  # noqa: E731
+        cfg, params, num_blocks=256, decode_width=8,
+        program_cache_dir=cache, **kw)
+
+    # --- tuning phase: one resolve searches the geometry space ------
+    autotune.reset()
+    t_tune0 = stat_get("STAT_autotune_trials")
+    tuned_eng = mk(autotune=True)
+    entry = tuned_eng._policy_entry
+    if entry is None:
+        return {"error": "tuning did not complete (reference trial "
+                         "failed)"}
+    eligible = [c for c in entry["candidates"]
+                if c.get("eligible") and "us_per_token" in c]
+    worst = max(eligible, key=lambda c: c["us_per_token"])
+    defaults = entry["candidates"][0]  # reference form == flag defaults
+    # when the tuner confirms the hand-set defaults ARE the optimum
+    # (common on CPU, where chunk sizes >= the decode width plateau),
+    # tuned and defaults are the SAME form — one engine serves both
+    # roles and the ratio is 1.0 by identity, not a noise coin-flip
+    # measured between two copies of the same executable
+    tuned_is_defaults = entry["label"] == defaults["label"]
+
+    def pinned(c):
+        return mk(autotune=False, kernel=c["kernel"],
+                  block_size=c["block_size"],
+                  prefill_chunk=c["prefill_chunk"],
+                  token_budget=c["token_budget"])
+
+    defaults_eng = tuned_eng if tuned_is_defaults else pinned(defaults)
+    worst_eng = pinned(worst)
+    for e in (tuned_eng, defaults_eng, worst_eng):
+        e.warmup()
+
+    def run_pass(eng, reqs):
+        for r in reqs:
+            eng.submit(replace(r))
+        done = []
+        t0 = time.perf_counter()
+        while not eng.idle:
+            done.extend(eng.step())
+        wall = time.perf_counter() - t0
+        return wall, {res.request_id: tuple(res.tokens)
+                      for res in done}
+
+    # interleaved best-of-N: every engine samples every drift window;
+    # throughput per pass uses that pass's own token count, best-of
+    # over passes per engine
+    pass_new = [sum(r.max_new_tokens for r in rs) for rs in pass_reqs]
+    c0 = stat_get("STAT_generation_compile")
+    best_tps = {}
+    streams = {}  # name -> list of per-pass {request_id: tokens}
+    for p in range(PASSES):
+        for name, eng in (("tuned", tuned_eng),
+                          ("defaults", defaults_eng),
+                          ("worst_eligible", worst_eng)):
+            wall, st = run_pass(eng, pass_reqs[p])
+            t = pass_new[p] / wall
+            if t > best_tps.get(name, 0.0):
+                best_tps[name] = t
+            streams.setdefault(name, []).append(st)
+    recompiles = int(stat_get("STAT_generation_compile") - c0)
+    bitwise = (streams["tuned"] == streams["defaults"]
+               == streams["worst_eligible"])
+    tps = {n: round(t, 1) for n, t in best_tps.items()}
+
+    # --- restart: reload the persisted policy, recompile nothing ----
+    autotune.reset()
+    t0 = stat_get("STAT_autotune_trials")
+    m0 = stat_get("STAT_program_cache_trace_miss")
+    r_eng = mk(autotune=True)
+    r_eng.warmup()
+    _, r_streams = run_pass(r_eng, pass_reqs[0])
+    restart = {
+        "policy_source": (r_eng._policy_entry or {}).get("source"),
+        "retune_trials": int(stat_get("STAT_autotune_trials") - t0),
+        "trace_cache_misses": int(
+            stat_get("STAT_program_cache_trace_miss") - m0),
+        "streams_bitwise_identical": r_streams == streams["tuned"][0],
+    }
+
+    vs_worst = round(tps["tuned"] / tps["worst_eligible"], 2)
+    vs_defaults = 1.0 if tuned_is_defaults \
+        else round(tps["tuned"] / tps["defaults"], 2)
+    return {
+        "workload": "decoder L%d-H%d: %d fresh requests/pass x %d "
+                    "passes, prompts 60..90, ~%d new tokens/pass, "
+                    "width 8" % (cfg.layers, cfg.hidden, R, PASSES,
+                                 pass_new[0]),
+        "tuning": {"winner": entry["label"],
+                   "trials": int(stat_get("STAT_autotune_trials")
+                                 - t_tune0),
+                   "tuned_s": entry["tuned_s"],
+                   "candidates": entry["candidates"]},
+        "tokens_per_sec": tps,
+        "speedup_tuned_vs_worst_eligible": vs_worst,
+        "speedup_tuned_vs_defaults": vs_defaults,
+        "tuned_is_defaults_form": bool(tuned_is_defaults),
+        "meets_1p15x_vs_worst": vs_worst >= 1.15,
+        "meets_1p0x_vs_defaults": vs_defaults >= 1.0,
+        "tokens_bitwise_identical": bool(bitwise),
+        "steady_state_recompiles": recompiles,
+        "restart": restart,
+    }
+
+
 def bench_spmd():
     """spmd block (ISSUE 6): dp/mp scaling + loss parity of the
     mesh-native runtime, measured in a subprocess that owns the 8 fake
@@ -2423,6 +2578,12 @@ def _run_worker(backend):
         # greedy stream agreement, zero steady-state recompiles
         # (ISSUE 15 — error and capacity are real on CPU too)
         rec["quantized_serving"] = bench_quantized_serving()
+    if not os.environ.get("PT_SKIP_AUTOTUNE_BENCH"):
+        # adaptive kernel dispatch: tuned geometry >= 1.15x tokens/s
+        # vs the worst eligible candidate and >= 1.0x vs the flag
+        # defaults, bitwise streams across forms, zero steady-state
+        # recompiles incl. across a policy-reload restart (ISSUE 16)
+        rec["autotune"] = bench_autotune()
     if not os.environ.get("PT_SKIP_SPMD_BENCH"):
         # mesh-native SPMD runtime: dp scaling + dp4xmp2 loss parity on
         # 8 fake CPU devices; subprocess-isolated because the virtual

@@ -107,6 +107,11 @@ class ProgramRecord:
             # /programz answer to "which checkpoint flavor compiled
             # this" without digging through meta
             "quant": str(self.meta.get("qm", "off")),
+            # autotune policy label the program was traced under
+            # (engine meta carries policy=; untuned / legacy programs
+            # report "") — the /programz answer to "which tuned
+            # geometry compiled this" (docs/autotune.md)
+            "policy": str(self.meta.get("policy", "")),
             "flops": self.flops,
             "transcendentals": self.transcendentals,
             "bytes_accessed": self.bytes_accessed,

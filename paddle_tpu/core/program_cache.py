@@ -11,6 +11,12 @@ share one directory (FLAGS_program_cache_dir, default
       Program.fingerprint() (op descs/attrs + feed/state signatures +
       lowering-relevant FLAGS + jax/backend versions + a framework
       source token). A hit skips the Python retrace entirely.
+  <dir>/policy/<fingerprint>.json
+      autotune's winning dispatch forms (paddle_tpu/autotune.py,
+      docs/autotune.md) — one JSON entry per (shape-bucket, backend,
+      quant-mode) key, version-stamped and self-healing like the
+      trace layer, so a tuned deployment restarts straight into its
+      winning geometry with zero re-tuning and zero recompiles.
   <dir>/xla/
       jax's persistent compilation cache — XLA binaries keyed by HLO.
       Both the cold and the warm path execute the SAME deserialized
@@ -254,6 +260,132 @@ def has_trace(cache_dir: str, fingerprint: str) -> bool:
             len(MAGIC)
     except OSError:
         return False
+
+
+# ---------------------------------------------------------------------------
+# autotune policy sidecar (paddle_tpu/autotune.py, docs/autotune.md):
+# <dir>/policy/<fingerprint>.json holds the winning dispatch form for
+# one (shape-bucket, backend, quant-mode) key — same MAGIC + JSON
+# header + atomic-replace + corrupt-entry-self-heal recipe as the
+# trace layer, so a damaged or version-skewed policy file is deleted
+# and the key simply re-tunes (never a crash, never a stale form).
+# ---------------------------------------------------------------------------
+
+POLICY_MAGIC = b"PTPOL1\n"
+POLICY_FORMAT_VERSION = 1
+
+# The knobs autotune searches. They are EXCLUDED from the policy
+# fingerprint's lowering snapshot: the policy's job is to choose them,
+# so keying the policy on their current values would fragment the key
+# space (every flag flip would look like a new deployment). A pinned
+# tuned flag still isolates correctly — pins ride the key meta itself
+# (autotune.py puts them there), not the flag snapshot.
+TUNED_FLAGS = ("FLAGS_paged_attention_kernel",)
+
+
+def _policy_path(cache_dir: str, fingerprint: str) -> str:
+    return os.path.join(cache_dir, "policy", fingerprint + ".json")
+
+
+def policy_fingerprint(meta: dict) -> str:
+    """Disk key for one autotune policy entry: sha256 over the
+    caller's key metadata (shape-bucket, backend, quant-mode, pins) +
+    the NON-tuned lowering flags + jax/jaxlib/backend versions + the
+    framework source token — the fn_fingerprint invalidation surface
+    minus the knobs the policy itself chooses (TUNED_FLAGS)."""
+    import jax
+    import jaxlib
+    from ..flags import lowering_snapshot
+    flags = tuple(kv for kv in lowering_snapshot()
+                  if kv[0] not in TUNED_FLAGS)
+    h = hashlib.sha256()
+    h.update(json.dumps({
+        "tag": "autotune_policy",
+        "meta": meta,
+        "flags": flags,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": jax.default_backend(),
+        "framework": framework_token(),
+    }, sort_keys=True, default=str).encode())
+    return h.hexdigest()
+
+
+def _policy_header(fingerprint: str) -> bytes:
+    import jax
+    import jaxlib
+    return json.dumps({
+        "format": POLICY_FORMAT_VERSION,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "fingerprint": fingerprint,
+    }, sort_keys=True).encode() + b"\n"
+
+
+def load_policy(cache_dir: str, fingerprint: str) -> Optional[dict]:
+    """Return the persisted policy entry dict for `fingerprint`, or
+    None on miss. Malformed / truncated / version-skewed files are
+    deleted (STAT_program_cache_corrupt) so the key re-tunes cleanly —
+    the same self-heal contract as load_trace."""
+    path = _policy_path(cache_dir, fingerprint)
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        return None
+    try:
+        if not blob.startswith(POLICY_MAGIC):
+            raise ValueError("bad magic")
+        rest = blob[len(POLICY_MAGIC):]
+        nl = rest.index(b"\n")
+        hdr = json.loads(rest[:nl])
+        import jax
+        import jaxlib
+        if (hdr.get("format") != POLICY_FORMAT_VERSION
+                or hdr.get("jax") != jax.__version__
+                or hdr.get("jaxlib") != jaxlib.__version__
+                or hdr.get("fingerprint") != fingerprint):
+            raise ValueError("header mismatch")
+        entry = json.loads(rest[nl + 1:])
+        if not isinstance(entry, dict):
+            raise ValueError("payload not a dict")
+    except (ValueError, KeyError):
+        _stat_add("STAT_program_cache_corrupt")
+        discard_policy(cache_dir, fingerprint)
+        return None
+    return entry
+
+
+def store_policy(cache_dir: str, fingerprint: str, entry: dict) -> bool:
+    """Atomically publish a policy entry (temp file + os.replace).
+    IO failure means no persistence this time — never an error."""
+    path = _policy_path(cache_dir, fingerprint)
+    blob = POLICY_MAGIC + _policy_header(fingerprint) \
+        + json.dumps(entry, sort_keys=True, default=str).encode()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   prefix=".tmp_" + fingerprint[:16])
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except OSError:
+        return False
+    return True
+
+
+def discard_policy(cache_dir: str, fingerprint: str) -> None:
+    try:
+        os.unlink(_policy_path(cache_dir, fingerprint))
+    except OSError:
+        pass
 
 
 def fn_fingerprint(tag: str, meta: dict) -> str:

@@ -93,6 +93,12 @@ KNOWN_SITES: Tuple[str, ...] = (
     "dist.rendezvous",
     "worker.heartbeat",
     "worker.step",
+    # ISSUE 16: adaptive dispatch candidate trial (autotune.py) —
+    # fires before a trial engine is built / a trial form runs. Fault
+    # on a non-reference candidate discards just that candidate; fault
+    # on the reference trial aborts the tune with NOTHING persisted
+    # (the policy cache is never poisoned by a half-measured search)
+    "autotune.measure",
 )
 
 

@@ -261,9 +261,36 @@ _DEFS: Dict[str, Any] = {
     # per-token-per-head absmax scales alongside and dequantize inside
     # the online-softmax loop of kernels/paged_attention.py.
     "FLAGS_generation_kv_quant": "auto",
+    # adaptive kernel dispatch (paddle_tpu/autotune.py,
+    # docs/autotune.md): once per (shape-bucket, backend, quant-mode)
+    # key, benchmark candidate forms (kernel form x mixed-step
+    # geometry), keep only candidates whose token streams are
+    # bitwise-identical to the reference form, pick the winner by
+    # measured step time, and persist it in the program cache's
+    # policy/ sidecar. OFF by default; when on, the four geometry
+    # flags below become PINS (override precedence: explicitly-set
+    # flags / ctor args > persisted policy > defaults — MIGRATION.md):
+    #   FLAGS_paged_attention_kernel, FLAGS_generation_block_size,
+    #   FLAGS_generation_prefill_chunk, FLAGS_generation_token_budget
+    "FLAGS_autotune": False,
+    # candidate budget: how many forms one tune may trial (the
+    # reference/default form is always candidate #1; the Pallas kernel
+    # form is ordered last, so small budgets search geometry only)
+    "FLAGS_autotune_candidates": 4,
+    # probe workload scale: total generated tokens the deterministic
+    # trial workload asks for (split over a handful of requests with a
+    # prompt-length spread)
+    "FLAGS_autotune_probe_tokens": 32,
 }
 
 _values: Dict[str, Any] = dict(_DEFS)
+
+# Names the user has ever passed through set_flags(). The autotune
+# override precedence (docs/autotune.md: explicit flags > persisted
+# policy > defaults) needs to distinguish "the operator pinned
+# FLAGS_generation_block_size" from "it still holds its default" —
+# the VALUE cannot tell them apart.
+_EXPLICIT: set = set()
 
 # Flags read DURING op lowering: their value is baked into the traced
 # computation, so every compilation cache key (the Executor's in-memory
@@ -307,6 +334,7 @@ def set_flags(flags: Dict[str, Any]) -> None:
             raise ValueError("unknown flag %r (known: %d flags)"
                              % (k, len(_values)))
         _values[k] = v
+        _EXPLICIT.add(k)
         if k == "FLAGS_failpoints" and v:
             # arm the registry from the spec as a side effect — the
             # natural scripting surface (set_flags is how every other
@@ -337,6 +365,25 @@ def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, Any]:
 
 def get_flag(name: str, default: Any = None) -> Any:
     return _values.get(_canon(name), default)
+
+
+def explicitly_set(name: str) -> bool:
+    """True when the flag was ever driven through set_flags() — i.e.
+    the operator pinned it, as opposed to it holding its default.
+    Autotune (docs/autotune.md) treats explicitly-set geometry flags
+    as candidate PINS the policy may not override."""
+    return _canon(name) in _EXPLICIT
+
+
+def clear_explicit(*names: str) -> None:
+    """Forget that the given flags (all, when none given) were
+    explicitly set — test/tooling helper so a set_flags restore does
+    not pin autotune forever. Values are untouched."""
+    if not names:
+        _EXPLICIT.clear()
+        return
+    for n in names:
+        _EXPLICIT.discard(_canon(n))
 
 
 def register_flag(name: str, default: Any, lowering: bool = False) -> None:

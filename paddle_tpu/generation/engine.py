@@ -101,7 +101,9 @@ from .. import telemetry as _tm
 from .. import tracing as _tr
 from ..core import program_cache
 from ..failpoints import failpoint
+from .. import flags as _flags
 from ..flags import get_flag
+from ..kernels.paged_attention import kernel_form as _kernel_form
 from ..inference import bucket_for, bucket_or_exact, parse_bucket_ladder
 from ..monitor import gauge_set, stat_add, timer_observe
 from .kv_cache import (TRASH_BLOCK, BlockPoolExhausted, KVCacheManager,
@@ -185,36 +187,31 @@ class GenerationEngine:
                  draft_params: Optional[Dict[str, Any]] = None,
                  program_cache_dir: Optional[str] = None,
                  quant_mode: Optional[str] = None,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 kernel: Optional[str] = None,
+                 autotune: Optional[bool] = None):
         self.cfg = cfg
         self.params = jax.tree.map(jnp.asarray, params)
         nb = int(num_blocks if num_blocks is not None
                  else get_flag("FLAGS_generation_kv_blocks"))
-        bs = int(block_size if block_size is not None
-                 else get_flag("FLAGS_generation_block_size"))
         self.decode_width = int(
             decode_width if decode_width is not None
             else get_flag("FLAGS_generation_decode_width"))
         if self.decode_width < 1:
             raise ValueError("decode_width must be >= 1")
-        self.prefill_chunk = int(
-            prefill_chunk if prefill_chunk is not None
-            else get_flag("FLAGS_generation_prefill_chunk"))
-        if self.prefill_chunk < 0:
-            raise ValueError("prefill_chunk must be >= 0")
         self.spec_tokens = int(
             spec_tokens if spec_tokens is not None
             else get_flag("FLAGS_generation_spec_tokens"))
         if self.spec_tokens < 0:
             raise ValueError("spec_tokens must be >= 0")
-        if self.spec_tokens and not self.prefill_chunk:
-            raise ValueError(
-                "speculative decoding rides the chunked mixed step — "
-                "FLAGS_generation_spec_tokens needs "
-                "FLAGS_generation_prefill_chunk > 0")
+        # drafter KIND resolves early: it is part of the autotune
+        # policy key below; the model-draft arg validation stays with
+        # the draft pool setup further down
+        self.draft_kind = str(draft if draft is not None
+                              else get_flag("FLAGS_generation_draft"))
         # quantized serving (ISSUE 15, paddle_tpu/quant): weight quant
         # mode + KV pool dtype. Both ride every program fingerprint
-        # (lowering flags + the v=3 meta below) so an fp32 cached
+        # (lowering flags + the v=4 meta below) so an fp32 cached
         # program can never serve a quantized checkpoint.
         self.quant_mode = str(quant_mode if quant_mode is not None
                               else get_flag("FLAGS_quant_mode"))
@@ -239,11 +236,6 @@ class GenerationEngine:
                 "kv_dtype='fp8' needs float8_e4m3fn in this jax "
                 "build/backend (quant.supports_fp8()) — use 'int8'")
         self.kv_dtype = kvq
-        if self.kv_dtype != "fp32" and not self.prefill_chunk:
-            raise ValueError(
-                "quantized KV rides the chunked mixed step — "
-                "FLAGS_generation_kv_quant needs "
-                "FLAGS_generation_prefill_chunk > 0")
         if self.quant_mode != "off" and not _quant.is_quantized(
                 self.params):
             # fp32 params are converted in-process (tests/bench
@@ -253,13 +245,78 @@ class GenerationEngine:
                 jnp.asarray,
                 _quant.quantize_decoder_params(self.params,
                                                self.quant_mode))
+        self._program_cache_dir = program_cache_dir
+        # --- adaptive dispatch (ISSUE 16, paddle_tpu/autotune.py) ---
+        # Resolution per geometry knob: ctor arg / explicitly-set flag
+        # PINS it > the persisted/tuned policy entry > flag default.
+        # Tuning (trial engines over a probe workload) runs here, once
+        # per (shape-bucket, backend, quant-mode) key — trial engines
+        # recurse with autotune=False.
+        self.autotune = bool(autotune if autotune is not None
+                             else get_flag("FLAGS_autotune"))
+        pins: Dict[str, Any] = {}
+
+        def _pin(name, arg, flag, cast):
+            if arg is not None:
+                pins[name] = cast(arg)
+            elif _flags.explicitly_set(flag):
+                pins[name] = cast(get_flag(flag))
+        _pin("kernel", kernel, "FLAGS_paged_attention_kernel", str)
+        _pin("block_size", block_size,
+             "FLAGS_generation_block_size", int)
+        _pin("prefill_chunk", prefill_chunk,
+             "FLAGS_generation_prefill_chunk", int)
+        _pin("token_budget", token_budget,
+             "FLAGS_generation_token_budget", int)
+        self._policy_entry = None
+        if self.autotune and len(pins) < 4:
+            from .. import autotune as _at
+            self._policy_entry = _at.resolve_generation(
+                cfg, self.params, num_blocks=nb,
+                decode_width=self.decode_width,
+                spec_tokens=self.spec_tokens,
+                quant_mode=self.quant_mode, kv_dtype=self.kv_dtype,
+                draft_kind=self.draft_kind, draft_cfg=draft_cfg,
+                draft_params=draft_params, prefix_cache=prefix_cache,
+                program_cache_dir=program_cache_dir, pins=pins)
+
+        def _knob(name, flag, cast):
+            if name in pins:
+                return pins[name]
+            if self._policy_entry is not None:
+                return cast(self._policy_entry[name])
+            return cast(get_flag(flag))
+        self.kernel = _knob("kernel", "FLAGS_paged_attention_kernel",
+                            str)
+        if self.kernel not in ("reference", "pallas"):
+            raise ValueError("unknown paged-attention kernel %r "
+                             "(reference|pallas)" % self.kernel)
+        bs = _knob("block_size", "FLAGS_generation_block_size", int)
+        self.prefill_chunk = _knob(
+            "prefill_chunk", "FLAGS_generation_prefill_chunk", int)
+        if self.prefill_chunk < 0:
+            raise ValueError("prefill_chunk must be >= 0")
+        tb_raw = _knob("token_budget",
+                       "FLAGS_generation_token_budget", int)
+        # geometry-dependent validations, deferred to the RESOLVED
+        # chunk (a policy entry always keeps chunk > 0 when it was
+        # tuned with spec/quantized KV on, but pins can force it)
+        if self.spec_tokens and not self.prefill_chunk:
+            raise ValueError(
+                "speculative decoding rides the chunked mixed step — "
+                "FLAGS_generation_spec_tokens needs "
+                "FLAGS_generation_prefill_chunk > 0")
+        if self.kv_dtype != "fp32" and not self.prefill_chunk:
+            raise ValueError(
+                "quantized KV rides the chunked mixed step — "
+                "FLAGS_generation_kv_quant needs "
+                "FLAGS_generation_prefill_chunk > 0")
         if self.prefill_chunk:
             # chunked mode: prompts stream through the mixed step, so
             # the bucket ladder is a compat shim with one rung
             # (MIGRATION.md) — submit still validates against it
             self.prefill_ladder = [cfg.max_seq_len]
-            tb = int(token_budget if token_budget is not None
-                     else get_flag("FLAGS_generation_token_budget"))
+            tb = int(tb_raw)
             # auto budget leaves room for every lane's k draft slots
             # so speculation never starves prefill chunks
             self.token_budget = (
@@ -318,8 +375,7 @@ class GenerationEngine:
         # drafter for speculative decoding: "ngram" is a host-side
         # prompt-lookup (zero device cost); "model" runs a small draft
         # decoder over its OWN paged pools indexed by the same tables
-        self.draft_kind = str(draft if draft is not None
-                              else get_flag("FLAGS_generation_draft"))
+        # (self.draft_kind resolved above, with the policy key)
         self.draft_cfg = draft_cfg
         self.draft_params = None
         self.dk_pools = self.dv_pools = None
@@ -344,7 +400,6 @@ class GenerationEngine:
         elif self.spec_tokens and self.draft_kind != "ngram":
             raise ValueError("unknown draft kind %r (ngram|model)"
                              % self.draft_kind)
-        self._program_cache_dir = program_cache_dir
         # compiled-step registry: dict miss == an engine compilation
         # (STAT_generation_compile — the zero-steady-state-recompile
         # pin counts THIS, plus the fixed shapes make jax's own cache
@@ -367,6 +422,7 @@ class GenerationEngine:
         # flipped by warmup(): the GenerationPool's /readyz probe
         self._warmed = False
         self._publish_quant_gauges()
+        self._publish_autotune_gauges()
 
     # --- quantized serving (ISSUE 15) ----------------------------------
 
@@ -407,6 +463,17 @@ class GenerationEngine:
         gauge_set("GAUGE_quant_weight_bytes_saved",
                   _quant.weight_bytes_saved(self.params))
 
+    def _publish_autotune_gauges(self) -> None:
+        """(Re)publish the autotune gauges for this engine's resolved
+        policy entry. Called at construction AND by the scheduler's
+        _reset_engine (tests/test_autotune.py pins the retraction) —
+        an untuned engine publishes zeros, which IS the retraction."""
+        e = self._policy_entry or {}
+        gauge_set("GAUGE_autotune_active", 1.0 if e else 0.0)
+        gauge_set("GAUGE_autotune_step_time_us",
+                  float(e.get("step_time_us", 0.0)))
+        gauge_set("GAUGE_autotune_trials", float(e.get("trials", 0.0)))
+
     # --- compiled-step registry ---------------------------------------
 
     def _get_fn(self, kind: str, bucket: int = 0):
@@ -414,6 +481,12 @@ class GenerationEngine:
         fn = self._fns.get(key)
         if fn is not None:
             return fn
+        with _kernel_form(self.kernel):
+            fn = self._build_fn(kind, bucket)
+        self._fns[key] = fn
+        return fn
+
+    def _build_fn(self, kind: str, bucket: int):
         stat_add("STAT_generation_compile")
         cfg = self.cfg
         if kind == "prefill":
@@ -557,9 +630,7 @@ class GenerationEngine:
             )
         else:
             raise ValueError(kind)
-        fn = self._aot_or_jit(kind, bucket, raw, avals)
-        self._fns[key] = fn
-        return fn
+        return self._aot_or_jit(kind, bucket, raw, avals)
 
     def _aot_or_jit(self, kind: str, bucket: int, raw, avals):
         """Route the step through the persistent AOT program cache
@@ -571,15 +642,19 @@ class GenerationEngine:
                else "generation_%s" % kind)
         base = (self.draft_cfg.meta() if kind.startswith("draft")
                 else self.cfg.meta())
-        # v=3: ISSUE-15 quantized serving — qm/kvq join the
-        # fingerprint because ctor args can override the (lowering)
-        # flags per-engine, and a cached fp32 program must NEVER serve
-        # a quantized checkpoint (or vice versa); stale disk-cache
-        # entries must miss on the fingerprint rather than trip
-        # exported_entry's aval check. samp rides along because two
-        # engines can share every other dimension yet differ in
-        # spec_tokens.
-        meta = dict(base, kind=kind, bucket=bucket, v=3,
+        # v=4: ISSUE-16 adaptive dispatch — kern is the RESOLVED
+        # kernel form (the flag may say "reference" while the policy
+        # baked "pallas" via the kernel_form override, so the flag in
+        # lowering_snapshot no longer tells the whole story), and
+        # policy is the entry label that produced this geometry, which
+        # is what makes zero-steady-state-recompiles provable across a
+        # restart: a process that reloads the persisted policy builds
+        # the SAME meta, hits the SAME fingerprint, and loads the AOT
+        # trace the tuned process exported. v=3 (ISSUE 15) added
+        # qm/kvq so an fp32 cached program can never serve a quantized
+        # checkpoint; samp rides along because two engines can share
+        # every other dimension yet differ in spec_tokens.
+        meta = dict(base, kind=kind, bucket=bucket, v=4,
                     blocks=self.kv.num_blocks,
                     block_size=self.kv.block_size,
                     width=self.decode_width,
@@ -589,7 +664,9 @@ class GenerationEngine:
                     slots=self.token_budget,
                     samp=self.sample_width,
                     qm=self.quant_mode,
-                    kvq=self.kv_dtype)
+                    kvq=self.kv_dtype,
+                    kern=self.kernel,
+                    policy=(self._policy_entry or {}).get("label", ""))
         cache_dir = program_cache.resolve_dir(self._program_cache_dir)
         if cache_dir is not None:
             fp = program_cache.fn_fingerprint("generation_step", meta)
@@ -608,7 +685,13 @@ class GenerationEngine:
         executable (there is nothing else to compile — the collapsed
         ladder never runs); two-phase mode warms the decode step plus
         every prefill bucket (or the given subset). Steady state then
-        never compiles."""
+        never compiles. The engine's resolved kernel form is pinned
+        for anything traced here (the rare accounted-compile fallback
+        traces at first call, inside this block)."""
+        with _kernel_form(self.kernel):
+            return self._warmup_inner(buckets)
+
+    def _warmup_inner(self, buckets=None) -> dict:
         report = {}
         if self.prefill_chunk:
             t0 = time.perf_counter()

@@ -382,3 +382,6 @@ class GenerationPool:
         # (pool dtype, quantized params), so re-deriving them IS the
         # retraction — a rebuilt fp32 engine publishes zeros
         eng._publish_quant_gauges()
+        # likewise the autotune gauges: a rebuilt engine without a
+        # resolved policy entry retracts GAUGE_autotune_* to zero
+        eng._publish_autotune_gauges()

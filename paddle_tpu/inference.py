@@ -152,6 +152,9 @@ class Config:
         # follows FLAGS_quant_mode, enable_quant()/disable_quant() pin
         # it for this predictor
         self._quant_mode: Optional[str] = None
+        # adaptive bucket dispatch (docs/autotune.md): None follows
+        # FLAGS_autotune, switch_autotune() pins it for this predictor
+        self._autotune: Optional[bool] = None
 
     def enable_quant(self, mode: str = "int8"):
         """Serve with weight-only quantization: at load, every
@@ -171,6 +174,17 @@ class Config:
 
     def disable_quant(self):
         self._quant_mode = "off"
+
+    def switch_autotune(self, x: bool = True):
+        """Adaptive bucket dispatch (docs/autotune.md): on the first
+        request of each (rows, bucket) shape key the predictor
+        measures pad-to-bucket vs exact-shape execution (bitwise
+        row-identical results are required for eligibility), persists
+        the winner in the program cache's policy/ sidecar, and routes
+        every later request through a one-dict-lookup policy table.
+        Default follows FLAGS_autotune."""
+        self._autotune = bool(x)
+        return self
 
     def enable_spmd(self, plan_or_spec, data_axis: str = "dp"):
         """Serve under a ShardingPlan (docs/spmd.md): batch feeds shard
@@ -313,6 +327,15 @@ class Predictor:
         # compiles in the serving counters
         self._warm_sigs: set = set()
         self._plan = getattr(config, "_spmd_plan", None)
+        at = getattr(config, "_autotune", None)
+        if at is None:
+            at = bool(_gf("FLAGS_autotune"))
+        self._autotune = bool(at)
+        # program identity for the autotune policy key — computed once
+        # (fingerprint() canonicalizes every op); False = not yet
+        # computed, None = this program cannot be fingerprinted (then
+        # bucket dispatch stays on the reference pad-to-bucket form)
+        self._prog_fp = False
 
     def _prog_tag(self, bucket: int) -> str:
         """/programz tag for a bucketed execution — the quant mode is
@@ -413,6 +436,20 @@ class Predictor:
         # an overflow compiles the exact shape — loud, never wrong
         target = bucket_or_exact(b, ladder,
                                  "STAT_predictor_bucket_overflow")
+        if self._autotune and target != b:
+            # adaptive dispatch (docs/autotune.md): the tuned policy
+            # may prefer the exact shape over pad-to-bucket for this
+            # (rows, bucket) key — tuned once, then one dict lookup
+            target = self._dispatch_target(arrs, b, target, ladder)
+        return self._exec_padded(arrs, b, target, ladder)
+
+    def _exec_padded(self, arrs: Dict[str, Any], b: int, target: int,
+                     ladder: List[int]):
+        """Pad the feeds' bucketed axes up to `target` rows (plus any
+        extra configured axes to the ladder), execute under the
+        /programz tag, slice row outputs back to the true batch `b`.
+        target == b is the exact-shape form (no row padding)."""
+        from .monitor import stat_add
         axes = getattr(self.config, "_bucket_axes", (0,))
         padded = {}
         pad_elems = 0
@@ -459,6 +496,66 @@ class Predictor:
             outs = [o[:b] if getattr(o, "ndim", 0) and
                     o.shape[0] == target else o for o in outs]
         return outs
+
+    def _program_token(self) -> Optional[str]:
+        """The program's cross-process identity for the policy key,
+        computed once per predictor. None = unfingerprintable program
+        (holds a non-canonicalizable attr) — such predictors skip
+        adaptive dispatch rather than risk key collisions."""
+        if self._prog_fp is False:
+            self._prog_fp = self.program.fingerprint(
+                fetch_names=list(self.fetch_names))
+        return self._prog_fp
+
+    def _dispatch_target(self, arrs: Dict[str, Any], b: int,
+                         target: int, ladder: List[int]) -> int:
+        """Adaptive bucket dispatch (docs/autotune.md): resolve the
+        pad-to-bucket vs exact-shape choice for this (rows, bucket)
+        key through the autotune policy. Steady state is ONE dict
+        lookup; a miss tunes inline — interleaved timed passes of both
+        forms on the REAL request, eligibility = bitwise-identical
+        rows — and persists the winner in the policy/ sidecar keyed by
+        the program fingerprint, so a restarted server re-tunes
+        nothing. The reference (pad-to-bucket) form wins ties and any
+        faulted tune."""
+        prog = self._program_token()
+        if prog is None:
+            return target
+        import jax
+        from . import autotune as _at
+        from .monitor import stat_add
+        key_meta = {"kind": "predictor", "prog": prog,
+                    "rows": int(b), "bucket": int(target),
+                    "qm": self._quant_mode,
+                    "backend": jax.default_backend()}
+        entry = _at.policy().resolve(_at.key_for(key_meta))
+        if entry is not None:
+            stat_add("STAT_autotune_cache_hits")
+        else:
+            def _bitwise_rows(ref, val):
+                if len(ref) != len(val):
+                    return False
+                for x, y in zip(ref, val):
+                    x = np.ascontiguousarray(np.asarray(x))
+                    y = np.ascontiguousarray(np.asarray(y))
+                    if x.shape != y.shape or x.dtype != y.dtype or \
+                            x.tobytes() != y.tobytes():
+                        return False
+                return True
+            entry = _at.tune_two_forms(
+                key_meta,
+                program_cache_dir=getattr(
+                    self.config, "_program_cache_dir", None),
+                forms={
+                    "bucket": lambda: self._exec_padded(
+                        arrs, b, target, ladder),
+                    "exact": lambda: self._exec_padded(
+                        arrs, b, b, ladder),
+                },
+                reference="bucket", compare=_bitwise_rows)
+        if entry is not None and entry.get("form") == "exact":
+            return b
+        return target
 
     def warmup_buckets(self, example_feeds: Sequence,
                        max_bucket: Optional[int] = None) -> Dict:

@@ -213,6 +213,7 @@ def statusz() -> Dict[str, Any]:
             "quant": _quant_status(counters),
         },
         "flight_recorder_steps": len(telemetry.flight_records()),
+        "autotune": _autotune_status(counters),
         "gangs": _gang_status(),
         "tracing": _tracing_status(counters),
         "slo": _slo_status(),
@@ -236,6 +237,25 @@ def _quant_status(counters: Dict[str, Any]) -> Dict[str, Any]:
             "GAUGE_quant_weight_bytes_saved"),
         "kv_quant_blocks": counters.get(
             "STAT_generation_kv_quant_blocks", 0),
+    }
+
+
+def _autotune_status(counters: Dict[str, Any]) -> Dict[str, Any]:
+    """The /statusz "autotune" section (docs/autotune.md): one line
+    per resolved policy key — winning form, geometry label, measured
+    step time, trial count, source (tuned this process vs reloaded
+    from disk) — plus the tuning counters. Steady state should show
+    cache_hits growing and trials flat; the opposite is the re-tuning
+    loop tools/stat_diff.py flags as a cost regression."""
+    from .flags import get_flag
+    from . import autotune
+    return {
+        "enabled": bool(get_flag("FLAGS_autotune")),
+        "policies": autotune.policies(),
+        "trials": counters.get("STAT_autotune_trials", 0),
+        "wins": counters.get("STAT_autotune_wins", 0),
+        "cache_hits": counters.get("STAT_autotune_cache_hits", 0),
+        "fallbacks": counters.get("STAT_autotune_fallbacks", 0),
     }
 
 

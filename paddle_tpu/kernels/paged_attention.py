@@ -412,21 +412,62 @@ def paged_attention_pallas(q, k_pool, v_pool, block_tables, ctx_lens,
 
 
 # ---------------------------------------------------------------------------
-# public entry: flag-routed seam
+# public entry: flag-routed seam (+ the autotune override)
 # ---------------------------------------------------------------------------
+
+# Trace-scoped kernel-form override (paddle_tpu/autotune.py): the
+# dispatch policy's winning form must be bakeable into a compile
+# WITHOUT flipping the process-global flag (two engines in one process
+# may resolve different forms). The engine wraps its trace-time
+# construction in kernel_form(...); the flag stays the default route
+# and the compile-key story is unchanged — the engine puts the
+# RESOLVED form into its program fingerprint meta (kern=..., v=4).
+_FORM_OVERRIDE: Optional[str] = None
+
+
+class kernel_form:
+    """Context manager pinning the kernel form ("reference"|"pallas")
+    for computations TRACED inside the block. None passes through to
+    FLAGS_paged_attention_kernel."""
+
+    __slots__ = ("form", "_prev")
+
+    def __init__(self, form: Optional[str]):
+        self.form = form
+
+    def __enter__(self):
+        global _FORM_OVERRIDE
+        self._prev = _FORM_OVERRIDE
+        if self.form is not None:
+            _FORM_OVERRIDE = self.form
+        return self
+
+    def __exit__(self, *exc):
+        global _FORM_OVERRIDE
+        _FORM_OVERRIDE = self._prev
+        return False
+
+
+def resolved_form() -> str:
+    """The kernel form the next trace will bake in: the active
+    kernel_form override, else FLAGS_paged_attention_kernel."""
+    if _FORM_OVERRIDE is not None:
+        return _FORM_OVERRIDE
+    from ..flags import get_flag
+    return str(get_flag("FLAGS_paged_attention_kernel"))
+
 
 def paged_attention(q, k_pool, v_pool, block_tables, ctx_lens,
                     sm_scale: Optional[float] = None,
                     k_scales=None, v_scales=None):
     """Decode-step attention over the paged KV pool. Routed by
     FLAGS_paged_attention_kernel (a lowering flag: it is baked into
-    every generation compile key): "reference" is the bitwise parity
-    path; "pallas" runs the blocked kernel (interpret mode off-TPU).
-    k_scales/v_scales (quantized pools, paddle_tpu/quant) flow to the
-    dequant-fused forms of both paths; None = the untouched fp32
-    path."""
-    from ..flags import get_flag
-    mode = get_flag("FLAGS_paged_attention_kernel")
+    every generation compile key), subject to the kernel_form override
+    above: "reference" is the bitwise parity path; "pallas" runs the
+    blocked kernel (interpret mode off-TPU). k_scales/v_scales
+    (quantized pools, paddle_tpu/quant) flow to the dequant-fused
+    forms of both paths; None = the untouched fp32 path."""
+    mode = resolved_form()
     if mode == "pallas" and _HAS_PLTPU:
         return paged_attention_pallas(q, k_pool, v_pool, block_tables,
                                       ctx_lens, sm_scale,
@@ -444,10 +485,9 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, q_lens,
     """Mixed prefill+decode attention over the paged KV pool: q
     `[B, Cq, H, D]` with per-row true query length (1 = decode, chunk
     width = prefill). Routed by the same FLAGS_paged_attention_kernel
-    seam as the decode entry; k_scales/v_scales select the
-    quantized-KV dequant-fused forms."""
-    from ..flags import get_flag
-    mode = get_flag("FLAGS_paged_attention_kernel")
+    seam (+ kernel_form override) as the decode entry; k_scales /
+    v_scales select the quantized-KV dequant-fused forms."""
+    mode = resolved_form()
     if mode == "pallas" and _HAS_PLTPU:
         return ragged_paged_attention_pallas(
             q, k_pool, v_pool, block_tables, q_lens, ctx_lens, sm_scale,

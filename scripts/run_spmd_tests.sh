@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Run the mesh-native SPMD runtime suite (-m spmd, docs/spmd.md) on the
-# 8-device virtual CPU mesh and emit MULTICHIP_r06.json: the usual
-# multichip dryrun transcript (same shape as MULTICHIP_r0{1..5}.json)
+# 8-device virtual CPU mesh and emit MULTICHIP_r07.json: the usual
+# multichip dryrun transcript (same shape as MULTICHIP_r0{1..6}.json)
 # plus the mesh plan, the per-axis host-collective census
 # (STAT_mesh_collective_<axis>, monitor.py), the chaos smoke
 # (failpoints armed over /failpointz, recovery asserted — ISSUE 9),
@@ -10,7 +10,10 @@
 # (2 supervised jax workers, one killed -9 mid-step, bitwise-identical
 # resumed loss stream — ISSUE 13), and the quantized-serving smoke
 # (int8 checkpoint round-tripped through the conversion path and
-# served with the int8 KV pool under the plan — ISSUE 15).
+# served with the int8 KV pool under the plan — ISSUE 15), and the
+# adaptive-dispatch smoke (geometry tuned once, policy scraped from
+# /statusz, restart re-serves from the persisted sidecar with zero
+# trials / zero recompiles / bitwise streams — ISSUE 16).
 #
 # Usage: scripts/run_spmd_tests.sh [extra pytest args...]
 set -u
@@ -26,7 +29,7 @@ echo "== spmd-marked tests (8 virtual CPU devices) =="
 python -m pytest tests/ -q -m spmd -p no:cacheprovider "$@"
 test_rc=$?
 
-echo "== multichip dryrun + mesh census -> MULTICHIP_r06.json =="
+echo "== multichip dryrun + mesh census -> MULTICHIP_r07.json =="
 python - "$test_rc" <<'EOF'
 import io
 import json
@@ -392,6 +395,92 @@ try:
 except Exception as e:  # noqa: BLE001 - artifact records the failure
     quant_smoke["error"] = "%s: %s" % (type(e).__name__, e)
 
+# adaptive-dispatch smoke (ISSUE 16, docs/autotune.md): tune the
+# ragged-step geometry ONCE under the same dp4xmp2 plan with a tiny
+# search budget, read the resolved policy back through /statusz, then
+# simulate a process restart (in-memory policy tables cleared) — the
+# fresh engine must reload the winner from the persisted sidecar with
+# ZERO new trials, ZERO trace-cache misses, zero steady-state
+# recompiles after warmup, and bitwise-identical streams.
+autotune_smoke = {"ok": False}
+try:
+    import tempfile as _attmp
+    from paddle_tpu import autotune as _at
+    from paddle_tpu import flags as _atflags
+
+    _atflags.set_flags({"FLAGS_autotune_candidates": 3,
+                        "FLAGS_autotune_probe_tokens": 8})
+    _atflags.clear_explicit("FLAGS_autotune_candidates",
+                            "FLAGS_autotune_probe_tokens")
+    _at.reset()
+    _atdir = _attmp.mkdtemp(prefix="pt_autotune_smoke_")
+    _atrng = np.random.RandomState(16)
+    atreqs = lambda: [GenerationRequest(
+        prompt=list(_atrng.randint(1, 64, size=int(n))),
+        max_new_tokens=5,
+        sampling=SamplingParams(temperature=0.7, seed=i),
+        request_id=i) for i, n in enumerate([11, 5, 14, 8])]
+    _atrng2 = np.random.RandomState(16)   # same stream for the replay
+    atreqs2 = lambda: [GenerationRequest(
+        prompt=list(_atrng2.randint(1, 64, size=int(n))),
+        max_new_tokens=5,
+        sampling=SamplingParams(temperature=0.7, seed=i),
+        request_id=i) for i, n in enumerate([11, 5, 14, 8])]
+
+    def at_eng():
+        # kernel/block_size pinned via ctor, prefill_chunk left FREE:
+        # the tuner searches chunk geometry only (fast, deterministic)
+        return GenerationEngine(gcfg, gparams, num_blocks=64,
+                                block_size=4, decode_width=2,
+                                kernel="reference", autotune=True,
+                                program_cache_dir=_atdir)
+
+    with use_plan(plan):
+        t0 = stat_get("STAT_autotune_trials")
+        eng1 = at_eng()
+        eng1.warmup()
+        trials = int(stat_get("STAT_autotune_trials") - t0)
+        toks1 = {r.request_id: r.tokens for r in eng1.generate(atreqs())}
+
+        # scrape the policy through the live introspection surface
+        install_plan(plan)
+        srv = introspect.start(port=0)
+        atz = json.load(urllib.request.urlopen(
+            srv.url + "/statusz", timeout=10))["autotune"]
+        introspect.stop()
+        install_plan(None)
+
+        # restart: clear the in-memory tables; the sidecar must serve
+        _at.reset()
+        t1 = stat_get("STAT_autotune_trials")
+        m1 = stat_get("STAT_program_cache_trace_miss")
+        eng2 = at_eng()
+        eng2.warmup()
+        c1 = stat_get("STAT_generation_compile")
+        toks2 = {r.request_id: r.tokens
+                 for r in eng2.generate(atreqs2())}
+        at_recompiles = int(stat_get("STAT_generation_compile") - c1)
+        retune = int(stat_get("STAT_autotune_trials") - t1)
+        at_miss = int(stat_get("STAT_program_cache_trace_miss") - m1)
+        src = (eng2._policy_entry or {}).get("source")
+
+    autotune_smoke = {
+        "ok": (trials > 0 and bool(atz["policies"])
+               and atz["trials"] >= trials and retune == 0
+               and at_miss == 0 and at_recompiles == 0
+               and src == "disk" and toks1 == toks2),
+        "winner": (eng1._policy_entry or {}).get("label"),
+        "tune_trials": trials,
+        "statusz_policies": len(atz["policies"]),
+        "restart_policy_source": src,
+        "restart_retune_trials": retune,
+        "restart_trace_cache_misses": at_miss,
+        "steady_state_recompiles": at_recompiles,
+        "streams_bitwise_identical": toks1 == toks2,
+    }
+except Exception as e:  # noqa: BLE001 - artifact records the failure
+    autotune_smoke["error"] = "%s: %s" % (type(e).__name__, e)
+
 # slo smoke (ISSUE 12, docs/observability.md): enable the windowed SLO
 # engine, drive tenant-attributed traced requests (a quarter of them
 # deadline-missed), scrape /sloz text + JSON and the tenant-filtered
@@ -550,6 +639,7 @@ artifact = {
     "ok": rc == 0 and test_rc == 0 and intro.get("ok", False)
     and chaos.get("ok", False) and generation.get("ok", False)
     and quant_smoke.get("ok", False)
+    and autotune_smoke.get("ok", False)
     and slo_smoke.get("ok", False) and multihost.get("ok", False),
     "skipped": False,
     "spmd_tests_rc": test_rc,
@@ -565,6 +655,7 @@ artifact = {
     "multihost": multihost,
     "generation": generation,
     "quant": quant_smoke,
+    "autotune": autotune_smoke,
     "slo": slo_smoke,
     "collectives": {k: v for k, v in sorted(counters.items())
                     if k.startswith("STAT_mesh_collective_")},
@@ -572,13 +663,14 @@ artifact = {
                       if k.startswith("STAT_mesh_")},
     "tail": buf.getvalue() + ("" if err is None else err + "\n"),
 }
-with open("MULTICHIP_r06.json", "w") as f:
+with open("MULTICHIP_r07.json", "w") as f:
     json.dump(artifact, f, indent=1)
     f.write("\n")
 print(json.dumps({k: artifact[k] for k in
                   ("n_devices", "rc", "ok", "spmd_tests_rc",
                    "introspect", "chaos", "multihost", "generation",
-                   "quant", "slo", "collectives")}, indent=1))
+                   "quant", "autotune", "slo", "collectives")},
+                 indent=1))
 sys.exit(0 if artifact["ok"] else 1)
 EOF
 exit $?

@@ -45,7 +45,15 @@ COST_SUFFIXES = ("_sync", "_miss", "_corrupt", "_evict", "_dropped",
 # in a quant-OFF baseline run means the fp32 path silently started
 # quantizing — a correctness regression the percentage gate must flag
 # regardless of magnitude.
-COST_INFIXES = ("_shed_", "_restart", "_kv_quant_")
+COST_INFIXES = ("_shed_", "_restart", "_kv_quant_", "_autotune_")
+# cost-family exemptions: STAT_autotune_cache_hits is the HEALTHY
+# autotune steady state (policy resolved from the table, no trials
+# run) — growth there is good. Growth in the rest of the _autotune_
+# family (trials/wins/fallbacks) during a steady-state run means the
+# policy cache is missing every step (a re-tuning loop: key churn,
+# corrupt sidecar, or a reset() in the hot path), which is exactly the
+# regression the cost gate must flag (docs/autotune.md).
+COST_EXEMPT_SUFFIXES = ("_autotune_cache_hits",)
 
 
 def _family(name: str) -> str:
@@ -58,6 +66,8 @@ def _family(name: str) -> str:
 
 def _is_cost_counter(name: str) -> bool:
     fam = _family(name)
+    if fam.endswith(COST_EXEMPT_SUFFIXES):
+        return False
     return fam.endswith(COST_SUFFIXES) \
         or any(infix in fam for infix in COST_INFIXES)
 
