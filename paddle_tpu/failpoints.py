@@ -99,6 +99,12 @@ KNOWN_SITES: Tuple[str, ...] = (
     # on the reference trial aborts the tune with NOTHING persisted
     # (the policy cache is never poisoned by a half-measured search)
     "autotune.measure",
+    # ISSUE 17: quantized gradient collective (mesh/collectives.py) —
+    # fires per bucket while TrainStep STAGES the exchange, BEFORE any
+    # quantized-buffer op is committed to the trace. A fault demotes
+    # just that bucket to the fp32 exchange (counted in
+    # STAT_collective_quant_fallbacks); the step still converges
+    "dist.collective_quant",
 )
 
 

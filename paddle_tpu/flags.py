@@ -281,6 +281,28 @@ _DEFS: Dict[str, Any] = {
     # trial workload asks for (split over a handful of requests with a
     # prompt-length spread)
     "FLAGS_autotune_probe_tokens": 32,
+    # quantized gradient collectives (paddle_tpu/mesh/collectives.py,
+    # docs/spmd.md "Quantized collectives"): how TrainStep syncs
+    # gradients over the data-parallel mesh axis.
+    #   "off"  — legacy GSPMD-inserted fp32 sync (bitwise-unchanged)
+    #   "fp32" — explicit per-microbatch fp32 exchange through the
+    #            shard_map seam (the synchronous oracle the int8 path
+    #            is budgeted against)
+    #   "int8" — accumulate locally in fp32, then one block-scaled
+    #            int8 ReduceScatter+AllGather of the averaged grads
+    #            (PR-15 absmax scale contract; ~3.9x fewer wire bytes
+    #            per exchange, NOT bitwise vs fp32)
+    "FLAGS_collective_quant": "off",
+    # fusion-buffer cap for the quantized exchange: big grads are
+    # concatenated (reverse-topological order) into buckets of at most
+    # this many MiB of fp32 payload, each exchanged as one collective
+    # so XLA can overlap buckets with remaining backward compute
+    "FLAGS_collective_bucket_mb": 4,
+    # grads with fewer elements than this (or ndim <= 1: biases,
+    # norms) skip quantization and sync per-tensor in fp32 — scale
+    # overhead would eat the int8 savings and 1-D params are the most
+    # error-sensitive
+    "FLAGS_collective_quant_min_numel": 2048,
 }
 
 _values: Dict[str, Any] = dict(_DEFS)
@@ -313,6 +335,12 @@ _LOWERING_FLAGS = [
     # quantized checkpoint, so both ride every compile key
     "FLAGS_quant_mode",
     "FLAGS_generation_kv_quant",
+    # collective quantization reshapes the traced step program (bucket
+    # layout, wire dtype): fp32 and quantized step programs must never
+    # share an AOT entry, mirroring the qm= isolation above
+    "FLAGS_collective_quant",
+    "FLAGS_collective_bucket_mb",
+    "FLAGS_collective_quant_min_numel",
 ]
 
 

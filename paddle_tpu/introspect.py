@@ -158,12 +158,9 @@ def statusz() -> Dict[str, Any]:
             "data_axis": plan.data_axis,
             "placed": bool(getattr(plan, "_placed", False)),
         }
-    # per-axis host-collective census rides along even without a live
-    # plan (parallel/collective.py counts them process-globally)
-    mesh["collectives"] = {
-        k[len("STAT_mesh_collective_"):]: v
-        for k, v in sorted(counters.items())
-        if k.startswith("STAT_mesh_collective_")}
+    # per-axis collective census rides along even without a live plan
+    # (parallel/collective.py and TrainStep count process-globally)
+    mesh["collectives"] = _collectives_status(counters)
 
     program_accounting.refresh_throughput()
     programs = dict(program_accounting.totals())
@@ -219,6 +216,44 @@ def statusz() -> Dict[str, Any]:
         "slo": _slo_status(),
         "failpoints_armed": _armed_failpoints(),
         "readiness": {"ready": ready, "checks": checks},
+    }
+
+
+def _collectives_status(counters: Dict[str, Any]) -> Dict[str, Any]:
+    """The /statusz mesh.collectives section (docs/spmd.md "Quantized
+    collectives"): per-axis op counts, payload bytes on the wire by
+    (axis, dtype) under the ring model documented in monitor.py, and
+    the quantized-collective health numbers — configured mode, live
+    bucket geometry gauges, cumulative bucket exchanges and fp32
+    fallbacks."""
+    import re
+    from .flags import get_flag
+    from .monitor import gauge_get
+    ops: Dict[str, Any] = {}
+    by_axis: Dict[str, Dict[str, Any]] = {}
+    for k, v in sorted(counters.items()):
+        if not k.startswith("STAT_mesh_collective_"):
+            continue
+        rest = k[len("STAT_mesh_collective_"):]
+        m = re.match(r'bytes\{axis="([^"]*)",dtype="([^"]*)"\}$', rest)
+        if m:
+            by_axis.setdefault(m.group(1), {})[m.group(2)] = v
+        elif "{" not in rest:
+            ops[rest] = v
+    return {
+        "ops": ops,
+        "bytes": by_axis,
+        "quant": {
+            "mode": str(get_flag("FLAGS_collective_quant")),
+            "buckets": gauge_get("GAUGE_collective_quant_buckets"),
+            "small_tensors": gauge_get("GAUGE_collective_quant_small"),
+            "wire_bytes_per_exchange": gauge_get(
+                "GAUGE_collective_quant_wire_bytes"),
+            "bucket_exchanges": counters.get(
+                "STAT_collective_quant_buckets", 0),
+            "fallbacks": counters.get(
+                "STAT_collective_quant_fallbacks", 0),
+        },
     }
 
 

@@ -162,6 +162,26 @@ def _accum_init(p, fill, is_scalar):
             else jnp.full_like(p, fill))
 
 
+def _microbatch(vals, k: int, i: int):
+    """Static slice i-of-k along dim 0 of every batch leaf (None and
+    scalars pass through untouched)."""
+    if k == 1:
+        return tuple(vals)
+    out = []
+    for x in vals:
+        if x is None or getattr(x, "ndim", 0) == 0:
+            out.append(x)
+            continue
+        n = int(x.shape[0])
+        if n % k:
+            raise ValueError(
+                "grad_accum_steps=%d does not divide batch dim %d"
+                % (k, n))
+        mb = n // k
+        out.append(jax.lax.slice_in_dim(x, i * mb, (i + 1) * mb, axis=0))
+    return tuple(out)
+
+
 class TrainStep:
     """One fused forward+backward+update XLA computation with donated
     parameter/optimizer state.
@@ -242,6 +262,43 @@ class TrainStep:
             new_opt[name] = nst
         return new_params, new_opt
 
+    def _make_loss_of(self, consts, rng, inputs, labels):
+        """The per-microbatch loss closure differentiated by the step.
+        Factored out of _build so the legacy, accumulation, and
+        explicit-exchange step builders all trace the IDENTICAL
+        forward+loss computation."""
+        model, loss_fn = self.model, self.loss_fn
+
+        def loss_of(p):
+            full = {**consts, **p}
+            if self.amp_dtype is not None:
+                old_amp = tape._state.amp_dtype
+                tape._state.amp_dtype = self.amp_dtype
+            r1, r2 = jax.random.split(rng)
+            try:
+                out, new_state = functional_call(
+                    model, full,
+                    *[Tensor(x) if x is not None else None
+                      for x in inputs],
+                    training=True, rng=r1)
+            finally:
+                if self.amp_dtype is not None:
+                    tape._state.amp_dtype = old_amp
+            # loss ops under an explicit rng scope so traced keys never
+            # leak into the global eager chain; no_grad because
+            # jax.grad differentiates
+            with tape.rng_scope(r2), tape.no_grad():
+                loss_t = loss_fn(
+                    *(out if isinstance(out, (tuple, list))
+                      else (out,)),
+                    *[Tensor(x) for x in labels])
+            loss_v = loss_t.value if isinstance(loss_t, Tensor) \
+                else loss_t
+            new_buf = {n: new_state[n] for n in self.buffer_names}
+            return loss_v.astype(jnp.float32), new_buf
+
+        return loss_of
+
     def _build(self, donate: bool = None):
         if donate is None:
             # same policy as the static Executor: donation is free
@@ -249,49 +306,193 @@ class TrainStep:
             # would defeat run_loop/fit's dispatch-ahead window
             from .core.executor import _donate_state
             donate = _donate_state()
-        model, loss_fn = self.model, self.loss_fn
+        from .flags import get_flag
+        mode = str(get_flag("FLAGS_collective_quant"))
+        k = max(1, int(self.grad_accum_steps))
+        if mode != "off":
+            manual = self._build_manual(mode, k, donate)
+            if manual is not None:
+                return manual
+        # explicit-exchange path not taken: retract its gauges and
+        # manifest so a legacy rebuild doesn't advertise stale bucket
+        # geometry or keep bumping the byte census
+        from .mesh import collectives as _coll
+        _coll.retract_gauges()
+        self._coll_manifest = None
 
         def step(state, opt_state, lr_step, rng, batch):
             inputs, labels = batch
             params = {n: state[n] for n in self.param_names}
             consts = {n: state[n] for n in self.buffer_names}
-
-            def loss_of(p):
-                full = {**consts, **p}
-                if self.amp_dtype is not None:
-                    old_amp = tape._state.amp_dtype
-                    tape._state.amp_dtype = self.amp_dtype
-                r1, r2 = jax.random.split(rng)
-                try:
-                    out, new_state = functional_call(
-                        model, full,
-                        *[Tensor(x) if x is not None else None
-                          for x in inputs],
-                        training=True, rng=r1)
-                finally:
-                    if self.amp_dtype is not None:
-                        tape._state.amp_dtype = old_amp
-                # loss ops under an explicit rng scope so traced keys never
-                # leak into the global eager chain; no_grad because
-                # jax.grad differentiates
-                with tape.rng_scope(r2), tape.no_grad():
-                    loss_t = loss_fn(
-                        *(out if isinstance(out, (tuple, list))
-                          else (out,)),
-                        *[Tensor(x) for x in labels])
-                loss_v = loss_t.value if isinstance(loss_t, Tensor) \
-                    else loss_t
-                new_buf = {n: new_state[n] for n in self.buffer_names}
-                return loss_v.astype(jnp.float32), new_buf
-
-            (loss, new_buf), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(params)
+            if k == 1:
+                (loss, new_buf), grads = jax.value_and_grad(
+                    self._make_loss_of(consts, rng, inputs, labels),
+                    has_aux=True)(params)
+            else:
+                # grad accumulation: k static microbatches, grads
+                # accumulated in fp32 and AVERAGED before _opt_update,
+                # so global-norm clipping sees the accumulated gradient
+                # — never a per-microbatch one
+                # (tests/test_quant_collectives.py pins vs big-batch)
+                rngs = jax.random.split(rng, k)
+                losses, acc, new_buf = [], None, None
+                for i in range(k):
+                    (l, new_buf), g = jax.value_and_grad(
+                        self._make_loss_of(
+                            consts, rngs[i], _microbatch(inputs, k, i),
+                            _microbatch(labels, k, i)),
+                        has_aux=True)(params)
+                    losses.append(l)
+                    acc = g if acc is None else jax.tree_util.tree_map(
+                        jnp.add, acc, g)
+                grads = jax.tree_util.tree_map(
+                    lambda a: a * (1.0 / k), acc)
+                loss = jnp.mean(jnp.stack(losses))
             new_params, new_opt = self._opt_update(params, grads, opt_state,
                                                   lr_step)
             new_state = {**new_buf, **new_params}
             return loss, new_state, new_opt, lr_step + 1
 
-        in_shardings = None
+        jit_kwargs = {}
+        if donate:
+            jit_kwargs["donate_argnums"] = (0, 1)
+        return jax.jit(step, **jit_kwargs)
+
+    def _build_manual(self, mode: str, k: int, donate: bool):
+        """Explicit-exchange step for FLAGS_collective_quant: a
+        full-manual shard_map over the plan's mesh whose gradient sync
+        runs through mesh/collectives.py — "fp32" exchanges every
+        microbatch (the synchronous oracle), "int8" accumulates
+        locally in fp32 and quantizes only the final exchange, with
+        buckets staged reverse-topologically so XLA overlaps them with
+        remaining backward compute. Returns None (caller keeps the
+        legacy GSPMD build) when no plan/data axis is active or params
+        are mesh-sharded: the manual body updates FULL parameter
+        values, so mp-sharded plans keep GSPMD's own sync
+        (docs/spmd.md documents the limitation)."""
+        plan = self.plan
+        if plan is None or getattr(plan, "data_axis", None) is None:
+            return None
+        dp_axis = plan.data_axis
+        mesh = plan.mesh
+        dp = int(mesh.shape[dp_axis])
+        if dp <= 1:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        state0 = state_of(self.model)
+        shapes = {n: tuple(np.shape(state0[n])) for n in self.param_names}
+        for n in self.param_names:
+            sp = plan.param_sharding(n, shapes[n])
+            spec = sp.spec if isinstance(sp, NamedSharding) else sp
+            if any(e is not None for e in tuple(spec)):
+                import warnings
+                warnings.warn(
+                    "FLAGS_collective_quant=%r needs replicated "
+                    "parameters; param %r is mesh-sharded — keeping "
+                    "the legacy GSPMD gradient sync" % (mode, n),
+                    stacklevel=3)
+                return None
+        from .flags import get_flag
+        from .mesh import collectives as coll
+        from .mesh import compat as _compat
+        cplan = coll.plan_buckets(
+            shapes, dp_axis, dp, mode=mode,
+            bucket_mb=int(get_flag("FLAGS_collective_bucket_mb")),
+            min_numel=int(get_flag("FLAGS_collective_quant_min_numel")))
+        coll.publish_gauges(cplan)
+        self._coll_plan = cplan
+        # per-dispatch census: stat_add cannot run inside the trace, so
+        # byte/op counts are derived from the plan here and bumped
+        # host-side after every __call__ (ring model — monitor.py)
+        entries = coll.wire_entries(cplan)
+        reps = k if mode == "fp32" else 1
+        fbufs = [n for n in self.buffer_names
+                 if jnp.issubdtype(state0[n].dtype, jnp.floating)]
+        bts: Dict[str, int] = {}
+        for _op, dt, nb in entries:
+            bts[dt] = bts.get(dt, 0) + reps * nb
+        extra = coll._ring(2 * 4, dp)  # loss pmean
+        for n in fbufs:
+            v = state0[n]
+            extra += coll._ring(2 * int(v.size) * v.dtype.itemsize, dp)
+        bts["float32"] = bts.get("float32", 0) + extra
+        self._coll_manifest = {
+            "axis": dp_axis,
+            "ops": reps * len(entries) + 1 + len(fbufs),
+            "bytes": bts,
+            "buckets": reps * sum(1 for b in cplan.buckets if b.quantized),
+        }
+        pn, bn = self.param_names, self.buffer_names
+
+        def step(state, opt_state, lr_step, rng, batch):
+            inputs, labels = batch
+            params = {n: state[n] for n in pn}
+            consts = {n: state[n] for n in bn}
+
+            def body(bparams, bconsts, brng, binputs, blabels):
+                # per-shard rng: every dp rank sees a different batch
+                # shard, so dropout/noise streams must differ too
+                r = jax.random.fold_in(brng, jax.lax.axis_index(dp_axis))
+                rngs = jax.random.split(r, k)
+                losses, acc, new_buf = [], None, None
+                for i in range(k):
+                    (l, new_buf), g = jax.value_and_grad(
+                        self._make_loss_of(
+                            bconsts, rngs[i], _microbatch(binputs, k, i),
+                            _microbatch(blabels, k, i)),
+                        has_aux=True)(bparams)
+                    losses.append(l)
+                    if mode == "fp32":
+                        # synchronous oracle: exchange EVERY microbatch
+                        g = coll.exchange_grads(g, cplan)
+                    acc = g if acc is None else jax.tree_util.tree_map(
+                        jnp.add, acc, g)
+                grads = jax.tree_util.tree_map(
+                    lambda a: a * (1.0 / k), acc)
+                if mode != "fp32":
+                    # int8: accumulate locally in fp32, quantize only
+                    # the final cross-host exchange
+                    grads = coll.exchange_grads(grads, cplan)
+                loss = jax.lax.pmean(jnp.mean(jnp.stack(losses)), dp_axis)
+                # float buffers (running stats) are computed per-shard;
+                # pmean makes the replicated out_spec well-defined
+                new_buf = {
+                    n: (jax.lax.pmean(v, dp_axis)
+                        if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                    for n, v in new_buf.items()}
+                return loss, grads, new_buf
+
+            def _in_spec(prefix, vals):
+                specs = []
+                for i, x in enumerate(vals):
+                    if x is None:
+                        specs.append(None)
+                        continue
+                    sh = plan.input_sharding("%s%d" % (prefix, i),
+                                             tuple(x.shape))
+                    specs.append(sh.spec if isinstance(sh, NamedSharding)
+                                 else sh)
+                return tuple(specs)
+
+            # check_vma=False: grads leave the body replicated (the
+            # exchange guarantees it) but old-jax rep-tracking cannot
+            # prove that through all_to_all/all_gather; nothing here
+            # differentiates THROUGH the shard_map (value_and_grad is
+            # inside the body), so the transpose caveat in compat.py
+            # does not apply
+            synced = _compat.shard_map(
+                body, mesh=mesh,
+                in_specs=(P(), P(), P(), _in_spec("input", inputs),
+                          _in_spec("label", labels)),
+                out_specs=(P(), P(), P()),
+                check_vma=False)
+            loss, grads, new_buf = synced(params, consts, rng,
+                                          inputs, labels)
+            new_params, new_opt = self._opt_update(params, grads,
+                                                   opt_state, lr_step)
+            new_state = {**new_buf, **new_params}
+            return loss, new_state, new_opt, lr_step + 1
+
         jit_kwargs = {}
         if donate:
             jit_kwargs["donate_argnums"] = (0, 1)
@@ -439,6 +640,18 @@ class TrainStep:
             loss, self._state, self._opt_state, self._lr_step = \
                 self._step_fn(self._state, self._opt_state,
                               self._lr_step, sub, (inputs, labels))
+        m = getattr(self, "_coll_manifest", None)
+        if m:
+            # explicit-exchange collectives run inside the jitted step,
+            # invisible to parallel/collective.py's launch counters —
+            # the census is bumped from the build-time wire manifest
+            from .monitor import labeled, stat_add
+            stat_add("STAT_mesh_collective_%s" % m["axis"], m["ops"])
+            for dt, nb in sorted(m["bytes"].items()):
+                stat_add(labeled("STAT_mesh_collective_bytes",
+                                 {"axis": m["axis"], "dtype": dt}), nb)
+            if m["buckets"]:
+                stat_add("STAT_collective_quant_buckets", m["buckets"])
         if step_id is not None:
             _tm.flight_note(step_id, "dispatched_us", _tm.now_us())
         return loss

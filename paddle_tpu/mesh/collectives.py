@@ -1,0 +1,288 @@
+"""Quantized gradient collectives over the data-parallel mesh axis.
+
+At pod scale cross-host bandwidth, not FLOPs, caps step time (ROADMAP
+item 4); EQuARX (PAPERS.md) shows a block-scaled int8 AllReduce
+recovers most of it with negligible quality loss. This module is the
+wire layer TrainStep threads its gradient sync through when
+``FLAGS_collective_quant`` is on (docs/spmd.md "Quantized
+collectives"):
+
+- :func:`plan_buckets` packs the model's gradients into fixed-size
+  fusion buffers (``FLAGS_collective_bucket_mb``) in
+  reverse-topological order — later layers' grads are ready first in
+  the backward pass, so staging their buckets first lets XLA's
+  latency-hiding scheduler overlap each bucket's exchange with the
+  remaining backward compute. Small / 1-D grads below
+  ``FLAGS_collective_quant_min_numel`` stay on a per-tensor fp32
+  pmean (scale overhead would eat the savings and biases/norms are
+  the most error-sensitive).
+- :func:`exchange_grads` runs inside the manual shard_map body
+  (mesh/compat.py seam) and syncs a name->grad dict: int8 buckets go
+  through the block-scaled ReduceScatter+AllGather wire, everything
+  else through fp32 pmean.
+
+The int8 wire reuses the PR-15 absmax scale contract
+(paddle_tpu/quant): per-block fp32 absmax ``s``, ``q = round(x *
+127 / s)``, dead-block guard (``s <= 0 -> 1.0``) applied BEFORE the
+store so a zero block round-trips to exact zeros. The scale is
+*shared* across the axis via pmax before quantization, which makes
+the integer shard sum exact (|q| <= 127 per rank, summed in int16)
+and lets the reduced shard requantize onto the SAME grid — the full
+exchange is: pmax scales -> int8 all_to_all (ReduceScatter) ->
+int16 sum -> requantize -> int8 all_gather -> one dequant. Wire
+bytes per exchange drop ~3.9x vs a fp32 AllReduce (measured by the
+``STAT_mesh_collective_bytes{axis,dtype}`` census; the ring model
+used for byte accounting is documented in monitor.py).
+
+Faults injected at the ``dist.collective_quant`` failpoint fire per
+bucket at PLAN time — before any quantized-buffer op is staged into
+the trace — and demote just that bucket to the fp32 exchange
+(``STAT_collective_quant_fallbacks``); the step still converges.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..failpoints import InjectedFault, failpoint
+from ..monitor import gauge_set, stat_add
+
+# elements per fp32-absmax scale block of the int8 wire format. 1 KiB
+# blocks keep scale overhead at ~0.8% of payload while bounding the
+# blast radius of one outlier to 1024 elements (same tradeoff as the
+# quantized KV pool's per-token-per-head scales).
+BLOCK = 1024
+
+# the PR-15 scale contract grid (quant/__init__.py GRID_INT8): stored
+# scale is always the divisor actually used
+GRID = 127.0
+
+GAUGE_FAMILY = (
+    "GAUGE_collective_quant_buckets",
+    "GAUGE_collective_quant_small",
+    "GAUGE_collective_quant_wire_bytes",
+)
+
+
+@dataclass(frozen=True)
+class Bucket:
+    """One fusion buffer: member grads are flattened fp32 and
+    concatenated in order; ``padded`` is the wire length (numel rounded
+    up to a BLOCK*axis_size multiple so scale blocks survive the
+    ReduceScatter reshape)."""
+    names: Tuple[str, ...]
+    shapes: Tuple[Tuple[int, ...], ...]
+    sizes: Tuple[int, ...]
+    numel: int
+    padded: int
+    quantized: bool
+
+    @property
+    def wire_elems(self) -> int:
+        return self.padded if self.quantized else self.numel
+
+
+@dataclass(frozen=True)
+class CollectivePlan:
+    """Deterministic pure function of (names+shapes, axis, flags) —
+    tests pin that two plans over the same inputs are equal."""
+    axis: str
+    axis_size: int
+    block: int
+    mode: str
+    buckets: Tuple[Bucket, ...]
+    small: Tuple[Tuple[str, int], ...]  # (name, numel), per-tensor fp32
+
+
+def plan_buckets(shapes: Dict[str, Tuple[int, ...]], axis: str,
+                 axis_size: int, *, mode: str, bucket_mb: int,
+                 min_numel: int, block: int = BLOCK) -> CollectivePlan:
+    """Pack gradients into exchange buckets.
+
+    ``shapes`` iterates in model-construction (forward-topological)
+    order; buckets are assembled over ``reversed(shapes)`` because the
+    backward pass produces later layers' grads first. Tensors with
+    ndim <= 1 or fewer than ``min_numel`` elements sync per-tensor in
+    fp32. The ``dist.collective_quant`` failpoint fires once per
+    would-be-quantized bucket BEFORE it is committed to the int8 wire;
+    a fault demotes that bucket to fp32.
+    """
+    cap = max(1, int(bucket_mb)) * (1 << 20) // 4  # fp32 elements
+    small: List[Tuple[str, int]] = []
+    big: List[Tuple[str, Tuple[int, ...], int]] = []
+    for name in reversed(list(shapes)):
+        shape = tuple(shapes[name])
+        numel = 1
+        for d in shape:
+            numel *= int(d)
+        if len(shape) <= 1 or numel < int(min_numel):
+            small.append((name, numel))
+        else:
+            big.append((name, shape, numel))
+
+    groups: List[List[Tuple[str, Tuple[int, ...], int]]] = []
+    cur: List[Tuple[str, Tuple[int, ...], int]] = []
+    cur_numel = 0
+    for item in big:
+        if cur and cur_numel + item[2] > cap:
+            groups.append(cur)
+            cur, cur_numel = [], 0
+        cur.append(item)
+        cur_numel += item[2]
+    if cur:
+        groups.append(cur)
+
+    unit = block * int(axis_size)
+    buckets: List[Bucket] = []
+    for i, grp in enumerate(groups):
+        numel = sum(n for _, _, n in grp)
+        quantized = mode == "int8"
+        if quantized:
+            try:
+                failpoint("dist.collective_quant", {
+                    "bucket": i, "names": tuple(n for n, _, _ in grp),
+                    "numel": numel})
+            except InjectedFault:
+                quantized = False
+                stat_add("STAT_collective_quant_fallbacks")
+        buckets.append(Bucket(
+            names=tuple(n for n, _, _ in grp),
+            shapes=tuple(s for _, s, _ in grp),
+            sizes=tuple(n for _, _, n in grp),
+            numel=numel,
+            padded=-(-numel // unit) * unit,
+            quantized=quantized))
+    return CollectivePlan(axis=axis, axis_size=int(axis_size),
+                          block=int(block), mode=str(mode),
+                          buckets=tuple(buckets), small=tuple(small))
+
+
+# -- wire formats (run inside the manual shard_map body) ----------------
+
+def _exchange_int8(flat, bucket: Bucket, plan: CollectivePlan):
+    """Block-scaled int8 ReduceScatter+AllGather mean over plan.axis."""
+    dp = plan.axis_size
+    nb = bucket.padded // plan.block
+    x = flat.reshape(nb, plan.block)
+    s = jnp.max(jnp.abs(x), axis=1)
+    # shared scale: pmax makes every rank quantize onto the same grid,
+    # so the shard sum below is exact integer arithmetic and the
+    # reduced shard requantizes losslessly relative to that grid
+    s = jax.lax.pmax(s, plan.axis)
+    # dead-block guard BEFORE the store (scale contract): an all-zero
+    # block keeps divisor 1.0 and round-trips to exact zeros
+    s = jnp.where(s > 0.0, s, 1.0)
+    q = jnp.round(x * (GRID / s)[:, None]).astype(jnp.int8)
+    # ReduceScatter as tiled all_to_all + local sum: rank r ends up
+    # holding every rank's quantized copy of segment r
+    qx = jax.lax.all_to_all(q.reshape(dp, -1), plan.axis, 0, 0,
+                            tiled=True)
+    red = jnp.sum(qx.astype(jnp.int16), axis=0)  # |q|<=127: exact
+    if dp & (dp - 1) == 0:
+        shift = dp.bit_length() - 1
+        q2 = ((red + (dp >> 1)) >> shift).astype(jnp.int8)
+    else:
+        q2 = jnp.round(red.astype(jnp.float32) * (1.0 / dp)) \
+                .astype(jnp.int8)
+    qg = jax.lax.all_gather(q2, plan.axis, tiled=True)
+    out = qg.reshape(nb, plan.block).astype(jnp.float32) \
+        * (s * (1.0 / GRID))[:, None]
+    return out.reshape(-1)
+
+
+def exchange_bucket(flat, bucket: Bucket, plan: CollectivePlan):
+    if bucket.quantized:
+        return _exchange_int8(flat, bucket, plan)
+    return jax.lax.pmean(flat, plan.axis)
+
+
+def bucket_concat(grads: Sequence[Any], bucket: Bucket):
+    flat = jnp.concatenate(
+        [jnp.asarray(g, jnp.float32).reshape(-1) for g in grads])
+    pad = bucket.wire_elems - bucket.numel
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    return flat
+
+
+def bucket_split(flat, bucket: Bucket) -> List[Any]:
+    out, off = [], 0
+    for size, shape in zip(bucket.sizes, bucket.shapes):
+        out.append(flat[off:off + size].reshape(shape))
+        off += size
+    return out
+
+
+def exchange_grads(grads: Dict[str, Any],
+                   plan: CollectivePlan) -> Dict[str, Any]:
+    """Sync a name->grad dict over ``plan.axis`` (mean) inside a
+    shard_map body. Buckets are staged in plan order (reverse
+    topological) as independent collectives so XLA can overlap each
+    with remaining backward compute; small grads pmean per-tensor."""
+    out = dict(grads)
+    for b in plan.buckets:
+        flat = exchange_bucket(
+            bucket_concat([grads[n] for n in b.names], b), b, plan)
+        for n, g in zip(b.names, bucket_split(flat, b)):
+            out[n] = g
+    for name, _numel in plan.small:
+        out[name] = jax.lax.pmean(grads[name], plan.axis)
+    return out
+
+
+# -- byte census (ring model; see monitor.py "mesh" instruments) --------
+
+def _ring(payload_bytes: int, dp: int) -> int:
+    """Bytes a rank puts on the wire moving ``payload_bytes`` through
+    one ring pass: each of the dp ranks forwards (dp-1)/dp of it."""
+    return int(payload_bytes * (dp - 1) / dp)
+
+
+def wire_entries(plan: CollectivePlan) -> List[Tuple[str, str, int]]:
+    """(op, dtype, bytes-on-wire-per-rank) for ONE full exchange of
+    every bucket + small tensor. AllReduce-family ops (pmean/pmax)
+    cost two ring passes; all_to_all / tiled all_gather cost one."""
+    dp = plan.axis_size
+    out: List[Tuple[str, str, int]] = []
+    for b in plan.buckets:
+        if b.quantized:
+            nb = b.padded // plan.block
+            out.append(("pmax", "float32", _ring(2 * nb * 4, dp)))
+            out.append(("all_to_all", "int8", _ring(b.padded, dp)))
+            out.append(("all_gather", "int8", _ring(b.padded, dp)))
+        else:
+            out.append(("pmean", "float32", _ring(2 * b.numel * 4, dp)))
+    for _name, numel in plan.small:
+        out.append(("pmean", "float32", _ring(2 * numel * 4, dp)))
+    return out
+
+
+def census_bytes(plan: CollectivePlan) -> Dict[str, int]:
+    """Per-exchange wire bytes aggregated by dtype."""
+    agg: Dict[str, int] = {}
+    for _op, dt, nb in wire_entries(plan):
+        agg[dt] = agg.get(dt, 0) + nb
+    return agg
+
+
+# -- gauges (PR-14+ retraction discipline) ------------------------------
+
+def publish_gauges(plan: CollectivePlan) -> None:
+    gauge_set("GAUGE_collective_quant_buckets",
+              sum(1 for b in plan.buckets if b.quantized))
+    gauge_set("GAUGE_collective_quant_small", len(plan.small))
+    gauge_set("GAUGE_collective_quant_wire_bytes",
+              sum(census_bytes(plan).values()))
+
+
+def retract_gauges() -> None:
+    """Remove the family entirely (not zero it): a step rebuilt with
+    the flag off must not keep advertising stale bucket geometry —
+    same discipline as the PR-14 scheduler/KV gauge resets."""
+    from ..monitor import _GAUGES, _LOCK
+    with _LOCK:
+        for g in GAUGE_FAMILY:
+            _GAUGES.pop(g, None)

@@ -79,10 +79,24 @@ exposes (always-on, like the serving timers):
   ShardingPlan actually performed (values already resident with the
   right sharding are skipped) — a steady-state training loop must show
   these standing still, or state is ping-ponging between layouts;
-- STAT_mesh_collective_<axis>: host-level collective launches per mesh
-  axis (parallel/collective.py — all_reduce/all_gather/broadcast/
-  all_to_all outside shard_map), the per-axis traffic census
-  MULTICHIP_r06.json records;
+- STAT_mesh_collective_<axis>: collective launches per mesh axis —
+  host-level calls (parallel/collective.py: all_reduce/all_gather/
+  broadcast/all_to_all outside shard_map) plus TrainStep's explicit
+  gradient exchange (counted from its build-time wire manifest), the
+  per-axis traffic census the MULTICHIP round artifact records;
+- STAT_mesh_collective_bytes{axis,dtype}: payload bytes those
+  launches put on the wire, by dtype, under a ring model: each of the
+  p ranks forwards (p-1)/p of the payload per ring pass, AllReduce-
+  family ops (psum/pmean/pmax) cost two passes, all_gather /
+  psum_scatter / all_to_all one. This is the census that proves the
+  int8 collective path (mesh/collectives.py) shrank gradient-sync
+  bytes ≥3x vs fp32;
+- STAT_collective_quant_buckets / _fallbacks and
+  GAUGE_collective_quant_buckets / _small / _wire_bytes: quantized-
+  collective health — bucket exchanges dispatched, buckets demoted to
+  fp32 by the dist.collective_quant failpoint, and the live step's
+  bucket geometry (gauges retracted when the step rebuilds with the
+  flag off, like every PR-14+ gauge family);
 - GAUGE_mesh_devices: device count of the most recently built plan;
 - TIMER_mesh_compile_us: walltime of plan.compile()'s first
   (trace+compile) call with explicit in/out shardings.

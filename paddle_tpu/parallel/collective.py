@@ -48,14 +48,29 @@ def _in_shard_map(axis: str) -> bool:
     return _compat.in_named_axis(axis)
 
 
-def _count_launch(axis: str) -> None:
-    """Per-axis host-level collective counter (STAT_mesh_collective_dp
-    etc.) — the mesh instrument family, docs/spmd.md."""
-    from ..monitor import stat_add
+def _count_launch(axis: str, val=None, passes: int = 2) -> None:
+    """Per-axis host-level collective census — op counts
+    (STAT_mesh_collective_dp etc.) plus payload bytes by dtype
+    (STAT_mesh_collective_bytes{axis,dtype}), the mesh instrument
+    family of docs/spmd.md. Bytes follow the ring model documented in
+    monitor.py: each rank forwards (p-1)/p of the payload per ring
+    pass; AllReduce-family ops cost two passes, gather/scatter/
+    all_to_all one."""
+    from ..monitor import labeled, stat_add
     stat_add("STAT_mesh_collective_%s" % axis)
+    if val is None:
+        return
+    mesh = _envmod.get_mesh()
+    p = int(mesh.shape[axis]) if (
+        mesh is not None and axis in mesh.axis_names) else 1
+    nbytes = int(getattr(val, "nbytes", 0) or 0)
+    if p > 1 and nbytes:
+        stat_add(labeled("STAT_mesh_collective_bytes",
+                         {"axis": axis, "dtype": str(val.dtype)}),
+                 int(passes * nbytes * (p - 1) / p))
 
 
-def _host_collective(fn, x, axis):
+def _host_collective(fn, x, axis, passes: int = 2):
     """Apply a per-rank collective to a host-level value via shard_map.
 
     Rank semantics follow the input's sharding. An array actually sharded
@@ -78,7 +93,7 @@ def _host_collective(fn, x, axis):
                    for a in (entry if isinstance(entry, tuple) else (entry,))]
         if axis in in_axes:
             spec = sh.spec
-    _count_launch(axis)
+    _count_launch(axis, x, passes)
     return jax.jit(_compat.shard_map(fn, mesh=mesh, in_specs=spec,
                                      out_specs=spec, check_vma=False))(x)
 
@@ -126,7 +141,7 @@ def all_gather(x, axis: Optional[str] = None, ring_id: int = 0,
 
     def f(shard):
         return jax.lax.all_gather(shard, axis, axis=tensor_axis, tiled=True)
-    _count_launch(axis)
+    _count_launch(axis, val, passes=1)
     out = jax.jit(_compat.shard_map(f, mesh=mesh, in_specs=spec_in,
                                     out_specs=spec_out, check_vma=False))(val)
     return _rewrap(x, out)
@@ -154,7 +169,7 @@ def broadcast(x, src: int = 0, axis: Optional[str] = None, ring_id: int = 0):
     def f(shard):
         n = _compat.axis_size(axis)
         return jax.lax.ppermute(shard, axis, [(src, i) for i in range(n)])
-    out = _host_collective(f, val, axis)
+    out = _host_collective(f, val, axis, passes=1)
     return _rewrap(x, out)
 
 
@@ -191,7 +206,7 @@ def all_to_all(x, axis: Optional[str] = None, ring_id: int = 0,
     def f(shard):
         return jax.lax.all_to_all(shard, axis, split_axis=split_axis,
                                   concat_axis=concat_axis, tiled=True)
-    _count_launch(axis)
+    _count_launch(axis, val, passes=1)
     out = jax.jit(_compat.shard_map(f, mesh=mesh, in_specs=spec,
                                     out_specs=spec, check_vma=False))(val)
     return _rewrap(x, out)

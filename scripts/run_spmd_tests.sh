@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Run the mesh-native SPMD runtime suite (-m spmd, docs/spmd.md) on the
-# 8-device virtual CPU mesh and emit MULTICHIP_r07.json: the usual
-# multichip dryrun transcript (same shape as MULTICHIP_r0{1..6}.json)
+# 8-device virtual CPU mesh and emit MULTICHIP_r08.json: the usual
+# multichip dryrun transcript (same shape as MULTICHIP_r0{1..7}.json)
 # plus the mesh plan, the per-axis host-collective census
 # (STAT_mesh_collective_<axis>, monitor.py), the chaos smoke
 # (failpoints armed over /failpointz, recovery asserted — ISSUE 9),
@@ -13,7 +13,11 @@
 # served with the int8 KV pool under the plan — ISSUE 15), and the
 # adaptive-dispatch smoke (geometry tuned once, policy scraped from
 # /statusz, restart re-serves from the persisted sidecar with zero
-# trials / zero recompiles / bitwise streams — ISSUE 16).
+# trials / zero recompiles / bitwise streams — ISSUE 16), and the
+# quantized-collective smoke (int8 block-scaled gradient exchange in
+# TrainStep under the plan: census bytes >= 3x smaller than the fp32
+# oracle, loss inside the budget, gauges retract on flag-off rebuild —
+# ISSUE 17).
 #
 # Usage: scripts/run_spmd_tests.sh [extra pytest args...]
 set -u
@@ -481,6 +485,79 @@ try:
 except Exception as e:  # noqa: BLE001 - artifact records the failure
     autotune_smoke["error"] = "%s: %s" % (type(e).__name__, e)
 
+# quantized-collective smoke (ISSUE 17, docs/spmd.md "Quantized
+# collectives"): train under the SAME dp4xmp2 plan with
+# FLAGS_collective_quant=int8 — params replicated, so the dp axis
+# carries the gradient exchange while mp just replicates — and assert
+# against the explicit fp32 oracle: the per-step census says the dp
+# sync wire shrank >= 3x, the loss trajectory stays inside the 0.05
+# budget, the quant instruments are live, and the gauges retract when
+# the step rebuilds with the flag off.
+collective_quant = {"ok": False}
+try:
+    from paddle_tpu import nn
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.monitor import gauge_get, get_float_stats
+
+    def _cq_loss(out, label):
+        d = out - label
+        return (d * d).mean()
+
+    def _cq_build(mode):
+        pt.dygraph.seed(0)
+        np.random.seed(0)
+        set_flags({"FLAGS_collective_quant": mode})
+        m = nn.Sequential(nn.Linear(16, 4096), nn.ReLU(),
+                          nn.Linear(4096, 8))
+        opt = pt.optimizer.SGD(0.05, parameters=m.parameters())
+        return TrainStep(m, _cq_loss, opt, plan=plan)
+
+    def _cq_run(mode, steps=6):
+        step = _cq_build(mode)
+        r = np.random.RandomState(17)
+        out = []
+        for _ in range(steps):
+            xb = r.randn(16, 16).astype(np.float32)
+            yb = r.randn(16, 8).astype(np.float32)
+            out.append(float(step((xb,), (yb,))))
+        return step, out
+
+    with use_plan(plan):
+        cq_fp32, losses_fp32 = _cq_run("fp32")
+        cq_int8, losses_int8 = _cq_run("int8")
+        cq_loss_diff = max(abs(a - b)
+                           for a, b in zip(losses_fp32, losses_int8))
+        by32 = cq_fp32._coll_manifest["bytes"]
+        by8 = cq_int8._coll_manifest["bytes"]
+        cq_ratio = sum(by32.values()) / float(sum(by8.values()))
+        cq_counters = get_float_stats()
+        cq_gauge = gauge_get("GAUGE_collective_quant_wire_bytes")
+        # flag-off rebuild retracts the gauges
+        _cq_build("off")._build()
+        set_flags({"FLAGS_collective_quant": "off"})
+        cq_retracted = "GAUGE_collective_quant_buckets" not in \
+            monitor.snapshot()["gauges"]
+    cq_int8_key = 'STAT_mesh_collective_bytes{axis="dp",dtype="int8"}'
+    collective_quant = {
+        "ok": (cq_ratio >= 3.0 and cq_loss_diff < 0.05
+               and cq_counters.get(cq_int8_key, 0) > 0
+               and cq_gauge > 0 and cq_retracted
+               and all(np.isfinite(losses_int8))),
+        "per_step_sync_bytes_fp32": by32,
+        "per_step_sync_bytes_int8": by8,
+        "sync_bytes_ratio": round(cq_ratio, 2),
+        "loss_max_abs_diff": float(cq_loss_diff),
+        "quantized_buckets": cq_int8._coll_manifest["buckets"],
+        "int8_wire_counter": cq_counters.get(cq_int8_key, 0),
+        "gauges_retract_on_flag_off": cq_retracted,
+    }
+except Exception as e:  # noqa: BLE001 - artifact records the failure
+    collective_quant["error"] = "%s: %s" % (type(e).__name__, e)
+finally:
+    from paddle_tpu.flags import set_flags as _cq_restore
+    _cq_restore({"FLAGS_collective_quant": "off"})
+
 # slo smoke (ISSUE 12, docs/observability.md): enable the windowed SLO
 # engine, drive tenant-attributed traced requests (a quarter of them
 # deadline-missed), scrape /sloz text + JSON and the tenant-filtered
@@ -640,6 +717,7 @@ artifact = {
     and chaos.get("ok", False) and generation.get("ok", False)
     and quant_smoke.get("ok", False)
     and autotune_smoke.get("ok", False)
+    and collective_quant.get("ok", False)
     and slo_smoke.get("ok", False) and multihost.get("ok", False),
     "skipped": False,
     "spmd_tests_rc": test_rc,
@@ -656,6 +734,7 @@ artifact = {
     "generation": generation,
     "quant": quant_smoke,
     "autotune": autotune_smoke,
+    "collective_quant": collective_quant,
     "slo": slo_smoke,
     "collectives": {k: v for k, v in sorted(counters.items())
                     if k.startswith("STAT_mesh_collective_")},
@@ -663,13 +742,14 @@ artifact = {
                       if k.startswith("STAT_mesh_")},
     "tail": buf.getvalue() + ("" if err is None else err + "\n"),
 }
-with open("MULTICHIP_r07.json", "w") as f:
+with open("MULTICHIP_r08.json", "w") as f:
     json.dump(artifact, f, indent=1)
     f.write("\n")
 print(json.dumps({k: artifact[k] for k in
                   ("n_devices", "rc", "ok", "spmd_tests_rc",
                    "introspect", "chaos", "multihost", "generation",
-                   "quant", "autotune", "slo", "collectives")},
+                   "quant", "autotune", "collective_quant", "slo",
+                   "collectives")},
                  indent=1))
 sys.exit(0 if artifact["ok"] else 1)
 EOF

@@ -68,17 +68,24 @@ def _parse_exposition(text):
 
 def _check_family_membership(fams, samples):
     """Every sample belongs to a declared family, in a role its kind
-    allows. This is exactly what a strict scraper enforces."""
+    allows. This is exactly what a strict scraper enforces: counter and
+    gauge samples may carry a label block (the labeled() series of
+    monitor.py — per-tenant families, the per-axis/dtype collective
+    bytes census), but a summary family may only hold quantile samples
+    plus its _sum/_count, and any label block must be well-formed
+    key="value" pairs."""
+    label_re = re.compile(
+        r'^\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\}$')
     for name, labels, _ in samples:
+        if labels:
+            assert label_re.match(labels), \
+                "malformed label block on %s: %r" % (name, labels)
         if name in fams:
             fam, kind = name, fams[name]
             if kind == "summary":
                 assert "quantile=" in labels, \
                     "bare %s sample inside summary family" % name
-            else:
-                assert labels == "", \
-                    "%s family %s sample has labels %s" % (kind, name,
-                                                           labels)
             continue
         base = next((name[:-len(s)] for s in ("_sum", "_count")
                      if name.endswith(s)

@@ -1,0 +1,491 @@
+"""Quantized gradient collectives (ISSUE 17, docs/spmd.md "Quantized
+collectives"): the int8 block-scaled exchange wire format and its
+scale contract, bucket planning, the TrainStep threading behind
+FLAGS_collective_quant (off = legacy, fp32 = explicit synchronous
+oracle, int8 = accumulate-then-quantized-exchange), grad accumulation
+with clip-on-the-averaged-gradient, the dist.collective_quant
+failpoint's per-bucket fp32 fallback, AOT fingerprint isolation, and
+the bytes-by-dtype census on /statusz."""
+import contextlib
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import failpoints
+from paddle_tpu.flags import get_flag, set_flags
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.mesh import ShardingPlan
+from paddle_tpu.mesh import collectives as coll
+from paddle_tpu.mesh import compat as _compat
+from paddle_tpu.monitor import reset_all, snapshot, stat_get
+
+
+@contextlib.contextmanager
+def _flags(**kv):
+    old = {k: get_flag(k) for k in kv}
+    set_flags(kv)
+    try:
+        yield
+    finally:
+        set_flags(old)
+
+
+def _ts_loss(out, label):
+    import paddle_tpu.nn.functional as F
+    return F.cross_entropy(out, label)
+
+
+def _build_step(mode, seed=42, accum=1, hidden=64, min_numel=16):
+    from paddle_tpu import nn
+    pt.dygraph.seed(seed)
+    np.random.seed(seed)
+    m = nn.Sequential(nn.Linear(8, hidden), nn.ReLU(),
+                      nn.Linear(hidden, 4))
+    o = pt.optimizer.SGD(0.1, parameters=m.parameters())
+    set_flags({"FLAGS_collective_quant": mode,
+               "FLAGS_collective_quant_min_numel": min_numel})
+    return TrainStep(m, _ts_loss, o, plan=ShardingPlan("dp4"),
+                     grad_accum_steps=accum)
+
+
+def _run(step, steps=5, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(steps):
+        x = rng.randn(batch, 8).astype(np.float32)
+        y = rng.randint(0, 4, (batch, 1)).astype(np.int32)
+        out.append(float(step((x,), (y,))))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scale contract / wire format
+# ---------------------------------------------------------------------------
+
+def _wire_roundtrip(x_global, in_spec):
+    """Run the int8 exchange over dp4 and return the per-rank result
+    stack."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    plan = coll.plan_buckets({"w": (1, x_global.shape[-1])}, "dp", 4,
+                             mode="int8", bucket_mb=4, min_numel=1)
+    (bucket,) = plan.buckets
+
+    def body(x):
+        flat = coll.bucket_concat([x.reshape(-1)], bucket)
+        out = coll.exchange_bucket(flat, bucket, plan)
+        return coll.bucket_split(out, bucket)[0]
+
+    mesh = ShardingPlan("dp4").mesh
+    f = _compat.shard_map(body, mesh=mesh, in_specs=in_spec,
+                          out_specs=P(), check_vma=False)
+    return np.asarray(jax.jit(f)(x_global)).ravel()
+
+
+def test_wire_roundtrip_replicated_is_quantize_dequantize():
+    """With the same value on every rank, the full wire (shared-scale
+    quantize -> int8 ReduceScatter -> requantize -> AllGather ->
+    dequant) must collapse to one quantize/dequantize round trip: the
+    integer shard sum is exact and the mean requantizes losslessly
+    onto the same grid."""
+    from jax.sharding import PartitionSpec as P
+    rng = np.random.RandomState(7)
+    x = (rng.randn(coll.BLOCK * 8) * 3.0).astype(np.float32)
+    got = _wire_roundtrip(x, P())
+    # reference: per-block absmax contract from quant/ (PR 15)
+    blocks = x.reshape(-1, coll.BLOCK)
+    s = np.abs(blocks).max(axis=1)
+    s = np.where(s > 0.0, s, 1.0)
+    ref = (np.round(blocks * (127.0 / s[:, None])) *
+           (s[:, None] / 127.0)).reshape(-1)
+    np.testing.assert_allclose(got, ref, rtol=0, atol=1e-6)
+    # quantization error bounded by half a grid step per block
+    assert np.max(np.abs(got - x)) <= (s.max() / 127.0) * 0.5 + 1e-6
+
+
+def test_wire_dead_block_guard_exact_zeros():
+    """An all-zero scale block must round-trip to EXACT zeros: the
+    dead-block guard pins its divisor to 1.0 before the store (the
+    PR-15 contract), so no 0/0 NaN can enter the gradient stream."""
+    from jax.sharding import PartitionSpec as P
+    x = np.zeros(coll.BLOCK * 4, np.float32)
+    x[coll.BLOCK:2 * coll.BLOCK] = 1.5  # one live block among dead ones
+    got = _wire_roundtrip(x, P())
+    assert np.all(np.isfinite(got))
+    assert np.all(got[:coll.BLOCK] == 0.0)
+    assert np.all(got[2 * coll.BLOCK:] == 0.0)
+
+
+def test_wire_rank_varying_mean_within_grid_error():
+    """Rank-varying inputs: the exchange must return the cross-rank
+    mean within the shared-scale int8 grid error."""
+    from jax.sharding import PartitionSpec as P
+    rng = np.random.RandomState(11)
+    x = rng.randn(4, coll.BLOCK * 4).astype(np.float32)
+    got = _wire_roundtrip(x, P("dp"))
+    want = x.mean(axis=0)
+    # two rounding stages (per-rank quantize + requantized mean), each
+    # at most half a step of the shared per-block grid
+    step = np.abs(x).max() / 127.0
+    assert np.max(np.abs(got - want)) <= 1.5 * step
+
+
+# ---------------------------------------------------------------------------
+# bucket planning
+# ---------------------------------------------------------------------------
+
+_SHAPES = {
+    "layer0.w": (256, 64), "layer0.b": (64,),
+    "layer1.w": (512, 512), "layer1.b": (512,),
+    "head.w": (512, 128), "tiny.w": (4, 4),
+}
+
+
+def test_plan_small_grad_fp32_fallback_threshold():
+    plan = coll.plan_buckets(_SHAPES, "dp", 4, mode="int8", bucket_mb=4,
+                             min_numel=2048)
+    small = dict(plan.small)
+    # 1-D always small; below-threshold 2-D small; the rest bucketed
+    assert set(small) == {"layer0.b", "layer1.b", "tiny.w"}
+    bucketed = [n for b in plan.buckets for n in b.names]
+    assert set(bucketed) == {"layer0.w", "layer1.w", "head.w"}
+    assert all(b.quantized for b in plan.buckets)
+    # raising the threshold demotes more tensors to the fp32 path
+    plan2 = coll.plan_buckets(_SHAPES, "dp", 4, mode="int8", bucket_mb=4,
+                              min_numel=100_000)
+    assert [n for b in plan2.buckets for n in b.names] == ["layer1.w"]
+
+
+def test_plan_deterministic_reverse_order_and_cap():
+    a = coll.plan_buckets(_SHAPES, "dp", 4, mode="int8", bucket_mb=1,
+                          min_numel=2048)
+    b = coll.plan_buckets(_SHAPES, "dp", 4, mode="int8", bucket_mb=1,
+                          min_numel=2048)
+    assert a == b  # pure function of (shapes, axis, flags)
+    # reverse-topological: last-constructed big tensor leads bucket 0
+    assert a.buckets[0].names[0] == "head.w"
+    cap = 1 * (1 << 20) // 4
+    for bk in a.buckets:
+        assert bk.numel <= cap or len(bk.names) == 1
+        assert bk.padded % (coll.BLOCK * 4) == 0
+        assert bk.padded >= bk.numel
+    # every tensor lands exactly once
+    names = [n for bk in a.buckets for n in bk.names] + \
+        [n for n, _ in a.small]
+    assert sorted(names) == sorted(_SHAPES)
+
+
+def test_plan_fp32_mode_never_quantizes():
+    plan = coll.plan_buckets(_SHAPES, "dp", 4, mode="fp32", bucket_mb=4,
+                             min_numel=2048)
+    assert plan.buckets and not any(b.quantized for b in plan.buckets)
+
+
+# ---------------------------------------------------------------------------
+# failpoint: per-bucket fp32 fallback (mirrors generation.kv_quant)
+# ---------------------------------------------------------------------------
+
+def test_collective_quant_failpoint_demotes_one_bucket():
+    assert "dist.collective_quant" in failpoints.KNOWN_SITES
+    shapes = {"a.w": (600, 512), "b.w": (600, 512)}  # 2 buckets @ 1MiB
+    f0 = stat_get("STAT_collective_quant_fallbacks")
+    failpoints.arm_spec("dist.collective_quant=raise@once")
+    try:
+        plan = coll.plan_buckets(shapes, "dp", 4, mode="int8",
+                                 bucket_mb=1, min_numel=2048)
+    finally:
+        failpoints.disarm("dist.collective_quant")
+    # the faulted bucket fell back to fp32; the other stayed quantized
+    assert [b.quantized for b in plan.buckets] == [False, True]
+    assert stat_get("STAT_collective_quant_fallbacks") == f0 + 1
+    # disarmed: both quantize
+    plan2 = coll.plan_buckets(shapes, "dp", 4, mode="int8",
+                              bucket_mb=1, min_numel=2048)
+    assert all(b.quantized for b in plan2.buckets)
+    assert stat_get("STAT_collective_quant_fallbacks") == f0 + 1
+
+
+def test_collective_quant_fault_step_still_converges():
+    """Every bucket demoted by an armed fault -> the step runs the
+    fp32 exchange and produces the SAME losses as the explicit fp32
+    oracle (with accum=1 their traces coincide)."""
+    with _flags(FLAGS_collective_quant="off",
+                FLAGS_collective_quant_min_numel=2048):
+        oracle = _run(_build_step("fp32"))
+        failpoints.arm_spec("dist.collective_quant=raise")
+        try:
+            step = _build_step("int8")
+            faulted = _run(step)
+        finally:
+            failpoints.disarm("dist.collective_quant")
+        assert step._coll_manifest["buckets"] == 0  # nothing quantized
+        assert all(np.isfinite(faulted))
+        np.testing.assert_allclose(faulted, oracle, rtol=0, atol=1e-6)
+        # fp32 engines never reach the site: armed 'raise' cannot fire
+        failpoints.arm_spec("dist.collective_quant=raise")
+        try:
+            assert all(np.isfinite(_run(_build_step("fp32"), steps=2)))
+        finally:
+            failpoints.disarm("dist.collective_quant")
+
+
+# ---------------------------------------------------------------------------
+# TrainStep threading: modes, trajectory, recompiles, census
+# ---------------------------------------------------------------------------
+
+def test_trainstep_off_mode_untouched_and_uninstrumented():
+    with _flags(FLAGS_collective_quant="off"):
+        reset_all()
+        s1 = _build_step("off")
+        l1 = _run(s1)
+        l2 = _run(_build_step("off"))
+        assert l1 == l2  # deterministic legacy path
+        assert s1._coll_manifest is None  # no manifest, no census
+        snap = snapshot()
+        assert not any("collective_quant" in k
+                       for k in snap["counters"])
+        assert not any(k.startswith("GAUGE_collective_quant")
+                       for k in snap["gauges"])
+
+
+def test_trainstep_int8_trajectory_and_zero_recompiles():
+    with _flags(FLAGS_collective_quant="off",
+                FLAGS_collective_quant_min_numel=16):
+        reset_all()
+        oracle = _run(_build_step("fp32"), steps=8)
+        step = _build_step("int8")
+        got = _run(step, steps=8)
+        # int8 grads diverge only within the quantization error budget
+        diff = max(abs(a - b) for a, b in zip(got, oracle))
+        assert diff < 5e-3, (diff, got, oracle)
+        assert step._step_fn._cache_size() == 1  # zero steady-state
+        m = step._coll_manifest
+        assert m["axis"] == "dp" and m["buckets"] >= 1
+        assert m["bytes"]["int8"] > 0
+        # fp32 oracle manifest carries no int8 wire at all
+        fp_step = _build_step("fp32")
+        fp_step._build()
+        fp = fp_step._coll_manifest
+        assert "int8" not in fp["bytes"] and fp["buckets"] == 0
+
+
+def test_census_bytes_shrink_3x_on_bert_scale_shapes():
+    """The >=3x wire-byte claim (ISSUE 17 acceptance) holds at
+    realistic gradient sizes where the BLOCK*dp bucket padding is
+    amortized — BERT-base-ish matrices, not toy Linear layers. (The
+    executed-census version of this gate runs in bench.py's
+    quantized_collectives block and the run_spmd_tests.sh smoke.)"""
+    shapes = {}
+    for i in range(12):
+        shapes["l%d.qkv" % i] = (768, 2304)
+        shapes["l%d.out" % i] = (768, 768)
+        shapes["l%d.ffn_in" % i] = (768, 3072)
+        shapes["l%d.ffn_out" % i] = (3072, 768)
+        shapes["l%d.ln_g" % i] = (768,)
+    kw = dict(bucket_mb=4, min_numel=2048)
+    b8 = coll.census_bytes(
+        coll.plan_buckets(shapes, "dp", 4, mode="int8", **kw))
+    b32 = coll.census_bytes(
+        coll.plan_buckets(shapes, "dp", 4, mode="fp32", **kw))
+    assert sum(b32.values()) >= 3 * sum(b8.values()), (b32, b8)
+
+
+def test_trainstep_census_and_statusz_section():
+    with _flags(FLAGS_collective_quant="off",
+                FLAGS_collective_quant_min_numel=16):
+        reset_all()
+        with _flags(FLAGS_collective_quant="int8"):
+            step = _build_step("int8")
+            _run(step, steps=3)
+            from paddle_tpu.introspect import statusz
+            sz = statusz()["mesh"]["collectives"]
+            assert sz["quant"]["mode"] == "int8"
+        assert sz["ops"].get("dp", 0) > 0
+        assert sz["bytes"]["dp"]["int8"] == 3 * \
+            step._coll_manifest["bytes"]["int8"]
+        assert sz["quant"]["buckets"] >= 1
+        assert sz["quant"]["bucket_exchanges"] == 3 * \
+            step._coll_manifest["buckets"]
+        assert sz["quant"]["fallbacks"] == 0
+        # gauges retract when the step rebuilds with the flag off
+        _build_step("off")._build()
+        assert "GAUGE_collective_quant_buckets" not in \
+            snapshot()["gauges"]
+
+
+def test_host_collective_bytes_census_by_dtype():
+    """Satellite: parallel/collective.py host-level calls count wire
+    bytes by dtype under the same ring model."""
+    import paddle_tpu.parallel as dist
+    from paddle_tpu.mesh import use_plan
+    reset_all()
+    with use_plan(ShardingPlan("dp4")):
+        x = np.ones((8, 4), np.float32)
+        dist.all_reduce(x)
+    key = 'STAT_mesh_collective_bytes{axis="dp",dtype="float32"}'
+    # AllReduce rings twice: 2 * 128B * 3/4
+    assert stat_get(key) == 2 * x.nbytes * 3 / 4
+
+
+# ---------------------------------------------------------------------------
+# grad accumulation: clip applies to the AVERAGED gradient
+# ---------------------------------------------------------------------------
+
+def _mse(out, label):
+    d = out - label
+    return (d * d).mean()
+
+
+def _clip_step(accum, seed=5):
+    from paddle_tpu import nn
+    from paddle_tpu.optimizer import GradientClipByGlobalNorm
+    pt.dygraph.seed(seed)
+    np.random.seed(seed)
+    m = nn.Linear(8, 4)
+    o = pt.optimizer.SGD(
+        0.2, parameters=m.parameters(),
+        grad_clip=GradientClipByGlobalNorm(0.05))
+    return TrainStep(m, _mse, o, grad_accum_steps=accum)
+
+
+def test_grad_accum_clip_matches_big_batch():
+    """grad_accum_steps=4 must match the equivalent big-batch step:
+    global-norm clipping applies once to the averaged accumulated
+    gradient — clipping per microbatch would rescale each microbatch
+    by its own norm and the trajectories would split immediately (the
+    0.05 clip_norm is tight enough that clipping is ACTIVE here)."""
+    rng = np.random.RandomState(2)
+    xs = [rng.randn(16, 8).astype(np.float32) for _ in range(4)]
+    ys = [rng.randn(16, 4).astype(np.float32) for _ in range(4)]
+    big = _clip_step(accum=1)
+    acc = _clip_step(accum=4)
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        lb = float(big((x,), (y,)))
+        la = float(acc((x,), (y,)))
+        assert abs(lb - la) < 1e-5, (i, lb, la)
+    # clipping really engaged: an unclipped run must diverge from the
+    # clipped one (grad norm of a fresh MSE head >> clip_norm=0.05)
+    from paddle_tpu import nn
+    pt.dygraph.seed(5)
+    np.random.seed(5)
+    m = nn.Linear(8, 4)
+    o = pt.optimizer.SGD(0.2, parameters=m.parameters())
+    unclipped = TrainStep(m, _mse, o, grad_accum_steps=1)
+    lu = [float(unclipped((x,), (y,))) for x, y in zip(xs, ys)]
+    lc = [float(_clip_step(accum=1)((x,), (y,))) for x, y in zip(xs, ys)]
+    assert max(abs(a - b) for a, b in zip(lu, lc)) > 1e-3
+
+
+def test_grad_accum_under_quantized_modes():
+    """Accumulation composes with the explicit-exchange step: fp32
+    mode (sync every microbatch) and the off-mode legacy loop agree to
+    fp32 tolerance; int8 (one deferred quantized exchange) stays
+    within the quantization budget."""
+    with _flags(FLAGS_collective_quant="off",
+                FLAGS_collective_quant_min_numel=16):
+        base = _run(_build_step("off", accum=4), steps=4)
+        fp32 = _run(_build_step("fp32", accum=4), steps=4)
+        int8 = _run(_build_step("int8", accum=4), steps=4)
+    d_fp = max(abs(a - b) for a, b in zip(base, fp32))
+    d_i8 = max(abs(a - b) for a, b in zip(base, int8))
+    assert d_fp < 1e-4, (d_fp, base, fp32)
+    assert d_i8 < 5e-3, (d_i8, base, int8)
+
+
+def test_grad_accum_rejects_indivisible_batch():
+    step = _build_step("off", accum=3)
+    with pytest.raises(ValueError, match="does not divide"):
+        _run(step, steps=1, batch=16)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint isolation: quant-on/off programs get disjoint AOT entries
+# ---------------------------------------------------------------------------
+
+def test_lowering_snapshot_isolates_quant_collectives():
+    from paddle_tpu.flags import _LOWERING_FLAGS, lowering_snapshot
+    for f in ("FLAGS_collective_quant", "FLAGS_collective_bucket_mb",
+              "FLAGS_collective_quant_min_numel"):
+        assert f in _LOWERING_FLAGS
+    with _flags(FLAGS_collective_quant="off"):
+        snap_off = lowering_snapshot()
+        with _flags(FLAGS_collective_quant="int8"):
+            snap_int8 = lowering_snapshot()
+    assert snap_off != snap_int8
+
+
+def test_program_fingerprint_disjoint_per_mode():
+    prog = pt.Program()
+    with _flags(FLAGS_collective_quant="off"):
+        fp_off = prog.fingerprint(feed_sig=(), fetch_names=())
+        with _flags(FLAGS_collective_quant="int8"):
+            fp_int8 = prog.fingerprint(feed_sig=(), fetch_names=())
+        with _flags(FLAGS_collective_bucket_mb=16):
+            fp_bucket = prog.fingerprint(feed_sig=(), fetch_names=())
+    assert fp_off and fp_int8 and fp_bucket
+    assert len({fp_off, fp_int8, fp_bucket}) == 3
+
+
+# ---------------------------------------------------------------------------
+# stat_diff cost family
+# ---------------------------------------------------------------------------
+
+def test_stat_diff_flags_fallbacks_not_buckets():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "stat_diff", os.path.join(os.path.dirname(__file__), "..",
+                                  "tools", "stat_diff.py"))
+    sd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sd)
+    assert sd._is_cost_counter("STAT_collective_quant_fallbacks")
+    assert not sd._is_cost_counter("STAT_collective_quant_buckets")
+    # labeled byte census diffs like its family, not as a cost
+    assert not sd._is_cost_counter(
+        'STAT_mesh_collective_bytes{axis="dp",dtype="int8"}')
+
+
+# ---------------------------------------------------------------------------
+# 12-layer BERT-shaped trajectory under dp4 (the bench-scale claim)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.spmd
+@pytest.mark.slow
+def test_bert_dp4_fp32_vs_int8_loss_budget():
+    """fp32-vs-int8 loss trajectory on a 12-layer BERT-shaped step
+    under dp4 — the in-repo version of bench.py's 50-step
+    quantized_collectives gate (budget stated in docs/spmd.md)."""
+    from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
+                                        pretraining_loss)
+    cfg = BertConfig(vocab_size=128, hidden_size=64,
+                     num_hidden_layers=12, num_attention_heads=2,
+                     intermediate_size=128, max_position_embeddings=32,
+                     hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+    B, S, steps = 8, 16, 6
+    rng = np.random.RandomState(0)
+    batches = []
+    for _ in range(steps):
+        ids = rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32)
+        mlm = np.where(rng.rand(B, S) < 0.15, ids, -100).astype(np.int32)
+        nsp = rng.randint(0, 2, (B, 1)).astype(np.int32)
+        batches.append((ids, mlm, nsp))
+
+    def run(mode):
+        pt.dygraph.seed(0)
+        np.random.seed(0)
+        model = BertForPretraining(cfg)
+        opt = pt.optimizer.Adam(1e-3, parameters=model.parameters())
+        with _flags(FLAGS_collective_quant=mode):
+            step = TrainStep(model, pretraining_loss, opt,
+                             plan=ShardingPlan("dp4"))
+            losses = [float(step((ids,), (mlm, nsp)))
+                      for ids, mlm, nsp in batches]
+        return losses, step._step_fn._cache_size()
+
+    fp32, c_fp = run("fp32")
+    int8, c_i8 = run("int8")
+    diff = max(abs(a - b) for a, b in zip(fp32, int8))
+    assert diff < 0.05, (diff, fp32, int8)
+    assert c_fp == 1 and c_i8 == 1  # zero steady-state recompiles

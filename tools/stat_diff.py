@@ -45,15 +45,21 @@ COST_SUFFIXES = ("_sync", "_miss", "_corrupt", "_evict", "_dropped",
 # in a quant-OFF baseline run means the fp32 path silently started
 # quantizing — a correctness regression the percentage gate must flag
 # regardless of magnitude.
-COST_INFIXES = ("_shed_", "_restart", "_kv_quant_", "_autotune_")
+COST_INFIXES = ("_shed_", "_restart", "_kv_quant_", "_autotune_",
+                "_collective_quant_")
 # cost-family exemptions: STAT_autotune_cache_hits is the HEALTHY
 # autotune steady state (policy resolved from the table, no trials
 # run) — growth there is good. Growth in the rest of the _autotune_
 # family (trials/wins/fallbacks) during a steady-state run means the
 # policy cache is missing every step (a re-tuning loop: key churn,
 # corrupt sidecar, or a reset() in the hot path), which is exactly the
-# regression the cost gate must flag (docs/autotune.md).
-COST_EXEMPT_SUFFIXES = ("_autotune_cache_hits",)
+# regression the cost gate must flag (docs/autotune.md). Likewise
+# STAT_collective_quant_buckets is the healthy quantized-collective
+# steady state (bucket exchanges dispatched per step, docs/spmd.md);
+# only _fallbacks growth — buckets demoted to fp32 by faults — is a
+# cost.
+COST_EXEMPT_SUFFIXES = ("_autotune_cache_hits",
+                        "_collective_quant_buckets")
 
 
 def _family(name: str) -> str:
