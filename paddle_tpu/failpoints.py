@@ -20,6 +20,9 @@ Arming a site attaches an *action* (what to inject) gated by a
               once              first call only, then auto-disarm
               every(N)          calls N, 2N, 3N, ...
               after(N)          every call after the first N
+              first(N)          calls 1..N only, then stays quiet —
+                                a self-clearing injection (the
+                                straggler drill's "disarm")
               prob(p,seed)      Bernoulli(p) from an explicit seeded PRNG
 
 Sites are armed from a spec string — clauses ``site=action@trigger``
@@ -33,6 +36,13 @@ via (in precedence order) the ``/failpointz`` HTTP endpoint (POST),
 ``set_flags({"FLAGS_failpoints": spec})``, the ``PADDLE_TPU_FAILPOINTS``
 environment variable (read once at import), or programmatically with
 :func:`arm` / :func:`arm_spec` / the :func:`armed` context manager.
+
+Gang workers can additionally be armed *per rank*: when
+``PADDLE_TRAINER_ID`` is ``k``, the ``PADDLE_TPU_FAILPOINTS_RANK<k>``
+environment variable (also read once at import) arms only that rank —
+every rank of a gang inherits the same supervisor environment, so this
+is how a drill injects a fault into exactly one rank (e.g. the
+straggler drill arms ``worker.step=delay(250)@first(8)`` on rank 1).
 
 Hit counts (calls seen while armed / faults actually fired) are kept
 per site and survive disarming, so a chaos harness can arm, drive load,
@@ -147,6 +157,8 @@ class _Failpoint:
                 return n % self.trigger_arg == 0
             if self.trigger == "after":
                 return n > self.trigger_arg
+            if self.trigger == "first":
+                return n <= self.trigger_arg
             if self.trigger == "prob":
                 return self._rng.random() < self.trigger_arg[0]
             return False
@@ -232,7 +244,7 @@ def _parse_call(text: str) -> Tuple[str, Optional[str]]:
 
 
 _ACTIONS = ("raise", "delay", "corrupt", "truncate")
-_TRIGGERS = ("always", "once", "every", "after", "prob")
+_TRIGGERS = ("always", "once", "every", "after", "first", "prob")
 
 
 def _parse_clause(clause: str) -> Tuple[str, str, Any, str, Any]:
@@ -267,7 +279,7 @@ def _parse_clause(clause: str) -> Tuple[str, str, Any, str, Any]:
     else:  # raise
         action_arg = a_arg  # optional message
     # normalize trigger arg
-    if trigger in ("every", "after"):
+    if trigger in ("every", "after", "first"):
         if t_arg is None:
             raise ValueError("%s needs a count arg: %s(N)"
                              % (trigger, trigger))
@@ -361,8 +373,25 @@ def reset_counts() -> None:
         _COUNTS.clear()
 
 
+def _arm_from_env(environ: Dict[str, str]) -> List[str]:
+    """Arm from *environ*: the global ``PADDLE_TPU_FAILPOINTS`` spec
+    plus, when ``PADDLE_TRAINER_ID`` is set, the rank-targeted
+    ``PADDLE_TPU_FAILPOINTS_RANK<id>`` spec.  Rank targeting is how a
+    gang-wide environment injects a fault into exactly one worker
+    (ISSUE 18 straggler drill).  Returns the sites armed."""
+    armed_sites: List[str] = []
+    spec = environ.get("PADDLE_TPU_FAILPOINTS", "")
+    if spec:
+        armed_sites += arm_spec(spec)
+    rank = environ.get("PADDLE_TRAINER_ID")
+    if rank is not None:
+        spec = environ.get("PADDLE_TPU_FAILPOINTS_RANK%s" % rank.strip(), "")
+        if spec:
+            armed_sites += arm_spec(spec)
+    return armed_sites
+
+
 # Env arming happens once at import so a process can be launched with
-# faults pre-armed (chaos smoke, kill-and-resume child processes).
-_env_spec = os.environ.get("PADDLE_TPU_FAILPOINTS", "")
-if _env_spec:
-    arm_spec(_env_spec)
+# faults pre-armed (chaos smoke, kill-and-resume child processes,
+# rank-targeted gang drills).
+_arm_from_env(os.environ)

@@ -303,6 +303,31 @@ _DEFS: Dict[str, Any] = {
     # overhead would eat the int8 savings and 1-D params are the most
     # error-sensitive
     "FLAGS_collective_quant_min_numel": 2048,
+    # gang-wide observability (docs/observability.md "Gang-wide
+    # observability"): host-measured per-phase step timing in TrainStep
+    # (TIMER_step_phase_us{phase=stage|dispatch|compute|exchange|sync}
+    # plus phase="total"). Off by default: the enabled path serializes
+    # the dispatch-ahead pipeline (each step blocks to attribute time),
+    # and on the manual collective path it adds a pre-exchange sync
+    # fence output to the step program — hence a lowering flag
+    "FLAGS_step_phases": False,
+    # heartbeat-piggybacked worker metrics digest (launch.py): when on,
+    # each heartbeat line carries a bounded versioned "digest" field
+    # (step counter, phase-timer window stats, collective byte deltas,
+    # KV occupancy). When off the wire line is byte-identical to the
+    # PR-13 format and the disabled path is one flag lookup
+    "FLAGS_launch_digest": True,
+    # hard cap on the serialized digest JSON (bytes). Oversized digests
+    # degrade (drop detail, then drop the digest entirely) worker-side;
+    # the supervisor independently rejects oversized lines
+    "FLAGS_launch_digest_max_bytes": 1024,
+    # straggler skew score above which a rank counts as a straggler
+    # (score = per-rank windowed self step-time / gang lower-median;
+    # see GAUGE_gang_straggler_score in docs/observability.md)
+    "FLAGS_launch_straggler_threshold": 2.0,
+    # trailing window (seconds) for the supervisor's per-rank step-rate
+    # / skew computation. 0 = auto: 20x the gang heartbeat interval
+    "FLAGS_launch_straggler_window_s": 0.0,
 }
 
 _values: Dict[str, Any] = dict(_DEFS)
@@ -341,6 +366,10 @@ _LOWERING_FLAGS = [
     "FLAGS_collective_quant",
     "FLAGS_collective_bucket_mb",
     "FLAGS_collective_quant_min_numel",
+    # the manual-collective step program grows a pre-exchange sync
+    # fence output when phase timing is on: fenced and unfenced step
+    # programs must never share a compiled entry
+    "FLAGS_step_phases",
 ]
 
 

@@ -39,6 +39,11 @@ code could. Endpoints:
                  "Multi-host fault model"): per-worker state,
                  last-heartbeat age, step progress, restart budget —
                  read from the supervisor process
+- ``/gangz``     gang observability plane (docs/observability.md
+                 "Gang-wide observability"): per-rank step-phase p50s
+                 from the heartbeat-piggybacked digests, straggler
+                 scores, collective-wait fractions, KV occupancy
+                 (text; ``?format=json`` for the raw payload)
 
 Lifecycle: **off by default, zero overhead when off.**
 ``FLAGS_introspect_port`` is 0 → :func:`maybe_start` (called from
@@ -296,11 +301,23 @@ def _autotune_status(counters: Dict[str, Any]) -> Dict[str, Any]:
 
 def _gang_status() -> list:
     """The /statusz "gangs" section: one compact line per supervised
-    gang (/workerz has the full per-worker table)."""
+    gang (/workerz has the full per-worker table, /gangz the digest
+    view). `max_straggler` is the worst per-rank skew score from the
+    heartbeat digests — the one number a dashboard needs to decide
+    whether to click through to /gangz."""
     from . import launch
-    return [{"name": g["name"], "state": g["state"],
-             "restarts": g["restarts"], "workers": len(g["workers"])}
-            for g in launch.workerz()["gangs"]]
+    out = []
+    for g in launch.workerz()["gangs"]:
+        scores = [(w.get("straggler_score"), w.get("rank"))
+                  for w in g["workers"]
+                  if w.get("straggler_score") is not None]
+        row = {"name": g["name"], "state": g["state"],
+               "restarts": g["restarts"], "workers": len(g["workers"])}
+        if scores:
+            worst, rank = max(scores)
+            row["max_straggler"] = {"rank": rank, "score": worst}
+        out.append(row)
+    return out
 
 
 def _slo_status() -> Dict[str, Any]:
@@ -426,12 +443,20 @@ class _Handler(BaseHTTPRequestHandler):
             elif url.path == "/workerz":
                 from . import launch
                 self._json(launch.workerz())
+            elif url.path == "/gangz":
+                from . import launch
+                q = parse_qs(url.query)
+                if q.get("format", [""])[0] == "json":
+                    self._json(launch.gangz())
+                else:
+                    self._send(200, launch.gangz_text(),
+                               "text/plain; charset=utf-8")
             elif url.path == "/":
                 self._send(
                     200,
                     "paddle_tpu introspection: /metrics /healthz "
                     "/readyz /statusz /flightz /programz /tracez "
-                    "/sloz /failpointz /workerz\n",
+                    "/sloz /failpointz /workerz /gangz\n",
                     "text/plain; charset=utf-8")
             else:
                 self._send(404, "not found: %s\n" % url.path,

@@ -17,6 +17,7 @@ ParallelExecutor/SSA-graph machinery of framework/details/).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -152,6 +153,26 @@ def _unwrap(x):
     if x is None:  # optional model inputs (e.g. token_type_ids) pass through
         return None
     return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# precomposed TIMER_step_phase_us{phase=...} keys: label composition
+# costs string work per call, and the phase set is tiny and fixed
+_PHASE_KEYS: Dict[str, str] = {}
+
+# every phase the decomposition can emit, in timeline order ("total" is
+# the whole-step series the others sum to; "exchange" appears only on
+# the manual collective path, where the fence separates it)
+STEP_PHASES = ("stage", "dispatch", "compute", "exchange", "sync",
+               "total")
+
+
+def _phase_timer(phase: str) -> str:
+    key = _PHASE_KEYS.get(phase)
+    if key is None:
+        from .monitor import labeled
+        key = _PHASE_KEYS[phase] = labeled("TIMER_step_phase_us",
+                                           {"phase": phase})
+    return key
 
 
 def _accum_init(p, fill, is_scalar):
@@ -319,6 +340,10 @@ class TrainStep:
         from .mesh import collectives as _coll
         _coll.retract_gauges()
         self._coll_manifest = None
+        # no fence output on the GSPMD path: the compiler owns the
+        # gradient sync, so exchange-wait cannot be separated from
+        # device compute (docs/observability.md documents the split)
+        self._has_fence = False
 
         def step(state, opt_state, lr_step, rng, batch):
             inputs, labels = batch
@@ -423,6 +448,12 @@ class TrainStep:
             "buckets": reps * sum(1 for b in cplan.buckets if b.quantized),
         }
         pn, bn = self.param_names, self.buffer_names
+        # step-phase fence (ISSUE 18): an extra rank-sharded (1,)
+        # output depending on every PRE-exchange gradient, so the host
+        # can time "local compute done" separately from "bucketed
+        # exchange done". Baked into the trace -> lowering flag.
+        phases = bool(get_flag("FLAGS_step_phases"))
+        self._has_fence = phases
 
         def step(state, opt_state, lr_step, rng, batch):
             inputs, labels = batch
@@ -434,7 +465,7 @@ class TrainStep:
                 # shard, so dropout/noise streams must differ too
                 r = jax.random.fold_in(brng, jax.lax.axis_index(dp_axis))
                 rngs = jax.random.split(r, k)
-                losses, acc, new_buf = [], None, None
+                losses, acc, new_buf, fence = [], None, None, None
                 for i in range(k):
                     (l, new_buf), g = jax.value_and_grad(
                         self._make_loss_of(
@@ -442,6 +473,12 @@ class TrainStep:
                             _microbatch(blabels, k, i)),
                         has_aux=True)(bparams)
                     losses.append(l)
+                    if phases:
+                        # accumulated per microbatch so the fence stays
+                        # pre-exchange even in fp32 mode, where the
+                        # exchange runs inside this loop
+                        f = coll.phase_fence(g)
+                        fence = f if fence is None else fence + f
                     if mode == "fp32":
                         # synchronous oracle: exchange EVERY microbatch
                         g = coll.exchange_grads(g, cplan)
@@ -460,6 +497,8 @@ class TrainStep:
                     n: (jax.lax.pmean(v, dp_axis)
                         if jnp.issubdtype(v.dtype, jnp.floating) else v)
                     for n, v in new_buf.items()}
+                if phases:
+                    return loss, grads, new_buf, fence
                 return loss, grads, new_buf
 
             def _in_spec(prefix, vals):
@@ -480,17 +519,24 @@ class TrainStep:
             # differentiates THROUGH the shard_map (value_and_grad is
             # inside the body), so the transpose caveat in compat.py
             # does not apply
+            # the fence out_spec shards over the dp axis: pre-exchange
+            # grads are rank-varying, and a replicated fence would
+            # itself force the sync it is meant to observe
+            out_specs = (P(), P(), P(), P(dp_axis)) if phases \
+                else (P(), P(), P())
             synced = _compat.shard_map(
                 body, mesh=mesh,
                 in_specs=(P(), P(), P(), _in_spec("input", inputs),
                           _in_spec("label", labels)),
-                out_specs=(P(), P(), P()),
+                out_specs=out_specs,
                 check_vma=False)
-            loss, grads, new_buf = synced(params, consts, rng,
-                                          inputs, labels)
+            res = synced(params, consts, rng, inputs, labels)
+            loss, grads, new_buf = res[0], res[1], res[2]
             new_params, new_opt = self._opt_update(params, grads,
                                                    opt_state, lr_step)
             new_state = {**new_buf, **new_params}
+            if phases:
+                return loss, new_state, new_opt, lr_step + 1, res[3]
             return loss, new_state, new_opt, lr_step + 1
 
         jit_kwargs = {}
@@ -587,6 +633,12 @@ class TrainStep:
                 self._opt_state = self._init_opt_state(self._state)
         if self._pending_restore is not None:
             self._apply_restore()
+        # step-phase decomposition (docs/observability.md): consecutive
+        # host intervals from one clock, so the phases sum to the
+        # step's wall time by construction. Off: one flag lookup.
+        from .flags import get_flag
+        phases_on = bool(get_flag("FLAGS_step_phases"))
+        t0 = time.perf_counter() if phases_on else 0.0
         inputs = tuple(_unwrap(x) for x in (
             inputs if isinstance(inputs, (tuple, list)) else (inputs,)))
         labels = tuple(_unwrap(x) for x in (
@@ -634,12 +686,19 @@ class TrainStep:
         else:
             import contextlib
             plan_ctx = contextlib.nullcontext()
+        t1 = time.perf_counter() if phases_on else 0.0
         with _tm.span("trainstep/dispatch", step=step_id,
                       track="dispatch",
                       timer="TIMER_trainstep_dispatch_us"), plan_ctx:
-            loss, self._state, self._opt_state, self._lr_step = \
-                self._step_fn(self._state, self._opt_state,
-                              self._lr_step, sub, (inputs, labels))
+            res = self._step_fn(self._state, self._opt_state,
+                                self._lr_step, sub, (inputs, labels))
+        if getattr(self, "_has_fence", False):
+            loss, self._state, self._opt_state, self._lr_step, fence = res
+        else:
+            loss, self._state, self._opt_state, self._lr_step = res
+            fence = None
+        if phases_on:
+            self._observe_phases(t0, t1, loss, fence, step_id)
         m = getattr(self, "_coll_manifest", None)
         if m:
             # explicit-exchange collectives run inside the jitted step,
@@ -655,6 +714,55 @@ class TrainStep:
         if step_id is not None:
             _tm.flight_note(step_id, "dispatched_us", _tm.now_us())
         return loss
+
+    def _observe_phases(self, t0, t1, loss, fence, step_id):
+        """Attribute the step's wall time to host phases by blocking on
+        progressively later results: stage (t0->t1, host-side input
+        staging + rng), dispatch (t1->return of the jitted call),
+        compute (until the pre-exchange fence is ready — manual
+        collective path only), exchange (fence -> new params, i.e. the
+        bucketed collective + optimizer), sync (-> loss fetched). Each
+        boundary is read once off one clock, so the phases sum to the
+        "total" series exactly. Blocking serializes the dispatch-ahead
+        pipeline, which is why FLAGS_step_phases is opt-in. On the
+        legacy GSPMD path (no fence) and on XLA:CPU — where every
+        output of one executable becomes ready together — the
+        compute/exchange split collapses into "compute"
+        (docs/observability.md states the caveat); the decomposition
+        separates cleanly on a real multi-host gang."""
+        t2 = time.perf_counter()
+        if fence is not None:
+            jax.block_until_ready(fence)
+            t3 = time.perf_counter()
+            jax.block_until_ready(self._state)
+            t4 = time.perf_counter()
+        else:
+            jax.block_until_ready(self._state)
+            t3 = t4 = time.perf_counter()
+        jax.block_until_ready(loss)
+        t5 = time.perf_counter()
+        spans = [("stage", t0, t1), ("dispatch", t1, t2),
+                 ("compute", t2, t3)]
+        if fence is not None:
+            spans.append(("exchange", t3, t4))
+        spans.append(("sync", t4, t5))
+        spans.append(("total", t0, t5))
+        from .monitor import observe_many
+        observe_many(timers=[(_phase_timer(ph), (b - a) * 1e6)
+                             for ph, a, b in spans])
+        from . import telemetry as _tm
+        if _tm.enabled():
+            # mirror the phases onto the trace so per-rank exports
+            # (tools/trace_merge.py) show exchange-wait across ranks
+            from . import profiler as _pf
+            end_us = _tm.now_us()
+            for ph, a, b in spans:
+                if ph == "total":
+                    continue
+                _pf.add_trace_event(
+                    "phase/%s" % ph, end_us - (t5 - a) * 1e6,
+                    (b - a) * 1e6, cat="phase", track="phase",
+                    step=step_id)
 
     # -- crash-safe checkpointing (incubate/checkpoint/atomic.py) --------
 

@@ -43,11 +43,29 @@ rendezvous ride inside it).
 
 Failpoint sites `dist.rendezvous`, `worker.heartbeat`, `worker.step`
 drive the chaos tests; workers inherit arming through the
-PADDLE_TPU_FAILPOINTS environment variable (read once at import).
+PADDLE_TPU_FAILPOINTS environment variable (read once at import; the
+PADDLE_TPU_FAILPOINTS_RANK<k> variant arms a single rank — the
+straggler drill's injection path).
 Observability: ``/workerz`` on the introspection server (per-worker
 state, last-heartbeat age, restart counts), STAT_launch_restarts /
 STAT_launch_worker_deaths / STAT_launch_worker_lost counters and the
 GAUGE_launch_worker_state{rank=...} series.
+
+Gang-wide observability plane (docs/observability.md "Gang-wide
+observability"): when FLAGS_launch_digest is on (default), every
+heartbeat line piggybacks a bounded, versioned ``digest`` —
+:func:`build_digest`: step counter, TIMER_step_phase_us window stats,
+collective-byte census deltas, KV-pool occupancy. The supervisor
+re-emits digests as rank-labeled instruments (GAUGE_gang_step,
+TIMER_gang_step_phase_us, GAUGE_gang_collective_wait_frac), scores
+per-rank skew into GAUGE_gang_straggler_score (self step-time — wall
+time minus the host's device/gang waits — vs the gang's lower
+median), and feeds the skew SLO objective (slo.py) so the burn-rate
+engine pages on a persistent straggler. ``/gangz`` serves the
+per-rank table (text + ?format=json). Digest-off keeps the wire
+byte-identical to the PR-13 format and costs one flag lookup. Workers
+additionally export per-rank chrome traces at exit when
+PADDLE_TPU_TRACE_DIR is set (merge with tools/trace_merge.py).
 
 CLI::
 
@@ -58,6 +76,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import signal
 import socket
 import subprocess
@@ -68,11 +87,14 @@ import weakref
 from typing import Any, Dict, List, Optional
 
 from .failpoints import failpoint
-from .monitor import gauge_set, labeled, stat_add
+from .monitor import gauge_set, labeled, observe_many, stat_add
 
 __all__ = [
     "GangFailed",
     "GangSupervisor",
+    "build_digest",
+    "gangz",
+    "gangz_text",
     "heartbeat_step",
     "main",
     "maybe_start_worker_heartbeat",
@@ -107,8 +129,113 @@ class GangFailed(RuntimeError):
 
 
 # ---------------------------------------------------------------------------
-# worker side: heartbeat client
+# worker side: heartbeat client + metrics digest
 # ---------------------------------------------------------------------------
+
+# digest wire-format version: the supervisor accepts 1..DIGEST_VERSION
+# and counts anything else into STAT_launch_digest_rejected without
+# touching the beat's liveness fields, so mixed-version gangs degrade
+# to metrics loss, never to restarts
+DIGEST_VERSION = 1
+
+# supervisor-side hard cap on ONE heartbeat line: a line that blows it
+# is skimmed to the next newline and counted, never buffered or parsed
+# (satellite bugfix: the old reader buffered unbounded lines)
+MAX_BEAT_LINE = 64 * 1024
+
+# phase keys mirrored from jit.STEP_PHASES — spelled out here because
+# launch.py must stay importable without jax (workers heartbeat before
+# and during the jax import)
+_DIGEST_PHASES = ("stage", "dispatch", "compute", "exchange", "sync",
+                  "total")
+
+_DTYPE_RE = re.compile(r'dtype="([^"]*)"')
+
+
+def build_digest(step: int, prev: Optional[Dict[str, Any]] = None,
+                 max_bytes: Optional[int] = None
+                 ) -> Optional[Dict[str, Any]]:
+    """The bounded worker metrics digest one heartbeat line carries.
+
+    Fields (all optional beyond v/step, dropped oldest-luxury-first
+    when the serialized JSON would exceed the cap):
+
+    - ``v``/``step`` — format version + the worker's step counter.
+    - ``phases`` — per-phase {n,p50,p95} from the TIMER_step_phase_us
+      windowed monitor (all-time stats when windows are off).
+    - ``dev_us``/``wait_us`` — cumulative microseconds spent INSIDE
+      the step call (the "total" phase: staging through the loss sync)
+      and in the exchange+sync gang tail alone. The supervisor
+      subtracts dev_us deltas from beat-to-beat wall time to get the
+      rank's own "self time" — the straggler score numerator. The
+      whole call counts, not just compute+waits, because on a
+      synchronous gang the healthy ranks absorb a straggler's lag as
+      device-queue backpressure anywhere inside their call (staging
+      blocks behind the stuck collective), while the dragging host's
+      own stall is by definition OUTSIDE its step call.
+    - ``coll`` — dtype -> collective wire-byte deltas since the last
+      digest (census counters; *prev* carries the totals between
+      calls).
+    - ``kv`` — KV block-pool occupancy when serving.
+
+    Returns None when even the minimal digest would not fit.
+    """
+    from . import monitor
+    if max_bytes is None:
+        from .flags import get_flag
+        max_bytes = int(get_flag("FLAGS_launch_digest_max_bytes"))
+    d: Dict[str, Any] = {"v": DIGEST_VERSION, "step": int(step)}
+    use_win = monitor.windows_enabled()
+    phases: Dict[str, Any] = {}
+    dev_us = wait_us = 0.0
+    for ph in _DIGEST_PHASES:
+        key = labeled("TIMER_step_phase_us", {"phase": ph})
+        tot = monitor.timer_get(key)
+        if not tot["count"]:
+            continue
+        st = monitor.timer_window(key, 60.0) if use_win else tot
+        if st["count"]:
+            phases[ph] = {"n": int(st["count"]),
+                          "p50": round(float(st["p50"]), 1),
+                          "p95": round(float(st["p95"]), 1)}
+        if ph == "total":
+            dev_us += tot["sum"]
+        if ph in ("exchange", "sync"):
+            wait_us += tot["sum"]
+    if phases:
+        d["phases"] = phases
+        d["dev_us"] = round(dev_us, 1)
+        d["wait_us"] = round(wait_us, 1)
+    counters = monitor.get_float_stats()
+    totals = {k: v for k, v in counters.items()
+              if k.startswith("STAT_mesh_collective_bytes{")}
+    if totals:
+        prev_c = prev.get("coll", {}) if prev is not None else {}
+        deltas: Dict[str, int] = {}
+        for k, v in totals.items():
+            dv = v - prev_c.get(k, 0.0)
+            if dv > 0:
+                m = _DTYPE_RE.search(k)
+                dt = m.group(1) if m else "?"
+                deltas[dt] = deltas.get(dt, 0) + int(dv)
+        if deltas:
+            d["coll"] = deltas
+        if prev is not None:
+            prev["coll"] = totals
+    free = monitor.gauge_get("GAUGE_generation_blocks_free", -1.0)
+    used = monitor.gauge_get("GAUGE_generation_blocks_used", -1.0)
+    if free >= 0 and used >= 0 and free + used > 0:
+        d["kv"] = {"free": int(free), "used": int(used)}
+    compact = (",", ":")
+    if len(json.dumps(d, separators=compact)) <= max_bytes:
+        return d
+    stat_add("STAT_launch_digest_truncated")
+    for key in ("coll", "kv", "phases", "wait_us", "dev_us"):
+        d.pop(key, None)
+        if len(json.dumps(d, separators=compact)) <= max_bytes:
+            return d
+    return None
+
 
 class _Beater:
     """Worker-side heartbeat thread. One JSON line per interval over the
@@ -123,6 +250,16 @@ class _Beater:
         self.interval_s = interval_s
         self.state = state
         self.step = 0
+        # PADDLE_LAUNCH_DIGEST (set by the supervisor from its own
+        # FLAGS_launch_digest) wins over this worker's flag so a
+        # digest-off supervisor gets a PR-13 wire from every worker;
+        # unset (plain maybe_start_worker_heartbeat) defers to the flag
+        denv = os.environ.get("PADDLE_LAUNCH_DIGEST")
+        self._digest_env = None if denv is None \
+            else denv not in ("0", "", "false")
+        self._digest_prev: Dict[str, Any] = {}
+        from .flags import get_flag
+        self._get_flag = get_flag
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._sock = socket.create_connection((host, int(port)), timeout=5)
@@ -130,11 +267,30 @@ class _Beater:
                                         name="pt-heartbeat", daemon=True)
         self._thread.start()
 
+    def _maybe_digest(self) -> Optional[Dict[str, Any]]:
+        on = self._digest_env
+        if on is None:
+            # disabled path = this one flag lookup (pinned like
+            # tracing/failpoints/slo): build_digest is never called
+            on = bool(self._get_flag("FLAGS_launch_digest"))
+        if not on:
+            return None
+        try:
+            return build_digest(self.step, prev=self._digest_prev)
+        except Exception:
+            return None  # metrics must never break liveness
+
     def _send(self) -> None:
+        dig = self._maybe_digest()
         with self._lock:
             msg = {"rank": self.rank, "attempt": self.attempt,
                    "pid": os.getpid(), "state": self.state,
                    "step": self.step}
+            if dig is not None:
+                # appended AFTER the PR-13 fields: digest-off stays
+                # byte-identical, digest-on parses on old supervisors
+                # (unknown key ignored)
+                msg["digest"] = dig
             self._sock.sendall((json.dumps(msg) + "\n").encode("utf-8"))
         stat_add("STAT_worker_heartbeats_sent")
 
@@ -185,6 +341,12 @@ def maybe_start_worker_heartbeat(state: str = "spawned") -> bool:
                 state=state)
         except OSError:
             return False  # supervisor already gone; run unsupervised
+        if os.environ.get("PADDLE_TPU_TRACE_DIR"):
+            # per-rank chrome trace for tools/trace_merge.py, written
+            # at exit so one file covers the worker's whole life
+            import atexit
+            from . import profiler
+            atexit.register(profiler.maybe_export_rank_trace)
     return True
 
 
@@ -221,10 +383,12 @@ class _Worker:
     """Supervisor-side view of one gang member."""
 
     __slots__ = ("rank", "proc", "state", "spawned_at", "last_beat",
-                 "beats", "step", "exit_code", "log_path")
+                 "beats", "step", "exit_code", "log_path",
+                 "digest", "digest_at", "hist", "score", "wait_frac")
 
     def __init__(self, rank: int, proc: subprocess.Popen,
                  log_path: Optional[str]):
+        from collections import deque
         self.rank = rank
         self.proc = proc
         self.state = "spawned"
@@ -234,6 +398,14 @@ class _Worker:
         self.step = 0
         self.exit_code: Optional[int] = None
         self.log_path = log_path
+        # gang-observability state, all digest-fed: the latest digest
+        # (for /gangz), a (t_mono, step, dev_us, wait_us) history the
+        # straggler window slides over, and the derived scores
+        self.digest: Optional[Dict[str, Any]] = None
+        self.digest_at: Optional[float] = None
+        self.hist: "deque" = deque(maxlen=512)
+        self.score: Optional[float] = None
+        self.wait_frac: Optional[float] = None
 
 
 _SUPERVISORS: "weakref.WeakSet[GangSupervisor]" = weakref.WeakSet()
@@ -284,6 +456,8 @@ class GangSupervisor:
                  restart_backoff_ms: Optional[float] = None,
                  rendezvous_timeout_s: Optional[float] = None,
                  term_grace_s: float = 5.0,
+                 straggler_threshold: Optional[float] = None,
+                 straggler_window_s: Optional[float] = None,
                  name: Optional[str] = None):
         from .flags import get_flag
 
@@ -311,6 +485,18 @@ class GangSupervisor:
         self.rendezvous_timeout_s = None if rendezvous_timeout_s is None \
             else float(rendezvous_timeout_s)
         self.term_grace_s = float(term_grace_s)
+        self.straggler_threshold = _flag(
+            straggler_threshold, "FLAGS_launch_straggler_threshold", float)
+        sw = _flag(straggler_window_s,
+                   "FLAGS_launch_straggler_window_s", float)
+        # auto window scales with the beat cadence so a fast-beating
+        # test gang converges (and clears) in seconds
+        self.straggler_window_s = sw if sw > 0 else \
+            max(20.0 * self.heartbeat_interval_s, 2.0)
+        # read once here: workers inherit the supervisor's digest
+        # setting through PADDLE_LAUNCH_DIGEST (fresh processes would
+        # otherwise reset to the flag default on every restart)
+        self._digest_on = bool(get_flag("FLAGS_launch_digest"))
         self.name = name or "gang%d" % os.getpid()
 
         self._lock = threading.Lock()
@@ -354,6 +540,12 @@ class GangSupervisor:
                     "last_beat_age_s": (
                         round(now - w.last_beat, 3)
                         if w.last_beat is not None else None),
+                    "straggler_score": (
+                        round(w.score, 3) if w.score is not None
+                        else None),
+                    "wait_frac": (
+                        round(w.wait_frac, 4) if w.wait_frac is not None
+                        else None),
                 })
             return {
                 "name": self.name,
@@ -367,6 +559,10 @@ class GangSupervisor:
                     "interval_s": self.heartbeat_interval_s,
                     "timeout_s": self.heartbeat_timeout_s,
                     "spawn_grace_s": self.spawn_grace_s,
+                },
+                "straggler": {
+                    "threshold": self.straggler_threshold,
+                    "window_s": self.straggler_window_s,
                 },
                 "workers": sorted(workers, key=lambda w: w["rank"]),
             }
@@ -393,12 +589,30 @@ class GangSupervisor:
     def _hb_conn(self, conn: socket.socket) -> None:
         try:
             with conn, conn.makefile("r", encoding="utf-8") as f:
-                for line in f:
+                while True:
+                    # bounded readline: the old `for line in f` buffered
+                    # arbitrarily long lines, so one runaway digest
+                    # could balloon supervisor memory. A line that hits
+                    # the cap is counted, skimmed to its newline, and
+                    # the connection keeps serving — a bad metrics line
+                    # must never tear the gang down
+                    line = f.readline(MAX_BEAT_LINE)
+                    if not line:
+                        return
+                    if not line.endswith("\n") and \
+                            len(line) >= MAX_BEAT_LINE:
+                        stat_add("STAT_launch_digest_rejected")
+                        while True:
+                            rest = f.readline(MAX_BEAT_LINE)
+                            if not rest or rest.endswith("\n"):
+                                break
+                        continue
                     try:
                         msg = json.loads(line)
                     except ValueError:
                         continue
-                    self._on_beat(msg)
+                    if isinstance(msg, dict):
+                        self._on_beat(msg)
         except OSError:
             pass
 
@@ -430,6 +644,121 @@ class GangSupervisor:
             self._event("worker_running", rank=w.rank)
         if progressed:
             self._event("step_progress", rank=w.rank, step=step)
+        dig = msg.get("digest")
+        if dig is not None:
+            try:
+                self._ingest_digest(w, dig, now)
+            except Exception:
+                # malformed/unsupported digest: drop the metrics, keep
+                # the beat — liveness already updated above
+                stat_add("STAT_launch_digest_rejected")
+
+    # -- digest aggregation / straggler scoring ---------------------------
+
+    def _ingest_digest(self, w: _Worker, dig: Dict[str, Any],
+                       now: float) -> None:
+        """Re-emit one worker digest as rank-labeled instruments and
+        refresh the gang's straggler scores. Any malformed field raises
+        and the caller counts one STAT_launch_digest_rejected."""
+        if not isinstance(dig, dict):
+            raise ValueError("digest is not an object")
+        v = int(dig.get("v", -1))
+        if not 1 <= v <= DIGEST_VERSION:
+            raise ValueError("unsupported digest version %d" % v)
+        step = int(dig.get("step", w.step) or 0)
+        lbl = {"gang": self.name, "rank": str(w.rank)}
+        timers = []
+        phases = dig.get("phases")
+        if phases is not None:
+            # one window-p50 sample per beat: TIMER_gang_step_phase_us
+            # is a summary-of-summaries (documented), good for skew and
+            # trend — not a raw latency histogram
+            for ph, st in sorted(phases.items()):
+                timers.append((
+                    labeled("TIMER_gang_step_phase_us",
+                            {**lbl, "phase": str(ph)[:16]}),
+                    float(st["p50"])))
+        dev = dig.get("dev_us")
+        wait = dig.get("wait_us")
+        with self._lock:
+            w.digest = dig
+            w.digest_at = now
+            w.hist.append((now, step,
+                           None if dev is None else float(dev),
+                           None if wait is None else float(wait)))
+            scores, fracs = self._straggler_scores(now)
+        worst = 0.0
+        for rank, sc in scores.items():
+            gauge_set(labeled("GAUGE_gang_straggler_score",
+                              {"gang": self.name, "rank": str(rank)}), sc)
+            wr = self._workers.get(rank)
+            if wr is not None:
+                wr.score = sc
+            worst = max(worst, sc)
+        for rank, fr in fracs.items():
+            gauge_set(labeled("GAUGE_gang_collective_wait_frac",
+                              {"gang": self.name, "rank": str(rank)}), fr)
+            wr = self._workers.get(rank)
+            if wr is not None:
+                wr.wait_frac = fr
+        gauge_set(labeled("GAUGE_gang_step", lbl), float(step))
+        # the skew SLO's ratio: beats observed while the gang had a
+        # straggler / all digest beats (slo.install_gang_objectives)
+        stats = [("STAT_gang_digest_beats", 1.0)]
+        if worst > self.straggler_threshold:
+            stats.append(("STAT_gang_straggler_beats", 1.0))
+        observe_many(timers=timers, stats=stats)
+
+    def _straggler_scores(self, now: float):
+        """(scores, wait_fracs) by rank, from each worker's digest
+        history over the trailing straggler window. Self step-time =
+        (wall delta - dev_us delta) / steps: the time the rank's HOST
+        spent outside its step call — in a synchronous gang every
+        rank's raw step RATE equals the slowest rank's, so raw rate
+        cannot finger the straggler, but the dragging host accrues its
+        stall outside its call while everyone else absorbs that lag as
+        backpressure INSIDE their calls (dev_us). Scores are self-time
+        over the gang lower median (biases healthy when half the gang
+        drags — we assume a minority of stragglers), floored at a
+        quarter of the gang's median step time so near-zero self-times
+        score ~0 instead of amplifying noise. Callers hold
+        self._lock."""
+        win = self.straggler_window_s
+        selfs: Dict[int, float] = {}
+        rates: Dict[int, float] = {}
+        fracs: Dict[int, float] = {}
+        for w in self._workers.values():
+            ent = [e for e in w.hist if e[0] >= now - win]
+            if len(ent) < 2:
+                continue
+            t0, s0, d0, w0 = ent[0]
+            t1, s1, d1, w1 = ent[-1]
+            dsteps = s1 - s0
+            dt_us = (t1 - t0) * 1e6
+            if dsteps <= 0 or dt_us <= 0:
+                continue
+            if w0 is not None and w1 is not None:
+                fracs[w.rank] = min(max((w1 - w0) / dt_us, 0.0), 1.0)
+            rates[w.rank] = dt_us / dsteps
+            if d0 is not None and d1 is not None:
+                self_us = max(dt_us - max(d1 - d0, 0.0), 0.0)
+            else:
+                # no phase timers in this worker: fall back to the raw
+                # step time (still catches asynchronous stragglers)
+                self_us = dt_us
+            selfs[w.rank] = self_us / dsteps
+        if not selfs:
+            return {}, fracs
+        vals = sorted(selfs.values())
+        rvals = sorted(rates[r] for r in selfs)
+        # the denominator floors at a quarter of the gang's median step
+        # time: a healthy gang's self-times are near zero, and a ratio
+        # of two near-zeros is noise — self-time only MEANS straggling
+        # once it's a real fraction of a step, and the floor also keeps
+        # the score finite when the median self-time is ~0
+        med = max(vals[(len(vals) - 1) // 2],
+                  0.25 * rvals[(len(rvals) - 1) // 2], 1.0)
+        return {r: v / med for r, v in selfs.items()}, fracs
 
     # -- spawning / teardown -----------------------------------------------
 
@@ -446,6 +775,7 @@ class GangSupervisor:
         env["PADDLE_LAUNCH_HEARTBEAT_INTERVAL_S"] = \
             str(self.heartbeat_interval_s)
         env["PADDLE_LAUNCH_ATTEMPT"] = str(self._attempt)
+        env["PADDLE_LAUNCH_DIGEST"] = "1" if self._digest_on else "0"
         # Workers run `python <script>`, so sys.path[0] is the script's
         # directory, not the supervisor's cwd. Propagate the cwd on
         # PYTHONPATH (append, never overwrite: accelerator site dirs
@@ -637,6 +967,10 @@ class GangSupervisor:
         introspect.register_readiness(
             "gang_" + self.name,
             lambda: self._state in ("running", "done"))
+        # default skew objective: registration is idempotent and free
+        # when FLAGS_slo is off (evaluation is the gated part)
+        from . import slo as _slo
+        _slo.install_gang_objectives()
         self._spawn_gang()
         for target, nm in ((self._hb_serve, "pt-gang-accept"),
                            (self._supervise, "pt-gang-supervise")):
@@ -679,6 +1013,83 @@ class GangSupervisor:
                 pass
         from . import introspect
         introspect.unregister_readiness("gang_" + self.name)
+        self._retract_gauges()
+
+    # every rank-labeled gauge family this supervisor emits; timers and
+    # counters keep their history like every other family
+    GANG_GAUGE_FAMILIES = ("GAUGE_gang_step",
+                           "GAUGE_gang_straggler_score",
+                           "GAUGE_gang_collective_wait_frac")
+
+    def _retract_gauges(self) -> None:
+        """Remove this gang's rank-labeled gauges entirely (not zero
+        them) on stop — a dead gang must not keep advertising stale
+        per-rank scores. Same discipline as mesh/collectives.py
+        retract_gauges."""
+        from . import monitor
+        prefixes = tuple(labeled(f, {"gang": self.name})[:-1]
+                         for f in self.GANG_GAUGE_FAMILIES)
+        with monitor._LOCK:
+            for k in list(monitor._GAUGES):
+                if k.startswith(prefixes):
+                    monitor._GAUGES.pop(k)
+
+
+# ---------------------------------------------------------------------------
+# /gangz payload (introspect.py serves it; built here with the data)
+# ---------------------------------------------------------------------------
+
+def gangz() -> Dict[str, Any]:
+    """The /gangz JSON payload: every live gang's status() enriched
+    with each rank's latest digest-derived phase breakdown."""
+    gangs = []
+    for s in list(_SUPERVISORS):
+        st = s.status()
+        for row in st["workers"]:
+            w = s._workers.get(row["rank"])
+            dig = w.digest if w is not None else None
+            if dig:
+                row["digest_v"] = dig.get("v")
+                row["phases"] = dig.get("phases")
+                row["kv"] = dig.get("kv")
+        gangs.append(st)
+    return {"gangs": gangs}
+
+
+def gangz_text() -> str:
+    """Plain-text /gangz: one table per gang, one row per rank."""
+    z = gangz()
+    if not z["gangs"]:
+        return "no live gangs\n"
+    out = []
+    for g in z["gangs"]:
+        out.append(
+            "gang %s  state=%s attempt=%d restarts=%d/%d  "
+            "straggler thr=%.2f window=%.1fs" % (
+                g["name"], g["state"], g["attempt"], g["restarts"],
+                g["max_restarts"], g["straggler"]["threshold"],
+                g["straggler"]["window_s"]))
+        out.append("%-5s %-11s %9s %8s %10s %6s  %s" % (
+            "rank", "state", "beat_age", "step", "straggler",
+            "wait%", "phases p50 us"))
+        for w in g["workers"]:
+            phases = w.get("phases") or {}
+            ptxt = " ".join(
+                "%s=%.0f" % (ph, st.get("p50", 0.0))
+                for ph, st in sorted(phases.items())
+                if ph != "total") or "-"
+            out.append("%-5d %-11s %9s %8d %10s %6s  %s" % (
+                w["rank"], w["state"],
+                ("%.2fs" % w["last_beat_age_s"]
+                 if w["last_beat_age_s"] is not None else "-"),
+                w["step"],
+                ("%.2f" % w["straggler_score"]
+                 if w["straggler_score"] is not None else "-"),
+                ("%.0f%%" % (100.0 * w["wait_frac"])
+                 if w["wait_frac"] is not None else "-"),
+                ptxt))
+        out.append("")
+    return "\n".join(out)
 
 
 # ---------------------------------------------------------------------------

@@ -233,6 +233,32 @@ def exchange_grads(grads: Dict[str, Any],
     return out
 
 
+# -- step-phase sync fence (ISSUE 18; docs/observability.md) ------------
+
+def phase_fence(tree: Any):
+    """A (1,)-shaped value data-dependent on every leaf of *tree*.
+
+    The manual step body returns this computed from the PRE-exchange
+    gradients (when ``FLAGS_step_phases`` is on), so the host can
+    ``block_until_ready`` on it to separate "local compute done" from
+    "bucketed exchange done": the fence becomes ready only once every
+    local gradient exists, while the new params stay in flight behind
+    the collective.  Shape (1,) rather than scalar because the
+    pre-exchange grads are rank-varying, so the fence's out_spec must
+    shard over *axis* — a replicated scalar would itself force a sync.
+    The reduction is one add per leaf: noise next to the grads it
+    fences.
+    """
+    leaves = [x for x in jax.tree_util.tree_leaves(tree)
+              if hasattr(x, "dtype")]
+    if not leaves:
+        return jnp.zeros((1,), jnp.float32)
+    acc = jnp.zeros((), jnp.float32)
+    for x in leaves:
+        acc = acc + x.reshape(-1)[0].astype(jnp.float32)
+    return acc.reshape(1)
+
+
 # -- byte census (ring model; see monitor.py "mesh" instruments) --------
 
 def _ring(payload_bytes: int, dp: int) -> int:

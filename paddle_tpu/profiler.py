@@ -31,7 +31,8 @@ from typing import Dict, List, Optional
 __all__ = ["RecordEvent", "profiler", "start_profiler", "stop_profiler",
            "reset_profiler", "export_chrome_tracing", "summary",
            "start_device_trace", "stop_device_trace",
-           "set_device_trace_dir", "add_trace_event", "add_counter_event"]
+           "set_device_trace_dir", "add_trace_event", "add_counter_event",
+           "maybe_export_rank_trace"]
 
 _lock = threading.Lock()
 _enabled = False
@@ -237,12 +238,17 @@ def summary(sorted_key: Optional[str] = "total") -> List[dict]:
     return rows
 
 
-def export_chrome_tracing(path: str):
+def export_chrome_tracing(path: str, pid: int = 0,
+                          process_name: Optional[str] = None):
     """tools/timeline.py analog: write chrome://tracing JSON.
 
     Track-tagged telemetry events render as named rows (thread_name
     metadata per track) and keep their step id both in args.step and as
-    the event id; counter events export as "C" phases."""
+    the event id; counter events export as "C" phases.
+
+    `pid`/`process_name` tag every event with a process row — a gang
+    worker exports with pid=rank so tools/trace_merge.py can overlay N
+    rank files in one chrome://tracing view without tid collisions."""
     with _lock:
         events = list(_events)
     track_tids: Dict[str, int] = {}
@@ -251,7 +257,7 @@ def export_chrome_tracing(path: str):
         if e.get("ph") == "C":
             trace_events.append({
                 "name": e["name"], "cat": e.get("cat", "counter"),
-                "ph": "C", "ts": e["ts"], "pid": 0, "tid": 0,
+                "ph": "C", "ts": e["ts"], "pid": pid, "tid": 0,
                 "args": {"value": e["value"]}})
             continue
         track = e.get("track")
@@ -264,7 +270,7 @@ def export_chrome_tracing(path: str):
         else:
             tid = e["tid"]
         out = {"name": e["name"], "cat": e.get("cat", "op"), "ph": "X",
-               "ts": e["ts"], "dur": e["dur"], "pid": 0, "tid": tid,
+               "ts": e["ts"], "dur": e["dur"], "pid": pid, "tid": tid,
                "args": dict(e.get("args") or ())}
         if "full_name" in e:
             out["args"]["full_name"] = e["full_name"]
@@ -272,10 +278,13 @@ def export_chrome_tracing(path: str):
             out["args"]["step"] = e["step"]
             out["id"] = str(e["step"])
         trace_events.append(out)
-    meta = [{"name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+    meta = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
              "args": {"name": track}}
             for track, tid in sorted(track_tids.items(), key=lambda kv:
                                      kv[1])]
+    if process_name is not None:
+        meta.insert(0, {"name": "process_name", "ph": "M", "pid": pid,
+                        "tid": 0, "args": {"name": process_name}})
     trace = {"traceEvents": meta + trace_events}
     d = os.path.dirname(path)
     if d:
@@ -305,3 +314,29 @@ def stop_device_trace():
     d = _device_trace_dir
     _device_trace_dir = None
     return d
+
+
+# --- per-rank trace export (gang observability plane) --------------------
+
+def maybe_export_rank_trace(dir_path: Optional[str] = None
+                            ) -> Optional[str]:
+    """Export this worker's buffered events as `trace_rank<k>.json` in
+    `dir_path` (default $PADDLE_TPU_TRACE_DIR), tagged pid=rank so
+    tools/trace_merge.py can overlay the gang's files. Registered as an
+    atexit hook by launch.maybe_start_worker_heartbeat when the env var
+    is set; a no-op (returns None) when the dir is unset or there are
+    no events — must never raise on the worker exit path."""
+    try:
+        d = dir_path or os.environ.get("PADDLE_TPU_TRACE_DIR")
+        if not d:
+            return None
+        with _lock:
+            if not _events:
+                return None
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or "0")
+        path = os.path.join(d, "trace_rank%d.json" % rank)
+        export_chrome_tracing(path, pid=rank,
+                              process_name="rank %d" % rank)
+        return path
+    except Exception:
+        return None

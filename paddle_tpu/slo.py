@@ -167,6 +167,32 @@ def install_default_objectives() -> None:
         bad="STAT_generation_deadline_missed",
         total="STAT_generation_requests",
         description="< 1% of generation requests miss their deadline"))
+    install_gang_objectives()
+
+
+def install_gang_objectives(fast_window_s: float = 60.0,
+                            slow_window_s: float = 3600.0) -> None:
+    """The gang skew SLO (docs/observability.md "Gang-wide
+    observability"): the supervisor counts every digest beat into
+    STAT_gang_digest_beats and beats observed while some rank's
+    straggler score exceeded FLAGS_launch_straggler_threshold into
+    STAT_gang_straggler_beats. Target 0.95 keeps the full-outage burn
+    at 1/(1-0.95)=20, above the fast_burn=14 page threshold — a
+    persistent straggler (bad-ratio ~1.0) pages, and the page clears
+    once the short window drains after the injection stops. Registered
+    from GangSupervisor.start() and with the defaults; the window
+    overrides let second-scale drills (the straggler chaos test) run
+    the production alert math on a compressed timeline. NOTE:
+    re-registering replaces by name and resets alert state, so
+    override AFTER the supervisor is started."""
+    register(Objective(
+        name="gang_straggler_skew", kind="ratio", target=0.95,
+        bad="STAT_gang_straggler_beats",
+        total="STAT_gang_digest_beats",
+        window_s=fast_window_s * 5.0,
+        fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+        description="< 5% of gang heartbeats observed with a rank's "
+                    "skew score above the straggler threshold"))
 
 
 # ---------------------------------------------------------------------------

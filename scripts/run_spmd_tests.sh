@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Run the mesh-native SPMD runtime suite (-m spmd, docs/spmd.md) on the
-# 8-device virtual CPU mesh and emit MULTICHIP_r08.json: the usual
-# multichip dryrun transcript (same shape as MULTICHIP_r0{1..7}.json)
+# 8-device virtual CPU mesh and emit MULTICHIP_r09.json: the usual
+# multichip dryrun transcript (same shape as MULTICHIP_r0{1..8}.json)
 # plus the mesh plan, the per-axis host-collective census
 # (STAT_mesh_collective_<axis>, monitor.py), the chaos smoke
 # (failpoints armed over /failpointz, recovery asserted — ISSUE 9),
@@ -17,7 +17,11 @@
 # quantized-collective smoke (int8 block-scaled gradient exchange in
 # TrainStep under the plan: census bytes >= 3x smaller than the fp32
 # oracle, loss inside the budget, gauges retract on flag-off rebuild —
-# ISSUE 17).
+# ISSUE 17), and the gang-observability smoke (digest-on gang with a
+# rank-targeted delay injection: heartbeat digests land, rank 1's
+# straggler score trips, /gangz and /statusz serve the per-rank view —
+# ISSUE 18; the full drill incl. the skew-SLO page/clear cycle runs in
+# the -m spmd pytest pass above as test_straggler_drill_real_gang).
 #
 # Usage: scripts/run_spmd_tests.sh [extra pytest args...]
 set -u
@@ -33,7 +37,7 @@ echo "== spmd-marked tests (8 virtual CPU devices) =="
 python -m pytest tests/ -q -m spmd -p no:cacheprovider "$@"
 test_rc=$?
 
-echo "== multichip dryrun + mesh census -> MULTICHIP_r07.json =="
+echo "== multichip dryrun + mesh census -> MULTICHIP_r09.json =="
 python - "$test_rc" <<'EOF'
 import io
 import json
@@ -709,6 +713,79 @@ try:
 except Exception as e:  # noqa: BLE001 - artifact records the failure
     multihost["error"] = "%s: %s" % (type(e).__name__, e)
 
+# gang-observability smoke (ISSUE 18, docs/observability.md "Gang-wide
+# observability"): a digest-on 2-process gang with worker.step=delay
+# armed on rank 1 ONLY (rank-targeted env, self-clearing first(N)
+# trigger); versioned heartbeat digests with phase timers must land,
+# rank 1's straggler score must trip the threshold while the injection
+# runs with rank 0 staying healthy, and /gangz + /statusz must serve
+# the per-rank view live. The full drill including the skew-SLO
+# page/clear cycle runs in the -m spmd pytest pass above.
+gang_obs = {"ok": False}
+try:
+    import os
+    import shutil
+    import tempfile
+    import time as _time
+    from paddle_tpu.launch import GangSupervisor
+
+    _gtmp = tempfile.mkdtemp(prefix="pt_gangobs_smoke_")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    env.update({"GANG_STEPS": "4000", "GANG_PHASES": "1",
+                "PADDLE_TPU_FAILPOINTS_RANK1":
+                    "worker.step=delay(150)@first(40)"})
+    sup = GangSupervisor(
+        [os.path.join("tests", "gang_runner.py")], 2,
+        cpu_devices_per_proc=2, log_dir=os.path.join(_gtmp, "logs"),
+        env=env, heartbeat_interval_s=0.05, heartbeat_timeout_s=30.0,
+        spawn_grace_s=300.0, max_restarts=0,
+        straggler_threshold=2.0, straggler_window_s=1.5,
+        name="smoke_obs")
+    sup.start()
+    tripped = gangz_ok = statusz_ok = False
+    digest_v = None
+    healthy = {}
+    try:
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline:
+            st = sup.status()
+            sc = {w["rank"]: w.get("straggler_score")
+                  for w in st["workers"]}
+            if (sc.get(1) or 0.0) > 2.0:
+                tripped = True
+                break
+            _time.sleep(0.05)
+        healthy = {w["rank"]: w.get("straggler_score")
+                   for w in sup.status()["workers"]}
+        srv = introspect.start(port=0)
+        gz = json.load(urllib.request.urlopen(
+            srv.url + "/gangz?format=json", timeout=10))
+        grow = next(g for g in gz["gangs"] if g["name"] == "smoke_obs")
+        w1 = next(w for w in grow["workers"] if w["rank"] == 1)
+        digest_v = w1.get("digest_v")
+        gangz_ok = digest_v == 1 and bool(w1.get("phases"))
+        sz = json.load(urllib.request.urlopen(
+            srv.url + "/statusz", timeout=10))
+        srow = next(g for g in sz["gangs"] if g["name"] == "smoke_obs")
+        statusz_ok = (srow.get("max_straggler") or {}).get("rank") == 1
+    finally:
+        introspect.stop()
+        sup.stop()
+        shutil.rmtree(_gtmp, ignore_errors=True)
+    gang_obs = {
+        "ok": tripped and gangz_ok and statusz_ok
+        and (healthy.get(0) is None or healthy[0] < 2.0),
+        "straggler_tripped": tripped,
+        "healthy_rank_score": healthy.get(0),
+        "digest_version": digest_v,
+        "gangz_serves_digest": gangz_ok,
+        "statusz_max_straggler_rank1": statusz_ok,
+    }
+except Exception as e:  # noqa: BLE001 - artifact records the failure
+    gang_obs["error"] = "%s: %s" % (type(e).__name__, e)
+
 counters = monitor.get_float_stats()
 artifact = {
     "n_devices": len(jax.devices()),
@@ -718,7 +795,8 @@ artifact = {
     and quant_smoke.get("ok", False)
     and autotune_smoke.get("ok", False)
     and collective_quant.get("ok", False)
-    and slo_smoke.get("ok", False) and multihost.get("ok", False),
+    and slo_smoke.get("ok", False) and multihost.get("ok", False)
+    and gang_obs.get("ok", False),
     "skipped": False,
     "spmd_tests_rc": test_rc,
     "mesh_plan": {
@@ -736,20 +814,21 @@ artifact = {
     "autotune": autotune_smoke,
     "collective_quant": collective_quant,
     "slo": slo_smoke,
+    "gang_observability": gang_obs,
     "collectives": {k: v for k, v in sorted(counters.items())
                     if k.startswith("STAT_mesh_collective_")},
     "mesh_counters": {k: v for k, v in sorted(counters.items())
                       if k.startswith("STAT_mesh_")},
     "tail": buf.getvalue() + ("" if err is None else err + "\n"),
 }
-with open("MULTICHIP_r08.json", "w") as f:
+with open("MULTICHIP_r09.json", "w") as f:
     json.dump(artifact, f, indent=1)
     f.write("\n")
 print(json.dumps({k: artifact[k] for k in
                   ("n_devices", "rc", "ok", "spmd_tests_rc",
                    "introspect", "chaos", "multihost", "generation",
                    "quant", "autotune", "collective_quant", "slo",
-                   "collectives")},
+                   "gang_observability", "collectives")},
                  indent=1))
 sys.exit(0 if artifact["ok"] else 1)
 EOF

@@ -19,6 +19,12 @@ Env contract (beyond the launcher's PADDLE_* variables):
   GANG_FP        failpoint spec armed IFF this rank is GANG_FP_RANK and
   GANG_FP_RANK   this is gang attempt 0 (so the restarted gang runs
                  clean and recovery can be asserted)
+  GANG_PHASES    "1" enables FLAGS_step_phases so the heartbeat digest
+                 carries per-phase timers (the straggler drill needs
+                 dev_us to attribute host-side stalls to the injected
+                 rank; rank-targeted injection itself uses the
+                 PADDLE_TPU_FAILPOINTS_RANK<k> env armed at
+                 failpoints import)
 """
 import os
 import sys
@@ -99,6 +105,9 @@ def main():
 
     def loss_fn(pred, label):
         return ((pred - label) * (pred - label)).mean()
+
+    if os.environ.get("GANG_PHASES", "") not in ("", "0"):
+        pt.set_flags({"FLAGS_step_phases": True})
 
     model = MLP()
     opt = pt.optimizer.SGD(0.1, parameters=model.parameters())

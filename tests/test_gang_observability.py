@@ -1,0 +1,773 @@
+"""Gang-wide observability plane (ISSUE 18, docs/observability.md
+"Gang-wide observability").
+
+Fast tier: the digest schema / version / size-cap contract, the
+digest-OFF wire staying byte-identical to the PR-13 heartbeat (with
+build_digest pinned uncalled), the supervisor's bounded line reader
+surviving oversized and malformed digests (regression for the
+unbounded-readline bug), rank-labeled re-emission + gauge retraction
+on stop, deterministic straggler scoring and the skew SLO paging and
+clearing under a fake clock, /gangz over HTTP, step-phase timers
+summing to the measured step total on the real TrainStep (legacy and
+fenced manual paths), per-rank trace export + tools/trace_merge.py on
+synthetic rank files, and the first(N) failpoint trigger with
+PADDLE_TPU_FAILPOINTS_RANK<k> env arming.
+
+Slow tier (@slow @spmd, run by scripts/run_spmd_tests.sh): the
+end-to-end straggler drill — a real 2-process gang with
+worker.step=delay armed on rank 1 only; its score trips above the
+threshold, the skew SLO pages, and both clear after the self-clearing
+first(N) injection drains.
+"""
+import contextlib
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import failpoints, introspect, launch, monitor, slo
+from paddle_tpu.failpoints import InjectedFault
+from paddle_tpu.flags import get_flag, set_flags
+from paddle_tpu.jit import STEP_PHASES, TrainStep
+from paddle_tpu.launch import (GangSupervisor, build_digest, gangz,
+                               gangz_text)
+from paddle_tpu.mesh import ShardingPlan
+from paddle_tpu.monitor import gauge_get, labeled, stat_get, timer_get
+from tools import trace_merge
+
+RUNNER = os.path.join(os.path.dirname(__file__), "gang_runner.py")
+
+
+@contextlib.contextmanager
+def _flags(**kv):
+    old = {k: get_flag(k) for k in kv}
+    set_flags(kv)
+    try:
+        yield
+    finally:
+        set_flags(old)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    failpoints.disarm()
+    yield
+    failpoints.disarm()
+    slo.disable()
+    slo.clear_objectives()
+    monitor.disable_windows()
+
+
+def _poll(pred, timeout=20.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not reached within %.1fs" % timeout)
+
+
+def _seed_phase_timers(n=3):
+    """Deterministic TIMER_step_phase_us samples (the worker-side
+    instrument build_digest summarizes)."""
+    timers = []
+    for i in range(n):
+        for ph, us in (("stage", 100.0), ("dispatch", 50.0),
+                       ("compute", 800.0), ("exchange", 200.0),
+                       ("sync", 40.0), ("total", 1190.0)):
+            timers.append((labeled("TIMER_step_phase_us",
+                                   {"phase": ph}), us + i))
+    monitor.observe_many(timers=timers)
+
+
+# ---------------------------------------------------------------------------
+# digest schema / size cap
+# ---------------------------------------------------------------------------
+
+def test_build_digest_schema():
+    _seed_phase_timers()
+    d = build_digest(step=7)
+    assert d["v"] == launch.DIGEST_VERSION == 1
+    assert d["step"] == 7
+    for ph in STEP_PHASES:
+        st = d["phases"][ph]
+        assert st["n"] >= 1 and st["p50"] > 0 and st["p95"] >= st["p50"]
+    # dev_us covers the device-blocked phases, wait_us the gang tail
+    assert d["dev_us"] > d["wait_us"] > 0
+    # the digest must respect the configured cap and stay far under
+    # the supervisor's hard line bound
+    wire = json.dumps(d, separators=(",", ":"))
+    assert len(wire) <= int(get_flag("FLAGS_launch_digest_max_bytes"))
+    assert len(wire) < launch.MAX_BEAT_LINE / 4
+
+
+def test_build_digest_coll_deltas_between_calls():
+    prev = {}
+    key = labeled("STAT_mesh_collective_bytes",
+                  {"axis": "dp", "dtype": "int8", "op": "psum"})
+    monitor.stat_add(key, 1000)
+    d1 = build_digest(step=1, prev=prev)
+    assert d1["coll"]["int8"] >= 1000
+    # no new traffic -> no coll section (deltas, not totals)
+    d2 = build_digest(step=2, prev=prev)
+    assert "coll" not in d2
+    monitor.stat_add(key, 256)
+    d3 = build_digest(step=3, prev=prev)
+    assert d3["coll"]["int8"] == 256
+
+
+def test_build_digest_size_cap_drops_then_none():
+    _seed_phase_timers()
+    t0 = stat_get("STAT_launch_digest_truncated")
+    full = build_digest(step=9)
+    assert "phases" in full
+    # a cap that only fits the minimal digest: optional fields drop,
+    # the beat still carries v/step
+    minimal = build_digest(step=9, max_bytes=24)
+    assert minimal == {"v": 1, "step": 9}
+    assert stat_get("STAT_launch_digest_truncated") == t0 + 1
+    # a cap nothing fits under: digest skipped entirely, never a
+    # broken beat
+    assert build_digest(step=9, max_bytes=4) is None
+
+
+# ---------------------------------------------------------------------------
+# worker wire: digest-off byte-identical, digest-on appended after
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _wire_beater(monkeypatch, digest_flag, digest_env=None, rank=3):
+    """A real _Beater against a raw listening socket; yields (beater,
+    read_line)."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    if digest_env is None:
+        monkeypatch.delenv("PADDLE_LAUNCH_DIGEST", raising=False)
+    else:
+        monkeypatch.setenv("PADDLE_LAUNCH_DIGEST", digest_env)
+    b = None
+    with _flags(FLAGS_launch_digest=digest_flag):
+        b = launch._Beater("127.0.0.1:%d" % srv.getsockname()[1],
+                           rank=rank, attempt=1, interval_s=60.0,
+                           state="running")
+        conn, _ = srv.accept()
+        f = conn.makefile("r", encoding="utf-8")
+        try:
+            yield b, f.readline
+        finally:
+            b._stop.set()
+            b._sock.close()
+            f.close()
+            conn.close()
+            srv.close()
+
+
+def test_digest_off_wire_byte_identical_pr13(monkeypatch):
+    """Digest off = the PR-13 heartbeat line, byte for byte, and
+    build_digest is never called (the pinned one-flag-lookup disabled
+    path)."""
+    def boom(*a, **k):
+        raise AssertionError("build_digest called on the disabled path")
+    monkeypatch.setattr(launch, "build_digest", boom)
+    with _wire_beater(monkeypatch, digest_flag=False) as (b, readline):
+        line = readline()
+    expect = json.dumps({"rank": 3, "attempt": 1, "pid": os.getpid(),
+                         "state": "running", "step": 0}) + "\n"
+    assert line == expect
+
+
+def test_digest_env_override_wins_over_flag(monkeypatch):
+    """PADDLE_LAUNCH_DIGEST=0 (a digest-off supervisor) beats the
+    worker's own flag: restarted workers keep the gang's setting."""
+    def boom(*a, **k):
+        raise AssertionError("build_digest called under env override")
+    monkeypatch.setattr(launch, "build_digest", boom)
+    with _wire_beater(monkeypatch, digest_flag=True,
+                      digest_env="0") as (b, readline):
+        line = readline()
+    msg = json.loads(line)
+    assert "digest" not in msg
+
+
+def test_digest_on_appends_after_pr13_fields(monkeypatch):
+    _seed_phase_timers()
+    with _wire_beater(monkeypatch, digest_flag=True) as (b, readline):
+        line = readline()
+    msg = json.loads(line)
+    # key order IS the compat contract: the PR-13 prefix first, the
+    # digest appended last (old supervisors ignore the unknown key)
+    assert list(msg) == ["rank", "attempt", "pid", "state", "step",
+                        "digest"]
+    assert msg["digest"]["v"] == 1
+    assert "phases" in msg["digest"]
+
+
+# ---------------------------------------------------------------------------
+# supervisor: bounded reader + malformed-digest regression
+# ---------------------------------------------------------------------------
+
+class _FakeProc:
+    pid = 4242
+    returncode = 0
+
+    def poll(self):
+        return 0  # already-dead: stop()/_kill_gang never signals it
+
+
+def _bare_supervisor(nranks=2, name="obs-unit", **kw):
+    """An unstarted supervisor with injected fake workers — protocol
+    methods (_on_beat/_hb_conn/_ingest_digest) drive it directly, no
+    processes or threads."""
+    kw.setdefault("heartbeat_interval_s", 0.05)
+    kw.setdefault("max_restarts", 0)
+    sup = GangSupervisor([sys.executable, "-c", "pass"], nranks,
+                         name=name, **kw)
+    for r in range(nranks):
+        w = launch._Worker(r, _FakeProc(), None)
+        w.state = "running"
+        sup._workers[r] = w
+    return sup
+
+
+def _beat(rank, step, digest=None, attempt=0):
+    msg = {"rank": rank, "attempt": attempt, "pid": 1, "state": "running",
+           "step": step}
+    if digest is not None:
+        msg["digest"] = digest
+    return msg
+
+
+def test_oversized_heartbeat_line_skimmed_not_fatal():
+    """Regression (satellite bugfix): one oversized line must be
+    counted and skimmed — the connection keeps serving and the gang
+    stays up. The old reader buffered the whole line."""
+    sup = _bare_supervisor(nranks=1, name="obs-oversize")
+    a, b = socket.socketpair()
+    t = threading.Thread(target=sup._hb_conn, args=(b,), daemon=True)
+    t.start()
+    r0 = stat_get("STAT_launch_digest_rejected")
+    try:
+        a.sendall(b'{"rank": 0, "padding": "'
+                  + b"x" * (3 * launch.MAX_BEAT_LINE) + b'"}\n')
+        a.sendall((json.dumps(_beat(0, 5)) + "\n").encode())
+    finally:
+        a.close()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert stat_get("STAT_launch_digest_rejected") >= r0 + 1
+    w = sup._workers[0]
+    assert w.beats == 1 and w.step == 5  # the NEXT beat still lands
+
+
+def test_malformed_digest_drops_metrics_keeps_beat():
+    sup = _bare_supervisor(nranks=1, name="obs-malformed")
+    w = sup._workers[0]
+    r0 = stat_get("STAT_launch_digest_rejected")
+    for bad in ([1, 2, 3],                   # not an object
+                {"v": 99, "step": 1},        # unsupported version
+                {"v": 1, "step": 1,
+                 "phases": {"compute": {}}}):  # missing p50
+        sup._on_beat(_beat(0, 1, digest=bad))
+    assert stat_get("STAT_launch_digest_rejected") == r0 + 3
+    assert w.beats == 3  # liveness never depends on the metrics
+    assert w.digest is None or w.digest == bad
+
+
+def test_non_dict_beat_line_ignored():
+    sup = _bare_supervisor(nranks=1, name="obs-nondict")
+    a, b = socket.socketpair()
+    t = threading.Thread(target=sup._hb_conn, args=(b,), daemon=True)
+    t.start()
+    try:
+        a.sendall(b'[1, 2]\nnot json at all\n')
+        a.sendall((json.dumps(_beat(0, 2)) + "\n").encode())
+    finally:
+        a.close()
+    t.join(timeout=10)
+    assert sup._workers[0].beats == 1
+
+
+# ---------------------------------------------------------------------------
+# supervisor: re-emission, straggler scoring, retraction
+# ---------------------------------------------------------------------------
+
+def _digest(step, dev_us, wait_us, p50=1000.0):
+    return {"v": 1, "step": step,
+            "phases": {"compute": {"n": 5, "p50": p50, "p95": p50 * 2}},
+            "dev_us": dev_us, "wait_us": wait_us}
+
+
+def test_reemission_scoring_and_retraction(monkeypatch):
+    """Two fake ranks beat digests under a fake monotonic clock: the
+    host-dragging rank (low dev_us: its stall is OUTSIDE the step)
+    scores above threshold, the healthy rank (its wait lands INSIDE
+    dev_us) stays ~1, wait fractions and rank-labeled gauges re-emit,
+    and stop() retracts every gang gauge."""
+    clk = FakeClock(5000.0)
+    monkeypatch.setattr(time, "monotonic", clk)
+    sup = _bare_supervisor(name="obs-score", straggler_threshold=2.0,
+                           straggler_window_s=100.0)
+    g0 = stat_get("STAT_gang_digest_beats")
+    s0 = stat_get("STAT_gang_straggler_beats")
+    sup._on_beat(_beat(0, 0, _digest(0, 0.0, 0.0)))
+    sup._on_beat(_beat(1, 0, _digest(0, 0.0, 0.0)))
+    clk.t += 10.0
+    # 10s wall, 10 steps each -> gang median step time 1s. rank0: 9.5s
+    # inside the step (3s of it exchange wait) -> self 50ms/step.
+    # rank1: only 4s inside -> self 600ms/step. The denominator is
+    # max(median self 50ms, 0.25 * 1s step floor) = 250ms, so the
+    # scores are 0.2 and 2.4 -- over the 2.0 threshold.
+    sup._on_beat(_beat(0, 10, _digest(10, 9.5e6, 3.0e6)))
+    sup._on_beat(_beat(1, 10, _digest(10, 4.0e6, 0.2e6)))
+
+    assert stat_get("STAT_gang_digest_beats") == g0 + 4
+    assert stat_get("STAT_gang_straggler_beats") >= s0 + 1
+    lbl0 = {"gang": "obs-score", "rank": "0"}
+    lbl1 = {"gang": "obs-score", "rank": "1"}
+    assert gauge_get(labeled("GAUGE_gang_step", lbl1)) == 10.0
+    assert gauge_get(labeled("GAUGE_gang_straggler_score", lbl0)) \
+        == pytest.approx(0.2)
+    assert gauge_get(labeled("GAUGE_gang_straggler_score", lbl1)) \
+        == pytest.approx(2.4)
+    assert gauge_get(labeled("GAUGE_gang_collective_wait_frac", lbl0)) \
+        == pytest.approx(0.3)
+    tg = timer_get(labeled("TIMER_gang_step_phase_us",
+                           {**lbl1, "phase": "compute"}))
+    assert tg["count"] >= 2 and tg["p50"] == pytest.approx(1000.0)
+
+    st = sup.status()
+    by_rank = {w["rank"]: w for w in st["workers"]}
+    assert by_rank[1]["straggler_score"] == pytest.approx(2.4)
+    assert by_rank[0]["wait_frac"] == pytest.approx(0.3)
+    assert st["straggler"]["threshold"] == 2.0
+
+    sup.stop()
+    for fam in GangSupervisor.GANG_GAUGE_FAMILIES:
+        for lbl in (lbl0, lbl1):
+            assert gauge_get(labeled(fam, lbl), None) is None, \
+                "stale %s survived stop()" % fam
+
+
+def test_scores_without_phase_timers_fall_back_to_raw_rate(monkeypatch):
+    """Digests without dev_us (FLAGS_step_phases off on the worker)
+    still score — on raw step time, which catches a rank whose steps
+    are genuinely slower when the gang is not collectively-synchronous
+    (e.g. an async data-parallel setup)."""
+    clk = FakeClock(7000.0)
+    monkeypatch.setattr(time, "monotonic", clk)
+    sup = _bare_supervisor(name="obs-fallback",
+                           straggler_window_s=100.0)
+    sup._on_beat(_beat(0, 0, {"v": 1, "step": 0}))
+    sup._on_beat(_beat(1, 0, {"v": 1, "step": 0}))
+    clk.t += 10.0
+    sup._on_beat(_beat(0, 20, {"v": 1, "step": 20}))  # 0.5 s/step
+    sup._on_beat(_beat(1, 5, {"v": 1, "step": 5}))    # 2.0 s/step
+    assert gauge_get(labeled("GAUGE_gang_straggler_score",
+                             {"gang": "obs-fallback", "rank": "1"})) \
+        == pytest.approx(4.0)
+    sup.stop()
+
+
+def test_digestless_beats_still_parse_no_scores():
+    """A gang of PR-13 workers (no digest field at all) keeps full
+    liveness semantics and simply shows no observability columns."""
+    sup = _bare_supervisor(name="obs-plain")
+    for n in (1, 2, 3):
+        sup._on_beat(_beat(0, n))
+        sup._on_beat(_beat(1, n))
+    w = sup._workers[0]
+    assert w.beats == 3 and w.step == 3
+    assert w.score is None and w.digest is None
+    st = sup.status()
+    assert all(x["straggler_score"] is None for x in st["workers"])
+    sup.stop()
+
+
+# ---------------------------------------------------------------------------
+# /gangz + /statusz
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=10):
+    r = urllib.request.urlopen(url, timeout=timeout)
+    return r.status, r.read().decode()
+
+
+def test_gangz_endpoint_and_statusz_section(monkeypatch):
+    clk = FakeClock(9000.0)
+    monkeypatch.setattr(time, "monotonic", clk)
+    sup = _bare_supervisor(name="obs-http", straggler_threshold=2.0,
+                           straggler_window_s=100.0)
+    launch._SUPERVISORS.add(sup)
+    sup._on_beat(_beat(0, 0, _digest(0, 0.0, 0.0)))
+    sup._on_beat(_beat(1, 0, _digest(0, 0.0, 0.0)))
+    clk.t += 10.0
+    sup._on_beat(_beat(0, 10, _digest(10, 9.5e6, 3.0e6)))
+    sup._on_beat(_beat(1, 10, _digest(10, 4.0e6, 0.2e6)))
+    srv = introspect.start(port=0)
+    try:
+        code, body = _get(srv.url + "/gangz?format=json")
+        assert code == 200
+        gang = [g for g in json.loads(body)["gangs"]
+                if g["name"] == "obs-http"][0]
+        w1 = [w for w in gang["workers"] if w["rank"] == 1][0]
+        assert w1["digest_v"] == 1
+        assert w1["phases"]["compute"]["p50"] == 1000.0
+        assert w1["straggler_score"] == pytest.approx(2.4)
+
+        code, body = _get(srv.url + "/gangz")
+        assert code == 200
+        assert "gang obs-http" in body and "straggler" in body
+        assert "compute=1000" in body
+
+        code, body = _get(srv.url + "/statusz")
+        gz = [g for g in json.loads(body)["gangs"]
+              if g["name"] == "obs-http"][0]
+        assert gz["max_straggler"]["rank"] == 1
+        assert gz["max_straggler"]["score"] == pytest.approx(2.4)
+
+        code, body = _get(srv.url + "/")
+        assert "/gangz" in body
+    finally:
+        introspect.stop()
+        launch._SUPERVISORS.discard(sup)
+        sup.stop()
+
+
+def test_gangz_text_no_gangs():
+    assert "no live gangs" in gangz_text()
+
+
+# ---------------------------------------------------------------------------
+# skew SLO: pages on a persistent straggler, clears after
+# ---------------------------------------------------------------------------
+
+def test_gang_objective_installed_with_defaults():
+    slo.clear_objectives()
+    slo.install_default_objectives()
+    names = [o.name for o in slo.objectives()]
+    assert "gang_straggler_skew" in names
+    obj = [o for o in slo.objectives()
+           if o.name == "gang_straggler_skew"][0]
+    assert obj.kind == "ratio"
+    # target 0.95 keeps full-outage burn (1/(1-target) = 20) above
+    # fast_burn=14: a persistent straggler CAN page. 0.9 would cap
+    # burn at 10 and the objective could never fire.
+    assert obj.target == 0.95
+    assert 1.0 / (1.0 - obj.target) >= obj.fast_burn
+    slo.install_default_objectives()  # idempotent re-register
+    assert len([o for o in slo.objectives()
+                if o.name == "gang_straggler_skew"]) == 1
+
+
+def test_skew_slo_pages_and_clears():
+    clk = FakeClock(5.0)
+    slo.enable(bucket_s=10.0, n_buckets=60, clock=clk)
+    slo.clear_objectives()
+    slo.install_gang_objectives()
+    olbl = {"objective": "gang_straggler_skew"}
+
+    monitor.stat_add("STAT_gang_digest_beats", 10)  # healthy beats
+    ev = slo.evaluate(now=clk.t)
+    assert ev["firing"] == []
+
+    clk.t = 15.0  # persistent straggler: every beat is a bad beat
+    monitor.stat_add("STAT_gang_digest_beats", 90)
+    monitor.stat_add("STAT_gang_straggler_beats", 90)
+    ev = slo.evaluate(now=clk.t)
+    r = [o for o in ev["objectives"]
+         if o["name"] == "gang_straggler_skew"][0]
+    assert r["alert"]["firing"] is True
+    assert r["alert"]["severity"] == "page"
+    assert gauge_get(labeled("GAUGE_slo_alert_firing", olbl)) == 1.0
+
+    # straggler drained: good beats flow and dilute BOTH windows below
+    # their burn thresholds (slow/ticket needs bad/total < 0.3, so the
+    # 90 bad beats must fall under 30% of the in-window total)
+    clk.t = 25.0
+    monitor.stat_add("STAT_gang_digest_beats", 250)
+    ev = slo.evaluate(now=clk.t)
+    r = [o for o in ev["objectives"]
+         if o["name"] == "gang_straggler_skew"][0]
+    assert r["alert"]["firing"] is False
+    assert gauge_get(labeled("GAUGE_slo_alert_firing", olbl)) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# step-phase decomposition on the real TrainStep
+# ---------------------------------------------------------------------------
+
+def _ts_loss(out, label):
+    import paddle_tpu.nn.functional as F
+    return F.cross_entropy(out, label)
+
+
+def _run_steps(step, steps=4, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(steps):
+        x = rng.randn(batch, 8).astype(np.float32)
+        y = rng.randint(0, 4, (batch, 1)).astype(np.int32)
+        out.append(float(step((x,), (y,))))
+    return out
+
+
+def _phase_sums():
+    return {ph: timer_get(labeled("TIMER_step_phase_us",
+                                  {"phase": ph}))["sum"]
+            for ph in STEP_PHASES}
+
+
+def test_phase_timers_sum_to_step_total_legacy():
+    from paddle_tpu import nn
+    pt.dygraph.seed(11)
+    m = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+    o = pt.optimizer.SGD(0.1, parameters=m.parameters())
+    with _flags(FLAGS_step_phases=True):
+        step = TrainStep(m, _ts_loss, o)
+        before = _phase_sums()
+        _run_steps(step)
+        after = _phase_sums()
+    assert step._has_fence is False
+    d = {ph: after[ph] - before[ph] for ph in STEP_PHASES}
+    parts = d["stage"] + d["dispatch"] + d["compute"] + d["exchange"] \
+        + d["sync"]
+    # consecutive intervals of ONE clock: the parts sum to the total
+    # by construction (float rounding only)
+    assert parts == pytest.approx(d["total"], rel=0.02)
+    assert d["total"] > 0 and d["compute"] > 0
+    assert d["exchange"] == 0.0  # no fence on the legacy path
+
+
+def test_phase_timers_fenced_manual_path_and_loss_parity():
+    """The fence changes the traced program (a 4th output) but must
+    not change the math: loss stream identical with phases on/off.
+    Exchange shows up as its own phase on the fenced path."""
+    from paddle_tpu import nn
+
+    def build(phases):
+        pt.dygraph.seed(13)
+        m = nn.Sequential(nn.Linear(8, 32), nn.ReLU(),
+                          nn.Linear(32, 4))
+        o = pt.optimizer.SGD(0.1, parameters=m.parameters())
+        set_flags({"FLAGS_step_phases": phases})
+        return TrainStep(m, _ts_loss, o, plan=ShardingPlan("dp4"))
+
+    with _flags(FLAGS_step_phases=False,
+                FLAGS_collective_quant="int8",
+                FLAGS_collective_quant_min_numel=16):
+        base = _run_steps(build(False))
+        before = _phase_sums()
+        step = build(True)
+        fenced = _run_steps(step)
+        after = _phase_sums()
+    assert step._has_fence is True
+    assert fenced == base
+    d = {ph: after[ph] - before[ph] for ph in STEP_PHASES}
+    parts = sum(d[ph] for ph in STEP_PHASES if ph != "total")
+    assert parts == pytest.approx(d["total"], rel=0.02)
+    assert d["compute"] > 0
+
+
+def test_step_phases_is_a_lowering_flag():
+    from paddle_tpu.flags import _LOWERING_FLAGS
+    assert "FLAGS_step_phases" in _LOWERING_FLAGS
+
+
+# ---------------------------------------------------------------------------
+# per-rank trace export + trace merge
+# ---------------------------------------------------------------------------
+
+def test_rank_trace_export(tmp_path, monkeypatch):
+    from paddle_tpu import profiler
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    profiler.reset_profiler()
+    profiler.add_trace_event("phase/compute", 100.0, 50.0, cat="phase",
+                             track="phase", step=4)
+    out = profiler.maybe_export_rank_trace(str(tmp_path))
+    assert out == str(tmp_path / "trace_rank2.json")
+    trace = json.loads((tmp_path / "trace_rank2.json").read_text())
+    evs = trace["traceEvents"]
+    assert all(e["pid"] == 2 for e in evs)
+    pname = [e for e in evs if e.get("ph") == "M"
+             and e["name"] == "process_name"]
+    assert pname and pname[0]["args"]["name"] == "rank 2"
+    x = [e for e in evs if e.get("ph") == "X"][0]
+    assert x["args"]["step"] == 4
+    profiler.reset_profiler()
+
+
+def test_rank_trace_export_is_noop_without_dir(monkeypatch):
+    from paddle_tpu import profiler
+    monkeypatch.delenv("PADDLE_TPU_TRACE_DIR", raising=False)
+    assert profiler.maybe_export_rank_trace() is None
+
+
+def _synth_rank_trace(rank, base_ts, steps, step_us=1000.0):
+    """A synthetic per-rank file the shape maybe_export_rank_trace
+    writes: per-step phase spans + process metadata, clock origin at
+    base_ts."""
+    evs = [{"name": "process_name", "ph": "M", "pid": rank, "tid": 0,
+            "args": {"name": "rank %d" % rank}}]
+    for i, s in enumerate(steps):
+        ts = base_ts + i * step_us
+        evs.append({"name": "phase/compute", "cat": "phase", "ph": "X",
+                    "ts": ts, "dur": step_us * 0.7, "pid": rank,
+                    "tid": 1, "args": {"step": s}})
+        evs.append({"name": "phase/sync", "cat": "phase", "ph": "X",
+                    "ts": ts + step_us * 0.7, "dur": step_us * 0.2,
+                    "pid": rank, "tid": 1, "args": {"step": s}})
+    return {"traceEvents": evs}
+
+
+def test_trace_merge_aligns_on_common_step(tmp_path):
+    # rank clocks start eons apart; step 2 is the earliest common step
+    r0 = _synth_rank_trace(0, 1_000.0, steps=[1, 2, 3])
+    r1 = _synth_rank_trace(1, 9_000_000.0, steps=[2, 3, 4])
+    merged = trace_merge.merge_traces([r0, r1])
+    assert merged["metadata"]["align_step"] == 2
+    assert merged["metadata"]["ranks"] == [0, 1]
+    evs = merged["traceEvents"]
+    for rank in (0, 1):
+        anchor = min(e["ts"] for e in evs
+                     if e.get("ph") == "X" and e["pid"] == rank
+                     and e["args"]["step"] == 2)
+        assert anchor == 0.0  # the common step starts at ts=0 per rank
+        # a uniform shift preserves per-rank monotonicity
+        ts = [e["ts"] for e in evs
+              if e.get("ph") == "X" and e["pid"] == rank]
+        assert ts == sorted(ts)
+        names = {(e["name"], e["pid"]) for e in evs
+                 if e.get("ph") == "M"}
+        assert ("process_name", rank) in names
+        assert ("process_sort_index", rank) in names
+
+
+def test_trace_merge_cli_roundtrip(tmp_path):
+    p0, p1 = str(tmp_path / "trace_rank0.json"), \
+        str(tmp_path / "trace_rank1.json")
+    with open(p0, "w") as f:
+        json.dump(_synth_rank_trace(0, 50.0, [1, 2]), f)
+    with open(p1, "w") as f:
+        json.dump(_synth_rank_trace(1, 777.0, [1, 2]), f)
+    out = str(tmp_path / "merged.json")
+    assert trace_merge.main([p0, p1, "-o", out,
+                             "--align-step", "1"]) == 0
+    merged = json.load(open(out))  # valid JSON on disk
+    assert merged["metadata"]["align_step"] == 1
+    assert {e["pid"] for e in merged["traceEvents"]} == {0, 1}
+
+
+def test_trace_merge_rank_missing_anchor_best_effort():
+    r0 = _synth_rank_trace(0, 100.0, steps=[5, 6])
+    r1 = {"traceEvents": [{"name": "spawn", "cat": "op", "ph": "X",
+                           "ts": 4_000.0, "dur": 10.0, "pid": 1,
+                           "tid": 1}]}  # crash-looper: never stepped
+    merged = trace_merge.merge_traces([r0, r1])
+    evs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    # the stepless rank falls back to min-ts alignment, still present
+    assert min(e["ts"] for e in evs if e["pid"] == 1) == 0.0
+    assert {e["pid"] for e in evs} == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# failpoints: first(N) trigger + rank-targeted env arming
+# ---------------------------------------------------------------------------
+
+def test_first_n_trigger_fires_then_drains():
+    with failpoints.armed("worker.step=raise@first(2)"):
+        for _ in range(2):
+            with pytest.raises(InjectedFault):
+                failpoints.failpoint("worker.step")
+        # self-cleared: the drill's "disarm" needs no second actor
+        for _ in range(5):
+            failpoints.failpoint("worker.step")
+
+
+def test_rank_env_arming_targets_one_rank():
+    env = {"PADDLE_TRAINER_ID": "1",
+           "PADDLE_TPU_FAILPOINTS_RANK1": "worker.step=raise@once"}
+    try:
+        assert failpoints._arm_from_env(env) == ["worker.step"]
+        with pytest.raises(InjectedFault):
+            failpoints.failpoint("worker.step")
+    finally:
+        failpoints.disarm()
+    # every other rank ignores the rank-1 spec
+    env["PADDLE_TRAINER_ID"] = "0"
+    assert failpoints._arm_from_env(env) == []
+    failpoints.failpoint("worker.step")
+
+
+# ---------------------------------------------------------------------------
+# slow tier: the end-to-end straggler drill
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.spmd
+def test_straggler_drill_real_gang(tmp_path):
+    """A real 2-process gang with worker.step=delay(250)@first(10)
+    armed on rank 1 ONLY (env-targeted): rank 1's straggler score
+    trips above the threshold while the injection runs, the skew SLO
+    pages, and both clear after the self-clearing trigger drains —
+    the acceptance drill for the observability plane."""
+    env = dict(os.environ)
+    env.update({
+        "GANG_STEPS": "8000", "GANG_PHASES": "1",
+        "PADDLE_TPU_FAILPOINTS_RANK1":
+            "worker.step=delay(250)@first(50)",
+    })
+    slo.enable(bucket_s=0.5, n_buckets=240)
+    slo.clear_objectives()
+    sup = GangSupervisor(
+        [RUNNER], 2, cpu_devices_per_proc=2,
+        log_dir=str(tmp_path / "logs"), env=env,
+        heartbeat_interval_s=0.05, heartbeat_timeout_s=30.0,
+        spawn_grace_s=60.0, max_restarts=0,
+        straggler_threshold=2.0, straggler_window_s=1.5,
+        name="drill")
+    sup.start()  # installs the default gang_straggler_skew objective
+    # compress the alert windows to the drill's timescale (the armed
+    # epoch is ~12.5s: 50 steps x 250ms); re-register AFTER start()
+    # since register() replaces by name
+    slo.install_gang_objectives(fast_window_s=8.0, slow_window_s=16.0)
+
+    def score(rank):
+        for w in sup.status()["workers"]:
+            if w["rank"] == rank:
+                return w["straggler_score"]
+        return None
+
+    def firing():
+        return "gang_straggler_skew" in slo.evaluate()["firing"]
+
+    try:
+        # trip: the delayed host's stall lands OUTSIDE its jitted step,
+        # so rank 1 (and only rank 1) scores as the straggler
+        _poll(lambda: (score(1) or 0.0) > 2.0, timeout=120.0)
+        healthy = score(0)
+        assert healthy is None or healthy < 2.0
+        # the skew SLO pages within a couple of heartbeat windows
+        _poll(firing, timeout=30.0)
+        # drain: first(10) self-clears; the sliding window forgets the
+        # slow epoch, the score drops, the page clears on live beats
+        _poll(lambda: (score(1) or 99.0) < 1.5, timeout=120.0)
+        _poll(lambda: not firing(), timeout=60.0)
+    finally:
+        sup.stop()

@@ -71,9 +71,10 @@ def _check_family_membership(fams, samples):
     allows. This is exactly what a strict scraper enforces: counter and
     gauge samples may carry a label block (the labeled() series of
     monitor.py — per-tenant families, the per-axis/dtype collective
-    bytes census), but a summary family may only hold quantile samples
-    plus its _sum/_count, and any label block must be well-formed
-    key="value" pairs."""
+    bytes census), and so may a labeled summary series' _sum/_count
+    (the per-rank gang phase timers) — but every quantile sample must
+    carry quantile=, _sum/_count must NOT, and any label block must be
+    well-formed key="value" pairs."""
     label_re = re.compile(
         r'^\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
         r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\}$')
@@ -92,7 +93,8 @@ def _check_family_membership(fams, samples):
                      and fams.get(name[:-len(s)]) == "summary"), None)
         assert base is not None, \
             "sample %s belongs to no declared family" % name
-        assert labels == "", "summary %s sample has labels" % name
+        assert "quantile=" not in labels, \
+            "summary %s sample carries a quantile label" % name
 
 
 @pytest.fixture

@@ -45,8 +45,19 @@ COST_SUFFIXES = ("_sync", "_miss", "_corrupt", "_evict", "_dropped",
 # in a quant-OFF baseline run means the fp32 path silently started
 # quantizing — a correctness regression the percentage gate must flag
 # regardless of magnitude.
+# The gang-observability families (docs/observability.md "Gang-wide
+# observability") join here: STAT_gang_straggler_beats (digest beats
+# observed with a rank over the skew threshold) and the digest
+# ingestion faults STAT_launch_digest_rejected / _truncated are costs
+# — a clean gang produces none of them. The _step_phase_ infix covers
+# any future counter in the step-phase family; the TIMER_step_phase_us
+# / TIMER_gang_step_phase_us latency timers are already gated by the
+# generic p95 timer check, and the GAUGE_gang_straggler_score gauge is
+# exempt by construction (gauges are never cost-flagged: a score
+# sample is a reading, not an accumulation).
 COST_INFIXES = ("_shed_", "_restart", "_kv_quant_", "_autotune_",
-                "_collective_quant_")
+                "_collective_quant_", "_gang_", "_step_phase_",
+                "_digest_")
 # cost-family exemptions: STAT_autotune_cache_hits is the HEALTHY
 # autotune steady state (policy resolved from the table, no trials
 # run) — growth there is good. Growth in the rest of the _autotune_
@@ -58,8 +69,12 @@ COST_INFIXES = ("_shed_", "_restart", "_kv_quant_", "_autotune_",
 # steady state (bucket exchanges dispatched per step, docs/spmd.md);
 # only _fallbacks growth — buckets demoted to fp32 by faults — is a
 # cost.
+# STAT_gang_digest_beats is the skew SLO's free-running TOTAL (every
+# ingested digest counts one) — growth is the healthy heartbeat
+# steady state, so it is exempt from the _gang_/_digest_ cost infixes.
 COST_EXEMPT_SUFFIXES = ("_autotune_cache_hits",
-                        "_collective_quant_buckets")
+                        "_collective_quant_buckets",
+                        "_gang_digest_beats")
 
 
 def _family(name: str) -> str:

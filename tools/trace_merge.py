@@ -1,0 +1,162 @@
+"""Merge per-rank chrome-trace files into one gang timeline.
+
+Each gang worker exports its own chrome://tracing JSON at exit
+(paddle_tpu.profiler.maybe_export_rank_trace writes
+``$PADDLE_TPU_TRACE_DIR/trace_rank<k>.json`` with pid=rank). The files
+share no clock: every rank stamps events with its OWN
+``time.perf_counter()`` origin, so loading two of them side by side in
+chrome://tracing shows rank 1's step 40 nowhere near rank 0's. This
+tool aligns them on the *step index* instead of the wall clock — in a
+synchronous SPMD gang the collective at step N is a barrier, so the
+start of step N is the one host-side instant that is simultaneous
+across ranks up to the straggler skew this alignment exists to make
+visible.
+
+Used two ways:
+
+- CLI: ``python tools/trace_merge.py trace_rank0.json trace_rank1.json
+  -o merged.json [--align-step N]`` — merges N rank files; load
+  merged.json in chrome://tracing or Perfetto and each rank renders as
+  its own process row ("rank k").
+- library: ``merge_traces(paths_or_payloads, align_step=None)``
+  returns the merged trace dict (tests/test_gang_observability.py
+  drives it on synthetic rank files).
+
+Alignment: for each rank, the anchor is the earliest ``ts`` among
+events carrying ``args.step == align_step`` (default: the earliest
+step index present in EVERY input — ranks restarted mid-run trim to
+the common suffix). Every event of that rank is shifted by
+``-anchor``, so the chosen step starts at ts=0 on all ranks and any
+inter-rank skew at later steps is real drift, not clock origin.
+Inputs missing the anchor step fall back to their minimum ts (best
+effort, still one process row — a rank that never stepped, e.g. a
+crash-looping worker, should still show its spans).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+
+def _event_step(e: Dict[str, Any]) -> Optional[int]:
+    s = (e.get("args") or {}).get("step")
+    if s is None:
+        return None
+    try:
+        return int(s)
+    except (TypeError, ValueError):
+        return None
+
+
+def _load(src: Union[str, Dict[str, Any]]) -> Dict[str, Any]:
+    if isinstance(src, dict):
+        return src
+    with open(src) as f:
+        return json.load(f)
+
+
+def _rank_of(payload: Dict[str, Any], index: int) -> int:
+    """The rank a file claims via its event pids (profiler exports with
+    pid=rank); argv order breaks ties for hand-made files with pid 0."""
+    for e in payload.get("traceEvents", ()):
+        if e.get("ph") != "M" and "pid" in e:
+            return int(e["pid"])
+    return index
+
+
+def _steps_of(payload: Dict[str, Any]) -> List[int]:
+    return sorted({s for e in payload.get("traceEvents", ())
+                   if (s := _event_step(e)) is not None})
+
+
+def _anchor_ts(payload: Dict[str, Any],
+               step: Optional[int]) -> float:
+    """Min ts of the anchor step's events; min ts overall as the
+    no-anchor fallback; 0.0 for an empty trace."""
+    events = [e for e in payload.get("traceEvents", ())
+              if e.get("ph") != "M" and "ts" in e]
+    if step is not None:
+        anchored = [e["ts"] for e in events if _event_step(e) == step]
+        if anchored:
+            return float(min(anchored))
+    return float(min((e["ts"] for e in events), default=0.0))
+
+
+def merge_traces(sources: Sequence[Union[str, Dict[str, Any]]],
+                 align_step: Optional[int] = None) -> Dict[str, Any]:
+    """Merge rank trace files/payloads into one chrome-trace dict.
+
+    Per input: pid is forced to the file's rank, every ts is shifted so
+    the alignment anchor lands at 0, and process_name /
+    process_sort_index metadata make chrome://tracing render the ranks
+    as ordered "rank k" rows. Event order within a rank is preserved;
+    merged events stay ts-monotonic per (pid, tid) because a uniform
+    shift cannot reorder a monotonic input."""
+    payloads = [_load(s) for s in sources]
+    if align_step is None:
+        common: Optional[set] = None
+        for p in payloads:
+            steps = set(_steps_of(p))
+            if steps:
+                common = steps if common is None else common & steps
+        if common:
+            align_step = min(common)
+
+    merged: List[Dict[str, Any]] = []
+    seen_ranks: List[int] = []
+    for i, payload in enumerate(payloads):
+        rank = _rank_of(payload, i)
+        seen_ranks.append(rank)
+        shift = _anchor_ts(payload, align_step)
+        for e in payload.get("traceEvents", ()):
+            out = dict(e)
+            out["pid"] = rank
+            if "ts" in out:
+                out["ts"] = float(out["ts"]) - shift
+            if out.get("ph") == "M" and out.get("name") == \
+                    "process_name":
+                # input metadata keeps its label but moves to the
+                # merged pid with the rest of the rank's events
+                out["args"] = dict(out.get("args") or
+                                   {"name": "rank %d" % rank})
+            merged.append(out)
+
+    meta: List[Dict[str, Any]] = []
+    for rank in sorted(set(seen_ranks)):
+        meta.append({"name": "process_name", "ph": "M", "pid": rank,
+                     "tid": 0, "args": {"name": "rank %d" % rank}})
+        meta.append({"name": "process_sort_index", "ph": "M",
+                     "pid": rank, "tid": 0,
+                     "args": {"sort_index": rank}})
+    return {"traceEvents": meta + merged,
+            "metadata": {"align_step": align_step,
+                         "ranks": sorted(set(seen_ranks))}}
+
+
+def main(argv: List[str]) -> int:
+    p = argparse.ArgumentParser(
+        description="merge per-rank paddle_tpu chrome-trace files, "
+                    "aligned on a common step index")
+    p.add_argument("traces", nargs="+",
+                   help="per-rank trace JSON files (trace_rank*.json)")
+    p.add_argument("-o", "--output", required=True,
+                   help="merged chrome-trace JSON path")
+    p.add_argument("--align-step", type=int, default=None,
+                   help="step index to align ranks on (default: "
+                        "earliest step present in every input)")
+    ns = p.parse_args(argv)
+    trace = merge_traces(ns.traces, align_step=ns.align_step)
+    with open(ns.output, "w") as f:
+        json.dump(trace, f)
+    n_ev = len(trace["traceEvents"])
+    print("merged %d files (%d events, ranks %s) -> %s [align_step=%s]"
+          % (len(ns.traces), n_ev,
+             trace["metadata"]["ranks"], ns.output,
+             trace["metadata"]["align_step"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
