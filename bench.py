@@ -2009,6 +2009,340 @@ def bench_quantized_collectives():
                  "(see stderr)"}
 
 
+def _mp_quant_collectives_worker():
+    """mp_quantized_collectives block worker (ISSUE 19, docs/spmd.md
+    "Quantized collectives on the mp axis"): the SAME 12-layer
+    BERT-shaped step as _quant_collectives_worker, but under dp4xmp2
+    with Megatron param rules — FFN up column-sharded, FFN down and the
+    embedding table row-sharded over mp — so the mp-axis quantized
+    all-gather composes with the dp-axis gradient wire in one build.
+
+    Measures the ISSUE-19 acceptance gates directly:
+    - ZERO demotions: every mesh-sharded param rides the quantized
+      gather (STAT_collective_quant_demotions delta across all composed
+      builds must be 0, and no demotion warning fires);
+    - per-step mp-axis sync bytes >= 3x smaller for int8 vs the
+      fp32-composed oracle, from the per-axis census manifest (the same
+      numbers STAT_mesh_collective_bytes{axis="mp",dtype} publishes);
+    - 50-step loss trajectory within 0.05 of the fp32-composed oracle
+      (which itself must match the legacy flag-off GSPMD path — the
+      gather/slice math is exact in fp32);
+    - zero steady-state recompiles per mode (the out_shardings pin:
+      sharded state stays sharded at rest without a spec-spelling
+      cache miss);
+    - fp8-e4m3 exercised where quant.supports_fp8() admits, with the
+      resolved wire mode pinned in the artifact either way.
+
+    Step-time numbers carry the same CPU-fabric caveat as the dp block:
+    on shared-memory fake devices XLA's AllGather is nearly free, so
+    no speed CLAIM is made — the wire-byte ratio is what a real
+    DCN/ICI fabric would amortize."""
+    import warnings
+    import jax
+    from jax.sharding import PartitionSpec as P
+    import paddle_tpu as pt
+    from paddle_tpu import monitor, quant
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.mesh import ShardingPlan
+    from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
+                                        pretraining_loss)
+
+    assert jax.default_backend() == "cpu", jax.default_backend()
+    assert len(jax.devices()) >= 8, len(jax.devices())
+
+    cfg = BertConfig(vocab_size=512, hidden_size=128,
+                     num_hidden_layers=12, num_attention_heads=4,
+                     intermediate_size=256, max_position_embeddings=64,
+                     hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+    H, I, V = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+
+    def rules(name, shape):
+        # Megatron layout (examples/bert_pretrain.py): FFN up
+        # column-sharded, FFN down row-sharded, embedding row-sharded
+        if shape == (H, I):
+            return P(None, "mp")
+        if shape == (I, H):
+            return P("mp", None)
+        if shape == (V, H):
+            return P("mp", None)
+        return P()
+
+    B, S, accum, traj_steps = 16, 32, 4, 50
+    rng = np.random.RandomState(0)
+    batches = []
+    for _ in range(traj_steps):
+        ids = rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32)
+        mlm = np.where(rng.rand(B, S) < 0.15, ids, -100).astype(np.int32)
+        nsp = rng.randint(0, 2, (B, 1)).astype(np.int32)
+        batches.append((ids, mlm, nsp))
+
+    def build(mode, mp):
+        pt.dygraph.seed(0)
+        np.random.seed(0)
+        set_flags({"FLAGS_collective_quant": mode,
+                   "FLAGS_collective_quant_mp": mp})
+        model = BertForPretraining(cfg)
+        # 1e-4 (vs the dp block's 1e-3): quantizing BOTH wires (dp
+        # grads + mp gathers) doubles the rounding noise sources, and
+        # at 1e-3 Adam chaotically amplifies even the fp32-composed-
+        # vs-legacy reduction-order difference to ~8e-3 by step 50 —
+        # the budget gates quantization error, not trajectory chaos
+        opt = pt.optimizer.Adam(1e-4, parameters=model.parameters())
+        return TrainStep(model, pretraining_loss, opt,
+                         plan=ShardingPlan("dp4xmp2", params=rules),
+                         grad_accum_steps=accum)
+
+    def trajectory(mode, mp):
+        d0 = monitor.get_float_stats().get(
+            "STAT_collective_quant_demotions", 0.0)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            step = build(mode, mp)
+            losses = [float(step((ids,), (mlm, nsp)))
+                      for ids, mlm, nsp in batches]
+        d1 = monitor.get_float_stats().get(
+            "STAT_collective_quant_demotions", 0.0)
+        warned = any("legacy GSPMD" in str(w.message) for w in caught)
+        return step, losses, int(d1 - d0), warned
+
+    fp8_admitted = quant.supports_fp8()
+    step_off, losses_off, _, _ = trajectory("off", "off")
+    step_fp32, losses_fp32, dem_fp32, warn_fp32 = trajectory(
+        "fp32", "fp32")
+    step_int8, losses_int8, dem_int8, warn_int8 = trajectory(
+        "int8", "int8")
+    step_fp8, losses_fp8, dem_fp8, warn_fp8 = trajectory("int8", "fp8")
+
+    oracle_diff = max(abs(a - b)
+                      for a, b in zip(losses_off, losses_fp32))
+    loss_diff = max(abs(a - b)
+                    for a, b in zip(losses_fp32, losses_int8))
+    loss_diff_fp8 = max(abs(a - b)
+                        for a, b in zip(losses_fp32, losses_fp8))
+    recompiles = {m: s._step_fn._cache_size() - 1
+                  for m, s in (("off", step_off), ("fp32", step_fp32),
+                               ("int8", step_int8), ("fp8", step_fp8))}
+
+    # census: per-step mp-axis gather bytes from the per-axis manifest
+    def _mp_bytes(step):
+        axes = step._coll_manifest.get("axes", {})
+        return dict(axes.get("mp", {}).get("bytes", {}))
+
+    mp_fp32, mp_int8, mp_fp8 = (_mp_bytes(s) for s in
+                                (step_fp32, step_int8, step_fp8))
+    mp_ratio = sum(mp_fp32.values()) / max(1, sum(mp_int8.values()))
+
+    # timing: interleaved rounds (CPU caveat above — reported, not
+    # claimed)
+    ids, mlm, nsp = batches[0]
+    t = {"off": 0.0, "fp32": 0.0, "int8": 0.0}
+    rounds, per_round = 3, 5
+    steps = {"off": step_off, "fp32": step_fp32, "int8": step_int8}
+    for s in steps.values():  # warm
+        float(s((ids,), (mlm, nsp)))
+    for _ in range(rounds):
+        for key, s in steps.items():
+            t0 = time.perf_counter()
+            for _ in range(per_round):
+                loss = s((ids,), (mlm, nsp))
+            float(loss)  # sync
+            t[key] += time.perf_counter() - t0
+    n = rounds * per_round
+    sps = {"off_legacy_gspmd": n / t["off"],
+           "fp32_composed": n / t["fp32"],
+           "int8_composed": n / t["int8"]}
+
+    gathers = int(monitor.get_float_stats().get(
+        "STAT_collective_quant_mp_gathers", 0.0))
+    print(json.dumps({
+        "workload": "BERT-shaped L%d-H%d train step, dp4xmp2 Megatron "
+                    "rules (FFN up col / FFN down row / embedding row "
+                    "over mp), grad_accum=%d (B=%d, S=%d, adam) on 8 "
+                    "virtual CPU devices" % (cfg.num_hidden_layers,
+                                             cfg.hidden_size, accum,
+                                             B, S),
+        "mp_gather_params": len(step_int8._coll_plan.gathers),
+        "demotions": {"fp32": dem_fp32, "int8": dem_int8,
+                      "fp8": dem_fp8},
+        "demotion_warning_fired": bool(warn_fp32 or warn_int8
+                                       or warn_fp8),
+        "zero_demotions_gate": bool(
+            dem_fp32 == dem_int8 == dem_fp8 == 0),
+        "per_step_mp_sync_bytes_fp32": mp_fp32,
+        "per_step_mp_sync_bytes_int8": mp_int8,
+        "per_step_mp_sync_bytes_fp8": mp_fp8,
+        "mp_sync_bytes_ratio": round(mp_ratio, 2),
+        "mp_sync_bytes_gate_3x": bool(mp_ratio >= 3.0),
+        "loss_max_abs_diff_fp32_vs_legacy_%dsteps" % traj_steps:
+            float(oracle_diff),
+        "loss_max_abs_diff_int8_vs_fp32_%dsteps" % traj_steps:
+            float(loss_diff),
+        "loss_max_abs_diff_fp8_vs_fp32_%dsteps" % traj_steps:
+            float(loss_diff_fp8),
+        "loss_budget_0p05": bool(loss_diff < 0.05
+                                 and loss_diff_fp8 < 0.05),
+        "steady_state_recompiles": recompiles,
+        "recompile_note": "the legacy flag-off path recompiles once "
+                          "on mp-sharded state (GSPMD respells "
+                          "P('mp', None) as P('mp',) after step 0 — "
+                          "an equal-meaning, unequal-cache-key spec); "
+                          "the composed modes pin out_shardings and "
+                          "stay at zero",
+        "fp8_probe_admitted": bool(fp8_admitted),
+        "fp8_resolved_wire_mode": step_fp8._coll_plan.mp_mode,
+        "mp_gather_exchanges_observed": gathers,
+        "steps_per_sec": {k: round(v, 3) for k, v in sps.items()},
+        "timing_caveat": "shared-memory CPU fake devices — wire-byte "
+                         "ratio is the claim, step time is not",
+        "per_step_losses_fp32_first5":
+            [round(v, 6) for v in losses_fp32[:5]],
+        "per_step_losses_int8_first5":
+            [round(v, 6) for v in losses_int8[:5]],
+    }))
+
+
+def _mp_quant_gang_ab():
+    """Live 2-process gang A/B for the composed quantized wire
+    (ISSUE 19): the PR-13 launcher forms a REAL jax gang (2 localhost
+    processes x 2 fake CPU devices = dp2xmp2) over the Megatron-ruled
+    MLP in tests/gang_runner.py, once with the quantized wire off and
+    once with GANG_QUANT=int8 + GANG_QUANT_MP=int8. Per-rank evidence
+    comes off the heartbeat-digest plane, not the worker's stdout:
+
+    - GAUGE_gang_collective_wait_frac{rank} — fraction of in-step time
+      in the exchange+sync tail, per rank, from the supervisor's
+      straggler scorer;
+    - TIMER_gang_step_phase_us{rank,phase="exchange"} p50/p95 — the
+      digest-carried exchange-phase timer, re-emitted rank-labeled;
+    - bytes-by-dtype census: summing each rank's digest ``coll``
+      deltas (digests_rank<k>.jsonl under the supervisor log_dir)
+      over the steps they span gives per-step wire bytes per dtype —
+      int8 payloads + fp32 scale rows must appear in the quantized
+      run and be absent from the off run.
+
+    CPU-fabric caveat: localhost shared-memory collectives make
+    wait_frac/exchange-time DELTAS noise-bound — the A/B documents
+    that the quantized wire runs on a live gang with the dtype census
+    to prove it, not a speedup claim."""
+    import glob
+    import shutil
+    import tempfile
+    from paddle_tpu import monitor
+    from paddle_tpu.launch import GangSupervisor
+    from paddle_tpu.monitor import labeled
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    runner = os.path.join(repo, "tests", "gang_runner.py")
+    tmp = tempfile.mkdtemp(prefix="pt_mpquant_bench_")
+    STEPS = 120
+
+    def _run(name, quant_env):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env.update({"GANG_STEPS": str(STEPS), "GANG_PHASES": "1",
+                    "GANG_PLAN": "dp2xmp2"})
+        env.update(quant_env)
+        sup = GangSupervisor(
+            [runner], 2, cpu_devices_per_proc=2,
+            log_dir=os.path.join(tmp, name), env=env,
+            heartbeat_interval_s=0.05, heartbeat_timeout_s=30.0,
+            spawn_grace_s=300.0, max_restarts=0,
+            name="bench_mpq_" + name)
+        sup.start()
+        fracs: dict = {}
+        try:
+            deadline = time.monotonic() + 600
+            while time.monotonic() < deadline:
+                st = sup.status()
+                for w in st["workers"]:
+                    if w.get("wait_frac") is not None:
+                        fracs[w["rank"]] = w["wait_frac"]
+                done = max((w["step"] for w in st["workers"]),
+                           default=0) >= STEPS
+                dead = all(w["state"] in ("exited", "died", "lost")
+                           for w in st["workers"])
+                if done or dead:
+                    break
+                time.sleep(0.05)
+        finally:
+            sup.stop()
+        # exchange-phase p50/p95 per rank off the supervisor's
+        # rank-labeled re-emission of the digest timers
+        phases = {}
+        for rank in (0, 1):
+            key = labeled("TIMER_gang_step_phase_us",
+                          {"gang": "bench_mpq_" + name,
+                           "rank": str(rank), "phase": "exchange"})
+            ts = monitor.timer_get(key)
+            if ts["count"]:
+                phases[str(rank)] = {"p50_us": round(ts["p50"], 1),
+                                     "p95_us": round(ts["p95"], 1)}
+        # bytes-by-dtype census from the digest JSONL logs: sum each
+        # rank's coll deltas, divide by the steps they cover
+        sys.path.insert(0, os.path.join(repo, "tools"))
+        try:
+            from trace_merge import load_digests
+        finally:
+            sys.path.pop(0)
+        census = {}
+        for path in sorted(glob.glob(os.path.join(
+                tmp, name, "digests_rank*.jsonl"))):
+            rank = path.rsplit("digests_rank", 1)[1].split(".")[0]
+            digs = load_digests(path)
+            agg: dict = {}
+            hi = 0
+            for d in digs:
+                hi = max(hi, int(d.get("step", 0) or 0))
+                for dt, nb in (d.get("coll") or {}).items():
+                    agg[dt] = agg.get(dt, 0) + int(nb)
+            if hi:
+                census[rank] = {dt: int(round(nb / hi))
+                                for dt, nb in agg.items()}
+        return {"per_rank_wait_frac": {str(k): v
+                                       for k, v in sorted(fracs.items())},
+                "exchange_phase_us": phases,
+                "per_step_wire_bytes_by_dtype": census}
+
+    try:
+        off = _run("off", {})
+        on = _run("int8", {"GANG_QUANT": "int8",
+                           "GANG_QUANT_MP": "int8"})
+        on_dts = set()
+        for per in on["per_step_wire_bytes_by_dtype"].values():
+            on_dts |= set(per)
+        return {
+            "workload": "2-process gang x 2 CPU devices = dp2xmp2, "
+                        "Megatron MLP, %d steps, 50ms heartbeats, "
+                        "phase timers on" % STEPS,
+            "quant_off": off,
+            "quant_int8_mp_int8": on,
+            "int8_on_wire": bool("int8" in on_dts),
+            "fabric_caveat": "localhost shared-memory collectives; "
+                             "the dtype census is the evidence, the "
+                             "wait/exchange deltas are noise-bound",
+        }
+    except Exception as e:  # noqa: BLE001 - artifact records the failure
+        return {"error": "%s: %s" % (type(e).__name__, e)}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_mp_quant_collectives():
+    """mp_quantized_collectives block (ISSUE 19): mp-axis quantized
+    all-gather composed with Megatron sharding plans — dp4xmp2 BERT
+    gates in a subprocess (8 fake devices must predate backend init,
+    see _mp_quant_collectives_worker) plus a live 2-process gang A/B
+    reading the per-rank digest plane."""
+    rec = _spawn_spmd(worker="--mp-quant-collectives-worker")
+    out = rec if rec is not None else {
+        "error": "mp quant collectives worker produced no result "
+                 "(see stderr)"}
+    out["gang_ab"] = _mp_quant_gang_ab()
+    return out
+
+
 def bench_autotune():
     """adaptive kernel dispatch block (ISSUE 16, docs/autotune.md):
     the auto-tuned ragged-step geometry vs (a) the WORST candidate the
@@ -2919,6 +3253,14 @@ def _run_worker(backend):
         # (census-verified), int8 overlapped step <= fp32 sync step,
         # 50-step loss budget, zero steady-state recompiles (ISSUE 17)
         rec["quantized_collectives"] = bench_quantized_collectives()
+    if not os.environ.get("PT_SKIP_MP_QUANT_COLLECTIVES_BENCH"):
+        # mp-axis quantized all-gather composed with Megatron plans
+        # under dp4xmp2: zero demotions, >= 3x fewer mp sync bytes
+        # (census-verified), 50-step loss budget vs the fp32-composed
+        # oracle, zero steady-state recompiles, fp8 where the probe
+        # admits; plus a live 2-process dp2xmp2 gang A/B off the
+        # per-rank digest plane (ISSUE 19)
+        rec["mp_quantized_collectives"] = bench_mp_quant_collectives()
     if not os.environ.get("PT_SKIP_SPMD_BENCH"):
         # mesh-native SPMD runtime: dp scaling + dp4xmp2 loss parity on
         # 8 fake CPU devices; subprocess-isolated because the virtual
@@ -3126,6 +3468,8 @@ if __name__ == "__main__":
         _spmd_worker()
     elif "--quant-collectives-worker" in sys.argv:
         _quant_collectives_worker()
+    elif "--mp-quant-collectives-worker" in sys.argv:
+        _mp_quant_collectives_worker()
     elif "--worker" in sys.argv:
         idx = sys.argv.index("--worker")
         backend = sys.argv[idx + 1] if idx + 1 < len(sys.argv) else ""

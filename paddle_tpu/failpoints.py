@@ -115,6 +115,13 @@ KNOWN_SITES: Tuple[str, ...] = (
     # just that bucket to the fp32 exchange (counted in
     # STAT_collective_quant_fallbacks); the step still converges
     "dist.collective_quant",
+    # ISSUE 19: per-axis mp-wire demotion (mesh/collectives.py) —
+    # fires once per (axis, PartitionSpec) gather group while the
+    # axis-aware plan is assembled, BEFORE any quantized gather is
+    # staged. A fault demotes just that group's mp all-gather to fp32
+    # (counted in STAT_collective_quant_mp_fallbacks); the dp-axis
+    # exchange of those shards keeps its configured wire
+    "dist.collective_quant_mp",
 )
 
 

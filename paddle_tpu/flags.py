@@ -303,6 +303,25 @@ _DEFS: Dict[str, Any] = {
     # overhead would eat the int8 savings and 1-D params are the most
     # error-sensitive
     "FLAGS_collective_quant_min_numel": 2048,
+    # mp-axis wire for mesh-SHARDED parameters (ISSUE 19, docs/spmd.md
+    # "Quantized collectives on the mp axis"): how the explicit-exchange
+    # step moves model-parallel shards when FLAGS_collective_quant is on
+    # and the plan's param rules shard tensors over a non-data axis.
+    #   "off"  — mp-sharded plans keep the legacy GSPMD sync (the
+    #            PR-17 demotion, now warned once per build and counted
+    #            in STAT_collective_quant_demotions)
+    #   "fp32" — compose: params stay sharded at rest, the step
+    #            all-gathers them over the sharded axis in fp32 and
+    #            exchanges shard gradients over the data axis (the
+    #            parity oracle for the quantized wires below)
+    #   "int8" — the mp all-gather moves block-scaled int8 payloads
+    #            (per-SHARD scale blocks: scales are local to each
+    #            rank's shard and ride the gather — never pmax'd over
+    #            the axis the tensor is sharded on)
+    #   "fp8"  — same wire in fp8-e4m3 (GRID_FP8=448 scale contract)
+    #            where quant.supports_fp8() admits it; falls back to
+    #            int8 with a one-time warning where it doesn't
+    "FLAGS_collective_quant_mp": "off",
     # gang-wide observability (docs/observability.md "Gang-wide
     # observability"): host-measured per-phase step timing in TrainStep
     # (TIMER_step_phase_us{phase=stage|dispatch|compute|exchange|sync}
@@ -366,6 +385,10 @@ _LOWERING_FLAGS = [
     "FLAGS_collective_quant",
     "FLAGS_collective_bucket_mb",
     "FLAGS_collective_quant_min_numel",
+    # the mp-axis wire mode reshapes the step program just as much:
+    # gather ops, their wire dtype, and the shard-shaped grad exchange
+    # are all baked into the trace
+    "FLAGS_collective_quant_mp",
     # the manual-collective step program grows a pre-exchange sync
     # fence output when phase timing is on: fenced and unfenced step
     # programs must never share a compiled entry

@@ -258,6 +258,18 @@ def _collectives_status(counters: Dict[str, Any]) -> Dict[str, Any]:
                 "STAT_collective_quant_buckets", 0),
             "fallbacks": counters.get(
                 "STAT_collective_quant_fallbacks", 0),
+            # mp-axis composition (ISSUE 19): the sharded-param wire
+            # mode, quantized gathers per plan (gauge) and cumulative
+            # (counter), builds demoted to legacy GSPMD, and mp
+            # failpoint fp32 fallbacks
+            "mode_mp": str(get_flag("FLAGS_collective_quant_mp")),
+            "gathers": gauge_get("GAUGE_collective_quant_gathers"),
+            "gather_exchanges": counters.get(
+                "STAT_collective_quant_mp_gathers", 0),
+            "demotions": counters.get(
+                "STAT_collective_quant_demotions", 0),
+            "mp_fallbacks": counters.get(
+                "STAT_collective_quant_mp_fallbacks", 0),
         },
     }
 

@@ -383,6 +383,23 @@ class TrainStep:
             jit_kwargs["donate_argnums"] = (0, 1)
         return jax.jit(step, **jit_kwargs)
 
+    def _demote(self, mode: str, names, why: str):
+        """Keep the legacy GSPMD sync for this build: count every
+        demoted param, warn ONCE per TrainStep (a rebuild — flag flip,
+        restore — must not re-fire the same diagnostic)."""
+        from .monitor import stat_add
+        stat_add("STAT_collective_quant_demotions", float(len(names)))
+        if not getattr(self, "_warned_demotion", False):
+            self._warned_demotion = True
+            import warnings
+            warnings.warn(
+                "FLAGS_collective_quant=%r: %s — %d mesh-sharded "
+                "param(s) (first: %r) keep the legacy GSPMD gradient "
+                "sync; set FLAGS_collective_quant_mp to compose the "
+                "quantized wire with sharded params (docs/spmd.md)"
+                % (mode, why, len(names), names[0]), stacklevel=4)
+        return None
+
     def _build_manual(self, mode: str, k: int, donate: bool):
         """Explicit-exchange step for FLAGS_collective_quant: a
         full-manual shard_map over the plan's mesh whose gradient sync
@@ -390,11 +407,27 @@ class TrainStep:
         microbatch (the synchronous oracle), "int8" accumulates
         locally in fp32 and quantizes only the final exchange, with
         buckets staged reverse-topologically so XLA overlaps them with
-        remaining backward compute. Returns None (caller keeps the
-        legacy GSPMD build) when no plan/data axis is active or params
-        are mesh-sharded: the manual body updates FULL parameter
-        values, so mp-sharded plans keep GSPMD's own sync
-        (docs/spmd.md documents the limitation)."""
+        remaining backward compute.
+
+        Mesh-sharded params (Megatron rules) COMPOSE when
+        FLAGS_collective_quant_mp is on (ISSUE 19): each sharded param
+        stays sharded at rest and enters the body as its local shard;
+        the body all-gathers it over its sharded axis on the mp wire
+        (per-SHARD scale blocks — collectives.gather_param), computes
+        mp-replicated (batch shards over the data axis only, rng folds
+        only the dp rank), slices each full gradient back to the local
+        shard (exact: the forward is mp-replicated, so full grads are
+        mp-identical and the reduce-scatter is degenerate), and runs
+        the shard grads through the same bucketed dp exchange. The
+        optimizer updates sharded state OUTSIDE the shard_map —
+        elementwise, so GSPMD keeps every shard local.
+
+        Returns None (caller keeps the legacy GSPMD build) when no
+        plan/data axis is active, or params are mesh-sharded with
+        FLAGS_collective_quant_mp off (warned once per TrainStep,
+        counted in STAT_collective_quant_demotions), or a sharded spec
+        is outside the single-axis evenly-divisible form the wire
+        supports."""
         plan = self.plan
         if plan is None or getattr(plan, "data_axis", None) is None:
             return None
@@ -406,46 +439,81 @@ class TrainStep:
         from jax.sharding import NamedSharding, PartitionSpec as P
         state0 = state_of(self.model)
         shapes = {n: tuple(np.shape(state0[n])) for n in self.param_names}
-        for n in self.param_names:
-            sp = plan.param_sharding(n, shapes[n])
-            spec = sp.spec if isinstance(sp, NamedSharding) else sp
-            if any(e is not None for e in tuple(spec)):
-                import warnings
-                warnings.warn(
-                    "FLAGS_collective_quant=%r needs replicated "
-                    "parameters; param %r is mesh-sharded — keeping "
-                    "the legacy GSPMD gradient sync" % (mode, n),
-                    stacklevel=3)
-                return None
+        specs = {n: plan.param_spec_tuple(n, shapes[n])
+                 for n in self.param_names}
+        sharded = [n for n in self.param_names
+                   if any(e is not None for e in specs[n])]
+        sharded_bufs = [
+            n for n in self.buffer_names
+            if any(e is not None for e in plan.param_spec_tuple(
+                n, np.shape(state0[n])))]
         from .flags import get_flag
         from .mesh import collectives as coll
         from .mesh import compat as _compat
+        mp_mode = "off"
+        if sharded:
+            from . import quant as _quant
+            mp_raw = str(get_flag("FLAGS_collective_quant_mp"))
+            if mp_raw == "off":
+                return self._demote(mode,
+                                    sharded, "FLAGS_collective_quant_mp "
+                                    "is off")
+            if sharded_bufs:
+                # buffers are replicated inside the body (running
+                # stats pmean over dp); a sharded buffer has no wire
+                return self._demote(mode, sharded_bufs,
+                                    "buffer(s) are mesh-sharded")
+            mp_mode = _quant.resolve_wire_mode(mp_raw)
+            axis_sizes = {str(a): int(s) for a, s in mesh.shape.items()
+                          if str(a) != dp_axis}
+            for n in sharded:
+                try:
+                    coll._local_shape(shapes[n], specs[n], axis_sizes)
+                except ValueError as e:
+                    return self._demote(mode, [n], str(e))
         cplan = coll.plan_buckets(
             shapes, dp_axis, dp, mode=mode,
             bucket_mb=int(get_flag("FLAGS_collective_bucket_mb")),
-            min_numel=int(get_flag("FLAGS_collective_quant_min_numel")))
+            min_numel=int(get_flag("FLAGS_collective_quant_min_numel")),
+            specs=specs if sharded else None,
+            axis_sizes={str(a): int(s) for a, s in mesh.shape.items()
+                        if str(a) != dp_axis} if sharded else None,
+            mp_mode=mp_mode)
         coll.publish_gauges(cplan)
         self._coll_plan = cplan
         # per-dispatch census: stat_add cannot run inside the trace, so
         # byte/op counts are derived from the plan here and bumped
-        # host-side after every __call__ (ring model — monitor.py)
-        entries = coll.wire_entries(cplan)
+        # host-side after every __call__ (ring model — monitor.py).
+        # dp-axis bucket entries repeat per microbatch in fp32 mode;
+        # mp-axis gather entries run ONCE per step (params are gathered
+        # before the microbatch loop)
         reps = k if mode == "fp32" else 1
         fbufs = [n for n in self.buffer_names
                  if jnp.issubdtype(state0[n].dtype, jnp.floating)]
-        bts: Dict[str, int] = {}
-        for _op, dt, nb in entries:
-            bts[dt] = bts.get(dt, 0) + reps * nb
+        axes: Dict[str, Dict[str, Any]] = {}
+        for axis, _op, dt, nb in coll.wire_entries(cplan):
+            mul = reps if axis == dp_axis else 1
+            per = axes.setdefault(axis, {"ops": 0, "bytes": {}})
+            per["ops"] += mul
+            per["bytes"][dt] = per["bytes"].get(dt, 0) + mul * nb
+        dpa = axes.setdefault(dp_axis, {"ops": 0, "bytes": {}})
         extra = coll._ring(2 * 4, dp)  # loss pmean
         for n in fbufs:
             v = state0[n]
             extra += coll._ring(2 * int(v.size) * v.dtype.itemsize, dp)
-        bts["float32"] = bts.get("float32", 0) + extra
+        dpa["ops"] += 1 + len(fbufs)
+        dpa["bytes"]["float32"] = dpa["bytes"].get("float32", 0) + extra
+        flat_bytes: Dict[str, int] = {}
+        for per in axes.values():
+            for dt, nb in per["bytes"].items():
+                flat_bytes[dt] = flat_bytes.get(dt, 0) + nb
         self._coll_manifest = {
-            "axis": dp_axis,
-            "ops": reps * len(entries) + 1 + len(fbufs),
-            "bytes": bts,
+            "axis": dp_axis,  # the gradient-exchange axis (legacy key)
+            "axes": axes,
+            # all-axis aggregate: what bench/run_spmd_tests ratio reads
+            "bytes": flat_bytes,
             "buckets": reps * sum(1 for b in cplan.buckets if b.quantized),
+            "gathers": sum(1 for g in cplan.gathers if g.quantized),
         }
         pn, bn = self.param_names, self.buffer_names
         # step-phase fence (ISSUE 18): an extra rank-sharded (1,)
@@ -455,14 +523,32 @@ class TrainStep:
         phases = bool(get_flag("FLAGS_step_phases"))
         self._has_fence = phases
 
+        # sharded params enter the body as their LOCAL shard and leave
+        # their gradient the same way; replicated ones pass P().
+        # jax accepts a dict-of-specs against a dict argument.
+        param_specs = {n: P(*specs[n]) if n in set(
+            g.name for g in cplan.gathers) else P()
+            for n in pn}
+        grad_specs = dict(param_specs)
+
         def step(state, opt_state, lr_step, rng, batch):
             inputs, labels = batch
             params = {n: state[n] for n in pn}
             consts = {n: state[n] for n in bn}
 
             def body(bparams, bconsts, brng, binputs, blabels):
-                # per-shard rng: every dp rank sees a different batch
-                # shard, so dropout/noise streams must differ too
+                # mp composition: reassemble each sharded param's full
+                # value on the quantized wire ONCE, before the
+                # microbatch loop — every microbatch reuses the gather
+                fparams = dict(bparams)
+                for gsp in cplan.gathers:
+                    fparams[gsp.name] = coll.gather_param(
+                        bparams[gsp.name], gsp, cplan)
+                # per-shard rng folds ONLY the dp rank: every dp rank
+                # sees a different batch shard so dropout/noise streams
+                # must differ, but mp ranks compute the SAME replica —
+                # folding the mp rank would desynchronize the forward
+                # and break the degenerate grad slice below
                 r = jax.random.fold_in(brng, jax.lax.axis_index(dp_axis))
                 rngs = jax.random.split(r, k)
                 losses, acc, new_buf, fence = [], None, None, None
@@ -471,8 +557,12 @@ class TrainStep:
                         self._make_loss_of(
                             bconsts, rngs[i], _microbatch(binputs, k, i),
                             _microbatch(blabels, k, i)),
-                        has_aux=True)(bparams)
+                        has_aux=True)(fparams)
                     losses.append(l)
+                    # full grads are mp-identical (replicated forward),
+                    # so each rank's shard grad is an exact local slice
+                    # — the degenerate reduce-scatter, zero wire bytes
+                    g = coll.shard_grads(g, cplan)
                     if phases:
                         # accumulated per microbatch so the fence stays
                         # pre-exchange even in fp32 mode, where the
@@ -502,31 +592,31 @@ class TrainStep:
                 return loss, grads, new_buf
 
             def _in_spec(prefix, vals):
-                specs = []
+                specs_ = []
                 for i, x in enumerate(vals):
                     if x is None:
-                        specs.append(None)
+                        specs_.append(None)
                         continue
                     sh = plan.input_sharding("%s%d" % (prefix, i),
                                              tuple(x.shape))
-                    specs.append(sh.spec if isinstance(sh, NamedSharding)
-                                 else sh)
-                return tuple(specs)
+                    specs_.append(sh.spec if isinstance(sh, NamedSharding)
+                                  else sh)
+                return tuple(specs_)
 
-            # check_vma=False: grads leave the body replicated (the
-            # exchange guarantees it) but old-jax rep-tracking cannot
-            # prove that through all_to_all/all_gather; nothing here
-            # differentiates THROUGH the shard_map (value_and_grad is
-            # inside the body), so the transpose caveat in compat.py
+            # check_vma=False: grads leave the body replicated over dp
+            # (the exchange guarantees it) but old-jax rep-tracking
+            # cannot prove that through all_to_all/all_gather; nothing
+            # here differentiates THROUGH the shard_map (value_and_grad
+            # is inside the body), so the transpose caveat in compat.py
             # does not apply
             # the fence out_spec shards over the dp axis: pre-exchange
             # grads are rank-varying, and a replicated fence would
             # itself force the sync it is meant to observe
-            out_specs = (P(), P(), P(), P(dp_axis)) if phases \
-                else (P(), P(), P())
+            out_specs = (P(), grad_specs, P(), P(dp_axis)) if phases \
+                else (P(), grad_specs, P())
             synced = _compat.shard_map(
                 body, mesh=mesh,
-                in_specs=(P(), P(), P(), _in_spec("input", inputs),
+                in_specs=(param_specs, P(), P(), _in_spec("input", inputs),
                           _in_spec("label", labels)),
                 out_specs=out_specs,
                 check_vma=False)
@@ -542,6 +632,24 @@ class TrainStep:
         jit_kwargs = {}
         if donate:
             jit_kwargs["donate_argnums"] = (0, 1)
+        if cplan.gathers:
+            # pin output shardings to the params' committed layout:
+            # GSPMD spells a trailing-None spec back as its trimmed
+            # twin (P('mp', None) -> P('mp',)), which is semantically
+            # identical but unequal as a cache key — without the pin,
+            # step 1 recompiles against step 0's outputs
+            def _ns(sp):
+                return NamedSharding(mesh, sp)
+            state_sh = {n: _ns(param_specs[n]) for n in pn}
+            state_sh.update({n: _ns(P()) for n in bn})
+            _t, _a, accums = self.optimizer._eager_spec()
+            opt_sh = {n: {key: _ns(P()) if is_scalar else state_sh[n]
+                          for _i, _o, key, _f, is_scalar in accums}
+                      for n in pn}
+            outs = (_ns(P()), state_sh, opt_sh, _ns(P()))
+            if phases:
+                outs = outs + (_ns(P(dp_axis)),)
+            jit_kwargs["out_shardings"] = outs
         return jax.jit(step, **jit_kwargs)
 
     def _init_opt_state(self, state):
@@ -703,14 +811,21 @@ class TrainStep:
         if m:
             # explicit-exchange collectives run inside the jitted step,
             # invisible to parallel/collective.py's launch counters —
-            # the census is bumped from the build-time wire manifest
+            # the census is bumped per axis from the build-time wire
+            # manifest (mp gather entries land on their own axis)
             from .monitor import labeled, stat_add
-            stat_add("STAT_mesh_collective_%s" % m["axis"], m["ops"])
-            for dt, nb in sorted(m["bytes"].items()):
-                stat_add(labeled("STAT_mesh_collective_bytes",
-                                 {"axis": m["axis"], "dtype": dt}), nb)
+            for axis, per in sorted(m["axes"].items()):
+                if per["ops"]:
+                    stat_add("STAT_mesh_collective_%s" % axis,
+                             per["ops"])
+                for dt, nb in sorted(per["bytes"].items()):
+                    stat_add(labeled("STAT_mesh_collective_bytes",
+                                     {"axis": axis, "dtype": dt}), nb)
             if m["buckets"]:
                 stat_add("STAT_collective_quant_buckets", m["buckets"])
+            if m.get("gathers"):
+                stat_add("STAT_collective_quant_mp_gathers",
+                         m["gathers"])
         if step_id is not None:
             _tm.flight_note(step_id, "dispatched_us", _tm.now_us())
         return loss

@@ -708,6 +708,24 @@ class GangSupervisor:
         if worst > self.straggler_threshold:
             stats.append(("STAT_gang_straggler_beats", 1.0))
         observe_many(timers=timers, stats=stats)
+        if self.log_dir:
+            # append the raw digest to the rank's JSONL log so offline
+            # tools (tools/trace_merge.py --digests) can join wire-byte
+            # deltas onto the rank's exchange-phase trace slices.
+            # Receipt-stamped with the supervisor's monotonic clock —
+            # same basis as the liveness math; best-effort, a full
+            # disk must never tear the gang down
+            try:
+                os.makedirs(self.log_dir, exist_ok=True)
+                path = os.path.join(self.log_dir,
+                                    "digests_rank%d.jsonl" % w.rank)
+                line = json.dumps({"t_mono": round(now, 6),
+                                   "rank": w.rank, **dig},
+                                  separators=(",", ":"))
+                with open(path, "a", encoding="utf-8") as f:
+                    f.write(line + "\n")
+            except OSError:
+                pass
 
     def _straggler_scores(self, now: float):
         """(scores, wait_fracs) by rank, from each worker's digest

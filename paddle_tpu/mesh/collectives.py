@@ -1,4 +1,4 @@
-"""Quantized gradient collectives over the data-parallel mesh axis.
+"""Quantized collectives over the mesh — every axis, not just dp.
 
 At pod scale cross-host bandwidth, not FLOPs, caps step time (ROADMAP
 item 4); EQuARX (PAPERS.md) shows a block-scaled int8 AllReduce
@@ -15,11 +15,29 @@ collectives"):
   remaining backward compute. Small / 1-D grads below
   ``FLAGS_collective_quant_min_numel`` stay on a per-tensor fp32
   pmean (scale overhead would eat the savings and biases/norms are
-  the most error-sensitive).
+  the most error-sensitive). Since ISSUE 19 the planner is
+  AXIS-AWARE: tensors are packed by (exchange axis, PartitionSpec),
+  so one fusion buffer never mixes reduction domains — a Megatron
+  column shard and a replicated norm never share a buffer, and each
+  mesh-sharded spec group additionally gets a :class:`GatherSpec`
+  describing its forward all-gather over the axis it is sharded on.
 - :func:`exchange_grads` runs inside the manual shard_map body
   (mesh/compat.py seam) and syncs a name->grad dict: int8 buckets go
   through the block-scaled ReduceScatter+AllGather wire, everything
-  else through fp32 pmean.
+  else through fp32 pmean. For mesh-sharded params it receives the
+  LOCAL SHARD gradients — their scale blocks are computed on the
+  shard and pmax'd over the data axis (the axis the shard is
+  replicated on), never over the axis the tensor is sharded on.
+- :func:`gather_param` / :func:`quantized_all_gather` /
+  :func:`quantized_reduce_scatter` are the mp-axis wire
+  (``FLAGS_collective_quant_mp``): the all-gather moves per-SHARD
+  scale blocks (each rank quantizes its own shard on local scales and
+  the scales ride the gather — no pmax, the shards are different
+  tensors), the reduce-scatter shares scales via pmax over the
+  reduction axis exactly like the dp wire. Both speak fp32, int8 and
+  — the first real consumer of the PR-15 fp8 grid — fp8-e4m3 where
+  ``quant.supports_fp8()`` admits it (int8 fallback otherwise,
+  resolved once at plan time via ``quant.resolve_wire_mode``).
 
 The int8 wire reuses the PR-15 absmax scale contract
 (paddle_tpu/quant): per-block fp32 absmax ``s``, ``q = round(x *
@@ -29,20 +47,25 @@ store so a zero block round-trips to exact zeros. The scale is
 the integer shard sum exact (|q| <= 127 per rank, summed in int16)
 and lets the reduced shard requantize onto the SAME grid — the full
 exchange is: pmax scales -> int8 all_to_all (ReduceScatter) ->
-int16 sum -> requantize -> int8 all_gather -> one dequant. Wire
-bytes per exchange drop ~3.9x vs a fp32 AllReduce (measured by the
+int16 sum -> requantize -> int8 all_gather -> one dequant. The fp8
+wire keeps the same block/scale layout but sums upcast in fp32 (fp8
+addition is not exact); its replicated-input round-trip still equals
+plain quantize-dequantize. Wire bytes per exchange drop ~3.9x vs a
+fp32 AllReduce (measured by the
 ``STAT_mesh_collective_bytes{axis,dtype}`` census; the ring model
 used for byte accounting is documented in monitor.py).
 
 Faults injected at the ``dist.collective_quant`` failpoint fire per
 bucket at PLAN time — before any quantized-buffer op is staged into
 the trace — and demote just that bucket to the fp32 exchange
-(``STAT_collective_quant_fallbacks``); the step still converges.
+(``STAT_collective_quant_fallbacks``); ``dist.collective_quant_mp``
+does the same per (axis, spec) gather group for the mp wire
+(``STAT_collective_quant_mp_fallbacks``). The step still converges.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Any, Dict, List, Sequence, Tuple
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -64,6 +87,7 @@ GAUGE_FAMILY = (
     "GAUGE_collective_quant_buckets",
     "GAUGE_collective_quant_small",
     "GAUGE_collective_quant_wire_bytes",
+    "GAUGE_collective_quant_gathers",
 )
 
 
@@ -72,13 +96,18 @@ class Bucket:
     """One fusion buffer: member grads are flattened fp32 and
     concatenated in order; ``padded`` is the wire length (numel rounded
     up to a BLOCK*axis_size multiple so scale blocks survive the
-    ReduceScatter reshape)."""
+    ReduceScatter reshape). ``spec`` is the members' shared canonical
+    PartitionSpec tuple — () for replicated tensors; for mesh-sharded
+    members ``shapes``/``sizes``/``numel`` describe the LOCAL SHARD
+    (the value actually exchanged), and a buffer never mixes specs so
+    it never mixes reduction domains."""
     names: Tuple[str, ...]
     shapes: Tuple[Tuple[int, ...], ...]
     sizes: Tuple[int, ...]
     numel: int
     padded: int
     quantized: bool
+    spec: Tuple = ()
 
     @property
     def wire_elems(self) -> int:
@@ -86,21 +115,89 @@ class Bucket:
 
 
 @dataclass(frozen=True)
+class GatherSpec:
+    """Forward all-gather geometry for ONE mesh-sharded param: the
+    axis it is sharded on, the sharded tensor dim, full/local shapes,
+    and the padded local wire length (local numel rounded up to a
+    BLOCK multiple so each rank's shard carries whole scale blocks).
+    ``quantized`` False means this gather rides the fp32 wire (mp_mode
+    "fp32", or a ``dist.collective_quant_mp`` fault demoted its
+    group)."""
+    name: str
+    axis: str
+    axis_size: int
+    dim: int
+    shape: Tuple[int, ...]   # full (logical) shape
+    local: Tuple[int, ...]   # this rank's shard shape
+    padded: int              # local numel padded to a BLOCK multiple
+    quantized: bool
+
+    @property
+    def local_numel(self) -> int:
+        n = 1
+        for d in self.local:
+            n *= int(d)
+        return n
+
+
+@dataclass(frozen=True)
 class CollectivePlan:
-    """Deterministic pure function of (names+shapes, axis, flags) —
-    tests pin that two plans over the same inputs are equal."""
+    """Deterministic pure function of (names+shapes+specs, axes,
+    flags) — tests pin that two plans over the same inputs are equal.
+    ``axis`` is the gradient-exchange (data) axis; ``mp_mode`` is the
+    RESOLVED wire mode for the mp-axis gathers ("off" when no param
+    is mesh-sharded; "fp8" only when the probe admitted it)."""
     axis: str
     axis_size: int
     block: int
     mode: str
     buckets: Tuple[Bucket, ...]
     small: Tuple[Tuple[str, int], ...]  # (name, numel), per-tensor fp32
+    mp_mode: str = "off"
+    gathers: Tuple[GatherSpec, ...] = ()
+
+
+def _numel(shape: Tuple[int, ...]) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _local_shape(shape: Tuple[int, ...], spec: Tuple,
+                 axis_sizes: Dict[str, int]) -> Tuple[Tuple[int, ...],
+                                                      int, str]:
+    """(local shard shape, sharded dim, axis name) for a canonical
+    single-axis spec. Raises ValueError when the spec is not the
+    single-axis evenly-divisible form the composed path supports —
+    the caller turns that into a (counted, warn-once) demotion."""
+    dims = [(i, e) for i, e in enumerate(spec) if e is not None]
+    if len(dims) != 1 or isinstance(dims[0][1], tuple):
+        raise ValueError(
+            "unsupported spec %r: the mp wire handles exactly one "
+            "sharded dim over one axis" % (spec,))
+    dim, axis = dims[0]
+    size = int(axis_sizes.get(axis, 0))
+    if size < 1:
+        raise ValueError("spec %r names axis %r outside the plan's "
+                         "non-data axes %r" % (spec, axis,
+                                               sorted(axis_sizes)))
+    if int(shape[dim]) % size:
+        raise ValueError(
+            "dim %d of shape %r not divisible by %s=%d"
+            % (dim, shape, axis, size))
+    local = list(shape)
+    local[dim] = int(shape[dim]) // size
+    return tuple(local), dim, axis
 
 
 def plan_buckets(shapes: Dict[str, Tuple[int, ...]], axis: str,
                  axis_size: int, *, mode: str, bucket_mb: int,
-                 min_numel: int, block: int = BLOCK) -> CollectivePlan:
-    """Pack gradients into exchange buckets.
+                 min_numel: int, block: int = BLOCK,
+                 specs: Optional[Dict[str, Tuple]] = None,
+                 axis_sizes: Optional[Dict[str, int]] = None,
+                 mp_mode: str = "off") -> CollectivePlan:
+    """Pack gradients into axis-aware exchange buckets.
 
     ``shapes`` iterates in model-construction (forward-topological)
     order; buckets are assembled over ``reversed(shapes)`` because the
@@ -109,35 +206,82 @@ def plan_buckets(shapes: Dict[str, Tuple[int, ...]], axis: str,
     fp32. The ``dist.collective_quant`` failpoint fires once per
     would-be-quantized bucket BEFORE it is committed to the int8 wire;
     a fault demotes that bucket to fp32.
+
+    ``specs`` (canonical PartitionSpec tuples, plan.param_spec_tuple)
+    opts tensors into mesh-sharded handling: each sharded tensor gets
+    a :class:`GatherSpec` (forward all-gather over its sharded axis on
+    the ``mp_mode`` wire — the ``dist.collective_quant_mp`` failpoint
+    fires once per (axis, spec) group and demotes the group's gather
+    to fp32), its gradient buckets under the (axis, spec) key with
+    LOCAL shard geometry, and the small-tensor threshold applies to
+    the shard. Buckets never mix specs: a column-parallel shard and a
+    replicated tensor reduce over different domains, so fusing them
+    into one buffer would corrupt both. The bucket order interleaves
+    spec groups in first-appearance (reverse-topological) order.
     """
+    specs = specs or {}
+    axis_sizes = dict(axis_sizes or {})
     cap = max(1, int(bucket_mb)) * (1 << 20) // 4  # fp32 elements
     small: List[Tuple[str, int]] = []
-    big: List[Tuple[str, Tuple[int, ...], int]] = []
+    gathers: List[GatherSpec] = []
+    # spec key -> list of (name, LOCAL shape, LOCAL numel), plus the
+    # first-appearance order of keys so bucket emission stays
+    # reverse-topological across groups
+    by_spec: Dict[Tuple, List[Tuple[str, Tuple[int, ...], int]]] = {}
+    key_order: List[Tuple] = []
+    # (axis, spec) groups already offered to the mp failpoint, with
+    # the demotion verdict for every member of the group
+    group_fp32: Dict[Tuple, bool] = {}
     for name in reversed(list(shapes)):
         shape = tuple(shapes[name])
-        numel = 1
-        for d in shape:
-            numel *= int(d)
+        spec = tuple(specs.get(name) or ())
+        if any(e is not None for e in spec):
+            local, dim, ax = _local_shape(shape, spec, axis_sizes)
+            gkey = (ax, spec)
+            if gkey not in group_fp32:
+                demote = mp_mode == "fp32"
+                if not demote:
+                    try:
+                        failpoint("dist.collective_quant_mp", {
+                            "axis": ax, "spec": spec})
+                    except InjectedFault:
+                        demote = True
+                        stat_add("STAT_collective_quant_mp_fallbacks")
+                group_fp32[gkey] = demote
+            size = int(axis_sizes[ax])
+            gathers.append(GatherSpec(
+                name=name, axis=ax, axis_size=size, dim=dim,
+                shape=shape, local=local,
+                padded=-(-_numel(local) // block) * block,
+                quantized=not group_fp32[gkey]))
+            shape, numel = local, _numel(local)
+        else:
+            spec, numel = (), _numel(shape)
         if len(shape) <= 1 or numel < int(min_numel):
             small.append((name, numel))
-        else:
-            big.append((name, shape, numel))
+            continue
+        if spec not in by_spec:
+            by_spec[spec] = []
+            key_order.append(spec)
+        by_spec[spec].append((name, shape, numel))
 
-    groups: List[List[Tuple[str, Tuple[int, ...], int]]] = []
-    cur: List[Tuple[str, Tuple[int, ...], int]] = []
-    cur_numel = 0
-    for item in big:
-        if cur and cur_numel + item[2] > cap:
-            groups.append(cur)
-            cur, cur_numel = [], 0
-        cur.append(item)
-        cur_numel += item[2]
-    if cur:
-        groups.append(cur)
+    groups: List[Tuple[Tuple,
+                       List[Tuple[str, Tuple[int, ...], int]]]] = []
+    for spec in key_order:
+        cur: List[Tuple[str, Tuple[int, ...], int]] = []
+        cur_numel = 0
+        for item in by_spec[spec]:
+            if cur and cur_numel + item[2] > cap:
+                groups.append((spec, cur))
+                cur, cur_numel = [], 0
+            cur.append(item)
+            cur_numel += item[2]
+        if cur:
+            groups.append((spec, cur))
 
     unit = block * int(axis_size)
     buckets: List[Bucket] = []
-    for i, grp in enumerate(groups):
+    for i, (spec, grp) in enumerate(groups):
         numel = sum(n for _, _, n in grp)
         quantized = mode == "int8"
         if quantized:
@@ -154,10 +298,16 @@ def plan_buckets(shapes: Dict[str, Tuple[int, ...]], axis: str,
             sizes=tuple(n for _, _, n in grp),
             numel=numel,
             padded=-(-numel // unit) * unit,
-            quantized=quantized))
+            quantized=quantized,
+            spec=spec))
+    # gathers were collected in reverse-topological order; the FORWARD
+    # consumes them first-layer-first, so flip back
+    gathers.reverse()
     return CollectivePlan(axis=axis, axis_size=int(axis_size),
                           block=int(block), mode=str(mode),
-                          buckets=tuple(buckets), small=tuple(small))
+                          buckets=tuple(buckets), small=tuple(small),
+                          mp_mode=str(mp_mode) if gathers else "off",
+                          gathers=tuple(gathers))
 
 
 # -- wire formats (run inside the manual shard_map body) ----------------
@@ -197,6 +347,146 @@ def exchange_bucket(flat, bucket: Bucket, plan: CollectivePlan):
     if bucket.quantized:
         return _exchange_int8(flat, bucket, plan)
     return jax.lax.pmean(flat, plan.axis)
+
+
+# -- mp-axis wire: quantized all-gather / reduce-scatter ----------------
+
+def _wire_grid(mode: str) -> float:
+    from ..quant import GRID_FP8, GRID_INT8
+    return GRID_FP8 if mode == "fp8" else GRID_INT8
+
+
+def _wire_dtype(mode: str):
+    return jnp.float8_e4m3fn if mode == "fp8" else jnp.int8
+
+
+def _wire_encode(x, s, mode: str):
+    """Scale BLOCK-shaped rows of ``x`` onto the mode's grid and cast
+    to the wire dtype. ``s`` is the per-row scale, already guarded."""
+    scaled = x * (_wire_grid(mode) / s)[:, None]
+    if mode == "fp8":
+        return scaled.astype(jnp.float8_e4m3fn)
+    return jnp.round(scaled).astype(jnp.int8)
+
+
+def _wire_decode(q, s, mode: str):
+    return q.astype(jnp.float32) * (s * (1.0 / _wire_grid(mode)))[:, None]
+
+
+def _block_scales(x2d):
+    """Per-row absmax with the PR-15 dead-block guard applied BEFORE
+    the store: an all-zero block keeps divisor 1.0 and round-trips to
+    exact zeros."""
+    s = jnp.max(jnp.abs(x2d), axis=1)
+    return jnp.where(s > 0.0, s, 1.0)
+
+
+def quantized_all_gather(flat, axis: str, axis_size: int, *, mode: str,
+                         block: int = BLOCK):
+    """Tiled all-gather of a rank-LOCAL flat buffer over ``axis`` on
+    the quantized wire — the per-SHARD scale rule: every rank
+    quantizes its own buffer on scales computed from its own values
+    (the shards are different tensors, so there is nothing to pmax —
+    sharing scales over the sharded axis would let one rank's outlier
+    ruin every other rank's grid), and the fp32 scales ride the gather
+    next to the payload. ``flat`` length must be a ``block`` multiple
+    (pad with zeros; the pad lives in the last scale block and costs
+    nothing). Returns the (axis_size * len(flat),) fp32 concatenation
+    in rank order. mode "fp32" is the wire-parity oracle: one plain
+    tiled all_gather."""
+    if mode == "fp32":
+        return jax.lax.all_gather(flat, axis, tiled=True)
+    nb = flat.shape[0] // block
+    x = flat.reshape(nb, block)
+    s = _block_scales(x)
+    q = _wire_encode(x, s, mode)
+    qg = jax.lax.all_gather(q.reshape(-1), axis, tiled=True)
+    sg = jax.lax.all_gather(s, axis, tiled=True)
+    out = _wire_decode(qg.reshape(axis_size * nb, block),
+                       sg, mode)
+    return out.reshape(-1)
+
+
+def quantized_reduce_scatter(flat, axis: str, axis_size: int, *,
+                             mode: str, block: int = BLOCK,
+                             mean: bool = True):
+    """Block-scaled reduce-scatter over ``axis``: ``flat`` is each
+    rank's full-length copy (length = axis_size * seg, seg a ``block``
+    multiple); rank r gets back segment r summed (or averaged) over
+    the axis. This is the reduction half of the mp activation/grad
+    pair — scales here ARE shared via pmax over ``axis`` (the
+    reduction domain: every rank contributes to every block, so the
+    grid must agree), exactly the dp-wire rule and the mirror image of
+    the gather's per-shard scales. int8 sums ride int16 (exact,
+    axis_size <= 256); fp8 payloads upcast to fp32 before summing
+    (fp8 addition is not exact) so a replicated input still
+    round-trips to plain quantize-dequantize. mode "fp32" is
+    jax.lax.psum_scatter."""
+    seg = flat.shape[0] // axis_size
+    if mode == "fp32":
+        out = jax.lax.psum_scatter(flat, axis, scatter_dimension=0,
+                                   tiled=True)
+        return out * (1.0 / axis_size) if mean else out
+    nb = flat.shape[0] // block
+    x = flat.reshape(nb, block)
+    s = _block_scales(jax.lax.pmax(jnp.max(jnp.abs(x), axis=1),
+                                   axis)[:, None] * jnp.ones((1, 1)))
+    # (_block_scales on the pmax'd column keeps the dead-block guard
+    # a single shared code path)
+    q = _wire_encode(x, s, mode)
+    qx = jax.lax.all_to_all(q.reshape(axis_size, seg), axis, 0, 0,
+                            tiled=True)
+    if mode == "fp8":
+        red = jnp.sum(qx.astype(jnp.float32), axis=0)
+    else:
+        red = jnp.sum(qx.astype(jnp.int16), axis=0).astype(jnp.float32)
+    idx = jax.lax.axis_index(axis)
+    sown = jax.lax.dynamic_slice_in_dim(s, idx * (seg // block),
+                                        seg // block, axis=0)
+    out = _wire_decode(red.reshape(seg // block, block), sown, mode)
+    out = out.reshape(-1)
+    return out * (1.0 / axis_size) if mean else out
+
+
+def gather_param(shard, g: GatherSpec, plan: CollectivePlan):
+    """Reassemble a mesh-sharded param's FULL value inside the manual
+    body from this rank's shard, over ``g.axis`` on the plan's mp
+    wire. The shard is flattened in moveaxis-to-front layout so each
+    gathered row IS one rank's shard; the full tensor is rebuilt by
+    concatenating rows along the sharded dim."""
+    mode = plan.mp_mode if g.quantized else "fp32"
+    moved = jnp.moveaxis(shard.astype(jnp.float32), g.dim, 0)
+    flat = moved.reshape(-1)
+    pad = g.padded - g.local_numel
+    if pad and mode != "fp32":
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    full = quantized_all_gather(flat, g.axis, g.axis_size, mode=mode,
+                                block=plan.block)
+    rows = full.reshape(g.axis_size, -1)[:, :g.local_numel]
+    parts = [rows[r].reshape(moved.shape) for r in range(g.axis_size)]
+    return jnp.moveaxis(jnp.concatenate(parts, axis=0), 0, g.dim)
+
+
+def shard_grads(grads: Dict[str, Any],
+                plan: CollectivePlan) -> Dict[str, Any]:
+    """Slice each mesh-sharded param's FULL gradient down to this
+    rank's shard before the data-axis exchange. Inside the composed
+    body the forward is replicated over the sharded axis (every rank
+    gathered the same full params and saw the same batch shard), so
+    the full gradients are identical across it and the reduce-scatter
+    over that axis is degenerate — the local slice is its exact,
+    zero-wire-byte value. :func:`quantized_reduce_scatter` is the
+    non-degenerate wire for bodies whose cotangents DO vary over the
+    axis (true manual-TP forwards)."""
+    out = dict(grads)
+    for g in plan.gathers:
+        if g.name not in grads:
+            continue
+        idx = jax.lax.axis_index(g.axis)
+        out[g.name] = jax.lax.dynamic_slice_in_dim(
+            grads[g.name], idx * int(g.local[g.dim]),
+            int(g.local[g.dim]), axis=g.dim)
+    return out
 
 
 def bucket_concat(grads: Sequence[Any], bucket: Bucket):
@@ -267,30 +557,68 @@ def _ring(payload_bytes: int, dp: int) -> int:
     return int(payload_bytes * (dp - 1) / dp)
 
 
-def wire_entries(plan: CollectivePlan) -> List[Tuple[str, str, int]]:
-    """(op, dtype, bytes-on-wire-per-rank) for ONE full exchange of
-    every bucket + small tensor. AllReduce-family ops (pmean/pmax)
-    cost two ring passes; all_to_all / tiled all_gather cost one."""
+def _wire_itemsize(mode: str) -> int:
+    return 1  # int8 and fp8-e4m3 are both one byte on the wire
+
+
+def wire_entries(plan: CollectivePlan) \
+        -> List[Tuple[str, str, str, int]]:
+    """(axis, op, dtype, bytes-on-wire-per-rank) for ONE full exchange
+    of every bucket + small tensor + param gather. AllReduce-family
+    ops (pmean/pmax) cost two ring passes; all_to_all / tiled
+    all_gather cost one. Gather entries sit on each GatherSpec's own
+    axis (mp) with the plan's mp wire dtype; their fp32 scale rows
+    ride as a separate float32 entry so the dtype census shows
+    exactly what the wire carried."""
     dp = plan.axis_size
-    out: List[Tuple[str, str, int]] = []
+    out: List[Tuple[str, str, str, int]] = []
     for b in plan.buckets:
         if b.quantized:
             nb = b.padded // plan.block
-            out.append(("pmax", "float32", _ring(2 * nb * 4, dp)))
-            out.append(("all_to_all", "int8", _ring(b.padded, dp)))
-            out.append(("all_gather", "int8", _ring(b.padded, dp)))
+            out.append((plan.axis, "pmax", "float32",
+                        _ring(2 * nb * 4, dp)))
+            out.append((plan.axis, "all_to_all", "int8",
+                        _ring(b.padded, dp)))
+            out.append((plan.axis, "all_gather", "int8",
+                        _ring(b.padded, dp)))
         else:
-            out.append(("pmean", "float32", _ring(2 * b.numel * 4, dp)))
+            out.append((plan.axis, "pmean", "float32",
+                        _ring(2 * b.numel * 4, dp)))
     for _name, numel in plan.small:
-        out.append(("pmean", "float32", _ring(2 * numel * 4, dp)))
+        out.append((plan.axis, "pmean", "float32",
+                    _ring(2 * numel * 4, dp)))
+    wire_dt = ("float8_e4m3fn" if plan.mp_mode == "fp8" else "int8")
+    for g in plan.gathers:
+        n = g.axis_size
+        if g.quantized and plan.mp_mode in ("int8", "fp8"):
+            out.append((g.axis, "all_gather", wire_dt,
+                        _ring(g.padded * _wire_itemsize(plan.mp_mode),
+                              n)))
+            out.append((g.axis, "all_gather", "float32",
+                        _ring((g.padded // plan.block) * 4, n)))
+        else:
+            out.append((g.axis, "all_gather", "float32",
+                        _ring(g.local_numel * 4, n)))
     return out
 
 
 def census_bytes(plan: CollectivePlan) -> Dict[str, int]:
-    """Per-exchange wire bytes aggregated by dtype."""
+    """Per-exchange wire bytes aggregated by dtype (all axes pooled —
+    the shape tests and the bench ratio read)."""
     agg: Dict[str, int] = {}
-    for _op, dt, nb in wire_entries(plan):
+    for _axis, _op, dt, nb in wire_entries(plan):
         agg[dt] = agg.get(dt, 0) + nb
+    return agg
+
+
+def census_by_axis(plan: CollectivePlan) -> Dict[str, Dict[str, int]]:
+    """axis -> dtype -> per-exchange wire bytes. The manifest jit.py
+    bumps STAT_mesh_collective_bytes{axis=...,dtype=...} from, and
+    what the mp-quant bench prints as the mp-axis sync-byte line."""
+    agg: Dict[str, Dict[str, int]] = {}
+    for axis, _op, dt, nb in wire_entries(plan):
+        per = agg.setdefault(axis, {})
+        per[dt] = per.get(dt, 0) + nb
     return agg
 
 
@@ -302,6 +630,8 @@ def publish_gauges(plan: CollectivePlan) -> None:
     gauge_set("GAUGE_collective_quant_small", len(plan.small))
     gauge_set("GAUGE_collective_quant_wire_bytes",
               sum(census_bytes(plan).values()))
+    gauge_set("GAUGE_collective_quant_gathers",
+              sum(1 for g in plan.gathers if g.quantized))
 
 
 def retract_gauges() -> None:

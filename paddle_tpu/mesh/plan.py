@@ -100,6 +100,19 @@ class ShardingPlan:
         pspec = self._params(name, tuple(shape)) if self._params else None
         return self._named(pspec)
 
+    def param_spec_tuple(self, name: str, shape=()) -> tuple:
+        """Canonical per-dim PartitionSpec tuple for a param — one
+        entry per tensor dim, each an axis name, a tuple of axis
+        names, or None — padded/trimmed to the tensor's rank so
+        callers (the axis-aware collective planner, tests) never have
+        to normalize NamedSharding vs raw-spec spellings themselves."""
+        sh = self.param_sharding(name, shape)
+        spec = tuple(sh.spec)
+        rank = len(tuple(shape))
+        spec = spec[:rank] + (None,) * (rank - len(spec))
+        return tuple(tuple(e) if isinstance(e, (list, tuple)) else e
+                     for e in spec)
+
     def input_sharding(self, name: str, shape) -> Any:
         """NamedSharding for a feed/batch tensor. ``inputs`` rule wins;
         default shards dim 0 over the data axis when divisible."""

@@ -37,6 +37,7 @@ import numpy as np
 __all__ = [
     "GRID_INT8", "GRID_FP8", "SCALE_SUFFIX", "MODES", "KV_DTYPES",
     "supports_fp8", "grid_for_mode", "grid_for_dtype", "storage_dtype",
+    "resolve_wire_mode",
     "channel_absmax", "quantize_array", "dequantize_array",
     "matmul", "embed", "qmatmul", "quantize_kv_rows",
     "quantize_decoder_params", "is_quantized", "weight_bytes_saved",
@@ -102,6 +103,39 @@ def storage_dtype(mode: str):
                 "False) — use 'int8'")
         return jnp.float8_e4m3fn
     raise ValueError("unknown quant mode %r" % mode)
+
+
+_WIRE_WARNED = False
+
+
+def resolve_wire_mode(mode: str, *, warn: bool = True) -> str:
+    """Resolve a requested collective wire mode against the backend.
+
+    Unlike :func:`storage_dtype` (which RAISES for fp8 without backend
+    support — a checkpoint stored in a dtype the build lacks is
+    unrecoverable), a collective wire is negotiable: "fp8" degrades to
+    the int8 wire with a one-time warning when :func:`supports_fp8` is
+    false, because the exchange still has to happen. "fp32"/"int8"
+    pass through; anything else raises. mesh/collectives.py resolves
+    once at plan time so the traced program and the byte census agree
+    on the dtype actually on the wire."""
+    if mode in ("fp32", "int8"):
+        return mode
+    if mode == "fp8":
+        if supports_fp8():
+            return "fp8"
+        global _WIRE_WARNED
+        if warn and not _WIRE_WARNED:
+            _WIRE_WARNED = True
+            import warnings
+            warnings.warn(
+                "collective wire mode 'fp8' needs float8_e4m3fn "
+                "(quant.supports_fp8() is False on this backend) — "
+                "falling back to the int8 wire", stacklevel=2)
+        return "int8"
+    raise ValueError(
+        "unknown collective wire mode %r (expected fp32|int8|fp8)"
+        % (mode,))
 
 
 def channel_absmax(w: np.ndarray, axis: int) -> np.ndarray:

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Run the mesh-native SPMD runtime suite (-m spmd, docs/spmd.md) on the
-# 8-device virtual CPU mesh and emit MULTICHIP_r09.json: the usual
-# multichip dryrun transcript (same shape as MULTICHIP_r0{1..8}.json)
+# 8-device virtual CPU mesh and emit MULTICHIP_r10.json: the usual
+# multichip dryrun transcript (same shape as MULTICHIP_r0{1..9}.json)
 # plus the mesh plan, the per-axis host-collective census
 # (STAT_mesh_collective_<axis>, monitor.py), the chaos smoke
 # (failpoints armed over /failpointz, recovery asserted — ISSUE 9),
@@ -37,7 +37,7 @@ echo "== spmd-marked tests (8 virtual CPU devices) =="
 python -m pytest tests/ -q -m spmd -p no:cacheprovider "$@"
 test_rc=$?
 
-echo "== multichip dryrun + mesh census -> MULTICHIP_r09.json =="
+echo "== multichip dryrun + mesh census -> MULTICHIP_r10.json =="
 python - "$test_rc" <<'EOF'
 import io
 import json
@@ -562,6 +562,110 @@ finally:
     from paddle_tpu.flags import set_flags as _cq_restore
     _cq_restore({"FLAGS_collective_quant": "off"})
 
+# mp-axis composed quantized-collective smoke (ISSUE 19, docs/spmd.md
+# "Quantized collectives on the mp axis"): a Megatron-ruled MLP under
+# dp2xmp2 — l1 column-sharded, l2 row-sharded, head replicated — so
+# the mp-axis quantized all-gather composes with the dp gradient wire
+# in one build. Asserts ZERO demotions (no warning, no counter
+# growth), the per-axis census says the mp gather wire shrank >= 3x
+# vs the fp32-composed oracle, the loss trajectory stays inside the
+# 0.05 budget, and the steady state never recompiles (the
+# out_shardings pin keeps sharded params sharded at rest without a
+# spec-spelling cache miss).
+mp_collective_quant = {"ok": False}
+try:
+    import warnings as _mpw
+    from jax.sharding import PartitionSpec as _P
+    from paddle_tpu import nn
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.monitor import get_float_stats
+
+    def _mpq_rule(name, shape):
+        # local shards (16x128 / 128x16 = 2048 elems) span two full
+        # quant blocks so block padding doesn't eat the byte ratio
+        if shape == (16, 256):
+            return _P(None, "mp")
+        if shape == (256, 16):
+            return _P("mp", None)
+        return None
+
+    mpq_plan = ShardingPlan("dp2xmp2", params=_mpq_rule)
+
+    def _mpq_loss(out, label):
+        d = out - label
+        return (d * d).mean()
+
+    def _mpq_build(mode, mp):
+        pt.dygraph.seed(0)
+        np.random.seed(0)
+        set_flags({"FLAGS_collective_quant": mode,
+                   "FLAGS_collective_quant_mp": mp,
+                   "FLAGS_collective_quant_min_numel": 16})
+        m = nn.Sequential(nn.Linear(16, 256), nn.Tanh(),
+                          nn.Linear(256, 16), nn.Tanh(),
+                          nn.Linear(16, 8))
+        opt = pt.optimizer.SGD(0.05, parameters=m.parameters())
+        return TrainStep(m, _mpq_loss, opt, plan=mpq_plan)
+
+    def _mpq_run(mode, mp, steps=6):
+        d0 = get_float_stats().get(
+            "STAT_collective_quant_demotions", 0.0)
+        with _mpw.catch_warnings(record=True) as caught:
+            _mpw.simplefilter("always")
+            step = _mpq_build(mode, mp)
+            r = np.random.RandomState(23)
+            out = []
+            for _ in range(steps):
+                xb = r.randn(8, 16).astype(np.float32)
+                yb = r.randn(8, 8).astype(np.float32)
+                out.append(float(step((xb,), (yb,))))
+        d1 = get_float_stats().get(
+            "STAT_collective_quant_demotions", 0.0)
+        warned = any("legacy GSPMD" in str(w.message) for w in caught)
+        return step, out, int(d1 - d0), warned
+
+    with use_plan(mpq_plan):
+        mpq_fp32, mpl_fp32, mpd_fp32, mpw_fp32 = _mpq_run(
+            "fp32", "fp32")
+        mpq_int8, mpl_int8, mpd_int8, mpw_int8 = _mpq_run(
+            "int8", "int8")
+    mpq_loss_diff = max(abs(a - b)
+                        for a, b in zip(mpl_fp32, mpl_int8))
+    mpq_by32 = mpq_fp32._coll_manifest["axes"]["mp"]["bytes"]
+    mpq_by8 = mpq_int8._coll_manifest["axes"]["mp"]["bytes"]
+    mpq_ratio = sum(mpq_by32.values()) / float(sum(mpq_by8.values()))
+    mpq_recompiles = {
+        "fp32": mpq_fp32._step_fn._cache_size() - 1,
+        "int8": mpq_int8._step_fn._cache_size() - 1,
+    }
+    mpq_gathers = get_float_stats().get(
+        "STAT_collective_quant_mp_gathers", 0.0)
+    mp_collective_quant = {
+        "ok": (mpq_ratio >= 3.0 and mpq_loss_diff < 0.05
+               and mpd_fp32 == 0 and mpd_int8 == 0
+               and not (mpw_fp32 or mpw_int8)
+               and mpq_recompiles == {"fp32": 0, "int8": 0}
+               and mpq_gathers > 0
+               and all(np.isfinite(mpl_int8))),
+        "mp_gather_params": len(mpq_int8._coll_plan.gathers),
+        "per_step_mp_sync_bytes_fp32": mpq_by32,
+        "per_step_mp_sync_bytes_int8": mpq_by8,
+        "mp_sync_bytes_ratio": round(mpq_ratio, 2),
+        "loss_max_abs_diff": float(mpq_loss_diff),
+        "demotions": {"fp32": mpd_fp32, "int8": mpd_int8},
+        "demotion_warning_fired": bool(mpw_fp32 or mpw_int8),
+        "steady_state_recompiles": mpq_recompiles,
+        "mp_gather_exchanges": mpq_gathers,
+    }
+except Exception as e:  # noqa: BLE001 - artifact records the failure
+    mp_collective_quant["error"] = "%s: %s" % (type(e).__name__, e)
+finally:
+    from paddle_tpu.flags import set_flags as _mpq_restore
+    _mpq_restore({"FLAGS_collective_quant": "off",
+                  "FLAGS_collective_quant_mp": "off",
+                  "FLAGS_collective_quant_min_numel": 2048})
+
 # slo smoke (ISSUE 12, docs/observability.md): enable the windowed SLO
 # engine, drive tenant-attributed traced requests (a quarter of them
 # deadline-missed), scrape /sloz text + JSON and the tenant-filtered
@@ -795,6 +899,7 @@ artifact = {
     and quant_smoke.get("ok", False)
     and autotune_smoke.get("ok", False)
     and collective_quant.get("ok", False)
+    and mp_collective_quant.get("ok", False)
     and slo_smoke.get("ok", False) and multihost.get("ok", False)
     and gang_obs.get("ok", False),
     "skipped": False,
@@ -813,6 +918,7 @@ artifact = {
     "quant": quant_smoke,
     "autotune": autotune_smoke,
     "collective_quant": collective_quant,
+    "mp_collective_quant": mp_collective_quant,
     "slo": slo_smoke,
     "gang_observability": gang_obs,
     "collectives": {k: v for k, v in sorted(counters.items())
@@ -821,13 +927,14 @@ artifact = {
                       if k.startswith("STAT_mesh_")},
     "tail": buf.getvalue() + ("" if err is None else err + "\n"),
 }
-with open("MULTICHIP_r09.json", "w") as f:
+with open("MULTICHIP_r10.json", "w") as f:
     json.dump(artifact, f, indent=1)
     f.write("\n")
 print(json.dumps({k: artifact[k] for k in
                   ("n_devices", "rc", "ok", "spmd_tests_rc",
                    "introspect", "chaos", "multihost", "generation",
-                   "quant", "autotune", "collective_quant", "slo",
+                   "quant", "autotune", "collective_quant",
+                   "mp_collective_quant", "slo",
                    "gang_observability", "collectives")},
                  indent=1))
 sys.exit(0 if artifact["ok"] else 1)

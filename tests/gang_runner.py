@@ -25,6 +25,15 @@ Env contract (beyond the launcher's PADDLE_* variables):
                  rank; rank-targeted injection itself uses the
                  PADDLE_TPU_FAILPOINTS_RANK<k> env armed at
                  failpoints import)
+  GANG_PLAN      mesh spec string (e.g. "dp2xmp2"); default {"dp": ndev}.
+                 A spec with an "mp" axis switches the model to the
+                 Megatron-ruled MLP (column-parallel l1, row-parallel
+                 l2, replicated head) so the mp-axis quantized wire has
+                 sharded params to compose with (ISSUE 19)
+  GANG_QUANT     FLAGS_collective_quant for the dp gradient exchange
+                 ("off"/"fp32"/"int8")
+  GANG_QUANT_MP  FLAGS_collective_quant_mp for the mp-axis gathers
+                 ("off"/"fp32"/"int8"/"fp8")
 """
 import os
 import sys
@@ -92,7 +101,25 @@ def main():
     seed(7)
     nproc = jax.process_count()
     rank = jax.process_index()
-    plan = ShardingPlan({"dp": len(jax.devices())})
+    plan_spec = os.environ.get("GANG_PLAN", "")
+    megatron = "mp" in plan_spec
+
+    def _rule(name, shape):
+        # shape-matched Megatron split: column-parallel l1, row-
+        # parallel l2 — the head stays replicated so the wire sees
+        # both sharded AND replicated grads in one build
+        from jax.sharding import PartitionSpec as P
+        if shape == (8, 16):
+            return P(None, "mp")
+        if shape == (16, 16):
+            return P("mp", None)
+        return None
+
+    if plan_spec:
+        plan = ShardingPlan(plan_spec,
+                            params=_rule if megatron else None)
+    else:
+        plan = ShardingPlan({"dp": len(jax.devices())})
 
     class MLP(nn.Layer):
         def __init__(self):
@@ -103,13 +130,30 @@ def main():
         def forward(self, x):
             return self.l2(self.l1(x).tanh())
 
+    class MegatronMLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(8, 16)   # (8,16)  -> P(None, "mp")
+            self.l2 = nn.Linear(16, 16)  # (16,16) -> P("mp", None)
+            self.head = nn.Linear(16, 1)
+
+        def forward(self, x):
+            return self.head(self.l2(self.l1(x).tanh()).tanh())
+
     def loss_fn(pred, label):
         return ((pred - label) * (pred - label)).mean()
 
     if os.environ.get("GANG_PHASES", "") not in ("", "0"):
         pt.set_flags({"FLAGS_step_phases": True})
+    quant = os.environ.get("GANG_QUANT", "")
+    if quant:
+        pt.set_flags({"FLAGS_collective_quant": quant,
+                      "FLAGS_collective_quant_min_numel": 16})
+    quant_mp = os.environ.get("GANG_QUANT_MP", "")
+    if quant_mp:
+        pt.set_flags({"FLAGS_collective_quant_mp": quant_mp})
 
-    model = MLP()
+    model = MegatronMLP() if megatron else MLP()
     opt = pt.optimizer.SGD(0.1, parameters=model.parameters())
     step = TrainStep(model, loss_fn, opt, plan=plan)
 

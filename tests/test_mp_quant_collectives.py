@@ -1,0 +1,552 @@
+"""Quantized collectives on the mp axis (ISSUE 19, docs/spmd.md
+"Quantized collectives on the mp axis"): the composed gather-compute
+path that lets Megatron-sharded params ride the quantized wire instead
+of demoting to legacy GSPMD — per-SHARD scale blocks on the mp
+all-gather, the fp8-e4m3 wire (GRID_FP8=448) where the probe admits
+it, axis-aware spec-grouped bucket planning, the
+dist.collective_quant_mp failpoint, warn-once demotion accounting, and
+the TrainStep threading behind FLAGS_collective_quant_mp (dp2xmp2:
+zero demotions, loss-budget parity with the composed fp32 oracle, zero
+steady-state recompiles)."""
+import contextlib
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import failpoints, quant
+from paddle_tpu.flags import get_flag, set_flags
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.mesh import ShardingPlan
+from paddle_tpu.mesh import collectives as coll
+from paddle_tpu.mesh import compat as _compat
+from paddle_tpu.monitor import reset_all, snapshot, stat_get
+
+fp8_only = pytest.mark.skipif(not quant.supports_fp8(),
+                              reason="backend has no fp8-e4m3")
+
+
+@contextlib.contextmanager
+def _flags(**kv):
+    old = {k: get_flag(k) for k in kv}
+    set_flags(kv)
+    try:
+        yield
+    finally:
+        set_flags(old)
+
+
+def _mesh22():
+    return ShardingPlan("dp2xmp2").mesh
+
+
+# ---------------------------------------------------------------------------
+# wire primitives: quantized_all_gather / gather_param / reduce_scatter
+# ---------------------------------------------------------------------------
+
+_SHAPES = {"w1": (8, 16), "b1": (16,), "w2": (16, 8)}
+_SPECS = {"w1": (None, "mp"), "w2": ("mp", None)}
+
+
+def _mp_plan(mp_mode, min_numel=4):
+    return coll.plan_buckets(_SHAPES, "dp", 2, mode="int8", bucket_mb=4,
+                             min_numel=min_numel, specs=_SPECS,
+                             axis_sizes={"mp": 2}, mp_mode=mp_mode)
+
+
+def _gather(full, mp_mode, gather_idx=0):
+    """Run gather_param over the mp axis of a dp2xmp2 mesh, feeding
+    the FULL tensor sharded per its spec; returns the reassembled
+    full-value as seen inside the body."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    plan = _mp_plan(mp_mode)
+    g = plan.gathers[gather_idx]
+    f = _compat.shard_map(
+        lambda w: coll.gather_param(w, g, plan), mesh=_mesh22(),
+        in_specs=(P(*_SPECS[g.name]),), out_specs=P(),
+        check_vma=False)
+    return np.asarray(jax.jit(f)(full)), g
+
+
+def test_gather_param_fp32_oracle_exact():
+    """mp_mode fp32 is the wire-parity oracle: the gathered value is
+    BITWISE the resident full tensor."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(8, 16).astype(np.float32)
+    got, g = _gather(x, "fp32")
+    assert not g.quantized
+    assert np.array_equal(got, x)
+
+
+def test_gather_param_int8_per_shard_scales():
+    """int8 gather error is bounded by each SHARD's own grid step —
+    the per-shard scale rule: rank 1's outlier must not widen rank
+    0's grid."""
+    rng = np.random.RandomState(4)
+    x = rng.randn(8, 16).astype(np.float32)
+    x[:, 8:] *= 100.0  # rank 1's shard carries the outliers
+    got, g = _gather(x, "int8")
+    assert g.quantized
+    # per-shard bound: each half against ITS OWN absmax grid
+    for lo, hi in ((0, 8), (8, 16)):
+        step = np.abs(x[:, lo:hi]).max() / 127.0
+        assert np.max(np.abs(got[:, lo:hi] - x[:, lo:hi])) <= \
+            0.5 * step + 1e-6
+    # shared-scale wire could not meet rank 0's bound (grid 100x wider)
+    shared_step = np.abs(x).max() / 127.0
+    assert np.abs(x[:, :8]).max() / 127.0 < shared_step / 50
+
+
+def test_gather_param_row_split_dim0():
+    """Row-parallel (dim-0) shards reassemble in rank order through
+    the moveaxis layout."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(16, 8).astype(np.float32)
+    got, g = _gather(x, "int8", gather_idx=1)
+    assert g.dim == 0 and g.name == "w2"
+    step = np.abs(x).max() / 127.0
+    assert np.max(np.abs(got - x)) <= 0.5 * step + 1e-6
+
+
+@fp8_only
+def test_gather_param_fp8_wire_roundtrip():
+    """fp8-e4m3 wire: ~2 mantissa-bit relative error on the 448 grid,
+    never worse than a few percent of each block's absmax."""
+    rng = np.random.RandomState(6)
+    x = (rng.randn(8, 16) * 2.0).astype(np.float32)
+    got, g = _gather(x, "fp8")
+    assert g.quantized
+    assert np.all(np.isfinite(got))
+    assert np.max(np.abs(got - x)) <= 0.07 * np.abs(x).max()
+
+
+@fp8_only
+def test_fp8_wire_dead_block_exact_zeros():
+    """An all-zero scale block must round-trip to EXACT zeros on the
+    fp8 wire too: the dead-block guard pins the divisor to 1.0 (PR-15
+    contract), so no 0/0 NaN can enter the gathered params."""
+    x = np.zeros((8, 16), np.float32)
+    x[0, 0] = 3.0  # one live value on rank 0's shard
+    got, _ = _gather(x, "fp8")
+    assert np.all(np.isfinite(got))
+    assert got[0, 0] != 0.0
+    assert np.all(got[1:, :] == 0.0) and np.all(got[0, 8:] == 0.0)
+
+
+@fp8_only
+def test_fp8_reduce_scatter_replicated_is_qdq():
+    """Replicated input through the fp8 reduce-scatter must collapse
+    to one quantize-dequantize round trip: payloads upcast to fp32
+    before summing (fp8 addition is not exact), so the mean of n
+    identical encodings IS the encoding."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    rng = np.random.RandomState(7)
+    x = rng.randn(4 * coll.BLOCK).astype(np.float32)
+    f = _compat.shard_map(
+        lambda v: coll.quantized_reduce_scatter(v, "mp", 2, mode="fp8",
+                                                mean=True),
+        mesh=_mesh22(), in_specs=(P(),), out_specs=P(None),
+        check_vma=False)
+    got = np.asarray(jax.jit(f)(x))
+    # reference via the same encode/decode path, scales shared (input
+    # replicated -> pmax is identity)
+    import jax.numpy as jnp
+    x2 = jnp.asarray(x.reshape(-1, coll.BLOCK))
+    s = coll._block_scales(x2)
+    ref = np.asarray(coll._wire_decode(
+        coll._wire_encode(x2, s, "fp8"), s, "fp8")).reshape(-1)
+    seg = got.size
+    assert np.allclose(got, ref[:seg], atol=1e-6) or \
+        np.allclose(got, ref[seg:], atol=1e-6)
+
+
+def test_int8_reduce_scatter_rank_varying_mean():
+    """Rank-varying input: each rank's segment returns the cross-rank
+    mean within the shared-scale grid error (scales pmax over the
+    REDUCTION axis — the mirror image of the gather's per-shard
+    rule)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    rng = np.random.RandomState(8)
+    x = rng.randn(2, 2 * coll.BLOCK).astype(np.float32)
+
+    def body(v):
+        mine = v[jax.lax.axis_index("mp")]
+        return coll.quantized_reduce_scatter(
+            mine, "mp", 2, mode="int8", mean=True)
+
+    f = _compat.shard_map(body, mesh=_mesh22(), in_specs=(P(),),
+                          out_specs=P(None), check_vma=False)
+    got = np.asarray(jax.jit(f)(x))
+    want = x.mean(axis=0)
+    step = np.abs(x).max() / 127.0
+    seg = got.size
+    err0 = np.max(np.abs(got - want[:seg]))
+    err1 = np.max(np.abs(got - want[seg:]))
+    assert min(err0, err1) <= 1.5 * step
+
+
+# ---------------------------------------------------------------------------
+# resolve_wire_mode: fp8 probe fallback
+# ---------------------------------------------------------------------------
+
+def test_resolve_wire_mode_passthrough_and_unknown():
+    assert quant.resolve_wire_mode("fp32") == "fp32"
+    assert quant.resolve_wire_mode("int8") == "int8"
+    with pytest.raises(ValueError):
+        quant.resolve_wire_mode("int4")
+
+
+def test_resolve_wire_mode_probe_off_falls_back_int8(monkeypatch):
+    monkeypatch.setattr(quant, "supports_fp8", lambda: False)
+    monkeypatch.setattr(quant, "_WIRE_WARNED", False)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert quant.resolve_wire_mode("fp8") == "int8"
+        assert quant.resolve_wire_mode("fp8") == "int8"
+    assert len([x for x in w if "fp8" in str(x.message)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# axis-aware planner
+# ---------------------------------------------------------------------------
+
+def test_plan_spec_grouped_buckets_never_mix_domains():
+    shapes = {"a": (64, 64), "b": (64, 64), "c": (64, 64),
+              "d": (64, 64)}
+    specs = {"a": (None, "mp"), "c": (None, "mp"), "d": ("mp", None)}
+    plan = coll.plan_buckets(shapes, "dp", 2, mode="int8", bucket_mb=4,
+                             min_numel=4, specs=specs,
+                             axis_sizes={"mp": 2}, mp_mode="int8")
+    # one bucket per distinct spec, members never mixed
+    by_spec = {b.spec: set(b.names) for b in plan.buckets}
+    assert by_spec[()] == {"b"}
+    assert by_spec[(None, "mp")] == {"a", "c"}
+    assert by_spec[("mp", None)] == {"d"}
+    # sharded members carry LOCAL geometry
+    for b in plan.buckets:
+        if b.spec == (None, "mp"):
+            assert set(b.shapes) == {(64, 32)}
+    assert [g.name for g in plan.gathers] == ["a", "c", "d"]
+    # determinism: pure function of inputs
+    plan2 = coll.plan_buckets(shapes, "dp", 2, mode="int8", bucket_mb=4,
+                              min_numel=4, specs=specs,
+                              axis_sizes={"mp": 2}, mp_mode="int8")
+    assert plan == plan2
+
+
+def test_plan_small_threshold_applies_to_local_shard():
+    shapes = {"w": (4, 1024)}  # full 4096 elems, shard 2048
+    specs = {"w": (None, "mp")}
+    plan = coll.plan_buckets(shapes, "dp", 2, mode="int8", bucket_mb=4,
+                             min_numel=3000, specs=specs,
+                             axis_sizes={"mp": 2}, mp_mode="int8")
+    # the SHARD (2048) is under threshold: per-tensor fp32 dp sync,
+    # but the gather still rides the quantized wire
+    assert dict(plan.small) == {"w": 2048}
+    assert plan.gathers and plan.gathers[0].quantized
+
+
+def test_plan_bad_specs_raise():
+    with pytest.raises(ValueError):  # two sharded dims
+        coll._local_shape((8, 8), ("mp", "mp"), {"mp": 2})
+    with pytest.raises(ValueError):  # tuple axis entry
+        coll._local_shape((8, 8), (("dp", "mp"), None), {"mp": 2})
+    with pytest.raises(ValueError):  # axis outside non-data axes
+        coll._local_shape((8, 8), ("dp", None), {"mp": 2})
+    with pytest.raises(ValueError):  # indivisible dim
+        coll._local_shape((9, 8), ("mp", None), {"mp": 2})
+
+
+def test_plan_mp_failpoint_demotes_one_gather_group():
+    assert "dist.collective_quant_mp" in failpoints.KNOWN_SITES
+    f0 = stat_get("STAT_collective_quant_mp_fallbacks")
+    failpoints.arm_spec("dist.collective_quant_mp=raise@once")
+    try:
+        plan = _mp_plan("int8")
+    finally:
+        failpoints.disarm("dist.collective_quant_mp")
+    # planning walks reverse-topologically: w2's (mp, None) group is
+    # offered first and faulted to the fp32 wire; w1's group stays
+    # quantized. Fired once per GROUP, not per tensor.
+    quantized = {g.name: g.quantized for g in plan.gathers}
+    assert quantized == {"w1": True, "w2": False}
+    assert stat_get("STAT_collective_quant_mp_fallbacks") == f0 + 1
+    # disarmed: both quantize again
+    plan2 = _mp_plan("int8")
+    assert all(g.quantized for g in plan2.gathers)
+
+
+def test_census_by_axis_and_gather_entries():
+    plan = _mp_plan("int8")
+    ca = coll.census_by_axis(plan)
+    assert set(ca) == {"dp", "mp"}
+    assert ca["mp"].get("int8", 0) > 0       # quantized gather payload
+    assert ca["mp"].get("float32", 0) > 0    # fp32 scale rows
+    # flat census (legacy shape) is the axis sum
+    flat = coll.census_bytes(plan)
+    for dt in flat:
+        assert flat[dt] == sum(ca[a].get(dt, 0) for a in ca)
+    # fp32 oracle wire: no one-byte payloads on the mp axis
+    ca32 = coll.census_by_axis(_mp_plan("fp32"))
+    assert "int8" not in ca32["mp"] and "float8_e4m3fn" not in ca32["mp"]
+
+
+# ---------------------------------------------------------------------------
+# TrainStep: composed Megatron path on dp2xmp2
+# ---------------------------------------------------------------------------
+
+def _ts_loss(out, label):
+    import paddle_tpu.nn.functional as F
+    return F.cross_entropy(out, label)
+
+
+def _megatron_rule(name, shape):
+    from jax.sharding import PartitionSpec as P
+    if shape == (8, 16):
+        return P(None, "mp")   # column-parallel
+    if shape == (16, 4):
+        return P("mp", None)   # row-parallel
+    return None
+
+
+def _build_mp_step(mode, mp, accum=1, seed=42):
+    from paddle_tpu import nn
+    pt.dygraph.seed(seed)
+    np.random.seed(seed)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    o = pt.optimizer.SGD(0.1, parameters=m.parameters())
+    set_flags({"FLAGS_collective_quant": mode,
+               "FLAGS_collective_quant_mp": mp,
+               "FLAGS_collective_quant_min_numel": 16})
+    return TrainStep(m, _ts_loss, o,
+                     plan=ShardingPlan("dp2xmp2", params=_megatron_rule),
+                     grad_accum_steps=accum)
+
+
+def _run(step, steps=5, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(steps):
+        x = rng.randn(batch, 8).astype(np.float32)
+        y = rng.randint(0, 4, (batch, 1)).astype(np.int32)
+        out.append(float(step((x,), (y,))))
+    return out
+
+
+def test_composed_int8_zero_demotions_budget_and_recompiles():
+    with _flags(FLAGS_collective_quant="off",
+                FLAGS_collective_quant_mp="off",
+                FLAGS_collective_quant_min_numel=16):
+        reset_all()
+        oracle = _run(_build_mp_step("fp32", "fp32"))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            step = _build_mp_step("int8", "int8")
+            got = _run(step)
+        # ZERO demotions: no GSPMD fallback warning, counter untouched
+        assert not [x for x in w if "GSPMD" in str(x.message)]
+        assert stat_get("STAT_collective_quant_demotions") == 0
+        diff = max(abs(a - b) for a, b in zip(got, oracle))
+        assert diff < 0.05, (diff, got, oracle)
+        assert step._step_fn._cache_size() == 1  # zero steady-state
+        m = step._coll_manifest
+        assert m["gathers"] == 2
+        assert m["axes"]["mp"]["bytes"].get("int8", 0) > 0
+        assert m["axes"]["dp"]["bytes"].get("int8", 0) > 0
+        assert stat_get("STAT_collective_quant_mp_gathers") >= 10
+        # params stay SHARDED at rest through the whole run
+        for n, v in step._state.items():
+            if tuple(v.shape) == (8, 16):
+                assert tuple(v.sharding.spec)[:2] == (None, "mp")
+
+
+def test_composed_fp32_oracle_matches_legacy_gspmd():
+    """The composed fp32 wire is a PARITY oracle: same math as the
+    legacy GSPMD sync (gather is exact, grad slice is exact, same
+    batch/rng split), so losses agree to float tolerance."""
+    with _flags(FLAGS_collective_quant="off",
+                FLAGS_collective_quant_mp="off",
+                FLAGS_collective_quant_min_numel=16):
+        legacy = _run(_build_mp_step("off", "off"))
+        composed = _run(_build_mp_step("fp32", "fp32"))
+        diff = max(abs(a - b) for a, b in zip(composed, legacy))
+        assert diff < 1e-5, (diff, composed, legacy)
+
+
+@fp8_only
+def test_composed_fp8_within_budget():
+    with _flags(FLAGS_collective_quant="off",
+                FLAGS_collective_quant_mp="off",
+                FLAGS_collective_quant_min_numel=16):
+        oracle = _run(_build_mp_step("fp32", "fp32"))
+        step = _build_mp_step("int8", "fp8")
+        got = _run(step)
+        diff = max(abs(a - b) for a, b in zip(got, oracle))
+        assert diff < 0.05, (diff, got, oracle)
+        assert step._coll_manifest["axes"]["mp"]["bytes"].get(
+            "float8_e4m3fn", 0) > 0
+
+
+def test_composed_fp8_probe_off_pins_int8(monkeypatch):
+    """Where the probe does NOT admit fp8, the build lands on the int8
+    wire — same geometry, no crash, counted as int8 in the census."""
+    monkeypatch.setattr(quant, "supports_fp8", lambda: False)
+    monkeypatch.setattr(quant, "_WIRE_WARNED", True)  # silence
+    with _flags(FLAGS_collective_quant="off",
+                FLAGS_collective_quant_mp="off",
+                FLAGS_collective_quant_min_numel=16):
+        step = _build_mp_step("int8", "fp8")
+        got = _run(step, steps=2)
+        assert all(np.isfinite(got))
+        assert step._coll_plan.mp_mode == "int8"
+        assert step._coll_manifest["axes"]["mp"]["bytes"].get(
+            "int8", 0) > 0
+
+
+def test_flag_off_demotes_warn_once_and_counts():
+    """FLAGS_collective_quant_mp=off pins PR-17 behavior: sharded
+    params keep the legacy GSPMD sync — but the diagnostic now fires
+    ONCE per TrainStep (not per param, not per rebuild) and every
+    demoted param lands in STAT_collective_quant_demotions."""
+    with _flags(FLAGS_collective_quant="off",
+                FLAGS_collective_quant_mp="off",
+                FLAGS_collective_quant_min_numel=16):
+        reset_all()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            step = _build_mp_step("int8", "off")
+            _run(step, steps=2)
+            step._step_fn = None    # force a rebuild
+            _run(step, steps=1)
+        demo = [x for x in w if "GSPMD" in str(x.message)]
+        assert len(demo) == 1, [str(x.message) for x in w]
+        # 2 sharded params x 2 builds
+        assert stat_get("STAT_collective_quant_demotions") == 4
+        assert step._coll_manifest is None  # legacy path, no census
+
+
+def test_composed_grad_accum_finite():
+    with _flags(FLAGS_collective_quant="off",
+                FLAGS_collective_quant_mp="off",
+                FLAGS_collective_quant_min_numel=16):
+        got = _run(_build_mp_step("int8", "int8", accum=2), steps=3)
+        assert all(np.isfinite(got))
+
+
+def test_statusz_mp_section():
+    with _flags(FLAGS_collective_quant="off",
+                FLAGS_collective_quant_mp="off",
+                FLAGS_collective_quant_min_numel=16):
+        reset_all()
+        with _flags(FLAGS_collective_quant="int8",
+                    FLAGS_collective_quant_mp="int8"):
+            step = _build_mp_step("int8", "int8")
+            _run(step, steps=3)
+            from paddle_tpu.introspect import statusz
+            sz = statusz()["mesh"]["collectives"]
+            assert sz["quant"]["mode_mp"] == "int8"
+        assert sz["quant"]["gathers"] == 2
+        assert sz["quant"]["gather_exchanges"] == 3 * 2
+        assert sz["quant"]["demotions"] == 0
+        assert sz["quant"]["mp_fallbacks"] == 0
+        assert sz["bytes"]["mp"]["int8"] == 3 * \
+            step._coll_manifest["axes"]["mp"]["bytes"]["int8"]
+
+
+def test_mp_flag_is_a_lowering_flag():
+    """Flipping FLAGS_collective_quant_mp reshapes the traced program
+    (gather ops, wire dtype, shard-shaped exchange) — it must miss the
+    AOT cache, i.e. live in the lowering fingerprint."""
+    from paddle_tpu.flags import _LOWERING_FLAGS, lowering_snapshot
+    assert "FLAGS_collective_quant_mp" in _LOWERING_FLAGS
+    with _flags(FLAGS_collective_quant_mp="off"):
+        a = lowering_snapshot()
+        with _flags(FLAGS_collective_quant_mp="int8"):
+            b = lowering_snapshot()
+    assert a != b
+
+
+def test_stat_diff_families():
+    """The new counters classify correctly in the regression gate:
+    _mp_gathers is the healthy composed steady state (exchanges
+    dispatched per step — exempt); _demotions and _mp_fallbacks growth
+    mean builds or gather groups fell off the quantized wire — cost."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "stat_diff", os.path.join(os.path.dirname(__file__), "..",
+                                  "tools", "stat_diff.py"))
+    sd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sd)
+    assert not sd._is_cost_counter("STAT_collective_quant_mp_gathers")
+    assert sd._is_cost_counter("STAT_collective_quant_demotions")
+    assert sd._is_cost_counter("STAT_collective_quant_mp_fallbacks")
+    assert not sd._is_cost_counter("STAT_mesh_collective_bytes"
+                                   '{axis="mp",dtype="int8"}')
+
+
+# ---------------------------------------------------------------------------
+# trace_merge: wire-byte annotation of exchange slices from digests
+# ---------------------------------------------------------------------------
+
+def _phase_event(pid, step, name="phase/exchange", ts=None):
+    return {"name": name, "ph": "X", "pid": pid, "tid": 1,
+            "ts": float(100 * step if ts is None else ts), "dur": 50.0,
+            "cat": "phase", "args": {"step": step}}
+
+
+def test_trace_merge_annotates_exchange_slices_with_wire_bytes(
+        tmp_path):
+    """Digest ``coll`` deltas divided by their step span land on every
+    exchange slice the span covers — per dtype plus a total — and
+    slices outside any span (or on ranks without a digest log) stay
+    untouched."""
+    from tools import trace_merge
+    r0 = {"traceEvents": [_phase_event(0, s) for s in (1, 2, 3, 4)] +
+          [_phase_event(0, 2, name="phase/compute")]}
+    r1 = {"traceEvents": [_phase_event(1, s) for s in (1, 2, 3, 4)]}
+    merged = trace_merge.merge_traces([r0, r1], align_step=1)
+    # rank 0's digests: steps 1-2 moved 2000 int8 + 200 fp32, steps
+    # 3-4 moved only 1000 int8; rank 1 logs nothing
+    digs = [{"v": 1, "step": 2, "coll": {"int8": 2000,
+                                         "float32": 200}},
+            {"v": 1, "step": 4, "coll": {"int8": 1000}}]
+    n = trace_merge.annotate_wire_bytes(merged, {0: digs})
+    assert n == 4
+    got = {(_e["pid"], _e["args"]["step"]): _e["args"]
+           for _e in merged["traceEvents"]
+           if _e.get("name") == "phase/exchange"}
+    assert got[(0, 1)]["wire_bytes"] == {"int8": 1000, "float32": 100}
+    assert got[(0, 2)]["wire_bytes_total"] == 1100
+    assert got[(0, 3)]["wire_bytes"] == {"int8": 500}
+    assert "wire_bytes" not in got[(1, 2)]
+    # the compute slice is never annotated
+    comp = [e for e in merged["traceEvents"]
+            if e.get("name") == "phase/compute"]
+    assert all("wire_bytes" not in (e.get("args") or {}) for e in comp)
+
+
+def test_trace_merge_digests_cli_roundtrip(tmp_path):
+    import json
+    from tools import trace_merge
+    p0 = str(tmp_path / "r0.json")
+    with open(p0, "w") as f:
+        json.dump({"traceEvents": [_phase_event(0, 1),
+                                   _phase_event(0, 2)]}, f)
+    dpath = str(tmp_path / "digests_rank0.jsonl")
+    with open(dpath, "w") as f:
+        f.write(json.dumps({"v": 1, "step": 2,
+                            "coll": {"int8": 800}}) + "\n")
+        f.write("{corrupt\n")  # torn tail write must be skipped
+    out = str(tmp_path / "merged.json")
+    assert trace_merge.main([p0, "-o", out,
+                             "--digests", "0=%s" % dpath]) == 0
+    with open(out) as f:
+        merged = json.load(f)
+    ex = [e for e in merged["traceEvents"]
+          if e.get("name") == "phase/exchange"]
+    assert all(e["args"]["wire_bytes"] == {"int8": 400} for e in ex)

@@ -72,8 +72,17 @@ COST_INFIXES = ("_shed_", "_restart", "_kv_quant_", "_autotune_",
 # STAT_gang_digest_beats is the skew SLO's free-running TOTAL (every
 # ingested digest counts one) — growth is the healthy heartbeat
 # steady state, so it is exempt from the _gang_/_digest_ cost infixes.
+# The mp-axis composition (ISSUE 19, docs/spmd.md) splits the same
+# way: STAT_collective_quant_mp_gathers is the healthy composed steady
+# state (sharded params gathered on the quantized wire each step —
+# growth means the wire is doing its job), while _demotions (whole
+# builds falling back to legacy GSPMD sync) and _mp_fallbacks (gather
+# groups faulted to fp32) stay costs under the _collective_quant_
+# infix: either one growing in a steady-state run means sharded
+# params quietly left the quantized wire.
 COST_EXEMPT_SUFFIXES = ("_autotune_cache_hits",
                         "_collective_quant_buckets",
+                        "_collective_quant_mp_gathers",
                         "_gang_digest_beats")
 
 

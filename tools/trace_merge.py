@@ -31,6 +31,19 @@ inter-rank skew at later steps is real drift, not clock origin.
 Inputs missing the anchor step fall back to their minimum ts (best
 effort, still one process row — a rank that never stepped, e.g. a
 crash-looping worker, should still show its spans).
+
+Wire-byte annotation (ISSUE 19): ``--digests RANK=digests.jsonl``
+(repeatable) joins a rank's heartbeat-digest log — the
+``digests_rank<k>.jsonl`` files the supervisor writes under its
+log_dir — onto that rank's ``phase/exchange`` trace slices. Each
+digest carries ``coll`` (dtype -> collective wire-byte deltas since
+the previous digest, launch.build_digest); dividing a delta by the
+step span between consecutive digests gives per-step wire bytes, and
+every exchange slice whose ``args.step`` falls in the span gains
+``args.wire_bytes`` ({dtype: bytes}) and ``args.wire_bytes_total`` —
+so hovering an exchange span in Perfetto shows how many bytes that
+step's collectives actually moved, per dtype, next to how long the
+rank waited for them.
 """
 from __future__ import annotations
 
@@ -135,6 +148,70 @@ def merge_traces(sources: Sequence[Union[str, Dict[str, Any]]],
                          "ranks": sorted(set(seen_ranks))}}
 
 
+def load_digests(path: str) -> List[Dict[str, Any]]:
+    """Read one rank's digest JSONL log (digests_rank<k>.jsonl);
+    malformed lines are skipped — a torn tail write must not void the
+    rest of the log."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(d, dict):
+                out.append(d)
+    return out
+
+
+def _digest_intervals(digests: Sequence[Dict[str, Any]]
+                      ) -> List[tuple]:
+    """(lo_step, hi_step, {dtype: per-step wire bytes}) spans from a
+    rank's digest stream: digest i's ``coll`` deltas cover steps
+    (step_{i-1}, step_i], so per-step = delta / span. Digests without
+    ``coll`` (quant off, or dropped under the byte cap) still advance
+    the step cursor so the next delta divides by its true span."""
+    out: List[tuple] = []
+    prev = 0
+    for d in sorted(digests, key=lambda d: int(d.get("step", 0) or 0)):
+        step = int(d.get("step", 0) or 0)
+        coll = d.get("coll")
+        if isinstance(coll, dict) and coll and step > prev:
+            span = step - prev
+            out.append((prev, step,
+                        {str(k): int(round(float(v) / span))
+                         for k, v in coll.items()}))
+        prev = max(prev, step)
+    return out
+
+
+def annotate_wire_bytes(trace: Dict[str, Any],
+                        digests: Dict[int, Sequence[Dict[str, Any]]]
+                        ) -> int:
+    """Attach per-step wire-byte args to ``phase/exchange`` events of
+    a merged (or single-rank) trace, in place. Returns the number of
+    slices annotated."""
+    spans = {int(r): _digest_intervals(d) for r, d in digests.items()}
+    n = 0
+    for e in trace.get("traceEvents", ()):
+        if e.get("name") != "phase/exchange":
+            continue
+        step = _event_step(e)
+        if step is None:
+            continue
+        for lo, hi, per in spans.get(int(e.get("pid", -1)), ()):
+            if lo < step <= hi:
+                args = e.setdefault("args", {})
+                args["wire_bytes"] = dict(per)
+                args["wire_bytes_total"] = sum(per.values())
+                n += 1
+                break
+    return n
+
+
 def main(argv: List[str]) -> int:
     p = argparse.ArgumentParser(
         description="merge per-rank paddle_tpu chrome-trace files, "
@@ -146,15 +223,32 @@ def main(argv: List[str]) -> int:
     p.add_argument("--align-step", type=int, default=None,
                    help="step index to align ranks on (default: "
                         "earliest step present in every input)")
+    p.add_argument("--digests", action="append", default=[],
+                   metavar="RANK=PATH",
+                   help="rank's heartbeat-digest JSONL "
+                        "(digests_rank<k>.jsonl); repeatable — "
+                        "annotates that rank's exchange slices with "
+                        "per-step wire bytes")
     ns = p.parse_args(argv)
     trace = merge_traces(ns.traces, align_step=ns.align_step)
+    annotated = 0
+    if ns.digests:
+        digs: Dict[int, List[Dict[str, Any]]] = {}
+        for spec in ns.digests:
+            rank_s, _, path = spec.partition("=")
+            if not path:
+                p.error("--digests expects RANK=PATH, got %r" % spec)
+            digs[int(rank_s)] = load_digests(path)
+        annotated = annotate_wire_bytes(trace, digs)
     with open(ns.output, "w") as f:
         json.dump(trace, f)
     n_ev = len(trace["traceEvents"])
-    print("merged %d files (%d events, ranks %s) -> %s [align_step=%s]"
+    extra = (", %d exchange slices wire-annotated" % annotated
+             if ns.digests else "")
+    print("merged %d files (%d events, ranks %s) -> %s [align_step=%s]%s"
           % (len(ns.traces), n_ev,
              trace["metadata"]["ranks"], ns.output,
-             trace["metadata"]["align_step"]))
+             trace["metadata"]["align_step"], extra))
     return 0
 
 
