@@ -3093,6 +3093,333 @@ def bench_gang_observability():
     return out
 
 
+def bench_frontdoor():
+    """frontdoor block (ISSUE 20, docs/frontdoor.md): two models — an
+    fp32 fc predictor and an int8 generation engine — co-resident in
+    ONE process behind a FrontDoor, measured four ways:
+
+    - the disabled path (ns/call): frontdoor.active() with
+      FLAGS_frontdoor off is ONE list read (the tracing/failpoints/slo
+      contract — a deployment that never constructs a FrontDoor pays
+      nothing);
+    - priority admission under deliberate overload: a mixed two-tenant
+      burst (24 high-priority generous-deadline + 96 low-priority
+      tight-deadline requests, interleaved 1:4) against ONE dispatch
+      worker, vs the SAME burst in the SAME arrival order through a
+      plain FIFO PredictorPool — gates: hi p95 >= 2x lower than FIFO,
+      every shed request is low-priority, every hi request completes
+      inside its deadline;
+    - graceful hot-swap under live traffic: deploy(fc, v2) while 12
+      requests are in flight — gates: zero dropped in-flight, the
+      routing flip lands (verified over live /modelz HTTP JSON), and
+      post-swap steady-state traffic causes ZERO recompiles on either
+      endpoint (STAT_executor_compile / STAT_generation_compile deltas);
+    - the closed autoscale loop driven by the /sloz signal gauges:
+      under a failpoint-slowed queue the controller scales the fc
+      endpoint UP toward workers_max, and after drain + hysteresis it
+      scales back DOWN — both directions must fire, every decision
+      carries the gauge inputs it read.
+    """
+    import shutil
+    import tempfile
+    import urllib.request
+    import paddle_tpu as pt
+    from paddle_tpu import failpoints, frontdoor, introspect, monitor, \
+        quant, serving, slo
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.frontdoor import (EndpointSpec, FrontDoor,
+                                      ModelCatalog, QuotaExceeded)
+    from paddle_tpu.generation import (DecoderConfig, GenerationEngine,
+                                       GenerationRequest, init_params)
+    from paddle_tpu.monitor import stat_get
+    from paddle_tpu.serving import DeadlineBurned
+
+    # --- disabled-path microbench ------------------------------------
+    set_flags({"FLAGS_frontdoor": False})
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        frontdoor.active()
+    active_off_ns = (time.perf_counter() - t0) / n * 1e9
+
+    H_IN = 32
+    model_dir = tempfile.mkdtemp(prefix="pt_frontdoor_bench_")
+    out: dict = {
+        "disabled_active_ns_per_call": round(active_off_ns, 1),
+    }
+    old_flags = pt.get_flags(["FLAGS_frontdoor_scale_cooldown_s",
+                              "FLAGS_frontdoor_quota_burst_s"])
+    try:
+        # --- the fp32 predictor model (bench_slo's fc stack) ---------
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [H_IN])
+            h = x
+            for _ in range(8):
+                h = pt.layers.fc(h, 64, act="relu")
+            y = pt.layers.fc(h, 8)
+        exe = pt.Executor()
+        exe.run(startup)
+        pt.io.save_inference_model(model_dir, ["x"], [y], exe,
+                                   main_program=main)
+        cfg = pt.inference.Config(model_dir)
+        cfg.switch_shape_bucketing(True, buckets="pow2:32")
+
+        # --- the int8 generation model -------------------------------
+        gcfg = DecoderConfig(vocab_size=128, hidden=64, layers=2,
+                             heads=4, max_seq_len=64)
+        gq = quant.quantize_decoder_params(init_params(gcfg, seed=0),
+                                           "int8")
+        mk_engine = lambda: GenerationEngine(  # noqa: E731
+            gcfg, gq, num_blocks=64, block_size=8, decode_width=4,
+            prefill_buckets="pow2:32", prefill_chunk=16,
+            prefix_cache=False, quant_mode="int8", kv_dtype="int8")
+
+        rng = np.random.RandomState(7)
+        feed = lambda b: [rng.rand(b, H_IN).astype(np.float32)]  # noqa: E731
+
+        # --- FIFO baseline: same burst, plain single-model pool ------
+        # (max_batch=1 on BOTH sides so the A/B isolates the admission
+        # policy, not micro-batch coalescing)
+        R, HI_EVERY = 120, 5
+        order = [("hi", 10, 2.0) if i % HI_EVERY == 0
+                 else ("lo", 0, 0.03) for i in range(R)]
+        n_hi = sum(1 for t, _, _ in order if t == "hi")
+        payloads = [feed(int(rng.randint(1, 9))) for _ in range(R)]
+
+        # both measured phases run with serving.execute slowed 3ms via
+        # failpoint (the bench_slo storm idiom): the same stand-in for
+        # a heavier model on both sides, so the A/B isolates the
+        # admission policy rather than per-dispatch overhead
+        with serving.PredictorPool(pt.inference.create_predictor(cfg),
+                                   max_batch=1,
+                                   queue_depth=2 * R) as pool:
+            pool.warmup([np.zeros((1, H_IN), np.float32)])
+            try:
+                failpoints.arm_spec("serving.execute=delay(3)")
+                for p in payloads[:10]:
+                    pool.run(p)
+                t0 = time.perf_counter()
+                futs = [pool.submit(payloads[i], tenant=order[i][0])
+                        for i in range(R)]
+                fifo_hi = []
+                for i, f in enumerate(futs):
+                    f.result()
+                    if order[i][0] == "hi":
+                        fifo_hi.append(time.perf_counter() - t0)
+            finally:
+                failpoints.disarm("all")
+        fifo_hi_p95 = float(np.percentile(fifo_hi, 95))
+
+        # --- the front door: fc (fp32) + lm (int8) co-resident -------
+        catalog = ModelCatalog([
+            EndpointSpec(
+                name="fc", kind="predictor", version="v1",
+                factory=lambda: pt.inference.create_predictor(cfg),
+                warmup_feeds=[np.zeros((1, H_IN), np.float32)],
+                pool_kwargs={"max_batch": 1, "queue_depth": 2 * R},
+                queue_depth=2 * R, workers=1, workers_min=1,
+                workers_max=4, tenant_quota_rps={"metered": 5.0}),
+            EndpointSpec(
+                name="lm", kind="generation", version="v1",
+                factory=mk_engine, quant_mode="int8",
+                workers=1, workers_min=1, workers_max=2),
+        ])
+        set_flags({"FLAGS_frontdoor_scale_cooldown_s": 0.0,
+                   "FLAGS_frontdoor_quota_burst_s": 2.0})
+        srv = introspect.start(port=0)
+        door = FrontDoor(catalog, autoscale=False)
+        try:
+            lm_res = door.run("lm", GenerationRequest(
+                prompt=[3, 5, 7] * 4, max_new_tokens=8, request_id=0))
+
+            # --- priority admission under overload ------------------
+            shed_tenants: set = set()
+            admitted: list = []
+            try:
+                failpoints.arm_spec("serving.execute=delay(3)")
+                # prime the admission EWMAs at the measured service rate
+                for p in payloads[:10]:
+                    door.run("fc", p)
+                t0 = time.perf_counter()
+                for i in range(R):
+                    tn, prio, dl = order[i]
+                    try:
+                        admitted.append((i, door.submit(
+                            "fc", payloads[i], tenant=tn,
+                            priority=prio, deadline=dl)))
+                    except (DeadlineBurned, serving.ServingQueueFull):
+                        shed_tenants.add(tn)
+                fd_hi, lo_done, lo_shed_late = [], 0, 0
+                for i, f in admitted:
+                    tn = order[i][0]
+                    try:
+                        f.result(timeout=60.0)
+                        if tn == "hi":
+                            fd_hi.append(time.perf_counter() - t0)
+                        else:
+                            lo_done += 1
+                    except (DeadlineBurned, TimeoutError):
+                        # TimeoutError: dispatched with only a sliver
+                        # of deadline budget left, burned inside the
+                        # pool — the same deadline shed, raced past
+                        # the queue-side check
+                        shed_tenants.add(tn)
+                        lo_shed_late += 1
+            finally:
+                failpoints.disarm("all")
+            fd_hi_p95 = float(np.percentile(fd_hi, 95)) \
+                if len(fd_hi) == n_hi else float("inf")
+            hi_met_deadline = (len(fd_hi) == n_hi
+                               and max(fd_hi) < 2.0)
+            sheds_all_lo = shed_tenants <= {"lo"} and bool(shed_tenants)
+            out["priority_overload"] = {
+                "workload": "%d requests 1:%d hi:lo, hi prio=10 "
+                            "deadline=2s, lo prio=0 deadline=30ms, one "
+                            "dispatch worker" % (R, HI_EVERY - 1),
+                "fifo_hi_p95_ms": round(fifo_hi_p95 * 1e3, 2),
+                "frontdoor_hi_p95_ms": round(fd_hi_p95 * 1e3, 2),
+                "hi_p95_speedup_vs_fifo": round(
+                    fifo_hi_p95 / fd_hi_p95, 2),
+                "hi_completed": len(fd_hi),
+                "hi_met_deadline": hi_met_deadline,
+                "lo_completed": lo_done,
+                "lo_shed_at_admit": R - n_hi - lo_done - lo_shed_late,
+                "lo_shed_in_queue": lo_shed_late,
+                "shed_tenants": sorted(shed_tenants),
+                "sheds_all_low_priority": sheds_all_lo,
+            }
+
+            # --- per-tenant token-bucket quota -----------------------
+            q_ok = q_rej = 0
+            retry_hint = None
+            for _ in range(15):
+                try:
+                    door.submit("fc", payloads[0], tenant="metered")
+                    q_ok += 1
+                except QuotaExceeded as e:
+                    q_rej += 1
+                    retry_hint = e.retry_after_s
+            out["tenant_quota"] = {
+                "quota": "metered @ 5 rps, burst 2s",
+                "burst_submits": 15, "admitted": q_ok,
+                "rejected": q_rej,
+                "retry_after_s_hint": round(retry_hint, 3)
+                if retry_hint else None,
+            }
+
+            # --- graceful hot-swap under live traffic ----------------
+            door.catalog.add(EndpointSpec(
+                name="fc", kind="predictor", version="v2",
+                factory=lambda: pt.inference.create_predictor(cfg),
+                warmup_feeds=[np.zeros((1, H_IN), np.float32)],
+                pool_kwargs={"max_batch": 1, "queue_depth": 2 * R},
+                queue_depth=2 * R, workers=1, workers_min=1,
+                workers_max=4))
+            inflight = [door.submit("fc", feed(4)) for _ in range(12)]
+            door.deploy("fc", "v2")
+            dropped = 0
+            for f in inflight:
+                try:
+                    f.result(timeout=60.0)
+                except Exception:
+                    dropped += 1
+            z = json.load(urllib.request.urlopen(
+                srv.url + "/modelz?format=json", timeout=10))
+            flip_live = (z["models"]["fc"]["active_version"] == "v2"
+                         and z["models"]["fc"]["counters"]["swaps"] == 1)
+
+            # --- zero steady-state recompiles post-swap --------------
+            c_exec = stat_get("STAT_executor_compile")
+            c_gen = stat_get("STAT_generation_compile")
+            for _ in range(40):
+                door.run("fc", feed(int(rng.randint(1, 9))))
+            for i in range(3):
+                door.run("lm", GenerationRequest(
+                    prompt=[2, 4, 6] * 4, max_new_tokens=8,
+                    request_id=100 + i))
+            recompiles = {
+                "serving": int(stat_get("STAT_executor_compile")
+                               - c_exec),
+                "generation": int(stat_get("STAT_generation_compile")
+                                  - c_gen),
+            }
+            out["hot_swap"] = {
+                "in_flight_during_swap": len(inflight),
+                "dropped_in_flight": dropped,
+                "flip_verified_via_modelz_http": flip_live,
+                "old_version_drained": z["models"]["fc"]["history"][-1]
+                ["state"] == "retired",
+                "steady_state_recompiles": recompiles,
+            }
+
+            # --- autoscaler: up under pressure, down after drain -----
+            slo.enable(bucket_s=0.25, n_buckets=480)
+            timeline = [door.model_status()["fc"]["workers"]["target"]]
+            decisions = []
+            try:
+                failpoints.arm_spec("serving.execute=delay(10)")
+                backlog = [door.submit("fc", feed(2))
+                           for _ in range(30)]
+                for _ in range(3):
+                    slo.evaluate()
+                    decisions += door.autoscale_once()
+                    timeline.append(
+                        door.model_status()["fc"]["workers"]["target"])
+            finally:
+                failpoints.disarm("all")
+            for f in backlog:
+                f.result(timeout=120.0)
+            for _ in range(8):
+                slo.evaluate()
+                decisions += door.autoscale_once()
+                timeline.append(
+                    door.model_status()["fc"]["workers"]["target"])
+            ups = [d for d in decisions if d["action"] == "scale_up"]
+            downs = [d for d in decisions
+                     if d["action"] == "scale_down"]
+            out["autoscaler"] = {
+                "signal_gauges": ["GAUGE_slo_queue_depth_trend",
+                                  "GAUGE_slo_tpot_saturation",
+                                  "GAUGE_slo_kv_block_headroom"],
+                "workers_timeline": timeline,
+                "scaled_up": len(ups),
+                "scaled_down": len(downs),
+                "sample_decision": dict(ups[0]) if ups else None,
+            }
+        finally:
+            door.close()
+            introspect.stop()
+            slo.disable()
+        out["int8_generation"] = {
+            "quant_mode": "int8", "kv_dtype": "int8",
+            "warm_tokens": len(lm_res.tokens),
+        }
+        out["gates"] = {
+            "hi_p95_speedup_ge_2x":
+                out["priority_overload"]["hi_p95_speedup_vs_fifo"]
+                >= 2.0,
+            "sheds_all_low_priority":
+                out["priority_overload"]["sheds_all_low_priority"],
+            "hi_met_deadline":
+                out["priority_overload"]["hi_met_deadline"],
+            "hot_swap_zero_dropped":
+                out["hot_swap"]["dropped_in_flight"] == 0
+                and out["hot_swap"]["flip_verified_via_modelz_http"],
+            "zero_steady_state_recompiles": all(
+                v == 0 for v in
+                out["hot_swap"]["steady_state_recompiles"].values()),
+            "autoscaler_up_and_down":
+                out["autoscaler"]["scaled_up"] > 0
+                and out["autoscaler"]["scaled_down"] > 0,
+        }
+        out["gates_pass"] = all(out["gates"].values())
+    finally:
+        set_flags(old_flags)
+        shutil.rmtree(model_dir, ignore_errors=True)
+    return out
+
+
 def _git(*args):
     try:
         p = subprocess.run(
@@ -3287,6 +3614,12 @@ def _run_worker(backend):
         # digest on/off real-gang heartbeat A/B, straggler drill
         # detection/page latency (ISSUE 18 — host-side, real on CPU)
         rec["gang_observability"] = bench_gang_observability()
+    if not os.environ.get("PT_SKIP_FRONTDOOR_BENCH"):
+        # multi-tenant multi-model front door: priority admission vs
+        # FIFO under overload, quota rejection, zero-drop hot-swap,
+        # autoscaler up+down off the /sloz signal gauges (ISSUE 20 —
+        # host-side scheduling, real on CPU)
+        rec["frontdoor"] = bench_frontdoor()
     # VERDICT Weak-#3: the FLOPs-accounting change (honest-MFU, module
     # docstring) redefined the vs_baseline denominator mid-trajectory
     rec["schema_note"] = (

@@ -90,6 +90,7 @@ from . import monitor  # noqa: F401
 from . import telemetry  # noqa: F401
 from . import tracing  # noqa: F401
 from . import introspect  # noqa: F401
+from . import frontdoor  # noqa: F401
 from . import flags as _flags_mod  # noqa: F401
 from .flags import set_flags, get_flags  # noqa: F401
 from .core.enforce import enforce, EnforceNotMet  # noqa: F401

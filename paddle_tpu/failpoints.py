@@ -122,6 +122,16 @@ KNOWN_SITES: Tuple[str, ...] = (
     # (counted in STAT_collective_quant_mp_fallbacks); the dp-axis
     # exchange of those shards keeps its configured wire
     "dist.collective_quant_mp",
+    # ISSUE 20: serving front door (frontdoor.py). `frontdoor.admit`
+    # fires at the top of FrontDoor.submit (a fault is counted as a
+    # shed with reason="admit_fault" and surfaces as a typed error —
+    # mis-routing chaos). `frontdoor.swap` fires during deploy() AFTER
+    # the new version warmed but BEFORE the atomic routing-pointer
+    # flip: a fault aborts the swap with the OLD version still serving,
+    # the pointer unflipped, and the warmed new pool retired cleanly
+    # (pinned by tests/test_frontdoor.py)
+    "frontdoor.admit",
+    "frontdoor.swap",
 )
 
 

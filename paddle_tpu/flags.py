@@ -340,6 +340,30 @@ _DEFS: Dict[str, Any] = {
     # degrade (drop detail, then drop the digest entirely) worker-side;
     # the supervisor independently rejects oversized lines
     "FLAGS_launch_digest_max_bytes": 1024,
+    # multi-tenant multi-model serving front door (frontdoor.py,
+    # docs/frontdoor.md). OFF by default: with the flag unset nothing
+    # routes through the front door, the pools serve exactly as before,
+    # and the disabled check (frontdoor.active() -> None) is one module
+    # global read — the same zero-overhead contract as
+    # FLAGS_request_tracing/FLAGS_failpoints/FLAGS_slo, pinned by test.
+    # Constructing a FrontDoor flips the flag on; close() restores it.
+    "FLAGS_frontdoor": False,
+    # per-endpoint admission-queue bound: past it submit() rejects
+    # immediately with ServingQueueFull (the front door never blocks —
+    # priority admission decides NOW, backpressure is the client's job)
+    "FLAGS_frontdoor_queue_depth": 64,
+    # dispatcher-thread (worker) bounds per endpoint: the autoscaler
+    # grows/shrinks the live worker count inside [min, max]
+    "FLAGS_frontdoor_workers_min": 1,
+    "FLAGS_frontdoor_workers_max": 4,
+    # autoscaler control loop: evaluation period, and the per-endpoint
+    # cooldown after any scale decision (hysteresis — no flapping)
+    "FLAGS_frontdoor_autoscale_interval_s": 2.0,
+    "FLAGS_frontdoor_scale_cooldown_s": 10.0,
+    # tenant token buckets: burst capacity = quota_rps * burst_s (a
+    # tenant may spend this much headroom instantly, then refills at
+    # its configured rate)
+    "FLAGS_frontdoor_quota_burst_s": 2.0,
     # straggler skew score above which a rank counts as a straggler
     # (score = per-rank windowed self step-time / gang lower-median;
     # see GAUGE_gang_straggler_score in docs/observability.md)

@@ -1,0 +1,1028 @@
+"""Multi-tenant multi-model serving front door (docs/frontdoor.md).
+
+ROADMAP item 1: every ingredient existed — supervised pools (PR 9),
+deadline budgets + /tracez (PR 8), per-tenant attribution + burn-rate
+objectives + autoscaling signal gauges (PR 11), int8/fp8 models for
+density (PR 15) — but each pool served exactly ONE model with FIFO
+admission. This module is the layer above them, in the TensorFlow-paper
+shape (PAPERS.md): the pools stay dumb executors; the front door owns
+routing, admission, deployment, and scaling.
+
+A :class:`FrontDoor` hosts MANY named model/version endpoints in one
+process, each a SerializedCore-backed :class:`serving.PredictorPool` or
+a GenerationEngine-backed :class:`generation.GenerationPool`, declared
+by a :class:`ModelCatalog` of :class:`EndpointSpec`s. Per endpoint:
+
+- **deadline- and priority-aware admission** replacing FIFO: requests
+  carry (tenant, priority, deadline). Admission sheds at the door when
+  the predicted completion (measured queue-wait + service distributions
+  from the windowed monitor when enabled, EWMAs otherwise) would burn
+  the deadline; dequeues strict-priority; and enforces per-tenant
+  token-bucket quotas. Every rejection is attributed:
+  ``STAT_frontdoor_shed{model,tenant,reason}``.
+- **graceful hot-swap**: ``deploy(name, version)`` warms the new
+  version off-path through the AOT program cache + autotune sidecar
+  (pool/engine warmup), flips the atomic routing pointer only after a
+  /readyz-style probe passes, then drains and retires the old pool —
+  in-flight requests finish on the OLD version (pool.close() contract,
+  pinned by test). An armed ``frontdoor.swap`` failpoint aborts BEFORE
+  the flip: old version keeps serving, new pool is retired.
+- **closed-loop autoscaler**: a control thread consumes the /sloz
+  signal gauges (``GAUGE_slo_queue_depth_trend``, ``tpot_saturation``,
+  ``kv_block_headroom``) plus per-endpoint depth to grow/shrink each
+  endpoint's dispatcher worker count within [min, max] under hysteresis
+  (consecutive-interval confirmation + cooldown). Every decision is a
+  trace event plus ``STAT_frontdoor_scale_{up,down}{model}``.
+
+Surfaces: ``/modelz`` (text + ``?format=json``) via :func:`modelz` /
+:func:`modelz_text`; a ``frontdoor`` section in ``/statusz``; labeled
+Prometheus series ``{model,version,tenant}`` (tracing.py flushes the
+per-request ones, this module the admission/scale ones); and default
+per-model SLOs (slo.install_frontdoor_objectives on registration,
+retracted on retirement).
+
+Gate: ``FLAGS_frontdoor`` (default OFF). The front door is opt-in —
+direct ``PredictorPool``/``GenerationPool`` construction stays fully
+supported (docs/MIGRATION.md). With the flag unset no FrontDoor exists
+and the disabled check — :func:`active` — is ONE module-global read,
+the same zero-overhead contract as tracing/failpoints/slo, pinned by
+test. Constructing a FrontDoor flips the flag on; close() restores it.
+
+Failpoint sites: ``frontdoor.admit`` (top of submit; a fault counts as
+a shed with reason="admit_fault") and ``frontdoor.swap`` (mid-deploy,
+pre-flip).
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .flags import get_flag, set_flags
+from .failpoints import failpoint, InjectedFault
+from . import monitor
+from .monitor import (gauge_set, labeled, stat_add, timer_observe,
+                      timer_window)
+from . import tracing as _tr
+from . import slo
+from .serving import (DeadlineBurned, PredictorPool, ServingQueueFull,
+                      _Future)
+
+__all__ = ["EndpointSpec", "ModelCatalog", "FrontDoor", "UnknownModel",
+           "QuotaExceeded", "SwapFailed", "active", "modelz",
+           "modelz_text", "status_summary"]
+
+_FD_LOCK = threading.Lock()
+# THE disabled-path pin: with FLAGS_frontdoor unset no FrontDoor is
+# ever constructed, and active() is exactly this one list read
+_ACTIVE_FD: List[Optional["FrontDoor"]] = [None]
+
+_SHED_REASONS = ("admit_fault", "quota", "deadline_predicted",
+                 "deadline_queue", "queue_full")
+
+
+def active() -> Optional["FrontDoor"]:
+    """The process's live FrontDoor, or None (the one-read fast path —
+    /modelz, /statusz, and any FLAGS_frontdoor-gated caller go through
+    here)."""
+    return _ACTIVE_FD[0]
+
+
+# ---------------------------------------------------------------------------
+# typed errors
+# ---------------------------------------------------------------------------
+
+class UnknownModel(KeyError):
+    """submit()/deploy() named an endpoint the front door does not
+    host (and, for deploy, the catalog has no spec for)."""
+
+
+class QuotaExceeded(RuntimeError):
+    """Per-tenant token bucket empty: the tenant is over its
+    requests/s quota for this model. `retry_after_s` is when one token
+    will have refilled — the client backoff hint, same contract as
+    ServingQueueFull."""
+
+    def __init__(self, msg: str, tenant: str = "",
+                 retry_after_s: float = 0.0):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+
+
+class SwapFailed(RuntimeError):
+    """deploy() aborted BEFORE the routing flip — warmup failed, the
+    readiness probe failed, or an armed frontdoor.swap failpoint fired.
+    The old version is still serving; the new pool was retired. `cause`
+    carries the underlying error."""
+
+    def __init__(self, msg: str, cause: Optional[BaseException] = None):
+        super().__init__(msg)
+        self.cause = cause
+
+
+# ---------------------------------------------------------------------------
+# catalog
+# ---------------------------------------------------------------------------
+
+@dataclass
+class EndpointSpec:
+    """One deployable model version. `kind` picks the pool family:
+
+    - "predictor": `model_dir` names an export_serialized() artifact
+      (loaded through serving_core.SerializedCore) — or `factory`
+      returns any Predictor-like object (run()/feed_names, optionally
+      warmup_buckets) — wrapped in a PredictorPool.
+    - "generation": `factory` returns a GenerationEngine (quant mode,
+      KV dtype etc. are the factory's business — `quant_mode` here is
+      catalog metadata shown on /modelz), wrapped in a GenerationPool.
+
+    `warmup_feeds` (predictor) / `warmup_buckets` (generation) drive
+    the off-path warmup a deploy runs before the routing flip; None
+    skips compile-ahead but still marks the pool warmed so the
+    readiness probe can pass (tests with dummy cores do this).
+    `tenant_quota_rps` maps tenant -> requests/s (0 = unlimited);
+    `default_quota_rps` applies to tenants not listed. `priority` is
+    the default priority class for requests that don't carry one."""
+    name: str
+    kind: str                       # "predictor" | "generation"
+    version: str = "v1"
+    model_dir: Optional[str] = None
+    factory: Optional[Callable[[], Any]] = None
+    quant_mode: Optional[str] = None
+    warmup_feeds: Optional[Any] = None
+    warmup_buckets: Optional[Any] = None
+    pool_kwargs: Dict[str, Any] = field(default_factory=dict)
+    queue_depth: Optional[int] = None      # front-door admission queue
+    workers: Optional[int] = None
+    workers_min: Optional[int] = None
+    workers_max: Optional[int] = None
+    tenant_quota_rps: Dict[str, float] = field(default_factory=dict)
+    default_quota_rps: float = 0.0
+    priority: int = 0
+
+    def __post_init__(self):
+        if self.kind not in ("predictor", "generation"):
+            raise ValueError("EndpointSpec kind must be 'predictor' or "
+                             "'generation', got %r" % (self.kind,))
+        if self.kind == "generation" and self.factory is None:
+            raise ValueError("generation EndpointSpec needs factory= "
+                             "(a callable returning a GenerationEngine)")
+        if self.kind == "predictor" and self.factory is None \
+                and self.model_dir is None:
+            raise ValueError("predictor EndpointSpec needs model_dir= "
+                             "(an export_serialized artifact) or "
+                             "factory=")
+
+
+class ModelCatalog:
+    """Declarative endpoint registry keyed (name, version). The front
+    door deploys from it; extra versions stay parked for later
+    deploy(name, version) hot-swaps."""
+
+    def __init__(self, specs: Optional[List[EndpointSpec]] = None):
+        self._specs: "Dict[Tuple[str, str], EndpointSpec]" = {}
+        self._order: List[Tuple[str, str]] = []
+        for s in specs or ():
+            self.add(s)
+
+    def add(self, spec: EndpointSpec) -> EndpointSpec:
+        key = (spec.name, spec.version)
+        if key not in self._specs:
+            self._order.append(key)
+        self._specs[key] = spec
+        return spec
+
+    def get(self, name: str, version: Optional[str] = None) \
+            -> EndpointSpec:
+        if version is not None:
+            try:
+                return self._specs[(name, version)]
+            except KeyError:
+                raise UnknownModel("no catalog entry %s@%s"
+                                   % (name, version))
+        for key in self._order:
+            if key[0] == name:
+                return self._specs[key]
+        raise UnknownModel("no catalog entry for model %r" % (name,))
+
+    def names(self) -> List[str]:
+        out: List[str] = []
+        for n, _ in self._order:
+            if n not in out:
+                out.append(n)
+        return out
+
+    def versions(self, name: str) -> List[str]:
+        return [v for n, v in self._order if n == name]
+
+
+# ---------------------------------------------------------------------------
+# internals: quotas, deployments, endpoints
+# ---------------------------------------------------------------------------
+
+class _TokenBucket:
+    """Per-(endpoint, tenant) requests/s quota. Refill-on-take; burst
+    capacity = rate * FLAGS_frontdoor_quota_burst_s. Called under the
+    endpoint lock."""
+
+    __slots__ = ("rate", "burst", "tokens", "t_last")
+
+    def __init__(self, rate: float, burst_s: float):
+        self.rate = float(rate)
+        self.burst = max(1.0, self.rate * burst_s)
+        self.tokens = self.burst
+        self.t_last = time.monotonic()
+
+    def take(self) -> Tuple[bool, float]:
+        now = time.monotonic()
+        self.tokens = min(self.burst, self.tokens
+                          + (now - self.t_last) * self.rate)
+        self.t_last = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True, 0.0
+        return False, (1.0 - self.tokens) / max(self.rate, 1e-9)
+
+
+class _Admitted:
+    """One admitted request parked in the priority queue."""
+
+    __slots__ = ("payload", "tenant", "priority", "deadline_s",
+                 "deadline_end", "timeout_end", "future", "t_enq")
+
+    def __init__(self, payload, tenant, priority, deadline, timeout):
+        self.payload = payload
+        self.tenant = tenant
+        self.priority = priority
+        self.future = _Future()
+        t0 = self.future.t_submit
+        self.t_enq = t0
+        self.deadline_s = deadline
+        self.deadline_end = None if deadline is None else t0 + deadline
+        self.timeout_end = None if timeout is None else t0 + timeout
+
+
+class _Deployment:
+    """One pool serving one (model, version). `state` walks
+    warming -> active -> draining -> retired; `aborted` marks a swap
+    that never reached active."""
+
+    __slots__ = ("spec", "version", "pool", "state", "t_deployed")
+
+    def __init__(self, spec: EndpointSpec, pool):
+        self.spec = spec
+        self.version = spec.version
+        self.pool = pool
+        self.state = "warming"
+        self.t_deployed = time.time()
+
+
+class _Endpoint:
+    """Admission queue + dispatcher workers + routing pointer for one
+    model name. `active` is the atomic routing pointer: dispatchers
+    read it once per request, deploy() replaces it under the lock, and
+    a request already dispatched keeps the deployment it read — that is
+    the whole in-flight-finishes-on-old-version guarantee."""
+
+    def __init__(self, spec: EndpointSpec):
+        self.name = spec.name
+        self.kind = spec.kind
+        self.spec = spec
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.heap: List[Tuple[int, int, _Admitted]] = []
+        self._seq = itertools.count()
+        self.active: Optional[_Deployment] = None
+        self.history: deque = deque(maxlen=8)   # retired deployments
+        self.buckets: Dict[str, _TokenBucket] = {}
+        # dispatcher workers: live shrinks lazily (a worker exits when
+        # it notices live > target), target is what the autoscaler moves
+        self.workers_min = int(spec.workers_min
+                               if spec.workers_min is not None
+                               else get_flag("FLAGS_frontdoor_workers_min"))
+        self.workers_max = int(spec.workers_max
+                               if spec.workers_max is not None
+                               else get_flag("FLAGS_frontdoor_workers_max"))
+        self.workers_target = min(self.workers_max, max(
+            self.workers_min, int(spec.workers if spec.workers is not None
+                                  else self.workers_min)))
+        self.workers_live = 0
+        self.queue_depth = int(
+            spec.queue_depth if spec.queue_depth is not None
+            else get_flag("FLAGS_frontdoor_queue_depth"))
+        # measured distributions for admission prediction (EWMA
+        # fallback when monitor windows are off)
+        self.ewma_wait_s = 0.0
+        self.ewma_service_s = 0.0
+        # autoscaler hysteresis state
+        self.t_last_scale = 0.0
+        self.down_streak = 0
+        self.decisions: deque = deque(maxlen=32)
+        # local mirrors of the labeled counters for /modelz (reading
+        # them back out of the registry would mean a scan per scrape)
+        self.n_requests = 0
+        self.n_routed = 0
+        self.n_swaps = 0
+        self.n_scale_up = 0
+        self.n_scale_down = 0
+        self.n_quota_rejected = 0
+        self.sheds: Dict[str, int] = {r: 0 for r in _SHED_REASONS}
+        # precomputed labeled instrument names (hot path pays no
+        # label-composition string work; _tenant_names precedent)
+        lbl = {"model": self.name}
+        self.s_requests = labeled("STAT_frontdoor_requests_total", lbl)
+        self.s_shed_total = labeled("STAT_frontdoor_shed_total", lbl)
+        self.t_queue_wait = labeled("TIMER_frontdoor_queue_wait_us", lbl)
+        self.t_total = labeled("TIMER_frontdoor_total_us", lbl)
+        self.g_depth = labeled("GAUGE_frontdoor_queue_depth", lbl)
+        self.g_workers = labeled("GAUGE_frontdoor_workers", lbl)
+
+    # --- quota ---------------------------------------------------------
+
+    def quota_take(self, tenant: str) -> Tuple[bool, float]:
+        """True = admitted. Unknown tenants get default_quota_rps;
+        rate 0 means unlimited (no bucket)."""
+        rate = self.spec.tenant_quota_rps.get(
+            tenant, self.spec.default_quota_rps)
+        if not rate:
+            return True, 0.0
+        b = self.buckets.get(tenant)
+        if b is None or b.rate != float(rate):
+            b = self.buckets[tenant] = _TokenBucket(
+                rate, float(get_flag("FLAGS_frontdoor_quota_burst_s")))
+        return b.take()
+
+    # --- admission prediction ------------------------------------------
+
+    def predicted_latency_s(self, depth: int) -> float:
+        """Predicted completion for a request admitted NOW: measured
+        queue-wait p95 over the last minute when windowed aggregation
+        is on (slo.enable), the admission EWMAs otherwise, plus one
+        service time — scaled by how much queue is ahead per worker."""
+        wait = serve = None
+        if monitor.windows_enabled():
+            w = timer_window(self.t_queue_wait, 60.0)
+            if w["count"]:
+                wait = w["p95"] / 1e6
+            s = timer_window(self.t_total, 60.0)
+            if s["count"]:
+                serve = max(0.0, s["p95"] / 1e6 - (wait or 0.0))
+        if wait is None:
+            wait = self.ewma_wait_s
+        if serve is None:
+            serve = self.ewma_service_s
+        ahead = depth / max(1, self.workers_target)
+        return max(wait, serve * ahead) + serve
+
+    def retry_after_s(self, depth: int) -> float:
+        per = max(self.ewma_service_s, 1e-3)
+        return per * max(1, depth) / max(1, self.workers_target)
+
+
+# ---------------------------------------------------------------------------
+# the front door
+# ---------------------------------------------------------------------------
+
+class FrontDoor:
+    """One process, many models: registration, admission, routing,
+    hot-swap, autoscaling. See the module docstring for semantics and
+    docs/frontdoor.md for the operational story."""
+
+    def __init__(self, catalog: Optional[ModelCatalog] = None, *,
+                 autoscale: bool = True, _start: bool = True):
+        self.catalog = catalog or ModelCatalog()
+        self._endpoints: Dict[str, _Endpoint] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._autoscale = bool(autoscale)
+        self._scaler: Optional[threading.Thread] = None
+        self._started = False
+        if _start:
+            self.start()
+
+    # --- lifecycle -----------------------------------------------------
+
+    def start(self) -> "FrontDoor":
+        """Deploy the first catalog version of every model, start the
+        autoscaler, publish as the process front door, and flip
+        FLAGS_frontdoor on (close() restores it — the slo.enable
+        precedent)."""
+        with _FD_LOCK:
+            if _ACTIVE_FD[0] is not None and _ACTIVE_FD[0] is not self:
+                raise RuntimeError(
+                    "another FrontDoor is already active in this "
+                    "process (close() it first)")
+            _ACTIVE_FD[0] = self
+        set_flags({"FLAGS_frontdoor": True})
+        self._started = True
+        for name in self.catalog.names():
+            if name not in self._endpoints:
+                self.deploy(name)
+        if self._autoscale and self._scaler is None:
+            self._scaler = threading.Thread(
+                target=self._autoscale_loop,
+                name="frontdoor-autoscaler", daemon=True)
+            self._scaler.start()
+        from . import introspect
+        introspect.maybe_start()
+        return self
+
+    def close(self) -> None:
+        """Retire every endpoint (drain pools, retract SLO objectives
+        and gauges), stop the autoscaler, and restore FLAGS_frontdoor."""
+        self._stop.set()
+        if self._scaler is not None:
+            self._scaler.join(timeout=30.0)
+            self._scaler = None
+        for name in list(self._endpoints):
+            self.remove(name)
+        with _FD_LOCK:
+            if _ACTIVE_FD[0] is self:
+                _ACTIVE_FD[0] = None
+        if self._started:
+            set_flags({"FLAGS_frontdoor": False})
+            self._started = False
+
+    def __enter__(self) -> "FrontDoor":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # --- registration / deployment -------------------------------------
+
+    def register(self, spec: EndpointSpec,
+                 deploy: bool = True) -> EndpointSpec:
+        """Add a spec to the catalog; deploy=True also brings it live
+        (first version of a new name) or hot-swaps (existing name)."""
+        self.catalog.add(spec)
+        if deploy:
+            self.deploy(spec.name, spec.version)
+        return spec
+
+    def deploy(self, name: str, version: Optional[str] = None) -> Dict:
+        """Bring a catalog version live. For a new model name this is
+        plain bring-up; for a hosted name it is the graceful hot-swap:
+        warm the new pool OFF-PATH (AOT program cache + autotune
+        sidecar do their work here), probe readiness, pass the
+        frontdoor.swap failpoint gate, THEN flip the routing pointer
+        and drain the old pool (in-flight requests finish on the old
+        version). Any failure before the flip raises SwapFailed with
+        the old version untouched."""
+        spec = self.catalog.get(name, version)
+        ep = self._endpoints.get(name)
+        swap = ep is not None and ep.active is not None
+        dep = self._build(spec)
+        try:
+            report = self._warm(dep)
+            if not self._ready(dep):
+                raise SwapFailed("%s@%s failed its readiness probe "
+                                 "after warmup" % (name, spec.version))
+            # chaos gate: a fault here must leave the OLD version
+            # serving and the pointer unflipped (pinned by test)
+            failpoint("frontdoor.swap")
+        except BaseException as e:
+            dep.state = "retired"
+            try:
+                dep.pool.close()
+            except Exception:
+                pass
+            if ep is not None:
+                ep.history.append(self._dep_record(dep, aborted=True))
+            stat_add(labeled("STAT_frontdoor_swap_aborted",
+                             {"model": name}))
+            if isinstance(e, SwapFailed):
+                raise
+            raise SwapFailed("deploy %s@%s aborted before the routing "
+                             "flip: %r" % (name, spec.version, e),
+                             cause=e)
+        if ep is None:
+            ep = _Endpoint(spec)
+            with self._lock:
+                self._endpoints[name] = ep
+            slo.install_frontdoor_objectives(name)
+        old: Optional[_Deployment] = None
+        with ep.lock:
+            old = ep.active
+            dep.state = "active"
+            ep.active = dep            # THE atomic routing flip
+            ep.spec = spec
+            if old is not None:
+                old.state = "draining"
+        self._ensure_workers(ep)
+        gauge_set(ep.g_workers, float(ep.workers_live))
+        if old is not None:
+            # drain: pool.close() completes queued + in-flight work on
+            # the old version by contract, then the worker exits
+            old.pool.close()
+            old.state = "retired"
+            ep.history.append(self._dep_record(old))
+            ep.n_swaps += 1
+            stat_add(labeled("STAT_frontdoor_swaps", {"model": name}))
+        return {"model": name, "version": spec.version,
+                "swapped_from": old.version if old else None,
+                "warmup": report}
+
+    def remove(self, name: str) -> None:
+        """Retire an endpoint: stop its workers, fail whatever is still
+        queued, drain the pool, uninstall its SLO objectives, and
+        retract its gauges (nothing keeps exporting for a model that no
+        longer exists)."""
+        with self._lock:
+            ep = self._endpoints.pop(name, None)
+        if ep is None:
+            return
+        with ep.lock:
+            ep.workers_target = 0
+            dep = ep.active
+            if dep is not None:
+                dep.state = "draining"
+            pending = [it for _, _, it in ep.heap]
+            ep.heap.clear()
+            ep.cond.notify_all()
+        for it in pending:
+            it.future._set_error(
+                RuntimeError("endpoint %r retired" % name))
+        deadline = time.monotonic() + 30.0
+        while ep.workers_live > 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        if dep is not None:
+            dep.pool.close()
+            dep.state = "retired"
+            ep.history.append(self._dep_record(dep))
+        slo.uninstall_frontdoor_objectives(name)
+        monitor.gauge_retract(ep.g_depth, ep.g_workers)
+
+    def endpoints(self) -> List[str]:
+        with self._lock:
+            return sorted(self._endpoints)
+
+    def _build(self, spec: EndpointSpec) -> _Deployment:
+        if spec.kind == "predictor":
+            if spec.factory is not None:
+                core = spec.factory()
+            else:
+                from .serving_core import SerializedCore
+                core = SerializedCore(spec.model_dir)
+            pool = PredictorPool(core, **spec.pool_kwargs)
+        else:
+            from .generation.scheduler import GenerationPool
+            engine = spec.factory()
+            pool = GenerationPool(engine, **spec.pool_kwargs)
+        return _Deployment(spec, pool)
+
+    def _warm(self, dep: _Deployment):
+        """Off-path compile-ahead for a deployment that is NOT yet
+        routed to. No warmup inputs declared -> no compile-ahead, but
+        the pool is still marked warmed so the readiness probe can
+        pass."""
+        spec = dep.spec
+        if spec.kind == "predictor":
+            if spec.warmup_feeds is not None:
+                return dep.pool.warmup(spec.warmup_feeds)
+            dep.pool._warmed = True
+            return None
+        eng = dep.pool.engine
+        if spec.warmup_buckets is not None or not getattr(
+                eng, "_warmed", False):
+            warm = getattr(eng, "warmup", None)
+            if warm is not None:
+                return warm(spec.warmup_buckets) \
+                    if spec.warmup_buckets is not None else warm()
+            eng._warmed = True
+        return None
+
+    @staticmethod
+    def _ready(dep: _Deployment) -> bool:
+        """The same predicate the pools register on /readyz."""
+        pool = dep.pool
+        if dep.spec.kind == "predictor":
+            return bool(pool._warmed and pool._healthy)
+        return bool(getattr(pool.engine, "_warmed", False)
+                    and pool._healthy)
+
+    @staticmethod
+    def _dep_record(dep: _Deployment, aborted: bool = False) -> Dict:
+        rec = {"version": dep.version, "state": dep.state,
+               "t_deployed": dep.t_deployed}
+        if dep.spec.quant_mode:
+            rec["quant_mode"] = dep.spec.quant_mode
+        if aborted:
+            rec["aborted"] = True
+        return rec
+
+    # --- admission -----------------------------------------------------
+
+    def submit(self, model: str, payload, *,
+               tenant: Optional[str] = None,
+               priority: Optional[int] = None,
+               deadline: Optional[float] = None,
+               timeout: Optional[float] = None) -> _Future:
+        """Admit one request for `model` (feeds list for a predictor
+        endpoint, GenerationRequest for a generation one). Returns a
+        future with .result(timeout). Admission decides NOW — the front
+        door never blocks the caller:
+
+        - tenant over its token-bucket quota -> QuotaExceeded
+          (retry_after_s = one token's refill);
+        - deadline set and the measured queue-wait/service
+          distributions predict completion past it -> DeadlineBurned
+          (shedding at the door is strictly better than queueing work
+          nobody will wait for);
+        - admission queue at its bound -> ServingQueueFull immediately
+          (queue_depth + retry_after_s, the PR-9 backpressure
+          contract).
+
+        Every rejection bumps STAT_frontdoor_shed{model,tenant,reason}.
+        Dequeue is strict-priority (higher first; FIFO within a
+        class)."""
+        with self._lock:
+            ep = self._endpoints.get(model)
+        if ep is None:
+            raise UnknownModel("front door hosts no model %r "
+                               "(endpoints: %s)"
+                               % (model, self.endpoints()))
+        stat_add(ep.s_requests)
+        tn = tenant or ""
+        try:
+            failpoint("frontdoor.admit")
+        except InjectedFault:
+            with ep.lock:
+                ep.n_requests += 1
+            self._shed(ep, tn, "admit_fault")
+            raise
+        prio = ep.spec.priority if priority is None else int(priority)
+        item = _Admitted(payload, tenant, prio, deadline, timeout)
+        with ep.lock:
+            ep.n_requests += 1
+            ok, wait_s = ep.quota_take(tn)
+            if not ok:
+                ep.n_quota_rejected += 1
+                stat_add(labeled("STAT_frontdoor_quota_rejected",
+                                 {"model": model, "tenant": tn}))
+                self._shed_locked(ep, tn, "quota")
+                raise QuotaExceeded(
+                    "tenant %r over its %s quota (%.3g rps); retry in "
+                    "%.3fs" % (tn, model, ep.spec.tenant_quota_rps.get(
+                        tn, ep.spec.default_quota_rps), wait_s),
+                    tenant=tn, retry_after_s=wait_s)
+            depth = len(ep.heap)
+            if deadline is not None:
+                predicted = ep.predicted_latency_s(depth)
+                if predicted >= deadline:
+                    self._shed_locked(ep, tn, "deadline_predicted")
+                    raise DeadlineBurned(
+                        "predicted completion %.3fs burns the %.3fs "
+                        "deadline (depth %d, %d workers) — shed at "
+                        "admit" % (predicted, deadline, depth,
+                                   ep.workers_target))
+            if depth >= ep.queue_depth:
+                self._shed_locked(ep, tn, "queue_full")
+                raise ServingQueueFull(
+                    "front-door queue for %s full (depth %d)"
+                    % (model, depth), queue_depth=depth,
+                    retry_after_s=ep.retry_after_s(depth))
+            heapq.heappush(ep.heap, (-prio, next(ep._seq), item))
+            gauge_set(ep.g_depth, float(len(ep.heap)))
+            ep.cond.notify()
+        return item.future
+
+    def run(self, model: str, payload, *, tenant: Optional[str] = None,
+            priority: Optional[int] = None,
+            deadline: Optional[float] = None,
+            timeout: Optional[float] = None):
+        """Blocking submit+wait; `timeout` is ONE budget shared by
+        admission and the result wait (the pools' run() contract)."""
+        if timeout is None:
+            return self.submit(model, payload, tenant=tenant,
+                               priority=priority,
+                               deadline=deadline).result()
+        t_end = time.monotonic() + timeout
+        fut = self.submit(model, payload, tenant=tenant,
+                          priority=priority, deadline=deadline,
+                          timeout=timeout)
+        return fut.result(max(0.0, t_end - time.monotonic()))
+
+    def _shed(self, ep: _Endpoint, tenant: str, reason: str) -> None:
+        with ep.lock:
+            self._shed_locked(ep, tenant, reason)
+
+    @staticmethod
+    def _shed_locked(ep: _Endpoint, tenant: str, reason: str) -> None:
+        ep.sheds[reason] = ep.sheds.get(reason, 0) + 1
+        stat_add(labeled("STAT_frontdoor_shed",
+                         {"model": ep.name, "tenant": tenant,
+                          "reason": reason}))
+        stat_add(ep.s_shed_total)
+
+    # --- dispatch ------------------------------------------------------
+
+    def _ensure_workers(self, ep: _Endpoint) -> None:
+        with ep.lock:
+            n = ep.workers_target - ep.workers_live
+            ep.workers_live += max(0, n)
+            gauge_set(ep.g_workers, float(ep.workers_live))
+        for _ in range(max(0, n)):
+            threading.Thread(target=self._dispatch_loop, args=(ep,),
+                             name="frontdoor-%s" % ep.name,
+                             daemon=True).start()
+
+    def _dispatch_loop(self, ep: _Endpoint) -> None:
+        """One dispatcher worker: pop the highest-priority admitted
+        request, read the routing pointer ONCE, and route into that
+        deployment's pool (which does its own batching/continuous
+        batching). The worker count is what the autoscaler moves."""
+        while True:
+            with ep.cond:
+                while not ep.heap and not self._stop.is_set() \
+                        and ep.workers_live <= ep.workers_target:
+                    ep.cond.wait(0.1)
+                if self._stop.is_set() \
+                        or ep.workers_live > ep.workers_target:
+                    ep.workers_live -= 1
+                    gauge_set(ep.g_workers, float(ep.workers_live))
+                    return
+                _, _, item = heapq.heappop(ep.heap)
+                gauge_set(ep.g_depth, float(len(ep.heap)))
+                dep = ep.active
+            now = time.monotonic()
+            wait_s = now - item.t_enq
+            timer_observe(ep.t_queue_wait, wait_s * 1e6)
+            with ep.lock:
+                ep.ewma_wait_s += 0.2 * (wait_s - ep.ewma_wait_s)
+            if item.deadline_end is not None \
+                    and now >= item.deadline_end:
+                self._shed(ep, item.tenant or "", "deadline_queue")
+                item.future._set_error(DeadlineBurned(
+                    "deadline (%.3fs) burned in the front-door queue "
+                    "(waited %.3fs)" % (item.deadline_s, wait_s)))
+                continue
+            ends = [e for e in (item.deadline_end, item.timeout_end)
+                    if e is not None]
+            remaining = min(ends) - now if ends else None
+            rem_deadline = (item.deadline_end - now
+                            if item.deadline_end is not None else None)
+            try:
+                out = dep.pool.run(
+                    item.payload, timeout=remaining,
+                    deadline=rem_deadline, tenant=item.tenant,
+                    model=ep.name, version=dep.version)
+            except BaseException as e:
+                item.future._set_error(e)
+                continue
+            t_total = time.monotonic() - item.t_enq
+            timer_observe(ep.t_total, t_total * 1e6)
+            with ep.lock:
+                ep.n_routed += 1
+                ep.ewma_service_s += 0.2 * ((t_total - wait_s)
+                                            - ep.ewma_service_s)
+            stat_add(labeled("STAT_frontdoor_routed",
+                             {"model": ep.name,
+                              "version": dep.version}))
+            item.future._set(out)
+
+    # --- autoscaler ----------------------------------------------------
+
+    def set_workers(self, model: str, n: int) -> None:
+        """Manual override inside [min, max]; spawns/retires
+        dispatcher workers immediately."""
+        with self._lock:
+            ep = self._endpoints.get(model)
+        if ep is None:
+            raise UnknownModel("front door hosts no model %r" % model)
+        with ep.lock:
+            ep.workers_target = min(ep.workers_max,
+                                    max(ep.workers_min, int(n)))
+            ep.cond.notify_all()
+        self._ensure_workers(ep)
+
+    def _autoscale_loop(self) -> None:
+        interval = float(
+            get_flag("FLAGS_frontdoor_autoscale_interval_s") or 2.0)
+        while not self._stop.wait(interval):
+            try:
+                self.autoscale_once()
+            except Exception:
+                stat_add("STAT_frontdoor_autoscale_errors")
+
+    def autoscale_once(self, now: Optional[float] = None) -> List[Dict]:
+        """One control-loop evaluation over every endpoint (the thread
+        calls this every FLAGS_frontdoor_autoscale_interval_s; tests
+        and benches call it directly for determinism). Inputs are the
+        /sloz signal gauges — GAUGE_slo_queue_depth_trend for the
+        endpoint's pool family, GAUGE_slo_tpot_saturation and
+        GAUGE_slo_kv_block_headroom for generation — plus the
+        endpoint's own queue depth. Decisions:
+
+        - UP when the queue runs deeper than 2x the workers with a
+          non-falling trend, or (generation) TPOT p95 is past its
+          budget — VETOED when KV headroom is under 10% (more decode
+          concurrency with no blocks just thrashes the KV pool);
+        - DOWN when the queue is empty with a non-rising trend (and,
+          for generation, TPOT comfortably inside budget), confirmed
+          over >= 2 consecutive intervals (hysteresis);
+        - every decision respects [workers_min, workers_max] and the
+          FLAGS_frontdoor_scale_cooldown_s per-endpoint cooldown, and
+          is recorded as a trace event + STAT_frontdoor_scale_{up,down}.
+        """
+        if now is None:
+            now = time.monotonic()
+        cooldown = float(
+            get_flag("FLAGS_frontdoor_scale_cooldown_s") or 0.0)
+        with self._lock:
+            eps = list(self._endpoints.values())
+        out = []
+        for ep in eps:
+            pool_family = ("serving" if ep.kind == "predictor"
+                           else "generation")
+            trend = monitor.gauge_get(labeled(
+                "GAUGE_slo_queue_depth_trend", {"pool": pool_family}))
+            sat = monitor.gauge_get("GAUGE_slo_tpot_saturation")
+            headroom = monitor.gauge_get("GAUGE_slo_kv_block_headroom",
+                                         1.0)
+            with ep.lock:
+                depth = len(ep.heap)
+                workers = ep.workers_target
+            gen = ep.kind == "generation"
+            pressed = depth > 2 * workers and trend >= 0.0
+            saturated = gen and sat > 1.0
+            idle = depth == 0 and trend <= 0.0 \
+                and (not gen or sat < 0.5)
+            decision = None
+            if (pressed or saturated) and workers < ep.workers_max:
+                if gen and headroom < 0.1:
+                    decision = self._decide(
+                        ep, "up_vetoed_kv", workers, workers, now,
+                        depth=depth, trend=trend, tpot_saturation=sat,
+                        kv_block_headroom=headroom)
+                elif now - ep.t_last_scale >= cooldown:
+                    decision = self._scale(
+                        ep, workers + 1, "up", now, depth=depth,
+                        trend=trend, tpot_saturation=sat)
+            elif idle and workers > ep.workers_min:
+                ep.down_streak += 1
+                if ep.down_streak >= 2 \
+                        and now - ep.t_last_scale >= cooldown:
+                    decision = self._scale(
+                        ep, workers - 1, "down", now, depth=depth,
+                        trend=trend, tpot_saturation=sat)
+            if not idle:
+                ep.down_streak = 0
+            if decision is not None:
+                out.append(decision)
+        return out
+
+    def _decide(self, ep: _Endpoint, action: str, n_from: int,
+                n_to: int, now: float, **fields) -> Dict:
+        rec = dict(action=action, workers_from=n_from, workers_to=n_to,
+                   t=time.time(), **{k: round(float(v), 4)
+                                     for k, v in fields.items()})
+        ep.decisions.append(rec)
+        # every decision is a trace event (the /tracez audit trail for
+        # "why did the worker count move")
+        tr = _tr.begin("frontdoor")
+        tr.event("autoscale", model=ep.name, **rec)
+        tr.finish()
+        return dict(rec, model=ep.name)
+
+    def _scale(self, ep: _Endpoint, target: int, direction: str,
+               now: float, **fields) -> Dict:
+        with ep.lock:
+            n_from = ep.workers_target
+            ep.workers_target = min(ep.workers_max,
+                                    max(ep.workers_min, target))
+            ep.t_last_scale = now
+            ep.down_streak = 0
+            ep.cond.notify_all()
+        self._ensure_workers(ep)
+        if direction == "up":
+            ep.n_scale_up += 1
+            stat_add(labeled("STAT_frontdoor_scale_up",
+                             {"model": ep.name}))
+        else:
+            ep.n_scale_down += 1
+            stat_add(labeled("STAT_frontdoor_scale_down",
+                             {"model": ep.name}))
+        return self._decide(ep, "scale_" + direction, n_from,
+                            ep.workers_target, now, **fields)
+
+    # --- surfaces ------------------------------------------------------
+
+    def model_status(self) -> Dict[str, Any]:
+        with self._lock:
+            eps = dict(self._endpoints)
+        models = {}
+        for name, ep in sorted(eps.items()):
+            with ep.lock:
+                dep = ep.active
+                models[name] = {
+                    "kind": ep.kind,
+                    "active_version": dep.version if dep else None,
+                    "state": dep.state if dep else "none",
+                    "quant_mode": ep.spec.quant_mode,
+                    "catalog_versions": self.catalog.versions(name),
+                    "queue_depth": len(ep.heap),
+                    "queue_bound": ep.queue_depth,
+                    "workers": {"live": ep.workers_live,
+                                "target": ep.workers_target,
+                                "min": ep.workers_min,
+                                "max": ep.workers_max},
+                    "quotas": {"tenants": dict(ep.spec.tenant_quota_rps),
+                               "default_rps": ep.spec.default_quota_rps},
+                    "counters": {
+                        "requests": ep.n_requests,
+                        "routed": ep.n_routed,
+                        "shed": {k: v for k, v in ep.sheds.items()
+                                 if v},
+                        "quota_rejected": ep.n_quota_rejected,
+                        "swaps": ep.n_swaps,
+                        "scale_up": ep.n_scale_up,
+                        "scale_down": ep.n_scale_down,
+                    },
+                    "ewma": {"queue_wait_s":
+                             round(ep.ewma_wait_s, 6),
+                             "service_s":
+                             round(ep.ewma_service_s, 6)},
+                    "history": list(ep.history),
+                    "decisions": list(ep.decisions)[-8:],
+                }
+        return models
+
+
+# ---------------------------------------------------------------------------
+# /modelz + /statusz payloads (introspect.py serves these)
+# ---------------------------------------------------------------------------
+
+def modelz() -> Dict[str, Any]:
+    """The ``/modelz?format=json`` payload."""
+    fd = active()
+    if fd is None:
+        return {"enabled": False, "models": {}}
+    return {"enabled": True, "autoscale": fd._autoscale,
+            "models": fd.model_status()}
+
+
+def modelz_text() -> str:
+    """Human ``/modelz``: one block per hosted model — routing state,
+    workers, quotas, shed/scale counters, recent autoscale decisions."""
+    z = modelz()
+    if not z["enabled"]:
+        return ("frontdoor: disabled (construct a "
+                "paddle_tpu.frontdoor.FrontDoor to host models; "
+                "docs/frontdoor.md)\n")
+    lines = ["frontdoor: enabled (FLAGS_frontdoor=on, autoscale=%s)"
+             % ("on" if z["autoscale"] else "off"), ""]
+    for name, m in z["models"].items():
+        w = m["workers"]
+        head = "%s@%s [%s, %s]" % (name, m["active_version"],
+                                   m["kind"], m["state"])
+        if m.get("quant_mode"):
+            head += " quant=%s" % m["quant_mode"]
+        lines.append(head)
+        lines.append("    versions: %s"
+                     % " ".join(m["catalog_versions"]))
+        lines.append("    queue %d/%d  workers %d/%d (min %d max %d)"
+                     % (m["queue_depth"], m["queue_bound"], w["live"],
+                        w["target"], w["min"], w["max"]))
+        c = m["counters"]
+        shed = " ".join("%s=%d" % kv
+                        for kv in sorted(c["shed"].items())) or "none"
+        lines.append("    requests=%d routed=%d swaps=%d "
+                     "scale_up=%d scale_down=%d"
+                     % (c["requests"], c["routed"], c["swaps"],
+                        c["scale_up"], c["scale_down"]))
+        lines.append("    shed: %s  quota_rejected=%d"
+                     % (shed, c["quota_rejected"]))
+        q = m["quotas"]
+        if q["tenants"] or q["default_rps"]:
+            lines.append("    quotas: %s default=%grps" % (
+                " ".join("%s=%grps" % kv
+                         for kv in sorted(q["tenants"].items()))
+                or "(none)", q["default_rps"]))
+        for d in m["decisions"]:
+            lines.append("    autoscale %-14s %d->%d" % (
+                d["action"], d["workers_from"], d["workers_to"]))
+        lines.append("")
+    return "\n".join(lines)
+
+
+def status_summary() -> Dict[str, Any]:
+    """Compact frontdoor section for /statusz."""
+    fd = active()
+    if fd is None:
+        return {"enabled": False}
+    models = fd.model_status()
+    return {
+        "enabled": True,
+        "models": {n: {"version": m["active_version"],
+                       "kind": m["kind"], "state": m["state"],
+                       "queue_depth": m["queue_depth"],
+                       "workers": m["workers"]["live"]}
+                   for n, m in models.items()},
+    }

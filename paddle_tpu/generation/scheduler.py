@@ -152,7 +152,9 @@ class GenerationPool:
     def submit(self, req: GenerationRequest,
                timeout: Optional[float] = None,
                deadline: Optional[float] = None,
-               tenant: Optional[str] = None) -> _Future:
+               tenant: Optional[str] = None,
+               model: Optional[str] = None,
+               version: Optional[str] = None) -> _Future:
         """Enqueue one request; returns a future whose .result() is a
         GenerationResult. Blocks while the queue is full, then raises
         ServingQueueFull — the same backpressure contract as
@@ -160,10 +162,13 @@ class GenerationPool:
         (seconds) on the request's trace: STAT_generation_deadline_missed
         + per-stage budget burn when blown (never cancels). `tenant`
         attributes the request to a workload (labeled per-tenant
-        series at finish; /tracez?tenant= filter)."""
+        series at finish; /tracez?tenant= filter). `model`/`version`
+        stamp front-door routing identity ({model,version}-labeled
+        series at finish — frontdoor.py sets them)."""
         fut = _Future()
         fut.trace = _tr.begin("generation", deadline=deadline,
-                              tenant=tenant)
+                              tenant=tenant, model=model,
+                              version=version)
         # ONE shared budget: the enqueue wait is bounded by timeout AND
         # by the request's own deadline (serving.PredictorPool.submit
         # has the same contract)
@@ -221,16 +226,18 @@ class GenerationPool:
     def run(self, req: GenerationRequest,
             timeout: Optional[float] = None,
             deadline: Optional[float] = None,
-            tenant: Optional[str] = None):
+            tenant: Optional[str] = None,
+            model: Optional[str] = None,
+            version: Optional[str] = None):
         """Blocking submit+wait. `timeout` is ONE budget shared by the
         enqueue wait and the result wait (it used to be handed to both,
         so a 1 s budget could block ~2 s)."""
         if timeout is None:
-            return self.submit(req, deadline=deadline,
-                               tenant=tenant).result()
+            return self.submit(req, deadline=deadline, tenant=tenant,
+                               model=model, version=version).result()
         t_end = time.monotonic() + timeout
         fut = self.submit(req, timeout=timeout, deadline=deadline,
-                          tenant=tenant)
+                          tenant=tenant, model=model, version=version)
         return fut.result(max(0.0, t_end - time.monotonic()))
 
     # --- worker --------------------------------------------------------

@@ -30,6 +30,10 @@ code could. Endpoints:
                  fast/slow burn rates and alert state, autoscaling
                  signals, per-tenant accounting (text;
                  ``?format=json`` for the raw payload)
+- ``/modelz``    serving front door (frontdoor.py, FLAGS_frontdoor):
+                 per-model versions, routing state, quota/shed/scale
+                 counters, recent autoscale decisions (text;
+                 ``?format=json`` for the raw payload)
 - ``/failpointz`` fault injection (failpoints.py, docs/robustness.md):
                  GET lists every known site with its armed spec and
                  calls/fires hit counts; POST arms
@@ -219,6 +223,7 @@ def statusz() -> Dict[str, Any]:
         "gangs": _gang_status(),
         "tracing": _tracing_status(counters),
         "slo": _slo_status(),
+        "frontdoor": _frontdoor_status(),
         "failpoints_armed": _armed_failpoints(),
         "readiness": {"ready": ready, "checks": checks},
     }
@@ -339,6 +344,14 @@ def _slo_status() -> Dict[str, Any]:
     return slo.status_summary()
 
 
+def _frontdoor_status() -> Dict[str, Any]:
+    """The /statusz "frontdoor" section (frontdoor.status_summary:
+    enabled + per-model routing/worker/queue one-liners; /modelz has
+    the full view)."""
+    from . import frontdoor
+    return frontdoor.status_summary()
+
+
 def _armed_failpoints() -> Dict[str, str]:
     """site -> armed spec, armed sites only (/failpointz has the full
     table with hit counts)."""
@@ -441,6 +454,14 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._send(200, slo.sloz_text(),
                                "text/plain; charset=utf-8")
+            elif url.path == "/modelz":
+                from . import frontdoor
+                q = parse_qs(url.query)
+                if q.get("format", [""])[0] == "json":
+                    self._json(frontdoor.modelz())
+                else:
+                    self._send(200, frontdoor.modelz_text(),
+                               "text/plain; charset=utf-8")
             elif url.path == "/flightz":
                 from . import telemetry
                 q = parse_qs(url.query)
@@ -468,7 +489,7 @@ class _Handler(BaseHTTPRequestHandler):
                     200,
                     "paddle_tpu introspection: /metrics /healthz "
                     "/readyz /statusz /flightz /programz /tracez "
-                    "/sloz /failpointz /workerz /gangz\n",
+                    "/sloz /modelz /failpointz /workerz /gangz\n",
                     "text/plain; charset=utf-8")
             else:
                 self._send(404, "not found: %s\n" % url.path,

@@ -538,6 +538,23 @@ def gauge_get(name: str, default: float = 0.0) -> float:
         return _GAUGES.get(name, default)
 
 
+def gauge_retract(*names: str) -> int:
+    """Remove gauges from the registry (and /metrics) by exact name.
+
+    Gauges normally only accrete; retraction is for lifecycle events
+    where a series must STOP being exported rather than freeze at its
+    last value — e.g. slo.py retiring a front-door endpoint's objective
+    gauges, or pools resetting per-request KV gauges. Returns how many
+    of the given names were present and removed.
+    """
+    removed = 0
+    with _LOCK:
+        for n in names:
+            if _GAUGES.pop(n, None) is not None:
+                removed += 1
+    return removed
+
+
 # ---------------------------------------------------------------------------
 # timers (latency histograms)
 # ---------------------------------------------------------------------------

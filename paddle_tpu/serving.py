@@ -299,7 +299,9 @@ class PredictorPool:
 
     def submit(self, feeds: Sequence, timeout: Optional[float] = None,
                deadline: Optional[float] = None,
-               tenant: Optional[str] = None):
+               tenant: Optional[str] = None,
+               model: Optional[str] = None,
+               version: Optional[str] = None):
         """Enqueue one request; returns a future with .result(timeout).
         Blocks while the queue is at FLAGS_predictor_queue_depth, then
         raises ServingQueueFull (timeout=None blocks indefinitely).
@@ -309,7 +311,9 @@ class PredictorPool:
         per stage (it does NOT cancel the request). `tenant` attributes
         the request to a workload: its trace and the labeled per-tenant
         counter/timer series (slo.tenants(), /tracez?tenant=) carry
-        it."""
+        it. `model`/`version` stamp front-door routing identity on the
+        trace, flushing {model,version}-labeled series at finish
+        (frontdoor.py sets them; direct callers may too)."""
         arrs = [np.asarray(v) for v in feeds]
         names = self.predictor.feed_names
         if len(arrs) != len(names):
@@ -324,7 +328,8 @@ class PredictorPool:
         req = _Request(arrs, rows.pop(), _request_sig(arrs))
         if req.rows == 0:
             raise ValueError("empty-batch request")
-        tr = _tr.begin("serving", deadline=deadline, tenant=tenant)
+        tr = _tr.begin("serving", deadline=deadline, tenant=tenant,
+                       model=model, version=version)
         req.future.trace = tr
         tr.note(rows=req.rows)
         # ONE shared budget (PR 8 contract, extended): the enqueue wait
@@ -395,17 +400,19 @@ class PredictorPool:
 
     def run(self, feeds: Sequence, timeout: Optional[float] = None,
             deadline: Optional[float] = None,
-            tenant: Optional[str] = None) -> List[np.ndarray]:
+            tenant: Optional[str] = None,
+            model: Optional[str] = None,
+            version: Optional[str] = None) -> List[np.ndarray]:
         """Blocking submit+wait — the thread-safe drop-in for
         Predictor.run(feeds). `timeout` is ONE budget shared by the
         enqueue wait and the result wait (it used to be handed to both,
         so a 1 s budget could block ~2 s)."""
         if timeout is None:
-            return self.submit(feeds, deadline=deadline,
-                               tenant=tenant).result()
+            return self.submit(feeds, deadline=deadline, tenant=tenant,
+                               model=model, version=version).result()
         t_end = time.monotonic() + timeout
         fut = self.submit(feeds, timeout=timeout, deadline=deadline,
-                          tenant=tenant)
+                          tenant=tenant, model=model, version=version)
         return fut.result(max(0.0, t_end - time.monotonic()))
 
     # --- batcher -------------------------------------------------------

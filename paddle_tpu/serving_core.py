@@ -258,6 +258,49 @@ class SerializedCore:
             placed[k] = jax.device_put(v, NamedSharding(self._mesh, spec))
         return placed
 
+    def warmup_buckets(self, example_feeds, max_bucket=None):
+        """Compile-ahead: run one zero-filled batch per serving shape so
+        the first real request of any bucketed size hits a warm XLA
+        executable (the compiles land in the persistent cache enabled at
+        load). The counterpart of Predictor.warmup_buckets with the same
+        report shape ({bucket: {"seconds"} | {"error"}}), which is what
+        lets serving.PredictorPool.warmup — and the front door's
+        hot-swap warmup (frontdoor.py) — treat a SerializedCore like a
+        Predictor. For a dynamic_batch export the targets are the env
+        bucket ladder (PADDLE_TPU_SHAPE_BUCKETS, capped by
+        `max_bucket`); for a static export the single compiled batch is
+        warmed. Numpy-only on purpose — this file ships inside the
+        artifact."""
+        if len(example_feeds) != len(self.feed_names):
+            raise ValueError("expected %d example feeds (%s), got %d"
+                             % (len(self.feed_names), self.feed_names,
+                                len(example_feeds)))
+        examples = [np.asarray(v) for v in example_feeds]
+        kinds = set((self._batch_spec or {}).values())
+        if kinds == {"dyn"}:
+            targets = _bucket_ladder()
+            if max_bucket is not None:
+                targets = [b for b in targets if b <= max_bucket] \
+                    or targets[:1]
+        elif kinds and "dyn" not in kinds and len(kinds) == 1:
+            targets = [kinds.pop()]
+        else:
+            targets = [max(1, next((v.shape[0] for v in examples
+                                    if v.ndim), 1))]
+        import time as _time
+        report = {}
+        for bkt in targets:
+            feeds = [np.zeros((bkt,) + v.shape[1:], v.dtype)
+                     if v.ndim else v for v in examples]
+            t0 = _time.monotonic()
+            try:
+                self.run(feeds)
+                report[bkt] = {"seconds":
+                               round(_time.monotonic() - t0, 4)}
+            except Exception as e:  # partial warmup stays usable
+                report[bkt] = {"error": repr(e)}
+        return report
+
     # --- flat-ABI helpers for the C API --------------------------------
     @staticmethod
     def dtype_code(arr) -> int:

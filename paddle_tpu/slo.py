@@ -195,6 +195,72 @@ def install_gang_objectives(fast_window_s: float = 60.0,
                     "skew score above the straggler threshold"))
 
 
+def install_frontdoor_objectives(model: str,
+                                 latency_target: float = 0.95,
+                                 latency_threshold_us: float = 250_000.0,
+                                 shed_ratio_target: float = 0.95,
+                                 **overrides) -> List[Objective]:
+    """Default per-model front-door SLOs (frontdoor.py registers an
+    endpoint → these two objectives appear; docs/frontdoor.md):
+
+    - ``frontdoor_<model>_p95``: latency objective over the model's
+      TIMER_frontdoor_total_us{model=...} series (admission queue wait
+      + pool service, the latency a front-door client actually sees);
+    - ``frontdoor_<model>_shed``: shed-ratio objective over
+      STAT_frontdoor_shed_total{model=...} /
+      STAT_frontdoor_requests_total{model=...} — by default < 5% of a
+      model's requests shed (deadline predicted burned, quota, or
+      queue full).
+
+    ``overrides`` pass through to both Objectives (window_s, burns...).
+    Idempotent by name, like install_default_objectives."""
+    lbl = {"model": model}
+    return [
+        register(Objective(
+            name="frontdoor_%s_p95" % model, kind="latency",
+            target=latency_target,
+            timer=labeled("TIMER_frontdoor_total_us", lbl),
+            threshold_us=latency_threshold_us,
+            description="%d%% of %r front-door requests complete in "
+                        "< %dms" % (round(latency_target * 100), model,
+                                    round(latency_threshold_us / 1e3)),
+            **overrides)),
+        register(Objective(
+            name="frontdoor_%s_shed" % model, kind="ratio",
+            target=shed_ratio_target,
+            bad=labeled("STAT_frontdoor_shed_total", lbl),
+            total=labeled("STAT_frontdoor_requests_total", lbl),
+            description="< %d%% of %r front-door requests shed"
+                        % (round((1 - shed_ratio_target) * 100), model),
+            **overrides)),
+    ]
+
+
+def uninstall_frontdoor_objectives(model: str) -> None:
+    """Retire a model's front-door objectives AND retract their
+    exported gauges. Satellite of ISSUE 20: objective gauges used to
+    only accrete — a retired endpoint's burn-rate/budget/alert series
+    would freeze at their last values on /metrics forever, which reads
+    as a live (possibly firing) alert for a model that no longer
+    exists."""
+    for name in ("frontdoor_%s_p95" % model,
+                 "frontdoor_%s_shed" % model):
+        unregister(name)
+        _retract_objective_gauges(name)
+
+
+def _retract_objective_gauges(objective: str) -> None:
+    """Drop the gauges _eval_objective exports for one objective name
+    (monitor.gauge_retract — the series stop appearing on /metrics
+    rather than freezing at their last value)."""
+    olbl = {"objective": objective}
+    monitor.gauge_retract(
+        labeled("GAUGE_slo_burn_rate", dict(olbl, window="fast")),
+        labeled("GAUGE_slo_burn_rate", dict(olbl, window="slow")),
+        labeled("GAUGE_slo_error_budget_remaining", olbl),
+        labeled("GAUGE_slo_alert_firing", olbl))
+
+
 # ---------------------------------------------------------------------------
 # activation (FLAGS_slo side-effect wiring, failpoints precedent)
 # ---------------------------------------------------------------------------

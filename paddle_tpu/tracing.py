@@ -156,6 +156,46 @@ def _tenant_names(kind: str,
         return got
 
 
+# per-model attribution (frontdoor.py, docs/frontdoor.md): when the
+# front door routes a request into a pool it stamps model/version on
+# the trace, and finish() flushes {model,version[,tenant]}-labeled
+# series alongside the decomposition — same cached-name + capped
+# cardinality scheme as _tenant_names. The cap bounds distinct
+# (model, version, tenant) label sets; past it new combinations
+# collapse into model="__other__".
+_MODEL_CAP = 64
+_MODEL_NAMES: Dict[Tuple[str, str, str, str],
+                   Tuple[str, str, str, str]] = {}
+
+
+def _model_names(kind: str, model: str, version: str,
+                 tenant: str) -> Tuple[str, str, str, str]:
+    orig_key = (kind, model, version, tenant)
+    got = _MODEL_NAMES.get(orig_key)
+    if got is not None:
+        return got
+    with _LOCK:
+        got = _MODEL_NAMES.get(orig_key)
+        if got is not None:
+            return got
+        if model != _TENANT_OVERFLOW and len(_MODEL_NAMES) >= _MODEL_CAP:
+            stat_add("STAT_tracing_model_overflow")
+            model, version, tenant = _TENANT_OVERFLOW, "", ""
+        key = (kind, model, version, tenant)
+        got = _MODEL_NAMES.get(key)
+        if got is None:
+            lbl = {"model": model, "version": version}
+            if tenant:
+                lbl["tenant"] = tenant
+            got = (labeled("TIMER_%s_total_us" % kind, lbl),
+                   labeled("STAT_%s_requests" % kind, lbl),
+                   labeled("STAT_%s_errors" % kind, lbl),
+                   labeled("STAT_%s_deadline_missed" % kind, lbl))
+            _MODEL_NAMES[key] = got
+        _MODEL_NAMES[orig_key] = got
+        return got
+
+
 class _NoopTrace:
     """Shared do-nothing trace: what ``begin()`` returns with
     FLAGS_request_tracing off. Callers thread it exactly like a real
@@ -165,6 +205,8 @@ class _NoopTrace:
     trace_id = None
     deadline_s = None
     tenant = None
+    model = None
+    version = None
 
     def stage(self, name: str) -> None:
         pass
@@ -197,19 +239,23 @@ class RequestTrace:
     happens-before edge already."""
 
     __slots__ = ("trace_id", "kind", "t0", "deadline_s", "tenant",
-                 "stages", "events", "tokens", "t_first_token",
-                 "t_last_token", "fields", "error", "_done",
-                 "_total_us", "_missed")
+                 "model", "version", "stages", "events", "tokens",
+                 "t_first_token", "t_last_token", "fields", "error",
+                 "_done", "_total_us", "_missed")
 
     def __init__(self, trace_id: str, kind: str,
                  deadline: Optional[float] = None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None,
+                 model: Optional[str] = None,
+                 version: Optional[str] = None):
         now = time.monotonic()
         self.trace_id = trace_id
         self.kind = kind
         self.t0 = now
         self.deadline_s = None if deadline is None else float(deadline)
         self.tenant = tenant
+        self.model = model
+        self.version = version
         self.stages: List[Tuple[str, float]] = [("submit", now)]
         self.events: List[Dict[str, Any]] = []
         self.tokens = 0
@@ -313,6 +359,17 @@ class RequestTrace:
                 stats.append((tn[3], 1.0))
             if self._missed:
                 stats.append((tn[4], 1.0))
+        if self.model:
+            # per-model/version attribution (front-door routing): same
+            # flush, cached labeled names (see _model_names)
+            mn = _model_names(self.kind, self.model,
+                              self.version or "", self.tenant or "")
+            timers.append((mn[0], total_us))
+            stats.append((mn[1], 1.0))
+            if self.error is not None:
+                stats.append((mn[2], 1.0))
+            if self._missed:
+                stats.append((mn[3], 1.0))
         observe_many(timers, stats)
         if self.error is not None:
             # errored requests join the flight recorder keyed by trace
@@ -337,6 +394,10 @@ class RequestTrace:
         }
         if self.tenant:
             rec["tenant"] = self.tenant
+        if self.model:
+            rec["model"] = self.model
+            if self.version:
+                rec["version"] = self.version
         if self.events:
             rec["events"] = list(self.events)
         if self.tokens:
@@ -353,16 +414,20 @@ class RequestTrace:
 
 
 def begin(kind: str, deadline: Optional[float] = None,
-          tenant: Optional[str] = None):
+          tenant: Optional[str] = None, model: Optional[str] = None,
+          version: Optional[str] = None):
     """Open a trace for one request. THE disabled fast path: exactly
     one flag lookup, returning the shared no-op trace. ``deadline`` is
     a latency budget in seconds from now (monotonic); ``tenant`` routes
     the request's counters/timers into labeled per-tenant series at
-    finish (capped cardinality, see _tenant_names)."""
+    finish (capped cardinality, see _tenant_names); ``model``/
+    ``version`` do the same for {model,version}-labeled series when the
+    request arrived through the serving front door (frontdoor.py)."""
     if not get_flag("FLAGS_request_tracing"):
         return NOOP_TRACE
     return RequestTrace("t%06d" % next(_NEXT_ID), kind,
-                        deadline=deadline, tenant=tenant)
+                        deadline=deadline, tenant=tenant,
+                        model=model, version=version)
 
 
 # ---------------------------------------------------------------------------
@@ -456,6 +521,7 @@ def reset() -> None:
         _CLEAN_FLOOR[0] = None
         _TENANT_NAMES.clear()
         _TENANT_SEEN.clear()
+        _MODEL_NAMES.clear()
 
 
 # ---------------------------------------------------------------------------

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Run the mesh-native SPMD runtime suite (-m spmd, docs/spmd.md) on the
-# 8-device virtual CPU mesh and emit MULTICHIP_r10.json: the usual
+# 8-device virtual CPU mesh and emit MULTICHIP_r11.json: the usual
 # multichip dryrun transcript (same shape as MULTICHIP_r0{1..9}.json)
 # plus the mesh plan, the per-axis host-collective census
 # (STAT_mesh_collective_<axis>, monitor.py), the chaos smoke
@@ -21,7 +21,11 @@
 # rank-targeted delay injection: heartbeat digests land, rank 1's
 # straggler score trips, /gangz and /statusz serve the per-rank view —
 # ISSUE 18; the full drill incl. the skew-SLO page/clear cycle runs in
-# the -m spmd pytest pass above as test_straggler_drill_real_gang).
+# the -m spmd pytest pass above as test_straggler_drill_real_gang),
+# and the frontdoor smoke (one fp32 SerializedCore predictor + one
+# int8 generation engine co-resident behind the FrontDoor:
+# tenant-quota rejection observed, hot-swap flip verified over live
+# /modelz JSON — ISSUE 20).
 #
 # Usage: scripts/run_spmd_tests.sh [extra pytest args...]
 set -u
@@ -37,7 +41,7 @@ echo "== spmd-marked tests (8 virtual CPU devices) =="
 python -m pytest tests/ -q -m spmd -p no:cacheprovider "$@"
 test_rc=$?
 
-echo "== multichip dryrun + mesh census -> MULTICHIP_r10.json =="
+echo "== multichip dryrun + mesh census -> MULTICHIP_r11.json =="
 python - "$test_rc" <<'EOF'
 import io
 import json
@@ -890,6 +894,114 @@ try:
 except Exception as e:  # noqa: BLE001 - artifact records the failure
     gang_obs["error"] = "%s: %s" % (type(e).__name__, e)
 
+# frontdoor smoke (ISSUE 20, docs/frontdoor.md): two co-resident
+# models in ONE process behind the FrontDoor — an fp32 predictor
+# served from an export_serialized() artifact through SerializedCore,
+# plus an int8-quantized GenerationEngine — with a tenant-quota
+# rejection observed (QuotaExceeded carrying a retry_after_s hint,
+# STAT_frontdoor_quota_rejected{model,tenant} bumped) and a graceful
+# hot-swap whose routing flip is verified over live /modelz JSON
+# (active_version v1 -> v2, zero dropped in-flight requests, the old
+# deployment drained to "retired").
+frontdoor_smoke = {"ok": False}
+try:
+    import os
+    import shutil
+    import tempfile
+    from paddle_tpu import frontdoor as fdoor
+    from paddle_tpu import quant as _fquant
+    from paddle_tpu.generation import (DecoderConfig, GenerationEngine,
+                                       GenerationRequest, init_params)
+
+    _ftmp = tempfile.mkdtemp(prefix="pt_frontdoor_smoke_")
+    fmain, fstartup = pt.Program(), pt.Program()
+    with pt.program_guard(fmain, fstartup):
+        fx = layers.data("x", [16])
+        fy = layers.fc(layers.fc(fx, 32, act="relu"), 4)
+    fexe = pt.Executor()
+    fexe.run(fstartup)
+    _fdir = os.path.join(_ftmp, "m")
+    pt.io.save_inference_model(_fdir, ["x"], [fy], fexe,
+                               main_program=fmain)
+    _xb = np.zeros((4, 16), np.float32)
+    _fart = os.path.join(_ftmp, "art")
+    pt.inference.create_predictor(
+        pt.inference.Config(_fdir)).export_serialized(_fart, [_xb])
+
+    _gcfg = DecoderConfig(vocab_size=64, hidden=32, layers=2, heads=2,
+                          max_seq_len=32)
+    _gq = _fquant.quantize_decoder_params(
+        init_params(_gcfg, seed=0), "int8")
+    fcat = fdoor.ModelCatalog([
+        fdoor.EndpointSpec(name="fc", kind="predictor", version="v1",
+                           model_dir=_fart, warmup_feeds=[_xb],
+                           workers=1, workers_min=1, workers_max=2,
+                           tenant_quota_rps={"metered": 2.0}),
+        fdoor.EndpointSpec(name="fc", kind="predictor", version="v2",
+                           model_dir=_fart, warmup_feeds=[_xb],
+                           workers=1, workers_min=1, workers_max=2),
+        fdoor.EndpointSpec(
+            name="lm", kind="generation", version="v1",
+            quant_mode="int8", workers=1, workers_min=1,
+            workers_max=2,
+            factory=lambda: GenerationEngine(
+                _gcfg, _gq, num_blocks=32, block_size=8,
+                decode_width=2, prefill_buckets="pow2:16",
+                prefill_chunk=8, prefix_cache=False,
+                quant_mode="int8", kv_dtype="int8")),
+    ])
+    door = fdoor.FrontDoor(fcat, autoscale=False)
+    try:
+        fc_out = door.run("fc", [_xb])
+        lm_out = door.run("lm", GenerationRequest(
+            prompt=[3, 5, 7, 9], max_new_tokens=4, request_id=0))
+        q_rej, retry_hint = 0, None
+        for _ in range(8):
+            try:
+                door.run("fc", [_xb], tenant="metered")
+            except fdoor.QuotaExceeded as e:
+                q_rej += 1
+                retry_hint = e.retry_after_s
+        inflight = [door.submit("fc", [_xb]) for _ in range(6)]
+        door.deploy("fc", "v2")
+        dropped = 0
+        for f in inflight:
+            try:
+                f.result(timeout=60.0)
+            except Exception:
+                dropped += 1
+        srv = introspect.start(port=0)
+        mz = json.load(urllib.request.urlopen(
+            srv.url + "/modelz?format=json", timeout=10))
+        mz_text = urllib.request.urlopen(
+            srv.url + "/modelz", timeout=10).read().decode()
+    finally:
+        introspect.stop()
+        door.close()
+        shutil.rmtree(_ftmp, ignore_errors=True)
+    fc_row = mz["models"]["fc"]
+    quota_ctr = sum(v for k, v in monitor.get_float_stats().items()
+                    if k.startswith("STAT_frontdoor_quota_rejected"))
+    frontdoor_smoke = {
+        "ok": (len(fc_out) == 1 and len(lm_out.tokens) > 0
+               and q_rej > 0 and quota_ctr >= q_rej and dropped == 0
+               and mz["enabled"] is True
+               and fc_row["active_version"] == "v2"
+               and fc_row["counters"]["swaps"] == 1
+               and fc_row["history"][-1]["state"] == "retired"
+               and mz["models"]["lm"]["quant_mode"] == "int8"
+               and "fc" in mz_text and "lm" in mz_text),
+        "fp32_predictor_serves": len(fc_out) == 1,
+        "int8_generation_tokens": len(lm_out.tokens),
+        "quota_rejected": q_rej,
+        "retry_after_s_hint": retry_hint,
+        "hot_swap_dropped_in_flight": dropped,
+        "modelz_active_version": fc_row["active_version"],
+        "modelz_swaps": fc_row["counters"]["swaps"],
+    }
+except Exception as e:  # noqa: BLE001 - artifact records the failure
+    frontdoor_smoke["error"] = "%s: %s" % (type(e).__name__, e)
+
 counters = monitor.get_float_stats()
 artifact = {
     "n_devices": len(jax.devices()),
@@ -901,7 +1013,8 @@ artifact = {
     and collective_quant.get("ok", False)
     and mp_collective_quant.get("ok", False)
     and slo_smoke.get("ok", False) and multihost.get("ok", False)
-    and gang_obs.get("ok", False),
+    and gang_obs.get("ok", False)
+    and frontdoor_smoke.get("ok", False),
     "skipped": False,
     "spmd_tests_rc": test_rc,
     "mesh_plan": {
@@ -921,13 +1034,14 @@ artifact = {
     "mp_collective_quant": mp_collective_quant,
     "slo": slo_smoke,
     "gang_observability": gang_obs,
+    "frontdoor": frontdoor_smoke,
     "collectives": {k: v for k, v in sorted(counters.items())
                     if k.startswith("STAT_mesh_collective_")},
     "mesh_counters": {k: v for k, v in sorted(counters.items())
                       if k.startswith("STAT_mesh_")},
     "tail": buf.getvalue() + ("" if err is None else err + "\n"),
 }
-with open("MULTICHIP_r10.json", "w") as f:
+with open("MULTICHIP_r11.json", "w") as f:
     json.dump(artifact, f, indent=1)
     f.write("\n")
 print(json.dumps({k: artifact[k] for k in
@@ -935,7 +1049,8 @@ print(json.dumps({k: artifact[k] for k in
                    "introspect", "chaos", "multihost", "generation",
                    "quant", "autotune", "collective_quant",
                    "mp_collective_quant", "slo",
-                   "gang_observability", "collectives")},
+                   "gang_observability", "frontdoor",
+                   "collectives")},
                  indent=1))
 sys.exit(0 if artifact["ok"] else 1)
 EOF

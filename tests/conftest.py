@@ -37,7 +37,7 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "spmd: mesh-native SPMD runtime tests (docs/spmd.md) "
         "— need the 8-device virtual mesh; scripts/run_spmd_tests.sh "
-        "runs just these and emits MULTICHIP_r10.json")
+        "runs just these and emits MULTICHIP_r11.json")
 
 
 def pytest_sessionstart(session):

@@ -1,0 +1,510 @@
+"""Front-door tests (ISSUE 20, docs/frontdoor.md).
+
+Covers the tentpole: catalog registration, priority-ordered dispatch,
+per-tenant token-bucket quotas, predicted-deadline and queue-full
+shedding with attributed counters, graceful hot-swap (in-flight
+finishes on the OLD version; an armed frontdoor.swap failpoint aborts
+with the pointer unflipped and no future hung), the autoscaler's
+up/down/veto decisions from the /sloz signal gauges, /modelz + the
+/statusz section, per-model SLO objective install/retract, and the
+flag-off one-read disabled path. Plus the satellites: ServingQueueFull
+parity across BOTH pool families and monitor.gauge_retract.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import failpoints, frontdoor, monitor, slo
+from paddle_tpu import tracing
+from paddle_tpu.frontdoor import (EndpointSpec, FrontDoor, ModelCatalog,
+                                  QuotaExceeded, SwapFailed,
+                                  UnknownModel)
+from paddle_tpu.monitor import get_float_stats, labeled, stat_get
+from paddle_tpu.serving import (DeadlineBurned, PredictorPool,
+                                ServingQueueFull)
+
+
+class _Core:
+    """Predictor-like dummy: records the marker value of every request
+    it executes, tagged with this core's version."""
+
+    feed_names = ["x"]
+    fetch_names = ["y"]
+
+    def __init__(self, version=1, delay_s=0.0):
+        self.version = version
+        self.delay_s = delay_s
+        self.seen = []
+        self.lock = threading.Lock()
+
+    def run(self, feeds):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        x = np.asarray(feeds[0])
+        with self.lock:
+            self.seen.append(float(x.flat[0]))
+        return [x * float(self.version)]
+
+
+def _spec(core, version="v1", **kw):
+    kw.setdefault("pool_kwargs", {"max_batch": 4,
+                                  "batch_timeout_ms": 1.0})
+    return EndpointSpec(name="toy", kind="predictor", version=version,
+                        factory=lambda: core, **kw)
+
+
+def _req(v=1.0, rows=1):
+    return [np.full((rows, 2), v, np.float32)]
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    assert frontdoor.active() is None, \
+        "a test leaked a live FrontDoor"
+    monitor.reset_all()
+    tracing.reset()
+    slo.disable()
+    slo.clear_objectives()
+
+
+# ---------------------------------------------------------------------------
+# flag-off pin + catalog
+# ---------------------------------------------------------------------------
+
+def test_flag_off_is_one_read_and_dark():
+    """FLAGS_frontdoor unset: no FrontDoor exists, active() is one
+    module-global read returning None, /modelz reports disabled, and
+    nothing frontdoor-related reaches the registry when the pools are
+    used directly (the opt-in contract of docs/MIGRATION.md)."""
+    assert not pt.get_flags(["FLAGS_frontdoor"])["FLAGS_frontdoor"]
+    assert frontdoor.active() is None
+    assert frontdoor.modelz() == {"enabled": False, "models": {}}
+    assert frontdoor.status_summary() == {"enabled": False}
+    assert "disabled" in frontdoor.modelz_text()
+    pool = PredictorPool(_Core(), max_batch=2, batch_timeout_ms=1.0)
+    try:
+        pool.run(_req())
+    finally:
+        pool.close()
+    assert not [k for k in get_float_stats() if "frontdoor" in k]
+
+
+def test_catalog_and_spec_validation():
+    c = ModelCatalog([_spec(_Core(), "v1"), _spec(_Core(2), "v2")])
+    assert c.names() == ["toy"]
+    assert c.versions("toy") == ["v1", "v2"]
+    assert c.get("toy").version == "v1"          # first registered
+    assert c.get("toy", "v2").version == "v2"
+    with pytest.raises(UnknownModel):
+        c.get("toy", "v9")
+    with pytest.raises(UnknownModel):
+        c.get("nope")
+    with pytest.raises(ValueError):
+        EndpointSpec(name="a", kind="bogus")
+    with pytest.raises(ValueError):
+        EndpointSpec(name="a", kind="generation")    # no factory
+    with pytest.raises(ValueError):
+        EndpointSpec(name="a", kind="predictor")     # no dir/factory
+
+
+# ---------------------------------------------------------------------------
+# admission: priority, quotas, shedding
+# ---------------------------------------------------------------------------
+
+def test_strict_priority_dequeue():
+    """With dispatch parked (0 workers), mixed-priority submissions
+    drain highest-priority-first, FIFO within a class — not FIFO
+    overall."""
+    core = _Core()
+    door = FrontDoor(ModelCatalog([_spec(core, workers=0,
+                                         workers_min=0)]),
+                     autoscale=False)
+    try:
+        futs = [door.submit("toy", _req(v), priority=p)
+                for v, p in [(1.0, 0), (2.0, 5), (3.0, 1), (4.0, 5)]]
+        door.set_workers("toy", 1)
+        for f in futs:
+            f.result(timeout=10.0)
+        assert core.seen == [2.0, 4.0, 3.0, 1.0], core.seen
+    finally:
+        door.close()
+
+
+def test_tenant_quota_token_bucket():
+    """burst = rate * FLAGS_frontdoor_quota_burst_s tokens up front,
+    then QuotaExceeded with a refill hint; other tenants unaffected;
+    rejections attributed per (model, tenant, reason)."""
+    door = FrontDoor(ModelCatalog([_spec(
+        _Core(), tenant_quota_rps={"limited": 1.0})]), autoscale=False)
+    try:
+        for _ in range(2):   # burst_s default 2.0 -> 2 tokens
+            door.run("toy", _req(), tenant="limited")
+        with pytest.raises(QuotaExceeded) as ei:
+            door.submit("toy", _req(), tenant="limited")
+        assert ei.value.tenant == "limited"
+        assert ei.value.retry_after_s > 0
+        door.run("toy", _req(), tenant="other")   # unlimited
+        assert stat_get(labeled("STAT_frontdoor_quota_rejected",
+                                {"model": "toy",
+                                 "tenant": "limited"})) == 1
+        assert stat_get(labeled(
+            "STAT_frontdoor_shed",
+            {"model": "toy", "tenant": "limited",
+             "reason": "quota"})) == 1
+        assert stat_get(labeled("STAT_frontdoor_shed_total",
+                                {"model": "toy"})) == 1
+        z = frontdoor.modelz()["models"]["toy"]
+        assert z["counters"]["quota_rejected"] == 1
+        assert z["counters"]["shed"] == {"quota": 1}
+    finally:
+        door.close()
+
+
+def test_predicted_deadline_shed_at_admit():
+    """A deadline the measured service distribution says cannot be met
+    is shed AT THE DOOR (DeadlineBurned), before occupying a queue
+    slot."""
+    door = FrontDoor(ModelCatalog([_spec(_Core())]), autoscale=False)
+    try:
+        door.run("toy", _req())          # prime the service EWMA
+        ep = door._endpoints["toy"]
+        ep.ewma_service_s = 0.5          # measured: ~500ms a request
+        with pytest.raises(DeadlineBurned):
+            door.submit("toy", _req(), deadline=0.01)
+        # a generous deadline still admits
+        assert door.run("toy", _req(), deadline=30.0)
+        assert stat_get(labeled(
+            "STAT_frontdoor_shed",
+            {"model": "toy", "tenant": "",
+             "reason": "deadline_predicted"})) == 1
+    finally:
+        door.close()
+
+
+def test_queue_full_rejects_immediately():
+    """The front door never blocks the caller: at the admission bound
+    submit() raises ServingQueueFull NOW, with the depth and a backoff
+    hint (the PR-9 contract)."""
+    door = FrontDoor(ModelCatalog([_spec(_Core(), workers=0,
+                                         workers_min=0,
+                                         queue_depth=2)]),
+                     autoscale=False)
+    try:
+        door.submit("toy", _req())
+        door.submit("toy", _req())
+        t0 = time.monotonic()
+        with pytest.raises(ServingQueueFull) as ei:
+            door.submit("toy", _req())
+        assert time.monotonic() - t0 < 0.2   # decided now, no wait
+        assert ei.value.queue_depth == 2
+        assert ei.value.retry_after_s > 0
+        assert stat_get(labeled(
+            "STAT_frontdoor_shed",
+            {"model": "toy", "tenant": "",
+             "reason": "queue_full"})) == 1
+        door.set_workers("toy", 1)           # drain before close
+    finally:
+        door.close()
+
+
+def test_admit_failpoint_counts_as_shed():
+    door = FrontDoor(ModelCatalog([_spec(_Core())]), autoscale=False)
+    try:
+        with failpoints.armed("frontdoor.admit=raise@once"):
+            with pytest.raises(failpoints.InjectedFault):
+                door.submit("toy", _req(), tenant="acme")
+        assert stat_get(labeled(
+            "STAT_frontdoor_shed",
+            {"model": "toy", "tenant": "acme",
+             "reason": "admit_fault"})) == 1
+        door.run("toy", _req())   # disarmed: serving again
+    finally:
+        door.close()
+
+
+def test_unknown_model():
+    door = FrontDoor(ModelCatalog([_spec(_Core())]), autoscale=False)
+    try:
+        with pytest.raises(UnknownModel):
+            door.submit("nope", _req())
+    finally:
+        door.close()
+
+
+# ---------------------------------------------------------------------------
+# hot-swap
+# ---------------------------------------------------------------------------
+
+def test_hot_swap_in_flight_finishes_on_old_version():
+    """deploy(name, v2) warms off-path, flips the pointer, drains v1:
+    a request in flight ON v1 completes with v1's output (never
+    dropped, never rerouted), and the next request runs on v2."""
+    v1, v2 = _Core(1, delay_s=0.3), _Core(2)
+    door = FrontDoor(ModelCatalog([_spec(v1, "v1")]), autoscale=False)
+    try:
+        fut = door.submit("toy", _req(7.0))
+        time.sleep(0.1)      # dispatcher is now inside v1's pool
+        door.register(_spec(v2, "v2"))    # register + hot-swap
+        out = fut.result(timeout=10.0)
+        assert np.allclose(out[0], 7.0), "in-flight must finish on v1"
+        assert 7.0 in v1.seen and 7.0 not in v2.seen
+        out2 = door.run("toy", _req(9.0))
+        assert np.allclose(out2[0], 18.0), "post-swap routes to v2"
+        z = frontdoor.modelz()["models"]["toy"]
+        assert z["active_version"] == "v2"
+        assert z["counters"]["swaps"] == 1
+        assert [h["version"] for h in z["history"]] == ["v1"]
+        assert z["history"][0]["state"] == "retired"
+        assert stat_get(labeled("STAT_frontdoor_swaps",
+                                {"model": "toy"})) == 1
+    finally:
+        door.close()
+
+
+def test_swap_failpoint_leaves_old_serving_nothing_hung():
+    """Satellite: an armed frontdoor.swap fault mid-deploy (after
+    warmup, before the flip) must leave the OLD version serving, the
+    routing pointer unflipped, and every in-flight future resolved —
+    typed error or completed result, never a hang."""
+    v1, v2 = _Core(1, delay_s=0.25), _Core(2)
+    door = FrontDoor(ModelCatalog([_spec(v1, "v1")]), autoscale=False)
+    try:
+        door.catalog.add(_spec(v2, "v2"))
+        fut = door.submit("toy", _req(5.0))
+        time.sleep(0.05)     # in flight on v1
+        with failpoints.armed("frontdoor.swap=raise@once"):
+            with pytest.raises(SwapFailed) as ei:
+                door.deploy("toy", "v2")
+        assert isinstance(ei.value.cause, failpoints.InjectedFault)
+        # the in-flight future resolved with v1's result — no hang
+        out = fut.result(timeout=10.0)
+        assert np.allclose(out[0], 5.0)
+        # pointer unflipped, v1 still serving, v2 never saw traffic
+        z = frontdoor.modelz()["models"]["toy"]
+        assert z["active_version"] == "v1"
+        assert z["history"][0].get("aborted") is True
+        out2 = door.run("toy", _req(3.0))
+        assert np.allclose(out2[0], 3.0)
+        assert v2.seen == []
+        assert stat_get(labeled("STAT_frontdoor_swap_aborted",
+                                {"model": "toy"})) == 1
+        assert stat_get(labeled("STAT_frontdoor_swaps",
+                                {"model": "toy"})) == 0
+        # the catalog still has v2: a later deploy succeeds
+        door.deploy("toy", "v2")
+        assert np.allclose(door.run("toy", _req(3.0))[0], 6.0)
+    finally:
+        door.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_scales_up_and_down():
+    """Queue pressure grows the worker count toward max; confirmed
+    idleness (two consecutive intervals) shrinks it toward min. Every
+    decision lands in STAT_frontdoor_scale_{up,down} + the decision
+    ring."""
+    pt.set_flags({"FLAGS_frontdoor_scale_cooldown_s": 0.0})
+    try:
+        door = FrontDoor(ModelCatalog([_spec(_Core(), workers=0,
+                                             workers_min=0,
+                                             workers_max=3)]),
+                         autoscale=False)
+        try:
+            futs = [door.submit("toy", _req(float(i)))
+                    for i in range(6)]
+            d1 = door.autoscale_once()   # depth 6 > 2*0 -> up
+            assert [d["action"] for d in d1] == ["scale_up"]
+            for f in futs:
+                f.result(timeout=10.0)
+            assert door.autoscale_once() == []   # idle streak 1
+            d3 = door.autoscale_once()           # streak 2 -> down
+            assert [d["action"] for d in d3] == ["scale_down"]
+            assert stat_get(labeled("STAT_frontdoor_scale_up",
+                                    {"model": "toy"})) == 1
+            assert stat_get(labeled("STAT_frontdoor_scale_down",
+                                    {"model": "toy"})) == 1
+            z = frontdoor.modelz()["models"]["toy"]
+            acts = [d["action"] for d in z["decisions"]]
+            assert acts == ["scale_up", "scale_down"]
+            assert z["counters"]["scale_up"] == 1
+            assert z["counters"]["scale_down"] == 1
+        finally:
+            door.close()
+    finally:
+        pt.set_flags({"FLAGS_frontdoor_scale_cooldown_s": 10.0})
+
+
+def test_autoscaler_generation_kv_veto():
+    """A generation endpoint with saturated TPOT but <10% KV-block
+    headroom must NOT scale up (more decode concurrency with no blocks
+    thrashes the KV pool) — the decision is recorded as a veto. With
+    headroom back, the same signals scale up."""
+    from paddle_tpu.generation import (DecoderConfig, GenerationEngine,
+                                       init_params)
+
+    def engine():
+        cfg = DecoderConfig(vocab_size=64, hidden=32, layers=2,
+                            heads=4, max_seq_len=32)
+        eng = GenerationEngine(cfg, init_params(cfg, seed=0),
+                               num_blocks=16, block_size=4,
+                               decode_width=2)
+        eng._warmed = True   # no compile-ahead in this test
+        return eng
+
+    pt.set_flags({"FLAGS_frontdoor_scale_cooldown_s": 0.0})
+    try:
+        door = FrontDoor(ModelCatalog([EndpointSpec(
+            name="lm", kind="generation", factory=engine,
+            quant_mode="int8", workers=1, workers_max=3,
+            pool_kwargs={})]), autoscale=False)
+        try:
+            monitor.gauge_set("GAUGE_slo_tpot_saturation", 2.0)
+            monitor.gauge_set("GAUGE_slo_kv_block_headroom", 0.05)
+            d1 = door.autoscale_once()
+            assert [d["action"] for d in d1] == ["up_vetoed_kv"]
+            assert door._endpoints["lm"].workers_target == 1
+            monitor.gauge_set("GAUGE_slo_kv_block_headroom", 0.9)
+            d2 = door.autoscale_once()
+            assert [d["action"] for d in d2] == ["scale_up"]
+            assert door._endpoints["lm"].workers_target == 2
+        finally:
+            door.close()
+    finally:
+        pt.set_flags({"FLAGS_frontdoor_scale_cooldown_s": 10.0})
+
+
+# ---------------------------------------------------------------------------
+# surfaces: /modelz, /statusz, SLO objectives, labeled series
+# ---------------------------------------------------------------------------
+
+def test_modelz_http_and_statusz_section():
+    from paddle_tpu import introspect
+    door = FrontDoor(ModelCatalog([_spec(_Core())]), autoscale=False)
+    srv = introspect.start(port=0)
+    try:
+        door.run("toy", _req(), tenant="acme")
+        txt = urllib.request.urlopen(srv.url + "/modelz",
+                                     timeout=5).read().decode()
+        assert "toy@v1" in txt and "routed=1" in txt
+        z = json.loads(urllib.request.urlopen(
+            srv.url + "/modelz?format=json", timeout=5).read())
+        assert z["enabled"] and z["models"]["toy"]["kind"] == "predictor"
+        st = json.loads(urllib.request.urlopen(
+            srv.url + "/statusz", timeout=5).read())
+        assert st["frontdoor"]["enabled"]
+        assert st["frontdoor"]["models"]["toy"]["version"] == "v1"
+        idx = urllib.request.urlopen(srv.url + "/",
+                                     timeout=5).read().decode()
+        assert "/modelz" in idx
+    finally:
+        introspect.stop()
+        door.close()
+    # closed: the surface goes dark
+    assert frontdoor.modelz() == {"enabled": False, "models": {}}
+
+
+def test_slo_objectives_installed_and_retracted():
+    """Satellite: registration installs per-model p95 + shed-ratio
+    objectives; retirement unregisters them AND retracts their gauges
+    (they used to accrete forever)."""
+    slo.enable(bucket_s=0.5, n_buckets=20)
+    door = FrontDoor(ModelCatalog([_spec(_Core())]), autoscale=False)
+    try:
+        names = {o.name for o in slo.objectives()}
+        assert {"frontdoor_toy_p95", "frontdoor_toy_shed"} <= names
+        door.run("toy", _req())
+        assert slo.evaluate() is not None   # evaluates cleanly
+        snap = monitor.snapshot()["gauges"]
+        assert any("frontdoor_toy" in k for k in snap), snap.keys()
+    finally:
+        door.close()
+    names = {o.name for o in slo.objectives()}
+    assert not [n for n in names if n.startswith("frontdoor_toy")]
+    snap = monitor.snapshot()["gauges"]
+    assert not [k for k in snap if "frontdoor_toy" in k], \
+        "objective gauges must be retracted on retirement"
+    assert not [k for k in snap
+                if k.startswith("GAUGE_frontdoor_")], \
+        "endpoint gauges must be retracted on retirement"
+
+
+def test_model_version_tenant_labeled_series():
+    """Routing through the front door flushes {model,version,tenant}
+    labeled series from the pool trace (tracing._model_names path)."""
+    door = FrontDoor(ModelCatalog([_spec(_Core())]), autoscale=False)
+    try:
+        door.run("toy", _req(), tenant="acme")
+        stats = get_float_stats()
+        key = labeled("STAT_serving_requests",
+                      {"model": "toy", "version": "v1",
+                       "tenant": "acme"})
+        assert stats.get(key) == 1, [k for k in stats if "toy" in k]
+        assert stat_get(labeled("STAT_frontdoor_routed",
+                                {"model": "toy",
+                                 "version": "v1"})) == 1
+    finally:
+        door.close()
+
+
+# ---------------------------------------------------------------------------
+# satellites: queue-full parity across pool families, gauge_retract
+# ---------------------------------------------------------------------------
+
+def _full_predictor_pool():
+    pool = PredictorPool(_Core(), max_batch=2, batch_timeout_ms=1.0,
+                         queue_depth=2, _start=False)
+    pool.submit(_req())
+    pool.submit(_req())
+    return pool, lambda: pool.submit(_req(), timeout=0.05)
+
+
+def _full_generation_pool():
+    from paddle_tpu.generation import GenerationRequest
+    from paddle_tpu.generation.scheduler import GenerationPool
+
+    class _Eng:   # ctor only touches .on_request_error before start
+        decode_width = 2
+
+    pool = GenerationPool(_Eng(), queue_depth=2, _start=False)
+    req = GenerationRequest(prompt=[1, 2], max_new_tokens=2)
+    pool.submit(req)
+    pool.submit(req)
+    return pool, lambda: pool.submit(req, timeout=0.05)
+
+
+@pytest.mark.parametrize("make", [_full_predictor_pool,
+                                  _full_generation_pool],
+                         ids=["predictor", "generation"])
+def test_queue_full_carries_depth_and_retry_hint(make):
+    """ONE shared pin for BOTH pool families: ServingQueueFull carries
+    queue_depth + retry_after_s (PR 9 added it serving-side; the
+    generation pool must stay in parity — the front door's backoff
+    hints depend on it)."""
+    pool, overflow = make()
+    try:
+        with pytest.raises(ServingQueueFull) as ei:
+            overflow()
+        assert ei.value.queue_depth == 2
+        assert ei.value.retry_after_s > 0
+    finally:
+        pool.close()
+
+
+def test_gauge_retract():
+    monitor.gauge_set("GAUGE_t_retract_a", 1.0)
+    monitor.gauge_set("GAUGE_t_retract_b", 2.0)
+    assert monitor.gauge_retract("GAUGE_t_retract_a",
+                                 "GAUGE_t_retract_missing") == 1
+    snap = monitor.snapshot()["gauges"]
+    assert "GAUGE_t_retract_a" not in snap
+    assert snap["GAUGE_t_retract_b"] == 2.0
+    monitor.gauge_retract("GAUGE_t_retract_b")

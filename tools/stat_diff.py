@@ -57,7 +57,7 @@ COST_SUFFIXES = ("_sync", "_miss", "_corrupt", "_evict", "_dropped",
 # sample is a reading, not an accumulation).
 COST_INFIXES = ("_shed_", "_restart", "_kv_quant_", "_autotune_",
                 "_collective_quant_", "_gang_", "_step_phase_",
-                "_digest_")
+                "_digest_", "_frontdoor_")
 # cost-family exemptions: STAT_autotune_cache_hits is the HEALTHY
 # autotune steady state (policy resolved from the table, no trials
 # run) — growth there is good. Growth in the rest of the _autotune_
@@ -80,10 +80,22 @@ COST_INFIXES = ("_shed_", "_restart", "_kv_quant_", "_autotune_",
 # groups faulted to fp32) stay costs under the _collective_quant_
 # infix: either one growing in a steady-state run means sharded
 # params quietly left the quantized wire.
+# Front-door (docs/frontdoor.md): _shed_ / _quota_rejected_ growth is a
+# cost (deadlines burned, tenants throttled — the admission layer is
+# rejecting work). Routing hits, completed swaps, and autoscale
+# decisions are the HEALTHY steady state of a live front door: requests
+# flowing, deployments flipping, the control loop reacting — growth
+# there is good, so those families are exempt.
 COST_EXEMPT_SUFFIXES = ("_autotune_cache_hits",
                         "_collective_quant_buckets",
                         "_collective_quant_mp_gathers",
-                        "_gang_digest_beats")
+                        "_gang_digest_beats",
+                        "_frontdoor_requests",
+                        "_frontdoor_requests_total",
+                        "_frontdoor_routed",
+                        "_frontdoor_swaps",
+                        "_frontdoor_scale_up",
+                        "_frontdoor_scale_down")
 
 
 def _family(name: str) -> str:
